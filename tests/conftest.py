@@ -1,0 +1,43 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+The reference tests only single-box process parallelism (SURVEY.md §4);
+this framework's multi-chip paths are validated on a forced-host CPU mesh
+(`--xla_force_host_platform_device_count=8`), with the real TPU exercised by
+bench.py and the driver's dryrun.
+"""
+
+import os
+
+# Force CPU for tests even when the environment presets a TPU platform
+# (e.g. JAX_PLATFORMS=axon); the real chip is exercised by bench.py only.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def get_resource_dir(test_file: str) -> pathlib.Path:
+    """Map tests/<tier>/<name>.py → tests/resources/<tier>/<name>/ (reference convention, conftest.py:1-9)."""
+    p = pathlib.Path(test_file).resolve()
+    tests_root = p
+    while tests_root.name != "tests":
+        tests_root = tests_root.parent
+    rel = p.relative_to(tests_root).with_suffix("")
+    return tests_root / "resources" / rel
+
+
+@pytest.fixture
+def resource_dir(request):
+    d = get_resource_dir(str(request.fspath))
+    d.mkdir(parents=True, exist_ok=True)
+    return d

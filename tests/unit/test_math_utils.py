@@ -1,0 +1,42 @@
+import numpy as np
+import pytest
+
+from variantcalling_tpu.utils import math_utils
+
+
+def test_safe_divide():
+    assert math_utils.safe_divide(1, 2) == 0.5
+    assert math_utils.safe_divide(1, 0) == 0
+    assert math_utils.safe_divide(1, 0, return_if_denominator_is_0=7) == 7
+
+
+def test_phred_unphred_roundtrip():
+    p = np.array([1.0, 0.1, 0.01, 0.5])
+    q = math_utils.phred(p)
+    np.testing.assert_allclose(q, [0.0, 10.0, 20.0, 3.0103], atol=1e-4)
+    np.testing.assert_allclose(math_utils.unphred(q), p, atol=1e-12)
+
+
+def test_unphred_float_scalar():
+    assert math_utils.unphred(10.0) == pytest.approx(0.1)
+
+
+def test_phred_str_roundtrip():
+    p = [0.1, 0.01, 0.001]
+    s = math_utils.phred_str(p)
+    assert s == "+5?"
+    np.testing.assert_allclose(math_utils.unphred_str(s), p, atol=1e-12)
+
+
+def test_jax_math_matches_host():
+    import jax.numpy as jnp
+
+    from variantcalling_tpu.ops import math as jmath
+
+    p = np.array([1.0, 0.1, 0.003, 0.57])
+    np.testing.assert_allclose(np.asarray(jmath.phred(jnp.array(p))), math_utils.phred(p), rtol=1e-4)
+    q = np.array([0.0, 13.0, 45.0])
+    np.testing.assert_allclose(np.asarray(jmath.unphred(jnp.array(q))), math_utils.unphred(q), rtol=5e-4)
+    num = jnp.array([1.0, 2.0, 3.0])
+    den = jnp.array([2.0, 0.0, 4.0])
+    np.testing.assert_allclose(np.asarray(jmath.safe_divide(num, den)), [0.5, 0.0, 0.75])

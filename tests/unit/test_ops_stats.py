@@ -1,0 +1,82 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from variantcalling_tpu.ops import stats as jstats
+from variantcalling_tpu.utils import stats_utils as hstats
+
+
+def test_batched_multinomial_matches_host():
+    actual = np.array([[4, 4, 4], [4, 4, 40], [10, 10, 10], [1, 10, 40]])
+    expected = np.array([[4, 4, 4], [40, 40, 40], [1, 10, 40], [1, 10, 40]])
+    lik, ratio = jstats.multinomial_likelihood_ratio(jnp.array(actual), jnp.array(expected))
+    for i in range(len(actual)):
+        l_ref, r_ref = hstats.multinomial_likelihood_ratio(list(actual[i]), list(expected[i]))
+        # device kernels run f32 by default; ratios agree to ~1e-3
+        assert float(lik[i]) == pytest.approx(l_ref, rel=5e-3)
+        assert float(ratio[i]) == pytest.approx(r_ref, rel=5e-3)
+
+
+def test_batched_scale_contingency_table():
+    tables = jnp.array([[1, 1, 1], [10, 20, 25], [0, 0, 0]])
+    n = jnp.array([5, 100, 10])
+    out = np.asarray(jstats.scale_contingency_table(tables, n))
+    np.testing.assert_array_equal(out[0], [2, 2, 2])
+    np.testing.assert_array_equal(out[1], [18, 36, 45])
+    np.testing.assert_array_equal(out[2], [0, 0, 0])
+
+
+def test_confusion_counts():
+    calls = jnp.array([True, True, False, False, True])
+    truth = jnp.array([True, False, True, False, True])
+    tp, fp, fn = jstats.confusion_counts(calls, truth, fn_extra=2)
+    assert (int(tp), int(fp), int(fn)) == (2, 1, 3)
+
+
+def test_precision_recall_curve_dense_basic():
+    labels = jnp.array([0, 1] * 50, dtype=bool)
+    scores = jnp.array([0.1, 0.8] * 50)
+    curve = jstats.precision_recall_curve_dense(labels, scores)
+    # at rank 50 (all 0.8-scored true calls) precision=1, recall=1
+    assert float(curve["precision"][49]) == pytest.approx(1.0)
+    assert float(curve["recall"][49]) == pytest.approx(1.0)
+    assert float(curve["f1"][49]) == pytest.approx(1.0)
+    # FN mass reduces recall
+    curve = jstats.precision_recall_curve_dense(labels, scores, fn_count=50)
+    assert float(curve["recall"][49]) == pytest.approx(0.5)
+
+
+def test_precision_recall_curve_dense_padding():
+    labels = jnp.array([1, 1, 0, 1], dtype=bool)
+    scores = jnp.array([0.9, 0.8, 0.7, 0.6])
+    valid = jnp.array([True, True, True, False])
+    curve = jstats.precision_recall_curve_dense(labels, scores, valid=valid)
+    assert bool(curve["valid"][2]) and not bool(curve["valid"][3])
+    assert float(curve["precision"][2]) == pytest.approx(2 / 3)
+    assert float(curve["recall"][2]) == pytest.approx(1.0)
+
+
+def test_pl_to_gq_gt_and_normalize():
+    from variantcalling_tpu.ops import genotypes as g
+
+    pl = jnp.array([[30.0, 0.0, 40.0], [10.0, 20.0, 5.0]])
+    gq, gt_idx = g.pl_to_gq_gt(pl)
+    np.testing.assert_array_equal(np.asarray(gt_idx), [1, 2])
+    np.testing.assert_allclose(np.asarray(gq), [30.0, 5.0])
+    norm = np.asarray(g.normalize_pl(pl))
+    np.testing.assert_array_equal(norm, [[30, 0, 40], [5, 15, 0]])
+
+
+def test_genotype_ordering():
+    from variantcalling_tpu.ops.genotypes import genotype_index, genotype_ordering, n_genotypes
+
+    np.testing.assert_array_equal(genotype_ordering(1), [[0, 0], [0, 1], [1, 1]])
+    np.testing.assert_array_equal(
+        genotype_ordering(2), [[0, 0], [0, 1], [1, 1], [0, 2], [1, 2], [2, 2]]
+    )
+    for a in range(1, 5):
+        go = genotype_ordering(a)
+        assert go.shape[0] == n_genotypes(a)
+        idx = np.asarray(genotype_index(jnp.array(go[:, 0]), jnp.array(go[:, 1])))
+        np.testing.assert_array_equal(idx, np.arange(go.shape[0]))

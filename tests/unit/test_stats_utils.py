@@ -1,0 +1,108 @@
+"""Hand-computed parity tests ported from the reference's
+test/unit/utils/test_stats_utils.py (same expected values, new implementation)."""
+
+import numpy as np
+import pytest
+
+from variantcalling_tpu.utils.stats_utils import (
+    correct_multinomial_frequencies,
+    get_f1,
+    get_precision,
+    get_recall,
+    multinomial_likelihood,
+    multinomial_likelihood_ratio,
+    precision_recall_curve,
+    scale_contingency_table,
+)
+
+
+def test_scale_contingency_table():
+    table = [1, 1, 1]
+    assert scale_contingency_table(table, 2) == [1, 1, 1]
+    assert scale_contingency_table(table, 5) == [2, 2, 2]
+    assert scale_contingency_table(table, 9) == [3, 3, 3]
+    assert scale_contingency_table([10, 10, 10], 2) == [1, 1, 1]
+    assert scale_contingency_table([10, 20, 25], 100) == [18, 36, 45]
+    assert scale_contingency_table([10, 20, 25], 10) == [2, 4, 5]
+    assert scale_contingency_table([0, 0, 0], 10) == [0, 0, 0]
+
+
+def test_correct_multinomial_frequencies():
+    np.testing.assert_array_equal(np.array([1, 1, 1]) / 3, correct_multinomial_frequencies([10, 10, 10]))
+    np.testing.assert_array_equal(np.array([11, 11, 1]) / 23, correct_multinomial_frequencies([10, 10, 0]))
+
+
+def test_multinomial_likelihood():
+    assert multinomial_likelihood([4, 4, 4], [4, 4, 4]) == pytest.approx(0.0652, abs=1e-3)
+    assert multinomial_likelihood([4, 4, 4], [40, 40, 40]) == pytest.approx(0.0652, abs=1e-3)
+    assert multinomial_likelihood([40, 40, 40], [40, 40, 40]) == pytest.approx(0.0068, abs=1e-3)
+    assert multinomial_likelihood([4, 4, 40], [4, 4, 4]) == pytest.approx(3.3e-13, abs=1e-10)
+    assert multinomial_likelihood([10, 10, 10], [1, 10, 40]) == pytest.approx(2.1e-10, abs=1e-10)
+    assert multinomial_likelihood([40, 10, 1], [1, 10, 40]) == pytest.approx(2.7e-53, abs=1e-40)
+    assert multinomial_likelihood([1, 10, 40], [1, 10, 40]) == pytest.approx(0.039, abs=1e-3)
+    assert multinomial_likelihood([4, 4, 4], [4, 4, 0]) == pytest.approx(0.0043, abs=1e-3)
+    assert multinomial_likelihood([4, 4, 40], [0, 0, 0]) == pytest.approx(3.3e-13, abs=1e-3)
+
+
+def test_multinomial_likelihood_ratio():
+    assert multinomial_likelihood_ratio([4, 4, 4], [4, 4, 4])[1] == pytest.approx(1, abs=1e-3)
+    assert multinomial_likelihood_ratio([4, 4, 40], [4, 4, 4])[1] == pytest.approx(3.3e-13, abs=1e-10)
+    assert multinomial_likelihood_ratio([10, 10, 10], [1, 10, 40])[1] == pytest.approx(7.8e-9, abs=1e-10)
+    assert multinomial_likelihood_ratio([40, 10, 1], [1, 10, 40])[1] == pytest.approx(6.9e-52, abs=1e-40)
+    assert multinomial_likelihood_ratio([4, 4, 4], [4, 4, 0])[1] == pytest.approx(0.0661, abs=1e-3)
+    assert multinomial_likelihood_ratio([4, 4, 40], [0, 0, 0])[1] == pytest.approx(9.1e-12, abs=1e-10)
+
+
+def test_get_precision_recall_f1():
+    assert get_precision(100, 900) == pytest.approx(0.9)
+    assert get_precision(1, 900) == pytest.approx(0.99889, abs=1e-5)
+    assert get_precision(0, 0) == 1
+    assert get_recall(100, 900) == pytest.approx(0.9)
+    assert get_recall(1, 900) == pytest.approx(0.99889, abs=1e-5)
+    assert get_f1(recall=0.99, precision=0.9) == pytest.approx(0.942857, abs=1e-5)
+    assert get_f1(recall=0.5, precision=0.9) == pytest.approx(0.642857, abs=1e-5)
+    assert np.isnan(get_f1(np.nan, 0.5))
+
+
+def test_precision_recall_curve():
+    labels = np.array([0, 1] * 50)
+    scores = np.array([0.1, 0.8] * 50)
+    precision, recalls, f1, predictions = precision_recall_curve(
+        labels, scores, fn_mask=np.zeros_like(scores, dtype=bool), pos_label=1, min_class_counts_to_output=1
+    )
+    assert len(precision) == 1
+    assert len(f1) == 1
+    assert max(f1) == pytest.approx(1)
+
+    labels = np.array([0, 1] * 50 + [1] * 10)
+    scores = np.array([0.1, 0.8] * 50 + [-1] * 10)
+    precision, recalls, f1, predictions = precision_recall_curve(
+        labels,
+        scores,
+        np.concatenate((np.zeros(100, dtype=bool), np.ones(10, dtype=bool))),
+        pos_label=1,
+        min_class_counts_to_output=1,
+    )
+    assert len(precision) == 1
+    assert len(f1) == 1
+    assert max(f1) == pytest.approx(0.909090909)
+
+    precision, recalls, f1, predictions = precision_recall_curve(
+        [], [], np.array([]), pos_label=1, min_class_counts_to_output=1
+    )
+    assert len(precision) == 0
+    assert len(f1) == 0
+
+
+def test_binary_clf_curve_matches_sklearn(rng):
+    from sklearn import metrics as skm
+
+    from variantcalling_tpu.utils.stats_utils import _precision_recall_points
+
+    labels = rng.integers(0, 2, size=500).astype(bool)
+    scores = np.round(rng.random(500), 2)  # ties on purpose
+    p_ref, r_ref, t_ref = skm.precision_recall_curve(labels, scores, pos_label=True)
+    p, r, t = _precision_recall_points(labels, scores)
+    np.testing.assert_allclose(p, p_ref)
+    np.testing.assert_allclose(r, r_ref)
+    np.testing.assert_allclose(t, t_ref)

@@ -1,0 +1,30 @@
+"""variantcalling_tpu — a TPU-native (JAX/XLA/Pallas/pjit) variant-calling post-processing framework.
+
+Re-founds the capabilities of Ultimagen/VariantCalling (``ugvc``, reference at
+``/root/reference``) on a columnar-tensor + JAX execution model:
+
+- host-side VCF/BED/FASTA/BAM ingest into padded columnar numpy batches
+  (:mod:`variantcalling_tpu.io`),
+- device-side batched kernels for per-variant featurization, classifier
+  inference/training, coverage reductions and SEC cohort statistics
+  (:mod:`variantcalling_tpu.ops`, :mod:`variantcalling_tpu.models`),
+- a mesh/sharding layer (:mod:`variantcalling_tpu.parallel`) replacing the
+  reference's joblib/process fan-out (ref ``SURVEY.md`` §2.4) with
+  ``jax.sharding`` + ``shard_map`` collectives,
+- per-tool CLI pipelines mirroring the reference's argparse surfaces
+  (:mod:`variantcalling_tpu.pipelines`).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+__version__ = "0.1.0"
+
+logger = logging.getLogger("vctpu")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)

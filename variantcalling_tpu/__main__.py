@@ -1,0 +1,59 @@
+"""CLI dispatch: ``python -m variantcalling_tpu <tool> <args>`` (or ``vctpu <tool>``).
+
+Mirrors the reference's ugvc CLI surface (ugvc/__main__.py:43-105): each tool
+is a module exposing ``run(argv)`` with its own argparse parser; tools are
+lazily imported so the CLI stays fast and optional heavy deps stay optional.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+# tool name -> module path (module must expose run(argv))
+TOOLS: dict[str, str] = {
+    "filter_variants_pipeline": "variantcalling_tpu.pipelines.filter_variants",
+    "train_models_pipeline": "variantcalling_tpu.pipelines.train_models",
+    "training_prep_pipeline": "variantcalling_tpu.pipelines.training_prep",
+    "run_comparison_pipeline": "variantcalling_tpu.pipelines.run_comparison",
+    "evaluate_concordance": "variantcalling_tpu.pipelines.evaluate_concordance",
+    "coverage_analysis": "variantcalling_tpu.pipelines.coverage_analysis",
+    "correct_systematic_errors": "variantcalling_tpu.pipelines.sec.correct_systematic_errors",
+    "sec_training": "variantcalling_tpu.pipelines.sec.sec_training",
+    "correct_genotypes_by_imputation": "variantcalling_tpu.pipelines.correct_genotypes_by_imputation",
+    "convert_haploid_regions": "variantcalling_tpu.pipelines.convert_haploid_regions",
+    "compress_gvcf": "variantcalling_tpu.pipelines.compress_gvcf",
+    "cleanup_gvcf_before_calling": "variantcalling_tpu.pipelines.cleanup_gvcf_before_calling",
+    "gvcf_hcr": "variantcalling_tpu.pipelines.gvcf_hcr",
+    "denovo_recalibrated_qualities": "variantcalling_tpu.pipelines.denovo_recalibrated_qualities",
+    "quick_fingerprinting": "variantcalling_tpu.pipelines.quick_fingerprinting",
+    "sv_stats_collect": "variantcalling_tpu.pipelines.sv_stats_collect",
+    "run_no_gt_report": "variantcalling_tpu.pipelines.run_no_gt_report",
+    "vcfeval_flavors": "variantcalling_tpu.pipelines.vcfeval_flavors",
+}
+
+_LOGO = "variantcalling-tpu (vctpu) — TPU-native variant-calling post-processing"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in {"-h", "--help"}:
+        print(_LOGO)
+        print("usage: python -m variantcalling_tpu <tool> [tool args]\n\ntools:")
+        for name in sorted(TOOLS):
+            print(f"  {name}")
+        return 0
+    tool = argv[0]
+    if tool not in TOOLS:
+        print(f"unknown tool: {tool!r}; run with --help for the tool list", file=sys.stderr)
+        return 2
+    try:
+        module = importlib.import_module(TOOLS[tool])
+    except ModuleNotFoundError as e:
+        print(f"tool {tool!r} is not available yet: {e}", file=sys.stderr)
+        return 3
+    return int(module.run(argv[1:]) or 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Genotype-layout math: VCF PL ordering tensors and PL/GQ/GT kernels.
+
+The VCF spec orders diploid genotype likelihoods as (j,k) for k in 0..A,
+j in 0..k (index = k*(k+1)/2 + j). The reference materializes this as
+``genotype_ordering`` (ugbio_core.vcfbed.vcftools, used at
+correct_genotypes_by_imputation.py:228 and the haploid converter); here the
+ordering is a static numpy tensor per alt-count so ragged per-variant PL
+vectors can be padded into fixed (variants × G) tensors for vmap.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from variantcalling_tpu.ops.math import phred, unphred
+
+
+@functools.lru_cache(maxsize=32)
+def genotype_ordering(num_alt: int) -> np.ndarray:
+    """(G, 2) int array of diploid genotypes in VCF PL order; G=(A+1)(A+2)/2.
+
+    Row g = (j, k) with j<=k; parity with ugbio_core.vcfbed.vcftools
+    ``genotype_ordering`` as exercised by
+    test_correct_genotypes_by_imputation.py:12 (num_alt=1 →
+    [[0,0],[0,1],[1,1]]).
+    """
+    rows = []
+    for k in range(num_alt + 1):
+        for j in range(k + 1):
+            rows.append((j, k))
+    return np.asarray(rows, dtype=np.int32)
+
+
+def n_genotypes(num_alt: int) -> int:
+    return (num_alt + 1) * (num_alt + 2) // 2
+
+
+def genotype_index(j: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """PL index of unordered genotype {j,k} (elementwise)."""
+    lo = jnp.minimum(j, k)
+    hi = jnp.maximum(j, k)
+    return hi * (hi + 1) // 2 + lo
+
+
+def pl_to_gq_gt(pl: jnp.ndarray, valid: jnp.ndarray | None = None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched (GQ, argmin-genotype-index) from PL tensors (…, G).
+
+    GQ = second-smallest PL − smallest PL (capped at 99 by callers when
+    writing); padding slots are masked with +inf.
+    """
+    pl = jnp.asarray(pl, dtype=jnp.result_type(float))
+    if valid is not None:
+        pl = jnp.where(valid, pl, jnp.inf)
+    gt_idx = jnp.argmin(pl, axis=-1)
+    smallest2 = -jax.lax.top_k(-pl, 2)[0]
+    gq = smallest2[..., 1] - smallest2[..., 0]
+    return gq, gt_idx
+
+
+def normalize_pl(pl: jnp.ndarray, valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Shift PLs so the minimum is 0 (standard VCF normalization), rounded to int."""
+    pl = jnp.asarray(pl, dtype=jnp.result_type(float))
+    masked = jnp.where(valid, pl, jnp.inf) if valid is not None else pl
+    shifted = pl - jnp.min(masked, axis=-1, keepdims=True)
+    return jnp.rint(shifted).astype(jnp.int32)
+
+
+__all__ = [
+    "genotype_ordering",
+    "n_genotypes",
+    "genotype_index",
+    "pl_to_gq_gt",
+    "normalize_pl",
+    "phred",
+    "unphred",
+]

@@ -1,0 +1,36 @@
+"""Batched JAX math kernels: phred transforms and guarded division.
+
+Device-batched counterparts of :mod:`variantcalling_tpu.utils.math_utils`
+(parity target ugvc/utils/math_utils.py). All functions are jit-safe,
+shape-polymorphic over leading batch axes, and differentiable where that
+makes sense.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+# plain Python float: keeps import free of JAX backend initialization
+_LN10_OVER_10 = math.log(10.0) / 10.0
+
+
+def phred(p: jnp.ndarray) -> jnp.ndarray:
+    """Probabilities -> Phred scores, elementwise: ``-10*log10(p)``."""
+    return -10.0 * jnp.log10(p)
+
+
+def unphred(q: jnp.ndarray) -> jnp.ndarray:
+    """Phred scores -> probabilities, elementwise: ``10**(-q/10)``."""
+    return jnp.exp(-jnp.asarray(q, dtype=jnp.result_type(float)) * _LN10_OVER_10)
+
+
+def safe_divide(numerator: jnp.ndarray, denominator: jnp.ndarray, fill: float = 0.0) -> jnp.ndarray:
+    """Elementwise division returning ``fill`` where the denominator is 0.
+
+    NaN-safe under jit (uses a double-where to keep gradients finite).
+    """
+    denom_ok = denominator != 0
+    safe_denom = jnp.where(denom_ok, denominator, 1)
+    return jnp.where(denom_ok, numerator / safe_denom, fill)

@@ -1,0 +1,137 @@
+"""Batched JAX statistics kernels.
+
+Device-side counterparts of :mod:`variantcalling_tpu.utils.stats_utils`
+(parity target ugvc/utils/stats_utils.py). Everything here is jit-safe and
+batched over a leading axis so that, e.g., the SEC systematic-error test can
+score millions of loci as one fused reduction instead of the reference's
+per-locus scipy calls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from variantcalling_tpu.ops.math import safe_divide
+
+
+def correct_multinomial_frequencies(counts: jnp.ndarray) -> jnp.ndarray:
+    """Add-one-corrected category frequencies along the last axis."""
+    corrected = counts + 1.0
+    return corrected / jnp.sum(corrected, axis=-1, keepdims=True)
+
+
+def multinomial_log_pmf(x: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """log PMF of counts ``x`` (…, K) under category probabilities ``p`` (…, K)."""
+    x = jnp.asarray(x, dtype=jnp.result_type(float))
+    n = jnp.sum(x, axis=-1)
+    coeff = gammaln(n + 1.0) - jnp.sum(gammaln(x + 1.0), axis=-1)
+    logp = jnp.sum(jnp.where(x > 0, x * jnp.log(p), 0.0), axis=-1)
+    return coeff + logp
+
+
+def multinomial_likelihood(actual: jnp.ndarray, expected: jnp.ndarray) -> jnp.ndarray:
+    """Batched likelihood of ``actual`` under add-one-corrected fit to ``expected``.
+
+    Parity: stats_utils.py:48-63, vectorized over leading axes.
+    """
+    return jnp.exp(multinomial_log_pmf(actual, correct_multinomial_frequencies(expected)))
+
+
+def multinomial_likelihood_ratio(actual: jnp.ndarray, expected: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched (likelihood, likelihood-ratio vs self-fit). Parity: stats_utils.py:66-70.
+
+    Computed in log space for numerical stability at high depth.
+    """
+    log_l = multinomial_log_pmf(actual, correct_multinomial_frequencies(expected))
+    log_max = multinomial_log_pmf(actual, correct_multinomial_frequencies(actual))
+    return jnp.exp(log_l), jnp.exp(log_l - log_max)
+
+
+def scale_contingency_table(table: jnp.ndarray, n: jnp.ndarray) -> jnp.ndarray:
+    """Batched table rescale to total ~n. Parity: stats_utils.py:12-29."""
+    table = jnp.asarray(table)
+    s = jnp.sum(table, axis=-1, keepdims=True)
+    scaled = jnp.where(s > 0, jnp.round(table * (jnp.asarray(n)[..., None] / jnp.maximum(s, 1))), table)
+    return scaled.astype(jnp.int32)
+
+
+def precision_from_counts(fp: jnp.ndarray, tp: jnp.ndarray, fill: float = 1.0) -> jnp.ndarray:
+    """Batched precision with empty-denominator fill. Parity: stats_utils.py:76-94."""
+    return 1.0 - safe_divide(fp, fp + tp, fill=1.0 - fill)
+
+
+def recall_from_counts(fn: jnp.ndarray, tp: jnp.ndarray, fill: float = 1.0) -> jnp.ndarray:
+    """Batched recall with empty-denominator fill. Parity: stats_utils.py:97-116."""
+    return 1.0 - safe_divide(fn, fn + tp, fill=1.0 - fill)
+
+
+def f1_from_pr(precision: jnp.ndarray, recall: jnp.ndarray) -> jnp.ndarray:
+    """Batched F1 (harmonic mean); 0 where precision+recall == 0 (host get_f1 parity)."""
+    return safe_divide(2 * precision * recall, precision + recall, fill=0.0)
+
+
+def confusion_counts(is_positive_call: jnp.ndarray, is_true: jnp.ndarray, fn_extra: jnp.ndarray | int = 0):
+    """(tp, fp, fn) from boolean call/truth vectors plus out-of-band FN count.
+
+    The reference derives these via pandas groupby on the concordance
+    dataframe; here it is a pair of masked sums that XLA fuses with upstream
+    feature kernels.
+    """
+    is_positive_call = jnp.asarray(is_positive_call, dtype=bool)
+    is_true = jnp.asarray(is_true, dtype=bool)
+    tp = jnp.sum(is_positive_call & is_true)
+    fp = jnp.sum(is_positive_call & ~is_true)
+    fn = jnp.sum(~is_positive_call & is_true) + fn_extra
+    return tp, fp, fn
+
+
+def precision_recall_curve_dense(
+    labels: jnp.ndarray,
+    scores: jnp.ndarray,
+    fn_count: jnp.ndarray | int = 0,
+    valid: jnp.ndarray | None = None,
+) -> dict[str, jnp.ndarray]:
+    """Dense (per-rank) FN-aware precision/recall curve on device.
+
+    Sorts ``scores`` descending and computes cumulative precision/recall at
+    every rank (fixed shape → jit-safe). Host code dedups equal-score
+    plateaus when reference-identical curve points are required
+    (:func:`variantcalling_tpu.utils.stats_utils.precision_recall_curve`);
+    for threshold selection the dense curve is sufficient and avoids any
+    dynamic shapes.
+
+    Parameters
+    ----------
+    labels : bool (N,) — truth of each call
+    scores : float (N,)
+    fn_count : scalar — count of out-of-band false negatives (recall mass)
+    valid : optional bool (N,) — padding mask (False entries are ignored)
+    """
+    labels = jnp.asarray(labels, dtype=bool)
+    scores = jnp.asarray(scores, dtype=jnp.result_type(float))
+    if valid is not None:
+        labels = labels & valid
+        scores = jnp.where(valid, scores, -jnp.inf)
+        n_valid = jnp.sum(valid)
+    else:
+        n_valid = labels.shape[0]
+    order = jnp.argsort(-scores)
+    sorted_labels = labels[order].astype(jnp.int32)
+    ranks = jnp.arange(1, labels.shape[0] + 1)
+    tps = jnp.cumsum(sorted_labels)
+    in_range = ranks <= n_valid
+    fps = jnp.where(in_range, ranks - tps, 0)
+    precision = jnp.where(in_range, tps / ranks, 0.0)
+    total_true = tps[-1] + fn_count
+    recall = jnp.where(in_range, tps / jnp.maximum(total_true, 1), 0.0)
+    f1 = f1_from_pr(precision, recall)
+    return {
+        "threshold": scores[order],
+        "precision": precision,
+        "recall": recall,
+        "f1": jnp.where(in_range, f1, 0.0),
+        "tp": tps,
+        "fp": fps,
+        "valid": in_range,
+    }

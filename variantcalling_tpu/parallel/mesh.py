@@ -1,0 +1,89 @@
+"""Device mesh + sharding helpers — the framework's distributed substrate.
+
+Replaces the reference's parallelism layer (joblib process fan-out over
+genomic regions, SURVEY.md §2.4 / coverage_analysis.py:371-391) with a
+``jax.sharding.Mesh`` over which:
+
+- variant-axis data parallelism shards the (variants × features) tensor for
+  filter inference ("dp" axis),
+- model-parallel training shards hidden/feature dims ("mp" axis),
+- contig/window sharding is the sequence-parallel analog for coverage ("dp"
+  over contig shards),
+- SEC cohort aggregation all-reduces per-sample count tensors (psum over
+  "dp").
+
+All helpers degrade gracefully to a single device so every pipeline runs
+unchanged on 1 chip, an 8-chip pod slice, or a forced-host CPU mesh in tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "mp"
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1, devices=None) -> Mesh:
+    """Create a (dp, mp) mesh over available devices.
+
+    ``n_data=None`` uses all devices not claimed by ``n_model``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_model
+    use = n_data * n_model
+    if use == 0 or use > len(devices):
+        raise ValueError(
+            f"mesh shape dp={n_data} x mp={n_model} does not fit {len(devices)} available devices"
+        )
+    dev_array = np.asarray(devices[:use]).reshape(n_data, n_model)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard the leading (variants/contigs) axis across dp; replicate the rest."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0, fill=0) -> tuple[np.ndarray, int]:
+    """Pad ``x`` along ``axis`` to a multiple (static shapes for pjit). Returns (padded, n_orig)."""
+    n = x.shape[axis]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return x, n
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(x, widths, constant_values=fill), n
+
+
+def shard_batch(mesh: Mesh, arrays: dict[str, np.ndarray]) -> tuple[dict[str, jax.Array], int]:
+    """Pad every array to the dp-divisible length and device_put with dp sharding.
+
+    Returns (device arrays, original length). A ``valid`` bool mask is added
+    so downstream kernels can ignore padding rows.
+    """
+    if "valid" in arrays:
+        raise ValueError("'valid' is reserved for the generated padding mask")
+    lengths = {k: np.asarray(v).shape[0] for k, v in arrays.items()}
+    if len(set(lengths.values())) > 1:
+        raise ValueError(f"all arrays must share the leading axis length, got {lengths}")
+    n_data = mesh.shape[DATA_AXIS]
+    n_orig = 0
+    out: dict[str, jax.Array] = {}
+    for k, v in arrays.items():
+        padded, n_orig = pad_to_multiple(np.asarray(v), n_data, axis=0)
+        out[k] = jax.device_put(padded, data_sharding(mesh, padded.ndim))
+    if arrays:
+        n_padded = ((n_orig + n_data - 1) // n_data) * n_data
+        valid = np.zeros(n_padded, dtype=bool)
+        valid[:n_orig] = True
+        out["valid"] = jax.device_put(valid, data_sharding(mesh, 1))
+    return out, int(n_orig)

@@ -1,0 +1,45 @@
+"""Scalar/numpy math helpers with the reference API surface.
+
+Parity target: ``ugvc/utils/math_utils.py`` (reference ``/root/reference``).
+Device-batched equivalents live in :mod:`variantcalling_tpu.ops.math`; these
+host-side versions keep the exact call signatures so pipeline code and tests
+can run without a device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def safe_divide(numerator: float, denominator: float, return_if_denominator_is_0: float = 0):
+    """numerator/denominator, or ``return_if_denominator_is_0`` when denominator == 0.
+
+    Parity: ugvc/utils/math_utils.py:9-28.
+    """
+    if denominator == 0:
+        return return_if_denominator_is_0
+    return numerator / denominator
+
+
+def phred(p) -> np.ndarray:
+    """Probabilities -> Phred quality scores (-10*log10 p). Parity: math_utils.py:31-47."""
+    return -10 * np.log10(np.asarray(p, dtype=float))
+
+
+def unphred(q):
+    """Phred quality scores -> probabilities. Parity: math_utils.py:67-84."""
+    if isinstance(q, float):
+        return 10 ** (-q / 10)
+    return np.power(10.0, -np.asarray(q, dtype=float) / 10)
+
+
+def phred_str(p) -> str:
+    """Error probabilities -> phred+33 encoded string. Parity: math_utils.py:50-64."""
+    q = phred(p)
+    return "".join(chr(int(x) + 33) for x in q)
+
+
+def unphred_str(strq: str) -> np.ndarray:
+    """Phred+33 string -> error probabilities. Parity: math_utils.py:87-101."""
+    q = [ord(x) - 33 for x in strq]
+    return unphred(q)

@@ -1,0 +1,178 @@
+"""Host-side statistics with the reference API surface (no sklearn/scipy deps).
+
+Parity target: ``ugvc/utils/stats_utils.py`` in the reference. The
+FN-mask-aware precision/recall curve reproduces the reference's
+sklearn-based semantics (``stats_utils.py:141-210``) with a native
+implementation; batched device versions live in
+:mod:`variantcalling_tpu.ops.stats`.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from variantcalling_tpu.utils.math_utils import safe_divide
+
+# ---------------------------------------------------------------------------
+# Goodness-of-fit (multinomial) machinery — the SEC per-locus test substrate.
+# ---------------------------------------------------------------------------
+
+
+def scale_contingency_table(table: list[int], n: int) -> list[int]:
+    """Scale a count table so the total is ~n (rounded). Parity: stats_utils.py:12-29."""
+    sum_table = sum(table)
+    if sum_table > 0:
+        scaled_table = np.array(table) * (n / sum_table)
+        return list(np.round(scaled_table).astype(int))
+    return table
+
+
+def correct_multinomial_frequencies(counts: list[int]) -> np.ndarray:
+    """Add-one-corrected category frequencies. Parity: stats_utils.py:32-45."""
+    corrected_counts = np.array(counts) + 1
+    return corrected_counts / np.sum(corrected_counts)
+
+
+def _multinomial_log_pmf(x: np.ndarray, p: np.ndarray) -> float:
+    n = int(np.sum(x))
+    logp = math.lgamma(n + 1) - float(np.sum([math.lgamma(v + 1) for v in x]))
+    with np.errstate(divide="ignore"):
+        lp = np.where(x > 0, x * np.log(p), 0.0)
+    return logp + float(np.sum(lp))
+
+
+def multinomial_likelihood(actual: list[int], expected: list[int]) -> float:
+    """Likelihood of ``actual`` under the add-one-corrected multinomial fit to ``expected``.
+
+    Parity: stats_utils.py:48-63.
+    """
+    freq_expected = correct_multinomial_frequencies(expected)
+    return float(np.exp(_multinomial_log_pmf(np.asarray(actual, dtype=float), freq_expected)))
+
+
+def multinomial_likelihood_ratio(actual: list[int], expected: list[int]) -> tuple[float, float]:
+    """(likelihood, likelihood / max-likelihood-under-self-fit). Parity: stats_utils.py:66-70."""
+    likelihood = multinomial_likelihood(actual, expected)
+    max_likelihood = multinomial_likelihood(actual, actual)
+    likelihood_ratio = likelihood / max_likelihood
+    return likelihood, likelihood_ratio
+
+
+# ---------------------------------------------------------------------------
+# Precision / recall metrics
+# ---------------------------------------------------------------------------
+
+
+def get_precision(false_positives: int, true_positives: int, return_if_denominator_is_0=1) -> float:
+    """Precision from fp/tp counts. Parity: stats_utils.py:76-94."""
+    if false_positives + true_positives == 0:
+        return return_if_denominator_is_0
+    return 1 - false_positives / (false_positives + true_positives)
+
+
+def get_recall(false_negatives: int, true_positives: int, return_if_denominator_is_0=1) -> float:
+    """Recall from fn/tp counts. Parity: stats_utils.py:97-116."""
+    if false_negatives + true_positives == 0:
+        return return_if_denominator_is_0
+    return 1 - false_negatives / (false_negatives + true_positives)
+
+
+def get_f1(precision: float, recall: float, null_value=np.nan) -> float:
+    """Harmonic mean with null propagation. Parity: stats_utils.py:119-138."""
+    if null_value in {precision, recall}:
+        return null_value
+    return safe_divide(2 * precision * recall, precision + recall)
+
+
+def binary_clf_curve(y_true: np.ndarray, y_score: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(fps, tps, thresholds) at each distinct score, descending-score order.
+
+    Native equivalent of sklearn's ``_binary_clf_curve`` so the framework
+    carries no sklearn runtime dependency on the metrics path.
+    """
+    y_true = np.asarray(y_true).astype(bool)
+    y_score = np.asarray(y_score, dtype=float)
+    desc = np.argsort(y_score, kind="stable")[::-1]
+    y_score = y_score[desc]
+    y_true = y_true[desc]
+    distinct = np.where(np.diff(y_score))[0]
+    threshold_idxs = np.r_[distinct, y_true.size - 1]
+    tps = np.cumsum(y_true)[threshold_idxs]
+    fps = 1 + threshold_idxs - tps
+    return fps, tps, y_score[threshold_idxs]
+
+
+def _precision_recall_points(y_true: np.ndarray, y_score: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """sklearn.metrics.precision_recall_curve semantics, natively."""
+    fps, tps, thresholds = binary_clf_curve(y_true, y_score)
+    ps = tps + fps
+    precision = np.zeros_like(tps, dtype=float)
+    np.divide(tps, ps, out=precision, where=ps != 0)
+    if tps[-1] == 0:
+        recall = np.ones_like(tps, dtype=float)
+    else:
+        recall = tps / tps[-1]
+    # reverse so recall is decreasing; append the (1, 0) endpoint
+    sl = slice(None, None, -1)
+    return (
+        np.hstack((precision[sl], 1)),
+        np.hstack((recall[sl], 0)),
+        thresholds[sl],
+    )
+
+
+def precision_recall_curve(
+    gtr: np.ndarray,
+    predictions: np.ndarray,
+    fn_mask: np.ndarray,
+    pos_label: str | int | None = 1,
+    min_class_counts_to_output: int = 20,
+) -> tuple:
+    """FN-mask-aware precision/recall curve. Parity: stats_utils.py:141-210.
+
+    ``fn_mask`` marks variants that were false negatives (missed true calls,
+    present in ground truth but carrying no usable prediction); recall is
+    rescaled by ``tp/(tp+fn)`` so missed calls count against recall without
+    contributing curve points.
+    """
+    gtr = np.asarray(gtr)
+    predictions = np.asarray(predictions)
+    fn_mask = np.asarray(fn_mask, dtype=bool)
+
+    if len(gtr) == 0:
+        return np.array([]), np.array([]), np.array([]), np.array([])
+
+    assert len(set(gtr.tolist())) <= 2, "Only up to two classes of variant labels are possible"
+    assert len(fn_mask) == len(predictions), "FN mask should be of the length of predictions"
+
+    gtr_select = gtr[~fn_mask]
+    gtr_select = gtr_select == pos_label
+    predictions_select = predictions[~fn_mask]
+    original_fn_count = fn_mask.sum()
+
+    if len(gtr_select) > 0:
+        raw_precision, raw_recall, thresholds = _precision_recall_points(gtr_select, predictions_select)
+    else:
+        raw_precision = np.array([0.0, 1.0])
+        raw_recall = np.array([1.0, 0.0])
+        thresholds = np.array([0]) if len(predictions_select) == 0 else np.array([np.min(predictions_select)])
+
+    recall_correction = safe_divide(gtr_select.sum(), gtr_select.sum() + original_fn_count)
+    recalls = raw_recall * recall_correction
+    # strip the synthetic (1, 0) endpoint and the initial curve point
+    recalls = recalls[1:-1]
+    precisions = raw_precision[1:-1]
+    thresholds = thresholds[1:]
+    f1_score = 2 * (recalls * precisions) / (recalls + precisions + np.finfo(float).eps)
+
+    # drop the noisy low-count tail of the curve
+    predictions_select = np.sort(predictions_select)
+    if len(predictions_select) > 0:
+        threshold_cutoff = predictions_select[max(0, len(predictions_select) - min_class_counts_to_output)]
+    else:
+        threshold_cutoff = 0
+
+    mask = thresholds > threshold_cutoff
+    return precisions[~mask], recalls[~mask], f1_score[~mask], thresholds[~mask]
