@@ -1,0 +1,87 @@
+"""Benchmark: variants/sec through the filter hot path on the active device.
+
+Measures the north-star metric (BASELINE.json: "variants/sec filtered") on
+the fused device program — window featurization (GC/hmer/motif) + flat
+-forest inference (variantcalling_tpu.synthetic.fused_hot_path, the same
+program the filter pipeline's device stage runs) — over a realistic
+workload: 40-tree depth-12 forest, ~4.2M-variant batches (HG002 WGS is
+~5M variants).
+
+vs_baseline = device throughput / live sklearn predict_proba throughput on
+this host's CPU (the reference's execution engine for the same forest
+shape; docs/howto-callset-filter.md runs sklearn RF on CPU). Target from
+BASELINE.json: >= 50x.
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_BENCH = 1 << 22  # ~4.2M variants per measured batch
+N_TREES = 40
+DEPTH = 12
+
+
+def device_throughput() -> float:
+    import jax
+
+    from variantcalling_tpu.synthetic import N_HOT_FEATURES, fused_hot_path, hot_path_args, synthetic_forest
+
+    rng = np.random.default_rng(0)
+    forest = synthetic_forest(rng, n_trees=N_TREES, depth=DEPTH, n_features=N_HOT_FEATURES)
+    hot = jax.jit(fused_hot_path(forest))
+    args = hot_path_args(N_BENCH)
+    hot(*args)[0].block_until_ready()  # compile
+    n_iter = 5
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        out = hot(*args)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return N_BENCH * n_iter / dt
+
+
+def cpu_baseline_throughput() -> float:
+    """sklearn RF predict_proba on this host — the reference engine."""
+    from sklearn.ensemble import RandomForestClassifier
+
+    from variantcalling_tpu.synthetic import N_HOT_FEATURES
+
+    rng = np.random.default_rng(0)
+    n_fit = 20000
+    x_fit = rng.random((n_fit, N_HOT_FEATURES)).astype(np.float32)
+    y_fit = (x_fit[:, 0] + 0.3 * x_fit[:, 1] + rng.normal(0, 0.2, n_fit) > 0.6).astype(int)
+    clf = RandomForestClassifier(n_estimators=N_TREES, max_depth=DEPTH, random_state=0, n_jobs=1).fit(
+        x_fit, y_fit
+    )
+    n_pred = 200_000
+    x_pred = rng.random((n_pred, N_HOT_FEATURES)).astype(np.float32)
+    clf.predict_proba(x_pred[:1000])  # warm
+    t0 = time.perf_counter()
+    clf.predict_proba(x_pred)
+    dt = time.perf_counter() - t0
+    return n_pred / dt
+
+
+def main() -> None:
+    tput = device_throughput()
+    base = cpu_baseline_throughput()
+    print(
+        json.dumps(
+            {
+                "metric": "filter_hot_path_variants_per_sec",
+                "value": round(tput),
+                "unit": "variants/sec",
+                "vs_baseline": round(tput / base, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Run the test suite on a virtual 8-device CPU mesh.
+#
+# PYTHONPATH is cleared so the environment's axon sitecustomize
+# (/root/.axon_site) does not register the TPU PJRT plugin in test
+# processes — every registered process touches the single TPU tunnel, and
+# concurrent/killed test runs can wedge it. Tests are CPU-only by design;
+# bench.py is the real-chip path.
+set -eo pipefail
+cd "$(dirname "$0")"
+exec env PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest tests/ "$@"

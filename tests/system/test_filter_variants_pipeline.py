@@ -1,0 +1,163 @@
+"""End-to-end system test of filter_variants_pipeline on a synthetic callset
+(reference test-strategy analog: golden end-to-end runs, SURVEY.md §4)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from tests import fixtures
+from variantcalling_tpu.featurize import featurize
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.models import registry
+from variantcalling_tpu.models.forest import from_sklearn
+from variantcalling_tpu.pipelines import filter_variants as fvp
+
+
+@pytest.fixture(scope="module")
+def synthetic_world(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    tmp = tmp_path_factory.mktemp("fvp")
+    contigs = {"chr1": 20000, "chr2": 10000}
+    genome = fixtures.make_genome(rng, contigs)
+    fasta_path = tmp / "ref.fa"
+    fixtures.write_fasta(str(fasta_path), genome)
+    recs = fixtures.synth_variants(rng, genome, 400)
+    for r in recs:
+        r["pl"] = [30, 0, 40]
+        r["gq"] = int(rng.integers(10, 90))
+        r["ad"] = [int(rng.integers(5, 30)), int(rng.integers(1, 30))]
+    vcf_path = tmp / "calls.vcf.gz"
+    fixtures.write_vcf(str(vcf_path), recs, contigs)
+
+    # homopolymer runs bed: long A-runs in chr1 (synthesized independent of genome)
+    runs_bed = tmp / "runs.bed"
+    runs_bed.write_text("chr1\t1000\t1015\nchr1\t5000\t5012\nchr2\t2000\t2005\n")
+
+    # LCR-like annotation bed
+    lcr_bed = tmp / "LCR-test.bed"
+    lcr_bed.write_text("chr1\t0\t4000\nchr2\t8000\t10000\n")
+
+    # blacklist: 5 specific loci from the callset
+    bl = [(recs[i]["chrom"], recs[i]["pos"]) for i in (3, 10, 50, 100, 200)]
+    bl_path = tmp / "blacklist.pkl"
+    with open(bl_path, "wb") as fh:
+        pickle.dump(bl, fh)
+
+    # train a toy sklearn RF on the true features so scores are deterministic
+    from sklearn.ensemble import RandomForestClassifier
+
+    table = read_vcf(str(vcf_path))
+    fasta = FastaReader(str(fasta_path))
+    fs = featurize(table, fasta)
+    x = fs.matrix()
+    y = (x[:, fs.feature_names.index("qual")] > 50).astype(int)
+    clf = RandomForestClassifier(n_estimators=10, max_depth=5, random_state=0).fit(x, y)
+    model_path = tmp / "model.pkl"
+    registry.save_models(
+        str(model_path),
+        {"rf_model_ignore_gt_incl_hpol_runs": from_sklearn(clf, feature_names=fs.feature_names)},
+    )
+    return {
+        "tmp": tmp,
+        "recs": recs,
+        "vcf": str(vcf_path),
+        "fasta": str(fasta_path),
+        "runs": str(runs_bed),
+        "lcr": str(lcr_bed),
+        "blacklist": str(bl_path),
+        "model": str(model_path),
+        "clf": clf,
+        "bl_loci": bl,
+    }
+
+
+def test_filter_pipeline_end_to_end(synthetic_world):
+    w = synthetic_world
+    out = w["tmp"] / "filtered.vcf.gz"
+    rc = fvp.run(
+        [
+            "--input_file", w["vcf"],
+            "--model_file", w["model"],
+            "--model_name", "rf_model_ignore_gt_incl_hpol_runs",
+            "--runs_file", w["runs"],
+            "--blacklist", w["blacklist"],
+            "--reference_file", w["fasta"],
+            "--output_file", str(out),
+            "--annotate_intervals", w["lcr"],
+            "--hpol_filter_length_dist", "10", "10",
+            "--backend", "cpu",
+        ]
+    )
+    assert rc == 0
+    result = read_vcf(str(out))
+    assert len(result) == len(w["recs"])
+
+    # TREE_SCORE parity with sklearn predict_proba
+    table = read_vcf(w["vcf"])
+    fasta = FastaReader(w["fasta"])
+    from variantcalling_tpu.io.bed import read_bed
+
+    fs = featurize(table, fasta, annotate_intervals={"LCR-test": read_bed(w["lcr"])})
+    base_cols = [f for f in fs.feature_names if f != "LCR-test"]
+    ref_scores = w["clf"].predict_proba(fs.matrix(base_cols))[:, 1]
+    got = result.info_field("TREE_SCORE")
+    np.testing.assert_allclose(got, np.round(ref_scores, 4), atol=2e-4)
+
+    # PASS/LOW_SCORE consistent with threshold 0.5
+    filters = result.filters
+    bl_set = set(w["bl_loci"])
+    for i in range(len(result)):
+        locus = (result.chrom[i], int(result.pos[i]))
+        if locus in bl_set:
+            assert "COHORT_FP" in filters[i]
+            continue
+        if ref_scores[i] >= 0.5:
+            assert filters[i] in ("PASS", "PASS;HPOL_RUN") or filters[i].startswith("PASS")
+        else:
+            assert "LOW_SCORE" in filters[i]
+
+    # HPOL_RUN marking: all variants within 10bp of a >=10bp run are marked
+    from variantcalling_tpu.io.bed import read_bed as rb
+
+    runs = rb(w["runs"])
+    long_runs = [
+        (c, s, e) for c, s, e in zip(runs.chrom, runs.start, runs.end) if e - s >= 10
+    ]
+    n_hpol = 0
+    for i in range(len(result)):
+        near = any(
+            result.chrom[i] == c and s - 10 <= result.pos[i] - 1 <= e + 9
+            for c, s, e in long_runs
+        )
+        if near:
+            assert "HPOL_RUN" in filters[i]
+            n_hpol += 1
+        else:
+            assert "HPOL_RUN" not in filters[i]
+
+    # header declares new filters/info
+    header_text = "\n".join(result.header.lines)
+    for fid in ("LOW_SCORE", "COHORT_FP", "HPOL_RUN", "TREE_SCORE"):
+        assert fid in header_text
+
+
+def test_filter_pipeline_single_contig(synthetic_world):
+    w = synthetic_world
+    out = w["tmp"] / "chr2.vcf.gz"
+    rc = fvp.run(
+        [
+            "--input_file", w["vcf"],
+            "--model_file", w["model"],
+            "--model_name", "rf_model_ignore_gt_incl_hpol_runs",
+            "--reference_file", w["fasta"],
+            "--output_file", str(out),
+            "--limit_to_contig", "chr2",
+            "--backend", "cpu",
+        ]
+    )
+    assert rc == 0
+    result = read_vcf(str(out))
+    assert len(result) == sum(1 for r in w["recs"] if r["chrom"] == "chr2")
+    assert all(c == "chr2" for c in result.chrom)

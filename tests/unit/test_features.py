@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from variantcalling_tpu.io.fasta import encode_seq
+from variantcalling_tpu.ops import features as fops
+from variantcalling_tpu.ops import intervals as iops
+
+
+def win(seq: str) -> np.ndarray:
+    return encode_seq(seq)[None, :]
+
+
+def test_gc_content():
+    # 21bp window, center=10, radius=10: count G/C over the full window
+    w = win("A" * 10 + "G" + "C" * 10)
+    gc = fops.gc_content(jnp.asarray(w), center=10, radius=10)
+    assert float(gc[0]) == pytest.approx(11 / 21)
+    # N excluded from denominator
+    w = win("N" * 10 + "G" + "A" * 10)
+    gc = fops.gc_content(jnp.asarray(w), center=10, radius=10)
+    assert float(gc[0]) == pytest.approx(1 / 11)
+
+
+def test_run_length_at():
+    w = win("ACGTTTTTACGTACGTACGTA")
+    rl = fops.run_length_at(jnp.asarray(w), start=3)
+    assert int(rl[0]) == 5
+    rl = fops.run_length_at(jnp.asarray(w), start=0)
+    assert int(rl[0]) == 1
+    # run to the end of the window
+    w = win("AAAAA")
+    assert int(fops.run_length_at(jnp.asarray(w), start=0)[0]) == 5
+
+
+def test_hmer_indel_features():
+    # deletion of T in a TTTT run: window center anchor A, next bases TTTT
+    w = win("CCCCCATTTTGGGGGGGGGGG")  # center=5 is A
+    hl, hn = fops.hmer_indel_features(
+        jnp.asarray(w), 5, jnp.array([True]), jnp.array([3])  # T
+    )
+    assert int(hl[0]) == 4
+    assert int(hn[0]) == 3
+    # indel nuc mismatch with next base -> not hmer
+    hl, hn = fops.hmer_indel_features(jnp.asarray(w), 5, jnp.array([True]), jnp.array([2]))
+    assert int(hl[0]) == 0
+    assert int(hn[0]) == 4
+    # SNP -> not hmer
+    hl, hn = fops.hmer_indel_features(jnp.asarray(w), 5, jnp.array([False]), jnp.array([3]))
+    assert int(hl[0]) == 0
+
+
+def test_motif_codes():
+    w = win("ACGTACGTACGTACGTACGTA")
+    left, right = fops.motif_codes(jnp.asarray(w), center=10, k=5)
+    # left motif = w[5:10] = "CGTAC", right = w[11:16] = "TACGT"
+    def pack(s):
+        return sum(int(encode_seq(s)[i]) * 5 ** (4 - i) for i in range(5))
+
+    assert int(left[0]) == pack("CGTAC")
+    assert int(right[0]) == pack("TACGT")
+
+
+def test_cycle_skip_status():
+    # classic cycle-skip example under TGCA flow order:
+    # context ...T [C->T] A...: merging hmers changes flow count
+    w = win("AAAAAAAAAATCAAAAAAAAA")  # center=10 is T? no: w[10]='T'? seq: 10 A's then T C ...
+    # build explicit: left context AAAA, center X, right context CAAA
+    w = win("AAAAAAAAAACCAAAAAAAAA")
+    ref = jnp.array([1])  # C at center
+    alt = jnp.array([0])  # A
+    status = fops.cycle_skip_status(jnp.asarray(w), 10, ref, alt, jnp.array([True]))
+    assert int(status[0]) in (0, 2)
+    # non-SNP is NA (-1)
+    status = fops.cycle_skip_status(jnp.asarray(w), 10, ref, alt, jnp.array([False]))
+    assert int(status[0]) == -1
+    # a guaranteed skip: ref TGT vs alt TTT under TGCA (G hmer disappears)
+    w2 = win("AAAAAAAAATGTAAAAAAAAA")
+    # center=10 is G
+    status = fops.cycle_skip_status(jnp.asarray(w2), 10, jnp.array([2]), jnp.array([3]), jnp.array([True]))
+    assert int(status[0]) == 2
+
+
+def test_flow_key_length_known():
+    fo = jnp.array([3, 2, 1, 0])  # TGCA
+    seq = jnp.asarray(encode_seq("TGCA")[None, :])
+    # each base consumed by its own flow: 4 flows
+    assert int(fops._flow_key_length(seq, fo, 20)[0]) == 4
+    seq = jnp.asarray(encode_seq("TTTT")[None, :])
+    assert int(fops._flow_key_length(seq, fo, 20)[0]) == 1
+    seq = jnp.asarray(encode_seq("AT")[None, :])
+    # flows: T(no),G(no),C(no),A(yes=4 flows),T(consume T=5)
+    assert int(fops._flow_key_length(seq, fo, 20)[0]) == 5
+
+
+def test_interval_membership_and_distance():
+    coords = iops.GenomeCoords({"chr1": 1000, "chr2": 500})
+    gpos = coords.globalize(np.array(["chr1", "chr1", "chr2", "chrX"], dtype=object), np.array([10, 700, 100, 5]))
+    assert gpos[2] == 1100
+    assert gpos[3] == -1
+    gs = np.array([5, 1050])
+    ge = np.array([20, 1200])
+    m = iops.membership(gpos, gs, ge)
+    np.testing.assert_array_equal(m, [True, False, True, False])
+    d = iops.distance_to_nearest(gpos, gs, ge)
+    assert d[0] == 0
+    assert d[1] == min(700 - 19, 1050 - 700)  # distance to end of iv0 vs start of iv1
+    assert d[2] == 0
+    # whole-genome scale: > int32 coordinates must survive
+    big = iops.GenomeCoords({"c1": 3_000_000_000, "c2": 1_000_000})
+    g2 = big.globalize(np.array(["c2"], dtype=object), np.array([500]))
+    assert g2[0] == 3_000_000_500
+    assert iops.membership(g2, np.array([3_000_000_000]), np.array([3_000_001_000]))[0]

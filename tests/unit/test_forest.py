@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from variantcalling_tpu.models import forest as fmod
+
+
+def test_flatforest_matches_sklearn_rf(rng):
+    from sklearn.ensemble import RandomForestClassifier
+
+    x = rng.random((500, 8)).astype(np.float32)
+    y = (x[:, 0] + x[:, 3] * 0.5 + rng.normal(0, 0.1, 500) > 0.8).astype(int)
+    clf = RandomForestClassifier(n_estimators=20, max_depth=6, random_state=0).fit(x, y)
+    forest = fmod.from_sklearn(clf, feature_names=[f"f{i}" for i in range(8)])
+    score = np.asarray(fmod.predict_score(forest, jnp.asarray(x)))
+    ref = clf.predict_proba(x)[:, 1]
+    np.testing.assert_allclose(score, ref, atol=1e-5)
+
+
+def test_flatforest_single_tree(rng):
+    from sklearn.tree import DecisionTreeClassifier
+
+    x = rng.random((200, 4)).astype(np.float32)
+    y = (x[:, 1] > 0.5).astype(int)
+    clf = DecisionTreeClassifier(max_depth=4, random_state=0).fit(x, y)
+    forest = fmod.from_sklearn(clf)
+    score = np.asarray(fmod.predict_score(forest, jnp.asarray(x)))
+    np.testing.assert_allclose(score, clf.predict_proba(x)[:, 1], atol=1e-5)
+
+
+def test_feature_order_remap(rng):
+    from sklearn.ensemble import RandomForestClassifier
+
+    x = rng.random((300, 5)).astype(np.float32)
+    y = (x[:, 2] > 0.5).astype(int)
+    clf = RandomForestClassifier(n_estimators=5, max_depth=4, random_state=0).fit(x, y)
+    names = ["a", "b", "c", "d", "e"]
+    forest = fmod.from_sklearn(clf, feature_names=names)
+    # permute columns and remap
+    perm = ["e", "c", "a", "b", "d"]
+    x_perm = x[:, [names.index(p) for p in perm]]
+    remapped = fmod.with_feature_order(forest, perm)
+    s1 = np.asarray(fmod.predict_score(forest, jnp.asarray(x)))
+    s2 = np.asarray(fmod.predict_score(remapped, jnp.asarray(x_perm)))
+    np.testing.assert_allclose(s1, s2, atol=1e-6)
+
+
+def test_threshold_model():
+    from variantcalling_tpu.models import threshold as tmod
+
+    model = tmod.default_somatic_model(["qual", "tlod", "sor"])
+    x = jnp.asarray(
+        np.array(
+            [
+                [50.0, 40.0, 0.5],  # strong TLOD, low SOR -> high score
+                [50.0, 0.0, 9.0],  # weak -> low score
+            ],
+            dtype=np.float32,
+        )
+    )
+    s = np.asarray(tmod.predict_score(model, x))
+    assert s[0] > 0.9
+    assert s[1] < 0.05
+
+
+def test_registry_roundtrip(tmp_path, rng):
+    from sklearn.ensemble import RandomForestClassifier
+
+    from variantcalling_tpu.models import registry
+
+    x = rng.random((100, 3)).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(int)
+    clf = RandomForestClassifier(n_estimators=3, max_depth=3, random_state=0).fit(x, y)
+    flat = fmod.from_sklearn(clf)
+    path = tmp_path / "models.pkl"
+    registry.save_models(str(path), {"rf_model_ignore_gt_incl_hpol_runs": flat, "sk": clf})
+    loaded = registry.load_models(str(path))
+    # sklearn model auto-converted on load
+    assert isinstance(loaded["sk"], fmod.FlatForest)
+    s1 = np.asarray(fmod.predict_score(loaded["rf_model_ignore_gt_incl_hpol_runs"], jnp.asarray(x)))
+    s2 = np.asarray(fmod.predict_score(loaded["sk"], jnp.asarray(x)))
+    np.testing.assert_allclose(s1, s2, atol=1e-6)
+    with pytest.raises(KeyError):
+        registry.load_model(str(path), "nope")

@@ -1,0 +1,128 @@
+import gzip
+
+import numpy as np
+import pytest
+
+from tests import fixtures
+from variantcalling_tpu.io import bed as bedio
+from variantcalling_tpu.io import fasta as fastaio
+from variantcalling_tpu.io import vcf as vcfio
+
+
+@pytest.fixture
+def genome_and_vcf(tmp_path, rng):
+    contigs = {"chr1": 5000, "chr2": 3000}
+    genome = fixtures.make_genome(rng, contigs)
+    fasta_path = tmp_path / "ref.fa"
+    fixtures.write_fasta(str(fasta_path), genome)
+    recs = fixtures.synth_variants(rng, genome, 200)
+    for r in recs:
+        r["pl"] = [30, 0, 40]
+        r["gq"] = 30
+        r["ad"] = [10, 5]
+    vcf_path = tmp_path / "calls.vcf.gz"
+    fixtures.write_vcf(str(vcf_path), recs, contigs)
+    return genome, recs, str(fasta_path), str(vcf_path), contigs
+
+
+def test_read_vcf_columns(genome_and_vcf):
+    genome, recs, fasta_path, vcf_path, contigs = genome_and_vcf
+    t = vcfio.read_vcf(vcf_path)
+    assert len(t) == len(recs)
+    assert t.header.samples == ["SAMPLE"]
+    assert t.header.contigs == ["chr1", "chr2"]
+    assert t.header.contig_lengths["chr1"] == 5000
+    assert t.pos[0] == recs[0]["pos"]
+    assert t.ref[0] == recs[0]["ref"]
+    assert t.alt[0] == ",".join(recs[0]["alts"])
+    assert t.qual[0] == pytest.approx(recs[0]["qual"])
+    # INFO extraction
+    dp = t.info_field("DP", dtype=np.float64)
+    assert np.all(dp == 30)
+    # FORMAT extraction
+    pl = t.format_numeric("PL")
+    np.testing.assert_array_equal(pl[0], [30, 0, 40])
+    gts = t.genotypes()
+    assert tuple(gts[0]) == recs[0]["gt"]
+
+
+def test_read_vcf_region(genome_and_vcf):
+    _, recs, _, vcf_path, _ = genome_and_vcf
+    t = vcfio.read_vcf(vcf_path, region=("chr2", 1, 3000))
+    assert len(t) == sum(1 for r in recs if r["chrom"] == "chr2")
+    assert all(c == "chr2" for c in t.chrom)
+
+
+def test_vcf_roundtrip_and_rewrite(genome_and_vcf, tmp_path):
+    _, recs, _, vcf_path, _ = genome_and_vcf
+    t = vcfio.read_vcf(vcf_path)
+    # rewrite with new filters + TREE_SCORE info
+    score = np.round(np.linspace(0, 1, len(t)), 3)
+    new_filt = np.where(score > 0.5, "PASS", "LOW_SCORE").astype(object)
+    t.header.ensure_filter("LOW_SCORE", "Low model score")
+    t.header.ensure_info("TREE_SCORE", "1", "Float", "Model score")
+    out_path = tmp_path / "filtered.vcf.gz"
+    vcfio.write_vcf(str(out_path), t, new_filters=new_filt, extra_info={"TREE_SCORE": score})
+    t2 = vcfio.read_vcf(str(out_path))
+    assert len(t2) == len(t)
+    np.testing.assert_array_equal(t2.filters, new_filt)
+    ts = t2.info_field("TREE_SCORE")
+    np.testing.assert_allclose(ts, score, atol=1e-6)
+    # untouched columns identical
+    np.testing.assert_array_equal(t2.ref, t.ref)
+    np.testing.assert_array_equal(t2.pos, t.pos)
+    np.testing.assert_array_equal(np.asarray(t2.sample_cols), np.asarray(t.sample_cols))
+
+
+def test_fasta_reader(genome_and_vcf, tmp_path):
+    genome, _, fasta_path, _, _ = genome_and_vcf
+    fr = fastaio.FastaReader(fasta_path)
+    assert fr.references == ["chr1", "chr2"]
+    assert fr.get_reference_length("chr1") == 5000
+    assert fr.fetch("chr1", 100, 160) == genome["chr1"][100:160]
+    # cross line boundaries + clamping
+    assert fr.fetch("chr2", 2990, 3010) == genome["chr2"][2990:3000]
+    # padded array fetch
+    arr = fr.fetch_array("chr1", -5, 10)
+    assert len(arr) == 15
+    assert np.all(arr[:5] == 4)
+    assert fastaio.decode_seq(arr[5:]) == genome["chr1"][:10]
+
+
+def test_encode_revcomp():
+    assert fastaio.decode_seq(fastaio.encode_seq("ACGTN")) == "ACGTN"
+    assert fastaio.revcomp("ACGTN") == "NACGT"
+    assert fastaio.revcomp("AAGCT") == "AGCTT"
+
+
+def test_bed_ops(tmp_path):
+    bed = tmp_path / "a.bed"
+    bed.write_text("chr1\t10\t20\nchr1\t15\t30\nchr1\t40\t50\nchr2\t5\t8\n")
+    iv = bedio.read_bed(str(bed))
+    assert len(iv) == 4
+    merged = iv.merged()
+    assert len(merged) == 3
+    assert merged.total_length() == (30 - 10) + 10 + 3
+
+    other = bedio.IntervalSet(
+        np.array(["chr1", "chr2"], dtype=object), np.array([18, 0]), np.array([45, 100])
+    )
+    inter = iv.intersect(other)
+    # chr1: [18,30) and [40,45); chr2: [5,8)
+    assert [(c, int(s), int(e)) for c, s, e in zip(inter.chrom, inter.start, inter.end)] == [
+        ("chr1", 18, 30),
+        ("chr1", 40, 45),
+        ("chr2", 5, 8),
+    ]
+
+    member = iv.contains(np.array(["chr1", "chr1", "chr2", "chr3"], dtype=object), np.array([12, 35, 6, 1]))
+    np.testing.assert_array_equal(member, [True, False, True, False])
+
+
+def test_interval_list(tmp_path):
+    il = tmp_path / "x.interval_list"
+    il.write_text("@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000\nchr1\t11\t20\t+\tfoo\n")
+    iv = bedio.read_interval_list(str(il))
+    assert len(iv) == 1
+    assert (int(iv.start[0]), int(iv.end[0])) == (10, 20)
+    assert bedio.read_intervals(str(il)).total_length() == 10

@@ -1,0 +1,228 @@
+"""Variant featurization: VariantTable + reference genome -> device feature tensors.
+
+This is the front half of the north-star hot path
+(filter_variants_pipeline, docs/filter_variants_pipeline.md): the reference
+computes per-variant annotations in pandas; here host code gathers fixed
+-width reference windows and allele scalars, and
+:mod:`variantcalling_tpu.ops.features` kernels compute the window-derived
+features on device, fused with classifier inference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from variantcalling_tpu.io.bed import IntervalSet
+from variantcalling_tpu.io.fasta import FastaReader, encode_seq
+from variantcalling_tpu.io.vcf import VariantTable
+from variantcalling_tpu.ops import features as fops
+from variantcalling_tpu.ops import intervals as iops
+
+WINDOW_RADIUS = 20  # bases either side of the anchor in the gathered window
+CENTER = WINDOW_RADIUS
+
+# feature order of the assembled matrix; models store this list as metadata
+BASE_FEATURES = [
+    "qual",
+    "dp",
+    "sor",
+    "af",
+    "gq",
+    "is_het",
+    "is_snp",
+    "is_indel",
+    "is_ins",
+    "indel_length",
+    "hmer_indel_length",
+    "hmer_indel_nuc",
+    "gc_content",
+    "cycleskip_status",
+    "left_motif",
+    "right_motif",
+    "ref_code",
+    "alt_code",
+    "n_alts",
+]
+
+
+@dataclass
+class AlleleColumns:
+    """Host-derived per-variant allele scalars (first ALT; multiallelic flagged)."""
+
+    is_snp: np.ndarray
+    is_indel: np.ndarray
+    is_ins: np.ndarray
+    indel_length: np.ndarray
+    indel_nuc: np.ndarray  # 0..3 if single-nucleotide indel diff else 4
+    ref_code: np.ndarray  # anchor base code for SNPs (else 4)
+    alt_code: np.ndarray
+    n_alts: np.ndarray
+
+
+def classify_alleles(table: VariantTable) -> AlleleColumns:
+    """Indel/SNP classification from REF/ALT strings (parity: classify_indel,
+    ugbio_core.vcfbed.variant_annotation; run_no_gt_report.py:92)."""
+    n = len(table)
+    is_snp = np.zeros(n, dtype=bool)
+    is_indel = np.zeros(n, dtype=bool)
+    is_ins = np.zeros(n, dtype=bool)
+    indel_length = np.zeros(n, dtype=np.int32)
+    indel_nuc = np.full(n, 4, dtype=np.int32)
+    ref_code = np.full(n, 4, dtype=np.int32)
+    alt_code = np.full(n, 4, dtype=np.int32)
+    n_alts = table.n_alts()
+    code = {"A": 0, "C": 1, "G": 2, "T": 3}
+    for i in range(n):
+        ref = table.ref[i]
+        alt_s = table.alt[i]
+        if alt_s in (".", ""):
+            continue
+        alt = alt_s.split(",")[0]
+        if alt in ("<NON_REF>", "<*>") or alt.startswith("<"):
+            continue
+        if len(ref) == len(alt) == 1:
+            is_snp[i] = True
+            ref_code[i] = code.get(ref.upper(), 4)
+            alt_code[i] = code.get(alt.upper(), 4)
+        elif len(ref) != len(alt):
+            is_indel[i] = True
+            if len(alt) > len(ref):
+                is_ins[i] = True
+                diff = alt[len(ref) :] if alt.startswith(ref) else alt[1:]
+            else:
+                diff = ref[len(alt) :] if ref.startswith(alt) else ref[1:]
+            indel_length[i] = abs(len(alt) - len(ref))
+            u = set(diff.upper())
+            if len(u) == 1:
+                indel_nuc[i] = code.get(next(iter(u)), 4)
+    return AlleleColumns(is_snp, is_indel, is_ins, indel_length, indel_nuc, ref_code, alt_code, n_alts)
+
+
+def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW_RADIUS) -> np.ndarray:
+    """(N, 2*radius+1) uint8 reference windows centered on each variant anchor.
+
+    One contig-sequence encode per contig, then a vectorized gather — the
+    host-side analog of the reference's per-record pyfaidx fetches.
+    """
+    n = len(table)
+    out = np.full((n, 2 * radius + 1), 4, dtype=np.uint8)
+    chrom = np.asarray(table.chrom)
+    pos0 = table.pos - 1
+    for contig in dict.fromkeys(chrom.tolist()):
+        m = chrom == contig
+        if contig not in fasta.references:
+            continue
+        seq = encode_seq(fasta.fetch(contig, 0, fasta.get_reference_length(contig)))
+        padded = np.concatenate([np.full(radius, 4, np.uint8), seq, np.full(radius, 4, np.uint8)])
+        centers = pos0[m].astype(np.int64) + radius
+        idx = centers[:, None] + np.arange(-radius, radius + 1)[None, :]
+        out[m] = padded[idx]
+    return out
+
+
+@dataclass
+class FeatureSet:
+    """Named per-variant feature columns + assembly into a (N, F) matrix."""
+
+    columns: dict[str, np.ndarray]
+    feature_names: list[str]
+    windows: np.ndarray | None = None  # (N, 2*WINDOW_RADIUS+1) uint8 ref context
+
+    def matrix(self, names: list[str] | None = None) -> np.ndarray:
+        names = names or self.feature_names
+        return np.stack([np.asarray(self.columns[f], dtype=np.float32) for f in names], axis=1)
+
+    def __len__(self) -> int:
+        return len(next(iter(self.columns.values())))
+
+
+def _compute_af(table: VariantTable) -> np.ndarray:
+    """Allele fraction per record: FORMAT AD (alt/sum) where present, else INFO AF."""
+    info_af = table.info_field("AF", dtype=np.float64).astype(np.float32)
+    ad = table.format_numeric("AD")
+    if ad.shape[1] < 2:
+        return info_af
+    tot = np.sum(np.where(ad > 0, ad, 0), axis=1)
+    alt = np.where(ad[:, 1] > 0, ad[:, 1], 0)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        ad_af = np.where(tot > 0, alt / np.maximum(tot, 1), np.nan).astype(np.float32)
+    return np.where(np.isnan(ad_af), info_af, ad_af)
+
+
+def featurize(
+    table: VariantTable,
+    fasta: FastaReader,
+    annotate_intervals: dict[str, IntervalSet] | None = None,
+    flow_order: str = fops.DEFAULT_FLOW_ORDER,
+    extra_info_fields: list[str] | None = None,
+) -> FeatureSet:
+    """Full featurization: BASE_FEATURES + one 0/1 column per annotation interval.
+
+    Device kernels are jit-compiled once per padded batch shape.
+    """
+    alle = classify_alleles(table)
+    windows = gather_windows(table, fasta)
+
+    jw = jnp.asarray(windows)
+    gc = fops.gc_content(jw, CENTER, radius=10)
+    hmer_len, hmer_nuc = fops.hmer_indel_features(
+        jw, CENTER, jnp.asarray(alle.is_indel), jnp.asarray(alle.indel_nuc)
+    )
+    left_motif, right_motif = fops.motif_codes(jw, CENTER, k=5)
+    cyc = fops.cycle_skip_status(
+        jw,
+        CENTER,
+        jnp.asarray(alle.ref_code),
+        jnp.asarray(alle.alt_code),
+        jnp.asarray(alle.is_snp),
+        flow_order=flow_order,
+    )
+
+    gts = table.genotypes()
+    is_het = (gts[:, 0] != gts[:, 1]) & (gts[:, 1] >= 0)
+    gq = table.format_numeric("GQ", max_len=1, missing=np.nan)[:, 0]
+
+    cols: dict[str, np.ndarray] = {
+        "qual": np.nan_to_num(table.qual, nan=0.0),
+        "dp": np.nan_to_num(table.info_field("DP"), nan=0.0),
+        "sor": np.nan_to_num(table.info_field("SOR"), nan=0.0),
+        "af": np.nan_to_num(_compute_af(table), nan=0.0),
+        "gq": np.nan_to_num(gq, nan=0.0),
+        "is_het": is_het.astype(np.float32),
+        "is_snp": alle.is_snp.astype(np.float32),
+        "is_indel": alle.is_indel.astype(np.float32),
+        "is_ins": alle.is_ins.astype(np.float32),
+        "indel_length": alle.indel_length,
+        "hmer_indel_length": np.asarray(hmer_len),
+        "hmer_indel_nuc": np.asarray(hmer_nuc),
+        "gc_content": np.asarray(gc),
+        "cycleskip_status": np.asarray(cyc),
+        "left_motif": np.asarray(left_motif),
+        "right_motif": np.asarray(right_motif),
+        "ref_code": alle.ref_code,
+        "alt_code": alle.alt_code,
+        "n_alts": alle.n_alts,
+    }
+    names = list(BASE_FEATURES)
+
+    for f in extra_info_fields or []:
+        cols[f] = np.nan_to_num(table.info_field(f), nan=0.0).astype(np.float32)
+        names.append(f)
+
+    if annotate_intervals:
+        coords = iops.GenomeCoords(
+            table.header.contig_lengths
+            or {c: fasta.get_reference_length(c) for c in fasta.references}
+        )
+        gpos = coords.globalize(np.asarray(table.chrom), table.pos - 1)
+        for name, iv in annotate_intervals.items():
+            gs, ge = coords.globalize_intervals(iv)
+            cols[name] = iops.membership(gpos, gs, ge).astype(np.float32)
+            names.append(name)
+
+    return FeatureSet(columns=cols, feature_names=names, windows=windows)

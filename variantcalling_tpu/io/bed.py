@@ -1,0 +1,210 @@
+"""BED / Picard interval_list parsing and host-side interval algebra.
+
+Replaces the reference's bedtools/pybedtools subprocess layer
+(coverage_analysis.py:732, quick_fingerprinter.py:56-72) with numpy
+sorted-interval operations; device-side membership joins live in
+:mod:`variantcalling_tpu.ops.intervals`.
+
+Intervals are half-open 0-based [start, end) as in BED.
+"""
+
+from __future__ import annotations
+
+import gzip
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class IntervalSet:
+    """Columnar interval set: parallel arrays (chrom str, start, end)."""
+
+    chrom: np.ndarray  # object (str)
+    start: np.ndarray  # int64
+    end: np.ndarray  # int64
+    name: np.ndarray | None = None
+    header_lines: list[str] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.start)
+
+    def total_length(self) -> int:
+        return int(np.sum(self.end - self.start))
+
+    def by_chrom(self) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+        """chrom -> (starts, ends), each sorted by start."""
+        out: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        for c in dict.fromkeys(self.chrom.tolist()):
+            m = self.chrom == c
+            s, e = self.start[m], self.end[m]
+            order = np.argsort(s, kind="stable")
+            out[c] = (s[order], e[order])
+        return out
+
+    def merged(self) -> "IntervalSet":
+        """Union of overlapping/adjacent intervals (bedtools merge semantics)."""
+        chroms: list[str] = []
+        starts: list[int] = []
+        ends: list[int] = []
+        for c, (s, e) in self.by_chrom().items():
+            cur_s = cur_e = None
+            for i in range(len(s)):
+                if cur_s is None:
+                    cur_s, cur_e = int(s[i]), int(e[i])
+                elif int(s[i]) <= cur_e:
+                    cur_e = max(cur_e, int(e[i]))
+                else:
+                    chroms.append(c)
+                    starts.append(cur_s)
+                    ends.append(cur_e)
+                    cur_s, cur_e = int(s[i]), int(e[i])
+            if cur_s is not None:
+                chroms.append(c)
+                starts.append(cur_s)
+                ends.append(cur_e)
+        return IntervalSet(_obj(chroms), np.asarray(starts, dtype=np.int64), np.asarray(ends, dtype=np.int64))
+
+    def intersect(self, other: "IntervalSet") -> "IntervalSet":
+        """Pairwise intersection (bedtools intersect), via merged sweeps per chrom."""
+        a = self.merged().by_chrom()
+        b = other.merged().by_chrom()
+        chroms: list[str] = []
+        starts: list[int] = []
+        ends: list[int] = []
+        for c in a:
+            if c not in b:
+                continue
+            sa, ea = a[c]
+            sb, eb = b[c]
+            i = j = 0
+            while i < len(sa) and j < len(sb):
+                lo = max(sa[i], sb[j])
+                hi = min(ea[i], eb[j])
+                if lo < hi:
+                    chroms.append(c)
+                    starts.append(int(lo))
+                    ends.append(int(hi))
+                if ea[i] < eb[j]:
+                    i += 1
+                else:
+                    j += 1
+        return IntervalSet(_obj(chroms), np.asarray(starts, dtype=np.int64), np.asarray(ends, dtype=np.int64))
+
+    def contains(self, chrom: np.ndarray, pos0: np.ndarray) -> np.ndarray:
+        """Membership of 0-based positions; vectorized searchsorted per chrom."""
+        out = np.zeros(len(pos0), dtype=bool)
+        merged = self.merged().by_chrom()
+        chrom = np.asarray(chrom)
+        for c, (s, e) in merged.items():
+            m = chrom == c
+            if not m.any():
+                continue
+            idx = np.searchsorted(s, pos0[m], side="right") - 1
+            ok = idx >= 0
+            hit = np.zeros(m.sum(), dtype=bool)
+            hit[ok] = pos0[m][ok] < e[idx[ok]]
+            out[m] = hit
+        return out
+
+
+def _obj(x: list[str]) -> np.ndarray:
+    a = np.empty(len(x), dtype=object)
+    a[:] = x
+    return a
+
+
+def _open_text(path: str):
+    if str(path).endswith(".gz"):
+        return gzip.open(path, "rt")
+    return open(path, "rt")
+
+
+def read_bed(path: str) -> IntervalSet:
+    """Read BED (3+ columns); tolerates track/browser/# headers."""
+    chroms: list[str] = []
+    starts: list[int] = []
+    ends: list[int] = []
+    names: list[str] = []
+    headers: list[str] = []
+    with _open_text(path) as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line or line.startswith(("#", "track", "browser")):
+                headers.append(line)
+                continue
+            p = line.split("\t")
+            chroms.append(p[0])
+            starts.append(int(p[1]))
+            ends.append(int(p[2]))
+            names.append(p[3] if len(p) > 3 else "")
+    return IntervalSet(
+        _obj(chroms),
+        np.asarray(starts, dtype=np.int64),
+        np.asarray(ends, dtype=np.int64),
+        name=_obj(names),
+        header_lines=headers,
+    )
+
+
+def read_interval_list(path: str) -> IntervalSet:
+    """Picard .interval_list: SAM-style @ header + 1-based inclusive rows.
+
+    Replaces picard IntervalListToBed (coverage_analysis.py:895).
+    """
+    chroms: list[str] = []
+    starts: list[int] = []
+    ends: list[int] = []
+    headers: list[str] = []
+    with _open_text(path) as fh:
+        for line in fh:
+            line = line.rstrip("\n")
+            if not line or line.startswith("@"):
+                headers.append(line)
+                continue
+            p = line.split("\t")
+            chroms.append(p[0])
+            starts.append(int(p[1]) - 1)  # 1-based inclusive -> 0-based half-open
+            ends.append(int(p[2]))
+    return IntervalSet(
+        _obj(chroms), np.asarray(starts, dtype=np.int64), np.asarray(ends, dtype=np.int64), header_lines=headers
+    )
+
+
+def read_intervals(path: str) -> IntervalSet:
+    """Dispatch on extension: .bed(.gz) or .interval_list (reference IntervalFile behavior)."""
+    s = str(path)
+    if s.endswith(".interval_list"):
+        return read_interval_list(path)
+    return read_bed(path)
+
+
+def write_bed(path: str, intervals: IntervalSet) -> None:
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "wt") as out:
+        for i in range(len(intervals)):
+            cols = [str(intervals.chrom[i]), str(int(intervals.start[i])), str(int(intervals.end[i]))]
+            if intervals.name is not None and intervals.name[i]:
+                cols.append(str(intervals.name[i]))
+            out.write("\t".join(cols) + "\n")
+
+
+class BedWriter:
+    """Streaming BED writer (parity: ugbio_core.vcfbed.bed_writer.BedWriter)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fh = (gzip.open if str(path).endswith(".gz") else open)(path, "wt")
+
+    def write(self, chrom: str, start: int, end: int, *extra) -> None:
+        cols = [chrom, str(start), str(end), *map(str, extra)]
+        self._fh.write("\t".join(cols) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

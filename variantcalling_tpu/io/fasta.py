@@ -1,0 +1,146 @@
+"""Indexed FASTA reader (pyfaidx/pysam.FastaFile equivalent, no native deps).
+
+Supports .fai index files (created on demand for uncompressed FASTA).
+Used by featurization for motif windows and hmer detection
+(parity targets: calibrate_bridging_snvs.py:3 FastaFile usage,
+collect_hpol_table.py pyfaidx usage).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class _FaiEntry:
+    length: int
+    offset: int
+    line_bases: int
+    line_width: int
+
+
+def build_fai(path: str) -> dict[str, _FaiEntry]:
+    """Scan a FASTA and build the .fai table (writes <path>.fai)."""
+    entries: dict[str, _FaiEntry] = {}
+    order: list[str] = []
+    with open(path, "rb") as fh:
+        name = None
+        length = 0
+        offset = 0
+        line_bases = 0
+        line_width = 0
+        pos = 0
+        for raw in fh:
+            line_len = len(raw)
+            line = raw.rstrip(b"\r\n")
+            if line.startswith(b">"):
+                if name is not None:
+                    entries[name] = _FaiEntry(length, offset, line_bases, line_width)
+                name = line[1:].split()[0].decode()
+                order.append(name)
+                length = 0
+                offset = pos + line_len
+                line_bases = 0
+                line_width = 0
+            else:
+                if line_bases == 0:
+                    line_bases = len(line)
+                    line_width = line_len
+                length += len(line)
+            pos += line_len
+        if name is not None:
+            entries[name] = _FaiEntry(length, offset, line_bases, line_width)
+    with open(path + ".fai", "wt") as out:
+        for n in order:
+            e = entries[n]
+            out.write(f"{n}\t{e.length}\t{e.offset}\t{e.line_bases}\t{e.line_width}\n")
+    return entries
+
+
+def read_fai(path: str) -> dict[str, _FaiEntry]:
+    entries: dict[str, _FaiEntry] = {}
+    with open(path, "rt") as fh:
+        for line in fh:
+            p = line.rstrip("\n").split("\t")
+            entries[p[0]] = _FaiEntry(int(p[1]), int(p[2]), int(p[3]), int(p[4]))
+    return entries
+
+
+class FastaReader:
+    """Random-access FASTA with 0-based half-open ``fetch``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        fai = path + ".fai"
+        if os.path.exists(fai):
+            self._index = read_fai(fai)
+        else:
+            self._index = build_fai(path)
+        self._fh = open(path, "rb")
+
+    @property
+    def references(self) -> list[str]:
+        return list(self._index)
+
+    def get_reference_length(self, chrom: str) -> int:
+        return self._index[chrom].length
+
+    def fetch(self, chrom: str, start: int, end: int) -> str:
+        """Uppercased sequence [start, end), clamped to contig bounds."""
+        e = self._index[chrom]
+        start = max(0, int(start))
+        end = min(e.length, int(end))
+        if end <= start:
+            return ""
+        first_line = start // e.line_bases
+        byte_start = e.offset + first_line * e.line_width + (start - first_line * e.line_bases)
+        last_line = (end - 1) // e.line_bases
+        byte_end = e.offset + last_line * e.line_width + ((end - 1) - last_line * e.line_bases) + 1
+        self._fh.seek(byte_start)
+        data = self._fh.read(byte_end - byte_start)
+        return data.replace(b"\n", b"").replace(b"\r", b"").decode().upper()
+
+    def fetch_array(self, chrom: str, start: int, end: int, pad: str = "N") -> np.ndarray:
+        """uint8 sequence codes over [start, end) with out-of-bounds padding.
+
+        Codes: A=0 C=1 G=2 T=3 other=4 — the device-side encoding used by the
+        featurization kernels.
+        """
+        seq = self.fetch(chrom, start, end)
+        left_pad = max(0, -start)
+        right_pad = (end - start) - left_pad - len(seq)
+        return encode_seq(pad * left_pad + seq + pad * right_pad)
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_CODE = np.full(256, 4, dtype=np.uint8)
+for _i, _b in enumerate(b"ACGT"):
+    _CODE[_b] = _i
+for _i, _b in enumerate(b"acgt"):
+    _CODE[_b] = _i
+
+
+def encode_seq(seq: str) -> np.ndarray:
+    """str -> uint8 codes (A0 C1 G2 T3 N/other 4)."""
+    return _CODE[np.frombuffer(seq.encode(), dtype=np.uint8)]
+
+
+def decode_seq(codes: np.ndarray) -> str:
+    return "".join("ACGTN"[c] for c in codes)
+
+
+def revcomp(seq: str) -> str:
+    """Reverse complement (parity: ugbio_core.dna_sequence_utils.revcomp)."""
+    comp = {"A": "T", "C": "G", "G": "C", "T": "A", "N": "N", "a": "t", "c": "g", "g": "c", "t": "a"}
+    return "".join(comp.get(c, "N") for c in reversed(seq))

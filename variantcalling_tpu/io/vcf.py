@@ -1,0 +1,377 @@
+"""Columnar VCF/gVCF reader and writer (host-side ingest layer).
+
+The reference reads VCFs through pysam/htslib one record at a time
+(e.g. compress_gvcf.py:19, convert_haploid_regions.py:3) and through
+``ugbio_core.vcfbed.vcftools.get_vcf_df`` into pandas. This framework's
+ingest instead produces a **columnar** :class:`VariantTable` — numpy arrays
+over all records — which featurization turns into padded device tensors.
+The original tab-separated fields are retained so writers can rewrite only
+the columns a pipeline touched (FILTER/INFO/FORMAT), keeping untouched
+bytes identical to the input.
+
+BGZF-compressed inputs (``.gz``) are readable via Python's gzip (BGZF is a
+gzip-compatible framing); a C++ BGZF codec accelerates this path when built
+(variantcalling_tpu/native).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io as _io
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+MISSING = "."
+
+
+def _open_text(path: str):
+    if str(path).endswith(".gz") or str(path).endswith(".bgz"):
+        return _io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
+    return open(path, "rt", encoding="utf-8")
+
+
+@dataclass
+class VcfHeader:
+    """Parsed VCF header: meta lines (verbatim), contigs, field definitions, samples."""
+
+    lines: list[str] = field(default_factory=list)  # '##...' lines, no newline
+    samples: list[str] = field(default_factory=list)
+    contigs: list[str] = field(default_factory=list)
+    contig_lengths: dict[str, int] = field(default_factory=dict)
+    infos: dict[str, dict] = field(default_factory=dict)  # id -> {Number, Type, Description}
+    formats: dict[str, dict] = field(default_factory=dict)
+    filters: dict[str, str] = field(default_factory=dict)
+
+    @staticmethod
+    def _parse_structured(line: str) -> dict:
+        # ##INFO=<ID=DP,Number=1,Type=Integer,Description="...">
+        inner = line[line.index("<") + 1 : line.rindex(">")]
+        out: dict[str, str] = {}
+        key = ""
+        val = ""
+        in_quotes = False
+        target = "key"
+        for ch in inner:
+            if target == "key":
+                if ch == "=":
+                    target = "val"
+                else:
+                    key += ch
+            else:
+                if ch == '"':
+                    in_quotes = not in_quotes
+                    val += ch
+                elif ch == "," and not in_quotes:
+                    out[key] = val.strip('"')
+                    key, val, target = "", "", "key"
+                else:
+                    val += ch
+        if key:
+            out[key] = val.strip('"')
+        return out
+
+    def add_meta_line(self, line: str) -> None:
+        line = line.rstrip("\n")
+        self.lines.append(line)
+        if line.startswith("##contig="):
+            d = self._parse_structured(line)
+            name = d.get("ID", "")
+            self.contigs.append(name)
+            if "length" in d:
+                try:
+                    self.contig_lengths[name] = int(d["length"])
+                except ValueError:
+                    pass
+        elif line.startswith("##INFO="):
+            d = self._parse_structured(line)
+            self.infos[d.get("ID", "")] = d
+        elif line.startswith("##FORMAT="):
+            d = self._parse_structured(line)
+            self.formats[d.get("ID", "")] = d
+        elif line.startswith("##FILTER="):
+            d = self._parse_structured(line)
+            self.filters[d.get("ID", "")] = d.get("Description", "")
+
+    def ensure_info(self, info_id: str, number: str, info_type: str, description: str) -> None:
+        if info_id not in self.infos:
+            line = f'##INFO=<ID={info_id},Number={number},Type={info_type},Description="{description}">'
+            self.add_meta_line(line)
+
+    def ensure_filter(self, filter_id: str, description: str) -> None:
+        if filter_id not in self.filters:
+            self.add_meta_line(f'##FILTER=<ID={filter_id},Description="{description}">')
+
+    def column_header(self) -> str:
+        cols = ["#CHROM", "POS", "ID", "REF", "ALT", "QUAL", "FILTER", "INFO"]
+        if self.samples:
+            cols += ["FORMAT", *self.samples]
+        return "\t".join(cols)
+
+
+@dataclass
+class VariantTable:
+    """Columnar view of a VCF: one numpy array per column over all records.
+
+    String-ish columns are object arrays; ragged per-record structures
+    (ALTs, per-sample fields) stay host-side until featurization pads them
+    into device tensors.
+    """
+
+    header: VcfHeader
+    chrom: np.ndarray  # object (str)
+    pos: np.ndarray  # int64, 1-based
+    vid: np.ndarray  # object
+    ref: np.ndarray  # object
+    alt: np.ndarray  # object: comma-joined ALT string as in file ('.' possible)
+    qual: np.ndarray  # float64 (nan for '.')
+    filters: np.ndarray  # object: raw FILTER column string
+    info: np.ndarray  # object: raw INFO column string
+    fmt_keys: np.ndarray | None = None  # object: FORMAT column per record
+    sample_cols: np.ndarray | None = None  # object (n, n_samples): raw sample strings
+
+    def __len__(self) -> int:
+        return len(self.pos)
+
+    @property
+    def n_samples(self) -> int:
+        return len(self.header.samples)
+
+    # -- derived columnar views ------------------------------------------------
+
+    def alt_lists(self) -> list[list[str]]:
+        return [[] if a in (MISSING, "") else a.split(",") for a in self.alt]
+
+    def n_alts(self) -> np.ndarray:
+        return np.fromiter(
+            (0 if a in (MISSING, "") else a.count(",") + 1 for a in self.alt),
+            dtype=np.int32,
+            count=len(self),
+        )
+
+    def filter_sets(self) -> list[set[str]]:
+        return [set() if f in (MISSING, "", "PASS") else set(f.split(";")) for f in self.filters]
+
+    def info_field(self, name: str, dtype=np.float64, missing=np.nan, index: int = 0) -> np.ndarray:
+        """Vectorized extraction of one INFO key (scalar or ``index``-th element)."""
+        out = np.full(len(self), missing, dtype=dtype)
+        key_eq = name + "="
+        for i, s in enumerate(self.info):
+            if s is None or s == MISSING:
+                continue
+            for part in s.split(";"):
+                if part.startswith(key_eq):
+                    v = part[len(key_eq) :]
+                    if "," in v:
+                        v = v.split(",")[index]
+                    if v != MISSING and v != "":
+                        try:
+                            out[i] = dtype(v) if not isinstance(dtype, type) else np.dtype(dtype).type(v)
+                        except (ValueError, TypeError):
+                            pass
+                    break
+        return out
+
+    def info_flag(self, name: str) -> np.ndarray:
+        out = np.zeros(len(self), dtype=bool)
+        for i, s in enumerate(self.info):
+            if s is None or s == MISSING:
+                continue
+            for part in s.split(";"):
+                if part == name or part.startswith(name + "="):
+                    out[i] = True
+                    break
+        return out
+
+    def format_field(self, name: str, sample: int = 0) -> list[str | None]:
+        """Raw string of one FORMAT key for one sample, per record (None if absent)."""
+        if self.fmt_keys is None or self.sample_cols is None:
+            return [None] * len(self)
+        out: list[str | None] = []
+        for i in range(len(self)):
+            keys = self.fmt_keys[i]
+            if not keys or keys == MISSING:
+                out.append(None)
+                continue
+            try:
+                idx = keys.split(":").index(name)
+            except ValueError:
+                out.append(None)
+                continue
+            vals = self.sample_cols[i][sample].split(":")
+            out.append(vals[idx] if idx < len(vals) else None)
+        return out
+
+    def genotypes(self, sample: int = 0) -> np.ndarray:
+        """(n, 2) int8 diploid genotype; -1 for missing/haploid-second slot; phasing dropped."""
+        gt_strs = self.format_field("GT", sample)
+        out = np.full((len(self), 2), -1, dtype=np.int8)
+        for i, g in enumerate(gt_strs):
+            if not g:
+                continue
+            parts = g.replace("|", "/").split("/")
+            for j, p in enumerate(parts[:2]):
+                if p not in (MISSING, ""):
+                    out[i, j] = int(p)
+        return out
+
+    def format_numeric(self, name: str, sample: int = 0, max_len: int | None = None, missing=-1) -> np.ndarray:
+        """Padded (n, max_len) numeric tensor of a comma-listed FORMAT field (e.g. PL, AD)."""
+        raw = self.format_field(name, sample)
+        split = [r.split(",") if r not in (None, MISSING, "") else [] for r in raw]
+        if max_len is None:
+            max_len = max((len(s) for s in split), default=0)
+        out = np.full((len(self), max_len), missing, dtype=np.float64)
+        for i, vals in enumerate(split):
+            for j, v in enumerate(vals[:max_len]):
+                if v not in (MISSING, ""):
+                    try:
+                        out[i, j] = float(v)
+                    except ValueError:
+                        pass
+        return out
+
+
+def read_vcf(
+    path: str,
+    region: tuple[str, int, int] | None = None,
+    drop_format: bool = False,
+) -> VariantTable:
+    """Parse a VCF/gVCF (.vcf or .vcf.gz) into a :class:`VariantTable`.
+
+    ``region`` is (chrom, start_1based, end_inclusive); streaming filter,
+    no index required (an index-aware C++ path can replace this later).
+    """
+    header = VcfHeader()
+    chrom: list[str] = []
+    pos: list[int] = []
+    vid: list[str] = []
+    ref: list[str] = []
+    alt: list[str] = []
+    qual: list[float] = []
+    filt: list[str] = []
+    info: list[str] = []
+    fmt_keys: list[str] = []
+    sample_cols: list[tuple[str, ...]] = []
+    n_samples = 0
+
+    with _open_text(path) as fh:
+        for line in fh:
+            if line.startswith("##"):
+                header.add_meta_line(line)
+                continue
+            if line.startswith("#"):
+                cols = line.rstrip("\n").split("\t")
+                if len(cols) > 9:
+                    header.samples = cols[9:]
+                n_samples = len(header.samples)
+                continue
+            parts = line.rstrip("\n").split("\t")
+            if region is not None:
+                if parts[0] != region[0]:
+                    continue
+                p = int(parts[1])
+                if p < region[1] or p > region[2]:
+                    continue
+            chrom.append(parts[0])
+            pos.append(int(parts[1]))
+            vid.append(parts[2])
+            ref.append(parts[3])
+            alt.append(parts[4])
+            qual.append(float(parts[5]) if parts[5] != MISSING else np.nan)
+            filt.append(parts[6])
+            info.append(parts[7] if len(parts) > 7 else MISSING)
+            if n_samples and not drop_format:
+                fmt_keys.append(parts[8] if len(parts) > 8 else MISSING)
+                sample_cols.append(tuple(parts[9 : 9 + n_samples]))
+
+    def obj(x):
+        a = np.empty(len(x), dtype=object)
+        a[:] = x
+        return a
+
+    table = VariantTable(
+        header=header,
+        chrom=obj(chrom),
+        pos=np.asarray(pos, dtype=np.int64),
+        vid=obj(vid),
+        ref=obj(ref),
+        alt=obj(alt),
+        qual=np.asarray(qual, dtype=np.float64),
+        filters=obj(filt),
+        info=obj(info),
+    )
+    if n_samples and not drop_format:
+        table.fmt_keys = obj(fmt_keys)
+        sc = np.empty((len(sample_cols), n_samples), dtype=object)
+        for i, tup in enumerate(sample_cols):
+            sc[i, :] = tup
+        table.sample_cols = sc
+    return table
+
+
+def format_qual(q: float) -> str:
+    if q is None or (isinstance(q, float) and np.isnan(q)):
+        return MISSING
+    if float(q) == int(q):
+        return str(int(q))
+    return f"{q:g}"
+
+
+def write_vcf(
+    path: str,
+    table: VariantTable,
+    new_filters: np.ndarray | None = None,
+    extra_info: dict[str, np.ndarray] | None = None,
+    sample_overrides: dict[int, np.ndarray] | None = None,
+    fmt_override: np.ndarray | None = None,
+) -> None:
+    """Write a VariantTable back to VCF, rewriting only the requested columns.
+
+    - ``new_filters``: object array replacing the FILTER column.
+    - ``extra_info``: info-key -> per-record value (np.nan/None skips a record;
+      ``True`` writes a bare flag). Appended to the existing INFO string.
+    - ``sample_overrides``: sample index -> object array of replacement
+      sample strings; ``fmt_override`` replaces the FORMAT column.
+    """
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "wt") as out:
+        for line in table.header.lines:
+            out.write(line + "\n")
+        out.write(table.header.column_header() + "\n")
+        n = len(table)
+        for i in range(n):
+            info_s = table.info[i]
+            if extra_info:
+                parts = [] if info_s in (MISSING, "", None) else [info_s]
+                for k, vals in extra_info.items():
+                    v = vals[i]
+                    if v is None or (isinstance(v, float) and np.isnan(v)):
+                        continue
+                    if v is True:
+                        parts.append(k)
+                    elif isinstance(v, (float, np.floating)):
+                        parts.append(f"{k}={float(v):g}")
+                    else:
+                        parts.append(f"{k}={v}")
+                info_s = ";".join(parts) if parts else MISSING
+            filt_s = new_filters[i] if new_filters is not None else table.filters[i]
+            cols = [
+                table.chrom[i],
+                str(table.pos[i]),
+                table.vid[i],
+                table.ref[i],
+                table.alt[i],
+                format_qual(table.qual[i]),
+                filt_s,
+                info_s,
+            ]
+            if table.fmt_keys is not None:
+                cols.append(fmt_override[i] if fmt_override is not None else table.fmt_keys[i])
+                for s in range(table.n_samples):
+                    if sample_overrides and s in sample_overrides:
+                        cols.append(sample_overrides[s][i])
+                    else:
+                        cols.append(table.sample_cols[i][s])
+            out.write("\t".join(cols) + "\n")

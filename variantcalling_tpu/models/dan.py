@@ -1,0 +1,141 @@
+"""Neural variant-filter model (deep averaging network) — the MXU-native
+model family.
+
+The reference's model families are random forest + threshold models
+(docs/howto-callset-filter.md). This framework adds a TPU-first family: an
+embedding + MLP scorer over the same per-variant features — motif codes get
+learned embeddings, numeric features are normalized, and the network runs
+in bfloat16 on the MXU. Training is a standard optax step, sharded dp
+(variants) × mp (hidden) over the mesh; gradient reduction is XLA-inserted
+psum over dp, matching BASELINE config 3's sharded-fit requirement.
+
+Precedent for DAN-style scoring of variants: "Genome Variant Calling with
+a Deep Averaging Network" (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+MOTIF_VOCAB = 5**5  # base-5 packed 5-mers (A,C,G,T,N)
+
+
+@dataclass(frozen=True)
+class DanConfig:
+    n_numeric: int  # numeric feature count (feature matrix minus motif columns)
+    embed_dim: int = 16
+    hidden: int = 256
+    n_layers: int = 2
+    dtype: str = "bfloat16"
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-4
+
+
+def init_params(cfg: DanConfig, key: jax.Array) -> dict:
+    k_embed, k_in, *k_hidden = jax.random.split(key, cfg.n_layers + 2)
+    in_dim = cfg.n_numeric + 2 * cfg.embed_dim
+    params = {
+        "motif_embed": jax.random.normal(k_embed, (MOTIF_VOCAB, cfg.embed_dim)) * 0.02,
+        "w_in": jax.random.normal(k_in, (in_dim, cfg.hidden)) * (1.0 / np.sqrt(in_dim)),
+        "b_in": jnp.zeros((cfg.hidden,)),
+        "w_out": jnp.zeros((cfg.hidden, 1)),
+        "b_out": jnp.zeros((1,)),
+    }
+    for i, k in enumerate(k_hidden[: cfg.n_layers - 1]):
+        params[f"w_{i}"] = jax.random.normal(k, (cfg.hidden, cfg.hidden)) * (1.0 / np.sqrt(cfg.hidden))
+        params[f"b_{i}"] = jnp.zeros((cfg.hidden,))
+    return params
+
+
+def param_shardings(cfg: DanConfig, mesh) -> dict:
+    """NamedShardings: hidden axis tensor-parallel over mp, embeddings replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s = {
+        "motif_embed": NamedSharding(mesh, P(None, None)),
+        "w_in": NamedSharding(mesh, P(None, MODEL_AXIS)),
+        "b_in": NamedSharding(mesh, P(MODEL_AXIS)),
+        "w_out": NamedSharding(mesh, P(MODEL_AXIS, None)),
+        "b_out": NamedSharding(mesh, P(None)),
+    }
+    for i in range(cfg.n_layers - 1):
+        s[f"w_{i}"] = NamedSharding(mesh, P(MODEL_AXIS, None))
+        s[f"b_{i}"] = NamedSharding(mesh, P(None))
+    return s
+
+
+def forward(cfg: DanConfig, params: dict, numeric: jnp.ndarray, motif_left: jnp.ndarray,
+            motif_right: jnp.ndarray) -> jnp.ndarray:
+    """Logit per variant. numeric (N, n_numeric) f32; motifs int32 in [0, 5^5)."""
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    emb_l = params["motif_embed"][motif_left]
+    emb_r = params["motif_embed"][motif_right]
+    x = jnp.concatenate([numeric, emb_l, emb_r], axis=1).astype(dtype)
+    h = jax.nn.gelu(x @ params["w_in"].astype(dtype) + params["b_in"].astype(dtype))
+    for i in range(cfg.n_layers - 1):
+        h = jax.nn.gelu(h @ params[f"w_{i}"].astype(dtype) + params[f"b_{i}"].astype(dtype))
+    logit = h @ params["w_out"].astype(dtype) + params["b_out"].astype(dtype)
+    return logit[:, 0].astype(jnp.float32)
+
+
+def predict_score(cfg: DanConfig, params: dict, numeric, motif_left, motif_right) -> jnp.ndarray:
+    return jax.nn.sigmoid(forward(cfg, params, numeric, motif_left, motif_right))
+
+
+def make_optimizer(cfg: DanConfig):
+    return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+
+
+def loss_fn(cfg: DanConfig, params: dict, batch: dict) -> jnp.ndarray:
+    """Masked BCE over valid rows; `weight` supports exome upweighting
+    (reference --exome_weight semantics, docs/train_models_pipeline.md)."""
+    logits = forward(cfg, params, batch["numeric"], batch["motif_left"], batch["motif_right"])
+    losses = optax.sigmoid_binary_cross_entropy(logits, batch["label"])
+    w = batch.get("weight")
+    if w is None:
+        w = jnp.ones_like(losses)
+    valid = batch.get("valid")
+    if valid is not None:
+        w = w * valid.astype(w.dtype)
+    return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def train_step(cfg: DanConfig, optimizer, params: dict, opt_state, batch: dict):
+    loss, grads = jax.value_and_grad(lambda p: loss_fn(cfg, p, batch))(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+@dataclass
+class DanModel:
+    """Pickle-able container compatible with the model registry."""
+
+    cfg: DanConfig
+    params_np: dict  # numpy copies of params
+    feature_names: list[str] = field(default_factory=list)
+    numeric_features: list[str] = field(default_factory=list)
+    pass_threshold: float = 0.5
+
+    def params(self) -> dict:
+        return {k: jnp.asarray(v) for k, v in self.params_np.items()}
+
+    @staticmethod
+    def from_params(cfg, params, feature_names, numeric_features, pass_threshold=0.5) -> "DanModel":
+        return DanModel(
+            cfg=cfg,
+            params_np={k: np.asarray(v) for k, v in params.items()},
+            feature_names=list(feature_names),
+            numeric_features=list(numeric_features),
+            pass_threshold=pass_threshold,
+        )

@@ -1,0 +1,142 @@
+"""Decision-forest inference as vmap'd node-gather traversal on TPU.
+
+The reference's hot loop is sklearn RandomForest / xgboost ``predict_proba``
+over ~5M variants on CPU (docs/howto-callset-filter.md:63,114; BASELINE
+north_star). Here a trained forest is flattened into dense per-tree node
+arrays and traversal is ``max_depth`` rounds of batched gathers — fully
+vectorized over (variants × trees), jit/pjit-safe, and shardable along the
+variants axis. Works for both class-probability forests (RF: mean of leaf
+probabilities) and boosted margins (GBT: sum + sigmoid).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+LEAF = -1
+
+
+@dataclass
+class FlatForest:
+    """Dense forest: (n_trees, max_nodes) arrays; leaves self-loop with feature=LEAF."""
+
+    feature: np.ndarray  # int32 (T, M); LEAF for leaf nodes
+    threshold: np.ndarray  # float32 (T, M)
+    left: np.ndarray  # int32 (T, M)
+    right: np.ndarray  # int32 (T, M)
+    value: np.ndarray  # float32 (T, M): leaf payload (class-1 prob or margin)
+    max_depth: int
+    aggregation: str = "mean"  # "mean" (RF proba) | "logit_sum" (GBT margin)
+    base_score: float = 0.0  # added before sigmoid for logit_sum
+    feature_names: list[str] = field(default_factory=list)
+    pass_threshold: float = 0.5  # TREE_SCORE >= this -> PASS
+
+    @property
+    def n_trees(self) -> int:
+        return self.feature.shape[0]
+
+    def astuple(self):
+        return (
+            jnp.asarray(self.feature),
+            jnp.asarray(self.threshold),
+            jnp.asarray(self.left),
+            jnp.asarray(self.right),
+            jnp.asarray(self.value),
+        )
+
+
+def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
+    """TREE_SCORE in [0,1] for a (N, F) feature matrix (jit-safe).
+
+    Traversal: ``max_depth`` rounds of gathers; each round every (variant,
+    tree) pair advances one level (leaves self-loop), so control flow is
+    static and XLA lowers the whole forest to fused gathers — no
+    per-variant Python, no host sync.
+    """
+    feat, thr, left, right, value = forest.astuple()
+    n = x.shape[0]
+    t = feat.shape[0]
+    tree_ids = jnp.arange(t)[None, :]  # (1, T)
+
+    def body(_, idx):
+        f = feat[tree_ids, idx]  # (N, T)
+        th = thr[tree_ids, idx]
+        xv = jnp.take_along_axis(x, jnp.maximum(f, 0), axis=1)  # (N, T)
+        nxt = jnp.where(xv <= th, left[tree_ids, idx], right[tree_ids, idx])
+        return jnp.where(f == LEAF, idx, nxt)
+
+    idx0 = jnp.zeros((n, t), dtype=jnp.int32)
+    idx = jax.lax.fori_loop(0, forest.max_depth, body, idx0)
+    leaf_vals = value[tree_ids, idx]  # (N, T)
+    if forest.aggregation == "mean":
+        return jnp.mean(leaf_vals, axis=1)
+    if forest.aggregation == "logit_sum":
+        return jax.nn.sigmoid(jnp.sum(leaf_vals, axis=1) + forest.base_score)
+    raise ValueError(f"unknown aggregation {forest.aggregation!r}")
+
+
+def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: float = 0.5) -> FlatForest:
+    """Flatten a fitted sklearn RandomForestClassifier/DecisionTree ensemble.
+
+    Faithful to sklearn semantics: split is ``x[f] <= threshold`` goes left
+    (sklearn uses <=); leaf value = class-1 fraction of training samples in
+    the leaf; prediction = mean over trees (predict_proba).
+    """
+    estimators = getattr(clf, "estimators_", None) or [clf]
+    n_nodes = [e.tree_.node_count for e in estimators]
+    m = max(n_nodes)
+    t = len(estimators)
+    feature = np.full((t, m), LEAF, dtype=np.int32)
+    threshold = np.zeros((t, m), dtype=np.float32)
+    left = np.zeros((t, m), dtype=np.int32)
+    right = np.zeros((t, m), dtype=np.int32)
+    value = np.zeros((t, m), dtype=np.float32)
+    max_depth = 1
+    for ti, est in enumerate(estimators):
+        tr = est.tree_
+        nc = tr.node_count
+        f = tr.feature.astype(np.int32)
+        is_leaf = tr.children_left == -1
+        feature[ti, :nc] = np.where(is_leaf, LEAF, f)
+        # sklearn compares float32-cast x against float64 thresholds; storing
+        # the largest f32 <= threshold keeps `x <= thr` decisions bit-identical
+        thr64 = tr.threshold
+        thr32 = thr64.astype(np.float32)
+        too_big = thr32.astype(np.float64) > thr64
+        thr32[too_big] = np.nextafter(thr32[too_big], np.float32(-np.inf))
+        threshold[ti, :nc] = thr32
+        node_ids = np.arange(nc, dtype=np.int32)
+        left[ti, :nc] = np.where(is_leaf, node_ids, tr.children_left)
+        right[ti, :nc] = np.where(is_leaf, node_ids, tr.children_right)
+        counts = tr.value[:, 0, :]  # (nc, n_classes) — class sample fractions
+        if counts.shape[1] == 2:
+            denom = counts.sum(axis=1)
+            value[ti, :nc] = np.where(denom > 0, counts[:, 1] / np.maximum(denom, 1e-12), 0.0)
+        else:
+            value[ti, :nc] = counts[:, 0]
+        max_depth = max(max_depth, int(tr.max_depth))
+    return FlatForest(
+        feature=feature,
+        threshold=threshold,
+        left=left,
+        right=right,
+        value=value,
+        max_depth=max_depth,
+        aggregation="mean",
+        feature_names=feature_names or [],
+        pass_threshold=pass_threshold,
+    )
+
+
+def with_feature_order(forest: FlatForest, feature_names: list[str]) -> FlatForest:
+    """Remap node feature indices to a new feature-column order."""
+    if not forest.feature_names or forest.feature_names == feature_names:
+        return forest
+    mapping = np.asarray([feature_names.index(f) for f in forest.feature_names], dtype=np.int32)
+    new_feat = np.where(forest.feature == LEAF, LEAF, mapping[np.maximum(forest.feature, 0)])
+    return replace(forest, feature=new_feat.astype(np.int32), feature_names=list(feature_names))

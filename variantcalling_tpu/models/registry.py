@@ -1,0 +1,55 @@
+"""Model container: named-model pickles with the reference's naming scheme.
+
+The reference's ``train_models_pipeline`` dumps ``<prefix>.pkl`` holding
+multiple named models — {rf, threshold} × {ignore_gt} × {incl/excl hpol
+runs} (names observed at docs/howto-callset-filter.md:114,139 and
+test_vc_report.py:23). This registry keeps that contract: a dict-like
+pickle ``{model_name: model}`` where model is a FlatForest, ThresholdModel,
+or a fitted sklearn classifier (converted to FlatForest on load).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from variantcalling_tpu.models.forest import FlatForest, from_sklearn
+from variantcalling_tpu.models.threshold import ThresholdModel
+
+MODEL_NAME_PATTERN = "{family}_model_{gt}_{hpol}"  # e.g. rf_model_ignore_gt_incl_hpol_runs
+
+
+def standard_model_names(families=("rf", "threshold")) -> list[str]:
+    names = []
+    for fam in families:
+        for gt in ("ignore_gt", "use_gt"):
+            for hpol in ("incl_hpol_runs", "excl_hpol_runs"):
+                names.append(MODEL_NAME_PATTERN.format(family=fam, gt=gt, hpol=hpol))
+    return names
+
+
+def save_models(path: str, models: dict[str, object]) -> None:
+    with open(path, "wb") as fh:
+        pickle.dump(models, fh)
+
+
+def load_models(path: str) -> dict[str, object]:
+    with open(path, "rb") as fh:
+        models = pickle.load(fh)
+    if not isinstance(models, dict):
+        models = {"model": models}
+    return {k: _coerce(v) for k, v in models.items()}
+
+
+def load_model(path: str, model_name: str) -> object:
+    models = load_models(path)
+    if model_name not in models:
+        raise KeyError(f"model {model_name!r} not in {sorted(models)} (file: {path})")
+    return models[model_name]
+
+
+def _coerce(model: object) -> object:
+    if isinstance(model, (FlatForest, ThresholdModel)):
+        return model
+    if hasattr(model, "tree_") or hasattr(model, "estimators_"):
+        return from_sklearn(model)
+    return model

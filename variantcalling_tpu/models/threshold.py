@@ -1,0 +1,55 @@
+"""Threshold model for somatic callsets (TLOD/SOR), re-derived from the docs.
+
+The reference's somatic filter is "a simple model that uses TLOD and SOR of
+the variant to assign confidence score TREE_SCORE"
+(docs/howto-callset-filter.md:129-139, model name
+``threshold_model_ignore_gt_incl_hpol_runs``). The internal code is in the
+missing ugbio_filtering submodule; this implementation defines the model as
+a per-feature soft margin: each feature contributes
+``sigmoid((x - thr) * sign / scale)`` and TREE_SCORE is the product —
+monotone in each feature, 0.5 at the threshold, hard PASS at
+``score >= pass_threshold``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class ThresholdModel:
+    feature_names: list[str]  # features used, in order of thresholds
+    thresholds: np.ndarray  # float32 (F,)
+    signs: np.ndarray  # +1 = higher is better, -1 = lower is better
+    scales: np.ndarray  # softness per feature
+    pass_threshold: float = 0.5
+    all_feature_names: list[str] = field(default_factory=list)  # column order of X
+
+    def column_indices(self, feature_names: list[str]) -> np.ndarray:
+        return np.asarray([feature_names.index(f) for f in self.feature_names], dtype=np.int32)
+
+
+def predict_score(model: ThresholdModel, x: jnp.ndarray, feature_names: list[str] | None = None) -> jnp.ndarray:
+    """TREE_SCORE in [0,1] for (N, F) features (jit-safe)."""
+    names = feature_names or model.all_feature_names or model.feature_names
+    cols = model.column_indices(names)
+    xs = x[:, cols]
+    margins = (xs - jnp.asarray(model.thresholds)) * jnp.asarray(model.signs) / jnp.asarray(model.scales)
+    return jnp.prod(jax.nn.sigmoid(margins), axis=1)
+
+
+def default_somatic_model(all_feature_names: list[str]) -> ThresholdModel:
+    """TLOD/SOR thresholds per the somatic howto (TLOD high good, SOR low good)."""
+    return ThresholdModel(
+        feature_names=["tlod", "sor"],
+        thresholds=np.asarray([6.3, 3.0], dtype=np.float32),
+        signs=np.asarray([1.0, -1.0], dtype=np.float32),
+        scales=np.asarray([2.0, 1.0], dtype=np.float32),
+        pass_threshold=0.25,
+        all_feature_names=list(all_feature_names),
+    )

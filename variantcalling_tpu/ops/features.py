@@ -1,0 +1,168 @@
+"""Per-variant featurization kernels (reference-context windows -> feature tensors).
+
+The reference featurizes per variant in pandas (classify_indel,
+is_hmer_indel, get_motif_around, gc-content, interval flags — surfaced at
+run_no_gt_report.py:92-94 and consumed by the missing ugbio_filtering
+models). Here featurization is split:
+
+- host: gather fixed-width reference windows around each variant into a
+  (N, W) uint8 tensor (A0 C1 G2 T3 N4) + scalar allele columns,
+- device: batched window kernels below (GC content, homopolymer run length,
+  packed motif codes, cycle-skip status) — all jit/vmap-safe with static
+  shapes, fused by XLA into the classifier's input pipeline.
+
+Window layout convention: ``windows[:, CENTER]`` is the variant's anchor
+base (POS, 1-based VCF => window center index ``center``), left motif is
+``windows[:, center-k:center]``, right context starts at ``center+1``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+A, C, G, T, N = 0, 1, 2, 3, 4
+
+DEFAULT_FLOW_ORDER = "TGCA"  # reference DEFAULT_FLOW_ORDER (ugbio_core.consts)
+
+
+def gc_content(windows: jnp.ndarray, center: int, radius: int = 10) -> jnp.ndarray:
+    """Fraction of G/C in the +-radius window around the anchor (N excluded from denominator)."""
+    w = windows[:, center - radius : center + radius + 1]
+    is_gc = (w == G) | (w == C)
+    is_base = w != N
+    return jnp.sum(is_gc, axis=1) / jnp.maximum(jnp.sum(is_base, axis=1), 1)
+
+
+def run_length_at(windows: jnp.ndarray, start: int, max_run: int = 40) -> jnp.ndarray:
+    """Length of the homopolymer run starting at column ``start`` (capped at max_run).
+
+    run = number of consecutive bases equal to windows[:, start].
+    """
+    base = windows[:, start][:, None]
+    span = windows[:, start : start + max_run]
+    same = span == base
+    # first False position = run length; all-True -> max_run
+    any_diff = ~jnp.all(same, axis=1)
+    first_diff = jnp.argmin(same.astype(jnp.int32), axis=1)
+    return jnp.where(any_diff, first_diff, jnp.minimum(max_run, span.shape[1])).astype(jnp.int32)
+
+
+def hmer_indel_features(
+    windows: jnp.ndarray,
+    center: int,
+    is_indel: jnp.ndarray,
+    indel_nuc: jnp.ndarray,
+    max_run: int = 40,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(hmer_indel_length, hmer_indel_nuc_code) per variant.
+
+    An indel is an hmer indel when its inserted/deleted sequence is a single
+    repeated nucleotide (``indel_nuc`` in 0..3, else 4) that matches the
+    reference base immediately after the anchor; its length is the reference
+    homopolymer run length starting at center+1 (semantics per
+    ugbio_core.vcfbed.variant_annotation.is_hmer_indel as exercised by
+    report categories, report_utils.py:508-538).
+    """
+    run_len = run_length_at(windows, center + 1, max_run=max_run)
+    next_base = windows[:, center + 1]
+    is_hmer = is_indel & (indel_nuc < 4) & (indel_nuc == next_base)
+    hmer_len = jnp.where(is_hmer, run_len, 0).astype(jnp.int32)
+    hmer_nuc = jnp.where(is_hmer, indel_nuc, N).astype(jnp.int32)
+    return hmer_len, hmer_nuc
+
+
+def motif_codes(windows: jnp.ndarray, center: int, k: int = 5) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Base-5-packed left/right k-mer motif codes (ints), adjacent to the anchor.
+
+    left motif = windows[:, center-k:center], right = windows[:, center+1:center+k+1]
+    (parity: get_motif_around(df, 5, fasta) producing left_motif/right_motif).
+    """
+    powers = 5 ** jnp.arange(k - 1, -1, -1)
+    left = jnp.sum(windows[:, center - k : center] * powers, axis=1)
+    right = jnp.sum(windows[:, center + 1 : center + 1 + k] * powers, axis=1)
+    return left.astype(jnp.int32), right.astype(jnp.int32)
+
+
+def _flow_keys(seq: jnp.ndarray, flow_order: jnp.ndarray, max_flows: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(flow count, per-flow hmer key) for each padded sequence.
+
+    Flow sequencing emits one hmer signal per flow cycle base; the key is
+    the run length consumed at each flow and the count is the number of
+    flows until the sequence is consumed. The first N (code 4) truncates
+    the effective sequence (contig-edge padding / reference Ns). Parity
+    concept: ugbio_core.flow_format.flow_based_read.generate_key_from_sequence.
+    """
+    n, L = seq.shape
+    n_flow_bases = flow_order.shape[0]
+    idx = jnp.arange(L)[None, :]
+
+    # effective length: position of the first N, or L if none
+    is_n = seq == N
+    eff_len = jnp.where(jnp.any(is_n, axis=1), jnp.argmax(is_n, axis=1), L).astype(jnp.int32)
+
+    def body(carry, t):
+        ptr, flows = carry
+        flow_base = flow_order[t % n_flow_bases]
+        active = ptr < eff_len
+        # run length of flow_base starting at ptr (within effective sequence)
+        matches_from_ptr = jnp.where((idx >= ptr[:, None]) & (idx < eff_len[:, None]), seq == flow_base, True)
+        run = jnp.argmin(matches_from_ptr.astype(jnp.int32), axis=1) - ptr
+        run = jnp.where(jnp.all(matches_from_ptr, axis=1), eff_len - ptr, run)
+        run = jnp.where(active, jnp.maximum(run, 0), 0)
+        new_flows = jnp.where(active, flows + 1, flows)
+        return (ptr + run, new_flows), run
+
+    ptr0 = jnp.zeros(n, dtype=jnp.int32)
+    flows0 = jnp.zeros(n, dtype=jnp.int32)
+    (ptr, flows), key = _scan_fixed(body, (ptr0, flows0), max_flows)
+    return flows, key.T  # (n,), (n, max_flows)
+
+
+def _flow_key_length(seq: jnp.ndarray, flow_order: jnp.ndarray, max_flows: int) -> jnp.ndarray:
+    return _flow_keys(seq, flow_order, max_flows)[0]
+
+
+def _scan_fixed(body, carry, length):
+    import jax
+
+    return jax.lax.scan(body, carry, jnp.arange(length))
+
+
+def cycle_skip_status(
+    windows: jnp.ndarray,
+    center: int,
+    ref_code: jnp.ndarray,
+    alt_code: jnp.ndarray,
+    is_snp: jnp.ndarray,
+    flow_order: str = DEFAULT_FLOW_ORDER,
+    context: int = 4,
+) -> jnp.ndarray:
+    """Cycle-skip status code per variant: 0=non-skip, 1=possible-cycle-skip, 2=cycle-skip, -1=NA.
+
+    Compares flow keys of the local haplotype (context bases either side of
+    the variant) with ref vs alt at the center:
+
+    - differing flow count -> cycle-skip (2): downstream signals shift by
+      whole flow cycles;
+    - equal count but a flow whose signal changes between zero and nonzero
+      -> possible-cycle-skip (1);
+    - otherwise non-skip (0); non-SNPs are NA (-1).
+
+    Parity concept: ugvc cycleskip_status column (three-valued, detailed
+    VarReport.v0 'cycleskip SNP' category).
+    """
+    fo = jnp.asarray([{"A": A, "C": C, "G": G, "T": T}[c] for c in flow_order], dtype=jnp.int32)
+    L = 2 * context + 1
+    left = windows[:, center - context : center]
+    right = windows[:, center + 1 : center + 1 + context]
+    ref_hap = jnp.concatenate([left, ref_code[:, None], right], axis=1)
+    alt_hap = jnp.concatenate([left, alt_code[:, None], right], axis=1)
+    max_flows = 4 * L + 4
+    ref_flows, ref_key = _flow_keys(ref_hap, fo, max_flows)
+    alt_flows, alt_key = _flow_keys(alt_hap, fo, max_flows)
+    skip = ref_flows != alt_flows
+    zero_pattern_change = jnp.any((ref_key == 0) != (alt_key == 0), axis=1)
+    status = jnp.where(skip, 2, jnp.where(zero_pattern_change, 1, 0))
+    return jnp.where(is_snp, status, -1).astype(jnp.int32)

@@ -1,0 +1,287 @@
+"""filter_variants_pipeline — ML filtering of a called VCF on TPU.
+
+Drop-in surface of the reference tool (docs/filter_variants_pipeline.md:
+same flags), re-founded: VCF -> columnar table -> featurization + forest
+inference as one jitted device program over the variants axis -> VCF
+writeback with TREE_SCORE / PASS / LOW_SCORE / COHORT_FP / HPOL_RUN.
+
+Hot-path structure (BASELINE north_star): per-variant work is a (N, F)
+tensor; scoring shards over the mesh dp axis; chunked execution bounds
+host memory with one compile per chunk shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.featurize import featurize
+from variantcalling_tpu.io import bed as bedio
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.io.vcf import VariantTable, read_vcf, write_vcf
+from variantcalling_tpu.models import forest as forest_mod
+from variantcalling_tpu.models import threshold as threshold_mod
+from variantcalling_tpu.models.forest import FlatForest
+from variantcalling_tpu.models.registry import load_model
+from variantcalling_tpu.models.threshold import ThresholdModel
+from variantcalling_tpu.ops import intervals as iops
+
+LOW_SCORE = "LOW_SCORE"
+COHORT_FP = "COHORT_FP"
+HPOL_RUN = "HPOL_RUN"
+PASS = "PASS"
+CHUNK = 1 << 18
+
+
+def get_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="filter_variants_pipeline", description="Filter VCF")
+    ap.add_argument("--input_file", required=True, help="Name of the input VCF file")
+    ap.add_argument("--model_file", required=True, help="Pickle model file")
+    ap.add_argument("--model_name", required=True, help="Model name inside the pickle")
+    ap.add_argument(
+        "--hpol_filter_length_dist",
+        nargs=2,
+        type=int,
+        default=[10, 10],
+        help="Length and distance to the hpol run to mark",
+    )
+    ap.add_argument("--runs_file", help="Homopolymer runs BED file")
+    ap.add_argument("--blacklist", help="Blacklist file (bed/h5/pkl of loci)")
+    ap.add_argument("--blacklist_cg_insertions", action="store_true", help="Filter CCG/GGC insertions")
+    ap.add_argument("--reference_file", required=True, help="Indexed reference FASTA file")
+    ap.add_argument("--output_file", required=True, help="Output VCF file")
+    ap.add_argument("--is_mutect", action="store_true", help="Input is a Mutect callset")
+    ap.add_argument("--flow_order", default="TGCA", help="Sequencing flow order (4 cycle)")
+    ap.add_argument(
+        "--annotate_intervals",
+        action="append",
+        default=[],
+        help="interval files for annotation (multiple possible)",
+    )
+    ap.add_argument("--backend", default="tpu", choices=["tpu", "cpu"], help="Execution backend")
+    ap.add_argument("--limit_to_contig", default=None, help="Process a single contig")
+    return ap
+
+
+def _interval_name(path: str) -> str:
+    base = os.path.basename(path)
+    for suffix in (".bed.gz", ".bed", ".interval_list"):
+        if base.endswith(suffix):
+            return base[: -len(suffix)]
+    return base
+
+
+def read_blacklist(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """Blacklist loci -> (chrom object array, pos 1-based). Accepts bed/h5/pkl."""
+    if path.endswith((".bed", ".bed.gz")):
+        iv = bedio.read_bed(path)
+        return iv.chrom, (iv.start + 1).astype(np.int64)
+    if path.endswith((".h5", ".hdf", ".hdf5")):
+        import pandas as pd
+
+        df = pd.read_hdf(path)
+        if isinstance(df.index, __import__("pandas").MultiIndex):
+            df = df.reset_index()
+        return df["chrom"].to_numpy(dtype=object), df["pos"].to_numpy(dtype=np.int64)
+    with open(path, "rb") as fh:
+        obj = pickle.load(fh)
+    chroms, poss = zip(*obj) if obj else ((), ())
+    out_c = np.empty(len(chroms), dtype=object)
+    out_c[:] = chroms
+    return out_c, np.asarray(poss, dtype=np.int64)
+
+
+def _is_cg_insertion(table: VariantTable, windows: np.ndarray, center: int) -> np.ndarray:
+    """CCG/GGC insertion artifacts (--blacklist_cg_insertions,
+    docs/filter_variants_pipeline.md "Should CCG/GGC insertions be filtered out?").
+
+    A single-base insertion of C between C and G (anchor C, next ref base G
+    -> CCG) or of G between G and C (anchor G, next C -> GGC). The next
+    reference base comes from the gathered window tensor.
+    """
+    out = np.zeros(len(table), dtype=bool)
+    code = {"C": 1, "G": 2}
+    for i in range(len(table)):
+        ref = table.ref[i]
+        alt = table.alt[i].split(",")[0]
+        if len(alt) == len(ref) + 1 and alt.startswith(ref):
+            ins = alt[len(ref) :]
+            anchor = ref[-1]
+            next_base = int(windows[i, center + 1])
+            if ins == "C" and anchor == "C" and next_base == code["G"]:
+                out[i] = True
+            elif ins == "G" and anchor == "G" and next_base == code["C"]:
+                out[i] = True
+    return out
+
+
+def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray:
+    """Jitted chunked scoring, sharded over the mesh dp axis; returns TREE_SCORE per row.
+
+    Multi-device: the feature chunk is device_put with a dp sharding and the
+    scoring program partitions over the variants axis (model arrays are
+    replicated); single device degrades to plain jit.
+    """
+    if isinstance(model, FlatForest):
+        model = forest_mod.with_feature_order(model, feature_names)
+        fn = jax.jit(lambda xx: forest_mod.predict_score(model, xx))
+    elif isinstance(model, ThresholdModel):
+        fn = jax.jit(lambda xx: threshold_mod.predict_score(model, xx, feature_names))
+    else:  # raw sklearn estimator that escaped conversion
+        return np.asarray(model.predict_proba(x)[:, 1])
+
+    from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_model=1) if n_dev > 1 else None
+    sharding = data_sharding(mesh, 2) if mesh is not None else None
+    chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
+
+    n = x.shape[0]
+    out = np.empty(n, dtype=np.float32)
+    for lo in range(0, n, chunk_size):
+        hi = min(lo + chunk_size, n)
+        chunk = x[lo:hi]
+        if hi - lo < chunk_size and (n > chunk_size or n_dev > 1):
+            # pad the tail chunk: steady-state shape (one compile) + dp divisibility
+            target = chunk_size if n > chunk_size else ((hi - lo + n_dev - 1) // n_dev) * n_dev
+            chunk = np.pad(chunk, ((0, target - (hi - lo)), (0, 0)))
+        dev_chunk = jax.device_put(chunk, sharding) if sharding is not None else jnp.asarray(chunk)
+        out[lo:hi] = np.asarray(fn(dev_chunk))[: hi - lo]
+    return out
+
+
+def filter_variants(
+    table: VariantTable,
+    model,
+    fasta: FastaReader,
+    runs_file: str | None = None,
+    hpol_length: int = 10,
+    hpol_dist: int = 10,
+    blacklist: tuple[np.ndarray, np.ndarray] | None = None,
+    blacklist_cg_insertions: bool = False,
+    annotate_intervals: dict[str, bedio.IntervalSet] | None = None,
+    flow_order: str = "TGCA",
+    is_mutect: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Core: returns (tree_score float array, new FILTER object array)."""
+    extra_info = ["TLOD"] if is_mutect else []
+    fs = featurize(table, fasta, annotate_intervals=annotate_intervals, flow_order=flow_order,
+                   extra_info_fields=extra_info)
+    if is_mutect and "TLOD" in fs.columns:
+        fs.columns["tlod"] = fs.columns.pop("TLOD")
+        fs.feature_names[fs.feature_names.index("TLOD")] = "tlod"
+    x = fs.matrix()
+    score = score_variants(model, x, fs.feature_names)
+
+    pass_thr = getattr(model, "pass_threshold", 0.5)
+    n = len(table)
+    low = score < pass_thr
+
+    cohort_fp = np.zeros(n, dtype=bool)
+    if blacklist is not None and len(blacklist[0]):
+        bl = set(zip(blacklist[0].tolist(), blacklist[1].tolist()))
+        for i in range(n):
+            if (table.chrom[i], int(table.pos[i])) in bl:
+                cohort_fp[i] = True
+    if blacklist_cg_insertions and fs.windows is not None:
+        from variantcalling_tpu.featurize import CENTER
+
+        cohort_fp |= _is_cg_insertion(table, fs.windows, CENTER)
+
+    hpol_near = np.zeros(n, dtype=bool)
+    if runs_file:
+        runs = bedio.read_bed(runs_file)
+        # only runs of length >= hpol_length are marked
+        keep = (runs.end - runs.start) >= hpol_length
+        runs = bedio.IntervalSet(runs.chrom[keep], runs.start[keep], runs.end[keep])
+        if len(runs):
+            contig_lengths = table.header.contig_lengths or {
+                c: fasta.get_reference_length(c) for c in fasta.references
+            }
+            coords = iops.GenomeCoords(contig_lengths)
+            gpos = coords.globalize(np.asarray(table.chrom), table.pos - 1)
+            gs, ge = coords.globalize_intervals(runs)
+            hpol_near = iops.distance_to_nearest(gpos, gs, ge) <= hpol_dist
+
+    filters = np.empty(n, dtype=object)
+    for i in range(n):
+        parts = []
+        if cohort_fp[i]:
+            parts.append(COHORT_FP)
+        elif low[i]:
+            parts.append(LOW_SCORE)
+        if hpol_near[i]:
+            parts.append(HPOL_RUN)
+        filters[i] = ";".join(parts) if parts else PASS
+    return score, filters
+
+
+def run(argv: list[str]) -> int:
+    args = get_parser().parse_args(argv)
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    logger.info("reading %s", args.input_file)
+    table = read_vcf(args.input_file)
+    if args.limit_to_contig:
+        keep = np.asarray(table.chrom) == args.limit_to_contig
+        table = _subset(table, keep)
+    model = load_model(args.model_file, args.model_name)
+    fasta = FastaReader(args.reference_file)
+    annotate = {_interval_name(p): bedio.read_intervals(p) for p in args.annotate_intervals}
+    blacklist = read_blacklist(args.blacklist) if args.blacklist else None
+
+    score, filters = filter_variants(
+        table,
+        model,
+        fasta,
+        runs_file=args.runs_file,
+        hpol_length=args.hpol_filter_length_dist[0],
+        hpol_dist=args.hpol_filter_length_dist[1],
+        blacklist=blacklist,
+        blacklist_cg_insertions=args.blacklist_cg_insertions,
+        annotate_intervals=annotate,
+        flow_order=args.flow_order,
+        is_mutect=args.is_mutect,
+    )
+
+    table.header.ensure_filter(LOW_SCORE, "Model score below threshold")
+    table.header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
+    table.header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
+    table.header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
+    write_vcf(args.output_file, table, new_filters=filters, extra_info={"TREE_SCORE": np.round(score, 4)})
+    logger.info(
+        "wrote %s: %d variants, %d PASS", args.output_file, len(table), int(np.sum(filters == PASS))
+    )
+    return 0
+
+
+def _subset(table: VariantTable, keep: np.ndarray) -> VariantTable:
+    from dataclasses import replace
+
+    return replace(
+        table,
+        chrom=table.chrom[keep],
+        pos=table.pos[keep],
+        vid=table.vid[keep],
+        ref=table.ref[keep],
+        alt=table.alt[keep],
+        qual=table.qual[keep],
+        filters=table.filters[keep],
+        info=table.info[keep],
+        fmt_keys=table.fmt_keys[keep] if table.fmt_keys is not None else None,
+        sample_cols=table.sample_cols[keep] if table.sample_cols is not None else None,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

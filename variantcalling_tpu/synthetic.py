@@ -1,0 +1,105 @@
+"""Shared synthetic workloads: the fused filter hot path + input builders.
+
+Single source of truth for bench.py and __graft_entry__.py so the benchmark
+and the driver's compile check always measure the same program as the real
+pipeline's device stage (featurization kernels + flat-forest inference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from variantcalling_tpu.models.forest import FlatForest, predict_score
+from variantcalling_tpu.ops import features as fops
+
+N_HOT_FEATURES = 12  # features assembled by fused_hot_path below
+WINDOW = 41
+
+
+def synthetic_batch(n: int, rng: np.random.Generator):
+    """(windows, scalar dict, is_indel, indel_nuc) shaped like real featurized input."""
+    windows = rng.integers(0, 4, size=(n, WINDOW), dtype=np.uint8)
+    scalars = {
+        "qual": rng.uniform(0, 100, n).astype(np.float32),
+        "dp": rng.integers(1, 60, n).astype(np.float32),
+        "sor": rng.uniform(0, 4, n).astype(np.float32),
+        "af": rng.uniform(0, 1, n).astype(np.float32),
+        "gq": rng.integers(0, 99, n).astype(np.float32),
+        "is_het": rng.integers(0, 2, n).astype(np.float32),
+    }
+    is_indel = rng.random(n) < 0.1
+    indel_nuc = np.where(is_indel, rng.integers(0, 4, n), 4).astype(np.int32)
+    return windows, scalars, is_indel, indel_nuc
+
+
+def synthetic_forest(rng: np.random.Generator, n_trees: int = 40, depth: int = 12,
+                     n_features: int = N_HOT_FEATURES) -> FlatForest:
+    """Random but structurally-valid forest: complete binary trees, leaf level at the bottom."""
+    m = 2**depth
+    feature = rng.integers(0, n_features, size=(n_trees, m)).astype(np.int32)
+    left = np.minimum(2 * np.arange(m) + 1, m - 1).astype(np.int32)
+    right = np.minimum(2 * np.arange(m) + 2, m - 1).astype(np.int32)
+    is_leaf = np.arange(m) >= (m // 2 - 1)
+    feature[:, is_leaf] = -1
+    return FlatForest(
+        feature=feature,
+        threshold=rng.uniform(0, 50, size=(n_trees, m)).astype(np.float32),
+        left=np.broadcast_to(np.where(is_leaf, np.arange(m), left), (n_trees, m)).astype(np.int32),
+        right=np.broadcast_to(np.where(is_leaf, np.arange(m), right), (n_trees, m)).astype(np.int32),
+        value=rng.uniform(0, 1, size=(n_trees, m)).astype(np.float32),
+        max_depth=depth,
+    )
+
+
+def fused_hot_path(forest: FlatForest):
+    """The filter device program: windows+scalars -> features -> TREE_SCORE.
+
+    Returns a jittable fn(windows, qual, dp, sor, af, gq, is_het, is_indel,
+    indel_nuc) mirroring the pipeline's featurize+score stage.
+    """
+    import jax.numpy as jnp
+
+    def fwd(windows, qual, dp, sor, af, gq, is_het, is_indel, indel_nuc):
+        center = windows.shape[1] // 2
+        gc = fops.gc_content(windows, center, radius=10)
+        hmer_len, hmer_nuc = fops.hmer_indel_features(windows, center, is_indel, indel_nuc)
+        left_m, right_m = fops.motif_codes(windows, center)
+        x = jnp.stack(
+            [
+                qual,
+                dp,
+                sor,
+                af,
+                gq,
+                is_het,
+                is_indel.astype(jnp.float32),
+                hmer_len.astype(jnp.float32),
+                hmer_nuc.astype(jnp.float32),
+                gc,
+                (left_m % 125).astype(jnp.float32),
+                (right_m % 125).astype(jnp.float32),
+            ],
+            axis=1,
+        )
+        return predict_score(forest, x)
+
+    return fwd
+
+
+def hot_path_args(n: int, seed: int = 1):
+    """Device-ready positional args for fused_hot_path."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    windows, scalars, is_indel, indel_nuc = synthetic_batch(n, rng)
+    return (
+        jnp.asarray(windows),
+        jnp.asarray(scalars["qual"]),
+        jnp.asarray(scalars["dp"]),
+        jnp.asarray(scalars["sor"]),
+        jnp.asarray(scalars["af"]),
+        jnp.asarray(scalars["gq"]),
+        jnp.asarray(scalars["is_het"]),
+        jnp.asarray(is_indel),
+        jnp.asarray(indel_nuc),
+    )
