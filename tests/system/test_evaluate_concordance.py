@@ -1,0 +1,50 @@
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.pipelines import evaluate_concordance as ec
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+
+def test_evaluate_concordance_end_to_end(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 500
+    is_indel = rng.random(n) < 0.3
+    hmer = np.where(is_indel & (rng.random(n) < 0.6), rng.integers(1, 14, n), 0)
+    cls = rng.choice(["tp", "fp", "fn"], n, p=[0.7, 0.2, 0.1])
+    score = np.where(cls == "tp", rng.uniform(0.4, 1, n), rng.uniform(0, 0.6, n))
+    score[cls == "fn"] = np.nan
+    df = pd.DataFrame(
+        {
+            "chrom": ["chr20"] * n,
+            "pos": np.arange(1, n + 1) * 37,
+            "indel": is_indel,
+            "hmer_indel_length": hmer,
+            "classify": cls,
+            "classify_gt": cls,
+            "filter": np.where(rng.random(n) < 0.1, "LOW_SCORE", "PASS"),
+            "tree_score": score,
+        }
+    )
+    inp = str(tmp_path / "comp.h5")
+    write_hdf(df, inp, key="chr20", mode="w")
+
+    prefix = str(tmp_path / "out")
+    rc = ec.run(["--input_file", inp, "--output_prefix", prefix, "--dataset_key", "all", "--output_bed"])
+    assert rc == 0
+
+    acc = read_hdf(prefix + ".h5", key="optimal_recall_precision")
+    assert set(["group", "tp", "fp", "fn", "precision", "recall", "f1"]) <= set(acc.columns)
+    assert "SNP" in acc["group"].tolist() and "INDELS" in acc["group"].tolist()
+    snp = acc[acc["group"] == "SNP"].iloc[0]
+    assert snp["tp"] > 0 and 0 <= snp["precision"] <= 1
+
+    curve = read_hdf(prefix + ".h5", key="recall_precision_curve")
+    assert "threshold" in curve.columns
+    stats = open(prefix + ".stats.csv").read()
+    assert stats.splitlines()[0].startswith("group;tp;fp;fn")
+    thr = pd.read_csv(prefix + ".thresholds.csv")
+    assert list(thr.columns) == ["group", "threshold"]
+    # bed outputs
+    assert (tmp_path / "out_tp.bed").exists()
+    tp_lines = open(tmp_path / "out_tp.bed").read().splitlines()
+    assert len(tp_lines) == int((cls == "tp").sum())

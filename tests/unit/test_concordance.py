@@ -1,0 +1,90 @@
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.concordance.concordance_utils import (
+    calc_accuracy_metrics,
+    calc_recall_precision_curve,
+    category_masks,
+    passes_filter,
+)
+
+
+def _frame():
+    # 6 SNPs: 3 tp (one filtered), 2 fp (one filtered), 1 fn
+    # 4 hmer indels len 2: 2 tp, 1 fp, 1 fn
+    n = 10
+    return pd.DataFrame(
+        {
+            "chrom": ["chr1"] * n,
+            "pos": np.arange(100, 100 + n),
+            "indel": [False] * 6 + [True] * 4,
+            "hmer_indel_length": [0] * 6 + [2] * 4,
+            "classify_gt": ["tp", "tp", "tp", "fp", "fp", "fn", "tp", "tp", "fp", "fn"],
+            "filter": ["PASS", "PASS", "LOW_SCORE", "PASS", "LOW_SCORE", "PASS",
+                       "PASS", "HPOL_RUN", "PASS", "PASS"],
+            "tree_score": [0.9, 0.8, 0.3, 0.6, 0.2, np.nan, 0.95, 0.7, 0.4, np.nan],
+        }
+    )
+
+
+def test_passes_filter_ignored():
+    f = np.array(["PASS", "LOW_SCORE", "HPOL_RUN", "HPOL_RUN;LOW_SCORE", "."], dtype=object)
+    np.testing.assert_array_equal(
+        passes_filter(f, ["HPOL_RUN"]), [True, False, True, False, True]
+    )
+
+
+def test_accuracy_metrics_filtering_semantics():
+    df = _frame()
+    acc = calc_accuracy_metrics(df, "classify_gt", ["HPOL_RUN"]).set_index("group")
+    # SNP: tp pass=2, filtered tp->fn so fn=1+1=2, fp pass=1
+    assert acc.loc["SNP", "tp"] == 2
+    assert acc.loc["SNP", "fp"] == 1
+    assert acc.loc["SNP", "fn"] == 2
+    assert abs(acc.loc["SNP", "precision"] - 2 / 3) < 1e-4
+    assert acc.loc["SNP", "recall"] == 0.5
+    # hmer indel <= 4: HPOL_RUN ignored -> both tps pass
+    assert acc.loc["HMER indel <= 4", "tp"] == 2
+    assert acc.loc["HMER indel <= 4", "fn"] == 1
+    # INDELS aggregates all indels
+    assert acc.loc["INDELS", "tp"] == 2
+    assert acc.loc["INDELS", "fp"] == 1
+
+
+def test_category_masks_overlap():
+    df = _frame()
+    names, masks = category_masks(df)
+    assert "SNP" in names and "INDELS" in names
+    snp = masks[names.index("SNP")]
+    indels = masks[names.index("INDELS")]
+    assert snp.sum() == 6 and indels.sum() == 4
+    assert not np.any(snp & indels)
+
+
+def test_custom_group_column():
+    df = _frame()
+    df["vartype"] = ["a"] * 5 + ["b"] * 5
+    names, masks = category_masks(df, "vartype")
+    assert names == ["a", "b"]
+    assert masks.sum() == 10
+
+
+def test_recall_precision_curve_shape():
+    rng = np.random.default_rng(0)
+    n = 400
+    df = pd.DataFrame(
+        {
+            "indel": [False] * n,
+            "hmer_indel_length": [0] * n,
+            "classify_gt": rng.choice(["tp", "fp"], n, p=[0.7, 0.3]),
+            "filter": ["PASS"] * n,
+        }
+    )
+    # informative score: tps higher
+    df["tree_score"] = np.where(df["classify_gt"] == "tp", rng.uniform(0.5, 1, n), rng.uniform(0, 0.5, n))
+    curve = calc_recall_precision_curve(df, "classify_gt", [])
+    snp = curve[curve["group"] == "SNP"].iloc[0]
+    assert len(snp["precision"]) == len(snp["recall"]) == len(snp["f1"])
+    assert 0.0 <= snp["threshold"] <= 1.0
+    # a clean separation -> the best-f1 threshold sits near the class boundary
+    assert 0.3 <= snp["threshold"] <= 0.6

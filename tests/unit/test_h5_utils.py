@@ -1,0 +1,60 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf, write_hdf
+
+
+def test_roundtrip_basic(tmp_path):
+    df = pd.DataFrame(
+        {
+            "chrom": ["chr1", "chr1", "chr2"],
+            "pos": np.array([100, 200, 300], dtype=np.int64),
+            "score": [0.5, np.nan, 1.25],
+            "is_snp": [True, False, True],
+        }
+    )
+    p = str(tmp_path / "t.h5")
+    write_hdf(df, p, key="all", mode="w")
+    back = read_hdf(p, key="all")
+    assert list(back.columns) == list(df.columns)
+    assert back["chrom"].tolist() == df["chrom"].tolist()
+    np.testing.assert_array_equal(back["pos"], df["pos"])
+    np.testing.assert_allclose(back["score"], df["score"])
+    assert back["is_snp"].dtype == bool
+
+
+def test_multi_key_all_concat(tmp_path):
+    p = str(tmp_path / "t.h5")
+    write_hdf(pd.DataFrame({"x": [1, 2]}), p, key="chr1", mode="w")
+    write_hdf(pd.DataFrame({"x": [3]}), p, key="chr2", mode="a")
+    write_hdf(pd.DataFrame({"y": [9]}), p, key="input_args", mode="a")
+    assert list_keys(p) == ["chr1", "chr2", "input_args"]
+    back = read_hdf(p, key="all", skip_keys=["input_args"])
+    assert back["x"].tolist() == [1, 2, 3]
+    with pytest.raises(KeyError):
+        read_hdf(p, key="missing")
+
+
+def test_ragged_columns(tmp_path):
+    df = pd.DataFrame(
+        {
+            "group": ["a", "b"],
+            "curve": [np.array([0.1, 0.2, 0.3]), np.array([1.0])],
+            "threshold": [0.5, 0.7],
+        }
+    )
+    p = str(tmp_path / "t.h5")
+    write_hdf(df, p, key="recall_precision_curve", mode="w")
+    back = read_hdf(p, key="recall_precision_curve")
+    np.testing.assert_allclose(back["curve"][0], [0.1, 0.2, 0.3])
+    np.testing.assert_allclose(back["curve"][1], [1.0])
+    np.testing.assert_allclose(back["threshold"], [0.5, 0.7])
+
+
+def test_index_preserved(tmp_path):
+    df = pd.DataFrame({"v": [1.0, 2.0]}, index=["SNP", "INDEL"])
+    p = str(tmp_path / "t.h5")
+    write_hdf(df, p, key="k", mode="w")
+    back = read_hdf(p, key="k")
+    assert back.index.tolist() == ["SNP", "INDEL"]

@@ -1,0 +1,109 @@
+"""Regression tests for code-review findings (round 1)."""
+
+import gzip
+
+import numpy as np
+import jax
+import pytest
+
+
+def test_bgzf_roundtrip_and_eof(tmp_path):
+    from variantcalling_tpu.io.bgzf import BGZF_EOF, BgzfWriter
+
+    p = str(tmp_path / "t.vcf.gz")
+    payload = "\n".join(f"line {i} " + "x" * 100 for i in range(5000)) + "\n"
+    with BgzfWriter(p) as w:
+        w.write(payload)
+    raw = open(p, "rb").read()
+    assert raw.endswith(BGZF_EOF)
+    # every block carries the BC extra field
+    assert raw[:4] == b"\x1f\x8b\x08\x04"
+    assert gzip.decompress(raw).decode() == payload
+
+
+def test_gbt_flatten_matches_sklearn(rng):
+    from sklearn.ensemble import GradientBoostingClassifier
+
+    from variantcalling_tpu.models.forest import from_sklearn, predict_score
+
+    x = rng.random((500, 5)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0.8).astype(int)
+    clf = GradientBoostingClassifier(n_estimators=12, max_depth=3, random_state=0).fit(x, y)
+    forest = from_sklearn(clf)
+    assert forest.aggregation == "logit_sum"
+    got = np.asarray(jax.jit(lambda a: predict_score(forest, a))(x))
+    want = clf.predict_proba(x)[:, 1]
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_single_class_forest(rng):
+    from sklearn.ensemble import RandomForestClassifier
+
+    from variantcalling_tpu.models.forest import from_sklearn, predict_score
+
+    x = rng.random((50, 3)).astype(np.float32)
+    clf = RandomForestClassifier(n_estimators=3, random_state=0).fit(x, np.zeros(50, dtype=int))
+    forest = from_sklearn(clf)
+    got = np.asarray(predict_score(forest, x))
+    np.testing.assert_allclose(got, 0.0)  # lone class is 0 -> P(class 1) = 0
+
+
+def test_gather_windows_out_of_range(tmp_path, rng):
+    from tests.fixtures import make_genome, write_fasta
+
+    from variantcalling_tpu.featurize import gather_windows
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import VariantTable, VcfHeader
+
+    genome = make_genome(rng, {"chr1": 300})
+    fa = str(tmp_path / "g.fa")
+    write_fasta(fa, genome)
+
+    def obj(x):
+        a = np.empty(len(x), dtype=object)
+        a[:] = x
+        return a
+
+    table = VariantTable(
+        header=VcfHeader(),
+        chrom=obj(["chr1", "chr1"]),
+        pos=np.array([100, 5000], dtype=np.int64),  # 5000 beyond contig
+        vid=obj([".", "."]),
+        ref=obj(["A", "A"]),
+        alt=obj(["T", "T"]),
+        qual=np.array([10.0, 10.0]),
+        filters=obj(["PASS", "PASS"]),
+        info=obj([".", "."]),
+    )
+    with FastaReader(fa) as fasta:
+        w = gather_windows(table, fasta, radius=5)
+    assert w.shape == (2, 11)
+    assert np.all(w[1] == 4)  # all-N window, no crash
+
+
+def test_blacklist_vectorized_join(tmp_path, rng):
+    from variantcalling_tpu.pipelines.filter_variants import filter_variants  # noqa: F401 — import check
+
+    # direct check of the packed-key join semantics via the pipeline helper
+    from variantcalling_tpu.io.vcf import VariantTable, VcfHeader
+
+    def obj(x):
+        a = np.empty(len(x), dtype=object)
+        a[:] = x
+        return a
+
+    n = 100
+    chroms = obj(["chr1"] * 50 + ["chr2"] * 50)
+    pos = np.arange(1, n + 1, dtype=np.int64) * 10
+    bl_chrom = obj(["chr1", "chr2", "chr3"])
+    bl_pos = np.array([100, 990, 10], dtype=np.int64)
+    # inline the same join the pipeline uses
+    cmap = {c: i for i, c in enumerate(dict.fromkeys(np.concatenate([bl_chrom, chroms]).tolist()))}
+    cidx_bl = np.fromiter((cmap[c] for c in bl_chrom), dtype=np.int64)
+    cidx_tb = np.fromiter((cmap[c] for c in chroms), dtype=np.int64)
+    key_bl = np.sort((cidx_bl << 40) | bl_pos)
+    key_tb = (cidx_tb << 40) | pos
+    loc = np.minimum(np.searchsorted(key_bl, key_tb), len(key_bl) - 1)
+    hit = key_bl[loc] == key_tb
+    assert hit.sum() == 2
+    assert set(np.nonzero(hit)[0].tolist()) == {9, 98}  # chr1:100, chr2:990

@@ -1,0 +1,1 @@
+"""Concordance: callset-vs-ground-truth accounting, metrics, and curves."""

@@ -1,0 +1,158 @@
+"""Accuracy metrics + recall/precision curves over a concordance frame.
+
+Re-derivation of ``ugbio_core.concordance.concordance_utils`` (missing
+submodule; contract from evaluate_concordance.py:100-108, output table in
+docs/evaluate_concordance.md:46-58, filtering semantics from
+report_utils.py:415-470). The per-category tally runs as one MXU matmul
+(ops/concordance.grouped_confusion); curves use the FN-mask-aware PR curve
+(utils/stats_utils.precision_recall_curve, parity stats_utils.py:141-210).
+
+Input frame columns (run_comparison_pipeline schema, report_data_loader.py:
+66-104): ``classify``/``classify_gt`` in {tp, fp, fn}, ``filter``,
+``tree_score``, ``indel`` (bool), ``hmer_indel_length`` (int), plus any
+custom grouping column.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.ops.concordance import accuracy_from_counts, grouped_confusion
+from variantcalling_tpu.utils.stats_utils import precision_recall_curve
+
+# default variant categories (docs/evaluate_concordance.md:49-58); each is
+# (name, selector(df) -> bool mask); categories may overlap (INDELS).
+_HMER = "hmer_indel_length"
+
+
+def default_categories() -> list[tuple[str, callable]]:
+    return [
+        ("SNP", lambda d: ~_indel(d)),
+        ("Non-hmer INDEL", lambda d: _indel(d) & (_hmer(d) == 0)),
+        ("HMER indel <= 4", lambda d: _indel(d) & (_hmer(d) > 0) & (_hmer(d) <= 4)),
+        ("HMER indel (4:8]", lambda d: _indel(d) & (_hmer(d) > 4) & (_hmer(d) <= 8)),
+        ("HMER indel [8:10]", lambda d: _indel(d) & (_hmer(d) > 8) & (_hmer(d) <= 10)),
+        ("HMER indel 11:12", lambda d: _indel(d) & (_hmer(d) > 10) & (_hmer(d) <= 12)),
+        ("HMER indel > 12", lambda d: _indel(d) & (_hmer(d) > 12)),
+        ("INDELS", _indel),
+    ]
+
+
+def _indel(d: pd.DataFrame) -> np.ndarray:
+    if "indel" in d.columns:
+        return np.asarray(d["indel"], dtype=bool)
+    ref = d["ref"].astype(str).str.len()
+    alt = d["alleles"].astype(str) if "alleles" in d.columns else d["alt"].astype(str)
+    return np.asarray(ref != alt.str.split(",").str[0].str.len())
+
+
+def _hmer(d: pd.DataFrame) -> np.ndarray:
+    if _HMER in d.columns:
+        return np.nan_to_num(np.asarray(d[_HMER], dtype=float)).astype(int)
+    return np.zeros(len(d), dtype=int)
+
+
+def category_masks(df: pd.DataFrame, group_testing_column: str | None = None) -> tuple[list[str], np.ndarray]:
+    """(names, (G, N) bool mask matrix) for default or custom grouping."""
+    if group_testing_column and group_testing_column in df.columns:
+        values = df[group_testing_column].astype(str).to_numpy()
+        names = sorted(set(values))
+        masks = np.stack([values == name for name in names])
+        return names, masks
+    cats = default_categories()
+    names = [name for name, _ in cats]
+    masks = np.stack([np.asarray(sel(df), dtype=bool) for _, sel in cats])
+    return names, masks
+
+
+def passes_filter(filters: np.ndarray, ignored_filters: list[str] | None) -> np.ndarray:
+    """True where FILTER is PASS after dropping ``ignored_filters``.
+
+    evaluate_concordance defaults to ignoring HPOL_RUN (:44-48): a variant
+    filtered *only* by ignored filters still counts as passing.
+    """
+    ignored = set(ignored_filters or [])
+    out = np.empty(len(filters), dtype=bool)
+    for i, f in enumerate(filters):
+        if f in ("PASS", ".", "", None):
+            out[i] = True
+        else:
+            out[i] = not (set(str(f).split(";")) - ignored - {"PASS"})
+    return out
+
+
+def _classes(df: pd.DataFrame, classify_column: str) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    cls = df[classify_column].astype(str).to_numpy()
+    return cls == "tp", cls == "fp", cls == "fn"
+
+
+def calc_accuracy_metrics(
+    df: pd.DataFrame,
+    classify_column: str,
+    ignored_filters: list[str] | None = None,
+    group_testing_column: str | None = None,
+) -> pd.DataFrame:
+    """Per-category [tp, fp, fn, precision, recall, f1] at the filter operating point."""
+    names, masks = category_masks(df, group_testing_column)
+    is_tp, is_fp, is_fn = _classes(df, classify_column)
+    pf = passes_filter(df["filter"].to_numpy() if "filter" in df.columns else np.array(["PASS"] * len(df)),
+                       ignored_filters)
+    counts = np.asarray(grouped_confusion(masks, is_tp, is_fp, is_fn, pf))
+    acc = np.asarray(accuracy_from_counts(counts))
+    out = pd.DataFrame(
+        {
+            "group": names,
+            "tp": counts[:, 0].astype(int),
+            "fp": counts[:, 1].astype(int),
+            "fn": counts[:, 2].astype(int),
+            "precision": np.round(acc[:, 0], 5),
+            "recall": np.round(acc[:, 1], 5),
+            "f1": np.round(acc[:, 2], 5),
+        }
+    )
+    return out
+
+
+def calc_recall_precision_curve(
+    df: pd.DataFrame,
+    classify_column: str,
+    ignored_filters: list[str] | None = None,
+    group_testing_column: str | None = None,
+) -> pd.DataFrame:
+    """Per-category score-sweep curve + max-F1 threshold.
+
+    One row per category with array-valued ``precision``/``recall``/``f1``/
+    ``predictions`` columns and the scalar ``threshold`` that maximizes F1
+    (the value evaluate_concordance writes to ``<prefix>.thresholds.csv``).
+    """
+    names, masks = category_masks(df, group_testing_column)
+    is_tp, is_fp, is_fn = _classes(df, classify_column)
+    scores = np.nan_to_num(np.asarray(df["tree_score"], dtype=float)) if "tree_score" in df.columns else np.ones(len(df))
+
+    rows = []
+    for gi, name in enumerate(names):
+        m = masks[gi]
+        # curve sweeps the score over *called* variants (tp/fp); fns carry no
+        # score and enter through the FN mask's recall correction
+        called = m & (is_tp | is_fp)
+        labels = is_tp[m].astype(int)
+        preds = np.where(called[m], scores[m], 0.0)
+        fn_mask = is_fn[m]
+        prec, rec, f1, thr = precision_recall_curve(labels, preds, fn_mask)
+        if len(f1) and np.any(np.isfinite(f1)):
+            best = int(np.nanargmax(f1))
+            best_thr = float(thr[best])
+        else:
+            best_thr = 0.0
+        rows.append(
+            {
+                "group": name,
+                "predictions": thr,
+                "precision": prec,
+                "recall": rec,
+                "f1": f1,
+                "threshold": best_thr,
+            }
+        )
+    return pd.DataFrame(rows)

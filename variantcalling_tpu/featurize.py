@@ -121,7 +121,10 @@ def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW
         padded = np.concatenate([np.full(radius, 4, np.uint8), seq, np.full(radius, 4, np.uint8)])
         centers = pos0[m].astype(np.int64) + radius
         idx = centers[:, None] + np.arange(-radius, radius + 1)[None, :]
-        out[m] = padded[idx]
+        # positions beyond the contig (wrong reference build / truncated
+        # FASTA) read as N instead of crashing the whole ingest
+        valid = (idx >= 0) & (idx < len(padded))
+        out[m] = np.where(valid, padded[np.clip(idx, 0, len(padded) - 1)], 4)
     return out
 
 

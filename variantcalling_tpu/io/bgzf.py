@@ -1,0 +1,76 @@
+"""Pure-Python BGZF codec (blocked gzip, the htslib container framing).
+
+The reference leans on bgzip/tabix binaries for every compressed artifact
+(bash/index_vcf_file.sh, compress_gvcf.py:214). Writing plain gzip would
+break the drop-in contract — ``tabix``/``bcftools index`` refuse non-BGZF
+input — so this framework's writers emit true BGZF blocks: independent
+<=64KiB gzip members carrying the BC extra-field with the block size, and
+the canonical 28-byte EOF sentinel. Reading BGZF needs nothing special
+(it is valid multi-member gzip).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+MAX_BLOCK_DATA = 65280  # uncompressed payload per block (htslib convention)
+BGZF_EOF = bytes.fromhex("1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+
+def compress_block(data: bytes, level: int = 6) -> bytes:
+    """One complete BGZF block for <=64KiB of payload."""
+    co = zlib.compressobj(level, zlib.DEFLATED, -15)
+    deflated = co.compress(data) + co.flush()
+    bsize = len(deflated) + 25 + 1  # header(18) + crc/isize(8) - 1
+    if bsize > 0xFFFF:
+        raise ValueError("BGZF block overflow (incompressible 64K payload)")
+    header = (
+        b"\x1f\x8b\x08\x04"  # magic, CM=deflate, FLG=FEXTRA
+        + b"\x00\x00\x00\x00"  # MTIME
+        + b"\x00\xff"  # XFL, OS=unknown
+        + struct.pack("<H", 6)  # XLEN
+        + b"BC"
+        + struct.pack("<H", 2)
+        + struct.pack("<H", bsize)
+    )
+    trailer = struct.pack("<II", zlib.crc32(data) & 0xFFFFFFFF, len(data) & 0xFFFFFFFF)
+    return header + deflated + trailer
+
+
+class BgzfWriter:
+    """File-like text/binary writer emitting BGZF blocks."""
+
+    def __init__(self, path: str, level: int = 6):
+        self._fh = open(path, "wb")
+        self._buf = bytearray()
+        self._level = level
+
+    def write(self, data: str | bytes) -> int:
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        self._buf += data
+        while len(self._buf) >= MAX_BLOCK_DATA:
+            chunk = bytes(self._buf[:MAX_BLOCK_DATA])
+            del self._buf[:MAX_BLOCK_DATA]
+            self._fh.write(compress_block(chunk, self._level))
+        return len(data)
+
+    def close(self) -> None:
+        if self._fh.closed:
+            return
+        if self._buf:
+            self._fh.write(compress_block(bytes(self._buf), self._level))
+            self._buf.clear()
+        self._fh.write(BGZF_EOF)
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def open_bgzf_text(path: str, level: int = 6) -> BgzfWriter:
+    return BgzfWriter(path, level)

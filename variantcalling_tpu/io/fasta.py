@@ -53,10 +53,13 @@ def build_fai(path: str) -> dict[str, _FaiEntry]:
             pos += line_len
         if name is not None:
             entries[name] = _FaiEntry(length, offset, line_bases, line_width)
-    with open(path + ".fai", "wt") as out:
-        for n in order:
-            e = entries[n]
-            out.write(f"{n}\t{e.length}\t{e.offset}\t{e.line_bases}\t{e.line_width}\n")
+    try:  # cache the index beside the FASTA; read-only mounts just skip it
+        with open(path + ".fai", "wt") as out:
+            for n in order:
+                e = entries[n]
+                out.write(f"{n}\t{e.length}\t{e.offset}\t{e.line_bases}\t{e.line_width}\n")
+    except OSError:
+        pass
     return entries
 
 

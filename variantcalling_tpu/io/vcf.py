@@ -335,7 +335,12 @@ def write_vcf(
     - ``sample_overrides``: sample index -> object array of replacement
       sample strings; ``fmt_override`` replaces the FORMAT column.
     """
-    opener = gzip.open if str(path).endswith(".gz") else open
+    if str(path).endswith(".gz"):
+        from variantcalling_tpu.io.bgzf import BgzfWriter
+
+        opener = lambda p, _mode: BgzfWriter(p)  # noqa: E731 — tabix-compatible blocks
+    else:
+        opener = open
     with opener(path, "wt") as out:
         for line in table.header.lines:
             out.write(line + "\n")

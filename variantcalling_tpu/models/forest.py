@@ -87,7 +87,17 @@ def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: fl
     (sklearn uses <=); leaf value = class-1 fraction of training samples in
     the leaf; prediction = mean over trees (predict_proba).
     """
-    estimators = getattr(clf, "estimators_", None) or [clf]
+    raw = getattr(clf, "estimators_", None)
+    if raw is None:
+        estimators = [clf]
+    elif isinstance(raw, np.ndarray):
+        # GradientBoosting stores an (n_stages, n_classes) ndarray of
+        # regressor trees -> boosted-margin aggregation, not mean-proba
+        if raw.ndim == 2 and raw.shape[1] != 1:
+            raise ValueError("only binary-class boosted ensembles are supported")
+        return _from_sklearn_gbt(clf, raw.ravel().tolist(), feature_names, pass_threshold)
+    else:
+        estimators = list(raw)
     n_nodes = [e.tree_.node_count for e in estimators]
     m = max(n_nodes)
     t = len(estimators)
@@ -118,7 +128,9 @@ def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: fl
             denom = counts.sum(axis=1)
             value[ti, :nc] = np.where(denom > 0, counts[:, 1] / np.maximum(denom, 1e-12), 0.0)
         else:
-            value[ti, :nc] = counts[:, 0]
+            # degenerate single-class fit: every leaf predicts that class
+            classes = getattr(est, "classes_", getattr(clf, "classes_", np.array([1])))
+            value[ti, :nc] = 1.0 if classes[0] == 1 else 0.0
         max_depth = max(max_depth, int(tr.max_depth))
     return FlatForest(
         feature=feature,
@@ -128,6 +140,55 @@ def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: fl
         value=value,
         max_depth=max_depth,
         aggregation="mean",
+        feature_names=feature_names or [],
+        pass_threshold=pass_threshold,
+    )
+
+
+def _from_sklearn_gbt(clf, trees: list, feature_names: list[str] | None, pass_threshold: float) -> FlatForest:
+    """Flatten a fitted binary GradientBoostingClassifier.
+
+    score = sigmoid(init_log_odds + lr * sum(tree margins)) — matches
+    sklearn's staged decision function for the log-loss binary case.
+    """
+    lr = float(getattr(clf, "learning_rate", 1.0))
+    base = 0.0
+    init = getattr(clf, "init_", None)
+    if init is not None and hasattr(init, "class_prior_"):
+        p1 = float(np.clip(init.class_prior_[-1], 1e-12, 1 - 1e-12))
+        base = float(np.log(p1 / (1 - p1)))
+    m = max(t.tree_.node_count for t in trees)
+    t_n = len(trees)
+    feature = np.full((t_n, m), LEAF, dtype=np.int32)
+    threshold = np.zeros((t_n, m), dtype=np.float32)
+    left = np.zeros((t_n, m), dtype=np.int32)
+    right = np.zeros((t_n, m), dtype=np.int32)
+    value = np.zeros((t_n, m), dtype=np.float32)
+    max_depth = 1
+    for ti, est in enumerate(trees):
+        tr = est.tree_
+        nc = tr.node_count
+        is_leaf = tr.children_left == -1
+        feature[ti, :nc] = np.where(is_leaf, LEAF, tr.feature.astype(np.int32))
+        thr64 = tr.threshold
+        thr32 = thr64.astype(np.float32)
+        too_big = thr32.astype(np.float64) > thr64
+        thr32[too_big] = np.nextafter(thr32[too_big], np.float32(-np.inf))
+        threshold[ti, :nc] = thr32
+        node_ids = np.arange(nc, dtype=np.int32)
+        left[ti, :nc] = np.where(is_leaf, node_ids, tr.children_left)
+        right[ti, :nc] = np.where(is_leaf, node_ids, tr.children_right)
+        value[ti, :nc] = lr * tr.value[:, 0, 0]
+        max_depth = max(max_depth, int(tr.max_depth))
+    return FlatForest(
+        feature=feature,
+        threshold=threshold,
+        left=left,
+        right=right,
+        value=value,
+        max_depth=max_depth,
+        aggregation="logit_sum",
+        base_score=base,
         feature_names=feature_names or [],
         pass_threshold=pass_threshold,
     )

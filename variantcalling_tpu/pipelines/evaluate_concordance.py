@@ -1,0 +1,80 @@
+"""evaluate_concordance — precision/recall evaluation of a compared callset.
+
+Drop-in surface of the reference tool (ugvc/pipelines/evaluate_concordance.py:
+32-108): reads a concordance frame (h5 from run_comparison_pipeline), writes
+``<prefix>.h5`` keys ``optimal_recall_precision`` / ``recall_precision_curve``
+plus ``.stats.csv`` (';'-separated) and ``.thresholds.csv``. The per-category
+tally runs as one device matmul (ops/concordance).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics, calc_recall_precision_curve
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="evaluate_concordance", description=run.__doc__)
+    ap.add_argument("--input_file", required=True, help="Input concordance h5 file")
+    ap.add_argument("--output_prefix", required=True, help="Prefix to output files")
+    ap.add_argument("--dataset_key", default="all", help="h5 dataset name, such as chromosome name")
+    ap.add_argument("--score_key", default="tree_score", help="column for calculating the score")
+    ap.add_argument("--ignore_genotype", action="store_true", help="ignore genotype when comparing to ground-truth")
+    ap.add_argument("--ignore_filters", default="HPOL_RUN", help="comma separated list of filters to ignore")
+    ap.add_argument("--output_bed", action="store_true", help="output bed files of fp/fn/tp per variant type")
+    ap.add_argument("--use_for_group_testing", type=str, default=None, help="Column to use for grouping")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def bed_files_output(df, prefix: str, classify_column: str) -> None:
+    """fp/fn/tp BED triplet (vcftools.bed_files_output surface)."""
+    from variantcalling_tpu.io.bed import BedWriter
+
+    for cls in ("fp", "fn", "tp"):
+        sel = df[df[classify_column].astype(str) == cls]
+        with BedWriter(f"{prefix}_{cls}.bed") as bw:
+            for chrom, pos in zip(sel["chrom"], sel["pos"]):
+                bw.write(str(chrom), int(pos) - 1, int(pos))
+
+
+def run(argv: list[str]) -> int:
+    """Calculate precision and recall for compared HDF5."""
+    args = parse_args(argv)
+    import logging
+
+    logger.setLevel(getattr(logging, args.verbosity))
+    skip = ["concordance", "scored_concordance", "input_args", "comparison_result"] if args.dataset_key == "all" else []
+    df = read_hdf(args.input_file, key=args.dataset_key, skip_keys=skip)
+
+    score_column = args.score_key.lower()
+    if score_column not in df.columns or bool(np.all(np.isnan(np.asarray(df[score_column], dtype=float)))):
+        df[score_column] = 1
+        logger.warning("No %s field in comparison hdf input, expect invalid recall/precision curves", score_column)
+    df["tree_score"] = df[score_column]
+    classify_column = "classify" if args.ignore_genotype else "classify_gt"
+    if classify_column not in df.columns:  # single-classification frames
+        classify_column = "classify"
+    ignored = args.ignore_filters.split(",")
+
+    accuracy_df = calc_accuracy_metrics(df, classify_column, ignored, args.use_for_group_testing)
+    write_hdf(accuracy_df, f"{args.output_prefix}.h5", key="optimal_recall_precision", mode="w")
+    accuracy_df.to_csv(f"{args.output_prefix}.stats.csv", sep=";", index=False)
+
+    curve_df = calc_recall_precision_curve(df, classify_column, ignored, args.use_for_group_testing)
+    write_hdf(curve_df, f"{args.output_prefix}.h5", key="recall_precision_curve", mode="a")
+    curve_df[["group", "threshold"]].to_csv(f"{args.output_prefix}.thresholds.csv", index=False)
+
+    if args.output_bed:
+        bed_files_output(df, args.output_prefix, classify_column)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

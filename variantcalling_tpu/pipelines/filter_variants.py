@@ -188,10 +188,16 @@ def filter_variants(
 
     cohort_fp = np.zeros(n, dtype=bool)
     if blacklist is not None and len(blacklist[0]):
-        bl = set(zip(blacklist[0].tolist(), blacklist[1].tolist()))
-        for i in range(n):
-            if (table.chrom[i], int(table.pos[i])) in bl:
-                cohort_fp[i] = True
+        # vectorized (chrom, pos) join: map chroms to small ints, pack into
+        # one int64 key, sorted-membership — no per-record Python on the 5M path
+        chroms = {c: i for i, c in enumerate(dict.fromkeys(np.concatenate([blacklist[0], table.chrom]).tolist()))}
+        cidx_bl = np.fromiter((chroms[c] for c in blacklist[0]), dtype=np.int64, count=len(blacklist[0]))
+        cidx_tb = np.fromiter((chroms[c] for c in table.chrom), dtype=np.int64, count=n)
+        key_bl = np.sort((cidx_bl << 40) | blacklist[1].astype(np.int64))
+        key_tb = (cidx_tb << 40) | table.pos.astype(np.int64)
+        loc = np.searchsorted(key_bl, key_tb)
+        loc = np.minimum(loc, len(key_bl) - 1)
+        cohort_fp = key_bl[loc] == key_tb
     if blacklist_cg_insertions and fs.windows is not None:
         from variantcalling_tpu.featurize import CENTER
 

@@ -1,0 +1,144 @@
+"""DataFrame <-> HDF5 store built on h5py.
+
+The reference persists every tabular artifact as pandas ``.to_hdf`` keys
+(evaluate_concordance.py:101-105, coverage stats, report sections) via
+pytables. This framework keeps the same *surface* — ``write_hdf(df, path,
+key)`` / ``read_hdf(path, key, skip_keys)`` with multi-key files and the
+``key="all"`` concat convention — on an h5py-backed columnar layout:
+one group per key, one dataset per column, dtype metadata in attrs.
+Columnar layout means a reader can pull a single column of a multi-GB
+store without materializing the frame (the ingest path for device batches).
+"""
+
+from __future__ import annotations
+
+import json
+
+import h5py
+import numpy as np
+import pandas as pd
+
+_FORMAT_ATTR = "vctpu_frame"
+
+
+def _encode_column(vals: np.ndarray):
+    """(data, kind) where kind notes how to restore the dtype."""
+    if vals.dtype == object and len(vals) and isinstance(vals[0], (np.ndarray, list)):
+        # ragged array-valued column (e.g. per-group PR curves) -> CSR layout
+        arrays = [np.asarray(v, dtype=np.float64).ravel() for v in vals]
+        offsets = np.zeros(len(arrays) + 1, dtype=np.int64)
+        np.cumsum([len(a) for a in arrays], out=offsets[1:])
+        flat = np.concatenate(arrays) if arrays else np.array([], dtype=np.float64)
+        return (flat, offsets), "ragged"
+    if vals.dtype == object or vals.dtype.kind in ("U", "S"):
+        out = np.array(
+            ["\0" if v is None or (isinstance(v, float) and np.isnan(v)) else str(v) for v in vals],
+            dtype=object,
+        )
+        return out, "str"
+    if vals.dtype.kind == "b":
+        return vals.astype(np.uint8), "bool"
+    return vals, vals.dtype.kind
+
+
+def _decode_column(ds, kind: str) -> np.ndarray:
+    if kind == "ragged":
+        flat = ds["values"][()]
+        offsets = ds["offsets"][()]
+        out = np.empty(len(offsets) - 1, dtype=object)
+        for i in range(len(out)):
+            out[i] = flat[offsets[i] : offsets[i + 1]]
+        return out
+    data = ds[()]
+    if kind == "str":
+        out = np.array([v.decode() if isinstance(v, bytes) else str(v) for v in data], dtype=object)
+        return np.where(out == "\0", None, out)
+    if kind == "bool":
+        return data.astype(bool)
+    return data
+
+
+def write_hdf(df: pd.DataFrame, path: str, key: str, mode: str = "a") -> None:
+    """Write one DataFrame under ``key`` (pandas ``df.to_hdf`` surface)."""
+    with h5py.File(path, mode) as f:
+        if key in f:
+            del f[key]
+        g = f.create_group(key)
+        g.attrs[_FORMAT_ATTR] = 1
+        kinds: dict[str, str] = {}
+        names = [str(c) for c in df.columns]
+        g.attrs["columns"] = json.dumps(names)
+        # non-trivial index is preserved as a pseudo-column
+        idx = df.index
+        if not (isinstance(idx, pd.RangeIndex) and idx.start == 0 and idx.step == 1):
+            raw = idx.to_numpy()
+            if raw.dtype.kind not in "biufc":
+                raw = raw.astype(object)
+            ivals, ikind = _encode_column(raw)
+            kinds["__index__"] = ikind
+            _write_ds(g, "__index__", ivals)
+        for col, name in zip(df.columns, names):
+            vals = df[col].to_numpy()
+            data, kind = _encode_column(vals)
+            kinds[name] = kind
+            _write_ds(g, name, data)
+        g.attrs["kinds"] = json.dumps(kinds)
+
+
+def _write_ds(g: h5py.Group, name: str, data) -> None:
+    if isinstance(data, tuple):  # ragged: (flat values, offsets)
+        sub = g.create_group(name)
+        sub.create_dataset("values", data=data[0])
+        sub.create_dataset("offsets", data=data[1])
+        return
+    if data.dtype == object:
+        dt = h5py.string_dtype(encoding="utf-8")
+        g.create_dataset(name, data=data.astype(dt), dtype=dt)
+    else:
+        g.create_dataset(name, data=data)
+
+
+def _read_frame(g: h5py.Group) -> pd.DataFrame:
+    kinds = json.loads(g.attrs["kinds"])
+    names = json.loads(g.attrs["columns"])
+    cols = {}
+    for name in names:
+        cols[name] = _decode_column(g[name], kinds.get(name, "f"))
+    df = pd.DataFrame(cols)
+    if "__index__" in g:
+        df.index = _decode_column(g["__index__"], kinds.get("__index__", "f"))
+    return df
+
+
+def list_keys(path: str) -> list[str]:
+    with h5py.File(path, "r") as f:
+        return sorted(k for k in f.keys() if isinstance(f[k], h5py.Group) and _FORMAT_ATTR in f[k].attrs)
+
+
+def read_hdf(path: str, key: str = "all", skip_keys: list[str] | None = None, columns_subset=None) -> pd.DataFrame:
+    """Read one key, or concat every stored key when ``key="all"`` is absent.
+
+    Mirrors ugbio_core.h5_utils.read_hdf as used by evaluate_concordance.py:
+    82-87 — the "all" pseudo-key concatenates per-chromosome frames, minus
+    ``skip_keys``.
+    """
+    skip = set(skip_keys or [])
+    with h5py.File(path, "r") as f:
+        if key in f and key not in ("all",):
+            df = _read_frame(f[key])
+        elif key == "all" and "all" in f:
+            df = _read_frame(f["all"])
+        elif key == "all":
+            frames = [
+                _read_frame(f[k])
+                for k in sorted(f.keys())
+                if k not in skip and isinstance(f[k], h5py.Group) and _FORMAT_ATTR in f[k].attrs
+            ]
+            if not frames:
+                raise KeyError(f"no frames in {path}")
+            df = pd.concat(frames, ignore_index=False)
+        else:
+            raise KeyError(f"key {key!r} not in {path}")
+    if columns_subset is not None:
+        df = df[[c for c in columns_subset if c in df.columns]]
+    return df
