@@ -1,0 +1,86 @@
+"""End-to-end: synthesize labeled data -> train_models_pipeline -> reuse the
+model in filter_variants_pipeline (the reference's train->filter contract,
+docs/train_models_pipeline.md:96-98)."""
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.models.registry import load_models
+from variantcalling_tpu.pipelines import train_models
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+
+def _concordance_frame(rng, n=3000):
+    """Labeled frame where low-qual high-sor variants are fp."""
+    qual = rng.uniform(0, 100, n).astype(np.float32)
+    sor = rng.uniform(0, 10, n).astype(np.float32)
+    is_indel = rng.random(n) < 0.3
+    hmer = np.where(is_indel & (rng.random(n) < 0.5), rng.integers(1, 12, n), 0)
+    p_tp = 1 / (1 + np.exp(-(0.08 * qual - 0.5 * sor)))
+    is_tp = rng.random(n) < p_tp
+    chrom = np.where(np.arange(n) % 4 == 0, "chr20", "chr1")
+    return pd.DataFrame(
+        {
+            "chrom": chrom,
+            "pos": np.arange(1, n + 1) * 13,
+            "qual": qual,
+            "sor": sor,
+            "dp": rng.uniform(10, 60, n).astype(np.float32),
+            "af": rng.uniform(0.1, 1, n).astype(np.float32),
+            "is_indel": is_indel.astype(np.float32),
+            "hmer_indel_length": hmer.astype(np.float32),
+            "classify": np.where(is_tp, "tp", "fp"),
+            "classify_gt": np.where(is_tp, "tp", "fp"),
+        }
+    )
+
+
+def test_train_models_pipeline_h5_mode(tmp_path, rng):
+    df = _concordance_frame(rng)
+    inp = str(tmp_path / "comp.h5")
+    write_hdf(df, inp, key="all", mode="w")
+    prefix = str(tmp_path / "model")
+    rc = train_models.run(
+        [
+            "--input_file", inp,
+            "--output_file_prefix", prefix,
+            "--evaluate_concordance",
+            "--evaluate_concordance_contig", "chr20",
+            "--apply_model", "rf_model_ignore_gt_incl_hpol_runs",
+            "--n_trees", "20",
+            "--tree_depth", "4",
+        ]
+    )
+    assert rc == 0
+
+    models = load_models(prefix + ".pkl")
+    assert "rf_model_ignore_gt_incl_hpol_runs" in models
+    assert "threshold_model_ignore_gt_incl_hpol_runs" in models
+    # model learned the qual/sor signal
+    res = read_hdf(prefix + ".h5", key="training_results")
+    rf_row = res[res["model"] == "rf_model_ignore_gt_incl_hpol_runs"].iloc[0]
+    assert rf_row["f1"] > 0.75
+
+    # held-out evaluation recorded
+    acc = read_hdf(prefix + ".h5", key="optimal_recall_precision")
+    assert "SNP" in acc["group"].tolist()
+
+
+def test_trained_model_scores_in_filter(tmp_path, rng):
+    """The pkl round-trips through the filter pipeline's model loader."""
+    from variantcalling_tpu.models.registry import load_model
+    from variantcalling_tpu.models.forest import predict_score
+
+    df = _concordance_frame(rng, n=2000)
+    inp = str(tmp_path / "comp.h5")
+    write_hdf(df, inp, key="all", mode="w")
+    prefix = str(tmp_path / "model")
+    train_models.run(["--input_file", inp, "--output_file_prefix", prefix, "--n_trees", "10", "--tree_depth", "3"])
+    model = load_model(prefix + ".pkl", "rf_model_use_gt_incl_hpol_runs")
+    names = model.feature_names
+    x = np.stack([np.asarray(df[f], dtype=np.float32) for f in names], axis=1)
+    score = np.asarray(predict_score(model, x))
+    # scores separate tp from fp
+    tp_mean = score[df["classify"] == "tp"].mean()
+    fp_mean = score[df["classify"] == "fp"].mean()
+    assert tp_mean > fp_mean + 0.2

@@ -1,0 +1,31 @@
+import numpy as np
+
+from variantcalling_tpu.models import threshold as tm
+
+
+def test_fit_threshold_model_recovers_cuts(rng):
+    n = 5000
+    tlod = rng.uniform(0, 20, n).astype(np.float32)
+    sor = rng.uniform(0, 6, n).astype(np.float32)
+    y = ((tlod > 8) & (sor < 3)).astype(np.float32)
+    x = np.stack([tlod, sor, rng.random(n).astype(np.float32)], axis=1)
+    names = ["tlod", "sor", "junk"]
+    model = tm.fit_threshold_model(x, y, names, candidate_features=["tlod", "sor"])
+    assert model.feature_names == ["tlod", "sor"]
+    assert model.signs.tolist() == [1.0, -1.0]
+    assert 5 < model.thresholds[0] < 10
+    assert 2 < model.thresholds[1] < 4
+    score = np.asarray(tm.predict_score(model, x, names))
+    pred = score >= model.pass_threshold
+    f1_den = (pred & (y > 0)).sum() * 2 + (pred & (y == 0)).sum() + (~pred & (y > 0)).sum()
+    f1 = 2 * (pred & (y > 0)).sum() / max(f1_den, 1)
+    assert f1 > 0.9
+
+
+def test_fit_threshold_fallback_features(rng):
+    n = 1000
+    x = rng.random((n, 3)).astype(np.float32)
+    y = (x[:, 2] > 0.5).astype(np.float32)
+    model = tm.fit_threshold_model(x, y, ["a", "b", "c"], candidate_features=["tlod"])
+    # tlod absent -> falls back to the most correlated features
+    assert "c" in model.feature_names

@@ -1,0 +1,223 @@
+"""Histogram-based gradient-boosted trees, trained end-to-end on TPU.
+
+The reference trains sklearn RandomForest / xgboost on CPU
+(docs/train_models_pipeline.md, setup/environment.yml: xgboost 2.1.2) —
+a per-node, pointer-chasing algorithm. This trainer is re-founded for the
+MXU/XLA execution model:
+
+- features are quantile-binned once (B bins), so every split decision is a
+  histogram lookup, never a sort;
+- trees are complete depth-D trees grown level-by-level, so every shape is
+  static: per level, gradient/hessian histograms over (node, feature, bin)
+  are segment-sums, split search is a cumsum + argmax, and sample routing
+  is one gather — the entire fit of all T trees is ONE jitted
+  ``lax.fori_loop`` program with zero host round-trips;
+- under pjit, the sample axis shards across the mesh and XLA inserts the
+  psum for each histogram (the "sharded training reductions" of BASELINE
+  config 3) — the same program runs single-chip or on a pod.
+
+The fitted model exports to :class:`~variantcalling_tpu.models.forest.
+FlatForest` (aggregation="logit_sum"), so inference shares the filter
+pipeline's gather-traversal kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from variantcalling_tpu.models.forest import LEAF, FlatForest
+
+
+@dataclass(frozen=True)
+class BoostConfig:
+    n_trees: int = 100
+    depth: int = 6
+    n_bins: int = 64
+    learning_rate: float = 0.15
+    reg_lambda: float = 1.0
+    min_child_weight: float = 1.0
+    base_score: float = 0.0  # initial margin (log-odds)
+
+
+def quantile_bin_edges(x: np.ndarray, n_bins: int, max_sample: int = 200_000, seed: int = 0) -> np.ndarray:
+    """(F, n_bins-1) per-feature bin edges from (sub-sampled) quantiles."""
+    n = x.shape[0]
+    if n > max_sample:
+        idx = np.random.default_rng(seed).choice(n, max_sample, replace=False)
+        x = x[idx]
+    qs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    edges = np.quantile(x, qs, axis=0).T.astype(np.float32)  # (F, B-1)
+    # non-decreasing edges keep searchsorted well-defined; duplicate edges
+    # (constant-ish features) just leave empty bins, which cost no gain
+    return np.maximum.accumulate(edges, axis=1)
+
+
+def bin_features(x: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """(N, F) int32 bin ids in [0, B); device-side vectorized searchsorted."""
+    return jax.vmap(lambda col, e: jnp.searchsorted(e, col), in_axes=(1, 0), out_axes=1)(x, edges).astype(jnp.int32)
+
+
+def _grow_tree(binned, g, h, cfg: BoostConfig):
+    """One complete depth-D tree. Returns (feat (D, L), bin (D, L), leaf (2^D,)).
+
+    ``feat[l, k]`` / ``bin[l, k]`` describe the split of node k at level l
+    (feat == -1: dead node, routes everything left). L = 2^(D-1) padded to
+    2^D for static shapes.
+    """
+    n, f = binned.shape
+    b = cfg.n_bins
+    max_nodes = 1 << cfg.depth  # leaves
+    lam = cfg.reg_lambda
+
+    def level_step(level, carry):
+        node_id, feats, bins = carry
+        # histograms over (node, bin) per feature: two segment-sums (g, h)
+        seg = node_id * b + binned.T  # (F, N) segment ids in [0, max_nodes*b)
+        hist_g = jax.vmap(lambda s: jax.ops.segment_sum(g, s, num_segments=max_nodes * b))(seg)
+        hist_h = jax.vmap(lambda s: jax.ops.segment_sum(h, s, num_segments=max_nodes * b))(seg)
+        hist_g = hist_g.reshape(f, max_nodes, b).transpose(1, 0, 2)  # (node, F, B)
+        hist_h = hist_h.reshape(f, max_nodes, b).transpose(1, 0, 2)
+
+        gl = jnp.cumsum(hist_g, axis=2)  # left sums for split at bin <= j
+        hl = jnp.cumsum(hist_h, axis=2)
+        gt = gl[:, :, -1:]
+        ht = hl[:, :, -1:]
+        gr = gt - gl
+        hr = ht - hl
+        parent = (gt * gt) / (ht + lam)
+        gain = (gl * gl) / (hl + lam) + (gr * gr) / (hr + lam) - parent  # (node, F, B)
+        ok = (hl >= cfg.min_child_weight) & (hr >= cfg.min_child_weight)
+        gain = jnp.where(ok, gain, -jnp.inf)
+        gain = gain.at[:, :, -1].set(-jnp.inf)  # last bin = no split
+        flat = gain.reshape(max_nodes, f * b)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // b).astype(jnp.int32)
+        bb = (best % b).astype(jnp.int32)
+        dead = ~jnp.isfinite(best_gain) | (best_gain <= 0.0)
+        bf = jnp.where(dead, -1, bf)
+
+        feats = feats.at[level].set(bf)
+        bins = bins.at[level].set(bb)
+
+        # route samples: right iff bin[best_feat] > best_bin (dead -> left)
+        nf = jnp.maximum(bf[node_id], 0)  # (N,)
+        sample_bin = jnp.take_along_axis(binned, nf[:, None], axis=1)[:, 0]
+        go_right = (bf[node_id] >= 0) & (sample_bin > bb[node_id])
+        node_id = node_id * 2 + go_right.astype(jnp.int32)
+        return node_id, feats, bins
+
+    node_id0 = jnp.zeros(n, dtype=jnp.int32)
+    feats0 = jnp.full((cfg.depth, max_nodes), -1, dtype=jnp.int32)
+    bins0 = jnp.zeros((cfg.depth, max_nodes), dtype=jnp.int32)
+    node_id, feats, bins = jax.lax.fori_loop(0, cfg.depth, level_step, (node_id0, feats0, bins0))
+
+    leaf_g = jax.ops.segment_sum(g, node_id, num_segments=max_nodes)
+    leaf_h = jax.ops.segment_sum(h, node_id, num_segments=max_nodes)
+    leaf = -cfg.learning_rate * leaf_g / (leaf_h + lam)
+    return feats, bins, leaf, node_id
+
+
+def fit(
+    x: np.ndarray | jnp.ndarray,
+    y: np.ndarray | jnp.ndarray,
+    sample_weight: np.ndarray | None = None,
+    cfg: BoostConfig = BoostConfig(),
+    feature_names: list[str] | None = None,
+    edges: np.ndarray | None = None,
+) -> FlatForest:
+    """Fit a boosted forest; the full T-tree loop runs as one jit."""
+    x = np.asarray(x, dtype=np.float32)
+    y01 = np.asarray(y, dtype=np.float32)
+    w = np.ones_like(y01) if sample_weight is None else np.asarray(sample_weight, dtype=np.float32)
+    if edges is None:
+        edges = quantile_bin_edges(x, cfg.n_bins)
+
+    binned = bin_features(jnp.asarray(x), jnp.asarray(edges))
+
+    @jax.jit
+    def train(binned, y01, w):
+        max_nodes = 1 << cfg.depth
+
+        def tree_step(t, carry):
+            margin, all_feats, all_bins, all_leaves = carry
+            p = jax.nn.sigmoid(margin)
+            g = w * (p - y01)
+            h = jnp.maximum(w * p * (1.0 - p), 1e-12)
+            feats, bins, leaf, node_id = _grow_tree(binned, g, h, cfg)
+            margin = margin + leaf[node_id]
+            all_feats = jax.lax.dynamic_update_index_in_dim(all_feats, feats, t, 0)
+            all_bins = jax.lax.dynamic_update_index_in_dim(all_bins, bins, t, 0)
+            all_leaves = jax.lax.dynamic_update_index_in_dim(all_leaves, leaf, t, 0)
+            return margin, all_feats, all_bins, all_leaves
+
+        n = binned.shape[0]
+        margin0 = jnp.full(n, cfg.base_score, dtype=jnp.float32)
+        feats0 = jnp.zeros((cfg.n_trees, cfg.depth, max_nodes), dtype=jnp.int32)
+        bins0 = jnp.zeros((cfg.n_trees, cfg.depth, max_nodes), dtype=jnp.int32)
+        leaves0 = jnp.zeros((cfg.n_trees, max_nodes), dtype=jnp.float32)
+        return jax.lax.fori_loop(0, cfg.n_trees, tree_step, (margin0, feats0, bins0, leaves0))
+
+    _, all_feats, all_bins, all_leaves = train(binned, jnp.asarray(y01), jnp.asarray(w))
+    return _to_flat_forest(
+        np.asarray(all_feats), np.asarray(all_bins), np.asarray(all_leaves), np.asarray(edges), cfg, feature_names
+    )
+
+
+def _to_flat_forest(
+    feats: np.ndarray,  # (T, D, 2^D)
+    bins: np.ndarray,
+    leaves: np.ndarray,  # (T, 2^D)
+    edges: np.ndarray,  # (F, B-1)
+    cfg: BoostConfig,
+    feature_names: list[str] | None,
+) -> FlatForest:
+    """Heap-layout complete trees -> FlatForest node arrays.
+
+    Internal node (level l, k-th) sits at heap index 2^l-1+k; leaves fill
+    the last level. Dead splits keep feature 0 with threshold +inf (all
+    samples route left), preserving the complete-tree shape.
+    """
+    t, d, _ = feats.shape
+    n_leaves = 1 << d
+    m = (1 << (d + 1)) - 1
+    feature = np.full((t, m), LEAF, dtype=np.int32)
+    threshold = np.zeros((t, m), dtype=np.float32)
+    left = np.tile(np.arange(m, dtype=np.int32), (t, 1))
+    right = np.tile(np.arange(m, dtype=np.int32), (t, 1))
+    value = np.zeros((t, m), dtype=np.float32)
+
+    b = cfg.n_bins
+    for level in range(d):
+        n_nodes = 1 << level
+        base = (1 << level) - 1
+        idx = base + np.arange(n_nodes)
+        bf = feats[:, level, :n_nodes]  # (T, n_nodes)
+        bb = bins[:, level, :n_nodes]
+        dead = bf < 0
+        safe_f = np.maximum(bf, 0)
+        # split "bin <= j" -> threshold edges[f, j] (right-open); last edge
+        # index clamped (no-split guards make it unreachable)
+        thr = edges[safe_f, np.minimum(bb, edges.shape[1] - 1)]
+        feature[:, idx] = np.where(dead, 0, safe_f)
+        threshold[:, idx] = np.where(dead, np.float32(np.inf), thr)
+        left[:, idx] = 2 * idx + 1
+        right[:, idx] = 2 * idx + 2
+    leaf_idx = (1 << d) - 1 + np.arange(n_leaves)
+    value[:, leaf_idx] = leaves
+    return FlatForest(
+        feature=feature,
+        threshold=threshold,
+        left=left,
+        right=right,
+        value=value,
+        max_depth=d,
+        aggregation="logit_sum",
+        base_score=cfg.base_score,
+        feature_names=feature_names or [],
+    )

@@ -43,6 +43,78 @@ def predict_score(model: ThresholdModel, x: jnp.ndarray, feature_names: list[str
     return jnp.prod(jax.nn.sigmoid(margins), axis=1)
 
 
+def fit_threshold_model(
+    x: np.ndarray,
+    y: np.ndarray,
+    feature_names: list[str],
+    candidate_features: list[str] | None = None,
+    sample_weight: np.ndarray | None = None,
+    n_grid: int = 24,
+    pass_threshold: float = 0.25,
+) -> ThresholdModel:
+    """Fit thresholds by exhaustive grid search — one device pass.
+
+    For each used feature, candidate thresholds are quantiles of its
+    distribution and the sign is chosen by class-mean direction; the joint
+    grid (n_grid^F combinations for the 2-feature somatic case) is scored
+    in a single (N, G) batched evaluation and the max-F1 cell wins —
+    the TPU-native analog of the reference's hand-tuned TLOD/SOR cuts
+    (docs/howto-callset-filter.md:129-139).
+    """
+    cand = [f for f in (candidate_features or ["tlod", "sor"]) if f in feature_names]
+    if not cand:  # fall back to the two strongest features by |corr|
+        corr = [abs(float(np.corrcoef(x[:, i], y)[0, 1])) if np.std(x[:, i]) > 0 else 0.0 for i in range(x.shape[1])]
+        cand = [feature_names[i] for i in np.argsort(corr)[::-1][:2]]
+    cols = [feature_names.index(f) for f in cand]
+    xs = np.asarray(x[:, cols], dtype=np.float32)  # (N, F)
+    yv = np.asarray(y, dtype=np.float32)
+    wv = np.ones(len(y), np.float32) if sample_weight is None else np.asarray(sample_weight, np.float32)
+    if len(xs) > 500_000:  # the (N, G^F) sweep is memory-bound; a 500K
+        sel = np.random.default_rng(0).choice(len(xs), 500_000, replace=False)  # subsample loses no precision here
+        xs, yv, wv = xs[sel], yv[sel], wv[sel]
+    yb = jnp.asarray(yv)
+    w = jnp.asarray(wv)
+
+    pos, neg = yv > 0.5, yv <= 0.5
+    if not pos.any() or not neg.any():
+        signs = np.ones(xs.shape[1], dtype=np.float32)
+    else:
+        signs = np.array(
+            [1.0 if xs[pos, j].mean() >= xs[neg, j].mean() else -1.0 for j in range(xs.shape[1])],
+            dtype=np.float32,
+        )
+    qs = np.linspace(0.02, 0.98, n_grid)
+    cand_thr = np.quantile(xs, qs, axis=0).astype(np.float32)  # (G, F)
+    # joint grid over per-feature candidates
+    grids = np.meshgrid(*[cand_thr[:, j] for j in range(xs.shape[1])], indexing="ij")
+    combos = np.stack([g.ravel() for g in grids], axis=1)  # (G^F, F)
+
+    @jax.jit
+    def best_combo(xs_d, combos_d):
+        # hard pass/fail per combo: all features on the good side
+        ok = (xs_d[:, None, :] - combos_d[None, :, :]) * signs[None, None, :] >= 0  # (N, C, F)
+        pred = jnp.all(ok, axis=2).astype(jnp.float32)  # (N, C)
+        tp = (w * yb) @ pred
+        fp = (w * (1 - yb)) @ pred
+        fn = jnp.sum(w * yb) - tp
+        f1 = 2 * tp / jnp.maximum(2 * tp + fp + fn, 1e-9)
+        return jnp.argmax(f1)
+
+    idx = int(best_combo(jnp.asarray(xs), jnp.asarray(combos)))
+    thr = combos[idx]
+    # sharp sigmoids keep the soft score close to the hard cut the grid
+    # search optimized, while staying differentiable for downstream curves
+    scales = np.maximum(np.std(xs, axis=0) * 0.05, 1e-3).astype(np.float32)
+    return ThresholdModel(
+        feature_names=cand,
+        thresholds=thr.astype(np.float32),
+        signs=signs,
+        scales=scales,
+        pass_threshold=pass_threshold,
+        all_feature_names=list(feature_names),
+    )
+
+
 def default_somatic_model(all_feature_names: list[str]) -> ThresholdModel:
     """TLOD/SOR thresholds per the somatic howto (TLOD high good, SOR low good)."""
     return ThresholdModel(

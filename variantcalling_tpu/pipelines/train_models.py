@@ -1,0 +1,265 @@
+"""train_models_pipeline — fit the filtering model family on TPU.
+
+Drop-in surface of the reference tool (docs/train_models_pipeline.md:16-98).
+Trains the standard named-model grid {rf, threshold} x {use_gt, ignore_gt}
+x {incl, excl hpol runs} and dumps ``<prefix>.pkl`` (registry format read
+by filter_variants_pipeline) + ``<prefix>.h5`` training results.
+
+TPU re-founding: the "rf" family is the histogram gradient-boosted forest
+(models/boosting — one jitted fori_loop program, psum-able histogram
+reductions per BASELINE config 3), not a CPU sklearn fit; "threshold" is a
+device grid search. Labeling modes (exact vs approximate GT) follow
+training_prep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io import bed as bedio
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.models import boosting
+from variantcalling_tpu.models import forest as forest_mod
+from variantcalling_tpu.models import threshold as threshold_mod
+from variantcalling_tpu.models.registry import MODEL_NAME_PATTERN, save_models
+from variantcalling_tpu.pipelines.training_prep import (
+    blacklist_membership,
+    labels_from_approximate_gt,
+    read_blacklist_loci,
+)
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+# numeric feature columns recognized in a concordance frame (h5 mode)
+H5_FEATURES = [
+    "qual", "dp", "sor", "af", "gq", "is_het", "is_snp", "is_indel", "is_ins",
+    "indel_length", "hmer_indel_length", "hmer_indel_nuc", "gc_content",
+    "cycleskip_status", "left_motif", "right_motif", "ref_code", "alt_code",
+    "n_alts", "tlod",
+]
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="train_models_pipeline", description=run.__doc__)
+    ap.add_argument("--input_file", required=True, help="h5 (comparison output) or VCF input")
+    ap.add_argument("--blacklist", help="blacklist file by which we decide variants as FP")
+    ap.add_argument("--output_file_prefix", required=True, help=".pkl with models, .h5 with results")
+    ap.add_argument("--mutect", action="store_true")
+    ap.add_argument("--evaluate_concordance", action="store_true",
+                    help="apply a model to the held-out contig and record metrics")
+    ap.add_argument("--apply_model", default="rf_model_ignore_gt_incl_hpol_runs")
+    ap.add_argument("--evaluate_concordance_contig", default="chr20")
+    ap.add_argument("--input_interval", help="bed of intersected intervals from run_comparison")
+    ap.add_argument("--list_of_contigs_to_read", nargs="*", default=None)
+    ap.add_argument("--reference", required=False, help="reference FASTA (VCF input mode)")
+    ap.add_argument("--runs_intervals", help="hpol runs intervals (bed/interval_list)")
+    ap.add_argument("--annotate_intervals", action="append", default=[])
+    ap.add_argument("--exome_weight", type=float, default=1.0)
+    ap.add_argument("--flow_order", default="TGCA")
+    ap.add_argument("--exome_weight_annotation", default=None)
+    ap.add_argument("--vcf_type", default="single_sample", choices=["single_sample", "joint"])
+    ap.add_argument("--ignore_filter_status", action="store_true")
+    ap.add_argument("--n_trees", type=int, default=100)
+    ap.add_argument("--tree_depth", type=int, default=6)
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def _ingest(args):
+    """-> (x, feature_names, label, label_gt, weight, in_hpol, contig)."""
+    if args.input_file.endswith((".h5", ".hdf", ".hdf5")):
+        df = read_hdf(args.input_file, key="all",
+                      skip_keys=["concordance", "scored_concordance", "input_args", "comparison_result"])
+        if args.list_of_contigs_to_read:
+            df = df[df["chrom"].isin(args.list_of_contigs_to_read)]
+        cls = df["classify"].astype(str).to_numpy()
+        keep = np.isin(cls, ["tp", "fp"])
+        df = df[keep]
+        label = (cls[keep] == "tp").astype(np.float32)
+        cls_gt = df["classify_gt"].astype(str).to_numpy() if "classify_gt" in df.columns else cls[keep]
+        label_gt = (cls_gt == "tp").astype(np.float32)
+        names = [f for f in H5_FEATURES if f in df.columns]
+        extra = [c for c in df.columns if c.startswith(("LCR", "mappability", "exome", "ug_hcr"))]
+        names += extra
+        x = np.stack([np.nan_to_num(np.asarray(df[f], dtype=np.float32)) for f in names], axis=1)
+        in_hpol = (
+            np.asarray(df["hpol_run"], dtype=bool) if "hpol_run" in df.columns else np.zeros(len(df), dtype=bool)
+        )
+        contig = df["chrom"].astype(str).to_numpy()
+        weight = _exome_weight(args, names, x)
+        return x, names, label, label_gt, weight, in_hpol, contig
+
+    # VCF mode: featurize against the reference; approximate-GT labels
+    from variantcalling_tpu.featurize import featurize
+    from variantcalling_tpu.ops import intervals as iops
+
+    if not args.reference:
+        raise SystemExit("--reference is required for VCF input")
+    table = read_vcf(args.input_file)
+    if args.list_of_contigs_to_read:
+        m = np.isin(table.chrom, args.list_of_contigs_to_read)
+        table = _subset_table(table, m)
+    annotate = {}
+    for path in args.annotate_intervals:
+        annotate[_interval_name(path)] = bedio.read_intervals(path)
+    with FastaReader(args.reference) as fasta:
+        fs = featurize(table, fasta, annotate_intervals=annotate, flow_order=args.flow_order,
+                       extra_info_fields=["TLOD"] if args.mutect else [])
+        if args.mutect and "TLOD" in fs.columns:
+            fs.columns["tlod"] = fs.columns.pop("TLOD")
+            fs.feature_names[fs.feature_names.index("TLOD")] = "tlod"
+        in_hpol = np.zeros(len(table), dtype=bool)
+        if args.runs_intervals:
+            runs = bedio.read_intervals(args.runs_intervals)
+            contig_lengths = table.header.contig_lengths or {
+                c: fasta.get_reference_length(c) for c in fasta.references
+            }
+            coords = iops.GenomeCoords(contig_lengths)
+            gpos = coords.globalize(np.asarray(table.chrom), table.pos - 1)
+            gs, ge = coords.globalize_intervals(runs)
+            in_hpol = np.asarray(iops.membership(gpos, gs, ge))
+
+    in_dbsnp = (np.asarray(table.vid) != ".") | table.info_flag("DB")
+    if args.blacklist:
+        bl_chrom, bl_pos = read_blacklist_loci(args.blacklist)
+        in_bl = blacklist_membership(table.chrom, table.pos, bl_chrom, bl_pos)
+    else:
+        in_bl = np.zeros(len(table), dtype=bool)
+    keep, label = labels_from_approximate_gt(table.chrom, table.pos, in_dbsnp, in_bl)
+    x = fs.matrix()[keep]
+    label = label[keep].astype(np.float32)
+    weight = _exome_weight(args, fs.feature_names, x)
+    return x, fs.feature_names, label, label.copy(), weight, in_hpol[keep], np.asarray(table.chrom)[keep]
+
+
+def _exome_weight(args, names: list[str], x: np.ndarray) -> np.ndarray:
+    w = np.ones(len(x), dtype=np.float32)
+    if args.exome_weight != 1.0 and args.exome_weight_annotation:
+        matches = [i for i, n in enumerate(names) if args.exome_weight_annotation in n]
+        if matches:
+            w = np.where(x[:, matches[0]] > 0, args.exome_weight, 1.0).astype(np.float32)
+    return w
+
+
+def _subset_table(table, mask: np.ndarray):
+    from dataclasses import replace
+
+    kw = {}
+    for f in ("chrom", "pos", "vid", "ref", "alt", "qual", "filters", "info"):
+        kw[f] = getattr(table, f)[mask]
+    t = replace(table, **kw)
+    if table.fmt_keys is not None:
+        t.fmt_keys = table.fmt_keys[mask]
+        t.sample_cols = table.sample_cols[mask]
+    return t
+
+
+def _interval_name(path: str) -> str:
+    import os
+
+    base = os.path.basename(path)
+    for suf in (".bed", ".interval_list", ".gz"):
+        base = base[: -len(suf)] if base.endswith(suf) else base
+    return base
+
+
+def run(argv: list[str]) -> int:
+    """Train filtering models on the concordance file."""
+    args = parse_args(argv)
+    x, names, label, label_gt, weight, in_hpol, contig = _ingest(args)
+    logger.info("training set: %d variants, %d features (%s)", len(x), len(names), ",".join(names[:8]))
+
+    holdout = np.zeros(len(x), dtype=bool)
+    if args.evaluate_concordance:
+        holdout = contig == args.evaluate_concordance_contig
+    train_m = ~holdout
+
+    cfg = boosting.BoostConfig(n_trees=args.n_trees, depth=args.tree_depth)
+    models: dict[str, object] = {}
+    results = []
+    for gt_name, lab in (("ignore_gt", label), ("use_gt", label_gt)):
+        for hpol_name, hmask in (("incl_hpol_runs", np.ones(len(x), bool)), ("excl_hpol_runs", ~in_hpol)):
+            m = train_m & hmask
+            if m.sum() < 10 or len(set(lab[m].tolist())) < 2:
+                logger.warning("skipping %s/%s: degenerate training subset (%d rows)", gt_name, hpol_name, m.sum())
+                continue
+            fkey = MODEL_NAME_PATTERN.format(family="rf", gt=gt_name, hpol=hpol_name)
+            forest = boosting.fit(x[m], lab[m], sample_weight=weight[m], cfg=cfg, feature_names=list(names))
+            models[fkey] = forest
+            results.append(_train_metrics(fkey, forest, x[m], lab[m], list(names)))
+            tkey = MODEL_NAME_PATTERN.format(family="threshold", gt=gt_name, hpol=hpol_name)
+            cand = ["tlod", "sor"] if args.mutect else ["qual", "sor"]
+            tmodel = threshold_mod.fit_threshold_model(x[m], lab[m], list(names), candidate_features=cand,
+                                                       sample_weight=weight[m])
+            models[tkey] = tmodel
+            results.append(_train_metrics(tkey, tmodel, x[m], lab[m], list(names)))
+
+    pkl = f"{args.output_file_prefix}.pkl"
+    save_models(pkl, models)
+    res_df = pd.DataFrame(results)
+    out_h5 = f"{args.output_file_prefix}.h5"
+    write_hdf(res_df, out_h5, key="training_results", mode="w")
+    logger.info("saved %d models to %s", len(models), pkl)
+
+    if args.evaluate_concordance and holdout.any() and args.apply_model in models:
+        mdl = models[args.apply_model]
+        score = _apply(mdl, x[holdout], list(names))
+        eval_df = pd.DataFrame(
+            {
+                "chrom": contig[holdout],
+                "pos": np.arange(int(holdout.sum())),
+                "indel": x[holdout][:, names.index("is_indel")] > 0 if "is_indel" in names else False,
+                "hmer_indel_length": x[holdout][:, names.index("hmer_indel_length")]
+                if "hmer_indel_length" in names
+                else 0,
+                "classify": np.where(label[holdout] > 0, "tp", "fp"),
+                "classify_gt": np.where(label_gt[holdout] > 0, "tp", "fp"),
+                "filter": np.where(score >= getattr(mdl, "pass_threshold", 0.5), "PASS", "LOW_SCORE"),
+                "tree_score": score,
+            }
+        )
+        from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics
+
+        acc = calc_accuracy_metrics(eval_df, "classify_gt", ["HPOL_RUN"])
+        write_hdf(acc, out_h5, key="optimal_recall_precision", mode="a")
+        logger.info("held-out (%s) accuracy:\n%s", args.evaluate_concordance_contig, acc.to_string(index=False))
+    return 0
+
+
+def _apply(model, x: np.ndarray, names: list[str]) -> np.ndarray:
+    import jax
+
+    if isinstance(model, threshold_mod.ThresholdModel):
+        return np.asarray(threshold_mod.predict_score(model, x, names))
+    fm = forest_mod.with_feature_order(model, names) if model.feature_names else model
+    return np.asarray(jax.jit(lambda a: forest_mod.predict_score(fm, a))(x))
+
+
+def _train_metrics(name: str, model, x: np.ndarray, y: np.ndarray, names: list[str]) -> dict:
+    score = _apply(model, x, names)
+    pred = score >= getattr(model, "pass_threshold", 0.5)
+    yb = y > 0.5
+    tp = int((pred & yb).sum())
+    fp = int((pred & ~yb).sum())
+    fn = int((~pred & yb).sum())
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    return {
+        "model": name,
+        "n": len(y),
+        "tp": tp,
+        "fp": fp,
+        "fn": fn,
+        "precision": round(prec, 5),
+        "recall": round(rec, 5),
+        "f1": round(2 * prec * rec / max(prec + rec, 1e-9), 5),
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

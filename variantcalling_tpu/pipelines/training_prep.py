@@ -1,0 +1,122 @@
+"""training_prep_pipeline — build a labeled training set for model fitting.
+
+Re-derivation of ``ugbio_filtering.training_prep`` (missing submodule;
+contract from docs/train_models_pipeline.md:5-10 and the orphaned
+test resources ``test/resources/unit/filtering/test_training_prep/`` —
+vcfeval output + blacklist -> labels h5). Two labeling modes:
+
+- exact ground truth: a concordance frame (run_comparison h5) already
+  carries classify/classify_gt — tp -> label 1, fp -> label 0, fn dropped
+  (no call to train on);
+- approximate ground truth: a dbSNP-annotated callset VCF — dbSNP members
+  (ID set or INFO/DB flag) -> 1, blacklist members -> 0, everything else
+  dropped.
+
+Output: ``<prefix>.labels.h5`` with per-contig keys of
+(chrom, pos, label, label_gt) suitable for train_models_pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+
+def labels_from_concordance(df: pd.DataFrame) -> pd.DataFrame:
+    """Exact-GT labels: tp=1, fp=0 (per classify and classify_gt); fn dropped."""
+    cls = df["classify"].astype(str)
+    keep = cls.isin(["tp", "fp"]).to_numpy()
+    out = df.loc[keep, [c for c in df.columns if c not in ("classify", "classify_gt")]].copy()
+    out["label"] = (cls[keep] == "tp").astype(np.int8).to_numpy()
+    cls_gt = df["classify_gt"].astype(str) if "classify_gt" in df.columns else cls
+    out["label_gt"] = (cls_gt[keep] == "tp").astype(np.int8).to_numpy()
+    return out
+
+
+def labels_from_approximate_gt(
+    chrom: np.ndarray,
+    pos: np.ndarray,
+    in_dbsnp: np.ndarray,
+    in_blacklist: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(keep mask, labels): dbSNP hit -> 1, blacklist hit -> 0, rest dropped.
+
+    A locus in both sets is treated as blacklisted (cohort evidence of a
+    systematic artifact beats database membership).
+    """
+    keep = in_dbsnp | in_blacklist
+    labels = np.where(in_blacklist, 0, 1).astype(np.int8)
+    return keep, labels
+
+
+def blacklist_membership(chrom: np.ndarray, pos: np.ndarray, bl_chrom: np.ndarray, bl_pos: np.ndarray) -> np.ndarray:
+    """Vectorized (chrom, pos) membership via packed int64 keys."""
+    if len(bl_chrom) == 0:
+        return np.zeros(len(chrom), dtype=bool)
+    cmap = {c: i for i, c in enumerate(dict.fromkeys(np.concatenate([bl_chrom, chrom]).tolist()))}
+    cidx_bl = np.fromiter((cmap[c] for c in bl_chrom), dtype=np.int64, count=len(bl_chrom))
+    cidx = np.fromiter((cmap[c] for c in chrom), dtype=np.int64, count=len(chrom))
+    key_bl = np.sort((cidx_bl << 40) | np.asarray(bl_pos, dtype=np.int64))
+    key = (cidx << 40) | np.asarray(pos, dtype=np.int64)
+    loc = np.minimum(np.searchsorted(key_bl, key), len(key_bl) - 1)
+    return key_bl[loc] == key
+
+
+def read_blacklist_loci(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """Blacklist loci from bed / h5 / pkl (filter_variants-compatible)."""
+    from variantcalling_tpu.pipelines.filter_variants import read_blacklist
+
+    return read_blacklist(path)
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="training_prep_pipeline", description=run.__doc__)
+    ap.add_argument("--input_file", required=True, help="concordance h5 or dbSNP-annotated VCF")
+    ap.add_argument("--blacklist", help="blacklist loci (bed/h5/pkl) for approximate-GT labeling")
+    ap.add_argument("--output_prefix", required=True)
+    ap.add_argument("--dataset_key", default="all")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv: list[str]) -> int:
+    """Build labeled training data from exact or approximate ground truth."""
+    args = parse_args(argv)
+    out_path = f"{args.output_prefix}.labels.h5"
+    if args.input_file.endswith((".h5", ".hdf", ".hdf5")):
+        df = read_hdf(args.input_file, key=args.dataset_key,
+                      skip_keys=["concordance", "scored_concordance", "input_args", "comparison_result"])
+        labeled = labels_from_concordance(df)
+    else:
+        table = read_vcf(args.input_file)
+        in_dbsnp = (np.asarray(table.vid) != ".") | table.info_flag("DB")
+        if args.blacklist:
+            bl_chrom, bl_pos = read_blacklist_loci(args.blacklist)
+            in_bl = blacklist_membership(table.chrom, table.pos, bl_chrom, bl_pos)
+        else:
+            in_bl = np.zeros(len(table), dtype=bool)
+        keep, labels = labels_from_approximate_gt(table.chrom, table.pos, in_dbsnp, in_bl)
+        labeled = pd.DataFrame(
+            {
+                "chrom": table.chrom[keep],
+                "pos": table.pos[keep],
+                "label": labels[keep],
+                "label_gt": labels[keep],
+            }
+        )
+    for contig in dict.fromkeys(labeled["chrom"].tolist()):
+        write_hdf(labeled[labeled["chrom"] == contig], out_path, key=str(contig),
+                  mode="w" if contig == labeled["chrom"].iloc[0] else "a")
+    logger.info("wrote %d labeled variants to %s", len(labeled), out_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
