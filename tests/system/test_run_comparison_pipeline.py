@@ -1,0 +1,87 @@
+"""End-to-end comparison pipeline on a synthetic genome, chained into
+evaluate_concordance (the reference's compare->evaluate flow, SURVEY §3.4)."""
+
+import numpy as np
+
+from tests.fixtures import make_genome, synth_variants, write_fasta, write_vcf
+
+from variantcalling_tpu.pipelines import evaluate_concordance as ec
+from variantcalling_tpu.pipelines import run_comparison as rc
+from variantcalling_tpu.utils.h5_utils import read_hdf
+
+
+def test_run_comparison_end_to_end(tmp_path, rng):
+    genome = make_genome(rng, {"chr1": 20000, "chr2": 12000})
+    fasta_path = str(tmp_path / "ref.fa")
+    write_fasta(fasta_path, genome)
+    contigs = {c: len(s) for c, s in genome.items()}
+
+    truth_recs = synth_variants(rng, genome, 300)
+    # calls: drop ~10% (fn), keep 90%, add ~30 novel (fp)
+    keep = rng.random(len(truth_recs)) > 0.1
+    call_recs = [dict(r) for r, k in zip(truth_recs, keep) if k]
+    taken = {(r["chrom"], r["pos"]) for r in truth_recs}
+    n_fp = 0
+    while n_fp < 30:
+        c = "chr1" if rng.random() < 0.6 else "chr2"
+        p = int(rng.integers(10, contigs[c] - 20))
+        if (c, p + 1) in taken:
+            continue
+        ref_b = genome[c][p]
+        alt = "ACGT"[("ACGT".index(ref_b) + 1) % 4]
+        call_recs.append({"chrom": c, "pos": p + 1, "ref": ref_b, "alts": [alt],
+                          "qual": float(rng.uniform(5, 40)), "gt": (0, 1)})
+        taken.add((c, p + 1))
+        n_fp += 1
+    call_recs.sort(key=lambda r: (r["chrom"], r["pos"]))
+
+    truth_vcf = str(tmp_path / "truth.vcf")
+    calls_vcf = str(tmp_path / "calls.vcf")
+    write_vcf(truth_vcf, truth_recs, contigs)
+    write_vcf(calls_vcf, call_recs, contigs)
+
+    hc_bed = str(tmp_path / "hc.bed")
+    with open(hc_bed, "w") as fh:
+        for c, ln in contigs.items():
+            fh.write(f"{c}\t0\t{ln}\n")
+
+    out_h5 = str(tmp_path / "comp.h5")
+    out_iv = str(tmp_path / "cmp.bed")
+    rcode = rc.run(
+        [
+            "--input_prefix", calls_vcf,
+            "--output_file", out_h5,
+            "--output_interval", out_iv,
+            "--gtr_vcf", truth_vcf,
+            "--highconf_intervals", hc_bed,
+            "--reference", fasta_path,
+            "--call_sample_name", "S1",
+            "--truth_sample_name", "GT1",
+        ]
+    )
+    assert rcode == 0
+
+    df = read_hdf(out_h5, key="all")
+    n_fn_expected = int((~keep).sum())
+    assert (df["classify"] == "fn").sum() == n_fn_expected
+    # every kept truth record matches itself -> tp
+    assert (df["classify"] == "tp").sum() == len(call_recs) - n_fp
+    assert (df["classify"] == "fp").sum() == n_fp
+    assert set(df[df["classify"] == "fn"]["call"]) == {"NA"}
+    assert set(df[df["classify"] == "fn"]["base"]) == {"FN"}
+    # schema essentials for downstream consumers
+    for col in ("indel", "hmer_indel_length", "tree_score", "filter", "gt_ultima",
+                "gt_ground_truth", "gc_content", "vaf", "qual", "hpol_run"):
+        assert col in df.columns, col
+
+    # chain into evaluate_concordance
+    prefix = str(tmp_path / "ev")
+    assert ec.run(["--input_file", out_h5, "--output_prefix", prefix]) == 0
+    acc = read_hdf(prefix + ".h5", key="optimal_recall_precision")
+    snp = acc[acc["group"] == "SNP"].iloc[0]
+    # no tree_score -> score=1 everywhere; operating point = raw counts
+    assert snp["tp"] > 0 and snp["fn"] >= 0
+    # overall: recall should reflect the 10% drop
+    total_tp = (df["classify"] == "tp").sum()
+    recall = total_tp / max(total_tp + n_fn_expected, 1)
+    assert 0.85 <= recall <= 0.95

@@ -1,0 +1,87 @@
+import numpy as np
+
+from variantcalling_tpu.comparison.matcher import (
+    make_side,
+    match_contig,
+    normalize_variant,
+)
+
+REF = "ACGTACGTACGTAAAAACGTACGTACGTACGTACGTACGT"  # 40bp; AAAAA run at 12-16 (0-based)
+
+
+def _side(variants):
+    """variants: list of (pos1, ref, [alts], (gt0, gt1))"""
+    pos = np.array([v[0] for v in variants], dtype=np.int64)
+    ref = [v[1] for v in variants]
+    alts = [v[2] for v in variants]
+    gt = np.array([v[3] for v in variants], dtype=np.int8) if variants else np.zeros((0, 2), np.int8)
+    return make_side(pos, ref, alts, gt)
+
+
+def test_normalize_variant():
+    assert normalize_variant(10, "AT", "CT") == (10, "A", "C")  # shared suffix
+    assert normalize_variant(10, "ACC", "AC") == (10, "AC", "A")  # del, suffix trim
+    assert normalize_variant(10, "TAC", "TC") == (10, "TA", "T")  # suffix trimmed first
+    assert normalize_variant(10, "TACG", "TTCG") == (11, "A", "T")  # prefix after suffix
+
+
+def test_exact_snp_match_and_fn():
+    calls = _side([(5, "A", ["C"], (0, 1))])
+    truth = _side([(5, "A", ["C"], (0, 1)), (20, "C", ["G"], (1, 1))])
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [True]
+    assert r.call_tp_gt.tolist() == [True]
+    assert r.truth_tp.tolist() == [True, False]  # second truth variant missed
+
+
+def test_genotype_mismatch_gt_aware():
+    calls = _side([(5, "A", ["C"], (1, 1))])  # hom call
+    truth = _side([(5, "A", ["C"], (0, 1))])  # het truth
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [True]  # allele matches
+    assert r.call_tp_gt.tolist() == [False]  # genotype does not
+
+
+def test_representation_difference_indel():
+    # deletion of one A from the AAAAA run (ref 0-based 12..16 = pos1 13..17):
+    # left-anchored at pos 12 vs right-shifted at pos 16 are the same event
+    calls = _side([(12, "TA", ["T"], (0, 1))])
+    truth = _side([(16, "AA", ["A"], (0, 1))])
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [True]
+    assert r.truth_tp.tolist() == [True]
+    assert r.call_tp_gt.tolist() == [True]
+
+
+def test_mnp_vs_two_snps_phased():
+    # truth: MNP CG>TT at pos 2-3; calls: two hom SNPs — same haplotype
+    truth = _side([(2, "CG", ["TT"], (1, 1))])
+    calls = _side([(2, "C", ["T"], (1, 1)), (3, "G", ["T"], (1, 1))])
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [True, True]
+    assert r.truth_tp.tolist() == [True]
+
+
+def test_het_phasing_mismatch():
+    # truth: both SNPs on the same haplotype (MNP het); calls: two het SNPs.
+    # some phasing of the calls puts them on one haplotype -> match
+    truth = _side([(2, "CG", ["TT"], (0, 1))])
+    calls = _side([(2, "C", ["T"], (0, 1)), (3, "G", ["T"], (0, 1))])
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [True, True]
+
+
+def test_false_positive_no_truth():
+    calls = _side([(8, "T", ["G"], (0, 1))])
+    truth = _side([])
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [False]
+
+
+def test_multiallelic_split_vs_joint():
+    # truth joint record A -> C,G het-alt; calls split into two records
+    truth = _side([(5, "A", ["C", "G"], (1, 2))])
+    calls = _side([(5, "A", ["C"], (0, 1)), (5, "A", ["G"], (0, 1))])
+    r = match_contig(calls, truth, REF)
+    assert r.call_tp.tolist() == [True, True]
+    assert r.truth_tp.tolist() == [True]

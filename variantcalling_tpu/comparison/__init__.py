@@ -1,0 +1,1 @@
+"""Callset-vs-truth comparison: normalization, haplotype matching, annotation."""

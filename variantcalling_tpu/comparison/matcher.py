@@ -1,0 +1,260 @@
+"""Haplotype-aware variant matching (the vcfeval-equivalent core).
+
+The reference delegates TP/FP/FN matching to rtg-tools vcfeval as a black
+box (docs/run_comparison_pipeline.md:3-5, SURVEY §2.5). This module is a
+native re-derivation of the *behavior*: two callsets match when some
+assignment of their variants onto haplotypes yields identical sequence —
+so different representations (split/joined multiallelics, shifted indels)
+still pair up.
+
+Pipeline per contig:
+
+1. **normalize** every variant (trim shared suffix then prefix per allele)
+   so trivially different encodings share a key;
+2. **exact match** on (pos, ref, alt-set) — resolves the overwhelming
+   majority of loci in one vectorized join;
+3. **local haplotype search** for the residue: cluster unmatched call +
+   truth variants within a merge window, then try all diploid phasings of
+   each side (capped combinatorics, as vcfeval caps its search) and accept
+   clusters whose {hap1, hap2} sequence sets agree. Matched clusters mark
+   their variants tp (genotype-consistent by construction).
+
+Genotype-ignoring classification (`classify`) counts allele-level hits;
+`classify_gt` additionally requires genotype equality (exact stage) or
+phase-consistency (haplotype stage).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+MAX_CLUSTER_VARIANTS = 8  # per side; larger clusters fall back to exact-only
+MAX_HETS = 6  # 2^6 phasings per side, mirrors vcfeval's bounded search
+CLUSTER_GAP = 30  # bp between cluster members
+FLANK = 10  # reference padding around a cluster
+
+
+def normalize_variant(pos: int, ref: str, alt: str) -> tuple[int, str, str]:
+    """Trim shared suffix, then shared prefix (VT/bcftools-norm semantics).
+
+    1-based pos; returns the minimal (pos, ref, alt) representation.
+    """
+    while len(ref) > 1 and len(alt) > 1 and ref[-1] == alt[-1]:
+        ref = ref[:-1]
+        alt = alt[:-1]
+    while len(ref) > 1 and len(alt) > 1 and ref[0] == alt[0]:
+        ref = ref[1:]
+        alt = alt[1:]
+        pos += 1
+    return pos, ref, alt
+
+
+@dataclass
+class SideVariants:
+    """Per-contig columnar view of one side (calls or truth)."""
+
+    pos: np.ndarray  # int64, 1-based (original)
+    ref: list[str]
+    alts: list[list[str]]
+    gt: np.ndarray  # (n, 2) int8, -1 = missing
+    norm_keys: list[frozenset]  # per-variant set of normalized (pos, ref, alt)
+
+
+def make_side(pos: np.ndarray, ref: list[str], alts: list[list[str]], gt: np.ndarray) -> SideVariants:
+    keys = []
+    for i in range(len(pos)):
+        ks = []
+        for a in alts[i]:
+            if a in (".", "", "*", "<NON_REF>") or a.startswith("<"):
+                continue
+            ks.append(normalize_variant(int(pos[i]), ref[i], a))
+        keys.append(frozenset(ks))
+    return SideVariants(np.asarray(pos, dtype=np.int64), list(ref), [list(a) for a in alts],
+                        np.asarray(gt, dtype=np.int8), keys)
+
+
+def _called_allele_keys(side: SideVariants, i: int) -> frozenset:
+    """Normalized keys of the alleles the genotype actually calls (all alts if no GT)."""
+    g = side.gt[i]
+    called_idx = {int(a) for a in g if a > 0}
+    if not called_idx:
+        return side.norm_keys[i]
+    out = []
+    for ai in sorted(called_idx):
+        if ai - 1 < len(side.alts[i]):
+            a = side.alts[i][ai - 1]
+            if a in (".", "", "*", "<NON_REF>") or a.startswith("<"):
+                continue
+            out.append(normalize_variant(int(side.pos[i]), side.ref[i], a))
+    return frozenset(out)
+
+
+@dataclass
+class MatchResult:
+    call_tp: np.ndarray  # bool per call: allele-level match
+    call_tp_gt: np.ndarray  # bool per call: genotype-level match
+    truth_tp: np.ndarray  # bool per truth
+    truth_tp_gt: np.ndarray
+    # per-call index of matched truth record (-1 = none) for gt/error columns
+    call_truth_idx: np.ndarray
+
+
+def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str) -> MatchResult:
+    nc, nt = len(calls.pos), len(truth.pos)
+    call_tp = np.zeros(nc, dtype=bool)
+    call_tp_gt = np.zeros(nc, dtype=bool)
+    truth_tp = np.zeros(nt, dtype=bool)
+    truth_tp_gt = np.zeros(nt, dtype=bool)
+    call_truth_idx = np.full(nc, -1, dtype=np.int64)
+
+    # ---- stage 2: exact normalized-key join ------------------------------
+    truth_by_key: dict = {}
+    for j in range(nt):
+        for k in _called_allele_keys(truth, j):
+            truth_by_key.setdefault(k, j)
+    for i in range(nc):
+        ck = _called_allele_keys(calls, i)
+        if not ck:
+            continue
+        hits = {k: truth_by_key[k] for k in ck if k in truth_by_key}
+        if len(hits) == len(ck):  # every called allele present in truth
+            j = next(iter(hits.values()))
+            call_tp[i] = True
+            call_truth_idx[i] = j
+            for jj in set(hits.values()):
+                truth_tp[jj] = True
+            # genotype equality: same multiset of normalized called alleles
+            # AND same zygosity pattern
+            if len(set(hits.values())) == 1 and _gt_equivalent(calls, i, truth, j):
+                call_tp_gt[i] = True
+                truth_tp_gt[j] = True
+
+    # ---- stage 3: local haplotype search on the residue ------------------
+    un_c = np.nonzero(~call_tp)[0]
+    un_t = np.nonzero(~truth_tp)[0]
+    for c_idx, t_idx in _clusters(calls, truth, un_c, un_t):
+        if not c_idx or not t_idx:
+            continue
+        if len(c_idx) > MAX_CLUSTER_VARIANTS or len(t_idx) > MAX_CLUSTER_VARIANTS:
+            continue
+        lo = min(min(int(calls.pos[i]) for i in c_idx), min(int(truth.pos[j]) for j in t_idx)) - FLANK
+        hi = max(
+            max(int(calls.pos[i]) + len(calls.ref[i]) for i in c_idx),
+            max(int(truth.pos[j]) + len(truth.ref[j]) for j in t_idx),
+        ) + FLANK
+        lo = max(lo, 1)
+        window = ref_seq[lo - 1 : hi - 1]
+        haps_c = _diploid_haplotypes(calls, c_idx, lo, window)
+        haps_t = _diploid_haplotypes(truth, t_idx, lo, window)
+        if haps_c is None or haps_t is None:
+            continue
+        if haps_c & haps_t:
+            for i in c_idx:
+                call_tp[i] = True
+                call_tp_gt[i] = True
+            for j in t_idx:
+                truth_tp[j] = True
+                truth_tp_gt[j] = True
+
+    return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
+
+
+def _gt_equivalent(calls: SideVariants, i: int, truth: SideVariants, j: int) -> bool:
+    """Same zygosity over equivalent alleles (allele indices may differ)."""
+
+    def pattern(side: SideVariants, k: int) -> tuple:
+        g = [int(a) for a in side.gt[k] if a >= 0]
+        if not g:
+            return ("any",)
+        keys = []
+        for a in sorted(g):
+            if a == 0:
+                keys.append(("ref",))
+            elif a - 1 < len(side.alts[k]):
+                keys.append(normalize_variant(int(side.pos[k]), side.ref[k], side.alts[k][a - 1]))
+        return tuple(sorted(map(str, keys)))
+
+    pc, pt = pattern(calls, i), pattern(truth, j)
+    return pc == pt or pc == ("any",) or pt == ("any",)
+
+
+def _clusters(calls: SideVariants, truth: SideVariants, un_c: np.ndarray, un_t: np.ndarray):
+    """Group leftover variants (both sides) into gap-bounded position clusters."""
+    events = [(int(calls.pos[i]), 0, int(i)) for i in un_c] + [(int(truth.pos[j]), 1, int(j)) for j in un_t]
+    events.sort()
+    cur_c: list[int] = []
+    cur_t: list[int] = []
+    last = None
+    for pos, side, idx in events:
+        if last is not None and pos - last > CLUSTER_GAP and (cur_c or cur_t):
+            yield cur_c, cur_t
+            cur_c, cur_t = [], []
+        (cur_c if side == 0 else cur_t).append(idx)
+        last = pos
+    if cur_c or cur_t:
+        yield cur_c, cur_t
+
+
+def _diploid_haplotypes(side: SideVariants, idx: list[int], lo: int, window: str) -> set | None:
+    """All {hap_a, hap_b} sequence pairs over the window, one per phasing.
+
+    Returns None when the phasing space is too large or variants overlap
+    (can't be replayed consistently).
+    """
+    hets = []
+    applied = []  # (start0, end0, alt, which) which: 2=both, 0/1 het slot
+    for k in idx:
+        g = [int(a) for a in side.gt[k] if a >= 0]
+        alleles = sorted({a for a in g if a > 0}) or ([1] if side.alts[k] else [])
+        for ai in alleles:
+            if ai - 1 >= len(side.alts[k]):
+                return None
+            alt = side.alts[k][ai - 1]
+            if alt in (".", "", "*", "<NON_REF>") or alt.startswith("<"):
+                continue
+            s0 = int(side.pos[k]) - lo
+            e0 = s0 + len(side.ref[k])
+            hom = len(g) >= 2 and g.count(ai) == len([a for a in g if a > 0]) and 0 not in g
+            if hom:
+                applied.append((s0, e0, alt, 2))
+            else:
+                applied.append((s0, e0, alt, len(hets)))
+                hets.append(k)
+    if len(hets) > MAX_HETS:
+        return None
+
+    out = set()
+    for mask in range(1 << len(hets)):
+        hap0, hap1 = [], []
+        ok = True
+        for s0, e0, alt, which in applied:
+            if which == 2:
+                hap0.append((s0, e0, alt))
+                hap1.append((s0, e0, alt))
+            else:
+                target = hap0 if (mask >> which) & 1 == 0 else hap1
+                target.append((s0, e0, alt))
+        a = _apply(window, hap0)
+        b = _apply(window, hap1)
+        if a is None or b is None:
+            ok = False
+        if ok:
+            out.add(frozenset((a, b)) if a != b else frozenset((a,)))
+    return out if out else None
+
+
+def _apply(window: str, edits: list[tuple[int, int, str]]) -> str | None:
+    """Apply non-overlapping (start0, end0, alt) edits; None on overlap/ooband."""
+    edits = sorted(edits)
+    out = []
+    cur = 0
+    for s0, e0, alt in edits:
+        if s0 < cur or e0 > len(window) or s0 < 0:
+            return None
+        out.append(window[cur:s0])
+        out.append(alt)
+        cur = e0
+    out.append(window[cur:])
+    return "".join(out)

@@ -1,0 +1,284 @@
+"""run_comparison_pipeline — compare a callset to ground truth.
+
+Drop-in surface of the reference tool (docs/run_comparison_pipeline.md):
+produces the per-chromosome-keyed concordance HDF5 (schema per
+report_data_loader.py:66-104) and the intersected-intervals BED. The
+matching engine is the native haplotype matcher
+(variantcalling_tpu.comparison.matcher) instead of an rtg vcfeval
+subprocess; annotation runs through the shared device featurization
+kernels, so classification + annotation of a 5M-variant callset is a
+handful of jitted batches rather than per-record Python.
+
+Genotype columns are stored as "a/b" strings (the h5 store is columnar);
+downstream consumers parse them with utils column helpers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.comparison.matcher import make_side, match_contig
+from variantcalling_tpu.featurize import featurize
+from variantcalling_tpu.io import bed as bedio
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.io.vcf import VariantTable, read_vcf
+from variantcalling_tpu.ops import intervals as iops
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="run_comparison_pipeline", description=run.__doc__)
+    ap.add_argument("--n_parts", type=int, default=0, help="Number of parts the VCF is split into")
+    ap.add_argument("--input_prefix", required=True, help="Prefix of the input file (or full path)")
+    ap.add_argument("--output_file", required=True, help="Output h5 file")
+    ap.add_argument("--output_interval", required=True, help="Output bed of intersected intervals")
+    ap.add_argument("--gtr_vcf", required=True, help="Ground truth VCF")
+    ap.add_argument("--cmp_intervals", help="Ranges on which to perform comparison (bed/interval_list)")
+    ap.add_argument("--highconf_intervals", required=True, help="High confidence intervals")
+    ap.add_argument("--runs_intervals", help="Runs intervals (bed/interval_list)")
+    ap.add_argument("--annotate_intervals", action="append", default=[])
+    ap.add_argument("--reference", required=True, help="Reference FASTA")
+    ap.add_argument("--reference_dict", help="(accepted for drop-in compatibility; unused)")
+    ap.add_argument("--coverage_bw_high_quality", help="BigWig coverage, high-mapq (optional)")
+    ap.add_argument("--coverage_bw_all_quality", help="BigWig coverage, all-mapq (optional)")
+    ap.add_argument("--call_sample_name", default="sm1")
+    ap.add_argument("--truth_sample_name", default="HG001")
+    ap.add_argument("--header_file", help="(accepted; unused)")
+    ap.add_argument("--filter_runs", action="store_true")
+    ap.add_argument("--hpol_filter_length_dist", nargs=2, type=int, default=[10, 10])
+    ap.add_argument("--ignore_filter_status", action="store_true")
+    ap.add_argument("--flow_order", default="TGCA")
+    ap.add_argument("--output_suffix", default="")
+    ap.add_argument("--concordance_tool", default="native", help="native haplotype matcher (VCFEVAL-equivalent)")
+    ap.add_argument("--disable_reinterpretation", action="store_true")
+    ap.add_argument("--is_mutect", action="store_true")
+    ap.add_argument("--n_jobs", type=int, default=-1, help="(accepted; XLA owns parallelism)")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def _input_path(prefix: str, n_parts: int) -> list[str]:
+    if os.path.exists(prefix):
+        return [prefix]
+    if n_parts and n_parts > 1:
+        parts = []
+        for i in range(1, n_parts + 1):
+            for ext in (f"{prefix}.{i}.vcf.gz", f"{prefix}.{i}.vcf"):
+                if os.path.exists(ext):
+                    parts.append(ext)
+                    break
+        if parts:
+            return parts
+    for ext in (prefix + ".vcf.gz", prefix + ".vcf"):
+        if os.path.exists(ext):
+            return [ext]
+    raise FileNotFoundError(f"no VCF found for prefix {prefix!r}")
+
+
+def _concat_tables(tables: list[VariantTable]) -> VariantTable:
+    if len(tables) == 1:
+        return tables[0]
+    base = tables[0]
+    kw = {}
+    for f in ("chrom", "pos", "vid", "ref", "alt", "qual", "filters", "info"):
+        kw[f] = np.concatenate([getattr(t, f) for t in tables])
+    out = VariantTable(header=base.header, **kw)
+    if base.fmt_keys is not None:
+        out.fmt_keys = np.concatenate([t.fmt_keys for t in tables])
+        out.sample_cols = np.concatenate([t.sample_cols for t in tables], axis=0)
+    return out
+
+
+def _subset(table: VariantTable, mask: np.ndarray) -> VariantTable:
+    kw = {f: getattr(table, f)[mask] for f in ("chrom", "pos", "vid", "ref", "alt", "qual", "filters", "info")}
+    out = VariantTable(header=table.header, **kw)
+    if table.fmt_keys is not None:
+        out.fmt_keys = table.fmt_keys[mask]
+        out.sample_cols = table.sample_cols[mask]
+    return out
+
+
+def _gt_strings(table: VariantTable) -> list[str]:
+    gts = table.genotypes()
+    return ["/".join(str(a) if a >= 0 else "." for a in g) for g in gts]
+
+
+def _restrict(table: VariantTable, intervals: bedio.IntervalSet) -> VariantTable:
+    if intervals is None or len(intervals) == 0:
+        return table
+    mask = intervals.contains(np.asarray(table.chrom), table.pos - 1)
+    return _subset(table, np.asarray(mask))
+
+
+def build_concordance_frame(
+    calls: VariantTable,
+    truth: VariantTable,
+    fasta: FastaReader,
+    annotate_intervals: dict[str, bedio.IntervalSet] | None = None,
+    runs_intervals: bedio.IntervalSet | None = None,
+    hpol_length: int = 10,
+    hpol_dist: int = 10,
+    flow_order: str = "TGCA",
+    is_mutect: bool = False,
+) -> pd.DataFrame:
+    """Match + annotate -> one concordance DataFrame over calls ∪ FN-truth."""
+    contigs = list(dict.fromkeys(list(calls.chrom) + list(truth.chrom)))
+    call_tp = np.zeros(len(calls), dtype=bool)
+    call_tp_gt = np.zeros(len(calls), dtype=bool)
+    truth_tp = np.zeros(len(truth), dtype=bool)
+    truth_tp_gt = np.zeros(len(truth), dtype=bool)
+    call_truth_gt = np.full(len(calls), "./.", dtype=object)
+
+    for contig in contigs:
+        cm = np.asarray(calls.chrom) == contig
+        tm = np.asarray(truth.chrom) == contig
+        if contig not in fasta.references:
+            continue
+        seq = fasta.fetch(contig, 0, fasta.get_reference_length(contig))
+        cs = make_side(calls.pos[cm], list(calls.ref[cm]),
+                       [a.split(",") if a not in (".", "") else [] for a in calls.alt[cm]],
+                       calls.genotypes()[cm])
+        ts = make_side(truth.pos[tm], list(truth.ref[tm]),
+                       [a.split(",") if a not in (".", "") else [] for a in truth.alt[tm]],
+                       truth.genotypes()[tm])
+        res = match_contig(cs, ts, seq)
+        call_tp[cm] = res.call_tp
+        call_tp_gt[cm] = res.call_tp_gt
+        truth_tp[tm] = res.truth_tp
+        truth_tp_gt[tm] = res.truth_tp_gt
+        t_gt = np.asarray(_gt_strings(_subset(truth, tm)), dtype=object) if tm.any() else np.array([], object)
+        matched = res.call_truth_idx >= 0
+        sub = call_truth_gt[cm]
+        sub[matched] = t_gt[res.call_truth_idx[matched]]
+        call_truth_gt[cm] = sub
+
+    fn_mask = ~truth_tp
+    fn_truth = _subset(truth, fn_mask)
+
+    frames = []
+    for table, is_call in ((calls, True), (fn_truth, False)):
+        if len(table) == 0:
+            continue
+        fs = featurize(table, fasta, annotate_intervals=annotate_intervals, flow_order=flow_order,
+                       extra_info_fields=["TLOD"] if is_mutect else [])
+        cols: dict[str, np.ndarray] = {
+            "chrom": np.asarray(table.chrom),
+            "pos": table.pos,
+            "ref": np.asarray(table.ref),
+            "alleles": np.asarray(table.alt),
+            "qual": np.nan_to_num(table.qual, nan=0.0),
+            "filter": _filters_norm(table),
+        }
+        for f in ("dp", "af", "gq", "indel_length", "hmer_indel_length", "hmer_indel_nuc",
+                  "gc_content", "left_motif", "right_motif", "cycleskip_status", "sor"):
+            cols[f] = np.asarray(fs.columns[f])
+        cols["vaf"] = cols.pop("af")
+        cols["indel"] = np.asarray(fs.columns["is_indel"], dtype=bool)
+        ic = np.full(len(table), None, dtype=object)
+        ic[np.asarray(fs.columns["is_ins"], dtype=bool)] = "ins"
+        ic[cols["indel"] & ~np.asarray(fs.columns["is_ins"], dtype=bool)] = "del"
+        cols["indel_classify"] = ic
+        cols["tree_score"] = table.info_field("TREE_SCORE")
+        cols["ad"] = [",".join(f"{int(v)}" for v in row if v >= 0) for row in table.format_numeric("AD")]
+        for name in (annotate_intervals or {}):
+            cols[name] = np.asarray(fs.columns[name], dtype=bool)
+        if is_call:
+            cols["classify"] = np.where(call_tp, "tp", "fp")
+            cols["classify_gt"] = np.where(call_tp_gt, "tp", "fp")
+            cols["call"] = np.where(call_tp, "TP", "FP")
+            cols["base"] = np.where(call_tp, "TP", None)
+            cols["gt_ultima"] = np.asarray(_gt_strings(table), dtype=object)
+            cols["gt_ground_truth"] = call_truth_gt
+        else:
+            cols["classify"] = np.full(len(table), "fn", dtype=object)
+            cols["classify_gt"] = np.full(len(table), "fn", dtype=object)
+            cols["call"] = np.full(len(table), "NA", dtype=object)
+            cols["base"] = np.full(len(table), "FN", dtype=object)
+            cols["gt_ultima"] = np.full(len(table), "./.", dtype=object)
+            cols["gt_ground_truth"] = np.asarray(_gt_strings(table), dtype=object)
+        cols["blacklst"] = np.full(len(table), "", dtype=object)
+        frames.append(pd.DataFrame(cols))
+
+    df = pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+    if len(df):
+        df = df.sort_values(["chrom", "pos"], kind="stable").reset_index(drop=True)
+        if runs_intervals is not None and len(runs_intervals):
+            keep = (runs_intervals.end - runs_intervals.start) >= hpol_length
+            runs = bedio.IntervalSet(runs_intervals.chrom[keep], runs_intervals.start[keep],
+                                     runs_intervals.end[keep])
+            contig_lengths = {c: fasta.get_reference_length(c) for c in fasta.references}
+            coords = iops.GenomeCoords(contig_lengths)
+            gpos = coords.globalize(df["chrom"].to_numpy(), df["pos"].to_numpy() - 1)
+            if len(runs):
+                gs, ge = coords.globalize_intervals(runs)
+                df["hpol_run"] = np.asarray(iops.distance_to_nearest(gpos, gs, ge) <= hpol_dist)
+            else:
+                df["hpol_run"] = False
+        else:
+            df["hpol_run"] = False
+    return df
+
+
+def _filters_norm(table: VariantTable) -> np.ndarray:
+    return np.asarray(["PASS" if f in (".", "", None) else f for f in table.filters], dtype=object)
+
+
+def run(argv: list[str]) -> int:
+    """Compare VCF to ground truth."""
+    args = parse_args(argv)
+    import logging
+
+    logger.setLevel(getattr(logging, args.verbosity))
+
+    paths = _input_path(args.input_prefix, args.n_parts)
+    logger.info("reading calls: %s", paths)
+    calls = _concat_tables([read_vcf(p) for p in paths])
+    truth = read_vcf(args.gtr_vcf)
+
+    highconf = bedio.read_intervals(args.highconf_intervals)
+    region = highconf
+    if args.cmp_intervals:
+        region = highconf.intersect(bedio.read_intervals(args.cmp_intervals))
+    bedio.write_bed(args.output_interval, region)
+
+    calls = _restrict(calls, region)
+    truth = _restrict(truth, region)
+    logger.info("restricted to %d calls, %d truth variants", len(calls), len(truth))
+
+    annotate = {}
+    for path in args.annotate_intervals:
+        name = os.path.basename(path)
+        for suf in (".gz", ".bed", ".interval_list"):
+            name = name[: -len(suf)] if name.endswith(suf) else name
+        annotate[name] = bedio.read_intervals(path)
+    runs = bedio.read_intervals(args.runs_intervals) if args.runs_intervals else None
+
+    with FastaReader(args.reference) as fasta:
+        df = build_concordance_frame(
+            calls, truth, fasta,
+            annotate_intervals=annotate,
+            runs_intervals=runs,
+            hpol_length=args.hpol_filter_length_dist[0],
+            hpol_dist=args.hpol_filter_length_dist[1],
+            flow_order=args.flow_order,
+            is_mutect=args.is_mutect,
+        )
+
+    first = True
+    for contig in dict.fromkeys(df["chrom"].tolist()) if len(df) else []:
+        write_hdf(df[df["chrom"] == contig], args.output_file, key=str(contig), mode="w" if first else "a")
+        first = False
+    if len(df) == 0:
+        write_hdf(df, args.output_file, key="all", mode="w")
+    logger.info("wrote %d rows to %s", len(df), args.output_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -19,6 +19,9 @@ import numpy as np
 import pandas as pd
 
 _FORMAT_ATTR = "vctpu_frame"
+# h5py VLEN strings reject NUL bytes; \x01 framing keeps the sentinel
+# unambiguous against real data
+_NULL = "\x01null\x01"
 
 
 def _encode_column(vals: np.ndarray):
@@ -32,7 +35,7 @@ def _encode_column(vals: np.ndarray):
         return (flat, offsets), "ragged"
     if vals.dtype == object or vals.dtype.kind in ("U", "S"):
         out = np.array(
-            ["\0" if v is None or (isinstance(v, float) and np.isnan(v)) else str(v) for v in vals],
+            [_NULL if v is None or (isinstance(v, float) and np.isnan(v)) else str(v) for v in vals],
             dtype=object,
         )
         return out, "str"
@@ -52,7 +55,7 @@ def _decode_column(ds, kind: str) -> np.ndarray:
     data = ds[()]
     if kind == "str":
         out = np.array([v.decode() if isinstance(v, bytes) else str(v) for v in data], dtype=object)
-        return np.where(out == "\0", None, out)
+        return np.where(out == _NULL, None, out)
     if kind == "bool":
         return data.astype(bool)
     return data
