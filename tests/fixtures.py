@@ -139,3 +139,46 @@ def write_vcf(
     else:
         with open(path, "wt") as fh:
             fh.write(text)
+
+
+def write_bam(path: str, contigs: dict[str, int], reads: list[dict]) -> None:
+    """Minimal BAM writer for reader/coverage tests.
+
+    Each read dict: contig (name), pos (0-based), cigar [(op_char, len)],
+    optional mapq (60), flag (0), quals (list[int], default 30s).
+    """
+    import struct
+
+    ops = "MIDNSHP=X"
+    names = list(contigs)
+    body = bytearray()
+    body += b"BAM\x01"
+    text = b"@HD\tVN:1.6\n" + b"".join(
+        f"@SQ\tSN:{n}\tLN:{l}\n".encode() for n, l in contigs.items()
+    )
+    body += struct.pack("<i", len(text)) + text
+    body += struct.pack("<i", len(names))
+    for n in names:
+        nb = n.encode() + b"\x00"
+        body += struct.pack("<i", len(nb)) + nb + struct.pack("<i", contigs[n])
+    for r in reads:
+        cigar = r["cigar"]
+        read_len = sum(l for op, l in cigar if op in "MIS=X")
+        quals = r.get("quals", [30] * read_len)
+        name = r.get("name", "r").encode() + b"\x00"
+        rec = bytearray()
+        rec += struct.pack("<i", names.index(r["contig"]))
+        rec += struct.pack("<i", r["pos"])
+        mapq = r.get("mapq", 60)
+        rec += struct.pack("<I", (4680 << 16) | (mapq << 8) | len(name))
+        rec += struct.pack("<I", (r.get("flag", 0) << 16) | len(cigar))
+        rec += struct.pack("<i", read_len)
+        rec += struct.pack("<iii", -1, -1, 0)
+        rec += name
+        for op, l in cigar:
+            rec += struct.pack("<I", (l << 4) | ops.index(op))
+        rec += b"\xff" * ((read_len + 1) // 2)  # seq nibbles (N)
+        rec += bytes(quals[:read_len])
+        body += struct.pack("<i", len(rec)) + rec
+    with gzip.open(path, "wb") as fh:
+        fh.write(bytes(body))

