@@ -1,0 +1,60 @@
+"""correct_systematic_errors — filter callset loci matching cohort noise.
+
+Reference surface: ugbio_filtering sec correct_systematic_errors
+(ugvc/__main__.py:19,56; behavior per SURVEY §2.3 and the report-side
+contract report_utils.py:71-75 — corrected variants carry "SEC"). For
+every call at a DB locus, the batched multinomial likelihood-ratio kernel
+decides whether the observed allele counts look like the cohort noise; if
+so the FILTER gains ``SEC`` and INFO gains the ratio (``SEC_RATIO``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+from variantcalling_tpu.sec.caller import DEFAULT_NOISE_RATIO, correct_calls
+from variantcalling_tpu.sec.db import SecDb
+
+SEC = "SEC"
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="correct_systematic_errors", description=run.__doc__)
+    ap.add_argument("--relevant_coords", help="(accepted; DB already carries its loci)")
+    ap.add_argument("--model", required=True, help="SEC DB h5 (from sec_training)")
+    ap.add_argument("--gvcf", required=True, help="input callset/gVCF")
+    ap.add_argument("--output_file", required=True, help="corrected VCF (.vcf/.vcf.gz)")
+    ap.add_argument("--noise_ratio_threshold", type=float, default=DEFAULT_NOISE_RATIO)
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv: list[str]) -> int:
+    """Correct systematic errors using a cohort noise database."""
+    args = parse_args(argv)
+    db = SecDb.load(args.model)
+    table = read_vcf(args.gvcf)
+    is_sec, ratios = correct_calls(table, db, args.noise_ratio_threshold)
+
+    table.header.ensure_filter(SEC, "Matches cohort systematic-error (noise) distribution")
+    table.header.ensure_info("SEC_RATIO", "1", "Float", "Noise-vs-best-fit multinomial likelihood ratio")
+    new_filters = np.array(
+        [
+            SEC if s and f in ("PASS", ".", "", None) else (f"{f};{SEC}" if s else f)
+            for s, f in zip(is_sec, table.filters)
+        ],
+        dtype=object,
+    )
+    extra = {"SEC_RATIO": np.where(is_sec, ratios.astype(np.float64), np.nan)}
+    write_vcf(args.output_file, table, new_filters=new_filters, extra_info=extra)
+    logger.info("%d/%d records marked %s -> %s", int(is_sec.sum()), len(table), SEC, args.output_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,87 @@
+"""sec_training — build the SEC noise DB from a cohort of callsets.
+
+Reference surface: ugbio_filtering sec_training (registered at
+ugvc/__main__.py:19; internals missing — behavior re-derived per SURVEY
+§2.3). Input: per-sample VCFs (gVCF/callset with FORMAT/AD) + the loci of
+interest (BED of known-noisy positions, or every locus seen in >=
+min_samples samples). Per sample, allele counts at each locus; cohort
+aggregation is a device all-reduce over the sample axis (sec.aggregate)
+when a mesh is available, host merge otherwise. Output: SecDb h5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io import bed as bedio
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.sec.caller import observed_allele_counts
+from variantcalling_tpu.sec.db import SecDb, merge_sample_counts, pack_keys
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="sec_training", description=run.__doc__)
+    ap.add_argument("--inputs", nargs="+", required=True, help="per-sample VCFs (the cohort)")
+    ap.add_argument("--relevant_coords", help="BED of loci to model (default: union of cohort calls)")
+    ap.add_argument("--output_file", required=True, help="SEC DB h5")
+    ap.add_argument("--min_samples", type=int, default=2,
+                    help="keep loci observed in at least this many samples")
+    ap.add_argument("--use_mesh", action="store_true",
+                    help="aggregate per-sample tensors with a mesh all-reduce")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv: list[str]) -> int:
+    """Build a cohort systematic-error (noise) database."""
+    args = parse_args(argv)
+    region = bedio.read_intervals(args.relevant_coords) if args.relevant_coords else None
+
+    contigs: list[str] = []
+    per_sample = []
+    seen_count: dict[int, int] = {}
+    for path in args.inputs:
+        table = read_vcf(path)
+        for c in table.header.contigs or dict.fromkeys(table.chrom.tolist()):
+            if c not in contigs:
+                contigs.append(c)
+        mask = np.ones(len(table), dtype=bool)
+        if region is not None and len(region):
+            mask = np.asarray(region.contains(np.asarray(table.chrom), table.pos - 1))
+        keys = pack_keys(contigs, np.asarray(table.chrom)[mask], table.pos[mask])
+        counts = observed_allele_counts(table)[mask]
+        order = np.argsort(keys)
+        keys, counts = keys[order], counts[order]
+        per_sample.append((keys, counts))
+        for k in keys.tolist():
+            seen_count[k] = seen_count.get(k, 0) + 1
+        logger.info("%s: %d loci", path, len(keys))
+
+    if args.use_mesh and per_sample:
+        # dense (S, L, A) over the union of loci -> one mesh psum
+        from variantcalling_tpu.parallel.mesh import make_mesh
+        from variantcalling_tpu.sec.aggregate import aggregate_on_mesh
+
+        all_keys = np.unique(np.concatenate([k for k, _ in per_sample]))
+        dense = np.zeros((len(per_sample), len(all_keys), per_sample[0][1].shape[1]), dtype=np.float32)
+        for s, (keys, counts) in enumerate(per_sample):
+            dense[s, np.searchsorted(all_keys, keys)] = counts
+        total = aggregate_on_mesh(dense, make_mesh())
+        db = SecDb(contigs=contigs, keys=all_keys, counts=total.astype(np.float32),
+                   n_samples=len(per_sample))
+    else:
+        db = merge_sample_counts(contigs, per_sample)
+
+    keep = np.asarray([seen_count.get(int(k), 0) >= args.min_samples for k in db.keys])
+    db = SecDb(contigs=db.contigs, keys=db.keys[keep], counts=db.counts[keep], n_samples=db.n_samples)
+    db.save(args.output_file)
+    logger.info("SEC DB: %d loci from %d samples -> %s", len(db), db.n_samples, args.output_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

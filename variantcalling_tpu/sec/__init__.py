@@ -1,0 +1,1 @@
+"""SEC — systematic error correction: cohort noise DB + per-locus testing."""

@@ -1,0 +1,44 @@
+"""Cohort count aggregation as a mesh all-reduce (BASELINE config 5).
+
+The reference's SEC training walks per-sample files on one box; at pod
+scale the natural layout is samples sharded across devices and the cohort
+noise tensor produced by one ``psum`` over the sample axis — ICI-resident,
+no host gather. ``aggregate_on_mesh`` is that program: a shard_map whose
+per-device body sums its local sample slab and psums across the mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from variantcalling_tpu.parallel.mesh import DATA_AXIS
+
+
+def aggregate_on_mesh(sample_counts: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """(S, L, A) per-sample count tensors -> (L, A) cohort sum via psum.
+
+    Samples shard over the mesh data axis (padded to a multiple); the
+    result is replicated on every device.
+    """
+    s = sample_counts.shape[0]
+    n_dev = mesh.shape[DATA_AXIS]
+    pad = (-s) % n_dev
+    if pad:
+        sample_counts = np.concatenate(
+            [sample_counts, np.zeros((pad, *sample_counts.shape[1:]), sample_counts.dtype)], axis=0
+        )
+    arr = jax.device_put(jnp.asarray(sample_counts), NamedSharding(mesh, P(DATA_AXIS, None, None)))
+
+    @jax.jit
+    def reduce(x):
+        return jax.lax.with_sharding_constraint(
+            jnp.sum(x, axis=0, dtype=jnp.float32), NamedSharding(mesh, P(None, None))
+        )
+
+    with mesh:
+        out = reduce(arr)
+    return np.asarray(out)

@@ -1,0 +1,67 @@
+"""SEC correction: score observed allele counts against the cohort noise DB.
+
+For every callset variant at a DB locus the test is the batched multinomial
+likelihood ratio (ops/stats, parity ugvc/utils/stats_utils.py:48-70): how
+likely are the observed AD counts under the cohort noise distribution,
+relative to their own best fit? High ratio -> the observation looks like
+the systematic noise seen across the cohort -> the call is corrected
+(FILTER gains SEC, report-side re-filtering per report_utils.py:71-75).
+One jitted kernel scores the whole callset; no per-locus scipy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from variantcalling_tpu.ops.stats import multinomial_log_pmf, correct_multinomial_frequencies
+from variantcalling_tpu.sec.db import N_ALLELE_SLOTS, SecDb
+
+DEFAULT_NOISE_RATIO = 0.1  # ratio above this -> noise-consistent -> SEC
+
+
+@jax.jit
+def noise_likelihood_ratio(observed: jnp.ndarray, noise_counts: jnp.ndarray) -> jnp.ndarray:
+    """(N,) likelihood ratio of observed (N, A) counts under noise (N, A)."""
+    p_noise = correct_multinomial_frequencies(noise_counts)
+    log_l = multinomial_log_pmf(observed, p_noise)
+    log_max = multinomial_log_pmf(observed, correct_multinomial_frequencies(observed))
+    return jnp.exp(log_l - log_max)
+
+
+def observed_allele_counts(table, max_alts: int = N_ALLELE_SLOTS - 2) -> np.ndarray:
+    """(N, N_ALLELE_SLOTS) counts from FORMAT/AD: ref, alt1..alt3, other."""
+    ad = table.format_numeric("AD")
+    n = len(table)
+    out = np.zeros((n, N_ALLELE_SLOTS), dtype=np.float32)
+    if ad.shape[1] == 0:
+        return out
+    valid = np.where(ad >= 0, ad, 0.0)
+    out[:, 0] = valid[:, 0] if ad.shape[1] > 0 else 0
+    k = min(max_alts, ad.shape[1] - 1)
+    if k > 0:
+        out[:, 1 : 1 + k] = valid[:, 1 : 1 + k]
+    if ad.shape[1] - 1 > max_alts:
+        out[:, -1] = valid[:, 1 + max_alts :].sum(axis=1)
+    return out
+
+
+def correct_calls(
+    table,
+    db: SecDb,
+    noise_ratio_threshold: float = DEFAULT_NOISE_RATIO,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(is_sec bool per record, likelihood ratio float per record)."""
+    hit, rows = db.lookup(np.asarray(table.chrom), table.pos)
+    ratios = np.zeros(len(table), dtype=np.float32)
+    if not hit.any() or len(db) == 0:
+        return np.zeros(len(table), dtype=bool), ratios
+    obs = observed_allele_counts(table)[hit]
+    noise = db.counts[rows[hit]]
+    r = np.asarray(noise_likelihood_ratio(jnp.asarray(obs), jnp.asarray(noise)))
+    ratios[hit] = r
+    is_sec = np.zeros(len(table), dtype=bool)
+    is_sec[hit] = r > noise_ratio_threshold
+    return is_sec, ratios

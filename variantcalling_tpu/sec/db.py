@@ -1,0 +1,91 @@
+"""SEC systematic-error database: per-locus cohort allele-count distributions.
+
+Re-derivation of the reference's SEC model (missing ugbio_filtering.sec
+submodule; statistical basis = the multinomial likelihood machinery the
+reference keeps in ugvc/utils/stats_utils.py:12-70, orphaned test resource
+names: "merge_conditional_allele_distributions"). The DB stores, for every
+known-noisy locus, the cohort-aggregated allele-count distribution observed
+in samples that do NOT carry a real variant there — the noise fingerprint.
+
+Layout is columnar and device-ready: packed (contig_idx << 40 | pos) int64
+locus keys, an (L, A) count tensor (A = ref + 3 alt slots + other), and
+sample counts — so correction scores millions of loci as one batched
+kernel, and cohort building is an all-reduce over per-sample tensors
+(BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import h5py
+import numpy as np
+
+N_ALLELE_SLOTS = 5  # ref, alt1, alt2, alt3, other
+
+
+@dataclass
+class SecDb:
+    contigs: list[str]  # contig name per index used in keys
+    keys: np.ndarray  # int64 (L,) sorted packed (contig_idx << 40) | pos(1-based)
+    counts: np.ndarray  # float32 (L, N_ALLELE_SLOTS) cohort noise allele counts
+    n_samples: int
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def contig_index(self) -> dict[str, int]:
+        return {c: i for i, c in enumerate(self.contigs)}
+
+    def lookup(self, chrom: np.ndarray, pos: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(hit mask, row index) for (chrom, 1-based pos) arrays."""
+        cmap = self.contig_index()
+        cidx = np.fromiter((cmap.get(c, -1) for c in chrom), dtype=np.int64, count=len(chrom))
+        key = (cidx << 40) | np.asarray(pos, dtype=np.int64)
+        if len(self.keys) == 0:
+            return np.zeros(len(chrom), dtype=bool), np.zeros(len(chrom), dtype=np.int64)
+        loc = np.minimum(np.searchsorted(self.keys, key), len(self.keys) - 1)
+        hit = (self.keys[loc] == key) & (cidx >= 0)
+        return hit, loc
+
+    def save(self, path: str) -> None:
+        with h5py.File(path, "w") as f:
+            f.attrs["n_samples"] = self.n_samples
+            dt = h5py.string_dtype()
+            f.create_dataset("contigs", data=np.asarray(self.contigs, dtype=dt), dtype=dt)
+            f.create_dataset("keys", data=self.keys)
+            f.create_dataset("counts", data=self.counts)
+
+    @staticmethod
+    def load(path: str) -> "SecDb":
+        with h5py.File(path, "r") as f:
+            contigs = [c.decode() if isinstance(c, bytes) else str(c) for c in f["contigs"][()]]
+            return SecDb(
+                contigs=contigs,
+                keys=f["keys"][()],
+                counts=f["counts"][()],
+                n_samples=int(f.attrs["n_samples"]),
+            )
+
+
+def pack_keys(contigs: list[str], chrom: np.ndarray, pos: np.ndarray) -> np.ndarray:
+    cmap = {c: i for i, c in enumerate(contigs)}
+    cidx = np.fromiter((cmap[c] for c in chrom), dtype=np.int64, count=len(chrom))
+    return (cidx << 40) | np.asarray(pos, dtype=np.int64)
+
+
+def merge_sample_counts(
+    contigs: list[str],
+    per_sample: list[tuple[np.ndarray, np.ndarray]],  # (keys, (l, A) counts) per sample
+) -> SecDb:
+    """Union of loci; summed counts — the host-side (DCN-scale) merge.
+
+    Device-side cohort aggregation over a mesh lives in sec.aggregate;
+    this entry point merges pre-reduced per-sample (or per-host) tables.
+    """
+    all_keys = np.unique(np.concatenate([k for k, _ in per_sample])) if per_sample else np.array([], np.int64)
+    counts = np.zeros((len(all_keys), N_ALLELE_SLOTS), dtype=np.float32)
+    for keys, c in per_sample:
+        idx = np.searchsorted(all_keys, keys)
+        np.add.at(counts, idx, c.astype(np.float32))
+    return SecDb(contigs=list(contigs), keys=all_keys, counts=counts, n_samples=len(per_sample))
