@@ -1,0 +1,55 @@
+import numpy as np
+
+from tests.fixtures import write_vcf
+
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.pipelines import correct_genotypes_by_imputation as cgi
+
+
+def test_imputation_pipeline_end_to_end(tmp_path):
+    contigs = {"chr1": 10000}
+    ds_def = ['##FORMAT=<ID=DS,Number=A,Type=Float,Description="Dosage">']
+    recs = []
+    # het call, hom imputation, weak PL margin -> should flip to 1/1
+    recs.append({"chrom": "chr1", "pos": 100, "ref": "A", "alts": ["G"], "qual": 50.0,
+                 "gt": (0, 1), "gq": 5, "pl": (30, 0, 5)})
+    # het call, het imputation -> unchanged
+    recs.append({"chrom": "chr1", "pos": 200, "ref": "C", "alts": ["T"], "qual": 50.0,
+                 "gt": (0, 1), "gq": 40, "pl": (40, 0, 40)})
+    # no DS annotation -> passthrough untouched
+    recs.append({"chrom": "chr1", "pos": 300, "ref": "G", "alts": ["A"], "qual": 50.0,
+                 "gt": (1, 1), "gq": 30, "pl": (50, 20, 0)})
+    in_vcf = str(tmp_path / "in.vcf")
+    write_vcf(in_vcf, recs, contigs, extra_info_defs=ds_def)
+    # append DS to the first two records' FORMAT
+    lines = open(in_vcf).read().splitlines()
+    out_lines = []
+    for ln in lines:
+        if ln.startswith("chr1\t100"):
+            parts = ln.split("\t")
+            parts[8] += ":DS"
+            parts[9] += ":2.0"
+            ln = "\t".join(parts)
+        elif ln.startswith("chr1\t200"):
+            parts = ln.split("\t")
+            parts[8] += ":DS"
+            parts[9] += ":1.0"
+            ln = "\t".join(parts)
+        out_lines.append(ln)
+    open(in_vcf, "w").write("\n".join(out_lines) + "\n")
+
+    out_vcf = str(tmp_path / "out.vcf")
+    rc = cgi.run(["--beagle_annotated_vcf", in_vcf, "--output_vcf", out_vcf])
+    assert rc == 0
+
+    out = read_vcf(out_vcf)
+    gt = out.format_field("GT")
+    assert gt[0] == "1/1"  # flipped
+    assert gt[1] == "0/1"  # unchanged
+    assert gt[2] == "1/1"  # passthrough
+    gt0 = out.format_field("GT0")
+    assert gt0[0] == "0|1"  # original preserved
+    assert gt0[2] is None  # untouched record carries no GT0
+    stats = open(str(tmp_path / "out_counts.csv")).read()
+    assert "changed_gt" in stats.splitlines()[0]
+    assert ",1" in stats  # one changed genotype counted

@@ -1,0 +1,83 @@
+"""Imputation-weighted PL/GQ/GT rewrite as a batched device kernel.
+
+Parity target: ``modify_stats_with_imp`` + ``_convert_ds_to_genotype_
+imputation_priors`` (correct_genotypes_by_imputation.py:189-251) — the
+reference computes this per record in pure numpy ("trivially batchable to
+vmap", SURVEY §3.5). Here it is exactly that: one jitted vmap over a
+(variants, G) PL tensor per alt-count group, with the genotype-ordering
+table baked in as a static constant.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from variantcalling_tpu.ops.genotypes import genotype_ordering
+
+
+def genotype_priors(ds: jnp.ndarray, gt_table: jnp.ndarray, epsilon: float) -> jnp.ndarray:
+    """(G,) per-genotype imputation prior from (A,) allele dosages.
+
+    f_het = clip(2 - ds, eps, 1-eps); f_hom = clip(max(ds,1) - 1, eps,
+    1-eps); per allele the prior applies to genotypes carrying it (hom vs
+    het), per genotype the max over its alleles wins (missing DS -> eps),
+    and hom-ref keeps prior 1 (:205-206).
+    """
+    f_het = jnp.clip(2.0 - ds, epsilon, 1.0 - epsilon)
+    f_hom = jnp.clip(jnp.maximum(ds, 1.0) - 1.0, epsilon, 1.0 - epsilon)
+    allele_ids = jnp.arange(1, ds.shape[0] + 1)  # (A,)
+    has = (gt_table[:, :, None] == allele_ids[None, None, :]).any(axis=1)  # (G, A)
+    is_hom = gt_table[:, 0] == gt_table[:, 1]  # (G,)
+    f_allele = jnp.where(
+        has,
+        jnp.where(is_hom[:, None], f_hom[None, :], f_het[None, :]),
+        jnp.nan,
+    )
+    f_gt = jnp.max(jnp.nan_to_num(f_allele, nan=epsilon), axis=1)
+    return f_gt.at[0].set(1.0)
+
+
+@partial(jax.jit, static_argnames=("num_alt", "epsilon"))
+def modify_stats_with_imp_batch(
+    pl: jnp.ndarray,  # (N, G) phred likelihoods
+    ds: jnp.ndarray,  # (N, A) allele dosages (nan = missing)
+    gt_idx: jnp.ndarray,  # (N,) current genotype index into genotype_ordering
+    num_alt: int,
+    epsilon: float = 0.01,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(new_pl (N, G) int32, new_gq (N,) int32, new_gt_idx (N,) int32)."""
+    gt_table = jnp.asarray(genotype_ordering(num_alt))
+
+    def one(pl_row, ds_row, cur_idx):
+        f_gt = genotype_priors(ds_row, gt_table, epsilon)
+        unphred = jnp.power(10.0, -pl_row / 10.0)
+        pl_f = unphred * f_gt
+        alt_sum_u = jnp.sum(unphred[1:])
+        alt_sum_f = jnp.maximum(jnp.sum(pl_f[1:]), 1e-300)
+        scaled = jnp.concatenate([unphred[:1], alt_sum_u / alt_sum_f * pl_f[1:]])
+        phredded = -10.0 * jnp.log10(jnp.maximum(scaled, 1e-300))
+        min_pl = jnp.min(phredded)
+        # tie rule (:243-247): keep the current GT when its new PL equals the min
+        keep = phredded[cur_idx] == min_pl
+        new_idx = jnp.where(keep, cur_idx, jnp.argmin(phredded))
+        new_pl = jnp.rint(phredded - min_pl).astype(jnp.int32)
+        two_smallest = jax.lax.top_k(-new_pl, 2)[0]
+        new_gq = (-two_smallest[1]) - (-two_smallest[0])
+        return new_pl, new_gq.astype(jnp.int32), new_idx.astype(jnp.int32)
+
+    return jax.vmap(one)(pl, ds, gt_idx)
+
+
+def gt_to_index(gt: np.ndarray, num_alt: int) -> np.ndarray:
+    """(N, 2) genotype pairs -> row index in genotype_ordering(num_alt)."""
+    table = genotype_ordering(num_alt)
+    lut = {tuple(row): i for i, row in enumerate(table.tolist())}
+    return np.asarray(
+        [lut.get((int(min(a, b)), int(max(a, b))), 0) for a, b in gt],
+        dtype=np.int32,
+    )

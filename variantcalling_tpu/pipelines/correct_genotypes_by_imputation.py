@@ -1,0 +1,142 @@
+"""correct_genotypes_by_imputation — imputation-weighted PL/GQ/GT rewrite.
+
+Reference behavior (correct_genotypes_by_imputation.py:361-492): subset ->
+high-GQ filter -> beagle -> collapse -> annotate FORMAT/DS -> per-record
+PL update. The beagle stages are external Java plumbing the reference
+shells out to; this tool TPU-izes the hot loop (SURVEY §3.5: the PL update
+is "trivially batchable to vmap") and consumes a beagle-annotated VCF
+directly via ``--beagle_annotated_vcf``. PASS records with a called alt
+genotype and FORMAT/DS get new PL/GQ/GT (old values preserved as
+PL0/GQ0/GT0, :281-303); batching groups records by alt count so every
+group is one fused kernel call. A stats csv mirrors the reference's
+counter categories (:276, 455-473).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+from variantcalling_tpu.ops.genotypes import genotype_ordering, n_genotypes
+from variantcalling_tpu.ops.imputation import gt_to_index, modify_stats_with_imp_batch
+
+import jax.numpy as jnp
+
+MAX_ALTS = 3
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="correct_genotypes_by_imputation", description=run.__doc__)
+    ap.add_argument("--beagle_annotated_vcf", required=True,
+                    help="VCF annotated with beagle FORMAT/DS (the reference's beagle_anno stage output)")
+    ap.add_argument("--output_vcf", required=True)
+    ap.add_argument("--epsilon", type=float, default=0.01,
+                    help="imputation weight in the new PL (0..1)")
+    ap.add_argument("--stats_file", default=None)
+    ap.add_argument("--add_imp_effect", action="store_true")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv: list[str]) -> int:
+    """Correct a vcf based on imputation."""
+    args = parse_args(argv)
+    table = read_vcf(args.beagle_annotated_vcf)
+    n = len(table)
+
+    gts = table.genotypes()
+    n_alts = table.n_alts()
+    ds_raw = table.format_numeric("DS", missing=np.nan)
+    has_ds = np.array([r is not None for r in table.format_field("DS")])
+    is_pass = np.array([f in ("PASS", ".", "") for f in table.filters])
+    has_alt = (gts > 0).any(axis=1)
+    eligible = is_pass & has_alt & has_ds & (n_alts >= 1) & (n_alts <= MAX_ALTS)
+
+    # outputs default to passthrough
+    new_gt_str = np.array([None] * n, dtype=object)
+    new_gq = np.full(n, -1, dtype=np.int64)
+    new_pl_str = np.array([None] * n, dtype=object)
+    counters: dict[str, dict] = defaultdict(
+        lambda: {"pass": 0, "has_non_ref_imp": 0, "imp_has_different_gt": 0, "changed_gt": 0}
+    )
+    vtypes = np.where(n_alts > 1, "multi", np.where(
+        np.array([len(r) == len(a.split(",")[0]) if a not in (".", "") else True
+                  for r, a in zip(table.ref, table.alt)]), "snp", "indel"))
+    for i in np.nonzero(is_pass & has_alt)[0]:
+        counters[vtypes[i]]["pass"] += 1
+
+    changed = 0
+    for num_alt in range(1, MAX_ALTS + 1):
+        m = eligible & (n_alts == num_alt)
+        if not m.any():
+            continue
+        g = n_genotypes(num_alt)
+        pl = table.format_numeric("PL", max_len=g, missing=np.nan)[m]
+        ok = ~np.isnan(pl).any(axis=1)
+        idx = np.nonzero(m)[0][ok]
+        if len(idx) == 0:
+            continue
+        pl = pl[ok]
+        ds = ds_raw[m][ok][:, :num_alt] if ds_raw.shape[1] >= num_alt else np.full((len(idx), num_alt), np.nan)
+        cur_idx = gt_to_index(gts[idx], num_alt)
+        npl, ngq, nidx = modify_stats_with_imp_batch(
+            jnp.asarray(pl), jnp.asarray(ds), jnp.asarray(cur_idx), num_alt, args.epsilon
+        )
+        npl, ngq, nidx = np.asarray(npl), np.asarray(ngq), np.asarray(nidx)
+        gt_table = genotype_ordering(num_alt)
+        for row, i in enumerate(idx):
+            vt = vtypes[i]
+            counters[vt]["has_non_ref_imp"] += 1
+            imp_is_hom = bool(np.nanmax(ds[row]) >= 1.5) if not np.isnan(ds[row]).all() else False
+            gt_is_hom = gts[i, 0] == gts[i, 1]
+            if imp_is_hom != gt_is_hom:
+                counters[vt]["imp_has_different_gt"] += 1
+            new_pair = tuple(gt_table[nidx[row]])
+            new_pl_str[i] = ",".join(str(int(v)) for v in npl[row])
+            new_gq[i] = int(ngq[row])
+            new_gt_str[i] = f"{new_pair[0]}/{new_pair[1]}"
+            if set(new_pair) != {a for a in gts[i] if a >= 0}:
+                counters[vt]["changed_gt"] += 1
+                changed += 1
+
+    # rebuild sample strings with GT0/GQ0/PL0 retention
+    table.header.lines.append('##FORMAT=<ID=GT0,Number=1,Type=String,Description="Genotype (pre-imputation)">')
+    table.header.lines.append('##FORMAT=<ID=GQ0,Number=1,Type=Integer,Description="GQ (pre-imputation)">')
+    table.header.lines.append('##FORMAT=<ID=PL0,Number=G,Type=Integer,Description="PL (pre-imputation)">')
+    fmt_override = np.array(table.fmt_keys, dtype=object)
+    sample0 = np.array(table.sample_cols[:, 0], dtype=object)
+    for i in range(n):
+        if new_gt_str[i] is None:
+            continue
+        keys = table.fmt_keys[i].split(":")
+        vals = table.sample_cols[i][0].split(":")
+        kv = dict(zip(keys, vals))
+        old_gt, old_gq, old_pl = kv.get("GT", "./."), kv.get("GQ", "."), kv.get("PL", ".")
+        kv["GT"] = new_gt_str[i]
+        kv["GQ"] = str(new_gq[i])
+        kv["PL"] = new_pl_str[i]
+        kv["GT0"] = old_gt.replace("/", "|")
+        kv["GQ0"] = old_gq
+        kv["PL0"] = old_pl
+        order = [k for k in keys if k in kv] + ["GT0", "GQ0", "PL0"]
+        fmt_override[i] = ":".join(order)
+        sample0[i] = ":".join(kv[k] for k in order)
+
+    write_vcf(args.output_vcf, table, fmt_override=fmt_override, sample_overrides={0: sample0})
+
+    stats_file = args.stats_file or args.output_vcf.replace(".vcf.gz", "").replace(".vcf", "") + "_counts.csv"
+    with open(stats_file, "w") as fh:
+        fh.write("variant_type,pass,has_non_ref_imp,imp_has_different_gt,changed_gt\n")
+        for vt, c in sorted(counters.items()):
+            fh.write(f"{vt},{c['pass']},{c['has_non_ref_imp']},{c['imp_has_different_gt']},{c['changed_gt']}\n")
+    logger.info("rewrote %d genotypes -> %s (stats: %s)", changed, args.output_vcf, stats_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
