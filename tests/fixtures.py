@@ -145,7 +145,8 @@ def write_bam(path: str, contigs: dict[str, int], reads: list[dict]) -> None:
     """Minimal BAM writer for reader/coverage tests.
 
     Each read dict: contig (name), pos (0-based), cigar [(op_char, len)],
-    optional mapq (60), flag (0), quals (list[int], default 30s).
+    optional mapq (60), flag (0), quals (list[int], default 30s),
+    seq (str, default all-N).
     """
     import struct
 
@@ -177,7 +178,16 @@ def write_bam(path: str, contigs: dict[str, int], reads: list[dict]) -> None:
         rec += name
         for op, l in cigar:
             rec += struct.pack("<I", (l << 4) | ops.index(op))
-        rec += b"\xff" * ((read_len + 1) // 2)  # seq nibbles (N)
+        seq = r.get("seq")
+        if seq is None:
+            rec += b"\xff" * ((read_len + 1) // 2)  # seq nibbles (N)
+        else:
+            nib_map = {"A": 1, "C": 2, "G": 4, "T": 8, "N": 15}
+            nibs = [nib_map.get(b, 15) for b in seq.upper()[:read_len]]
+            nibs += [15] * (read_len - len(nibs))
+            if len(nibs) % 2:
+                nibs.append(0)
+            rec += bytes((nibs[i] << 4) | nibs[i + 1] for i in range(0, len(nibs), 2))
         rec += bytes(quals[:read_len])
         body += struct.pack("<i", len(rec)) + rec
     with gzip.open(path, "wb") as fh:

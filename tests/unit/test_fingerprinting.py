@@ -1,0 +1,140 @@
+"""Unit tests: pileup SNV caller, hit fraction, quick fingerprinter."""
+
+import numpy as np
+import pytest
+
+from tests.fixtures import write_bam, write_fasta
+
+from variantcalling_tpu.comparison.pileup_caller import (
+    VariantHitFractionCaller,
+    call_snvs,
+    pileup_counts,
+    snp_set_from_vcf,
+)
+
+VCF_HEADER = (
+    "##fileformat=VCFv4.2\n"
+    "##contig=<ID=chr1,length=200>\n"
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+)
+
+
+def test_pileup_counts_basic(tmp_path):
+    p = str(tmp_path / "t.bam")
+    # two reads agreeing on G at offset 5, one read with C
+    write_bam(
+        p,
+        {"chr1": 200},
+        [
+            {"contig": "chr1", "pos": 0, "cigar": [("M", 10)], "seq": "AAAAAGAAAA"},
+            {"contig": "chr1", "pos": 0, "cigar": [("M", 10)], "seq": "AAAAAGAAAA"},
+            {"contig": "chr1", "pos": 3, "cigar": [("M", 10)], "seq": "AACAAAAAAA"},
+            {"contig": "chr1", "pos": 0, "cigar": [("M", 10)], "seq": "AAAAAAAAAA", "flag": 0x400},  # dup
+        ],
+    )
+    counts = pileup_counts(p, "chr1", 0, 20)
+    assert counts[5, 2] == 2  # G x2
+    assert counts[5, 1] == 1  # C from read3 (pos 3 + offset 2)
+    assert counts[0, 0] == 2  # dup excluded
+    assert counts.sum() == 3 * 10
+
+
+def test_pileup_respects_cigar(tmp_path):
+    p = str(tmp_path / "t.bam")
+    # 5M 3D 5M: read base 5 lands at ref 8; 2I consumes read only
+    write_bam(
+        p,
+        {"chr1": 200},
+        [{"contig": "chr1", "pos": 10, "cigar": [("M", 5), ("D", 3), ("M", 5)], "seq": "AAAAACCCCC"}],
+    )
+    counts = pileup_counts(p, "chr1", 0, 40)
+    assert counts[10:15, 0].tolist() == [1] * 5  # A run
+    assert counts[15:18].sum() == 0  # deletion: no base counts
+    assert counts[18:23, 1].tolist() == [1] * 5  # C run
+
+
+def test_call_snvs_af_gate():
+    counts = np.zeros((4, 4), dtype=np.int32)
+    counts[0] = [98, 2, 0, 0]  # af=0.02 < 0.03 → no call
+    counts[1] = [90, 0, 10, 0]  # af=0.1 → G call
+    counts[2] = [0, 0, 0, 50]  # hom alt T
+    # row 3: zero depth
+    ref = np.array([0, 0, 0, 0], dtype=np.int8)
+    offs, alts, af = call_snvs(counts, ref, min_af=0.03)
+    assert offs.tolist() == [1, 2]
+    assert alts.tolist() == [2, 3]
+    np.testing.assert_allclose(af, [0.1, 1.0])
+
+
+def test_hit_fraction_join():
+    called = {("chr1", 10, "A", "G"), ("chr1", 20, "C", "T"), ("chr1", 30, "G", "A")}
+    truth = {("chr1", 10, "A", "G"), ("chr1", 20, "C", "T"), ("chr1", 99, "T", "C")}
+    frac, hits, n_gt = VariantHitFractionCaller.calc_hit_fraction(called, truth)
+    assert hits == 2 and n_gt == 3
+    assert frac == pytest.approx(2 / 3.001)
+
+
+def test_snp_set_from_vcf_filters_indels_and_region(tmp_path):
+    vcf = tmp_path / "gt.vcf"
+    vcf.write_text(
+        VCF_HEADER
+        + "chr1\t10\t.\tA\tG\t50\tPASS\t.\n"
+        + "chr1\t20\t.\tAC\tA\t50\tPASS\t.\n"  # indel: dropped
+        + "chr1\t150\t.\tC\tT\t50\tPASS\t.\n"  # outside region
+    )
+    s = snp_set_from_vcf(str(vcf), ("chr1", 1, 100))
+    assert s == {("chr1", 10, "A", "G")}
+
+
+def test_quick_fingerprinter_end_to_end(tmp_path, rng):
+    from variantcalling_tpu.comparison.quick_fingerprinter import QuickFingerprinter
+
+    # genome of As; sample1 has G at pos 50 (1-based 51), sample2 has T at pos 80
+    genome = {"chr1": "A" * 200}
+    fasta = tmp_path / "ref.fa"
+    write_fasta(str(fasta), genome)
+
+    def mk_bam(path, alt_offset, alt_base):
+        seq = ["A"] * 100
+        seq[alt_offset] = alt_base
+        reads = [{"contig": "chr1", "pos": 0, "cigar": [("M", 100)], "seq": "".join(seq)} for _ in range(10)]
+        write_bam(str(path), {"chr1": 200}, reads)
+
+    mk_bam(tmp_path / "s1.bam", 50, "G")
+    mk_bam(tmp_path / "s2.bam", 80, "T")
+
+    def mk_truth(path, pos1, alt):
+        path.write_text(VCF_HEADER + f"chr1\t{pos1}\t.\tA\t{alt}\t50\tPASS\t.\n")
+
+    mk_truth(tmp_path / "gt1.vcf", 51, "G")
+    mk_truth(tmp_path / "gt2.vcf", 81, "T")
+    hcr = tmp_path / "hcr.bed"
+    hcr.write_text("chr1\t0\t200\n")
+
+    qf = QuickFingerprinter(
+        {"s1": [str(tmp_path / "s1.bam")], "s2": [str(tmp_path / "s2.bam")]},
+        {"s1": str(tmp_path / "gt1.vcf"), "s2": str(tmp_path / "gt2.vcf")},
+        {"s1": str(hcr), "s2": str(hcr)},
+        str(fasta),
+        "chr1:1-200",
+        0.03,
+        0.99,
+        str(tmp_path / "out"),
+    )
+    qf.check()  # matching setup: no error
+    results = (tmp_path / "out" / "quick_fingerprinting_results.txt").read_text()
+    assert "s1 vs. s1 hit_fraction=0.999" in results
+
+    # swapped truths must raise
+    qf_bad = QuickFingerprinter(
+        {"s1": [str(tmp_path / "s1.bam")]},
+        {"s1": str(tmp_path / "gt2.vcf"), "s2": str(tmp_path / "gt1.vcf")},
+        {"s1": str(hcr), "s2": str(hcr)},
+        str(fasta),
+        "chr1:1-200",
+        0.03,
+        0.99,
+        str(tmp_path / "out2"),
+    )
+    with pytest.raises(RuntimeError):
+        qf_bad.check()

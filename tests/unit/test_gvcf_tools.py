@@ -1,0 +1,203 @@
+"""Unit tests: gVCF compression / overlap cleanup / GQ BED / haploid conversion.
+
+Seeded by the reference's hand-computed unit tier (test_compress_gvcf,
+test_gvcf_bed, test_cleanup_gvcf_before_joint — SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.joint.gvcf import (
+    cleanup_gvcf_table,
+    compress_gvcf,
+    compress_pl_to_3,
+    gvcf_to_bed,
+)
+
+GVCF_HEADER = """##fileformat=VCFv4.2
+##FILTER=<ID=PASS,Description="ok">
+##FILTER=<ID=RefCall,Description="ref block">
+##INFO=<ID=END,Number=1,Type=Integer,Description="end">
+##FORMAT=<ID=GT,Number=1,Type=String,Description="gt">
+##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="gq">
+##FORMAT=<ID=DP,Number=1,Type=Integer,Description="dp">
+##FORMAT=<ID=MIN_DP,Number=1,Type=Integer,Description="min dp">
+##FORMAT=<ID=PL,Number=G,Type=Integer,Description="pl">
+##contig=<ID=chr1,length=100000>
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tSAMPLE
+"""
+
+
+def _rec(pos, filt, gq, dp, pl, ref="A", alt="<*>", end=None):
+    info = f"END={end}" if end else "."
+    return f"chr1\t{pos}\t.\t{ref}\t{alt}\t.\t{filt}\t{info}\tGT:GQ:DP:PL\t0/0:{gq}:{dp}:{pl}"
+
+
+def test_compress_pl_to_3_passthrough_and_collapse():
+    # one alt: passthrough
+    pl = np.array([[7.0, 0.0, 99.0]])
+    out = compress_pl_to_3(pl, np.array([1]))
+    assert out.tolist() == [[7, 0, 99]]
+    # two alts, G=6, order (0,0),(0,1),(1,1),(0,2),(1,2),(2,2)
+    pl = np.array([[5.0, 10.0, 40.0, 8.0, 33.0, 21.0]])
+    out = compress_pl_to_3(pl, np.array([2]))
+    # slot1 = min(PL(0,1), PL(0,2)) = min(10,8); slot2 = min(40,33,21)
+    assert out.tolist() == [[5, 8, 21]]
+
+
+def test_compress_gvcf_merges_band(tmp_path):
+    lines = [
+        _rec(100, "RefCall", 30, 20, "0,30,300", end=150),
+        _rec(151, "RefCall", 35, 22, "0,35,350", end=200),
+        _rec(201, "RefCall", 33, 18, "0,33,330", end=250),
+        _rec(251, "PASS", 50, 25, "0,50,500", ref="A", alt="G"),
+        _rec(252, "RefCall", 10, 9, "0,10,100", end=300),  # low-GQ refcall kept verbatim
+        _rec(301, "RefCall", 40, 21, "0,40,400", end=350),
+    ]
+    inp = tmp_path / "in.g.vcf"
+    inp.write_text(GVCF_HEADER + "\n".join(lines) + "\n")
+    out = tmp_path / "out.g.vcf"
+    n_in, n_out = compress_gvcf(str(inp), str(out))
+    assert n_in == 6
+    # records 1-3 merge into one block; PASS, low-refcall, last kept separate
+    assert n_out == 4
+    t = read_vcf(str(out))
+    assert t.pos.tolist() == [100, 251, 252, 301]
+    merged = t.sample_cols[0][0]
+    # GQ=min(30,35,33)=30, MIN_DP=min(dp)=18, PL elementwise min
+    assert merged == "0/0:30:18:0,30,300"
+    assert "END=250" in t.info[0]
+    assert t.alt[0] == "<*>"
+
+
+def test_compress_gvcf_gq_band_break(tmp_path):
+    # GQ drift >= 10 forces a new group
+    lines = [
+        _rec(100, "RefCall", 30, 20, "0,30,300", end=150),
+        _rec(151, "RefCall", 45, 22, "0,45,450", end=200),  # 45-30 >= 10 → break
+    ]
+    inp = tmp_path / "in.g.vcf"
+    inp.write_text(GVCF_HEADER + "\n".join(lines) + "\n")
+    out = tmp_path / "out.g.vcf"
+    _, n_out = compress_gvcf(str(inp), str(out))
+    assert n_out == 2
+
+
+def _mk_table(tmp_path, rows):
+    p = tmp_path / "t.vcf"
+    p.write_text(GVCF_HEADER + "\n".join(rows) + "\n")
+    return read_vcf(str(p))
+
+
+def test_cleanup_drops_uncalled_over_called_deletion(tmp_path):
+    rows = [
+        # called het deletion ACGT->A spanning pos 100-103
+        "chr1\t100\t.\tACGT\tA\t50\tPASS\t.\tGT:GQ:DP:PL\t0/1:50:30:50,0,900",
+        # uncalled ./. record inside the deletion span → dropped
+        "chr1\t102\t.\tA\tG\t.\t.\t.\tGT:GQ:DP:PL\t./.:.:.:.",
+        # called record inside span → kept
+        "chr1\t103\t.\tG\tC\t40\tPASS\t.\tGT:GQ:DP:PL\t0/1:40:25:40,0,800",
+        # outside span → kept even though uncalled
+        "chr1\t200\t.\tT\tA\t.\t.\t.\tGT:GQ:DP:PL\t./.:.:.:.",
+    ]
+    t = _mk_table(tmp_path, rows)
+    keep, n_written, n_removed = cleanup_gvcf_table(t)
+    assert keep.tolist() == [True, False, True, True]
+    assert (n_written, n_removed) == (3, 1)
+
+
+def test_cleanup_keeps_uncalled_when_no_called_in_buffer(tmp_path):
+    rows = [
+        # uncalled deletion; nothing called overlaps
+        "chr1\t100\t.\tACGT\tA\t.\t.\t.\tGT:GQ:DP:PL\t./.:.:.:.",
+        "chr1\t102\t.\tA\tG\t.\t.\t.\tGT:GQ:DP:PL\t0/0:20:10:0,20,200",
+    ]
+    t = _mk_table(tmp_path, rows)
+    keep, n_written, n_removed = cleanup_gvcf_table(t)
+    assert keep.all() and n_removed == 0
+
+
+def test_gvcf_to_bed_threshold_and_extent(tmp_path):
+    rows = [
+        _rec(100, "RefCall", 30, 20, "0,30,300", end=150),  # GQ 30 >= 20 → emitted [99,150)
+        _rec(120, "RefCall", 25, 20, "0,25,250", end=140),  # starts before extent → skipped
+        _rec(151, "RefCall", 10, 9, "0,10,100", end=200),  # GQ 10 < 20 → not emitted (gt mode)
+    ]
+    inp = tmp_path / "in.g.vcf"
+    inp.write_text(GVCF_HEADER + "\n".join(rows) + "\n")
+    bed = tmp_path / "out.bed"
+    skipped = gvcf_to_bed(str(inp), str(bed), gq_threshold=20, gt=True)
+    assert skipped == 1
+    lines = [l.split("\t") for l in bed.read_text().splitlines()]
+    assert lines == [["chr1", "99", "150"]]
+
+
+def test_gvcf_to_bed_refcall_deletion_first_base_only(tmp_path):
+    rows = [
+        # hom-ref deletion-shaped block: only first base covered
+        "chr1\t100\t.\tACGT\tA\t.\tRefCall\t.\tGT:GQ:DP:PL\t0/0:33:20:0,33,330",
+    ]
+    inp = tmp_path / "in.g.vcf"
+    inp.write_text(GVCF_HEADER + "\n".join(rows) + "\n")
+    bed = tmp_path / "out.bed"
+    gvcf_to_bed(str(inp), str(bed), gq_threshold=20, gt=True)
+    assert bed.read_text().splitlines() == ["chr1\t99\t100"]
+
+
+class TestHaploidConversion:
+    def test_kernel_matches_reference_math(self):
+        from variantcalling_tpu.ops.genotypes import diploid_pl_to_haploid
+
+        # one alt, PL=(hom-ref, het, hom-alt)
+        pl = np.array([[0.0, 30.0, 60.0], [60.0, 30.0, 0.0]])
+        hpl, gq, gt = (np.asarray(x) for x in diploid_pl_to_haploid(pl, 1))
+        # reference math: probs at hom indices (0, 2), renormalized
+        p = 10 ** (-pl[:, [0, 2]] / 10)
+        p = p / p.sum(1, keepdims=True)
+        expect = np.trunc(-10 * np.log10(p)).astype(int)
+        expect = expect - expect.min(1, keepdims=True)
+        np.testing.assert_array_equal(hpl, expect)
+        assert gt.tolist() == [0, 1]
+        assert gq.tolist() == [int(expect[0].max()), int(expect[1].max())]
+
+    def test_pipeline_end_to_end(self, tmp_path):
+        from variantcalling_tpu.pipelines.convert_haploid_regions import run
+
+        header = GVCF_HEADER.replace("ID=chr1", "ID=chrX")
+        rows = [
+            "chrX\t3000000\t.\tA\tG\t50\tPASS\t.\tGT:GQ:PL\t0/1:30:30,0,60",
+            "chrX\t156040999\t.\tA\tG\t50\tPASS\t.\tGT:GQ:PL\t0/1:30:30,0,60",  # outside non-PAR
+        ]
+        inp = tmp_path / "in.vcf"
+        inp.write_text(header.replace("chr1", "chrX") + "\n".join(rows) + "\n")
+        out = tmp_path / "out.vcf"
+        run(["--input_vcf", str(inp), "--output_vcf", str(out), "--haploid_regions", "hg38_non_par"])
+        t = read_vcf(str(out))
+        s0 = t.sample_cols[0][0].split(":")
+        # in-region: haploid 2-value PL
+        assert len(s0[-1].split(",")) == 2
+        # out-of-region untouched
+        assert t.sample_cols[1][0] == "0/1:30:30,0,60"
+
+
+def test_denovo_refinement(tmp_path):
+    from variantcalling_tpu.joint.denovo_refinement import write_recalibrated_vcf
+
+    header = (
+        "##fileformat=VCFv4.2\n"
+        '##INFO=<ID=hiConfDeNovo,Number=.,Type=String,Description="s">\n'
+        "##contig=<ID=chr1,length=100000>\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    )
+    denovo = tmp_path / "denovo.vcf"
+    denovo.write_text(header + "chr1\t100\t.\tA\tG\t50\tPASS\thiConfDeNovo=kid1\n")
+    mom = tmp_path / "mom.vcf"
+    mom.write_text(header + "chr1\t100\t.\tA\tG\t33\tPASS\t.\n")
+    dad = tmp_path / "dad.vcf"
+    dad.write_text(header + "chr1\t100\t.\tA\tG\t44\tPASS\t.\n")
+    out = tmp_path / "out.vcf"
+    n = write_recalibrated_vcf(str(denovo), str(out), {"kid1": str(mom)}, {"kid1": str(dad)})
+    assert n == 1
+    t = read_vcf(str(out))
+    assert t.info_field("DENOVO_QUAL")[0] == pytest.approx(33.0)

@@ -107,3 +107,91 @@ def test_blacklist_vectorized_join(tmp_path, rng):
     hit = key_bl[loc] == key_tb
     assert hit.sum() == 2
     assert set(np.nonzero(hit)[0].tolist()) == {9, 98}  # chr1:100, chr2:990
+
+
+def test_imputation_kernel_no_float32_underflow():
+    """PL spans >= 380 must not produce inf/int32-garbage (float32 underflow guard)."""
+    import jax.numpy as jnp
+    from variantcalling_tpu.ops.imputation import modify_stats_with_imp_batch
+
+    pl = jnp.asarray([[990.0, 60.0, 0.0]])
+    ds = jnp.asarray([[2.0]])
+    npl, ngq, nidx = modify_stats_with_imp_batch(pl, ds, jnp.asarray([2]), 1)
+    npl = np.asarray(npl)
+    assert np.all(np.abs(npl) < 100000), npl
+    assert npl.min() == 0
+    assert int(nidx[0]) == 2  # hom-alt stays hom-alt under hom-supporting DS
+
+
+def test_haploid_kernel_no_float32_underflow():
+    from variantcalling_tpu.ops.genotypes import diploid_pl_to_haploid
+
+    pl = np.array([[990.0, 60.0, 0.0]])
+    hpl, gq, gt = (np.asarray(x) for x in diploid_pl_to_haploid(pl, 1))
+    assert np.all(np.abs(hpl) < 100000), hpl
+    assert gt.tolist() == [1]
+    assert 0 < int(gq[0]) <= 10000
+
+
+def test_gt_to_index_rejects_non_diploid():
+    from variantcalling_tpu.ops.imputation import gt_to_index
+
+    out = gt_to_index(np.array([[0, 1], [-1, 1], [1, 1]]), 1)
+    assert out.tolist() == [1, -1, 2]
+
+
+def _imp_vcf(tmp_path, rows, fmts="GT:GQ:DP:PL:DS"):
+    header = (
+        "##fileformat=VCFv4.2\n"
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+        '##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="g">\n'
+        '##FORMAT=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+        '##FORMAT=<ID=PL,Number=G,Type=Integer,Description="p">\n'
+        '##FORMAT=<ID=DS,Number=A,Type=Float,Description="ds">\n'
+        "##contig=<ID=chr1,length=100000>\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n"
+    )
+    p = tmp_path / "imp_in.vcf"
+    p.write_text(header + "\n".join(rows) + "\n")
+    return str(p)
+
+
+def test_imputation_pipeline_skips_half_missing_gt(tmp_path):
+    from variantcalling_tpu.pipelines.correct_genotypes_by_imputation import run
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    rows = [
+        "chr1\t100\t.\tA\tG\t50\tPASS\t.\tGT:GQ:DP:PL:DS\t./1:30:20:30,0,60:1.0",
+        "chr1\t200\t.\tA\tG\t50\tPASS\t.\tGT:GQ:DP:PL:DS\t0/1:30:20:30,0,60:2.0",
+    ]
+    vcf = _imp_vcf(tmp_path, rows)
+    out = str(tmp_path / "out.vcf")
+    run(["--beagle_annotated_vcf", vcf, "--output_vcf", out])
+    t = read_vcf(out)
+    # half-missing record untouched
+    assert t.sample_cols[0][0] == "./1:30:20:30,0,60:1.0"
+    # called record rewritten with GT0 retention
+    assert "GT0" in t.fmt_keys[1]
+
+
+def test_imputation_pipeline_idempotent_rerun_and_missing_gq(tmp_path):
+    from variantcalling_tpu.pipelines.correct_genotypes_by_imputation import run
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    # record lacking GQ in FORMAT: rewritten output must still carry GQ
+    rows = ["chr1\t100\t.\tA\tG\t50\tPASS\t.\tGT:PL:DS\t0/1:30,0,60:2.0"]
+    vcf = _imp_vcf(tmp_path, rows)
+    out1 = str(tmp_path / "out1.vcf")
+    run(["--beagle_annotated_vcf", vcf, "--output_vcf", out1])
+    t1 = read_vcf(out1)
+    keys1 = t1.fmt_keys[0].split(":")
+    assert "GQ" in keys1
+    assert keys1.count("GT0") == 1
+    # re-run on own output: no duplicate keys, no duplicate header lines
+    out2 = str(tmp_path / "out2.vcf")
+    run(["--beagle_annotated_vcf", out1, "--output_vcf", out2])
+    t2 = read_vcf(out2)
+    keys2 = t2.fmt_keys[0].split(":")
+    assert keys2.count("GT0") == 1 and keys2.count("PL0") == 1
+    gt0_defs = [l for l in t2.header.lines if l.startswith("##FORMAT=<ID=GT0")]
+    assert len(gt0_defs) == 1

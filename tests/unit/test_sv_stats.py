@@ -1,0 +1,76 @@
+"""Unit tests: sv_stats_collect histograms and concordance (reference test_sv_stats_collect style)."""
+
+import pandas as pd
+import pytest
+
+from variantcalling_tpu.pipelines.sv_stats_collect import (
+    SVLABELS,
+    collect_size_type_histograms,
+    concordance_with_gt,
+    concordance_with_gt_roc,
+    run,
+)
+
+HEADER = (
+    "##fileformat=VCFv4.2\n"
+    '##INFO=<ID=SVLEN,Number=.,Type=Integer,Description="len">\n'
+    '##INFO=<ID=SVTYPE,Number=1,Type=String,Description="type">\n'
+    "##contig=<ID=chr1,length=10000000>\n"
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+)
+
+
+def _write_sv_vcf(path):
+    rows = [
+        "chr1\t100\t.\tN\t<DEL>\t50\tPASS\tSVLEN=-80;SVTYPE=DEL",
+        "chr1\t200\t.\tN\t<DEL>\t50\tPASS\tSVLEN=-250;SVTYPE=DEL",
+        "chr1\t300\t.\tN\t<INS>\t50\tPASS\tSVLEN=400;SVTYPE=INS",
+        "chr1\t400\t.\tN\t<INS>\t50\tLowQual\tSVLEN=90;SVTYPE=INS",  # filtered
+        "chr1\t500\t.\tN\t<CTX>\t50\tPASS\tSVTYPE=CTX",  # no SVLEN
+    ]
+    path.write_text(HEADER + "\n".join(rows) + "\n")
+
+
+def test_histograms(tmp_path):
+    vcf = tmp_path / "sv.vcf"
+    _write_sv_vcf(vcf)
+    res = collect_size_type_histograms(str(vcf))
+    assert res["type_counts"]["DEL"] == 2
+    assert res["type_counts"]["INS"] == 1
+    assert res["length_counts"]["50-100"] == 2  # DEL 80 + CTX svlen=0... 0 falls in 50-100 bin [0,100)
+    assert res["length_by_type_counts"].loc["DEL", "100-300"] == 1
+    assert "CTX" not in res["length_by_type_counts"].index
+    # ignore_filter keeps the LowQual record
+    res2 = collect_size_type_histograms(str(vcf), ignore_filter=True)
+    assert res2["type_counts"]["INS"] == 2
+
+
+def test_concordance_series():
+    base = pd.DataFrame({"label": ["TP", "TP", "FN", "FN"]})
+    calls = pd.DataFrame({"label": ["TP", "TP", "FP"]})
+    s = concordance_with_gt(base, calls)
+    assert s["TP_base"] == 2 and s["FN"] == 2 and s["FP"] == 1
+    assert s["Precision"] == pytest.approx(2 / 3)
+    assert s["Recall"] == pytest.approx(0.5)
+
+
+def test_roc_handles_fn_mask():
+    base = pd.DataFrame({"label": ["FN"] * 5, "qual": [None] * 5})
+    calls = pd.DataFrame({"label": ["TP"] * 30 + ["FP"] * 10, "qual": list(range(30)) + [1.0] * 10})
+    s = concordance_with_gt_roc(base, calls)
+    assert len(s["precision"]) == len(s["recall"])
+    # recall scaled by tp/(tp+fn) = 30/35
+    assert max(s["recall"]) <= 30 / 35 + 1e-9
+
+
+def test_run_pickle_output(tmp_path):
+    import pickle
+
+    vcf = tmp_path / "sv.vcf"
+    _write_sv_vcf(vcf)
+    out = tmp_path / "res.pkl"
+    run([str(vcf), str(out)])
+    with open(out, "rb") as f:
+        results = pickle.load(f)
+    assert set(results) == {"type_counts", "length_counts", "length_by_type_counts"}
+    assert list(results["length_by_type_counts"].columns) == SVLABELS

@@ -1,0 +1,160 @@
+"""Pileup SNV caller + variant hit-fraction matching (fingerprinting core).
+
+Replaces the reference's ``bcftools mpileup | bcftools view -i
+'AD[0:1]/DP >= af'`` subprocess chain
+(ugvc/comparison/variant_hit_fraction_caller.py:23-28) with an in-process
+engine: BAM alignments are scattered into a (region_len × 4) allele-count
+tensor host-side, and the AF gate + major-alt selection run as one batched
+device kernel. Hit fraction joins called vs ground-truth variants on
+(chrom, pos, ref, major_alt) exactly as the reference's pandas merge
+(variant_hit_fraction_caller.py:30-49).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from variantcalling_tpu.io.bam import EXCLUDE_FLAGS, BamReader
+
+_M_OPS = {0, 7, 8}  # CIGAR ops that consume both read and ref (M, =, X)
+_BASES = "ACGT"
+MAX_DEPTH = 500  # matches bcftools mpileup -d 500
+
+
+def pileup_counts(bam_path: str, chrom: str, start: int, end: int) -> np.ndarray:
+    """(L, 4) int32 base counts over [start, end) of ``chrom`` (0-based).
+
+    Skips unmapped/secondary/qcfail/dup reads (mpileup defaults) and
+    indels (``--skip-indels``); depth capped at MAX_DEPTH per locus.
+    """
+    length = end - start
+    counts = np.zeros((length, 4), dtype=np.int32)
+    with BamReader(bam_path, decode_seq=True) as reader:
+        try:
+            tid = reader.header.references.index(chrom)
+        except ValueError:
+            return counts
+        for aln in reader:
+            if aln.ref_id != tid or aln.flag & EXCLUDE_FLAGS or aln.seq is None:
+                continue
+            if aln.pos >= end:
+                continue
+            rpos = aln.pos  # ref cursor
+            qpos = 0  # read cursor
+            for op, ln in aln.cigar:
+                if op in _M_OPS:
+                    lo = max(rpos, start)
+                    hi = min(rpos + ln, end)
+                    if hi > lo:
+                        q0 = qpos + (lo - rpos)
+                        codes = aln.seq[q0 : q0 + (hi - lo)]
+                        valid = codes < 4
+                        idx = np.arange(lo - start, hi - start)[valid]
+                        np.add.at(counts, (idx, codes[valid].astype(np.int64)), 1)
+                    rpos += ln
+                    qpos += ln
+                elif op in (1, 4):  # I, S consume read
+                    qpos += ln
+                elif op in (2, 3):  # D, N consume ref
+                    rpos += ln
+    np.minimum(counts, MAX_DEPTH, out=counts)
+    return counts
+
+
+def call_snvs(counts: np.ndarray, ref_codes: np.ndarray, min_af: float) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """AF-gated SNV calls from a pileup tensor — one batched device program.
+
+    Returns (offsets, major_alt_code, alt_fraction) for loci where the
+    best non-reference allele has count/depth >= min_af (the reference's
+    ``AD[0:1]/DP >= af`` gate) and depth > 0.
+    """
+    import jax.numpy as jnp
+
+    c = jnp.asarray(counts)
+    ref = jnp.asarray(ref_codes)
+    depth = jnp.sum(c, axis=1)
+    masked = jnp.where(jnp.arange(4)[None, :] == ref[:, None], -1, c)
+    alt = jnp.argmax(masked, axis=1)
+    alt_count = jnp.max(masked, axis=1)
+    af = jnp.where(depth > 0, alt_count / jnp.maximum(depth, 1), 0.0)
+    hit = (af >= min_af) & (depth > 0) & (ref < 4) & (alt_count > 0)
+    hit = np.asarray(hit)
+    return np.nonzero(hit)[0], np.asarray(alt)[hit], np.asarray(af)[hit]
+
+
+class VariantHitFractionCaller:
+    """Drop-in surface of the reference class (variant_hit_fraction_caller.py:15-73)."""
+
+    def __init__(self, ref: str, out_dir: str, min_af_snps: float, region: str):
+        self.ref = ref
+        self.out_dir = out_dir
+        self.min_af_snps = min_af_snps
+        self.region = region
+
+    def call_variants(self, bam: str, chrom: str, start: int, end: int, min_af: float) -> set[tuple[str, int, str, str]]:
+        """Called SNVs as {(chrom, pos_1based, ref_base, major_alt)}."""
+        from variantcalling_tpu.io.fasta import FastaReader
+
+        counts = pileup_counts(bam, chrom, start, end)
+        with FastaReader(self.ref) as fa:
+            ref_seq = fa.fetch(chrom, start, min(end, fa.get_reference_length(chrom)))
+        codes = np.full(end - start, 4, dtype=np.int8)
+        for i, b in enumerate(ref_seq.upper()):
+            if b in _BASES:
+                codes[i] = _BASES.index(b)
+        offs, alts, _af = call_snvs(counts, codes, min_af)
+        return {(chrom, start + int(o) + 1, _BASES[codes[o]], _BASES[int(a)]) for o, a in zip(offs, alts)}
+
+    @staticmethod
+    def calc_hit_fraction(
+        called: set[tuple[str, int, str, str]],
+        ground_truth: set[tuple[str, int, str, str]],
+    ) -> tuple[float, int, int]:
+        """(hit_fraction, hit_count, ground_truth_count); +0.001 guard as reference."""
+        hits = len(called & ground_truth)
+        n_gt = len(ground_truth)
+        return hits / (n_gt + 0.001), hits, n_gt
+
+    @staticmethod
+    def add_args_to_parser(parser) -> None:
+        parser.add_argument("--max_vars", type=int, default=2000, help="max number of variants to check for concordance")
+        parser.add_argument(
+            "--min_af_snps", type=float, default=0.03, help="min allele frequency to count as a ground-truth hit"
+        )
+        parser.add_argument(
+            "--min_af_germline_snps",
+            type=float,
+            default=0.1,
+            help="min allele frequency to count a snp as germline snp, for normal-in-tumor <-> normal matching",
+        )
+        parser.add_argument(
+            "--min_hit_fraction_target",
+            type=float,
+            default=0.99,
+            help="fraction of ground-truth variants which has hits in target samples",
+        )
+
+
+def snp_set_from_vcf(vcf_path: str, region: tuple[str, int, int] | None, hcr=None) -> set[tuple[str, int, str, str]]:
+    """Ground-truth SNP keys (chrom, pos, ref, first_alt) within region ∩ HCR."""
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    table = read_vcf(vcf_path, region=region, drop_format=True)
+    out: set[tuple[str, int, str, str]] = set()
+    hcr_by_chrom = hcr.merged().by_chrom() if hcr is not None else None
+    for i in range(len(table)):
+        ref = table.ref[i]
+        alts = table.alt[i].split(",")
+        major = alts[0]
+        if len(ref) != 1 or len(major) != 1 or major not in _BASES or ref not in _BASES:
+            continue
+        chrom, pos = str(table.chrom[i]), int(table.pos[i])
+        if hcr_by_chrom is not None:
+            if chrom not in hcr_by_chrom:
+                continue
+            s, e = hcr_by_chrom[chrom]
+            j = np.searchsorted(s, pos - 1, side="right") - 1
+            if j < 0 or pos - 1 >= e[j]:
+                continue
+        out.add((chrom, pos, ref, major))
+    return out

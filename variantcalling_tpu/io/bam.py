@@ -45,6 +45,13 @@ class Alignment:
     cigar: list[tuple[int, int]]  # (op, length)
     read_len: int
     qual: np.ndarray | None  # per-base phred or None
+    seq: np.ndarray | None = None  # per-base code 0..3 A/C/G/T, 4 other (when decoded)
+
+
+# BAM 4-bit base nibble -> 0..3 ACGT, 4 anything else ('=ACMGRSVTWYHKDBN')
+_NIBBLE_TO_CODE = np.full(16, 4, dtype=np.uint8)
+for _nib, _code in ((1, 0), (2, 1), (4, 2), (8, 3)):
+    _NIBBLE_TO_CODE[_nib] = _code
 
 
 def _read_exact(fh, n: int) -> bytes:
@@ -55,7 +62,8 @@ def _read_exact(fh, n: int) -> bytes:
 
 
 class BamReader:
-    def __init__(self, path: str):
+    def __init__(self, path: str, decode_seq: bool = False):
+        self._decode_seq = decode_seq
         self._fh = gzip.open(path, "rb")  # BGZF is valid multi-member gzip
         magic = _read_exact(self._fh, 4)
         if magic != b"BAM\x01":
@@ -89,10 +97,17 @@ class BamReader:
             cigar_raw = np.frombuffer(rec, dtype="<u4", count=n_cigar, offset=off)
             off += 4 * n_cigar
             seq_bytes = (l_seq + 1) // 2
+            seq = None
+            if self._decode_seq and l_seq:
+                packed = np.frombuffer(rec, dtype=np.uint8, count=seq_bytes, offset=off)
+                nibbles = np.empty(seq_bytes * 2, dtype=np.uint8)
+                nibbles[0::2] = packed >> 4
+                nibbles[1::2] = packed & 0xF
+                seq = _NIBBLE_TO_CODE[nibbles[:l_seq]]
             off += seq_bytes
             qual = np.frombuffer(rec, dtype=np.uint8, count=l_seq, offset=off) if l_seq else None
             cigar = [(int(c & 0xF), int(c >> 4)) for c in cigar_raw]
-            yield Alignment(ref_id, pos, mapq, flag, cigar, l_seq, qual)
+            yield Alignment(ref_id, pos, mapq, flag, cigar, l_seq, qual, seq)
 
     def close(self) -> None:
         self._fh.close()

@@ -99,6 +99,11 @@ class VcfHeader:
             line = f'##INFO=<ID={info_id},Number={number},Type={info_type},Description="{description}">'
             self.add_meta_line(line)
 
+    def ensure_format(self, fmt_id: str, number: str, fmt_type: str, description: str) -> None:
+        if fmt_id not in self.formats:
+            line = f'##FORMAT=<ID={fmt_id},Number={number},Type={fmt_type},Description="{description}">'
+            self.add_meta_line(line)
+
     def ensure_filter(self, filter_id: str, description: str) -> None:
         if filter_id not in self.filters:
             self.add_meta_line(f'##FILTER=<ID={filter_id},Description="{description}">')

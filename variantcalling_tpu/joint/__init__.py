@@ -1,0 +1,8 @@
+"""Joint-calling gVCF utilities: compression, overlap cleanup, GQ-band BEDs.
+
+TPU-native counterparts of the reference's ``ugvc/joint`` package
+(compress_gvcf.py, cleanup_gvcf_before_calling.py, gvcf_bed.py,
+denovo_refinement.py). IO is host-side streaming over columnar
+:class:`~variantcalling_tpu.io.vcf.VariantTable` arrays; per-record PL math
+is vectorized (ops/genotypes) rather than record-at-a-time.
+"""

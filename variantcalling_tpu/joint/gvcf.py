@@ -1,0 +1,352 @@
+"""gVCF block algebra: PL band-compression, record merging, overlap cleanup, GQ BEDs.
+
+Behavioral parity targets (reference, studied not copied):
+- ``ugvc/joint/compress_gvcf.py:28-216`` — merge sequential reference-band
+  records whose GQ stays within a band; PL collapsed to 3 values.
+- ``ugvc/joint/cleanup_gvcf_before_calling.py:11-86`` — drop uncalled
+  (./.) records that overlap called deletions (GLNexus pre-pass).
+- ``ugvc/joint/gvcf_bed.py:9-69`` — GQ-threshold BED emission with
+  overlap/extent suppression.
+
+Design: records are ingested once into columnar arrays; the 3-value PL
+collapse is one vectorized masked segment-min over the padded (n, G) PL
+tensor for all records at once (the reference recomputes a Python loop per
+record); the merge decision scan is a single pass over plain int arrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from variantcalling_tpu.io.vcf import MISSING, VariantTable, read_vcf, write_vcf
+from variantcalling_tpu.ops.genotypes import genotype_ordering
+
+_GQ_SENTINEL = np.iinfo(np.int32).min
+
+
+def compress_pl_to_3(pl: np.ndarray, n_alts: np.ndarray) -> np.ndarray:
+    """Collapse padded diploid PL tensors (n, G_max) to (n, 3) hom-ref bands.
+
+    Output per record: ``[PL(0,0), min_k PL(0,k) k>=1, min of all other
+    genotypes]`` — the reference-band summary the merged ``<*>`` record
+    carries (reference compress_gvcf.py:28-60). Records with G == 3 (one
+    alt) pass through unchanged by construction. Vectorized over all
+    records: slot masks depend only on each record's alt count, so records
+    are bucketed by alt count and each bucket reduces with one masked min.
+    """
+    n = pl.shape[0]
+    out = np.zeros((n, 3), dtype=pl.dtype)
+    big = np.iinfo(np.int64).max if np.issubdtype(pl.dtype, np.integer) else np.inf
+    for a in np.unique(n_alts):
+        rows = np.nonzero(n_alts == a)[0]
+        order = genotype_ordering(int(a))  # (G, 2) rows (j, k), j<=k
+        g = order.shape[0]
+        j, k = order[:, 0], order[:, 1]
+        slot = np.where((j == 0) & (k == 0), 0, np.where(j == 0, 1, 2))
+        block = pl[rows][:, :g]
+        for s in range(3):
+            m = slot == s
+            if not m.any():
+                continue
+            out[rows, s] = np.min(np.where(m[None, :], block, big), axis=1)
+    return out
+
+
+def _int_format_field(table: VariantTable, name: str) -> np.ndarray:
+    """Scalar integer FORMAT field as int32; _GQ_SENTINEL where absent."""
+    raw = table.format_field(name)
+    out = np.full(len(table), _GQ_SENTINEL, dtype=np.int64)
+    for i, r in enumerate(raw):
+        if r not in (None, MISSING, ""):
+            try:
+                out[i] = int(float(r))
+            except ValueError:
+                pass
+    return out
+
+
+def compress_gvcf_table(
+    table: VariantTable,
+    refcall_gq_threshold: int = 22,
+    merge_gq_threshold: int = 10,
+) -> tuple[list[str], int, int]:
+    """Merge sequential gVCF records within a GQ band; returns output lines.
+
+    A record starts a new group (flushing the previous one) when any holds
+    (reference compress_gvcf.py:153-158):
+    - it or the previous record is PASS, or is RefCall with
+      GQ <= refcall_gq_threshold (these are kept verbatim, unmerged);
+    - the chromosome changes;
+    - its GQ drifts >= merge_gq_threshold from the group's running
+      min or max GQ.
+
+    Groups of size 1 are emitted verbatim. A merged group becomes one
+    ``<*>`` block: pos/ref-base of the first record, END of the last,
+    GT=0/0, GQ=min GQ, MIN_DP=min(MIN_DP or DP), PL = elementwise min of
+    the 3-value collapsed PLs.
+    """
+    n = len(table)
+    assert table.n_samples == 1, "gVCF compression expects a single-sample file"
+    gq = _int_format_field(table, "GQ")
+    min_dp = _int_format_field(table, "MIN_DP")
+    dp = _int_format_field(table, "DP")
+    n_alts = np.maximum(table.n_alts(), 1)
+    g_max = int(np.max((n_alts + 1) * (n_alts + 2) // 2))
+    pl = table.format_numeric("PL", max_len=g_max, missing=np.inf)
+    pl3 = compress_pl_to_3(pl, n_alts).astype(np.int64)
+
+    filter_sets = [set(f.split(";")) if f not in (MISSING, "") else set() for f in table.filters]
+    is_pass = np.fromiter(("PASS" in f for f in filter_sets), dtype=bool, count=n)
+    is_low_refcall = np.fromiter(
+        (("RefCall" in filter_sets[i]) and gq[i] != _GQ_SENTINEL and gq[i] <= refcall_gq_threshold for i in range(n)),
+        dtype=bool,
+        count=n,
+    )
+    # END of each record: INFO END= if present else pos + len(ref) - 1
+    end = table.info_field("END", dtype=np.int64, missing=-1)
+    ref_len = np.fromiter((len(r) for r in table.ref), dtype=np.int64, count=n)
+    end = np.where(end >= 0, end, table.pos + ref_len - 1)
+
+    # keep_verbatim records break groups on both sides (reference checks the
+    # condition for the current AND previous record)
+    keep = is_pass | is_low_refcall
+
+    def raw_line(i: int) -> str:
+        cols = [
+            table.chrom[i],
+            str(table.pos[i]),
+            table.vid[i],
+            table.ref[i],
+            table.alt[i],
+            _fmt_qual(table.qual[i]),
+            table.filters[i],
+            table.info[i],
+            table.fmt_keys[i],
+            table.sample_cols[i][0],
+        ]
+        return "\t".join(cols)
+
+    def merged_line(lo: int, hi: int, grp_gq: int, grp_dp: int, grp_pl: np.ndarray) -> str:
+        info = f"END={int(end[hi])}"
+        sample = f"0/0:{grp_gq}:{grp_dp}:{int(grp_pl[0])},{int(grp_pl[1])},{int(grp_pl[2])}"
+        return "\t".join(
+            [
+                table.chrom[lo],
+                str(table.pos[lo]),
+                ".",
+                table.ref[lo][0],
+                "<*>",
+                "0",
+                MISSING,
+                info,
+                "GT:GQ:MIN_DP:PL",
+                sample,
+            ]
+        )
+
+    out_lines: list[str] = []
+    lo = 0
+    grp_min_gq = grp_max_gq = int(gq[0]) if n else 0
+    grp_dp = int(min_dp[0]) if n and min_dp[0] != _GQ_SENTINEL else (int(dp[0]) if n else 0)
+    grp_pl = pl3[0].copy() if n else np.zeros(3, dtype=np.int64)
+
+    def flush(hi: int) -> None:
+        if hi == lo:
+            out_lines.append(raw_line(lo))
+        else:
+            out_lines.append(merged_line(lo, hi, grp_min_gq, grp_dp, grp_pl))
+
+    for i in range(1, n):
+        gqi = int(gq[i]) if gq[i] != _GQ_SENTINEL else 0
+        new_group = (
+            keep[i]
+            or keep[i - 1]
+            or table.chrom[i] != table.chrom[i - 1]
+            or gqi - grp_min_gq >= merge_gq_threshold
+            or grp_max_gq - gqi >= merge_gq_threshold
+        )
+        if new_group:
+            flush(i - 1)
+            lo = i
+            grp_min_gq = grp_max_gq = gqi
+            grp_dp = int(min_dp[i]) if min_dp[i] != _GQ_SENTINEL else int(dp[i]) if dp[i] != _GQ_SENTINEL else 0
+            grp_pl = pl3[i].copy()
+        else:
+            grp_min_gq = min(grp_min_gq, gqi)
+            grp_max_gq = max(grp_max_gq, gqi)
+            cand = min_dp[i] if min_dp[i] != _GQ_SENTINEL else dp[i]
+            if cand != _GQ_SENTINEL:
+                grp_dp = min(grp_dp, int(cand)) if grp_dp else int(cand)
+            np.minimum(grp_pl, pl3[i], out=grp_pl)
+    if n:
+        flush(n - 1)
+    return out_lines, n, len(out_lines)
+
+
+def compress_gvcf(input_path: str, output_path: str, refcall_gq_threshold: int = 22, merge_gq_threshold: int = 10):
+    table = read_vcf(input_path)
+    lines, n_in, n_out = compress_gvcf_table(table, refcall_gq_threshold, merge_gq_threshold)
+    _write_lines(output_path, table, lines)
+    return n_in, n_out
+
+
+def _fmt_qual(q) -> str:
+    if q is None or (isinstance(q, float) and np.isnan(q)):
+        return MISSING
+    q = float(q)
+    return str(int(q)) if q == int(q) else f"{q:g}"
+
+
+def _write_lines(path: str, table: VariantTable, lines: list[str]) -> None:
+    if str(path).endswith(".gz"):
+        from variantcalling_tpu.io.bgzf import BgzfWriter
+
+        out = BgzfWriter(path)
+    else:
+        out = open(path, "wt", encoding="utf-8")
+    with out:
+        for line in table.header.lines:
+            out.write(line + "\n")
+        out.write(table.header.column_header() + "\n")
+        for line in lines:
+            out.write(line + "\n")
+
+
+# ---------------------------------------------------------------------------
+# overlap cleanup (GLNexus pre-pass)
+# ---------------------------------------------------------------------------
+
+
+def cleanup_gvcf_table(table: VariantTable) -> tuple[np.ndarray, int, int]:
+    """Keep-mask over records: drop ./. records overlapping called deletions.
+
+    Reference semantics (cleanup_gvcf_before_calling.py:31-86): maintain a
+    buffer of records overlapping a deletion's span; if any record in the
+    buffer has a called non-ref GT, every ``./.`` record in the buffer is
+    dropped. Implemented as one pass over columnar arrays.
+    """
+    n = len(table)
+    gts = table.genotypes()
+    uncalled = gts[:, 0] == -1
+    called_alt = (gts[:, 0] > 0) | (gts[:, 1] > 0)
+    # max deletion length per record (ref longer than alt)
+    ref_len = np.fromiter((len(r) for r in table.ref), dtype=np.int64, count=n)
+    max_del = np.zeros(n, dtype=np.int64)
+    for i, alts in enumerate(table.alt_lists()):
+        best = 0
+        for a in alts:
+            if a.startswith("<"):
+                continue
+            d = int(ref_len[i]) - len(a)
+            if d > best:
+                best = d
+        max_del[i] = best
+
+    keep = np.ones(n, dtype=bool)
+    buf: list[int] = []
+    buf_chrom = ""
+    buf_span = -1
+    buf_has_called = False
+
+    def flush() -> None:
+        nonlocal buf, buf_has_called
+        if buf_has_called:
+            for idx in buf:
+                if uncalled[idx]:
+                    keep[idx] = False
+        buf = []
+        buf_has_called = False
+
+    for i in range(n):
+        if buf and (table.chrom[i] != buf_chrom or table.pos[i] > buf_span):
+            flush()
+        if buf:
+            buf.append(i)
+            if max_del[i] > 0:
+                buf_span = max(buf_span, int(table.pos[i]) + int(max_del[i]))
+        elif max_del[i] > 0:
+            buf = [i]
+            buf_chrom = table.chrom[i]
+            buf_span = int(table.pos[i]) + int(max_del[i])
+        if buf and called_alt[i]:
+            buf_has_called = True
+    flush()
+    n_written = int(keep.sum())
+    return keep, n_written, n - n_written
+
+
+def cleanup_gvcf(input_path: str, output_path: str) -> tuple[int, int]:
+    table = read_vcf(input_path)
+    keep, n_written, n_removed = cleanup_gvcf_table(table)
+    sub = _subset_table(table, keep)
+    write_vcf(output_path, sub)
+    return n_written, n_removed
+
+
+def _subset_table(table: VariantTable, mask: np.ndarray) -> VariantTable:
+    sub = VariantTable(
+        header=table.header,
+        chrom=table.chrom[mask],
+        pos=table.pos[mask],
+        vid=table.vid[mask],
+        ref=table.ref[mask],
+        alt=table.alt[mask],
+        qual=table.qual[mask],
+        filters=table.filters[mask],
+        info=table.info[mask],
+    )
+    if table.fmt_keys is not None:
+        sub.fmt_keys = table.fmt_keys[mask]
+        sub.sample_cols = table.sample_cols[mask]
+    return sub
+
+
+# ---------------------------------------------------------------------------
+# GQ-threshold BED
+# ---------------------------------------------------------------------------
+
+
+def gvcf_to_bed(gvcf_file: str, bed_file: str, gq_threshold: int = 20, gt: bool = True) -> int:
+    """Write BED of gVCF spans with GQ >= threshold (or < when ``gt=False``).
+
+    Reference semantics (gvcf_bed.py:9-69): refcall deletion blocks cover
+    only their first base; records starting before the running extent are
+    skipped; extent tracks the max end seen per chrom. Returns the skipped
+    count.
+    """
+    from variantcalling_tpu.io.bed import BedWriter
+
+    table = read_vcf(gvcf_file)
+    n = len(table)
+    gq = _int_format_field(table, "GQ")
+    gts = table.genotypes()
+    ref_len = np.fromiter((len(r) for r in table.ref), dtype=np.int64, count=n)
+    end_info = table.info_field("END", dtype=np.int64, missing=-1)
+    # 0-based start; stop = END if present else pos+len(ref)-1
+    start = table.pos - 1
+    stop = np.where(end_info >= 0, end_info, table.pos + ref_len - 1)
+    hom_ref = (gts[:, 0] == 0) & (gts[:, 1] == 0)
+    uncalled = gts[:, 0] == -1
+    no_gq = gq == _GQ_SENTINEL
+    refblock_del = (ref_len > 1) & (no_gq | hom_ref | uncalled)
+    end = np.where(refblock_del, start + 1, stop)
+
+    skipped = 0
+    extent = -1
+    last_chrom = ""
+    with BedWriter(bed_file) as bed:
+        for i in range(n):
+            chrom = table.chrom[i]
+            if chrom == last_chrom and start[i] < extent:
+                skipped += 1
+                continue
+            if chrom != last_chrom or extent < end[i]:
+                last_chrom = chrom
+                extent = int(end[i])
+            if gt:
+                if not no_gq[i] and gq[i] >= gq_threshold:
+                    bed.write(chrom, int(start[i]), int(end[i]))
+            else:
+                if no_gq[i] or gq[i] < gq_threshold:
+                    bed.write(chrom, int(start[i]), int(end[i]))
+    return skipped

@@ -70,12 +70,42 @@ def normalize_pl(pl: jnp.ndarray, valid: jnp.ndarray | None = None) -> jnp.ndarr
     return jnp.rint(shifted).astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnums=1)
+def diploid_pl_to_haploid(pl: jnp.ndarray, num_alt: int) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched diploid→haploid PL conversion for a fixed alt count.
+
+    Parity with the reference's non-PAR X/Y rewrite
+    (ugvc/pipelines/convert_haploid_regions.py:38-70): keep only the
+    homozygous likelihood mass, renormalize, re-phred with truncation,
+    shift to min 0. Returns (haploid_pl (…, A+1) int32, gq int32,
+    gt int32). GT is the **last** zero-PL allele and GQ the smallest
+    nonzero PL (10000 if none), matching the reference's scan order.
+    """
+    hom_idx = jnp.asarray([i * (i + 3) // 2 for i in range(num_alt + 1)], dtype=jnp.int32)
+    hom_pl = jnp.take(jnp.asarray(pl, dtype=jnp.result_type(float)), hom_idx, axis=-1)
+    # shift-invariant: normalize + clamp span to 350 so float32 unphred
+    # stays in normal range (no inf from underflowed likelihoods)
+    hom_pl = jnp.minimum(hom_pl - jnp.min(hom_pl, axis=-1, keepdims=True), 350.0)
+    hom = unphred(hom_pl)
+    hom = hom / jnp.sum(hom, axis=-1, keepdims=True)
+    hpl = jnp.trunc(phred(hom)).astype(jnp.int32)
+    hpl = hpl - jnp.min(hpl, axis=-1, keepdims=True)
+    is_zero = hpl == 0
+    # last zero index: scan order of the reference keeps overwriting
+    rev = jnp.flip(is_zero, axis=-1)
+    gt = (num_alt - jnp.argmax(rev, axis=-1)).astype(jnp.int32)
+    nonzero = jnp.where(is_zero, 10000, hpl)
+    gq = jnp.min(nonzero, axis=-1).astype(jnp.int32)
+    return hpl, gq, gt
+
+
 __all__ = [
     "genotype_ordering",
     "n_genotypes",
     "genotype_index",
     "pl_to_gq_gt",
     "normalize_pl",
+    "diploid_pl_to_haploid",
     "phred",
     "unphred",
 ]

@@ -18,6 +18,13 @@ import jax
 import jax.numpy as jnp
 
 from variantcalling_tpu.ops.genotypes import genotype_ordering
+from variantcalling_tpu.ops.math import phred, unphred
+
+# PL span clamp keeping 10**(-PL/10) inside float32 normal range (min normal
+# ~1.2e-38); PLs are shift-invariant here so clamping the span only caps
+# pathological >350 spreads instead of underflowing them to inf
+_PL_CLAMP = 350.0
+_PROB_FLOOR = 1e-37
 
 
 def genotype_priors(ds: jnp.ndarray, gt_table: jnp.ndarray, epsilon: float) -> jnp.ndarray:
@@ -55,12 +62,16 @@ def modify_stats_with_imp_batch(
 
     def one(pl_row, ds_row, cur_idx):
         f_gt = genotype_priors(ds_row, gt_table, epsilon)
-        unphred = jnp.power(10.0, -pl_row / 10.0)
-        pl_f = unphred * f_gt
-        alt_sum_u = jnp.sum(unphred[1:])
-        alt_sum_f = jnp.maximum(jnp.sum(pl_f[1:]), 1e-300)
-        scaled = jnp.concatenate([unphred[:1], alt_sum_u / alt_sum_f * pl_f[1:]])
-        phredded = -10.0 * jnp.log10(jnp.maximum(scaled, 1e-300))
+        # PLs are shift-invariant through this whole transform (uniform
+        # likelihood scale cancels in the ratio and the final min-shift), so
+        # normalize + clamp to keep float32 out of underflow territory
+        pl_row = jnp.minimum(pl_row - jnp.min(pl_row), _PL_CLAMP)
+        likelihood = unphred(pl_row)
+        pl_f = likelihood * f_gt
+        alt_sum_u = jnp.sum(likelihood[1:])
+        alt_sum_f = jnp.maximum(jnp.sum(pl_f[1:]), _PROB_FLOOR)
+        scaled = jnp.concatenate([likelihood[:1], alt_sum_u / alt_sum_f * pl_f[1:]])
+        phredded = phred(jnp.maximum(scaled, _PROB_FLOOR))
         min_pl = jnp.min(phredded)
         # tie rule (:243-247): keep the current GT when its new PL equals the min
         keep = phredded[cur_idx] == min_pl
@@ -74,10 +85,14 @@ def modify_stats_with_imp_batch(
 
 
 def gt_to_index(gt: np.ndarray, num_alt: int) -> np.ndarray:
-    """(N, 2) genotype pairs -> row index in genotype_ordering(num_alt)."""
+    """(N, 2) genotype pairs -> row index in genotype_ordering(num_alt).
+
+    Pairs not in the diploid table (haploid calls, half-missing ``./1``)
+    map to -1; callers must exclude those rows before the kernel.
+    """
     table = genotype_ordering(num_alt)
     lut = {tuple(row): i for i, row in enumerate(table.tolist())}
     return np.asarray(
-        [lut.get((int(min(a, b)), int(max(a, b))), 0) for a, b in gt],
+        [lut.get((int(min(a, b)), int(max(a, b))), -1) for a, b in gt],
         dtype=np.int32,
     )

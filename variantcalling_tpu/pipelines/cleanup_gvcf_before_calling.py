@@ -1,0 +1,27 @@
+"""cleanup_gvcf_before_calling — drop ./. records overlapping called deletions.
+
+Drop-in surface of the reference tool
+(ugvc/joint/cleanup_gvcf_before_calling.py:11-95): positional
+``input_gvcf output_gvcf``. GLNexus joint-calling pre-pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu.joint.gvcf import cleanup_gvcf
+
+
+def run(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="cleanup_gvcf_before_calling", description=__doc__)
+    ap.add_argument("input_gvcf")
+    ap.add_argument("output_gvcf")
+    args = ap.parse_args(argv)
+    n_written, n_removed = cleanup_gvcf(args.input_gvcf, args.output_gvcf)
+    sys.stderr.write(f"Written {n_written} records, removed {n_removed} records\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,106 @@
+"""convert_haploid_regions — rewrite diploid PL/GQ/GT as haploid in given regions.
+
+Drop-in surface of the reference tool
+(ugvc/pipelines/convert_haploid_regions.py:9-103): ``--input_vcf
+--output_vcf --haploid_regions <bed|hg38_non_par>``. The PL conversion runs
+as one batched device kernel per alt-count bucket
+(:func:`variantcalling_tpu.ops.genotypes.diploid_pl_to_haploid`) instead of
+the reference's per-record Python loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu.io.bed import read_bed
+from variantcalling_tpu.io.vcf import MISSING, read_vcf, write_vcf
+from variantcalling_tpu.ops.genotypes import diploid_pl_to_haploid, n_genotypes
+
+# reference hardcodes hg38 non-pseudoautosomal X/Y spans
+# (convert_haploid_regions.py:85-89); 1-based inclusive (chrom, start, end)
+HG38_NON_PAR = [
+    ("chrX", 1, 10001),
+    ("chrX", 2781479, 155701383),
+    ("chrX", 156030895, 156040895),
+    ("chrY", 1, 10001),
+    ("chrY", 2781479, 56887903),
+]
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="convert_haploid_regions", description=__doc__)
+    ap.add_argument("--input_vcf", required=True)
+    ap.add_argument("--output_vcf", required=True)
+    ap.add_argument(
+        "--haploid_regions",
+        required=True,
+        help="BED of haploid regions, or 'hg38_non_par' for the hardcoded hg38 non-PAR X/Y spans",
+    )
+    return ap.parse_args(argv)
+
+
+def _in_regions_mask(chrom: np.ndarray, pos: np.ndarray, regions: list[tuple[str, int, int]]) -> np.ndarray:
+    mask = np.zeros(len(pos), dtype=bool)
+    for rc, rs, re in regions:
+        mask |= (chrom == rc) & (pos > rs) & (pos <= re)
+    return mask
+
+
+def convert_haploid(table, regions: list[tuple[str, int, int]]):
+    """New (fmt-preserving) sample strings with haploid GT/GQ/PL in regions."""
+    n = len(table)
+    in_region = _in_regions_mask(table.chrom, table.pos, regions)
+    gt_raw = table.format_field("GT")
+    pl_raw = table.format_field("PL")
+    n_alts = table.n_alts()
+    new_sample = np.array(table.sample_cols[:, 0], dtype=object, copy=True)
+
+    # bucket region records by alt count; one device kernel call per bucket
+    for a in np.unique(n_alts[in_region]):
+        a = int(a)
+        g = n_genotypes(a)
+        rows = [
+            i
+            for i in np.nonzero(in_region & (n_alts == a))[0]
+            if pl_raw[i] not in (None, MISSING, "") and len(pl_raw[i].split(",")) == g
+        ]
+        if not rows:
+            continue
+        pl = np.asarray([[float(x) for x in pl_raw[i].split(",")] for i in rows])
+        if pl.shape[1] == 2:  # already haploid
+            continue
+        hpl, gq, gt = (np.asarray(x) for x in diploid_pl_to_haploid(pl, a))
+        for bi, i in enumerate(rows):
+            keys = table.fmt_keys[i].split(":")
+            vals = table.sample_cols[i][0].split(":")
+            vals += [MISSING] * (len(keys) - len(vals))
+            d = dict(zip(keys, vals))
+            # maintain no-call (reference convert_haploid_regions.py:65-66)
+            d["GT"] = MISSING if gt_raw[i] in (None, MISSING, "") or gt_raw[i].split("/")[0].split("|")[0] == MISSING else str(int(gt[bi]))
+            if "GQ" in d:
+                d["GQ"] = str(int(gq[bi]))
+            d["PL"] = ",".join(str(int(x)) for x in hpl[bi])
+            new_sample[i] = ":".join(d.get(k, MISSING) for k in keys)
+    return new_sample, int(in_region.sum())
+
+
+def run(argv: list[str]):
+    """Convert genotypes of specified regions to haploid calls, maintaining GT,GQ,PL."""
+    args = parse_args(argv)
+    if args.haploid_regions == "hg38_non_par":
+        regions = HG38_NON_PAR
+    else:
+        bed = read_bed(args.haploid_regions)
+        regions = [(str(c), int(s), int(e)) for c, s, e in zip(bed.chrom, bed.start, bed.end)]
+    table = read_vcf(args.input_vcf)
+    new_sample, n_conv = convert_haploid(table, regions)
+    write_vcf(args.output_vcf, table, sample_overrides={0: new_sample})
+    sys.stderr.write(f"convert_haploid_regions: {n_conv} records in haploid regions\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -55,7 +55,10 @@ def run(argv: list[str]) -> int:
     has_ds = np.array([r is not None for r in table.format_field("DS")])
     is_pass = np.array([f in ("PASS", ".", "") for f in table.filters])
     has_alt = (gts > 0).any(axis=1)
-    eligible = is_pass & has_alt & has_ds & (n_alts >= 1) & (n_alts <= MAX_ALTS)
+    # diploid fully-called only: haploid / half-missing GTs have no row in
+    # the genotype-ordering table and must not be force-rewritten
+    diploid_called = (gts >= 0).all(axis=1)
+    eligible = is_pass & has_alt & diploid_called & has_ds & (n_alts >= 1) & (n_alts <= MAX_ALTS)
 
     # outputs default to passthrough
     new_gt_str = np.array([None] * n, dtype=object)
@@ -71,12 +74,15 @@ def run(argv: list[str]) -> int:
         counters[vtypes[i]]["pass"] += 1
 
     changed = 0
+    # parse PL once for the whole table at the widest genotype count; each
+    # alt-count group slices its prefix
+    pl_all = table.format_numeric("PL", max_len=n_genotypes(MAX_ALTS), missing=np.nan)
     for num_alt in range(1, MAX_ALTS + 1):
         m = eligible & (n_alts == num_alt)
         if not m.any():
             continue
         g = n_genotypes(num_alt)
-        pl = table.format_numeric("PL", max_len=g, missing=np.nan)[m]
+        pl = pl_all[m][:, :g]
         ok = ~np.isnan(pl).any(axis=1)
         idx = np.nonzero(m)[0][ok]
         if len(idx) == 0:
@@ -84,6 +90,10 @@ def run(argv: list[str]) -> int:
         pl = pl[ok]
         ds = ds_raw[m][ok][:, :num_alt] if ds_raw.shape[1] >= num_alt else np.full((len(idx), num_alt), np.nan)
         cur_idx = gt_to_index(gts[idx], num_alt)
+        valid_gt = cur_idx >= 0
+        idx, pl, ds, cur_idx = idx[valid_gt], pl[valid_gt], ds[valid_gt], cur_idx[valid_gt]
+        if len(idx) == 0:
+            continue
         npl, ngq, nidx = modify_stats_with_imp_batch(
             jnp.asarray(pl), jnp.asarray(ds), jnp.asarray(cur_idx), num_alt, args.epsilon
         )
@@ -104,10 +114,11 @@ def run(argv: list[str]) -> int:
                 counters[vt]["changed_gt"] += 1
                 changed += 1
 
-    # rebuild sample strings with GT0/GQ0/PL0 retention
-    table.header.lines.append('##FORMAT=<ID=GT0,Number=1,Type=String,Description="Genotype (pre-imputation)">')
-    table.header.lines.append('##FORMAT=<ID=GQ0,Number=1,Type=Integer,Description="GQ (pre-imputation)">')
-    table.header.lines.append('##FORMAT=<ID=PL0,Number=G,Type=Integer,Description="PL (pre-imputation)">')
+    # rebuild sample strings with GT0/GQ0/PL0 retention (idempotent on re-run)
+    table.header.ensure_format("GT0", "1", "String", "Genotype (pre-imputation)")
+    table.header.ensure_format("GQ0", "1", "Integer", "GQ (pre-imputation)")
+    table.header.ensure_format("PL0", "G", "Integer", "PL (pre-imputation)")
+    retained = ("GT0", "GQ0", "PL0")
     fmt_override = np.array(table.fmt_keys, dtype=object)
     sample0 = np.array(table.sample_cols[:, 0], dtype=object)
     for i in range(n):
@@ -123,9 +134,16 @@ def run(argv: list[str]) -> int:
         kv["GT0"] = old_gt.replace("/", "|")
         kv["GQ0"] = old_gq
         kv["PL0"] = old_pl
-        order = [k for k in keys if k in kv] + ["GT0", "GQ0", "PL0"]
+        order = [k for k in keys if k not in retained]
+        # the rewrite always produces GQ/PL values — emit them even when the
+        # input FORMAT lacked the key (GQ right after GT per convention)
+        if "GQ" not in order:
+            order.insert(1 if order and order[0] == "GT" else 0, "GQ")
+        if "PL" not in order:
+            order.append("PL")
+        order += list(retained)
         fmt_override[i] = ":".join(order)
-        sample0[i] = ":".join(kv[k] for k in order)
+        sample0[i] = ":".join(kv.get(k, ".") for k in order)
 
     write_vcf(args.output_vcf, table, fmt_override=fmt_override, sample_overrides={0: sample0})
 

@@ -1,0 +1,44 @@
+"""gvcf_hcr — high-confidence-region BED from a gVCF by GQ threshold.
+
+Drop-in surface of the reference tool (ugvc/pipelines/vcfbed/gvcf_hcr_main.py
++ gvcf_hcr.py): select gVCF spans with GQ >= threshold (or below, with
+``--below``), then merge adjacent/overlapping intervals (the reference
+shells to ``bedtools merge``; here the merge is the in-process interval
+sweep of :mod:`variantcalling_tpu.io.bed`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu.io.bed import BedWriter, read_bed
+from variantcalling_tpu.joint.gvcf import gvcf_to_bed
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="gvcf_hcr", description=__doc__)
+    ap.add_argument("--gvcf", required=True, help="Input gVCF")
+    ap.add_argument("--output_bed", required=True, help="Output merged BED")
+    ap.add_argument("--gq_threshold", type=int, default=20)
+    ap.add_argument("--below", action="store_true", help="Select GQ < threshold instead of >=")
+    return ap.parse_args(argv)
+
+
+def run(argv: list[str]):
+    args = parse_args(argv)
+    raw_bed = args.output_bed + ".raw.tmp"
+    skipped = gvcf_to_bed(args.gvcf, raw_bed, gq_threshold=args.gq_threshold, gt=not args.below)
+    merged = read_bed(raw_bed).merged()
+    with BedWriter(args.output_bed) as bw:
+        for chrom, start, end in zip(merged.chrom, merged.start, merged.end):
+            bw.write(str(chrom), int(start), int(end))
+    import os
+
+    os.remove(raw_bed)
+    sys.stderr.write(f"gvcf_hcr: wrote {len(merged)} merged intervals ({skipped} records skipped)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

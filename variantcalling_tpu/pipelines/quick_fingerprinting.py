@@ -1,0 +1,51 @@
+"""quick_fingerprinting — verify sample identity of BAMs vs known ground truths.
+
+Drop-in surface of the reference CLI
+(ugvc/pipelines/comparison/quick_fingerprinting.py:14-81): JSON conf with
+``cram_files`` (sample -> [paths]), ``ground_truth_vcf_files``,
+``ground_truth_hcr_files``, ``references.ref_fasta``. This framework's
+caller reads BAM directly (use ``samtools view -b`` upstream for CRAM).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from variantcalling_tpu.comparison.pileup_caller import VariantHitFractionCaller
+from variantcalling_tpu.comparison.quick_fingerprinter import QuickFingerprinter
+
+
+def run(argv: list[str]):
+    """quick fingerprinting to identify known samples in bams/crams"""
+    ap = argparse.ArgumentParser(prog="quick_fingerprinting", description=run.__doc__)
+    ap.add_argument("--json_conf", required=True, help="json with sample-names, crams, and ground truth files")
+    ap.add_argument(
+        "--region_str",
+        type=str,
+        default="chr15:26000000-26200000",
+        help="region subset string, compare variants only in this region",
+    )
+    VariantHitFractionCaller.add_args_to_parser(ap)
+    ap.add_argument("--out_dir", type=str, required=True, help="output directory")
+    args = ap.parse_args(argv)
+
+    with open(args.json_conf, encoding="utf-8") as fh:
+        conf = json.load(fh)
+
+    QuickFingerprinter(
+        conf["cram_files"],
+        conf["ground_truth_vcf_files"],
+        conf["ground_truth_hcr_files"],
+        conf["references"]["ref_fasta"],
+        args.region_str,
+        args.min_af_snps,
+        args.min_hit_fraction_target,
+        args.out_dir,
+    ).check()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

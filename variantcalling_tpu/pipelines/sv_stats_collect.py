@@ -1,0 +1,160 @@
+"""sv_stats_collect — SV size/type histograms + ground-truth concordance.
+
+Drop-in surface of the reference tool (ugvc/pipelines/sv_stats_collect.py:
+16-262): positional ``svcall_vcf output_file`` with ``--concordance_h5`` /
+``--ignore_filter``; pickled results dict with keys ``type_counts``,
+``length_counts``, ``length_by_type_counts`` and, with a concordance h5
+(keys ``base``/``calls``), ``concordance`` + ``fp_stats``. Histograms are
+computed from the columnar VCF table; PR/ROC uses the FN-mask-aware curve
+(utils/stats_utils, parity with ugbio_core.stats_utils).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.io.vcf import MISSING, read_vcf
+from variantcalling_tpu.utils.stats_utils import precision_recall_curve
+
+SVBINS = [0, 100, 300, 500, 1000, 2000, 3000, 5000, 10000, 100000, 1000000, float("inf")]
+SVLABELS = ["50-100", "100-300", "300-500", "0.5-1k", "1k-2k", "2k-3k", "3k-5k", "5k-10k", "10k-100k", "100k-1M", ">1M"]
+
+MIN_CLASS_COUNTS_TO_OUTPUT = 20
+
+
+def collect_size_type_histograms(svcall_vcf: str, ignore_filter: bool = False) -> dict[str, pd.DataFrame]:
+    """Size and type histograms from an SV call VCF (reference :16-60)."""
+    table = read_vcf(svcall_vcf, drop_format=True)
+    svlen = table.info_field("SVLEN", dtype=np.float64, missing=np.nan)
+    svtype = np.array(
+        [_info_str(s, "SVTYPE") for s in table.info], dtype=object
+    )
+    df = pd.DataFrame({"svlen": svlen, "svtype": svtype, "filter": table.filters})
+    if not ignore_filter:
+        df = df[df["filter"].isin(["PASS", "", MISSING])]
+    df["svlen"] = df["svlen"].fillna(0)
+    df["binned_svlens"] = pd.cut(df["svlen"].abs(), bins=SVBINS, labels=SVLABELS, right=False)
+    type_counts = df["svtype"].value_counts()
+    length_counts = df["binned_svlens"].value_counts().sort_index()
+    by_type = df.groupby(["svtype", "binned_svlens"], observed=False).size().unstack().fillna(0)
+    by_type = by_type.reindex(columns=SVLABELS, fill_value=0)
+    by_type = by_type.drop("CTX", errors="ignore")
+    return {"type_counts": type_counts, "length_counts": length_counts, "length_by_type_counts": by_type}
+
+
+def _info_str(info: str, key: str) -> str:
+    if info in (None, MISSING, ""):
+        return ""
+    for part in info.split(";"):
+        if part.startswith(key + "="):
+            return part.split("=", 1)[1]
+    return ""
+
+
+def concordance_with_gt(df_base: pd.DataFrame, df_calls: pd.DataFrame) -> pd.Series:
+    """TP/FN/FP + precision/recall/F1 from labeled base/calls frames (:63-97)."""
+    tp_base = int((df_base["label"] == "TP").sum())
+    tp_calls = int((df_calls["label"] == "TP").sum())
+    fn = int((df_base["label"] == "FN").sum())
+    fp = int((df_calls["label"] == "FP").sum())
+    precision = tp_calls / (tp_calls + fp) if (tp_calls + fp) > 0 else 0
+    recall = tp_base / (tp_base + fn) if (tp_base + fn) > 0 else 0
+    f1 = 2 * precision * recall / (precision + recall) if (precision + recall) > 0 else 0
+    return pd.Series(
+        {"TP_base": tp_base, "TP_calls": tp_calls, "FN": fn, "FP": fp, "Precision": precision, "Recall": recall, "F1": f1}
+    )
+
+
+def concordance_with_gt_roc(df_base: pd.DataFrame, df_calls: pd.DataFrame) -> pd.Series:
+    """Precision/recall/threshold arrays; FN records fold into recall (:100-130)."""
+    gt = pd.concat((df_base[df_base["label"] == "FN"], df_calls))
+    predictions = gt["qual"].fillna(0)
+    fn_mask = gt["label"] == "FN"
+    labels = gt["label"].replace({"FN": "TP"})
+    precision, recall, thresholds, _ = precision_recall_curve(
+        np.array(labels),
+        np.array(predictions),
+        np.array(fn_mask),
+        pos_label="TP",
+        min_class_counts_to_output=MIN_CLASS_COUNTS_TO_OUTPUT,
+    )
+    return pd.Series(dict(zip(["precision", "recall", "thresholds"], [precision, recall, thresholds])))
+
+
+def collect_sv_stats(
+    svcall_vcf: str, concordance_h5: str | None = None, ignore_filter: bool = False
+) -> tuple[dict, dict, pd.Series]:
+    sv_stats = collect_size_type_histograms(svcall_vcf, ignore_filter=ignore_filter)
+    concordance_stats: dict = {}
+    fp_stats = pd.Series(dtype="int64")
+    if concordance_h5 is not None:
+        df_base = pd.read_hdf(concordance_h5, key="base")
+        df_calls = pd.read_hdf(concordance_h5, key="calls")
+        for df in (df_base, df_calls):
+            df["binned_svlens"] = pd.cut(df["svlen_int"].abs(), bins=SVBINS, labels=SVLABELS, right=False)
+
+        for svtype in ["ALL", "DEL", "DUP", "INV", "INS", "CTX"]:
+            b = df_base if svtype == "ALL" else df_base[df_base["svtype"] == svtype]
+            c = df_calls if svtype == "ALL" else df_calls[df_calls["svtype"] == svtype]
+            concordance_stats[f"{svtype}_concordance"] = concordance_with_gt(b, c)
+            concordance_stats[f"{svtype}_roc"] = concordance_with_gt_roc(b, c)
+
+        for svtype in ["ALL", "DEL", "INS"]:
+            for len_bin in SVLABELS:
+                b = df_base if svtype == "ALL" else df_base[df_base["svtype"] == svtype]
+                c = df_calls if svtype == "ALL" else df_calls[df_calls["svtype"] == svtype]
+                b = b[b["binned_svlens"] == len_bin]
+                c = c[c["binned_svlens"] == len_bin]
+                concordance_stats[f"{svtype}_{len_bin}_concordance"] = concordance_with_gt(b, c).drop(
+                    ["FP", "Precision", "F1"]
+                )
+        fp_stats = (
+            df_calls[df_calls["label"] == "FP"][["svtype", "binned_svlens"]]
+            .value_counts()
+            .sort_index()
+            .astype("int64")
+        )
+    return sv_stats, concordance_stats, fp_stats
+
+
+def run(argv: list[str]):
+    ap = argparse.ArgumentParser(
+        prog="sv_stats_collect",
+        description="Collect SV statistics from a VCF file and (optionally) concordance H5.",
+    )
+    ap.add_argument("svcall_vcf", type=str, help="Path to the SV call VCF file.")
+    ap.add_argument("output_file", type=str, help="Output PKL file.")
+    ap.add_argument("--concordance_h5", type=str, default=None)
+    ap.add_argument("--ignore_filter", action="store_true", default=False)
+    args = ap.parse_args(argv)
+
+    sv_stats, concordance_stats, fp_stats = collect_sv_stats(args.svcall_vcf, args.concordance_h5, args.ignore_filter)
+    results: dict = {}
+    if concordance_stats:
+        concordance_df = pd.DataFrame({k: v for k, v in concordance_stats.items() if "concordance" in k}).T
+        idx = pd.DataFrame(
+            [x.split("_") if x.count("_") == 2 else x.replace("_", "__").split("_") for x in concordance_df.index]
+        )
+        idx = idx.drop(2, axis=1)
+        idx.columns = ["SV type", "SV length"]
+        concordance_df = pd.concat([idx, concordance_df.reset_index().drop("index", axis=1)], axis=1).set_index(
+            ["SV type", "SV length"]
+        )
+        roc_df = pd.DataFrame({k: v for k, v in concordance_stats.items() if "roc" in k}).T
+        roc_df = pd.concat([idx, roc_df.reset_index().drop("index", axis=1)], axis=1).set_index(["SV type", "SV length"])
+        roc_df = roc_df.rename(columns={"precision": "precision roc", "recall": "recall roc"})
+        results["concordance"] = pd.concat((concordance_df, roc_df), axis=1)
+        results["fp_stats"] = fp_stats
+    results.update(sv_stats)
+    with open(args.output_file, "wb") as f:
+        pickle.dump(results, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
