@@ -1,0 +1,184 @@
+"""Unit tests: flow-space keys, hpol table, bridging SNV calibration, consistency check."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tests.fixtures import write_bam, write_fasta
+
+from variantcalling_tpu.utils.flow import generate_key_from_sequence, key_to_base_index
+
+
+class TestFlowKeys:
+    def test_simple_sequence(self):
+        # TGCA order: 'T' consumed at flow 0, 'G' flow 1, 'C' flow 2, 'A' flow 3
+        key = generate_key_from_sequence("TGCA")
+        assert key.tolist() == [1, 1, 1, 1]
+
+    def test_hmer_counts(self):
+        key = generate_key_from_sequence("TTGGGA")
+        # T run len 2 at flow 0, G run len 3 at flow 1, A run len 1 at flow 3
+        assert key.tolist() == [2, 3, 0, 1]
+
+    def test_skipped_flows_cycle(self):
+        # sequence 'A' first: flows T,G,C empty then A
+        key = generate_key_from_sequence("A")
+        assert key.tolist() == [0, 0, 0, 1]
+        # 'AT': A at flow 3, then T needs next cycle flow 4
+        key = generate_key_from_sequence("AT")
+        assert key.tolist() == [0, 0, 0, 1, 1]
+
+    def test_same_base_cycle_advance(self):
+        # 'TATTT' : T@0, A@3, then T again -> flow 4 (full cycle from 3 to 4)
+        key = generate_key_from_sequence("TAT")
+        assert key.tolist() == [1, 0, 0, 1, 1]
+
+    def test_non_standard(self):
+        with pytest.raises(ValueError):
+            generate_key_from_sequence("TGNCA")
+        key = generate_key_from_sequence("TGNCA", non_standard_as_a=True)
+        # N->A: T@0 G@1 A@3 C@6 A@7
+        assert key.tolist() == [1, 1, 0, 1, 0, 0, 1, 1]
+
+    def test_roundtrip_base_index(self):
+        seq = "TTGGGCAATG"
+        key = generate_key_from_sequence(seq)
+        k2base = key_to_base_index(key)
+        # every nonzero flow's base index points at the run start
+        for f in np.nonzero(key)[0]:
+            b = int(k2base[f])
+            assert seq[b] == "TGCA"[f % 4]
+
+
+def test_collect_hpol_table(tmp_path):
+    from variantcalling_tpu.pipelines.collect_hpol_table import run
+
+    # genome with known runs: CCCC at 10, TTTTT at 30
+    seq = list("AGAGAGAGAG" * 10)
+    seq[10:14] = "CCCC"
+    seq[30:35] = "TTTTT"
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": "".join(seq)})
+    (tmp_path / "regions.bed").write_text("chr1\t0\t100\n")
+    out = tmp_path / "hpol.tsv"
+    run(
+        [
+            "--reference", str(tmp_path / "ref.fa"),
+            "--collection_regions", str(tmp_path / "regions.bed"),
+            "--output", str(out),
+            "--max_hpol_length", "10",
+            "--max_number_to_collect", "1000",
+        ]
+    )
+    rows = [l.split("\t") for l in out.read_text().splitlines()]
+    by_len_nuc = {(int(r[2]), r[3]): r for r in rows}
+    assert (4, "C") in by_len_nuc
+    assert (5, "T") in by_len_nuc
+    c_row = by_len_nuc[(4, "C")]
+    assert c_row[0] == "chr1" and int(c_row[1]) == 10
+
+
+class TestBridgingSnvs:
+    HEADER = (
+        "##fileformat=VCFv4.2\n"
+        '##FILTER=<ID=LowQual,Description="l">\n'
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+        '##FORMAT=<ID=AD,Number=R,Type=Integer,Description="a">\n'
+        '##FORMAT=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+        '##FORMAT=<ID=BG_AD,Number=R,Type=Integer,Description="b">\n'
+        '##FORMAT=<ID=BG_DP,Number=1,Type=Integer,Description="b">\n'
+        "##contig=<ID=chr1,length=10000>\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n"
+    )
+
+    def _run(self, tmp_path, seq, rows):
+        from variantcalling_tpu.pipelines.calibrate_bridging_snvs import run
+        from variantcalling_tpu.io.vcf import read_vcf
+
+        write_fasta(str(tmp_path / "ref.fa"), {"chr1": seq})
+        (tmp_path / "in.vcf").write_text(self.HEADER + "\n".join(rows) + "\n")
+        out = tmp_path / "out.vcf"
+        run(["--vcf", str(tmp_path / "in.vcf"), "--reference", str(tmp_path / "ref.fa"), "--output", str(out)])
+        return read_vcf(str(out))
+
+    def test_rescues_bridging_snv(self, tmp_path):
+        # ref: ...GGGG C GGGG... variant C->G at pos 21 bridges into a 9-mer
+        seq = "A" * 16 + "GGGG" + "C" + "GGGG" + "A" * 75
+        fmt = "GT:AD:DP:BG_AD:BG_DP"
+        rows = [f"chr1\t21\t.\tC\tG\t10\tLowQual\t.\t{fmt}\t0/1:10,10:20:15,0:15"]
+        t = self._run(tmp_path, seq, rows)
+        assert t.filters[0] == "PASS"
+        assert t.qual[0] == 20
+
+    def test_tandem_repeat_not_rescued(self, tmp_path):
+        # symmetric arms with matching bounding base == ref: tandem repeat
+        seq = "A" * 15 + "C" + "GG" + "C" + "GG" + "C" + "A" * 79
+        fmt = "GT:AD:DP:BG_AD:BG_DP"
+        rows = [f"chr1\t19\t.\tC\tG\t10\tLowQual\t.\t{fmt}\t0/1:10,10:20:15,0:15"]
+        t = self._run(tmp_path, seq, rows)
+        assert t.filters[0] == "LowQual"
+
+    def test_high_normal_vaf_not_rescued(self, tmp_path):
+        seq = "A" * 16 + "GGGG" + "C" + "GGGG" + "A" * 75
+        fmt = "GT:AD:DP:BG_AD:BG_DP"
+        rows = [f"chr1\t21\t.\tC\tG\t10\tLowQual\t.\t{fmt}\t0/1:10,10:20:10,5:15"]
+        t = self._run(tmp_path, seq, rows)
+        assert t.filters[0] == "LowQual"
+
+    def test_pass_record_untouched(self, tmp_path):
+        seq = "A" * 16 + "GGGG" + "C" + "GGGG" + "A" * 75
+        fmt = "GT:AD:DP:BG_AD:BG_DP"
+        rows = [f"chr1\t21\t.\tC\tG\t50\tPASS\t.\t{fmt}\t0/1:10,10:20:15,0:15"]
+        t = self._run(tmp_path, seq, rows)
+        assert t.qual[0] == 50
+
+
+def test_training_set_consistency(tmp_path):
+    from variantcalling_tpu.pipelines.training_set_consistency_check import run
+
+    genome = {"chr1": "A" * 300}
+    write_fasta(str(tmp_path / "ref.fa"), genome)
+
+    def mk_bam(path, alt_positions):
+        seq = ["A"] * 200
+        for p in alt_positions:
+            seq[p] = "G"
+        reads = [{"contig": "chr1", "pos": 0, "cigar": [("M", 200)], "seq": "".join(seq)} for _ in range(10)]
+        write_bam(str(path), {"chr1": 300}, reads)
+
+    mk_bam(tmp_path / "tumor.bam", [50, 80, 110])
+    mk_bam(tmp_path / "normal.bam", [140])
+
+    vcf_header = "##fileformat=VCFv4.2\n##contig=<ID=chr1,length=300>\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    (tmp_path / "gt.vcf").write_text(
+        vcf_header + "".join(f"chr1\t{p + 1}\t.\tA\tG\t50\tPASS\t.\n" for p in (50, 80, 110))
+    )
+    (tmp_path / "hcr.bed").write_text("chr1\t0\t300\n")
+    (tmp_path / "ti.interval_list").write_text("@HD\tVN:1.6\nchr1\t1\t300\t+\tti\n")
+    conf = {
+        "wf.references": {"ref_fasta": str(tmp_path / "ref.fa")},
+        "wf.cram_files": [[str(tmp_path / "tumor.bam")]],
+        "wf.background_cram_files": [[str(tmp_path / "normal.bam")]],
+        "wf.ground_truth_vcf_files": [str(tmp_path / "gt.vcf")],
+        "wf.training_hcr_files": [str(tmp_path / "hcr.bed")],
+        "wf.training_intervals": [str(tmp_path / "ti.interval_list")],
+    }
+    (tmp_path / "conf.json").write_text(json.dumps(conf))
+    # consistent setup: no error
+    run(["--training_json_conf", str(tmp_path / "conf.json"), "--region_str", "chr1:1-300", "--out_dir", str(tmp_path / "out")])
+
+    # swapped: normal as target anti-correlates -> suspected normal-in-tumor,
+    # and it matches the normal's germline set, so still no error; but with no
+    # normals listed it must fail
+    conf_bad = dict(conf)
+    conf_bad["wf.cram_files"] = [[str(tmp_path / "normal.bam")]]
+    conf_bad["wf.background_cram_files"] = []
+    (tmp_path / "conf_bad.json").write_text(json.dumps(conf_bad))
+    with pytest.raises(RuntimeError):
+        run(
+            [
+                "--training_json_conf", str(tmp_path / "conf_bad.json"),
+                "--region_str", "chr1:1-300",
+                "--out_dir", str(tmp_path / "out_bad"),
+            ]
+        )

@@ -1,0 +1,113 @@
+"""Unit tests: featuremap ingest + LPR train/apply round trip."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.fixtures import write_fasta
+
+FM_HEADER = (
+    "##fileformat=VCFv4.2\n"
+    '##INFO=<ID=X_SCORE,Number=1,Type=Float,Description="s">\n'
+    '##INFO=<ID=X_EDIST,Number=1,Type=Integer,Description="e">\n'
+    '##INFO=<ID=X_MAPQ,Number=1,Type=Integer,Description="m">\n'
+    '##INFO=<ID=X_READ_COUNT,Number=1,Type=Integer,Description="rc">\n'
+    '##INFO=<ID=RN,Number=1,Type=String,Description="read name">\n'
+    "##contig=<ID=chr1,length=100000>\n"
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+)
+
+
+def _write_featuremap(path, seq, rng):
+    """TP loci: 20 reads of 20 (af=1); FP loci: 1 read of 50 (af=0.02)."""
+    rows = []
+    for locus_i in range(10):
+        pos = 100 + locus_i * 50
+        ref = seq[pos - 1]
+        alt = "ACGT"[("ACGT".index(ref) + 1) % 4]
+        for r in range(20):  # TP: high score reads
+            score = 8 + rng.random() * 2
+            rows.append(
+                f"chr1\t{pos}\t.\t{ref}\t{alt}\t50\tPASS\t"
+                f"X_SCORE={score:.2f};X_EDIST=1;X_MAPQ=60;X_READ_COUNT=20;RN=r{locus_i}_{r}"
+            )
+    for locus_i in range(40):
+        pos = 1000 + locus_i * 20
+        ref = seq[pos - 1]
+        alt = "ACGT"[("ACGT".index(ref) + 2) % 4]
+        score = 1 + rng.random() * 2  # FP: low score
+        rows.append(
+            f"chr1\t{pos}\t.\t{ref}\t{alt}\t50\tPASS\t"
+            f"X_SCORE={score:.2f};X_EDIST=3;X_MAPQ=20;X_READ_COUNT=50;RN=f{locus_i}"
+        )
+    path.write_text(FM_HEADER + "\n".join(rows) + "\n")
+
+
+def test_featuremap_to_dataframe(tmp_path, rng):
+    from variantcalling_tpu.io.featuremap import featuremap_to_dataframe, numeric_feature_columns
+
+    seq = "ACGT" * 25000
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": seq})
+    fm = tmp_path / "fm.vcf"
+    _write_featuremap(fm, seq, rng)
+    df = featuremap_to_dataframe(str(fm), str(tmp_path / "ref.fa"))
+    assert len(df) == 240
+    assert "x_score" in df.columns and "x_read_count" in df.columns
+    assert "rn" in df.columns  # string field
+    assert "ref_motif" in df.columns
+    assert all(len(m) == 3 for m in df["ref_motif"])
+    feats = numeric_feature_columns(df)
+    assert "x_score" in feats and "rn" not in feats
+
+
+def test_lpr_train_and_apply(tmp_path, rng):
+    from variantcalling_tpu.pipelines.lpr.train_lib_prep_recalibration_model import run as train_run
+    from variantcalling_tpu.pipelines.lpr.filter_vcf_with_lib_prep_recalibration_model import run as filter_run
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    seq = "ACGT" * 25000
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": seq})
+    fm = tmp_path / "fm.vcf"
+    _write_featuremap(fm, seq, rng)
+    out_dir = tmp_path / "lpr"
+    train_run(
+        [
+            "--out_dir", str(out_dir),
+            "--ref_fasta", str(tmp_path / "ref.fa"),
+            "--featuremap_vcf", str(fm),
+            "--n_trees", "20",
+            "--depth", "4",
+        ]
+    )
+    assert (out_dir / "labeled_featuremap_training_set.parquet").exists()
+    model_file = out_dir / "lib_prep_model.npz"
+    assert model_file.exists()
+    labeled = pd.read_parquet(out_dir / "labeled_featuremap_training_set.parquet")
+    assert labeled["label"].sum() == 200  # TP reads
+    assert (~labeled["label"]).sum() == 40
+
+    # calls VCF: one TP locus and one FP locus
+    calls = tmp_path / "calls.vcf"
+    ref100 = seq[99]
+    alt100 = "ACGT"[("ACGT".index(ref100) + 1) % 4]
+    ref1000 = seq[999]
+    alt1000 = "ACGT"[("ACGT".index(ref1000) + 2) % 4]
+    calls.write_text(
+        FM_HEADER
+        + f"chr1\t100\t.\t{ref100}\t{alt100}\t50\tPASS\t.\n"
+        + f"chr1\t1000\t.\t{ref1000}\t{alt1000}\t50\tPASS\t.\n"
+    )
+    filter_run(
+        [
+            "--out_dir", str(out_dir / "apply"),
+            "--ref_fasta", str(tmp_path / "ref.fa"),
+            "--lib_prep_model_file", str(model_file),
+            "--calls_vcf", str(calls),
+            "--featuremap_vcf", str(fm),
+        ]
+    )
+    out_vcf = out_dir / "apply" / "recalibrated.vcf.gz"
+    t = read_vcf(str(out_vcf))
+    scores = t.info_field("LPR_SCORE")
+    # TP locus scored above FP locus by the model
+    assert scores[0] > scores[1]

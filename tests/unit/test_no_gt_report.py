@@ -1,0 +1,181 @@
+"""Unit tests: no-GT report stats (96-motif fold, indel stats, VariantEval tables)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.fixtures import write_fasta
+
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.reports import no_gt_stats
+from variantcalling_tpu.reports.variant_eval import compute_eval_tables, dbsnp_membership
+
+HEADER = (
+    "##fileformat=VCFv4.2\n"
+    '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+    '##FORMAT=<ID=AD,Number=R,Type=Integer,Description="a">\n'
+    '##FORMAT=<ID=DP,Number=1,Type=Integer,Description="d">\n'
+    "##contig=<ID=chr1,length=10000>\n"
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n"
+)
+
+
+def test_fold_table_canonical():
+    fold = no_gt_stats._fold_table()
+    idx = list(no_gt_stats.motif_index_96())
+    # ACA -> G is canonical (center C... wait center is C? ACA center C no — 'ACA' center 'C')
+    # motif ACA (A,C,A codes 0,1,0) = 0*16+1*4+0 = 4; alt G=2
+    assert idx[fold[4, 2]] == ("ACA", "G")
+    # TGT center G folds to revcomp: revcomp('TGT')='ACA', revcomp('G')='C' → ('ACA','C')... alt C
+    code_tgt = 3 * 16 + 2 * 4 + 3
+    assert idx[fold[code_tgt, 1]] == ("ACA", "G")  # alt C revcomp → G
+    # ref == alt center → -1
+    assert fold[4, 1] == -1
+
+
+def test_snp_statistics_folds_strands(tmp_path):
+    # genome: position 100 (1-based) has context ACA; position 200 has TGT
+    seq = list("A" * 300)
+    seq[98:101] = "ACA"  # 0-based 98,99,100 → variant at pos 100 center C
+    seq[198:201] = "TGT"  # variant at pos 200 center G
+    genome = {"chr1": "".join(seq)}
+    write_fasta(str(tmp_path / "ref.fa"), genome)
+    vcf = tmp_path / "in.vcf"
+    vcf.write_text(
+        HEADER
+        + "chr1\t100\t.\tC\tG\t50\tPASS\t.\tGT:AD:DP\t0/1:10,10:20\n"
+        + "chr1\t200\t.\tG\tC\t50\tPASS\t.\tGT:AD:DP\t0/1:10,10:20\n"
+    )
+    table = read_vcf(str(vcf))
+    cols, windows, hmer_len, hmer_nuc = no_gt_stats._annotate(table, str(tmp_path / "ref.fa"))
+    motifs = no_gt_stats.snp_statistics(table, cols, windows)
+    # both records fold to (ACA, G)
+    assert motifs[("ACA", "G")] == 2
+    assert motifs.sum() == 2
+
+
+def test_insertion_deletion_statistics(tmp_path):
+    # reference with an A-run of length 5 after pos 100 and G-run length 3 after pos 200
+    seq = list("C" * 300)
+    seq[100:105] = "AAAAA"
+    seq[200:203] = "GGG"
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": "".join(seq)})
+    vcf = tmp_path / "in.vcf"
+    vcf.write_text(
+        HEADER
+        + "chr1\t100\t.\tC\tCA\t50\tPASS\t.\tGT\t1/1\n"  # hom ins A, hmer len 5
+        + "chr1\t200\t.\tC\tCG\t50\tPASS\t.\tGT\t0/1\n"  # het ins G, hmer len 3
+        + "chr1\t100\t.\tCA\tC\t50\tPASS\t.\tGT\t0/1\n"  # het del A
+    )
+    table = read_vcf(str(vcf))
+    cols, windows, hmer_len, hmer_nuc = no_gt_stats._annotate(table, str(tmp_path / "ref.fa"))
+    res = no_gt_stats.insertion_deletion_statistics(table, cols, hmer_len, hmer_nuc)
+    assert res["homo"].loc["ins A", 5] == 1
+    assert res["hete"].loc["ins G", 3] == 1
+    assert res["hete"].loc["del A", 5] == 1
+    assert res["homo"].values.sum() == 1 and res["hete"].values.sum() == 2
+
+
+def test_allele_freq_hist():
+    vtype = np.array(["snp", "snp", "h-indel"])
+
+    class FakeTable:
+        pass
+
+    af = np.array([0.5, 0.51, 0.99])
+    import unittest.mock as mock
+
+    with mock.patch.object(no_gt_stats, "_compute_af", return_value=af):
+        df = no_gt_stats.allele_freq_hist(FakeTable(), vtype)
+    assert df["snp"].sum() == 2
+    assert df["h-indel"].iloc[-2:].sum() == 1  # 0.99 in one of the top bins
+    assert len(df) == 100
+
+
+def test_eval_tables(tmp_path):
+    vcf = tmp_path / "in.vcf"
+    vcf.write_text(
+        HEADER
+        + "chr1\t10\t.\tA\tG\t50\tPASS\t.\tGT\t0/1\n"  # Ti, het
+        + "chr1\t20\t.\tA\tC\t50\tPASS\t.\tGT\t1/1\n"  # Tv, hom
+        + "chr1\t30\t.\tAT\tA\t50\tPASS\t.\tGT\t0/1\n"  # del
+        + "chr1\t40\t.\tA\tAGG\t50\tPASS\t.\tGT\t0/1\n"  # ins len 2
+        + "chr1\t50\t.\tA\tG,T\t50\tPASS\t.\tGT\t1/2\n"  # multiallelic SNP
+    )
+    dbsnp = tmp_path / "dbsnp.vcf"
+    dbsnp.write_text(
+        "##fileformat=VCFv4.2\n##contig=<ID=chr1,length=10000>\n"
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        "chr1\t10\trs1\tA\tG\t.\t.\t.\n"
+    )
+    table = read_vcf(str(vcf))
+    known = dbsnp_membership(table, str(dbsnp))
+    assert known.tolist() == [True, False, False, False, False]
+    tables = compute_eval_tables(table, known=known)
+    cv = tables["CountVariants"].set_index("Novelty")
+    assert cv.loc["all", "nSNPs"] == 3
+    assert cv.loc["known", "nSNPs"] == 1
+    assert cv.loc["novel", "nInsertions"] == 1
+    assert cv.loc["all", "nMultiAllelic"] == 1
+    titv = tables["TiTvVariantEvaluator"].set_index("Novelty")
+    assert titv.loc["all", "nTi"] == 2  # A>G at 10, A>G first-alt at 50
+    assert titv.loc["all", "nTv"] == 1
+    ilh = tables["IndelLengthHistogram"]
+    assert int(ilh.loc[ilh["Length"] == -1, "Freq"].iloc[0]) == 1
+    assert int(ilh.loc[ilh["Length"] == 2, "Freq"].iloc[0]) == 1
+    isum = tables["IndelSummary"].set_index("Novelty")
+    assert isum.loc["all", "SNP_to_indel_ratio"] == pytest.approx(1.5)
+    assert set(tables) == {
+        "CompOverlap",
+        "CountVariants",
+        "TiTvVariantEvaluator",
+        "IndelLengthHistogram",
+        "IndelSummary",
+        "MetricsCollection",
+        "ValidationReport",
+        "VariantSummary",
+        "MultiallelicSummary",
+    }
+
+
+def test_full_analysis_pipeline(tmp_path):
+    from variantcalling_tpu.pipelines.run_no_gt_report import run
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+    seq = "ACGT" * 2500
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": seq})
+    vcf = tmp_path / "in.vcf"
+    rows = []
+    for i, pos in enumerate(range(100, 400, 10)):
+        ref = seq[pos - 1]
+        alt = "ACGT"[("ACGT".index(ref) + 1) % 4]
+        rows.append(f"chr1\t{pos}\t.\t{ref}\t{alt}\t50\tPASS\t.\tGT:AD:DP\t0/1:10,10:20")
+    vcf.write_text(HEADER + "\n".join(rows) + "\n")
+    dbsnp = tmp_path / "dbsnp.vcf"
+    dbsnp.write_text(
+        "##fileformat=VCFv4.2\n##contig=<ID=chr1,length=10000>\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+    )
+    bed = tmp_path / "callable.bed"
+    bed.write_text("chr1\t0\t5000\n")
+    prefix = str(tmp_path / "out")
+    run(
+        [
+            "full_analysis",
+            "--input_file",
+            str(vcf),
+            "--dbsnp",
+            str(dbsnp),
+            "--reference",
+            str(tmp_path / "ref.fa"),
+            "--output_prefix",
+            prefix,
+            "--callable_region",
+            str(bed),
+        ]
+    )
+    keys = set(list_keys(prefix + ".h5"))
+    assert {"callable_size", "ins_del_hete", "ins_del_homo", "af_hist", "snp_motifs", "eval_CountVariants"} <= keys
+    motifs = read_hdf(prefix + ".h5", key="snp_motifs")
+    assert motifs["size"].sum() == 30
+    cs = read_hdf(prefix + ".h5", key="callable_size")
+    assert int(cs["callable_size"].iloc[0]) == 5000

@@ -1,0 +1,194 @@
+"""Unit tests: report utils performance math, error typing, data loader."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from variantcalling_tpu.reports.report_data_loader import ReportDataLoader, get_error_type
+from variantcalling_tpu.reports.report_utils import (
+    DEFAULT_CATEGORIES,
+    ErrorType,
+    ReportUtils,
+    filter_by_category,
+    has_sec,
+)
+
+
+def _mk_frame(n_tp=50, n_fp=10, n_fn=5, indel=False):
+    rows = []
+    rng = np.random.default_rng(0)
+    for i in range(n_tp):
+        rows.append(
+            {"call": "TP", "base": "TP", "tp": True, "fp": False, "fn": False,
+             "tree_score": 0.5 + 0.5 * rng.random(), "filter": "PASS",
+             "error_type": ErrorType.NO_ERROR}
+        )
+    for i in range(n_fp):
+        rows.append(
+            {"call": "FP", "base": None, "tp": False, "fp": True, "fn": False,
+             "tree_score": 0.5 * rng.random(), "filter": "PASS",
+             "error_type": ErrorType.NOISE}
+        )
+    for i in range(n_fn):
+        rows.append(
+            {"call": "NA", "base": "FN", "tp": False, "fp": False, "fn": True,
+             "tree_score": np.nan, "filter": "PASS",
+             "error_type": ErrorType.NO_VARIANT}
+        )
+    df = pd.DataFrame(rows)
+    df["indel"] = indel
+    df["hmer_length"] = 0
+    df["indel_length"] = 0
+    df["alleles"] = "A,G"
+    df["gt_ultima"] = "0/1"
+    df["gt_ground_truth"] = "0/1"
+    return df
+
+
+def test_calc_performance_basic(tmp_path):
+    ru = ReportUtils(5, str(tmp_path / "out.h5"))
+    d = _mk_frame()
+    res, curve = ru.calc_performance(d)
+    assert res["# pos"] == 55
+    assert res["initial_fp"] == 10
+    assert res["recall"] == pytest.approx(50 / 55)
+    assert res["precision"] == pytest.approx(50 / 60)
+    assert res["miss_candidate"] == 5
+    assert res["noise"] == 10
+    # curve ends at the full-filtering point; recall decreases along curve
+    assert len(curve) == 65
+    assert curve["recall"].iloc[-1] == pytest.approx(0) or np.isnan(curve["recall"].iloc[-1])
+
+
+def test_calc_performance_filtered_counts(tmp_path):
+    ru = ReportUtils(5, str(tmp_path / "out.h5"))
+    d = _mk_frame(n_tp=20, n_fp=10, n_fn=0)
+    # filter half the fps and 2 tps
+    d.loc[d.index[:2], "filter"] = "LOW_SCORE"  # tps filtered
+    d.loc[d.index[20:25], "filter"] = "LOW_SCORE"  # fps filtered
+    res, _ = ru.calc_performance(d)
+    assert res["tp"] == 18
+    assert res["fp"] == 5
+    assert res["fn"] == 2  # filtered tps count as fn
+
+
+def test_basic_analysis_sec_refilter(tmp_path):
+    h5 = str(tmp_path / "out.h5")
+    ru = ReportUtils(5, h5)
+    d = _mk_frame()
+    d["classify"] = np.where(d["tp"], "tp", np.where(d["fp"], "fp", "fn"))
+    d["classify_gt"] = d["classify"]
+    d["blacklst"] = ""
+    d.loc[d.index[0], "blacklst"] = "SEC"  # one tp turns fn after SEC
+    opt, err = ru.basic_analysis(d, ["SNP"], "all_data", out_key_sec="all_data_sec")
+    from variantcalling_tpu.utils.h5_utils import list_keys
+
+    keys = set(list_keys(h5))
+    assert {"all_data", "all_data_error_types", "all_data_sec", "all_data_sec_error_types"} <= keys
+    assert opt.loc["SNP", "# pos"] == 55
+
+
+def test_filter_by_category():
+    d = pd.DataFrame(
+        {
+            "indel": [False, True, True, True],
+            "hmer_length": [0, 0, 3, 12],
+            "indel_length": [0, 2, 1, 1],
+        }
+    )
+    assert len(filter_by_category(d, "SNP")) == 1
+    assert len(filter_by_category(d, "non-hmer Indel")) == 1
+    assert len(filter_by_category(d, "hmer Indel <=4")) == 1
+    assert len(filter_by_category(d, "hmer Indel >10,<=12")) == 1
+    with pytest.raises(RuntimeError):
+        filter_by_category(d, "bogus")
+
+
+def test_error_type_decision_tree():
+    assert get_error_type("0/1", "0/1") == ErrorType.NO_ERROR
+    assert get_error_type("0/0", "0/1") == ErrorType.NOISE
+    assert get_error_type("./.", "0/1") == ErrorType.NOISE
+    assert get_error_type("0/1", "./.") == ErrorType.NO_VARIANT
+    assert get_error_type("1/1", "0/1") == ErrorType.HOM_TO_HET
+    assert get_error_type("0/1", "1/1") == ErrorType.HET_TO_HOM
+    assert get_error_type("0/1", "0/2") == ErrorType.WRONG_ALLELE
+    # tuple form also supported
+    assert get_error_type((1, 1), (0, 1)) == ErrorType.HOM_TO_HET
+
+
+def test_has_sec():
+    assert has_sec("SEC")
+    assert has_sec("COHORT;SEC")
+    assert not has_sec("")
+    assert not has_sec(None)
+    assert not has_sec(np.nan)
+
+
+def test_data_loader_roundtrip(tmp_path):
+    from variantcalling_tpu.utils.h5_utils import write_hdf
+
+    n = 12
+    df = pd.DataFrame(
+        {
+            "indel": [False] * n,
+            "hmer_indel_length": [0] * n,
+            "tree_score": np.linspace(0, 1, n),
+            "filter": ["PASS"] * n,
+            "blacklst": [""] * n,
+            "classify": ["tp"] * n,
+            "classify_gt": ["tp"] * n,
+            "indel_length": [0] * n,
+            "hmer_indel_nuc": [None] * n,
+            "base": ["TP"] * 10 + ["FN"] * 2,
+            "call": ["TP"] * 10 + ["NA"] * 2,
+            "gt_ground_truth": ["0/1"] * n,
+            "gt_ultima": ["0/1"] * 10 + ["./."] * 2,
+            "ad": ["10,10"] * n,
+            "dp": [20.0] * n,
+            "ref": ["A"] * n,
+            "alleles": ["G"] * n,
+            "gc_content": [0.5] * n,
+            "indel_classify": [None] * n,
+            "qual": [50.0] * n,
+            "gq": [40.0] * n,
+        }
+    )
+    path = str(tmp_path / "conc.h5")
+    write_hdf(df, path, key="all", mode="w")
+    loader = ReportDataLoader(path, "hg38", "exome.twist")
+    out = loader.load_concordance_df()
+    assert out["tp"].sum() == 10
+    assert out["fn"].sum() == 2
+    assert "max_vaf" in out.columns
+    assert out["vaf"].iloc[0] == pytest.approx(0.5)
+    assert out["error_type"].iloc[0] == ErrorType.NO_ERROR
+    assert out["error_type"].iloc[-1] == ErrorType.NO_VARIANT
+    assert "hmer_length" in out.columns
+
+
+def test_create_var_report_end_to_end(tmp_path):
+    from variantcalling_tpu.pipelines.create_var_report import run
+    from variantcalling_tpu.utils.h5_utils import list_keys, write_hdf
+
+    d = _mk_frame()
+    d["classify"] = np.where(d["tp"], "tp", np.where(d["fp"], "fp", "fn"))
+    d["classify_gt"] = d["classify"]
+    d["blacklst"] = ""
+    d["hmer_indel_length"] = 0
+    d["hmer_indel_nuc"] = None
+    d["ad"] = "10,10"
+    d["dp"] = 20.0
+    d["ref"] = "A"
+    d["gc_content"] = 0.5
+    d["indel_classify"] = None
+    d["qual"] = 50.0
+    d["gq"] = 40.0
+    d = d.drop(columns=["hmer_length", "error_type"])
+    path = str(tmp_path / "conc.h5")
+    write_hdf(d, path, key="all", mode="w")
+    out_h5 = str(tmp_path / "report.h5")
+    out_html = str(tmp_path / "report.html")
+    run(["--h5_concordance_file", path, "--h5_output", out_h5, "--html_output", out_html])
+    assert "all_data" in list_keys(out_h5)
+    html = open(out_html).read()
+    assert "General accuracy" in html and "SNP" in html

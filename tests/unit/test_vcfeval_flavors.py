@@ -1,0 +1,73 @@
+"""Unit tests: vcfeval_flavors penalty arithmetic (reference test_vcfeval_flavors style)."""
+
+import numpy as np
+import pytest
+
+from tests.fixtures import write_fasta
+
+
+HEADER = (
+    "##fileformat=VCFv4.2\n"
+    '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+    "##contig=<ID=chr1,length=1000>\n"
+    "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n"
+)
+
+
+@pytest.fixture
+def setup(tmp_path):
+    seq = "ACGTACGTAC" * 100
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": seq})
+
+    def snp_row(pos, alt, gt="0/1", filt="PASS"):
+        ref = seq[pos - 1]
+        return f"chr1\t{pos}\t.\t{ref}\t{alt}\t50\t{filt}\tGT\t{gt}".replace("\tGT\t", "\t.\tGT\t")
+
+    def alt_of(pos, shift=1):
+        return "ACGT"[("ACGT".index(seq[pos - 1]) + shift) % 4]
+
+    # truth: SNPs at 101, 201, 301; calls: match at 101, wrong allele at 201,
+    # miss 301, extra fp at 401
+    truth_rows = [snp_row(p, alt_of(p)) for p in (101, 201, 301)]
+    call_rows = [
+        snp_row(101, alt_of(101)),
+        snp_row(201, alt_of(201, 2)),  # wrong allele
+        snp_row(401, alt_of(401)),  # clean fp
+        snp_row(501, alt_of(501), filt="LowQual"),  # filtered: excluded
+    ]
+    (tmp_path / "truth.vcf").write_text(HEADER + "\n".join(truth_rows) + "\n")
+    (tmp_path / "calls.vcf").write_text(HEADER + "\n".join(call_rows) + "\n")
+    (tmp_path / "hcr.bed").write_text("chr1\t0\t1000\n")
+    return tmp_path
+
+
+@pytest.mark.parametrize(
+    "penalty,tp,fp,fn",
+    [
+        (2, 1, 2.0, 2.0),
+        (1, 1, 1.5, 1.5),
+        (0, 1, 1.0, 1.0),
+        (-1, 2, 1.0, 1.0),
+    ],
+)
+def test_penalties(setup, penalty, tp, fp, fn):
+    from variantcalling_tpu.pipelines.vcfeval_flavors import run
+
+    out = setup / f"out_p{penalty}"
+    result = run(
+        [
+            "-b", str(setup / "truth.vcf"),
+            "-c", str(setup / "calls.vcf"),
+            "-e", str(setup / "hcr.bed"),
+            "-o", str(out),
+            "-t", str(setup / "ref.fa"),
+            "-p", str(penalty),
+            "--var_type", "snps",
+        ]
+    )
+    row = result[1].split()
+    assert row[0] == "snps"
+    assert float(row[1]) == tp
+    assert float(row[2]) == fp
+    assert float(row[3]) == fn
+    assert (out / "vcfeval_flavors_results.txt").exists()

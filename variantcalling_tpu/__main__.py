@@ -30,6 +30,14 @@ TOOLS: dict[str, str] = {
     "sv_stats_collect": "variantcalling_tpu.pipelines.sv_stats_collect",
     "run_no_gt_report": "variantcalling_tpu.pipelines.run_no_gt_report",
     "vcfeval_flavors": "variantcalling_tpu.pipelines.vcfeval_flavors",
+    "create_var_report": "variantcalling_tpu.pipelines.create_var_report",
+    "collect_hpol_table": "variantcalling_tpu.pipelines.collect_hpol_table",
+    "calibrate_bridging_snvs": "variantcalling_tpu.pipelines.calibrate_bridging_snvs",
+    "training_set_consistency_check": "variantcalling_tpu.pipelines.training_set_consistency_check",
+    "train_lib_prep_recalibration_model": "variantcalling_tpu.pipelines.lpr.train_lib_prep_recalibration_model",
+    "filter_vcf_with_lib_prep_recalibration_model": (
+        "variantcalling_tpu.pipelines.lpr.filter_vcf_with_lib_prep_recalibration_model"
+    ),
 }
 
 _LOGO = "variantcalling-tpu (vctpu) — TPU-native variant-calling post-processing"
@@ -52,7 +60,10 @@ def main(argv: list[str] | None = None) -> int:
     except ModuleNotFoundError as e:
         print(f"tool {tool!r} is not available yet: {e}", file=sys.stderr)
         return 3
-    return int(module.run(argv[1:]) or 0)
+    result = module.run(argv[1:])
+    # tools may return rich results (e.g. vcfeval_flavors' rows); only
+    # int-like returns are exit codes
+    return result if isinstance(result, int) else 0
 
 
 if __name__ == "__main__":

@@ -161,6 +161,53 @@ def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str) -> Matc
     return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
 
 
+def match_tables(calls, truth, fasta) -> MatchResult:
+    """Whole-genome match of two VariantTables: per-contig match_contig sweep.
+
+    Returns a MatchResult over the full (unsplit) record order of each
+    table. Shared by run_comparison and vcfeval_flavors.
+    """
+    contigs = list(dict.fromkeys(list(calls.chrom) + list(truth.chrom)))
+    nc, nt = len(calls), len(truth)
+    res = MatchResult(
+        np.zeros(nc, dtype=bool),
+        np.zeros(nc, dtype=bool),
+        np.zeros(nt, dtype=bool),
+        np.zeros(nt, dtype=bool),
+        np.full(nc, -1, dtype=np.int64),
+    )
+    for contig in contigs:
+        cm = np.asarray(calls.chrom) == contig
+        tm = np.asarray(truth.chrom) == contig
+        if contig not in fasta.references:
+            continue
+        seq = fasta.fetch(contig, 0, fasta.get_reference_length(contig))
+        cs = make_side(
+            calls.pos[cm],
+            list(calls.ref[cm]),
+            [a.split(",") if a not in (".", "") else [] for a in calls.alt[cm]],
+            calls.genotypes()[cm],
+        )
+        ts = make_side(
+            truth.pos[tm],
+            list(truth.ref[tm]),
+            [a.split(",") if a not in (".", "") else [] for a in truth.alt[tm]],
+            truth.genotypes()[tm],
+        )
+        r = match_contig(cs, ts, seq)
+        res.call_tp[cm] = r.call_tp
+        res.call_tp_gt[cm] = r.call_tp_gt
+        res.truth_tp[tm] = r.truth_tp
+        res.truth_tp_gt[tm] = r.truth_tp_gt
+        # remap per-contig truth indices to global
+        t_global = np.nonzero(tm)[0]
+        matched = r.call_truth_idx >= 0
+        glob = np.full(len(r.call_truth_idx), -1, dtype=np.int64)
+        glob[matched] = t_global[r.call_truth_idx[matched]]
+        res.call_truth_idx[cm] = glob
+    return res
+
+
 def _gt_equivalent(calls: SideVariants, i: int, truth: SideVariants, j: int) -> bool:
     """Same zygosity over equivalent alleles (allele indices may differ)."""
 

@@ -1,0 +1,93 @@
+"""Featuremap VCF -> columnar per-read DataFrame (ugbio_featuremap parity).
+
+A featuremap is a VCF with one record per supporting read of each candidate
+SNV, carrying per-read evidence in INFO (X_SCORE, X_EDIST, X_LENGTH,
+X_MAPQ, X_INDEX, X_READ_COUNT, X_FILTERED_COUNT, rq, ...). The reference's
+``featuremap_to_dataframe`` (lpr/train_lib_prep_recalibration_model.py:
+60-118 call sites) converts it to a parquet frame; here the conversion is
+one columnar pass: numeric INFO keys become float columns, the rest become
+strings, plus chrom/pos/ref/alt/qual/filter and reference trinucleotide
+motif columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.io.vcf import MISSING, read_vcf
+
+
+def featuremap_to_dataframe(
+    featuremap_vcf: str,
+    ref_fasta: str | None = None,
+    flow_order: str = "TGCA",
+    info_fields: list[str] | None = None,
+    motif_length: int = 3,
+) -> pd.DataFrame:
+    """Columnar frame from a featuremap VCF; one row per record (= per read)."""
+    table = read_vcf(featuremap_vcf)
+    n = len(table)
+    cols: dict[str, np.ndarray] = {
+        "chrom": np.asarray(table.chrom),
+        "pos": table.pos,
+        "ref": np.asarray(table.ref),
+        "alt": np.asarray([a.split(",")[0] for a in table.alt], dtype=object),
+        "qual": np.nan_to_num(table.qual, nan=0.0),
+        "filter": np.asarray(["PASS" if f in (MISSING, "") else f for f in table.filters], dtype=object),
+    }
+
+    # discover INFO keys from the header (or use the explicit list)
+    keys = info_fields if info_fields is not None else list(table.header.infos)
+    for key in keys:
+        meta = table.header.infos.get(key, {})
+        typ = meta.get("Type", "String")
+        if typ in ("Integer", "Float"):
+            cols[key.lower()] = table.info_field(key, dtype=np.float64, missing=np.nan)
+        elif typ == "Flag":
+            cols[key.lower()] = table.info_flag(key)
+        else:
+            vals = np.full(n, "", dtype=object)
+            for i, s in enumerate(table.info):
+                if s in (None, MISSING, ""):
+                    continue
+                for part in s.split(";"):
+                    if part.startswith(key + "="):
+                        vals[i] = part.split("=", 1)[1]
+                        break
+            cols[key.lower()] = vals
+
+    if ref_fasta is not None:
+        from variantcalling_tpu.featurize import gather_windows
+        from variantcalling_tpu.io.fasta import FastaReader
+
+        radius = motif_length
+        with FastaReader(ref_fasta) as fa:
+            windows = gather_windows(table, fa, radius=radius)
+        bases = np.array(list("ACGTN"))
+        left = ["".join(bases[w[:radius]]) for w in windows]
+        right = ["".join(bases[w[radius + 1 :]]) for w in windows]
+        cols["left_motif"] = np.asarray(left, dtype=object)
+        cols["right_motif"] = np.asarray(right, dtype=object)
+        cols["ref_motif"] = np.asarray(
+            [l[-1] + r + rt[0] for l, r, rt in zip(left, cols["ref"], right)], dtype=object
+        )
+    return pd.DataFrame(cols)
+
+
+NUMERIC_FEATURE_CANDIDATES = [
+    "x_score",
+    "x_edist",
+    "x_length",
+    "x_mapq",
+    "x_index",
+    "x_fc1",
+    "x_fc2",
+    "rq",
+    "max_softclip_length",
+]
+
+
+def numeric_feature_columns(df: pd.DataFrame) -> list[str]:
+    """The numeric per-read evidence columns present in a featuremap frame."""
+    return [c for c in NUMERIC_FEATURE_CANDIDATES if c in df.columns and np.issubdtype(df[c].dtype, np.number)]

@@ -1,0 +1,126 @@
+"""calibrate_bridging_snvs — un-filter somatic SNVs that bridge long homopolymers.
+
+Drop-in surface of the reference tool
+(ugvc/pipelines/vcfbed/calibrate_bridging_snvs.py:9-130): a filtered SNV
+whose alt allele joins flanking reference homopolymers into a run of
+>= min_query_hmer_size (and is not a symmetric tandem repeat), with high
+tumor VAF and low normal VAF (FORMAT AD/DP vs BG_AD/BG_DP), gets PASS and
+``--set_qual``. The hmer-bridging test runs as one batched kernel over
+reference windows instead of per-record fetches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.featurize import gather_windows
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+
+_BASES = "ACGT"
+
+
+def bridging_hmer_lengths(windows: np.ndarray, alt_code: np.ndarray, radius: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(upstream_len, downstream_len, tandem) for each variant window.
+
+    upstream/downstream = consecutive reference bases equal to the alt base
+    on each side of the variant position; tandem = the bases bounding the
+    joined run are equal to each other AND to the reference base at the
+    variant, with symmetric arm lengths (reference :51-55).
+    """
+    import jax.numpy as jnp
+
+    w = jnp.asarray(windows)
+    alt = jnp.asarray(alt_code)[:, None]
+    right = w[:, radius + 1 :]
+    left = jnp.flip(w[:, :radius], axis=1)
+
+    def run_len(arm):
+        same = arm == alt
+        any_diff = ~jnp.all(same, axis=1)
+        first = jnp.argmin(same.astype(jnp.int32), axis=1)
+        return jnp.where(any_diff, first, arm.shape[1])
+
+    up = run_len(left)
+    down = run_len(right)
+    # bounding bases (code 4 when run reaches the window edge)
+    up_i = jnp.minimum(up, left.shape[1] - 1)
+    down_i = jnp.minimum(down, right.shape[1] - 1)
+    before = jnp.where(up < left.shape[1], jnp.take_along_axis(left, up_i[:, None], axis=1)[:, 0], 4)
+    after = jnp.where(down < right.shape[1], jnp.take_along_axis(right, down_i[:, None], axis=1)[:, 0], 4)
+    ref_base = w[:, radius]
+    tandem = (before == after) & (before == ref_base) & (up == down)
+    return np.asarray(up), np.asarray(down), np.asarray(tandem)
+
+
+def run(argv: list[str]):
+    """Un-filter SNVs which generate a long homopolymer, have borderline quality
+    and have a high VAF in the tumor and low VAF in the normal."""
+    ap = argparse.ArgumentParser(prog="calibrate_bridging_snvs", description=run.__doc__)
+    ap.add_argument("--vcf", required=True, help="Path to the VCF file")
+    ap.add_argument("--reference", required=True, help="Path to the reference genome")
+    ap.add_argument("--output", required=True, help="name of output vcf file")
+    ap.add_argument("--min_query_hmer_size", default=5, type=int)
+    ap.add_argument("--min_initial_qual", default=5, type=int)
+    ap.add_argument("--min_tumor_vaf", default=0.2, type=float)
+    ap.add_argument("--max_normal_vaf", default=0.1, type=float)
+    ap.add_argument("--min_normal_depth", default=10, type=int)
+    ap.add_argument("--min_distance_from_edge", default=0, type=int)
+    ap.add_argument("--set_qual", default=20, type=int)
+    args = ap.parse_args(argv)
+
+    table = read_vcf(args.vcf)
+    n = len(table)
+    code = {b: i for i, b in enumerate(_BASES)}
+
+    is_snv = np.zeros(n, dtype=bool)
+    alt_code = np.full(n, 4, dtype=np.int32)
+    for i in range(n):
+        alts = table.alt[i].split(",")
+        if len(table.ref[i]) == 1 and len(alts) == 1 and len(alts[0]) == 1 and alts[0] in code:
+            is_snv[i] = True
+            alt_code[i] = code[alts[0]]
+    not_pass = np.array([f not in ("PASS",) and "PASS" not in str(f).split(";") for f in table.filters])
+    qual_ok = np.nan_to_num(table.qual, nan=-1) >= args.min_initial_qual
+    candidate = is_snv & not_pass & qual_ok
+
+    radius = args.min_query_hmer_size
+    with FastaReader(args.reference) as fa:
+        windows = gather_windows(table, fa, radius=radius)
+    up, down, tandem = bridging_hmer_lengths(windows, alt_code, radius)
+    hmer_size = 1 + up + down
+    bridging = (
+        candidate
+        & (hmer_size >= args.min_query_hmer_size)
+        & ~tandem
+        & (np.minimum(up, down) >= args.min_distance_from_edge)
+    )
+
+    ad = table.format_numeric("AD", missing=0)
+    dp = table.format_numeric("DP", max_len=1, missing=0)[:, 0]
+    bg_ad = table.format_numeric("BG_AD", missing=0)
+    bg_dp = table.format_numeric("BG_DP", max_len=1, missing=0)[:, 0]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        tumor_vaf = np.where(dp > 0, ad[:, 1:].sum(axis=1) / np.maximum(dp, 1), 0.0)
+        normal_vaf = bg_ad[:, 1:].sum(axis=1) / np.maximum(bg_dp, 0.01)
+    rescued = (
+        bridging
+        & (tumor_vaf >= args.min_tumor_vaf)
+        & (normal_vaf <= args.max_normal_vaf)
+        & (bg_dp > args.min_normal_depth)
+    )
+
+    new_filters = np.array(table.filters, dtype=object, copy=True)
+    new_filters[rescued] = "PASS"
+    table.qual = np.where(rescued, float(args.set_qual), table.qual)
+    write_vcf(args.output, table, new_filters=new_filters)
+    logger.info("calibrate_bridging_snvs: rescued %d of %d candidate SNVs", int(rescued.sum()), int(candidate.sum()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,90 @@
+"""collect_hpol_table — sample homopolymer loci (length × nucleotide) from a reference.
+
+Drop-in surface of the reference tool (ugvc/scripts/collect_hpol_table.py:
+16-134): ``--reference --collection_regions --output --max_hpol_length
+--max_number_to_collect``. Flow-space key generation is the vectorized RLE
+encoder (utils/flow); sampling fractions follow interval lengths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu.io.bed import read_bed
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.utils.flow import DEFAULT_FLOW_ORDER, generate_key_from_sequence, key_to_base_index
+
+
+def plan_sampling(collection_regions: str) -> list[float]:
+    """Per-interval fraction of the total collection length (reference :43-62)."""
+    ivals = read_bed(collection_regions)
+    lengths = (ivals.end - ivals.start).astype(float)
+    total = lengths.sum()
+    return (lengths / total).tolist() if total else []
+
+
+def collect_homopolymers(
+    reference: str,
+    collection_regions: str,
+    max_hpol_length: int,
+    max_number_to_collect: int,
+    sampling_fractions: list[float],
+    seed: int = 0,
+) -> list[tuple]:
+    """[(chrom, pos0, hmer_length, nucleotide)] sampled per (length, nuc) class."""
+    rng = np.random.default_rng(seed)
+    ivals = read_bed(collection_regions)
+    out: list[tuple] = []
+    with FastaReader(reference) as fa:
+        for i in range(len(ivals)):
+            chrom = str(ivals.chrom[i])
+            start, end = int(ivals.start[i]), int(ivals.end[i])
+            if chrom not in fa.references:
+                continue
+            seq = fa.fetch(chrom, start, min(end, fa.get_reference_length(chrom)))
+            key = generate_key_from_sequence(seq, DEFAULT_FLOW_ORDER, non_standard_as_a=True)
+            if len(key) == 0:
+                continue
+            k2base = key_to_base_index(key)
+            take = int(np.ceil(sampling_fractions[i] * max_number_to_collect))
+            for h in range(1, max_hpol_length + 1):
+                locs_h = np.nonzero(key == h)[0]
+                for j, nuc in enumerate(DEFAULT_FLOW_ORDER):
+                    # flows j, j+4, ... carry nucleotide DEFAULT_FLOW_ORDER[j]
+                    locs = locs_h[locs_h % len(DEFAULT_FLOW_ORDER) == j]
+                    if len(locs) == 0:
+                        continue
+                    locs = rng.permutation(locs)[:take]
+                    for b in k2base[locs]:
+                        out.append((chrom, int(b) + start, h, nuc))
+    out.sort(key=lambda x: (x[0], x[1]))
+    return out
+
+
+def write_hpol_table(hpol_list: list[tuple], output: str) -> None:
+    with open(output, "w", encoding="utf-8") as fh:
+        for chrom, position, length, nucleotide in hpol_list:
+            fh.write(f"{chrom}\t{position}\t{length}\t{nucleotide}\n")
+
+
+def run(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="collect_hpol_table", description="Collect homopolymer locations")
+    ap.add_argument("--reference", required=True, help="Reference genome")
+    ap.add_argument("--collection_regions", required=True, help="bed file with regions to collect from")
+    ap.add_argument("--output", required=True, help="Homopolymer table")
+    ap.add_argument("--max_hpol_length", default=20, type=int)
+    ap.add_argument("--max_number_to_collect", default=100000, type=int)
+    args = ap.parse_args(argv)
+    fractions = plan_sampling(args.collection_regions)
+    table = collect_homopolymers(
+        args.reference, args.collection_regions, args.max_hpol_length, args.max_number_to_collect, fractions
+    )
+    write_hpol_table(table, args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

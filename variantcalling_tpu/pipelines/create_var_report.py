@@ -1,0 +1,97 @@
+"""create_var_report — germline accuracy report from a concordance h5.
+
+The reference renders ugvc/reports/createVarReport.ipynb through papermill
++ nbconvert (test_vc_report.py:15-26), parameterized by a VarReport INI
+config (report_utils.parse_config). This framework generates the same
+artifact set directly — no notebook runtime: per-category accuracy tables
+(+SEC re-filtered variants), error-type decomposition, PR-curve PNGs, and
+a self-contained HTML summary, all derived from one loaded concordance
+frame.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.reports.report_data_loader import ReportDataLoader
+from variantcalling_tpu.reports.report_utils import DEFAULT_CATEGORIES, ReportUtils, parse_config
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="create_var_report", description=__doc__)
+    ap.add_argument("--config", help="VarReport INI config (reference var_report.config surface)")
+    ap.add_argument("--h5_concordance_file", help="run_comparison output h5 (overrides config)")
+    ap.add_argument("--h5_output", default=None, help="output h5 (default var_report.h5)")
+    ap.add_argument("--html_output", default=None, help="optional HTML summary path")
+    ap.add_argument("--reference_version", default="hg38")
+    ap.add_argument("--exome_column_name", default="exome.twist")
+    ap.add_argument("--verbosity", type=int, default=5)
+    ap.add_argument("--plot_dir", default=None, help="directory for PR-curve PNGs")
+    return ap.parse_args(argv)
+
+
+def run(argv: list[str]) -> int:
+    args = parse_args(argv)
+    h5_in = args.h5_concordance_file
+    h5_out = args.h5_output
+    verbosity = args.verbosity
+    ref_version = args.reference_version
+    if args.config:
+        params, _ = parse_config(args.config)
+        h5_in = h5_in or params["h5_concordance_file"]
+        h5_out = h5_out or params.get("h5outfile")
+        verbosity = int(params.get("verbosity", verbosity))
+        ref_version = params.get("reference_version", ref_version)
+    h5_out = h5_out or "var_report.h5"
+
+    loader = ReportDataLoader(h5_in, ref_version, args.exome_column_name)
+    df = loader.load_concordance_df()
+    logger.info("loaded %d records from %s", len(df), h5_in)
+
+    ru = ReportUtils(verbosity, h5_out, plot_dir=args.plot_dir)
+    sections: dict[str, pd.DataFrame] = {}
+
+    opt_tab, err_tab = ru.basic_analysis(df, list(DEFAULT_CATEGORIES), "all_data", out_key_sec="all_data_sec")
+    sections["General accuracy (all data)"] = opt_tab
+    if len(err_tab):
+        sections["Error types (all data)"] = err_tab
+
+    # PASS-only view (reference notebook's filtered section)
+    df_pass = df[df["filter"] == "PASS"]
+    if len(df_pass):
+        opt_pass, _ = ru.basic_analysis(df_pass, list(DEFAULT_CATEGORIES), "pass_data")
+        sections["General accuracy (PASS only)"] = opt_pass
+
+    # homozygous genotyping + base stratification (reference :108-126)
+    try:
+        sections["Homozygous accuracy"] = ru.homozygous_genotyping_analysis(df, ["SNP", "Indel"], "homozygous")
+    except Exception as e:  # noqa: BLE001 — section optional when columns absent
+        logger.warning("homozygous section skipped: %s", e)
+    for bases in (("A", "T"), ("G", "C")):
+        try:
+            sections[f"Base stratification {bases}"] = ru.base_stratification_analysis(
+                df, ["SNP", "hmer Indel <=4"], bases
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("base stratification %s skipped: %s", bases, e)
+
+    if args.html_output:
+        with open(args.html_output, "w", encoding="utf-8") as fh:
+            fh.write("<html><head><title>Variant Report</title></head><body>\n")
+            fh.write("<h1>Variant calling accuracy report</h1>\n")
+            for title, tab in sections.items():
+                fh.write(f"<h2>{title}</h2>\n")
+                fh.write(tab.to_html(float_format=lambda x: f"{x:.4f}"))
+            fh.write("</body></html>\n")
+        logger.info("wrote %s", args.html_output)
+    logger.info("wrote %s", h5_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

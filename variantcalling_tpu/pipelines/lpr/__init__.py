@@ -1,0 +1,1 @@
+"""Library-prep recalibration (LPR): per-read SNV quality model train/apply."""

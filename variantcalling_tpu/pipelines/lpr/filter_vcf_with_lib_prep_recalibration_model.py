@@ -1,0 +1,96 @@
+"""filter_vcf_with_lib_prep_recalibration_model — re-score a VCF with an LPR model.
+
+Reference behavior (ugvc/pipelines/lpr/filter_vcf_with_lib_prep_
+recalibration_model.py:24-69, via two papermill notebooks): score every
+featuremap read with the trained model, aggregate the top-N read scores per
+allele, and attach the aggregate as a recalibrated score on the calls.
+Here both stages are direct: read scoring is one batched forest-inference
+call on device; per-allele aggregation is a groupby head; output is a
+scored parquet + a VCF annotated with ``LPR_SCORE``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.featuremap import featuremap_to_dataframe, numeric_feature_columns
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+from variantcalling_tpu.models.forest import predict_score
+from variantcalling_tpu.models.registry import load_models
+
+
+def init_parser():
+    ap = argparse.ArgumentParser(prog="filter_vcf_with_lib_prep_recalibration_model", description=run.__doc__)
+    ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--output_suffix", default="")
+    ap.add_argument("--ref_fasta", required=True)
+    ap.add_argument("--lib_prep_model_file", required=True)
+    ap.add_argument("--calls_vcf", required=True, help="VCF to re-score")
+    ap.add_argument("--featuremap_vcf", required=True, help="featuremap intersected on calls")
+    ap.add_argument("--top_n_reads", type=int, default=5, help="top read scores aggregated per allele")
+    return ap
+
+
+def score_alleles(featuremap_df: pd.DataFrame, forest, top_n: int) -> pd.DataFrame:
+    """Per-(chrom,pos,ref,alt) mean of the top-N per-read model scores."""
+    features = forest.feature_names or numeric_feature_columns(featuremap_df)
+    missing = [f for f in features if f not in featuremap_df.columns]
+    if missing:
+        # a silently narrowed matrix would misalign the forest's feature
+        # indices (clamped gathers read the wrong column) — hard error
+        raise ValueError(f"featuremap lacks trained feature columns: {missing}")
+    x = np.nan_to_num(featuremap_df[features].to_numpy(dtype=np.float32), nan=0.0)
+    scores = np.asarray(predict_score(forest, jnp.asarray(x)))
+    df = featuremap_df[["chrom", "pos", "ref", "alt"]].copy()
+    df["read_score"] = scores
+    agg = (
+        df.sort_values("read_score", ascending=False)
+        .groupby(["chrom", "pos", "ref", "alt"], sort=False)
+        .head(top_n)
+        .groupby(["chrom", "pos", "ref", "alt"], sort=False)["read_score"]
+        .agg(["mean", "count"])
+        .rename(columns={"mean": "lpr_score", "count": "n_scored_reads"})
+        .reset_index()
+    )
+    return agg
+
+
+def run(argv: list[str]):
+    """Filter vcf file using lib-prep recalibration model"""
+    args = init_parser().parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    forest = load_models(args.lib_prep_model_file)["lib_prep"]
+    fm = featuremap_to_dataframe(args.featuremap_vcf, args.ref_fasta)
+    scored = score_alleles(fm, forest, args.top_n_reads)
+    scored_path = os.path.join(args.out_dir, f"scored_alleles{args.output_suffix}.parquet")
+    scored.to_parquet(scored_path)
+    logger.info("scored %d alleles -> %s", len(scored), scored_path)
+
+    calls = read_vcf(args.calls_vcf)
+    key_to_score = {
+        (str(c), int(p), r, a): s
+        for c, p, r, a, s in zip(scored["chrom"], scored["pos"], scored["ref"], scored["alt"], scored["lpr_score"])
+    }
+    lpr = np.full(len(calls), np.nan)
+    for i in range(len(calls)):
+        k = (str(calls.chrom[i]), int(calls.pos[i]), calls.ref[i], calls.alt[i].split(",")[0])
+        if k in key_to_score:
+            lpr[i] = float(key_to_score[k])
+    calls.header.ensure_info("LPR_SCORE", "1", "Float", "Library-prep recalibration score (mean of top read scores)")
+    out_vcf = os.path.join(args.out_dir, f"recalibrated{args.output_suffix}.vcf.gz")
+    write_vcf(out_vcf, calls, extra_info={"LPR_SCORE": lpr})
+    logger.info("wrote %s", out_vcf)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,123 @@
+"""train_lib_prep_recalibration_model — train a per-read SNV recalibration model.
+
+Reference behavior (ugvc/pipelines/lpr/train_lib_prep_recalibration_model.py:
+11-156): build a labeled featuremap training set — TP reads at loci with
+AF >= ``--tp_min_af`` (germline-like), FP reads at loci with
+AF <= ``--fp_max_af`` (library-prep noise), where AF = supporting reads /
+X_READ_COUNT — then train xgboost through a papermill notebook. Here the
+labeling is one columnar pass over the featuremap frame and training is the
+on-device histogram GBT (models/boosting): the whole fit is a single jitted
+program, no notebooks. Outputs ``labeled_featuremap_training_set.parquet``
+and ``lib_prep_model<suffix>.npz``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.featuremap import featuremap_to_dataframe, numeric_feature_columns
+from variantcalling_tpu.models import boosting
+from variantcalling_tpu.models.registry import save_models
+
+
+def init_parser():
+    ap = argparse.ArgumentParser(prog="train_lib_prep_recalibration_model", description=run.__doc__)
+    ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--ref_fasta", required=True)
+    ap.add_argument("--featuremap_vcf", help="full featuremap vcf file")
+    ap.add_argument("--calls_vcf", help="variant calling vcf file (calibrate on pass-filter events)")
+    ap.add_argument("--tp_min_af", type=float, default=0.9, help="min allele-frequency to consider a variant tp")
+    ap.add_argument("--fp_max_af", type=float, default=0.04, help="max allele-frequency to consider a variant fp")
+    ap.add_argument("--output_suffix", default="")
+    ap.add_argument("--balance_motifs", default=False, action="store_true")
+    ap.add_argument("--balance_tp_fp", default=False, action="store_true")
+    ap.add_argument("--n_trees", type=int, default=100)
+    ap.add_argument("--depth", type=int, default=6)
+    return ap
+
+
+def label_by_allele_frequency(df: pd.DataFrame, tp_min_af: float, fp_max_af: float) -> pd.DataFrame:
+    """Label featuremap reads by locus AF = reads-at-locus / X_READ_COUNT."""
+    if "x_read_count" not in df.columns:
+        raise ValueError("featuremap lacks X_READ_COUNT; cannot estimate AF")
+    counts = df.groupby(["chrom", "pos", "ref", "alt"], sort=False).size().rename("n_supporting")
+    df = df.merge(counts, left_on=["chrom", "pos", "ref", "alt"], right_index=True)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        df["af"] = df["n_supporting"] / df["x_read_count"].replace(0, np.nan)
+    tp = df[df["af"] >= tp_min_af].copy()
+    fp = df[df["af"] <= fp_max_af].copy()
+    tp["label"] = True
+    fp["label"] = False
+    return pd.concat([tp, fp], ignore_index=True)
+
+
+def balance(df: pd.DataFrame, by_motif: bool, tp_fp: bool, seed: int = 0) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    if tp_fp:
+        n = df["label"].value_counts().min()
+        df = pd.concat(
+            [g.sample(n=n, random_state=int(rng.integers(1 << 31))) for _, g in df.groupby("label")],
+            ignore_index=True,
+        )
+    if by_motif and "ref_motif" in df.columns:
+        n = max(1, int(df.groupby("ref_motif").size().median()))
+        df = pd.concat(
+            [g.sample(n=min(n, len(g)), random_state=int(rng.integers(1 << 31))) for _, g in df.groupby("ref_motif")],
+            ignore_index=True,
+        )
+    return df
+
+
+def run(argv: list[str]):
+    """Lib-prep recalibration model training pipeline"""
+    args = init_parser().parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    df = featuremap_to_dataframe(args.featuremap_vcf, args.ref_fasta)
+    if args.calls_vcf:
+        # calibrate on pass-filter biallelic SNVs from the calls VCF
+        from variantcalling_tpu.io.vcf import read_vcf
+
+        calls = read_vcf(args.calls_vcf, drop_format=True)
+        pass_snv = {
+            (str(calls.chrom[i]), int(calls.pos[i]))
+            for i in range(len(calls))
+            if calls.filters[i] in ("PASS", ".", "")
+            and len(calls.ref[i]) == 1
+            and "," not in calls.alt[i]
+            and len(calls.alt[i]) == 1
+        }
+        on_calls = df[[(c, p) in pass_snv for c, p in zip(df["chrom"], df["pos"])]].copy()
+        labeled = label_by_allele_frequency(on_calls, args.tp_min_af, args.fp_max_af)
+    else:
+        labeled = label_by_allele_frequency(df, args.tp_min_af, args.fp_max_af)
+
+    labeled = balance(labeled, args.balance_motifs, args.balance_tp_fp)
+    training_set = os.path.join(args.out_dir, "labeled_featuremap_training_set.parquet")
+    labeled.to_parquet(training_set)
+    logger.info("labeled training set: %d reads (%d tp, %d fp) -> %s",
+                len(labeled), int(labeled["label"].sum()), int((~labeled["label"]).sum()), training_set)
+
+    features = numeric_feature_columns(labeled)
+    if not features:
+        raise ValueError("no numeric evidence columns found in featuremap")
+    x = labeled[features].to_numpy(dtype=np.float32)
+    x = np.nan_to_num(x, nan=0.0)
+    y = labeled["label"].to_numpy(dtype=np.float32)
+    cfg = boosting.BoostConfig(n_trees=args.n_trees, depth=args.depth)
+    forest = boosting.fit(x, y, cfg=cfg, feature_names=features)
+
+    model_path = os.path.join(args.out_dir, f"lib_prep_model{args.output_suffix}.npz")
+    save_models(model_path, {"lib_prep": forest})
+    logger.info("trained %d-tree depth-%d model on %d features -> %s", args.n_trees, args.depth, len(features), model_path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

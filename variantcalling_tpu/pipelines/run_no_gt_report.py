@@ -1,0 +1,145 @@
+"""run_no_gt_report — callset statistics without ground truth.
+
+Drop-in surface of the reference tool (ugvc/pipelines/run_no_gt_report.py:
+598-664): subcommands ``full_analysis`` / ``variant_eval`` /
+``somatic_analysis``. The GATK VariantEval subprocess is replaced by
+in-process device reductions (reports/variant_eval); the SigProfiler
+somatic stage reduces to the 96-channel SBS matrix (signature assignment
+needs the external SigProfiler package and is gated on its presence).
+Outputs the same HDF5 key layout (``ins_del_hete``, ``ins_del_homo``,
+``af_hist``, ``snp_motifs``, ``eval_<Table>``, ``callable_size``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.reports import no_gt_stats
+from variantcalling_tpu.reports.variant_eval import compute_eval_tables, dbsnp_membership
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def _sample_index(table, sample_id: int | None, sample_name: str | None) -> int:
+    if sample_name is not None and sample_name in table.header.samples:
+        return table.header.samples.index(sample_name)
+    return sample_id or 0
+
+
+def run_full_analysis(args) -> None:
+    out_h5 = f"{args.output_prefix}.h5"
+    mode = "w"
+    if args.callable_region is not None:
+        from variantcalling_tpu.io.bed import read_bed
+
+        size = read_bed(args.callable_region).total_length()
+        write_hdf(pd.DataFrame({"callable_size": [size]}), out_h5, key="callable_size", mode=mode)
+        mode = "a"
+
+    table = read_vcf(args.input_file)
+    sample = _sample_index(table, args.sample_id, args.sample_name)
+    known = dbsnp_membership(table, args.dbsnp) if args.dbsnp else None
+    eval_tables = compute_eval_tables(table, known=known, sample=sample)
+
+    logger.info("annotating %d records", len(table))
+    cols, windows, hmer_len, hmer_nuc = no_gt_stats._annotate(table, args.reference)
+
+    logger.info("insertion/deletion statistics")
+    ins_del = no_gt_stats.insertion_deletion_statistics(table, cols, hmer_len, hmer_nuc, sample=sample)
+
+    logger.info("allele frequency histogram")
+    vtype = no_gt_stats.variant_type_labels(cols, hmer_len)
+    af_df = no_gt_stats.allele_freq_hist(table, vtype, sample=sample)
+
+    logger.info("snp motif statistics")
+    snp_motifs = no_gt_stats.snp_statistics(table, cols, windows)
+
+    write_hdf(ins_del["hete"].T.reset_index(names="hmer_len"), out_h5, key="ins_del_hete", mode=mode)
+    write_hdf(ins_del["homo"].T.reset_index(names="hmer_len"), out_h5, key="ins_del_homo", mode="a")
+    write_hdf(af_df, out_h5, key="af_hist", mode="a")
+    motif_df = snp_motifs.reset_index()
+    write_hdf(motif_df, out_h5, key="snp_motifs", mode="a")
+    for name, tbl in eval_tables.items():
+        write_hdf(tbl, out_h5, key=f"eval_{name}", mode="a")
+    logger.info("wrote %s", out_h5)
+
+
+def run_eval_tables_only(args) -> None:
+    table = read_vcf(args.input_file)
+    sample = _sample_index(table, args.sample_id, args.sample_name)
+    known = dbsnp_membership(table, args.dbsnp) if args.dbsnp else None
+    eval_tables = compute_eval_tables(table, known=known, sample=sample)
+    mode = "w"
+    for name, tbl in eval_tables.items():
+        write_hdf(tbl, f"{args.output_prefix}.h5", key=f"eval_{name}", mode=mode)
+        mode = "a"
+
+
+def run_somatic_analysis(args) -> None:
+    """96-channel SBS matrix (+ optional SigProfiler assignment when installed)."""
+    table = read_vcf(args.input_file)
+    cols, windows, hmer_len, _hmer_nuc = no_gt_stats._annotate(table, args.reference)
+    snp_motifs = no_gt_stats.snp_statistics(table, cols, windows)
+    # SBS96 channel labels: C>A style with flanks, e.g. A[C>A]G
+    labels = [f"{m[0]}[{m[1]}>{a}]{m[2]}" for (m, a) in snp_motifs.index]
+    sbs = pd.DataFrame({"MutationType": labels, args.output_prefix.split("/")[-1]: snp_motifs.values})
+    sbs_path = f"{args.output_prefix}.SBS96.all"
+    sbs.to_csv(sbs_path, sep="\t", index=False)
+    logger.info("wrote SBS96 matrix: %s", sbs_path)
+    try:  # optional external signature assignment (reference :334-595)
+        from SigProfilerAssignment import Analyzer as Analyze  # type: ignore
+
+        Analyze.cosmic_fit(
+            samples=sbs_path,
+            output=f"{args.output_prefix}_sig",
+            input_type="matrix",
+            cosmic_version=float(args.cosmic_version),
+        )
+    except ImportError:
+        logger.warning("SigProfilerAssignment not installed; skipping signature fitting")
+
+
+def run(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(prog="run_no_gt_report", description="Collect metrics for runs without ground truth")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    full = sub.add_parser("full_analysis", description="Run the full analysis of no_gt_report")
+    full.add_argument("--input_file", required=True)
+    full.add_argument("--dbsnp", required=True)
+    full.add_argument("--reference", required=True)
+    full.add_argument("--output_prefix", required=True)
+    full.add_argument("--sample_id", type=int, default=0)
+    full.add_argument("--sample_name", type=str, default=None)
+    full.add_argument("--callable_region", type=str, default=None)
+    full.set_defaults(func=run_full_analysis)
+
+    ev = sub.add_parser("variant_eval", description="Run variant eval only")
+    ev.add_argument("--input_file", required=True)
+    ev.add_argument("--dbsnp", required=True)
+    ev.add_argument("--reference", required=True)
+    ev.add_argument("--output_prefix", required=True)
+    ev.add_argument("--sample_name", type=str, default=None)
+    ev.add_argument("--sample_id", type=int, default=None)
+    ev.add_argument("--annotation_names", nargs="*", default=None)
+    ev.set_defaults(func=run_eval_tables_only)
+
+    som = sub.add_parser("somatic_analysis", description="Run mutation signatures and motif graphs")
+    som.add_argument("--input_file", required=True)
+    som.add_argument("--reference", required=True, help="Reference FASTA (for motif windows)")
+    som.add_argument("--reference_name", type=str, default="GRCh38")
+    som.add_argument("--output_prefix", required=True)
+    som.add_argument("--cosmic_version", type=str, default="3.3")
+    som.set_defaults(func=run_somatic_analysis)
+
+    args = ap.parse_args(argv)
+    args.func(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

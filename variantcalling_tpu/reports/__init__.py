@@ -1,0 +1,7 @@
+"""Report subsystem: metric tables, data loaders, and report generation.
+
+TPU-native counterpart of ``ugvc/reports`` + the GATK VariantEval tables
+the reference parses from subprocess output (run_no_gt_report.py:175-256).
+All tables here are computed in-process from columnar variant tables with
+batched device reductions.
+"""

@@ -1,0 +1,156 @@
+"""No-ground-truth callset statistics: indel hmer stats, AF histograms, SNP motifs.
+
+Parity targets (ugvc/pipelines/run_no_gt_report.py, studied not copied):
+- ``insertion_deletion_statistics`` :44-69 — hmer-indel counts per length
+  1..12 × {ins,del} × {A/T, G/C}, split hom (1/1) vs het.
+- ``allele_freq_hist`` :72-87 — per-variant-type AF histogram over 100 bins.
+- ``snp_statistics`` :90-172 — SNP counts per (trinucleotide ref motif,
+  alt) folded onto the 96 canonical (center A/C) classes by reverse
+  complement.
+
+All three run as batched device reductions over class-code vectors (one-hot
+matmul / bincount), not per-record pandas loops.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from variantcalling_tpu.featurize import classify_alleles, gather_windows
+from variantcalling_tpu.io.fasta import FastaReader, revcomp
+from variantcalling_tpu.io.vcf import VariantTable
+from variantcalling_tpu.ops.features import hmer_indel_features
+
+_BASES = "ACGT"
+
+
+def _annotate(table: VariantTable, ref_fasta: str):
+    cols = classify_alleles(table)
+    with FastaReader(ref_fasta) as fa:
+        windows = gather_windows(table, fa, radius=12)
+    hmer_len, hmer_nuc = (
+        np.asarray(x)
+        for x in hmer_indel_features(
+            jnp.asarray(windows), 12, jnp.asarray(cols.is_indel), jnp.asarray(cols.indel_nuc)
+        )
+    )
+    return cols, windows, hmer_len, hmer_nuc
+
+
+def insertion_deletion_statistics(
+    table: VariantTable, cols, hmer_len: np.ndarray, hmer_nuc: np.ndarray, sample: int = 0
+) -> dict[str, pd.DataFrame]:
+    """{'homo','hete'} -> (4 × 12) hmer count frames (index ins A/ins G/del A/del G)."""
+    gts = table.genotypes(sample)
+    hom = (gts[:, 0] == 1) & (gts[:, 1] == 1)
+
+    # class code per variant: (ins/del) × (A/T vs G/C) = 4 classes; -1 n/a
+    is_at = (hmer_nuc == 0) | (hmer_nuc == 3)
+    is_gc = (hmer_nuc == 1) | (hmer_nuc == 2)
+    cls = np.where(
+        cols.is_ins & is_at, 0, np.where(cols.is_ins & is_gc, 1, np.where(is_at, 2, np.where(is_gc, 3, -1)))
+    )
+    valid = cols.is_indel & (hmer_len >= 1) & (hmer_len <= 12) & (cls >= 0)
+
+    def tally(zygosity_mask: np.ndarray) -> pd.DataFrame:
+        m = valid & zygosity_mask
+        # fused one-hot count over (class × length) on device
+        code = cls[m] * 12 + (hmer_len[m] - 1)
+        counts = np.asarray(jnp.bincount(jnp.asarray(code), length=48)).reshape(4, 12)
+        return pd.DataFrame(counts, index=["ins A", "ins G", "del A", "del G"], columns=range(1, 13))
+
+    return {"homo": tally(hom), "hete": tally(~hom)}
+
+
+def variant_type_labels(cols, hmer_len: np.ndarray) -> np.ndarray:
+    """snp / h-indel / non-h-indel labels (annotate_concordance convention)."""
+    return np.where(
+        cols.is_snp, "snp", np.where(cols.is_indel & (hmer_len > 0), "h-indel", "non-h-indel")
+    )
+
+
+def allele_freq_hist(table: VariantTable, vtype: np.ndarray, nbins: int = 100, sample: int = 0) -> pd.DataFrame:
+    """Per-variant-type AF histogram (VAF from FORMAT/VAF|AF, else AD/DP)."""
+    af = _compute_af(table, sample)
+    result = {}
+    edges = np.linspace(0, 1, nbins + 1)
+    for group in pd.unique(vtype):
+        vals = af[(vtype == group) & ~np.isnan(af)]
+        hist = np.asarray(jnp.histogram(jnp.asarray(vals), bins=jnp.asarray(edges))[0]) if len(vals) else np.zeros(nbins, dtype=np.int64)
+        result[group] = pd.Series(hist)
+    return pd.DataFrame(result)
+
+
+def _compute_af(table: VariantTable, sample: int = 0) -> np.ndarray:
+    n = len(table)
+    for key in ("VAF", "AF"):
+        raw = table.format_field(key, sample)
+        if any(r not in (None, ".", "") for r in raw):
+            out = np.full(n, np.nan)
+            for i, r in enumerate(raw):
+                if r not in (None, ".", ""):
+                    try:
+                        out[i] = float(r.split(",")[0])
+                    except ValueError:
+                        pass
+            return out
+    ad = table.format_numeric("AD", sample=sample, missing=np.nan)
+    dp = table.format_numeric("DP", sample=sample, max_len=1, missing=np.nan)
+    if ad.shape[1] >= 2:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(dp[:, 0] > 0, ad[:, 1] / dp[:, 0], np.nan)
+    return np.full(n, np.nan)
+
+
+def motif_index_96() -> pd.MultiIndex:
+    """The 96 canonical (trinucleotide with center A/C, alt != center) classes."""
+    return pd.MultiIndex.from_tuples(
+        [
+            x
+            for x in itertools.product(
+                ["".join(m) for m in itertools.product(_BASES, repeat=3)], list(_BASES)
+            )
+            if x[0][1] != x[1] and x[0][1] in ("A", "C")
+        ],
+        names=["ref_motif", "alt_1"],
+    )
+
+
+def _fold_table() -> np.ndarray:
+    """(64, 4) -> canonical class id 0..95 (or -1): static fold map.
+
+    Center G/T motifs map via reverse complement of (motif, alt); built
+    once host-side, applied as a device gather.
+    """
+    canon = {t: i for i, t in enumerate(motif_index_96())}
+    out = np.full((64, 4), -1, dtype=np.int32)
+    for m in range(64):
+        motif = _BASES[m // 16] + _BASES[(m // 4) % 4] + _BASES[m % 4]
+        for a in range(4):
+            alt = _BASES[a]
+            if motif[1] == alt:
+                continue
+            key = (motif, alt) if motif[1] in ("A", "C") else (revcomp(motif), revcomp(alt))
+            out[m, a] = canon[key]
+    return out
+
+
+def snp_statistics(table: VariantTable, cols, windows: np.ndarray, center: int = 12) -> pd.Series:
+    """96-class folded SNP motif counts as one device bincount."""
+    m = cols.is_snp & (cols.ref_code < 4) & (cols.alt_code < 4)
+    left = windows[m, center - 1].astype(np.int64)
+    mid = cols.ref_code[m].astype(np.int64)
+    right = windows[m, center + 1].astype(np.int64)
+    ok = (left < 4) & (right < 4)
+    motif_code = left[ok] * 16 + mid[ok] * 4 + right[ok]
+    alt_code = cols.alt_code[m][ok].astype(np.int64)
+    fold = _fold_table()
+    cls = fold[motif_code, alt_code]
+    cls = cls[cls >= 0]
+    counts = np.asarray(jnp.bincount(jnp.asarray(cls), length=96)) if len(cls) else np.zeros(96, dtype=np.int64)
+    return pd.Series(counts.astype(np.int64), index=motif_index_96(), name="size")

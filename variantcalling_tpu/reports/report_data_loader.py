@@ -1,0 +1,126 @@
+"""Concordance-frame loader for the variant report.
+
+Parity target: ugvc/reports/report_data_loader.py:8-126 — loads the
+run_comparison h5 (key ``all``), derives fp/fn/tp masks, max_vaf, qual
+fallback, and the per-variant ErrorType from (ground-truth, called)
+genotype pairs. Genotypes here are ``j/k`` strings (the columnar frame's
+representation); error typing is vectorized over parsed allele sets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.reports.report_utils import ErrorType
+from variantcalling_tpu.utils.h5_utils import read_hdf
+
+COMMON_COLUMNS = [
+    "indel",
+    "hmer_indel_length",
+    "tree_score",
+    "filter",
+    "blacklst",
+    "classify",
+    "classify_gt",
+    "indel_length",
+    "hmer_indel_nuc",
+    "well_mapped_coverage",
+    "base",
+    "call",
+    "gt_ground_truth",
+    "gt_ultima",
+    "ad",
+    "dp",
+    "vaf",
+    "ref",
+    "alleles",
+    "gc_content",
+    "indel_classify",
+    "qual",
+    "gq",
+]
+
+
+def _gt_set(g) -> frozenset:
+    """'0/1' | '1|1' | './.' | tuple -> set of allele ints (None for '.')."""
+    if isinstance(g, tuple):
+        return frozenset(g)
+    if g is None or (isinstance(g, float) and np.isnan(g)):
+        return frozenset({None})
+    parts = str(g).replace("|", "/").split("/")
+    return frozenset(None if p in (".", "") else int(p) for p in parts)
+
+
+def get_error_type(gtr, call) -> ErrorType:
+    """Reference decision tree (report_data_loader.py:106-126)."""
+    gtr_gt = _gt_set(gtr)
+    call_gt = _gt_set(call)
+    if gtr_gt == call_gt:
+        return ErrorType.NO_ERROR
+    if gtr_gt in (frozenset({0}), frozenset({None})):
+        return ErrorType.NOISE
+    if call_gt in (frozenset({0}), frozenset({None})):
+        return ErrorType.NO_VARIANT
+    if gtr_gt & call_gt == gtr_gt:
+        return ErrorType.HOM_TO_HET
+    if gtr_gt & call_gt == call_gt:
+        return ErrorType.HET_TO_HOM
+    return ErrorType.WRONG_ALLELE
+
+
+class ReportDataLoader:
+    def __init__(self, concordance_file: str, reference_version: str = "hg38", exome_column_name: str = "exome.twist"):
+        self.concordance_file = concordance_file
+        self.reference_version = reference_version
+        self.columns = self._columns_subset(exome_column_name)
+        self.rename_dict = self._rename_dict()
+
+    def load_concordance_df(self) -> pd.DataFrame:
+        df = read_hdf(
+            self.concordance_file, key="all", skip_keys=["concordance", "input_args"], columns_subset=self.columns
+        )
+        df = df.rename(columns=self.rename_dict)
+        df["fp"] = (df["call"] == "FP") | (df["call"] == "FP_CA")
+        df["fn"] = (df["base"] == "FN") | (df["base"] == "FN_CA")
+        df["tp"] = df["call"] == "TP"
+        if "vaf" not in df.columns:
+            with np.errstate(invalid="ignore", divide="ignore"):
+                ad1 = df["ad"].apply(lambda x: float(str(x).split(",")[1]) if isinstance(x, str) and "," in x else 0.0)
+                df["vaf"] = ad1 / df["dp"].replace(0, np.nan)
+        df["max_vaf"] = df["vaf"].apply(lambda x: 0 if isinstance(x, float) and np.isnan(x) else (max(x) if isinstance(x, (tuple, list)) else x))
+        if "qual" not in df or (~df["qual"].isna()).sum() == 0:
+            df["qual"] = df["tree_score"]
+        df["error_type"] = [
+            get_error_type(g, u) for g, u in zip(df["gt_ground_truth"], df["gt_ultima"])
+        ]
+        df = df.rename(columns={"hmer_indel_length": "hmer_length"})
+        return df
+
+    def load_sv_concordance_df(self) -> tuple[dict, dict]:
+        import pickle
+
+        with open(self.concordance_file, "rb") as f:
+            data = pickle.load(f)
+        dfs_no_gt = {k: v for k, v in data.items() if k.endswith("counts")}
+        dfs_with_gt = {k: v for k, v in data.items() if not k.endswith("counts")}
+        return dfs_no_gt, dfs_with_gt
+
+    def _rename_dict(self):
+        if self.reference_version == "hg38":
+            return {"LCR-hs38": "LCR"}
+        if self.reference_version == "hg19":
+            return {
+                "LCR-hg19_tab_no_chr": "LCR",
+                "mappability.hg19.0_tab_no_chr": "mappability.0",
+                "ug_hcr_hg19_no_chr": "ug_hcr",
+            }
+        return {}
+
+    def _columns_subset(self, exome_column_name):
+        cols = COMMON_COLUMNS + [exome_column_name]
+        if self.reference_version == "hg38":
+            return cols + ["LCR-hs38", "mappability.0", "ug_hcr", "callable"]
+        if self.reference_version == "hg19":
+            return cols + ["LCR-hg19_tab_no_chr", "mappability.hg19.0_tab_no_chr", "ug_hcr_hg19_no_chr", "callable"]
+        return cols

