@@ -1,6 +1,7 @@
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from variantcalling_tpu.models import forest as fmod
@@ -83,3 +84,40 @@ def test_registry_roundtrip(tmp_path, rng):
     np.testing.assert_allclose(s1, s2, atol=1e-6)
     with pytest.raises(KeyError):
         registry.load_model(str(path), "nope")
+
+
+def test_gemm_matches_gather_synthetic(rng):
+    """GEMM (MXU matmul) encoding is leaf-exact vs the gather walk."""
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    for depth in (3, 6, 8, 10):
+        f = synthetic_forest(rng, n_trees=5, depth=depth, n_features=12)
+        x = rng.uniform(0, 50, (400, 12)).astype(np.float32)
+        a = np.asarray(fmod.predict_score(f, jnp.asarray(x)))
+        b = np.asarray(fmod.predict_score_gemm(fmod.to_gemm(f, 12), jnp.asarray(x)))
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=f"depth={depth}")
+
+
+def test_gemm_matches_sklearn(rng):
+    from sklearn.ensemble import GradientBoostingClassifier, RandomForestClassifier
+
+    x = rng.random((1500, 8)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] + rng.normal(0, 0.2, 1500) > 0.6).astype(int)
+    xq = rng.random((800, 8)).astype(np.float32)
+    for clf in (
+        RandomForestClassifier(n_estimators=8, max_depth=7, random_state=0).fit(x, y),
+        GradientBoostingClassifier(n_estimators=10, max_depth=4, random_state=0).fit(x, y),
+    ):
+        flat = fmod.from_sklearn(clf)
+        s = np.asarray(fmod.predict_score_gemm(fmod.to_gemm(flat, 8), jnp.asarray(xq)))
+        np.testing.assert_allclose(s, clf.predict_proba(xq)[:, 1], atol=2e-6)
+
+
+def test_make_predictor_cpu_uses_gather(rng):
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    f = synthetic_forest(rng, n_trees=3, depth=4, n_features=12)
+    x = rng.uniform(0, 50, (64, 12)).astype(np.float32)
+    pred = fmod.make_predictor(f, 12)
+    s = np.asarray(jax.jit(pred)(jnp.asarray(x)))
+    np.testing.assert_allclose(s, np.asarray(fmod.predict_score(f, jnp.asarray(x))), atol=1e-6)

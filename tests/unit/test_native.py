@@ -1,0 +1,139 @@
+"""Native C++ engine parity tests: BGZF codec, BAM depth walker, intervals.
+
+Each native entry point is checked against its pure-Python fallback (the
+readable spec) on the same synthetic inputs — the CPU-reference-vs-kernel
+parity tier SURVEY.md §4 calls for, applied to the host-side engine.
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from tests.fixtures import write_bam
+from variantcalling_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+
+
+def test_bgzf_round_trip(rng):
+    data = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    blob = native.bgzf_compress(data)
+    # stdlib gzip can read BGZF (multi-member gzip)
+    assert gzip.decompress(blob) == data
+    assert native.bgzf_decompress(blob) == data
+    # and the native inflater reads plain (non-BGZF) gzip too
+    assert native.bgzf_decompress(gzip.compress(data)) == data
+
+
+def test_bgzf_empty():
+    blob = native.bgzf_compress(b"")
+    assert native.bgzf_decompress(blob) == b""
+
+
+def test_bgzf_eof_sentinel():
+    from variantcalling_tpu.io.bgzf import BGZF_EOF
+
+    assert native.bgzf_compress(b"x")[-28:] == BGZF_EOF
+
+
+def _python_depth(path, **kw):
+    import os
+
+    os.environ["VCTPU_NO_NATIVE"] = "1"
+    try:
+        native._TRIED, native._LIB = True, None
+        from variantcalling_tpu.io.bam import depth_diff_arrays
+
+        return depth_diff_arrays(path, **kw)
+    finally:
+        del os.environ["VCTPU_NO_NATIVE"]
+        native._TRIED = False
+
+
+def test_bam_depth_parity(tmp_path, rng):
+    contigs = {"chr1": 500, "chr2": 300}
+    reads = []
+    for _ in range(200):
+        contig = "chr1" if rng.random() < 0.7 else "chr2"
+        pos = int(rng.integers(0, contigs[contig] - 60))
+        style = rng.integers(0, 4)
+        if style == 0:
+            cigar = [("M", 50)]
+        elif style == 1:
+            cigar = [("S", 5), ("M", 20), ("D", 4), ("M", 20)]
+        elif style == 2:
+            cigar = [("M", 10), ("I", 3), ("M", 30), ("N", 8), ("M", 5)]
+        else:
+            cigar = [("M", 25), ("X", 5), ("=", 10)]
+        read_len = sum(l for op, l in cigar if op in "MIS=X")
+        reads.append(
+            {
+                "contig": contig,
+                "pos": pos,
+                "cigar": cigar,
+                "mapq": int(rng.integers(0, 61)),
+                "flag": int(rng.choice([0, 16, 0x400, 0x100])),
+                "quals": [int(q) for q in rng.integers(2, 41, read_len)],
+            }
+        )
+    path = str(tmp_path / "t.bam")
+    write_bam(path, contigs, reads)
+
+    for kw in (
+        {},
+        {"min_mapq": 20},
+        {"min_bq": 20},
+        {"min_bq": 25, "min_mapq": 10, "min_read_length": 40},
+        {"include_deletions": False, "min_bq": 15},
+        {"regions": ["chr2"]},
+    ):
+        hdr_n, d_n = None, None
+        from variantcalling_tpu.io.bam import _depth_diff_arrays_native
+
+        region_contigs = {r.split(":")[0] for r in kw.get("regions", [])} or None
+        out = _depth_diff_arrays_native(
+            path,
+            kw.get("min_bq", 0),
+            kw.get("min_mapq", 0),
+            kw.get("min_read_length", 0),
+            kw.get("include_deletions", True),
+            region_contigs,
+        )
+        assert out is not None, "native path unexpectedly unavailable"
+        hdr_n, d_n = out
+        hdr_p, d_p = _python_depth(path, **kw)
+        assert hdr_n.references == hdr_p.references
+        assert set(d_n) == set(d_p), kw
+        for name in d_p:
+            np.testing.assert_array_equal(d_n[name], d_p[name], err_msg=f"{name} {kw}")
+
+
+def test_interval_membership_parity(rng):
+    starts = np.sort(rng.choice(10_000, 50, replace=False)).astype(np.int64)
+    ends = starts + rng.integers(1, 120, 50)
+    # enforce non-overlap
+    ends = np.minimum(ends, np.append(starts[1:], 10**9))
+    pos = rng.integers(0, 11_000, 5000)
+    got = native.interval_membership(starts, ends, pos)
+    want = np.zeros(len(pos), dtype=np.uint8)
+    idx = np.searchsorted(starts, pos, side="right") - 1
+    ok = idx >= 0
+    want[ok] = (pos[ok] < ends[idx[ok]]).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_vcf_reader_native_gz(tmp_path):
+    from variantcalling_tpu.io.bgzf import BgzfWriter
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    path = str(tmp_path / "t.vcf.gz")
+    with BgzfWriter(path) as fh:
+        fh.write("##fileformat=VCFv4.2\n")
+        fh.write('##contig=<ID=chr1,length=1000>\n')
+        fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        fh.write("chr1\t100\t.\tA\tG\t50\tPASS\t.\n")
+        fh.write("chr1\t200\t.\tC\tT\t30\tPASS\t.\n")
+    table = read_vcf(path)
+    assert len(table.pos) == 2
+    assert table.pos.tolist() == [100, 200]

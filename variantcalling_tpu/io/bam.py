@@ -136,6 +136,11 @@ def depth_diff_arrays(
     region_contigs = None
     if regions:
         region_contigs = {r.split(":")[0] for r in regions}
+    native_out = _depth_diff_arrays_native(
+        path, min_bq, min_mapq, min_read_length, include_deletions, region_contigs
+    )
+    if native_out is not None:
+        return native_out
     with BamReader(path) as bam:
         refs = bam.header.references
         diffs: dict[str, np.ndarray] = {}
@@ -162,6 +167,72 @@ def depth_diff_arrays(
                 if op in _REF_CONSUME:
                     ref_pos += length
         return bam.header, diffs
+
+
+def _depth_diff_arrays_native(
+    path: str,
+    min_bq: int,
+    min_mapq: int,
+    min_read_length: int,
+    include_deletions: bool,
+    region_contigs: set[str] | None,
+) -> tuple[BamHeader, dict[str, np.ndarray]] | None:
+    """C++ fast path: whole-file BGZF inflate + native record walk."""
+    from variantcalling_tpu import native
+
+    if not native.available():
+        return None
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    arr = native.bgzf_decompress_array(raw)
+    del raw
+    if arr is None:
+        return None
+    buf = memoryview(arr)  # zero-copy view for header parsing
+    if bytes(buf[:4]) != b"BAM\x01":
+        return None
+    (l_text,) = struct.unpack_from("<i", buf, 4)
+    off = 8 + l_text
+    text = bytes(buf[8 : 8 + l_text]).rstrip(b"\x00").decode(errors="replace")
+    (n_ref,) = struct.unpack_from("<i", buf, off)
+    off += 4
+    refs: list[str] = []
+    lengths: dict[str, int] = {}
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", buf, off)
+        name = bytes(buf[off + 4 : off + 4 + l_name - 1]).decode()
+        (l_ref,) = struct.unpack_from("<i", buf, off + 4 + l_name)
+        off += 8 + l_name
+        refs.append(name)
+        lengths[name] = l_ref
+    header = BamHeader(text, refs, lengths)
+    starts = np.full(n_ref, -1, dtype=np.int64)
+    lens = np.zeros(n_ref, dtype=np.int64)
+    cursor = 0
+    for i, name in enumerate(refs):
+        lens[i] = lengths[name]
+        if region_contigs is None or name in region_contigs:
+            starts[i] = cursor
+            cursor += lengths[name] + 1
+    diff_flat = np.zeros(max(cursor, 1), dtype=np.int32)
+    n = native.bam_depth(
+        arr[off:],  # numpy slice: zero-copy view
+        starts,
+        lens,
+        diff_flat,
+        min_bq=min_bq,
+        min_mapq=min_mapq,
+        min_read_length=min_read_length,
+        include_deletions=include_deletions,
+        exclude_flags=EXCLUDE_FLAGS,
+    )
+    if n is None:
+        return None
+    diffs: dict[str, np.ndarray] = {}
+    for i, name in enumerate(refs):
+        if starts[i] >= 0:
+            diffs[name] = diff_flat[starts[i] : starts[i] + lengths[name] + 1]
+    return header, diffs
 
 
 def _add_bq_filtered(diff: np.ndarray, aln: Alignment, min_bq: int, cov_ops: set) -> None:

@@ -115,9 +115,9 @@ def _obj(x: list[str]) -> np.ndarray:
 
 
 def _open_text(path: str):
-    if str(path).endswith(".gz"):
-        return gzip.open(path, "rt")
-    return open(path, "rt")
+    from variantcalling_tpu.io.vcf import _open_text as _vcf_open_text
+
+    return _vcf_open_text(path)
 
 
 def read_bed(path: str) -> IntervalSet:

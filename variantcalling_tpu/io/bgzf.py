@@ -50,11 +50,24 @@ class BgzfWriter:
         if isinstance(data, str):
             data = data.encode("utf-8")
         self._buf += data
-        while len(self._buf) >= MAX_BLOCK_DATA:
-            chunk = bytes(self._buf[:MAX_BLOCK_DATA])
-            del self._buf[:MAX_BLOCK_DATA]
-            self._fh.write(compress_block(chunk, self._level))
+        if len(self._buf) >= MAX_BLOCK_DATA:
+            n_full = (len(self._buf) // MAX_BLOCK_DATA) * MAX_BLOCK_DATA
+            chunk = bytes(self._buf[:n_full])
+            del self._buf[:n_full]
+            self._fh.write(self._compress_blocks(chunk))
         return len(data)
+
+    def _compress_blocks(self, chunk: bytes) -> bytes:
+        """Compress a multiple-of-block-size payload (C path when built)."""
+        from variantcalling_tpu import native
+
+        out = native.bgzf_compress(chunk, self._level)
+        if out is not None:
+            return out[:-28]  # strip the EOF sentinel; close() writes it once
+        return b"".join(
+            compress_block(chunk[i : i + MAX_BLOCK_DATA], self._level)
+            for i in range(0, len(chunk), MAX_BLOCK_DATA)
+        )
 
     def close(self) -> None:
         if self._fh.closed:

@@ -26,8 +26,21 @@ import numpy as np
 MISSING = "."
 
 
+# above this compressed size, keep constant-memory streaming via gzip
+# rather than whole-file native inflation (shared by io/bed.py)
+NATIVE_INFLATE_MAX_BYTES = 512 << 20
+
+
 def _open_text(path: str):
     if str(path).endswith(".gz") or str(path).endswith(".bgz"):
+        from variantcalling_tpu import native
+
+        if native.available() and os.path.getsize(path) <= NATIVE_INFLATE_MAX_BYTES:
+            with open(path, "rb") as fh:
+                raw = fh.read()
+            data = native.bgzf_decompress(raw)
+            if data is not None:
+                return _io.TextIOWrapper(_io.BytesIO(data), encoding="utf-8")
         return _io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
     return open(path, "rt", encoding="utf-8")
 

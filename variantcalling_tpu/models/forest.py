@@ -80,6 +80,137 @@ def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     raise ValueError(f"unknown aggregation {forest.aggregation!r}")
 
 
+@dataclass
+class GemmForest:
+    """MXU-friendly forest encoding (Hummingbird-style GEMM strategy).
+
+    Tree traversal recast as matmuls so inference rides the systolic array
+    instead of XLA's (slow on TPU) dynamic gathers:
+
+      XF    = X @ A          (N,F)@(F,I) one-hot feature pick per internal node
+      D     = XF <= thr      {0,1} decisions
+      match = D @ M2 + c     (N,I)@(I,L); M2 = 2*B - P with B[i,l]=1 iff leaf
+                             l sits in i's LEFT subtree, P[i,l]=1 iff i is on
+                             l's path; c[l] = #right-turns on l's path
+      leaf  = (match == path_len)   — exactly one leaf matches
+      score = leaf @ value
+
+    All matmul operands are small exact integers (|M2|<=1, path sums <=
+    depth), so the routing matmuls are bit-exact even in bf16; the feature
+    pick runs at HIGHEST precision to keep threshold compares faithful.
+    """
+
+    a: np.ndarray  # f32 (T, F, I) one-hot feature selectors
+    thr: np.ndarray  # f32 (T, I)
+    m2: np.ndarray  # f32 (T, I, L) = 2B - P
+    c: np.ndarray  # f32 (T, L) right-turn counts
+    plen: np.ndarray  # f32 (T, L); -1 for padded leaves
+    value: np.ndarray  # f32 (T, L)
+    aggregation: str
+    base_score: float
+
+    @property
+    def n_leaves(self) -> int:
+        return self.m2.shape[2]
+
+
+def to_gemm(forest: FlatForest, n_features: int | None = None) -> GemmForest:
+    """Rewrite a FlatForest into path-matrix (GEMM) form (host-side, once)."""
+    t = forest.n_trees
+    n_features = int(n_features if n_features is not None else max(int(forest.feature.max()) + 1, 1))
+    per_tree = []
+    max_i, max_l = 1, 1
+    for ti in range(t):
+        feat, left, right = forest.feature[ti], forest.left[ti], forest.right[ti]
+        internals: list[int] = []
+        leaves: list[int] = []
+        paths: list[list[tuple[int, bool]]] = []
+        stack: list[tuple[int, list[tuple[int, bool]]]] = [(0, [])]
+        while stack:
+            node, path = stack.pop()
+            if feat[node] == LEAF:
+                leaves.append(node)
+                paths.append(path)
+            else:
+                k = len(internals)
+                internals.append(node)
+                stack.append((int(right[node]), path + [(k, False)]))
+                stack.append((int(left[node]), path + [(k, True)]))
+        per_tree.append((internals, leaves, paths))
+        max_i = max(max_i, len(internals))
+        max_l = max(max_l, len(leaves))
+    a = np.zeros((t, n_features, max_i), dtype=np.float32)
+    thr = np.zeros((t, max_i), dtype=np.float32)
+    m2 = np.zeros((t, max_i, max_l), dtype=np.float32)
+    c = np.zeros((t, max_l), dtype=np.float32)
+    plen = np.full((t, max_l), -1.0, dtype=np.float32)  # -1: padded leaf never matches
+    value = np.zeros((t, max_l), dtype=np.float32)
+    for ti, (internals, leaves, paths) in enumerate(per_tree):
+        for k, node in enumerate(internals):
+            a[ti, forest.feature[ti, node], k] = 1.0
+            thr[ti, k] = forest.threshold[ti, node]
+        for j, (node, path) in enumerate(zip(leaves, paths)):
+            value[ti, j] = forest.value[ti, node]
+            plen[ti, j] = len(path)
+            for k, went_left in path:
+                m2[ti, k, j] = 1.0 if went_left else -1.0  # 2B-P: left=+1, right=-1
+                if not went_left:
+                    c[ti, j] += 1.0
+    return GemmForest(a, thr, m2, c, plen, value, forest.aggregation, forest.base_score)
+
+
+# beyond this many leaves per tree the (N,I)@(I,L) routing matmul costs more
+# than the gather walk saves; fall back to the gather traversal
+GEMM_MAX_LEAVES = 512
+
+
+def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    """TREE_SCORE via the matmul formulation (jit/pjit-safe, MXU-bound).
+
+    Scans over trees so peak memory is O(N * (I+L)) rather than
+    O(T * N * L): each step is two (N,·)@(·,·) matmuls that tile cleanly
+    onto the systolic array.
+    """
+    tables = (
+        jnp.asarray(gf.a),
+        jnp.asarray(gf.thr),
+        jnp.asarray(gf.m2),
+        jnp.asarray(gf.c),
+        jnp.asarray(gf.plen),
+        jnp.asarray(gf.value),
+    )
+
+    def per_tree(acc, tree):
+        a, thr, m2, c, plen, value = tree
+        # one-hot feature pick must preserve f32 values exactly: default
+        # matmul precision rounds operands to bf16
+        xf = jnp.dot(x, a, precision=jax.lax.Precision.HIGHEST)  # (N,I)
+        d = (xf <= thr[None, :]).astype(jnp.float32)
+        # routing matmul: operands are small exact integers — bf16-safe
+        match = jnp.dot(d, m2) + c[None, :]  # (N,L)
+        onehot = (match == plen[None, :]).astype(jnp.float32)
+        s = jnp.dot(onehot, value, precision=jax.lax.Precision.HIGHEST)  # (N,)
+        return acc + s, None
+
+    total, _ = jax.lax.scan(per_tree, jnp.zeros(x.shape[0], dtype=jnp.float32), tables)
+    if gf.aggregation == "mean":
+        return total / gf.m2.shape[0]
+    if gf.aggregation == "logit_sum":
+        return jax.nn.sigmoid(total + gf.base_score)
+    raise ValueError(f"unknown aggregation {gf.aggregation!r}")
+
+
+def make_predictor(forest: FlatForest, n_features: int | None = None):
+    """Best inference strategy for the active backend: GEMM encoding on
+    TPU-class devices when trees are small enough for the routing matmul,
+    else the gather walk. Returns a jittable fn(x) -> scores."""
+    gf = to_gemm(forest, n_features)
+    use_gemm = gf.n_leaves <= GEMM_MAX_LEAVES and jax.default_backend() != "cpu"
+    if use_gemm:
+        return lambda x: predict_score_gemm(gf, x)
+    return lambda x: predict_score(forest, x)
+
+
 def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: float = 0.5) -> FlatForest:
     """Flatten a fitted sklearn RandomForestClassifier/DecisionTree ensemble.
 

@@ -1,0 +1,189 @@
+"""Native (C++) host engine: BGZF codec, BAM depth walker, interval joins.
+
+The reference's native layer is external subprocessed binaries (samtools,
+bgzip/tabix, bedtools — SURVEY.md §2.5); ours is an in-process shared
+library (``src/vctpu_native.cc``) compiled on demand with g++ and bound via
+ctypes (pybind11 is not in the image). Every entry point has a pure-Python
+fallback at its call site (io/bam.py depth walk, io/vcf.py + io/bed.py
+compressed-text ingest, io/bgzf.py block writer), so the framework works
+without a toolchain; with one, ingest runs at C speed and feeds flat
+arrays straight to the device kernels.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "src", "vctpu_native.cc")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+_i64 = ctypes.c_int64
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> str | None:
+    with open(_SRC, "rb") as fh:
+        tag = hashlib.sha256(fh.read()).hexdigest()[:12]
+    out = os.path.join(_DIR, f"_vctpu_native_{tag}.so")
+    if os.path.exists(out):
+        return out
+    # per-process tmp name keeps os.replace atomic under concurrent builds
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, "-lz"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        os.replace(tmp, out)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return out
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Compile (once, cached by source hash) and load the native library."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("VCTPU_NO_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.vctpu_bgzf_uncompressed_size.restype = _i64
+        lib.vctpu_bgzf_uncompressed_size.argtypes = [_u8p, _i64]
+        lib.vctpu_gzip_inflate.restype = _i64
+        lib.vctpu_gzip_inflate.argtypes = [_u8p, _i64, _u8p, _i64]
+        lib.vctpu_bgzf_compress.restype = _i64
+        lib.vctpu_bgzf_compress.argtypes = [_u8p, _i64, _u8p, _i64, ctypes.c_int]
+        lib.vctpu_bam_depth.restype = _i64
+        lib.vctpu_bam_depth.argtypes = [
+            _u8p, _i64, _i64p, _i64p, ctypes.c_int32, _i32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
+        ]
+        lib.vctpu_interval_membership.restype = None
+        lib.vctpu_interval_membership.argtypes = [_i64p, _i64p, _i64, _i64p, _i64, _u8p]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _u8view(data) -> np.ndarray:
+    """Zero-copy uint8 view over bytes / bytearray / ndarray."""
+    return np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+
+
+def bgzf_decompress_array(data) -> np.ndarray | None:
+    """Inflate a whole BGZF/gzip buffer to a uint8 array (no extra copies)."""
+    lib = get_lib()
+    if lib is None or len(data) == 0:
+        return None
+    src_arr = np.ascontiguousarray(_u8view(data))
+    src = src_arr.ctypes.data_as(_u8p)
+    size = lib.vctpu_bgzf_uncompressed_size(src, len(src_arr))
+    if size < 0:
+        # not BGZF-framed: inflate with geometric capacity growth
+        cap = max(4 * len(src_arr), 1 << 16)
+        for _ in range(8):
+            dst = np.empty(cap, dtype=np.uint8)
+            n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), cap)
+            if n >= 0:
+                return dst[:n]
+            cap *= 4
+        return None
+    dst = np.empty(max(int(size), 1), dtype=np.uint8)
+    n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
+    if n != size:
+        return None
+    return dst[:n]
+
+
+def bgzf_decompress(data: bytes) -> bytes | None:
+    """Inflate a whole BGZF/gzip byte string; None → use the Python fallback."""
+    out = bgzf_decompress_array(data)
+    return None if out is None else out.tobytes()
+
+
+def bgzf_compress(data: bytes, level: int = 6) -> bytes | None:
+    """Deflate into BGZF blocks (+EOF sentinel); None → Python fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(data or b"\x00")
+    n_blocks = len(data) // 65280 + 1
+    cap = len(data) + n_blocks * 128 + 64
+    dst = np.empty(cap, dtype=np.uint8)
+    n = lib.vctpu_bgzf_compress(src, len(data), dst.ctypes.data_as(_u8p), cap, level)
+    if n < 0:
+        return None
+    return dst[:n].tobytes()
+
+
+def bam_depth(
+    records,
+    contig_starts: np.ndarray,
+    contig_lens: np.ndarray,
+    diff_flat: np.ndarray,
+    *,
+    min_bq: int = 0,
+    min_mapq: int = 0,
+    min_read_length: int = 0,
+    include_deletions: bool = True,
+    exclude_flags: int = 0x704,
+) -> int | None:
+    """Accumulate depth diffs over raw BAM records (bytes or uint8 array view);
+    None → Python fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    starts = np.ascontiguousarray(contig_starts, dtype=np.int64)
+    lens = np.ascontiguousarray(contig_lens, dtype=np.int64)
+    assert diff_flat.dtype == np.int32 and diff_flat.flags["C_CONTIGUOUS"]
+    src_arr = np.ascontiguousarray(_u8view(records))
+    n = lib.vctpu_bam_depth(
+        src_arr.ctypes.data_as(_u8p), len(src_arr),
+        starts.ctypes.data_as(_i64p), lens.ctypes.data_as(_i64p), len(starts),
+        diff_flat.ctypes.data_as(_i32p),
+        min_bq, min_mapq, min_read_length, int(include_deletions), exclude_flags,
+    )
+    return None if n < 0 else int(n)
+
+
+def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:
+    """1/0 membership of each pos in sorted non-overlapping [start, end)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    s = np.ascontiguousarray(starts, dtype=np.int64)
+    e = np.ascontiguousarray(ends, dtype=np.int64)
+    p = np.ascontiguousarray(pos, dtype=np.int64)
+    out = np.zeros(len(p), dtype=np.uint8)
+    lib.vctpu_interval_membership(
+        s.ctypes.data_as(_i64p), e.ctypes.data_as(_i64p), len(s),
+        p.ctypes.data_as(_i64p), len(p), out.ctypes.data_as(_u8p),
+    )
+    return out

@@ -1,0 +1,249 @@
+// vctpu native engine: BGZF codec + BAM depth walker + interval membership.
+//
+// Host-side hot loops behind the TPU ingest layer. The reference gets these
+// from external C binaries (samtools depth: coverage_analysis.py:653-683 in
+// /root/reference; bgzip/tabix: bash/index_vcf_file.sh) — here they are
+// in-process, produce flat arrays ready for device transfer, and are loaded
+// via ctypes (no pybind11 in the image). Python fallbacks live beside every
+// call site (io/bam.py, io/bgzf.py); this library is the measured path.
+//
+// Build: g++ -O3 -shared -fPIC vctpu_native.cc -lz  (see native/__init__.py)
+
+#include <zlib.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Parse one gzip member header starting at src[off]; return the BGZF BSIZE
+// (total block length) from the BC extra subfield, or -1 if not BGZF-framed.
+int64_t bgzf_block_size(const uint8_t* src, int64_t n, int64_t off) {
+    if (off + 18 > n) return -1;
+    if (src[off] != 0x1f || src[off + 1] != 0x8b) return -1;
+    if (!(src[off + 3] & 4)) return -1;  // FEXTRA required for BGZF
+    uint16_t xlen = (uint16_t)src[off + 10] | ((uint16_t)src[off + 11] << 8);
+    int64_t xoff = off + 12;
+    int64_t xend = xoff + xlen;
+    if (xend > n) return -1;
+    while (xoff + 4 <= xend) {
+        uint8_t s1 = src[xoff], s2 = src[xoff + 1];
+        uint16_t slen = (uint16_t)src[xoff + 2] | ((uint16_t)src[xoff + 3] << 8);
+        if (xoff + 4 + slen > xend) return -1;
+        if (s1 == 'B' && s2 == 'C' && slen == 2) {
+            int64_t bsize = ((int64_t)src[xoff + 4] | ((int64_t)src[xoff + 5] << 8)) + 1;
+            return bsize;
+        }
+        xoff += 4 + slen;
+    }
+    return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Sum of ISIZE trailers across BGZF blocks (exact uncompressed size).
+// Returns -1 when the stream is not pure BGZF framing (caller falls back).
+int64_t vctpu_bgzf_uncompressed_size(const uint8_t* src, int64_t n) {
+    int64_t off = 0, total = 0;
+    while (off < n) {
+        int64_t bsize = bgzf_block_size(src, n, off);
+        if (bsize < 0 || bsize < 28 || off + bsize > n) return -1;
+        uint32_t isize;
+        std::memcpy(&isize, src + off + bsize - 4, 4);
+        total += isize;
+        off += bsize;
+    }
+    return off == n ? total : -1;
+}
+
+// Inflate a concatenated-gzip-member stream (BGZF is one) into dst.
+// Returns bytes written, or -1 on error / capacity overflow.
+int64_t vctpu_gzip_inflate(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap) {
+    z_stream zs;
+    std::memset(&zs, 0, sizeof zs);
+    if (inflateInit2(&zs, 15 + 32) != Z_OK) return -1;  // auto gzip header
+    int64_t in_off = 0, out_off = 0;
+    int ret = Z_OK;
+    uint8_t scratch[64];  // overflow detector for zero-output tail members
+    while (in_off < n || ret == Z_OK) {
+        uInt in_chunk = (uInt)std::min<int64_t>(n - in_off, 1 << 30);
+        uInt out_chunk = (uInt)std::min<int64_t>(cap - out_off, 1 << 30);
+        bool use_scratch = out_chunk == 0;
+        zs.next_in = const_cast<uint8_t*>(src) + in_off;
+        zs.avail_in = in_chunk;
+        zs.next_out = use_scratch ? scratch : dst + out_off;
+        zs.avail_out = use_scratch ? (uInt)sizeof scratch : out_chunk;
+        uInt gave = zs.avail_out;
+        ret = inflate(&zs, Z_NO_FLUSH);
+        in_off += in_chunk - zs.avail_in;
+        int64_t produced = (int64_t)(gave - zs.avail_out);
+        if (use_scratch && produced > 0) {
+            inflateEnd(&zs);
+            return -1;  // capacity exhausted: member produced real output
+        }
+        if (!use_scratch) out_off += produced;
+        if (ret == Z_STREAM_END) {
+            if (in_off >= n) break;          // done: all members consumed
+            if (inflateReset2(&zs, 15 + 32) != Z_OK) {  // next member
+                inflateEnd(&zs);
+                return -1;
+            }
+            ret = Z_OK;
+            continue;
+        }
+        if (ret != Z_OK) {
+            inflateEnd(&zs);
+            return -1;
+        }
+        if (zs.avail_in == in_chunk && produced == 0) break;  // no progress
+    }
+    inflateEnd(&zs);
+    return out_off;
+}
+
+// Deflate src into independent BGZF blocks (<=65280B payload each) with the
+// BC extra field + canonical EOF sentinel. Returns bytes written or -1.
+int64_t vctpu_bgzf_compress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap, int level) {
+    static const uint8_t EOF_BLOCK[28] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00,
+                                          0x42, 0x43, 0x02, 0x00, 0x1b, 0x00, 0x03, 0x00, 0, 0, 0,
+                                          0, 0, 0, 0, 0};
+    const int64_t CHUNK = 65280;
+    int64_t in_off = 0, out_off = 0;
+    while (in_off < n) {
+        int64_t len = std::min(CHUNK, n - in_off);
+        z_stream zs;
+        std::memset(&zs, 0, sizeof zs);
+        if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK) return -1;
+        uint8_t body[1 << 17];
+        zs.next_in = const_cast<uint8_t*>(src) + in_off;
+        zs.avail_in = (uInt)len;
+        zs.next_out = body;
+        zs.avail_out = sizeof body;
+        int ret = deflate(&zs, Z_FINISH);
+        int64_t deflated = (int64_t)(sizeof body) - zs.avail_out;
+        deflateEnd(&zs);
+        if (ret != Z_STREAM_END) return -1;
+        int64_t bsize = deflated + 26;  // header(18) + crc/isize(8)
+        if (bsize > 0xFFFF + 1) return -1;
+        if (out_off + bsize > cap) return -1;
+        uint8_t* h = dst + out_off;
+        const uint8_t head[12] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00};
+        std::memcpy(h, head, 12);
+        h[12] = 'B';
+        h[13] = 'C';
+        h[14] = 2;
+        h[15] = 0;
+        uint16_t bs16 = (uint16_t)(bsize - 1);
+        std::memcpy(h + 16, &bs16, 2);
+        std::memcpy(h + 18, body, deflated);
+        uint32_t crc = (uint32_t)crc32(0L, src + in_off, (uInt)len);
+        uint32_t isize = (uint32_t)len;
+        std::memcpy(h + 18 + deflated, &crc, 4);
+        std::memcpy(h + 22 + deflated, &isize, 4);
+        out_off += bsize;
+        in_off += len;
+    }
+    if (out_off + 28 > cap) return -1;
+    std::memcpy(dst + out_off, EOF_BLOCK, 28);
+    return out_off + 28;
+}
+
+// Walk uncompressed BAM alignment records (buf starts at the first record,
+// i.e. after the header + reference list) and accumulate per-contig depth
+// difference arrays with samtools-depth semantics (-a -J -q -Q -l;
+// reference call site coverage_analysis.py:653-683).
+//
+// diff_flat holds all selected contigs back to back; contig_starts[ref_id]
+// is the offset of that contig's (length+1)-long diff region, or -1 to skip.
+// Returns records seen, or -1 on malformed input.
+int64_t vctpu_bam_depth(const uint8_t* buf, int64_t n, const int64_t* contig_starts,
+                        const int64_t* contig_lens, int32_t n_refs, int32_t* diff_flat,
+                        int32_t min_bq, int32_t min_mapq, int32_t min_len, int32_t include_del,
+                        uint32_t exclude_flags) {
+    int64_t off = 0, count = 0;
+    while (off + 4 <= n) {
+        int32_t bs;
+        std::memcpy(&bs, buf + off, 4);
+        if (bs < 32 || off + 4 + bs > n) return -1;
+        const uint8_t* r = buf + off + 4;
+        off += 4 + bs;
+        count++;
+        int32_t ref_id, pos, l_seq;
+        uint32_t lrn, flag_nc;
+        std::memcpy(&ref_id, r, 4);
+        std::memcpy(&pos, r + 4, 4);
+        std::memcpy(&lrn, r + 8, 4);
+        std::memcpy(&flag_nc, r + 12, 4);
+        std::memcpy(&l_seq, r + 16, 4);
+        uint32_t l_read_name = lrn & 0xff;
+        int32_t mapq = (int32_t)((lrn >> 8) & 0xff);
+        uint32_t n_cigar = flag_nc & 0xffff;
+        uint32_t flag = flag_nc >> 16;
+        if ((flag & exclude_flags) || ref_id < 0 || ref_id >= n_refs || pos < 0) continue;
+        if (mapq < min_mapq || l_seq < min_len) continue;
+        int64_t base = contig_starts[ref_id];
+        if (base < 0) continue;
+        int64_t clen = contig_lens[ref_id];
+        const uint8_t* cig = r + 32 + l_read_name;
+        const uint8_t* qual = cig + 4 * (int64_t)n_cigar + (l_seq + 1) / 2;
+        if (cig + 4 * (int64_t)n_cigar > buf + off || qual + l_seq > buf + off) return -1;
+        int64_t ref_pos = pos, read_pos = 0;
+        for (uint32_t i = 0; i < n_cigar; i++) {
+            uint32_t c;
+            std::memcpy(&c, cig + 4 * (int64_t)i, 4);
+            uint32_t op = c & 0xf;
+            int64_t len = c >> 4;
+            bool match_like = (op == 0 || op == 7 || op == 8);  // M, =, X
+            bool covers = match_like || (include_del && op == 2);
+            if (covers && ref_pos < clen) {
+                if (!match_like || min_bq <= 0) {
+                    int64_t s = ref_pos, e = std::min(ref_pos + len, clen);
+                    diff_flat[base + s] += 1;
+                    diff_flat[base + e] -= 1;
+                } else {
+                    // run-length encode (qual >= min_bq) into diff updates;
+                    // clamp by l_seq too in case the CIGAR overruns the quals
+                    int64_t s = -1;
+                    int64_t max_j = std::min({len, clen - ref_pos, (int64_t)l_seq - read_pos});
+                    for (int64_t j = 0; j <= max_j; j++) {
+                        bool ok = (j < max_j) && ((int32_t)qual[read_pos + j] >= min_bq);
+                        if (ok && s < 0) {
+                            s = j;
+                        } else if (!ok && s >= 0) {
+                            diff_flat[base + ref_pos + s] += 1;
+                            diff_flat[base + ref_pos + j] -= 1;
+                            s = -1;
+                        }
+                    }
+                }
+            }
+            if (op == 0 || op == 2 || op == 3 || op == 7 || op == 8) ref_pos += len;  // ref-consuming
+            if (op == 0 || op == 1 || op == 4 || op == 7 || op == 8) read_pos += len;  // read-consuming
+        }
+    }
+    return count;
+}
+
+// Membership of each position in a set of sorted, non-overlapping,
+// half-open [start, end) intervals. out[i] = 1 if covered.
+void vctpu_interval_membership(const int64_t* starts, const int64_t* ends, int64_t n_iv,
+                               const int64_t* pos, int64_t n_pos, uint8_t* out) {
+    for (int64_t i = 0; i < n_pos; i++) {
+        int64_t p = pos[i];
+        // rightmost interval with start <= p
+        int64_t lo = 0, hi = n_iv;
+        while (lo < hi) {
+            int64_t mid = (lo + hi) / 2;
+            if (starts[mid] <= p)
+                lo = mid + 1;
+            else
+                hi = mid;
+        }
+        out[i] = (lo > 0 && p < ends[lo - 1]) ? 1 : 0;
+    }
+}
+
+}  // extern "C"

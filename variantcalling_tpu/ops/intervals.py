@@ -61,6 +61,12 @@ def membership(gpos: np.ndarray, gstarts: np.ndarray, gends: np.ndarray) -> np.n
     gpos = np.asarray(gpos, dtype=np.int64)
     if len(gstarts) == 0:
         return np.zeros(gpos.shape, dtype=bool)
+    if gpos.size >= 1 << 16:  # C binary-search path for big joins
+        from variantcalling_tpu import native
+
+        out = native.interval_membership(gstarts, gends, np.maximum(gpos, 0))
+        if out is not None:
+            return out.astype(bool) & (gpos >= 0)
     idx = np.searchsorted(gstarts, gpos, side="right") - 1
     safe = np.clip(idx, 0, len(gstarts) - 1)
     return (idx >= 0) & (gpos < gends[safe]) & (gpos >= 0)

@@ -132,7 +132,8 @@ def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray
     """
     if isinstance(model, FlatForest):
         model = forest_mod.with_feature_order(model, feature_names)
-        fn = jax.jit(lambda xx: forest_mod.predict_score(model, xx))
+        # GEMM (MXU) encoding on TPU, gather walk on CPU
+        fn = jax.jit(forest_mod.make_predictor(model, len(feature_names)))
     elif isinstance(model, ThresholdModel):
         fn = jax.jit(lambda xx: threshold_mod.predict_score(model, xx, feature_names))
     else:  # raw sklearn estimator that escaped conversion

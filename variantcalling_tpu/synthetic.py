@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from variantcalling_tpu.models.forest import FlatForest, predict_score
+from variantcalling_tpu.models.forest import FlatForest, make_predictor
 from variantcalling_tpu.ops import features as fops
 
 N_HOT_FEATURES = 12  # features assembled by fused_hot_path below
@@ -55,9 +55,13 @@ def fused_hot_path(forest: FlatForest):
     """The filter device program: windows+scalars -> features -> TREE_SCORE.
 
     Returns a jittable fn(windows, qual, dp, sor, af, gq, is_het, is_indel,
-    indel_nuc) mirroring the pipeline's featurize+score stage.
+    indel_nuc) mirroring the pipeline's featurize+score stage. Inference
+    strategy picks GEMM (MXU matmuls) on TPU, gather walk on CPU
+    (models/forest.make_predictor).
     """
     import jax.numpy as jnp
+
+    predictor = make_predictor(forest, N_HOT_FEATURES)
 
     def fwd(windows, qual, dp, sor, af, gq, is_het, is_indel, indel_nuc):
         center = windows.shape[1] // 2
@@ -81,7 +85,7 @@ def fused_hot_path(forest: FlatForest):
             ],
             axis=1,
         )
-        return predict_score(forest, x)
+        return predictor(x)
 
     return fwd
 
