@@ -189,6 +189,14 @@ def write_bam(path: str, contigs: dict[str, int], reads: list[dict]) -> None:
                 nibs.append(0)
             rec += bytes((nibs[i] << 4) | nibs[i + 1] for i in range(0, len(nibs), 2))
         rec += bytes(quals[:read_len])
+        for tag, val in r.get("tags", {}).items():
+            rec += tag.encode()[:2]
+            if isinstance(val, int):
+                rec += b"i" + struct.pack("<i", val)
+            elif isinstance(val, float):
+                rec += b"f" + struct.pack("<f", val)
+            else:
+                rec += b"Z" + str(val).encode() + b"\x00"
         body += struct.pack("<i", len(rec)) + rec
     with gzip.open(path, "wb") as fh:
         fh.write(bytes(body))

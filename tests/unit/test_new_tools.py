@@ -1,0 +1,380 @@
+"""Tests for the SEC validation/assessment, methylation, and misc core tools."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+from tests.fixtures import write_vcf
+
+
+# ---------- SEC ----------
+
+
+def _mini_db(tmp_path):
+    from variantcalling_tpu.sec.db import SecDb
+
+    keys = np.sort((np.int64(0) << 40) | np.array([100, 200, 300], dtype=np.int64))
+    counts = np.array([[50, 5, 0, 0, 0], [30, 10, 0, 0, 0], [80, 2, 0, 0, 0]], dtype=np.float32)
+    db = SecDb(contigs=["chr1"], keys=keys, counts=counts, n_samples=4)
+    path = str(tmp_path / "db.h5")
+    db.save(path)
+    return path
+
+
+def _vcf_with_ad(path, rows):
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=100000>",
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">',
+        '##FORMAT=<ID=AD,Number=R,Type=Integer,Description="ad">',
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1",
+    ]
+    for pos, ad in rows:
+        lines.append(f"chr1\t{pos}\t.\tA\tG\t50\tPASS\t.\tGT:AD\t0/1:{ad}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def test_sec_validation_sweep(tmp_path):
+    from variantcalling_tpu.pipelines.sec import sec_validation
+
+    db_path = _mini_db(tmp_path)
+    sample = str(tmp_path / "s.vcf")
+    truth = str(tmp_path / "t.vcf")
+    # pos 100 noise-like (matches 50:5 cohort shape), pos 200 strong variant
+    _vcf_with_ad(sample, [(100, "48,5"), (200, "15,22"), (999, "10,10")])
+    _vcf_with_ad(truth, [(200, "15,22")])
+    out = str(tmp_path / "sweep.csv")
+    rc = sec_validation.run(["--model", db_path, "--sample_vcf", sample, "--truth_vcf", truth,
+                             "--output_file", out])
+    assert rc == 0
+    sweep = pd.read_csv(out)
+    assert len(sweep) > 0
+    # at a permissive threshold the noise-like locus is suppressed, the true one kept
+    row = sweep.iloc[0]
+    assert row["suppressed"] >= 1
+    assert row["kept_true"] + row["lost_true"] == 1
+
+
+def test_assess_sec_concordance(tmp_path):
+    from variantcalling_tpu.pipelines.sec import assess_sec_concordance as asc
+
+    df = pd.DataFrame(
+        {
+            "chrom": ["chr1"] * 6,
+            "pos": [10, 20, 30, 40, 50, 60],
+            "classify": ["tp", "tp", "fp", "fp", "fn", "tp"],
+            "filter": ["PASS"] * 6,
+            "indel": [False] * 6,
+            "tree_score": [0.9, 0.8, 0.7, 0.6, np.nan, 0.95],
+        }
+    )
+    h5 = str(tmp_path / "conc.h5")
+    write_hdf(df, h5, key="all", mode="w")
+    # corrected VCF marks pos 30 (an fp) and pos 20 (a tp) as SEC
+    vcf = str(tmp_path / "corr.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=100000>",
+        '##FILTER=<ID=SEC,Description="sec">',
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t20\t.\tA\tG\t50\tSEC\t.",
+        "chr1\t30\t.\tA\tG\t50\tSEC\t.",
+        "chr1\t40\t.\tA\tG\t50\tPASS\t.",
+    ]
+    with open(vcf, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    out = str(tmp_path / "assess.h5")
+    rc = asc.run(["--concordance_h5", h5, "--corrected_vcf", vcf, "--output_file", out])
+    assert rc == 0
+    delta = read_hdf(out, key="delta")
+    total = delta[delta["group"] == "ALL"].iloc[0] if "ALL" in set(delta["group"]) else delta.sum(numeric_only=True)
+    assert int(delta["fp_removed"].max()) >= 1
+    assert int(delta["tp_lost"].max()) >= 1
+
+
+# ---------- methylation ----------
+
+
+def _bedgraph(path, rows):
+    with open(path, "w") as fh:
+        fh.write('track type="bedGraph"\n')
+        for r in rows:
+            fh.write("\t".join(str(x) for x in r) + "\n")
+
+
+def test_merge_context_and_metrics(tmp_path):
+    from variantcalling_tpu.pipelines.methylation import process_merge_context as pmc
+
+    bg = str(tmp_path / "cpg.bedGraph")
+    # one CpG: + strand C at 100, - strand C at 101 -> merged counts 8+2 / 2+3
+    _bedgraph(bg, [
+        ("chr1", 100, 101, 80.0, 8, 2),
+        ("chr1", 101, 102, 40.0, 2, 3),
+        ("chr1", 500, 501, 0.0, 0, 10),
+    ])
+    out = str(tmp_path / "m.h5")
+    merged_out = str(tmp_path / "merged.bedGraph")
+    rc = pmc.run(["--input", bg, "--output", out, "--merged_bedgraph", merged_out])
+    assert rc == 0
+    summary = read_hdf(out, key="summary")
+    assert summary.iloc[0]["n_sites"] == 2  # merged CpG + lone site
+    merged = pd.read_csv(merged_out, sep="\t", header=None)
+    assert merged.iloc[0][4] == 10 and merged.iloc[0][5] == 5  # summed counts
+    hist = read_hdf(out, key="histogram")
+    assert hist["n_sites"].sum() == 2
+
+
+def test_mbias_processing(tmp_path):
+    from variantcalling_tpu.pipelines.methylation import process_mbias
+
+    src = str(tmp_path / "mbias.txt")
+    rows = ["Strand\tRead\tPosition\tnMethylated\tnUnmethylated"]
+    for p in range(1, 11):
+        nm = 2 if p <= 2 else 50  # biased head positions
+        rows.append(f"OT\t1\t{p}\t{nm}\t50")
+    with open(src, "w") as fh:
+        fh.write("\n".join(rows) + "\n")
+    out = str(tmp_path / "mb.h5")
+    rc = process_mbias.run(["--input", src, "--output", out])
+    assert rc == 0
+    bounds = read_hdf(out, key="inclusion_bounds")
+    assert bounds.iloc[0]["inclusion_start"] == 3  # head bias trimmed
+
+
+def test_concat_methyldackel(tmp_path):
+    from variantcalling_tpu.pipelines.methylation import concat_methyldackel_csvs as cmc
+
+    a, b = str(tmp_path / "a.bg"), str(tmp_path / "b.bg")
+    _bedgraph(a, [("chr1", 10, 11, 50.0, 1, 1)])
+    _bedgraph(b, [("chr1", 10, 11, 100.0, 3, 0), ("chr2", 5, 6, 0.0, 0, 2)])
+    out = str(tmp_path / "merged.csv")
+    rc = cmc.run(["--inputs", a, b, "--output", out])
+    assert rc == 0
+    df = pd.read_csv(out, sep="\t", header=None)
+    assert len(df) == 2
+    assert df.iloc[0][4] == 4 and df.iloc[0][5] == 1  # summed duplicate site
+
+
+def test_per_read(tmp_path):
+    from variantcalling_tpu.pipelines.methylation import process_per_read
+
+    src = str(tmp_path / "pr.tsv")
+    with open(src, "w") as fh:
+        for i, frac in enumerate([0.0, 0.5, 1.0, 1.0]):
+            fh.write(f"r{i}\tchr1\t{100+i}\t{frac}\t{5}\n")
+    out = str(tmp_path / "pr.h5")
+    rc = process_per_read.run(["--input", src, "--output", out])
+    assert rc == 0
+    s = read_hdf(out, key="summary")
+    assert s.iloc[0]["n_reads"] == 4
+    assert abs(s.iloc[0]["mean_read_methylation"] - 0.625) < 1e-6
+
+
+# ---------- misc core tools ----------
+
+
+def test_cloud_sync_passthrough(tmp_path, monkeypatch):
+    import subprocess as sp
+
+    from variantcalling_tpu.utils import cloud
+
+    local = str(tmp_path / "x.txt")
+    open(local, "w").write("hi")
+    assert cloud.cloud_sync(local) == local
+    # remote with all cloud CLIs failing (simulated: this environment has
+    # zero egress, so a real gsutil would hang): optional passes through,
+    # strict raises
+    def _fail(*a, **k):
+        raise sp.SubprocessError("no network")
+
+    monkeypatch.setattr(cloud.subprocess, "run", _fail)
+    assert cloud.optional_cloud_sync("gs://bucket/obj", cache_dir=str(tmp_path)) == "gs://bucket/obj"
+    with pytest.raises(RuntimeError):
+        cloud.cloud_sync("gs://bucket/obj", cache_dir=str(tmp_path))
+
+
+def test_convert_h5_to_json(tmp_path):
+    from variantcalling_tpu.pipelines.misc import convert_h5_to_json as c2j
+
+    h5 = str(tmp_path / "m.h5")
+    write_hdf(pd.DataFrame({"a": [1, 2]}), h5, key="t1", mode="w")
+    write_hdf(pd.DataFrame({"b": ["x"]}), h5, key="t2", mode="a")
+    out = str(tmp_path / "m.json")
+    rc = c2j.run(["--input_h5", h5, "--output_json", out])
+    assert rc == 0
+    data = json.load(open(out))
+    assert data["t1"] == [{"a": 1}, {"a": 2}]
+
+
+def test_sorter_tools(tmp_path):
+    from variantcalling_tpu.pipelines.misc import sorter_stats_to_mean_coverage as s2c
+    from variantcalling_tpu.pipelines.misc import sorter_to_h5
+
+    j = str(tmp_path / "s.json")
+    json.dump({"aligned_bases": 93_000_000_000, "pct_q30": 0.93}, open(j, "w"))
+    out_txt = str(tmp_path / "cov.txt")
+    rc = s2c.run(["--input_sorter_stats_json", j, "--output_file", out_txt])
+    assert rc == 0
+    assert open(out_txt).read().strip() == "30"
+
+    csv = str(tmp_path / "s.csv")
+    pd.DataFrame({"metric": ["reads"], "value": [100]}).to_csv(csv, index=False)
+    out_h5 = str(tmp_path / "s.h5")
+    rc = sorter_to_h5.run(["--input_csv_file", csv, "--input_json_file", j, "--output_file", out_h5])
+    assert rc == 0
+    assert read_hdf(out_h5, key="scalar_stats").iloc[0]["pct_q30"] == 0.93
+
+
+def test_collect_existing_metrics(tmp_path):
+    from variantcalling_tpu.pipelines.misc import collect_existing_metrics as cem
+
+    picard = str(tmp_path / "dup.metrics")
+    with open(picard, "w") as fh:
+        fh.write("## METRICS CLASS\tpicard.DuplicationMetrics\n")
+        fh.write("LIBRARY\tPCT_DUPLICATION\nlib1\t0.05\n\n")
+    csv = str(tmp_path / "x.csv")
+    pd.DataFrame({"a": [1]}).to_csv(csv, index=False)
+    out = str(tmp_path / "all.h5")
+    rc = cem.run(["--metric_files", picard, csv, "--output_h5", out])
+    assert rc == 0
+    m = read_hdf(out, key="dup_metrics")
+    assert m.iloc[0]["PCT_DUPLICATION"] == "0.05"
+
+
+# ---------- vcfbed tools ----------
+
+
+def test_intersect_and_subtract_bed(tmp_path):
+    from variantcalling_tpu.io.bed import IntervalSet, read_bed
+    from variantcalling_tpu.pipelines.vcfbed import intersect_bed_regions as ibr
+
+    a, b, c = (str(tmp_path / f"{n}.bed") for n in "abc")
+    open(a, "w").write("chr1\t0\t100\nchr1\t200\t300\n")
+    open(b, "w").write("chr1\t50\t250\n")
+    open(c, "w").write("chr1\t60\t70\n")
+    out = str(tmp_path / "out.bed")
+    rc = ibr.run(["--include-regions", a, b, "--exclude-regions", c, "--output-bed", out])
+    assert rc == 0
+    iv = read_bed(out)
+    got = list(zip(iv.start.tolist(), iv.end.tolist()))
+    assert got == [(50, 60), (70, 100), (200, 250)]
+
+
+def test_annotate_contig(tmp_path):
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.pipelines.vcfbed import annotate_contig as ac
+
+    vcf = str(tmp_path / "in.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=100000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t150\t.\tA\tG\t50\tPASS\t.",
+        "chr1\t500\t.\tC\tT\t50\tPASS\t.",
+    ]
+    open(vcf, "w").write("\n".join(lines) + "\n")
+    bed = str(tmp_path / "lcr.bed")
+    open(bed, "w").write("chr1\t100\t200\n")
+    out = str(tmp_path / "out.vcf")
+    rc = ac.run(["--input_vcf", vcf, "--output_vcf", out, "--annotate_intervals", bed])
+    assert rc == 0
+    t = read_vcf(out)
+    assert "lcr" in t.info[0] and "lcr" not in t.info[1]
+
+
+# ---------- tabix + helper tools ----------
+
+
+def test_tabix_region_roundtrip(tmp_path, rng):
+    from variantcalling_tpu.io.bgzf import BgzfWriter
+    from variantcalling_tpu.io.tabix import TabixIndex, build_tabix_index, read_region_lines
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    path = str(tmp_path / "big.vcf.gz")
+    pos = np.sort(rng.choice(5_000_000, 20_000, replace=False)) + 1
+    with BgzfWriter(path) as fh:
+        fh.write("##fileformat=VCFv4.2\n##contig=<ID=chr1,length=6000000>\n")
+        fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        for p in pos:
+            fh.write(f"chr1\t{p}\t.\tAC\tA\t50\tPASS\t.\n")
+    build_tabix_index(path)
+    idx = TabixIndex.load(path + ".tbi")
+    assert idx.names == ["chr1"]
+    lo, hi = 1_000_000, 1_050_000
+    got = sorted(int(l.split("\t")[1]) for l in read_region_lines(path, "chr1", lo, hi))
+    want = sorted(int(p) for p in pos[(pos - 1 < hi) & (pos + 1 > lo)])
+    assert got == want
+    # read_vcf region path uses the index
+    t = read_vcf(path, region=("chr1", lo + 1, hi))
+    in_region = pos[(pos >= lo + 1) & (pos <= hi)]
+    assert sorted(t.pos.tolist()) == sorted(int(p) for p in in_region)
+
+
+def test_write_vcf_auto_index(tmp_path):
+    from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+    import os
+
+    src = str(tmp_path / "s.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=1000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t10\t.\tA\tG\t50\tPASS\t.",
+    ]
+    open(src, "w").write("\n".join(lines) + "\n")
+    out = str(tmp_path / "o.vcf.gz")
+    write_vcf(out, read_vcf(src))
+    assert os.path.exists(out + ".tbi")
+
+
+def test_remove_vcf_duplicates(tmp_path):
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.pipelines.misc import remove_vcf_duplicates as rvd
+
+    src = str(tmp_path / "d.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=1000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t10\t.\tA\tG\t50\tPASS\t.",
+        "chr1\t10\t.\tA\tG\t60\tPASS\t.",
+        "chr1\t10\t.\tA\tT\t50\tPASS\t.",
+    ]
+    open(src, "w").write("\n".join(lines) + "\n")
+    out = str(tmp_path / "o.vcf")
+    assert rvd.run([src, out]) == 0
+    t = read_vcf(out)
+    assert len(t) == 2
+
+
+def test_remove_empty_files(tmp_path, capsys):
+    from variantcalling_tpu.pipelines.misc import remove_empty_files as ref_tool
+
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.touch()
+    b.write_text("x")
+    assert ref_tool.run([str(a), str(b)]) == 0
+    assert not a.exists() and b.exists()
+
+
+def test_index_vcf_file_tool(tmp_path):
+    import os
+
+    from variantcalling_tpu.pipelines.misc import index_vcf_file as ivf
+
+    src = str(tmp_path / "s.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=1000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t10\t.\tA\tG\t50\tPASS\t.",
+    ]
+    open(src, "w").write("\n".join(lines) + "\n")
+    assert ivf.run([src]) == 0
+    assert os.path.exists(src + ".gz.tbi")

@@ -1,0 +1,123 @@
+"""Tests for the srsnv, mrd, and ppmseq package equivalents."""
+
+import numpy as np
+import pandas as pd
+
+from tests.fixtures import write_bam
+from variantcalling_tpu.utils.h5_utils import read_hdf
+
+
+def _featuremap(path, rng, n, score_shift):
+    """Featuremap VCF: one record per read with X_* INFO features."""
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=10000000>",
+        '##INFO=<ID=X_SCORE,Number=1,Type=Float,Description="x">',
+        '##INFO=<ID=X_EDIST,Number=1,Type=Float,Description="x">',
+        '##INFO=<ID=X_LENGTH,Number=1,Type=Float,Description="x">',
+        '##INFO=<ID=X_MAPQ,Number=1,Type=Float,Description="x">',
+        '##INFO=<ID=X_INDEX,Number=1,Type=Float,Description="x">',
+        '##INFO=<ID=rq,Number=1,Type=Float,Description="x">',
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+    ]
+    for i in range(n):
+        pos = int(rng.integers(1, 9_000_000))
+        score = rng.normal(5 + score_shift, 1.5)
+        edist = rng.normal(3 - score_shift, 1.0)
+        info = (
+            f"X_SCORE={score:.2f};X_EDIST={edist:.2f};X_LENGTH={int(rng.integers(100, 200))};"
+            f"X_MAPQ=60;X_INDEX={int(rng.integers(0, 150))};rq={rng.uniform(0.9, 1.0):.3f}"
+        )
+        lines.append(f"chr1\t{pos}\t.\tA\tG\t50\tPASS\t{info}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def test_srsnv_train_and_infer(tmp_path, rng):
+    from variantcalling_tpu.pipelines.srsnv import srsnv_inference, srsnv_training
+
+    tp, fp = str(tmp_path / "tp.vcf"), str(tmp_path / "fp.vcf")
+    _featuremap(tp, rng, 400, score_shift=2.0)
+    _featuremap(fp, rng, 400, score_shift=-2.0)
+    model = str(tmp_path / "model.pkl")
+    rc = srsnv_training.run(
+        ["--tp_featuremap", tp, "--fp_featuremap", fp, "--output_model", model, "--n_trees", "20"]
+    )
+    assert rc == 0
+    out = str(tmp_path / "scored.vcf")
+    rc = srsnv_inference.run(["--featuremap", tp, "--model", model, "--output_featuremap", out])
+    assert rc == 0
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    scored_tp = read_vcf(out).info_field("ML_QUAL")
+    rc = srsnv_inference.run(["--featuremap", fp, "--model", model, "--output_featuremap", out])
+    assert rc == 0
+    scored_fp = read_vcf(out).info_field("ML_QUAL")
+    # separable features -> TP reads score far above FP reads
+    assert np.median(scored_tp) > np.median(scored_fp) + 10
+
+
+def test_mrd_estimation(tmp_path, rng):
+    from variantcalling_tpu.pipelines.mrd_analysis import estimate_tumor_fraction
+
+    # 1000 loci x 1000x coverage; tf=1e-3 -> expect ~500 supporting reads
+    r = estimate_tumor_fraction(1000, 500, 1000.0, background_rate=1e-7)
+    assert 5e-4 < r["tumor_fraction"] < 2e-3
+    assert r["mrd_detected"]
+    assert r["tf_ci_low"] < r["tumor_fraction"] < r["tf_ci_high"]
+    # zero support -> no detection, tf ~ 0
+    r0 = estimate_tumor_fraction(1000, 0, 1000.0, background_rate=1e-7)
+    assert not r0["mrd_detected"]
+    assert r0["tumor_fraction"] < 1e-5
+
+
+def test_mrd_counting(tmp_path, rng):
+    from variantcalling_tpu.pipelines import mrd_analysis
+
+    sig = str(tmp_path / "sig.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=10000000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t100\t.\tA\tG\t50\tPASS\t.",
+        "chr1\t200\t.\tC\tT\t50\tPASS\t.",
+    ]
+    open(sig, "w").write("\n".join(lines) + "\n")
+    fm = str(tmp_path / "fm.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=10000000>",
+        '##INFO=<ID=ML_QUAL,Number=1,Type=Float,Description="q">',
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+        "chr1\t100\t.\tA\tG\t50\tPASS\tML_QUAL=55",  # supports, passes
+        "chr1\t100\t.\tA\tG\t50\tPASS\tML_QUAL=10",  # supports, fails qual
+        "chr1\t200\t.\tC\tT\t50\tPASS\tML_QUAL=45",  # supports, passes
+        "chr1\t999\t.\tG\tA\t50\tPASS\tML_QUAL=60",  # off-signature
+    ]
+    open(fm, "w").write("\n".join(lines) + "\n")
+    n_loci, n_support = mrd_analysis.count_supporting_reads(sig, fm, 40.0)
+    assert n_loci == 2 and n_support == 2
+
+
+def test_ppmseq_qc(tmp_path):
+    from variantcalling_tpu.pipelines import ppmseq_qc
+
+    reads = []
+    for s, e, n in (("MIXED", "MIXED", 6), ("MIXED", "MINUS", 2), ("UNDETERMINED", "MIXED", 1)):
+        for i in range(n):
+            reads.append(
+                {"contig": "chr1", "pos": 10 * len(reads), "cigar": [("M", 20)],
+                 "tags": {"as": s, "ae": e}}
+            )
+    reads.append({"contig": "chr1", "pos": 500, "cigar": [("M", 20)]})  # untagged
+    bam = str(tmp_path / "t.bam")
+    write_bam(bam, {"chr1": 10000}, reads)
+    out = str(tmp_path / "qc.h5")
+    rc = ppmseq_qc.run(["--input_bam", bam, "--output_h5", out])
+    assert rc == 0
+    summary = read_hdf(out, key="summary")
+    assert summary.iloc[0]["total_reads"] == 10
+    assert abs(summary.iloc[0]["pct_mixed_mixed"] - 0.6) < 1e-9
+    cross = read_hdf(out, key="strand_tag_crosstab").set_index("start_tag")
+    assert cross.loc["MIXED", "MINUS"] == 2
+    assert cross.loc["MISSING", "MISSING"] == 1

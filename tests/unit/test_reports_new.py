@@ -1,0 +1,151 @@
+"""Tests for the report generators (SV/QC/joint-calling/sub-error/importMetrics)."""
+
+import pickle
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+
+def test_create_sv_report(tmp_path):
+    from variantcalling_tpu.pipelines import create_sv_report as svr
+
+    results = {
+        "sv_stats": {
+            "type_counts": {"DEL": {"PASS": 10, "all": 12}, "INS": {"PASS": 5, "all": 6}},
+            "size_histograms": pd.DataFrame({"DEL": [3, 4], "INS": [1, 2]}, index=["<100", "100-500"]),
+        },
+        "concordance_stats": {
+            "ALL_concordance": pd.Series({"TP": 9, "FP": 2, "FN": 1, "Precision": 0.818, "Recall": 0.9, "F1": 0.857})
+        },
+        "fp_stats": pd.Series([2], index=pd.MultiIndex.from_tuples([("DEL", "<100")], names=["svtype", "binned_svlens"])),
+    }
+    pkl = str(tmp_path / "sv.pkl")
+    with open(pkl, "wb") as fh:
+        pickle.dump(results, fh)
+    h5 = str(tmp_path / "sv_report.h5")
+    html = str(tmp_path / "sv_report.html")
+    rc = svr.run(["--statistics_file", pkl, "--h5_output", h5, "--html_output", html])
+    assert rc == 0
+    conc = read_hdf(h5, key="concordance")
+    assert conc.iloc[0]["TP"] == 9
+    assert "SV Report" in open(html).read()
+
+
+def _picard_file(path, cls, params: dict, hist: list | None = None):
+    with open(path, "w") as fh:
+        fh.write(f"## METRICS CLASS\t{cls}\n")
+        fh.write("\t".join(params) + "\n")
+        fh.write("\t".join(str(v) for v in params.values()) + "\n\n")
+        if hist:
+            fh.write("## HISTOGRAM\tjava.lang.Integer\n")
+            fh.write("coverage\tcount\n")
+            for cov, cnt in hist:
+                fh.write(f"{cov}\t{cnt}\n")
+
+
+def test_import_metrics_and_qc_report(tmp_path):
+    from variantcalling_tpu.pipelines import create_qc_report as qcr
+    from variantcalling_tpu.pipelines import import_metrics as im
+
+    for sample in ("s1", "s2"):
+        _picard_file(
+            str(tmp_path / f"{sample}.alignment_summary_metrics"),
+            "AlignmentSummaryMetrics",
+            {"PF_READS_ALIGNED": 900, "MEAN_READ_LENGTH": 150, "PF_MISMATCH_RATE": 0.002, "PF_INDEL_RATE": 0.0004},
+        )
+        _picard_file(
+            str(tmp_path / f"{sample}.quality_yield_metrics"),
+            "QualityYieldMetricsFlow",
+            {"TOTAL_READS": 1000, "PF_READS": 990, "PF_BASES": 150000, "PF_Q30_BASES": 140000},
+        )
+        _picard_file(
+            str(tmp_path / f"{sample}.raw_wgs_metrics"),
+            "RawWgsMetrics",
+            {"MEAN_COVERAGE": 31.5, "MEDIAN_COVERAGE": 31, "PCT_20X": 0.95, "FOLD_90_BASE_PENALTY": 1.3},
+            hist=[(0, 10), (30, 1000)],
+        )
+        rc = im.run(["--metrics_prefix", str(tmp_path / sample), "--output_h5", str(tmp_path / f"{sample}.metrics.h5")])
+        assert rc == 0
+    m = read_hdf(str(tmp_path / "s1.metrics.h5"), key="metrics")
+    assert {"File", "Parameter", "Value"} <= set(m.columns)
+
+    h5 = str(tmp_path / "qc.h5")
+    html = str(tmp_path / "qc.html")
+    rc = qcr.run([
+        "--samples", "s1", "s2",
+        "--metrics_h5", str(tmp_path / "s1.metrics.h5"), str(tmp_path / "s2.metrics.h5"),
+        "--h5_output", h5, "--html_output", html,
+    ])
+    assert rc == 0
+    top = read_hdf(h5, key="top_metrics").set_index("metric")
+    assert top.loc["MEAN_COVERAGE", "s1"] == 31.5
+    assert top.loc["TOTAL_READS", "s2"] == 1000
+    cov = read_hdf(h5, key="coverage").set_index("metric")
+    assert cov.loc["PCT_20X", "s1"] == 0.95
+
+
+def test_joint_calling_report(tmp_path):
+    from variantcalling_tpu.pipelines import joint_calling_report as jcr
+
+    vcf = str(tmp_path / "joint.vcf")
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=100000>",
+        '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">',
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tA\tB",
+        "chr1\t100\t.\tA\tG\t50\tPASS\t.\tGT\t0/1\t1/1",
+        "chr1\t200\t.\tC\tT\t50\tPASS\t.\tGT\t0/1\t./.",
+        "chr1\t300\t.\tG\tGA\t50\tPASS\t.\tGT\t1/1\t0/1",
+        "chr1\t400\t.\tTCA\tT\t50\tPASS\t.\tGT\t0/0\t0/1",
+    ]
+    open(vcf, "w").write("\n".join(lines) + "\n")
+    h5 = str(tmp_path / "joint.h5")
+    rc = jcr.run(["--input_vcf", vcf, "--h5_output", h5])
+    assert rc == 0
+    per_sample = read_hdf(h5, key="per_sample")
+    a = per_sample[per_sample["sample"] == "A"].iloc[0]
+    assert a["call_rate"] == 1.0
+    b = per_sample[per_sample["sample"] == "B"].iloc[0]
+    assert b["call_rate"] == 0.75
+
+
+def test_substitution_error_rate_report(tmp_path):
+    from variantcalling_tpu.pipelines import substitution_error_rate_report as serr
+
+    rows = [
+        {"ref": "C", "alt": "T", "left_motif": "A", "right_motif": "G", "n_errors": 10, "n_bases": 1000},
+        {"ref": "G", "alt": "A", "left_motif": "C", "right_motif": "T", "n_errors": 30, "n_bases": 1000},
+        {"ref": "T", "alt": "G", "left_motif": "A", "right_motif": "A", "n_errors": 5, "n_bases": 500},
+    ]
+    h5_in = str(tmp_path / "err.h5")
+    write_hdf(pd.DataFrame(rows), h5_in, key="motif_1", mode="w")
+    h5_out = str(tmp_path / "rep.h5")
+    rc = serr.run(["--h5_substitution_error_rate", h5_in, "--h5_output", h5_out])
+    assert rc == 0
+    folded = read_hdf(h5_out, key="folded_motifs")
+    # C>T at A_G folds with G>A at C_T (revcomp pair): one canonical row
+    ct = folded[(folded["mut_type"] == "C>T")]
+    assert len(ct) == 1
+    assert ct.iloc[0]["fwd_errors"] == 10 and ct.iloc[0]["rev_errors"] == 30
+    assert abs(ct.iloc[0]["asymmetry"] - (10 / 1000) / (30 / 1000)) < 1e-9
+
+
+def test_nexusplt_save(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from variantcalling_tpu.reports import nexusplt
+
+    fig, ax = plt.subplots()
+    ax.plot([1, 2, 3], [4, 5, 6], label="x")
+    paths = nexusplt.save(fig, "t", str(tmp_path), formats=("png", "html", "json"))
+    assert len(paths) == 3
+    import json as _json
+
+    data = _json.load(open(paths[2]))
+    assert data["axes"][0]["lines"][0]["y"] == [4.0, 5.0, 6.0]
+    plt.close(fig)

@@ -20,6 +20,23 @@ TOOLS: dict[str, str] = {
     "coverage_analysis": "variantcalling_tpu.pipelines.coverage_analysis",
     "correct_systematic_errors": "variantcalling_tpu.pipelines.sec.correct_systematic_errors",
     "sec_training": "variantcalling_tpu.pipelines.sec.sec_training",
+    "sec_validation": "variantcalling_tpu.pipelines.sec.sec_validation",
+    "assess_sec_concordance": "variantcalling_tpu.pipelines.sec.assess_sec_concordance",
+    "concat_methyldackel_csvs": "variantcalling_tpu.pipelines.methylation.concat_methyldackel_csvs",
+    "process_mbias": "variantcalling_tpu.pipelines.methylation.process_mbias",
+    "process_merge_context": "variantcalling_tpu.pipelines.methylation.process_merge_context",
+    "process_merge_context_no_cp_g": "variantcalling_tpu.pipelines.methylation.process_merge_context_no_cp_g",
+    "process_per_read": "variantcalling_tpu.pipelines.methylation.process_per_read",
+    "cloud_sync": "variantcalling_tpu.pipelines.misc.cloud_sync",
+    "sorter_to_h5": "variantcalling_tpu.pipelines.misc.sorter_to_h5",
+    "sorter_stats_to_mean_coverage": "variantcalling_tpu.pipelines.misc.sorter_stats_to_mean_coverage",
+    "collect_existing_metrics": "variantcalling_tpu.pipelines.misc.collect_existing_metrics",
+    "convert_h5_to_json": "variantcalling_tpu.pipelines.misc.convert_h5_to_json",
+    "annotate_contig": "variantcalling_tpu.pipelines.vcfbed.annotate_contig",
+    "intersect_bed_regions": "variantcalling_tpu.pipelines.vcfbed.intersect_bed_regions",
+    "index_vcf_file": "variantcalling_tpu.pipelines.misc.index_vcf_file",
+    "remove_vcf_duplicates": "variantcalling_tpu.pipelines.misc.remove_vcf_duplicates",
+    "remove_empty_files": "variantcalling_tpu.pipelines.misc.remove_empty_files",
     "correct_genotypes_by_imputation": "variantcalling_tpu.pipelines.correct_genotypes_by_imputation",
     "convert_haploid_regions": "variantcalling_tpu.pipelines.convert_haploid_regions",
     "compress_gvcf": "variantcalling_tpu.pipelines.compress_gvcf",
@@ -31,6 +48,16 @@ TOOLS: dict[str, str] = {
     "run_no_gt_report": "variantcalling_tpu.pipelines.run_no_gt_report",
     "vcfeval_flavors": "variantcalling_tpu.pipelines.vcfeval_flavors",
     "create_var_report": "variantcalling_tpu.pipelines.create_var_report",
+    "create_sv_report": "variantcalling_tpu.pipelines.create_sv_report",
+    "create_qc_report": "variantcalling_tpu.pipelines.create_qc_report",
+    "joint_calling_report": "variantcalling_tpu.pipelines.joint_calling_report",
+    "substitution_error_rate_report": "variantcalling_tpu.pipelines.substitution_error_rate_report",
+    "import_metrics": "variantcalling_tpu.pipelines.import_metrics",
+    "cnv_calling": "variantcalling_tpu.pipelines.cnv_calling",
+    "srsnv_training": "variantcalling_tpu.pipelines.srsnv.srsnv_training",
+    "srsnv_inference": "variantcalling_tpu.pipelines.srsnv.srsnv_inference",
+    "mrd_analysis": "variantcalling_tpu.pipelines.mrd_analysis",
+    "ppmseq_qc": "variantcalling_tpu.pipelines.ppmseq_qc",
     "collect_hpol_table": "variantcalling_tpu.pipelines.collect_hpol_table",
     "calibrate_bridging_snvs": "variantcalling_tpu.pipelines.calibrate_bridging_snvs",
     "training_set_consistency_check": "variantcalling_tpu.pipelines.training_set_consistency_check",

@@ -1,0 +1,153 @@
+"""CNV calling from binned coverage: GC normalization + HMM segmentation.
+
+Reference surface: the ugbio_cnv package (setup.py:4-8) — the reference
+calls CNVs with external R/py tools (cn.mops, cnvpytor envs at
+setup/other_envs/cnmops.yml). This module is the TPU-native equivalent
+over the coverage pipeline's binned depth (pipelines/coverage_analysis
+windows): median/GC normalization to log2 ratios, then a copy-number HMM
+whose forward pass and Viterbi backtrace run as ``lax.scan`` device
+kernels — segmentation of a whole genome's bins is one jitted program.
+
+States: copy number 0..4 (del0, del1, neutral, dup3, dup4); emissions are
+Gaussian in log2-ratio space centered at log2(cn/2) (cn=0 floored).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+COPY_STATES = np.array([0, 1, 2, 3, 4])
+_LOG2_FLOOR = -3.0  # log2 ratio assigned to cn=0 (avoid -inf)
+
+
+def state_means() -> np.ndarray:
+    with np.errstate(divide="ignore"):
+        m = np.log2(np.maximum(COPY_STATES, 1e-9) / 2.0)
+    return np.maximum(m, _LOG2_FLOOR)
+
+
+def normalize_coverage(
+    depth: np.ndarray, gc: np.ndarray | None = None, n_gc_bins: int = 20
+) -> np.ndarray:
+    """Binned depth -> log2 ratio vs autosomal median, GC-corrected.
+
+    GC correction: each GC-content stratum is scaled to the global median
+    (the LOESS-free rolling-median correction cn.mops-family tools use).
+    """
+    depth = np.asarray(depth, dtype=np.float64)
+    med = np.median(depth[depth > 0]) if (depth > 0).any() else 1.0
+    corrected = depth.astype(np.float64)
+    if gc is not None:
+        gc_bin = np.clip((np.asarray(gc) * n_gc_bins).astype(int), 0, n_gc_bins - 1)
+        for b in range(n_gc_bins):
+            m = gc_bin == b
+            if m.sum() >= 10:
+                stratum_med = np.median(corrected[m][corrected[m] > 0]) if (corrected[m] > 0).any() else med
+                if stratum_med > 0:
+                    corrected[m] *= med / stratum_med
+    ratio = corrected / max(med, 1e-9)
+    return np.log2(np.maximum(ratio, 2.0**_LOG2_FLOOR)).astype(np.float32)
+
+
+def viterbi_segment(
+    log2_ratio: np.ndarray,
+    sigma: float = 0.35,
+    p_stay: float = 0.999,
+) -> np.ndarray:
+    """Most likely copy-number state per bin (device Viterbi over lax.scan)."""
+    means = jnp.asarray(state_means(), dtype=jnp.float32)
+    k = len(COPY_STATES)
+    obs = jnp.asarray(log2_ratio, dtype=jnp.float32)
+    log_trans = jnp.log(
+        jnp.where(jnp.eye(k, dtype=bool), p_stay, (1.0 - p_stay) / (k - 1))
+    ).astype(jnp.float32)
+
+    def emission(o):
+        return -0.5 * ((o - means) / sigma) ** 2  # (K,)
+
+    def fwd_step(delta, o):
+        # delta: (K,) best log prob ending in each state
+        cand = delta[:, None] + log_trans  # (K_prev, K)
+        best_prev = jnp.argmax(cand, axis=0)  # (K,)
+        delta_new = jnp.max(cand, axis=0) + emission(o)
+        return delta_new, best_prev
+
+    delta0 = emission(obs[0]) + jnp.log(jnp.full((k,), 1.0 / k))
+    delta_t, backptr = jax.lax.scan(fwd_step, delta0, obs[1:])
+
+    def back_step(state, ptr):
+        prev = ptr[state]
+        return prev, prev
+
+    last = jnp.argmax(delta_t)
+    _, states_rev = jax.lax.scan(back_step, last, backptr[::-1])
+    states = jnp.concatenate([states_rev[::-1], jnp.array([last])])
+    return np.asarray(states, dtype=np.int32)
+
+
+@dataclass
+class Segment:
+    chrom: str
+    start: int  # 0-based bin-aligned
+    end: int
+    copy_number: int
+    n_bins: int
+    mean_log2: float
+
+
+def states_to_segments(
+    states: np.ndarray, log2_ratio: np.ndarray, chrom: str, bin_size: int, min_bins: int = 3
+) -> list[Segment]:
+    """Run-length merge of per-bin states into CNV segments (neutral dropped)."""
+    segs: list[Segment] = []
+    n = len(states)
+    i = 0
+    while i < n:
+        j = i
+        while j < n and states[j] == states[i]:
+            j += 1
+        cn = int(COPY_STATES[states[i]])
+        if cn != 2 and (j - i) >= min_bins:
+            segs.append(
+                Segment(
+                    chrom=chrom,
+                    start=i * bin_size,
+                    end=j * bin_size,
+                    copy_number=cn,
+                    n_bins=j - i,
+                    mean_log2=float(np.mean(log2_ratio[i:j])),
+                )
+            )
+        i = j
+    return segs
+
+
+def call_cnvs(
+    depth_per_contig: dict[str, np.ndarray],
+    bin_size: int,
+    gc_per_contig: dict[str, np.ndarray] | None = None,
+    sigma: float = 0.35,
+    p_stay: float = 0.999,
+    min_bins: int = 3,
+) -> list[Segment]:
+    """End-to-end: normalize (jointly) then segment each contig."""
+    names = list(depth_per_contig)
+    all_depth = np.concatenate([depth_per_contig[c] for c in names])
+    all_gc = (
+        np.concatenate([gc_per_contig[c] for c in names]) if gc_per_contig else None
+    )
+    log2 = normalize_coverage(all_depth, all_gc)
+    segs: list[Segment] = []
+    off = 0
+    for c in names:
+        n = len(depth_per_contig[c])
+        lr = log2[off : off + n]
+        states = viterbi_segment(lr, sigma=sigma, p_stay=p_stay)
+        segs.extend(states_to_segments(states, lr, c, bin_size, min_bins))
+        off += n
+    return segs

@@ -46,6 +46,39 @@ class Alignment:
     read_len: int
     qual: np.ndarray | None  # per-base phred or None
     seq: np.ndarray | None = None  # per-base code 0..3 A/C/G/T, 4 other (when decoded)
+    tags: dict | None = None  # optional aux tags (when decode_tags)
+
+
+def _parse_aux_tags(rec: bytes, off: int) -> dict:
+    """BAM auxiliary fields (SAM spec §4.2.4): tag(2) type(1) value."""
+    tags: dict = {}
+    n = len(rec)
+    while off + 3 <= n:
+        tag = rec[off : off + 2].decode(errors="replace")
+        typ = chr(rec[off + 2])
+        off += 3
+        if typ == "A":
+            tags[tag] = chr(rec[off]); off += 1
+        elif typ in "cC":
+            tags[tag] = rec[off] if typ == "C" else struct.unpack_from("<b", rec, off)[0]; off += 1
+        elif typ in "sS":
+            tags[tag] = struct.unpack_from("<h" if typ == "s" else "<H", rec, off)[0]; off += 2
+        elif typ in "iI":
+            tags[tag] = struct.unpack_from("<i" if typ == "i" else "<I", rec, off)[0]; off += 4
+        elif typ == "f":
+            tags[tag] = struct.unpack_from("<f", rec, off)[0]; off += 4
+        elif typ in "ZH":
+            end = rec.index(b"\x00", off)
+            tags[tag] = rec[off:end].decode(errors="replace"); off = end + 1
+        elif typ == "B":
+            sub = chr(rec[off]); (cnt,) = struct.unpack_from("<I", rec, off + 1); off += 5
+            size = {"c": 1, "C": 1, "s": 2, "S": 2, "i": 4, "I": 4, "f": 4}[sub]
+            fmt = {"c": "<b", "C": "<B", "s": "<h", "S": "<H", "i": "<i", "I": "<I", "f": "<f"}[sub]
+            tags[tag] = [struct.unpack_from(fmt, rec, off + j * size)[0] for j in range(cnt)]
+            off += cnt * size
+        else:  # unknown type code: cannot continue safely
+            break
+    return tags
 
 
 # BAM 4-bit base nibble -> 0..3 ACGT, 4 anything else ('=ACMGRSVTWYHKDBN')
@@ -62,8 +95,9 @@ def _read_exact(fh, n: int) -> bytes:
 
 
 class BamReader:
-    def __init__(self, path: str, decode_seq: bool = False):
+    def __init__(self, path: str, decode_seq: bool = False, decode_tags: bool = False):
         self._decode_seq = decode_seq
+        self._decode_tags = decode_tags
         self._fh = gzip.open(path, "rb")  # BGZF is valid multi-member gzip
         magic = _read_exact(self._fh, 4)
         if magic != b"BAM\x01":
@@ -106,8 +140,10 @@ class BamReader:
                 seq = _NIBBLE_TO_CODE[nibbles[:l_seq]]
             off += seq_bytes
             qual = np.frombuffer(rec, dtype=np.uint8, count=l_seq, offset=off) if l_seq else None
+            off += l_seq
+            tags = _parse_aux_tags(rec, off) if self._decode_tags else None
             cigar = [(int(c & 0xF), int(c >> 4)) for c in cigar_raw]
-            yield Alignment(ref_id, pos, mapq, flag, cigar, l_seq, qual, seq)
+            yield Alignment(ref_id, pos, mapq, flag, cigar, l_seq, qual, seq, tags)
 
     def close(self) -> None:
         self._fh.close()

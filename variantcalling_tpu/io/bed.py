@@ -91,6 +91,36 @@ class IntervalSet:
                     j += 1
         return IntervalSet(_obj(chroms), np.asarray(starts, dtype=np.int64), np.asarray(ends, dtype=np.int64))
 
+    def subtract(self, other: "IntervalSet") -> "IntervalSet":
+        """Set difference self \\ other (bedtools subtract), merged sweeps per chrom."""
+        a = self.merged().by_chrom()
+        b = other.merged().by_chrom()
+        chroms: list[str] = []
+        starts: list[int] = []
+        ends: list[int] = []
+        for c in a:
+            sa, ea = a[c]
+            sb, eb = b[c] if c in b else (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+            j = 0
+            for i in range(len(sa)):
+                cur = int(sa[i])
+                end = int(ea[i])
+                while j < len(sb) and eb[j] <= cur:
+                    j += 1
+                k = j
+                while k < len(sb) and sb[k] < end:
+                    if cur < sb[k]:
+                        chroms.append(c)
+                        starts.append(cur)
+                        ends.append(int(sb[k]))
+                    cur = max(cur, int(eb[k]))
+                    k += 1
+                if cur < end:
+                    chroms.append(c)
+                    starts.append(cur)
+                    ends.append(end)
+        return IntervalSet(_obj(chroms), np.asarray(starts, dtype=np.int64), np.asarray(ends, dtype=np.int64))
+
     def contains(self, chrom: np.ndarray, pos0: np.ndarray) -> np.ndarray:
         """Membership of 0-based positions; vectorized searchsorted per chrom."""
         out = np.zeros(len(pos0), dtype=bool)

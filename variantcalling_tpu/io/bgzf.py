@@ -22,8 +22,8 @@ def compress_block(data: bytes, level: int = 6) -> bytes:
     """One complete BGZF block for <=64KiB of payload."""
     co = zlib.compressobj(level, zlib.DEFLATED, -15)
     deflated = co.compress(data) + co.flush()
-    bsize = len(deflated) + 25 + 1  # header(18) + crc/isize(8) - 1
-    if bsize > 0xFFFF:
+    bsize = len(deflated) + 26  # header(18) + deflated + crc/isize(8)
+    if bsize - 1 > 0xFFFF:
         raise ValueError("BGZF block overflow (incompressible 64K payload)")
     header = (
         b"\x1f\x8b\x08\x04"  # magic, CM=deflate, FLG=FEXTRA
@@ -32,7 +32,7 @@ def compress_block(data: bytes, level: int = 6) -> bytes:
         + struct.pack("<H", 6)  # XLEN
         + b"BC"
         + struct.pack("<H", 2)
-        + struct.pack("<H", bsize)
+        + struct.pack("<H", bsize - 1)  # spec: BSIZE = total block size - 1
     )
     trailer = struct.pack("<II", zlib.crc32(data) & 0xFFFFFFFF, len(data) & 0xFFFFFFFF)
     return header + deflated + trailer

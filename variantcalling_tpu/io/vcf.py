@@ -258,8 +258,9 @@ def read_vcf(
 ) -> VariantTable:
     """Parse a VCF/gVCF (.vcf or .vcf.gz) into a :class:`VariantTable`.
 
-    ``region`` is (chrom, start_1based, end_inclusive); streaming filter,
-    no index required (an index-aware C++ path can replace this later).
+    ``region`` is (chrom, start_1based, end_inclusive); served from the
+    sibling ``.tbi`` index when present (io/tabix — only covering BGZF
+    blocks are inflated), streaming filter otherwise.
     """
     header = VcfHeader()
     chrom: list[str] = []
@@ -274,8 +275,30 @@ def read_vcf(
     sample_cols: list[tuple[str, ...]] = []
     n_samples = 0
 
-    with _open_text(path) as fh:
+    indexed_lines = None
+    if region is not None and str(path).endswith(".gz") and os.path.exists(str(path) + ".tbi"):
+        from variantcalling_tpu.io.tabix import read_region_lines
+
+        indexed_lines = read_region_lines(str(path), region[0], region[1] - 1, region[2])
+
+    def _indexed_source(fh):
+        # header from the file head, records straight from covering blocks
         for line in fh:
+            if not line.startswith("#"):
+                break
+            yield line
+        for line in indexed_lines:
+            yield line + "\n"
+
+    if indexed_lines is not None:
+        # stream just the header (stops at the first record); the records
+        # themselves come from the index's covering blocks only
+        opener = _io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
+    else:
+        opener = _open_text(path)
+    with opener as fh:
+        source = _indexed_source(fh) if indexed_lines is not None else fh
+        for line in source:
             if line.startswith("##"):
                 header.add_meta_line(line)
                 continue
@@ -344,6 +367,7 @@ def write_vcf(
     extra_info: dict[str, np.ndarray] | None = None,
     sample_overrides: dict[int, np.ndarray] | None = None,
     fmt_override: np.ndarray | None = None,
+    index: bool = True,
 ) -> None:
     """Write a VariantTable back to VCF, rewriting only the requested columns.
 
@@ -352,6 +376,8 @@ def write_vcf(
       ``True`` writes a bare flag). Appended to the existing INFO string.
     - ``sample_overrides``: sample index -> object array of replacement
       sample strings; ``fmt_override`` replaces the FORMAT column.
+    - ``index``: for ``.gz`` outputs, also build the sibling ``.tbi``
+      (io/tabix) so htslib tools can consume the file directly.
     """
     if str(path).endswith(".gz"):
         from variantcalling_tpu.io.bgzf import BgzfWriter
@@ -398,3 +424,10 @@ def write_vcf(
                     else:
                         cols.append(table.sample_cols[i][s])
             out.write("\t".join(cols) + "\n")
+    if index and str(path).endswith(".gz"):
+        from variantcalling_tpu.io.tabix import build_tabix_index
+
+        try:
+            build_tabix_index(str(path))
+        except (ValueError, OSError):
+            pass  # unsorted/odd inputs: the VCF itself is still valid

@@ -1,0 +1,144 @@
+"""Methylation postprocessing core (MethylDackel output → metrics tensors).
+
+Reference surface: the five ugbio_methylation CLI tools registered at
+ugvc/__main__.py:20-26,58-64 (concat_methyldackel_csvs, process_mbias,
+process_merge_context[_no_cp_g], process_per_read); their internals live in
+the missing ugbio_utils submodule, so behavior is re-derived from
+MethylDackel's public output formats:
+
+- ``extract`` bedGraph rows: chrom, start, end, meth_pct, n_meth, n_unmeth
+- ``mbias --txt`` rows: strand (OT/OB/CTOT/CTOB), read (1/2), position,
+  n_meth, n_unmeth
+- ``perRead`` rows: read, chrom, pos, meth_fraction, n_sites
+
+Aggregations (methylation histograms, coverage×methylation joint stats,
+per-position M-bias curves) are batched device reductions — one-hot psum
+style, the same kernel family as ops/coverage histograms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+BEDGRAPH_COLS = ["chrom", "start", "end", "meth_pct", "n_meth", "n_unmeth"]
+MBIAS_COLS = ["strand", "read", "position", "n_meth", "n_unmeth"]
+
+
+def read_extract_bedgraph(path: str) -> pd.DataFrame:
+    """MethylDackel extract output (with or without the track header line)."""
+    df = pd.read_csv(path, sep="\t", comment="t", header=None, names=BEDGRAPH_COLS)
+    # "comment='t'" drops the 'track ...' header; re-validate dtypes
+    df = df[pd.to_numeric(df["start"], errors="coerce").notna()]
+    for c in BEDGRAPH_COLS[1:]:
+        df[c] = pd.to_numeric(df[c])
+    return df.reset_index(drop=True)
+
+
+def read_mbias_txt(path: str) -> pd.DataFrame:
+    df = pd.read_csv(path, sep="\t")
+    df.columns = [c.strip().lower().replace("#", "").replace(" ", "_") for c in df.columns]
+    rename = {"nmethylated": "n_meth", "nunmethylated": "n_unmeth", "pos": "position"}
+    df = df.rename(columns=rename)
+    for col in ("strand", "read", "position", "n_meth", "n_unmeth"):
+        if col not in df.columns:
+            raise ValueError(f"{path}: mbias table missing column {col!r}")
+    return df
+
+
+def methylation_histogram(n_meth: np.ndarray, n_unmeth: np.ndarray, n_bins: int = 101) -> np.ndarray:
+    """Histogram of per-site methylation fraction (0..1 in n_bins bins), device-reduced."""
+    nm = jnp.asarray(n_meth, dtype=jnp.float32)
+    nu = jnp.asarray(n_unmeth, dtype=jnp.float32)
+    cov = nm + nu
+    frac = jnp.where(cov > 0, nm / jnp.maximum(cov, 1.0), 0.0)
+    bins = jnp.clip((frac * (n_bins - 1) + 0.5).astype(jnp.int32), 0, n_bins - 1)
+    hist = jnp.zeros(n_bins, dtype=jnp.int32).at[bins].add(jnp.where(cov > 0, 1, 0))
+    return np.asarray(hist)
+
+
+def coverage_methylation_stats(n_meth: np.ndarray, n_unmeth: np.ndarray, max_cov: int = 100) -> pd.DataFrame:
+    """Per-coverage-level mean methylation + site counts (joint reduction)."""
+    nm = jnp.asarray(n_meth, dtype=jnp.float32)
+    nu = jnp.asarray(n_unmeth, dtype=jnp.float32)
+    cov = jnp.clip((nm + nu).astype(jnp.int32), 0, max_cov)
+    frac = jnp.where(nm + nu > 0, nm / jnp.maximum(nm + nu, 1.0), 0.0)
+    counts = jnp.zeros(max_cov + 1, dtype=jnp.int32).at[cov].add(1)
+    sums = jnp.zeros(max_cov + 1, dtype=jnp.float32).at[cov].add(frac)
+    counts_np = np.asarray(counts)
+    mean = np.divide(np.asarray(sums), np.maximum(counts_np, 1), where=counts_np > 0)
+    return pd.DataFrame(
+        {"coverage": np.arange(max_cov + 1), "n_sites": counts_np, "mean_methylation": np.round(mean, 5)}
+    )
+
+
+def global_methylation_summary(df: pd.DataFrame) -> pd.DataFrame:
+    nm = float(df["n_meth"].sum())
+    nu = float(df["n_unmeth"].sum())
+    cov = df["n_meth"].to_numpy() + df["n_unmeth"].to_numpy()
+    return pd.DataFrame(
+        [
+            {
+                "n_sites": len(df),
+                "n_covered_sites": int((cov > 0).sum()),
+                "total_calls": nm + nu,
+                "global_methylation": round(nm / max(nm + nu, 1.0), 5),
+                "mean_coverage": round(float(cov.mean()) if len(cov) else 0.0, 3),
+            }
+        ]
+    )
+
+
+def mbias_curves(df: pd.DataFrame) -> pd.DataFrame:
+    """Per (strand, read, position) methylation fraction — the M-bias curve."""
+    g = df.groupby(["strand", "read", "position"], as_index=False)[["n_meth", "n_unmeth"]].sum()
+    tot = g["n_meth"] + g["n_unmeth"]
+    g["methylation"] = np.round(np.where(tot > 0, g["n_meth"] / tot.clip(lower=1), np.nan), 5)
+    return g
+
+
+def mbias_inclusion_bounds(curves: pd.DataFrame, tolerance: float = 0.05) -> pd.DataFrame:
+    """Suggested 5'/3' trim bounds per (strand, read): positions whose
+    methylation deviates > tolerance from the plateau median are excluded
+    (the standard MethylDackel --OT/--OB trimming recommendation)."""
+    rows = []
+    for (strand, read), grp in curves.groupby(["strand", "read"]):
+        grp = grp.sort_values("position")
+        m = grp["methylation"].to_numpy()
+        pos = grp["position"].to_numpy()
+        if len(m) == 0 or np.all(np.isnan(m)):
+            continue
+        med = np.nanmedian(m)
+        ok = np.abs(m - med) <= tolerance
+        first = pos[np.argmax(ok)] if ok.any() else pos[0]
+        last = pos[len(ok) - 1 - np.argmax(ok[::-1])] if ok.any() else pos[-1]
+        rows.append({"strand": strand, "read": read, "inclusion_start": int(first), "inclusion_end": int(last)})
+    return pd.DataFrame(rows)
+
+
+def merge_cpg_strands(df: pd.DataFrame) -> pd.DataFrame:
+    """Combine +/- strand CpG records into per-CpG-dinucleotide rows.
+
+    MethylDackel emits one row per cytosine; the C on the reverse strand of
+    a CpG sits at start+1. Rows whose start differs by 1 on the same chrom
+    are merged by summing counts (the ``--mergeContext`` semantics)."""
+    df = df.sort_values(["chrom", "start"]).reset_index(drop=True)
+    chrom = df["chrom"].to_numpy()
+    start = df["start"].to_numpy()
+    prev_same = np.zeros(len(df), dtype=bool)
+    if len(df) > 1:
+        prev_same[1:] = (chrom[1:] == chrom[:-1]) & (start[1:] == start[:-1] + 1)
+    # group id increments where a row does NOT merge with its predecessor
+    gid = np.cumsum(~prev_same)
+    out = df.groupby(gid).agg(
+        chrom=("chrom", "first"),
+        start=("start", "first"),
+        end=("end", "max"),
+        n_meth=("n_meth", "sum"),
+        n_unmeth=("n_unmeth", "sum"),
+    )
+    tot = out["n_meth"] + out["n_unmeth"]
+    out["meth_pct"] = np.round(100.0 * out["n_meth"] / tot.clip(lower=1), 2)
+    return out.reset_index(drop=True)[BEDGRAPH_COLS[:3] + ["meth_pct", "n_meth", "n_unmeth"]]

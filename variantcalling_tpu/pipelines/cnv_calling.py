@@ -1,0 +1,107 @@
+"""cnv_calling — CNV segments from a BAM or binned-coverage parquet.
+
+Reference surface: the ugbio_cnv package CLI (setup.py:4-8; the reference
+runs cn.mops/cnvpytor in dedicated conda envs). Here calling runs on the
+same depth tensors the coverage pipeline produces: BAM -> per-contig depth
+(native C++ walker) -> binned means (device reshape-mean) -> GC-corrected
+log2 ratios -> HMM Viterbi segmentation (device scan). Output: BED of
+segments (chrom, start, end, CN, n_bins, mean_log2) + optional VCF with
+symbolic <DEL>/<DUP> alleles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.cnv.caller import call_cnvs
+from variantcalling_tpu.io.bam import depth_diff_arrays, depth_vectors
+from variantcalling_tpu.io.fasta import FastaReader
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="cnv_calling", description=run.__doc__)
+    ap.add_argument("--input_bam", required=True)
+    ap.add_argument("--output_bed", required=True)
+    ap.add_argument("--output_vcf", default=None)
+    ap.add_argument("--bin_size", type=int, default=1000)
+    ap.add_argument("--reference", default=None, help="FASTA for GC correction")
+    ap.add_argument("--min_contig_length", type=int, default=1_000_000)
+    ap.add_argument("--min_bins", type=int, default=3)
+    ap.add_argument("--sigma", type=float, default=0.35)
+    ap.add_argument("--mapq", type=int, default=1)
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def binned_depth(depth: np.ndarray, bin_size: int) -> np.ndarray:
+    n_bins = len(depth) // bin_size
+    if n_bins == 0:
+        return np.zeros(0, dtype=np.float32)
+    return depth[: n_bins * bin_size].reshape(n_bins, bin_size).mean(axis=1).astype(np.float32)
+
+
+def gc_per_bin(fasta: FastaReader, contig: str, n_bins: int, bin_size: int) -> np.ndarray:
+    seq = fasta.fetch(contig, 0, n_bins * bin_size).upper()  # fetch is 0-based half-open
+    arr = np.frombuffer(seq.encode(), dtype=np.uint8)[: n_bins * bin_size]
+    if len(arr) < n_bins * bin_size:
+        arr = np.pad(arr, (0, n_bins * bin_size - len(arr)), constant_values=ord("N"))
+    is_gc = (arr == ord("G")) | (arr == ord("C"))
+    return is_gc.reshape(n_bins, bin_size).mean(axis=1).astype(np.float32)
+
+
+def run(argv) -> int:
+    """Call CNVs from coverage depth via the device HMM."""
+    args = parse_args(argv)
+    header, diffs = depth_diff_arrays(args.input_bam, min_mapq=args.mapq)
+    depth = depth_vectors(header, diffs)
+    per_contig: dict[str, np.ndarray] = {}
+    gc: dict[str, np.ndarray] | None = {} if args.reference else None
+    fasta = FastaReader(args.reference) if args.reference else None
+    for name, d in depth.items():
+        if header.lengths[name] < args.min_contig_length:
+            continue
+        b = binned_depth(d, args.bin_size)
+        if not len(b):
+            continue
+        per_contig[name] = b
+        if fasta is not None:
+            gc[name] = gc_per_bin(fasta, name, len(b), args.bin_size)
+    segs = call_cnvs(
+        per_contig, args.bin_size, gc, sigma=args.sigma, min_bins=args.min_bins
+    )
+    with open(args.output_bed, "w") as fh:
+        for s in segs:
+            fh.write(f"{s.chrom}\t{s.start}\t{s.end}\tCN{s.copy_number}\t{s.n_bins}\t{s.mean_log2:.3f}\n")
+    if args.output_vcf:
+        _write_vcf(args.output_vcf, segs, header)
+    logger.info("%d CNV segments -> %s", len(segs), args.output_bed)
+    return 0
+
+
+def _write_vcf(path: str, segs, header) -> None:
+    from variantcalling_tpu.io.bgzf import BgzfWriter
+
+    opener = BgzfWriter(path) if path.endswith(".gz") else open(path, "w")
+    with opener as fh:
+        fh.write("##fileformat=VCFv4.2\n")
+        fh.write('##ALT=<ID=DEL,Description="Deletion">\n##ALT=<ID=DUP,Description="Duplication">\n')
+        fh.write('##INFO=<ID=END,Number=1,Type=Integer,Description="Segment end">\n')
+        fh.write('##INFO=<ID=CN,Number=1,Type=Integer,Description="Copy number">\n')
+        fh.write('##INFO=<ID=SVTYPE,Number=1,Type=String,Description="SV type">\n')
+        for name, length in header.lengths.items():
+            fh.write(f"##contig=<ID={name},length={length}>\n")
+        fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+        for s in segs:
+            svtype = "DEL" if s.copy_number < 2 else "DUP"
+            fh.write(
+                f"{s.chrom}\t{s.start + 1}\t.\tN\t<{svtype}>\t.\tPASS\t"
+                f"END={s.end};CN={s.copy_number};SVTYPE={svtype}\n"
+            )
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,142 @@
+"""create_qc_report — sequence-data QC report over per-sample metrics h5s.
+
+Reference surface: ugvc/reports/createQCReport.ipynb + qc_report.config +
+top_metrics_for_tbl.csv (the KPI set). Consumes import_metrics h5s (long
+File/Parameter/Value tables + coverage histograms) for N samples and emits
+Throughput / Coverage / Error sections + the top-metrics table as h5 + HTML.
+"""
+
+from __future__ import annotations
+
+import argparse
+import configparser
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+# reference top_metrics_for_tbl.csv (key, metric-file)
+TOP_METRICS = [
+    ("TOTAL_READS", "quality_yield_metrics"),
+    ("PCT_PF_READS", "alignment_summary_metrics"),
+    ("PCT_PF_READS_ALIGNED", "alignment_summary_metrics"),
+    ("PF_BASES", "quality_yield_metrics"),
+    ("PF_Q30_BASES", "quality_yield_metrics"),
+    ("MEAN_READ_LENGTH", "alignment_summary_metrics"),
+    ("MEAN_COVERAGE", "raw_wgs_metrics"),
+    ("FOLD_90_BASE_PENALTY", "raw_wgs_metrics"),
+    ("PCT_20X", "raw_wgs_metrics"),
+    ("PERCENT_DUPLICATION", "duplication_metrics"),
+    ("PF_INDEL_RATE", "alignment_summary_metrics"),
+    ("PF_MISMATCH_RATE", "alignment_summary_metrics"),
+]
+
+
+def get_metric(metrics: pd.DataFrame, file_substr: str, param: str):
+    m = metrics[(metrics["File"].str.contains(file_substr, regex=False)) & (metrics["Parameter"] == param)]
+    if not len(m):
+        return np.nan
+    try:
+        return float(m.iloc[0]["Value"])
+    except (TypeError, ValueError):
+        return np.nan
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="create_qc_report", description=run.__doc__)
+    ap.add_argument("--config", help="QCReport INI (qc_report.config surface)")
+    ap.add_argument("--samples", nargs="*", default=None, help="sample names")
+    ap.add_argument("--metrics_h5", nargs="*", default=None, help="per-sample import_metrics h5 (same order)")
+    ap.add_argument("--run_id", default="NA")
+    ap.add_argument("--h5_output", default="qc_report.h5")
+    ap.add_argument("--html_output", default=None)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Generate the QC report from per-sample metrics stores."""
+    args = parse_args(argv)
+    samples = args.samples or []
+    metrics_files = args.metrics_h5 or []
+    run_id = args.run_id
+    if args.config:
+        cp = configparser.ConfigParser()
+        cp.read(args.config)
+        sec = cp["QCReport"]
+        run_id = sec.get("run_id", run_id)
+        if not samples:
+            samples = [s.strip() for s in sec.get("samples", "").split(",") if s.strip()]
+        if not metrics_files:
+            metrics_files = [f"{s}.metrics.h5" for s in samples]
+    if not samples or len(samples) != len(metrics_files):
+        raise SystemExit("need --samples and --metrics_h5 of equal length (or a --config)")
+
+    per_sample = {s: read_hdf(f, key="metrics") for s, f in zip(samples, metrics_files)}
+    rep = HtmlReport(f"Sequence data QC Report — run {run_id}")
+    rep.add_params({"run_id": run_id, "samples": ", ".join(samples)})
+
+    top = pd.DataFrame(
+        {s: {k: get_metric(per_sample[s], f, k) for k, f in TOP_METRICS} for s in samples}
+    )
+    rep.add_section("Top metrics")
+    rep.add_table(top)
+    write_hdf(top.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="top_metrics", mode="w")
+
+    tp = pd.DataFrame(
+        {
+            s: {
+                "Total reads": get_metric(per_sample[s], "quality_yield_metrics", "TOTAL_READS"),
+                "PF reads": get_metric(per_sample[s], "quality_yield_metrics", "PF_READS"),
+                "Aligned reads": get_metric(per_sample[s], "alignment_summary_metrics", "PF_READS_ALIGNED"),
+                "PF bases": get_metric(per_sample[s], "quality_yield_metrics", "PF_BASES"),
+                "Q30 bases": get_metric(per_sample[s], "quality_yield_metrics", "PF_Q30_BASES"),
+            }
+            for s in samples
+        }
+    )
+    rep.add_section("Throughput")
+    rep.add_table(tp)
+    write_hdf(tp.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="throughput", mode="a")
+
+    cm = pd.DataFrame(
+        {
+            s: {
+                "Mean coverage": get_metric(per_sample[s], "raw_wgs_metrics", "MEAN_COVERAGE"),
+                "Median coverage": get_metric(per_sample[s], "raw_wgs_metrics", "MEDIAN_COVERAGE"),
+                "PCT_20X": get_metric(per_sample[s], "raw_wgs_metrics", "PCT_20X"),
+                "Fold-90 penalty": get_metric(per_sample[s], "raw_wgs_metrics", "FOLD_90_BASE_PENALTY"),
+            }
+            for s in samples
+        }
+    )
+    rep.add_section("Coverage")
+    rep.add_table(cm)
+    write_hdf(cm.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="coverage", mode="a")
+
+    em = pd.DataFrame(
+        {
+            s: {
+                "Mismatch rate": get_metric(per_sample[s], "alignment_summary_metrics", "PF_MISMATCH_RATE"),
+                "Indel rate": get_metric(per_sample[s], "alignment_summary_metrics", "PF_INDEL_RATE"),
+                "Duplication": get_metric(per_sample[s], "duplication_metrics", "PERCENT_DUPLICATION"),
+            }
+            for s in samples
+        }
+    )
+    rep.add_section("Error")
+    rep.add_table(em)
+    write_hdf(em.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="error", mode="a")
+
+    if args.html_output:
+        rep.write(args.html_output)
+    logger.info("QC report for %d samples -> %s", len(samples), args.h5_output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

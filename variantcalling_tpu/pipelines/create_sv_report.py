@@ -1,0 +1,90 @@
+"""create_sv_report — SV accuracy report from sv_stats_collect results.
+
+Reference surface: ugvc/reports/createSVReport.ipynb (papermill). Consumes
+the pickled results dict of sv_stats_collect (keys: type_counts,
+size_histograms, concordance stats per svtype/length-bin, fp_stats) and
+emits the same artifact set directly: section tables in h5 + HTML.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="create_sv_report", description=run.__doc__)
+    ap.add_argument("--statistics_file", required=True, help="sv_stats_collect pickle")
+    ap.add_argument("--run_id", default="NA")
+    ap.add_argument("--pipeline_version", default="NA")
+    ap.add_argument("--reference_version", default="hg38")
+    ap.add_argument("--truth_sample_name", default="NA")
+    ap.add_argument("--h5_output", default="sv_report.h5")
+    ap.add_argument("--html_output", default=None)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Generate the SV report (h5 sections + optional HTML)."""
+    args = parse_args(argv)
+    with open(args.statistics_file, "rb") as fh:
+        results = pickle.load(fh)
+    sv_stats = results.get("sv_stats", results if isinstance(results, dict) else {})
+    concordance = results.get("concordance_stats", {})
+    fp_stats = results.get("fp_stats", pd.Series(dtype="int64"))
+
+    rep = HtmlReport("SV Report")
+    rep.add_params(
+        {
+            "run_id": args.run_id,
+            "pipeline_version": args.pipeline_version,
+            "reference_version": args.reference_version,
+            "truth_sample_name": args.truth_sample_name,
+            "statistics_file": args.statistics_file,
+        }
+    )
+    mode = "w"
+    if "type_counts" in sv_stats:
+        tc = pd.DataFrame(sv_stats["type_counts"]).T if isinstance(sv_stats["type_counts"], dict) else pd.DataFrame(sv_stats["type_counts"])
+        rep.add_section("SV type counts")
+        rep.add_table(tc)
+        write_hdf(tc.reset_index(), args.h5_output, key="type_counts", mode=mode)
+        mode = "a"
+    if "size_histograms" in sv_stats:
+        sh = sv_stats["size_histograms"]
+        sh = pd.DataFrame(sh) if not isinstance(sh, pd.DataFrame) else sh
+        rep.add_section("SV size histograms")
+        rep.add_table(sh)
+        write_hdf(sh.reset_index(), args.h5_output, key="size_histograms", mode=mode)
+        mode = "a"
+    if concordance:
+        conc_rows = {k: v for k, v in concordance.items() if isinstance(v, pd.Series)}
+        if conc_rows:
+            conc = pd.DataFrame(conc_rows).T
+            rep.add_section("Concordance vs ground truth")
+            rep.add_table(conc)
+            write_hdf(conc.reset_index(), args.h5_output, key="concordance", mode=mode)
+            mode = "a"
+    if len(fp_stats):
+        rep.add_section("False positives by type and size")
+        fp_df = fp_stats.rename("count").reset_index()
+        fp_df = fp_df.astype({c: str for c in fp_df.columns if fp_df[c].dtype == "category"})
+        rep.add_table(fp_df)
+        write_hdf(fp_df, args.h5_output, key="fp_stats", mode=mode)
+        mode = "a"
+    if args.html_output:
+        rep.write(args.html_output)
+    logger.info("SV report -> %s%s", args.h5_output, f" + {args.html_output}" if args.html_output else "")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

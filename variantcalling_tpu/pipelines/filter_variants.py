@@ -85,9 +85,9 @@ def read_blacklist(path: str) -> tuple[np.ndarray, np.ndarray]:
         iv = bedio.read_bed(path)
         return iv.chrom, (iv.start + 1).astype(np.int64)
     if path.endswith((".h5", ".hdf", ".hdf5")):
-        import pandas as pd
+        from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
 
-        df = pd.read_hdf(path)
+        df = read_hdf(path, key=list_keys(path)[0])
         if isinstance(df.index, __import__("pandas").MultiIndex):
             df = df.reset_index()
         return df["chrom"].to_numpy(dtype=object), df["pos"].to_numpy(dtype=np.int64)
@@ -237,8 +237,11 @@ def run(argv: list[str]) -> int:
     if args.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    from variantcalling_tpu.utils.trace import report, stage
+
     logger.info("reading %s", args.input_file)
-    table = read_vcf(args.input_file)
+    with stage("ingest"):
+        table = read_vcf(args.input_file)
     if args.limit_to_contig:
         keep = np.asarray(table.chrom) == args.limit_to_contig
         table = _subset(table, keep)
@@ -247,25 +250,28 @@ def run(argv: list[str]) -> int:
     annotate = {_interval_name(p): bedio.read_intervals(p) for p in args.annotate_intervals}
     blacklist = read_blacklist(args.blacklist) if args.blacklist else None
 
-    score, filters = filter_variants(
-        table,
-        model,
-        fasta,
-        runs_file=args.runs_file,
-        hpol_length=args.hpol_filter_length_dist[0],
-        hpol_dist=args.hpol_filter_length_dist[1],
-        blacklist=blacklist,
-        blacklist_cg_insertions=args.blacklist_cg_insertions,
-        annotate_intervals=annotate,
-        flow_order=args.flow_order,
-        is_mutect=args.is_mutect,
-    )
+    with stage("featurize+score"):
+        score, filters = filter_variants(
+            table,
+            model,
+            fasta,
+            runs_file=args.runs_file,
+            hpol_length=args.hpol_filter_length_dist[0],
+            hpol_dist=args.hpol_filter_length_dist[1],
+            blacklist=blacklist,
+            blacklist_cg_insertions=args.blacklist_cg_insertions,
+            annotate_intervals=annotate,
+            flow_order=args.flow_order,
+            is_mutect=args.is_mutect,
+        )
 
     table.header.ensure_filter(LOW_SCORE, "Model score below threshold")
     table.header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
     table.header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
     table.header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
-    write_vcf(args.output_file, table, new_filters=filters, extra_info={"TREE_SCORE": np.round(score, 4)})
+    with stage("writeback"):
+        write_vcf(args.output_file, table, new_filters=filters, extra_info={"TREE_SCORE": np.round(score, 4)})
+    logger.debug("%s", report())
     logger.info(
         "wrote %s: %d variants, %d PASS", args.output_file, len(table), int(np.sum(filters == PASS))
     )

@@ -1,0 +1,69 @@
+"""import_metrics — picard metrics directory -> long-format metrics h5.
+
+Reference surface: ugvc/reports/importMetrics.ipynb — walks
+``<prefix>*.metrics``-style files, parses htsjdk metrics sections, and
+produces the (File, Parameter, Value) long table + coverage histograms the
+QC report consumes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.pipelines.misc.collect_existing_metrics import read_picard_metrics
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def metrics_long_table(paths: list[str]) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """(metrics long table, coverage histograms) from picard-style files."""
+    rows = []
+    cvg_frames = []
+    for path in paths:
+        name = os.path.basename(path)
+        for suffix in (".txt", ".metrics", ".csv"):
+            name = name.removesuffix(suffix)
+        # strip the sample prefix: keep the metric-class part after the first '.'
+        short = name.split(".", 1)[1] if "." in name else name
+        sections = read_picard_metrics(path)
+        m = sections.get("metrics")
+        if m is not None and len(m):
+            first = m.iloc[0]
+            for col in m.columns:
+                rows.append({"File": short, "Parameter": col, "Value": first[col]})
+        h = sections.get("histogram")
+        if h is not None and len(h):
+            h = h.copy()
+            h["File"] = short
+            cvg_frames.append(h)
+    metrics = pd.DataFrame(rows)
+    cvg = pd.concat(cvg_frames, ignore_index=True) if cvg_frames else pd.DataFrame()
+    return metrics, cvg
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="import_metrics", description=run.__doc__)
+    ap.add_argument("--metrics_prefix", required=True, help="glob prefix: <prefix>* files are parsed")
+    ap.add_argument("--output_h5", required=True)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Import a sample's picard metrics files into the QC-report h5 layout."""
+    args = parse_args(argv)
+    paths = sorted(p for p in glob.glob(args.metrics_prefix + "*") if os.path.isfile(p))
+    metrics, cvg = metrics_long_table(paths)
+    write_hdf(metrics, args.output_h5, key="metrics", mode="w")
+    if len(cvg):
+        write_hdf(cvg, args.output_h5, key="coverage_histograms", mode="a")
+    logger.info("%d metric rows from %d files -> %s", len(metrics), len(paths), args.output_h5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

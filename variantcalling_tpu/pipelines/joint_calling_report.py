@@ -1,0 +1,79 @@
+"""joint_calling_report — cohort joint-calling statistics report.
+
+Reference surface: ugvc/reports/joint_calling_report.ipynb: VariantEval-
+style known/novel nSNP/nIndel/TiTv tables per annotation + indel length
+histogram. Consumes a joint VCF directly (the eval tables come from
+reports/variant_eval's device reductions, replacing GATK VariantEval).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.variant_eval import compute_eval_tables, dbsnp_membership
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="joint_calling_report", description=run.__doc__)
+    ap.add_argument("--input_vcf", required=True, help="joint-called cohort VCF")
+    ap.add_argument("--dbsnp", default=None, help="dbSNP VCF for known/novel split")
+    ap.add_argument("--h5_output", default="joint_calling_report.h5")
+    ap.add_argument("--html_output", default=None)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Cohort variant statistics: counts, TiTv, indel spectrum, per-sample."""
+    args = parse_args(argv)
+    table = read_vcf(args.input_vcf)
+    known = dbsnp_membership(table, args.dbsnp) if args.dbsnp else None
+    rep = HtmlReport("Joint Calling Report")
+    rep.add_params({"input": args.input_vcf, "n_records": len(table), "n_samples": table.n_samples})
+
+    mode = "w"
+    tables = compute_eval_tables(table, known=known)
+    for name in ("CountVariants", "TiTvVariantEvaluator", "IndelSummary", "IndelLengthHistogram"):
+        if name in tables:
+            rep.add_section(name)
+            rep.add_table(tables[name])
+            write_hdf(tables[name], args.h5_output, key=name, mode=mode)
+            mode = "a"
+
+    # per-sample: call rate, het/hom ratio
+    if table.n_samples:
+        rows = []
+        for s, name in enumerate(table.header.samples):
+            gts = table.genotypes(s)
+            called = (gts >= 0).any(axis=1)
+            het = called & (gts[:, 0] != gts[:, 1])
+            hom_var = called & (gts[:, 0] == gts[:, 1]) & (gts[:, 0] > 0)
+            rows.append(
+                {
+                    "sample": name,
+                    "call_rate": round(float(called.mean()), 5),
+                    "n_het": int(het.sum()),
+                    "n_hom_var": int(hom_var.sum()),
+                    "het_hom_ratio": round(float(het.sum() / max(int(hom_var.sum()), 1)), 4),
+                }
+            )
+        per_sample = pd.DataFrame(rows)
+        rep.add_section("Per-sample statistics")
+        rep.add_table(per_sample)
+        write_hdf(per_sample, args.h5_output, key="per_sample", mode=mode)
+
+    if args.html_output:
+        rep.write(args.html_output)
+    logger.info("joint calling report -> %s", args.h5_output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,47 @@
+"""concat_methyldackel_csvs — merge per-shard MethylDackel extract outputs.
+
+Reference surface: ugbio_methylation concat_methyldackel_csvs
+(ugvc/__main__.py:21; internals missing — MethylDackel bedGraph format is
+public). Concatenates per-region/per-chunk extract CSVs in genomic order
+and merges duplicate sites by summing counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.methyl import read_extract_bedgraph
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="concat_methyldackel_csvs", description=run.__doc__)
+    ap.add_argument("--inputs", nargs="+", required=True, help="per-shard extract bedGraph/CSV files")
+    ap.add_argument("--output", required=True, help="merged CSV")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Concatenate and sort MethylDackel extract shards."""
+    import pandas as pd
+
+    args = parse_args(argv)
+    frames = [read_extract_bedgraph(p) for p in args.inputs]
+    df = pd.concat(frames, ignore_index=True)
+    df = (
+        df.groupby(["chrom", "start", "end"], as_index=False)[["n_meth", "n_unmeth"]]
+        .sum()
+        .sort_values(["chrom", "start"])
+    )
+    tot = (df["n_meth"] + df["n_unmeth"]).clip(lower=1)
+    df["meth_pct"] = (100.0 * df["n_meth"] / tot).round(2)
+    df = df[["chrom", "start", "end", "meth_pct", "n_meth", "n_unmeth"]]
+    df.to_csv(args.output, sep="\t", index=False, header=False)
+    logger.info("%d sites from %d shards -> %s", len(df), len(args.inputs), args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

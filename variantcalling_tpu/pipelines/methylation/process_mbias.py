@@ -1,0 +1,40 @@
+"""process_mbias — M-bias curves + trim-bound suggestion from MethylDackel mbias.
+
+Reference surface: ugvc/__main__.py:22 (internals in missing submodule;
+mbias --txt format is public). Outputs h5 keys ``mbias`` (per strand/read/
+position curves) and ``inclusion_bounds`` (suggested trimming).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.utils.h5_utils import write_hdf
+from variantcalling_tpu.methyl import mbias_curves, mbias_inclusion_bounds, read_mbias_txt
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="process_mbias", description=run.__doc__)
+    ap.add_argument("--input", required=True, help="MethylDackel mbias --txt output")
+    ap.add_argument("--output", required=True, help="metrics h5")
+    ap.add_argument("--tolerance", type=float, default=0.05)
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Process an M-bias table into curves and inclusion bounds."""
+    args = parse_args(argv)
+    df = read_mbias_txt(args.input)
+    curves = mbias_curves(df)
+    bounds = mbias_inclusion_bounds(curves, args.tolerance)
+    write_hdf(curves, args.output, key="mbias", mode="w")
+    write_hdf(bounds, args.output, key="inclusion_bounds", mode="a")
+    logger.info("mbias curves (%d rows) + bounds (%d) -> %s", len(curves), len(bounds), args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

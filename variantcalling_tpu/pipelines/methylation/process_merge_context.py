@@ -1,0 +1,60 @@
+"""process_merge_context — CpG-context methylation metrics (strand-merged).
+
+Reference surface: ugvc/__main__.py:23 (internals in missing submodule).
+Merges +/- strand CpG rows (--mergeContext semantics), then reduces
+genome-wide metrics on device: methylation-fraction histogram, coverage ×
+methylation stats, global summary. Output: h5 keys ``summary``,
+``histogram``, ``coverage_stats``, and optionally the merged bedGraph.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.utils.h5_utils import write_hdf
+from variantcalling_tpu.methyl import (
+    coverage_methylation_stats,
+    global_methylation_summary,
+    merge_cpg_strands,
+    methylation_histogram,
+    read_extract_bedgraph,
+)
+
+
+def parse_args(argv, prog="process_merge_context"):
+    ap = argparse.ArgumentParser(prog=prog, description=run.__doc__)
+    ap.add_argument("--input", required=True, help="MethylDackel extract bedGraph (CpG context)")
+    ap.add_argument("--output", required=True, help="metrics h5")
+    ap.add_argument("--merged_bedgraph", help="also write the strand-merged bedGraph here")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def process(df: pd.DataFrame, output: str, merged_bedgraph: str | None, merge_strands: bool) -> None:
+    if merge_strands:
+        df = merge_cpg_strands(df)
+    if merged_bedgraph:
+        df.to_csv(merged_bedgraph, sep="\t", index=False, header=False)
+    nm, nu = df["n_meth"].to_numpy(), df["n_unmeth"].to_numpy()
+    write_hdf(global_methylation_summary(df), output, key="summary", mode="w")
+    hist = methylation_histogram(nm, nu)
+    write_hdf(pd.DataFrame({"bin": np.arange(len(hist)), "n_sites": hist}), output, key="histogram", mode="a")
+    write_hdf(coverage_methylation_stats(nm, nu), output, key="coverage_stats", mode="a")
+
+
+def run(argv) -> int:
+    """CpG-context methylation metrics with strand merging."""
+    args = parse_args(argv)
+    df = read_extract_bedgraph(args.input)
+    process(df, args.output, args.merged_bedgraph, merge_strands=True)
+    logger.info("merge-context metrics -> %s", args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,27 @@
+"""process_merge_context_no_cp_g — non-CpG (CHG/CHH) methylation metrics.
+
+Reference surface: ugvc/__main__.py:24. Same reductions as
+process_merge_context but without strand merging (non-CpG contexts are not
+palindromic).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.methyl import read_extract_bedgraph
+from variantcalling_tpu.pipelines.methylation.process_merge_context import parse_args, process
+
+
+def run(argv) -> int:
+    """Non-CpG-context methylation metrics (no strand merge)."""
+    args = parse_args(argv, prog="process_merge_context_no_cp_g")
+    df = read_extract_bedgraph(args.input)
+    process(df, args.output, args.merged_bedgraph, merge_strands=False)
+    logger.info("non-CpG metrics -> %s", args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,61 @@
+"""process_per_read — per-read methylation distribution metrics.
+
+Reference surface: ugvc/__main__.py:25 (internals in missing submodule;
+MethylDackel perRead format is public: read, chrom, pos, meth_fraction,
+n_sites). Device-reduces the per-read methylation histogram and the
+n_sites-weighted summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.methyl import methylation_histogram
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+PER_READ_COLS = ["read", "chrom", "pos", "meth_fraction", "n_sites"]
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="process_per_read", description=run.__doc__)
+    ap.add_argument("--input", required=True, help="MethylDackel perRead output")
+    ap.add_argument("--output", required=True, help="metrics h5")
+    ap.add_argument("--min_sites", type=int, default=1, help="ignore reads with fewer CpG sites")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Per-read methylation metrics."""
+    args = parse_args(argv)
+    df = pd.read_csv(args.input, sep="\t", header=None, names=PER_READ_COLS, comment="#")
+    df = df[pd.to_numeric(df["meth_fraction"], errors="coerce").notna()]
+    df["meth_fraction"] = pd.to_numeric(df["meth_fraction"])
+    df["n_sites"] = pd.to_numeric(df["n_sites"])
+    df = df[df["n_sites"] >= args.min_sites]
+    frac = df["meth_fraction"].to_numpy()
+    # reuse the fraction histogram kernel: frac == nm/(nm+nu) with unit mass
+    hist = methylation_histogram(frac, 1.0 - frac)
+    write_hdf(pd.DataFrame({"bin": np.arange(len(hist)), "n_reads": hist}), args.output, key="histogram", mode="w")
+    summary = pd.DataFrame(
+        [
+            {
+                "n_reads": len(df),
+                "mean_read_methylation": round(float(frac.mean()) if len(df) else 0.0, 5),
+                "median_read_methylation": round(float(np.median(frac)) if len(df) else 0.0, 5),
+                "mean_sites_per_read": round(float(df["n_sites"].mean()) if len(df) else 0.0, 3),
+            }
+        ]
+    )
+    write_hdf(summary, args.output, key="summary", mode="a")
+    logger.info("per-read metrics (%d reads) -> %s", len(df), args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,76 @@
+"""collect_existing_metrics — gather scattered metric files into one h5.
+
+Reference surface: ugbio_core/collect_existing_metrics.py (setup.py:36;
+internals in the missing submodule). Accepts picard-style ``.metrics``
+files (## HISTOGRAM / ## METRICS sections), csvs, and h5s; each lands
+under its own key in the output h5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf, write_hdf
+
+
+def read_picard_metrics(path: str) -> dict[str, pd.DataFrame]:
+    """Parse picard-format sections: '## METRICS CLASS ...' / '## HISTOGRAM ...'."""
+    out: dict[str, pd.DataFrame] = {}
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("## METRICS CLASS") or line.startswith("## HISTOGRAM"):
+            section = "metrics" if "METRICS" in line else "histogram"
+            rows = []
+            i += 1
+            while i < len(lines) and lines[i].strip() and not lines[i].startswith("#"):
+                rows.append(lines[i].split("\t"))
+                i += 1
+            if len(rows) >= 2:
+                out[section] = pd.DataFrame(rows[1:], columns=rows[0])
+        else:
+            i += 1
+    return out
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="collect_existing_metrics", description=run.__doc__)
+    ap.add_argument("--metric_files", nargs="+", required=True)
+    ap.add_argument("--output_h5", required=True)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Collect metric files into one keyed h5."""
+    args = parse_args(argv)
+    mode = "w"
+    n = 0
+    for path in args.metric_files:
+        stem = os.path.basename(path).split(".")[0]
+        if path.endswith((".h5", ".hdf", ".hdf5")):
+            for key in list_keys(path):
+                write_hdf(read_hdf(path, key=key), args.output_h5, key=f"{stem}_{key}", mode=mode)
+                mode = "a"
+                n += 1
+        elif path.endswith(".csv"):
+            write_hdf(pd.read_csv(path), args.output_h5, key=stem, mode=mode)
+            mode = "a"
+            n += 1
+        else:  # picard .metrics / generic sectioned text
+            for section, df in read_picard_metrics(path).items():
+                write_hdf(df, args.output_h5, key=f"{stem}_{section}", mode=mode)
+                mode = "a"
+                n += 1
+    logger.info("%d tables -> %s", n, args.output_h5)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,50 @@
+"""convert_h5_to_json — dump every key of a metrics h5 as one JSON document.
+
+Reference surface: ugbio_core/convert_h5_to_json.py (setup.py:48; internals
+in the missing submodule). Output shape: {key: records-or-scalar-map}, the
+form the reference's report machinery feeds to external dashboards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import pandas as pd
+
+from variantcalling_tpu import logger
+
+
+def h5_to_dict(path: str, ignored_substrings: list[str] | None = None) -> dict:
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+    out: dict = {}
+    for name in list_keys(path):
+        if ignored_substrings and any(sub in name for sub in ignored_substrings):
+            continue
+        df = read_hdf(path, key=name)
+        out[name] = json.loads(df.to_json(orient="records"))
+    return out
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="convert_h5_to_json", description=run.__doc__)
+    ap.add_argument("--input_h5", required=True)
+    ap.add_argument("--output_json", required=True)
+    ap.add_argument("--ignored_h5_key_substring", nargs="*", default=None)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Convert a keyed metrics h5 into JSON."""
+    args = parse_args(argv)
+    data = h5_to_dict(args.input_h5, args.ignored_h5_key_substring)
+    with open(args.output_json, "w") as fh:
+        json.dump(data, fh, indent=2, default=str)
+    logger.info("%d keys -> %s", len(data), args.output_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,41 @@
+"""index_vcf_file — build the .tbi index for a BGZF VCF in-process.
+
+Reference surface: ugvc/bash/index_vcf_file.sh (bgzip+tabix subprocess).
+Here the index is written by io/tabix (no external binaries); plain-text
+inputs are BGZF-recompressed first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.bgzf import BgzfWriter
+from variantcalling_tpu.io.tabix import build_tabix_index
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="index_vcf_file", description=run.__doc__)
+    ap.add_argument("input", help="VCF (.vcf -> recompressed to .vcf.gz first, or .vcf.gz)")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """BGZF-compress (if needed) and tabix-index a VCF."""
+    args = parse_args(argv)
+    path = args.input
+    if not path.endswith(".gz"):
+        gz = path + ".gz"
+        with open(path, "rt") as src, BgzfWriter(gz) as dst:
+            for line in src:
+                dst.write(line)
+        path = gz
+    tbi = build_tabix_index(path)
+    logger.info("indexed %s -> %s", path, tbi)
+    print(tbi)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

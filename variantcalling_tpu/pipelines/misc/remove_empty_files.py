@@ -1,0 +1,37 @@
+"""remove_empty_files — delete zero-length (or header-only gz) files.
+
+Reference surface: ugvc/bash/remove_empty_files.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from variantcalling_tpu import logger
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="remove_empty_files", description=run.__doc__)
+    ap.add_argument("paths", nargs="+", help="files to check")
+    ap.add_argument("--dry_run", action="store_true")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Remove empty files from the argument list."""
+    args = parse_args(argv)
+    removed = 0
+    for p in args.paths:
+        if os.path.isfile(p) and os.path.getsize(p) == 0:
+            if not args.dry_run:
+                os.remove(p)
+            removed += 1
+            logger.info("removed empty file %s", p)
+    print(removed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,45 @@
+"""remove_vcf_duplicates — drop exact-duplicate VCF records.
+
+Reference surface: ugvc/bash/remove_vcf_duplicates.sh (awk/sort chain).
+Duplicates = same (CHROM, POS, REF, ALT); the first occurrence wins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="remove_vcf_duplicates", description=run.__doc__)
+    ap.add_argument("input", help="input VCF (.vcf/.vcf.gz)")
+    ap.add_argument("output", help="output VCF (.vcf/.vcf.gz)")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Remove duplicate records (same CHROM/POS/REF/ALT)."""
+    args = parse_args(argv)
+    table = read_vcf(args.input)
+    seen: set[tuple] = set()
+    keep = np.ones(len(table), dtype=bool)
+    for i in range(len(table)):
+        key = (table.chrom[i], int(table.pos[i]), table.ref[i], table.alt[i])
+        if key in seen:
+            keep[i] = False
+        else:
+            seen.add(key)
+    from variantcalling_tpu.pipelines.filter_variants import _subset
+
+    write_vcf(args.output, _subset(table, keep))
+    logger.info("%d records, %d duplicates removed -> %s", len(table), int((~keep).sum()), args.output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,51 @@
+"""sorter_to_h5 — aggregate Ultima sorter stats (csv + json) into a metrics h5.
+
+Reference surface: ugbio_core sorter_to_h5 (ugvc/__main__.py misc_modules;
+internals in the missing submodule). The sorter emits a per-metric csv
+(histogram-style: metric,value rows or key,count tables) and a json of
+scalar run statistics; both are keyed into one h5 the report loaders read
+(the de-facto metrics sink, SURVEY §5.5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="sorter_to_h5", description=run.__doc__)
+    ap.add_argument("--input_csv_file", required=True, help="sorter stats csv")
+    ap.add_argument("--input_json_file", required=True, help="sorter scalar stats json")
+    ap.add_argument("--metric_mapping_file", default=None,
+                    help="optional csv mapping sorter metric names -> report names")
+    ap.add_argument("--output_file", required=True, help="output h5")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Convert sorter csv+json stats into a keyed h5."""
+    args = parse_args(argv)
+    csv_df = pd.read_csv(args.input_csv_file)
+    with open(args.input_json_file) as fh:
+        scalars = json.load(fh)
+    if args.metric_mapping_file:
+        mapping = pd.read_csv(args.metric_mapping_file)
+        cols = {a: b for a, b in zip(mapping.iloc[:, 0], mapping.iloc[:, 1])}
+        csv_df = csv_df.rename(columns=cols)
+        scalars = {cols.get(k, k): v for k, v in scalars.items()}
+    flat = pd.json_normalize(scalars)
+    write_hdf(csv_df, args.output_file, key="stats", mode="w")
+    write_hdf(flat, args.output_file, key="scalar_stats", mode="a")
+    logger.info("sorter stats (%d rows, %d scalars) -> %s", len(csv_df), flat.shape[1], args.output_file)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

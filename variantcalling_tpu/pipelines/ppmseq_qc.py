@@ -1,0 +1,93 @@
+"""ppmseq_qc — ppmSeq strand-tag QC categorization.
+
+Reference surface: the ugbio_ppmseq package (setup.py:4-8). ppmSeq reads
+carry loop-adapter strand tags at both ends (BAM aux tags, default ``as``/
+``ae`` — start/end strand calls: MIXED / MINUS / PLUS / UNDETERMINED).
+This tool walks the BAM (native tag-decoding reader), cross-tabulates the
+start×end categories, and reports the headline ppmSeq QC rates (mixed-
+mixed fraction = usable duplex-like reads; undetermined rate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import Counter
+
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.bam import BamReader
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+CATEGORIES = ["MIXED", "MINUS", "PLUS", "UNDETERMINED", "END_UNREACHED", "MISSING"]
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="ppmseq_qc", description=run.__doc__)
+    ap.add_argument("--input_bam", required=True)
+    ap.add_argument("--output_h5", required=True)
+    ap.add_argument("--start_tag", default="as")
+    ap.add_argument("--end_tag", default="ae")
+    ap.add_argument("--max_reads", type=int, default=0, help="0 = all")
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def _norm(v) -> str:
+    if v is None:
+        return "MISSING"
+    s = str(v).upper()
+    return s if s in CATEGORIES else ("UNDETERMINED" if s else "MISSING")
+
+
+def categorize(bam_path: str, start_tag: str, end_tag: str, max_reads: int = 0) -> Counter:
+    counts: Counter = Counter()
+    with BamReader(bam_path, decode_tags=True) as bam:
+        for i, aln in enumerate(bam):
+            if max_reads and i >= max_reads:
+                break
+            tags = aln.tags or {}
+            counts[(_norm(tags.get(start_tag)), _norm(tags.get(end_tag)))] += 1
+    return counts
+
+
+def qc_tables(counts: Counter) -> tuple[pd.DataFrame, pd.DataFrame]:
+    cross = pd.DataFrame(0, index=CATEGORIES, columns=CATEGORIES)
+    for (s, e), n in counts.items():
+        cross.loc[s, e] = n
+    total = int(cross.to_numpy().sum())
+    mixed_mixed = int(cross.loc["MIXED", "MIXED"])
+    undet = int(cross.loc["UNDETERMINED"].sum() + cross["UNDETERMINED"].sum() - cross.loc["UNDETERMINED", "UNDETERMINED"])
+    summary = pd.DataFrame(
+        [
+            {
+                "total_reads": total,
+                "mixed_mixed": mixed_mixed,
+                "pct_mixed_mixed": round(mixed_mixed / total, 5) if total else 0.0,
+                "pct_undetermined": round(undet / total, 5) if total else 0.0,
+            }
+        ]
+    )
+    return cross, summary
+
+
+def run(argv) -> int:
+    """Cross-tabulate ppmSeq strand tags and write QC rates."""
+    args = parse_args(argv)
+    counts = categorize(args.input_bam, args.start_tag, args.end_tag, args.max_reads)
+    cross, summary = qc_tables(counts)
+    write_hdf(cross.reset_index().rename(columns={"index": "start_tag"}), args.output_h5,
+              key="strand_tag_crosstab", mode="w")
+    write_hdf(summary, args.output_h5, key="summary", mode="a")
+    logger.info(
+        "%d reads, %.1f%% mixed-mixed -> %s",
+        int(summary.iloc[0]["total_reads"]),
+        100 * summary.iloc[0]["pct_mixed_mixed"],
+        args.output_h5,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

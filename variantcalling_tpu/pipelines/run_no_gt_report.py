@@ -91,6 +91,25 @@ def run_somatic_analysis(args) -> None:
     sbs_path = f"{args.output_prefix}.SBS96.all"
     sbs.to_csv(sbs_path, sep="\t", index=False)
     logger.info("wrote SBS96 matrix: %s", sbs_path)
+    if getattr(args, "signatures_file", None):
+        # native device fitting: KL-NNLS against the provided catalog
+        from variantcalling_tpu.reports import signatures as sigmod
+
+        catalog = sigmod.load_signature_matrix(args.signatures_file)
+        catalog = catalog.reindex(labels).fillna(0.0)  # align channel order
+        exposures = sigmod.fit_signatures(snp_motifs.values[None, :], catalog.to_numpy())
+        exposures = sigmod.sparsify_exposures(exposures)
+        meta = (
+            sigmod.load_signature_metadata(args.signatures_metadata)
+            if getattr(args, "signatures_metadata", None)
+            else None
+        )
+        tbl = sigmod.assignment_table(
+            exposures, list(catalog.columns), meta, [args.output_prefix.split("/")[-1]]
+        )
+        write_hdf(tbl, f"{args.output_prefix}.h5", key="signature_exposures", mode="a")
+        logger.info("fitted %d active signatures (device NNLS)", int((exposures > 0).sum()))
+        return
     try:  # optional external signature assignment (reference :334-595)
         from SigProfilerAssignment import Analyzer as Analyze  # type: ignore
 
@@ -101,7 +120,9 @@ def run_somatic_analysis(args) -> None:
             cosmic_version=float(args.cosmic_version),
         )
     except ImportError:
-        logger.warning("SigProfilerAssignment not installed; skipping signature fitting")
+        logger.warning(
+            "SigProfilerAssignment not installed and no --signatures_file given; skipping fitting"
+        )
 
 
 def run(argv: list[str]) -> int:
@@ -134,6 +155,10 @@ def run(argv: list[str]) -> int:
     som.add_argument("--reference_name", type=str, default="GRCh38")
     som.add_argument("--output_prefix", required=True)
     som.add_argument("--cosmic_version", type=str, default="3.3")
+    som.add_argument("--signatures_file", default=None,
+                     help="COSMIC-style signature matrix (tsv) -> native device NNLS fitting")
+    som.add_argument("--signatures_metadata", default=None,
+                     help="cosmic_signatures json (descriptions/links) for annotation")
     som.set_defaults(func=run_somatic_analysis)
 
     args = ap.parse_args(argv)

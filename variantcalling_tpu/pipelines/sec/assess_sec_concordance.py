@@ -1,0 +1,106 @@
+"""assess_sec_concordance — quantify the accuracy effect of SEC correction.
+
+Reference surface: ugbio_filtering sec assess_sec_concordance (packaged at
+setup.py:41-46; internals missing — behavior re-derived from the
+report-side contract, report_utils.py:71-75: variants whose blacklist
+contains "SEC" are re-filtered, turning SEC-corrected TPs into FNs and
+dropping SEC-corrected FPs). Given a concordance dataframe (run_comparison
+h5) and the SEC-corrected callset, this tool recomputes accuracy metrics
+with and without the SEC re-filter and reports the delta per category:
+how many false positives SEC removed vs how many true positives it cost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.utils.h5_utils import read_hdf
+
+SEC = "SEC"
+
+
+def parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(prog="assess_sec_concordance", description=run.__doc__)
+    ap.add_argument("--concordance_h5", required=True, help="run_comparison_pipeline output h5")
+    ap.add_argument("--hdf_key", default="all")
+    ap.add_argument("--corrected_vcf", required=True, help="SEC-corrected callset (correct_systematic_errors)")
+    ap.add_argument("--output_file", required=True, help="assessment h5 (keys: with_sec, without_sec, delta)")
+    ap.add_argument("--classify_column", default="classify")
+    ap.add_argument("--ignore_filters", nargs="*", default=["HPOL_RUN"])
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def mark_sec_from_vcf(df: pd.DataFrame, corrected_vcf: str) -> np.ndarray:
+    """Bool per concordance row: locus carries SEC in the corrected callset."""
+    table = read_vcf(corrected_vcf)
+    sec_loci = {
+        (c, int(p))
+        for c, p, f in zip(table.chrom, table.pos, table.filters)
+        if f and SEC in str(f).split(";")
+    }
+    chrom = df["chrom"].astype(str).to_numpy()
+    pos = df["pos"].to_numpy()
+    return np.fromiter(((c, int(p)) in sec_loci for c, p in zip(chrom, pos)), dtype=bool, count=len(df))
+
+
+def apply_sec_refilter(df: pd.DataFrame, is_sec: np.ndarray, classify_column: str) -> pd.DataFrame:
+    """The report-side SEC semantics (report_utils.py:71-75): corrected TPs
+    become FNs (the call is suppressed but truth remains); corrected FPs
+    are dropped from the callset."""
+    out = df.copy()
+    cls = out[classify_column].astype(str).to_numpy().copy()
+    drop = is_sec & (cls == "fp")
+    cls[is_sec & (cls == "tp")] = "fn"
+    out[classify_column] = cls
+    return out.loc[~drop]
+
+
+def assess(
+    df: pd.DataFrame, is_sec: np.ndarray, classify_column: str, ignore_filters: list[str]
+) -> dict[str, pd.DataFrame]:
+    before = calc_accuracy_metrics(df, classify_column, ignore_filters)
+    after = calc_accuracy_metrics(apply_sec_refilter(df, is_sec, classify_column), classify_column, ignore_filters)
+    merged = before.merge(after, on="group", suffixes=("_before", "_after"))
+    delta = pd.DataFrame(
+        {
+            "group": merged["group"],
+            "fp_removed": merged["fp_before"] - merged["fp_after"],
+            "tp_lost": merged["tp_before"] - merged["tp_after"],
+            "precision_delta": merged["precision_after"] - merged["precision_before"],
+            "recall_delta": merged["recall_after"] - merged["recall_before"],
+            "f1_delta": merged["f1_after"] - merged["f1_before"],
+        }
+    )
+    return {"without_sec": before, "with_sec": after, "delta": delta}
+
+
+def run(argv: list[str]) -> int:
+    """Assess SEC correction against ground-truth concordance."""
+    args = parse_args(argv)
+    df = read_hdf(args.concordance_h5, key=args.hdf_key)
+    is_sec = mark_sec_from_vcf(df, args.corrected_vcf)
+    results = assess(df, is_sec, args.classify_column, args.ignore_filters)
+    from variantcalling_tpu.utils.h5_utils import write_hdf
+
+    for i, (key, frame) in enumerate(results.items()):
+        write_hdf(frame, args.output_file, key=key, mode="a" if i else "w")
+    d = results["delta"]
+    logger.info(
+        "SEC effect: removed %d FPs, lost %d TPs -> %s",
+        int(d["fp_removed"].sum()),
+        int(d["tp_lost"].sum()),
+        args.output_file,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

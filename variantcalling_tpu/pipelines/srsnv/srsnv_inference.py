@@ -1,0 +1,68 @@
+"""srsnv_inference — annotate a featuremap with per-read SNV qualities.
+
+Reference surface: ugbio_srsnv inference (setup.py:4-8). Scores every
+supporting read with the trained GBT (same device kernels as
+filter_variants: GEMM encoding on TPU, gather walk on CPU) and writes the
+featuremap VCF back with ``ML_QUAL`` (phred of the model probability)
+in INFO — the quantity MRD analyses threshold on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+import jax
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.featuremap import featuremap_to_dataframe
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+from variantcalling_tpu.models import registry
+from variantcalling_tpu.models.forest import make_predictor, with_feature_order
+from variantcalling_tpu.pipelines.srsnv.srsnv_training import MODEL_NAME
+
+MAX_PHRED = 60.0
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="srsnv_inference", description=run.__doc__)
+    ap.add_argument("--featuremap", required=True)
+    ap.add_argument("--model", required=True, help="srsnv_training output pkl")
+    ap.add_argument("--model_name", default=MODEL_NAME)
+    ap.add_argument("--output_featuremap", required=True)
+    ap.add_argument("--reference", default=None)
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Score a featuremap's reads with the single-read SNV model."""
+    args = parse_args(argv)
+    model = registry.load_model(args.model, args.model_name)
+    feats = model.feature_names
+    df = featuremap_to_dataframe(args.featuremap, ref_fasta=args.reference)
+    missing = [f for f in feats if f not in df.columns]
+    if missing:
+        raise SystemExit(f"featuremap lacks model features {missing}")
+    x = np.nan_to_num(df[feats].to_numpy(np.float32))
+    model = with_feature_order(model, feats)
+    scores = np.asarray(jax.jit(make_predictor(model, len(feats)))(x))
+    p_err = np.clip(1.0 - scores, 10 ** (-MAX_PHRED / 10), 1.0)
+    ml_qual = np.minimum(-10.0 * np.log10(p_err), MAX_PHRED)
+
+    table = read_vcf(args.featuremap)
+    table.header.ensure_info("ML_QUAL", "1", "Float", "Single-read SNV model quality (phred)")
+    write_vcf(args.output_featuremap, table, extra_info={"ML_QUAL": np.round(ml_qual, 2)})
+    logger.info(
+        "scored %d reads (median ML_QUAL %.1f) -> %s",
+        len(table),
+        float(np.median(ml_qual)) if len(table) else 0.0,
+        args.output_featuremap,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,90 @@
+"""srsnv_training — train the single-read SNV quality model.
+
+Reference surface: the ugbio_srsnv package (setup.py:4-8; "single-read
+SNV" — reference trains an xgboost classifier on featuremap per-read
+features separating true variant reads (TP featuremap, high-AF loci) from
+error reads (FP featuremap, low-AF artifact loci)). Here training is the
+framework's histogram-GBT (models/boosting): binning, gradient/hessian
+histograms, and the full tree loop run as one jitted device program; the
+fitted model saves through models/registry and scores via the same
+forest kernels as filter_variants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.featuremap import featuremap_to_dataframe, numeric_feature_columns
+from variantcalling_tpu.models import registry
+from variantcalling_tpu.models.boosting import BoostConfig, fit
+
+# featuremap_to_dataframe lowercases INFO keys into column names
+DEFAULT_FEATURES = ["x_score", "x_edist", "x_length", "x_mapq", "x_index", "rq"]
+MODEL_NAME = "srsnv_model"
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="srsnv_training", description=run.__doc__)
+    ap.add_argument("--tp_featuremap", required=True, help="featuremap of true-variant supporting reads")
+    ap.add_argument("--fp_featuremap", required=True, help="featuremap of error reads")
+    ap.add_argument("--reference", default=None, help="FASTA for motif columns")
+    ap.add_argument("--output_model", required=True, help="output model pkl")
+    ap.add_argument("--features", nargs="*", default=None, help="feature columns (default: measured set)")
+    ap.add_argument("--n_trees", type=int, default=100)
+    ap.add_argument("--max_depth", type=int, default=6)
+    ap.add_argument("--learning_rate", type=float, default=0.15)
+    ap.add_argument("--train_fraction", type=float, default=0.8)
+    ap.add_argument("--random_seed", type=int, default=0)
+    ap.add_argument("--verbosity", default="INFO")
+    return ap.parse_args(argv)
+
+
+def build_training_frame(tp_fm: str, fp_fm: str, reference: str | None, features: list[str] | None):
+    tp = featuremap_to_dataframe(tp_fm, ref_fasta=reference)
+    fp = featuremap_to_dataframe(fp_fm, ref_fasta=reference)
+    feats = features or [f for f in DEFAULT_FEATURES if f in tp.columns and f in fp.columns]
+    if not feats:
+        feats = sorted(set(numeric_feature_columns(tp)) & set(numeric_feature_columns(fp)))
+    x = np.concatenate([tp[feats].to_numpy(np.float32), fp[feats].to_numpy(np.float32)])
+    y = np.concatenate([np.ones(len(tp)), np.zeros(len(fp))]).astype(np.float32)
+    return np.nan_to_num(x), y, feats
+
+
+def run(argv) -> int:
+    """Train the single-read SNV quality GBT on device."""
+    args = parse_args(argv)
+    x, y, feats = build_training_frame(args.tp_featuremap, args.fp_featuremap, args.reference, args.features)
+    rng = np.random.default_rng(args.random_seed)
+    order = rng.permutation(len(y))
+    n_train = int(len(y) * args.train_fraction)
+    tr, te = order[:n_train], order[n_train:]
+    cfg = BoostConfig(n_trees=args.n_trees, depth=args.max_depth, learning_rate=args.learning_rate)
+    model = fit(x[tr], y[tr], cfg=cfg, feature_names=feats)
+    from variantcalling_tpu.models.forest import predict_score
+
+    if len(te):
+        s = np.asarray(predict_score(model, x[te]))
+        auc = _auc(y[te], s)
+        logger.info("held-out AUC = %.4f (%d reads)", auc, len(te))
+    registry.save_models(args.output_model, {MODEL_NAME: model})
+    logger.info("srsnv model (%d trees on %s) -> %s", args.n_trees, feats, args.output_model)
+    return 0
+
+
+def _auc(y: np.ndarray, s: np.ndarray) -> float:
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    n_pos = int(y.sum())
+    n_neg = len(y) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    return float((ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,111 @@
+"""substitution_error_rate_report — per-motif substitution error report.
+
+Reference surface: ugvc/reports/substitution_error_rate_report.ipynb:
+reads an error-rate h5 (key ``motif_1``: per-motif error counts/rates from
+the featuremap substitution analysis), folds forward/reverse-complement
+strands into matched rows, and reports error rate by mutation type +
+strand asymmetry. The folding uses the same 96-channel machinery as the
+no-GT SNP motif stats (reports/no_gt_stats).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+_COMP = {"A": "T", "C": "G", "G": "C", "T": "A"}
+
+
+def revcomp(seq: str) -> str:
+    return "".join(_COMP.get(b, "N") for b in reversed(seq))
+
+
+def fold_strands(df: pd.DataFrame) -> pd.DataFrame:
+    """Match each (ref, alt, left_motif, right_motif) row with its reverse
+    complement; emits one row per canonical pyrimidine-ref channel with
+    forward/reverse counts and the asymmetry ratio."""
+    need = {"ref", "alt", "left_motif", "right_motif"}
+    if not need.issubset(df.columns):
+        raise ValueError(f"motif table missing columns {sorted(need - set(df.columns))}")
+    count_col = next((c for c in ("n_errors", "count", "n") if c in df.columns), None)
+    base_col = next((c for c in ("n_bases", "coverage", "total") if c in df.columns), None)
+    keyed = {}
+    for _, row in df.iterrows():
+        key = (row["ref"], row["alt"], row["left_motif"], row["right_motif"])
+        keyed[key] = row
+    rows = []
+    seen = set()
+    for key, row in keyed.items():
+        ref, alt, left, right = key
+        rc_key = (_COMP.get(ref, "N"), _COMP.get(alt, "N"), revcomp(right), revcomp(left))
+        canon = key if ref in ("C", "T") else rc_key
+        if canon in seen:
+            continue
+        seen.add(canon)
+        fwd = keyed.get(canon)
+        rev = keyed.get(
+            (_COMP.get(canon[0], "N"), _COMP.get(canon[1], "N"), revcomp(canon[3]), revcomp(canon[2]))
+        )
+        out = {
+            "ref": canon[0],
+            "alt": canon[1],
+            "left_motif": canon[2],
+            "right_motif": canon[3],
+            "mut_type": f"{canon[0]}>{canon[1]}",
+        }
+        for tag, r in (("fwd", fwd), ("rev", rev)):
+            out[f"{tag}_errors"] = float(r[count_col]) if r is not None and count_col else np.nan
+            out[f"{tag}_bases"] = float(r[base_col]) if r is not None and base_col else np.nan
+        if count_col and base_col:
+            out["fwd_rate"] = out["fwd_errors"] / max(out["fwd_bases"], 1.0)
+            out["rev_rate"] = out["rev_errors"] / max(out["rev_bases"], 1.0)
+            out["asymmetry"] = out["fwd_rate"] / max(out["rev_rate"], 1e-12)
+        rows.append(out)
+    return pd.DataFrame(rows)
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="substitution_error_rate_report", description=run.__doc__)
+    ap.add_argument("--h5_substitution_error_rate", required=True)
+    ap.add_argument("--motif_key", default="motif_1")
+    ap.add_argument("--h5_output", default="substitution_error_rate_report.h5")
+    ap.add_argument("--html_output", default=None)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Fold strands and summarize substitution error rates."""
+    args = parse_args(argv)
+    df = read_hdf(args.h5_substitution_error_rate, key=args.motif_key)
+    folded = fold_strands(df)
+    by_type = (
+        folded.groupby("mut_type")[[c for c in ("fwd_errors", "rev_errors", "fwd_bases", "rev_bases") if c in folded]]
+        .sum()
+        .reset_index()
+    )
+    if {"fwd_errors", "fwd_bases"}.issubset(by_type.columns):
+        tot_err = by_type["fwd_errors"] + by_type["rev_errors"]
+        tot_bases = (by_type["fwd_bases"] + by_type["rev_bases"]).clip(lower=1.0)
+        by_type["error_rate"] = tot_err / tot_bases
+    write_hdf(folded, args.h5_output, key="folded_motifs", mode="w")
+    write_hdf(by_type, args.h5_output, key="by_mut_type", mode="a")
+    rep = HtmlReport("Substitution Error Rate Report")
+    rep.add_section("Error rate by mutation type")
+    rep.add_table(by_type)
+    rep.add_section("Folded motif table (head)")
+    rep.add_table(folded.head(50))
+    if args.html_output:
+        rep.write(args.html_output)
+    logger.info("substitution error report: %d folded motifs -> %s", len(folded), args.h5_output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

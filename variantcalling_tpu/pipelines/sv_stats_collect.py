@@ -93,8 +93,10 @@ def collect_sv_stats(
     concordance_stats: dict = {}
     fp_stats = pd.Series(dtype="int64")
     if concordance_h5 is not None:
-        df_base = pd.read_hdf(concordance_h5, key="base")
-        df_calls = pd.read_hdf(concordance_h5, key="calls")
+        from variantcalling_tpu.utils.h5_utils import read_hdf
+
+        df_base = read_hdf(concordance_h5, key="base")
+        df_calls = read_hdf(concordance_h5, key="calls")
         for df in (df_base, df_calls):
             df["binned_svlens"] = pd.cut(df["svlen_int"].abs(), bins=SVBINS, labels=SVLABELS, right=False)
 

@@ -1,0 +1,42 @@
+"""intersect_bed_regions — intersect N BED files into one merged BED.
+
+Reference surface: ugbio_core.vcfbed intersect_bed_regions
+(ugvc/__main__.py vcfbed_modules; internals in the missing submodule —
+the reference otherwise shells out to ``bedtools intersect``). Here the
+intersection is the sorted-interval sweep from io/bed.IntervalSet (the
+same host kernels the annotation join uses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.bed import read_bed, write_bed
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="intersect_bed_regions", description=run.__doc__)
+    ap.add_argument("--include-regions", nargs="+", required=True, help="BEDs to intersect")
+    ap.add_argument("--exclude-regions", nargs="*", default=None, help="BEDs to subtract")
+    ap.add_argument("--output-bed", required=True)
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Intersect (and optionally subtract) BED files."""
+    args = parse_args(argv)
+    acc = read_bed(args.include_regions[0]).merged()
+    for path in args.include_regions[1:]:
+        acc = acc.intersect(read_bed(path).merged())
+    if args.exclude_regions:
+        for path in args.exclude_regions:
+            acc = acc.subtract(read_bed(path).merged())
+    write_bed(args.output_bed, acc)
+    logger.info("%d intervals (%d bp) -> %s", len(acc), acc.total_length(), args.output_bed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,66 @@
+"""Minimal self-contained HTML report writer (shared by the report tools).
+
+The reference renders reports by papermill-executing notebooks and
+nbconvert-ing to HTML (test_vc_report.py:15-26). These generators emit the
+same artifact — titled sections of tables and inline images — without a
+notebook runtime.
+"""
+
+from __future__ import annotations
+
+import base64
+import html as _html
+import io
+
+import pandas as pd
+
+_STYLE = """
+body { font-family: -apple-system, Segoe UI, sans-serif; margin: 2em; color: #222; }
+h1 { border-bottom: 2px solid #444; padding-bottom: .2em; }
+h2 { margin-top: 1.6em; color: #333; }
+table { border-collapse: collapse; margin: .8em 0; font-size: .92em; }
+th, td { border: 1px solid #bbb; padding: .3em .7em; text-align: right; }
+th { background: #f0f0f0; }
+td:first-child, th:first-child { text-align: left; }
+img { max-width: 100%; }
+.param { color: #666; font-size: .9em; }
+"""
+
+
+class HtmlReport:
+    def __init__(self, title: str):
+        self.title = title
+        self.parts: list[str] = []
+
+    def add_params(self, params: dict) -> None:
+        rows = "".join(
+            f"<tr><td>{_html.escape(str(k))}</td><td>{_html.escape(str(v))}</td></tr>"
+            for k, v in params.items()
+        )
+        self.parts.append(f'<table class="param"><tr><th>parameter</th><th>value</th></tr>{rows}</table>')
+
+    def add_section(self, heading: str) -> None:
+        self.parts.append(f"<h2>{_html.escape(heading)}</h2>")
+
+    def add_table(self, df: pd.DataFrame, float_fmt: str = "{:,.4g}") -> None:
+        self.parts.append(df.to_html(float_format=lambda x: float_fmt.format(x), border=0))
+
+    def add_text(self, text: str) -> None:
+        self.parts.append(f"<p>{_html.escape(text)}</p>")
+
+    def add_figure(self, fig) -> None:
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", bbox_inches="tight", dpi=110)
+        b64 = base64.b64encode(buf.getvalue()).decode()
+        self.parts.append(f'<img src="data:image/png;base64,{b64}"/>')
+
+    def write(self, path: str) -> str:
+        doc = (
+            f"<html><head><meta charset='utf-8'><title>{_html.escape(self.title)}</title>"
+            f"<style>{_STYLE}</style></head><body><h1>{_html.escape(self.title)}</h1>"
+            + "".join(self.parts)
+            + "</body></html>"
+        )
+        with open(path, "w") as fh:
+            fh.write(doc)
+        return path

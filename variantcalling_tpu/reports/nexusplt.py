@@ -1,0 +1,72 @@
+"""Figure saver: png / html / json export (reference ugvc/reports/nexusplt.py:41-89).
+
+The reference saves matplotlib figures as png, mpld3 html, and mpld3 json.
+mpld3 is not in this image, so html embeds the png (self-contained report
+fragment) and json serializes the axes data (lines/labels/limits) — enough
+for downstream dashboards to re-plot.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+
+
+def save(fig, name: str, outdir: str = ".", formats: tuple[str, ...] = ("png",)) -> list[str]:
+    """Save a matplotlib figure under each format; returns written paths."""
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for fmt in formats:
+        path = os.path.join(outdir, f"{name}.{fmt}")
+        if fmt == "png":
+            fig.savefig(path, format="png", bbox_inches="tight", dpi=120)
+        elif fmt == "html":
+            buf = io.BytesIO()
+            fig.savefig(buf, format="png", bbox_inches="tight", dpi=120)
+            b64 = base64.b64encode(buf.getvalue()).decode()
+            with open(path, "w") as fh:
+                fh.write(
+                    f'<html><body><img alt="{name}" '
+                    f'src="data:image/png;base64,{b64}"/></body></html>'
+                )
+        elif fmt == "json":
+            with open(path, "w") as fh:
+                json.dump(_fig_to_dict(fig), fh)
+        else:
+            raise ValueError(f"unknown format {fmt!r}")
+        written.append(path)
+    return written
+
+
+def save_all(figures: dict, outdir: str = ".", formats: tuple[str, ...] = ("png",)) -> list[str]:
+    """Save {name: figure}; returns all written paths."""
+    out = []
+    for name, fig in figures.items():
+        out.extend(save(fig, name, outdir, formats))
+    return out
+
+
+def _fig_to_dict(fig) -> dict:
+    axes_out = []
+    for ax in fig.get_axes():
+        lines = [
+            {
+                "label": ln.get_label(),
+                "x": [float(v) for v in ln.get_xdata()],
+                "y": [float(v) for v in ln.get_ydata()],
+            }
+            for ln in ax.get_lines()
+        ]
+        axes_out.append(
+            {
+                "title": ax.get_title(),
+                "xlabel": ax.get_xlabel(),
+                "ylabel": ax.get_ylabel(),
+                "xlim": [float(v) for v in ax.get_xlim()],
+                "ylim": [float(v) for v in ax.get_ylim()],
+                "lines": lines,
+            }
+        )
+    return {"axes": axes_out}

@@ -1,0 +1,151 @@
+"""Mutational-signature fitting and extraction as device kernels.
+
+The reference assigns somatic mutational signatures by subprocessing
+SigProfilerAssignment/SigProfilerMatrixGenerator (run_no_gt_report.py:
+334-595) and annotates results from a COSMIC metadata json
+(test resource somatic_test.cosmic_signatures_v3.3.json — descriptions/
+links only; the 96-channel definitions ship separately as a COSMIC tsv).
+This module replaces the external fitters with JAX kernels:
+
+- :func:`fit_signatures` — known-signature assignment: non-negative
+  least squares on the 96-channel SBS counts via multiplicative updates
+  (Lee–Seung, KL objective — the same family SigProfiler uses), jitted,
+  batched over samples.
+- :func:`extract_signatures` — de-novo extraction: KL-NMF with
+  multiplicative updates over a (samples, 96) matrix.
+- :func:`cosine_similarity_matrix` — match extracted signatures to a
+  reference catalog.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pandas as pd
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def load_signature_matrix(path: str) -> pd.DataFrame:
+    """COSMIC-style signature definitions: rows = 96 contexts, cols = signatures.
+
+    Accepts the COSMIC tsv/csv layout (first column 'Type' like 'A[C>A]A')."""
+    sep = "\t" if path.endswith((".tsv", ".txt")) else ","
+    df = pd.read_csv(path, sep=sep)
+    df = df.set_index(df.columns[0])
+    return df
+
+
+def load_signature_metadata(path: str) -> dict[str, dict]:
+    """The reference's cosmic_signatures json: {SBS1: {description, link}}."""
+    with open(path) as fh:
+        return json.load(fh)
+
+
+@jax.jit
+def _nnls_kl_updates(exposures: jnp.ndarray, sigs: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
+    """One multiplicative KL update: e <- e * (S^T (c / (S e))) / (S^T 1)."""
+    recon = sigs @ exposures + _EPS  # (96,) per sample via vmap
+    ratio = counts / recon
+    num = sigs.T @ ratio
+    den = jnp.sum(sigs, axis=0) + _EPS
+    return exposures * num / den
+
+
+def fit_signatures(
+    counts: np.ndarray, signatures: np.ndarray, n_iter: int = 500
+) -> np.ndarray:
+    """Exposures (S, K) explaining counts (S, 96) with signatures (96, K).
+
+    Batched over samples with vmap; the whole iteration runs as one jitted
+    lax.fori_loop on device.
+    """
+    counts = jnp.asarray(np.atleast_2d(counts), dtype=jnp.float32)
+    sigs = jnp.asarray(signatures, dtype=jnp.float32)
+    sigs = sigs / jnp.maximum(sigs.sum(axis=0, keepdims=True), _EPS)  # column-stochastic
+    k = sigs.shape[1]
+
+    def fit_one(c):
+        e0 = jnp.full((k,), jnp.maximum(c.sum(), 1.0) / k, dtype=jnp.float32)
+        return jax.lax.fori_loop(0, n_iter, lambda _, e: _nnls_kl_updates(e, sigs, c), e0)
+
+    out = jax.vmap(fit_one)(counts)
+    return np.asarray(out)
+
+
+def sparsify_exposures(exposures: np.ndarray, min_fraction: float = 0.03) -> np.ndarray:
+    """Zero signatures contributing < min_fraction of a sample's mutations
+    (SigProfilerAssignment's sparsity heuristic)."""
+    total = exposures.sum(axis=1, keepdims=True)
+    frac = exposures / np.maximum(total, _EPS)
+    return np.where(frac >= min_fraction, exposures, 0.0)
+
+
+def extract_signatures(
+    counts: np.ndarray, n_signatures: int, n_iter: int = 2000, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """De-novo KL-NMF: counts (S, 96) ~= exposures (S, K) @ sigs.T (K, 96).
+
+    Returns (signatures (96, K) column-normalized, exposures (S, K)).
+    """
+    c = jnp.asarray(np.atleast_2d(counts), dtype=jnp.float32).T  # (96, S)
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    w0 = jax.random.uniform(k1, (c.shape[0], n_signatures), minval=0.1, maxval=1.0)
+    h0 = jax.random.uniform(k2, (n_signatures, c.shape[1]), minval=0.1, maxval=1.0)
+
+    def step(_, wh):
+        w, h = wh
+        recon = w @ h + _EPS
+        h = h * (w.T @ (c / recon)) / (jnp.sum(w, axis=0)[:, None] + _EPS)
+        recon = w @ h + _EPS
+        w = w * ((c / recon) @ h.T) / (jnp.sum(h, axis=1)[None, :] + _EPS)
+        return w, h
+
+    w, h = jax.lax.fori_loop(0, n_iter, step, (w0, h0))
+    w = np.asarray(w)
+    h = np.asarray(h)
+    scale = w.sum(axis=0)
+    w = w / np.maximum(scale, _EPS)
+    h = h * scale[:, None]
+    return w, h.T
+
+
+def cosine_similarity_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(Ka, Kb) cosine similarities between signature columns."""
+    an = a / np.maximum(np.linalg.norm(a, axis=0, keepdims=True), _EPS)
+    bn = b / np.maximum(np.linalg.norm(b, axis=0, keepdims=True), _EPS)
+    return an.T @ bn
+
+
+def assignment_table(
+    exposures: np.ndarray,
+    signature_names: list[str],
+    metadata: dict[str, dict] | None = None,
+    sample_names: list[str] | None = None,
+) -> pd.DataFrame:
+    """Long-form exposures with optional COSMIC metadata annotation."""
+    exposures = np.atleast_2d(exposures)
+    samples = sample_names or [f"sample{i}" for i in range(exposures.shape[0])]
+    rows = []
+    for si, sample in enumerate(samples):
+        total = exposures[si].sum()
+        for ki, name in enumerate(signature_names):
+            if exposures[si, ki] <= 0:
+                continue
+            row = {
+                "sample": sample,
+                "signature": name,
+                "mutations": float(exposures[si, ki]),
+                "fraction": float(exposures[si, ki] / max(total, _EPS)),
+            }
+            if metadata and name in metadata:
+                row["description"] = metadata[name].get(
+                    "description", metadata[name].get("descprition", "")
+                )
+            rows.append(row)
+    return pd.DataFrame(rows).sort_values(["sample", "mutations"], ascending=[True, False]).reset_index(drop=True)

@@ -1,0 +1,61 @@
+"""Cloud object localization (gs:// s3:// -> local files).
+
+Reference surface: ugbio_cloud_utils.cloud_sync / optional_cloud_sync
+(imported at coverage_analysis.py:46, quick_fingerprinter.py:6; internals
+in the missing submodule). Local paths pass through untouched; remote URIs
+are localized into a cache directory via the gsutil/gcloud/aws CLIs when
+present. This framework runs in zero-egress environments, so failure modes
+are explicit: ``cloud_sync`` raises, ``optional_cloud_sync`` returns the
+URI unchanged (callers that can stream it themselves may still proceed).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".cache", "vctpu_cloud")
+
+
+def is_remote(path: str) -> bool:
+    return str(path).startswith(("gs://", "s3://"))
+
+
+def _local_target(uri: str, cache_dir: str) -> str:
+    scheme, rest = uri.split("://", 1)
+    return os.path.join(cache_dir, scheme, rest)
+
+
+DOWNLOAD_TIMEOUT_S = int(os.environ.get("VCTPU_CLOUD_TIMEOUT", "600"))
+
+
+def cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE, force: bool = False) -> str:
+    """Localize a gs:// or s3:// object; local paths pass through."""
+    if not is_remote(uri):
+        return uri
+    target = _local_target(uri, cache_dir)
+    if os.path.exists(target) and not force:
+        return target
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    tmp = target + ".part"
+    if uri.startswith("gs://"):
+        cmds = [["gsutil", "-q", "cp", uri, tmp], ["gcloud", "storage", "cp", uri, tmp]]
+    else:
+        cmds = [["aws", "s3", "cp", "--quiet", uri, tmp]]
+    last_err: Exception | None = None
+    for cmd in cmds:
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=DOWNLOAD_TIMEOUT_S)
+            os.replace(tmp, target)
+            return target
+        except (OSError, subprocess.SubprocessError) as e:  # tool missing / copy failed / hung
+            last_err = e
+    raise RuntimeError(f"could not localize {uri}: no working cloud CLI ({last_err})")
+
+
+def optional_cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE) -> str:
+    """cloud_sync that degrades to returning the URI unchanged."""
+    try:
+        return cloud_sync(uri, cache_dir)
+    except RuntimeError:
+        return uri

@@ -1,0 +1,109 @@
+"""Tracing/profiling: per-stage wall-clock + JAX device profiler, first-class.
+
+The reference's only profiling primitive is an (unused, buggy — it prints
+t_start - t_end, a negative duration) wall-clock decorator
+(ugvc/utils/decorators.py:4-14) plus simppl's command echo. SURVEY §5.1
+makes tracing first-class here:
+
+- ``stage(name)`` / ``@timed``: nested wall-clock spans collected into a
+  process-global table every pipeline can dump (``report()``), enabled by
+  default (near-zero overhead), logged at DEBUG.
+- ``device_trace(logdir)``: context manager around ``jax.profiler`` —
+  captures an XLA trace (HLO timelines, fusion views) viewable in
+  TensorBoard/Perfetto; no-op if profiling is unavailable.
+- ``VCTPU_TRACE=1`` env makes every ``stage`` span print as it closes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import time
+from dataclasses import dataclass, field
+
+from variantcalling_tpu import logger
+
+
+@dataclass
+class Span:
+    name: str
+    seconds: float
+    depth: int
+
+
+@dataclass
+class _Tracer:
+    spans: list[Span] = field(default_factory=list)
+    _depth: int = 0
+
+    def clear(self) -> None:
+        self.spans.clear()
+
+    def report(self) -> str:
+        lines = ["stage timings:"]
+        for s in self.spans:
+            lines.append(f"  {'  ' * s.depth}{s.name}: {s.seconds:.3f}s")
+        return "\n".join(lines)
+
+
+TRACER = _Tracer()
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """Nested wall-clock span; spans land in TRACER.spans in close order."""
+    TRACER._depth += 1
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        TRACER._depth -= 1
+        TRACER.spans.append(Span(name, dt, TRACER._depth))
+        if os.environ.get("VCTPU_TRACE"):
+            logger.info("stage %s: %.3fs", name, dt)
+        else:
+            logger.debug("stage %s: %.3fs", name, dt)
+
+
+def timed(fn=None, *, name: str | None = None):
+    """Decorator form of ``stage`` (fixes the reference's negative-duration timer)."""
+
+    def deco(f):
+        label = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with stage(label):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def report() -> str:
+    return TRACER.report()
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a JAX/XLA device trace into ``logdir`` (TensorBoard-viewable)."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # profiling unsupported on this backend/build
+        logger.warning("device trace unavailable: %s", e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                logger.info("device trace written to %s", logdir)
+            except Exception as e:
+                logger.warning("device trace stop failed: %s", e)
