@@ -1,0 +1,107 @@
+"""find_adapter_coords tests: adapter localization + tag passthrough."""
+
+import numpy as np
+
+from tests.fixtures import write_bam
+from variantcalling_tpu.io.bam import BamReader
+
+
+def _run(tmp_path, reads_seqs, **kw):
+    from variantcalling_tpu.pipelines import find_adapter_coords as fac
+
+    reads = [
+        {"contig": "chr1", "pos": 10 * i, "cigar": [("M", len(s))], "seq": s}
+        for i, s in enumerate(reads_seqs)
+    ]
+    src = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    write_bam(src, {"chr1": 100000}, reads)
+    argv = ["--input_bam", src, "--output_bam", out]
+    for k, v in kw.items():
+        argv += [f"--{k}", str(v)]
+    assert fac.run(argv) == 0
+    tagged = []
+    with BamReader(out, decode_tags=True) as bam:
+        for aln in bam:
+            tagged.append(aln.tags)
+    return tagged
+
+
+def test_adapter_coords_basic(tmp_path, rng):
+    left = "TTTACACGACGCTC"
+    right = "AGATCGGAAGAGC"
+    insert = "".join(rng.choice(list("ACGT"), 40))
+    seqs = [
+        left + insert + right + "CCCC",  # both adapters
+        insert + right,                   # 3' only, at end
+        left + insert,                    # 5' only
+        insert,                           # neither
+    ]
+    tags = _run(
+        tmp_path, seqs,
+        left_adapter=left, right_adapter=right,
+        min_overlap_5p=5, min_overlap_3p=5,
+    )
+    # read 0: XF = len(left)+1, XT = 1-based start of right adapter
+    assert tags[0]["XF"] == len(left) + 1
+    assert tags[0]["XT"] == len(left) + len(insert) + 1
+    # read 1: no 5' -> XF=1; right adapter at insert end
+    assert tags[1]["XF"] == 1
+    assert tags[1]["XT"] == len(insert) + 1
+    # read 2: no 3' -> XT = len+1
+    assert tags[2]["XF"] == len(left) + 1
+    assert tags[2]["XT"] == len(seqs[2]) + 1
+    # read 3: neither
+    assert tags[3]["XF"] == 1 and tags[3]["XT"] == len(insert) + 1
+
+
+def test_adapter_umis(tmp_path, rng):
+    left = "ACACGACGCTCTTC"
+    right = "AGATCGGAAGAGC"
+    umi1 = "ACGTA"
+    umi2 = "TTGCA"
+    insert = "".join(rng.choice(list("ACGT"), 30))
+    seq = left + umi1 + insert + umi2 + right
+    tags = _run(
+        tmp_path, [seq],
+        left_adapter=left, right_adapter=right,
+        left_umi_length=5, right_umi_length=5,
+    )[0]
+    assert tags["XF"] == len(left) + 5 + 1
+    assert tags["XT"] == len(left) + 5 + len(insert) + 1
+    comp = {"A": "T", "C": "G", "G": "C", "T": "A"}
+    umi2_rc = "".join(comp[b] for b in reversed(umi2))
+    assert tags["RX"] == f"{umi1}-{umi2_rc}"
+
+
+def test_adapter_with_errors(tmp_path, rng):
+    right = "AGATCGGAAGAGC"
+    mutated = "AGATCGGTAGAGC"  # 1 mismatch (rate 1/13 < 0.2)
+    insert = "".join(rng.choice(list("ACGT"), 30))
+    tags = _run(tmp_path, [insert + mutated], right_adapter=right, error_rate_3p=0.2)[0]
+    assert tags["XT"] == len(insert) + 1
+
+
+def test_add_ml_tags_bam(tmp_path, rng):
+    from variantcalling_tpu.pipelines import add_ml_tags_bam as amt
+
+    n_reads, n_flows, n_classes = 3, 8, 5
+    probs = rng.dirichlet(np.ones(n_classes) * 0.3, size=(n_reads, n_flows)).astype(np.float32)
+    npy = str(tmp_path / "p.npy")
+    np.save(npy, probs)
+    reads = [{"contig": "chr1", "pos": 10 * i, "cigar": [("M", 20)]} for i in range(n_reads)]
+    src = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    write_bam(src, {"chr1": 10000}, reads)
+    rc = amt.run(["--probability_tensor", npy, "--input_ubam", src, "--output_ubam", out])
+    assert rc == 0
+    with BamReader(out, decode_tags=True) as bam:
+        alns = list(bam)
+    assert len(alns) == n_reads
+    for i, aln in enumerate(alns):
+        assert len(aln.tags["kr"]) == n_flows
+        assert np.array_equal(np.asarray(aln.tags["kr"]), probs[i].argmax(axis=1))
+        # alternates above threshold, excluding the called class
+        n_alt = int(((probs[i] >= 0.003).sum()) - (probs[i].argmax(axis=1) >= 0).sum()
+                    + (probs[i][np.arange(n_flows), probs[i].argmax(axis=1)] < 0.003).sum())
+        assert len(aln.tags["kh"]) == len(aln.tags["kf"]) == len(aln.tags["kd"])

@@ -149,3 +149,77 @@ def test_nexusplt_save(tmp_path):
     data = _json.load(open(paths[2]))
     assert data["axes"][0]["lines"][0]["y"] == [4.0, 5.0, 6.0]
     plt.close(fig)
+
+
+def test_report_wo_gt(tmp_path):
+    from variantcalling_tpu.pipelines import report_wo_gt
+
+    h5 = str(tmp_path / "nogt.h5")
+    write_hdf(pd.DataFrame({"callable_size": [2.9e9]}), h5, key="callable_size", mode="w")
+    write_hdf(pd.DataFrame({"bin": range(5), "count": [1, 2, 3, 0, 1]}), h5, key="af_hist", mode="a")
+    html = str(tmp_path / "r.html")
+    rc = report_wo_gt.run(["--input_h5", h5, "--html_output", html, "--sample_name", "S"])
+    assert rc == 0
+    text = open(html).read()
+    assert "Callable region size" in text and "S" in text
+
+
+def test_mrd_data_analysis(tmp_path):
+    from variantcalling_tpu.pipelines import mrd_data_analysis
+
+    h5 = str(tmp_path / "mrd.h5")
+    write_hdf(
+        pd.DataFrame(
+            [
+                {
+                    "n_signature_loci": 100,
+                    "n_supporting_reads": 7,
+                    "n_trials": 100000,
+                    "tumor_fraction": 1.2e-4,
+                    "tf_ci_low": 5e-5,
+                    "tf_ci_high": 3e-4,
+                    "expected_background_reads": 0.1,
+                    "mrd_detected": True,
+                }
+            ]
+        ),
+        h5,
+        key="mrd_summary",
+        mode="w",
+    )
+    html = str(tmp_path / "mrd.html")
+    rc = mrd_data_analysis.run(["--mrd_summary_h5", h5, "--html_output", html,
+                                "--h5_output", str(tmp_path / "out.h5")])
+    assert rc == 0
+    assert "DETECTED" in open(html).read()
+
+
+def test_detailed_var_report(tmp_path, rng):
+    from variantcalling_tpu.pipelines import detailed_var_report as dvr
+
+    n = 300
+    df = pd.DataFrame(
+        {
+            "chrom": ["chr1"] * n,
+            "pos": np.arange(1, n + 1),
+            "classify": rng.choice(["tp", "fp", "fn"], n, p=[0.8, 0.1, 0.1]),
+            "filter": ["PASS"] * n,
+            "indel": rng.random(n) < 0.2,
+            "hmer_indel_length": np.zeros(n),
+            "tree_score": rng.random(n),
+            "LCR-hs38": rng.random(n) < 0.1,
+            "coverage": rng.integers(5, 60, n).astype(float),
+        }
+    )
+    h5 = str(tmp_path / "conc.h5")
+    write_hdf(df, h5, key="all", mode="w")
+    out = str(tmp_path / "det.h5")
+    html = str(tmp_path / "det.html")
+    rc = dvr.run(["--h5_concordance_file", h5, "--h5_output", out, "--html_output", html])
+    assert rc == 0
+    from variantcalling_tpu.utils.h5_utils import list_keys
+
+    keys = list_keys(out)
+    assert "overall" in keys
+    assert any("LCR" in k for k in keys)
+    assert any(k.startswith("coverage_") for k in keys)

@@ -1,0 +1,96 @@
+"""Tests: somatic GT builder + DAN trainer checkpoint/resume."""
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+
+def _vcf(path, rows):
+    lines = [
+        "##fileformat=VCFv4.2",
+        "##contig=<ID=chr1,length=1000000>",
+        "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO",
+    ]
+    for pos, ref, alt in rows:
+        lines.append(f"chr1\t{pos}\t.\t{ref}\t{alt}\t50\tPASS\t.")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def test_create_somatic_gt(tmp_path):
+    from variantcalling_tpu.io.bed import read_bed
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.pipelines import create_somatic_gt_file as sgt
+
+    tumor = str(tmp_path / "t.vcf")
+    normal = str(tmp_path / "n.vcf")
+    # 100: tumor-private (somatic). 200: exact shared (germline, dropped).
+    # 300: position shared, allele differs (problematic). 400: tumor del, pos-shared.
+    _vcf(tumor, [(100, "A", "G"), (200, "C", "T"), (300, "G", "A"), (400, "TAAAA", "T")])
+    _vcf(normal, [(200, "C", "T"), (300, "G", "C"), (400, "TAA", "T")])
+    cmp_bed = str(tmp_path / "cmp.bed")
+    open(cmp_bed, "w").write("chr1\t0\t1000\n")
+    out = str(tmp_path / "out")
+    rc = sgt.run([
+        "--gt_tumor", tumor, "--gt_normal", normal,
+        "--gt_tumor_name", "T", "--gt_normal_name", "N",
+        "--cmp_intervals", cmp_bed, "--output_folder", out,
+    ])
+    assert rc == 0
+    gt = read_vcf(f"{out}/OUTPUT_gt_T_minus_N.vcf.gz")
+    assert sorted(gt.pos.tolist()) == [100, 300, 400]  # germline 200 removed
+    bed = read_bed(f"{out}/OUTPUT_cmp_no_problematic_positions.bed")
+    # positions 300 (1bp each side) and 400 (del spans) subtracted
+    spans = list(zip(bed.start.tolist(), bed.end.tolist()))
+    total = sum(e - s for s, e in spans)
+    assert total < 1000
+    from variantcalling_tpu.io.bed import IntervalSet
+
+    pos0 = np.array([299, 399, 400, 403, 99, 150])
+    member = bed.contains(np.array(["chr1"] * 6, dtype=object), pos0)
+    assert not member[0] and not member[1] and not member[2] and not member[3]  # problematic removed
+    assert member[4] and member[5]  # clean loci kept
+
+
+def _training_h5(path, rng, n=600):
+    x0 = rng.normal(0, 1, n)
+    label = (x0 + rng.normal(0, 0.5, n) > 0).astype(str)
+    df = pd.DataFrame(
+        {
+            "chrom": ["chr1"] * n,
+            "pos": np.arange(1, n + 1),
+            "classify": np.where(label == "True", "tp", "fp"),
+            "qual": 50 + 10 * x0,
+            "dp": rng.integers(10, 60, n).astype(float),
+            "sor": rng.uniform(0, 3, n),
+            "left_motif": rng.integers(0, 3125, n).astype(float),
+            "right_motif": rng.integers(0, 3125, n).astype(float),
+            "filter": ["PASS"] * n,
+        }
+    )
+    write_hdf(df, path, key="all", mode="w")
+
+
+def test_train_dan_checkpoint_resume(tmp_path, rng):
+    from variantcalling_tpu.models import registry
+    from variantcalling_tpu.pipelines import train_dan
+
+    h5 = str(tmp_path / "conc.h5")
+    _training_h5(h5, rng)
+    ckpt = str(tmp_path / "ckpt")
+    prefix = str(tmp_path / "dan")
+    common = [
+        "--input_file", h5, "--output_file_prefix", prefix,
+        "--n_steps", "30", "--batch_size", "256", "--hidden", "32",
+        "--embed_dim", "4", "--checkpoint_dir", ckpt, "--checkpoint_every", "10",
+    ]
+    assert train_dan.run(common) == 0
+    model = registry.load_model(prefix + ".pkl", train_dan.MODEL_NAME)
+    assert model.norm_mu is not None
+
+    # resume: latest checkpoint (step 29) short-circuits most of the loop
+    assert train_dan.run(common) == 0
+    import os
+
+    assert os.path.isdir(ckpt)

@@ -58,6 +58,14 @@ TOOLS: dict[str, str] = {
     "srsnv_inference": "variantcalling_tpu.pipelines.srsnv.srsnv_inference",
     "mrd_analysis": "variantcalling_tpu.pipelines.mrd_analysis",
     "ppmseq_qc": "variantcalling_tpu.pipelines.ppmseq_qc",
+    "create_somatic_gt_file": "variantcalling_tpu.pipelines.create_somatic_gt_file",
+    "run_somatic_comparison_and_graphs": "variantcalling_tpu.pipelines.run_somatic_comparison_and_graphs",
+    "train_dan": "variantcalling_tpu.pipelines.train_dan",
+    "report_wo_gt": "variantcalling_tpu.pipelines.report_wo_gt",
+    "mrd_data_analysis": "variantcalling_tpu.pipelines.mrd_data_analysis",
+    "detailed_var_report": "variantcalling_tpu.pipelines.detailed_var_report",
+    "find_adapter_coords": "variantcalling_tpu.pipelines.find_adapter_coords",
+    "add_ml_tags_bam": "variantcalling_tpu.pipelines.add_ml_tags_bam",
     "collect_hpol_table": "variantcalling_tpu.pipelines.collect_hpol_table",
     "calibrate_bridging_snvs": "variantcalling_tpu.pipelines.calibrate_bridging_snvs",
     "training_set_consistency_check": "variantcalling_tpu.pipelines.training_set_consistency_check",

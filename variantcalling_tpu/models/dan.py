@@ -126,6 +126,8 @@ class DanModel:
     feature_names: list[str] = field(default_factory=list)
     numeric_features: list[str] = field(default_factory=list)
     pass_threshold: float = 0.5
+    norm_mu: np.ndarray | None = None  # numeric normalization (train_dan)
+    norm_sd: np.ndarray | None = None
 
     def params(self) -> dict:
         return {k: jnp.asarray(v) for k, v in self.params_np.items()}

@@ -1,0 +1,123 @@
+"""add_ml_tags_bam — write per-flow probability tags into a uBAM.
+
+Reference surface: ugvc/scripts/add_ml_tags_bam.py, which delegates to the
+external ``python.error_model`` package (Ultima basecaller repo — not part
+of the reference snapshot, add_ml_tags_bam.py:5). Behavior re-derived from
+the public Ultima flow-BAM tag layout:
+
+- ``kr:B:c`` — the regressed flow key (hmer length per flow, clipped 0..127);
+- ``kh:B:c`` / ``kf:B:i`` / ``kd:B:c`` — alternative hmer calls: for every
+  (flow, class) whose probability ≥ ``--probability_threshold`` and is not
+  the called class, the alternative hmer value, its flow index, and the
+  scaled phred of p_alt/p_called.
+
+Inputs: probability tensor (reads × flows × classes; ``.npy`` or raw
+``.bin`` float32 with ``--n_flows/--n_classes``) and optionally the
+regressed key (reads × flows; default = per-flow argmax). Records stream
+through the BGZF layer untouched except for the appended tags; read order
+must match the tensor's first axis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import struct
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.bgzf import BgzfWriter
+
+DEFAULT_FLOW_ORDER = "TGCA"
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="add_ml_tags_bam", description=run.__doc__)
+    ap.add_argument("--probability_tensor", required=True, help="npy/bin (reads, flows, classes)")
+    ap.add_argument("--regressed_key", default=None, help="npy/bin (reads, flows); default argmax")
+    ap.add_argument("--input_ubam", required=True)
+    ap.add_argument("--output_ubam", required=True)
+    ap.add_argument("--flow_order", default=DEFAULT_FLOW_ORDER)
+    ap.add_argument("--n_flows", type=int, default=None)
+    ap.add_argument("--n_classes", type=int, default=None)
+    ap.add_argument("--probability_threshold", type=float, default=0.003)
+    ap.add_argument("--probability_scaling_factor", type=float, default=10.0)
+    return ap.parse_args(argv)
+
+
+def load_tensor(path: str, n_flows: int | None, n_classes: int | None) -> np.ndarray:
+    if path.endswith(".npy"):
+        return np.load(path)
+    if n_flows is None or n_classes is None:
+        raise SystemExit("--n_flows/--n_classes required for .bin tensors")
+    raw = np.fromfile(path, dtype=np.float32)
+    return raw.reshape(-1, n_flows, n_classes)
+
+
+def read_tags(probs: np.ndarray, key: np.ndarray, threshold: float, sf: float) -> bytes:
+    """Tag bytes for one read from its (flows, classes) probabilities."""
+    called = np.clip(key.astype(np.int64), 0, probs.shape[1] - 1)
+    p_called = np.maximum(probs[np.arange(len(key)), called], 1e-10)
+    alt_flows, alt_classes = np.nonzero(probs >= threshold)
+    keep = probs[alt_flows, alt_classes] >= threshold
+    not_called = alt_classes != called[alt_flows]
+    alt_flows, alt_classes = alt_flows[keep & not_called], alt_classes[keep & not_called]
+    ratios = probs[alt_flows, alt_classes] / p_called[alt_flows]
+    kd = np.clip(np.round(-sf * np.log10(np.maximum(ratios, 1e-10))), -127, 127).astype(np.int8)
+
+    out = bytearray()
+    kr8 = np.clip(key, 0, 127).astype(np.int8)
+    out += b"krBc" + struct.pack("<I", len(kr8)) + kr8.tobytes()
+    out += b"khBc" + struct.pack("<I", len(alt_classes)) + np.clip(alt_classes, 0, 127).astype(np.int8).tobytes()
+    out += b"kfBi" + struct.pack("<I", len(alt_flows)) + alt_flows.astype(np.int32).tobytes()
+    out += b"kdBc" + struct.pack("<I", len(kd)) + kd.tobytes()
+    return bytes(out)
+
+
+def run(argv) -> int:
+    """Append flow-probability tags to every uBAM record."""
+    args = parse_args(argv)
+    probs = load_tensor(args.probability_tensor, args.n_flows, args.n_classes)
+    if args.regressed_key:
+        key = load_tensor(args.regressed_key, args.n_flows, 1).reshape(probs.shape[0], -1)
+    else:
+        key = probs.argmax(axis=2)
+
+    from variantcalling_tpu import native
+
+    with open(args.input_ubam, "rb") as fh:
+        raw = fh.read()
+    buf = native.bgzf_decompress(raw)
+    if buf is None:
+        import gzip
+
+        buf = gzip.decompress(raw)
+    if buf[:4] != b"BAM\x01":
+        raise SystemExit(f"{args.input_ubam}: not a BAM")
+    (l_text,) = struct.unpack_from("<i", buf, 4)
+    off = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", buf, off)
+    off += 4
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", buf, off)
+        off += 8 + l_name
+    i = 0
+    with BgzfWriter(args.output_ubam) as out:
+        out.write(buf[:off])
+        while off + 4 <= len(buf):
+            (bs,) = struct.unpack_from("<i", buf, off)
+            rec = buf[off + 4 : off + 4 + bs]
+            off += 4 + bs
+            if i >= probs.shape[0]:
+                raise SystemExit(f"probability tensor has {probs.shape[0]} reads; BAM has more")
+            extra = read_tags(probs[i], key[i], args.probability_threshold, args.probability_scaling_factor)
+            new_rec = rec + extra
+            out.write(struct.pack("<i", len(new_rec)) + new_rec)
+            i += 1
+    logger.info("tagged %d reads -> %s", i, args.output_ubam)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,132 @@
+"""create_somatic_gt_file — tumor-minus-normal somatic ground truth.
+
+Reference surface: ugvc/scripts/create_somatic_gt_file.py:74-415 — a chain
+of bcftools isec / convert2bed / bedtools subtract subprocesses. Same
+semantics in-process over the columnar VCF/interval layers:
+
+- somatic GT VCF = tumor GT records absent from the normal GT (exact
+  chrom/pos/ref/alt match removes them);
+- "problematic positions" = loci where tumor and normal share the position
+  but not an exact allele (ambiguous subtraction), plus the full reference
+  spans of deletions there; these are subtracted from ``cmp_intervals`` to
+  form the comparison high-confidence BED (optionally intersected with
+  ``regions_bed``).
+
+Outputs (matching the reference's names the downstream pipeline consumes):
+  OUTPUT_gt_<tumor>_minus_<normal>.vcf.gz
+  [OUTPUT_]<cmp_prefix>_no_problematic_positions[_in_regions_only].bed
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.bed import IntervalSet, read_bed, write_bed
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="create_somatic_gt_file", description=run.__doc__)
+    ap.add_argument("--gt_tumor", required=True, help="tumor ground-truth VCF")
+    ap.add_argument("--gt_normal", required=True, help="normal ground-truth VCF")
+    ap.add_argument("--gt_tumor_name", required=True)
+    ap.add_argument("--gt_normal_name", required=True)
+    ap.add_argument("--regions_bed", default=None)
+    ap.add_argument("--cmp_intervals", required=True)
+    ap.add_argument("--output_folder", required=True)
+    return ap.parse_args(argv)
+
+
+def _obj(items) -> np.ndarray:
+    a = np.empty(len(items), dtype=object)
+    a[:] = list(items)
+    return a
+
+
+def problematic_intervals(tumor, normal) -> IntervalSet:
+    """0-based spans of position-shared-but-not-exact loci (+deletion spans)."""
+    exact_n = {
+        (c, int(p), r, a) for c, p, r, a in zip(normal.chrom, normal.pos, normal.ref, normal.alt)
+    }
+    pos_n = {(c, int(p)) for c, p in zip(normal.chrom, normal.pos)}
+    chroms: list[str] = []
+    starts: list[int] = []
+    ends: list[int] = []
+
+    def add(table):
+        for c, p, r, a in zip(table.chrom, table.pos, table.ref, table.alt):
+            key_pos = (c, int(p))
+            if key_pos not in pos_t or key_pos not in pos_n:
+                continue
+            if (c, int(p), r, a) in exact_n:
+                continue
+            chroms.append(c)
+            starts.append(int(p) - 1)
+            # deletions cover their full reference span
+            ends.append(int(p) - 1 + max(len(r), 1))
+
+    pos_t = {(c, int(p)) for c, p in zip(tumor.chrom, tumor.pos)}
+    add(tumor)
+    add(normal)
+    return IntervalSet(_obj(chroms), np.asarray(starts, dtype=np.int64), np.asarray(ends, dtype=np.int64)).merged()
+
+
+def run(argv) -> int:
+    """Build the somatic (tumor-minus-normal) GT VCF + cleaned cmp intervals."""
+    args = parse_args(argv)
+    os.makedirs(args.output_folder, exist_ok=True)
+    tumor = read_vcf(args.gt_tumor)
+    normal = read_vcf(args.gt_normal)
+
+    exact_n = {
+        (c, int(p), r, a) for c, p, r, a in zip(normal.chrom, normal.pos, normal.ref, normal.alt)
+    }
+    keep = np.fromiter(
+        (
+            (c, int(p), r, a) not in exact_n
+            for c, p, r, a in zip(tumor.chrom, tumor.pos, tumor.ref, tumor.alt)
+        ),
+        dtype=bool,
+        count=len(tumor),
+    )
+    from variantcalling_tpu.pipelines.filter_variants import _subset
+
+    somatic = _subset(tumor, keep)
+    gt_out = os.path.join(
+        args.output_folder, f"OUTPUT_gt_{args.gt_tumor_name}_minus_{args.gt_normal_name}.vcf.gz"
+    )
+    write_vcf(gt_out, somatic)
+
+    bad = problematic_intervals(tumor, normal)
+    cmp_iv = read_bed(args.cmp_intervals).merged()
+    cleaned = cmp_iv.subtract(bad)
+    prefix = os.path.splitext(os.path.basename(args.cmp_intervals))[0].split(".")[0]
+    if args.regions_bed is None:
+        bed_out = os.path.join(args.output_folder, f"OUTPUT_{prefix}_no_problematic_positions.bed")
+        write_bed(bed_out, cleaned)
+    else:
+        mid = os.path.join(args.output_folder, f"{prefix}_no_problematic_positions.bed")
+        write_bed(mid, cleaned)
+        final = cleaned.intersect(read_bed(args.regions_bed).merged())
+        bed_out = os.path.join(
+            args.output_folder, f"OUTPUT_{prefix}_no_problematic_positions_in_regions_only.bed"
+        )
+        write_bed(bed_out, final)
+    logger.info(
+        "somatic GT: %d/%d tumor records private; %d problematic spans removed -> %s, %s",
+        int(keep.sum()),
+        len(tumor),
+        len(bad),
+        gt_out,
+        bed_out,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

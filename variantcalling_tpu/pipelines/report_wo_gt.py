@@ -1,0 +1,66 @@
+"""report_wo_gt — HTML report over the no-ground-truth statistics h5.
+
+Reference surface: ugvc/reports/report_wo_gt.ipynb (papermill over the
+run_no_gt_report full_analysis h5). Renders every collected section —
+callable size, indel ins/del-by-hmer tables, allele-frequency histogram,
+96-channel SNP motif spectrum, VariantEval tables, fitted signature
+exposures — as one self-contained HTML + pass-through h5.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+import pandas as pd
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+SECTION_TITLES = {
+    "callable_size": "Callable region size",
+    "ins_del_hete": "Heterozygous indels by hmer length",
+    "ins_del_homo": "Homozygous indels by hmer length",
+    "af_hist": "Allele-frequency histogram",
+    "snp_motifs": "SNP 96-motif spectrum",
+    "signature_exposures": "Mutational signature exposures",
+}
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="report_wo_gt", description=run.__doc__)
+    ap.add_argument("--input_h5", required=True, help="run_no_gt_report output h5")
+    ap.add_argument("--html_output", required=True)
+    ap.add_argument("--sample_name", default="NA")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Render the no-GT report HTML."""
+    args = parse_args(argv)
+    rep = HtmlReport(f"Variant Report (no ground truth) — {args.sample_name}")
+    rep.add_params({"input": args.input_h5, "sample": args.sample_name})
+    n_sections = 0
+    keys = list_keys(args.input_h5)
+    ordered = [k for k in SECTION_TITLES if k in keys] + sorted(
+        k for k in keys if k not in SECTION_TITLES
+    )
+    for key in ordered:
+        df = read_hdf(args.input_h5, key=key)
+        title = SECTION_TITLES.get(key, key.replace("_", " "))
+        rep.add_section(title)
+        if key == "af_hist" and len(df) > 25:
+            # compact: show non-empty bins only
+            num = df.select_dtypes(include=[np.number])
+            df = df[(num.sum(axis=1) > 0)]
+        rep.add_table(df.head(120))
+        n_sections += 1
+    rep.write(args.html_output)
+    logger.info("%d sections -> %s", n_sections, args.html_output)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

@@ -1,0 +1,98 @@
+"""run_somatic_comparison_and_graphs — somatic (Mutect-style) eval driver.
+
+Reference surface: ugvc/scripts/run_somatic_comparison_and_graphs.py —
+drives run_comparison_pipeline then evaluate_concordance on a somatic
+callset vs the tumor-minus-normal GT (create_somatic_gt_file outputs) and
+renders accuracy graphs. Here both stages are in-process calls; the PR
+curve and score-distribution figures save via reports/nexusplt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.pipelines import evaluate_concordance, run_comparison
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="run_somatic_comparison_and_graphs", description=run.__doc__)
+    ap.add_argument("--somatic_vcf", required=True, help="Mutect-style somatic callset")
+    ap.add_argument("--gt_vcf", required=True, help="tumor-minus-normal GT (create_somatic_gt_file)")
+    ap.add_argument("--highconf_bed", required=True, help="cleaned cmp intervals (create_somatic_gt_file)")
+    ap.add_argument("--reference", required=True)
+    ap.add_argument("--output_folder", required=True)
+    ap.add_argument("--call_sample_name", default="tumor")
+    ap.add_argument("--truth_sample_name", default="somatic_gt")
+    ap.add_argument("--score_key", default="tree_score")
+    ap.add_argument("--make_plots", action="store_true")
+    return ap.parse_args(argv)
+
+
+def run(argv) -> int:
+    """Chain comparison + concordance evaluation (+ graphs) for somatic calls."""
+    args = parse_args(argv)
+    os.makedirs(args.output_folder, exist_ok=True)
+    h5 = os.path.join(args.output_folder, "somatic_comparison.h5")
+    bed = os.path.join(args.output_folder, "somatic_comparison.intervals.bed")
+    rc = run_comparison.run(
+        [
+            "--input_prefix", args.somatic_vcf,
+            "--output_file", h5,
+            "--output_interval", bed,
+            "--gtr_vcf", args.gt_vcf,
+            "--highconf_intervals", args.highconf_bed,
+            "--reference", args.reference,
+            "--call_sample_name", args.call_sample_name,
+            "--truth_sample_name", args.truth_sample_name,
+            "--ignore_filter_status",
+        ]
+    )
+    if rc not in (0, None):
+        return int(rc)
+    prefix = os.path.join(args.output_folder, "somatic_eval")
+    rc = evaluate_concordance.run(
+        ["--input_file", h5, "--output_prefix", prefix, "--score_key", args.score_key]
+    )
+    if rc not in (0, None):
+        return int(rc)
+    if args.make_plots:
+        _plots(prefix, args.output_folder)
+    logger.info("somatic comparison + evaluation -> %s", args.output_folder)
+    return 0
+
+
+def _plots(prefix: str, outdir: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from variantcalling_tpu.reports import nexusplt
+    from variantcalling_tpu.utils.h5_utils import read_hdf
+
+    try:
+        curve = read_hdf(prefix + ".h5", key="recall_precision_curve")
+    except (KeyError, OSError):
+        logger.warning("no recall_precision_curve key; skipping graphs")
+        return
+    fig, ax = plt.subplots(figsize=(7, 6))
+    for _, row in curve.iterrows():
+        rec, prec = np.asarray(row.get("recall")), np.asarray(row.get("precision"))
+        if rec is None or prec is None or np.ndim(rec) == 0:
+            continue
+        ax.plot(rec, prec, label=str(row.get("group", "")))
+    ax.set_xlabel("recall")
+    ax.set_ylabel("precision")
+    ax.set_title("Somatic recall/precision")
+    ax.legend(fontsize=8)
+    nexusplt.save(fig, "somatic_recall_precision", outdir, formats=("png", "html"))
+    plt.close(fig)
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
