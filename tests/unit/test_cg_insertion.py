@@ -1,0 +1,63 @@
+"""Vectorized CCG/GGC insertion flag (filter_variants._is_cg_insertion).
+
+Reference semantics (docs/filter_variants_pipeline.md "--blacklist_cg_insertions"):
+flag single-base insertions of C after a C anchor followed by G (C[C]G) and
+of G after a G anchor followed by C (G[G]C). Exercised through both ingest
+paths (native cache and Python fallback).
+"""
+
+import numpy as np
+
+from variantcalling_tpu.featurize import CENTER, gather_windows
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.io.vcf import read_vcf
+from variantcalling_tpu.pipelines.filter_variants import _is_cg_insertion
+
+HEADER = """##fileformat=VCFv4.2
+##contig=<ID=c,length=60>
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO
+"""
+#        123456789012345678901234567890
+GENOME = "AACGTTTTTTCCGGAAAAAAGGCATTTTTA"  # CG at 11-13 (CCG), GGC at 21-23
+
+
+def _write(tmp_path, rows):
+    fa = tmp_path / "r.fa"
+    fa.write_text(">c\n" + GENOME + "\n")
+    p = tmp_path / "t.vcf"
+    p.write_text(HEADER.replace("\\t", "\t") + "\n".join(rows) + "\n")
+    return str(p), str(fa)
+
+
+def _rows():
+    # pos is 1-based; GENOME[10]='C' GENOME[11]='C' GENOME[12]='G';
+    # GENOME[20]='G' GENOME[21]='G' GENOME[22]='C'
+    return [
+        "c\t11\t.\tC\tCC\t50\t.\t.",    # anchor C @ pos 11, next ref G? GENOME[11]='C' -> not CG yet
+        "c\t12\t.\tC\tCC\t50\t.\t.",    # anchor C @ pos 12, next G -> CCG flagged
+        "c\t21\t.\tG\tGG\t50\t.\t.",    # anchor G @ 21, next G -> not flagged
+        "c\t22\t.\tG\tGG\t50\t.\t.",    # anchor G @ 22, next C -> GGC flagged
+        "c\t12\t.\tC\tCA\t50\t.\t.",    # SNP-ish pair, not an insertion
+        "c\t12\t.\tC\tCG\t50\t.\t.",    # inserted G (anchor C) -> not flagged
+        "c\t5\t.\tT\tTT\t50\t.\t.",     # T insertion -> not flagged
+    ]
+
+
+def test_cg_insertion_flags(tmp_path):
+    vcf, fa = _write(tmp_path, _rows())
+    table = read_vcf(vcf)
+    windows = gather_windows(table, FastaReader(fa))
+    got = _is_cg_insertion(table, windows, CENTER)
+    np.testing.assert_array_equal(got, [False, True, False, True, False, False, False])
+
+
+def test_cg_insertion_python_fallback(tmp_path, monkeypatch):
+    import variantcalling_tpu.io.vcf as vcfmod
+
+    monkeypatch.setattr(vcfmod, "_read_vcf_native", lambda p, drop_format=False: None)
+    vcf, fa = _write(tmp_path, _rows())
+    table = read_vcf(vcf)
+    assert table.aux is None
+    windows = gather_windows(table, FastaReader(fa))
+    got = _is_cg_insertion(table, windows, CENTER)
+    np.testing.assert_array_equal(got, [False, True, False, True, False, False, False])

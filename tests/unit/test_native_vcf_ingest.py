@@ -1,0 +1,201 @@
+"""Native C++ VCF scanner vs the streaming Python parser — full parity.
+
+The native path (io/vcf._read_vcf_native over native/src vctpu_vcf_parse)
+must agree with the Python fallback on every column and derived accessor,
+including the pre-parsed caches (GT/GQ/DP/AD, hot INFO keys, allele
+classes). The fixture deliberately covers: multiallelics, symbolic alleles,
+missing values, flags, phased/haploid genotypes, multi-sample records,
+and high-ploidy GT strings.
+"""
+
+import numpy as np
+import pytest
+
+from variantcalling_tpu import native
+from variantcalling_tpu.featurize import classify_alleles
+from variantcalling_tpu.io.vcf import _read_vcf_native, read_vcf, write_vcf
+
+TRICKY = """##fileformat=VCFv4.2
+##contig=<ID=chr1,length=100000>
+##contig=<ID=chr2,length=50000>
+##INFO=<ID=DP,Number=1,Type=Integer,Description="Depth">
+##INFO=<ID=AF,Number=A,Type=Float,Description="Allele freq">
+##INFO=<ID=DB,Number=0,Type=Flag,Description="dbSNP">
+##INFO=<ID=SOR,Number=1,Type=Float,Description="SOR">
+##FORMAT=<ID=GT,Number=1,Type=String,Description="GT">
+##FORMAT=<ID=GQ,Number=1,Type=Integer,Description="GQ">
+##FORMAT=<ID=DP,Number=1,Type=Integer,Description="DP">
+##FORMAT=<ID=AD,Number=R,Type=Integer,Description="AD">
+#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1\tS2
+chr1\t100\trs1\tA\tG\t50.5\tPASS\tDP=30;AF=0.5;DB\tGT:GQ:DP:AD\t0/1:45:30:14,16\t1/1:20:22:2,20
+chr1\t200\t.\tAC\tA\t12\tq10\tDP=10\tGT:GQ\t0|1:33\t.:.
+chr1\t300\t.\tG\tGTT,GT\t.\t.\tAF=0.2,0.1\tGT:AD\t1/2:1,2,3\t0/0:9,0,0
+chr1\t400\t.\tT\t<NON_REF>\t5\t.\tDP=7\tGT\t0/0\t./.
+chr1\t500\t.\tTAAA\tT,TA\t9.1\tPASS;weird\tSOR=1.25\tGT:GQ:DP\t2|1:11:40\t1:9:12
+chr2\t10\t.\tC\tT\t1e2\t.\t.\tGT:GQ\t0/1/1:55\t0/1:44
+chr2\t20\t.\tCGG\tCGGG\t3\t.\tDP=0;AF=.\tGT:AD\t0/1:5,.\t1/1:.,.
+chr2\t30\t.\tA\t.\t.\t.\t.\tGT\t./.\t0/0
+"""
+
+
+@pytest.fixture
+def paths(tmp_path):
+    p = tmp_path / "tricky.vcf"
+    p.write_text(TRICKY.replace("\\t", "\t"))
+    return str(p)
+
+
+def _python_read(path):
+    import variantcalling_tpu.io.vcf as vcfmod
+
+    orig = vcfmod._read_vcf_native
+    vcfmod._read_vcf_native = lambda p, drop_format=False: None
+    try:
+        return read_vcf(path)
+    finally:
+        vcfmod._read_vcf_native = orig
+
+
+def test_native_available():
+    assert native.available(), "native library failed to build"
+
+
+def test_column_parity(paths):
+    tn = _read_vcf_native(paths)
+    tp = _python_read(paths)
+    assert tn is not None
+    assert len(tn) == len(tp) == 8
+    for colname in ("chrom", "vid", "ref", "alt", "filters", "info"):
+        assert list(getattr(tn, colname)) == list(getattr(tp, colname)), colname
+    np.testing.assert_array_equal(tn.pos, tp.pos)
+    np.testing.assert_allclose(tn.qual, tp.qual)
+    assert tn.header.samples == tp.header.samples == ["S1", "S2"]
+
+
+def test_format_materialization_parity(paths):
+    tn = _read_vcf_native(paths)
+    tp = _python_read(paths)
+    # property access triggers lazy materialization
+    assert list(tn.fmt_keys) == list(tp.fmt_keys)
+    assert [list(r) for r in tn.sample_cols] == [list(r) for r in tp.sample_cols]
+
+
+def test_genotypes_and_format_numerics_parity(paths):
+    tn = _read_vcf_native(paths)
+    tp = _python_read(paths)
+    np.testing.assert_array_equal(tn.genotypes(), tp.genotypes())
+    np.testing.assert_array_equal(tn.genotypes(1), tp.genotypes(1))
+    for name in ("GQ", "DP"):
+        a = tn.format_numeric(name, max_len=1, missing=np.nan)
+        b = tp.format_numeric(name, max_len=1, missing=np.nan)
+        np.testing.assert_allclose(a, b, equal_nan=True)
+
+
+def test_info_field_parity(paths):
+    tn = _read_vcf_native(paths)
+    tp = _python_read(paths)
+    for key in ("DP", "AF", "SOR"):
+        np.testing.assert_allclose(
+            tn.info_field(key), tp.info_field(key), equal_nan=True, err_msg=key
+        )
+    np.testing.assert_array_equal(
+        tn.info_field("DP", dtype=np.int64, missing=-1), tp.info_field("DP", dtype=np.int64, missing=-1)
+    )
+    # DB flag is cached as 1.0
+    assert tn.info_field("DB")[0] == 1.0 and np.isnan(tn.info_field("DB")[1])
+    # non-cached key falls back to the string scan
+    assert np.isnan(tn.info_field("NOSUCH")).all()
+
+
+def test_allele_class_parity(paths):
+    tn = _read_vcf_native(paths)
+    tp = _python_read(paths)
+    a, b = classify_alleles(tn), classify_alleles(tp)
+    for f in ("is_snp", "is_indel", "is_ins", "indel_length", "indel_nuc", "ref_code", "alt_code", "n_alts"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+    np.testing.assert_array_equal(tn.n_alts(), tp.n_alts())
+
+
+def test_subset_keeps_aux_aligned(paths):
+    tn = _read_vcf_native(paths)
+    keep = np.asarray([0, 2, 4, 6])
+    sub = tn.subset(keep)
+    assert sub.aux is not None
+    np.testing.assert_array_equal(sub.pos, tn.pos[keep])
+    np.testing.assert_array_equal(sub.genotypes(), tn.genotypes()[keep])
+    assert list(sub.fmt_keys) == [tn.fmt_keys[i] for i in keep]
+
+
+def test_fast_writeback_roundtrip(paths, tmp_path):
+    """Byte-slice writeback: untouched columns byte-identical, FILTER/INFO rewritten."""
+    tn = _read_vcf_native(paths)
+    out = tmp_path / "out.vcf"
+    new_filters = np.array(["PASS", "LOW_SCORE", "PASS", "X", "PASS", "PASS", "CG", "PASS"], dtype=object)
+    scores = np.round(np.linspace(0.1, 0.9, 8), 4)
+    tn.header.ensure_info("TREE_SCORE", "1", "Float", "score")
+    write_vcf(str(out), tn, new_filters=new_filters, extra_info={"TREE_SCORE": scores})
+    back = _python_read(str(out))
+    assert list(back.filters) == list(new_filters)
+    np.testing.assert_allclose(back.info_field("TREE_SCORE"), scores, rtol=1e-6)
+    # untouched columns identical
+    for colname in ("chrom", "vid", "ref", "alt"):
+        assert list(getattr(back, colname)) == list(getattr(tn, colname))
+    assert list(back.fmt_keys) == list(tn.fmt_keys)
+    # records with INFO='.' got the extra key as their whole INFO
+    assert back.info[7].startswith("TREE_SCORE=")
+
+
+def test_write_parity_slow_vs_fast(paths, tmp_path):
+    """Fast byte-slice writer output == slow per-record writer output."""
+    tn = _read_vcf_native(paths)
+    tp = _python_read(paths)
+    f1, f2 = tmp_path / "fast.vcf", tmp_path / "slow.vcf"
+    write_vcf(str(f1), tn)
+    write_vcf(str(f2), tp)
+    assert f1.read_text() == f2.read_text()
+
+
+def test_fast_write_honors_core_column_edits(paths, tmp_path):
+    """In-place edits to core columns must reach the output (review finding:
+    the tail-only fast path rebuilds CHROM..INFO from the live arrays)."""
+    tn = _read_vcf_native(paths)
+    tn.qual[0] = 99.25
+    tn.ref[1] = "ACGT"
+    tn.pos[2] = 12345
+    out = tmp_path / "edited.vcf"
+    write_vcf(str(out), tn)
+    back = _python_read(str(out))
+    assert back.qual[0] == 99.25
+    assert back.ref[1] == "ACGT"
+    assert back.pos[2] == 12345
+    # FORMAT/sample tail still verbatim
+    assert list(back.fmt_keys) == list(tn.fmt_keys)
+
+
+def test_drop_format_parity(paths):
+    """drop_format must behave identically on both ingest paths."""
+    tn = read_vcf(paths, drop_format=True)
+    tp_mod = _python_read(paths)  # full python read for reference shape
+    assert tn.aux is not None and not tn.aux.has_format
+    assert tn.fmt_keys is None and tn.sample_cols is None
+    np.testing.assert_array_equal(tn.genotypes(), np.full((len(tp_mod), 2), -1, dtype=np.int8))
+    # numeric INFO caches survive drop_format
+    np.testing.assert_allclose(tn.info_field("DP"), tp_mod.info_field("DP"), equal_nan=True)
+
+
+def test_genotypes_copy_semantics(paths):
+    tn = _read_vcf_native(paths)
+    g = tn.genotypes()
+    g[:] = -9
+    np.testing.assert_array_equal(tn.genotypes()[0], [0, 1])  # cache untouched
+
+
+def test_gz_native_roundtrip(tmp_path):
+    from variantcalling_tpu.io.bgzf import BgzfWriter
+
+    p = tmp_path / "t.vcf.gz"
+    with BgzfWriter(str(p)) as fh:
+        fh.write(TRICKY.replace("\\t", "\t"))
+    tn = read_vcf(str(p))
+    assert tn.aux is not None, "gz input should take the native path"
+    assert len(tn) == 8 and tn.pos[0] == 100

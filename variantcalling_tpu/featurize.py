@@ -66,7 +66,24 @@ class AlleleColumns:
 
 def classify_alleles(table: VariantTable) -> AlleleColumns:
     """Indel/SNP classification from REF/ALT strings (parity: classify_indel,
-    ugbio_core.vcfbed.variant_annotation; run_no_gt_report.py:92)."""
+    ugbio_core.vcfbed.variant_annotation; run_no_gt_report.py:92).
+
+    Served from the native scan cache when the table came through the C++
+    ingest (io/vcf._read_vcf_native) — zero per-record Python on that path.
+    """
+    if table.aux is not None:
+        a = table.aux.alle
+        cls = a["aclass"]
+        return AlleleColumns(  # fresh arrays: the cache must stay pristine
+            is_snp=(cls & 1).astype(bool),
+            is_indel=(cls & 2).astype(bool),
+            is_ins=(cls & 4).astype(bool),
+            indel_length=a["indel_length"].copy(),
+            indel_nuc=a["indel_nuc"].copy(),
+            ref_code=a["ref_code"].copy(),
+            alt_code=a["alt_code"].copy(),
+            n_alts=a["n_alts"].copy(),
+        )
     n = len(table)
     is_snp = np.zeros(n, dtype=bool)
     is_indel = np.zeros(n, dtype=bool)
@@ -147,11 +164,16 @@ class FeatureSet:
 def _compute_af(table: VariantTable) -> np.ndarray:
     """Allele fraction per record: FORMAT AD (alt/sum) where present, else INFO AF."""
     info_af = table.info_field("AF", dtype=np.float64).astype(np.float32)
-    ad = table.format_numeric("AD")
-    if ad.shape[1] < 2:
-        return info_af
-    tot = np.sum(np.where(ad > 0, ad, 0), axis=1)
-    alt = np.where(ad[:, 1] > 0, ad[:, 1], 0)
+    if table.aux is not None:
+        ad1 = table.aux.ad[:, 1]
+        tot = np.where(np.isnan(table.aux.ad[:, 2]), 0, table.aux.ad[:, 2])
+        alt = np.where(np.isnan(ad1) | (ad1 < 0), 0, ad1)
+    else:
+        ad = table.format_numeric("AD")
+        if ad.shape[1] < 2:
+            return info_af
+        tot = np.sum(np.where(ad > 0, ad, 0), axis=1)
+        alt = np.where(ad[:, 1] > 0, ad[:, 1], 0)
     with np.errstate(invalid="ignore", divide="ignore"):
         ad_af = np.where(tot > 0, alt / np.maximum(tot, 1), np.nan).astype(np.float32)
     return np.where(np.isnan(ad_af), info_af, ad_af)

@@ -129,25 +129,88 @@ class VcfHeader:
 
 
 @dataclass
+class NativeAux:
+    """Products of the native one-pass VCF scan (native/src vctpu_vcf_parse).
+
+    Row-aligned with the owning :class:`VariantTable`; ``buf`` is the shared
+    uncompressed text buffer, spans are [start, end) byte offsets into it.
+    Serves three purposes: (1) numeric caches (FORMAT GT/GQ/DP/AD, hot INFO
+    keys, allele classification) so featurization never re-parses strings,
+    (2) lazy FORMAT/sample materialization, (3) byte-slice VCF writeback.
+    """
+
+    buf: np.ndarray  # uint8 text
+    line_spans: np.ndarray  # (n, 2)
+    tail_spans: np.ndarray  # (n, 2): FORMAT..line-end (empty span if no samples)
+    info_spans: np.ndarray  # (n, 2)
+    filter_spans: np.ndarray  # (n, 2)
+    gt: np.ndarray  # (n, 2) int8
+    gt_phased: np.ndarray  # (n,) uint8
+    gq: np.ndarray  # (n,) float32, NaN missing
+    dp_fmt: np.ndarray  # (n,) float32
+    ad: np.ndarray  # (n, 3) float32: ref, alt1, positive-total
+    info_vals: np.ndarray  # (n, len(info_keys)) float64
+    info_keys: tuple
+    alle: dict  # aclass/indel_length/indel_nuc/ref_code/alt_code/n_alts/ref_len
+    has_format: bool = True  # False after drop_format: no sample data, no buffer
+
+    def take(self, keep: np.ndarray) -> "NativeAux":
+        return NativeAux(
+            buf=self.buf,
+            has_format=self.has_format,
+            line_spans=self.line_spans[keep],
+            tail_spans=self.tail_spans[keep],
+            info_spans=self.info_spans[keep],
+            filter_spans=self.filter_spans[keep],
+            gt=self.gt[keep],
+            gt_phased=self.gt_phased[keep],
+            gq=self.gq[keep],
+            dp_fmt=self.dp_fmt[keep],
+            ad=self.ad[keep],
+            info_vals=self.info_vals[keep],
+            info_keys=self.info_keys,
+            alle={k: v[keep] for k, v in self.alle.items()},
+        )
+
+
 class VariantTable:
     """Columnar view of a VCF: one numpy array per column over all records.
 
     String-ish columns are object arrays; ragged per-record structures
     (ALTs, per-sample fields) stay host-side until featurization pads them
-    into device tensors.
+    into device tensors. ``aux`` (native ingest only) carries pre-parsed
+    numeric caches + raw byte spans; use :meth:`subset` for row filtering so
+    it stays aligned. ``fmt_keys``/``sample_cols`` are lazy on the native
+    path: reading them materializes the strings from the raw buffer.
     """
 
-    header: VcfHeader
-    chrom: np.ndarray  # object (str)
-    pos: np.ndarray  # int64, 1-based
-    vid: np.ndarray  # object
-    ref: np.ndarray  # object
-    alt: np.ndarray  # object: comma-joined ALT string as in file ('.' possible)
-    qual: np.ndarray  # float64 (nan for '.')
-    filters: np.ndarray  # object: raw FILTER column string
-    info: np.ndarray  # object: raw INFO column string
-    fmt_keys: np.ndarray | None = None  # object: FORMAT column per record
-    sample_cols: np.ndarray | None = None  # object (n, n_samples): raw sample strings
+    def __init__(
+        self,
+        header: VcfHeader,
+        chrom: np.ndarray,
+        pos: np.ndarray,
+        vid: np.ndarray,
+        ref: np.ndarray,
+        alt: np.ndarray,
+        qual: np.ndarray,
+        filters: np.ndarray,
+        info: np.ndarray,
+        fmt_keys: np.ndarray | None = None,
+        sample_cols: np.ndarray | None = None,
+        aux: NativeAux | None = None,
+    ):
+        self.header = header
+        self.chrom = chrom
+        self.pos = pos
+        self.vid = vid
+        self.ref = ref
+        self.alt = alt
+        self.qual = qual
+        self.filters = filters
+        self.info = info
+        self._fmt_keys = fmt_keys
+        self._sample_cols = sample_cols
+        self.aux = aux
 
     def __len__(self) -> int:
         return len(self.pos)
@@ -156,12 +219,76 @@ class VariantTable:
     def n_samples(self) -> int:
         return len(self.header.samples)
 
+    @property
+    def fmt_keys(self) -> np.ndarray | None:
+        if self._fmt_keys is None and self.aux is not None and self.n_samples > 0:
+            self.materialize_format()
+        return self._fmt_keys
+
+    @fmt_keys.setter
+    def fmt_keys(self, v) -> None:
+        self._fmt_keys = v
+
+    @property
+    def sample_cols(self) -> np.ndarray | None:
+        if self._sample_cols is None and self.aux is not None and self.n_samples > 0:
+            self.materialize_format()
+        return self._sample_cols
+
+    @sample_cols.setter
+    def sample_cols(self, v) -> None:
+        self._sample_cols = v
+
+    @property
+    def format_materialized(self) -> bool:
+        """True when FORMAT/sample strings exist in memory (possibly edited)."""
+        return self._fmt_keys is not None
+
+    def subset(self, keep: np.ndarray) -> "VariantTable":
+        """Row-subset every column (and aux) by a boolean/index array."""
+        return VariantTable(
+            header=self.header,
+            chrom=self.chrom[keep],
+            pos=self.pos[keep],
+            vid=self.vid[keep],
+            ref=self.ref[keep],
+            alt=self.alt[keep],
+            qual=self.qual[keep],
+            filters=self.filters[keep],
+            info=self.info[keep],
+            fmt_keys=self._fmt_keys[keep] if self._fmt_keys is not None else None,
+            sample_cols=self._sample_cols[keep] if self._sample_cols is not None else None,
+            aux=self.aux.take(keep) if self.aux is not None else None,
+        )
+
+    def materialize_format(self) -> None:
+        """Fill fmt_keys/sample_cols from the native tail spans (lazy path)."""
+        if self._fmt_keys is not None or self.aux is None or self.n_samples == 0:
+            return
+        if not self.aux.has_format or self.aux.buf is None:
+            return  # drop_format ingest: no sample data, mirroring the Python path
+        text = self.aux.buf.tobytes().decode("latin-1")
+        spans = self.aux.tail_spans.tolist()
+        n = len(self)
+        k = self.n_samples
+        fmt = np.empty(n, dtype=object)
+        sc = np.empty((n, k), dtype=object)
+        for i, (a, b) in enumerate(spans):
+            parts = text[a:b].split("\t") if b > a else [MISSING]
+            fmt[i] = parts[0]
+            for s in range(k):
+                sc[i, s] = parts[1 + s] if 1 + s < len(parts) else MISSING
+        self._fmt_keys = fmt
+        self._sample_cols = sc
+
     # -- derived columnar views ------------------------------------------------
 
     def alt_lists(self) -> list[list[str]]:
         return [[] if a in (MISSING, "") else a.split(",") for a in self.alt]
 
     def n_alts(self) -> np.ndarray:
+        if self.aux is not None:
+            return self.aux.alle["n_alts"].copy()
         return np.fromiter(
             (0 if a in (MISSING, "") else a.count(",") + 1 for a in self.alt),
             dtype=np.int32,
@@ -173,6 +300,17 @@ class VariantTable:
 
     def info_field(self, name: str, dtype=np.float64, missing=np.nan, index: int = 0) -> np.ndarray:
         """Vectorized extraction of one INFO key (scalar or ``index``-th element)."""
+        if self.aux is not None and index == 0 and name in self.aux.info_keys:
+            vals = self.aux.info_vals[:, self.aux.info_keys.index(name)]
+            if np.issubdtype(np.dtype(dtype) if not isinstance(dtype, type) else dtype, np.floating) or dtype is float:
+                out = vals.astype(dtype)
+                if not (isinstance(missing, float) and np.isnan(missing)):
+                    out = np.where(np.isnan(vals), missing, out)
+                return out
+            out = np.full(len(self), missing, dtype=dtype)
+            ok = ~np.isnan(vals)
+            out[ok] = vals[ok].astype(dtype)
+            return out
         out = np.full(len(self), missing, dtype=dtype)
         key_eq = name + "="
         for i, s in enumerate(self.info):
@@ -204,7 +342,7 @@ class VariantTable:
 
     def format_field(self, name: str, sample: int = 0) -> list[str | None]:
         """Raw string of one FORMAT key for one sample, per record (None if absent)."""
-        if self.fmt_keys is None or self.sample_cols is None:
+        if self.fmt_keys is None or self.sample_cols is None:  # property materializes lazily
             return [None] * len(self)
         out: list[str | None] = []
         for i in range(len(self)):
@@ -223,6 +361,8 @@ class VariantTable:
 
     def genotypes(self, sample: int = 0) -> np.ndarray:
         """(n, 2) int8 diploid genotype; -1 for missing/haploid-second slot; phasing dropped."""
+        if sample == 0 and self.aux is not None:
+            return self.aux.gt.copy()  # cache stays pristine if callers edit
         gt_strs = self.format_field("GT", sample)
         out = np.full((len(self), 2), -1, dtype=np.int8)
         for i, g in enumerate(gt_strs):
@@ -236,6 +376,12 @@ class VariantTable:
 
     def format_numeric(self, name: str, sample: int = 0, max_len: int | None = None, missing=-1) -> np.ndarray:
         """Padded (n, max_len) numeric tensor of a comma-listed FORMAT field (e.g. PL, AD)."""
+        if sample == 0 and self.aux is not None and name in ("GQ", "DP") and max_len in (None, 1):
+            vals = self.aux.gq if name == "GQ" else self.aux.dp_fmt
+            out = vals.astype(np.float64)[:, None]
+            if not (isinstance(missing, float) and np.isnan(missing)):
+                out = np.where(np.isnan(out), missing, out)
+            return out
         raw = self.format_field(name, sample)
         split = [r.split(",") if r not in (None, MISSING, "") else [] for r in raw]
         if max_len is None:
@@ -251,6 +397,118 @@ class VariantTable:
         return out
 
 
+def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | None:
+    """Whole-file ingest through the C++ one-pass scanner (native/src).
+
+    Numeric columns, sample-0 FORMAT numerics, hot INFO keys and allele
+    classes come out of the scan as flat arrays; only the short string
+    columns are materialized here. FORMAT/sample strings stay lazy
+    (NativeAux spans). Returns None when the native library is unavailable
+    (caller uses the streaming Python parser).
+    """
+    from variantcalling_tpu import native
+
+    if not native.available():
+        return None
+    if str(path).endswith((".gz", ".bgz")):
+        if os.path.getsize(path) > NATIVE_INFLATE_MAX_BYTES:
+            return None
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        arr = native.bgzf_decompress_array(raw)
+        if arr is None:
+            return None
+        bufb = arr.tobytes()
+    else:
+        with open(path, "rb") as fh:
+            bufb = fh.read()
+    buf_np = np.frombuffer(bufb, dtype=np.uint8)
+
+    header = VcfHeader()
+    off, n = 0, len(bufb)
+    while off < n:
+        nl = bufb.find(b"\n", off)
+        end = nl if nl >= 0 else n
+        if end > off and bufb[off : off + 1] != b"#":
+            break
+        line = bufb[off:end].decode("utf-8", "replace")
+        if line.startswith("##"):
+            header.add_meta_line(line)
+        elif line.startswith("#"):
+            cols = line.rstrip("\r").split("\t")
+            if len(cols) > 9:
+                header.samples = cols[9:]
+        off = end + 1
+
+    parsed = native.vcf_parse(buf_np, len(header.samples))
+    if parsed is None:
+        return None
+    nrec = parsed["n"]
+    text = bufb.decode("latin-1")  # ASCII-safe; str slicing beats bytes+decode
+
+    def col(slot: int) -> np.ndarray:
+        spans = parsed["field_spans"][:, slot, :].tolist()
+        out = np.empty(nrec, dtype=object)
+        for i, (a, b) in enumerate(spans):
+            out[i] = text[a:b]
+        return out
+
+    chrom_names = np.array(parsed["chroms"] + [""], dtype=object)
+    if drop_format:
+        # mirror the Python path: no sample data retained, and release the
+        # text buffer (numeric/INFO/allele caches are kept — they are small)
+        aux = NativeAux(
+            buf=None,
+            has_format=False,
+            line_spans=np.zeros((nrec, 2), dtype=np.int64),
+            tail_spans=np.zeros((nrec, 2), dtype=np.int64),
+            info_spans=np.zeros((nrec, 2), dtype=np.int64),
+            filter_spans=np.zeros((nrec, 2), dtype=np.int64),
+            gt=np.full((nrec, 2), -1, dtype=np.int8),
+            gt_phased=np.zeros(nrec, dtype=np.uint8),
+            gq=np.full(nrec, np.nan, dtype=np.float32),
+            dp_fmt=np.full(nrec, np.nan, dtype=np.float32),
+            ad=np.full((nrec, 3), np.nan, dtype=np.float32),
+            info_vals=parsed["info_vals"],
+            info_keys=tuple(native.VCF_INFO_KEYS),
+            alle={
+                k: parsed[k]
+                for k in ("aclass", "indel_length", "indel_nuc", "ref_code", "alt_code", "n_alts", "ref_len")
+            },
+        )
+    else:
+        aux = NativeAux(
+            buf=buf_np,
+            line_spans=parsed["line_spans"],
+            tail_spans=parsed["field_spans"][:, 5, :],
+            info_spans=parsed["field_spans"][:, 4, :],
+            filter_spans=parsed["field_spans"][:, 3, :],
+            gt=parsed["gt"],
+            gt_phased=parsed["gt_phased"],
+            gq=parsed["gq"],
+            dp_fmt=parsed["dp_fmt"],
+            ad=parsed["ad"],
+            info_vals=parsed["info_vals"],
+            info_keys=tuple(native.VCF_INFO_KEYS),
+            alle={
+                k: parsed[k]
+                for k in ("aclass", "indel_length", "indel_nuc", "ref_code", "alt_code", "n_alts", "ref_len")
+            },
+        )
+    return VariantTable(
+        header=header,
+        chrom=chrom_names[parsed["chrom_codes"]] if nrec else np.empty(0, dtype=object),
+        pos=parsed["pos"],
+        vid=col(0),
+        ref=col(1),
+        alt=col(2),
+        qual=parsed["qual"],
+        filters=col(3),
+        info=col(4),
+        aux=aux,
+    )
+
+
 def read_vcf(
     path: str,
     region: tuple[str, int, int] | None = None,
@@ -258,10 +516,16 @@ def read_vcf(
 ) -> VariantTable:
     """Parse a VCF/gVCF (.vcf or .vcf.gz) into a :class:`VariantTable`.
 
-    ``region`` is (chrom, start_1based, end_inclusive); served from the
-    sibling ``.tbi`` index when present (io/tabix — only covering BGZF
-    blocks are inflated), streaming filter otherwise.
+    Whole-file reads go through the native C++ scanner when built
+    (:func:`_read_vcf_native`); ``region`` is (chrom, start_1based,
+    end_inclusive), served from the sibling ``.tbi`` index when present
+    (io/tabix — only covering BGZF blocks are inflated), streaming filter
+    otherwise.
     """
+    if region is None:
+        table = _read_vcf_native(path, drop_format=drop_format)
+        if table is not None:
+            return table
     header = VcfHeader()
     chrom: list[str] = []
     pos: list[int] = []
@@ -385,6 +649,33 @@ def write_vcf(
         opener = lambda p, _mode: BgzfWriter(p)  # noqa: E731 — tabix-compatible blocks
     else:
         opener = open
+    # tail fast path: FORMAT/sample columns come verbatim from the original
+    # byte buffer (never materialized => never edited); all eight core
+    # columns are rebuilt from the (possibly caller-edited) column arrays,
+    # so in-place edits to chrom/pos/qual/... are always honored.
+    fast = (
+        table.aux is not None
+        and table.aux.buf is not None
+        and fmt_override is None
+        and sample_overrides is None
+        and not table.format_materialized
+    )
+    if not fast:
+        table.materialize_format()  # slow path renders FORMAT/sample strings per record
+    if fast:
+        with opener(path, "wb") as out:
+            for line in table.header.lines:
+                out.write((line + "\n").encode())
+            out.write((table.header.column_header() + "\n").encode())
+            _write_records_fast(out, table, new_filters, extra_info)
+        if index and str(path).endswith(".gz"):
+            from variantcalling_tpu.io.tabix import build_tabix_index
+
+            try:
+                build_tabix_index(str(path))
+            except (ValueError, OSError):
+                pass
+        return
     with opener(path, "wt") as out:
         for line in table.header.lines:
             out.write(line + "\n")
@@ -431,3 +722,57 @@ def write_vcf(
             build_tabix_index(str(path))
         except (ValueError, OSError):
             pass  # unsorted/odd inputs: the VCF itself is still valid
+
+
+def _format_extra_info_bytes(n: int, extra_info: dict) -> list[bytes]:
+    """Per-record b";K=V" suffixes, vectorized per key where possible."""
+    suffix = [b""] * n
+    for k, vals in (extra_info or {}).items():
+        kb = k.encode()
+        arr = np.asarray(vals)
+        if arr.dtype.kind == "f":
+            strs = np.char.mod(b"%g", arr.astype(np.float64))
+            ok = ~np.isnan(arr.astype(np.float64))
+            for i in np.nonzero(ok)[0]:
+                suffix[i] += b";" + kb + b"=" + strs[i]
+        else:
+            for i in range(n):
+                v = vals[i]
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    continue
+                if v is True:
+                    suffix[i] += b";" + kb
+                else:
+                    suffix[i] += b";" + kb + b"=" + str(v).encode()
+    return suffix
+
+
+def _write_records_fast(out, table: VariantTable, new_filters, extra_info) -> None:
+    """Record writeback with the FORMAT/sample tail copied verbatim from the
+    original buffer (NativeAux spans); the eight core columns are rebuilt
+    from the live column arrays so caller edits are always written."""
+    aux = table.aux
+    bufb = aux.buf.tobytes()
+    n = len(table)
+    tails = aux.tail_spans.tolist()
+    suffix = _format_extra_info_bytes(n, extra_info) if extra_info else None
+    filters = new_filters if new_filters is not None else table.filters
+    pos_s = np.char.mod("%d", table.pos)  # vectorized int formatting
+    chrom, vid, ref, alt, info_col, qual = table.chrom, table.vid, table.ref, table.alt, table.info, table.qual
+    chunks: list[bytes] = []
+    for i in range(n):
+        info = info_col[i]
+        if suffix is not None and suffix[i]:
+            sfx = suffix[i].decode()
+            info = sfx[1:] if info == MISSING else info + sfx
+        ta, tb = tails[i]
+        tail = b"\t" + bufb[ta:tb] if tb > ta else b""
+        line = "\t".join(
+            (chrom[i], pos_s[i], vid[i], ref[i], alt[i], format_qual(qual[i]), filters[i], info)
+        )
+        chunks.append(line.encode() + tail + b"\n")
+        if len(chunks) >= 16384:
+            out.write(b"".join(chunks))
+            chunks.clear()
+    if chunks:
+        out.write(b"".join(chunks))

@@ -87,6 +87,7 @@ def compress_gvcf_table(
     """
     n = len(table)
     assert table.n_samples == 1, "gVCF compression expects a single-sample file"
+    table.materialize_format()  # record rewriting needs FORMAT/sample strings
     gq = _int_format_field(table, "GQ")
     min_dp = _int_format_field(table, "MIN_DP")
     dp = _int_format_field(table, "DP")
@@ -284,20 +285,7 @@ def cleanup_gvcf(input_path: str, output_path: str) -> tuple[int, int]:
 
 
 def _subset_table(table: VariantTable, mask: np.ndarray) -> VariantTable:
-    sub = VariantTable(
-        header=table.header,
-        chrom=table.chrom[mask],
-        pos=table.pos[mask],
-        vid=table.vid[mask],
-        ref=table.ref[mask],
-        alt=table.alt[mask],
-        qual=table.qual[mask],
-        filters=table.filters[mask],
-        info=table.info[mask],
-    )
-    if table.fmt_keys is not None:
-        sub.fmt_keys = table.fmt_keys[mask]
-        sub.sample_cols = table.sample_cols[mask]
+    sub = table.subset(mask)
     return sub
 
 

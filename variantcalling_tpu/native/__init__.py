@@ -84,6 +84,20 @@ def get_lib() -> ctypes.CDLL | None:
         ]
         lib.vctpu_interval_membership.restype = None
         lib.vctpu_interval_membership.argtypes = [_i64p, _i64p, _i64, _i64p, _i64, _u8p]
+        lib.vctpu_vcf_count.restype = _i64
+        lib.vctpu_vcf_count.argtypes = [_u8p, _i64, _i64p]
+        _f32p = ctypes.POINTER(ctypes.c_float)
+        _f64p = ctypes.POINTER(ctypes.c_double)
+        _i8p = ctypes.POINTER(ctypes.c_int8)
+        lib.vctpu_vcf_parse.restype = _i64
+        lib.vctpu_vcf_parse.argtypes = [
+            _u8p, _i64, _i64, _i64, ctypes.c_int32,
+            _i64p, _i64p, _i64p, _f64p,
+            _i32p, _u8p, _i32p,
+            _i8p, _u8p, _f32p, _f32p, _f32p,
+            _u8p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+            _u8p, _i32p, ctypes.c_int32, _f64p,
+        ]
         _LIB = lib
         return _LIB
 
@@ -171,6 +185,88 @@ def bam_depth(
         min_bq, min_mapq, min_read_length, int(include_deletions), exclude_flags,
     )
     return None if n < 0 else int(n)
+
+
+# INFO keys extracted during the native VCF scan; info_field() serves these
+# from the cache without touching the INFO strings (filter/featurize hot set)
+VCF_INFO_KEYS = ("DP", "SOR", "AF", "QD", "FS", "MQ", "TLOD", "AS_SOR", "DB", "END")
+
+
+def vcf_parse(buf, n_samples: int) -> dict | None:
+    """One-pass columnar parse of an uncompressed VCF text buffer.
+
+    Returns a dict of flat arrays (see vctpu_vcf_parse in src) or None when
+    the native library is unavailable / input malformed — caller falls back
+    to the Python line parser.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    src_arr = np.ascontiguousarray(_u8view(buf))
+    src = src_arr.ctypes.data_as(_u8p)
+    first_off = _i64(0)
+    n = lib.vctpu_vcf_count(src, len(src_arr), ctypes.byref(first_off))
+    if n < 0:
+        return None
+    n = int(n)
+    uniq_cap = 4096
+    f32, f64, i64, i32 = np.float32, np.float64, np.int64, np.int32
+    out = {
+        "n": n,
+        "line_spans": np.empty((n, 2), dtype=i64),
+        "field_spans": np.empty((n, 6, 2), dtype=i64),
+        "pos": np.empty(n, dtype=i64),
+        "qual": np.empty(n, dtype=f64),
+        "chrom_codes": np.empty(n, dtype=i32),
+        "gt": np.empty((n, 2), dtype=np.int8),
+        "gt_phased": np.empty(n, dtype=np.uint8),
+        "gq": np.empty(n, dtype=f32),
+        "dp_fmt": np.empty(n, dtype=f32),
+        "ad": np.empty((n, 3), dtype=f32),
+        "aclass": np.empty(n, dtype=np.uint8),
+        "indel_length": np.empty(n, dtype=i32),
+        "indel_nuc": np.empty(n, dtype=i32),
+        "ref_code": np.empty(n, dtype=i32),
+        "alt_code": np.empty(n, dtype=i32),
+        "n_alts": np.empty(n, dtype=i32),
+        "ref_len": np.empty(n, dtype=i32),
+        "info_vals": np.empty((n, len(VCF_INFO_KEYS)), dtype=f64),
+    }
+    if n == 0:
+        out["chroms"] = []
+        return out
+    uniq_buf = np.zeros(uniq_cap * 64, dtype=np.uint8)
+    uniq_n = (ctypes.c_int32 * 1)(uniq_cap)
+    keys_b = "".join(VCF_INFO_KEYS).encode()
+    keys_arr = np.frombuffer(keys_b, dtype=np.uint8)
+    key_lens = np.asarray([len(k) for k in VCF_INFO_KEYS], dtype=i32)
+
+    def p(a, typ):
+        return a.ctypes.data_as(typ)
+
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    _f64p = ctypes.POINTER(ctypes.c_double)
+    _i8p = ctypes.POINTER(ctypes.c_int8)
+    rc = lib.vctpu_vcf_parse(
+        src, len(src_arr), first_off.value, n, int(n_samples),
+        p(out["line_spans"], _i64p), p(out["field_spans"], _i64p),
+        p(out["pos"], _i64p), p(out["qual"], _f64p),
+        p(out["chrom_codes"], _i32p), p(uniq_buf, _u8p), uniq_n,
+        p(out["gt"], _i8p), p(out["gt_phased"], _u8p),
+        p(out["gq"], _f32p), p(out["dp_fmt"], _f32p), p(out["ad"], _f32p),
+        p(out["aclass"], _u8p), p(out["indel_length"], _i32p), p(out["indel_nuc"], _i32p),
+        p(out["ref_code"], _i32p), p(out["alt_code"], _i32p), p(out["n_alts"], _i32p),
+        p(out["ref_len"], _i32p),
+        p(np.ascontiguousarray(keys_arr), _u8p), p(key_lens, _i32p), len(VCF_INFO_KEYS),
+        p(out["info_vals"], _f64p),
+    )
+    if rc != n:
+        return None
+    n_uniq = uniq_n[0]
+    out["chroms"] = [
+        bytes(uniq_buf[i * 64 : (i + 1) * 64]).rstrip(b"\x00").decode() for i in range(n_uniq)
+    ]
+    return out
 
 
 def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:

@@ -12,7 +12,9 @@
 #include <zlib.h>
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 namespace {
@@ -226,6 +228,329 @@ int64_t vctpu_bam_depth(const uint8_t* buf, int64_t n, const int64_t* contig_sta
     }
     return count;
 }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// VCF record scanner: one pass over the uncompressed text buffer producing
+// columnar arrays. Replaces the per-line Python split on the 5M-variant
+// filter hot path (the reference parses per record via pysam/pandas —
+// SURVEY.md §3.1); numeric fields, sample-0 FORMAT numerics, hot INFO keys
+// and allele classification all come out as flat arrays ready for device
+// transfer, so the Python layer only materializes strings it actually uses.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline int base_code(uint8_t c) {
+    switch (c) {
+        case 'A': case 'a': return 0;
+        case 'C': case 'c': return 1;
+        case 'G': case 'g': return 2;
+        case 'T': case 't': return 3;
+        default: return 4;
+    }
+}
+
+inline double parse_double(const uint8_t* s, int64_t len) {
+    if (len <= 0 || (len == 1 && s[0] == '.')) return NAN;
+    char tmp[64];
+    int64_t m = len < 63 ? len : 63;
+    std::memcpy(tmp, s, m);
+    tmp[m] = 0;
+    char* end = nullptr;
+    double v = strtod(tmp, &end);
+    if (end == tmp) return NAN;
+    return v;
+}
+
+inline int64_t parse_i64(const uint8_t* s, int64_t len) {
+    int64_t v = 0;
+    bool neg = false;
+    int64_t i = 0;
+    if (len > 0 && (s[0] == '-' || s[0] == '+')) { neg = s[0] == '-'; i = 1; }
+    for (; i < len; i++) {
+        if (s[i] < '0' || s[i] > '9') return -1;
+        v = v * 10 + (s[i] - '0');
+    }
+    return neg ? -v : v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of record lines (not starting with '#') and offset of the first one.
+int64_t vctpu_vcf_count(const uint8_t* buf, int64_t n, int64_t* first_rec_off) {
+    int64_t off = 0, count = 0;
+    *first_rec_off = n;
+    while (off < n) {
+        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', n - off);
+        int64_t end = nl ? (nl - buf) : n;
+        if (end > off && buf[off] != '#') {
+            if (count == 0) *first_rec_off = off;
+            count++;
+        }
+        off = end + 1;
+    }
+    return count;
+}
+
+// One-pass columnar parse. All output arrays are caller-allocated for
+// n_rec records (from vctpu_vcf_count). Returns records parsed or -1.
+//
+// field_spans layout per record: 6 x (start, end) byte spans —
+//   [0]=ID [1]=REF [2]=ALT [3]=FILTER [4]=INFO [5]=FORMAT..line-end (tail)
+// aclass bitmask: 1=snp 2=indel 4=ins 8=first-alt-prefixed-by-ref
+// gt/gq/dp/ad are sample-0 FORMAT numerics (NaN/-1 when missing);
+// ad = (ref_count, alt1_count, total). info_vals = (n_rec, n_keys) doubles
+// for the requested INFO keys (first element of comma lists; Flag -> 1).
+int64_t vctpu_vcf_parse(
+    const uint8_t* buf, int64_t n, int64_t start_off, int64_t n_rec, int32_t n_samples,
+    int64_t* line_spans, int64_t* field_spans, int64_t* pos, double* qual,
+    int32_t* chrom_codes, uint8_t* chrom_uniq, int32_t* uniq_inout,
+    int8_t* gt, uint8_t* gt_phased, float* gq, float* dpf, float* ad,
+    uint8_t* aclass, int32_t* indel_length, int32_t* indel_nuc,
+    int32_t* ref_code, int32_t* alt_code, int32_t* n_alts, int32_t* ref_len_out,
+    const uint8_t* keys, const int32_t* key_lens, int32_t n_keys, double* info_vals) {
+    const int32_t uniq_cap = *uniq_inout;
+    int32_t n_uniq = 0;
+    int64_t off = start_off, rec = 0;
+    while (off < n && rec < n_rec) {
+        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', n - off);
+        int64_t end = nl ? (nl - buf) : n;
+        if (end > off && buf[off + (end - off) - 1] == '\r') end--;  // CRLF
+        if (end <= off || buf[off] == '#') { off = (nl ? nl - buf : n) + 1; continue; }
+        line_spans[rec * 2] = off;
+        line_spans[rec * 2 + 1] = end;
+
+        // tokenize up to 10 tab-separated spans: CHROM POS ID REF ALT QUAL FILTER INFO [FORMAT samples...]
+        int64_t fs[9][2];
+        int nf = 0;
+        int64_t p = off;
+        for (; nf < 8 && p <= end; nf++) {
+            const uint8_t* tab = (const uint8_t*)std::memchr(buf + p, '\t', end - p);
+            int64_t fe = tab ? (tab - buf) : end;
+            fs[nf][0] = p;
+            fs[nf][1] = fe;
+            p = fe + 1;
+            if (!tab) { nf++; break; }
+        }
+        if (nf < 8) return -1;  // malformed record
+        int64_t tail_start = p <= end ? p : end;  // FORMAT column onward ('' if absent)
+
+        // CHROM -> dictionary code (linear probe over uniques; contigs are few)
+        {
+            int64_t cl = fs[0][1] - fs[0][0];
+            if (cl > 63) cl = 63;
+            int32_t code = -1;
+            for (int32_t u = 0; u < n_uniq; u++) {
+                const uint8_t* name = chrom_uniq + (int64_t)u * 64;
+                if (name[cl] == 0 && std::memcmp(name, buf + fs[0][0], cl) == 0) { code = u; break; }
+            }
+            if (code < 0) {
+                if (n_uniq >= uniq_cap) return -1;
+                uint8_t* name = chrom_uniq + (int64_t)n_uniq * 64;
+                std::memset(name, 0, 64);
+                std::memcpy(name, buf + fs[0][0], cl);
+                code = n_uniq++;
+            }
+            chrom_codes[rec] = code;
+        }
+        pos[rec] = parse_i64(buf + fs[1][0], fs[1][1] - fs[1][0]);
+        qual[rec] = parse_double(buf + fs[5][0], fs[5][1] - fs[5][0]);
+        field_spans[rec * 12 + 0] = fs[2][0];  field_spans[rec * 12 + 1] = fs[2][1];   // ID
+        field_spans[rec * 12 + 2] = fs[3][0];  field_spans[rec * 12 + 3] = fs[3][1];   // REF
+        field_spans[rec * 12 + 4] = fs[4][0];  field_spans[rec * 12 + 5] = fs[4][1];   // ALT
+        field_spans[rec * 12 + 6] = fs[6][0];  field_spans[rec * 12 + 7] = fs[6][1];   // FILTER
+        field_spans[rec * 12 + 8] = fs[7][0];  field_spans[rec * 12 + 9] = fs[7][1];   // INFO
+        field_spans[rec * 12 + 10] = tail_start; field_spans[rec * 12 + 11] = end;     // tail
+
+        // ---- allele classification (parity: featurize.classify_alleles) ----
+        {
+            const uint8_t* ref = buf + fs[3][0];
+            int64_t rl = fs[3][1] - fs[3][0];
+            const uint8_t* alt = buf + fs[4][0];
+            int64_t al_full = fs[4][1] - fs[4][0];
+            ref_len_out[rec] = (int32_t)rl;
+            uint8_t cls = 0;
+            int32_t ilen = 0, inuc = 4, rc = 4, ac = 4, na = 0;
+            if (!(al_full == 0 || (al_full == 1 && alt[0] == '.'))) {
+                na = 1;
+                for (int64_t i = 0; i < al_full; i++)
+                    if (alt[i] == ',') na++;
+                const uint8_t* comma = (const uint8_t*)std::memchr(alt, ',', al_full);
+                int64_t al = comma ? (comma - alt) : al_full;
+                if (al > 0 && alt[0] != '<') {
+                    if (rl == 1 && al == 1) {
+                        cls |= 1;  // snp
+                        rc = base_code(ref[0]);
+                        ac = base_code(alt[0]);
+                    } else if (rl != al) {
+                        cls |= 2;  // indel
+                        const uint8_t* diff;
+                        int64_t dlen;
+                        if (al > rl) {
+                            cls |= 4;  // ins
+                            bool pref = (al >= rl) && std::memcmp(alt, ref, rl) == 0;
+                            if (pref) cls |= 8;
+                            diff = pref ? alt + rl : alt + 1;
+                            dlen = pref ? al - rl : al - 1;
+                        } else {
+                            bool pref = (rl >= al) && std::memcmp(ref, alt, al) == 0;
+                            if (pref) cls |= 8;
+                            diff = pref ? ref + al : ref + 1;
+                            dlen = pref ? rl - al : rl - 1;
+                        }
+                        ilen = (int32_t)(al > rl ? al - rl : rl - al);
+                        int u = -2;  // unset
+                        for (int64_t i = 0; i < dlen; i++) {
+                            int c = base_code(diff[i] >= 'a' ? diff[i] - 32 : diff[i]);
+                            if (u == -2) u = c;
+                            else if (u != c) { u = -1; break; }
+                        }
+                        inuc = (u >= 0) ? u : 4;
+                    }
+                }
+            }
+            aclass[rec] = cls;
+            indel_length[rec] = ilen;
+            indel_nuc[rec] = inuc;
+            ref_code[rec] = rc;
+            alt_code[rec] = ac;
+            n_alts[rec] = na;
+        }
+
+        // ---- INFO numeric keys ----
+        if (n_keys > 0) {
+            for (int32_t k = 0; k < n_keys; k++) info_vals[rec * n_keys + k] = NAN;
+            int64_t ip = fs[7][0], ie = fs[7][1];
+            if (!(ie - ip == 1 && buf[ip] == '.')) {
+                while (ip < ie) {
+                    const uint8_t* semi = (const uint8_t*)std::memchr(buf + ip, ';', ie - ip);
+                    int64_t ee = semi ? (semi - buf) : ie;
+                    const uint8_t* eq = (const uint8_t*)std::memchr(buf + ip, '=', ee - ip);
+                    int64_t klen = eq ? (eq - buf - ip) : (ee - ip);
+                    int64_t koff = 0;
+                    for (int32_t k = 0; k < n_keys; k++) {
+                        int32_t kl = key_lens[k];
+                        if (kl == klen && std::memcmp(keys + koff, buf + ip, klen) == 0) {
+                            if (!eq) {
+                                info_vals[rec * n_keys + k] = 1.0;  // Flag
+                            } else {
+                                int64_t vs = ip + klen + 1;
+                                const uint8_t* comma = (const uint8_t*)std::memchr(buf + vs, ',', ee - vs);
+                                int64_t ve = comma ? (comma - buf) : ee;
+                                info_vals[rec * n_keys + k] = parse_double(buf + vs, ve - vs);
+                            }
+                            break;
+                        }
+                        koff += kl;
+                    }
+                    ip = ee + 1;
+                }
+            }
+        }
+
+        // ---- FORMAT sample-0 numerics (GT / GQ / DP / AD) ----
+        gt[rec * 2] = -1; gt[rec * 2 + 1] = -1; gt_phased[rec] = 0;
+        gq[rec] = NAN; dpf[rec] = NAN;
+        ad[rec * 3] = NAN; ad[rec * 3 + 1] = NAN; ad[rec * 3 + 2] = NAN;
+        if (n_samples > 0 && tail_start < end) {
+            // FORMAT keys
+            const uint8_t* ftab = (const uint8_t*)std::memchr(buf + tail_start, '\t', end - tail_start);
+            int64_t fend = ftab ? (ftab - buf) : end;
+            int gt_i = -1, gq_i = -1, dp_i = -1, ad_i = -1;
+            {
+                int idx = 0;
+                int64_t kp = tail_start;
+                while (kp < fend) {
+                    const uint8_t* colon = (const uint8_t*)std::memchr(buf + kp, ':', fend - kp);
+                    int64_t ke = colon ? (colon - buf) : fend;
+                    int64_t kl = ke - kp;
+                    if (kl == 2) {
+                        if (buf[kp] == 'G' && buf[kp + 1] == 'T') gt_i = idx;
+                        else if (buf[kp] == 'G' && buf[kp + 1] == 'Q') gq_i = idx;
+                        else if (buf[kp] == 'D' && buf[kp + 1] == 'P') dp_i = idx;
+                        else if (buf[kp] == 'A' && buf[kp + 1] == 'D') ad_i = idx;
+                    }
+                    idx++;
+                    kp = ke + 1;
+                }
+            }
+            if (ftab) {
+                int64_t sp = fend + 1;
+                const uint8_t* stab = (const uint8_t*)std::memchr(buf + sp, '\t', end - sp);
+                int64_t send = stab ? (stab - buf) : end;
+                int idx = 0;
+                int64_t vp = sp;
+                while (vp <= send) {
+                    const uint8_t* colon = (const uint8_t*)std::memchr(buf + vp, ':', send - vp);
+                    int64_t ve = colon ? (colon - buf) : send;
+                    if (idx == gt_i && ve > vp) {
+                        // a[/|]b (or haploid a)
+                        const uint8_t* s = buf + vp;
+                        int64_t l = ve - vp;
+                        int64_t sep = -1;
+                        for (int64_t i = 0; i < l; i++)
+                            if (s[i] == '/' || s[i] == '|') { sep = i; break; }
+                        int64_t a_len = sep >= 0 ? sep : l;
+                        if (!(a_len == 1 && s[0] == '.')) {
+                            int64_t v = parse_i64(s, a_len);
+                            if (v >= -128 && v <= 127) gt[rec * 2] = (int8_t)v;
+                        }
+                        if (sep >= 0) {
+                            gt_phased[rec] = s[sep] == '|';
+                            int64_t b_len = l - sep - 1;
+                            // second diploid slot only (extra ploidy ignored)
+                            const uint8_t* b = s + sep + 1;
+                            int64_t b2 = b_len;
+                            for (int64_t i = 0; i < b_len; i++)
+                                if (b[i] == '/' || b[i] == '|') { b2 = i; break; }
+                            if (!(b2 == 1 && b[0] == '.')) {
+                                int64_t v = parse_i64(b, b2);
+                                if (v >= -128 && v <= 127) gt[rec * 2 + 1] = (int8_t)v;
+                            }
+                        }
+                    } else if (idx == gq_i) {
+                        gq[rec] = (float)parse_double(buf + vp, ve - vp);
+                    } else if (idx == dp_i) {
+                        dpf[rec] = (float)parse_double(buf + vp, ve - vp);
+                    } else if (idx == ad_i && ve > vp) {
+                        double total = 0;
+                        int ai = 0;
+                        bool any = false;
+                        int64_t ap = vp;
+                        while (ap < ve) {
+                            const uint8_t* comma = (const uint8_t*)std::memchr(buf + ap, ',', ve - ap);
+                            int64_t ae = comma ? (comma - buf) : ve;
+                            double v = parse_double(buf + ap, ae - ap);
+                            if (v == v) {  // not NaN
+                                any = true;
+                                if (v > 0) total += v;
+                                if (ai < 2) ad[rec * 3 + ai] = (float)v;
+                            }
+                            ai++;
+                            ap = ae + 1;
+                        }
+                        if (any) ad[rec * 3 + 2] = (float)total;
+                    }
+                    idx++;
+                    if (!colon || ve >= send) break;
+                    vp = ve + 1;
+                }
+            }
+        }
+        rec++;
+        off = (nl ? nl - buf : n) + 1;
+    }
+    *uniq_inout = n_uniq;
+    return rec;
+}
+
+}  // extern "C"
+
+extern "C" {
 
 // Membership of each position in a set of sorted, non-overlapping,
 // half-open [start, end) intervals. out[i] = 1 if covered.

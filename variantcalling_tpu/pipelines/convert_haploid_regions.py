@@ -51,6 +51,7 @@ def _in_regions_mask(chrom: np.ndarray, pos: np.ndarray, regions: list[tuple[str
 
 def convert_haploid(table, regions: list[tuple[str, int, int]]):
     """New (fmt-preserving) sample strings with haploid GT/GQ/PL in regions."""
+    table.materialize_format()  # sample-string rewrite needs the raw columns
     n = len(table)
     in_region = _in_regions_mask(table.chrom, table.pos, regions)
     gt_raw = table.format_field("GT")

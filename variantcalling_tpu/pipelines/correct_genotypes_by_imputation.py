@@ -119,6 +119,7 @@ def run(argv: list[str]) -> int:
     table.header.ensure_format("GQ0", "1", "Integer", "GQ (pre-imputation)")
     table.header.ensure_format("PL0", "G", "Integer", "PL (pre-imputation)")
     retained = ("GT0", "GQ0", "PL0")
+    table.materialize_format()  # sample-string rewrite needs the raw columns
     fmt_override = np.array(table.fmt_keys, dtype=object)
     sample0 = np.array(table.sample_cols[:, 0], dtype=object)
     for i in range(n):

@@ -104,23 +104,38 @@ def _is_cg_insertion(table: VariantTable, windows: np.ndarray, center: int) -> n
     docs/filter_variants_pipeline.md "Should CCG/GGC insertions be filtered out?").
 
     A single-base insertion of C between C and G (anchor C, next ref base G
-    -> CCG) or of G between G and C (anchor G, next C -> GGC). The next
-    reference base comes from the gathered window tensor.
+    -> CCG) or of G between G and C (anchor G, next C -> GGC). Vectorized:
+    the inserted base is the native scan's indel_nuc (single-base diff), the
+    anchor and next reference base come from the gathered window tensor.
     """
-    out = np.zeros(len(table), dtype=bool)
-    code = {"C": 1, "G": 2}
-    for i in range(len(table)):
-        ref = table.ref[i]
-        alt = table.alt[i].split(",")[0]
-        if len(alt) == len(ref) + 1 and alt.startswith(ref):
-            ins = alt[len(ref) :]
-            anchor = ref[-1]
-            next_base = int(windows[i, center + 1])
-            if ins == "C" and anchor == "C" and next_base == code["G"]:
-                out[i] = True
-            elif ins == "G" and anchor == "G" and next_base == code["C"]:
-                out[i] = True
-    return out
+    n = len(table)
+    from variantcalling_tpu.featurize import classify_alleles
+
+    alle = classify_alleles(table)
+    aux = table.aux
+    if aux is not None:
+        prefix_ins = (aux.alle["aclass"] & 8).astype(bool)
+        ref_len = aux.alle["ref_len"]
+    else:
+        ref_len = np.fromiter(map(len, table.ref), dtype=np.int64, count=n)
+        alt0_len = np.fromiter(
+            (len(a) if "," not in a else a.index(",") for a in table.alt), dtype=np.int64, count=n
+        )
+        cand = alle.is_ins & (alt0_len == ref_len + 1)
+        prefix_ins = np.zeros(n, dtype=bool)
+        for i in np.nonzero(cand)[0]:
+            prefix_ins[i] = table.alt[i].split(",")[0].startswith(table.ref[i])
+    # single-base left-anchored insertion; anchor base = ref[-1]. The window
+    # is centered on POS (first ref base), so anchor sits at center+ref_len-1
+    # and the next reference base right after it.
+    cand = alle.is_ins & prefix_ins & (alle.indel_length == 1)
+    anchor_idx = np.minimum(center + ref_len - 1, windows.shape[1] - 1)
+    next_idx = np.minimum(anchor_idx + 1, windows.shape[1] - 1)
+    rows = np.arange(n)
+    anchor = windows[rows, anchor_idx].astype(np.int32)
+    nxt = windows[rows, next_idx].astype(np.int32)
+    ins = alle.indel_nuc  # C=1, G=2
+    return cand & (((ins == 1) & (anchor == 1) & (nxt == 2)) | ((ins == 2) & (anchor == 2) & (nxt == 1)))
 
 
 def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray:
@@ -219,16 +234,13 @@ def filter_variants(
             gs, ge = coords.globalize_intervals(runs)
             hpol_near = iops.distance_to_nearest(gpos, gs, ge) <= hpol_dist
 
-    filters = np.empty(n, dtype=object)
-    for i in range(n):
-        parts = []
-        if cohort_fp[i]:
-            parts.append(COHORT_FP)
-        elif low[i]:
-            parts.append(LOW_SCORE)
-        if hpol_near[i]:
-            parts.append(HPOL_RUN)
-        filters[i] = ";".join(parts) if parts else PASS
+    # vectorized FILTER assembly (no per-record Python on the 5M path):
+    # COHORT_FP beats LOW_SCORE; HPOL_RUN appends with ';'
+    base = np.where(cohort_fp, COHORT_FP, np.where(low, LOW_SCORE, ""))
+    base = base.astype(object)
+    hp = np.where(base == "", HPOL_RUN, base + (";" + HPOL_RUN))
+    filters = np.where(hpol_near, hp, base)
+    filters = np.where(filters == "", PASS, filters).astype(object)
     return score, filters
 
 
@@ -279,21 +291,7 @@ def run(argv: list[str]) -> int:
 
 
 def _subset(table: VariantTable, keep: np.ndarray) -> VariantTable:
-    from dataclasses import replace
-
-    return replace(
-        table,
-        chrom=table.chrom[keep],
-        pos=table.pos[keep],
-        vid=table.vid[keep],
-        ref=table.ref[keep],
-        alt=table.alt[keep],
-        qual=table.qual[keep],
-        filters=table.filters[keep],
-        info=table.info[keep],
-        fmt_keys=table.fmt_keys[keep] if table.fmt_keys is not None else None,
-        sample_cols=table.sample_cols[keep] if table.sample_cols is not None else None,
-    )
+    return table.subset(keep)
 
 
 if __name__ == "__main__":

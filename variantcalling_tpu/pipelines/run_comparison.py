@@ -85,6 +85,8 @@ def _concat_tables(tables: list[VariantTable]) -> VariantTable:
     if len(tables) == 1:
         return tables[0]
     base = tables[0]
+    for t in tables:
+        t.materialize_format()  # cross-buffer concat cannot keep lazy spans
     kw = {}
     for f in ("chrom", "pos", "vid", "ref", "alt", "qual", "filters", "info"):
         kw[f] = np.concatenate([getattr(t, f) for t in tables])
@@ -96,12 +98,7 @@ def _concat_tables(tables: list[VariantTable]) -> VariantTable:
 
 
 def _subset(table: VariantTable, mask: np.ndarray) -> VariantTable:
-    kw = {f: getattr(table, f)[mask] for f in ("chrom", "pos", "vid", "ref", "alt", "qual", "filters", "info")}
-    out = VariantTable(header=table.header, **kw)
-    if table.fmt_keys is not None:
-        out.fmt_keys = table.fmt_keys[mask]
-        out.sample_cols = table.sample_cols[mask]
-    return out
+    return table.subset(mask)
 
 
 def _gt_strings(table: VariantTable) -> list[str]:

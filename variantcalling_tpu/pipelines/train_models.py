@@ -147,16 +147,7 @@ def _exome_weight(args, names: list[str], x: np.ndarray) -> np.ndarray:
 
 
 def _subset_table(table, mask: np.ndarray):
-    from dataclasses import replace
-
-    kw = {}
-    for f in ("chrom", "pos", "vid", "ref", "alt", "qual", "filters", "info"):
-        kw[f] = getattr(table, f)[mask]
-    t = replace(table, **kw)
-    if table.fmt_keys is not None:
-        t.fmt_keys = table.fmt_keys[mask]
-        t.sample_cols = table.sample_cols[mask]
-    return t
+    return table.subset(mask)
 
 
 def _interval_name(path: str) -> str:

@@ -59,20 +59,7 @@ def get_parser():
 
 
 def _subset(table: VariantTable, mask: np.ndarray) -> VariantTable:
-    sub = VariantTable(
-        header=table.header,
-        chrom=table.chrom[mask],
-        pos=table.pos[mask],
-        vid=table.vid[mask],
-        ref=table.ref[mask],
-        alt=table.alt[mask],
-        qual=table.qual[mask],
-        filters=table.filters[mask],
-        info=table.info[mask],
-    )
-    if table.fmt_keys is not None:
-        sub.fmt_keys = table.fmt_keys[mask]
-        sub.sample_cols = table.sample_cols[mask]
+    sub = table.subset(mask)
     return sub
 
 
