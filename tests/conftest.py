@@ -7,6 +7,7 @@ bench.py and the driver's dryrun.
 """
 
 import os
+import sys
 
 # Force CPU for tests even when the environment presets a TPU platform
 # (e.g. JAX_PLATFORMS=axon); the real chip is exercised by bench.py only.
@@ -14,6 +15,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# A sitecustomize (e.g. /root/.axon_site) may have imported jax at interpreter
+# startup, capturing JAX_PLATFORMS before the env mutation above. The config
+# can still be redirected until the first backend init, which no sitecustomize
+# performs eagerly — so update it through jax.config here.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 

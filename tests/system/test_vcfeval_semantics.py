@@ -1,0 +1,212 @@
+"""Reproduce the reference's published expected-count tables on equivalent inputs.
+
+The reference validates its comparison engine against rtg vcfeval with two
+published tables (git-lfs fixtures, unhydrated in the snapshot):
+
+1. vcfeval_flavors penalty table —
+   /root/reference/test/system/test_vcfeval_flavors.py:12-17: with 1 indel
+   allele-error site among the errors, tp/fp/fn go
+   (24,6,7)@p=2 -> (24,5.5,6.5)@p=1 -> (24,5,6)@p=0 -> (25,5,6)@p=-1 with
+   precision 80.0 -> 83.33 and recall 77.42 -> 80.65.
+2. evaluate_concordance accuracy table —
+   /root/reference/docs/evaluate_concordance.md:49-58: per-category
+   tp/fp/fn + P/R/F1 (SNP f1 0.99401 ... INDELS f1 0.84524).
+
+These tests synthesize inputs with the same error structure (counts per
+category, allele/genotype error sites) and assert the full pipeline —
+native matcher -> concordance frame -> accuracy metrics — reproduces the
+published numbers exactly.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tests.fixtures import write_fasta
+
+BLOCK = 64  # one variant site per block; blocks never share a match cluster
+ANCHOR = 20  # 0-based offset of the anchor base within a block
+FILLER = "GACTGCAGTCAGCTGATCGACTGCAGTCAGCTGATCGACTGCAGTCAGCTGATCGACTGCAGTC"
+
+
+class SiteBuilder:
+    """Lay out one variant site per 64bp block of a synthetic contig."""
+
+    def __init__(self):
+        self.blocks = [FILLER[:BLOCK]]  # block 0 variant-free (window padding)
+        self.call_rows: list[str] = []
+        self.truth_rows: list[str] = []
+
+    def _add_block(self, run_len: int = 0, run_nuc: str = "T") -> int:
+        """Append a block; optional homopolymer run right after the anchor.
+
+        Returns the 1-based position of the anchor base ('A').
+        """
+        body = list(FILLER[:BLOCK])
+        body[ANCHOR] = "A"
+        body[ANCHOR - 1] = "C"
+        for k in range(run_len):
+            body[ANCHOR + 1 + k] = run_nuc
+        body[ANCHOR + 1 + run_len] = "G"  # terminate the run
+        pos = len(self.blocks) * BLOCK + ANCHOR + 1
+        self.blocks.append("".join(body))
+        return pos
+
+    def _emit(self, where: str, pos: int, ref: str, alt: str, gt: str = "0/1"):
+        row = f"chr1\t{pos}\t.\t{ref}\t{alt}\t50\tPASS\t.\tGT\t{gt}"
+        if where in ("both", "call"):
+            self.call_rows.append(row)
+        if where in ("both", "truth"):
+            self.truth_rows.append(row)
+
+    def snp(self, where: str):
+        pos = self._add_block()
+        self._emit(where, pos, "A", "G")
+
+    def nonhmer_indel(self, where: str):
+        # 2-base mixed insertion: never an hmer, not shiftable against FILLER
+        pos = self._add_block()
+        self._emit(where, pos, "A", "ACG")
+
+    def hmer_indel(self, where: str, length: int):
+        # insert one T before a T-run of `length` -> hmer_indel_length == length
+        pos = self._add_block(run_len=length, run_nuc="T")
+        self._emit(where, pos, "A", "AT")
+
+    def allele_error(self):
+        # same site, different indel allele on each side (the reference's
+        # "indel allele error", e.g. chr1:805514 AC>A vs truth)
+        pos = self._add_block()
+        self._emit("call", pos, "AG", "A")  # deletes the G after the anchor
+        self._emit("truth", pos, "A", "ACTT")
+
+    def write(self, d):
+        seq = "".join(self.blocks) + FILLER[:BLOCK]
+        write_fasta(str(d / "ref.fa"), {"chr1": seq})
+        header = (
+            "##fileformat=VCFv4.2\n"
+            '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n'
+            f"##contig=<ID=chr1,length={len(seq)}>\n"
+            "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n"
+        )
+
+        def key(row):
+            return int(row.split("\t")[1])
+
+        (d / "calls.vcf").write_text(header + "\n".join(sorted(self.call_rows, key=key)) + "\n")
+        (d / "truth.vcf").write_text(header + "\n".join(sorted(self.truth_rows, key=key)) + "\n")
+        (d / "hcr.bed").write_text(f"chr1\t0\t{len(seq)}\n")
+        return d
+
+
+@pytest.fixture(scope="module")
+def penalty_fixture(tmp_path_factory):
+    """Same indel error structure as the reference's chr1 fixture: 24 indel
+    TPs, 1 allele-error site, 5 novel FP indels, 6 uncalled truth indels
+    (plus SNP background that must not leak into the indels row)."""
+    b = SiteBuilder()
+    for _ in range(24):
+        b.nonhmer_indel("both")
+    b.allele_error()
+    for _ in range(5):
+        b.nonhmer_indel("call")
+    for _ in range(6):
+        b.nonhmer_indel("truth")
+    for _ in range(10):
+        b.snp("both")
+    b.snp("call")
+    b.snp("truth")
+    b.snp("truth")
+    return b.write(tmp_path_factory.mktemp("penalty"))
+
+
+@pytest.mark.parametrize(
+    "penalty,tp,fp,fn,precision,recall",
+    [
+        (2, 24, 6, 7, 80.0, 77.42),
+        (1, 24, 5.5, 6.5, 81.36, 78.69),
+        (0, 24, 5, 6, 82.76, 80.0),
+        (-1, 25, 5, 6, 83.33, 80.65),
+    ],
+)
+def test_reference_penalty_table(penalty_fixture, tmp_path, penalty, tp, fp, fn, precision, recall):
+    """Reference test_vcfeval_flavors.py:12-17 penalty rows, bit-for-bit."""
+    from variantcalling_tpu.pipelines.vcfeval_flavors import run
+
+    result = run(
+        [
+            "-b", str(penalty_fixture / "truth.vcf"),
+            "-c", str(penalty_fixture / "calls.vcf"),
+            "-e", str(penalty_fixture / "hcr.bed"),
+            "-o", str(tmp_path / f"out_{penalty}"),
+            "-t", str(penalty_fixture / "ref.fa"),
+            "-p", str(penalty),
+        ]
+    )
+    vtype, r_tp, r_fp, r_fn, r_prec, r_rec, _f1 = result[1].split()
+    assert vtype == "indels"
+    assert float(r_tp) == tp
+    assert float(r_fp) == fp
+    assert float(r_fn) == fn
+    assert float(r_prec) == precision
+    assert float(r_rec) == recall
+
+
+# docs/evaluate_concordance.md:49-58 — (category, hmer_len, tp, fp, fn, P, R, F1)
+ACCURACY_TABLE = [
+    ("SNP", None, 747, 3, 6, 0.996, 0.99203, 0.99401),
+    ("Non-hmer INDEL", 0, 36, 3, 3, 0.92308, 0.92308, 0.92308),
+    ("HMER indel <= 4", 3, 14, 1, 1, 0.93333, 0.93333, 0.93333),
+    ("HMER indel (4:8]", 6, 5, 0, 0, 1.0, 1.0, 1.0),
+    ("HMER indel [8:10]", 9, 9, 0, 0, 1.0, 1.0, 1.0),
+    ("HMER indel 11:12", 12, 7, 0, 3, 1.0, 0.7, 0.82353),
+    ("HMER indel > 12", 14, 0, 2, 13, 0.0, 0.0, 0.0),
+    ("INDELS", None, 71, 6, 20, 0.92208, 0.78022, 0.84524),
+]
+
+
+@pytest.fixture(scope="module")
+def accuracy_fixture(tmp_path_factory):
+    b = SiteBuilder()
+    for name, hlen, tp, fp, fn, *_ in ACCURACY_TABLE:
+        if name == "INDELS":
+            continue  # aggregate of the hmer/non-hmer rows
+        for where, count in (("both", tp), ("call", fp), ("truth", fn)):
+            for _ in range(count):
+                if name == "SNP":
+                    b.snp(where)
+                elif hlen == 0:
+                    b.nonhmer_indel(where)
+                else:
+                    b.hmer_indel(where, hlen)
+    return b.write(tmp_path_factory.mktemp("accuracy"))
+
+
+def test_reference_accuracy_table(accuracy_fixture):
+    """docs/evaluate_concordance.md:49-58 optimal_recall_precision, bit-for-bit."""
+    from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.pipelines.run_comparison import build_concordance_frame
+
+    calls = read_vcf(str(accuracy_fixture / "calls.vcf"))
+    truth = read_vcf(str(accuracy_fixture / "truth.vcf"))
+    with FastaReader(str(accuracy_fixture / "ref.fa")) as fasta:
+        df = build_concordance_frame(calls, truth, fasta)
+
+    table = calc_accuracy_metrics(df, "classify").set_index("group")
+    expected = pd.DataFrame(
+        [(n, tp, fp, fn, p, r, f1) for n, _h, tp, fp, fn, p, r, f1 in ACCURACY_TABLE],
+        columns=["group", "tp", "fp", "fn", "precision", "recall", "f1"],
+    ).set_index("group")
+    for group, exp in expected.iterrows():
+        got = table.loc[group]
+        assert (got.tp, got.fp, got.fn) == (exp.tp, exp.fp, exp.fn), (
+            f"{group}: counts {got.tp, got.fp, got.fn} != {exp.tp, exp.fp, exp.fn}"
+        )
+        np.testing.assert_allclose(
+            [got.precision, got.recall, got.f1],
+            [exp.precision, exp.recall, exp.f1],
+            atol=5e-6,
+            err_msg=group,
+        )

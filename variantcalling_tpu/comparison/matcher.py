@@ -132,8 +132,13 @@ def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str) -> Matc
                 truth_tp_gt[j] = True
 
     # ---- stage 3: local haplotype search on the residue ------------------
-    un_c = np.nonzero(~call_tp)[0]
-    un_t = np.nonzero(~truth_tp)[0]
+    # The residue is everything not matched at the GENOTYPE level: a cluster
+    # whose diploid haplotype sets agree is genotype-consistent by
+    # construction, so split-vs-joined multiallelics (call het G + het T vs
+    # truth G/T) and MNP-vs-SNPs recover both classify and classify_gt here
+    # (vcfeval semantics; reference treats rtg as the black-box oracle).
+    un_c = np.nonzero(~call_tp_gt)[0]
+    un_t = np.nonzero(~truth_tp_gt)[0]
     for c_idx, t_idx in _clusters(calls, truth, un_c, un_t):
         if not c_idx or not t_idx:
             continue
