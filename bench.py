@@ -97,7 +97,8 @@ def e2e_pipeline(fixture_dir: str) -> dict:
     out_path = os.path.join(fixture_dir, "out.vcf")
     table.header.ensure_filter("LOW_SCORE", "Model score below threshold")
     table.header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
-    write_vcf(out_path, table, new_filters=filters, extra_info={"TREE_SCORE": np.round(score, 4)})
+    write_vcf(out_path, table, new_filters=filters,
+              extra_info={"TREE_SCORE": np.round(score, 4)}, verbatim_core=True)
     t3 = time.perf_counter()
     n = len(table)
     warm_wall = (t1 - t0) + (t2 - t1b) + (t3 - t2)

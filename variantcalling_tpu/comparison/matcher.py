@@ -132,36 +132,52 @@ def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str) -> Matc
                 truth_tp_gt[j] = True
 
     # ---- stage 3: local haplotype search on the residue ------------------
-    # The residue is everything not matched at the GENOTYPE level: a cluster
-    # whose diploid haplotype sets agree is genotype-consistent by
-    # construction, so split-vs-joined multiallelics (call het G + het T vs
-    # truth G/T) and MNP-vs-SNPs recover both classify and classify_gt here
-    # (vcfeval semantics; reference treats rtg as the black-box oracle).
-    un_c = np.nonzero(~call_tp_gt)[0]
-    un_t = np.nonzero(~truth_tp_gt)[0]
-    for c_idx, t_idx in _clusters(calls, truth, un_c, un_t):
-        if not c_idx or not t_idx:
-            continue
-        if len(c_idx) > MAX_CLUSTER_VARIANTS or len(t_idx) > MAX_CLUSTER_VARIANTS:
-            continue
-        lo = min(min(int(calls.pos[i]) for i in c_idx), min(int(truth.pos[j]) for j in t_idx)) - FLANK
-        hi = max(
-            max(int(calls.pos[i]) + len(calls.ref[i]) for i in c_idx),
-            max(int(truth.pos[j]) + len(truth.ref[j]) for j in t_idx),
-        ) + FLANK
-        lo = max(lo, 1)
-        window = ref_seq[lo - 1 : hi - 1]
-        haps_c = _diploid_haplotypes(calls, c_idx, lo, window)
-        haps_t = _diploid_haplotypes(truth, t_idx, lo, window)
-        if haps_c is None or haps_t is None:
-            continue
-        if haps_c & haps_t:
-            for i in c_idx:
-                call_tp[i] = True
-                call_tp_gt[i] = True
-            for j in t_idx:
-                truth_tp[j] = True
-                truth_tp_gt[j] = True
+    # Two passes with the same bounded search. Pass 1 clusters the
+    # allele-level residue (exact-join misses): a match sets both levels.
+    # Pass 2 clusters the remaining genotype-level residue — a cluster whose
+    # diploid haplotype sets agree is genotype-consistent by construction,
+    # so split-vs-joined multiallelics (call het G + het T vs truth G/T)
+    # recover classify_gt (vcfeval semantics). Running the allele pass first
+    # keeps genotype errors (allele-matched, gt-mismatched sites) from
+    # joining — and poisoning — allele-level clusters.
+    failed: set = set()  # pass-1 clusters that already failed; identical
+    # pass-2 clusters (no gt-only members joined) are skipped, not re-searched
+    for level in ("allele", "genotype"):
+        if level == "allele":
+            un_c = np.nonzero(~call_tp)[0]
+            un_t = np.nonzero(~truth_tp)[0]
+        else:
+            un_c = np.nonzero(~call_tp_gt)[0]
+            un_t = np.nonzero(~truth_tp_gt)[0]
+        for c_idx, t_idx in _clusters(calls, truth, un_c, un_t):
+            if not c_idx or not t_idx:
+                continue
+            ckey = (tuple(c_idx), tuple(t_idx))
+            if ckey in failed:
+                continue
+            if level == "allele":
+                failed.add(ckey)  # removed below on success
+            if len(c_idx) > MAX_CLUSTER_VARIANTS or len(t_idx) > MAX_CLUSTER_VARIANTS:
+                continue
+            lo = min(min(int(calls.pos[i]) for i in c_idx), min(int(truth.pos[j]) for j in t_idx)) - FLANK
+            hi = max(
+                max(int(calls.pos[i]) + len(calls.ref[i]) for i in c_idx),
+                max(int(truth.pos[j]) + len(truth.ref[j]) for j in t_idx),
+            ) + FLANK
+            lo = max(lo, 1)
+            window = ref_seq[lo - 1 : hi - 1]
+            haps_c = _diploid_haplotypes(calls, c_idx, lo, window)
+            haps_t = _diploid_haplotypes(truth, t_idx, lo, window)
+            if haps_c is None or haps_t is None:
+                continue
+            if haps_c & haps_t:
+                failed.discard(ckey)
+                for i in c_idx:
+                    call_tp[i] = True
+                    call_tp_gt[i] = True
+                for j in t_idx:
+                    truth_tp[j] = True
+                    truth_tp_gt[j] = True
 
     return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
 

@@ -11,11 +11,11 @@ features on device, fused with classifier inference.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from variantcalling_tpu.io.bed import IntervalSet
 from variantcalling_tpu.io.fasta import FastaReader, encode_seq
@@ -179,34 +179,90 @@ def _compute_af(table: VariantTable) -> np.ndarray:
     return np.where(np.isnan(ad_af), info_af, ad_af)
 
 
-def featurize(
+def device_feature_dict(windows, is_indel, indel_nuc, ref_code, alt_code, is_snp,
+                        *, center: int, flow_order: str) -> dict:
+    """The window-kernel block, traceable inside any jitted program.
+
+    Single source of truth for the DEVICE_FEATURES columns — featurize()'s
+    standalone program and the filter pipeline's fused featurize+score
+    program both call this, so train/serve feature parity holds by
+    construction.
+    """
+    gc = fops.gc_content(windows, center, radius=10)
+    hmer_len, hmer_nuc = fops.hmer_indel_features(windows, center, is_indel, indel_nuc)
+    left_motif, right_motif = fops.motif_codes(windows, center, k=5)
+    cyc = fops.cycle_skip_status(windows, center, ref_code, alt_code, is_snp, flow_order=flow_order)
+    return {
+        "hmer_indel_length": hmer_len,
+        "hmer_indel_nuc": hmer_nuc,
+        "gc_content": gc,
+        "cycleskip_status": cyc,
+        "left_motif": left_motif,
+        "right_motif": right_motif,
+    }
+
+
+@partial(jax.jit, static_argnames=("center", "flow_order"))
+def _device_feature_program(windows, is_indel, indel_nuc, ref_code, alt_code, is_snp,
+                            *, center: int, flow_order: str):
+    """Jitted standalone wrapper over :func:`device_feature_dict`.
+
+    Module-level so the jit cache persists across featurize() calls — the
+    cycle-skip lax.scan in particular must not retrace per call (it costs a
+    full XLA compile). Cache key = (padded batch shape, center, flow_order).
+    """
+    d = device_feature_dict(windows, is_indel, indel_nuc, ref_code, alt_code, is_snp,
+                            center=center, flow_order=flow_order)
+    return tuple(d[k] for k in DEVICE_FEATURES)
+
+
+_PAD_MIN = 1 << 10
+
+
+def _bucket(n: int) -> int:
+    """Next power-of-two batch size: bounds distinct compiled shapes to log2(N)."""
+    b = _PAD_MIN
+    while b < n:
+        b <<= 1
+    return b
+
+
+# feature columns produced ON DEVICE by the window kernels; everything else
+# in BASE_FEATURES comes from host-side allele/FORMAT/INFO columns
+DEVICE_FEATURES = (
+    "hmer_indel_length",
+    "hmer_indel_nuc",
+    "gc_content",
+    "cycleskip_status",
+    "left_motif",
+    "right_motif",
+)
+
+
+@dataclass
+class HostFeatures:
+    """Host half of featurization: windows + every non-window column.
+
+    ``names`` is the FULL feature order (host + device columns interleaved
+    per BASE_FEATURES); consumers either run the device program to fill the
+    device columns (featurize) or fuse them into a larger device program
+    (filter_variants' featurize+score fusion).
+    """
+
+    alle: AlleleColumns
+    windows: np.ndarray  # (N, 2*WINDOW_RADIUS+1) uint8
+    cols: dict[str, np.ndarray]  # host columns only
+    names: list[str]  # full feature order, incl. DEVICE_FEATURES
+
+
+def host_featurize(
     table: VariantTable,
     fasta: FastaReader,
     annotate_intervals: dict[str, IntervalSet] | None = None,
-    flow_order: str = fops.DEFAULT_FLOW_ORDER,
     extra_info_fields: list[str] | None = None,
-) -> FeatureSet:
-    """Full featurization: BASE_FEATURES + one 0/1 column per annotation interval.
-
-    Device kernels are jit-compiled once per padded batch shape.
-    """
+) -> HostFeatures:
     alle = classify_alleles(table)
     windows = gather_windows(table, fasta)
-
-    jw = jnp.asarray(windows)
-    gc = fops.gc_content(jw, CENTER, radius=10)
-    hmer_len, hmer_nuc = fops.hmer_indel_features(
-        jw, CENTER, jnp.asarray(alle.is_indel), jnp.asarray(alle.indel_nuc)
-    )
-    left_motif, right_motif = fops.motif_codes(jw, CENTER, k=5)
-    cyc = fops.cycle_skip_status(
-        jw,
-        CENTER,
-        jnp.asarray(alle.ref_code),
-        jnp.asarray(alle.alt_code),
-        jnp.asarray(alle.is_snp),
-        flow_order=flow_order,
-    )
 
     gts = table.genotypes()
     is_het = (gts[:, 0] != gts[:, 1]) & (gts[:, 1] >= 0)
@@ -223,12 +279,6 @@ def featurize(
         "is_indel": alle.is_indel.astype(np.float32),
         "is_ins": alle.is_ins.astype(np.float32),
         "indel_length": alle.indel_length,
-        "hmer_indel_length": np.asarray(hmer_len),
-        "hmer_indel_nuc": np.asarray(hmer_nuc),
-        "gc_content": np.asarray(gc),
-        "cycleskip_status": np.asarray(cyc),
-        "left_motif": np.asarray(left_motif),
-        "right_motif": np.asarray(right_motif),
         "ref_code": alle.ref_code,
         "alt_code": alle.alt_code,
         "n_alts": alle.n_alts,
@@ -250,4 +300,48 @@ def featurize(
             cols[name] = iops.membership(gpos, gs, ge).astype(np.float32)
             names.append(name)
 
-    return FeatureSet(columns=cols, feature_names=names, windows=windows)
+    return HostFeatures(alle=alle, windows=windows, cols=cols, names=names)
+
+
+def featurize(
+    table: VariantTable,
+    fasta: FastaReader,
+    annotate_intervals: dict[str, IntervalSet] | None = None,
+    flow_order: str = fops.DEFAULT_FLOW_ORDER,
+    extra_info_fields: list[str] | None = None,
+) -> FeatureSet:
+    """Full featurization: BASE_FEATURES + one 0/1 column per annotation interval.
+
+    Device kernels are jit-compiled once per padded batch shape.
+    """
+    hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
+                        extra_info_fields=extra_info_fields)
+    return materialize_features(hf, flow_order=flow_order)
+
+
+def materialize_features(hf: HostFeatures, flow_order: str = fops.DEFAULT_FLOW_ORDER) -> FeatureSet:
+    """Run the device window kernels over a HostFeatures batch and merge."""
+    alle, windows = hf.alle, hf.windows
+
+    n = len(windows)
+    b = _bucket(n)
+
+    def pad(a, fill=0):
+        a = np.asarray(a)
+        return np.pad(a, [(0, b - n)] + [(0, 0)] * (a.ndim - 1), constant_values=fill)
+
+    device_out = _device_feature_program(
+        pad(windows, fill=4),
+        pad(alle.is_indel),
+        pad(alle.indel_nuc, fill=4),
+        pad(alle.ref_code, fill=4),
+        pad(alle.alt_code, fill=4),
+        pad(alle.is_snp),
+        center=CENTER,
+        flow_order=flow_order,
+    )
+    # one bulk fetch for all six outputs (each np.asarray would sync separately)
+    fetched = jax.device_get(device_out)
+    cols = dict(hf.cols)
+    cols.update({k: v[:n] for k, v in zip(DEVICE_FEATURES, fetched)})
+    return FeatureSet(columns=cols, feature_names=hf.names, windows=windows)

@@ -173,6 +173,34 @@ class NativeAux:
         )
 
 
+class _LazyCols:
+    """Deferred string columns: (name -> (n,2) span array) into a shared buffer.
+
+    Materialization decodes buffer slices once per column on first access;
+    row subsets just subset the span arrays, so a pipeline that never
+    touches REF/ALT/INFO strings never pays for them. The backing is the
+    SAME bytes object the NativeAux buffer views (np.frombuffer), so
+    laziness adds no memory beyond the span arrays.
+    """
+
+    __slots__ = ("buf", "spans")
+
+    def __init__(self, buf: bytes, spans: dict):
+        self.buf = buf
+        self.spans = spans
+
+    def take(self, keep) -> "_LazyCols":
+        return _LazyCols(self.buf, {k: v[keep] for k, v in self.spans.items()})
+
+    def materialize(self, name: str) -> np.ndarray:
+        spans = self.spans[name].tolist()
+        buf = self.buf
+        out = np.empty(len(spans), dtype=object)
+        for i, (a, b) in enumerate(spans):
+            out[i] = buf[a:b].decode("latin-1")
+        return out
+
+
 class VariantTable:
     """Columnar view of a VCF: one numpy array per column over all records.
 
@@ -198,22 +226,41 @@ class VariantTable:
         fmt_keys: np.ndarray | None = None,
         sample_cols: np.ndarray | None = None,
         aux: NativeAux | None = None,
+        lazy_cols: "_LazyCols | None" = None,
     ):
         self.header = header
         self.chrom = chrom
         self.pos = pos
-        self.vid = vid
-        self.ref = ref
-        self.alt = alt
+        self._vid = vid
+        self._ref = ref
+        self._alt = alt
         self.qual = qual
-        self.filters = filters
-        self.info = info
+        self._filters = filters
+        self._info = info
         self._fmt_keys = fmt_keys
         self._sample_cols = sample_cols
         self.aux = aux
+        self._lazy = lazy_cols
 
     def __len__(self) -> int:
         return len(self.pos)
+
+    def _col(self, slot: str):
+        v = getattr(self, "_" + slot)
+        if v is None and self._lazy is not None:
+            v = self._lazy.materialize(slot)
+            setattr(self, "_" + slot, v)
+        return v
+
+    # The five record string columns are lazy on the native-ingest path:
+    # spans into the shared text buffer until first touched (the filter
+    # pipeline never touches REF/ALT/INFO strings — allele classes come from
+    # the native numeric cache and writeback splices byte spans).
+    vid = property(lambda s: s._col("vid"), lambda s, v: setattr(s, "_vid", v))
+    ref = property(lambda s: s._col("ref"), lambda s, v: setattr(s, "_ref", v))
+    alt = property(lambda s: s._col("alt"), lambda s, v: setattr(s, "_alt", v))
+    filters = property(lambda s: s._col("filters"), lambda s, v: setattr(s, "_filters", v))
+    info = property(lambda s: s._col("info"), lambda s, v: setattr(s, "_info", v))
 
     @property
     def n_samples(self) -> int:
@@ -246,16 +293,20 @@ class VariantTable:
 
     def subset(self, keep: np.ndarray) -> "VariantTable":
         """Row-subset every column (and aux) by a boolean/index array."""
+        lazy_pending = self._lazy is not None and any(
+            getattr(self, "_" + f) is None for f in ("vid", "ref", "alt", "filters", "info")
+        )
         return VariantTable(
             header=self.header,
             chrom=self.chrom[keep],
             pos=self.pos[keep],
-            vid=self.vid[keep],
-            ref=self.ref[keep],
-            alt=self.alt[keep],
+            vid=self._vid[keep] if self._vid is not None else None,
+            ref=self._ref[keep] if self._ref is not None else None,
+            alt=self._alt[keep] if self._alt is not None else None,
             qual=self.qual[keep],
-            filters=self.filters[keep],
-            info=self.info[keep],
+            filters=self._filters[keep] if self._filters is not None else None,
+            info=self._info[keep] if self._info is not None else None,
+            lazy_cols=self._lazy.take(keep) if lazy_pending else None,
             fmt_keys=self._fmt_keys[keep] if self._fmt_keys is not None else None,
             sample_cols=self._sample_cols[keep] if self._sample_cols is not None else None,
             aux=self.aux.take(keep) if self.aux is not None else None,
@@ -444,14 +495,20 @@ def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | Non
     if parsed is None:
         return None
     nrec = parsed["n"]
-    text = bufb.decode("latin-1")  # ASCII-safe; str slicing beats bytes+decode
 
-    def col(slot: int) -> np.ndarray:
-        spans = parsed["field_spans"][:, slot, :].tolist()
-        out = np.empty(nrec, dtype=object)
-        for i, (a, b) in enumerate(spans):
-            out[i] = text[a:b]
-        return out
+    # the five record string columns stay lazy (spans into the shared byte
+    # buffer): the hot pipelines never touch them, so ingest skips ~70% of
+    # its old wallclock and laziness costs no extra buffer copy
+    lazy = _LazyCols(
+        bufb,
+        {
+            "vid": parsed["field_spans"][:, 0, :],
+            "ref": parsed["field_spans"][:, 1, :],
+            "alt": parsed["field_spans"][:, 2, :],
+            "filters": parsed["field_spans"][:, 3, :],
+            "info": parsed["field_spans"][:, 4, :],
+        },
+    )
 
     chrom_names = np.array(parsed["chroms"] + [""], dtype=object)
     if drop_format:
@@ -495,16 +552,24 @@ def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | Non
                 for k in ("aclass", "indel_length", "indel_nuc", "ref_code", "alt_code", "n_alts", "ref_len")
             },
         )
+    if drop_format:
+        # drop_format's contract is "release the buffer": materialize the
+        # five string columns eagerly so nothing pins the uncompressed text
+        eager = {k: lazy.materialize(k) for k in ("vid", "ref", "alt", "filters", "info")}
+        lazy = None
+    else:
+        eager = dict.fromkeys(("vid", "ref", "alt", "filters", "info"))
     return VariantTable(
         header=header,
         chrom=chrom_names[parsed["chrom_codes"]] if nrec else np.empty(0, dtype=object),
         pos=parsed["pos"],
-        vid=col(0),
-        ref=col(1),
-        alt=col(2),
+        vid=eager["vid"],
+        ref=eager["ref"],
+        alt=eager["alt"],
         qual=parsed["qual"],
-        filters=col(3),
-        info=col(4),
+        filters=eager["filters"],
+        info=eager["info"],
+        lazy_cols=lazy,
         aux=aux,
     )
 
@@ -632,6 +697,7 @@ def write_vcf(
     sample_overrides: dict[int, np.ndarray] | None = None,
     fmt_override: np.ndarray | None = None,
     index: bool = True,
+    verbatim_core: bool = False,
 ) -> None:
     """Write a VariantTable back to VCF, rewriting only the requested columns.
 
@@ -642,6 +708,11 @@ def write_vcf(
       sample strings; ``fmt_override`` replaces the FORMAT column.
     - ``index``: for ``.gz`` outputs, also build the sibling ``.tbi``
       (io/tabix) so htslib tools can consume the file directly.
+    - ``verbatim_core``: caller asserts CHROM..QUAL were NOT edited since
+      read; record assembly then runs in the native engine by splicing new
+      FILTER/INFO between byte spans of the original buffer (the filter
+      pipeline's writeback hot path). Ignored when the native library or
+      parse buffer is unavailable.
     """
     if str(path).endswith(".gz"):
         from variantcalling_tpu.io.bgzf import BgzfWriter
@@ -667,7 +738,11 @@ def write_vcf(
             for line in table.header.lines:
                 out.write((line + "\n").encode())
             out.write((table.header.column_header() + "\n").encode())
-            _write_records_fast(out, table, new_filters, extra_info)
+            body = _assemble_native(table, new_filters, extra_info) if verbatim_core else None
+            if body is not None:
+                out.write(body.tobytes())
+            else:
+                _write_records_fast(out, table, new_filters, extra_info)
         if index and str(path).endswith(".gz"):
             from variantcalling_tpu.io.tabix import build_tabix_index
 
@@ -725,26 +800,67 @@ def write_vcf(
 
 
 def _format_extra_info_bytes(n: int, extra_info: dict) -> list[bytes]:
-    """Per-record b";K=V" suffixes, vectorized per key where possible."""
-    suffix = [b""] * n
+    """Per-record b";K=V" suffixes in dict key order (float columns vectorized)."""
+    acc = np.full(n, b"", dtype="S1")
     for k, vals in (extra_info or {}).items():
-        kb = k.encode()
         arr = np.asarray(vals)
         if arr.dtype.kind == "f":
-            strs = np.char.mod(b"%g", arr.astype(np.float64))
-            ok = ~np.isnan(arr.astype(np.float64))
-            for i in np.nonzero(ok)[0]:
-                suffix[i] += b";" + kb + b"=" + strs[i]
+            f64 = arr.astype(np.float64)
+            joined = np.char.add((";" + k + "=").encode(), np.char.mod(b"%g", f64))
+            acc = np.where(~np.isnan(f64), np.char.add(acc, joined), acc)
         else:
+            kb = k.encode()
+            part = []
             for i in range(n):
                 v = vals[i]
                 if v is None or (isinstance(v, float) and np.isnan(v)):
-                    continue
-                if v is True:
-                    suffix[i] += b";" + kb
+                    part.append(b"")
+                elif v is True:
+                    part.append(b";" + kb)
                 else:
-                    suffix[i] += b";" + kb + b"=" + str(v).encode()
-    return suffix
+                    part.append(b";" + kb + b"=" + str(v).encode())
+            acc = np.char.add(acc, np.asarray(part, dtype="S"))
+    return acc.tolist()
+
+
+def _format_qual_column(qual: np.ndarray) -> np.ndarray:
+    """Vectorized format_qual over the whole column (object array of str)."""
+    q = np.asarray(qual, dtype=np.float64)
+    out = np.full(len(q), MISSING, dtype=object)
+    ok = ~np.isnan(q)
+    is_int = ok & (q == np.floor(q))
+    out[is_int] = np.char.mod("%d", q[is_int].astype(np.int64))
+    frac = ok & ~is_int
+    out[frac] = np.char.mod("%g", q[frac])
+    return out
+
+
+def _assemble_native(table: VariantTable, new_filters, extra_info) -> np.ndarray | None:
+    """Native record assembly (verbatim CHROM..QUAL head; see write_vcf)."""
+    from variantcalling_tpu import native
+
+    aux = table.aux
+    if aux is None or aux.buf is None or not native.available():
+        return None
+    n = len(table)
+    filters = new_filters if new_filters is not None else table.filters
+    filt_list = [(str(f) if f not in (None, "") else MISSING).encode() for f in filters]
+    filt_offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.fromiter(map(len, filt_list), dtype=np.int64, count=n), out=filt_offs[1:])
+    suffix = _format_extra_info_bytes(n, extra_info) if extra_info else [b""] * n
+    sfx_offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.fromiter(map(len, suffix), dtype=np.int64, count=n), out=sfx_offs[1:])
+    return native.vcf_assemble(
+        aux.buf,
+        aux.line_spans,
+        aux.filter_spans,
+        aux.info_spans,
+        aux.tail_spans,
+        b"".join(filt_list),
+        filt_offs,
+        b"".join(suffix),
+        sfx_offs,
+    )
 
 
 def _write_records_fast(out, table: VariantTable, new_filters, extra_info) -> None:
@@ -758,7 +874,8 @@ def _write_records_fast(out, table: VariantTable, new_filters, extra_info) -> No
     suffix = _format_extra_info_bytes(n, extra_info) if extra_info else None
     filters = new_filters if new_filters is not None else table.filters
     pos_s = np.char.mod("%d", table.pos)  # vectorized int formatting
-    chrom, vid, ref, alt, info_col, qual = table.chrom, table.vid, table.ref, table.alt, table.info, table.qual
+    qual_s = _format_qual_column(table.qual)
+    chrom, vid, ref, alt, info_col = table.chrom, table.vid, table.ref, table.alt, table.info
     chunks: list[bytes] = []
     for i in range(n):
         info = info_col[i]
@@ -768,7 +885,7 @@ def _write_records_fast(out, table: VariantTable, new_filters, extra_info) -> No
         ta, tb = tails[i]
         tail = b"\t" + bufb[ta:tb] if tb > ta else b""
         line = "\t".join(
-            (chrom[i], pos_s[i], vid[i], ref[i], alt[i], format_qual(qual[i]), filters[i], info)
+            (chrom[i], pos_s[i], vid[i], ref[i], alt[i], qual_s[i], filters[i], info)
         )
         chunks.append(line.encode() + tail + b"\n")
         if len(chunks) >= 16384:

@@ -84,6 +84,13 @@ def get_lib() -> ctypes.CDLL | None:
         ]
         lib.vctpu_interval_membership.restype = None
         lib.vctpu_interval_membership.argtypes = [_i64p, _i64p, _i64, _i64p, _i64, _u8p]
+        lib.vctpu_vcf_assemble.restype = _i64
+        lib.vctpu_vcf_assemble.argtypes = [
+            _u8p, _i64, _i64,
+            _i64p, _i64p, _i64p, _i64p,
+            _u8p, _i64p, _u8p, _i64p,
+            _u8p, _i64,
+        ]
         lib.vctpu_vcf_count.restype = _i64
         lib.vctpu_vcf_count.argtypes = [_u8p, _i64, _i64p]
         _f32p = ctypes.POINTER(ctypes.c_float)
@@ -267,6 +274,51 @@ def vcf_parse(buf, n_samples: int) -> dict | None:
         bytes(uniq_buf[i * 64 : (i + 1) * 64]).rstrip(b"\x00").decode() for i in range(n_uniq)
     ]
     return out
+
+
+def vcf_assemble(
+    buf: np.ndarray,
+    line_spans: np.ndarray,
+    filter_spans: np.ndarray,
+    info_spans: np.ndarray,
+    tail_spans: np.ndarray,
+    filt_blob: bytes,
+    filt_offs: np.ndarray,
+    sfx_blob: bytes,
+    sfx_offs: np.ndarray,
+) -> np.ndarray | None:
+    """Assemble writeback record lines from parse-buffer spans + new FILTER/INFO.
+
+    Returns the uint8 output buffer, or None -> Python fallback.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(line_spans)
+    src = np.ascontiguousarray(_u8view(buf))
+    fb = np.frombuffer(filt_blob or b"\x00", dtype=np.uint8)
+    sb = np.frombuffer(sfx_blob or b"\x00", dtype=np.uint8)
+    cap = int(
+        (line_spans[:, 1] - line_spans[:, 0]).sum() + len(filt_blob) + len(sfx_blob) + 4 * n + 64
+    )
+    out = np.empty(cap, dtype=np.uint8)
+
+    # keep contiguous copies referenced for the duration of the call
+    arrs = [
+        np.ascontiguousarray(a, dtype=np.int64)
+        for a in (line_spans, filter_spans, info_spans, tail_spans, filt_offs, sfx_offs)
+    ]
+    w = lib.vctpu_vcf_assemble(
+        src.ctypes.data_as(_u8p), len(src), n,
+        arrs[0].ctypes.data_as(_i64p), arrs[1].ctypes.data_as(_i64p),
+        arrs[2].ctypes.data_as(_i64p), arrs[3].ctypes.data_as(_i64p),
+        fb.ctypes.data_as(_u8p), arrs[4].ctypes.data_as(_i64p),
+        sb.ctypes.data_as(_u8p), arrs[5].ctypes.data_as(_i64p),
+        out.ctypes.data_as(_u8p), cap,
+    )
+    if w < 0:
+        return None
+    return out[:w]
 
 
 def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:

@@ -572,3 +572,59 @@ void vctpu_interval_membership(const int64_t* starts, const int64_t* ends, int64
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Assemble VCF record lines for writeback: the CHROM..QUAL head and the
+// FORMAT/sample tail are copied verbatim from the original parse buffer
+// (spans from vctpu_vcf_parse); the FILTER column is replaced and an INFO
+// suffix spliced in (";K=V" blob per record; replaces a missing "." INFO).
+// Returns bytes written, or -1 when out_cap is too small.
+int64_t vctpu_vcf_assemble(
+    const uint8_t* buf, int64_t buf_len, int64_t n,
+    const int64_t* line_spans,    // (n,2) full record line [start,end)
+    const int64_t* filter_spans,  // (n,2) original FILTER field
+    const int64_t* info_spans,    // (n,2) original INFO field
+    const int64_t* tail_spans,    // (n,2) FORMAT..line-end ([s==e] if none)
+    const uint8_t* filt_blob, const int64_t* filt_offs,  // n+1 offsets
+    const uint8_t* sfx_blob, const int64_t* sfx_offs,    // n+1 offsets
+    uint8_t* out, int64_t out_cap) {
+    int64_t w = 0;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t head_s = line_spans[i * 2], head_e = filter_spans[i * 2];
+        int64_t info_s = info_spans[i * 2], info_e = info_spans[i * 2 + 1];
+        int64_t tail_s = tail_spans[i * 2], tail_e = tail_spans[i * 2 + 1];
+        int64_t flt_s = filt_offs[i], flt_e = filt_offs[i + 1];
+        int64_t sfx_s = sfx_offs[i], sfx_e = sfx_offs[i + 1];
+        if (head_s < 0 || head_e > buf_len || head_e < head_s) return -2;
+        bool info_missing = (info_e - info_s == 1 && buf[info_s] == '.');
+        int64_t need = (head_e - head_s) + (flt_e - flt_s) + 1 +
+                       (info_e - info_s) + (sfx_e - sfx_s) +
+                       (tail_e > tail_s ? 1 + (tail_e - tail_s) : 0) + 1;
+        if (w + need > out_cap) return -1;
+        memcpy(out + w, buf + head_s, head_e - head_s);  // "...QUAL\t"
+        w += head_e - head_s;
+        memcpy(out + w, filt_blob + flt_s, flt_e - flt_s);
+        w += flt_e - flt_s;
+        out[w++] = '\t';
+        if (info_missing && sfx_e > sfx_s) {
+            // "." + ";K=V" -> "K=V" (drop the missing marker and the ';')
+            memcpy(out + w, sfx_blob + sfx_s + 1, sfx_e - sfx_s - 1);
+            w += sfx_e - sfx_s - 1;
+        } else {
+            memcpy(out + w, buf + info_s, info_e - info_s);
+            w += info_e - info_s;
+            memcpy(out + w, sfx_blob + sfx_s, sfx_e - sfx_s);
+            w += sfx_e - sfx_s;
+        }
+        if (tail_e > tail_s) {
+            out[w++] = '\t';
+            memcpy(out + w, buf + tail_s, tail_e - tail_s);
+            w += tail_e - tail_s;
+        }
+        out[w++] = '\n';
+    }
+    return w;
+}
+
+}  // extern "C"

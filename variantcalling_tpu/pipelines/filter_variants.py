@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from variantcalling_tpu import logger
-from variantcalling_tpu.featurize import featurize
+from variantcalling_tpu.featurize import host_featurize
 from variantcalling_tpu.io import bed as bedio
 from variantcalling_tpu.io.fasta import FastaReader
 from variantcalling_tpu.io.vcf import VariantTable, read_vcf, write_vcf
@@ -138,6 +138,119 @@ def _is_cg_insertion(table: VariantTable, windows: np.ndarray, center: int) -> n
     return cand & (((ins == 1) & (anchor == 1) & (nxt == 2)) | ((ins == 2) & (anchor == 2) & (nxt == 1)))
 
 
+# Compiled predictors keyed on (model identity, feature order[, flow order]).
+# A fresh jax.jit per call would recompile the forest program on every
+# pipeline invocation; cached entries hold the model reference so id() stays
+# valid for the cache lifetime. Bounded FIFO so a long-lived process scoring
+# many models does not accumulate compiled programs forever.
+_PREDICTOR_CACHE: dict[tuple, tuple[object, object]] = {}
+_PREDICTOR_CACHE_MAX = 8
+
+
+def _cache_put(key: tuple, value: tuple) -> None:
+    while len(_PREDICTOR_CACHE) >= _PREDICTOR_CACHE_MAX:
+        _PREDICTOR_CACHE.pop(next(iter(_PREDICTOR_CACHE)))
+    _PREDICTOR_CACHE[key] = value
+
+
+def _raw_predictor(model, feature_names: list[str]):
+    if isinstance(model, FlatForest):
+        ordered = forest_mod.with_feature_order(model, feature_names)
+        # GEMM (MXU) encoding on TPU, gather walk on CPU
+        return forest_mod.make_predictor(ordered, len(feature_names))
+    return lambda xx: threshold_mod.predict_score(model, xx, feature_names)
+
+
+def _predictor_for(model, feature_names: list[str]):
+    key = ("x", id(model), tuple(feature_names))
+    hit = _PREDICTOR_CACHE.get(key)
+    if hit is not None and hit[0] is model:
+        return hit[1]
+    fn = jax.jit(_raw_predictor(model, feature_names))
+    _cache_put(key, (model, fn))
+    return fn
+
+
+def _fused_program(model, feature_names: list[str], flow_order: str):
+    """One jitted device program: windows + host columns -> TREE_SCORE.
+
+    Fuses the window featurization kernels (gc/hmer/motif/cycle-skip) with
+    forest inference so only the per-variant score crosses back to the host
+    — on TPU the feature tensors never leave HBM. Host-computed columns
+    arrive as one (N, K) matrix in ``host_names`` order.
+    """
+    from variantcalling_tpu.featurize import CENTER, DEVICE_FEATURES, device_feature_dict
+
+    key = ("fused", id(model), tuple(feature_names), flow_order)
+    hit = _PREDICTOR_CACHE.get(key)
+    if hit is not None and hit[0] is model:
+        return hit[1]
+
+    predictor = _raw_predictor(model, feature_names)
+    host_names = [f for f in feature_names if f not in DEVICE_FEATURES]
+    host_idx = {f: i for i, f in enumerate(host_names)}
+
+    def fn(windows, host_feats, is_indel, indel_nuc, ref_code, alt_code, is_snp):
+        dev = device_feature_dict(windows, is_indel, indel_nuc, ref_code, alt_code,
+                                  is_snp, center=CENTER, flow_order=flow_order)
+        cols = [
+            dev[f].astype(jnp.float32) if f in dev else host_feats[:, host_idx[f]]
+            for f in feature_names
+        ]
+        return predictor(jnp.stack(cols, axis=1))
+
+    jitted = (jax.jit(fn), host_names)
+    _cache_put(key, (model, jitted))
+    return jitted
+
+
+def fused_featurize_score(model, hf, flow_order: str) -> np.ndarray:
+    """Chunked fused featurize+score over a HostFeatures batch; returns scores."""
+    fn, host_names = _fused_program(model, hf.names, flow_order)
+    host_feats = np.stack(
+        [np.asarray(hf.cols[f], dtype=np.float32) for f in host_names], axis=1
+    )
+
+    from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_model=1) if n_dev > 1 else None
+    shard2 = data_sharding(mesh, 2) if mesh is not None else None
+    chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
+
+    from variantcalling_tpu.featurize import _bucket
+
+    alle = hf.alle
+    n = host_feats.shape[0]
+    out = np.empty(n, dtype=np.float32)
+    for lo in range(0, n, chunk_size):
+        hi = min(lo + chunk_size, n)
+        # power-of-two bucket (rounded up to a dp multiple) so distinct batch
+        # sizes reuse the same compiled program instead of retracing
+        target = min(chunk_size, -(-_bucket(hi - lo) // n_dev) * n_dev)
+        pad = target - (hi - lo)
+
+        def prep(a, fill=0):
+            c = np.asarray(a)[lo:hi]
+            if pad:
+                c = np.pad(c, [(0, pad)] + [(0, 0)] * (c.ndim - 1), constant_values=fill)
+            if shard2 is not None:
+                return jax.device_put(c, shard2 if c.ndim == 2 else data_sharding(mesh, 1))
+            return jnp.asarray(c)
+
+        score = fn(
+            prep(hf.windows, fill=4),
+            prep(host_feats),
+            prep(alle.is_indel),
+            prep(alle.indel_nuc, fill=4),
+            prep(alle.ref_code, fill=4),
+            prep(alle.alt_code, fill=4),
+            prep(alle.is_snp),
+        )
+        out[lo:hi] = np.asarray(score)[: hi - lo]
+    return out
+
+
 def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray:
     """Jitted chunked scoring, sharded over the mesh dp axis; returns TREE_SCORE per row.
 
@@ -145,14 +258,10 @@ def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray
     scoring program partitions over the variants axis (model arrays are
     replicated); single device degrades to plain jit.
     """
-    if isinstance(model, FlatForest):
-        model = forest_mod.with_feature_order(model, feature_names)
-        # GEMM (MXU) encoding on TPU, gather walk on CPU
-        fn = jax.jit(forest_mod.make_predictor(model, len(feature_names)))
-    elif isinstance(model, ThresholdModel):
-        fn = jax.jit(lambda xx: threshold_mod.predict_score(model, xx, feature_names))
-    else:  # raw sklearn estimator that escaped conversion
+    if not isinstance(model, (FlatForest, ThresholdModel)):
+        # raw sklearn estimator that escaped conversion
         return np.asarray(model.predict_proba(x)[:, 1])
+    fn = _predictor_for(model, feature_names)
 
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
 
@@ -190,13 +299,20 @@ def filter_variants(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Core: returns (tree_score float array, new FILTER object array)."""
     extra_info = ["TLOD"] if is_mutect else []
-    fs = featurize(table, fasta, annotate_intervals=annotate_intervals, flow_order=flow_order,
-                   extra_info_fields=extra_info)
-    if is_mutect and "TLOD" in fs.columns:
-        fs.columns["tlod"] = fs.columns.pop("TLOD")
-        fs.feature_names[fs.feature_names.index("TLOD")] = "tlod"
-    x = fs.matrix()
-    score = score_variants(model, x, fs.feature_names)
+    hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
+                        extra_info_fields=extra_info)
+    if is_mutect and "TLOD" in hf.cols:
+        hf.cols["tlod"] = hf.cols.pop("TLOD")
+        hf.names[hf.names.index("TLOD")] = "tlod"
+    if isinstance(model, (FlatForest, ThresholdModel)):
+        # fused featurize+score: window features and the forest walk run as
+        # one device program, only TREE_SCORE returns to the host
+        score = fused_featurize_score(model, hf, flow_order)
+    else:  # raw sklearn estimator: materialize the matrix from the same hf
+        from variantcalling_tpu.featurize import materialize_features
+
+        fs = materialize_features(hf, flow_order=flow_order)
+        score = score_variants(model, fs.matrix(), fs.feature_names)
 
     pass_thr = getattr(model, "pass_threshold", 0.5)
     n = len(table)
@@ -214,10 +330,10 @@ def filter_variants(
         loc = np.searchsorted(key_bl, key_tb)
         loc = np.minimum(loc, len(key_bl) - 1)
         cohort_fp = key_bl[loc] == key_tb
-    if blacklist_cg_insertions and fs.windows is not None:
+    if blacklist_cg_insertions and hf.windows is not None:
         from variantcalling_tpu.featurize import CENTER
 
-        cohort_fp |= _is_cg_insertion(table, fs.windows, CENTER)
+        cohort_fp |= _is_cg_insertion(table, hf.windows, CENTER)
 
     hpol_near = np.zeros(n, dtype=bool)
     if runs_file:
@@ -282,7 +398,10 @@ def run(argv: list[str]) -> int:
     table.header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
     table.header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
     with stage("writeback"):
-        write_vcf(args.output_file, table, new_filters=filters, extra_info={"TREE_SCORE": np.round(score, 4)})
+        # verbatim_core: this pipeline never edits CHROM..QUAL, so record
+        # assembly can splice FILTER/TREE_SCORE between original byte spans
+        write_vcf(args.output_file, table, new_filters=filters,
+                  extra_info={"TREE_SCORE": np.round(score, 4)}, verbatim_core=True)
     logger.debug("%s", report())
     logger.info(
         "wrote %s: %d variants, %d PASS", args.output_file, len(table), int(np.sum(filters == PASS))
