@@ -131,3 +131,16 @@ def test_phase_consistency_two_hets():
     res = match_contig(calls, truth, REF_SEQ)
     assert res.call_tp.all() and res.truth_tp.all()
     assert res.call_tp_gt.all() and res.truth_tp_gt.all()
+
+
+def test_disable_reinterpretation_strict_mode():
+    # shifted-representation del matches only via haplotype rescue; with
+    # rescue off (--disable_reinterpretation) it must stay FP/FN
+    left = _side([(34, REF_SEQ[33:35], [REF_SEQ[33]], (0, 1))])
+    right = _side([(38, REF_SEQ[37:39], [REF_SEQ[37]], (0, 1))])
+    res = match_contig(left, right, REF_SEQ, haplotype_rescue=False)
+    assert not res.call_tp.any() and not res.truth_tp.any()
+    # exact-representation matches still work in strict mode
+    same = _side([(17, "A", ["G"], (0, 1))])
+    res2 = match_contig(same, same, REF_SEQ, haplotype_rescue=False)
+    assert res2.call_tp.all() and res2.call_tp_gt.all()

@@ -101,7 +101,8 @@ class MatchResult:
     call_truth_idx: np.ndarray
 
 
-def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str) -> MatchResult:
+def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str,
+                 haplotype_rescue: bool = True) -> MatchResult:
     nc, nt = len(calls.pos), len(truth.pos)
     call_tp = np.zeros(nc, dtype=bool)
     call_tp_gt = np.zeros(nc, dtype=bool)
@@ -140,6 +141,14 @@ def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str) -> Matc
     # recover classify_gt (vcfeval semantics). Running the allele pass first
     # keeps genotype errors (allele-matched, gt-mismatched sites) from
     # joining — and poisoning — allele-level clusters.
+    if not haplotype_rescue:
+        # representation-strict mode: exact normalized-key joins only — the
+        # run_comparison --disable_reinterpretation contract (the reference's
+        # "reinterpretation" stage repairs vcfeval representation artifacts;
+        # here that repair IS the haplotype search, so disabling maps to
+        # skipping stage 3; docs/run_comparison_pipeline.md:78)
+        return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
+
     failed: set = set()  # pass-1 clusters that already failed; identical
     # pass-2 clusters (no gt-only members joined) are skipped, not re-searched
     for level in ("allele", "genotype"):

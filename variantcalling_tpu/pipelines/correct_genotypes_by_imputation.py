@@ -28,12 +28,30 @@ from variantcalling_tpu.ops.imputation import gt_to_index, modify_stats_with_imp
 import jax.numpy as jnp
 
 MAX_ALTS = 3
+COUNTER_KEYS = ("pass", "has_non_ref_imp", "imp_has_different_gt", "changed_gt")
+
+
+def _new_counter() -> dict:
+    return dict.fromkeys(COUNTER_KEYS, 0)
 
 
 def parse_args(argv: list[str]):
     ap = argparse.ArgumentParser(prog="correct_genotypes_by_imputation", description=run.__doc__)
-    ap.add_argument("--beagle_annotated_vcf", required=True,
-                    help="VCF annotated with beagle FORMAT/DS (the reference's beagle_anno stage output)")
+    ap.add_argument("--beagle_annotated_vcf",
+                    help="VCF annotated with beagle FORMAT/DS (skip the stage chain)")
+    # full orchestration surface (reference get_parser :42-130)
+    ap.add_argument("--input_vcf", help="VCF to be corrected (runs the full stage chain)")
+    ap.add_argument("--chrom_to_cohort_vcfs_json",
+                    help="json mapping chromosome names to reference-cohort VCFs")
+    ap.add_argument("--chrom_to_plink_json",
+                    help="json mapping chromosome names to plink genomic maps")
+    ap.add_argument("--single_chrom", help="single chromosome to work on (cromwell mode)")
+    ap.add_argument("--single_cohort_vcf", help="reference cohort VCF for --single_chrom")
+    ap.add_argument("--single_genomic_map_plink", help="plink genomic map for --single_chrom")
+    ap.add_argument("--temp_dir", default=None, help="directory for stage files")
+    ap.add_argument("--threads_for_contig", type=int, default=1, help="(accepted; in-process stages)")
+    ap.add_argument("--threads_beagle", type=int, default=1)
+    ap.add_argument("--beagle_cmd", default="beagle", help="beagle executable (testing seam)")
     ap.add_argument("--output_vcf", required=True)
     ap.add_argument("--epsilon", type=float, default=0.01,
                     help="imputation weight in the new PL (0..1)")
@@ -46,7 +64,87 @@ def parse_args(argv: list[str]):
 def run(argv: list[str]) -> int:
     """Correct a vcf based on imputation."""
     args = parse_args(argv)
-    table = read_vcf(args.beagle_annotated_vcf)
+    if args.input_vcf and not args.beagle_annotated_vcf:
+        return _run_stage_chain(args)
+    if not args.beagle_annotated_vcf:
+        raise SystemExit("provide --beagle_annotated_vcf, or --input_vcf with cohort/map args")
+    return _correct_annotated(args.beagle_annotated_vcf, args)
+
+
+def _run_stage_chain(args) -> int:
+    """The reference's per-chromosome orchestration (:361-453), in-process.
+
+    subset -> high-GQ filter -> beagle (external) -> collapse -> annotate,
+    then the vmap'd PL update per chromosome and a final concat.
+    """
+    import json
+    import tempfile
+
+    from variantcalling_tpu.pipelines import imputation_stages as st
+
+    if args.chrom_to_cohort_vcfs_json and args.chrom_to_plink_json:
+        with open(args.chrom_to_cohort_vcfs_json, encoding="utf-8") as fh:
+            chrom_to_cohort = json.load(fh)
+        with open(args.chrom_to_plink_json, encoding="utf-8") as fh:
+            chrom_to_plink = json.load(fh)
+    elif args.single_chrom and args.single_cohort_vcf and args.single_genomic_map_plink:
+        chrom_to_cohort = {args.single_chrom: args.single_cohort_vcf}
+        chrom_to_plink = {args.single_chrom: args.single_genomic_map_plink}
+    else:
+        raise SystemExit(
+            "define chrom_to_cohort_vcfs_json + chrom_to_plink_json, or the three single_* args"
+        )
+    missing_maps = set(chrom_to_cohort) - set(chrom_to_plink)
+    if missing_maps:
+        raise SystemExit(
+            f"chrom_to_plink_json lacks genomic maps for {sorted(missing_maps)} "
+            "(every cohort chromosome needs a plink map)"
+        )
+
+    tmp = args.temp_dir or tempfile.mkdtemp(prefix="imputation_")
+    import os
+
+    os.makedirs(tmp, exist_ok=True)
+    part_files = []
+    all_counters: dict = defaultdict(_new_counter)
+    input_table = read_vcf(args.input_vcf)  # parse once for all chromosomes
+    for chrom in chrom_to_cohort:
+        subset_path = os.path.join(tmp, f"subset.{chrom}.vcf.gz")
+        high_gq_path = os.path.join(tmp, f"high_gq.{chrom}.vcf.gz")
+        beagle_path = os.path.join(tmp, f"beagle.{chrom}.vcf.gz")
+        collapsed_path = os.path.join(tmp, f"beagle_collapsed.{chrom}.vcf.gz")
+        anno_path = os.path.join(tmp, f"beagle_anno.{chrom}.vcf.gz")
+        part_path = os.path.join(tmp, f"add_imp.{chrom}.vcf.gz")
+
+        sub = st.subset_vcf(input_table, chrom, subset_path)
+        st.filter_high_gq(sub, high_gq_path)
+        st.run_beagle(high_gq_path, chrom_to_cohort[chrom], chrom_to_plink[chrom],
+                      beagle_path, nthreads=args.threads_beagle, beagle_cmd=args.beagle_cmd)
+        collapsed = st.collapse_beagle(beagle_path, collapsed_path)
+        st.annotate_with_beagle(sub, collapsed, anno_path)
+
+        counters = _correct_annotated(anno_path, args, output_override=part_path)
+        for vt, c in counters.items():
+            for k, v in c.items():
+                all_counters[vt][k] += v
+        part_files.append(part_path)
+
+    st.concat_vcfs(part_files, args.output_vcf)
+    _write_stats(args, all_counters)
+    return 0
+
+
+def _write_stats(args, counters) -> None:
+    stats_file = args.stats_file or args.output_vcf.replace(".vcf.gz", "").replace(".vcf", "") + "_counts.csv"
+    with open(stats_file, "w") as fh:
+        fh.write("variant_type," + ",".join(COUNTER_KEYS) + "\n")
+        for vt, c in sorted(counters.items()):
+            fh.write(vt + "," + ",".join(str(c[k]) for k in COUNTER_KEYS) + "\n")
+
+
+def _correct_annotated(annotated_vcf: str, args, output_override: str | None = None):
+    """The TPU-ized PL/GQ/GT rewrite over a beagle-annotated VCF."""
+    table = read_vcf(annotated_vcf)
     n = len(table)
 
     gts = table.genotypes()
@@ -64,9 +162,7 @@ def run(argv: list[str]) -> int:
     new_gt_str = np.array([None] * n, dtype=object)
     new_gq = np.full(n, -1, dtype=np.int64)
     new_pl_str = np.array([None] * n, dtype=object)
-    counters: dict[str, dict] = defaultdict(
-        lambda: {"pass": 0, "has_non_ref_imp": 0, "imp_has_different_gt": 0, "changed_gt": 0}
-    )
+    counters: dict[str, dict] = defaultdict(_new_counter)
     vtypes = np.where(n_alts > 1, "multi", np.where(
         np.array([len(r) == len(a.split(",")[0]) if a not in (".", "") else True
                   for r, a in zip(table.ref, table.alt)]), "snp", "indel"))
@@ -146,14 +242,12 @@ def run(argv: list[str]) -> int:
         fmt_override[i] = ":".join(order)
         sample0[i] = ":".join(kv.get(k, ".") for k in order)
 
-    write_vcf(args.output_vcf, table, fmt_override=fmt_override, sample_overrides={0: sample0})
-
-    stats_file = args.stats_file or args.output_vcf.replace(".vcf.gz", "").replace(".vcf", "") + "_counts.csv"
-    with open(stats_file, "w") as fh:
-        fh.write("variant_type,pass,has_non_ref_imp,imp_has_different_gt,changed_gt\n")
-        for vt, c in sorted(counters.items()):
-            fh.write(f"{vt},{c['pass']},{c['has_non_ref_imp']},{c['imp_has_different_gt']},{c['changed_gt']}\n")
-    logger.info("rewrote %d genotypes -> %s (stats: %s)", changed, args.output_vcf, stats_file)
+    out_path = output_override or args.output_vcf
+    write_vcf(out_path, table, fmt_override=fmt_override, sample_overrides={0: sample0})
+    logger.info("rewrote %d genotypes -> %s", changed, out_path)
+    if output_override is not None:
+        return dict(counters)  # stage-chain caller aggregates + writes stats
+    _write_stats(args, counters)
     return 0
 
 

@@ -9,8 +9,9 @@ interval histograms, percentiles, stats — is a fused device reduction
 (ops/coverage) instead of samtools|awk text plumbing.
 
 Outputs (reference-shaped):
-- ``collect_coverage``: per-contig bedGraph (.bedgraph.gz, run-length) —
-  bigWig export rides it when pyBigWig is importable;
+- ``collect_coverage``: per-contig bedGraph (.bedgraph.gz, run-length) +
+  sibling .bw via the native bigWig writer (io/bigwig), or .bw directly
+  when the output name asks for it;
 - ``full_analysis``: ``<out>.coverage_stats.h5`` with keys ``histogram`` /
   ``stats`` / ``percentiles`` (Q0..Q100 rows, interval columns, as read by
   generate_coverage_boxplot, coverage_analysis.py:960-1068) and binned
@@ -162,10 +163,25 @@ def full_analysis(args) -> int:
 def collect_coverage(args) -> int:
     depths = collect_depth(args)
     out = args.output
+    if out.endswith((".bw", ".bigwig", ".bigWig")):
+        # native bigWig export (reference depth_to_bigwig,
+        # coverage_analysis.py:686-714, via UCSC bedGraphToBigWig)
+        from variantcalling_tpu.io.bigwig import write_bigwig
+
+        write_bigwig(out, depths)
+        logger.info("wrote %s", out)
+        return 0
     if not out.endswith((".bedgraph", ".bedgraph.gz", ".bg", ".bg.gz")):
         out = out + ".bedgraph.gz"
     write_bedgraph(out, depths)
-    logger.info("wrote %s", out)
+    bw_out = out
+    for suf in (".gz", ".bedgraph", ".bg"):
+        bw_out = bw_out.removesuffix(suf)
+    bw_out += ".bw"
+    from variantcalling_tpu.io.bigwig import write_bigwig
+
+    write_bigwig(bw_out, depths)
+    logger.info("wrote %s + %s", out, bw_out)
     return 0
 
 

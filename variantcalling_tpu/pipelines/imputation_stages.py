@@ -1,0 +1,209 @@
+"""In-process stage chain for correct_genotypes_by_imputation.
+
+The reference orchestrates five bcftools/beagle shell stages per chromosome
+(correct_genotypes_by_imputation.py:133-180, 403-440):
+subset -> high-GQ filter -> beagle -> collapse -> annotate. Here every
+bcftools stage is an in-process columnar operation on VariantTable; beagle
+itself stays the one external process (a Java statistical imputer, out of
+scope per SURVEY §2.5), invoked with the reference's exact argument shape
+and gated behind availability with a clear error.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.vcf import VariantTable, read_vcf, write_vcf
+
+
+def subset_vcf(input_vcf: str | VariantTable, chrom: str, out_path: str) -> VariantTable:
+    """bcftools view <vcf> <chrom> equivalent (:133-138).
+
+    Accepts a pre-parsed VariantTable so a multi-chromosome chain parses the
+    input once, not once per chromosome.
+    """
+    table = input_vcf if isinstance(input_vcf, VariantTable) else read_vcf(input_vcf)
+    sub = table.subset(np.asarray(table.chrom) == chrom)
+    write_vcf(out_path, sub)
+    return sub
+
+
+def filter_high_gq(table: VariantTable, out_path: str, min_qual: float = 20.0,
+                   min_gq: float = 20.0) -> None:
+    """bcftools view -f PASS | filter -i 'QUAL>20 && FORMAT/GQ[0]>20' (:141-148)."""
+    is_pass = np.array([f in ("PASS", ".", "") for f in table.filters])
+    qual_ok = np.nan_to_num(table.qual, nan=-1.0) > min_qual
+    gq = table.format_numeric("GQ", max_len=1, missing=np.nan)[:, 0]
+    gq_ok = np.nan_to_num(gq, nan=-1.0) > min_gq
+    write_vcf(out_path, table.subset(is_pass & qual_ok & gq_ok))
+
+
+def run_beagle(high_gq_vcf: str, cohort_vcf: str, plink_map: str, out_vcf: str,
+               nthreads: int = 1, beagle_cmd: str = "beagle") -> None:
+    """beagle gt=<vcf> ref=<cohort> map=<plink> out=<prefix> (:151-161).
+
+    Raises a clear error when the beagle executable is unavailable (it is a
+    Java tool external to this framework, exactly as in the reference env).
+    """
+    if shutil.which(beagle_cmd.split()[0]) is None:
+        raise RuntimeError(
+            f"beagle executable {beagle_cmd!r} not found on PATH — the imputation "
+            "stage chain requires beagle 5.x (reference setup/environment.yml); "
+            "alternatively run this tool with --beagle_annotated_vcf on a "
+            "pre-annotated VCF"
+        )
+    prefix = out_vcf[:-7] if out_vcf.endswith(".vcf.gz") else out_vcf
+    cmd = beagle_cmd.split() + [
+        f"gt={high_gq_vcf}", f"ref={cohort_vcf}", f"map={plink_map}",
+        f"out={prefix}", f"nthreads={nthreads}", "window=100",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0 or not os.path.exists(prefix + ".vcf.gz"):
+        raise RuntimeError(f"beagle failed rc={proc.returncode}: {proc.stderr[-800:]}")
+
+
+def collapse_beagle(beagle_vcf: str, out_path: str) -> VariantTable:
+    """bcftools view -i 'GT=\"alt\"' | grep -v END | norm -m + (:164-171).
+
+    Keeps alt-called records, drops END-carrying blocks, joins biallelic
+    records at the same (chrom, pos) into one multiallelic record with
+    comma-joined ALT and per-allele FORMAT/DS.
+    """
+    t = read_vcf(beagle_vcf)
+    gts = t.genotypes()
+    has_alt = (gts > 0).any(axis=1)
+    has_end = np.array(["END" in (s or "") for s in t.info])
+    t = t.subset(has_alt & ~has_end)
+
+    # group biallelic rows by (chrom, pos, ref) preserving order
+    key_order: list[tuple] = []
+    groups: dict[tuple, list[int]] = {}
+    chrom_arr, pos_arr, ref_arr = np.asarray(t.chrom), t.pos, np.asarray(t.ref)
+    for i in range(len(t)):
+        k = (chrom_arr[i], int(pos_arr[i]), ref_arr[i])
+        if k not in groups:
+            groups[k] = []
+            key_order.append(k)
+        groups[k].append(i)
+
+    ds = t.format_numeric("DS", max_len=1, missing=np.nan)[:, 0]
+    dr2 = t.info_field("DR2")
+
+    rows = {"chrom": [], "pos": [], "ref": [], "alts": [], "ds": [], "dr2": [], "imp": []}
+    for k in key_order:
+        idxs = groups[k]
+        alts, dvals = [], []
+        for i in idxs:
+            for a in t.alt[i].split(","):
+                if a not in (".", ""):
+                    alts.append(a)
+                    dvals.append(float(ds[i]) if not np.isnan(ds[i]) else np.nan)
+        if not alts:
+            continue
+        rows["chrom"].append(k[0])
+        rows["pos"].append(k[1])
+        rows["ref"].append(k[2])
+        rows["alts"].append(alts)
+        rows["ds"].append(dvals)
+        rows["dr2"].append(float(np.nanmax([dr2[i] for i in idxs])) if len(idxs) else np.nan)
+        rows["imp"].append(any("IMP" in (t.info[i] or "") for i in idxs))
+
+    # write the collapsed VCF (stage-file parity with the reference chain)
+    import gzip
+
+    opener = (lambda p: gzip.open(p, "wt")) if out_path.endswith(".gz") else (lambda p: open(p, "w"))
+    with opener(out_path) as fh:
+        fh.write("##fileformat=VCFv4.2\n")
+        fh.write('##INFO=<ID=DR2,Number=1,Type=Float,Description="Dosage R2">\n')
+        fh.write('##INFO=<ID=IMP,Number=0,Type=Flag,Description="Imputed">\n')
+        fh.write('##FORMAT=<ID=DS,Number=A,Type=Float,Description="Dosage">\n')
+        for c in dict.fromkeys(rows["chrom"]):
+            fh.write(f"##contig=<ID={c}>\n")
+        fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS\n")
+        for i in range(len(rows["pos"])):
+            info = []
+            if rows["imp"][i]:
+                info.append("IMP")
+            if not np.isnan(rows["dr2"][i]):
+                info.append(f"DR2={rows['dr2'][i]:g}")
+            ds_s = ",".join("." if np.isnan(v) else f"{v:g}" for v in rows["ds"][i])
+            fh.write(
+                f"{rows['chrom'][i]}\t{rows['pos'][i]}\t.\t{rows['ref'][i]}\t"
+                f"{','.join(rows['alts'][i])}\t.\t.\t{';'.join(info) or '.'}\tDS\t{ds_s}\n"
+            )
+    return rows
+
+
+def annotate_with_beagle(subset_table: VariantTable, collapsed_rows: dict, out_path: str) -> None:
+    """bcftools annotate --columns INFO/IMP,INFO/DR2,FORMAT/DS (:174-179).
+
+    Per-allele DS transfer by (chrom, pos, ref, alt) exact key; records with
+    no beagle counterpart pass through unannotated.
+    """
+    ds_by_key: dict[tuple, float] = {}
+    meta_by_site: dict[tuple, tuple] = {}
+    for i in range(len(collapsed_rows["pos"])):
+        site = (collapsed_rows["chrom"][i], collapsed_rows["pos"][i], collapsed_rows["ref"][i])
+        meta_by_site[site] = (collapsed_rows["imp"][i], collapsed_rows["dr2"][i])
+        for alt, d in zip(collapsed_rows["alts"][i], collapsed_rows["ds"][i]):
+            ds_by_key[site + (alt,)] = d
+
+    n = len(subset_table)
+    subset_table.materialize_format()
+    fmt_override = np.array(subset_table.fmt_keys, dtype=object)
+    sample0 = np.array(subset_table.sample_cols[:, 0], dtype=object)
+    imp_flag = np.full(n, None, dtype=object)
+    dr2_col = np.full(n, np.nan)
+    chrom_arr, pos_arr, ref_arr = np.asarray(subset_table.chrom), subset_table.pos, np.asarray(subset_table.ref)
+    for i in range(n):
+        site = (chrom_arr[i], int(pos_arr[i]), ref_arr[i])
+        if site not in meta_by_site:
+            continue
+        alts = [a for a in subset_table.alt[i].split(",") if a not in (".", "")]
+        dvals = [ds_by_key.get(site + (a,), np.nan) for a in alts]
+        if all(np.isnan(v) for v in dvals):
+            continue
+        ds_s = ",".join("." if np.isnan(v) else f"{v:g}" for v in dvals)
+        fmt_override[i] = fmt_override[i] + ":DS" if fmt_override[i] else "DS"
+        sample0[i] = sample0[i] + ":" + ds_s if sample0[i] else ds_s
+        imp, dr2 = meta_by_site[site]
+        imp_flag[i] = True if imp else None
+        dr2_col[i] = dr2
+
+    subset_table.header.ensure_format("DS", "A", "Float", "Genotype dosage from beagle")
+    subset_table.header.ensure_info("IMP", "0", "Flag", "Imputed marker")
+    subset_table.header.ensure_info("DR2", "1", "Float", "Dosage R2 from beagle")
+    write_vcf(out_path, subset_table, fmt_override=fmt_override,
+              sample_overrides={0: sample0},
+              extra_info={"IMP": imp_flag, "DR2": dr2_col})
+
+
+def concat_vcfs(paths: list[str], out_path: str) -> None:
+    """Header from the first part + records of every part, in order."""
+    from variantcalling_tpu.io.bgzf import BgzfWriter
+
+    opener = BgzfWriter if str(out_path).endswith(".gz") else (lambda p: open(p, "wb"))
+    with opener(out_path) as out:
+        for pi, p in enumerate(paths):
+            first = read_vcf(p)
+            if pi == 0:
+                for line in first.header.lines:
+                    out.write((line + "\n").encode())
+                out.write((first.header.column_header() + "\n").encode())
+            _append_records(out, p)
+    logger.info("concatenated %d parts -> %s", len(paths), out_path)
+
+
+def _append_records(out, path: str) -> None:
+    import gzip
+
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        for line in fh:
+            if not line.startswith("#"):
+                out.write(line.encode())

@@ -45,8 +45,10 @@ def parse_args(argv: list[str]):
     ap.add_argument("--annotate_intervals", action="append", default=[])
     ap.add_argument("--reference", required=True, help="Reference FASTA")
     ap.add_argument("--reference_dict", help="(accepted for drop-in compatibility; unused)")
-    ap.add_argument("--coverage_bw_high_quality", help="BigWig coverage, high-mapq (optional)")
-    ap.add_argument("--coverage_bw_all_quality", help="BigWig coverage, all-mapq (optional)")
+    ap.add_argument("--coverage_bw_high_quality", action="append", default=None,
+                    help="BigWig file with coverage only on high mapq reads")
+    ap.add_argument("--coverage_bw_all_quality", action="append", default=None,
+                    help="BigWig file with coverage on all mapq reads")
     ap.add_argument("--call_sample_name", default="sm1")
     ap.add_argument("--truth_sample_name", default="HG001")
     ap.add_argument("--header_file", help="(accepted; unused)")
@@ -56,7 +58,8 @@ def parse_args(argv: list[str]):
     ap.add_argument("--flow_order", default="TGCA")
     ap.add_argument("--output_suffix", default="")
     ap.add_argument("--concordance_tool", default="native", help="native haplotype matcher (VCFEVAL-equivalent)")
-    ap.add_argument("--disable_reinterpretation", action="store_true")
+    ap.add_argument("--disable_reinterpretation", action="store_true",
+                    help="skip the haplotype-rescue (representation repair) matching stage")
     ap.add_argument("--is_mutect", action="store_true")
     ap.add_argument("--n_jobs", type=int, default=-1, help="(accepted; XLA owns parallelism)")
     ap.add_argument("--verbosity", default="INFO")
@@ -123,8 +126,16 @@ def build_concordance_frame(
     hpol_dist: int = 10,
     flow_order: str = "TGCA",
     is_mutect: bool = False,
+    reinterpret: bool = True,
 ) -> pd.DataFrame:
-    """Match + annotate -> one concordance DataFrame over calls ∪ FN-truth."""
+    """Match + annotate -> one concordance DataFrame over calls ∪ FN-truth.
+
+    ``reinterpret=False`` (--disable_reinterpretation) turns off the
+    matcher's haplotype-rescue stage, leaving exact-representation joins —
+    the reference's reinterpretation stage exists to repair representation
+    artifacts of the black-box comparator, and the haplotype search is this
+    framework's native form of that repair.
+    """
     contigs = list(dict.fromkeys(list(calls.chrom) + list(truth.chrom)))
     call_tp = np.zeros(len(calls), dtype=bool)
     call_tp_gt = np.zeros(len(calls), dtype=bool)
@@ -144,7 +155,7 @@ def build_concordance_frame(
         ts = make_side(truth.pos[tm], list(truth.ref[tm]),
                        [a.split(",") if a not in (".", "") else [] for a in truth.alt[tm]],
                        truth.genotypes()[tm])
-        res = match_contig(cs, ts, seq)
+        res = match_contig(cs, ts, seq, haplotype_rescue=reinterpret)
         call_tp[cm] = res.call_tp
         call_tp_gt[cm] = res.call_tp_gt
         truth_tp[tm] = res.truth_tp
@@ -226,6 +237,51 @@ def _filters_norm(table: VariantTable) -> np.ndarray:
     return np.asarray(["PASS" if f in (".", "", None) else f for f in table.filters], dtype=object)
 
 
+def annotate_coverage(df: pd.DataFrame, bw_high: list[str] | None, bw_all: list[str] | None) -> None:
+    """Per-variant coverage columns from bigWig tracks (in place).
+
+    ``well_mapped_coverage`` from the high-mapq track(s), ``coverage`` from
+    the all-mapq track(s) — the schema report_data_loader.py:77 consumes
+    (reference annotates these inside ugbio_comparison from the same two
+    --coverage_bw_* flag sets). Multiple files per flag are concatenated
+    (reference accepts per-contig splits).
+    """
+    from variantcalling_tpu.io.bigwig import BigWigReader
+
+    max_span = 1 << 22  # decode at most 4 Mb per query window
+
+    for name, paths in (("well_mapped_coverage", bw_high), ("coverage", bw_all)):
+        if not paths:
+            continue
+        out = np.full(len(df), np.nan)
+        readers = [BigWigReader(p) for p in paths]
+        for contig in dict.fromkeys(df["chrom"].tolist()):
+            m = (df["chrom"] == contig).to_numpy()
+            pos0 = df.loc[m, "pos"].to_numpy() - 1
+            order = np.argsort(pos0)
+            sorted_pos = pos0[order]
+            vals = np.full(m.sum(), np.nan)
+            for bw in readers:
+                if bw.chroms(str(contig)) is None:
+                    continue
+                # bounded windows over the sorted positions: whole-chromosome
+                # spans (WGS) would otherwise decode GB-scale arrays
+                got_sorted = np.full(len(sorted_pos), np.nan)
+                i = 0
+                while i < len(sorted_pos):
+                    lo = int(sorted_pos[i])
+                    j = int(np.searchsorted(sorted_pos, lo + max_span, side="left"))
+                    hi = int(sorted_pos[j - 1]) + 1
+                    window = bw.values(str(contig), lo, hi)
+                    got_sorted[i:j] = window[sorted_pos[i:j] - lo]
+                    i = j
+                got = np.empty_like(got_sorted)
+                got[order] = got_sorted
+                vals = np.where(np.isnan(vals), got, vals)
+            out[m] = vals
+        df[name] = out
+
+
 def run(argv: list[str]) -> int:
     """Compare VCF to ground truth."""
     args = parse_args(argv)
@@ -265,7 +321,11 @@ def run(argv: list[str]) -> int:
             hpol_dist=args.hpol_filter_length_dist[1],
             flow_order=args.flow_order,
             is_mutect=args.is_mutect,
+            reinterpret=not args.disable_reinterpretation,
         )
+
+    if len(df) and (args.coverage_bw_high_quality or args.coverage_bw_all_quality):
+        annotate_coverage(df, args.coverage_bw_high_quality, args.coverage_bw_all_quality)
 
     first = True
     for contig in dict.fromkeys(df["chrom"].tolist()) if len(df) else []:
