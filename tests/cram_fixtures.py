@@ -1,0 +1,305 @@
+"""Minimal CRAM 3.0 writer for decoder tests.
+
+Follows the CRAM 3.0 specification independently of the C++ decoder
+(native/src/vctpu_cram.cc): ITF8/LTF8 varints, container/block framing,
+EXTERNAL/BYTE_ARRAY_STOP encodings, AP-delta positions, and an rANS-4x8
+order-0 encoder so the decoder's entropy codec is exercised against a
+second implementation. Not a general-purpose writer — single slice,
+single-ref containers, no tags.
+"""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+RANS_LOW = 1 << 23
+
+
+def itf8(v: int) -> bytes:
+    v &= 0xFFFFFFFF
+    if v < 0x80:
+        return bytes([v])
+    if v < 0x4000:
+        return bytes([0x80 | (v >> 8), v & 0xFF])
+    if v < 0x200000:
+        return bytes([0xC0 | (v >> 16), (v >> 8) & 0xFF, v & 0xFF])
+    if v < 0x10000000:
+        return bytes([0xE0 | (v >> 24), (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF])
+    return bytes([0xF0 | (v >> 28), (v >> 20) & 0xFF, (v >> 12) & 0xFF, (v >> 4) & 0xFF, v & 0x0F])
+
+
+def ltf8(v: int) -> bytes:
+    if v < 0x80:
+        return bytes([v])
+    if v < 0x4000:
+        return bytes([0x80 | (v >> 8), v & 0xFF])
+    # longer forms unneeded for fixtures
+    return bytes([0xC0 | (v >> 16), (v >> 8) & 0xFF, v & 0xFF])
+
+
+def itf8_neg(v: int) -> bytes:
+    """ITF8 of a negative value (two's complement 32-bit)."""
+    return itf8(v & 0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# rANS 4x8 order-0 encoder (spec section 13)
+# ---------------------------------------------------------------------------
+
+def _normalize_freqs(data: bytes) -> dict[int, int]:
+    counts: dict[int, int] = {}
+    for b in data:
+        counts[b] = counts.get(b, 0) + 1
+    total = len(data)
+    freqs = {}
+    acc = 0
+    items = sorted(counts.items())
+    for i, (sym, c) in enumerate(items):
+        if i == len(items) - 1:
+            f = 4096 - acc
+        else:
+            f = max(1, (c * 4096) // total)
+        freqs[sym] = f
+        acc += f
+    # fix overshoot by shrinking the largest
+    while acc > 4096:
+        big = max(freqs, key=lambda s: freqs[s])
+        take = min(freqs[big] - 1, acc - 4096)
+        freqs[big] -= take
+        acc -= take
+    return freqs
+
+
+def _freq_table_bytes(freqs: dict[int, int]) -> bytes:
+    """Symbol/freq table with the spec's run-length next-symbol encoding."""
+    syms = sorted(freqs)
+    out = bytearray([syms[0]])
+    i = 0
+    while i < len(syms):
+        f = freqs[syms[i]]
+        if f >= 128:
+            out += bytes([0x80 | (f >> 8), f & 0xFF])
+        else:
+            out.append(f)
+        # choose the next-symbol encoding the decoder expects
+        if i + 1 < len(syms) and syms[i + 1] == syms[i] + 1:
+            # run of consecutive symbols: emit first of run + extra count
+            j = i + 1
+            while j + 1 < len(syms) and syms[j + 1] == syms[j] + 1:
+                j += 1
+            run_extra = j - (i + 1)
+            out.append(syms[i + 1])
+            out.append(run_extra)
+            # emit freqs for the run (decoder increments symbol itself)
+            for k in range(i + 1, j + 1):
+                fk = freqs[syms[k]]
+                if fk >= 128:
+                    out += bytes([0x80 | (fk >> 8), fk & 0xFF])
+                else:
+                    out.append(fk)
+            i = j + 1
+            if i < len(syms):
+                out.append(syms[i])
+        else:
+            i += 1
+            if i < len(syms):
+                out.append(syms[i])
+    out.append(0)  # terminator
+    return bytes(out)
+
+
+def rans0_compress(data: bytes) -> bytes:
+    if len(data) == 0:
+        return struct.pack("<BII", 0, 0, 0)
+    freqs = _normalize_freqs(data)
+    cum = {}
+    x = 0
+    for s in sorted(freqs):
+        cum[s] = x
+        x += freqs[s]
+    table = _freq_table_bytes(freqs)
+
+    states = [RANS_LOW] * 4
+    emitted = bytearray()  # bytes in reverse stream order
+    for i in range(len(data) - 1, -1, -1):
+        s = data[i]
+        f, c = freqs[s], cum[s]
+        x = states[i % 4]
+        x_max = ((RANS_LOW >> 12) << 8) * f
+        while x >= x_max:
+            emitted.append(x & 0xFF)
+            x >>= 8
+        states[i % 4] = ((x // f) << 12) + (x % f) + c
+    payload = b"".join(struct.pack("<I", s) for s in states) + bytes(reversed(emitted))
+    body = table + payload
+    return struct.pack("<BII", 0, len(body), len(data)) + body
+
+
+# ---------------------------------------------------------------------------
+# blocks + encodings
+# ---------------------------------------------------------------------------
+
+RAW, GZIP, RANS = 0, 1, 4
+
+
+def block(content_type: int, content_id: int, data: bytes, method: int = RAW) -> bytes:
+    if method == GZIP:
+        comp = gzip.compress(data)
+    elif method == RANS:
+        comp = rans0_compress(data)
+    else:
+        comp = data
+    return (bytes([method, content_type]) + itf8(content_id) + itf8(len(comp)) +
+            itf8(len(data)) + comp + b"\x00\x00\x00\x00")  # CRC unchecked
+
+
+def enc_external(content_id: int) -> bytes:
+    params = itf8(content_id)
+    return itf8(1) + itf8(len(params)) + params
+
+
+def enc_byte_array_stop(stop: int, content_id: int) -> bytes:
+    params = bytes([stop]) + itf8(content_id)
+    return itf8(5) + itf8(len(params)) + params
+
+
+def enc_huffman_const(value: int) -> bytes:
+    params = itf8(1) + itf8(value) + itf8(1) + itf8(0)
+    return itf8(3) + itf8(len(params)) + params
+
+
+# content ids per data series
+IDS = {"BF": 1, "CF": 2, "RL": 3, "AP": 4, "RG": 5, "MQ": 6, "FN": 7, "FP": 8,
+       "FC": 9, "DL": 10, "NS": 11, "NP": 12, "TS": 13, "MF": 14, "RN": 15,
+       "IN": 16, "SC": 17, "BA": 18, "QS": 19, "TL": 20, "BS": 21, "RS": 22,
+       "PD": 23, "HC": 24}
+
+
+def comp_header_block() -> bytes:
+    # preservation map: RN=1 AP=1 RR=0 SM TD(one empty line)
+    pm = bytearray()
+    entries = 0
+    for key, val in (("RN", b"\x01"), ("AP", b"\x01"), ("RR", b"\x00")):
+        pm += key.encode() + val
+        entries += 1
+    pm += b"SM" + bytes(5)
+    entries += 1
+    td = b"\x00"
+    pm += b"TD" + itf8(len(td)) + td
+    entries += 1
+    pmap = itf8(entries) + bytes(pm)
+    pmap = itf8(len(pmap)) + pmap
+
+    dm = bytearray()
+    n = 0
+    for key, cid in IDS.items():
+        if key in ("RN", "IN", "SC"):
+            dm += key.encode() + enc_byte_array_stop(ord("\t"), cid)
+        else:
+            dm += key.encode() + enc_external(cid)
+        n += 1
+    dmap = itf8(n) + bytes(dm)
+    dmap = itf8(len(dmap)) + dmap
+
+    tmap_inner = itf8(0)
+    tmap = itf8(len(tmap_inner)) + tmap_inner
+    return block(1, 0, bytes(pmap + dmap + tmap))
+
+
+def write_cram(path: str, sam_header: str, records: list[dict],
+               method: int = RAW, slice_start: int = 1) -> None:
+    """records: {flag, pos (1-based), read_len, mapq, name, features}.
+
+    features: list of (code:str, read_pos:int, payload) where payload is an
+    int for D/RS/PD/HC/BS, bytes for IN/SC.
+    """
+    streams: dict[str, bytearray] = {k: bytearray() for k in IDS}
+
+    def put_int(series: str, v: int):
+        streams[series] += itf8(v) if v >= 0 else itf8_neg(v)
+
+    def put_byte(series: str, v: int):
+        streams[series].append(v)
+
+    last_pos = slice_start
+    n_bases = 0
+    for i, r in enumerate(records):
+        put_int("BF", r.get("flag", 0))
+        put_int("CF", 0)  # not detached, no mate downstream, no qual array
+        put_int("RL", r["read_len"])
+        n_bases += r["read_len"]
+        put_int("AP", r["pos"] - last_pos)
+        last_pos = r["pos"]
+        put_int("RG", -1)
+        streams["RN"] += (r.get("name", f"read{i}")).encode() + b"\t"
+        put_int("TL", -1)
+        if (r.get("flag", 0) & 4) == 0:
+            feats = r.get("features", [])
+            put_int("FN", len(feats))
+            prev_fp = 0
+            for code, fpos, payload in feats:
+                put_byte("FC", ord(code))
+                put_int("FP", fpos - prev_fp)
+                prev_fp = fpos
+                if code in ("D",):
+                    put_int("DL", payload)
+                elif code == "N":
+                    put_int("RS", payload)
+                elif code == "P":
+                    put_int("PD", payload)
+                elif code == "H":
+                    put_int("HC", payload)
+                elif code == "X":
+                    put_int("BS", payload)
+                elif code == "I":
+                    streams["IN"] += bytes(payload) + b"\t"
+                elif code == "S":
+                    streams["SC"] += bytes(payload) + b"\t"
+                elif code == "i":
+                    put_byte("BA", payload)
+                else:
+                    raise ValueError(code)
+            put_int("MQ", r.get("mapq", 60))
+        else:
+            for _ in range(r["read_len"]):
+                put_byte("BA", ord("N"))
+
+    ext_blocks = b""
+    used_ids = []
+    for key, cid in IDS.items():
+        if streams[key]:
+            ext_blocks += block(4, cid, bytes(streams[key]), method=method)
+            used_ids.append(cid)
+    core = block(5, 0, b"")
+
+    max_end = max((r["pos"] + r["read_len"] for r in records), default=slice_start)
+    span = max_end - slice_start
+    slice_hdr = (itf8(0) + itf8(slice_start) + itf8(span) + itf8(len(records)) +
+                 ltf8(0) + itf8(1 + len(used_ids)) + itf8(len(used_ids)) +
+                 b"".join(itf8(c) for c in used_ids) + itf8_neg(-1) + bytes(16))
+    slice_block = block(2, 0, slice_hdr)
+
+    ch = comp_header_block()
+    container_data = ch + slice_block + core + ext_blocks
+    landmark = len(ch)
+    cont_hdr = (struct.pack("<I", len(container_data)) + itf8(0) + itf8(slice_start) +
+                itf8(span) + itf8(len(records)) + ltf8(0) + ltf8(n_bases) +
+                itf8(2 + len(used_ids)) + itf8(1) + itf8(landmark) + b"\x00\x00\x00\x00")
+
+    # file header container (gzip-compressed SAM text block)
+    text = sam_header.encode()
+    fh_block = block(0, 0, struct.pack("<i", len(text)) + text, method=GZIP)
+    fh_cont = (struct.pack("<I", len(fh_block)) + itf8(0) + itf8(0) + itf8(0) + itf8(0) +
+               ltf8(0) + ltf8(0) + itf8(1) + itf8(0) + b"\x00\x00\x00\x00")
+
+    eof = (struct.pack("<I", 0) + itf8_neg(-1) + itf8(0) + itf8(0) + itf8(0) +
+           ltf8(0) + ltf8(0) + itf8(0) + itf8(0) + b"\x00\x00\x00\x00")
+
+    with open(path, "wb") as fh:
+        fh.write(b"CRAM" + bytes([3, 0]) + bytes(20))
+        fh.write(fh_cont + fh_block)
+        fh.write(cont_hdr + container_data)
+        fh.write(eof)

@@ -65,3 +65,56 @@ def test_full_analysis_outputs(tmp_path, rng):
     spike_bin = w100[(w100["chromStart"] == 1001)]["coverage"].iloc[0]
     base_bin = w100[(w100["chromStart"] == 201)]["coverage"].iloc[0]
     assert spike_bin >= base_bin + 30
+
+
+def test_full_analysis_plots_and_bigwig(tmp_path, rng):
+    """Boxplot + profile pngs (reference :960-1068, :1071-1209) and the
+    sibling .bw from collect_coverage's native bigWig writer."""
+    bam = _make_bam(tmp_path, rng)
+    out = str(tmp_path / "plots")
+    rc = ca.run(["full_analysis", "-i", bam, "-o", out, "-w", "100", "1000"])
+    assert rc == 0
+    import os
+
+    assert os.path.getsize(out + ".coverage_boxplot.png") > 1000
+    # chr1 is 4kb < MIN_LENGTH_TO_SHOW -> profile legitimately skipped
+    assert not os.path.exists(out + ".w1000.profile.png")
+
+    rc = ca.run(["collect_coverage", "-i", bam, "-o", str(tmp_path / "cov2")])
+    assert rc == 0
+    from variantcalling_tpu.io.bigwig import BigWigReader
+
+    bw = BigWigReader(str(tmp_path / "cov2.bw"))
+    assert bw.values("chr1", 1050, 1051)[0] == bw.values("chr1", 200, 201)[0] + 40
+
+
+def test_profile_plot_direct(tmp_path):
+    """plot_coverage_profile on a synthetic parquet with a long contig."""
+    n = ca.MIN_LENGTH_TO_SHOW // 1000 + 10
+    df = pd.DataFrame({
+        "chrom": ["chr1"] * n,
+        "chromStart": np.arange(n, dtype=np.int64) * 1000 + 1,
+        "chromEnd": (np.arange(n, dtype=np.int64) + 1) * 1000,
+        "coverage": np.full(n, 30.0),
+    })
+    p = str(tmp_path / "w1000.parquet")
+    df.to_parquet(p)
+    cen = tmp_path / "cen.tsv"
+    cen.write_text("chr1\t4000000\t5000000\tc1\tacen\n")
+    out = ca.plot_coverage_profile(p, centromere_file=str(cen), out_path=str(tmp_path / "prof.png"))
+    import os
+
+    assert out is not None and os.path.getsize(out) > 1000
+
+
+def test_gcs_token_contract(monkeypatch):
+    from variantcalling_tpu.utils import cloud
+
+    monkeypatch.delenv(cloud.GOOGLE_APPLICATION_CREDENTIALS, raising=False)
+    monkeypatch.setenv(cloud.GCS_OAUTH_TOKEN, "tok123")
+    assert cloud.get_gcs_token() == "tok123"
+    monkeypatch.delenv(cloud.GCS_OAUTH_TOKEN)
+    import pytest
+
+    with pytest.raises(ValueError, match="Could not generate gcs token"):
+        cloud.get_gcs_token()

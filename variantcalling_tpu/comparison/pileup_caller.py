@@ -27,6 +27,13 @@ def pileup_counts(bam_path: str, chrom: str, start: int, end: int) -> np.ndarray
     Skips unmapped/secondary/qcfail/dup reads (mpileup defaults) and
     indels (``--skip-indels``); depth capped at MAX_DEPTH per locus.
     """
+    if str(bam_path).endswith(".cram"):
+        raise ValueError(
+            "pileup from CRAM needs base reconstruction (reference + substitution "
+            "matrix), which the native CRAM decoder does not implement yet — "
+            "convert to BAM for fingerprinting, or use BAM inputs (depth-only "
+            "CRAM paths are supported, io/cram.py)"
+        )
     length = end - start
     counts = np.zeros((length, 4), dtype=np.int32)
     with BamReader(bam_path, decode_seq=True) as reader:

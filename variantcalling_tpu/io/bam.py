@@ -166,8 +166,16 @@ def depth_diff_arrays(
     """Per-contig depth via difference arrays (samtools depth -a -J semantics).
 
     Returns (header, {contig: int32 depth vector}). ``regions`` restricts to
-    named contigs (region strings "chr1" or "chr1:1000-2000").
+    named contigs (region strings "chr1" or "chr1:1000-2000"). CRAM inputs
+    dispatch to the native CRAM 3.0 decoder (io/cram).
     """
+    if str(path).endswith(".cram"):
+        from variantcalling_tpu.io import cram
+
+        return cram.depth_diff_arrays(
+            path, min_bq=min_bq, min_mapq=min_mapq, min_read_length=min_read_length,
+            include_deletions=include_deletions, regions=regions,
+        )
     cov_ops = _COV_OPS_J if include_deletions else _COV_OPS
     region_contigs = None
     if regions:

@@ -64,15 +64,14 @@ def write_bigwig(path: str, chrom_values: dict[str, np.ndarray],
     vmin, vmax, vsum, vsumsq = np.inf, -np.inf, 0.0, 0.0
     for cid, c in enumerate(chroms):
         starts, ends, vals = _runlength(chrom_values[c])
-        nz = vals != 0
-        if nz.any():
-            covered = (ends[nz] - starts[nz]).sum()
-            valid += int(covered)
-            vmin = min(vmin, float(vals[nz].min()))
-            vmax = max(vmax, float(vals[nz].max()))
-            w = (ends[nz] - starts[nz]).astype(np.float64)
-            vsum += float((vals[nz] * w).sum())
-            vsumsq += float((vals[nz].astype(np.float64) ** 2 * w).sum())
+        if len(vals):
+            # every emitted base — including zero runs — is "covered" data
+            valid += int((ends - starts).sum())
+            vmin = min(vmin, float(vals.min()))
+            vmax = max(vmax, float(vals.max()))
+            w = (ends - starts).astype(np.float64)
+            vsum += float((vals * w).sum())
+            vsumsq += float((vals.astype(np.float64) ** 2 * w).sum())
         for lo in range(0, len(starts), _SECTION_ITEMS):
             hi = min(lo + _SECTION_ITEMS, len(starts))
             s, e, v = starts[lo:hi], ends[lo:hi], vals[lo:hi]
@@ -168,9 +167,13 @@ class BigWigReader:
     """Minimal pyBigWig-compatible reader: chroms() + values()."""
 
     def __init__(self, path: str):
+        import mmap
+
         self.path = path
-        with open(path, "rb") as fh:
-            self._data = fh.read()
+        self._fh = open(path, "rb")
+        # mmap: block reads stay page-backed, so multi-GB WGS tracks never
+        # fully materialize in RAM (only R-tree-hit pages fault in)
+        self._data = mmap.mmap(self._fh.fileno(), 0, access=mmap.ACCESS_READ)
         magic, version, zooms, chrom_off, data_off, index_off, _fc, _dfc, _auto, \
             _summ, self._uncomp, _res = struct.unpack_from("<IHHQQQHHQQIQ", self._data, 0)
         if magic != BIGWIG_MAGIC:
@@ -185,7 +188,15 @@ class BigWigReader:
         return self
 
     def __exit__(self, *a):
+        self.close()
         return False
+
+    def close(self) -> None:
+        try:
+            self._data.close()
+            self._fh.close()
+        except (OSError, ValueError):
+            pass
 
     def chroms(self, chrom: str | None = None):
         if chrom is not None:

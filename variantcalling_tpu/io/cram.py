@@ -1,0 +1,114 @@
+"""CRAM ingest: native CRAM 3.0 decode -> per-contig depth vectors.
+
+The reference consumes CRAM everywhere via samtools subprocesses
+(quick_fingerprinter.py:104-108; BASELINE config 4 is "30x WGS CRAM");
+this module serves the same inputs through the in-process C++ decoder
+(native/src/vctpu_cram.cc): alignment records come back as flat arrays
+(ref_id, 1-based pos, reference span, mapq, flags, read length) and depth
+accumulation is one vectorized difference-array pass — no per-record
+Python, same downstream device reductions as the BAM path.
+
+Limitations (explicit, raised or logged — never silent): CRAM 3.1 codecs
+and bzip2/lzma blocks are unsupported; per-base-quality depth filtering
+(-q) needs base reconstruction and is not applied to CRAM inputs; N
+(reference-skip) ops count toward the span (DNA pipelines — this
+framework's domain — do not emit N ops).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from variantcalling_tpu import logger, native
+from variantcalling_tpu.io.bam import EXCLUDE_FLAGS, BamHeader
+
+
+def read_cram_header(path: str) -> BamHeader:
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    text = native.cram_header(buf)
+    if text is None:
+        raise ValueError(
+            f"cannot decode CRAM header of {path}: native engine unavailable or "
+            "unsupported CRAM version/codec (supported: CRAM 3.0, raw/gzip/rANS-4x8)"
+        )
+    refs: list[str] = []
+    lengths: dict[str, int] = {}
+    for line in text.splitlines():
+        if line.startswith("@SQ"):
+            name, ln = None, None
+            for field in line.split("\t")[1:]:
+                if field.startswith("SN:"):
+                    name = field[3:]
+                elif field.startswith("LN:"):
+                    ln = int(field[3:])
+            if name is not None and ln is not None:
+                refs.append(name)
+                lengths[name] = ln
+    return BamHeader(text=text, references=refs, lengths=lengths)
+
+
+def cram_records(path: str) -> tuple[BamHeader, dict]:
+    """(header, record arrays) for a whole CRAM file."""
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    header = read_cram_header(path)
+    cap = max(1 << 16, len(buf) // 16)
+    for _ in range(8):
+        recs = native.cram_scan(buf, cap)
+        if recs == "grow":
+            cap *= 4
+            continue
+        if recs is None:
+            raise ValueError(
+                f"cannot decode CRAM records of {path}: unsupported codec or "
+                "malformed stream (supported: CRAM 3.0, raw/gzip/rANS-4x8 blocks)"
+            )
+        return header, recs
+    raise ValueError(f"CRAM record count exceeds retry capacity for {path}")
+
+
+def depth_diff_arrays(
+    path: str,
+    min_bq: int = 0,
+    min_mapq: int = 0,
+    min_read_length: int = 0,
+    include_deletions: bool = True,
+    regions: list[str] | None = None,
+) -> tuple[BamHeader, dict[str, np.ndarray]]:
+    """CRAM counterpart of io.bam.depth_diff_arrays (same contract).
+
+    ``include_deletions`` matches -J semantics at the span level: the CRAM
+    record span already covers D/N ops; without -J per-op splitting would
+    need feature-level spans (the decoder folds them into one span), so the
+    flag only logs when it would differ.
+    """
+    if min_bq > 0:
+        logger.warning("CRAM depth: per-base-quality filter (-q %d) not applied to CRAM inputs",
+                       min_bq)
+    if not include_deletions:
+        logger.warning("CRAM depth: spans include deletions (samtools depth -J semantics)")
+    header, recs = cram_records(path)
+    region_contigs = {r.split(":")[0] for r in regions} if regions else None
+
+    keep = (recs["flags"] & EXCLUDE_FLAGS) == 0
+    keep &= recs["ref_id"] >= 0
+    keep &= recs["mapq"] >= min_mapq
+    keep &= recs["read_len"] >= min_read_length
+    ref_id = recs["ref_id"][keep]
+    start0 = recs["pos"][keep] - 1  # CRAM positions are 1-based
+    span = np.maximum(recs["span"][keep], 0)
+
+    diffs: dict[str, np.ndarray] = {}
+    for rid, name in enumerate(header.references):
+        if region_contigs is not None and name not in region_contigs:
+            continue
+        m = ref_id == rid
+        diff = np.zeros(header.lengths[name] + 1, dtype=np.int32)
+        if m.any():
+            s = np.clip(start0[m], 0, len(diff) - 1)
+            e = np.clip(start0[m] + span[m], 0, len(diff) - 1)
+            np.add.at(diff, s, 1)
+            np.add.at(diff, e, -1)
+        diffs[name] = diff
+    return header, diffs

@@ -22,6 +22,7 @@ import numpy as np
 
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "src", "vctpu_native.cc")
+_SRC_CRAM = os.path.join(_DIR, "src", "vctpu_cram.cc")
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
@@ -33,14 +34,17 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 
 
 def _build() -> str | None:
-    with open(_SRC, "rb") as fh:
-        tag = hashlib.sha256(fh.read()).hexdigest()[:12]
+    hasher = hashlib.sha256()
+    for src in (_SRC, _SRC_CRAM):
+        with open(src, "rb") as fh:
+            hasher.update(fh.read())
+    tag = hasher.hexdigest()[:12]
     out = os.path.join(_DIR, f"_vctpu_native_{tag}.so")
     if os.path.exists(out):
         return out
     # per-process tmp name keeps os.replace atomic under concurrent builds
     tmp = f"{out}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, "-lz"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, _SRC_CRAM, "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, out)
@@ -90,6 +94,12 @@ def get_lib() -> ctypes.CDLL | None:
             _i64p, _i64p, _i64p, _i64p,
             _u8p, _i64p, _u8p, _i64p,
             _u8p, _i64,
+        ]
+        lib.vctpu_cram_header.restype = _i64
+        lib.vctpu_cram_header.argtypes = [_u8p, _i64, _u8p, _i64]
+        lib.vctpu_cram_scan.restype = _i64
+        lib.vctpu_cram_scan.argtypes = [
+            _u8p, _i64, _i64, _i32p, _i64p, _i32p, _i32p, _i32p, _i32p,
         ]
         lib.vctpu_vcf_count.restype = _i64
         lib.vctpu_vcf_count.argtypes = [_u8p, _i64, _i64p]
@@ -319,6 +329,57 @@ def vcf_assemble(
     if w < 0:
         return None
     return out[:w]
+
+
+def cram_header(buf) -> str | None:
+    """SAM header text of a CRAM 3.0 buffer; None when unavailable/unsupported."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(_u8view(buf))
+    cap = 1 << 20
+    for _ in range(4):
+        out = np.empty(cap, dtype=np.uint8)
+        n = lib.vctpu_cram_header(src.ctypes.data_as(_u8p), len(src), out.ctypes.data_as(_u8p), cap)
+        if n == -3:
+            cap *= 8
+            continue
+        if n < 0:
+            return None
+        return out[:n].tobytes().decode("utf-8", "replace")
+    return None
+
+
+def cram_scan(buf, max_records: int) -> dict | None:
+    """Per-record alignment arrays from a CRAM 3.0 buffer.
+
+    Returns {ref_id, pos (1-based), span, mapq, flags, read_len} or None on
+    unsupported input (caller raises a clear error — there is no Python
+    fallback for CRAM decoding).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(_u8view(buf))
+    out = {
+        "ref_id": np.empty(max_records, dtype=np.int32),
+        "pos": np.empty(max_records, dtype=np.int64),
+        "span": np.empty(max_records, dtype=np.int32),
+        "mapq": np.empty(max_records, dtype=np.int32),
+        "flags": np.empty(max_records, dtype=np.int32),
+        "read_len": np.empty(max_records, dtype=np.int32),
+    }
+    n = lib.vctpu_cram_scan(
+        src.ctypes.data_as(_u8p), len(src), max_records,
+        out["ref_id"].ctypes.data_as(_i32p), out["pos"].ctypes.data_as(_i64p),
+        out["span"].ctypes.data_as(_i32p), out["mapq"].ctypes.data_as(_i32p),
+        out["flags"].ctypes.data_as(_i32p), out["read_len"].ctypes.data_as(_i32p),
+    )
+    if n == -4:
+        return "grow"  # capacity exceeded — caller retries with more room
+    if n < 0:
+        return None
+    return {k: v[:n] for k, v in out.items()}
 
 
 def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:

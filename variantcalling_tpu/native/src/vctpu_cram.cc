@@ -1,0 +1,832 @@
+// CRAM 3.0 decoder for the native host engine.
+//
+// The reference consumes CRAM through samtools subprocesses
+// (quick_fingerprinter.py:104-108, coverage_analysis BASELINE config 4:
+// "30x WGS CRAM"); this is an in-process reader producing per-record
+// alignment arrays (ref_id, pos, reference span, mapq, flags, read length)
+// that feed the same depth/pileup reductions as the BAM path.
+//
+// Scope: CRAM 3.0 (the htslib default writer format), block compression
+// raw/gzip/rANS-4x8, encodings NULL/EXTERNAL/HUFFMAN/BETA/BYTE_ARRAY_LEN/
+// BYTE_ARRAY_STOP/GAMMA. CRAM 3.1 codecs and the rare golomb/subexp
+// encodings return an error so callers fall back with a clear message.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+namespace cram {
+
+// ---------------------------------------------------------------------------
+// byte cursor + ITF8/LTF8
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+    const uint8_t* p;
+    const uint8_t* end;
+    bool ok = true;
+
+    uint8_t u8() {
+        if (p >= end) { ok = false; return 0; }
+        return *p++;
+    }
+    uint32_t u32le() {
+        if (p + 4 > end) { ok = false; return 0; }
+        uint32_t v = (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+                     ((uint32_t)p[3] << 24);
+        p += 4;
+        return v;
+    }
+    void skip(int64_t n) {
+        if (p + n > end) { ok = false; p = end; } else { p += n; }
+    }
+    int32_t itf8() {
+        uint8_t b0 = u8();
+        if ((b0 & 0x80) == 0) return b0;
+        if ((b0 & 0x40) == 0) return ((b0 & 0x3F) << 8) | u8();
+        if ((b0 & 0x20) == 0) {
+            int32_t v = (b0 & 0x1F) << 16; v |= u8() << 8; v |= u8(); return v;
+        }
+        if ((b0 & 0x10) == 0) {
+            int32_t v = (b0 & 0x0F) << 24; v |= u8() << 16; v |= u8() << 8; v |= u8(); return v;
+        }
+        int32_t v = (b0 & 0x0F) << 28; v |= u8() << 20; v |= u8() << 12; v |= u8() << 4;
+        v |= (u8() & 0x0F);
+        return v;
+    }
+    int64_t ltf8() {
+        uint8_t b0 = u8();
+        int n = 0;
+        for (int i = 7; i >= 0; i--) {
+            if (b0 & (1 << i)) n++; else break;
+        }
+        int64_t v = (n < 8) ? (b0 & ((1 << (7 - n)) - 1)) : 0;
+        for (int i = 0; i < n; i++) v = (v << 8) | u8();
+        return v;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// rANS 4x8 (order 0 and 1) — spec section 13 / htslib rANS_static
+// ---------------------------------------------------------------------------
+
+static const uint32_t RANS_LOW = 1u << 23;
+
+struct RansSyms {
+    uint16_t fc[256];  // freq
+    uint16_t cc[256];  // cumulative
+    uint8_t rev[4096];
+};
+
+static bool read_freq_table0(Cursor& c, RansSyms& t) {
+    memset(t.fc, 0, sizeof(t.fc));
+    memset(t.cc, 0, sizeof(t.cc));
+    int x = 0, rle = 0;
+    int j = c.u8();
+    do {
+        int f = c.u8();
+        if (f >= 128) f = ((f & 127) << 8) | c.u8();
+        if (!c.ok || x + f > 4096) return false;
+        t.fc[j] = f;
+        t.cc[j] = x;
+        if (f) memset(&t.rev[x], j, f);
+        x += f;
+        if (!rle && c.p < c.end && *c.p == j + 1) {
+            j = c.u8();
+            rle = c.u8();
+        } else if (rle) {
+            rle--;
+            j++;
+        } else {
+            j = c.u8();
+        }
+    } while (j && c.ok);
+    return c.ok;
+}
+
+static bool rans_uncompress(const uint8_t* in, int64_t in_len, std::vector<uint8_t>& out) {
+    Cursor c{in, in + in_len};
+    int order = c.u8();
+    uint32_t comp_sz = c.u32le();
+    uint32_t raw_sz = c.u32le();
+    (void)comp_sz;
+    if (!c.ok) return false;
+    out.resize(raw_sz);
+    if (raw_sz == 0) return true;
+
+    auto renorm = [&](uint32_t& x) {
+        while (x < RANS_LOW && c.p < c.end) x = (x << 8) | c.u8();
+    };
+
+    if (order == 0) {
+        RansSyms t;
+        if (!read_freq_table0(c, t)) return false;
+        uint32_t R[4];
+        for (int i = 0; i < 4; i++) R[i] = c.u32le();
+        if (!c.ok) return false;
+        for (uint32_t i = 0; i < raw_sz; i++) {
+            uint32_t& x = R[i & 3];
+            uint32_t m = x & 0xFFF;
+            uint8_t s = t.rev[m];
+            out[i] = s;
+            x = t.fc[s] * (x >> 12) + m - t.cc[s];
+            renorm(x);
+        }
+        return true;
+    }
+    if (order == 1) {
+        static thread_local std::vector<RansSyms> tables;
+        tables.assign(256, RansSyms());
+        std::vector<bool> present(256, false);
+        int rle = 0;
+        int i = c.u8();
+        do {
+            if (!read_freq_table0(c, tables[i])) return false;
+            present[i] = true;
+            if (!rle && c.p < c.end && *c.p == i + 1) {
+                i = c.u8();
+                rle = c.u8();
+            } else if (rle) {
+                rle--;
+                i++;
+            } else {
+                i = c.u8();
+            }
+        } while (i && c.ok);
+        if (!c.ok) return false;
+        uint32_t R[4];
+        for (int k = 0; k < 4; k++) R[k] = c.u32le();
+        if (!c.ok) return false;
+        uint32_t isz4 = raw_sz >> 2;
+        uint8_t last[4] = {0, 0, 0, 0};
+        for (uint32_t pos = 0; pos < isz4; pos++) {
+            for (int k = 0; k < 4; k++) {
+                uint32_t& x = R[k];
+                RansSyms& t = tables[last[k]];
+                uint32_t m = x & 0xFFF;
+                uint8_t s = t.rev[m];
+                out[pos + k * isz4] = s;
+                x = t.fc[s] * (x >> 12) + m - t.cc[s];
+                renorm(x);
+                last[k] = s;
+            }
+        }
+        // tail bytes with state 3
+        for (uint32_t pos = 4 * isz4; pos < raw_sz; pos++) {
+            uint32_t& x = R[3];
+            RansSyms& t = tables[last[3]];
+            uint32_t m = x & 0xFFF;
+            uint8_t s = t.rev[m];
+            out[pos] = s;
+            x = t.fc[s] * (x >> 12) + m - t.cc[s];
+            renorm(x);
+            last[3] = s;
+        }
+        return true;
+    }
+    return false;
+}
+
+static bool gzip_inflate_vec(const uint8_t* in, int64_t in_len, std::vector<uint8_t>& out,
+                             int64_t raw_size) {
+    out.resize(raw_size);
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, 15 + 32) != Z_OK) return false;
+    zs.next_in = const_cast<uint8_t*>(in);
+    zs.avail_in = (uInt)in_len;
+    zs.next_out = out.data();
+    zs.avail_out = (uInt)out.size();
+    int rc = inflate(&zs, Z_FINISH);
+    inflateEnd(&zs);
+    return rc == Z_STREAM_END && zs.total_out == (uLong)raw_size;
+}
+
+// ---------------------------------------------------------------------------
+// blocks
+// ---------------------------------------------------------------------------
+
+struct Block {
+    int content_type = -1;
+    int content_id = -1;
+    std::vector<uint8_t> data;
+};
+
+static bool read_block(Cursor& c, Block& b) {
+    int method = c.u8();
+    b.content_type = c.u8();
+    b.content_id = c.itf8();
+    int32_t comp_size = c.itf8();
+    int32_t raw_size = c.itf8();
+    if (!c.ok || comp_size < 0 || c.p + comp_size > c.end) return false;
+    const uint8_t* payload = c.p;
+    c.skip(comp_size);
+    c.skip(4);  // CRC32 (v3)
+    if (!c.ok) return false;
+    switch (method) {
+        case 0:  // raw
+            b.data.assign(payload, payload + comp_size);
+            return true;
+        case 1:  // gzip
+            return gzip_inflate_vec(payload, comp_size, b.data, raw_size);
+        case 4:  // rANS 4x8
+            return rans_uncompress(payload, comp_size, b.data) &&
+                   (int64_t)b.data.size() == raw_size;
+        default:  // bzip2/lzma/3.1 codecs unsupported
+            return false;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// encodings
+// ---------------------------------------------------------------------------
+
+struct Encoding {
+    int codec = 0;  // 0 null, 1 external, 3 huffman, 4 b.a.len, 5 b.a.stop, 6 beta, 9 gamma
+    int content_id = -1;
+    // huffman
+    std::vector<int32_t> symbols;
+    std::vector<int32_t> lengths;
+    // beta
+    int32_t offset = 0;
+    int32_t nbits = 0;
+    // byte_array_stop
+    uint8_t stop = 0;
+    // byte_array_len nested
+    std::vector<uint8_t> sub_params;  // raw params of (len enc, val enc)
+};
+
+struct BitReader {
+    const uint8_t* p = nullptr;
+    const uint8_t* end = nullptr;
+    int bit = 0;
+    bool ok = true;
+
+    int read_bit() {
+        if (p >= end) { ok = false; return 0; }
+        int v = (*p >> (7 - bit)) & 1;
+        if (++bit == 8) { bit = 0; p++; }
+        return v;
+    }
+    int64_t read_bits(int n) {
+        int64_t v = 0;
+        for (int i = 0; i < n; i++) v = (v << 1) | read_bit();
+        return v;
+    }
+};
+
+struct Slice;  // fwd
+
+struct Streams {
+    std::map<int, Cursor> ext;  // content id -> cursor over external block
+    BitReader core;
+};
+
+static bool parse_encoding(Cursor& c, Encoding& e) {
+    e.codec = c.itf8();
+    int32_t plen = c.itf8();
+    if (!c.ok || c.p + plen > c.end) return false;
+    Cursor pc{c.p, c.p + plen};
+    c.skip(plen);
+    switch (e.codec) {
+        case 0:
+            return true;
+        case 1:  // EXTERNAL
+            e.content_id = pc.itf8();
+            return pc.ok;
+        case 3: {  // HUFFMAN
+            int32_t n = pc.itf8();
+            for (int i = 0; i < n && pc.ok; i++) e.symbols.push_back(pc.itf8());
+            int32_t m = pc.itf8();
+            for (int i = 0; i < m && pc.ok; i++) e.lengths.push_back(pc.itf8());
+            return pc.ok && e.symbols.size() == e.lengths.size();
+        }
+        case 4:  // BYTE_ARRAY_LEN: nested (lengths encoding, values encoding)
+            e.sub_params.assign(pc.p, pc.end);
+            return true;
+        case 5:  // BYTE_ARRAY_STOP
+            e.stop = pc.u8();
+            e.content_id = pc.itf8();
+            return pc.ok;
+        case 6:  // BETA
+            e.offset = pc.itf8();
+            e.nbits = pc.itf8();
+            return pc.ok;
+        case 9:  // GAMMA
+            e.offset = pc.itf8();
+            return pc.ok;
+        default:
+            return false;  // golomb/subexp/rice unsupported
+    }
+}
+
+// canonical huffman decode (bit-by-bit, fine for the short codes CRAM uses)
+static bool huffman_decode(const Encoding& e, BitReader& br, int32_t& out) {
+    size_t n = e.symbols.size();
+    if (n == 1 || (n > 0 && e.lengths[0] == 0)) {  // constant
+        out = e.symbols[0];
+        return true;
+    }
+    // build canonical codes sorted by (len, symbol order as given)
+    struct Entry { int32_t sym; int32_t len; };
+    std::vector<Entry> entries(n);
+    for (size_t i = 0; i < n; i++) entries[i] = {e.symbols[i], e.lengths[i]};
+    // canonical order: ascending code length, ties by symbol value (spec §3.4)
+    std::stable_sort(entries.begin(), entries.end(), [](const Entry& a, const Entry& b) {
+        return a.len != b.len ? a.len < b.len : a.sym < b.sym;
+    });
+    int64_t code = 0;
+    int len = 0;
+    size_t idx = 0;
+    int64_t next_code = 0;
+    int prev_len = entries.empty() ? 0 : entries[0].len;
+    // assign canonical codes
+    std::vector<int64_t> codes(n);
+    for (size_t i = 0; i < n; i++) {
+        next_code <<= (entries[i].len - prev_len);
+        prev_len = entries[i].len;
+        codes[i] = next_code++;
+    }
+    while (idx < n && br.ok) {
+        code = (code << 1) | br.read_bit();
+        len++;
+        for (size_t i = 0; i < n; i++) {
+            if (entries[i].len == len && codes[i] == code) {
+                out = entries[i].sym;
+                return true;
+            }
+        }
+        if (len > 31) return false;
+    }
+    return false;
+}
+
+static bool decode_int(const Encoding& e, Streams& s, int32_t& out);
+
+static bool decode_byte(const Encoding& e, Streams& s, uint8_t& out) {
+    switch (e.codec) {
+        case 1: {
+            auto it = s.ext.find(e.content_id);
+            if (it == s.ext.end()) return false;
+            out = it->second.u8();
+            return it->second.ok;
+        }
+        case 3: {
+            int32_t v;
+            if (!huffman_decode(e, s.core, v)) return false;
+            out = (uint8_t)v;
+            return true;
+        }
+        case 6: {
+            out = (uint8_t)(s.core.read_bits(e.nbits) - e.offset);
+            return s.core.ok;
+        }
+        default:
+            return false;
+    }
+}
+
+static bool decode_int(const Encoding& e, Streams& s, int32_t& out) {
+    switch (e.codec) {
+        case 1: {  // EXTERNAL: ITF8 from the external stream
+            auto it = s.ext.find(e.content_id);
+            if (it == s.ext.end()) return false;
+            out = it->second.itf8();
+            return it->second.ok;
+        }
+        case 3:
+            return huffman_decode(e, s.core, out);
+        case 6:
+            out = (int32_t)(s.core.read_bits(e.nbits)) - e.offset;
+            return s.core.ok;
+        case 9: {  // GAMMA
+            int zeros = 0;
+            while (s.core.ok && s.core.read_bit() == 0) zeros++;
+            int64_t v = 1;
+            for (int i = 0; i < zeros; i++) v = (v << 1) | s.core.read_bit();
+            out = (int32_t)v - e.offset;
+            return s.core.ok;
+        }
+        default:
+            return false;
+    }
+}
+
+static bool decode_byte_array(const Encoding& e, Streams& s, std::vector<uint8_t>& out,
+                              int32_t known_len = -1) {
+    out.clear();
+    switch (e.codec) {
+        case 1: {  // EXTERNAL with caller-known length
+            if (known_len < 0) return false;
+            auto it = s.ext.find(e.content_id);
+            if (it == s.ext.end()) return false;
+            Cursor& c = it->second;
+            if (c.p + known_len > c.end) { c.ok = false; return false; }
+            out.assign(c.p, c.p + known_len);
+            c.skip(known_len);
+            return true;
+        }
+        case 4: {  // BYTE_ARRAY_LEN
+            Cursor pc{e.sub_params.data(), e.sub_params.data() + e.sub_params.size()};
+            Encoding len_enc, val_enc;
+            if (!parse_encoding(pc, len_enc) || !parse_encoding(pc, val_enc)) return false;
+            int32_t n;
+            if (!decode_int(len_enc, s, n) || n < 0 || n > (1 << 28)) return false;
+            if (val_enc.codec == 1) return decode_byte_array(val_enc, s, out, n);
+            out.resize(n);
+            for (int i = 0; i < n; i++)
+                if (!decode_byte(val_enc, s, out[i])) return false;
+            return true;
+        }
+        case 5: {  // BYTE_ARRAY_STOP
+            auto it = s.ext.find(e.content_id);
+            if (it == s.ext.end()) return false;
+            Cursor& c = it->second;
+            while (c.p < c.end && *c.p != e.stop) out.push_back(*c.p++);
+            if (c.p < c.end) c.p++;  // consume stop
+            return true;
+        }
+        default:
+            return false;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// compression header
+// ---------------------------------------------------------------------------
+
+struct CompHeader {
+    bool ap_delta = true;
+    bool rn_preserved = true;
+    std::map<uint16_t, Encoding> series;      // 2-char key -> encoding
+    std::map<int32_t, Encoding> tag_enc;      // packed tag key -> encoding
+    std::vector<std::vector<int32_t>> tag_lines;  // TD: tag ids per line
+};
+
+static uint16_t key2(const char* k) { return ((uint16_t)k[0] << 8) | (uint8_t)k[1]; }
+
+static bool parse_comp_header(const Block& b, CompHeader& h) {
+    Cursor c{b.data.data(), b.data.data() + b.data.size()};
+    // preservation map
+    int32_t psize = c.itf8();
+    (void)psize;
+    int32_t n = c.itf8();
+    for (int i = 0; i < n && c.ok; i++) {
+        uint16_t k = ((uint16_t)c.u8() << 8) | c.u8();
+        if (k == key2("RN")) h.rn_preserved = c.u8() != 0;
+        else if (k == key2("AP")) h.ap_delta = c.u8() != 0;
+        else if (k == key2("RR")) c.u8();
+        else if (k == key2("SM")) c.skip(5);
+        else if (k == key2("TD")) {
+            int32_t tdlen = c.itf8();
+            const uint8_t* td = c.p;
+            c.skip(tdlen);
+            // TD: \0-separated lines of 3-byte tag descriptors
+            std::vector<int32_t> line;
+            for (int32_t j = 0; j < tdlen; j++) {
+                if (td[j] == 0) {
+                    h.tag_lines.push_back(line);
+                    line.clear();
+                } else if (j + 2 < tdlen) {
+                    line.push_back(((int32_t)td[j] << 16) | ((int32_t)td[j + 1] << 8) | td[j + 2]);
+                    j += 2;
+                }
+            }
+        } else {
+            return false;  // unknown preservation key: layout unknown
+        }
+    }
+    // data series encodings
+    int32_t dsize = c.itf8();
+    (void)dsize;
+    n = c.itf8();
+    for (int i = 0; i < n && c.ok; i++) {
+        uint16_t k = ((uint16_t)c.u8() << 8) | c.u8();
+        Encoding e;
+        if (!parse_encoding(c, e)) return false;
+        h.series[k] = e;
+    }
+    // tag encodings
+    int32_t tsize = c.itf8();
+    (void)tsize;
+    n = c.itf8();
+    for (int i = 0; i < n && c.ok; i++) {
+        int32_t k = c.itf8();
+        Encoding e;
+        if (!parse_encoding(c, e)) return false;
+        h.tag_enc[k] = e;
+    }
+    return c.ok;
+}
+
+// ---------------------------------------------------------------------------
+// record decode
+// ---------------------------------------------------------------------------
+
+struct RecOut {
+    int32_t* ref_id;
+    int64_t* pos;
+    int32_t* span;
+    int32_t* mapq;
+    int32_t* flags;
+    int32_t* read_len;
+};
+
+static bool get_enc(const CompHeader& h, const char* k, Encoding& e) {
+    auto it = h.series.find(key2(k));
+    if (it == h.series.end()) return false;
+    e = it->second;
+    return true;
+}
+
+// decode all records of one slice; returns count or -1
+static int64_t decode_slice(const CompHeader& h, int container_ref,
+                            const std::vector<Block>& blocks, RecOut out, int64_t out_off,
+                            int64_t max_records) {
+    // slice header is blocks[0]
+    Cursor sh{blocks[0].data.data(), blocks[0].data.data() + blocks[0].data.size()};
+    int32_t slice_ref = sh.itf8();
+    int32_t slice_start = sh.itf8();
+    sh.itf8();  // span
+    int32_t n_records = sh.itf8();
+    sh.ltf8();  // record counter
+    sh.itf8();  // n blocks
+    int32_t n_ids = sh.itf8();
+    for (int i = 0; i < n_ids; i++) sh.itf8();
+    sh.itf8();  // embedded ref content id
+    if (!sh.ok) return -1;
+
+    Streams s;
+    for (size_t i = 1; i < blocks.size(); i++) {
+        const Block& b = blocks[i];
+        if (b.content_type == 5)  // core
+            s.core = BitReader{b.data.data(), b.data.data() + b.data.size(), 0, true};
+        else if (b.content_type == 4)
+            s.ext[b.content_id] = Cursor{b.data.data(), b.data.data() + b.data.size()};
+    }
+
+    Encoding eBF, eCF, eRI, eRL, eAP, eRG, eRN, eMF, eNS, eNP, eTS, eNF, eTL, eFN, eFC, eFP;
+    Encoding eDL, eBA, eQS, eBS, eIN, eSC, eHC, ePD, eRS, eMQ, eBB, eQQ;
+    bool hBF = get_enc(h, "BF", eBF), hCF = get_enc(h, "CF", eCF);
+    bool hRI = get_enc(h, "RI", eRI), hRL = get_enc(h, "RL", eRL);
+    bool hAP = get_enc(h, "AP", eAP), hRG = get_enc(h, "RG", eRG);
+    bool hRN = get_enc(h, "RN", eRN), hMF = get_enc(h, "MF", eMF);
+    bool hNS = get_enc(h, "NS", eNS), hNP = get_enc(h, "NP", eNP);
+    bool hTS = get_enc(h, "TS", eTS), hNF = get_enc(h, "NF", eNF);
+    bool hTL = get_enc(h, "TL", eTL), hFN = get_enc(h, "FN", eFN);
+    bool hFC = get_enc(h, "FC", eFC), hFP = get_enc(h, "FP", eFP);
+    bool hDL = get_enc(h, "DL", eDL), hBA = get_enc(h, "BA", eBA);
+    bool hQS = get_enc(h, "QS", eQS), hBS = get_enc(h, "BS", eBS);
+    bool hIN = get_enc(h, "IN", eIN), hSC = get_enc(h, "SC", eSC);
+    bool hHC = get_enc(h, "HC", eHC), hPD = get_enc(h, "PD", ePD);
+    bool hRS = get_enc(h, "RS", eRS), hMQ = get_enc(h, "MQ", eMQ);
+    bool hBB = get_enc(h, "BB", eBB), hQQ = get_enc(h, "QQ", eQQ);
+    if (!(hBF && hCF && hRL && hAP)) return -1;
+
+    int64_t last_pos = slice_start;
+    std::vector<uint8_t> scratch;
+    for (int32_t r = 0; r < n_records; r++) {
+        if (out_off + r >= max_records) return -4;  // caller grows the buffers
+        int32_t bf, cf, ri = container_ref, rl, ap, v;
+        if (!decode_int(eBF, s, bf)) return -1;
+        if (!decode_int(eCF, s, cf)) return -1;
+        if (container_ref == -2) {
+            if (!hRI || !decode_int(eRI, s, ri)) return -1;
+        } else {
+            ri = (slice_ref != -2) ? slice_ref : container_ref;
+        }
+        if (!decode_int(eRL, s, rl)) return -1;
+        if (!decode_int(eAP, s, ap)) return -1;
+        int64_t pos;
+        if (h.ap_delta) {
+            pos = last_pos + ap;
+            last_pos = pos;
+        } else {
+            pos = ap;
+        }
+        if (hRG && !decode_int(eRG, s, v)) return -1;
+        if (h.rn_preserved) {
+            if (!hRN || !decode_byte_array(eRN, s, scratch)) return -1;
+        }
+        if (cf & 0x2) {  // detached mate
+            if (!hMF || !decode_int(eMF, s, v)) return -1;
+            if (!h.rn_preserved) {
+                if (!hRN || !decode_byte_array(eRN, s, scratch)) return -1;
+            }
+            if (!hNS || !decode_int(eNS, s, v)) return -1;
+            if (!hNP || !decode_int(eNP, s, v)) return -1;
+            if (!hTS || !decode_int(eTS, s, v)) return -1;
+        } else if (cf & 0x4) {  // mate downstream
+            if (!hNF || !decode_int(eNF, s, v)) return -1;
+        }
+        int32_t tl = -1;
+        if (hTL && !decode_int(eTL, s, tl)) return -1;
+        if (hTL && tl >= 0 && (size_t)tl < h.tag_lines.size()) {
+            for (int32_t tag_key : h.tag_lines[tl]) {
+                auto it = h.tag_enc.find(tag_key);
+                if (it == h.tag_enc.end()) return -1;
+                if (!decode_byte_array(it->second, s, scratch)) return -1;
+            }
+        }
+        int32_t span = rl;
+        int32_t mapq = 0;
+        if ((bf & 4) == 0) {  // mapped
+            int32_t fn;
+            if (!decode_int(eFN, s, fn)) return -1;
+            int32_t soft = 0, ins = 0, dels = 0, skips = 0, hard = 0;
+            for (int32_t f = 0; f < fn; f++) {
+                uint8_t fc;
+                int32_t fp;
+                if (!decode_byte(eFC, s, fc)) return -1;
+                if (!decode_int(eFP, s, fp)) return -1;
+                uint8_t bb;
+                switch (fc) {
+                    case 'B':
+                        if (!hBA || !decode_byte(eBA, s, bb)) return -1;
+                        if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                        break;
+                    case 'X':
+                        if (!hBS || !decode_int(eBS, s, v)) return -1;
+                        break;
+                    case 'I':
+                        if (!hIN || !decode_byte_array(eIN, s, scratch)) return -1;
+                        ins += (int32_t)scratch.size();
+                        break;
+                    case 'S':
+                        if (!hSC || !decode_byte_array(eSC, s, scratch)) return -1;
+                        soft += (int32_t)scratch.size();
+                        break;
+                    case 'D':
+                        if (!hDL || !decode_int(eDL, s, v)) return -1;
+                        dels += v;
+                        break;
+                    case 'i':
+                        if (!hBA || !decode_byte(eBA, s, bb)) return -1;
+                        ins += 1;
+                        break;
+                    case 'N':
+                        if (!hRS || !decode_int(eRS, s, v)) return -1;
+                        skips += v;
+                        break;
+                    case 'P':
+                        if (!hPD || !decode_int(ePD, s, v)) return -1;
+                        break;
+                    case 'H':
+                        if (!hHC || !decode_int(eHC, s, v)) return -1;
+                        hard += v;
+                        break;
+                    case 'Q':
+                        if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                        break;
+                    case 'b':
+                        if (!hBB || !decode_byte_array(eBB, s, scratch)) return -1;
+                        break;
+                    case 'q':
+                        if (!hQQ || !decode_byte_array(eQQ, s, scratch)) return -1;
+                        break;
+                    default:
+                        return -1;
+                }
+            }
+            span = rl - soft - ins + dels + skips;
+            if (!hMQ || !decode_int(eMQ, s, mapq)) return -1;
+            if (cf & 0x1) {  // quality scores stored as array
+                for (int32_t q = 0; q < rl; q++) {
+                    uint8_t bb;
+                    if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                }
+            }
+        } else {  // unmapped: bases then quals
+            for (int32_t q = 0; q < rl; q++) {
+                uint8_t bb;
+                if (!hBA || !decode_byte(eBA, s, bb)) return -1;
+            }
+            if (cf & 0x1) {
+                for (int32_t q = 0; q < rl; q++) {
+                    uint8_t bb;
+                    if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                }
+            }
+        }
+        out.ref_id[out_off + r] = ri;
+        out.pos[out_off + r] = pos;
+        out.span[out_off + r] = span;
+        out.mapq[out_off + r] = mapq;
+        out.flags[out_off + r] = bf;
+        out.read_len[out_off + r] = rl;
+    }
+    return n_records;
+}
+
+}  // namespace cram
+
+#include <algorithm>
+
+extern "C" {
+
+// SAM header text of a CRAM file -> out buffer; returns text length or
+// negative (-1 malformed, -2 unsupported compression, -3 buffer too small).
+int64_t vctpu_cram_header(const uint8_t* buf, int64_t len, uint8_t* out, int64_t out_cap) {
+    using namespace cram;
+    if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
+    if (buf[4] != 3) return -2;  // major version
+    Cursor c{buf + 26, buf + len};
+    // first container = file header
+    c.u32le();  // container length
+    c.itf8(); c.itf8(); c.itf8(); c.itf8();  // ref id, start, span, n records
+    c.ltf8(); c.ltf8();                      // counter, bases
+    int32_t n_blocks = c.itf8();
+    int32_t n_landmarks = c.itf8();
+    for (int i = 0; i < n_landmarks; i++) c.itf8();
+    c.skip(4);  // CRC
+    if (!c.ok || n_blocks < 1) return -1;
+    Block b;
+    if (!read_block(c, b)) return -2;
+    if (b.data.size() < 4) return -1;
+    // block payload: int32 text length + SAM text
+    int32_t text_len = (int32_t)b.data[0] | ((int32_t)b.data[1] << 8) |
+                       ((int32_t)b.data[2] << 16) | ((int32_t)b.data[3] << 24);
+    if (text_len < 0 || (size_t)text_len + 4 > b.data.size()) return -1;
+    if (text_len > out_cap) return -3;
+    memcpy(out, b.data.data() + 4, text_len);
+    return text_len;
+}
+
+// Decode all alignment records. Returns record count, or negative on error.
+int64_t vctpu_cram_scan(const uint8_t* buf, int64_t len, int64_t max_records,
+                        int32_t* ref_id, int64_t* pos, int32_t* span, int32_t* mapq,
+                        int32_t* flags, int32_t* read_len) {
+    using namespace cram;
+    if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
+    if (buf[4] != 3) return -2;
+    Cursor c{buf + 26, buf + len};
+    int64_t total = 0;
+    bool first = true;
+    while (c.ok && c.p < c.end) {
+        const uint8_t* cont_start = c.p;
+        int32_t cont_len = (int32_t)c.u32le();
+        int32_t ref = c.itf8();
+        int32_t start = c.itf8();
+        (void)start;
+        c.itf8();  // span
+        int32_t n_rec = c.itf8();
+        c.ltf8();  // counter
+        c.ltf8();  // bases
+        int32_t n_blocks = c.itf8();
+        int32_t n_landmarks = c.itf8();
+        for (int i = 0; i < n_landmarks; i++) c.itf8();
+        c.skip(4);  // CRC
+        if (!c.ok) break;
+        const uint8_t* body = c.p;
+        // EOF container: ref -1, no records, 38-byte standard marker
+        if (ref == -1 && n_rec == 0 && n_blocks <= 1 && c.p + cont_len >= c.end) break;
+        if (first) {  // file header container
+            first = false;
+            c = Cursor{body + cont_len, buf + len};
+            continue;
+        }
+        if (n_rec == 0) {  // e.g. multi-container EOF variants
+            c = Cursor{body + cont_len, buf + len};
+            continue;
+        }
+        Cursor cc{body, body + cont_len};
+        Block chb;
+        if (!read_block(cc, chb) || chb.content_type != 1) return -2;
+        CompHeader h;
+        if (!parse_comp_header(chb, h)) return -2;
+        // remaining blocks: slices (each: slice header block + data blocks)
+        while (cc.ok && cc.p < cc.end) {
+            Block shb;
+            if (!read_block(cc, shb)) return -2;
+            if (shb.content_type != 2) break;
+            // slice header tells how many data blocks follow
+            Cursor sh{shb.data.data(), shb.data.data() + shb.data.size()};
+            sh.itf8(); sh.itf8(); sh.itf8(); sh.itf8();
+            sh.ltf8();
+            int32_t s_blocks = sh.itf8();
+            if (!sh.ok) return -1;
+            std::vector<Block> blocks;
+            blocks.push_back(shb);
+            for (int32_t i = 0; i < s_blocks; i++) {
+                Block db;
+                if (!read_block(cc, db)) return -2;
+                blocks.push_back(std::move(db));
+            }
+            RecOut out{ref_id, pos, span, mapq, flags, read_len};
+            int64_t n = decode_slice(h, ref, blocks, out, total, max_records);
+            if (n < 0) return n == -4 ? -4 : -1;
+            total += n;
+        }
+        c = Cursor{body + cont_len, buf + len};
+        (void)cont_start;
+    }
+    return total;
+}
+
+}  // extern "C"

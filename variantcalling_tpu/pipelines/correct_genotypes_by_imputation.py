@@ -64,7 +64,12 @@ def parse_args(argv: list[str]):
 def run(argv: list[str]) -> int:
     """Correct a vcf based on imputation."""
     args = parse_args(argv)
-    if args.input_vcf and not args.beagle_annotated_vcf:
+    if args.input_vcf and args.beagle_annotated_vcf:
+        raise SystemExit(
+            "--input_vcf (full stage chain) and --beagle_annotated_vcf "
+            "(pre-annotated input) are mutually exclusive"
+        )
+    if args.input_vcf:
         return _run_stage_chain(args)
     if not args.beagle_annotated_vcf:
         raise SystemExit("provide --beagle_annotated_vcf, or --input_vcf with cohort/map args")

@@ -52,7 +52,8 @@ def parse_args(argv: list[str], command: str):
     ap.add_argument("-q", "-bq", dest="bq", type=int, default=0)
     ap.add_argument("-Q", "-mapq", dest="mapq", type=int, default=0)
     ap.add_argument("-l", dest="min_read_length", type=int, default=0)
-    ap.add_argument("--reference", default=None, help="(cram inputs unsupported; accepted)")
+    ap.add_argument("--reference", default=None,
+                    help="reference FASTA (CRAM decode is reference-free for depth)")
     ap.add_argument("--reference-gaps", default=None)
     ap.add_argument("--centromeres", default=None)
     ap.add_argument("-j", "--jobs", type=int, default=-1, help="(accepted; XLA owns parallelism)")
@@ -156,6 +157,21 @@ def full_analysis(args) -> int:
     write_hdf(df_hist, out_h5, key="histogram", mode="w")
     write_hdf(df_stats.reset_index().rename(columns={"index": "stat"}), out_h5, key="stats", mode="a")
     write_hdf(df_pct.reset_index().rename(columns={"index": "percentile"}), out_h5, key="percentiles", mode="a")
+
+    # --- plots (reference :536-544 boxplot, :596-609 per-window profiles) --
+    try:
+        generate_coverage_boxplot(df_pct, out_path=f"{base}.coverage_boxplot.png")
+        for w in sorted(windows):
+            if w >= 1000:
+                plot_coverage_profile(
+                    f"{base}.w{w}.parquet",
+                    centromere_file=getattr(args, "centromeres", None),
+                    reference_gaps_file=getattr(args, "reference_gaps", None),
+                    title=f"(window {w})",
+                    out_path=f"{base}.w{w}.profile.png",
+                )
+    except Exception as e:  # plotting must never fail the numeric outputs
+        logger.warning("coverage plots skipped: %s", e)
     logger.info("wrote %s (histogram/stats/percentiles) + %d binned parquets", out_h5, len(windows))
     return 0
 
@@ -199,3 +215,131 @@ def run(argv: list[str]) -> int:
 
 if __name__ == "__main__":
     sys.exit(run(sys.argv[1:]))
+
+
+# ---------------------------------------------------------------------------
+# plots (reference coverage_analysis.py:960-1068 boxplot, :1071-1209 profile)
+# ---------------------------------------------------------------------------
+
+MIN_LENGTH_TO_SHOW = 10_000_000  # contigs below this are not profiled (:63)
+
+
+def generate_coverage_boxplot(df_percentiles: pd.DataFrame, out_path: str | None = None,
+                              title: str = "") -> str | None:
+    """Percentile boxplot per coverage category, normalized to the Genome median.
+
+    Same figure contract as the reference's generate_coverage_boxplot
+    (:960-1068): one box per category from the Q5/Q25/Q50/Q75/Q95 rows,
+    median + 5th-percentile value labels, y = coverage relative to median.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if isinstance(df_percentiles, str):
+        from variantcalling_tpu.utils.h5_utils import read_hdf
+
+        df_percentiles = read_hdf(df_percentiles, key="percentiles").set_index("percentile")
+    genome_cols = [c for c in df_percentiles.columns if "Genome" in str(c)]
+    denom = float(df_percentiles.loc["Q50", genome_cols[0]]) if genome_cols else \
+        float(df_percentiles.loc["Q50"].iloc[0])
+    norm = df_percentiles / max(denom, 1e-9)
+
+    bxp = []
+    for col in norm.columns:
+        bxp.append({
+            "label": str(col),
+            "med": float(norm.loc["Q50", col]),
+            "q1": float(norm.loc["Q25", col]),
+            "q3": float(norm.loc["Q75", col]),
+            "whislo": float(norm.loc["Q5", col]),
+            "whishi": float(norm.loc["Q95", col]),
+            "mean": float(norm.loc["Q50", col]),
+        })
+
+    plt.figure(figsize=(20, 8))
+    fig, ax = plt.gcf(), plt.gca()
+    patches = ax.bxp(bxp, widths=0.7, showfliers=False, showmeans=True, patch_artist=True)
+    ax.set_title(title)
+    for j, bx in enumerate(bxp):
+        plt.text(j + 1, bx["med"] + 0.03, f"{bx['med']:.2f}", ha="center", fontsize=12)
+        plt.text(j + 1, bx["whislo"] - 0.06, f"{bx['whislo']:.2f}", ha="center", fontsize=12)
+    plt.xticks(rotation=90)
+    plt.ylim(-0.1, 2)
+    plt.grid(axis="x")
+    plt.ylabel("Coverage relative to median")
+    for box in patches["boxes"]:
+        box.set_edgecolor("k")
+        box.set_linewidth(2)
+    plt.tight_layout()
+    if out_path is not None:
+        target = out_path if "." in os.path.basename(out_path) else \
+            os.path.join(out_path, "coverage_boxplot.png")
+        fig.savefig(target, dpi=150, bbox_inches="tight")
+        plt.close(fig)
+        return target
+    return None
+
+
+def plot_coverage_profile(binned_parquet: str, centromere_file: str | None = None,
+                          reference_gaps_file: str | None = None, title: str = "",
+                          y_max: float = 3.0, out_path: str | None = None) -> str | None:
+    """Per-contig normalized coverage profile grid (reference :1071-1209).
+
+    Reads one binned-coverage parquet (the w>=1000 cascade output), keeps
+    contigs >= MIN_LENGTH_TO_SHOW, downsamples each to <=300 points, plots
+    coverage/median with optional centromere/gap shading.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    df_all = pd.read_parquet(binned_parquet)
+    spans = {}
+    for contig, grp in df_all.groupby("chrom", sort=False):
+        if grp["chromEnd"].max() >= MIN_LENGTH_TO_SHOW:
+            spans[str(contig)] = grp
+    if not spans:
+        return None
+    med = np.median([g["coverage"].median() for g in spans.values() if len(g) > 100] or
+                    [g["coverage"].median() for g in spans.values()])
+    med = max(float(med), 1.0)
+
+    def _regions(path, want_type=None):
+        if path is None:
+            return {}
+        tbl = pd.read_csv(path, sep="\t", header=None, comment="#").iloc[:, :5]
+        tbl.columns = ["chrom", "chromStart", "chromEnd", "name", "type"][: tbl.shape[1]]
+        if want_type is not None and "type" in tbl.columns:
+            tbl = tbl[tbl["type"] == want_type]
+        return {c: g for c, g in tbl.groupby("chrom")}
+
+    acen = _regions(centromere_file, "acen")
+    gaps = _regions(reference_gaps_file)
+
+    n = len(spans)
+    rows = -(-n // 2)
+    fig, axs = plt.subplots(rows, 2, figsize=(28, rows * 3), sharey="all", squeeze=False)
+    fig.subplots_adjust(hspace=0.5, wspace=0.01)
+    fig.suptitle(f"Coverage profile (normalized to median) {title}\nMedian coverage = {med:.1f}",
+                 y=0.98)
+    for ax, (contig, grp) in zip(axs.flatten(), spans.items()):
+        if len(grp) > 300:
+            grp = grp.iloc[:: len(grp) // 300]
+        x = (grp["chromStart"] + grp["chromEnd"]) / 2 / 1e6
+        ax.plot(x, np.clip(grp["coverage"] / med, 0, 100), ".", markersize=3)
+        ax.set_title(str(contig), fontsize=18)
+        ax.set_ylim(0, y_max)
+        for tbl, color in ((acen.get(contig), "green"), (gaps.get(contig), "red")):
+            if tbl is not None:
+                for _, r in tbl.iterrows():
+                    ax.axvspan(r["chromStart"] / 1e6, r["chromEnd"] / 1e6, color=color, alpha=0.3)
+    for ax in axs.flatten()[n:]:
+        ax.axis("off")
+    if out_path is not None:
+        fig.savefig(out_path, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out_path
+    return None

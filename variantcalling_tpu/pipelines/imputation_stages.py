@@ -67,7 +67,7 @@ def run_beagle(high_gq_vcf: str, cohort_vcf: str, plink_map: str, out_vcf: str,
         raise RuntimeError(f"beagle failed rc={proc.returncode}: {proc.stderr[-800:]}")
 
 
-def collapse_beagle(beagle_vcf: str, out_path: str) -> VariantTable:
+def collapse_beagle(beagle_vcf: str, out_path: str) -> dict:
     """bcftools view -i 'GT=\"alt\"' | grep -v END | norm -m + (:164-171).
 
     Keeps alt-called records, drops END-carrying blocks, joins biallelic

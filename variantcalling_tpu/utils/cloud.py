@@ -59,3 +59,52 @@ def optional_cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE) -> str:
         return cloud_sync(uri, cache_dir)
     except RuntimeError:
         return uri
+
+
+# ---------------------------------------------------------------------------
+# GCS OAuth token mint (reference ugvc/utils/cloud_auth.py:17-45)
+# ---------------------------------------------------------------------------
+
+GOOGLE_APPLICATION_CREDENTIALS = "GOOGLE_APPLICATION_CREDENTIALS"
+GCS_OAUTH_TOKEN = "GCS_OAUTH_TOKEN"
+_GCS_SCOPE = "https://www.googleapis.com/auth/devstorage.read_only"
+
+
+def get_gcs_token(verify: bool = False) -> str:
+    """Mint (or pass through) a GCS access token.
+
+    Mirrors the reference contract: with GOOGLE_APPLICATION_CREDENTIALS set,
+    mint + refresh through google.auth; else fall back to a pre-existing
+    GCS_OAUTH_TOKEN; else raise. ``verify=True`` additionally checks token
+    liveness against the oauth2 tokeninfo endpoint (the reference always
+    POSTs; here it is opt-in because this framework targets zero-egress
+    environments where the mint itself is offline but verification is not).
+    """
+    if GOOGLE_APPLICATION_CREDENTIALS in os.environ:
+        from google.auth import default
+        from google.auth.transport.requests import Request
+
+        credentials, _project = default(scopes=[_GCS_SCOPE])
+        credentials.refresh(Request())
+        token = credentials.token
+    elif GCS_OAUTH_TOKEN in os.environ:
+        token = os.environ[GCS_OAUTH_TOKEN]
+    else:
+        raise ValueError(
+            f"Could not generate gcs token: set {GOOGLE_APPLICATION_CREDENTIALS} "
+            f"(to mint) or {GCS_OAUTH_TOKEN} (pre-existing token)"
+        )
+    if verify:
+        import requests
+
+        resp = requests.post(
+            "https://www.googleapis.com/oauth2/v1/tokeninfo",
+            data=f"access_token={token}",
+            headers={"content-type": "application/x-www-form-urlencoded"},
+            timeout=30,
+        )
+        if not resp.ok:
+            raise ValueError(f"Could not verify token: {resp.text}")
+        if not resp.json().get("expires_in", 0) > 0:
+            raise ValueError("token expired")
+    return token
