@@ -117,3 +117,21 @@ def test_cram_coverage_cli(tmp_path):
 
     lines = _gz.open(out + ".bedgraph.gz", "rt").read().splitlines()
     assert any(ln.startswith("chr1\t10\t") for ln in lines)
+
+
+def test_corrupt_cram_is_error_not_crash(tmp_path):
+    """Truncated/bit-flipped inputs must surface as ValueError, never abort."""
+    from variantcalling_tpu.io.cram import cram_records
+
+    p = str(tmp_path / "ok.cram")
+    write_cram(p, SAM_HEADER, _records(), method=GZIP)
+    data = bytearray(open(p, "rb").read())
+    # truncate mid-container
+    (tmp_path / "trunc.cram").write_bytes(bytes(data[: len(data) // 2]))
+    # flip bytes in the data region
+    for off in range(len(data) // 2, min(len(data) // 2 + 64, len(data))):
+        data[off] ^= 0xFF
+    (tmp_path / "flip.cram").write_bytes(bytes(data))
+    for name in ("trunc.cram", "flip.cram"):
+        with pytest.raises(ValueError):
+            cram_records(str(tmp_path / name))

@@ -192,3 +192,78 @@ def test_create_var_report_end_to_end(tmp_path):
     assert "all_data" in list_keys(out_h5)
     html = open(out_html).read()
     assert "General accuracy" in html and "SNP" in html
+
+
+def test_create_var_report_full_sections(tmp_path, rng):
+    """The deepened notebook-section inventory: region sections, per-base
+    stratification, homozygous keys, error-example tables, indel analysis
+    (createVarReport.ipynb cells 8-20)."""
+    from variantcalling_tpu.pipelines.create_var_report import run
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf, write_hdf
+
+    n = 400
+    is_indel = rng.random(n) < 0.4
+    hmer = np.where(is_indel & (rng.random(n) < 0.6), rng.integers(1, 22, n), 0)
+    cls = rng.choice(["tp", "tp", "tp", "fp", "fn"], n)
+    d = pd.DataFrame({
+        "chrom": "chr1",
+        "pos": np.arange(1, n + 1) * 50,
+        "indel": is_indel,
+        "hmer_indel_length": hmer,
+        "hmer_indel_nuc": np.where(hmer > 0, rng.choice(list("ACGT"), n), None),
+        "tree_score": rng.random(n),
+        "filter": np.where(rng.random(n) < 0.9, "PASS", "LOW_SCORE"),
+        "blacklst": "",
+        "classify": cls,
+        "classify_gt": cls,
+        "indel_length": np.where(is_indel, rng.integers(1, 12, n), 0),
+        "well_mapped_coverage": rng.integers(5, 60, n).astype(float),
+        "base": np.where(cls == "fn", "FN", "TP"),
+        "call": np.where(cls == "fp", "FP", np.where(cls == "fn", "NA", "TP")),
+        "gt_ground_truth": rng.choice(["0/1", "1/1"], n),
+        "gt_ultima": rng.choice(["0/1", "1/1"], n),
+        "ad": "10,10",
+        "dp": 20.0,
+        "vaf": rng.random(n),
+        "ref": rng.choice(list("ACGT"), n),
+        "alleles": "A,G",
+        "gc_content": 0.5,
+        "indel_classify": np.where(is_indel, rng.choice(["ins", "del"], n), None),
+        "qual": rng.uniform(10, 80, n),
+        "gq": rng.uniform(10, 80, n),
+        "ug_hcr": rng.random(n) < 0.7,
+        "exome.twist": rng.random(n) < 0.3,
+        "mappability.0": rng.random(n) < 0.8,
+        "callable": rng.random(n) < 0.9,
+        "LCR-hs38": rng.random(n) < 0.1,
+    })
+    path = str(tmp_path / "conc.h5")
+    write_hdf(d, path, key="all", mode="w")
+    out_h5 = str(tmp_path / "report.h5")
+    plot_dir = str(tmp_path / "plots")
+    run(["--h5_concordance_file", path, "--h5_output", out_h5,
+         "--plot_dir", plot_dir, "--verbosity", "5"])
+
+    keys = set(list_keys(out_h5))
+    expected = {"parameters", "all_data", "sec_data", "all_data_per_base",
+                "all_data_homozygous", "ug_hcr", "ug_hcr_homozygous", "exome",
+                "good_cvg_data", "good_cvg_data_homozygous", "callable_data",
+                "wg_indel_analysis", "ug_hcr_indel_analysis", "exome_indel_analysis"}
+    missing = expected - keys
+    assert not missing, f"missing h5 keys: {missing} (got {sorted(keys)})"
+
+    ia = read_hdf(out_h5, key="wg_indel_analysis")
+    assert {"group", "variable", "bin_left", "ins_tp", "del_fp", "precision",
+            "recall"} <= set(ia.columns)
+    assert set(ia["group"]) == {"hmer_indels", "non_hmer_indels"}
+    assert "hmer_length" in set(ia["variable"])
+    # counts in the analysis equal the frame's own tallies for one cell
+    hm = d[d["indel"] & (d["hmer_indel_length"] > 0)]
+    expect_tp_ins = int(((hm["classify"] == "tp") & (hm["indel_classify"] == "ins")
+                         & (hm["indel_length"] == 3)).sum())
+    row = ia[(ia["group"] == "hmer_indels") & (ia["variable"] == "indel_length")
+             & (ia["bin_left"] == 3)]
+    assert int(row["ins_tp"].iloc[0]) == expect_tp_ins
+    import os
+
+    assert any(f.startswith("indel_") for f in os.listdir(plot_dir))

@@ -26,6 +26,10 @@ from variantcalling_tpu.io.bam import EXCLUDE_FLAGS, BamHeader
 def read_cram_header(path: str) -> BamHeader:
     with open(path, "rb") as fh:
         buf = fh.read()
+    return header_from_buffer(buf, path)
+
+
+def header_from_buffer(buf, path: str = "<buffer>") -> BamHeader:
     text = native.cram_header(buf)
     if text is None:
         raise ValueError(
@@ -49,23 +53,20 @@ def read_cram_header(path: str) -> BamHeader:
 
 
 def cram_records(path: str) -> tuple[BamHeader, dict]:
-    """(header, record arrays) for a whole CRAM file."""
+    """(header, record arrays) for a whole CRAM file (single read, exact alloc)."""
     with open(path, "rb") as fh:
         buf = fh.read()
-    header = read_cram_header(path)
-    cap = max(1 << 16, len(buf) // 16)
-    for _ in range(8):
-        recs = native.cram_scan(buf, cap)
-        if recs == "grow":
-            cap *= 4
-            continue
-        if recs is None:
-            raise ValueError(
-                f"cannot decode CRAM records of {path}: unsupported codec or "
-                "malformed stream (supported: CRAM 3.0, raw/gzip/rANS-4x8 blocks)"
-            )
-        return header, recs
-    raise ValueError(f"CRAM record count exceeds retry capacity for {path}")
+    header = header_from_buffer(buf, path)
+    n = native.cram_count(buf)
+    if n is None:
+        raise ValueError(f"cannot walk CRAM containers of {path} (malformed stream?)")
+    recs = native.cram_scan(buf, max(n, 1))
+    if recs is None or recs == "grow":
+        raise ValueError(
+            f"cannot decode CRAM records of {path}: unsupported codec or "
+            "malformed stream (supported: CRAM 3.0, raw/gzip/rANS-4x8 blocks)"
+        )
+    return header, recs
 
 
 def depth_diff_arrays(

@@ -97,6 +97,8 @@ def get_lib() -> ctypes.CDLL | None:
         ]
         lib.vctpu_cram_header.restype = _i64
         lib.vctpu_cram_header.argtypes = [_u8p, _i64, _u8p, _i64]
+        lib.vctpu_cram_count.restype = _i64
+        lib.vctpu_cram_count.argtypes = [_u8p, _i64]
         lib.vctpu_cram_scan.restype = _i64
         lib.vctpu_cram_scan.argtypes = [
             _u8p, _i64, _i64, _i32p, _i64p, _i32p, _i32p, _i32p, _i32p,
@@ -348,6 +350,16 @@ def cram_header(buf) -> str | None:
             return None
         return out[:n].tobytes().decode("utf-8", "replace")
     return None
+
+
+def cram_count(buf) -> int | None:
+    """Exact record count from the container headers (no block decode)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(_u8view(buf))
+    n = lib.vctpu_cram_count(src.ctypes.data_as(_u8p), len(src))
+    return None if n < 0 else int(n)
 
 
 def cram_scan(buf, max_records: int) -> dict | None:

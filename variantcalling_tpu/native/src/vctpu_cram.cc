@@ -116,7 +116,7 @@ static bool rans_uncompress(const uint8_t* in, int64_t in_len, std::vector<uint8
     uint32_t comp_sz = c.u32le();
     uint32_t raw_sz = c.u32le();
     (void)comp_sz;
-    if (!c.ok) return false;
+    if (!c.ok || raw_sz > (uint32_t)(int64_t(1) << 31)) return false;
     out.resize(raw_sz);
     if (raw_sz == 0) return true;
 
@@ -218,13 +218,17 @@ struct Block {
     std::vector<uint8_t> data;
 };
 
+static const int64_t MAX_BLOCK_RAW = int64_t(1) << 31;  // corrupt-size guard
+
 static bool read_block(Cursor& c, Block& b) {
     int method = c.u8();
     b.content_type = c.u8();
     b.content_id = c.itf8();
     int32_t comp_size = c.itf8();
     int32_t raw_size = c.itf8();
-    if (!c.ok || comp_size < 0 || c.p + comp_size > c.end) return false;
+    if (!c.ok || comp_size < 0 || raw_size < 0 || raw_size > MAX_BLOCK_RAW ||
+        c.p + comp_size > c.end)
+        return false;
     const uint8_t* payload = c.p;
     c.skip(comp_size);
     c.skip(4);  // CRC32 (v3)
@@ -250,17 +254,44 @@ static bool read_block(Cursor& c, Block& b) {
 struct Encoding {
     int codec = 0;  // 0 null, 1 external, 3 huffman, 4 b.a.len, 5 b.a.stop, 6 beta, 9 gamma
     int content_id = -1;
-    // huffman
+    // huffman: canonical table precomputed once at parse time (decode runs
+    // per record x per feature — rebuilding it per symbol would dominate)
     std::vector<int32_t> symbols;
     std::vector<int32_t> lengths;
+    std::vector<int32_t> canon_sym;   // sorted (len, sym) order
+    std::vector<int32_t> canon_len;
+    std::vector<int64_t> canon_code;
     // beta
     int32_t offset = 0;
     int32_t nbits = 0;
     // byte_array_stop
     uint8_t stop = 0;
-    // byte_array_len nested
-    std::vector<uint8_t> sub_params;  // raw params of (len enc, val enc)
+    // byte_array_len nested (parsed once: [0]=lengths encoding, [1]=values)
+    std::vector<Encoding> children;
 };
+
+static void build_canonical(Encoding& e) {
+    size_t n = e.symbols.size();
+    std::vector<size_t> order(n);
+    for (size_t i = 0; i < n; i++) order[i] = i;
+    // canonical order: ascending code length, ties by symbol value (spec §3.4)
+    std::stable_sort(order.begin(), order.end(), [&](size_t a, size_t b) {
+        return e.lengths[a] != e.lengths[b] ? e.lengths[a] < e.lengths[b]
+                                            : e.symbols[a] < e.symbols[b];
+    });
+    e.canon_sym.resize(n);
+    e.canon_len.resize(n);
+    e.canon_code.resize(n);
+    int64_t next_code = 0;
+    int prev_len = n ? e.lengths[order[0]] : 0;
+    for (size_t i = 0; i < n; i++) {
+        e.canon_sym[i] = e.symbols[order[i]];
+        e.canon_len[i] = e.lengths[order[i]];
+        next_code <<= (e.canon_len[i] - prev_len);
+        prev_len = e.canon_len[i];
+        e.canon_code[i] = next_code++;
+    }
+}
 
 struct BitReader {
     const uint8_t* p = nullptr;
@@ -302,14 +333,19 @@ static bool parse_encoding(Cursor& c, Encoding& e) {
             return pc.ok;
         case 3: {  // HUFFMAN
             int32_t n = pc.itf8();
+            if (n < 0 || n > (1 << 20)) return false;
             for (int i = 0; i < n && pc.ok; i++) e.symbols.push_back(pc.itf8());
             int32_t m = pc.itf8();
+            if (m != n) return false;
             for (int i = 0; i < m && pc.ok; i++) e.lengths.push_back(pc.itf8());
-            return pc.ok && e.symbols.size() == e.lengths.size();
-        }
-        case 4:  // BYTE_ARRAY_LEN: nested (lengths encoding, values encoding)
-            e.sub_params.assign(pc.p, pc.end);
+            if (!pc.ok || e.symbols.size() != e.lengths.size()) return false;
+            build_canonical(e);
             return true;
+        }
+        case 4: {  // BYTE_ARRAY_LEN: nested (lengths encoding, values encoding)
+            e.children.resize(2);
+            return parse_encoding(pc, e.children[0]) && parse_encoding(pc, e.children[1]);
+        }
         case 5:  // BYTE_ARRAY_STOP
             e.stop = pc.u8();
             e.content_id = pc.itf8();
@@ -326,43 +362,26 @@ static bool parse_encoding(Cursor& c, Encoding& e) {
     }
 }
 
-// canonical huffman decode (bit-by-bit, fine for the short codes CRAM uses)
+// canonical huffman decode over the precomputed table (build_canonical)
 static bool huffman_decode(const Encoding& e, BitReader& br, int32_t& out) {
-    size_t n = e.symbols.size();
-    if (n == 1 || (n > 0 && e.lengths[0] == 0)) {  // constant
-        out = e.symbols[0];
+    size_t n = e.canon_sym.size();
+    if (n == 1 || (n > 0 && e.canon_len[0] == 0)) {  // constant
+        out = e.canon_sym[0];
         return true;
     }
-    // build canonical codes sorted by (len, symbol order as given)
-    struct Entry { int32_t sym; int32_t len; };
-    std::vector<Entry> entries(n);
-    for (size_t i = 0; i < n; i++) entries[i] = {e.symbols[i], e.lengths[i]};
-    // canonical order: ascending code length, ties by symbol value (spec §3.4)
-    std::stable_sort(entries.begin(), entries.end(), [](const Entry& a, const Entry& b) {
-        return a.len != b.len ? a.len < b.len : a.sym < b.sym;
-    });
     int64_t code = 0;
     int len = 0;
-    size_t idx = 0;
-    int64_t next_code = 0;
-    int prev_len = entries.empty() ? 0 : entries[0].len;
-    // assign canonical codes
-    std::vector<int64_t> codes(n);
-    for (size_t i = 0; i < n; i++) {
-        next_code <<= (entries[i].len - prev_len);
-        prev_len = entries[i].len;
-        codes[i] = next_code++;
-    }
-    while (idx < n && br.ok) {
+    size_t i = 0;  // table is length-sorted: scan forward as bits accrue
+    while (br.ok && len <= 31) {
         code = (code << 1) | br.read_bit();
         len++;
-        for (size_t i = 0; i < n; i++) {
-            if (entries[i].len == len && codes[i] == code) {
-                out = entries[i].sym;
+        while (i < n && e.canon_len[i] < len) i++;
+        for (size_t j = i; j < n && e.canon_len[j] == len; j++) {
+            if (e.canon_code[j] == code) {
+                out = e.canon_sym[j];
                 return true;
             }
         }
-        if (len > 31) return false;
     }
     return false;
 }
@@ -432,10 +451,10 @@ static bool decode_byte_array(const Encoding& e, Streams& s, std::vector<uint8_t
             c.skip(known_len);
             return true;
         }
-        case 4: {  // BYTE_ARRAY_LEN
-            Cursor pc{e.sub_params.data(), e.sub_params.data() + e.sub_params.size()};
-            Encoding len_enc, val_enc;
-            if (!parse_encoding(pc, len_enc) || !parse_encoding(pc, val_enc)) return false;
+        case 4: {  // BYTE_ARRAY_LEN (children parsed once at header time)
+            if (e.children.size() != 2) return false;
+            const Encoding& len_enc = e.children[0];
+            const Encoding& val_enc = e.children[1];
             int32_t n;
             if (!decode_int(len_enc, s, n) || n < 0 || n > (1 << 28)) return false;
             if (val_enc.codec == 1) return decode_byte_array(val_enc, s, out, n);
@@ -732,7 +751,7 @@ extern "C" {
 
 // SAM header text of a CRAM file -> out buffer; returns text length or
 // negative (-1 malformed, -2 unsupported compression, -3 buffer too small).
-int64_t vctpu_cram_header(const uint8_t* buf, int64_t len, uint8_t* out, int64_t out_cap) {
+static int64_t cram_header_impl(const uint8_t* buf, int64_t len, uint8_t* out, int64_t out_cap) {
     using namespace cram;
     if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
     if (buf[4] != 3) return -2;  // major version
@@ -758,10 +777,48 @@ int64_t vctpu_cram_header(const uint8_t* buf, int64_t len, uint8_t* out, int64_t
     return text_len;
 }
 
-// Decode all alignment records. Returns record count, or negative on error.
-int64_t vctpu_cram_scan(const uint8_t* buf, int64_t len, int64_t max_records,
-                        int32_t* ref_id, int64_t* pos, int32_t* span, int32_t* mapq,
-                        int32_t* flags, int32_t* read_len) {
+int64_t vctpu_cram_header(const uint8_t* buf, int64_t len, uint8_t* out, int64_t out_cap) {
+    try {
+        return cram_header_impl(buf, len, out, out_cap);
+    } catch (...) {
+        return -1;
+    }
+}
+
+// Total record count across containers (header-only walk, no block decode).
+// Lets callers allocate exact output buffers for scan. Negative on error.
+int64_t vctpu_cram_count(const uint8_t* buf, int64_t len) {
+    using namespace cram;
+    if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
+    if (buf[4] != 3) return -2;
+    Cursor c{buf + 26, buf + len};
+    int64_t total = 0;
+    bool first = true;
+    while (c.ok && c.p < c.end) {
+        int32_t cont_len = (int32_t)c.u32le();
+        int32_t ref = c.itf8();
+        c.itf8();
+        c.itf8();
+        int32_t n_rec = c.itf8();
+        c.ltf8();
+        c.ltf8();
+        int32_t n_blocks = c.itf8();
+        int32_t n_landmarks = c.itf8();
+        for (int i = 0; i < n_landmarks; i++) c.itf8();
+        c.skip(4);
+        if (!c.ok || cont_len < 0) break;
+        const uint8_t* body = c.p;
+        if (ref == -1 && n_rec == 0 && n_blocks <= 1 && c.p + cont_len >= c.end) break;
+        if (!first) total += n_rec;
+        first = false;
+        c = Cursor{body + cont_len, buf + len};
+    }
+    return total;
+}
+
+static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_records,
+                              int32_t* ref_id, int64_t* pos, int32_t* span, int32_t* mapq,
+                              int32_t* flags, int32_t* read_len) {
     using namespace cram;
     if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
     if (buf[4] != 3) return -2;
@@ -827,6 +884,19 @@ int64_t vctpu_cram_scan(const uint8_t* buf, int64_t len, int64_t max_records,
         (void)cont_start;
     }
     return total;
+}
+
+// Decode all alignment records. Returns record count, or negative on error.
+// Exception barrier: corrupt inputs must produce error codes at the ctypes
+// boundary, never C++ exceptions (which would abort the Python process).
+int64_t vctpu_cram_scan(const uint8_t* buf, int64_t len, int64_t max_records,
+                        int32_t* ref_id, int64_t* pos, int32_t* span, int32_t* mapq,
+                        int32_t* flags, int32_t* read_len) {
+    try {
+        return cram_scan_impl(buf, len, max_records, ref_id, pos, span, mapq, flags, read_len);
+    } catch (...) {
+        return -1;
+    }
 }
 
 }  // extern "C"

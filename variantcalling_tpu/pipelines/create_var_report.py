@@ -3,10 +3,22 @@
 The reference renders ugvc/reports/createVarReport.ipynb through papermill
 + nbconvert (test_vc_report.py:15-26), parameterized by a VarReport INI
 config (report_utils.parse_config). This framework generates the same
-artifact set directly — no notebook runtime: per-category accuracy tables
-(+SEC re-filtered variants), error-type decomposition, PR-curve PNGs, and
-a self-contained HTML summary, all derived from one loaded concordance
-frame.
+artifact set directly — no notebook runtime — with the notebook's full
+section inventory (cells 4-20):
+
+1. parameters (+ mean_var_depth when well_mapped_coverage exists)
+2. all data: fine-grained category accuracy (+SEC refilter), base
+   stratification (A,T)+(G,C) -> ``all_data_per_base``, homozygous
+   genotyping -> ``all_data_homozygous``
+3. UG high-confidence regions (``ug_hcr`` column) + homozygous
+4. exome (+ indel/SNP error example tables -> ``exome_*_errors``)
+5. well-covered well-mapped regions (coverage>=20 & mappability.0)
+6. callable regions
+7. indel analysis histograms (wg / ug-hcr / exome) — per-factor
+   fp/tp/fn + per-bin precision/recall, ins/del and hmer/non-hmer split
+
+Every section lands in the output h5 under the notebook's key names, and
+optionally in a self-contained HTML summary + PNGs.
 """
 
 from __future__ import annotations
@@ -15,11 +27,39 @@ import argparse
 import os
 import sys
 
+import numpy as np
 import pandas as pd
 
 from variantcalling_tpu import logger
 from variantcalling_tpu.reports.report_data_loader import ReportDataLoader
-from variantcalling_tpu.reports.report_utils import DEFAULT_CATEGORIES, ReportUtils, parse_config
+from variantcalling_tpu.reports.report_utils import ReportUtils, parse_config
+
+# notebook cell 8 (verbosity > 1) — the full stratification list
+FINE_CATEGORIES = [
+    "SNP", "Indel", "non-hmer Indel", "non-hmer Indel w/o LCR",
+    "hmer Indel <=4", "hmer Indel >4,<=8",
+    "hmer Indel 4", "hmer Indel 5", "hmer Indel 6", "hmer Indel 7", "hmer Indel 8",
+    "hmer Indel >8,<=10", "hmer Indel >10,<=12", "hmer Indel >12,<=14",
+    "hmer Indel >15,<=19", "hmer Indel >=20",
+]
+BASE_STRAT_CATEGORIES = [
+    "SNP", "Indel", "hmer Indel <=4", "hmer Indel >4,<=8", "hmer Indel >8,<=10",
+    "hmer Indel >10,<=12", "hmer Indel >12,<=14", "hmer Indel >15,<=19", "hmer Indel >=20",
+]
+HOM_CATEGORIES = [
+    "SNP", "Indel", "non-hmer Indel", "hmer Indel <=4", "hmer Indel >4,<=8",
+    "hmer Indel >8,<=10", "hmer Indel >10,<=12", "hmer Indel >12,<=14",
+    "hmer Indel >15,<=19", "hmer Indel >=20",
+]
+REGION_CATEGORIES = [
+    "SNP", "Indel", "non-hmer Indel", "non-hmer Indel w/o LCR", "hmer Indel <=4",
+    "hmer Indel >4,<=8", "hmer Indel 4", "hmer Indel 5", "hmer Indel 6",
+    "hmer Indel 7", "hmer Indel 8", "hmer Indel >8,<=10",
+]
+EXOME_CATEGORIES = ["SNP", "Indel", "non-hmer Indel", "hmer Indel <=4",
+                    "hmer Indel >4,<=8", "hmer Indel >8,<=10"]
+ERROR_EXAMPLE_COLUMNS = ["alleles", "call", "base", "gt_ultima", "gt_ground_truth", "ad",
+                         "max_vaf", "ug_hcr", "mappability.0", "hmer_length"]
 
 
 def parse_args(argv: list[str]):
@@ -30,9 +70,17 @@ def parse_args(argv: list[str]):
     ap.add_argument("--html_output", default=None, help="optional HTML summary path")
     ap.add_argument("--reference_version", default="hg38")
     ap.add_argument("--exome_column_name", default="exome.twist")
+    ap.add_argument("--run_id", default="NA")
+    ap.add_argument("--pipeline_version", default="NA")
+    ap.add_argument("--truth_sample_name", default="NA")
     ap.add_argument("--verbosity", type=int, default=5)
-    ap.add_argument("--plot_dir", default=None, help="directory for PR-curve PNGs")
+    ap.add_argument("--plot_dir", default=None, help="directory for PR-curve / indel PNGs")
     return ap.parse_args(argv)
+
+
+def _section(sections, title, tab):
+    if tab is not None and len(tab):
+        sections[title] = tab
 
 
 def run(argv: list[str]) -> int:
@@ -48,48 +96,131 @@ def run(argv: list[str]) -> int:
         verbosity = int(params.get("verbosity", verbosity))
         ref_version = params.get("reference_version", ref_version)
     h5_out = h5_out or "var_report.h5"
+    if os.path.exists(h5_out):
+        if h5_in and os.path.exists(h5_in) and os.path.samefile(h5_out, h5_in):
+            raise SystemExit("--h5_output must differ from --h5_concordance_file "
+                             f"(both point at {h5_out})")
+        os.remove(h5_out)
 
     loader = ReportDataLoader(h5_in, ref_version, args.exome_column_name)
-    df = loader.load_concordance_df()
-    logger.info("loaded %d records from %s", len(df), h5_in)
+    data = loader.load_concordance_df()
+    logger.info("loaded %d records from %s", len(data), h5_in)
 
     ru = ReportUtils(verbosity, h5_out, plot_dir=args.plot_dir)
     sections: dict[str, pd.DataFrame] = {}
 
-    opt_tab, err_tab = ru.basic_analysis(df, list(DEFAULT_CATEGORIES), "all_data", out_key_sec="all_data_sec")
-    sections["General accuracy (all data)"] = opt_tab
-    if len(err_tab):
-        sections["Error types (all data)"] = err_tab
+    # --- 1. parameters (notebook cells 2, 5) ------------------------------
+    parameters = {
+        "h5_concordance_file": str(h5_in),
+        "run_id": args.run_id,
+        "pipeline_version": str(args.pipeline_version),
+        "verbosity": str(verbosity),
+        "reference_version": ref_version,
+        "truth_sample_name": args.truth_sample_name,
+        "h5outfile": h5_out,
+    }
+    if "well_mapped_coverage" in data.columns:
+        parameters["mean_var_depth"] = f"{data['well_mapped_coverage'].mean():.2f}"
+    params_df = pd.DataFrame.from_dict(parameters, orient="index", columns=["value"])
+    ru._to_hdf(params_df, "parameters")
+    _section(sections, "Input parameters", params_df)
 
-    # PASS-only view (reference notebook's filtered section)
-    df_pass = df[df["filter"] == "PASS"]
-    if len(df_pass):
-        opt_pass, _ = ru.basic_analysis(df_pass, list(DEFAULT_CATEGORIES), "pass_data")
-        sections["General accuracy (PASS only)"] = opt_pass
+    cats = FINE_CATEGORIES if verbosity > 1 else ["SNP", "Indel"]
 
-    # homozygous genotyping + base stratification (reference :108-126)
-    try:
-        sections["Homozygous accuracy"] = ru.homozygous_genotyping_analysis(df, ["SNP", "Indel"], "homozygous")
-    except Exception as e:  # noqa: BLE001 — section optional when columns absent
-        logger.warning("homozygous section skipped: %s", e)
-    for bases in (("A", "T"), ("G", "C")):
-        try:
-            sections[f"Base stratification {bases}"] = ru.base_stratification_analysis(
-                df, ["SNP", "hmer Indel <=4"], bases
-            )
-        except Exception as e:  # noqa: BLE001
-            logger.warning("base stratification %s skipped: %s", bases, e)
+    # --- 2. all data ------------------------------------------------------
+    opt, err = ru.basic_analysis(data, cats, "all_data", "sec_data")
+    _section(sections, "2. All data — General accuracy", opt)
+    _section(sections, "2. All data — error types", err)
+    if verbosity > 1:
+        at_df = ru.base_stratification_analysis(data, BASE_STRAT_CATEGORIES, ("A", "T"))
+        gc_df = ru.base_stratification_analysis(
+            data, ["SNP", "Indel", "hmer Indel <=4", "hmer Indel >4,<=8", "hmer Indel >8,<=10"],
+            ("G", "C"))
+        base_strat = pd.concat([at_df, gc_df])
+        out = base_strat.copy()
+        ru.make_multi_index(out)
+        ru._to_hdf(out, "all_data_per_base")
+        _section(sections, "2.1 Stratified by base", base_strat)
+        hom = ru.homozygous_genotyping_analysis(data, HOM_CATEGORIES, "all_data_homozygous")
+        _section(sections, "2.2 Homozygous genotyping accuracy", hom)
+
+    # --- 3. UG high confidence regions ------------------------------------
+    ug_hcr_data = pd.DataFrame()
+    if "ug_hcr" in data.columns:
+        ug_hcr_data = data[data["ug_hcr"].astype(bool)].copy()
+    if len(ug_hcr_data):
+        rcats = REGION_CATEGORIES if verbosity > 1 else ["SNP", "Indel"]
+        opt, err = ru.basic_analysis(ug_hcr_data, rcats, "ug_hcr", "ug_hcr_sec_data")
+        _section(sections, "3. UG-HCR — General accuracy", opt)
+        _section(sections, "3. UG-HCR — error types", err)
+        if verbosity > 1:
+            hom = ru.homozygous_genotyping_analysis(ug_hcr_data, EXOME_CATEGORIES,
+                                                    "ug_hcr_homozygous")
+            _section(sections, "3.1 UG-HCR homozygous accuracy", hom)
+
+    # --- 4. exome ---------------------------------------------------------
+    exome_data = pd.DataFrame()
+    if args.exome_column_name in data.columns:
+        exome_data = data[data[args.exome_column_name].astype(bool)].copy()
+    if len(exome_data):
+        ecats = EXOME_CATEGORIES if verbosity > 1 else ["SNP", "Indel"]
+        opt, err = ru.basic_analysis(exome_data, ecats, "exome", "exome_sec_data")
+        _section(sections, "4. Exome — General accuracy", opt)
+        _section(sections, "4. Exome — error types", err)
+        if verbosity > 1:
+            present = [c for c in ERROR_EXAMPLE_COLUMNS if c in exome_data.columns]
+            indel_errors = exome_data["indel"].astype(bool) & (
+                (exome_data["fp"] & (exome_data["filter"] == "PASS")) | exome_data["fn"])
+            hmer_len = np.nan_to_num(np.asarray(exome_data.get("hmer_length", 0), dtype=float))
+            hmer_err = exome_data[indel_errors & (hmer_len > 0)][present]
+            non_hmer_err = exome_data[indel_errors & (hmer_len == 0)][present]
+            snp_err = exome_data[~exome_data["tp"] & ~exome_data["indel"].astype(bool)
+                                 & (exome_data["filter"] == "PASS")][present].head(20)
+            for key, tab in (("exome_hmer_indel_errors", hmer_err),
+                             ("exome_non_hmer_indel_errors", non_hmer_err),
+                             ("exome_snp_errors", snp_err)):
+                if len(tab):
+                    ru._to_hdf(tab.reset_index(drop=True).astype(str), key)
+            _section(sections, "4.1 Exome hmer-indel error examples", hmer_err)
+            _section(sections, "4.2 Exome non-hmer-indel error examples", non_hmer_err)
+            _section(sections, "4.3 Exome SNP error examples", snp_err)
+
+    # --- 5. well-covered, well-mapped regions (notebook cell 18) ----------
+    if verbosity > 1 and "well_mapped_coverage" in data.columns and "mappability.0" in data.columns:
+        good = data[(data["well_mapped_coverage"] >= 20) & data["mappability.0"].astype(bool)].copy()
+        if len(good):
+            opt, _ = ru.basic_analysis(good, REGION_CATEGORIES, "good_cvg_data")
+            _section(sections, "5. Coverage>=20 w/ mappability — accuracy", opt)
+            hom = ru.homozygous_genotyping_analysis(
+                good, ["SNP", "Indel", "non-hmer Indel", "non-hmer Indel w/o LCR",
+                       "hmer Indel <=4", "hmer Indel >4,<=8"], "good_cvg_data_homozygous")
+            _section(sections, "5.1 Homozygous accuracy", hom)
+
+    # --- 6. callable regions (notebook cell 19) ---------------------------
+    if verbosity > 1 and "callable" in data.columns:
+        callable_data = data[data["callable"].astype(bool)].copy()
+        if len(callable_data):
+            opt, _ = ru.basic_analysis(callable_data, FINE_CATEGORIES, "callable_data")
+            _section(sections, "6. Callable regions — accuracy", opt)
+
+    # --- 7. indel analysis (notebook cell 20) -----------------------------
+    if verbosity > 2:
+        ru.indel_analysis(data, "wg")
+        if len(ug_hcr_data):
+            ru.indel_analysis(ug_hcr_data, "ug-hcr")
+        if len(exome_data):
+            ru.indel_analysis(exome_data, "exome")
 
     if args.html_output:
         with open(args.html_output, "w", encoding="utf-8") as fh:
             fh.write("<html><head><title>Variant Report</title></head><body>\n")
-            fh.write("<h1>Variant calling accuracy report</h1>\n")
+            fh.write(f"<h1>Variant calling accuracy report {args.pipeline_version}</h1>\n")
             for title, tab in sections.items():
                 fh.write(f"<h2>{title}</h2>\n")
                 fh.write(tab.to_html(float_format=lambda x: f"{x:.4f}"))
             fh.write("</body></html>\n")
         logger.info("wrote %s", args.html_output)
-    logger.info("wrote %s", h5_out)
+    logger.info("wrote %s (%d sections)", h5_out, len(sections))
     return 0
 
 

@@ -78,7 +78,12 @@ def filter_by_category(data: pd.DataFrame, cat: str) -> pd.DataFrame:
     if cat == "non-hmer Indel":
         return data[indel & (hmer == 0) & (data["indel_length"] > 0)]
     if cat == "non-hmer Indel w/o LCR":
-        return data[indel & (hmer == 0) & (data["indel_length"] > 0) & (~data["LCR"].astype(bool))]
+        # the LCR annotation column name varies by reference build
+        # (LCR-hs38 / LCR-hg19_tab_no_chr, report_data_loader.py:94-103);
+        # without one the category degrades to plain non-hmer Indel
+        lcr_cols = [c for c in data.columns if str(c).startswith("LCR")]
+        lcr = data[lcr_cols[0]].astype(bool) if lcr_cols else pd.Series(False, index=data.index)
+        return data[indel & (hmer == 0) & (data["indel_length"] > 0) & ~lcr]
     if cat == "hmer Indel <=4":
         return data[indel & (hmer > 0) & (hmer <= 4)]
     if cat == "hmer Indel >4,<=8":
@@ -306,6 +311,93 @@ class ReportUtils:
             },
             index=[cat],
         )
+
+    # reference indel_analysis factor grid (report_utils.py:225-232)
+    INDEL_VARIABLES = ("indel_length", "hmer_length", "max_vaf", "qual", "gq", "dp")
+    INDEL_MINS = (1, 0, 0, 0, 0, 0)
+    INDEL_MAXS = (15, 20, 1, 80, 80, 80)
+    INDEL_BINS = (1, 1, 0.05, 3, 3, 3)
+
+    def indel_analysis(self, data: pd.DataFrame, data_name: str) -> pd.DataFrame:
+        """Per-factor indel error histograms + per-bin precision/recall.
+
+        Reference report_utils.py:225-305 renders 5-panel matplotlib grids
+        per (factor × hmer/non-hmer) inline; here the same numbers land in
+        one long-format frame (h5 key ``{name}_indel_analysis``) with
+        columns [group, variable, bin_left, ins_fp/tp/fn, del_fp/tp/fn,
+        precision, recall] plus optional PNG grids under ``plot_dir``.
+        Insertions/deletions are split per bin; hmer and non-hmer indels
+        are separate groups, as in the reference plots.
+        """
+        indels = data[data["indel"].astype(bool)]
+        hmer_len = np.nan_to_num(np.asarray(indels.get("hmer_length", 0), dtype=float))
+        groups = (("hmer_indels", hmer_len > 0), ("non_hmer_indels", hmer_len == 0))
+        rows = []
+        for k, variable in enumerate(self.INDEL_VARIABLES):
+            if variable not in indels.columns:
+                continue
+            lo, hi, width = self.INDEL_MINS[k], self.INDEL_MAXS[k], self.INDEL_BINS[k]
+            if hi > 1:
+                hi += 1
+            bins = np.arange(lo, hi + width / 2, width)
+            vals = np.asarray(indels[variable], dtype=float)
+            is_ins = np.asarray(indels["indel_classify"] == "ins")
+            for gname, gmask in groups:
+                counts = {}
+                for cls in ("fp", "tp", "fn"):
+                    cmask = np.asarray(indels[cls], dtype=bool) & gmask
+                    for side, smask in (("ins", is_ins), ("del", ~is_ins)):
+                        v = vals[cmask & smask]
+                        counts[f"{side}_{cls}"], _ = np.histogram(v[~np.isnan(v)], bins=bins)
+                tp = counts["ins_tp"] + counts["del_tp"]
+                fp = counts["ins_fp"] + counts["del_fp"]
+                fn = counts["ins_fn"] + counts["del_fn"]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    precision = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1), np.nan)
+                    recall = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1), np.nan)
+                for b in range(len(bins) - 1):
+                    rows.append({
+                        "group": gname, "variable": variable, "bin_left": bins[b],
+                        **{key: int(cnt[b]) for key, cnt in counts.items()},
+                        "precision": precision[b], "recall": recall[b],
+                    })
+                if self.plot_dir and self.verbosity > 2:
+                    self._plot_indel_panel(data_name, gname, variable, bins, counts,
+                                           precision, recall)
+        out = pd.DataFrame(rows)
+        safe = data_name.replace("-", "_").replace(" ", "_")
+        if len(out):
+            self._to_hdf(out, f"{safe}_indel_analysis")
+        return out
+
+    def _plot_indel_panel(self, data_name, gname, variable, bins, counts, precision, recall):
+        import os
+
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(1, 5, figsize=(15, 3))
+        centers = bins[:-1]
+        for i, cls in enumerate(("fp", "tp", "fn")):
+            ax[i].bar(centers, counts[f"ins_{cls}"], width=np.diff(bins), alpha=0.5, label="ins",
+                      align="edge")
+            ax[i].bar(centers, counts[f"del_{cls}"], width=np.diff(bins), alpha=0.5, label="del",
+                      color="g", align="edge")
+            ax[i].set_title(cls)
+            ax[i].set_xlabel(variable)
+            ax[i].legend()
+        ax[3].plot(centers, precision, "-o", markersize=3)
+        ax[3].set_title("precision")
+        ax[4].plot(centers, recall, "-o", markersize=3)
+        ax[4].set_title("recall")
+        fig.suptitle(f"{data_name} {gname} — {variable}")
+        fig.tight_layout()
+        os.makedirs(self.plot_dir, exist_ok=True)
+        safe = f"{data_name}_{gname}_{variable}".replace("/", "_").replace(" ", "_")
+        fig.savefig(os.path.join(self.plot_dir, f"indel_{safe}.png"))
+        plt.close(fig)
 
     @staticmethod
     def make_multi_index(df: pd.DataFrame) -> None:
