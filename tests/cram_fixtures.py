@@ -185,7 +185,8 @@ def comp_header_block() -> bytes:
     for key, val in (("RN", b"\x01"), ("AP", b"\x01"), ("RR", b"\x00")):
         pm += key.encode() + val
         entries += 1
-    pm += b"SM" + bytes(5)
+    # SM: 2-bit code of alt j (ACGTN order minus ref) = j -> 0b00011011
+    pm += b"SM" + bytes([0x1B] * 5)
     entries += 1
     td = b"\x00"
     pm += b"TD" + itf8(len(td)) + td

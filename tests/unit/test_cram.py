@@ -135,3 +135,70 @@ def test_corrupt_cram_is_error_not_crash(tmp_path):
     for name in ("trunc.cram", "flip.cram"):
         with pytest.raises(ValueError):
             cram_records(str(tmp_path / name))
+
+
+def test_cram_pileup_reconstruction(tmp_path):
+    """Base reconstruction: matches come from the reference, X through the
+    SM substitution matrix; insertions/soft-clips don't hit the pileup."""
+    from tests.fixtures import write_fasta
+
+    from variantcalling_tpu.comparison.pileup_caller import pileup_counts
+
+    ref = "ACGT" * 300  # chr1, 1200bp
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": ref})
+    hdr = "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1200\n"
+    recs = [
+        # 3 plain reads covering 101..150 (bases == reference)
+        {"flag": 0, "pos": 101, "read_len": 50, "mapq": 60},
+        {"flag": 0, "pos": 101, "read_len": 50, "mapq": 60},
+        {"flag": 0, "pos": 101, "read_len": 50, "mapq": 60},
+        # substitution at read pos 10 (ref pos 110): ref base ref[109],
+        # BS code 1 -> second alternative in ACGTN-minus-ref order
+        {"flag": 0, "pos": 101, "read_len": 50, "mapq": 60,
+         "features": [("X", 10, 1)]},
+        # insertion + soft clip: aligned span shifts, inserted bases not counted
+        {"flag": 0, "pos": 201, "read_len": 30, "mapq": 60,
+         "features": [("S", 1, b"AAAAA"), ("I", 20, b"GG")]},
+        # duplicate excluded from pileup
+        {"flag": 0x400, "pos": 101, "read_len": 50, "mapq": 60},
+    ]
+    p = str(tmp_path / "p.cram")
+    write_cram(p, hdr, recs, method=GZIP)
+    counts = pileup_counts(p, "chr1", 0, 1200, ref_path=str(tmp_path / "ref.fa"))
+
+    code = {"A": 0, "C": 1, "G": 2, "T": 3}
+    # ref-matching depth at pos 105 (0-based 104): 4 reads (dup excluded)
+    assert counts[104, code[ref[104]]] == 4 and counts[104].sum() == 4
+    # substitution site 0-based 109: 3 ref bases + 1 substituted
+    ref_b = ref[109]
+    alts = [b for b in "ACGTN" if b != ref_b]
+    expected_alt = alts[1]  # BS code 1 with the identity SM matrix
+    assert counts[109, code[ref_b]] == 3
+    assert counts[109, code[expected_alt]] == 1
+    # soft-clipped read: S consumes 5 read bases, I consumes 2: aligned ref
+    # span is 30-5-2=23 from pos 201 -> covered 0-based 200..222
+    assert counts[200].sum() == 1 and counts[222].sum() == 1 and counts[223].sum() == 0
+    # aligned bases equal reference there
+    assert counts[200, code[ref[200]]] == 1
+
+
+def test_cram_fingerprint_call_variants(tmp_path):
+    """VariantHitFractionCaller.call_variants end-to-end on CRAM input."""
+    from tests.fixtures import write_fasta
+
+    from variantcalling_tpu.comparison.pileup_caller import VariantHitFractionCaller
+
+    ref = "ACGT" * 300
+    write_fasta(str(tmp_path / "ref.fa"), {"chr1": ref})
+    hdr = "@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1200\n"
+    # every read carries the same substitution at ref pos 110 -> a call
+    recs = [{"flag": 0, "pos": 101, "read_len": 50, "mapq": 60,
+             "features": [("X", 10, 1)]} for _ in range(10)]
+    p = str(tmp_path / "f.cram")
+    write_cram(p, hdr, recs, method=GZIP)
+    vc = VariantHitFractionCaller(str(tmp_path / "ref.fa"), str(tmp_path), 0.03, "chr1")
+    called = vc.call_variants(p, "chr1", 0, 1200, 0.3)
+    ref_b = ref[109]
+    alts = [b for b in "ACGTN" if b != ref_b]
+    assert (("chr1", 110, ref_b, alts[1])) in called
+    assert len(called) == 1

@@ -21,19 +21,43 @@ _BASES = "ACGT"
 MAX_DEPTH = 500  # matches bcftools mpileup -d 500
 
 
-def pileup_counts(bam_path: str, chrom: str, start: int, end: int) -> np.ndarray:
+def _cram_pileup_counts(cram_path: str, chrom: str, start: int, end: int,
+                        ref_path: str | None) -> np.ndarray:
+    """CRAM pileup via the native decoder's base reconstruction."""
+    from variantcalling_tpu import native
+    from variantcalling_tpu.io.cram import header_from_buffer
+    from variantcalling_tpu.io.fasta import FastaReader
+
+    if ref_path is None:
+        raise ValueError("CRAM pileup needs the reference FASTA (ref_path)")
+    with open(cram_path, "rb") as fh:
+        buf = fh.read()
+    header = header_from_buffer(buf, cram_path)
+    if chrom not in header.references:
+        return np.zeros((end - start, 4), dtype=np.int32)
+    tid = header.references.index(chrom)
+    with FastaReader(ref_path) as fa:
+        ref_seq = fa.fetch(chrom, 0, fa.get_reference_length(chrom))
+    counts = native.cram_pileup(buf, tid, start, end, ref_seq)
+    if counts is None:
+        raise ValueError(
+            f"cannot pile up CRAM {cram_path}: unsupported codec or malformed "
+            "stream (supported: CRAM 3.0, raw/gzip/rANS-4x8)"
+        )
+    np.minimum(counts, MAX_DEPTH, out=counts)  # same -d cap as the BAM path
+    return counts
+
+
+def pileup_counts(bam_path: str, chrom: str, start: int, end: int,
+                  ref_path: str | None = None) -> np.ndarray:
     """(L, 4) int32 base counts over [start, end) of ``chrom`` (0-based).
 
     Skips unmapped/secondary/qcfail/dup reads (mpileup defaults) and
     indels (``--skip-indels``); depth capped at MAX_DEPTH per locus.
+    CRAM inputs reconstruct bases natively and need ``ref_path``.
     """
     if str(bam_path).endswith(".cram"):
-        raise ValueError(
-            "pileup from CRAM needs base reconstruction (reference + substitution "
-            "matrix), which the native CRAM decoder does not implement yet — "
-            "convert to BAM for fingerprinting, or use BAM inputs (depth-only "
-            "CRAM paths are supported, io/cram.py)"
-        )
+        return _cram_pileup_counts(bam_path, chrom, start, end, ref_path)
     length = end - start
     counts = np.zeros((length, 4), dtype=np.int32)
     with BamReader(bam_path, decode_seq=True) as reader:
@@ -102,7 +126,7 @@ class VariantHitFractionCaller:
         """Called SNVs as {(chrom, pos_1based, ref_base, major_alt)}."""
         from variantcalling_tpu.io.fasta import FastaReader
 
-        counts = pileup_counts(bam, chrom, start, end)
+        counts = pileup_counts(bam, chrom, start, end, ref_path=self.ref)
         with FastaReader(self.ref) as fa:
             ref_seq = fa.fetch(chrom, start, min(end, fa.get_reference_length(chrom)))
         codes = np.full(end - start, 4, dtype=np.int8)

@@ -8,11 +8,15 @@ this module serves the same inputs through the in-process C++ decoder
 accumulation is one vectorized difference-array pass — no per-record
 Python, same downstream device reductions as the BAM path.
 
+Base-level pileup (fingerprinting) is served by the decoder's
+reconstruction path: native.cram_pileup rebuilds aligned bases from the
+reference + SM substitution matrix (comparison/pileup_caller).
+
 Limitations (explicit, raised or logged — never silent): CRAM 3.1 codecs
 and bzip2/lzma blocks are unsupported; per-base-quality depth filtering
-(-q) needs base reconstruction and is not applied to CRAM inputs; N
-(reference-skip) ops count toward the span (DNA pipelines — this
-framework's domain — do not emit N ops).
+(-q) is not applied to CRAM inputs; N (reference-skip) ops count toward
+the depth span (DNA pipelines — this framework's domain — do not emit N
+ops).
 """
 
 from __future__ import annotations

@@ -99,6 +99,10 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_cram_header.argtypes = [_u8p, _i64, _u8p, _i64]
         lib.vctpu_cram_count.restype = _i64
         lib.vctpu_cram_count.argtypes = [_u8p, _i64]
+        lib.vctpu_cram_pileup.restype = _i64
+        lib.vctpu_cram_pileup.argtypes = [
+            _u8p, _i64, ctypes.c_int32, _i64, _i64, _u8p, _i64, _i32p,
+        ]
         lib.vctpu_cram_scan.restype = _i64
         lib.vctpu_cram_scan.argtypes = [
             _u8p, _i64, _i64, _i32p, _i64p, _i32p, _i32p, _i32p, _i32p,
@@ -392,6 +396,27 @@ def cram_scan(buf, max_records: int) -> dict | None:
     if n < 0:
         return None
     return {k: v[:n] for k, v in out.items()}
+
+
+def cram_pileup(buf, target_ref: int, start0: int, end0: int, ref_seq: str) -> np.ndarray | None:
+    """(end0-start0, 4) aligned base counts over one contig window.
+
+    ``ref_seq`` is the FULL target contig sequence (bases between CRAM
+    features are reference matches; X features go through the SM matrix).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(_u8view(buf))
+    ref = np.frombuffer(ref_seq.encode("ascii", "replace"), dtype=np.uint8)
+    counts = np.zeros((max(end0 - start0, 0), 4), dtype=np.int32)
+    n = lib.vctpu_cram_pileup(
+        src.ctypes.data_as(_u8p), len(src), target_ref, start0, end0,
+        ref.ctypes.data_as(_u8p), len(ref), counts.ctypes.data_as(_i32p),
+    )
+    if n < 0:
+        return None
+    return counts
 
 
 def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:

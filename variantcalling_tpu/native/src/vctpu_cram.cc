@@ -76,6 +76,11 @@ struct Cursor {
 // rANS 4x8 (order 0 and 1) — spec section 13 / htslib rANS_static
 // ---------------------------------------------------------------------------
 
+// corrupt-size guard: real CRAM blocks are <= a few MB (htslib slices hold
+// ~10k records); 256 MB bounds pathological headers without rejecting any
+// legitimate file
+static const int64_t MAX_BLOCK_RAW = int64_t(1) << 28;
+
 static const uint32_t RANS_LOW = 1u << 23;
 
 struct RansSyms {
@@ -116,7 +121,7 @@ static bool rans_uncompress(const uint8_t* in, int64_t in_len, std::vector<uint8
     uint32_t comp_sz = c.u32le();
     uint32_t raw_sz = c.u32le();
     (void)comp_sz;
-    if (!c.ok || raw_sz > (uint32_t)(int64_t(1) << 31)) return false;
+    if (!c.ok || raw_sz > (uint32_t)MAX_BLOCK_RAW) return false;
     out.resize(raw_sz);
     if (raw_sz == 0) return true;
 
@@ -217,8 +222,6 @@ struct Block {
     int content_id = -1;
     std::vector<uint8_t> data;
 };
-
-static const int64_t MAX_BLOCK_RAW = int64_t(1) << 31;  // corrupt-size guard
 
 static bool read_block(Cursor& c, Block& b) {
     int method = c.u8();
@@ -483,10 +486,39 @@ static bool decode_byte_array(const Encoding& e, Streams& s, std::vector<uint8_t
 struct CompHeader {
     bool ap_delta = true;
     bool rn_preserved = true;
+    uint8_t sub[5][4] = {{1, 2, 3, 4}, {0, 2, 3, 4}, {0, 1, 3, 4}, {0, 1, 2, 4}, {0, 1, 2, 3}};
     std::map<uint16_t, Encoding> series;      // 2-char key -> encoding
     std::map<int32_t, Encoding> tag_enc;      // packed tag key -> encoding
     std::vector<std::vector<int32_t>> tag_lines;  // TD: tag ids per line
 };
+
+// pileup accumulation target: one contig window, (len, 4) base counts
+struct PileupCtx {
+    int32_t target_ref;
+    int64_t start0;  // 0-based inclusive
+    int64_t end0;    // 0-based exclusive
+    const uint8_t* ref_seq;  // ASCII bases of the FULL target contig
+    int64_t ref_len;
+    int32_t* counts;  // (end0-start0, 4) row-major
+};
+
+static inline int base_code(uint8_t ch) {
+    switch (ch) {
+        case 'A': case 'a': return 0;
+        case 'C': case 'c': return 1;
+        case 'G': case 'g': return 2;
+        case 'T': case 't': return 3;
+        default: return 4;
+    }
+}
+
+static inline void pileup_add(PileupCtx* pc, int64_t ref_pos1, int code) {
+    // ref_pos1 is 1-based; count aligned A/C/G/T bases inside the window
+    if (code >= 4) return;
+    int64_t off = ref_pos1 - 1 - pc->start0;
+    if (off < 0 || off >= pc->end0 - pc->start0) return;
+    pc->counts[off * 4 + code]++;
+}
 
 static uint16_t key2(const char* k) { return ((uint16_t)k[0] << 8) | (uint8_t)k[1]; }
 
@@ -501,7 +533,21 @@ static bool parse_comp_header(const Block& b, CompHeader& h) {
         if (k == key2("RN")) h.rn_preserved = c.u8() != 0;
         else if (k == key2("AP")) h.ap_delta = c.u8() != 0;
         else if (k == key2("RR")) c.u8();
-        else if (k == key2("SM")) c.skip(5);
+        else if (k == key2("SM")) {
+            // substitution matrix: one byte per ref base (ACGTN order); the
+            // byte holds 2-bit codes for the four other bases in ACGTN
+            // order; BS code k selects the alt whose assigned code == k
+            for (int ri = 0; ri < 5; ri++) {
+                uint8_t b = c.u8();
+                int j = 0;
+                for (int alt = 0; alt < 5; alt++) {
+                    if (alt == ri) continue;
+                    uint8_t code = (b >> (6 - 2 * j)) & 3;
+                    h.sub[ri][code] = (uint8_t)alt;
+                    j++;
+                }
+            }
+        }
         else if (k == key2("TD")) {
             int32_t tdlen = c.itf8();
             const uint8_t* td = c.p;
@@ -567,7 +613,7 @@ static bool get_enc(const CompHeader& h, const char* k, Encoding& e) {
 // decode all records of one slice; returns count or -1
 static int64_t decode_slice(const CompHeader& h, int container_ref,
                             const std::vector<Block>& blocks, RecOut out, int64_t out_off,
-                            int64_t max_records) {
+                            int64_t max_records, PileupCtx* pc = nullptr) {
     // slice header is blocks[0]
     Cursor sh{blocks[0].data.data(), blocks[0].data.data() + blocks[0].data.size()};
     int32_t slice_ref = sh.itf8();
@@ -659,39 +705,74 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
             int32_t fn;
             if (!decode_int(eFN, s, fn)) return -1;
             int32_t soft = 0, ins = 0, dels = 0, skips = 0, hard = 0;
+            // base reconstruction for pileup: bases between features match
+            // the reference; X applies the SM substitution matrix
+            bool do_pile = pc != nullptr && ri == pc->target_ref && (bf & 0x704) == 0;
+            int64_t fabs_pos = 0;  // absolute 1-based in-read feature position
+            int64_t rcur = 1;      // next read position to emit
+            int64_t refp = pos;    // its reference position (1-based)
+            auto ref_char = [&](int64_t p1) -> int {
+                return (p1 >= 1 && p1 <= pc->ref_len) ? base_code(pc->ref_seq[p1 - 1]) : 4;
+            };
+            auto emit_matches = [&](int64_t upto) {
+                while (rcur < upto) {
+                    pileup_add(pc, refp, ref_char(refp));
+                    rcur++;
+                    refp++;
+                }
+            };
             for (int32_t f = 0; f < fn; f++) {
                 uint8_t fc;
                 int32_t fp;
                 if (!decode_byte(eFC, s, fc)) return -1;
                 if (!decode_int(eFP, s, fp)) return -1;
+                fabs_pos += fp;
+                if (do_pile) emit_matches(fabs_pos);
                 uint8_t bb;
                 switch (fc) {
                     case 'B':
                         if (!hBA || !decode_byte(eBA, s, bb)) return -1;
+                        if (do_pile) {
+                            pileup_add(pc, refp, base_code(bb));
+                            rcur++;
+                            refp++;
+                        }
                         if (!hQS || !decode_byte(eQS, s, bb)) return -1;
                         break;
                     case 'X':
                         if (!hBS || !decode_int(eBS, s, v)) return -1;
+                        if (do_pile) {
+                            int rc = ref_char(refp);
+                            int alt = rc < 4 ? h.sub[rc][v & 3] : 4;
+                            pileup_add(pc, refp, alt);
+                            rcur++;
+                            refp++;
+                        }
                         break;
                     case 'I':
                         if (!hIN || !decode_byte_array(eIN, s, scratch)) return -1;
                         ins += (int32_t)scratch.size();
+                        if (do_pile) rcur += (int64_t)scratch.size();
                         break;
                     case 'S':
                         if (!hSC || !decode_byte_array(eSC, s, scratch)) return -1;
                         soft += (int32_t)scratch.size();
+                        if (do_pile) rcur += (int64_t)scratch.size();
                         break;
                     case 'D':
                         if (!hDL || !decode_int(eDL, s, v)) return -1;
                         dels += v;
+                        if (do_pile) refp += v;
                         break;
                     case 'i':
                         if (!hBA || !decode_byte(eBA, s, bb)) return -1;
                         ins += 1;
+                        if (do_pile) rcur += 1;
                         break;
                     case 'N':
                         if (!hRS || !decode_int(eRS, s, v)) return -1;
                         skips += v;
+                        if (do_pile) refp += v;
                         break;
                     case 'P':
                         if (!hPD || !decode_int(ePD, s, v)) return -1;
@@ -705,6 +786,13 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
                         break;
                     case 'b':
                         if (!hBB || !decode_byte_array(eBB, s, scratch)) return -1;
+                        if (do_pile) {
+                            for (uint8_t sb : scratch) {
+                                pileup_add(pc, refp, base_code(sb));
+                                rcur++;
+                                refp++;
+                            }
+                        }
                         break;
                     case 'q':
                         if (!hQQ || !decode_byte_array(eQQ, s, scratch)) return -1;
@@ -713,6 +801,7 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
                         return -1;
                 }
             }
+            if (do_pile) emit_matches((int64_t)rl + 1);
             span = rl - soft - ins + dels + skips;
             if (!hMQ || !decode_int(eMQ, s, mapq)) return -1;
             if (cf & 0x1) {  // quality scores stored as array
@@ -733,12 +822,14 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
                 }
             }
         }
-        out.ref_id[out_off + r] = ri;
-        out.pos[out_off + r] = pos;
-        out.span[out_off + r] = span;
-        out.mapq[out_off + r] = mapq;
-        out.flags[out_off + r] = bf;
-        out.read_len[out_off + r] = rl;
+        if (out.ref_id != nullptr) {
+            out.ref_id[out_off + r] = ri;
+            out.pos[out_off + r] = pos;
+            out.span[out_off + r] = span;
+            out.mapq[out_off + r] = mapq;
+            out.flags[out_off + r] = bf;
+            out.read_len[out_off + r] = rl;
+        }
     }
     return n_records;
 }
@@ -794,6 +885,7 @@ int64_t vctpu_cram_count(const uint8_t* buf, int64_t len) {
     Cursor c{buf + 26, buf + len};
     int64_t total = 0;
     bool first = true;
+    bool saw_eof = false;
     while (c.ok && c.p < c.end) {
         int32_t cont_len = (int32_t)c.u32le();
         int32_t ref = c.itf8();
@@ -806,25 +898,31 @@ int64_t vctpu_cram_count(const uint8_t* buf, int64_t len) {
         int32_t n_landmarks = c.itf8();
         for (int i = 0; i < n_landmarks; i++) c.itf8();
         c.skip(4);
-        if (!c.ok || cont_len < 0) break;
+        if (!c.ok || cont_len < 0 || c.p + cont_len > c.end) break;
         const uint8_t* body = c.p;
-        if (ref == -1 && n_rec == 0 && n_blocks <= 1 && c.p + cont_len >= c.end) break;
+        if (ref == -1 && n_rec == 0 && n_blocks <= 1 && c.p + cont_len >= c.end) {
+            saw_eof = true;
+            break;
+        }
         if (!first) total += n_rec;
         first = false;
         c = Cursor{body + cont_len, buf + len};
     }
-    return total;
+    // no EOF container => truncated/corrupt stream, not a short file
+    return saw_eof ? total : -1;
 }
 
 static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_records,
                               int32_t* ref_id, int64_t* pos, int32_t* span, int32_t* mapq,
-                              int32_t* flags, int32_t* read_len) {
+                              int32_t* flags, int32_t* read_len,
+                              cram::PileupCtx* pctx = nullptr) {
     using namespace cram;
     if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
     if (buf[4] != 3) return -2;
     Cursor c{buf + 26, buf + len};
     int64_t total = 0;
     bool first = true;
+    bool saw_eof = false;
     while (c.ok && c.p < c.end) {
         const uint8_t* cont_start = c.p;
         int32_t cont_len = (int32_t)c.u32le();
@@ -839,10 +937,15 @@ static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_recor
         int32_t n_landmarks = c.itf8();
         for (int i = 0; i < n_landmarks; i++) c.itf8();
         c.skip(4);  // CRC
-        if (!c.ok) break;
+        // corrupt container length must neither rewind the cursor (infinite
+        // loop) nor run past the buffer (OOB read)
+        if (!c.ok || cont_len < 0 || c.p + cont_len > c.end) break;
         const uint8_t* body = c.p;
         // EOF container: ref -1, no records, 38-byte standard marker
-        if (ref == -1 && n_rec == 0 && n_blocks <= 1 && c.p + cont_len >= c.end) break;
+        if (ref == -1 && n_rec == 0 && n_blocks <= 1 && c.p + cont_len >= c.end) {
+            saw_eof = true;
+            break;
+        }
         if (first) {  // file header container
             first = false;
             c = Cursor{body + cont_len, buf + len};
@@ -876,14 +979,15 @@ static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_recor
                 blocks.push_back(std::move(db));
             }
             RecOut out{ref_id, pos, span, mapq, flags, read_len};
-            int64_t n = decode_slice(h, ref, blocks, out, total, max_records);
+            int64_t n = decode_slice(h, ref, blocks, out, total, max_records, pctx);
             if (n < 0) return n == -4 ? -4 : -1;
             total += n;
         }
         c = Cursor{body + cont_len, buf + len};
         (void)cont_start;
     }
-    return total;
+    // a stream without its EOF container was truncated mid-write/transfer
+    return saw_eof ? total : -1;
 }
 
 // Decode all alignment records. Returns record count, or negative on error.
@@ -894,6 +998,22 @@ int64_t vctpu_cram_scan(const uint8_t* buf, int64_t len, int64_t max_records,
                         int32_t* flags, int32_t* read_len) {
     try {
         return cram_scan_impl(buf, len, max_records, ref_id, pos, span, mapq, flags, read_len);
+    } catch (...) {
+        return -1;
+    }
+}
+
+// Base-level pileup over [start0, end0) of one contig: records are decoded
+// (streams are sequential so every record is consumed) and aligned bases
+// reconstructed from the reference + SM substitution matrix. ``counts`` is
+// (end0-start0, 4) row-major A/C/G/T. Returns records seen, negative on error.
+int64_t vctpu_cram_pileup(const uint8_t* buf, int64_t len, int32_t target_ref,
+                          int64_t start0, int64_t end0,
+                          const uint8_t* ref_seq, int64_t ref_len, int32_t* counts) {
+    try {
+        cram::PileupCtx ctx{target_ref, start0, end0, ref_seq, ref_len, counts};
+        return cram_scan_impl(buf, len, INT64_MAX, nullptr, nullptr, nullptr, nullptr,
+                              nullptr, nullptr, &ctx);
     } catch (...) {
         return -1;
     }

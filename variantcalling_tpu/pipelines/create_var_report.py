@@ -132,17 +132,25 @@ def run(argv: list[str]) -> int:
     _section(sections, "2. All data — General accuracy", opt)
     _section(sections, "2. All data — error types", err)
     if verbosity > 1:
-        at_df = ru.base_stratification_analysis(data, BASE_STRAT_CATEGORIES, ("A", "T"))
-        gc_df = ru.base_stratification_analysis(
-            data, ["SNP", "Indel", "hmer Indel <=4", "hmer Indel >4,<=8", "hmer Indel >8,<=10"],
-            ("G", "C"))
-        base_strat = pd.concat([at_df, gc_df])
-        out = base_strat.copy()
-        ru.make_multi_index(out)
-        ru._to_hdf(out, "all_data_per_base")
-        _section(sections, "2.1 Stratified by base", base_strat)
-        hom = ru.homozygous_genotyping_analysis(data, HOM_CATEGORIES, "all_data_homozygous")
-        _section(sections, "2.2 Homozygous genotyping accuracy", hom)
+        # optional sections: a concordance frame missing their columns must
+        # not take down the whole report (loader drops absent columns)
+        try:
+            at_df = ru.base_stratification_analysis(data, BASE_STRAT_CATEGORIES, ("A", "T"))
+            gc_df = ru.base_stratification_analysis(
+                data, ["SNP", "Indel", "hmer Indel <=4", "hmer Indel >4,<=8", "hmer Indel >8,<=10"],
+                ("G", "C"))
+            base_strat = pd.concat([at_df, gc_df])
+            out = base_strat.copy()
+            ru.make_multi_index(out)
+            ru._to_hdf(out, "all_data_per_base")
+            _section(sections, "2.1 Stratified by base", base_strat)
+        except KeyError as e:
+            logger.warning("base stratification skipped (missing column %s)", e)
+        try:
+            hom = ru.homozygous_genotyping_analysis(data, HOM_CATEGORIES, "all_data_homozygous")
+            _section(sections, "2.2 Homozygous genotyping accuracy", hom)
+        except KeyError as e:
+            logger.warning("homozygous section skipped (missing column %s)", e)
 
     # --- 3. UG high confidence regions ------------------------------------
     ug_hcr_data = pd.DataFrame()
@@ -154,9 +162,12 @@ def run(argv: list[str]) -> int:
         _section(sections, "3. UG-HCR — General accuracy", opt)
         _section(sections, "3. UG-HCR — error types", err)
         if verbosity > 1:
-            hom = ru.homozygous_genotyping_analysis(ug_hcr_data, EXOME_CATEGORIES,
-                                                    "ug_hcr_homozygous")
-            _section(sections, "3.1 UG-HCR homozygous accuracy", hom)
+            try:
+                hom = ru.homozygous_genotyping_analysis(ug_hcr_data, EXOME_CATEGORIES,
+                                                        "ug_hcr_homozygous")
+                _section(sections, "3.1 UG-HCR homozygous accuracy", hom)
+            except KeyError as e:
+                logger.warning("ug_hcr homozygous section skipped (missing column %s)", e)
 
     # --- 4. exome ---------------------------------------------------------
     exome_data = pd.DataFrame()
@@ -191,10 +202,13 @@ def run(argv: list[str]) -> int:
         if len(good):
             opt, _ = ru.basic_analysis(good, REGION_CATEGORIES, "good_cvg_data")
             _section(sections, "5. Coverage>=20 w/ mappability — accuracy", opt)
-            hom = ru.homozygous_genotyping_analysis(
-                good, ["SNP", "Indel", "non-hmer Indel", "non-hmer Indel w/o LCR",
-                       "hmer Indel <=4", "hmer Indel >4,<=8"], "good_cvg_data_homozygous")
-            _section(sections, "5.1 Homozygous accuracy", hom)
+            try:
+                hom = ru.homozygous_genotyping_analysis(
+                    good, ["SNP", "Indel", "non-hmer Indel", "non-hmer Indel w/o LCR",
+                           "hmer Indel <=4", "hmer Indel >4,<=8"], "good_cvg_data_homozygous")
+                _section(sections, "5.1 Homozygous accuracy", hom)
+            except KeyError as e:
+                logger.warning("good-coverage homozygous section skipped (missing column %s)", e)
 
     # --- 6. callable regions (notebook cell 19) ---------------------------
     if verbosity > 1 and "callable" in data.columns:
