@@ -44,8 +44,10 @@ TILE = 1 << 22  # 4M variants per device tile (HG002 WGS ~5M -> ~1.2 tiles)
 N_TILES = 3
 N_TREES = 40
 DEPTH = 6
-E2E_N = 200_000  # variants in the end-to-end pipeline fixture
-E2E_GENOME = 2_000_000  # bp
+E2E_N = 1_000_000  # variants in the end-to-end pipeline fixture
+E2E_GENOME = 10_000_000  # bp
+TRAIN_N = 500_000  # rows in the training-wallclock benchmark
+TRAIN_F = 12
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -112,6 +114,36 @@ def e2e_pipeline(fixture_dir: str) -> dict:
     }
 
 
+def train_fixture() -> tuple[np.ndarray, np.ndarray]:
+    """One dataset for BOTH the device fit and the sklearn baseline — a
+    drifted copy would silently compare different workloads."""
+    rng = np.random.default_rng(0)
+    x = rng.random((TRAIN_N, TRAIN_F)).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 1] + rng.normal(0, 0.25, TRAIN_N) > 0.7).astype(np.float32)
+    return x, y
+
+
+def train_wallclock() -> dict:
+    """Histogram-GBT fit wallclock on device (BASELINE metric #2).
+
+    Steady-state: the first fit pays jit compiles, the timed second fit is
+    the per-model cost train_models_pipeline sees across its model grid.
+    """
+    import time as _t
+
+    from variantcalling_tpu.models import boosting
+
+    x, y = train_fixture()
+    cfg = boosting.BoostConfig(n_trees=N_TREES, depth=DEPTH, n_bins=64)
+    boosting.fit(x, y, cfg=cfg)  # compile
+    t0 = _t.perf_counter()
+    forest = boosting.fit(x, y, cfg=cfg)
+    dt = _t.perf_counter() - t0
+    assert np.isfinite(float(forest.value.sum()))
+    return {"n": TRAIN_N, "n_features": TRAIN_F, "n_trees": N_TREES,
+            "wallclock_s": round(dt, 3)}
+
+
 def child_main(fixture_dir: str) -> None:
     import jax
 
@@ -123,6 +155,7 @@ def child_main(fixture_dir: str) -> None:
         "n_features": N_HOT_FEATURES,  # parent's sklearn baseline matches this width
         "hot_vps": device_throughput(),
         "e2e": e2e_pipeline(fixture_dir),
+        "train": train_wallclock(),
     }
     print("BENCH_CHILD_JSON " + json.dumps(result), flush=True)
 
@@ -200,6 +233,19 @@ def cpu_baseline_throughput(n_features: int = 12) -> float:
     return n_pred / dt
 
 
+def cpu_train_baseline() -> float:
+    """sklearn histogram-GBT fit wallclock on this host (same workload)."""
+    import time as _t
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    x, y = train_fixture()
+    clf = HistGradientBoostingClassifier(max_iter=N_TREES, max_depth=DEPTH, max_bins=64)
+    t0 = _t.perf_counter()
+    clf.fit(x, y.astype(int))
+    return _t.perf_counter() - t0
+
+
 def _cpu_env() -> dict[str, str]:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -255,6 +301,14 @@ def main() -> None:
         out["device"] = child["device"]
         out["attempt"] = label
         out["e2e"] = child["e2e"]
+        if "train" in child:
+            out["train"] = child["train"]
+            try:
+                base_train = cpu_train_baseline()
+                out["train"]["cpu_sklearn_fit_s"] = round(base_train, 3)
+                out["train"]["vs_baseline"] = round(base_train / max(child["train"]["wallclock_s"], 1e-9), 2)
+            except Exception as e:  # noqa: BLE001 — baseline failure must not kill the bench
+                out["train"]["baseline_error"] = str(e)[:200]
         if base:
             out["vs_baseline"] = round(child["hot_vps"] / base, 2)
             out["cpu_sklearn_vps"] = round(base)

@@ -9,28 +9,78 @@ from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
 
 
 def test_create_sv_report(tmp_path):
+    """Drives create_sv_report on sv_stats_collect's REAL pickle shape:
+    top-level keys, Series type/length counts, by-type frame with svtype
+    index, fp_stats MultiIndex (svtype, binned_svlens)."""
+    import numpy as np
+
     from variantcalling_tpu.pipelines import create_sv_report as svr
 
+    idx = pd.MultiIndex.from_tuples(
+        [("DEL", ""), ("INS", ""), ("DEL", "<100"), ("DEL", "100-500")],
+        names=["SV type", "SV length"],
+    )
+    concordance = pd.DataFrame(
+        {
+            "TP_base": [9, 4, 5, 4],
+            "TP_calls": [9, 4, 5, 4],
+            "FP": [2, 1, 1, 1],
+            "FN": [1, 1, 1, 0],
+            "Recall": [0.9, 0.8, 0.83, 1.0],
+            "Precision": [0.818, 0.8, 0.83, 0.8],
+            "F1": [0.857, 0.8, 0.83, 0.89],
+            "precision roc": [np.array([0.9, 0.8]), np.array([]), np.array([]), np.array([])],
+            "recall roc": [np.array([0.5, 0.9]), np.array([]), np.array([]), np.array([])],
+            "thresholds": [np.array([10, 5]), np.array([]), np.array([]), np.array([])],
+        },
+        index=idx,
+    )
     results = {
-        "sv_stats": {
-            "type_counts": {"DEL": {"PASS": 10, "all": 12}, "INS": {"PASS": 5, "all": 6}},
-            "size_histograms": pd.DataFrame({"DEL": [3, 4], "INS": [1, 2]}, index=["<100", "100-500"]),
-        },
-        "concordance_stats": {
-            "ALL_concordance": pd.Series({"TP": 9, "FP": 2, "FN": 1, "Precision": 0.818, "Recall": 0.9, "F1": 0.857})
-        },
-        "fp_stats": pd.Series([2], index=pd.MultiIndex.from_tuples([("DEL", "<100")], names=["svtype", "binned_svlens"])),
+        # collector shape: run() does results.update(sv_stats) — top level
+        "type_counts": pd.Series({"DEL": 12, "INS": 6}, name="svtype"),
+        "length_counts": pd.Series({"<100": 7, "100-500": 9}),
+        # index = svtype, columns = length bins (collect_size_type_histograms)
+        "length_by_type_counts": pd.DataFrame(
+            {"<100": [3, 1], "100-500": [4, 2]}, index=["DEL", "INS"]
+        ),
+        "concordance": concordance,
+        "fp_stats": pd.Series(
+            [2, 1],
+            index=pd.MultiIndex.from_tuples(
+                [("DEL", "<100"), ("INS", "100-500")], names=["svtype", "binned_svlens"]
+            ),
+        ),
     }
     pkl = str(tmp_path / "sv.pkl")
     with open(pkl, "wb") as fh:
         pickle.dump(results, fh)
     h5 = str(tmp_path / "sv_report.h5")
     html = str(tmp_path / "sv_report.html")
-    rc = svr.run(["--statistics_file", pkl, "--h5_output", h5, "--html_output", html])
+    plots = str(tmp_path / "figs")
+    rc = svr.run(["--statistics_file", pkl, "--h5_output", h5, "--html_output", html,
+                  "--plot_dir", plots])
     assert rc == 0
+    from variantcalling_tpu.utils.h5_utils import list_keys
+
+    keys = set(list_keys(h5))
+    assert {"parameters", "type_counts", "length_counts", "length_by_type_counts",
+            "concordance", "recall_per_length_and_type",
+            "fp_counts_per_length_and_type"} <= keys, keys
+    # orientation: length bins on the index axis, SV types as columns
+    lbt = read_hdf(h5, key="length_by_type_counts").set_index("index")
+    assert set(lbt.columns) == {"DEL", "INS"}, lbt.columns
+    assert set(lbt.index) == {"<100", "100-500"}, lbt.index
+    assert int(float(lbt.loc["100-500", "DEL"])) == 4
+    fp = read_hdf(h5, key="fp_counts_per_length_and_type")
+    assert "DEL" in fp.columns and "INS" in fp.columns  # types are columns
     conc = read_hdf(h5, key="concordance")
-    assert conc.iloc[0]["TP"] == 9
-    assert "SV Report" in open(html).read()
+    assert "TP_base" in conc.columns
+    import os
+
+    assert {"sv_type_pie.png", "sv_length_bar.png", "sv_length_by_type.png",
+            "sv_pr_roc.png", "sv_recall_per_length.png"} <= set(os.listdir(plots))
+    html_text = open(html).read()
+    assert "SV/CNV" in html_text and "data:image/png;base64" in html_text
 
 
 def _picard_file(path, cls, params: dict, hist: list | None = None):

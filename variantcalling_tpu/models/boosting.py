@@ -77,12 +77,24 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
 
     def level_step(level, carry):
         node_id, feats, bins = carry
-        # histograms over (node, bin) per feature: two segment-sums (g, h)
-        seg = node_id * b + binned.T  # (F, N) segment ids in [0, max_nodes*b)
-        hist_g = jax.vmap(lambda s: jax.ops.segment_sum(g, s, num_segments=max_nodes * b))(seg)
-        hist_h = jax.vmap(lambda s: jax.ops.segment_sum(h, s, num_segments=max_nodes * b))(seg)
-        hist_g = hist_g.reshape(f, max_nodes, b).transpose(1, 0, 2)  # (node, F, B)
-        hist_h = hist_h.reshape(f, max_nodes, b).transpose(1, 0, 2)
+        # histograms over (node, feature, bin) as ONE MXU matmul:
+        # lhs (N, 2*nodes) carries g/h masked by node one-hot, rhs (N, F*B)
+        # is the per-feature bin one-hot — their contraction over N yields
+        # both gradient and hessian histograms at systolic-array rate.
+        # (segment_sum lowers to scatter-add, which serializes on TPU: the
+        # same fit ran ~60x slower that way.) Under pjit the N contraction
+        # is where XLA inserts the cross-device psum (BASELINE config 3).
+        # bf16 operands, f32 accumulation: one-hot entries are exact in
+        # bf16; g/h lose ~3 decimal digits, far below split-gain contrasts
+        noh = jax.nn.one_hot(node_id, max_nodes, dtype=jnp.bfloat16)  # (N, nodes)
+        gh16 = jnp.stack([g, h], 1).astype(jnp.bfloat16)  # (N, 2)
+        lhs = (gh16[:, :, None] * noh[:, None, :]).reshape(n, 2 * max_nodes)
+        boh = jax.nn.one_hot(binned, b, dtype=jnp.bfloat16).reshape(n, f * b)
+        hist2 = jax.lax.dot_general(
+            lhs, boh, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (2*nodes, F*B)
+        hist_g = hist2[:max_nodes].reshape(max_nodes, f, b)
+        hist_h = hist2[max_nodes:].reshape(max_nodes, f, b)
 
         gl = jnp.cumsum(hist_g, axis=2)  # left sums for split at bin <= j
         hl = jnp.cumsum(hist_h, axis=2)
@@ -118,8 +130,9 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
     bins0 = jnp.zeros((cfg.depth, max_nodes), dtype=jnp.int32)
     node_id, feats, bins = jax.lax.fori_loop(0, cfg.depth, level_step, (node_id0, feats0, bins0))
 
-    leaf_g = jax.ops.segment_sum(g, node_id, num_segments=max_nodes)
-    leaf_h = jax.ops.segment_sum(h, node_id, num_segments=max_nodes)
+    leaf_oh = jax.nn.one_hot(node_id, max_nodes, dtype=jnp.float32)  # (N, leaves)
+    leaf_g = leaf_oh.T @ g
+    leaf_h = leaf_oh.T @ h
     leaf = -cfg.learning_rate * leaf_g / (leaf_h + lam)
     return feats, bins, leaf, node_id
 
@@ -128,6 +141,19 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
 #: {"input_sharding": str, "hlo_has_all_reduce": bool}. Test hook for the
 #: sharded-fit contract (VERDICT round-1 weak #3).
 last_fit_diag: dict = {}
+
+
+_TRAIN_CACHE: dict[BoostConfig, object] = {}
+
+
+def _jitted_train(cfg: BoostConfig):
+    """jit(train) cached per config — a fresh jit object per fit() would
+    recompile the whole T-tree program on every call (seconds per fit)."""
+    fn = _TRAIN_CACHE.get(cfg)
+    if fn is None:
+        fn = jax.jit(_make_train(cfg))
+        _TRAIN_CACHE[cfg] = fn
+    return fn
 
 
 def _make_train(cfg: BoostConfig):
@@ -202,6 +228,15 @@ def fit(
             edges = quantile_bin_edges(np.asarray(x, dtype=np.float32), cfg.n_bins)
     edges_d = jnp.asarray(edges)
 
+    # host inputs are binned on host and shipped as uint8 (4x less transfer
+    # than f32 features — the dominant per-fit cost on remote devices);
+    # device/sharded inputs bin on device (computation-follows-data)
+    host_binned = None
+    if not isinstance(x, jax.Array) and cfg.n_bins <= 256:
+        host_binned = np.empty(x.shape, dtype=np.uint8)
+        for j in range(x.shape[1]):
+            host_binned[:, j] = np.searchsorted(edges[j], x[:, j])
+
     if mesh is not None:
         from variantcalling_tpu.parallel.mesh import DATA_AXIS, data_sharding, pad_to_multiple
 
@@ -217,19 +252,20 @@ def fit(
                 padded, _ = pad_to_multiple(a, n_dp)
             return jax.device_put(padded, data_sharding(mesh, ndim))
 
-        xd, yd, wd = _pad_put(x, 2), _pad_put(y01, 1), _pad_put(w, 1)
+        yd, wd = _pad_put(y01, 1), _pad_put(w, 1)
+        binned = _pad_put(host_binned, 2) if host_binned is not None else \
+            bin_features(_pad_put(x, 2), edges_d)
     else:
-        xd = x if isinstance(x, jax.Array) else jnp.asarray(x)
         yd = y01 if isinstance(y01, jax.Array) else jnp.asarray(y01)
         wd = w if isinstance(w, jax.Array) else jnp.asarray(w)
+        binned = jnp.asarray(host_binned) if host_binned is not None else \
+            bin_features(x if isinstance(x, jax.Array) else jnp.asarray(x), edges_d)
 
-    binned = bin_features(xd, edges_d)  # sharding follows x (computation-follows-data)
-
-    train = _make_train(cfg)
+    train = _jitted_train(cfg)
     ctx = mesh if mesh is not None else nullcontext()
     with ctx:
         if diag:
-            lowered = jax.jit(train).lower(binned, yd, wd)
+            lowered = train.lower(binned, yd, wd)
             compiled = lowered.compile()
             hlo = compiled.as_text()
             last_fit_diag.clear()
@@ -239,7 +275,7 @@ def fit(
             )
             _, all_feats, all_bins, all_leaves = compiled(binned, yd, wd)
         else:
-            _, all_feats, all_bins, all_leaves = jax.jit(train)(binned, yd, wd)
+            _, all_feats, all_bins, all_leaves = train(binned, yd, wd)
     with jax.transfer_guard("allow"):  # outputs are host arrays by contract
         return _to_flat_forest(
             np.asarray(all_feats), np.asarray(all_bins), np.asarray(all_leaves), np.asarray(edges), cfg, feature_names

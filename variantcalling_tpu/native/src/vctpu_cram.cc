@@ -955,6 +955,13 @@ static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_recor
             c = Cursor{body + cont_len, buf + len};
             continue;
         }
+        // pileup-only walks skip single-ref containers off the target contig
+        // wholesale — per-region fingerprinting must not decode the genome
+        // (multi-ref containers, ref == -2, still decode)
+        if (pctx != nullptr && ref_id == nullptr && ref >= 0 && ref != pctx->target_ref) {
+            c = Cursor{body + cont_len, buf + len};
+            continue;
+        }
         Cursor cc{body, body + cont_len};
         Block chb;
         if (!read_block(cc, chb) || chb.content_type != 1) return -2;

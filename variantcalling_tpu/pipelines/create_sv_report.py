@@ -1,14 +1,18 @@
 """create_sv_report — SV accuracy report from sv_stats_collect results.
 
 Reference surface: ugvc/reports/createSVReport.ipynb (papermill). Consumes
-the pickled results dict of sv_stats_collect (keys: type_counts,
-size_histograms, concordance stats per svtype/length-bin, fp_stats) and
-emits the same artifact set directly: section tables in h5 + HTML.
+the pickled results dict of sv_stats_collect and emits the notebook's full
+artifact set directly — h5 keys ``parameters`` / ``type_counts`` /
+``length_counts`` / ``length_by_type_counts`` / ``concordance`` /
+``recall_per_length_and_type`` / ``fp_counts_per_length_and_type`` plus
+the figure set (type pie, log-scale length bars, per-category PR-ROC
+grid, recall and FP bars) and an HTML summary.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import pickle
 import sys
 
@@ -18,6 +22,8 @@ import pandas as pd
 from variantcalling_tpu import logger
 from variantcalling_tpu.reports.html import HtmlReport
 from variantcalling_tpu.utils.h5_utils import write_hdf
+
+SV_TYPE_ORDER = ["CNV", "DEL", "INS", "DUP", "BND"]  # notebook cell 19
 
 
 def parse_args(argv):
@@ -29,60 +35,185 @@ def parse_args(argv):
     ap.add_argument("--truth_sample_name", default="NA")
     ap.add_argument("--h5_output", default="sv_report.h5")
     ap.add_argument("--html_output", default=None)
+    ap.add_argument("--plot_dir", default=None, help="directory for figure PNGs")
     return ap.parse_args(argv)
 
 
+def _plots_dir(args):
+    d = args.plot_dir
+    if d is None and args.html_output:
+        d = os.path.splitext(args.html_output)[0] + "_figs"
+    if d:
+        os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _save(fig, plots, name, rep):
+    import matplotlib.pyplot as plt
+
+    rep.add_figure(fig)  # base64-embedded in the standalone HTML
+    if plots:
+        fig.savefig(os.path.join(plots, name), dpi=120, bbox_inches="tight")
+    plt.close(fig)
+
+
 def run(argv) -> int:
-    """Generate the SV report (h5 sections + optional HTML)."""
+    """Generate the SV report (h5 sections + figures + optional HTML)."""
     args = parse_args(argv)
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
     with open(args.statistics_file, "rb") as fh:
         results = pickle.load(fh)
     sv_stats = results.get("sv_stats", results if isinstance(results, dict) else {})
-    concordance = results.get("concordance_stats", {})
+    concordance = results.get("concordance")
     fp_stats = results.get("fp_stats", pd.Series(dtype="int64"))
+    plots = _plots_dir(args)
 
-    rep = HtmlReport("SV Report")
-    rep.add_params(
-        {
-            "run_id": args.run_id,
-            "pipeline_version": args.pipeline_version,
-            "reference_version": args.reference_version,
-            "truth_sample_name": args.truth_sample_name,
-            "statistics_file": args.statistics_file,
-        }
-    )
-    mode = "w"
+    rep = HtmlReport("SV/CNV Calling report")
+    params = {
+        "statistics_file": os.path.basename(args.statistics_file),
+        "run_id": args.run_id,
+        "reference_version": args.reference_version,
+        "pipeline_version": args.pipeline_version,
+        "truth_sample_name": args.truth_sample_name,
+        "h5outfile": args.h5_output,
+    }
+    rep.add_params(params)
+    params_df = pd.DataFrame.from_dict(params, orient="index", columns=["value"])
+    write_hdf(params_df.reset_index(), args.h5_output, key="parameters", mode="w")
+
+    # --- general statistics (notebook cells 9-20) -------------------------
     if "type_counts" in sv_stats:
-        tc = pd.DataFrame(sv_stats["type_counts"]).T if isinstance(sv_stats["type_counts"], dict) else pd.DataFrame(sv_stats["type_counts"])
-        rep.add_section("SV type counts")
+        tc = pd.DataFrame(sv_stats["type_counts"]).T
+        rep.add_section("SV type distribution")
         rep.add_table(tc)
-        write_hdf(tc.reset_index(), args.h5_output, key="type_counts", mode=mode)
-        mode = "a"
-    if "size_histograms" in sv_stats:
-        sh = sv_stats["size_histograms"]
-        sh = pd.DataFrame(sh) if not isinstance(sh, pd.DataFrame) else sh
-        rep.add_section("SV size histograms")
-        rep.add_table(sh)
-        write_hdf(sh.reset_index(), args.h5_output, key="size_histograms", mode=mode)
-        mode = "a"
-    if concordance:
-        conc_rows = {k: v for k, v in concordance.items() if isinstance(v, pd.Series)}
-        if conc_rows:
-            conc = pd.DataFrame(conc_rows).T
-            rep.add_section("Concordance vs ground truth")
-            rep.add_table(conc)
-            write_hdf(conc.reset_index(), args.h5_output, key="concordance", mode=mode)
-            mode = "a"
+        write_hdf(tc.reset_index(), args.h5_output, key="type_counts", mode="a")
+        fig, ax = plt.subplots(subplot_kw={"aspect": "equal"})
+        ax.pie(tc.values[0], labels=[str(c) for c in tc.columns], autopct="%1.1f%%",
+               startangle=90, pctdistance=0.9, labeldistance=1.1)
+        _save(fig, plots, "sv_type_pie.png", rep)
+
+    if "length_counts" in sv_stats:
+        lc = pd.DataFrame(sv_stats["length_counts"]).T
+        lc.columns = lc.columns.astype(str)
+        rep.add_section("SV length distribution")
+        rep.add_table(lc)
+        write_hdf(lc.reset_index(), args.h5_output, key="length_counts", mode="a")
+        fig, ax = plt.subplots()
+        lc.T.plot.bar(ax=ax, legend=False)
+        ax.set_xlabel("Length")
+        ax.set_ylabel("# Calls")
+        ax.set_yscale("log")
+        _save(fig, plots, "sv_length_bar.png", rep)
+
+    if "length_by_type_counts" in sv_stats:
+        lbt = sv_stats["length_by_type_counts"]
+        lbt = pd.DataFrame(lbt) if not isinstance(lbt, pd.DataFrame) else lbt.copy()
+        # collector emits index=svtype, columns=length bins
+        # (sv_stats_collect.collect_size_type_histograms); the notebook
+        # transposes before plotting (createSVReport cell 18) so length is
+        # the x axis and SV type the legend
+        if any(t in lbt.index for t in SV_TYPE_ORDER):
+            lbt = lbt.T
+        order = [t for t in SV_TYPE_ORDER if t in lbt.columns] + \
+            [t for t in lbt.columns if t not in SV_TYPE_ORDER]
+        lbt = lbt.reindex(order, axis=1).dropna(how="all", axis=1)
+        rep.add_section("Length and type distribution")
+        rep.add_table(lbt)
+        save_lbt = lbt.copy()
+        save_lbt.columns = [str(c) for c in save_lbt.columns]
+        save_lbt.index = [str(i) for i in save_lbt.index]
+        write_hdf(save_lbt.reset_index(), args.h5_output, key="length_by_type_counts", mode="a")
+        fig, ax = plt.subplots(figsize=(8, 6))
+        lbt.plot(kind="bar", stacked=False, ax=ax)
+        ax.set_xlabel("Length")
+        ax.set_ylabel("# Calls")
+        ax.set_yscale("log")
+        ax.legend(title="SV Type", loc="upper right", fontsize=10)
+        _save(fig, plots, "sv_length_by_type.png", rep)
+
+    # --- concordance (notebook cells 21-27) -------------------------------
+    if concordance is not None and len(concordance):
+        conc = concordance.copy()
+        rep.add_section("Concordance evaluation")
+        roc_cols = [c for c in ("precision roc", "recall roc", "thresholds") if c in conc.columns]
+        overall = conc
+        if isinstance(conc.index, pd.MultiIndex) and "SV length" in conc.index.names:
+            overall = conc[conc.index.get_level_values("SV length") == ""]
+        values_df = overall.drop(columns=roc_cols, errors="ignore")
+        keep = [c for c in ("TP_base", "TP_calls", "FP", "FN", "Recall", "Precision", "F1")
+                if c in values_df.columns]
+        if keep:
+            values_df = values_df[keep]
+        rep.add_table(values_df.reset_index())
+        write_hdf(values_df.reset_index().astype(str), args.h5_output, key="concordance", mode="a")
+
+        # ROC grid per overall category
+        if roc_cols and len(overall):
+            rocs = [(idx, row) for idx, row in overall[roc_cols].iterrows()
+                    if len(np.atleast_1d(row.get("precision roc", [])))]
+            if rocs:
+                fig, axs = plt.subplots(1, len(rocs), figsize=(3 * len(rocs), 3), squeeze=False)
+                for ax, (idx, row) in zip(axs[0], rocs):
+                    ax.plot(row["recall roc"], row["precision roc"])
+                    ax.set_title(str(idx if not isinstance(idx, tuple) else idx[0]))
+                    ax.set_xlabel("Recall")
+                    ax.set_xlim(0, 0.8)
+                    ax.set_ylim(0.6, 1)
+                axs[0][0].set_ylabel("Precision")
+                _save(fig, plots, "sv_pr_roc.png", rep)
+
+        # recall per length and type (length-binned rows)
+        if isinstance(conc.index, pd.MultiIndex) and "SV length" in conc.index.names:
+            binned = conc[conc.index.get_level_values("SV length") != ""]
+            keep = [c for c in ("TP_base", "TP_calls", "FN", "Recall") if c in binned.columns]
+            if len(binned) and keep:
+                rec = binned[keep].copy()
+                for c in ("TP_base", "TP_calls", "FN"):
+                    if c in rec.columns:
+                        rec[c] = rec[c].astype(float).astype(int)
+                rep.add_section("Recall per variant length and type")
+                rep.add_table(rec.reset_index())
+                out = rec.reset_index()
+                out.columns = [str(c).replace(" ", "_") for c in out.columns]
+                write_hdf(out.astype(str), args.h5_output,
+                          key="recall_per_length_and_type", mode="a")
+                fig, ax = plt.subplots(figsize=(8, 4))
+                piv = out.pivot_table(index="SV_length", columns="SV_type", values="Recall",
+                                      aggfunc="first")
+                piv = piv.astype(float)
+                piv.plot(kind="bar", ax=ax)
+                ax.set_ylabel("Recall")
+                _save(fig, plots, "sv_recall_per_length.png", rep)
+
     if len(fp_stats):
-        rep.add_section("False positives by type and size")
-        fp_df = fp_stats.rename("count").reset_index()
+        rep.add_section("False positives per variant length and type")
+        fp_df = fp_stats.rename("FP count").reset_index()
+        # name by the collector's index names, not positional order
+        # (sv_stats_collect emits (svtype, binned_svlens))
+        fp_df = fp_df.rename(columns={"svtype": "SV type", "binned_svlens": "SV length"})
         fp_df = fp_df.astype({c: str for c in fp_df.columns if fp_df[c].dtype == "category"})
         rep.add_table(fp_df)
-        write_hdf(fp_df, args.h5_output, key="fp_stats", mode=mode)
-        mode = "a"
+        if {"SV length", "SV type", "FP count"} <= set(fp_df.columns):
+            piv = fp_df.pivot_table(index="SV length", columns="SV type", values="FP count",
+                                    aggfunc="sum").fillna(0).astype(int)
+            piv.columns = piv.columns.astype(str)
+            write_hdf(piv.reset_index().astype(str), args.h5_output,
+                      key="fp_counts_per_length_and_type", mode="a")
+            fig, ax = plt.subplots(figsize=(10, 5))
+            piv.plot.bar(ax=ax, width=0.8)
+            ax.legend(title="SV Type", bbox_to_anchor=(1.05, 1), loc="upper left")
+            _save(fig, plots, "sv_fp_per_length.png", rep)
+        else:
+            write_hdf(fp_df, args.h5_output, key="fp_counts_per_length_and_type", mode="a")
+
     if args.html_output:
         rep.write(args.html_output)
-    logger.info("SV report -> %s%s", args.h5_output, f" + {args.html_output}" if args.html_output else "")
+    logger.info("SV report -> %s%s", args.h5_output,
+                f" + {args.html_output}" if args.html_output else "")
     return 0
 
 
