@@ -258,7 +258,8 @@ def test_detailed_var_report(tmp_path, rng):
             "hmer_indel_length": np.zeros(n),
             "tree_score": rng.random(n),
             "LCR-hs38": rng.random(n) < 0.1,
-            "coverage": rng.integers(5, 60, n).astype(float),
+            "gc_content": rng.random(n),
+            "well_mapped_coverage": rng.integers(5, 60, n).astype(float),
         }
     )
     h5 = str(tmp_path / "conc.h5")
@@ -270,6 +271,14 @@ def test_detailed_var_report(tmp_path, rng):
     from variantcalling_tpu.utils.h5_utils import list_keys
 
     keys = list_keys(out)
-    assert "overall" in keys
+    assert "detailed_vars" in keys
+    det = read_hdf(out, key="detailed_vars")
+    assert {"Region", "Category", "Variant", "F1-stat", "F1-opt", "max recall",
+            "# pos"} <= set(det.columns)
+    assert "All" in set(det["Region"]) and "SNP" in set(det["Variant"])
+    # GC + coverage strata present when their columns exist
+    assert any(str(c).startswith("GC ") for c in det["Category"])
+    assert any(str(c).startswith("CVG ") for c in det["Category"])
+    html_text = open(html).read()
+    assert "data:image/png;base64" in html_text  # performance matrices
     assert any("LCR" in k for k in keys)
-    assert any(k.startswith("coverage_") for k in keys)

@@ -99,9 +99,24 @@ def run(argv) -> int:
             for s in samples
         }
     )
+    tp["Total"] = tp.sum(axis=1)  # notebook calcTotalRow
     rep.add_section("Throughput")
     rep.add_table(tp)
     write_hdf(tp.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="throughput", mode="a")
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        # read-attrition bars: Total -> PF -> Aligned per sample (cell 5)
+        fig, ax = plt.subplots(figsize=(7, 3.5))
+        tp.loc[["Total reads", "PF reads", "Aligned reads"], samples].T.plot.bar(ax=ax)
+        ax.set_ylabel("# reads")
+        rep.add_figure(fig)
+        plt.close(fig)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("throughput figure skipped: %s", e)
 
     cm = pd.DataFrame(
         {
@@ -118,6 +133,41 @@ def run(argv) -> int:
     rep.add_table(cm)
     write_hdf(cm.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="coverage", mode="a")
 
+    # coverage histogram + cumulative plot with median lines (cell 8)
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        hists = {}
+        for s, f in zip(samples, metrics_files):
+            try:
+                hists[s] = read_hdf(f, key="coverage_histograms")
+            except KeyError:
+                pass
+        if hists:
+            fig, ax = plt.subplots(1, 2, figsize=(14, 4))
+            for s, h in hists.items():
+                num = h.select_dtypes(include=[np.number])
+                if num.shape[1] < 2:
+                    continue
+                cov, cnt = num.iloc[:, 0], num.iloc[:, 1]
+                ax[0].plot(cov, cnt, label=s)
+                ax[1].plot(cov, cnt.cumsum() / max(cnt.sum(), 1), label=s)
+                med = get_metric(per_sample[s], "wgs_metrics", "MEDIAN_COVERAGE")
+                if np.isfinite(med):
+                    ax[0].axvline(med, ls="--", alpha=0.5)
+            ax[0].set_xlabel("coverage")
+            ax[0].set_ylabel("# loci")
+            ax[0].legend()
+            ax[1].set_xlabel("coverage")
+            ax[1].set_ylabel("cumulative fraction")
+            rep.add_figure(fig)
+            plt.close(fig)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("coverage figure skipped: %s", e)
+
     em = pd.DataFrame(
         {
             s: {
@@ -131,6 +181,13 @@ def run(argv) -> int:
     rep.add_section("Error")
     rep.add_table(em)
     write_hdf(em.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="error", mode="a")
+
+    # appendix: raw metric tables of the first sample per file (cells 12-15)
+    first = per_sample[samples[0]]
+    rep.add_section("Appendix — raw metrics")
+    for fname in list(dict.fromkeys(first["File"])):
+        rep.add_text(str(fname))
+        rep.add_table(first[first["File"] == fname].head(60))
 
     if args.html_output:
         rep.write(args.html_output)

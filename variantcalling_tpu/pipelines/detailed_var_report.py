@@ -1,12 +1,18 @@
 """detailed_var_report — stratified germline accuracy report.
 
 Reference surface: ugvc/reports/detailedVarReport.v0.ipynb +
-detailed_var_report.config. The detailed flavor adds genomic-context
-stratification on top of createVarReport: per-category accuracy inside and
-outside each annotation track (LCR, exome, mappability, ug_hcr), coverage
-bins when a coverage column exists, and the SEC re-filtered view — all from
-the same concordance frame with boolean-mask algebra (no extra passes over
-the data).
+detailed_var_report.config. Reproduces the notebook's artifact set:
+
+- the ``detailed_vars`` long frame (+ csv): one row per
+  (Region, Category, Variant) cell over regions (All/annotation tracks),
+  GC bins (0-20/20-80/80-100), coverage bins (0-20/20-40/40-100) and the
+  notebook's variant categories (All/SNP/Indel/non-hmer/hmer bins), each
+  carrying # pos/neg, avg coverage, max recall, static precision/recall/
+  F1 at the shipped thresholds, and the re-optimized F1 from a
+  tree_score threshold sweep (calcPerformanceOptimized);
+- the colored performance-matrix figures (genome + exome, F1-stat and
+  F1-opt, RdYlGn by value) embedded in the HTML;
+- per-track inside/outside accuracy tables (kept from the basic flavor).
 """
 
 from __future__ import annotations
@@ -20,29 +26,188 @@ import pandas as pd
 from variantcalling_tpu import logger
 from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics
 from variantcalling_tpu.reports.html import HtmlReport
-from variantcalling_tpu.reports.report_data_loader import ReportDataLoader
 from variantcalling_tpu.utils.h5_utils import write_hdf
 
-ANNOTATION_PREFIXES = ("LCR", "exome", "mappability", "ug_hcr")
+ANNOTATION_PREFIXES = ("LCR", "exome", "mappability", "ug_hcr", "callable")
+VAR_CATS = ["All", "SNP", "Indel", "non-hmer", "hmer 0-1", "hmer 2-4",
+            "hmer 5-8", "hmer 9-10", "hmer 11+"]
+GC_BINS = [(0.0, 0.2), (0.2, 0.8), (0.8, 1.01)]
+CVG_BINS = [(0, 20), (20, 40), (40, 100)]
 
 
 def parse_args(argv):
     ap = argparse.ArgumentParser(prog="detailed_var_report", description=run.__doc__)
     ap.add_argument("--h5_concordance_file", required=True)
     ap.add_argument("--h5_output", default="detailed_var_report.h5")
-    ap.add_argument("--html_output", default=None)
+    ap.add_argument("--csv_output", default=None, help="detailed_vars csv (config DetailedReport.csv)")
+    ap.add_argument("--html_output", required=False, default=None)
     ap.add_argument("--reference_version", default="hg38")
     ap.add_argument("--exome_column_name", default="exome.twist")
-    ap.add_argument("--coverage_column", default="coverage")
-    ap.add_argument("--coverage_bins", nargs="*", type=float, default=[0, 10, 20, 30, 40, 1e9])
+    ap.add_argument("--coverage_column", default="well_mapped_coverage")
     return ap.parse_args(argv)
+
+
+def _var_mask(d: pd.DataFrame, cat: str) -> pd.Series:
+    indel = d["indel"].astype(bool)
+    # the loader renames hmer_indel_length -> hmer_length; accept either
+    hmer_col = "hmer_length" if "hmer_length" in d.columns else "hmer_indel_length"
+    hmer = (pd.to_numeric(d[hmer_col], errors="coerce").fillna(0)
+            if hmer_col in d.columns else pd.Series(0.0, index=d.index))
+    if cat == "All":
+        return pd.Series(True, index=d.index)
+    if cat == "SNP":
+        return ~indel
+    if cat == "Indel":
+        return indel
+    if cat == "non-hmer":
+        return indel & (hmer == 0) & (pd.to_numeric(d.get("indel_length", 0)) > 1)
+    if cat == "hmer 0-1":
+        return indel & (hmer <= 1) & ~((hmer == 0) & (pd.to_numeric(d.get("indel_length", 0)) > 1))
+    if cat == "hmer 2-4":
+        return indel & (hmer >= 2) & (hmer <= 4)
+    if cat == "hmer 5-8":
+        return indel & (hmer >= 5) & (hmer <= 8)
+    if cat == "hmer 9-10":
+        return indel & (hmer >= 9) & (hmer <= 10)
+    if cat == "hmer 11+":
+        return indel & (hmer >= 11)
+    raise ValueError(cat)
+
+
+def _perf(d: pd.DataFrame, classify_col: str, cvg: pd.Series) -> dict | None:
+    """Static + threshold-reoptimized performance of one stratum cell."""
+    label = np.where(d[classify_col].astype(str) == "fp", 0, 1)
+    n_pos = int(label.sum())
+    n_neg = int(len(d) - n_pos)
+    if len(d) == 0 or n_pos == 0:
+        return {"# pos": n_pos, "# neg": n_neg, "avg cvg": float("nan"),
+                "max recall": np.nan, "recall": np.nan, "precision": np.nan,
+                "F1-stat": np.nan, "F1-opt": np.nan}
+    is_fn = d[classify_col].astype(str) == "fn"
+    passes = d["filter"].astype(str) == "PASS"
+    tp = int(((label == 1) & ~is_fn & passes).sum())
+    fp = int(((label == 0) & passes).sum())
+    fn = int((is_fn | ((label == 1) & ~passes)).sum())
+    recall = tp / (tp + fn) if tp + fn else np.nan
+    precision = tp / (tp + fp) if tp + fp else np.nan
+    f1 = tp / (tp + 0.5 * fn + 0.5 * fp) if tp + fn + fp else np.nan
+    max_recall = 1.0 - float(is_fn.sum()) / n_pos
+
+    # threshold sweep over tree_score (calcPerformanceOptimized): at each
+    # cut, calls below it flip to negatives — vectorized cumulative counts
+    if "tree_score" in d.columns:
+        score = pd.to_numeric(d["tree_score"], errors="coerce").fillna(0.0).to_numpy()
+    else:
+        score = np.zeros(len(d))  # no score: sweep degenerates to one point
+    callable_mask = ~is_fn.to_numpy()
+    base_fn = int(is_fn.sum())
+    order = np.argsort(score[callable_mask])
+    lab = label[callable_mask][order]
+    cum_pos_dropped = np.concatenate([[0], np.cumsum(lab)])
+    cum_neg_dropped = np.concatenate([[0], np.cumsum(1 - lab)])
+    total_pos = lab.sum()
+    total_neg = len(lab) - total_pos
+    tp_k = total_pos - cum_pos_dropped
+    fp_k = total_neg - cum_neg_dropped
+    fn_k = base_fn + cum_pos_dropped
+    with np.errstate(invalid="ignore", divide="ignore"):
+        f1_k = tp_k / (tp_k + 0.5 * fn_k + 0.5 * fp_k)
+    f1_opt = float(np.nanmax(f1_k)) if len(f1_k) else np.nan
+
+    return {"# pos": n_pos, "# neg": n_neg,
+            "avg cvg": float(pd.to_numeric(cvg, errors="coerce").mean()) if cvg is not None else np.nan,
+            "max recall": max_recall, "recall": recall, "precision": precision,
+            "F1-stat": f1, "F1-opt": f1_opt}
+
+
+def _bool_mask(vals: pd.Series) -> pd.Series:
+    """Annotation-column truthiness that survives h5 object-string round trips
+    (astype(bool) would map the string 'False' to True)."""
+    if vals.dtype == object:
+        return vals.astype(str).isin(["True", "1", "1.0", "true"])
+    return vals.astype(bool)
+
+
+def build_detailed_vars(df: pd.DataFrame, regions: list[str], classify_col: str,
+                        coverage_column: str) -> pd.DataFrame:
+    rows = []
+    cvg_all = pd.to_numeric(df.get(coverage_column), errors="coerce") \
+        if coverage_column in df.columns else None
+
+    def add(d1, region, category, var):
+        cvg = cvg_all.loc[d1.index] if cvg_all is not None else None
+        p = _perf(d1, classify_col, cvg)
+        rows.append({"Region": region, "Category": category, "Variant": var, **p})
+
+    for region in ["All"] + regions:
+        if region == "All":
+            d1 = df
+        elif region.startswith("Non-"):
+            d1 = df[~_bool_mask(df[region[4:]])]
+        else:
+            d1 = df[_bool_mask(df[region])]
+        for var in VAR_CATS:
+            d2 = d1[_var_mask(d1, var)]
+            add(d2, region, "All", var)
+            if "gc_content" in df.columns:
+                gc = pd.to_numeric(d2["gc_content"], errors="coerce")
+                for lo, hi in GC_BINS:
+                    add(d2[(gc >= lo) & (gc < hi)], region,
+                        f"GC {lo * 100:.0f}-{min(hi, 1) * 100:.0f}", var)
+            if cvg_all is not None:
+                cv = cvg_all.loc[d2.index]
+                for lo, hi in CVG_BINS:
+                    add(d2[(cv >= lo) & (cv < hi)], region, f"CVG {lo}-{hi}", var)
+    return pd.DataFrame(rows)
+
+
+def _matrix_figure(out: pd.DataFrame, rows: list[str], metric: str, title: str):
+    """Colored performance matrix (notebook cells 9-14)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    def cell(region, var):
+        x = out[(((out["Category"] == "All") & (out["Region"] == region)) |
+                 ((out["Category"] == region) & (out["Region"] == "All"))) &
+                (out["Variant"] == var)]
+        if not len(x):
+            return "-", "white"
+        v = x[metric].iloc[0]
+        n = x["# pos"].iloc[0]
+        cvg = x["avg cvg"].iloc[0]
+        if not np.isfinite(v):
+            return "-", "white"
+        num = f"{int(n / 1000):d}k" if n > 1000 else f"{int(n):d}"
+        cvg_s = f"{cvg:.1f}" if np.isfinite(cvg) else "-"
+        color = "white" if n < 30 else plt.cm.RdYlGn(max(min((v - 0.8) / 0.2, 1.0), 0.0))
+        return f"{v:.1%}\n({num},{cvg_s})", color
+
+    present = [r for r in rows if r == "All" or len(out[(out["Region"] == r) | (out["Category"] == r)])]
+    tabl, tabcol = [], []
+    for r in present:
+        txts, cols = zip(*(cell(r, c) for c in VAR_CATS))
+        tabl.append(list(txts))
+        tabcol.append(list(cols))
+    fig, ax = plt.subplots(figsize=(20, 1 + len(present)))
+    ax.set_axis_off()
+    table = ax.table(cellText=tabl, rowLabels=present, colLabels=VAR_CATS,
+                     cellColours=tabcol, cellLoc="center", loc="upper left")
+    table.set_fontsize(12)
+    table.scale(1, 2.2)
+    ax.set_title(title, fontsize=18)
+    return fig
 
 
 def run(argv) -> int:
     """Generate the detailed (context-stratified) variant report."""
     args = parse_args(argv)
+    from variantcalling_tpu.reports.report_data_loader import ReportDataLoader
+
     try:
-        loader = ReportDataLoader(args.h5_concordance_file, args.reference_version, args.exome_column_name)
+        loader = ReportDataLoader(args.h5_concordance_file, args.reference_version,
+                                  args.exome_column_name)
         df = loader.load_concordance_df()
     except KeyError:
         # frames without the genotype columns (gt_ultima/gt_ground_truth)
@@ -50,48 +215,75 @@ def run(argv) -> int:
         from variantcalling_tpu.utils.h5_utils import read_hdf
 
         df = read_hdf(args.h5_concordance_file, key="all")
+    classify_col = "classify_gt" if "classify_gt" in df.columns else "classify"
     rep = HtmlReport("Detailed Variant Report")
-    rep.add_params({"input": args.h5_concordance_file, "records": len(df)})
-    mode = "w"
+    rep.add_params({"input": args.h5_concordance_file, "records": len(df),
+                    "classify_column": classify_col})
 
-    overall = calc_accuracy_metrics(df, "classify", ["HPOL_RUN"])
-    rep.add_section("Overall accuracy")
-    rep.add_table(overall)
-    write_hdf(overall, args.h5_output, key="overall", mode=mode)
-    mode = "a"
+    ann_cols = [c for c in df.columns
+                if any(str(c).startswith(p) for p in ANNOTATION_PREFIXES)]
+    regions = []
+    for c in ann_cols:
+        regions += [str(c), f"Non-{c}"]
 
-    ann_cols = [
-        c for c in df.columns if any(str(c).startswith(p) for p in ANNOTATION_PREFIXES)
-    ]
+    detailed = build_detailed_vars(df, regions, classify_col, args.coverage_column)
+    write_hdf(detailed, args.h5_output, key="detailed_vars", mode="w")
+    if args.csv_output:
+        detailed.to_csv(args.csv_output, index=False)
+
+    rep.add_section("Summary performance — Genome")
+    matrix_rows = ["All", "GC 0-20", "GC 20-80", "GC 80-100", "CVG 0-20",
+                   "CVG 20-40", "CVG 40-100"] + regions
+    try:
+        for metric, title in (("F1-stat", "Genome — F1 (n,cvg)"),
+                              ("F1-opt", "Genome — re-optimized F1 (n,cvg)")):
+            fig = _matrix_figure(detailed, matrix_rows, metric, title)
+            rep.add_figure(fig)
+            import matplotlib.pyplot as plt
+
+            plt.close(fig)
+    except Exception as e:  # noqa: BLE001 — matrices are presentation only
+        logger.warning("performance matrix skipped: %s", e)
+
+    exome_col = args.exome_column_name if args.exome_column_name in df.columns else None
+    if exome_col:
+        rep.add_section("Summary performance — Exome")
+        exome_detailed = build_detailed_vars(
+            df[_bool_mask(df[exome_col])],
+            [r for r in regions if not r.startswith(("Non-" + exome_col, exome_col))],
+            classify_col, args.coverage_column)
+        write_hdf(exome_detailed, args.h5_output, key="detailed_vars_exome", mode="a")
+        try:
+            for metric, title in (("max recall", "Exome — max recall (n,cvg)"),
+                                  ("F1-stat", "Exome — F1 (n,cvg)"),
+                                  ("F1-opt", "Exome — re-optimized F1 (n,cvg)")):
+                fig = _matrix_figure(exome_detailed, matrix_rows, metric, title)
+                rep.add_figure(fig)
+                import matplotlib.pyplot as plt
+
+                plt.close(fig)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("exome matrix skipped: %s", e)
+
+    # per-track inside/outside accuracy tables (kept from the basic flavor)
     for col in ann_cols:
-        vals = df[col]
-        mask = vals.astype(bool) if vals.dtype != object else vals.astype(str).isin(["True", "1", "1.0"])
+        mask = _bool_mask(df[col])
         for label, m in ((f"inside {col}", mask), (f"outside {col}", ~mask)):
             sub = df[m]
             if not len(sub):
                 continue
             tab = calc_accuracy_metrics(sub, "classify", ["HPOL_RUN"])
-            key = label.replace(" ", "_").replace(".", "_")
+            key = label.replace(" ", "_").replace(".", "_").replace("-", "_")
             rep.add_section(f"Accuracy {label} ({int(m.sum())} records)")
             rep.add_table(tab)
-            write_hdf(tab, args.h5_output, key=key, mode=mode)
+            write_hdf(tab, args.h5_output, key=key, mode="a")
 
-    if args.coverage_column in df.columns:
-        cov = pd.to_numeric(df[args.coverage_column], errors="coerce")
-        bins = args.coverage_bins
-        for lo, hi in zip(bins[:-1], bins[1:]):
-            m = (cov >= lo) & (cov < hi)
-            if not m.any():
-                continue
-            tab = calc_accuracy_metrics(df[m], "classify", ["HPOL_RUN"])
-            label = f"coverage [{lo:g}, {hi:g})"
-            rep.add_section(f"Accuracy at {label}")
-            rep.add_table(tab)
-            write_hdf(tab, args.h5_output, key=f"coverage_{lo:g}_{hi:g}".replace(".", "_"), mode=mode)
-
+    rep.add_section("Detailed performance (all strata)")
+    rep.add_table(detailed.head(1000))
     if args.html_output:
         rep.write(args.html_output)
-    logger.info("detailed report (%d annotation tracks) -> %s", len(ann_cols), args.h5_output)
+    logger.info("detailed report: %d strata rows, %d tracks -> %s",
+                len(detailed), len(ann_cols), args.h5_output)
     return 0
 
 

@@ -37,8 +37,60 @@ def parse_args(argv):
     return ap.parse_args(argv)
 
 
+# SBS96 class colors (notebook base_colors / standard COSMIC palette order)
+_SBS_CLASS_COLORS = {
+    "C>A": "#03bcee", "C>G": "#010101", "C>T": "#e32926",
+    "T>A": "#cac9c9", "T>C": "#a1ce63", "T>G": "#ebc6c4",
+}
+
+
+def _figure_for(key: str, df: pd.DataFrame):
+    """Notebook-parity figure for a known section (None -> table only)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    num = df.select_dtypes(include=[np.number])
+    if key == "af_hist" and len(num):
+        fig, ax = plt.subplots(figsize=(8, 3))
+        num.plot.bar(ax=ax, legend=len(num.columns) > 1)
+        ax.set_xlabel("Allele frequency bin")
+        ax.set_ylabel("# variants")
+        return fig
+    if key == "snp_motifs" and len(df) >= 96:
+        fig, ax = plt.subplots(figsize=(14, 3))
+        counts = num.iloc[:, 0].to_numpy() if len(num.columns) else np.zeros(len(df))
+        labels = df.iloc[:, 0].astype(str) if df.columns[0] not in num.columns else df.index.astype(str)
+        colors = []
+        for lab in labels:
+            cls = next((c for c in _SBS_CLASS_COLORS if c in str(lab)), None)
+            colors.append(_SBS_CLASS_COLORS.get(cls, "#888888"))
+        ax.bar(np.arange(len(counts)), counts, color=colors, width=0.8)
+        ax.set_xticks(np.arange(0, len(counts), 16))
+        ax.set_xlabel("96 trinucleotide channels")
+        ax.set_ylabel("# SNVs")
+        return fig
+    if key in ("ins_del_hete", "ins_del_homo") and len(num):
+        plot_df = num
+        if "hmer_len" in num.columns:  # index column, not a data series
+            plot_df = num.drop(columns=["hmer_len"]).set_axis(num["hmer_len"], axis=0)
+        fig, ax = plt.subplots(figsize=(9, 3))
+        plot_df.plot.bar(ax=ax)
+        ax.set_xlabel("hmer length")
+        ax.set_ylabel("# indels")
+        ax.legend(fontsize=8)
+        return fig
+    if key == "signature_exposures" and len(num):
+        fig, ax = plt.subplots(figsize=(8, 3))
+        num.iloc[:, 0].plot.bar(ax=ax, legend=False)
+        ax.set_ylabel("Exposure")
+        return fig
+    return None
+
+
 def run(argv) -> int:
-    """Render the no-GT report HTML."""
+    """Render the no-GT report HTML (tables + notebook-parity figures)."""
     args = parse_args(argv)
     rep = HtmlReport(f"Variant Report (no ground truth) — {args.sample_name}")
     rep.add_params({"input": args.input_h5, "sample": args.sample_name})
@@ -51,6 +103,15 @@ def run(argv) -> int:
         df = read_hdf(args.input_h5, key=key)
         title = SECTION_TITLES.get(key, key.replace("_", " "))
         rep.add_section(title)
+        try:
+            fig = _figure_for(key, df)
+            if fig is not None:
+                rep.add_figure(fig)
+                import matplotlib.pyplot as plt
+
+                plt.close(fig)
+        except Exception as e:  # noqa: BLE001 — a bad figure must not kill the report
+            logger.warning("figure for %s skipped: %s", key, e)
         if key == "af_hist" and len(df) > 25:
             # compact: show non-empty bins only
             num = df.select_dtypes(include=[np.number])
