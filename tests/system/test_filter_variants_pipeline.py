@@ -161,3 +161,57 @@ def test_filter_pipeline_single_contig(synthetic_world):
     result = read_vcf(str(out))
     assert len(result) == sum(1 for r in w["recs"] if r["chrom"] == "chr2")
     assert all(c == "chr2" for c in result.chrom)
+
+
+def test_genome_resident_scoring_matches_host_windows(tmp_path, rng):
+    """The device-resident-genome window gather must score identically to
+    the host window path (featurize.device_genome / windows_on_device)."""
+    import bench
+    from variantcalling_tpu.featurize import host_featurize
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.pipelines.filter_variants import fused_featurize_score
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    d = str(tmp_path)
+    bench.make_fixtures(d, n=3000, genome_len=100_000)
+    table = read_vcf(f"{d}/calls.vcf")
+    fasta = FastaReader(f"{d}/ref.fa")
+    model = synthetic_forest(np.random.default_rng(0), n_trees=10, depth=5)
+    s_host = fused_featurize_score(model, host_featurize(table, fasta), "TGCA")
+    hf_dev = host_featurize(table, fasta, compute_windows=False)
+    assert hf_dev.windows is None
+    s_dev = fused_featurize_score(model, hf_dev, "TGCA", table=table, fasta=fasta)
+    np.testing.assert_allclose(s_host, s_dev, atol=1e-6)
+
+
+def test_globalize_positions_int32_safe_at_hg38_scale():
+    """Global coordinates past 2^31 must decompose exactly into int32
+    (block, offset) pairs — jax without x64 truncates int64 device arrays."""
+    from variantcalling_tpu.featurize import (_GBLOCK, DeviceGenome, GENOME_BLOCK_BITS,
+                                              globalize_positions)
+    from variantcalling_tpu.io.vcf import VariantTable, VcfHeader
+
+    big = 3_100_000_000  # chrX-at-end-of-hg38 scale global offset
+    genome = DeviceGenome(blocks=np.empty((big // _GBLOCK + 10, 0), dtype=np.uint8),
+                          offsets={"chrX": big, "chr1": 40},
+                          lengths={"chrX": 50_000_000, "chr1": 1_000}, flat=False)
+    n = 5
+    table = VariantTable(
+        header=VcfHeader(),
+        chrom=np.array(["chrX", "chrX", "chr1", "chrUn", "chrX"], dtype=object),
+        pos=np.array([1, 49_999_999, 500, 100, 7_654_321], dtype=np.int64),
+        vid=np.array(["."] * n, dtype=object), ref=np.array(["A"] * n, dtype=object),
+        alt=np.array(["G"] * n, dtype=object), qual=np.zeros(n),
+        filters=np.array(["PASS"] * n, dtype=object), info=np.array(["."] * n, dtype=object),
+    )
+    blk, off = globalize_positions(table, genome)
+    assert blk.dtype == np.int32 and off.dtype == np.int32
+    recon = blk.astype(np.int64) * _GBLOCK + off
+    assert recon[0] == big + 0
+    assert recon[1] == big + 49_999_998
+    assert recon[2] == 40 + 499
+    assert recon[4] == big + 7_654_320
+    # unknown contig resolves past the genome end (all-N window)
+    assert blk[3] >= genome.blocks.shape[0]
+    assert (1 << GENOME_BLOCK_BITS) == _GBLOCK

@@ -120,6 +120,123 @@ def classify_alleles(table: VariantTable) -> AlleleColumns:
     return AlleleColumns(is_snp, is_indel, is_ins, indel_length, indel_nuc, ref_code, alt_code, n_alts)
 
 
+# device-resident genome: fasta path -> (blocked device array, offsets, lengths).
+# Shipping the genome to HBM once turns per-run window transfer (41 bytes a
+# variant) into an on-device gather fed by one (block, offset) int32 pair
+# per variant. All contigs are concatenated with 2*WINDOW_RADIUS-wide N
+# gaps so windows never leak across contig boundaries, and the array is
+# reshaped to (n_blocks, 2^GENOME_BLOCK_BITS): hg38's ~3.1e9 global
+# coordinates exceed int32 (the only integer width jax uses without x64),
+# so all device-side indexing stays in the (small block id, small offset)
+# pair. The fused program compiles ONCE (per-contig arrays would retrace
+# per contig length). One entry cached (LRU 1, ~3.1GB HBM for hg38).
+_DEVICE_GENOME_CACHE: dict = {}
+GENOME_BLOCK_BITS = 20
+_GBLOCK = 1 << GENOME_BLOCK_BITS
+
+
+_FLAT_MAX = (1 << 31) - 4 * _GBLOCK  # flat int32 layout headroom
+
+
+class DeviceGenome:
+    __slots__ = ("blocks", "offsets", "lengths", "flat")
+
+    def __init__(self, blocks, offsets: dict[str, int], lengths: dict[str, int],
+                 flat: bool):
+        # flat=True: ``blocks`` is a 1-D array (total length < 2^31) and
+        # windows gather with plain int32 indices — the fast path. Larger
+        # genomes (hg38 + gaps ~3.2e9 > int32) use the (block, offset)
+        # 2-D layout, which costs an extra coordinate per lookup.
+        self.blocks = blocks
+        self.offsets = offsets
+        self.lengths = lengths
+        self.flat = flat
+
+
+def device_genome(fasta: FastaReader, radius: int = WINDOW_RADIUS,
+                  sharding=None) -> DeviceGenome:
+    key = (getattr(fasta, "path", id(fasta)), radius, str(sharding))
+    hit = _DEVICE_GENOME_CACHE.get(key)
+    if hit is not None:
+        return hit
+    gap = np.full(2 * radius, 4, dtype=np.uint8)
+    parts = [gap]
+    offsets: dict[str, int] = {}
+    lengths: dict[str, int] = {}
+    cur = len(gap)
+    for contig in fasta.references:
+        seq = encode_seq(fasta.fetch(contig, 0, fasta.get_reference_length(contig)))
+        offsets[contig] = cur
+        lengths[contig] = len(seq)
+        parts.append(seq)
+        parts.append(gap)
+        cur += len(seq) + len(gap)
+    flat_arr = np.concatenate(parts)
+    use_flat = len(flat_arr) < _FLAT_MAX
+    if not use_flat:
+        pad = (-len(flat_arr)) % _GBLOCK
+        if pad:
+            flat_arr = np.concatenate([flat_arr, np.full(pad, 4, dtype=np.uint8)])
+        flat_arr = flat_arr.reshape(-1, _GBLOCK)
+    arr = jax.device_put(flat_arr, sharding) if sharding is not None else jax.device_put(flat_arr)
+    _DEVICE_GENOME_CACHE.clear()
+    _DEVICE_GENOME_CACHE[key] = out = DeviceGenome(arr, offsets, lengths, use_flat)
+    return out
+
+
+def globalize_positions(table: VariantTable, genome: DeviceGenome,
+                        radius: int = WINDOW_RADIUS) -> tuple[np.ndarray, np.ndarray]:
+    """(block int32, within-block offset int32) per record.
+
+    Unknown contigs and positions past the contig end (wrong reference
+    build / truncated FASTA) get an out-of-range block so their windows
+    read all-N — the host gather's safety behavior. Positions within
+    ``radius`` past the end still resolve idx-wise into the N gap, exactly
+    like the host path.
+    """
+    import pandas as pd
+
+    chrom = pd.Series(np.asarray(table.chrom))
+    off = chrom.map(genome.offsets).to_numpy(dtype=np.float64)  # NaN = unknown
+    clen = chrom.map(genome.lengths).to_numpy(dtype=np.float64)
+    pos0 = table.pos.astype(np.int64) - 1
+    gpos = pos0 + np.nan_to_num(off, nan=0).astype(np.int64)
+    bad = np.isnan(off) | (pos0 < 0) | (pos0 >= np.nan_to_num(clen, nan=-1) + radius)
+    if genome.flat:
+        gpos[bad] = int(genome.blocks.shape[0]) + _GBLOCK  # past the end
+        return np.zeros(len(gpos), dtype=np.int32), gpos.astype(np.int32)
+    n_blocks = int(genome.blocks.shape[0])
+    gpos[bad] = n_blocks * _GBLOCK + _GBLOCK  # one block past the end
+    return (gpos >> GENOME_BLOCK_BITS).astype(np.int32), \
+        (gpos & (_GBLOCK - 1)).astype(np.int32)
+
+
+def windows_on_device(genome_blocks, block, off, radius: int = WINDOW_RADIUS):
+    """(N, 2R+1) uint8 windows gathered on device; out-of-range reads N=4.
+
+    Traceable — used inside the fused featurize+score program so the window
+    tensor never exists host-side. All arithmetic is int32-safe: 1-D
+    genomes (< 2^31) gather flat; larger ones use the (block + carry,
+    offset within block) pair.
+    """
+    import jax.numpy as jnp
+
+    if genome_blocks.ndim == 1:  # flat fast path
+        idx = off[:, None] + jnp.arange(-radius, radius + 1)[None, :]
+        glen = genome_blocks.shape[0]
+        valid = (idx >= 0) & (idx < glen)
+        vals = genome_blocks[jnp.clip(idx, 0, glen - 1)]
+        return jnp.where(valid, vals, 4).astype(jnp.uint8)
+
+    t = off[:, None] + jnp.arange(-radius, radius + 1)[None, :]  # may be +-R out
+    blk = block[:, None] + (t >> GENOME_BLOCK_BITS)  # arithmetic shift: floor div
+    o2 = t & (_GBLOCK - 1)
+    n_blocks = genome_blocks.shape[0]
+    valid = (blk >= 0) & (blk < n_blocks)
+    vals = genome_blocks[jnp.clip(blk, 0, n_blocks - 1), o2]
+    return jnp.where(valid, vals, 4).astype(jnp.uint8)
+
+
 def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW_RADIUS) -> np.ndarray:
     """(N, 2*radius+1) uint8 reference windows centered on each variant anchor.
 
@@ -260,9 +377,12 @@ def host_featurize(
     fasta: FastaReader,
     annotate_intervals: dict[str, IntervalSet] | None = None,
     extra_info_fields: list[str] | None = None,
+    compute_windows: bool = True,
 ) -> HostFeatures:
+    """``compute_windows=False`` skips the host window gather — for the
+    device-resident-genome scoring path, where windows are gathered in HBM."""
     alle = classify_alleles(table)
-    windows = gather_windows(table, fasta)
+    windows = gather_windows(table, fasta) if compute_windows else None
 
     gts = table.genotypes()
     is_het = (gts[:, 0] != gts[:, 1]) & (gts[:, 1] >= 0)

@@ -17,7 +17,7 @@ import numpy as np
 import pandas as pd
 
 from variantcalling_tpu import logger
-from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
 
 # reference top_metrics_for_tbl.csv (key, metric-file)
@@ -38,7 +38,11 @@ TOP_METRICS = [
 
 
 def get_metric(metrics: pd.DataFrame, file_substr: str, param: str):
-    m = metrics[(metrics["File"].str.contains(file_substr, regex=False)) & (metrics["Parameter"] == param)]
+    file_match = metrics["File"].str.contains(file_substr, regex=False)
+    if file_substr == "wgs_metrics":
+        # substring would also match raw_wgs_metrics (row-order dependent)
+        file_match &= ~metrics["File"].str.contains("raw_wgs_metrics", regex=False)
+    m = metrics[file_match & (metrics["Parameter"] == param)]
     if not len(m):
         return np.nan
     try:
@@ -103,20 +107,14 @@ def run(argv) -> int:
     rep.add_section("Throughput")
     rep.add_table(tp)
     write_hdf(tp.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="throughput", mode="a")
-    try:
-        import matplotlib
-
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
-
+    def _attrition(plt):
         # read-attrition bars: Total -> PF -> Aligned per sample (cell 5)
         fig, ax = plt.subplots(figsize=(7, 3.5))
         tp.loc[["Total reads", "PF reads", "Aligned reads"], samples].T.plot.bar(ax=ax)
         ax.set_ylabel("# reads")
-        rep.add_figure(fig)
-        plt.close(fig)
-    except Exception as e:  # noqa: BLE001
-        logger.warning("throughput figure skipped: %s", e)
+        return fig
+
+    add_figure_safe(rep, _attrition, "throughput figure")
 
     cm = pd.DataFrame(
         {
@@ -134,39 +132,41 @@ def run(argv) -> int:
     write_hdf(cm.reset_index().rename(columns={"index": "metric"}), args.h5_output, key="coverage", mode="a")
 
     # coverage histogram + cumulative plot with median lines (cell 8)
-    try:
-        import matplotlib
-
-        matplotlib.use("Agg")
-        import matplotlib.pyplot as plt
-
+    def _coverage_fig(plt):
         hists = {}
-        for s, f in zip(samples, metrics_files):
+        for sample, f in zip(samples, metrics_files):
             try:
-                hists[s] = read_hdf(f, key="coverage_histograms")
+                hists[sample] = read_hdf(f, key="coverage_histograms")
             except KeyError:
                 pass
-        if hists:
-            fig, ax = plt.subplots(1, 2, figsize=(14, 4))
-            for s, h in hists.items():
-                num = h.select_dtypes(include=[np.number])
-                if num.shape[1] < 2:
-                    continue
-                cov, cnt = num.iloc[:, 0], num.iloc[:, 1]
-                ax[0].plot(cov, cnt, label=s)
-                ax[1].plot(cov, cnt.cumsum() / max(cnt.sum(), 1), label=s)
-                med = get_metric(per_sample[s], "wgs_metrics", "MEDIAN_COVERAGE")
-                if np.isfinite(med):
-                    ax[0].axvline(med, ls="--", alpha=0.5)
-            ax[0].set_xlabel("coverage")
-            ax[0].set_ylabel("# loci")
-            ax[0].legend()
-            ax[1].set_xlabel("coverage")
-            ax[1].set_ylabel("cumulative fraction")
-            rep.add_figure(fig)
-            plt.close(fig)
-    except Exception as e:  # noqa: BLE001
-        logger.warning("coverage figure skipped: %s", e)
+        if not hists:
+            return None
+        fig, ax = plt.subplots(1, 2, figsize=(14, 4))
+        for sample, h in hists.items():
+            # the frame concatenates every picard file's histogram section;
+            # plot only the wgs_metrics one (raw_wgs_metrics etc. would
+            # zigzag over the same axis)
+            if "File" in h.columns:
+                wgs = h[h["File"].astype(str).str.contains("wgs_metrics")
+                        & ~h["File"].astype(str).str.contains("raw_wgs_metrics")]
+                h = wgs if len(wgs) else h
+            num = h.select_dtypes(include=[np.number])
+            if num.shape[1] < 2:
+                continue
+            cov, cnt = num.iloc[:, 0], num.iloc[:, 1]
+            ax[0].plot(cov, cnt, label=sample)
+            ax[1].plot(cov, cnt.cumsum() / max(cnt.sum(), 1), label=sample)
+            med = get_metric(per_sample[sample], "wgs_metrics", "MEDIAN_COVERAGE")
+            if np.isfinite(med):
+                ax[0].axvline(med, ls="--", alpha=0.5)
+        ax[0].set_xlabel("coverage")
+        ax[0].set_ylabel("# loci")
+        ax[0].legend()
+        ax[1].set_xlabel("coverage")
+        ax[1].set_ylabel("cumulative fraction")
+        return fig
+
+    add_figure_safe(rep, _coverage_fig, "coverage figure")
 
     em = pd.DataFrame(
         {

@@ -25,7 +25,7 @@ import pandas as pd
 
 from variantcalling_tpu import logger
 from variantcalling_tpu.concordance.concordance_utils import calc_accuracy_metrics
-from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.utils.h5_utils import write_hdf
 
 ANNOTATION_PREFIXES = ("LCR", "exome", "mappability", "ug_hcr", "callable")
@@ -47,6 +47,12 @@ def parse_args(argv):
     return ap.parse_args(argv)
 
 
+def _indel_len(d: pd.DataFrame) -> pd.Series:
+    if "indel_length" not in d.columns:
+        return pd.Series(0.0, index=d.index)
+    return pd.to_numeric(d["indel_length"], errors="coerce").fillna(0)
+
+
 def _var_mask(d: pd.DataFrame, cat: str) -> pd.Series:
     indel = d["indel"].astype(bool)
     # the loader renames hmer_indel_length -> hmer_length; accept either
@@ -60,9 +66,9 @@ def _var_mask(d: pd.DataFrame, cat: str) -> pd.Series:
     if cat == "Indel":
         return indel
     if cat == "non-hmer":
-        return indel & (hmer == 0) & (pd.to_numeric(d.get("indel_length", 0)) > 1)
+        return indel & (hmer == 0) & (_indel_len(d) > 1)
     if cat == "hmer 0-1":
-        return indel & (hmer <= 1) & ~((hmer == 0) & (pd.to_numeric(d.get("indel_length", 0)) > 1))
+        return indel & (hmer <= 1) & ~((hmer == 0) & (_indel_len(d) > 1))
     if cat == "hmer 2-4":
         return indel & (hmer >= 2) & (hmer <= 4)
     if cat == "hmer 5-8":
@@ -112,10 +118,17 @@ def _perf(d: pd.DataFrame, classify_col: str, cvg: pd.Series) -> dict | None:
     fn_k = base_fn + cum_pos_dropped
     with np.errstate(invalid="ignore", divide="ignore"):
         f1_k = tp_k / (tp_k + 0.5 * fn_k + 0.5 * fp_k)
-    f1_opt = float(np.nanmax(f1_k)) if len(f1_k) else np.nan
+    # only cuts BETWEEN distinct scores are realizable thresholds — a cut
+    # inside a tie run would report an F1 no threshold achieves
+    s_sorted = score[callable_mask][order]
+    realizable = np.ones(len(f1_k), dtype=bool)
+    if len(s_sorted) > 1:
+        realizable[1:-1] = s_sorted[1:] != s_sorted[:-1]
+    f1_k = np.where(realizable, f1_k, np.nan)
+    f1_opt = float(np.nanmax(f1_k)) if len(f1_k) and np.isfinite(f1_k).any() else np.nan
 
     return {"# pos": n_pos, "# neg": n_neg,
-            "avg cvg": float(pd.to_numeric(cvg, errors="coerce").mean()) if cvg is not None else np.nan,
+            "avg cvg": float(np.nanmean(cvg)) if cvg is not None and len(cvg) else np.nan,
             "max recall": max_recall, "recall": recall, "precision": precision,
             "F1-stat": f1, "F1-opt": f1_opt}
 
@@ -130,34 +143,43 @@ def _bool_mask(vals: pd.Series) -> pd.Series:
 
 def build_detailed_vars(df: pd.DataFrame, regions: list[str], classify_col: str,
                         coverage_column: str) -> pd.DataFrame:
-    rows = []
-    cvg_all = pd.to_numeric(df.get(coverage_column), errors="coerce") \
-        if coverage_column in df.columns else None
+    """All strata cells from precomputed boolean masks.
 
-    def add(d1, region, category, var):
-        cvg = cvg_all.loc[d1.index] if cvg_all is not None else None
-        p = _perf(d1, classify_col, cvg)
+    Region/variant/bin masks are each computed ONCE on the full frame and
+    combined per cell; _perf sees only a 2-3 column core slice — on a
+    multi-million-row frame this avoids thousands of full-width DataFrame
+    copies.
+    """
+    rows = []
+    has_cvg = coverage_column in df.columns
+    core_cols = [classify_col, "filter"] + (["tree_score"] if "tree_score" in df.columns else [])
+    core = df[core_cols].reset_index(drop=True)
+    cvg_arr = pd.to_numeric(df[coverage_column], errors="coerce").to_numpy() if has_cvg else None
+    gc_arr = pd.to_numeric(df["gc_content"], errors="coerce").to_numpy() \
+        if "gc_content" in df.columns else None
+    var_masks = {v: _var_mask(df, v).to_numpy() for v in VAR_CATS}
+    region_masks = {"All": np.ones(len(df), dtype=bool)}
+    for region in regions:
+        if region.startswith("Non-"):
+            region_masks[region] = ~_bool_mask(df[region[4:]]).to_numpy()
+        else:
+            region_masks[region] = _bool_mask(df[region]).to_numpy()
+
+    def add(mask: np.ndarray, region: str, category: str, var: str):
+        p = _perf(core[mask], classify_col, cvg_arr[mask] if cvg_arr is not None else None)
         rows.append({"Region": region, "Category": category, "Variant": var, **p})
 
-    for region in ["All"] + regions:
-        if region == "All":
-            d1 = df
-        elif region.startswith("Non-"):
-            d1 = df[~_bool_mask(df[region[4:]])]
-        else:
-            d1 = df[_bool_mask(df[region])]
+    for region, rmask in region_masks.items():
         for var in VAR_CATS:
-            d2 = d1[_var_mask(d1, var)]
-            add(d2, region, "All", var)
-            if "gc_content" in df.columns:
-                gc = pd.to_numeric(d2["gc_content"], errors="coerce")
+            m = rmask & var_masks[var]
+            add(m, region, "All", var)
+            if gc_arr is not None:
                 for lo, hi in GC_BINS:
-                    add(d2[(gc >= lo) & (gc < hi)], region,
+                    add(m & (gc_arr >= lo) & (gc_arr < hi), region,
                         f"GC {lo * 100:.0f}-{min(hi, 1) * 100:.0f}", var)
-            if cvg_all is not None:
-                cv = cvg_all.loc[d2.index]
+            if cvg_arr is not None:
                 for lo, hi in CVG_BINS:
-                    add(d2[(cv >= lo) & (cv < hi)], region, f"CVG {lo}-{hi}", var)
+                    add(m & (cvg_arr >= lo) & (cvg_arr < hi), region, f"CVG {lo}-{hi}", var)
     return pd.DataFrame(rows)
 
 
@@ -234,16 +256,10 @@ def run(argv) -> int:
     rep.add_section("Summary performance — Genome")
     matrix_rows = ["All", "GC 0-20", "GC 20-80", "GC 80-100", "CVG 0-20",
                    "CVG 20-40", "CVG 40-100"] + regions
-    try:
-        for metric, title in (("F1-stat", "Genome — F1 (n,cvg)"),
-                              ("F1-opt", "Genome — re-optimized F1 (n,cvg)")):
-            fig = _matrix_figure(detailed, matrix_rows, metric, title)
-            rep.add_figure(fig)
-            import matplotlib.pyplot as plt
-
-            plt.close(fig)
-    except Exception as e:  # noqa: BLE001 — matrices are presentation only
-        logger.warning("performance matrix skipped: %s", e)
+    for metric, title in (("F1-stat", "Genome — F1 (n,cvg)"),
+                          ("F1-opt", "Genome — re-optimized F1 (n,cvg)")):
+        add_figure_safe(rep, lambda plt, m=metric, t=title: _matrix_figure(
+            detailed, matrix_rows, m, t), "performance matrix")
 
     exome_col = args.exome_column_name if args.exome_column_name in df.columns else None
     if exome_col:
@@ -253,17 +269,11 @@ def run(argv) -> int:
             [r for r in regions if not r.startswith(("Non-" + exome_col, exome_col))],
             classify_col, args.coverage_column)
         write_hdf(exome_detailed, args.h5_output, key="detailed_vars_exome", mode="a")
-        try:
-            for metric, title in (("max recall", "Exome — max recall (n,cvg)"),
-                                  ("F1-stat", "Exome — F1 (n,cvg)"),
-                                  ("F1-opt", "Exome — re-optimized F1 (n,cvg)")):
-                fig = _matrix_figure(exome_detailed, matrix_rows, metric, title)
-                rep.add_figure(fig)
-                import matplotlib.pyplot as plt
-
-                plt.close(fig)
-        except Exception as e:  # noqa: BLE001
-            logger.warning("exome matrix skipped: %s", e)
+        for metric, title in (("max recall", "Exome — max recall (n,cvg)"),
+                              ("F1-stat", "Exome — F1 (n,cvg)"),
+                              ("F1-opt", "Exome — re-optimized F1 (n,cvg)")):
+            add_figure_safe(rep, lambda plt, m=metric, t=title: _matrix_figure(
+                exome_detailed, matrix_rows, m, t), "exome matrix")
 
     # per-track inside/outside accuracy tables (kept from the basic flavor)
     for col in ann_cols:

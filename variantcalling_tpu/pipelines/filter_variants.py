@@ -171,17 +171,24 @@ def _predictor_for(model, feature_names: list[str]):
     return fn
 
 
-def _fused_program(model, feature_names: list[str], flow_order: str):
+def _fused_program(model, feature_names: list[str], flow_order: str,
+                   genome_resident: bool = False):
     """One jitted device program: windows + host columns -> TREE_SCORE.
 
     Fuses the window featurization kernels (gc/hmer/motif/cycle-skip) with
     forest inference so only the per-variant score crosses back to the host
     — on TPU the feature tensors never leave HBM. Host-computed columns
     arrive as one (N, K) matrix in ``host_names`` order.
-    """
-    from variantcalling_tpu.featurize import CENTER, DEVICE_FEATURES, device_feature_dict
 
-    key = ("fused", id(model), tuple(feature_names), flow_order)
+    ``genome_resident=True``: the first two arguments become the
+    HBM-resident global genome and per-variant global positions — windows
+    are gathered on device, so per-run transfer is 8 bytes a variant
+    instead of the 41-byte window row.
+    """
+    from variantcalling_tpu.featurize import (CENTER, DEVICE_FEATURES,
+                                              device_feature_dict, windows_on_device)
+
+    key = ("fused", id(model), tuple(feature_names), flow_order, genome_resident)
     hit = _PREDICTOR_CACHE.get(key)
     if hit is not None and hit[0] is model:
         return hit[1]
@@ -190,7 +197,7 @@ def _fused_program(model, feature_names: list[str], flow_order: str):
     host_names = [f for f in feature_names if f not in DEVICE_FEATURES]
     host_idx = {f: i for i, f in enumerate(host_names)}
 
-    def fn(windows, host_feats, is_indel, indel_nuc, ref_code, alt_code, is_snp):
+    def body(windows, host_feats, is_indel, indel_nuc, ref_code, alt_code, is_snp):
         dev = device_feature_dict(windows, is_indel, indel_nuc, ref_code, alt_code,
                                   is_snp, center=CENTER, flow_order=flow_order)
         cols = [
@@ -199,24 +206,50 @@ def _fused_program(model, feature_names: list[str], flow_order: str):
         ]
         return predictor(jnp.stack(cols, axis=1))
 
+    if genome_resident:
+        def fn(genome_blocks, block, off, host_feats, is_indel, indel_nuc,
+               ref_code, alt_code, is_snp):
+            return body(windows_on_device(genome_blocks, block, off), host_feats,
+                        is_indel, indel_nuc, ref_code, alt_code, is_snp)
+    else:
+        fn = body
+
     jitted = (jax.jit(fn), host_names)
     _cache_put(key, (model, jitted))
     return jitted
 
 
-def fused_featurize_score(model, hf, flow_order: str) -> np.ndarray:
-    """Chunked fused featurize+score over a HostFeatures batch; returns scores."""
-    fn, host_names = _fused_program(model, hf.names, flow_order)
+def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
+                          fasta: FastaReader | None = None) -> np.ndarray:
+    """Chunked fused featurize+score over a HostFeatures batch; returns scores.
+
+    With ``table``+``fasta`` and no precomputed host windows, the
+    device-resident-genome path runs: the encoded genome lives in HBM
+    (featurize.device_genome) and windows are gathered inside the fused
+    program from 8-byte global positions.
+    """
+    genome_resident = hf.windows is None and table is not None and fasta is not None
+    fn, host_names = _fused_program(model, hf.names, flow_order,
+                                    genome_resident=genome_resident)
     host_feats = np.stack(
         [np.asarray(hf.cols[f], dtype=np.float32) for f in host_names], axis=1
     )
 
-    from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
+    from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh, replicated
 
     n_dev = len(jax.devices())
     mesh = make_mesh(n_model=1) if n_dev > 1 else None
     shard2 = data_sharding(mesh, 2) if mesh is not None else None
     chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
+
+    genome = blk_all = off_all = None
+    if genome_resident:
+        from variantcalling_tpu.featurize import device_genome, globalize_positions
+
+        # replicate the genome across the mesh so chunk dispatches never
+        # reshard the multi-GB array
+        genome = device_genome(fasta, sharding=replicated(mesh) if mesh is not None else None)
+        blk_all, off_all = globalize_positions(table, genome)
 
     from variantcalling_tpu.featurize import _bucket
 
@@ -241,16 +274,23 @@ def fused_featurize_score(model, hf, flow_order: str) -> np.ndarray:
 
         # async dispatch overlaps chunk i+1's upload with chunk i's compute;
         # the bounded in-flight window keeps device residency at O(chunk)
-        # instead of the whole dataset
-        pending.append((lo, hi, fn(
-            prep(hf.windows, fill=4),
+        # (plus the resident genome) instead of the whole dataset
+        common = (
             prep(host_feats),
             prep(alle.is_indel),
             prep(alle.indel_nuc, fill=4),
             prep(alle.ref_code, fill=4),
             prep(alle.alt_code, fill=4),
             prep(alle.is_snp),
-        )))
+        )
+        if genome_resident:
+            # padding blocks sit past the genome end -> all-N windows
+            n_blocks = int(genome.blocks.shape[0])
+            pending.append((lo, hi, fn(genome.blocks,
+                                       prep(blk_all, fill=n_blocks + 1),
+                                       prep(off_all), *common)))
+        else:
+            pending.append((lo, hi, fn(prep(hf.windows, fill=4), *common)))
         while len(pending) > 2:
             plo, phi, score = pending.pop(0)
             out[plo:phi] = np.asarray(score)[: phi - plo]
@@ -307,15 +347,21 @@ def filter_variants(
 ) -> tuple[np.ndarray, np.ndarray]:
     """Core: returns (tree_score float array, new FILTER object array)."""
     extra_info = ["TLOD"] if is_mutect else []
+    # host windows are needed only by the cg-insertion check and the raw-
+    # sklearn fallback; the fused path gathers windows from the device-
+    # resident genome instead
+    needs_host_windows = blacklist_cg_insertions or not isinstance(
+        model, (FlatForest, ThresholdModel))
     hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
-                        extra_info_fields=extra_info)
+                        extra_info_fields=extra_info,
+                        compute_windows=needs_host_windows)
     if is_mutect and "TLOD" in hf.cols:
         hf.cols["tlod"] = hf.cols.pop("TLOD")
         hf.names[hf.names.index("TLOD")] = "tlod"
     if isinstance(model, (FlatForest, ThresholdModel)):
         # fused featurize+score: window features and the forest walk run as
         # one device program, only TREE_SCORE returns to the host
-        score = fused_featurize_score(model, hf, flow_order)
+        score = fused_featurize_score(model, hf, flow_order, table=table, fasta=fasta)
     else:  # raw sklearn estimator: materialize the matrix from the same hf
         from variantcalling_tpu.featurize import materialize_features
 

@@ -16,7 +16,7 @@ import numpy as np
 import pandas as pd
 
 from variantcalling_tpu import logger
-from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
 
 SECTION_TITLES = {
@@ -103,15 +103,8 @@ def run(argv) -> int:
         df = read_hdf(args.input_h5, key=key)
         title = SECTION_TITLES.get(key, key.replace("_", " "))
         rep.add_section(title)
-        try:
-            fig = _figure_for(key, df)
-            if fig is not None:
-                rep.add_figure(fig)
-                import matplotlib.pyplot as plt
-
-                plt.close(fig)
-        except Exception as e:  # noqa: BLE001 — a bad figure must not kill the report
-            logger.warning("figure for %s skipped: %s", key, e)
+        add_figure_safe(rep, lambda plt, k=key, d=df: _figure_for(k, d),
+                        f"figure for {key}")
         if key == "af_hist" and len(df) > 25:
             # compact: show non-empty bins only
             num = df.select_dtypes(include=[np.number])

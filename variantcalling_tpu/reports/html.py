@@ -64,3 +64,25 @@ class HtmlReport:
         with open(path, "w") as fh:
             fh.write(doc)
         return path
+
+
+def add_figure_safe(rep: HtmlReport, build, what: str = "figure") -> None:
+    """Build a matplotlib figure (Agg), embed it, close it; never raise.
+
+    ``build(plt)`` returns the figure (or None to skip). One home for the
+    backend selection + warn-on-failure pattern the report pipelines share.
+    """
+    from variantcalling_tpu import logger
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig = build(plt)
+        if fig is not None:
+            rep.add_figure(fig)
+            plt.close(fig)
+    except Exception as e:  # noqa: BLE001 — figures are presentation only
+        logger.warning("%s skipped: %s", what, e)
