@@ -37,12 +37,19 @@ TOP_METRICS = [
 ]
 
 
-def get_metric(metrics: pd.DataFrame, file_substr: str, param: str):
-    file_match = metrics["File"].str.contains(file_substr, regex=False)
+def file_mask(metrics: pd.DataFrame, file_substr: str) -> pd.Series:
+    """Rows of ``file_substr``'s metric file. 'wgs_metrics' also substring-
+    matches 'raw_wgs_metrics'; exclude the longer name when the shorter is
+    asked for (single home for the rule — get_metric and the coverage
+    figure both use it)."""
+    m = metrics["File"].str.contains(file_substr, regex=False)
     if file_substr == "wgs_metrics":
-        # substring would also match raw_wgs_metrics (row-order dependent)
-        file_match &= ~metrics["File"].str.contains("raw_wgs_metrics", regex=False)
-    m = metrics[file_match & (metrics["Parameter"] == param)]
+        m &= ~metrics["File"].str.contains("raw_wgs_metrics", regex=False)
+    return m
+
+
+def get_metric(metrics: pd.DataFrame, file_substr: str, param: str):
+    m = metrics[file_mask(metrics, file_substr) & (metrics["Parameter"] == param)]
     if not len(m):
         return np.nan
     try:
@@ -147,8 +154,7 @@ def run(argv) -> int:
             # plot only the wgs_metrics one (raw_wgs_metrics etc. would
             # zigzag over the same axis)
             if "File" in h.columns:
-                wgs = h[h["File"].astype(str).str.contains("wgs_metrics")
-                        & ~h["File"].astype(str).str.contains("raw_wgs_metrics")]
+                wgs = h[file_mask(h.astype({"File": str}), "wgs_metrics")]
                 h = wgs if len(wgs) else h
             num = h.select_dtypes(include=[np.number])
             if num.shape[1] < 2:

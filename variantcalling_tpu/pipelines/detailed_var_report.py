@@ -127,8 +127,9 @@ def _perf(d: pd.DataFrame, classify_col: str, cvg: pd.Series) -> dict | None:
     f1_k = np.where(realizable, f1_k, np.nan)
     f1_opt = float(np.nanmax(f1_k)) if len(f1_k) and np.isfinite(f1_k).any() else np.nan
 
+    has_cvg_vals = cvg is not None and len(cvg) and np.isfinite(cvg).any()
     return {"# pos": n_pos, "# neg": n_neg,
-            "avg cvg": float(np.nanmean(cvg)) if cvg is not None and len(cvg) else np.nan,
+            "avg cvg": float(np.nanmean(cvg)) if has_cvg_vals else np.nan,
             "max recall": max_recall, "recall": recall, "precision": precision,
             "F1-stat": f1, "F1-opt": f1_opt}
 

@@ -16,7 +16,7 @@ import pandas as pd
 
 from variantcalling_tpu import logger
 from variantcalling_tpu.io.vcf import read_vcf
-from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.reports.variant_eval import compute_eval_tables, dbsnp_membership
 from variantcalling_tpu.utils.h5_utils import write_hdf
 
@@ -46,6 +46,26 @@ def run(argv) -> int:
             rep.add_table(tables[name])
             write_hdf(tables[name], args.h5_output, key=name, mode=mode)
             mode = "a"
+    if "IndelLengthHistogram" in tables:
+        # notebook "Distribution of indel lengths" figure
+        def _indel_fig(plt, t=tables["IndelLengthHistogram"]):
+            import numpy as _np
+
+            num = t.select_dtypes(include=[_np.number])
+            if not len(num.columns):
+                return None
+            fig, ax = plt.subplots(figsize=(8, 3))
+            # label with the Length column (both columns are numeric, so
+            # dtype-based selection cannot find it)
+            x = t["Length"] if "Length" in t.columns else t.iloc[:, 0]
+            ax.bar(_np.arange(len(t)), num.iloc[:, -1])
+            ax.set_xticks(_np.arange(len(t)))
+            ax.set_xticklabels([str(v) for v in x], rotation=90, fontsize=7)
+            ax.set_xlabel("indel length")
+            ax.set_ylabel("# variants")
+            return fig
+
+        add_figure_safe(rep, _indel_fig, "indel length figure")
 
     # per-sample: call rate, het/hom ratio
     if table.n_samples:
@@ -67,6 +87,18 @@ def run(argv) -> int:
         per_sample = pd.DataFrame(rows)
         rep.add_section("Per-sample statistics")
         rep.add_table(per_sample)
+
+        def _per_sample_fig(plt):
+            fig, ax = plt.subplots(1, 2, figsize=(12, 3))
+            ax[0].bar(per_sample["sample"], per_sample["call_rate"])
+            ax[0].set_ylabel("call rate")
+            ax[0].tick_params(axis="x", rotation=90, labelsize=7)
+            ax[1].bar(per_sample["sample"], per_sample["het_hom_ratio"])
+            ax[1].set_ylabel("het/hom ratio")
+            ax[1].tick_params(axis="x", rotation=90, labelsize=7)
+            return fig
+
+        add_figure_safe(rep, _per_sample_fig, "per-sample figure")
         write_hdf(per_sample, args.h5_output, key="per_sample", mode=mode)
 
     if args.html_output:

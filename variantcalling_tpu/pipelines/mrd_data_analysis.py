@@ -16,7 +16,7 @@ import numpy as np
 import pandas as pd
 
 from variantcalling_tpu import logger
-from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
 
 
@@ -71,7 +71,18 @@ def run(argv) -> int:
     if args.featuremap:
         dist = qual_distributions(args.featuremap, args.signature_vcf)
         rep.add_section("ML_QUAL distribution (on vs off signature)")
-        rep.add_table(dist.pivot(index="ml_qual_bin", columns="population", values="n_reads"))
+        piv = dist.pivot(index="ml_qual_bin", columns="population", values="n_reads")
+        rep.add_table(piv)
+
+        def _qual_fig(plt):
+            fig, ax = plt.subplots(figsize=(7, 3))
+            piv.plot.bar(ax=ax)
+            ax.set_xlabel("ML_QUAL bin")
+            ax.set_ylabel("# reads")
+            ax.set_yscale("symlog")
+            return fig
+
+        add_figure_safe(rep, _qual_fig, "ML_QUAL figure")
         write_hdf(dist, args.h5_output, key="ml_qual_distribution", mode="a")
     rep.write(args.html_output)
     logger.info("MRD report -> %s", args.html_output)

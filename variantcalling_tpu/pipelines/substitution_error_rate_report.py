@@ -17,7 +17,7 @@ import numpy as np
 import pandas as pd
 
 from variantcalling_tpu import logger
-from variantcalling_tpu.reports.html import HtmlReport
+from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
 
 _COMP = {"A": "T", "C": "G", "G": "C", "T": "A"}
@@ -97,14 +97,77 @@ def run(argv) -> int:
     write_hdf(folded, args.h5_output, key="folded_motifs", mode="w")
     write_hdf(by_type, args.h5_output, key="by_mut_type", mode="a")
     rep = HtmlReport("Substitution Error Rate Report")
+
+    # average substitution error rate (notebook "Average substitution
+    # error rates" section): one overall number + per-strand split
+    if {"fwd_errors", "fwd_bases"}.issubset(folded.columns):
+        tot = pd.DataFrame({
+            "errors": [np.nansum(folded["fwd_errors"]) + np.nansum(folded["rev_errors"])],
+            "bases": [np.nansum(folded["fwd_bases"]) + np.nansum(folded["rev_bases"])],
+        })
+        tot["avg_error_rate"] = tot["errors"] / tot["bases"].clip(lower=1.0)
+        rep.add_section("Average substitution error rate")
+        rep.add_table(tot)
+        write_hdf(tot, args.h5_output, key="average_error_rate", mode="a")
+
     rep.add_section("Error rate by mutation type")
     rep.add_table(by_type)
+    if "error_rate" in by_type.columns:
+        add_figure_safe(rep, lambda plt: _by_type_figure(plt, by_type), "mut-type figure")
+
+    # detailed trinucleotide-context profile (96-channel bars by mut type)
+    if {"fwd_rate", "rev_rate"}.issubset(folded.columns) and len(folded):
+        rep.add_section("Error rate by trinucleotide context")
+        add_figure_safe(rep, lambda plt: _context_figure(plt, folded), "context figure")
+
+    # cycle-skip / strand asymmetry (notebook "Asymmetry" section)
+    if "asymmetry" in folded.columns:
+        asym = folded.dropna(subset=["asymmetry"]).sort_values("asymmetry", ascending=False)
+        rep.add_section("Strand asymmetry (top channels)")
+        rep.add_table(asym.head(20))
+        write_hdf(asym, args.h5_output, key="asymmetry", mode="a")
+        add_figure_safe(rep, lambda plt: _asymmetry_figure(plt, asym), "asymmetry figure")
+
     rep.add_section("Folded motif table (head)")
     rep.add_table(folded.head(50))
     if args.html_output:
         rep.write(args.html_output)
     logger.info("substitution error report: %d folded motifs -> %s", len(folded), args.h5_output)
     return 0
+
+
+_TYPE_COLORS = {"C>A": "#03bcee", "C>G": "#010101", "C>T": "#e32926",
+                "T>A": "#cac9c9", "T>C": "#a1ce63", "T>G": "#ebc6c4"}
+
+
+def _by_type_figure(plt, by_type: pd.DataFrame):
+    fig, ax = plt.subplots(figsize=(6, 3))
+    colors = [_TYPE_COLORS.get(t, "#888888") for t in by_type["mut_type"]]
+    ax.bar(by_type["mut_type"], by_type["error_rate"], color=colors)
+    ax.set_ylabel("error rate")
+    ax.set_yscale("log")
+    return fig
+
+
+def _context_figure(plt, folded: pd.DataFrame):
+    d = folded.sort_values(["mut_type", "left_motif", "right_motif"]).reset_index(drop=True)
+    rate = (np.nan_to_num(d["fwd_errors"]) + np.nan_to_num(d["rev_errors"])) / np.maximum(
+        np.nan_to_num(d["fwd_bases"]) + np.nan_to_num(d["rev_bases"]), 1.0)
+    fig, ax = plt.subplots(figsize=(14, 3))
+    ax.bar(np.arange(len(d)), rate,
+           color=[_TYPE_COLORS.get(t, "#888888") for t in d["mut_type"]], width=0.8)
+    ax.set_xlabel("trinucleotide channel (grouped by mutation type)")
+    ax.set_ylabel("error rate")
+    return fig
+
+
+def _asymmetry_figure(plt, asym: pd.DataFrame):
+    fig, ax = plt.subplots(figsize=(6, 3))
+    vals = np.log2(asym["asymmetry"].astype(float).clip(lower=1e-6))
+    ax.hist(vals, bins=30)
+    ax.set_xlabel("log2(fwd rate / rev rate)")
+    ax.set_ylabel("# channels")
+    return fig
 
 
 if __name__ == "__main__":
