@@ -100,12 +100,14 @@ def run(argv) -> int:
 
     # average substitution error rate (notebook "Average substitution
     # error rates" section): one overall number + per-strand split
-    if {"fwd_errors", "fwd_bases"}.issubset(folded.columns):
+    total_bases = np.nansum(folded.get("fwd_bases", np.nan)) + \
+        np.nansum(folded.get("rev_bases", np.nan))
+    if total_bases > 0:  # inputs without a base/coverage column: no rate
         tot = pd.DataFrame({
             "errors": [np.nansum(folded["fwd_errors"]) + np.nansum(folded["rev_errors"])],
-            "bases": [np.nansum(folded["fwd_bases"]) + np.nansum(folded["rev_bases"])],
+            "bases": [total_bases],
         })
-        tot["avg_error_rate"] = tot["errors"] / tot["bases"].clip(lower=1.0)
+        tot["avg_error_rate"] = tot["errors"] / tot["bases"]
         rep.add_section("Average substitution error rate")
         rep.add_table(tot)
         write_hdf(tot, args.h5_output, key="average_error_rate", mode="a")
@@ -122,7 +124,11 @@ def run(argv) -> int:
 
     # cycle-skip / strand asymmetry (notebook "Asymmetry" section)
     if "asymmetry" in folded.columns:
-        asym = folded.dropna(subset=["asymmetry"]).sort_values("asymmetry", ascending=False)
+        # most-asymmetric first in EITHER direction: |log2(fwd/rev)|
+        asym = folded.dropna(subset=["asymmetry"]).copy()
+        asym["abs_log2_asymmetry"] = np.abs(
+            np.log2(asym["asymmetry"].astype(float).clip(lower=1e-12)))
+        asym = asym.sort_values("abs_log2_asymmetry", ascending=False)
         rep.add_section("Strand asymmetry (top channels)")
         rep.add_table(asym.head(20))
         write_hdf(asym, args.h5_output, key="asymmetry", mode="a")
