@@ -103,6 +103,37 @@ class MatchResult:
 
 def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str,
                  haplotype_rescue: bool = True) -> MatchResult:
+    """Per-contig match. Dispatches to the native (C++) engine when built;
+    this Python implementation is the specification and the fallback
+    (native parity is locked by tests/unit/test_matcher_native.py)."""
+    native_res = _match_contig_native(calls, truth, ref_seq, haplotype_rescue)
+    if native_res is not None:
+        return native_res
+    return _match_contig_py(calls, truth, ref_seq, haplotype_rescue)
+
+
+def _match_contig_native(calls: SideVariants, truth: SideVariants, ref_seq: str,
+                         haplotype_rescue: bool) -> MatchResult | None:
+    from variantcalling_tpu import native
+
+    if not native.available():
+        return None
+    out = native.match_contig_native(
+        ref_seq,
+        # "" joined list = no alts; empty-string entries map to "." (both
+        # are symbolic to the spec) so [""] round-trips unambiguously
+        calls.pos, calls.ref, [",".join(x or "." for x in a) for a in calls.alts], calls.gt,
+        truth.pos, truth.ref, [",".join(x or "." for x in a) for a in truth.alts], truth.gt,
+        haplotype_rescue=haplotype_rescue,
+    )
+    if out is None:
+        return None
+    call_tp, call_tp_gt, truth_tp, truth_tp_gt, idx = out
+    return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, idx)
+
+
+def _match_contig_py(calls: SideVariants, truth: SideVariants, ref_seq: str,
+                     haplotype_rescue: bool = True) -> MatchResult:
     nc, nt = len(calls.pos), len(truth.pos)
     call_tp = np.zeros(nc, dtype=bool)
     call_tp_gt = np.zeros(nc, dtype=bool)

@@ -23,6 +23,7 @@ import numpy as np
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "src", "vctpu_native.cc")
 _SRC_CRAM = os.path.join(_DIR, "src", "vctpu_cram.cc")
+_SRC_MATCH = os.path.join(_DIR, "src", "vctpu_match.cc")
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
@@ -31,11 +32,12 @@ _i64 = ctypes.c_int64
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _i32p = ctypes.POINTER(ctypes.c_int32)
 _i64p = ctypes.POINTER(ctypes.c_int64)
+_i8p = ctypes.POINTER(ctypes.c_int8)
 
 
 def _build() -> str | None:
     hasher = hashlib.sha256()
-    for src in (_SRC, _SRC_CRAM):
+    for src in (_SRC, _SRC_CRAM, _SRC_MATCH):
         with open(src, "rb") as fh:
             hasher.update(fh.read())
     tag = hasher.hexdigest()[:12]
@@ -44,7 +46,7 @@ def _build() -> str | None:
         return out
     # per-process tmp name keeps os.replace atomic under concurrent builds
     tmp = f"{out}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, _SRC_CRAM, "-lz"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, _SRC_CRAM, _SRC_MATCH, "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, out)
@@ -99,6 +101,14 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_cram_header.argtypes = [_u8p, _i64, _u8p, _i64]
         lib.vctpu_cram_count.restype = _i64
         lib.vctpu_cram_count.argtypes = [_u8p, _i64]
+        lib.vctpu_match_contig.restype = _i64
+        lib.vctpu_match_contig.argtypes = [
+            _u8p, _i64,
+            _i64, _i64p, _u8p, _i64p, _u8p, _i64p, _i8p,
+            _i64, _i64p, _u8p, _i64p, _u8p, _i64p, _i8p,
+            ctypes.c_int32,
+            _u8p, _u8p, _u8p, _u8p, _i64p,
+        ]
         lib.vctpu_cram_pileup.restype = _i64
         lib.vctpu_cram_pileup.argtypes = [
             _u8p, _i64, ctypes.c_int32, _i64, _i64, _u8p, _i64, _i32p,
@@ -111,7 +121,6 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_vcf_count.argtypes = [_u8p, _i64, _i64p]
         _f32p = ctypes.POINTER(ctypes.c_float)
         _f64p = ctypes.POINTER(ctypes.c_double)
-        _i8p = ctypes.POINTER(ctypes.c_int8)
         lib.vctpu_vcf_parse.restype = _i64
         lib.vctpu_vcf_parse.argtypes = [
             _u8p, _i64, _i64, _i64, ctypes.c_int32,
@@ -417,6 +426,59 @@ def cram_pileup(buf, target_ref: int, start0: int, end0: int, ref_seq: str) -> n
     if n < 0:
         return None
     return counts
+
+
+
+
+def _pack(items):
+    """(uint8 blob, (n+1) int64 offsets) over concatenated strings."""
+    blob = "".join(items).encode("latin-1")
+    offs = np.zeros(len(items) + 1, dtype=np.int64)
+    np.cumsum(np.fromiter(map(len, items), dtype=np.int64, count=len(items)), out=offs[1:])
+    return np.frombuffer(blob or b"\x00", dtype=np.uint8), offs
+
+
+def match_contig_native(ref_seq: str, c_pos, c_ref, c_alt, c_gt,
+                        t_pos, t_ref, t_alt, t_gt, haplotype_rescue: bool = True):
+    """Native haplotype matcher; None -> Python fallback.
+
+    ``c_ref``/``t_ref`` are per-record REF strings, ``c_alt``/``t_alt`` the
+    comma-joined ALT strings; returns (call_tp, call_tp_gt, truth_tp,
+    truth_tp_gt, call_truth_idx) as the Python matcher does.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    nc, nt = len(c_pos), len(t_pos)
+    seq = np.frombuffer(ref_seq.encode("latin-1") or b"\x00", dtype=np.uint8)
+    crb, cro = _pack(list(c_ref))
+    cab, cao = _pack(list(c_alt))
+    trb, tro = _pack(list(t_ref))
+    tab, tao = _pack(list(t_alt))
+    cp = np.ascontiguousarray(c_pos, dtype=np.int64)
+    tp = np.ascontiguousarray(t_pos, dtype=np.int64)
+    cg = np.ascontiguousarray(c_gt, dtype=np.int8)
+    tg = np.ascontiguousarray(t_gt, dtype=np.int8)
+    call_tp = np.zeros(max(nc, 1), dtype=np.uint8)
+    call_tp_gt = np.zeros(max(nc, 1), dtype=np.uint8)
+    truth_tp = np.zeros(max(nt, 1), dtype=np.uint8)
+    truth_tp_gt = np.zeros(max(nt, 1), dtype=np.uint8)
+    idx = np.full(max(nc, 1), -1, dtype=np.int64)
+    rc = lib.vctpu_match_contig(
+        seq.ctypes.data_as(_u8p), len(ref_seq),
+        nc, cp.ctypes.data_as(_i64p), crb.ctypes.data_as(_u8p), cro.ctypes.data_as(_i64p),
+        cab.ctypes.data_as(_u8p), cao.ctypes.data_as(_i64p), cg.ctypes.data_as(_i8p),
+        nt, tp.ctypes.data_as(_i64p), trb.ctypes.data_as(_u8p), tro.ctypes.data_as(_i64p),
+        tab.ctypes.data_as(_u8p), tao.ctypes.data_as(_i64p), tg.ctypes.data_as(_i8p),
+        1 if haplotype_rescue else 0,
+        call_tp.ctypes.data_as(_u8p), call_tp_gt.ctypes.data_as(_u8p),
+        truth_tp.ctypes.data_as(_u8p), truth_tp_gt.ctypes.data_as(_u8p),
+        idx.ctypes.data_as(_i64p),
+    )
+    if rc != 0:
+        return None
+    return (call_tp[:nc].astype(bool), call_tp_gt[:nc].astype(bool),
+            truth_tp[:nt].astype(bool), truth_tp_gt[:nt].astype(bool), idx[:nc])
 
 
 def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:
