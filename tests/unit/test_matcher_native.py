@@ -101,13 +101,27 @@ def test_native_parity_fuzz(rng):
         _assert_parity(calls, truth, seq, rescue=bool(trial % 2))
 
 
-def test_native_used_by_default():
-    """match_contig dispatches to the native engine when built."""
+def test_native_used_by_default(monkeypatch):
+    """match_contig must route through the native engine when built."""
     from variantcalling_tpu.comparison import matcher
 
     ref = "GGCTAGCATCGATCGAACGTTAGC"
     side = make_side(np.array([17]), ["A"], [["G"]], np.array([[0, 1]], dtype=np.int8))
-    assert matcher._match_contig_native(side, side, ref, True) is not None
+    calls = {"native": 0, "py": 0}
+    real_native = matcher._match_contig_native
+
+    def spy_native(*a, **k):
+        calls["native"] += 1
+        return real_native(*a, **k)
+
+    def spy_py(*a, **k):  # pragma: no cover — must NOT run
+        calls["py"] += 1
+        raise AssertionError("python fallback ran despite native engine")
+
+    monkeypatch.setattr(matcher, "_match_contig_native", spy_native)
+    monkeypatch.setattr(matcher, "_match_contig_py", spy_py)
+    res = matcher.match_contig(side, side, ref)
+    assert res.call_tp.all() and calls["native"] == 1 and calls["py"] == 0
 
 
 def test_native_parity_symbolic_placeholder_alts():

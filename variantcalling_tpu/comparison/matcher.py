@@ -59,20 +59,28 @@ class SideVariants:
     ref: list[str]
     alts: list[list[str]]
     gt: np.ndarray  # (n, 2) int8, -1 = missing
-    norm_keys: list[frozenset]  # per-variant set of normalized (pos, ref, alt)
+    _norm_keys: list[frozenset] | None = None  # lazy: Python matcher only
+
+    @property
+    def norm_keys(self) -> list[frozenset]:
+        """Per-variant set of normalized (pos, ref, alt) — computed on first
+        use so the native matcher path never pays for the Python loop."""
+        if self._norm_keys is None:
+            keys = []
+            for i in range(len(self.pos)):
+                ks = []
+                for a in self.alts[i]:
+                    if a in (".", "", "*", "<NON_REF>") or a.startswith("<"):
+                        continue
+                    ks.append(normalize_variant(int(self.pos[i]), self.ref[i], a))
+                keys.append(frozenset(ks))
+            self._norm_keys = keys
+        return self._norm_keys
 
 
 def make_side(pos: np.ndarray, ref: list[str], alts: list[list[str]], gt: np.ndarray) -> SideVariants:
-    keys = []
-    for i in range(len(pos)):
-        ks = []
-        for a in alts[i]:
-            if a in (".", "", "*", "<NON_REF>") or a.startswith("<"):
-                continue
-            ks.append(normalize_variant(int(pos[i]), ref[i], a))
-        keys.append(frozenset(ks))
     return SideVariants(np.asarray(pos, dtype=np.int64), list(ref), [list(a) for a in alts],
-                        np.asarray(gt, dtype=np.int8), keys)
+                        np.asarray(gt, dtype=np.int8))
 
 
 def _called_allele_keys(side: SideVariants, i: int) -> frozenset:

@@ -352,8 +352,9 @@ static void match_contig(const std::string& ref_seq, std::vector<Variant>& calls
     }
 }
 
-// unpack one side from blob layout: ref/alt strings are '\n'-joined with
-// (n+1) byte offsets; alts comma-separated within a record
+// unpack one side from blob layout: ref/alt strings are plain-concatenated,
+// delimited by the (n+1) byte-offset array (native/__init__.py::_pack);
+// alts are comma-separated within a record, "" meaning no alts
 static void unpack(std::vector<Variant>& out, int64_t n, const int64_t* pos,
                    const uint8_t* ref_blob, const int64_t* ref_offs, const uint8_t* alt_blob,
                    const int64_t* alt_offs, const int8_t* gt) {

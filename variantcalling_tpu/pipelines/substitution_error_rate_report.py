@@ -124,15 +124,19 @@ def run(argv) -> int:
 
     # cycle-skip / strand asymmetry (notebook "Asymmetry" section)
     if "asymmetry" in folded.columns:
-        # most-asymmetric first in EITHER direction: |log2(fwd/rev)| —
-        # over channels with observed errors only (a 0/0 channel would
-        # saturate at the clip floor and outrank every real signal)
+        # most-asymmetric first in EITHER direction, ranked by EVIDENCE:
+        # |log2((fwd+0.5)/(rev+0.5))| — the pseudocount keeps zero-error
+        # and one-sided low-count channels from saturating the ranking
         asym = folded.dropna(subset=["asymmetry"]).copy()
         if {"fwd_errors", "rev_errors"}.issubset(asym.columns):
             asym = asym[(np.nan_to_num(asym["fwd_errors"]) > 0)
                         | (np.nan_to_num(asym["rev_errors"]) > 0)]
-        asym["abs_log2_asymmetry"] = np.abs(
-            np.log2(asym["asymmetry"].astype(float).clip(lower=1e-12)))
+            fwd = np.nan_to_num(asym["fwd_errors"]) + 0.5
+            rev = np.nan_to_num(asym["rev_errors"]) + 0.5
+            asym["abs_log2_asymmetry"] = np.abs(np.log2(fwd / rev))
+        else:
+            asym["abs_log2_asymmetry"] = np.abs(
+                np.log2(asym["asymmetry"].astype(float).clip(lower=1e-12)))
         asym = asym.sort_values("abs_log2_asymmetry", ascending=False)
         rep.add_section("Strand asymmetry (top channels)")
         rep.add_table(asym.head(20))
