@@ -129,8 +129,19 @@ def classify_alleles(table: VariantTable) -> AlleleColumns:
 # coordinates exceed int32 (the only integer width jax uses without x64),
 # so all device-side indexing stays in the (small block id, small offset)
 # pair. The fused program compiles ONCE (per-contig arrays would retrace
-# per contig length). One entry cached (LRU 1, ~3.1GB HBM for hg38).
+# per contig length). Two entries cached (the sharded + unsharded variants
+# of one genome; ~3.1GB HBM each for hg38).
 _DEVICE_GENOME_CACHE: dict = {}
+_DEVICE_GENOME_MAX = 2
+# tables below this size featurize through the host window gather — a tiny
+# job must not pay a whole-genome encode + HBM upload
+GENOME_RESIDENT_MIN_VARIANTS = 100_000
+
+
+def _genome_resident_worthwhile(table, fasta) -> bool:
+    path = getattr(fasta, "path", id(fasta))
+    already = any(k[0] == path for k in _DEVICE_GENOME_CACHE)
+    return already or len(table) >= GENOME_RESIDENT_MIN_VARIANTS
 GENOME_BLOCK_BITS = 20
 _GBLOCK = 1 << GENOME_BLOCK_BITS
 
@@ -179,7 +190,8 @@ def device_genome(fasta: FastaReader, radius: int = WINDOW_RADIUS,
             flat_arr = np.concatenate([flat_arr, np.full(pad, 4, dtype=np.uint8)])
         flat_arr = flat_arr.reshape(-1, _GBLOCK)
     arr = jax.device_put(flat_arr, sharding) if sharding is not None else jax.device_put(flat_arr)
-    _DEVICE_GENOME_CACHE.clear()
+    while len(_DEVICE_GENOME_CACHE) >= _DEVICE_GENOME_MAX:
+        _DEVICE_GENOME_CACHE.pop(next(iter(_DEVICE_GENOME_CACHE)))
     _DEVICE_GENOME_CACHE[key] = out = DeviceGenome(arr, offsets, lengths, use_flat)
     return out
 
@@ -432,34 +444,67 @@ def featurize(
 ) -> FeatureSet:
     """Full featurization: BASE_FEATURES + one 0/1 column per annotation interval.
 
-    Device kernels are jit-compiled once per padded batch shape.
+    Window features come from the device-resident genome (one HBM upload
+    per FASTA, on-device gather — run_comparison/train_models share the
+    filter pipeline's hot-path design); device kernels are jit-compiled
+    once per padded batch shape.
     """
+    resident = _genome_resident_worthwhile(table, fasta)
     hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
-                        extra_info_fields=extra_info_fields)
+                        extra_info_fields=extra_info_fields,
+                        compute_windows=not resident)
+    if resident:
+        return materialize_features(hf, flow_order=flow_order, table=table, fasta=fasta)
     return materialize_features(hf, flow_order=flow_order)
 
 
-def materialize_features(hf: HostFeatures, flow_order: str = fops.DEFAULT_FLOW_ORDER) -> FeatureSet:
-    """Run the device window kernels over a HostFeatures batch and merge."""
-    alle, windows = hf.alle, hf.windows
+@partial(jax.jit, static_argnames=("center", "flow_order"))
+def _device_feature_program_genome(genome_blocks, block, off, is_indel, indel_nuc,
+                                   ref_code, alt_code, is_snp, *, center: int,
+                                   flow_order: str):
+    """Standalone window-kernel program over the device-resident genome."""
+    windows = windows_on_device(genome_blocks, block, off, radius=center)
+    d = device_feature_dict(windows, is_indel, indel_nuc, ref_code, alt_code, is_snp,
+                            center=center, flow_order=flow_order)
+    return tuple(d[k] for k in DEVICE_FEATURES)
 
-    n = len(windows)
+
+def materialize_features(hf: HostFeatures, flow_order: str = fops.DEFAULT_FLOW_ORDER,
+                         table: VariantTable | None = None,
+                         fasta: FastaReader | None = None) -> FeatureSet:
+    """Run the device window kernels over a HostFeatures batch and merge.
+
+    With host windows absent and (table, fasta) given, windows are gathered
+    on device from the resident genome (no host window tensor at all).
+    """
+    alle, windows = hf.alle, hf.windows
+    genome_path = windows is None and table is not None and fasta is not None
+    n = len(table) if genome_path else len(windows)
     b = _bucket(n)
 
     def pad(a, fill=0):
         a = np.asarray(a)
         return np.pad(a, [(0, b - n)] + [(0, 0)] * (a.ndim - 1), constant_values=fill)
 
-    device_out = _device_feature_program(
-        pad(windows, fill=4),
+    alle_args = (
         pad(alle.is_indel),
         pad(alle.indel_nuc, fill=4),
         pad(alle.ref_code, fill=4),
         pad(alle.alt_code, fill=4),
         pad(alle.is_snp),
-        center=CENTER,
-        flow_order=flow_order,
     )
+    if genome_path:
+        genome = device_genome(fasta)
+        blk, off = globalize_positions(table, genome)
+        n_blocks = int(genome.blocks.shape[0])
+        device_out = _device_feature_program_genome(
+            genome.blocks, pad(blk, fill=n_blocks + 1), pad(off), *alle_args,
+            center=CENTER, flow_order=flow_order,
+        )
+    else:
+        device_out = _device_feature_program(
+            pad(windows, fill=4), *alle_args, center=CENTER, flow_order=flow_order,
+        )
     # one bulk fetch for all six outputs (each np.asarray would sync separately)
     fetched = jax.device_get(device_out)
     cols = dict(hf.cols)

@@ -349,9 +349,15 @@ def filter_variants(
     extra_info = ["TLOD"] if is_mutect else []
     # host windows are needed only by the cg-insertion check and the raw-
     # sklearn fallback; the fused path gathers windows from the device-
-    # resident genome instead
-    needs_host_windows = blacklist_cg_insertions or not isinstance(
-        model, (FlatForest, ThresholdModel))
+    # resident genome instead — unless the job is too small to justify the
+    # whole-genome HBM upload (featurize._genome_resident_worthwhile)
+    from variantcalling_tpu.featurize import _genome_resident_worthwhile
+
+    needs_host_windows = (
+        blacklist_cg_insertions
+        or not isinstance(model, (FlatForest, ThresholdModel))
+        or not _genome_resident_worthwhile(table, fasta)
+    )
     hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
                         extra_info_fields=extra_info,
                         compute_windows=needs_host_windows)

@@ -124,15 +124,18 @@ def run(argv) -> int:
 
     # cycle-skip / strand asymmetry (notebook "Asymmetry" section)
     if "asymmetry" in folded.columns:
-        # most-asymmetric first in EITHER direction, ranked by EVIDENCE:
-        # |log2((fwd+0.5)/(rev+0.5))| — the pseudocount keeps zero-error
-        # and one-sided low-count channels from saturating the ranking
+        # most-asymmetric first in EITHER direction, ranked by evidence-
+        # guarded RATES: |log2(((fwd_err+0.5)/fwd_bases)/((rev_err+0.5)/
+        # rev_bases))| — pseudocounts keep low-count channels from
+        # saturating while per-strand coverage stays normalized
         asym = folded.dropna(subset=["asymmetry"]).copy()
-        if {"fwd_errors", "rev_errors"}.issubset(asym.columns):
+        if {"fwd_errors", "rev_errors", "fwd_bases", "rev_bases"}.issubset(asym.columns):
             asym = asym[(np.nan_to_num(asym["fwd_errors"]) > 0)
                         | (np.nan_to_num(asym["rev_errors"]) > 0)]
-            fwd = np.nan_to_num(asym["fwd_errors"]) + 0.5
-            rev = np.nan_to_num(asym["rev_errors"]) + 0.5
+            fwd = (np.nan_to_num(asym["fwd_errors"]) + 0.5) / \
+                np.maximum(np.nan_to_num(asym["fwd_bases"]), 1.0)
+            rev = (np.nan_to_num(asym["rev_errors"]) + 0.5) / \
+                np.maximum(np.nan_to_num(asym["rev_bases"]), 1.0)
             asym["abs_log2_asymmetry"] = np.abs(np.log2(fwd / rev))
         else:
             asym["abs_log2_asymmetry"] = np.abs(
