@@ -138,10 +138,15 @@ _DEVICE_GENOME_MAX = 2
 GENOME_RESIDENT_MIN_VARIANTS = 100_000
 
 
-def _genome_resident_worthwhile(table, fasta) -> bool:
-    path = getattr(fasta, "path", id(fasta))
-    already = any(k[0] == path for k in _DEVICE_GENOME_CACHE)
-    return already or len(table) >= GENOME_RESIDENT_MIN_VARIANTS
+def _genome_resident_worthwhile(table, fasta, radius: int | None = None,
+                                sharding=None) -> bool:
+    """True when the EXACT genome entry the caller would use is already
+    resident, or the table is big enough to amortize the upload. Matching
+    on path alone would route small jobs onto a cache MISS (different
+    radius/sharding key) and re-upload the genome for 50 variants."""
+    key = (getattr(fasta, "path", id(fasta)),
+           WINDOW_RADIUS if radius is None else radius, str(sharding))
+    return key in _DEVICE_GENOME_CACHE or len(table) >= GENOME_RESIDENT_MIN_VARIANTS
 GENOME_BLOCK_BITS = 20
 _GBLOCK = 1 << GENOME_BLOCK_BITS
 

@@ -352,11 +352,14 @@ def filter_variants(
     # resident genome instead — unless the job is too small to justify the
     # whole-genome HBM upload (featurize._genome_resident_worthwhile)
     from variantcalling_tpu.featurize import _genome_resident_worthwhile
+    from variantcalling_tpu.parallel.mesh import make_mesh, replicated
 
+    n_dev = len(jax.devices())
+    genome_sharding = replicated(make_mesh(n_model=1)) if n_dev > 1 else None
     needs_host_windows = (
         blacklist_cg_insertions
         or not isinstance(model, (FlatForest, ThresholdModel))
-        or not _genome_resident_worthwhile(table, fasta)
+        or not _genome_resident_worthwhile(table, fasta, sharding=genome_sharding)
     )
     hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
                         extra_info_fields=extra_info,

@@ -130,12 +130,16 @@ def run(argv) -> int:
         # saturating while per-strand coverage stays normalized
         asym = folded.dropna(subset=["asymmetry"]).copy()
         if {"fwd_errors", "rev_errors", "fwd_bases", "rev_bases"}.issubset(asym.columns):
-            asym = asym[(np.nan_to_num(asym["fwd_errors"]) > 0)
-                        | (np.nan_to_num(asym["rev_errors"]) > 0)]
+            # rank only channels with errors AND coverage on both strands —
+            # a zero-coverage strand has no comparable rate
+            asym = asym[((np.nan_to_num(asym["fwd_errors"]) > 0)
+                         | (np.nan_to_num(asym["rev_errors"]) > 0))
+                        & (np.nan_to_num(asym["fwd_bases"]) > 0)
+                        & (np.nan_to_num(asym["rev_bases"]) > 0)]
             fwd = (np.nan_to_num(asym["fwd_errors"]) + 0.5) / \
-                np.maximum(np.nan_to_num(asym["fwd_bases"]), 1.0)
+                (np.nan_to_num(asym["fwd_bases"]) + 1.0)
             rev = (np.nan_to_num(asym["rev_errors"]) + 0.5) / \
-                np.maximum(np.nan_to_num(asym["rev_bases"]), 1.0)
+                (np.nan_to_num(asym["rev_bases"]) + 1.0)
             asym["abs_log2_asymmetry"] = np.abs(np.log2(fwd / rev))
         else:
             asym["abs_log2_asymmetry"] = np.abs(

@@ -34,11 +34,23 @@ def _encode_column(vals: np.ndarray):
         flat = np.concatenate(arrays) if arrays else np.array([], dtype=np.float64)
         return (flat, offsets), "ragged"
     if vals.dtype == object or vals.dtype.kind in ("U", "S"):
-        out = np.array(
-            [_NULL if v is None or (isinstance(v, float) and np.isnan(v)) else str(v) for v in vals],
-            dtype=object,
-        )
-        return out, "str"
+        ser = pd.Series(vals).where(pd.Series(vals).notna(), _NULL).astype(str)
+        lens = ser.str.len()
+        n = max(len(ser), 1)
+        max_len = int(lens.max()) if len(lens) else 1
+        total = int(lens.sum())
+        # choose the layout from LENGTHS before materializing anything wide:
+        # fixed-width bytes write as one block (h5py VLEN strings loop per
+        # element), but one outlier string must not blow up a (n, max_len)
+        # allocation — VLEN handles that case
+        if max_len <= 64 or max_len * n <= 4 * (total + n):
+            if ser.str.contains("\x00", regex=False).any():
+                # numpy 'S' silently strips trailing NULs; fail loudly like
+                # the VLEN path always did
+                raise ValueError("NUL bytes in string column are not storable")
+            u = np.asarray(ser, dtype="U")
+            return np.char.encode(u, "utf-8"), "fstr"
+        return ser.to_numpy(dtype=object), "str"
     if vals.dtype.kind == "b":
         return vals.astype(np.uint8), "bool"
     return vals, vals.dtype.kind
@@ -53,6 +65,9 @@ def _decode_column(ds, kind: str) -> np.ndarray:
             out[i] = flat[offsets[i] : offsets[i + 1]]
         return out
     data = ds[()]
+    if kind == "fstr":
+        out = np.char.decode(data, "utf-8").astype(object)
+        return np.where(out == _NULL, None, out)
     if kind == "str":
         out = np.array([v.decode() if isinstance(v, bytes) else str(v) for v in data], dtype=object)
         return np.where(out == _NULL, None, out)
