@@ -1,0 +1,48 @@
+"""Scratch: host-side stage breakdown of the e2e filter path (CPU jax)."""
+import os, sys, time, tempfile
+sys.path.insert(0, "/root/repo")
+import numpy as np
+
+import bench
+
+d = tempfile.mkdtemp(prefix="vctpu_e2e_")
+t0 = time.perf_counter()
+bench.make_fixtures(d)
+print("fixtures:", round(time.perf_counter() - t0, 2), flush=True)
+
+from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+from variantcalling_tpu.io.fasta import FastaReader
+from variantcalling_tpu.featurize import host_featurize, classify_alleles
+from variantcalling_tpu.synthetic import synthetic_forest
+from variantcalling_tpu.pipelines.filter_variants import filter_variants, fused_featurize_score
+
+t0 = time.perf_counter(); table = read_vcf(os.path.join(d, "calls.vcf")); print("ingest:", round(time.perf_counter() - t0, 2), flush=True)
+fasta = FastaReader(os.path.join(d, "ref.fa"))
+model = synthetic_forest(np.random.default_rng(0), n_trees=40, depth=6)
+
+t0 = time.perf_counter(); alle = classify_alleles(table); print("classify_alleles:", round(time.perf_counter() - t0, 3), flush=True)
+t0 = time.perf_counter()
+hf = host_featurize(table, fasta, compute_windows=False)
+print("host_featurize:", round(time.perf_counter() - t0, 3), flush=True)
+
+# host cols -> matrix stack cost
+import variantcalling_tpu.featurize as fz
+host_names = [f for f in hf.names if f not in fz.DEVICE_FEATURES]
+t0 = time.perf_counter()
+host_feats = np.stack([np.asarray(hf.cols[f], dtype=np.float32) for f in host_names], axis=1)
+print("host stack:", round(time.perf_counter() - t0, 3), "shape", host_feats.shape, flush=True)
+
+t0 = time.perf_counter()
+blk, off = fz.globalize_positions(table, fz.device_genome(fasta))
+print("genome+globalize:", round(time.perf_counter() - t0, 3), flush=True)
+
+# full featurize+score twice (compile then steady)
+t0 = time.perf_counter(); filter_variants(table, model, fasta); print("fvs compile:", round(time.perf_counter() - t0, 2), flush=True)
+t0 = time.perf_counter(); score, filters = filter_variants(table, model, fasta); print("fvs steady:", round(time.perf_counter() - t0, 2), flush=True)
+
+t0 = time.perf_counter()
+table.header.ensure_filter("LOW_SCORE", "x")
+table.header.ensure_info("TREE_SCORE", "1", "Float", "score")
+write_vcf(os.path.join(d, "out.vcf"), table, new_filters=filters,
+          extra_info={"TREE_SCORE": np.round(score, 4)}, verbatim_core=True)
+print("writeback:", round(time.perf_counter() - t0, 2), flush=True)

@@ -1,0 +1,43 @@
+"""Scratch: profile the GBT fit on the live chip. Not part of the package."""
+import sys, time, functools
+print = functools.partial(print, flush=True)
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+from variantcalling_tpu.models import boosting
+
+N, F = 500_000, 12
+rng = np.random.default_rng(0)
+x = rng.random((N, F)).astype(np.float32)
+y = (x[:, 0] + 0.4 * x[:, 1] + rng.normal(0, 0.25, N) > 0.7).astype(np.float32)
+cfg = boosting.BoostConfig(n_trees=40, depth=6, n_bins=64)
+
+print("backend:", jax.default_backend())
+
+# current fit
+boosting.fit(x, y, cfg=cfg)
+t0 = time.perf_counter(); boosting.fit(x, y, cfg=cfg); print("fit total:", round(time.perf_counter() - t0, 3))
+
+# isolate: host bin + transfer
+edges = boosting.quantile_bin_edges(x, cfg.n_bins)
+t0 = time.perf_counter()
+hb = np.empty(x.shape, dtype=np.uint8)
+for j in range(F):
+    hb[:, j] = np.searchsorted(edges[j], x[:, j])
+print("host bin:", round(time.perf_counter() - t0, 3))
+t0 = time.perf_counter()
+bd = jax.device_put(hb); bd.block_until_ready()
+print("transfer:", round(time.perf_counter() - t0, 3))
+
+# isolate: the jitted train program alone (device-resident inputs)
+train = boosting._jitted_train(cfg)
+yd = jnp.asarray(y); wd = jnp.ones(N, jnp.float32)
+binned = jnp.asarray(hb)
+out = train(binned, yd, wd); jax.block_until_ready(out)
+t0 = time.perf_counter()
+out = train(binned, yd, wd); jax.block_until_ready(out)
+print("train program:", round(time.perf_counter() - t0, 3))
+
+# quantile edges cost
+t0 = time.perf_counter(); boosting.quantile_bin_edges(x, cfg.n_bins); print("edges:", round(time.perf_counter() - t0, 3))

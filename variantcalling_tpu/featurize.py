@@ -228,6 +228,47 @@ def globalize_positions(table: VariantTable, genome: DeviceGenome,
         (gpos & (_GBLOCK - 1)).astype(np.int32)
 
 
+def pack_global_positions(block: np.ndarray, off: np.ndarray, genome: DeviceGenome) -> np.ndarray | None:
+    """Pack (block, offset) into ONE uint32 per record, or None if it can't fit.
+
+    Transfer-thinning for the fused scoring path: the per-variant position
+    pair (8 bytes) becomes 4 bytes on the wire. Fits whenever every
+    possible packed value — including the out-of-range sentinel and the
+    +1-block headroom the device-side unpack can produce — stays below
+    2^32 (hg38 + N gaps ≈ 3.2e9, comfortably in range).
+    """
+    if genome.flat:
+        # flat genomes are < 2^31 by construction (io gather is int32)
+        return off.astype(np.uint32)
+    n_blocks = int(genome.blocks.shape[0])
+    if (n_blocks + 3) << GENOME_BLOCK_BITS > (1 << 32):
+        return None
+    return ((block.astype(np.int64) << GENOME_BLOCK_BITS) | off.astype(np.int64)).astype(np.uint32)
+
+
+def packed_position_fill(genome: DeviceGenome) -> int:
+    """Padding value for packed positions: one block past the genome end."""
+    if genome.flat:
+        return int(genome.blocks.shape[0]) + _GBLOCK
+    return (int(genome.blocks.shape[0]) + 1) << GENOME_BLOCK_BITS
+
+
+def windows_from_packed(genome_blocks, gpos, radius: int = WINDOW_RADIUS):
+    """Windows gathered from uint32 packed positions (traceable).
+
+    Flat genomes treat the packed value as the flat index; blocked genomes
+    unpack the (block, offset) pair before the gather.
+    """
+    import jax.numpy as jnp
+
+    if genome_blocks.ndim == 1:
+        return windows_on_device(genome_blocks, None, gpos.astype(jnp.int32), radius)
+    g = gpos.astype(jnp.uint32)
+    blk = (g >> GENOME_BLOCK_BITS).astype(jnp.int32)
+    off = (g & jnp.uint32(_GBLOCK - 1)).astype(jnp.int32)
+    return windows_on_device(genome_blocks, blk, off, radius)
+
+
 def windows_on_device(genome_blocks, block, off, radius: int = WINDOW_RADIUS):
     """(N, 2R+1) uint8 windows gathered on device; out-of-range reads N=4.
 

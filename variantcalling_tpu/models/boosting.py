@@ -63,22 +63,37 @@ def bin_features(x: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(lambda col, e: jnp.searchsorted(e, col), in_axes=(1, 0), out_axes=1)(x, edges).astype(jnp.int32)
 
 
-def _grow_tree(binned, g, h, cfg: BoostConfig):
+#: Per-device byte cap for materializing the (N, F*B) bin one-hot once per
+#: fit (shared by every level of every tree). Above it, the one-hot is
+#: regenerated inside each level step instead — same results, more traffic.
+BOH_RESIDENT_MAX_BYTES = 4 << 30
+
+
+def _grow_tree(binned, boh, g, h, cfg: BoostConfig):
     """One complete depth-D tree. Returns (feat (D, L), bin (D, L), leaf (2^D,)).
 
     ``feat[l, k]`` / ``bin[l, k]`` describe the split of node k at level l
-    (feat == -1: dead node, routes everything left). L = 2^(D-1) padded to
-    2^D for static shapes.
+    (feat == -1: dead node, routes everything left); arrays are padded to
+    L = 2^D nodes for static shapes. ``boh`` is the fit-wide (N, F*B) bin
+    one-hot, or None to regenerate it per level (memory guard).
+
+    The level loop is UNROLLED (depth is a small static constant): at level
+    l only 2^l nodes exist, so the histogram matmul's lhs is (N, 2*2^l) —
+    the per-tree FLOP count is half what a constant 2*2^D-wide lhs costs,
+    and the dominant rhs read is amortized against one hoisted one-hot.
     """
     n, f = binned.shape
     b = cfg.n_bins
     max_nodes = 1 << cfg.depth  # leaves
     lam = cfg.reg_lambda
 
-    def level_step(level, carry):
-        node_id, feats, bins = carry
+    gh16 = jnp.stack([g, h], 1).astype(jnp.bfloat16)  # (N, 2)
+    node_id = jnp.zeros(n, dtype=jnp.int32)
+    feat_rows, bin_rows = [], []
+    for level in range(cfg.depth):
+        n_nodes = 1 << level
         # histograms over (node, feature, bin) as ONE MXU matmul:
-        # lhs (N, 2*nodes) carries g/h masked by node one-hot, rhs (N, F*B)
+        # lhs (N, 2*2^l) carries g/h masked by node one-hot, rhs (N, F*B)
         # is the per-feature bin one-hot — their contraction over N yields
         # both gradient and hessian histograms at systolic-array rate.
         # (segment_sum lowers to scatter-add, which serializes on TPU: the
@@ -86,15 +101,16 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
         # is where XLA inserts the cross-device psum (BASELINE config 3).
         # bf16 operands, f32 accumulation: one-hot entries are exact in
         # bf16; g/h lose ~3 decimal digits, far below split-gain contrasts
-        noh = jax.nn.one_hot(node_id, max_nodes, dtype=jnp.bfloat16)  # (N, nodes)
-        gh16 = jnp.stack([g, h], 1).astype(jnp.bfloat16)  # (N, 2)
-        lhs = (gh16[:, :, None] * noh[:, None, :]).reshape(n, 2 * max_nodes)
-        boh = jax.nn.one_hot(binned, b, dtype=jnp.bfloat16).reshape(n, f * b)
+        noh = jax.nn.one_hot(node_id, n_nodes, dtype=jnp.bfloat16)  # (N, 2^l)
+        lhs = (gh16[:, :, None] * noh[:, None, :]).reshape(n, 2 * n_nodes)
+        rhs = boh if boh is not None else \
+            jax.nn.one_hot(binned, b, dtype=jnp.bfloat16).reshape(n, f * b)
         hist2 = jax.lax.dot_general(
-            lhs, boh, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (2*nodes, F*B)
-        hist_g = hist2[:max_nodes].reshape(max_nodes, f, b)
-        hist_h = hist2[max_nodes:].reshape(max_nodes, f, b)
+            lhs, rhs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (2*2^l, F*B)
+        hist2 = hist2.reshape(n_nodes, 2, f, b)
+        hist_g = hist2[:, 0]
+        hist_h = hist2[:, 1]
 
         gl = jnp.cumsum(hist_g, axis=2)  # left sums for split at bin <= j
         hl = jnp.cumsum(hist_h, axis=2)
@@ -107,7 +123,7 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
         ok = (hl >= cfg.min_child_weight) & (hr >= cfg.min_child_weight)
         gain = jnp.where(ok, gain, -jnp.inf)
         gain = gain.at[:, :, -1].set(-jnp.inf)  # last bin = no split
-        flat = gain.reshape(max_nodes, f * b)
+        flat = gain.reshape(n_nodes, f * b)
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
         bf = (best // b).astype(jnp.int32)
@@ -115,20 +131,18 @@ def _grow_tree(binned, g, h, cfg: BoostConfig):
         dead = ~jnp.isfinite(best_gain) | (best_gain <= 0.0)
         bf = jnp.where(dead, -1, bf)
 
-        feats = feats.at[level].set(bf)
-        bins = bins.at[level].set(bb)
+        pad = (0, max_nodes - n_nodes)
+        feat_rows.append(jnp.pad(bf, pad, constant_values=-1))
+        bin_rows.append(jnp.pad(bb, pad))
 
         # route samples: right iff bin[best_feat] > best_bin (dead -> left)
         nf = jnp.maximum(bf[node_id], 0)  # (N,)
         sample_bin = jnp.take_along_axis(binned, nf[:, None], axis=1)[:, 0]
         go_right = (bf[node_id] >= 0) & (sample_bin > bb[node_id])
         node_id = node_id * 2 + go_right.astype(jnp.int32)
-        return node_id, feats, bins
 
-    node_id0 = jnp.zeros(n, dtype=jnp.int32)
-    feats0 = jnp.full((cfg.depth, max_nodes), -1, dtype=jnp.int32)
-    bins0 = jnp.zeros((cfg.depth, max_nodes), dtype=jnp.int32)
-    node_id, feats, bins = jax.lax.fori_loop(0, cfg.depth, level_step, (node_id0, feats0, bins0))
+    feats = jnp.stack(feat_rows)  # (depth, max_nodes)
+    bins = jnp.stack(bin_rows)
 
     leaf_oh = jax.nn.one_hot(node_id, max_nodes, dtype=jnp.float32)  # (N, leaves)
     leaf_g = leaf_oh.T @ g
@@ -168,13 +182,26 @@ def _make_train(cfg: BoostConfig):
 
     def train(binned, y01, w):
         max_nodes = 1 << cfg.depth
+        n, f = binned.shape
+        # the (N, F*B) bin one-hot is invariant across trees AND levels:
+        # materialize it once for the whole fit when it fits in HBM (the
+        # histogram matmuls re-read it 40*depth times either way, but
+        # regenerating it per level doubles the dominant HBM traffic).
+        # Per-device bytes under dp sharding = total / n_shards.
+        try:
+            n_shards = jax.device_count()
+        except Exception:  # noqa: BLE001
+            n_shards = 1
+        boh_bytes = 2 * n * f * cfg.n_bins // max(n_shards, 1)
+        boh = jax.nn.one_hot(binned, cfg.n_bins, dtype=jnp.bfloat16).reshape(n, f * cfg.n_bins) \
+            if boh_bytes <= BOH_RESIDENT_MAX_BYTES else None
 
         def tree_step(t, carry):
             margin, all_feats, all_bins, all_leaves = carry
             p = jax.nn.sigmoid(margin)
             g = w * (p - y01)
             h = jnp.maximum(w * p * (1.0 - p), 1e-12)
-            feats, bins, leaf, node_id = _grow_tree(binned, g, h, cfg)
+            feats, bins, leaf, node_id = _grow_tree(binned, boh, g, h, cfg)
             margin = margin + leaf[node_id]
             all_feats = jax.lax.dynamic_update_index_in_dim(all_feats, feats, t, 0)
             all_bins = jax.lax.dynamic_update_index_in_dim(all_bins, bins, t, 0)

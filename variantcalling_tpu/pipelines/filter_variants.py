@@ -177,16 +177,19 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
 
     Fuses the window featurization kernels (gc/hmer/motif/cycle-skip) with
     forest inference so only the per-variant score crosses back to the host
-    — on TPU the feature tensors never leave HBM. Host-computed columns
-    arrive as one (N, K) matrix in ``host_names`` order.
+    — on TPU the feature tensors never leave HBM. Host columns arrive as a
+    TUPLE of 1-D arrays in ``host_names`` order, each in whatever narrow
+    dtype the caller chose (uint8 for integral flag/code columns) — the
+    f32 feature matrix is assembled on device, so the wire carries 1 byte
+    instead of 4 for most columns (the tunnel is the e2e bottleneck).
 
     ``genome_resident=True``: the first two arguments become the
-    HBM-resident global genome and per-variant global positions — windows
-    are gathered on device, so per-run transfer is 8 bytes a variant
-    instead of the 41-byte window row.
+    HBM-resident global genome and the uint32 PACKED per-variant global
+    position — windows are gathered on device, so per-run transfer is
+    4 bytes a variant instead of the 41-byte window row.
     """
     from variantcalling_tpu.featurize import (CENTER, DEVICE_FEATURES,
-                                              device_feature_dict, windows_on_device)
+                                              device_feature_dict, windows_from_packed)
 
     key = ("fused", id(model), tuple(feature_names), flow_order, genome_resident)
     hit = _PREDICTOR_CACHE.get(key)
@@ -197,19 +200,24 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
     host_names = [f for f in feature_names if f not in DEVICE_FEATURES]
     host_idx = {f: i for i, f in enumerate(host_names)}
 
-    def body(windows, host_feats, is_indel, indel_nuc, ref_code, alt_code, is_snp):
-        dev = device_feature_dict(windows, is_indel, indel_nuc, ref_code, alt_code,
-                                  is_snp, center=CENTER, flow_order=flow_order)
+    def body(windows, host_cols, is_indel, indel_nuc, ref_code, alt_code, is_snp):
+        dev = device_feature_dict(windows, is_indel.astype(bool),
+                                  indel_nuc.astype(jnp.int32),
+                                  ref_code.astype(jnp.int32),
+                                  alt_code.astype(jnp.int32),
+                                  is_snp.astype(bool),
+                                  center=CENTER, flow_order=flow_order)
         cols = [
-            dev[f].astype(jnp.float32) if f in dev else host_feats[:, host_idx[f]]
+            dev[f].astype(jnp.float32) if f in dev
+            else host_cols[host_idx[f]].astype(jnp.float32)
             for f in feature_names
         ]
         return predictor(jnp.stack(cols, axis=1))
 
     if genome_resident:
-        def fn(genome_blocks, block, off, host_feats, is_indel, indel_nuc,
+        def fn(genome_blocks, gpos, host_cols, is_indel, indel_nuc,
                ref_code, alt_code, is_snp):
-            return body(windows_on_device(genome_blocks, block, off), host_feats,
+            return body(windows_from_packed(genome_blocks, gpos), host_cols,
                         is_indel, indel_nuc, ref_code, alt_code, is_snp)
     else:
         fn = body
@@ -217,6 +225,27 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
     jitted = (jax.jit(fn), host_names)
     _cache_put(key, (model, jitted))
     return jitted
+
+
+def _narrow_column(a: np.ndarray) -> np.ndarray:
+    """Cheapest exact wire dtype for a host feature column.
+
+    uint8 when every value is an exact small non-negative integer (flags,
+    base codes, interval membership, n_alts), else float32. Exactness is
+    checked, not assumed — scores must be bit-identical to the f32 path.
+    """
+    a = np.asarray(a)
+    if a.dtype == np.uint8 or a.dtype == np.bool_:
+        return a
+    small = a.astype(np.uint8, copy=True) if a.dtype.kind in "iu" else None
+    if small is None and a.dtype.kind == "f":
+        small = a.astype(np.uint8)
+        if not np.array_equal(small.astype(a.dtype), a):
+            return a.astype(np.float32, copy=False)
+        return small
+    if small is not None and np.array_equal(small.astype(a.dtype), a):
+        return small
+    return a.astype(np.float32, copy=False)
 
 
 def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
