@@ -215,3 +215,62 @@ def test_globalize_positions_int32_safe_at_hg38_scale():
     # unknown contig resolves past the genome end (all-N window)
     assert blk[3] >= genome.blocks.shape[0]
     assert (1 << GENOME_BLOCK_BITS) == _GBLOCK
+
+
+def test_fused_narrow_columns_bit_identical_to_f32_matrix(tmp_path):
+    """The fused path's narrow wire dtypes (uint8 host columns, packed
+    uint32 positions) must reproduce the stacked-f32-matrix scores exactly
+    — the _narrow_column contract is exactness, not approximation."""
+    import bench
+    from variantcalling_tpu.featurize import featurize, host_featurize
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.pipelines.filter_variants import (fused_featurize_score,
+                                                              score_variants)
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    d = str(tmp_path)
+    bench.make_fixtures(d, n=2000, genome_len=60_000)
+    table = read_vcf(f"{d}/calls.vcf")
+    fasta = FastaReader(f"{d}/ref.fa")
+    model = synthetic_forest(np.random.default_rng(1), n_trees=8, depth=5)
+
+    fs = featurize(table, fasta)
+    ref = score_variants(model, fs.matrix(), fs.feature_names)
+    fused = fused_featurize_score(model, host_featurize(table, fasta), "TGCA")
+    np.testing.assert_array_equal(fused, ref)
+
+
+def test_fused_threshold_model_matches_direct_predict(tmp_path):
+    """ThresholdModel must flow through the fused tuple-of-columns program
+    (it consumes the stacked matrix assembled on device) and match its
+    direct predict_score on the materialized f32 matrix."""
+    import bench
+    from variantcalling_tpu.featurize import featurize, host_featurize
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.models.threshold import ThresholdModel, predict_score
+    from variantcalling_tpu.pipelines.filter_variants import fused_featurize_score
+
+    d = str(tmp_path)
+    bench.make_fixtures(d, n=1500, genome_len=60_000)
+    table = read_vcf(f"{d}/calls.vcf")
+    fasta = FastaReader(f"{d}/ref.fa")
+
+    hf = host_featurize(table, fasta)
+    model = ThresholdModel(
+        feature_names=["qual", "gc_content"],
+        thresholds=np.asarray([40.0, 0.5], np.float32),
+        signs=np.asarray([1.0, -1.0], np.float32),
+        scales=np.asarray([10.0, 0.2], np.float32),
+        all_feature_names=list(hf.names),
+    )
+    fs = featurize(table, fasta)
+    ref = np.asarray(predict_score(model, fs.matrix(), fs.feature_names))
+    # host-window fused path
+    fused = fused_featurize_score(model, hf, "TGCA")
+    np.testing.assert_allclose(fused, ref, atol=1e-6)
+    # genome-resident fused path (packed uint32 positions)
+    hf_dev = host_featurize(table, fasta, compute_windows=False)
+    fused_dev = fused_featurize_score(model, hf_dev, "TGCA", table=table, fasta=fasta)
+    np.testing.assert_allclose(fused_dev, ref, atol=1e-6)

@@ -228,6 +228,17 @@ def globalize_positions(table: VariantTable, genome: DeviceGenome,
         (gpos & (_GBLOCK - 1)).astype(np.int32)
 
 
+def genome_packable(fasta: FastaReader, radius: int = WINDOW_RADIUS) -> bool:
+    """Whether the genome's positions will fit 4-byte packing — computable
+    from contig lengths alone, BEFORE paying the encode + HBM upload."""
+    gap = 2 * radius
+    total = gap + sum(fasta.get_reference_length(c) + gap for c in fasta.references)
+    if total < _FLAT_MAX:
+        return True
+    n_blocks = -(-total // _GBLOCK)
+    return (n_blocks + 3) << GENOME_BLOCK_BITS <= (1 << 32)
+
+
 def pack_global_positions(block: np.ndarray, off: np.ndarray, genome: DeviceGenome) -> np.ndarray | None:
     """Pack (block, offset) into ONE uint32 per record, or None if it can't fit.
 

@@ -108,9 +108,11 @@ def _grow_tree(binned, boh, g, h, cfg: BoostConfig):
         hist2 = jax.lax.dot_general(
             lhs, rhs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )  # (2*2^l, F*B)
-        hist2 = hist2.reshape(n_nodes, 2, f, b)
-        hist_g = hist2[:, 0]
-        hist_h = hist2[:, 1]
+        # lhs columns flatten as (gh, node) — index = gh * n_nodes + node —
+        # so the row axis unpacks gh-major
+        hist2 = hist2.reshape(2, n_nodes, f, b)
+        hist_g = hist2[0]
+        hist_h = hist2[1]
 
         gl = jnp.cumsum(hist_g, axis=2)  # left sums for split at bin <= j
         hl = jnp.cumsum(hist_h, axis=2)

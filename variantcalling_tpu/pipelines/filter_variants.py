@@ -255,15 +255,11 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
     With ``table``+``fasta`` and no precomputed host windows, the
     device-resident-genome path runs: the encoded genome lives in HBM
     (featurize.device_genome) and windows are gathered inside the fused
-    program from 8-byte global positions.
+    program from 4-byte PACKED uint32 global positions. Genomes whose
+    positions cannot pack into 4 bytes (> ~4 Gbp incl. N gaps) fall back
+    to the host window gather — checked from contig lengths before any
+    encode/upload is paid.
     """
-    genome_resident = hf.windows is None and table is not None and fasta is not None
-    fn, host_names = _fused_program(model, hf.names, flow_order,
-                                    genome_resident=genome_resident)
-    host_feats = np.stack(
-        [np.asarray(hf.cols[f], dtype=np.float32) for f in host_names], axis=1
-    )
-
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh, replicated
 
     n_dev = len(jax.devices())
@@ -271,19 +267,42 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
     shard2 = data_sharding(mesh, 2) if mesh is not None else None
     chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
 
-    genome = blk_all = off_all = None
+    windows = hf.windows
+    genome = gpos_all = None
+    gpos_fill = 0
+    genome_resident = windows is None and table is not None and fasta is not None
     if genome_resident:
-        from variantcalling_tpu.featurize import device_genome, globalize_positions
+        from variantcalling_tpu.featurize import (device_genome, gather_windows,
+                                                  genome_packable,
+                                                  globalize_positions,
+                                                  pack_global_positions,
+                                                  packed_position_fill)
 
-        # replicate the genome across the mesh so chunk dispatches never
-        # reshard the multi-GB array
-        genome = device_genome(fasta, sharding=replicated(mesh) if mesh is not None else None)
-        blk_all, off_all = globalize_positions(table, genome)
+        if not genome_packable(fasta):
+            # positions won't fit 4-byte packing (> ~4 Gbp incl. gaps):
+            # host window gather, without paying the genome upload
+            genome_resident = False
+            windows = gather_windows(table, fasta)
+        else:
+            # replicate the genome across the mesh so chunk dispatches never
+            # reshard the multi-GB array
+            genome = device_genome(fasta, sharding=replicated(mesh) if mesh is not None else None)
+            blk_all, off_all = globalize_positions(table, genome)
+            gpos_all = pack_global_positions(blk_all, off_all, genome)
+            if gpos_all is None:  # safety net: packable() and the packer disagree
+                genome_resident = False
+                windows = gather_windows(table, fasta)
+            else:
+                gpos_fill = packed_position_fill(genome)
+
+    fn, host_names = _fused_program(model, hf.names, flow_order,
+                                    genome_resident=genome_resident)
+    host_cols = tuple(_narrow_column(hf.cols[f]) for f in host_names)
 
     from variantcalling_tpu.featurize import _bucket
 
     alle = hf.alle
-    n = host_feats.shape[0]
+    n = len(table) if table is not None else len(windows)
     out = np.empty(n, dtype=np.float32)
     pending: list[tuple[int, int, object]] = []
     for lo in range(0, n, chunk_size):
@@ -305,7 +324,7 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
         # the bounded in-flight window keeps device residency at O(chunk)
         # (plus the resident genome) instead of the whole dataset
         common = (
-            prep(host_feats),
+            tuple(prep(c) for c in host_cols),
             prep(alle.is_indel),
             prep(alle.indel_nuc, fill=4),
             prep(alle.ref_code, fill=4),
@@ -313,13 +332,12 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
             prep(alle.is_snp),
         )
         if genome_resident:
-            # padding blocks sit past the genome end -> all-N windows
-            n_blocks = int(genome.blocks.shape[0])
+            # padding positions sit past the genome end -> all-N windows
             pending.append((lo, hi, fn(genome.blocks,
-                                       prep(blk_all, fill=n_blocks + 1),
-                                       prep(off_all), *common)))
+                                       prep(gpos_all, fill=gpos_fill),
+                                       *common)))
         else:
-            pending.append((lo, hi, fn(prep(hf.windows, fill=4), *common)))
+            pending.append((lo, hi, fn(prep(windows, fill=4), *common)))
         while len(pending) > 2:
             plo, phi, score = pending.pop(0)
             out[plo:phi] = np.asarray(score)[: phi - plo]
