@@ -2,26 +2,42 @@
 
 North-star metric (BASELINE.json): "variants/sec filtered" on the
 filter_variants_pipeline workload (docs/howto-callset-filter.md:59-149).
-Two numbers are produced:
+Phases, fastest first so SOMETHING always lands before any timeout:
 
-- ``value`` (headline): steady-state device throughput of the fused hot
-  path — window featurization (GC/hmer/motif) + forest inference, the same
-  jitted program the pipeline's device stage runs (GEMM/MXU forest encoding
-  on TPU, models/forest.predict_score_gemm). 3 tiles x 4M variants.
-- ``e2e``: wall-clock of the REAL pipeline end to end on a generated
-  HG002-like VCF — host ingest -> featurize+score -> VCF writeback — with
-  the per-stage split, so host IO cost is measured, not hidden (VERDICT
-  round-1 weak #1).
+- ``hot_small``: the fused hot path on a small tile — compiles in seconds,
+  gives a first device number almost immediately.
+- ``hot`` (headline ``value``): steady-state device throughput of the fused
+  hot path — window featurization (GC/hmer/motif) + forest inference, the
+  same jitted program the pipeline's device stage runs (GEMM/MXU forest
+  encoding on TPU, models/forest.predict_score_gemm). 3 tiles x 4M variants.
+- ``train``: histogram-GBT fit wallclock (BASELINE config 3).
+- ``coverage``: 1 kb-window binned means + depth histogram + percentiles
+  over a WGS-scale depth vector (BASELINE config 4).
+- ``sec``: cohort (sample, locus, allele) count aggregation (BASELINE
+  config 5; single-chip reduction here, psum'd on a mesh).
+- ``e2e``: the REAL pipeline end to end on a generated HG002-like VCF —
+  host ingest -> featurize+score -> VCF writeback — with the per-stage
+  split, so host IO cost is measured, not hidden.
 
 vs_baseline = device hot-path throughput / live sklearn predict_proba
 throughput on this host (the reference's execution engine for the same
 forest shape). Target: >= 50x.
 
-Robustness (round-1 BENCH was rc=1 on TPU init): all jax work runs in a
-CHILD process. The parent generates fixtures, launches the child against
-the default platform with a timeout, retries once, then falls back to a
-scrubbed-env CPU child (PYTHONPATH cleared so no PJRT plugin dials the TPU
-tunnel). The parent never imports jax and ALWAYS prints one JSON line.
+Robustness (round-1 BENCH was rc=1 on TPU init; round-2 timed out with no
+diagnosis): all jax work runs in a CHILD process that
+
+- flushes a ``BENCH_PHASE <name> ...`` line before/after every phase, so a
+  stall is attributable from captured output;
+- re-prints the cumulative ``BENCH_CHILD_JSON`` after EVERY phase — a
+  timeout kill still leaves the latest partial result in stdout;
+- gives each phase its own deadline from a wall-clock budget and skips
+  later phases when the budget is spent (skips are recorded).
+
+The parent generates fixtures, launches the child against the default
+platform with a timeout, retries once, then falls back to a scrubbed-env
+CPU child (PYTHONPATH cleared so no PJRT plugin dials the TPU tunnel). On
+timeout/crash it still parses the child's last partial JSON. The parent
+never imports jax and ALWAYS prints one JSON line.
 
 Timing inside the child is synchronized by a device-side reduction fetched
 as one scalar per tile: through the remote-dev tunnel, block_until_ready
@@ -42,12 +58,18 @@ import numpy as np
 
 TILE = 1 << 22  # 4M variants per device tile (HG002 WGS ~5M -> ~1.2 tiles)
 N_TILES = 3
+SMALL_TILE = 1 << 18
 N_TREES = 40
 DEPTH = 6
 E2E_N = 1_000_000  # variants in the end-to-end pipeline fixture
 E2E_GENOME = 10_000_000  # bp
 TRAIN_N = 500_000  # rows in the training-wallclock benchmark
 TRAIN_F = 12
+COV_LEN = 1 << 27  # ~134 Mbp depth vector (chr1-scale) for the coverage phase
+COV_WINDOW = 1000  # BASELINE config 4: 1 kb windows
+SEC_SAMPLES = 100  # BASELINE config 5: 100-sample cohort
+SEC_LOCI = 1 << 16
+SEC_ALLELES = 8
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -55,26 +77,23 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # child: all jax work
 # --------------------------------------------------------------------------
 
-def device_throughput() -> float:
+def device_throughput(tile: int, n_tiles: int) -> dict:
     import jax
 
     from variantcalling_tpu.synthetic import N_HOT_FEATURES, fused_hot_path, hot_path_args, synthetic_forest
 
-    # smaller tiles on the CPU fallback: that number is diagnostic only and
-    # must land well inside the subprocess timeout
-    tile = TILE if jax.default_backend() != "cpu" else TILE // 8
     rng = np.random.default_rng(0)
     forest = synthetic_forest(rng, n_trees=N_TREES, depth=DEPTH, n_features=N_HOT_FEATURES)
     hot = fused_hot_path(forest)
     step = jax.jit(lambda *a: hot(*a).sum())  # device-side checksum sync
-    tiles = [jax.device_put(hot_path_args(tile, seed=s)) for s in range(N_TILES)]
+    tiles = [jax.device_put(hot_path_args(tile, seed=s)) for s in range(n_tiles)]
     float(step(*tiles[0]))  # compile
     t0 = time.perf_counter()
     outs = [step(*args) for args in tiles]  # pipelined dispatch
     checksum = sum(float(o) for o in outs)  # scalar fetches force completion
     dt = time.perf_counter() - t0
     assert np.isfinite(checksum)
-    return tile * N_TILES / dt
+    return {"tile": tile, "n_tiles": n_tiles, "vps": round(tile * n_tiles / dt)}
 
 
 def e2e_pipeline(fixture_dir: str) -> dict:
@@ -90,10 +109,12 @@ def e2e_pipeline(fixture_dir: str) -> dict:
     t0 = time.perf_counter()
     table = read_vcf(vcf_in)
     t1 = time.perf_counter()
+    print("BENCH_PHASE e2e ingest done", flush=True)
     fasta = FastaReader(os.path.join(fixture_dir, "ref.fa"))
     model = synthetic_forest(np.random.default_rng(0), n_trees=N_TREES, depth=DEPTH)
     filter_variants(table, model, fasta)  # warm-up: jit compile happens here
     t1b = time.perf_counter()
+    print("BENCH_PHASE e2e warmup done", flush=True)
     score, filters = filter_variants(table, model, fasta)  # steady state
     t2 = time.perf_counter()
     out_path = os.path.join(fixture_dir, "out.vcf")
@@ -129,35 +150,140 @@ def train_wallclock() -> dict:
     Steady-state: the first fit pays jit compiles, the timed second fit is
     the per-model cost train_models_pipeline sees across its model grid.
     """
-    import time as _t
-
     from variantcalling_tpu.models import boosting
 
     x, y = train_fixture()
     cfg = boosting.BoostConfig(n_trees=N_TREES, depth=DEPTH, n_bins=64)
     boosting.fit(x, y, cfg=cfg)  # compile
-    t0 = _t.perf_counter()
+    t0 = time.perf_counter()
     forest = boosting.fit(x, y, cfg=cfg)
-    dt = _t.perf_counter() - t0
+    dt = time.perf_counter() - t0
     assert np.isfinite(float(forest.value.sum()))
     return {"n": TRAIN_N, "n_features": TRAIN_F, "n_trees": N_TREES,
             "wallclock_s": round(dt, 3)}
 
 
+def coverage_fixture() -> np.ndarray:
+    """One depth vector for BOTH the device phase and the numpy baseline."""
+    rng = np.random.default_rng(1)
+    # Poisson-ish 30x depth without the Poisson sampling cost at 134M
+    return np.clip(rng.normal(30, 8, size=COV_LEN), 0, 200).astype(np.int32)
+
+
+def coverage_reduce() -> dict:
+    """BASELINE config 4 on device: 1 kb binned means + depth histogram +
+    percentiles over a chr1-scale depth vector, as ONE jitted program —
+    the reference's `samtools depth | awk` + pyBigWig loops + awk re-bin
+    (coverage_analysis.py:653-683, 745-786, 798-856)."""
+    import jax
+    import jax.numpy as jnp
+
+    from variantcalling_tpu.ops import coverage as cov
+
+    depth = coverage_fixture()
+
+    @jax.jit
+    def step(d):
+        means = cov.binned_mean(d, COV_WINDOW)
+        hist = cov.depth_histogram(d)
+        pct = cov.percentiles_from_histogram(hist, jnp.asarray([0.05, 0.25, 0.5, 0.75, 0.95]))
+        # scalar checksum: one 4-byte fetch syncs the whole program
+        return means.sum() + hist.sum() + pct.sum()
+
+    d = jax.device_put(depth)
+    float(step(d))  # compile
+    t0 = time.perf_counter()
+    checksum = float(step(d))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    return {"bp": COV_LEN, "window": COV_WINDOW, "bp_per_sec": round(COV_LEN / dt)}
+
+
+def sec_fixture() -> np.ndarray:
+    rng = np.random.default_rng(2)
+    return rng.integers(0, 50, size=(SEC_SAMPLES, SEC_LOCI, SEC_ALLELES)).astype(np.float32)
+
+
+def sec_aggregate() -> dict:
+    """BASELINE config 5: cohort (sample, locus, allele) count aggregation.
+
+    Multi-device meshes run the psum'd shard_map (sec/aggregate.py); one
+    chip measures the same reduction jitted. Counts/sec = S*L*A / wall.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    counts = sec_fixture()
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from variantcalling_tpu.parallel.mesh import make_mesh
+        from variantcalling_tpu.sec.aggregate import aggregate_on_mesh
+
+        mesh = make_mesh(n_model=1)
+        aggregate_on_mesh(counts, mesh)  # compile
+        t0 = time.perf_counter()
+        out = aggregate_on_mesh(counts, mesh)
+        dt = time.perf_counter() - t0
+    else:
+        step = jax.jit(lambda x: jnp.sum(x, axis=0))
+        d = jax.device_put(counts)
+        np.asarray(step(d))  # compile
+        t0 = time.perf_counter()
+        out = np.asarray(step(d))
+        dt = time.perf_counter() - t0
+    assert np.isfinite(out.sum())
+    return {"samples": SEC_SAMPLES, "loci": SEC_LOCI, "alleles": SEC_ALLELES,
+            "counts_per_sec": round(counts.size / dt)}
+
+
 def child_main(fixture_dir: str) -> None:
+    t_start = time.time()
+    budget = float(os.environ.get("VCTPU_BENCH_CHILD_BUDGET", "420"))
+    result: dict = {}
+
+    def emit() -> None:
+        print("BENCH_CHILD_JSON " + json.dumps(result), flush=True)
+
+    def phase(name: str, fn, min_remaining: float = 30.0) -> None:
+        remaining = budget - (time.time() - t_start)
+        if remaining < min_remaining:
+            print(f"BENCH_PHASE {name} skipped (remaining {remaining:.0f}s "
+                  f"< {min_remaining:.0f}s)", flush=True)
+            result.setdefault("skipped", []).append(name)
+            emit()
+            return
+        print(f"BENCH_PHASE {name} start (remaining {remaining:.0f}s)", flush=True)
+        t0 = time.perf_counter()
+        try:
+            result[name] = fn()
+            print(f"BENCH_PHASE {name} done {time.perf_counter() - t0:.1f}s", flush=True)
+        except Exception as e:  # noqa: BLE001 — one phase must not kill the rest
+            result.setdefault("phase_errors", {})[name] = f"{type(e).__name__}: {e}"[:300]
+            print(f"BENCH_PHASE {name} FAILED after {time.perf_counter() - t0:.1f}s: "
+                  f"{e}", flush=True)
+        emit()
+
+    print("BENCH_PHASE init start", flush=True)
     import jax
 
     from variantcalling_tpu.synthetic import N_HOT_FEATURES
 
     dev = jax.devices()[0]
-    result = {
-        "device": f"{jax.default_backend()}:{getattr(dev, 'device_kind', '?')}",
-        "n_features": N_HOT_FEATURES,  # parent's sklearn baseline matches this width
-        "hot_vps": device_throughput(),
-        "e2e": e2e_pipeline(fixture_dir),
-        "train": train_wallclock(),
-    }
-    print("BENCH_CHILD_JSON " + json.dumps(result), flush=True)
+    result["device"] = f"{jax.default_backend()}:{getattr(dev, 'device_kind', '?')}"
+    result["n_features"] = N_HOT_FEATURES  # parent's sklearn baseline matches this width
+    print(f"BENCH_PHASE init done device={result['device']}", flush=True)
+    emit()
+
+    cpu = jax.default_backend() == "cpu"
+    # smaller full tiles on the CPU fallback: that number is diagnostic only
+    # and must land well inside the subprocess timeout
+    full_tile = TILE // 8 if cpu else TILE
+    phase("hot_small", lambda: device_throughput(SMALL_TILE, 2), min_remaining=20)
+    phase("hot", lambda: device_throughput(full_tile, N_TILES), min_remaining=45)
+    phase("train", train_wallclock, min_remaining=45)
+    phase("coverage", coverage_reduce, min_remaining=30)
+    phase("sec", sec_aggregate, min_remaining=25)
+    phase("e2e", lambda: e2e_pipeline(fixture_dir), min_remaining=100)
 
 
 # --------------------------------------------------------------------------
@@ -235,15 +361,39 @@ def cpu_baseline_throughput(n_features: int = 12) -> float:
 
 def cpu_train_baseline() -> float:
     """sklearn histogram-GBT fit wallclock on this host (same workload)."""
-    import time as _t
-
     from sklearn.ensemble import HistGradientBoostingClassifier
 
     x, y = train_fixture()
     clf = HistGradientBoostingClassifier(max_iter=N_TREES, max_depth=DEPTH, max_bins=64)
-    t0 = _t.perf_counter()
+    t0 = time.perf_counter()
     clf.fit(x, y.astype(int))
-    return _t.perf_counter() - t0
+    return time.perf_counter() - t0
+
+
+def cpu_coverage_baseline() -> float:
+    """Vectorized numpy host version of the coverage reductions — already
+    generous to the baseline (the reference's actual path is subprocess
+    text pipes). Returns bp/sec."""
+    depth = coverage_fixture()
+    t0 = time.perf_counter()
+    n_win = len(depth) // COV_WINDOW
+    means = depth[: n_win * COV_WINDOW].reshape(n_win, COV_WINDOW).mean(axis=1)
+    hist = np.bincount(np.clip(depth, 0, 1000), minlength=1001)
+    cdf = np.cumsum(hist) / hist.sum()
+    pct = np.searchsorted(cdf, [0.05, 0.25, 0.5, 0.75, 0.95])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(means.sum() + pct.sum())
+    return len(depth) / dt
+
+
+def cpu_sec_baseline() -> float:
+    """numpy cohort-sum on this host; counts/sec."""
+    counts = sec_fixture()
+    t0 = time.perf_counter()
+    out = counts.sum(axis=0)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(out.sum())
+    return counts.size / dt
 
 
 def _cpu_env() -> dict[str, str]:
@@ -253,20 +403,45 @@ def _cpu_env() -> dict[str, str]:
     return env
 
 
+def _parse_child_output(stdout: str) -> tuple[dict | None, str]:
+    """Latest partial JSON + the tail of the phase log (for stall diagnosis)."""
+    child = None
+    phases = []
+    for line in stdout.splitlines():
+        if line.startswith("BENCH_CHILD_JSON "):
+            try:
+                child = json.loads(line[len("BENCH_CHILD_JSON "):])
+            except json.JSONDecodeError:
+                pass
+        elif line.startswith("BENCH_PHASE "):
+            phases.append(line[len("BENCH_PHASE "):])
+    return child, "; ".join(phases[-6:])
+
+
 def _run_child(fixture_dir: str, env: dict[str, str], timeout: int) -> tuple[dict | None, str]:
     cmd = [sys.executable, os.path.abspath(__file__), "--child", fixture_dir]
+    env = dict(env)
+    env["VCTPU_BENCH_CHILD_BUDGET"] = str(max(timeout - 30, 45))
     try:
         proc = subprocess.run(
             cmd, env=env, cwd=_REPO, timeout=timeout, capture_output=True, text=True
         )
-    except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout}s"
-    if proc.returncode != 0:
-        return None, f"rc={proc.returncode}: {proc.stderr[-600:]}"
-    for line in reversed(proc.stdout.splitlines()):
-        if line.startswith("BENCH_CHILD_JSON "):
-            return json.loads(line[len("BENCH_CHILD_JSON "):]), ""
-    return None, f"no result line in child output: {proc.stdout[-300:]}"
+        stdout, failure = proc.stdout, (
+            "" if proc.returncode == 0 else f"rc={proc.returncode}: {proc.stderr[-600:]}"
+        )
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout.decode(errors="replace") if isinstance(e.stdout, bytes) else (e.stdout or "")
+        failure = f"timeout after {timeout}s"
+    child, phase_log = _parse_child_output(stdout)
+    if child is not None:
+        if failure:
+            child["incomplete"] = f"{failure} | phases: {phase_log}"
+        return child, ""
+    return None, f"{failure or 'no result line'} | phases: {phase_log or stdout[-300:]}"
+
+
+def _has_numbers(child: dict | None) -> bool:
+    return child is not None and ("hot" in child or "hot_small" in child)
 
 
 def main() -> None:
@@ -282,9 +457,15 @@ def main() -> None:
         label = ""
         for label, env, timeout in attempts:
             child, err = _run_child(d, env, timeout)
-            if child is not None:
+            if _has_numbers(child):
                 break
-            errors.append(f"{label}: {err}")
+            # keep the diagnosis even when the child got far enough to emit
+            # partial JSON (device line) but no throughput number
+            if err:
+                errors.append(f"{label}: {err}")
+            elif child is not None:
+                errors.append(f"{label}: {child.get('incomplete', 'no throughput phases ran')}")
+            child = None
 
     out = {
         "metric": "filter_hot_path_variants_per_sec",
@@ -297,20 +478,34 @@ def main() -> None:
     except Exception as e:  # sklearn failure must not kill the bench
         base, out["baseline_error"] = None, str(e)[:200]
     if child is not None:
-        out["value"] = round(child["hot_vps"])
-        out["device"] = child["device"]
+        hot = child.get("hot") or child.get("hot_small") or {}
+        out["value"] = hot.get("vps", 0)
+        out["device"] = child.get("device", "?")
         out["attempt"] = label
-        out["e2e"] = child["e2e"]
-        if "train" in child:
-            out["train"] = child["train"]
+        for k in ("hot_small", "hot", "e2e", "skipped", "phase_errors", "incomplete"):
+            if k in child:
+                out[k] = child[k]
+        def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:
+            """Wire a phase's CPU baseline + vs_baseline; failures only
+            annotate that phase."""
+            if key not in child:
+                return
+            out[key] = child[key]
             try:
-                base_train = cpu_train_baseline()
-                out["train"]["cpu_sklearn_fit_s"] = round(base_train, 3)
-                out["train"]["vs_baseline"] = round(base_train / max(child["train"]["wallclock_s"], 1e-9), 2)
+                base = baseline_fn()
+                out[key][base_key] = round(base, 3)
+                out[key]["vs_baseline"] = round(ratio(out[key], base), 2)
             except Exception as e:  # noqa: BLE001 — baseline failure must not kill the bench
-                out["train"]["baseline_error"] = str(e)[:200]
+                out[key]["baseline_error"] = str(e)[:200]
+
+        attach_baseline("train", cpu_train_baseline, "cpu_sklearn_fit_s",
+                        lambda ph, base: base / max(ph["wallclock_s"], 1e-9))
+        attach_baseline("coverage", cpu_coverage_baseline, "cpu_numpy_bp_per_sec",
+                        lambda ph, base: ph["bp_per_sec"] / base)
+        attach_baseline("sec", cpu_sec_baseline, "cpu_numpy_counts_per_sec",
+                        lambda ph, base: ph["counts_per_sec"] / base)
         if base:
-            out["vs_baseline"] = round(child["hot_vps"] / base, 2)
+            out["vs_baseline"] = round(out["value"] / base, 2)
             out["cpu_sklearn_vps"] = round(base)
     else:
         out["error"] = "; ".join(errors)[:800]

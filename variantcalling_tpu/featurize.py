@@ -447,9 +447,16 @@ def host_featurize(
     annotate_intervals: dict[str, IntervalSet] | None = None,
     extra_info_fields: list[str] | None = None,
     compute_windows: bool = True,
+    keep_nan: bool = False,
 ) -> HostFeatures:
     """``compute_windows=False`` skips the host window gather — for the
-    device-resident-genome scoring path, where windows are gathered in HBM."""
+    device-resident-genome scoring path, where windows are gathered in HBM.
+
+    ``keep_nan=True`` preserves NaN for absent QUAL/INFO/FORMAT values
+    instead of zero-filling — required when the scoring model carries
+    xgboost default_left routing, whose semantics are defined ON the
+    missing values (the reference feeds raw NaN into predict_proba).
+    """
     alle = classify_alleles(table)
     windows = gather_windows(table, fasta) if compute_windows else None
 
@@ -457,12 +464,15 @@ def host_featurize(
     is_het = (gts[:, 0] != gts[:, 1]) & (gts[:, 1] >= 0)
     gq = table.format_numeric("GQ", max_len=1, missing=np.nan)[:, 0]
 
+    def missing(a):
+        return a if keep_nan else np.nan_to_num(a, nan=0.0)
+
     cols: dict[str, np.ndarray] = {
-        "qual": np.nan_to_num(table.qual, nan=0.0),
-        "dp": np.nan_to_num(table.info_field("DP"), nan=0.0),
-        "sor": np.nan_to_num(table.info_field("SOR"), nan=0.0),
-        "af": np.nan_to_num(_compute_af(table), nan=0.0),
-        "gq": np.nan_to_num(gq, nan=0.0),
+        "qual": missing(table.qual),
+        "dp": missing(table.info_field("DP")),
+        "sor": missing(table.info_field("SOR")),
+        "af": missing(_compute_af(table)),
+        "gq": missing(gq),
         "is_het": is_het.astype(np.float32),
         "is_snp": alle.is_snp.astype(np.float32),
         "is_indel": alle.is_indel.astype(np.float32),
@@ -475,7 +485,7 @@ def host_featurize(
     names = list(BASE_FEATURES)
 
     for f in extra_info_fields or []:
-        cols[f] = np.nan_to_num(table.info_field(f), nan=0.0).astype(np.float32)
+        cols[f] = missing(table.info_field(f)).astype(np.float32)
         names.append(f)
 
     if annotate_intervals:

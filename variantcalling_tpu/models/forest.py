@@ -35,6 +35,12 @@ class FlatForest:
     base_score: float = 0.0  # added before sigmoid for logit_sum
     feature_names: list[str] = field(default_factory=list)
     pass_threshold: float = 0.5  # TREE_SCORE >= this -> PASS
+    # xgboost-style missing-value routing: NaN features take the node's
+    # default branch. None = no missing routing (NaN routes right, since
+    # all NaN comparisons are false) — sklearn/boosting models never see
+    # NaN (host columns are nan_to_num'd), so the hot path stays free of
+    # the extra gather.
+    default_left: np.ndarray | None = None  # bool (T, M) or None
 
     @property
     def n_trees(self) -> int:
@@ -59,6 +65,7 @@ def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     per-variant Python, no host sync.
     """
     feat, thr, left, right, value = forest.astuple()
+    dl = None if forest.default_left is None else jnp.asarray(forest.default_left)
     n = x.shape[0]
     t = feat.shape[0]
     tree_ids = jnp.arange(t)[None, :]  # (1, T)
@@ -67,7 +74,10 @@ def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
         f = feat[tree_ids, idx]  # (N, T)
         th = thr[tree_ids, idx]
         xv = jnp.take_along_axis(x, jnp.maximum(f, 0), axis=1)  # (N, T)
-        nxt = jnp.where(xv <= th, left[tree_ids, idx], right[tree_ids, idx])
+        go_left = xv <= th
+        if dl is not None:  # missing (NaN) takes the node's default branch
+            go_left = jnp.where(jnp.isnan(xv), dl[tree_ids, idx], go_left)
+        nxt = jnp.where(go_left, left[tree_ids, idx], right[tree_ids, idx])
         return jnp.where(f == LEAF, idx, nxt)
 
     idx0 = jnp.zeros((n, t), dtype=jnp.int32)
@@ -108,6 +118,9 @@ class GemmForest:
     value: np.ndarray  # f32 (T, L)
     aggregation: str
     base_score: float
+    # missing routing: None when the source forest has no default_left bits
+    # (no NaN machinery in the compiled program); else f32 (T, I) 0/1
+    dleft: np.ndarray | None = None
 
     @property
     def n_leaves(self) -> int:
@@ -145,10 +158,13 @@ def to_gemm(forest: FlatForest, n_features: int | None = None) -> GemmForest:
     c = np.zeros((t, max_l), dtype=np.float32)
     plen = np.full((t, max_l), -1.0, dtype=np.float32)  # -1: padded leaf never matches
     value = np.zeros((t, max_l), dtype=np.float32)
+    dleft = None if forest.default_left is None else np.zeros((t, max_i), dtype=np.float32)
     for ti, (internals, leaves, paths) in enumerate(per_tree):
         for k, node in enumerate(internals):
             a[ti, forest.feature[ti, node], k] = 1.0
             thr[ti, k] = forest.threshold[ti, node]
+            if dleft is not None:
+                dleft[ti, k] = float(forest.default_left[ti, node])
         for j, (node, path) in enumerate(zip(leaves, paths)):
             value[ti, j] = forest.value[ti, node]
             plen[ti, j] = len(path)
@@ -156,7 +172,8 @@ def to_gemm(forest: FlatForest, n_features: int | None = None) -> GemmForest:
                 m2[ti, k, j] = 1.0 if went_left else -1.0  # 2B-P: left=+1, right=-1
                 if not went_left:
                     c[ti, j] += 1.0
-    return GemmForest(a, thr, m2, c, plen, value, forest.aggregation, forest.base_score)
+    return GemmForest(a, thr, m2, c, plen, value, forest.aggregation, forest.base_score,
+                      dleft=dleft)
 
 
 # beyond this many leaves per tree the (N,I)@(I,L) routing matmul costs more
@@ -171,6 +188,7 @@ def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
     O(T * N * L): each step is two (N,·)@(·,·) matmuls that tile cleanly
     onto the systolic array.
     """
+    missing = gf.dleft is not None
     tables = (
         jnp.asarray(gf.a),
         jnp.asarray(gf.thr),
@@ -178,14 +196,23 @@ def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
         jnp.asarray(gf.c),
         jnp.asarray(gf.plen),
         jnp.asarray(gf.value),
-    )
+    ) + ((jnp.asarray(gf.dleft),) if missing else ())
+    if missing:
+        # NaN would poison every xf entry through the feature-pick matmul;
+        # pick from a scrubbed copy and matmul the NaN mask through the
+        # same selector to know, per node, whether its feature was missing
+        x_miss = jnp.isnan(x).astype(jnp.float32)
+        x = jnp.nan_to_num(x, nan=0.0)
 
     def per_tree(acc, tree):
-        a, thr, m2, c, plen, value = tree
+        a, thr, m2, c, plen, value = tree[:6]
         # one-hot feature pick must preserve f32 values exactly: default
         # matmul precision rounds operands to bf16
         xf = jnp.dot(x, a, precision=jax.lax.Precision.HIGHEST)  # (N,I)
         d = (xf <= thr[None, :]).astype(jnp.float32)
+        if missing:  # 0/1 mask matmul is exact even in bf16
+            mf = jnp.dot(x_miss, a)  # (N,I) 1 where the node's feature is NaN
+            d = jnp.where(mf > 0.5, tree[6][None, :], d)
         # routing matmul: operands are small exact integers — bf16-safe
         match = jnp.dot(d, m2) + c[None, :]  # (N,L)
         onehot = (match == plen[None, :]).astype(jnp.float32)

@@ -33,10 +33,20 @@ def save_models(path: str, models: dict[str, object]) -> None:
 
 
 def load_models(path: str) -> dict[str, object]:
+    if path.endswith(".json"):
+        # bare xgboost JSON model file (Booster.save_model output)
+        from variantcalling_tpu.models.xgb import from_xgboost_json
+
+        return {"model": from_xgboost_json(path)}
     with open(path, "rb") as fh:
         models = pickle.load(fh)
     if not isinstance(models, dict):
         models = {"model": models}
+    if isinstance(models.get("learner"), dict) and "gradient_booster" in models["learner"]:
+        # the pickle IS one parsed xgboost JSON model, not a name->model map
+        from variantcalling_tpu.models.xgb import from_xgboost_json
+
+        return {"model": from_xgboost_json(models)}
     return {k: _coerce(v) for k, v in models.items()}
 
 
@@ -50,6 +60,14 @@ def load_model(path: str, model_name: str) -> object:
 def _coerce(model: object) -> object:
     if isinstance(model, (FlatForest, ThresholdModel)):
         return model
+    from variantcalling_tpu.models.xgb import from_xgboost, from_xgboost_json, looks_like_xgboost
+
+    if looks_like_xgboost(model):
+        # XGBClassifier / Booster pickle — unpicklable only when xgboost is
+        # importable, in which case its own JSON dump is the exact source
+        return from_xgboost(model)
+    if isinstance(model, dict) and "learner" in model:
+        return from_xgboost_json(model)
     if hasattr(model, "tree_") or hasattr(model, "estimators_"):
         return from_sklearn(model)
     return model

@@ -408,9 +408,12 @@ def filter_variants(
         or not isinstance(model, (FlatForest, ThresholdModel))
         or not _genome_resident_worthwhile(table, fasta, sharding=genome_sharding)
     )
+    # xgboost models define missing-value semantics on NaN (default_left
+    # routing): zero-filling absent fields would walk the wrong branch
+    keep_nan = getattr(model, "default_left", None) is not None
     hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
                         extra_info_fields=extra_info,
-                        compute_windows=needs_host_windows)
+                        compute_windows=needs_host_windows, keep_nan=keep_nan)
     if is_mutect and "TLOD" in hf.cols:
         hf.cols["tlod"] = hf.cols.pop("TLOD")
         hf.names[hf.names.index("TLOD")] = "tlod"

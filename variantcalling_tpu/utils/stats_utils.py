@@ -1,9 +1,12 @@
 """Host-side statistics with the reference API surface (no sklearn/scipy deps).
 
-Parity target: ``ugvc/utils/stats_utils.py`` in the reference. The
-FN-mask-aware precision/recall curve reproduces the reference's
-sklearn-based semantics (``stats_utils.py:141-210``) with a native
-implementation; batched device versions live in
+Parity target: ``ugvc/utils/stats_utils.py`` in the reference — same
+function names, arguments, and numeric behavior (hand-computed expectations
+ported in tests/unit/test_stats_utils.py), independently implemented:
+the multinomial family runs in log space (the reference divides raw
+scipy.stats pmf values, which underflow at depth), and the FN-mask-aware
+precision/recall curve sits on a native cumulative-count curve instead of
+sklearn. Batched device versions live in
 :mod:`variantcalling_tpu.ops.stats`.
 """
 
@@ -21,43 +24,47 @@ from variantcalling_tpu.utils.math_utils import safe_divide
 
 
 def scale_contingency_table(table: list[int], n: int) -> list[int]:
-    """Scale a count table so the total is ~n (rounded). Parity: stats_utils.py:12-29."""
-    sum_table = sum(table)
-    if sum_table > 0:
-        scaled_table = np.array(table) * (n / sum_table)
-        return list(np.round(scaled_table).astype(int))
-    return table
+    """Rescale a count table so its total is ~n (rounded). Parity: stats_utils.py:12-29."""
+    total = int(np.sum(table))
+    if total <= 0:
+        return table
+    return np.rint(np.multiply(table, n / total)).astype(int).tolist()
 
 
 def correct_multinomial_frequencies(counts: list[int]) -> np.ndarray:
     """Add-one-corrected category frequencies. Parity: stats_utils.py:32-45."""
-    corrected_counts = np.array(counts) + 1
-    return corrected_counts / np.sum(corrected_counts)
+    c = np.asarray(counts, dtype=float) + 1.0
+    return c / c.sum()
 
 
-def _multinomial_log_pmf(x: np.ndarray, p: np.ndarray) -> float:
-    n = int(np.sum(x))
-    logp = math.lgamma(n + 1) - float(np.sum([math.lgamma(v + 1) for v in x]))
+def multinomial_log_likelihood(actual, expected) -> float:
+    """Log-likelihood of ``actual`` under the add-one-corrected multinomial
+    fit to ``expected`` — the stable primitive the likelihood/ratio pair
+    shares (the device twin is ops.stats.multinomial_log_pmf)."""
+    x = np.asarray(actual, dtype=float)
+    p = correct_multinomial_frequencies(expected)
+    coeff = math.lgamma(x.sum() + 1.0) - sum(math.lgamma(v + 1.0) for v in x)
     with np.errstate(divide="ignore"):
-        lp = np.where(x > 0, x * np.log(p), 0.0)
-    return logp + float(np.sum(lp))
+        terms = np.where(x > 0, x * np.log(p), 0.0)
+    return coeff + float(terms.sum())
 
 
 def multinomial_likelihood(actual: list[int], expected: list[int]) -> float:
-    """Likelihood of ``actual`` under the add-one-corrected multinomial fit to ``expected``.
-
-    Parity: stats_utils.py:48-63.
-    """
-    freq_expected = correct_multinomial_frequencies(expected)
-    return float(np.exp(_multinomial_log_pmf(np.asarray(actual, dtype=float), freq_expected)))
+    """Likelihood of ``actual`` under the add-one-corrected fit to
+    ``expected``. Parity: stats_utils.py:48-63."""
+    return float(np.exp(multinomial_log_likelihood(actual, expected)))
 
 
 def multinomial_likelihood_ratio(actual: list[int], expected: list[int]) -> tuple[float, float]:
-    """(likelihood, likelihood / max-likelihood-under-self-fit). Parity: stats_utils.py:66-70."""
-    likelihood = multinomial_likelihood(actual, expected)
-    max_likelihood = multinomial_likelihood(actual, actual)
-    likelihood_ratio = likelihood / max_likelihood
-    return likelihood, likelihood_ratio
+    """(likelihood, likelihood / max-likelihood-under-self-fit).
+
+    Parity: stats_utils.py:66-70, but the ratio is formed in log space —
+    at WGS depths both likelihoods underflow float64 and the reference's
+    raw division degrades to 0/0.
+    """
+    log_l = multinomial_log_likelihood(actual, expected)
+    log_max = multinomial_log_likelihood(actual, actual)
+    return float(np.exp(log_l)), float(np.exp(log_l - log_max))
 
 
 # ---------------------------------------------------------------------------
@@ -67,21 +74,19 @@ def multinomial_likelihood_ratio(actual: list[int], expected: list[int]) -> tupl
 
 def get_precision(false_positives: int, true_positives: int, return_if_denominator_is_0=1) -> float:
     """Precision from fp/tp counts. Parity: stats_utils.py:76-94."""
-    if false_positives + true_positives == 0:
-        return return_if_denominator_is_0
-    return 1 - false_positives / (false_positives + true_positives)
+    called = false_positives + true_positives
+    return true_positives / called if called else return_if_denominator_is_0
 
 
 def get_recall(false_negatives: int, true_positives: int, return_if_denominator_is_0=1) -> float:
     """Recall from fn/tp counts. Parity: stats_utils.py:97-116."""
-    if false_negatives + true_positives == 0:
-        return return_if_denominator_is_0
-    return 1 - false_negatives / (false_negatives + true_positives)
+    truth = false_negatives + true_positives
+    return true_positives / truth if truth else return_if_denominator_is_0
 
 
 def get_f1(precision: float, recall: float, null_value=np.nan) -> float:
     """Harmonic mean with null propagation. Parity: stats_utils.py:119-138."""
-    if null_value in {precision, recall}:
+    if {precision, recall} & {null_value}:
         return null_value
     return safe_divide(2 * precision * recall, precision + recall)
 
@@ -132,47 +137,45 @@ def precision_recall_curve(
 ) -> tuple:
     """FN-mask-aware precision/recall curve. Parity: stats_utils.py:141-210.
 
-    ``fn_mask`` marks variants that were false negatives (missed true calls,
-    present in ground truth but carrying no usable prediction); recall is
-    rescaled by ``tp/(tp+fn)`` so missed calls count against recall without
-    contributing curve points.
+    ``fn_mask`` marks variants that were false negatives (present in the
+    ground truth but carrying no usable prediction): they contribute no
+    curve points, but recall is shrunk by ``tp/(tp+fn)`` so every missed
+    call still counts against it. The noisy high-threshold tail — points
+    supported by fewer than ``min_class_counts_to_output`` predictions —
+    is dropped.
     """
-    gtr = np.asarray(gtr)
-    predictions = np.asarray(predictions)
-    fn_mask = np.asarray(fn_mask, dtype=bool)
-
-    if len(gtr) == 0:
+    labels = np.asarray(gtr)
+    scores = np.asarray(predictions)
+    missed = np.asarray(fn_mask, dtype=bool)
+    if labels.size == 0:
         return np.array([]), np.array([]), np.array([]), np.array([])
+    assert np.unique(labels.astype("U") if labels.dtype == object else labels).size <= 2, \
+        "variant labels must be binary"
+    assert missed.size == scores.size, "fn_mask must align with predictions"
 
-    assert len(set(gtr.tolist())) <= 2, "Only up to two classes of variant labels are possible"
-    assert len(fn_mask) == len(predictions), "FN mask should be of the length of predictions"
+    scored = ~missed
+    truth = labels[scored] == pos_label
+    kept_scores = scores[scored]
 
-    gtr_select = gtr[~fn_mask]
-    gtr_select = gtr_select == pos_label
-    predictions_select = predictions[~fn_mask]
-    original_fn_count = fn_mask.sum()
+    if truth.size:
+        prec_pts, rec_pts, thr_pts = _precision_recall_points(truth, kept_scores)
+    else:  # everything was missed: a degenerate two-point curve
+        prec_pts = np.array([0.0, 1.0])
+        rec_pts = np.array([1.0, 0.0])
+        thr_pts = np.array([kept_scores.min() if kept_scores.size else 0])
 
-    if len(gtr_select) > 0:
-        raw_precision, raw_recall, thresholds = _precision_recall_points(gtr_select, predictions_select)
+    # interior points only: strip the synthetic (1, 0) endpoint and the
+    # lowest-threshold point, then re-base recall onto the full truth set
+    n_tp = truth.sum()
+    shrink = safe_divide(n_tp, n_tp + int(missed.sum()))
+    prec = prec_pts[1:-1]
+    rec = rec_pts[1:-1] * shrink
+    thr = thr_pts[1:]
+    f1 = 2 * prec * rec / (prec + rec + np.finfo(float).eps)
+
+    if kept_scores.size:
+        cutoff = np.sort(kept_scores)[max(0, kept_scores.size - min_class_counts_to_output)]
     else:
-        raw_precision = np.array([0.0, 1.0])
-        raw_recall = np.array([1.0, 0.0])
-        thresholds = np.array([0]) if len(predictions_select) == 0 else np.array([np.min(predictions_select)])
-
-    recall_correction = safe_divide(gtr_select.sum(), gtr_select.sum() + original_fn_count)
-    recalls = raw_recall * recall_correction
-    # strip the synthetic (1, 0) endpoint and the initial curve point
-    recalls = recalls[1:-1]
-    precisions = raw_precision[1:-1]
-    thresholds = thresholds[1:]
-    f1_score = 2 * (recalls * precisions) / (recalls + precisions + np.finfo(float).eps)
-
-    # drop the noisy low-count tail of the curve
-    predictions_select = np.sort(predictions_select)
-    if len(predictions_select) > 0:
-        threshold_cutoff = predictions_select[max(0, len(predictions_select) - min_class_counts_to_output)]
-    else:
-        threshold_cutoff = 0
-
-    mask = thresholds > threshold_cutoff
-    return precisions[~mask], recalls[~mask], f1_score[~mask], thresholds[~mask]
+        cutoff = 0
+    keep = thr <= cutoff
+    return prec[keep], rec[keep], f1[keep], thr[keep]
