@@ -418,6 +418,28 @@ def _parse_child_output(stdout: str) -> tuple[dict | None, str]:
     return child, "; ".join(phases[-6:])
 
 
+def _tpu_probe(timeout: int = 120) -> str | None:
+    """Cheap pre-flight: does the default platform initialize at all?
+
+    The expensive failure mode (seen in rounds 1-3) is the axon claim leg
+    hanging at interpreter start — the child then produces ZERO output and
+    burns the whole attempt budget. A 120s probe child attributes that
+    state up front so main() can skip straight to the CPU fallback with a
+    real diagnosis instead of two silent timeouts.
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', d[0].platform, getattr(d[0], 'device_kind', '?'), flush=True)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=dict(os.environ),
+                              cwd=_REPO, timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"device probe produced no devices in {timeout}s — PJRT/tunnel "
+                "init hang (axon claim leg stuck before any bench code)")
+    if proc.returncode != 0 or "PROBE_OK" not in proc.stdout:
+        return f"device probe rc={proc.returncode}: {(proc.stderr or proc.stdout)[-300:]}"
+    return None
+
+
 def _run_child(fixture_dir: str, env: dict[str, str], timeout: int) -> tuple[dict | None, str]:
     cmd = [sys.executable, os.path.abspath(__file__), "--child", fixture_dir]
     env = dict(env)
@@ -437,6 +459,9 @@ def _run_child(fixture_dir: str, env: dict[str, str], timeout: int) -> tuple[dic
         if failure:
             child["incomplete"] = f"{failure} | phases: {phase_log}"
         return child, ""
+    if not phase_log and not stdout.strip():
+        return None, (f"{failure or 'no result line'} | child produced NO output "
+                      "(interpreter/PJRT init hang before bench code)")
     return None, f"{failure or 'no result line'} | phases: {phase_log or stdout[-300:]}"
 
 
@@ -454,6 +479,14 @@ def main() -> None:
             ("cpu-fallback", _cpu_env(), budget),
         ]
         child, errors = None, []
+        # probe unless the default env is explicitly CPU — a TPU can arrive
+        # either via JAX_PLATFORMS or via a PYTHONPATH sitecustomize PJRT
+        # plugin, and the probe is what catches the plugin-init hang
+        if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+            probe_err = _tpu_probe()
+            if probe_err:
+                errors.append(f"probe: {probe_err}")
+                attempts = [("cpu-fallback", _cpu_env(), budget)]
         label = ""
         for label, env, timeout in attempts:
             child, err = _run_child(d, env, timeout)
@@ -509,6 +542,9 @@ def main() -> None:
             out["cpu_sklearn_vps"] = round(base)
     else:
         out["error"] = "; ".join(errors)[:800]
+    if errors and "error" not in out:
+        # fallback succeeded but earlier attempts failed: keep the diagnosis
+        out["attempt_errors"] = "; ".join(errors)[:800]
     print(json.dumps(out))
 
 

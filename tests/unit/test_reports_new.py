@@ -282,3 +282,155 @@ def test_detailed_var_report(tmp_path, rng):
     html_text = open(html).read()
     assert "data:image/png;base64" in html_text  # performance matrices
     assert any("LCR" in k for k in keys)
+
+
+def _mrd_world(tmp_path):
+    """Featuremap + signature VCFs for the full MRD report sections."""
+    from tests import fixtures
+
+    contigs = {"chr1": 100000}
+    # signature: 20 loci with AF
+    sig_lines = []
+    fm_lines = []
+    rng = np.random.default_rng(5)
+    for i in range(20):
+        pos = 1000 + i * 500
+        sig_lines.append(f"chr1\t{pos}\t.\tC\tT\t50\tPASS\tAF={0.1 + 0.02*i:.2f}")
+        # 3 supporting reads per even locus, quality alternating
+        if i % 2 == 0:
+            for j in range(3):
+                q = 55 if j < 2 else 10
+                fm_lines.append(
+                    f"chr1\t{pos}\t.\tC\tT\t50\tPASS\tML_QUAL={q};X_LENGTH={120 + 10*j}")
+    # background (off-signature) reads
+    for i in range(30):
+        pos = 50000 + i * 100
+        fm_lines.append(f"chr1\t{pos}\t.\tG\tA\t50\tPASS\tML_QUAL={int(rng.integers(0, 60))};X_LENGTH=150")
+
+    def _write(path, lines, infos):
+        with open(path, "w") as fh:
+            fh.write("##fileformat=VCFv4.2\n##contig=<ID=chr1,length=100000>\n")
+            for i_ in infos:
+                fh.write(f'##INFO=<ID={i_},Number=1,Type=Float,Description="x">\n')
+            fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+            fh.write("\n".join(lines) + "\n")
+
+    sig = str(tmp_path / "sig.vcf")
+    fm = str(tmp_path / "fm.vcf")
+    _write(sig, sig_lines, ["AF"])
+    _write(fm, fm_lines, ["ML_QUAL", "X_LENGTH"])
+    return sig, fm
+
+
+def test_mrd_data_analysis_full_sections(tmp_path):
+    """All notebook-parity MRD sections: filters, mutation types, AF,
+    the six tumor-fraction keys, read lengths."""
+    from variantcalling_tpu.pipelines import mrd_data_analysis
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+    sig, fm = _mrd_world(tmp_path)
+    h5 = str(tmp_path / "mrd.h5")
+    write_hdf(pd.DataFrame([{
+        "n_signature_loci": 20, "n_supporting_reads": 20, "n_trials": 1000,
+        "tumor_fraction": 1e-3, "tf_ci_low": 5e-4, "tf_ci_high": 2e-3,
+        "expected_background_reads": 0.1, "mrd_detected": True,
+    }]), h5, key="mrd_summary", mode="w")
+    out = str(tmp_path / "out.h5")
+    html = str(tmp_path / "mrd.html")
+    rc = mrd_data_analysis.run([
+        "--mrd_summary_h5", h5, "--featuremap", fm, "--signature_vcf", sig,
+        "--read_filter_query", "ML_QUAL >= 40",
+        "--signature_filter_query", "AF >= 0.2",
+        "--coverage_per_locus", "30", "--html_output", html, "--h5_output", out,
+    ])
+    assert rc == 0
+    keys = set(list_keys(out))
+    for expect in ("filters_applied", "mutation_types", "allele_fractions",
+                   "df_tf_filt_signature_filt_featuremap",
+                   "df_tf_unfilt_signature_filt_featuremap",
+                   "df_tf_filt_signature_unfilt_featuremap",
+                   "df_supporting_reads_per_locus_filt_signature_filt_featuremap",
+                   "read_lengths", "ml_qual_distribution"):
+        assert expect in keys, f"missing {expect} in {sorted(keys)}"
+
+    # unfiltered reads/featuremap tf >= filtered (filter drops ML_QUAL<40 reads)
+    tf_f = read_hdf(out, key="df_tf_filt_signature_filt_featuremap")["tf"].iloc[0]
+    tf_u = read_hdf(out, key="df_tf_filt_signature_unfilt_featuremap")["tf"].iloc[0]
+    assert tf_u >= tf_f > 0
+    # unfiltered signature carries all 20 loci (filtered: AF >= 0.2 subset)
+    tf_su = read_hdf(out, key="df_tf_unfilt_signature_filt_featuremap")
+    assert int(tf_su["n_loci"].iloc[0]) == 20
+    assert int(read_hdf(out, key="df_tf_filt_signature_filt_featuremap")["n_loci"].iloc[0]) < 20
+    mut = read_hdf(out, key="mutation_types")
+    assert mut.iloc[0]["mutation"] == "C>T"
+    text = open(html).read()
+    for section in ("Filters applied", "mutation types", "allele fractions",
+                    "read length"):
+        assert section.lower() in text.lower()
+
+
+def test_joint_report_af_spectrum(tmp_path):
+    """Cohort AF spectrum section (notebook 'Allele Frequency')."""
+    from variantcalling_tpu.pipelines import joint_calling_report
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+    vcf = str(tmp_path / "joint.vcf")
+    with open(vcf, "w") as fh:
+        fh.write("##fileformat=VCFv4.2\n##contig=<ID=chr1,length=10000>\n")
+        fh.write('##FORMAT=<ID=GT,Number=1,Type=String,Description="g">\n')
+        fh.write("#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tA\tB\tC\n")
+        rows = [
+            ("0/1", "0/0", "0/0"),   # AF 1/6
+            ("1/1", "1/1", "1/1"),   # AF 1.0
+            ("0/1", "0/1", "./."),   # AF 2/4
+            ("0/0", "0/0", "0/1"),   # AF 1/6
+        ]
+        for i, gts in enumerate(rows):
+            fh.write(f"chr1\t{100+i*50}\t.\tA\tG\t50\tPASS\t.\tGT\t" + "\t".join(gts) + "\n")
+    h5 = str(tmp_path / "j.h5")
+    rc = joint_calling_report.run(["--input_vcf", vcf, "--h5_output", h5,
+                                   "--html_output", str(tmp_path / "j.html")])
+    assert rc == 0
+    assert "af_spectrum" in list_keys(h5)
+    af = read_hdf(h5, key="af_spectrum")
+    assert int(af["n_variants"].sum()) == 4
+    # the AF=1.0 variant lands in the top bin
+    assert int(af[af["af_bin_low"] >= 0.97]["n_variants"].sum()) == 1
+    assert "Allele frequency spectrum" in open(tmp_path / "j.html").read()
+
+
+def test_no_gt_report_scatter_and_stats(tmp_path):
+    """variants_statistics + af_scatter keys flow from full_analysis into
+    the report_wo_gt renderer."""
+    from tests import fixtures
+    from variantcalling_tpu.pipelines import report_wo_gt, run_no_gt_report
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+    rng = np.random.default_rng(3)
+    contigs = {"chr1": 30000}
+    genome = fixtures.make_genome(rng, contigs)
+    fasta = str(tmp_path / "r.fa")
+    fixtures.write_fasta(fasta, genome)
+    recs = fixtures.synth_variants(rng, genome, 120)
+    for r in recs:
+        r["ad"] = [int(rng.integers(5, 30)), int(rng.integers(1, 30))]
+    vcf = str(tmp_path / "c.vcf.gz")
+    fixtures.write_vcf(vcf, recs, contigs)
+
+    dbsnp = str(tmp_path / "dbsnp.vcf.gz")
+    fixtures.write_vcf(dbsnp, recs[:30], contigs)
+
+    prefix = str(tmp_path / "nogt")
+    rc = run_no_gt_report.run(["full_analysis", "--input_file", vcf, "--dbsnp", dbsnp,
+                               "--reference", fasta, "--output_prefix", prefix])
+    assert rc == 0
+    keys = list_keys(prefix + ".h5")
+    assert "variants_statistics" in keys and "af_scatter" in keys
+    stats = read_hdf(prefix + ".h5", key="variants_statistics")
+    assert int(stats["count"].sum()) == 120
+    scatter = read_hdf(prefix + ".h5", key="af_scatter")
+    assert {"chrom", "pos", "af", "dp"}.issubset(scatter.columns)
+    html = str(tmp_path / "w.html")
+    rc = report_wo_gt.run(["--input_h5", prefix + ".h5", "--html_output", html])
+    assert rc == 0
+    assert "Variants statistics" in open(html).read()

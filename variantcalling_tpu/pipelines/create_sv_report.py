@@ -150,6 +150,8 @@ def run(argv) -> int:
             values_df = values_df[keep]
         rep.add_table(values_df.reset_index())
         write_hdf(values_df.reset_index().astype(str), args.h5_output, key="concordance", mode="a")
+        # notebook cell 23 writes the same overall table under recall_per_type
+        write_hdf(values_df.reset_index().astype(str), args.h5_output, key="recall_per_type", mode="a")
 
         # ROC grid per overall category
         if roc_cols and len(overall):

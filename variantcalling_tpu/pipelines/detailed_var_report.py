@@ -250,7 +250,11 @@ def run(argv) -> int:
         regions += [str(c), f"Non-{c}"]
 
     detailed = build_detailed_vars(df, regions, classify_col, args.coverage_column)
-    write_hdf(detailed, args.h5_output, key="detailed_vars", mode="w")
+    params_df = pd.DataFrame.from_dict(
+        {"h5_concordance_file": str(args.h5_concordance_file), "records": str(len(df))},
+        orient="index", columns=["value"])
+    write_hdf(params_df, args.h5_output, key="det_parameters", mode="w")
+    write_hdf(detailed, args.h5_output, key="detailed_vars", mode="a")
     if args.csv_output:
         detailed.to_csv(args.csv_output, index=False)
 

@@ -58,7 +58,11 @@ def run(argv) -> int:
     args = parse_args(argv)
     paths = sorted(p for p in glob.glob(args.metrics_prefix + "*") if os.path.isfile(p))
     metrics, cvg = metrics_long_table(paths)
-    write_hdf(metrics, args.output_h5, key="metrics", mode="w")
+    params = pd.DataFrame.from_dict(
+        {"metrics_prefix": args.metrics_prefix, "n_files": str(len(paths))},
+        orient="index", columns=["value"])
+    write_hdf(params, args.output_h5, key="params", mode="w")
+    write_hdf(metrics, args.output_h5, key="metrics", mode="a")
     if len(cvg):
         write_hdf(cvg, args.output_h5, key="coverage_histograms", mode="a")
     logger.info("%d metric rows from %d files -> %s", len(metrics), len(paths), args.output_h5)

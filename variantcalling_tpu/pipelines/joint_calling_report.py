@@ -67,11 +67,40 @@ def run(argv) -> int:
 
         add_figure_safe(rep, _indel_fig, "indel length figure")
 
+    # allele-frequency spectrum (notebook "Allele Frequency" section):
+    # cohort-wide alt-allele frequency from the genotype matrix. Parsed
+    # once here; the per-sample section below reuses gt_all.
+    gt_all = None
+    if table.n_samples:
+        gt_all = [table.genotypes(s) for s in range(table.n_samples)]  # S x (N, 2)
+        stacked = np.stack(gt_all)
+        called = stacked >= 0
+        n_called = called.sum(axis=(0, 2))
+        n_alt = ((stacked > 0) & called).sum(axis=(0, 2))
+        with np.errstate(invalid="ignore"):
+            af = np.where(n_called > 0, n_alt / np.maximum(n_called, 1), np.nan)
+        hist, edges = np.histogram(af[~np.isnan(af)], bins=np.linspace(0, 1, 51))
+        af_df = pd.DataFrame({"af_bin_low": edges[:-1].round(3), "n_variants": hist})
+        rep.add_section("Allele frequency spectrum")
+        rep.add_table(af_df[af_df["n_variants"] > 0].head(60))
+
+        def _af_fig(plt):
+            fig, ax = plt.subplots(figsize=(8, 3))
+            ax.bar(af_df["af_bin_low"], af_df["n_variants"], width=0.018)
+            ax.set_xlabel("cohort alt-allele frequency")
+            ax.set_ylabel("# variants")
+            ax.set_yscale("symlog")
+            return fig
+
+        add_figure_safe(rep, _af_fig, "AF spectrum figure")
+        write_hdf(af_df, args.h5_output, key="af_spectrum", mode=mode)
+        mode = "a"
+
     # per-sample: call rate, het/hom ratio
     if table.n_samples:
         rows = []
         for s, name in enumerate(table.header.samples):
-            gts = table.genotypes(s)
+            gts = gt_all[s]
             called = (gts >= 0).any(axis=1)
             het = called & (gts[:, 0] != gts[:, 1])
             hom_var = called & (gts[:, 0] == gts[:, 1]) & (gts[:, 0] > 0)

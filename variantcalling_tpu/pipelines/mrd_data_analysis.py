@@ -1,15 +1,29 @@
 """mrd_data_analysis — automatic MRD analysis report.
 
 Reference surface: ugvc/reports/mrd_automatic_data_analysis.ipynb (the
-ugbio_mrd reporting layer). Consumes the mrd_analysis summary h5 (tumor
-fraction + CI + detection call) and, when given the scored featuremap,
-adds ML_QUAL distributions for on- vs off-signature reads. Emits h5
-sections + self-contained HTML.
+ugbio_mrd reporting layer), section by section:
+
+- Filters applied (cells 8-9): human-readable read/signature filter
+  terms -> ``filters_applied``
+- Matched signature analysis: mutation types (cell 12) ->
+  ``mutation_types``; allele fractions (cell 15) -> ``allele_fractions``
+- Tumor fractions (cells 18-29): filtered/unfiltered reads x
+  filtered/unfiltered signature -> the notebook's six h5 keys
+  (``df_tf_*`` + ``df_supporting_reads_per_locus_*``)
+- ML_QUAL distribution for on- vs off-signature reads (framework
+  addition; the notebook's X_SCORE likelihood section analog)
+- cfDNA read length distributions (cells 35-36) -> ``read_lengths``
+
+Consumes the mrd_analysis summary h5 (tumor fraction + CI + detection
+call) and, when given the scored featuremap + signature, computes the
+sections above from the columnar INFO tensors. Emits h5 sections +
+self-contained HTML.
 """
 
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 
 import numpy as np
@@ -19,36 +33,178 @@ from variantcalling_tpu import logger
 from variantcalling_tpu.reports.html import HtmlReport, add_figure_safe
 from variantcalling_tpu.utils.h5_utils import read_hdf, write_hdf
 
+# notebook cell 9's filter glossary
+FILTER_DESCRIPTIONS = {
+    "ug_hcr": "In UG High Confidence Region",
+    "giab_hcr": "In GIAB (HG001-007) High Confidence Region",
+    "ug_mrd_blacklist": "Not in UG MRD Blacklist",
+    "id": "Not in dbsnp",
+    "af": "Allele fraction filter",
+    "filtering_ratio": "Minimum ratio of reads passing read filters in locus",
+    "norm_coverage": "Filtering by coverage, normalized to median",
+    "X_SCORE": "Filtering by log likelihood score (effective BQ)",
+    "X_EDIST": "Filtering by edit distance from the reference",
+    "max_softclip_len": "Filtering by maximal softclip length",
+    "X_LENGTH": "Filtering by fragment length",
+    "rq": "Filtering by read quality",
+    "ML_QUAL": "Filtering by single-read model quality",
+}
+
 
 def parse_args(argv):
     ap = argparse.ArgumentParser(prog="mrd_data_analysis", description=run.__doc__)
     ap.add_argument("--mrd_summary_h5", required=True, help="mrd_analysis output")
     ap.add_argument("--featuremap", default=None, help="scored featuremap (srsnv_inference)")
     ap.add_argument("--signature_vcf", default=None)
+    ap.add_argument("--read_filter_query", default=None,
+                    help="pandas query over featuremap INFO columns (e.g. 'ML_QUAL >= 40')")
+    ap.add_argument("--signature_filter_query", default=None,
+                    help="pandas query over signature INFO columns (e.g. 'AF >= 0.05')")
+    ap.add_argument("--coverage_per_locus", type=float, default=None,
+                    help="tumor-fraction denominator per locus (defaults from the summary h5)")
+    ap.add_argument("--read_pass_fraction", type=float, default=1.0,
+                    help="SRSNV test-set read pass fraction (denominator correction, notebook cell 20)")
     ap.add_argument("--h5_output", default="mrd_report.h5")
     ap.add_argument("--html_output", required=True)
     return ap.parse_args(argv)
 
 
-def qual_distributions(featuremap: str, signature_vcf: str | None) -> pd.DataFrame:
-    from variantcalling_tpu.io.vcf import read_vcf
+def _query_identifiers(query: str) -> list[str]:
+    keywords = {"and", "or", "not", "in", "True", "False"}
+    return [t for t in dict.fromkeys(re.findall(r"[A-Za-z_][A-Za-z0-9_.]*", query))
+            if t not in keywords and not t[0].isdigit()]
 
-    fm = read_vcf(featuremap)
-    qual = fm.info_field("ML_QUAL")
-    on_sig = np.zeros(len(fm), dtype=bool)
-    if signature_vcf:
-        sig = read_vcf(signature_vcf)
-        loci = {(c, int(p)) for c, p in zip(sig.chrom, sig.pos)}
-        on_sig = np.fromiter(
-            ((c, int(p)) in loci for c, p in zip(fm.chrom, fm.pos)), dtype=bool, count=len(fm)
-        )
+
+def describe_filters(query: str) -> pd.DataFrame:
+    """Notebook cells 8-9: one row per filter term with its description."""
+    rows = []
+    for term in re.split(r"\band\b", query.replace("(", "").replace(")", "")):
+        term = term.strip()
+        if not term:
+            continue
+        name = re.split(r"[<>=!\s]", term.removeprefix("not").strip())[0].strip()
+        rows.append({"query": term,
+                     "description": FILTER_DESCRIPTIONS.get(name, "<Description unavailable>")})
+    return pd.DataFrame(rows)
+
+
+def _info_frame(table, query: str | None, extra: tuple[str, ...] = ()) -> pd.DataFrame:
+    """Featuremap/signature VCF -> DataFrame of the INFO columns a query
+    (plus standard report columns) needs."""
+    fields = set(extra)
+    if query:
+        fields.update(_query_identifiers(query))
+    df = pd.DataFrame({"chrom": np.asarray(table.chrom), "pos": table.pos})
+    for f in sorted(fields - {"chrom", "pos"}):  # never clobber the locus columns
+        df[f] = table.info_field(f)
+    return df
+
+
+def _apply_query(df: pd.DataFrame, query: str | None) -> pd.Series:
+    if not query:
+        return pd.Series(True, index=df.index)
+    try:
+        return df.eval(query).fillna(False).astype(bool)
+    except Exception as e:  # noqa: BLE001 — a bad query degrades to "no filter"
+        logger.warning("filter query %r failed (%s); treating as pass-all", query, e)
+        return pd.Series(True, index=df.index)
+
+
+def mutation_type_counts(sig) -> pd.DataFrame:
+    """ref>alt counts over the signature (notebook 'Mutation types')."""
+    refs = np.asarray([r.upper() if len(r) == 1 else "." for r in sig.ref])
+    alts = np.asarray([a.split(",")[0].upper() if a and len(a.split(",")[0]) == 1 else "."
+                       for a in sig.alt])
+    ok = (refs != ".") & (alts != ".")
+    pairs = pd.Series([f"{r}>{a}" for r, a in zip(refs[ok], alts[ok])])
+    if not len(pairs):
+        return pd.DataFrame(columns=["mutation", "count", "fraction"])
+    out = pairs.value_counts().rename_axis("mutation").reset_index(name="count")
+    out["fraction"] = out["count"] / max(int(out["count"].sum()), 1)
+    return out
+
+
+def af_histogram(sig, nbins: int = 50) -> pd.DataFrame:
+    af = sig.info_field("AF")
+    af = af[~np.isnan(af)]
+    hist, edges = np.histogram(af, bins=np.linspace(0, 1, nbins + 1))
+    return pd.DataFrame({"af_bin_low": edges[:-1].round(4), "n_variants": hist})
+
+
+def qual_distributions(fm_df: pd.DataFrame, matched: pd.Series) -> pd.DataFrame:
     bins = np.arange(0, 65, 5)
     rows = []
-    for name, mask in (("on_signature", on_sig), ("off_signature", ~on_sig)):
-        q = qual[mask & ~np.isnan(qual)]
+    qual = fm_df.get("ML_QUAL", pd.Series(np.nan, index=fm_df.index))
+    for name, mask in (("on_signature", matched), ("off_signature", ~matched)):
+        q = qual[mask & qual.notna()]
         hist, _ = np.histogram(q, bins=bins)
-        for lo, n in zip(bins[:-1], hist):
-            rows.append({"population": name, "ml_qual_bin": int(lo), "n_reads": int(n)})
+        rows.extend({"population": name, "ml_qual_bin": int(lo), "n_reads": int(n)}
+                    for lo, n in zip(bins[:-1], hist))
+    return pd.DataFrame(rows)
+
+
+def tumor_fraction_tables(fm_df: pd.DataFrame, sig_df: pd.DataFrame,
+                          read_query: str | None, sig_query: str | None,
+                          denominator_per_locus: float,
+                          pass_fraction: float) -> dict[str, pd.DataFrame]:
+    """The notebook's six h5 tables (cell 29): tumor fraction and
+    per-locus supporting-read counts for (filtered reads x filtered
+    signature), (unfiltered reads x filtered signature), (filtered reads
+    x unfiltered signature).
+
+    tf = supporting reads / (loci * coverage * read-pass-fraction)
+    (cell 20's denominator correction).
+    """
+    read_pass = _apply_query(fm_df, read_query)
+    sig_pass = _apply_query(sig_df, sig_query)
+    sig_loci_all = set(zip(sig_df["chrom"], sig_df["pos"].astype(int)))
+    sig_loci_filt = set(zip(sig_df.loc[sig_pass, "chrom"], sig_df.loc[sig_pass, "pos"].astype(int)))
+    fm_loci = list(zip(fm_df["chrom"], fm_df["pos"].astype(int)))
+
+    all_reads = pd.Series(True, index=fm_df.index)
+    # key halves name (signature filter state, featuremap/read filter state)
+    combos = {
+        "filt_signature_filt_featuremap": (read_pass, sig_loci_filt),
+        "unfilt_signature_filt_featuremap": (read_pass, sig_loci_all),
+        "filt_signature_unfilt_featuremap": (all_reads, sig_loci_filt),
+    }
+    out: dict[str, pd.DataFrame] = {}
+    for tag, (rmask, loci) in combos.items():
+        on = pd.Series([loc in loci for loc in fm_loci], index=fm_df.index)
+        support = fm_df[on & rmask]
+        per_locus = (support.groupby(["chrom", "pos"]).size().rename("n_supporting_reads")
+                     .reset_index()) if len(support) else \
+            pd.DataFrame(columns=["chrom", "pos", "n_supporting_reads"])
+        denom = max(len(loci), 1) * max(denominator_per_locus, 1e-12) * max(pass_fraction, 1e-12)
+        tf = len(support) / denom
+        out[f"df_tf_{tag}"] = pd.DataFrame(
+            [{"signature_type": "matched", "n_loci": len(loci),
+              "n_supporting_reads": len(support), "tf": tf}])
+        out[f"df_supporting_reads_per_locus_{tag}"] = per_locus
+    return out
+
+
+def read_length_table(fm_df: pd.DataFrame, matched: pd.Series,
+                      read_query: str | None) -> pd.DataFrame | None:
+    """Notebook cells 35-36: X_LENGTH histograms for matched/unmatched x
+    unfiltered/filtered reads."""
+    if "X_LENGTH" not in fm_df.columns or fm_df["X_LENGTH"].notna().sum() == 0:
+        return None
+    read_pass = _apply_query(fm_df, read_query)
+    length = fm_df["X_LENGTH"]
+    top = int(max(250, np.nanmax(length))) + 1
+    bins = np.arange(0, top + 10, 10)
+    rows = []
+    for name, mask in (
+        ("matched_unfiltered", matched),
+        ("matched_filtered", matched & read_pass),
+        ("unmatched_unfiltered", ~matched),
+        ("unmatched_filtered", ~matched & read_pass),
+    ):
+        vals = length[mask & length.notna()]
+        hist, _ = np.histogram(vals, bins=bins)
+        rows.extend({"population": name, "length_bin_low": int(lo), "n_reads": int(n)}
+                    for lo, n in zip(bins[:-1], hist) if n or name.startswith("matched"))
     return pd.DataFrame(rows)
 
 
@@ -57,6 +213,28 @@ def run(argv) -> int:
     args = parse_args(argv)
     summary = read_hdf(args.mrd_summary_h5, key="mrd_summary")
     rep = HtmlReport("MRD Automatic Data Analysis")
+    mode = "w"
+
+    def save(df: pd.DataFrame, key: str) -> None:
+        nonlocal mode
+        write_hdf(df, args.h5_output, key=key, mode=mode)
+        mode = "a"
+
+    # --- filters applied (cells 8-9) --------------------------------------
+    if args.read_filter_query or args.signature_filter_query:
+        rep.add_section("Filters applied")
+        tabs = []
+        for label, q in (("signature", args.signature_filter_query),
+                         ("reads", args.read_filter_query)):
+            if q:
+                t = describe_filters(q)
+                t.insert(0, "applies_to", label)
+                tabs.append(t)
+        filters = pd.concat(tabs, ignore_index=True)
+        rep.add_table(filters)
+        save(filters, "filters_applied")
+
+    # --- tumor fraction summary (cells 18-19) -----------------------------
     rep.add_section("Tumor fraction estimate")
     rep.add_table(summary)
     row = summary.iloc[0]
@@ -67,23 +245,104 @@ def run(argv) -> int:
         f"{int(row['n_supporting_reads'])} supporting reads over "
         f"{int(row['n_signature_loci'])} signature loci."
     )
-    write_hdf(summary, args.h5_output, key="mrd_summary", mode="w")
+    save(summary, "mrd_summary")
+
+    fm_df = sig = None
     if args.featuremap:
-        dist = qual_distributions(args.featuremap, args.signature_vcf)
-        rep.add_section("ML_QUAL distribution (on vs off signature)")
-        piv = dist.pivot(index="ml_qual_bin", columns="population", values="n_reads")
-        rep.add_table(piv)
+        from variantcalling_tpu.io.vcf import read_vcf
 
-        def _qual_fig(plt):
-            fig, ax = plt.subplots(figsize=(7, 3))
-            piv.plot.bar(ax=ax)
-            ax.set_xlabel("ML_QUAL bin")
-            ax.set_ylabel("# reads")
-            ax.set_yscale("symlog")
-            return fig
+        fm = read_vcf(args.featuremap)
+        fm_df = _info_frame(fm, args.read_filter_query, extra=("ML_QUAL", "X_LENGTH"))
+        if args.signature_vcf:
+            sig = read_vcf(args.signature_vcf)
 
-        add_figure_safe(rep, _qual_fig, "ML_QUAL figure")
-        write_hdf(dist, args.h5_output, key="ml_qual_distribution", mode="a")
+    matched = pd.Series(False, index=fm_df.index) if fm_df is not None else None
+    if sig is not None and fm_df is not None:
+        sig_df = _info_frame(sig, args.signature_filter_query, extra=("AF",))
+        loci = set(zip(sig_df["chrom"], sig_df["pos"].astype(int)))
+        matched = pd.Series([(c, int(p)) in loci
+                             for c, p in zip(fm_df["chrom"], fm_df["pos"])],
+                            index=fm_df.index)
+
+        # --- matched signature analysis (cells 10-15) ---------------------
+        mut = mutation_type_counts(sig)
+        if len(mut):
+            rep.add_section("Matched signature — mutation types")
+            rep.add_table(mut)
+
+            def _mut_fig(plt):
+                fig, ax = plt.subplots(figsize=(7, 3))
+                ax.bar(mut["mutation"], mut["count"])
+                ax.set_ylabel("# mutations")
+                return fig
+
+            add_figure_safe(rep, _mut_fig, "mutation types figure")
+            save(mut, "mutation_types")
+        afh = af_histogram(sig)
+        if afh["n_variants"].sum():
+            rep.add_section("Matched signature — allele fractions")
+
+            def _af_fig(plt):
+                fig, ax = plt.subplots(figsize=(7, 3))
+                ax.bar(afh["af_bin_low"], afh["n_variants"], width=0.018)
+                ax.set_xlabel("Allele fraction")
+                ax.set_ylabel("# variants")
+                return fig
+
+            add_figure_safe(rep, _af_fig, "AF figure")
+            save(afh, "allele_fractions")
+
+        # --- tumor fractions, filtered x unfiltered (cells 19-29) ---------
+        denom = args.coverage_per_locus or float(row.get("coverage_per_locus", 1.0) or 1.0)
+        tf_tables = tumor_fraction_tables(fm_df, sig_df, args.read_filter_query,
+                                          args.signature_filter_query, denom,
+                                          args.read_pass_fraction)
+        rep.add_section("Tumor fractions (filtered/unfiltered reads and signature)")
+        tf_summary = pd.concat([t.assign(variant=k.removeprefix("df_tf_"))
+                                for k, t in tf_tables.items() if k.startswith("df_tf_")],
+                               ignore_index=True)
+        rep.add_table(tf_summary)
+        for key, tab in tf_tables.items():
+            save(tab, key)
+
+    if fm_df is not None:
+        # --- ML_QUAL on/off signature -------------------------------------
+        dist = qual_distributions(fm_df, matched)
+        if dist["n_reads"].sum():
+            rep.add_section("ML_QUAL distribution (on vs off signature)")
+            piv = dist.pivot(index="ml_qual_bin", columns="population", values="n_reads")
+            rep.add_table(piv)
+
+            def _qual_fig(plt):
+                fig, ax = plt.subplots(figsize=(7, 3))
+                piv.plot.bar(ax=ax)
+                ax.set_xlabel("ML_QUAL bin")
+                ax.set_ylabel("# reads")
+                ax.set_yscale("symlog")
+                return fig
+
+            add_figure_safe(rep, _qual_fig, "ML_QUAL figure")
+            save(dist, "ml_qual_distribution")
+
+        # --- read length distributions (cells 35-36) ----------------------
+        rl = read_length_table(fm_df, matched, args.read_filter_query)
+        if rl is not None and len(rl):
+            rep.add_section("cfDNA read length distributions")
+
+            def _rl_fig(plt):
+                fig, axs = plt.subplots(2, 2, figsize=(11, 5), sharex=True)
+                for ax, pop in zip(axs.flatten(), rl["population"].unique()):
+                    sub = rl[rl["population"] == pop]
+                    ax.bar(sub["length_bin_low"], sub["n_reads"], width=9)
+                    ax.set_title(pop, fontsize=9)
+                for ax in axs[-1, :]:
+                    ax.set_xlabel("Read length")
+                fig.tight_layout()
+                return fig
+
+            add_figure_safe(rep, _rl_fig, "read length figure")
+            save(rl, "read_lengths")
+
     rep.write(args.html_output)
     logger.info("MRD report -> %s", args.html_output)
     return 0

@@ -21,9 +21,11 @@ from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
 
 SECTION_TITLES = {
     "callable_size": "Callable region size",
+    "variants_statistics": "Variants statistics",
     "ins_del_hete": "Heterozygous indels by hmer length",
     "ins_del_homo": "Homozygous indels by hmer length",
     "af_hist": "Allele-frequency histogram",
+    "af_scatter": "Allele frequency along the genome / vs depth",
     "snp_motifs": "SNP 96-motif spectrum",
     "signature_exposures": "Mutational signature exposures",
 }
@@ -81,6 +83,18 @@ def _figure_for(key: str, df: pd.DataFrame):
         ax.set_ylabel("# indels")
         ax.legend(fontsize=8)
         return fig
+    if key == "af_scatter" and {"af", "dp"}.issubset(df.columns) and len(df):
+        # notebook "AF along genome positions" + "AF vs depth" scatters
+        fig, axs = plt.subplots(1, 2, figsize=(13, 3))
+        chroms = df["chrom"].astype(str).to_numpy()
+        _, chrom_idx = np.unique(chroms, return_inverse=True)
+        axs[0].scatter(np.arange(len(df)), df["af"], s=2, c=chrom_idx, cmap="tab20", alpha=0.5)
+        axs[0].set_xlabel("variant rank along genome (color = contig)")
+        axs[0].set_ylabel("allele frequency")
+        axs[1].scatter(df["dp"], df["af"], s=2, alpha=0.4)
+        axs[1].set_xlabel("depth")
+        axs[1].set_ylabel("allele frequency")
+        return fig
     if key == "signature_exposures" and len(num):
         fig, ax = plt.subplots(figsize=(8, 3))
         num.iloc[:, 0].plot.bar(ax=ax, legend=False)
@@ -109,6 +123,9 @@ def run(argv) -> int:
             # compact: show non-empty bins only
             num = df.select_dtypes(include=[np.number])
             df = df[(num.sum(axis=1) > 0)]
+        if key == "af_scatter":  # thousands of scatter points: figure only
+            n_sections += 1
+            continue
         rep.add_table(df.head(120))
         n_sections += 1
     rep.write(args.html_output)

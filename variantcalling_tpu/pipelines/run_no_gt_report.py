@@ -66,6 +66,26 @@ def run_full_analysis(args) -> None:
     write_hdf(motif_df, out_h5, key="snp_motifs", mode="a")
     for name, tbl in eval_tables.items():
         write_hdf(tbl, out_h5, key=f"eval_{name}", mode="a")
+
+    # notebook report_wo_gt "Variants Statistics" merged table + the two
+    # per-variant AF scatters ("AF along genome positions", "AF vs depth"),
+    # stored downsampled so the report h5 stays small at WGS scale
+    vc = pd.Series(vtype).value_counts()
+    vstats = vc.rename_axis("variant_type").reset_index(name="count")
+    write_hdf(vstats, out_h5, key="variants_statistics", mode="a")
+    af = no_gt_stats._compute_af(table, sample=sample)
+    dp = table.info_field("DP")
+    ok = ~np.isnan(af)
+    idx = np.nonzero(ok)[0]
+    if len(idx) > 50_000:  # even stride keeps the genome-position spread
+        idx = idx[:: len(idx) // 50_000]
+    scatter = pd.DataFrame({
+        "chrom": np.asarray(table.chrom)[idx],
+        "pos": table.pos[idx],
+        "af": af[idx].astype(np.float32),
+        "dp": dp[idx].astype(np.float32),
+    })
+    write_hdf(scatter, out_h5, key="af_scatter", mode="a")
     logger.info("wrote %s", out_h5)
 
 

@@ -77,6 +77,8 @@ def parse_args(argv):
     ap.add_argument("--motif_key", default="motif_1")
     ap.add_argument("--h5_output", default="substitution_error_rate_report.h5")
     ap.add_argument("--html_output", default=None)
+    ap.add_argument("--position_key", default="by_position",
+                    help="input h5 key of the per-read-position error table")
     return ap.parse_args(argv)
 
 
@@ -150,6 +152,22 @@ def run(argv) -> int:
         write_hdf(asym, args.h5_output, key="asymmetry", mode="a")
         add_figure_safe(rep, lambda plt: _asymmetry_figure(plt, asym), "asymmetry figure")
 
+    # error rate as a function of read position (notebook "Substitution
+    # error rate as a function of position" section) — present when the
+    # upstream analysis emitted a per-position table
+    from variantcalling_tpu.utils.h5_utils import list_keys
+
+    if args.position_key in list_keys(args.h5_substitution_error_rate):
+        pos = read_hdf(args.h5_substitution_error_rate, key=args.position_key)
+        if {"position", "n_errors"}.issubset(pos.columns):
+            pos = pos.sort_values("position").reset_index(drop=True)
+            if "n_bases" in pos.columns:
+                pos["error_rate"] = pos["n_errors"] / pos["n_bases"].clip(lower=1.0)
+            rep.add_section("Error rate by read position")
+            rep.add_table(pos.head(40))
+            write_hdf(pos, args.h5_output, key="by_position", mode="a")
+            add_figure_safe(rep, lambda plt: _position_figure(plt, pos), "position figure")
+
     rep.add_section("Folded motif table (head)")
     rep.add_table(folded.head(50))
     if args.html_output:
@@ -180,6 +198,16 @@ def _context_figure(plt, folded: pd.DataFrame):
            color=[_TYPE_COLORS.get(t, "#888888") for t in d["mut_type"]], width=0.8)
     ax.set_xlabel("trinucleotide channel (grouped by mutation type)")
     ax.set_ylabel("error rate")
+    return fig
+
+
+def _position_figure(plt, pos: pd.DataFrame):
+    fig, ax = plt.subplots(figsize=(7, 3))
+    y = pos["error_rate"] if "error_rate" in pos.columns else pos["n_errors"]
+    ax.plot(pos["position"], y)
+    ax.set_xlabel("position in read")
+    ax.set_ylabel("error rate" if "error_rate" in pos.columns else "# errors")
+    ax.set_yscale("log")
     return fig
 
 
