@@ -85,3 +85,58 @@ def test_run_comparison_end_to_end(tmp_path, rng):
     total_tp = (df["classify"] == "tp").sum()
     recall = total_tp / max(total_tp + n_fn_expected, 1)
     assert 0.85 <= recall <= 0.95
+
+
+def test_concordance_tool_gc_mode(tmp_path, rng):
+    """--concordance_tool GC: exact-position genotype joins — a genotype
+    mismatch is tp under classify but fp under classify_gt, and a shifted
+    representation that the native haplotype matcher rescues stays fp."""
+    genome = {"chr1": "".join(rng.choice(list("ACGT"), 3000))}
+    # plant a homopolymer for the representation-shift case
+    g = list(genome["chr1"])
+    g[1000:1006] = list("AAAAAA")
+    g[999] = "C"
+    g[1006] = "G"
+    genome["chr1"] = "".join(g)
+    fasta_path = str(tmp_path / "ref.fa")
+    write_fasta(fasta_path, genome)
+    contigs = {"chr1": 3000}
+
+    # truth: SNP het at 101; deletion of one A anchored at 1000 (C)
+    truth_recs = [
+        {"chrom": "chr1", "pos": 101, "ref": genome["chr1"][100], 
+         "alts": ["ACGT"[("ACGT".index(genome["chr1"][100]) + 1) % 4]],
+         "qual": 50.0, "gt": (0, 1)},
+        {"chrom": "chr1", "pos": 1000, "ref": "CA", "alts": ["C"], "qual": 50.0, "gt": (0, 1)},
+    ]
+    # calls: same SNP but hom-alt; same deletion right-shifted (anchor at 1001)
+    call_recs = [
+        {"chrom": "chr1", "pos": 101, "ref": truth_recs[0]["ref"],
+         "alts": truth_recs[0]["alts"], "qual": 50.0, "gt": (1, 1)},
+        {"chrom": "chr1", "pos": 1001, "ref": "AA", "alts": ["A"], "qual": 50.0, "gt": (0, 1)},
+    ]
+    truth_vcf, calls_vcf = str(tmp_path / "t.vcf"), str(tmp_path / "c.vcf")
+    write_vcf(truth_vcf, truth_recs, contigs)
+    write_vcf(calls_vcf, call_recs, contigs)
+    hc = str(tmp_path / "hc.bed")
+    open(hc, "w").write("chr1\t0\t3000\n")
+
+    def _run(tool, out):
+        assert rc.run([
+            "--input_prefix", calls_vcf, "--output_file", out,
+            "--output_interval", str(tmp_path / "iv.bed"),
+            "--gtr_vcf", truth_vcf, "--highconf_intervals", hc,
+            "--reference", fasta_path, "--concordance_tool", tool,
+        ]) == 0
+        return read_hdf(out, key="chr1").set_index("pos")
+
+    gc = _run("GC", str(tmp_path / "gc.h5"))
+    native = _run("native", str(tmp_path / "nat.h5"))
+
+    # genotype mismatch at 101: allele-level tp both tools; GC classify_gt fp
+    assert gc.loc[101, "classify"] == "tp" and gc.loc[101, "classify_gt"] == "fp"
+    assert native.loc[101, "classify"] == "tp"
+    # shifted deletion: native haplotype matcher rescues it; GC does not
+    assert native.loc[1001, "classify"] == "tp"
+    assert gc.loc[1001, "classify"] == "fp"
+    assert gc.loc[1000, "classify"] == "fn"  # truth-side unmatched under GC

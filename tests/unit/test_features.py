@@ -165,3 +165,37 @@ def test_blocked_genome_packed_positions_round_trip():
     too_big = DeviceGenome(blocks=np.empty((5000, 0), dtype=np.uint8),
                            offsets={}, lengths={}, flat=False)
     assert pack_global_positions(blk, off, too_big) is None
+
+
+def test_genome_cache_key_shared_across_consumers(tmp_path):
+    """The small-job resident guard must answer the same for every consumer:
+    featurize() and the filter pipeline both key the genome cache through
+    standard_genome_sharding(), so one consumer's upload makes the cache
+    hit visible to the other regardless of call order."""
+    from variantcalling_tpu.featurize import (_genome_resident_worthwhile,
+                                              device_genome,
+                                              standard_genome_sharding)
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import VariantTable, VcfHeader
+
+    genome = "ACGT" * 500
+    fa = tmp_path / "tiny.fa"
+    fa.write_text(">chr1\n" + genome + "\n")
+    fasta = FastaReader(str(fa))
+
+    tiny = VariantTable(
+        header=VcfHeader(lines=[]),
+        chrom=np.array(["chr1"] * 3, dtype=object), pos=np.array([10, 20, 30]),
+        vid=np.array(["."] * 3, dtype=object), ref=np.array(["A"] * 3, dtype=object),
+        alt=np.array(["C"] * 3, dtype=object), qual=np.ones(3),
+        filters=np.array(["PASS"] * 3, dtype=object),
+        info=np.array(["."] * 3, dtype=object),
+    )
+    sh = standard_genome_sharding()
+    # small job, nothing cached -> host path (both consumers agree)
+    assert not _genome_resident_worthwhile(tiny, fasta, sharding=sh)
+    # any consumer uploads through the shared helper...
+    device_genome(fasta, sharding=sh)
+    # ...and now BOTH consumers see the cache hit with the same key
+    assert _genome_resident_worthwhile(tiny, fasta, sharding=sh)
+    assert _genome_resident_worthwhile(tiny, fasta, sharding=standard_genome_sharding())

@@ -285,8 +285,11 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
             windows = gather_windows(table, fasta)
         else:
             # replicate the genome across the mesh so chunk dispatches never
-            # reshard the multi-GB array
-            genome = device_genome(fasta, sharding=replicated(mesh) if mesh is not None else None)
+            # reshard the multi-GB array; the helper keeps the cache key
+            # identical across every consumer
+            from variantcalling_tpu.featurize import standard_genome_sharding
+
+            genome = device_genome(fasta, sharding=standard_genome_sharding())
             blk_all, off_all = globalize_positions(table, genome)
             gpos_all = pack_global_positions(blk_all, off_all, genome)
             if gpos_all is None:  # safety net: packable() and the packer disagree
@@ -398,11 +401,10 @@ def filter_variants(
     # sklearn fallback; the fused path gathers windows from the device-
     # resident genome instead — unless the job is too small to justify the
     # whole-genome HBM upload (featurize._genome_resident_worthwhile)
-    from variantcalling_tpu.featurize import _genome_resident_worthwhile
-    from variantcalling_tpu.parallel.mesh import make_mesh, replicated
+    from variantcalling_tpu.featurize import (_genome_resident_worthwhile,
+                                              standard_genome_sharding)
 
-    n_dev = len(jax.devices())
-    genome_sharding = replicated(make_mesh(n_model=1)) if n_dev > 1 else None
+    genome_sharding = standard_genome_sharding()
     needs_host_windows = (
         blacklist_cg_insertions
         or not isinstance(model, (FlatForest, ThresholdModel))

@@ -68,15 +68,32 @@ def run(argv) -> int:
         add_figure_safe(rep, _indel_fig, "indel length figure")
 
     # allele-frequency spectrum (notebook "Allele Frequency" section):
-    # cohort-wide alt-allele frequency from the genotype matrix. Parsed
-    # once here; the per-sample section below reuses gt_all.
-    gt_all = None
+    # cohort-wide alt-allele frequency. One pass over samples, O(N)
+    # accumulators — stacking an (S, N, 2) genotype tensor OOMs on the
+    # large joint cohorts this report targets. Per-sample stats are
+    # collected in the same pass and rendered further down.
+    per_sample_rows = []
     if table.n_samples:
-        gt_all = [table.genotypes(s) for s in range(table.n_samples)]  # S x (N, 2)
-        stacked = np.stack(gt_all)
-        called = stacked >= 0
-        n_called = called.sum(axis=(0, 2))
-        n_alt = ((stacked > 0) & called).sum(axis=(0, 2))
+        n = len(table)
+        n_called = np.zeros(n, dtype=np.int64)
+        n_alt = np.zeros(n, dtype=np.int64)
+        for s, name in enumerate(table.header.samples):
+            gts = table.genotypes(s)
+            called = gts >= 0
+            n_called += called.sum(axis=1)
+            n_alt += ((gts > 0) & called).sum(axis=1)
+            any_called = called.any(axis=1)
+            het = any_called & (gts[:, 0] != gts[:, 1])
+            hom_var = any_called & (gts[:, 0] == gts[:, 1]) & (gts[:, 0] > 0)
+            per_sample_rows.append(
+                {
+                    "sample": name,
+                    "call_rate": round(float(any_called.mean()), 5),
+                    "n_het": int(het.sum()),
+                    "n_hom_var": int(hom_var.sum()),
+                    "het_hom_ratio": round(float(het.sum() / max(int(hom_var.sum()), 1)), 4),
+                }
+            )
         with np.errstate(invalid="ignore"):
             af = np.where(n_called > 0, n_alt / np.maximum(n_called, 1), np.nan)
         hist, edges = np.histogram(af[~np.isnan(af)], bins=np.linspace(0, 1, 51))
@@ -96,24 +113,9 @@ def run(argv) -> int:
         write_hdf(af_df, args.h5_output, key="af_spectrum", mode=mode)
         mode = "a"
 
-    # per-sample: call rate, het/hom ratio
-    if table.n_samples:
-        rows = []
-        for s, name in enumerate(table.header.samples):
-            gts = gt_all[s]
-            called = (gts >= 0).any(axis=1)
-            het = called & (gts[:, 0] != gts[:, 1])
-            hom_var = called & (gts[:, 0] == gts[:, 1]) & (gts[:, 0] > 0)
-            rows.append(
-                {
-                    "sample": name,
-                    "call_rate": round(float(called.mean()), 5),
-                    "n_het": int(het.sum()),
-                    "n_hom_var": int(hom_var.sum()),
-                    "het_hom_ratio": round(float(het.sum() / max(int(hom_var.sum()), 1)), 4),
-                }
-            )
-        per_sample = pd.DataFrame(rows)
+    # per-sample: call rate, het/hom ratio (collected in the AF pass above)
+    if per_sample_rows:
+        per_sample = pd.DataFrame(per_sample_rows)
         rep.add_section("Per-sample statistics")
         rep.add_table(per_sample)
 

@@ -105,9 +105,19 @@ def _apply_query(df: pd.DataFrame, query: str | None) -> pd.Series:
         return pd.Series(True, index=df.index)
     try:
         return df.eval(query).fillna(False).astype(bool)
-    except Exception as e:  # noqa: BLE001 — a bad query degrades to "no filter"
-        logger.warning("filter query %r failed (%s); treating as pass-all", query, e)
-        return pd.Series(True, index=df.index)
+    except Exception as e:
+        # silently passing everything would publish UNFILTERED numbers
+        # under the "filtered" h5 keys of a clinical report — hard error
+        raise ValueError(f"filter query {query!r} failed: {e}") from e
+
+
+def _loci_mask(fm_df: pd.DataFrame, loci: set[tuple]) -> pd.Series:
+    """Vectorized (chrom, pos) membership — the per-read loop version cost
+    minutes on WGS featuremaps."""
+    if not loci:
+        return pd.Series(False, index=fm_df.index)
+    mi = pd.MultiIndex.from_arrays([fm_df["chrom"], fm_df["pos"].astype(int)])
+    return pd.Series(mi.isin(list(loci)), index=fm_df.index)
 
 
 def mutation_type_counts(sig) -> pd.DataFrame:
@@ -159,18 +169,18 @@ def tumor_fraction_tables(fm_df: pd.DataFrame, sig_df: pd.DataFrame,
     sig_pass = _apply_query(sig_df, sig_query)
     sig_loci_all = set(zip(sig_df["chrom"], sig_df["pos"].astype(int)))
     sig_loci_filt = set(zip(sig_df.loc[sig_pass, "chrom"], sig_df.loc[sig_pass, "pos"].astype(int)))
-    fm_loci = list(zip(fm_df["chrom"], fm_df["pos"].astype(int)))
+    on_filt = _loci_mask(fm_df, sig_loci_filt)
+    on_all = _loci_mask(fm_df, sig_loci_all)
 
     all_reads = pd.Series(True, index=fm_df.index)
     # key halves name (signature filter state, featuremap/read filter state)
     combos = {
-        "filt_signature_filt_featuremap": (read_pass, sig_loci_filt),
-        "unfilt_signature_filt_featuremap": (read_pass, sig_loci_all),
-        "filt_signature_unfilt_featuremap": (all_reads, sig_loci_filt),
+        "filt_signature_filt_featuremap": (read_pass, on_filt, sig_loci_filt),
+        "unfilt_signature_filt_featuremap": (read_pass, on_all, sig_loci_all),
+        "filt_signature_unfilt_featuremap": (all_reads, on_filt, sig_loci_filt),
     }
     out: dict[str, pd.DataFrame] = {}
-    for tag, (rmask, loci) in combos.items():
-        on = pd.Series([loc in loci for loc in fm_loci], index=fm_df.index)
+    for tag, (rmask, on, loci) in combos.items():
         support = fm_df[on & rmask]
         per_locus = (support.groupby(["chrom", "pos"]).size().rename("n_supporting_reads")
                      .reset_index()) if len(support) else \
@@ -259,10 +269,7 @@ def run(argv) -> int:
     matched = pd.Series(False, index=fm_df.index) if fm_df is not None else None
     if sig is not None and fm_df is not None:
         sig_df = _info_frame(sig, args.signature_filter_query, extra=("AF",))
-        loci = set(zip(sig_df["chrom"], sig_df["pos"].astype(int)))
-        matched = pd.Series([(c, int(p)) in loci
-                             for c, p in zip(fm_df["chrom"], fm_df["pos"])],
-                            index=fm_df.index)
+        matched = _loci_mask(fm_df, set(zip(sig_df["chrom"], sig_df["pos"].astype(int))))
 
         # --- matched signature analysis (cells 10-15) ---------------------
         mut = mutation_type_counts(sig)

@@ -57,7 +57,11 @@ def parse_args(argv: list[str]):
     ap.add_argument("--ignore_filter_status", action="store_true")
     ap.add_argument("--flow_order", default="TGCA")
     ap.add_argument("--output_suffix", default="")
-    ap.add_argument("--concordance_tool", default="native", help="native haplotype matcher (VCFEVAL-equivalent)")
+    ap.add_argument("--concordance_tool", default="native",
+                    choices=["native", "VCFEVAL", "vcfeval", "GC"],
+                    help="native/vcfeval: haplotype matcher (VCFEVAL-equivalent); "
+                         "GC: exact-position GenotypeConcordance joins "
+                         "(docs/run_comparison_pipeline.md:76-77)")
     ap.add_argument("--disable_reinterpretation", action="store_true",
                     help="skip the haplotype-rescue (representation repair) matching stage")
     ap.add_argument("--is_mutect", action="store_true")
@@ -116,6 +120,71 @@ def _restrict(table: VariantTable, intervals: bedio.IntervalSet) -> VariantTable
     return _subset(table, np.asarray(mask))
 
 
+class _GCResult:
+    """match_contig-shaped result from the genotype-concordance join."""
+
+    __slots__ = ("call_tp", "call_tp_gt", "truth_tp", "truth_tp_gt", "call_truth_idx")
+
+    def __init__(self, call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx):
+        self.call_tp = call_tp
+        self.call_tp_gt = call_tp_gt
+        self.truth_tp = truth_tp
+        self.truth_tp_gt = truth_tp_gt
+        self.call_truth_idx = call_truth_idx
+
+
+def genotype_concordance_match(calls: VariantTable, truth: VariantTable) -> _GCResult:
+    """The "GC" comparison flavor (--concordance_tool GC,
+    docs/run_comparison_pipeline.md:76-77): picard GenotypeConcordance-
+    style EXACT position joins — no haplotype search, no representation
+    repair. A call is tp when a truth record at the same (pos) carries an
+    overlapping called ALT allele; tp_gt additionally requires the same
+    called-allele multiset.
+    """
+    def called_alleles(table):
+        gts = table.genotypes()
+        out = []
+        for i in range(len(table)):
+            alleles = [table.ref[i]] + ([] if table.alt[i] in (".", "") else table.alt[i].split(","))
+            called = [alleles[a] for a in gts[i] if 0 <= a < len(alleles)]
+            alt_called = {alleles[a] for a in gts[i] if 0 < a < len(alleles)}
+            out.append((tuple(sorted(called)), alt_called))
+        return out
+
+    c_all = called_alleles(calls)
+    t_all = called_alleles(truth)
+    # every truth record per position — decomposed multiallelics put
+    # several records at one pos, and a call must match against ANY of them
+    t_by_pos: dict[int, list[int]] = {}
+    for j in range(len(truth)):
+        t_by_pos.setdefault(int(truth.pos[j]), []).append(j)
+
+    n_c, n_t = len(calls), len(truth)
+    call_tp = np.zeros(n_c, dtype=bool)
+    call_tp_gt = np.zeros(n_c, dtype=bool)
+    truth_tp = np.zeros(n_t, dtype=bool)
+    truth_tp_gt = np.zeros(n_t, dtype=bool)
+    call_truth_idx = np.full(n_c, -1, dtype=np.int64)
+    for i in range(n_c):
+        cands = t_by_pos.get(int(calls.pos[i]), [])
+        if not cands:
+            continue
+        best, exact = -1, False
+        for j in cands:
+            if c_all[i][1] & t_all[j][1]:
+                if c_all[i][0] == t_all[j][0]:
+                    best, exact = j, True
+                    break
+                if best < 0:
+                    best = j
+        call_truth_idx[i] = best if best >= 0 else cands[0]
+        if best >= 0:
+            call_tp[i] = truth_tp[best] = True
+            if exact:
+                call_tp_gt[i] = truth_tp_gt[best] = True
+    return _GCResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
+
+
 def build_concordance_frame(
     calls: VariantTable,
     truth: VariantTable,
@@ -127,6 +196,7 @@ def build_concordance_frame(
     flow_order: str = "TGCA",
     is_mutect: bool = False,
     reinterpret: bool = True,
+    tool: str = "native",
 ) -> pd.DataFrame:
     """Match + annotate -> one concordance DataFrame over calls ∪ FN-truth.
 
@@ -148,14 +218,18 @@ def build_concordance_frame(
         tm = np.asarray(truth.chrom) == contig
         if contig not in fasta.references:
             continue
-        seq = fasta.fetch(contig, 0, fasta.get_reference_length(contig))
-        cs = make_side(calls.pos[cm], list(calls.ref[cm]),
-                       [a.split(",") if a not in (".", "") else [] for a in calls.alt[cm]],
-                       calls.genotypes()[cm])
-        ts = make_side(truth.pos[tm], list(truth.ref[tm]),
-                       [a.split(",") if a not in (".", "") else [] for a in truth.alt[tm]],
-                       truth.genotypes()[tm])
-        res = match_contig(cs, ts, seq, haplotype_rescue=reinterpret)
+        if tool == "GC":
+            res = genotype_concordance_match(_subset(calls, cm), _subset(truth, tm))
+        else:
+            # only the haplotype matcher needs the contig sequence
+            seq = fasta.fetch(contig, 0, fasta.get_reference_length(contig))
+            cs = make_side(calls.pos[cm], list(calls.ref[cm]),
+                           [a.split(",") if a not in (".", "") else [] for a in calls.alt[cm]],
+                           calls.genotypes()[cm])
+            ts = make_side(truth.pos[tm], list(truth.ref[tm]),
+                           [a.split(",") if a not in (".", "") else [] for a in truth.alt[tm]],
+                           truth.genotypes()[tm])
+            res = match_contig(cs, ts, seq, haplotype_rescue=reinterpret)
         call_tp[cm] = res.call_tp
         call_tp_gt[cm] = res.call_tp_gt
         truth_tp[tm] = res.truth_tp
@@ -322,6 +396,7 @@ def run(argv: list[str]) -> int:
             flow_order=args.flow_order,
             is_mutect=args.is_mutect,
             reinterpret=not args.disable_reinterpretation,
+            tool=args.concordance_tool,
         )
 
     if len(df) and (args.coverage_bw_high_quality or args.coverage_bw_all_quality):

@@ -54,7 +54,8 @@ def run_full_analysis(args) -> None:
 
     logger.info("allele frequency histogram")
     vtype = no_gt_stats.variant_type_labels(cols, hmer_len)
-    af_df = no_gt_stats.allele_freq_hist(table, vtype, sample=sample)
+    af = no_gt_stats._compute_af(table, sample=sample)  # shared with the scatters
+    af_df = no_gt_stats.allele_freq_hist(table, vtype, sample=sample, af=af)
 
     logger.info("snp motif statistics")
     snp_motifs = no_gt_stats.snp_statistics(table, cols, windows)
@@ -73,8 +74,10 @@ def run_full_analysis(args) -> None:
     vc = pd.Series(vtype).value_counts()
     vstats = vc.rename_axis("variant_type").reset_index(name="count")
     write_hdf(vstats, out_h5, key="variants_statistics", mode="a")
-    af = no_gt_stats._compute_af(table, sample=sample)
     dp = table.info_field("DP")
+    if np.all(np.isnan(dp)):  # no INFO/DP: depth from the sample column,
+        dp = table.format_numeric("DP", sample=sample, max_len=1,  # matching the AF source
+                                  missing=np.nan)[:, 0]
     ok = ~np.isnan(af)
     idx = np.nonzero(ok)[0]
     if len(idx) > 50_000:  # even stride keeps the genome-position spread

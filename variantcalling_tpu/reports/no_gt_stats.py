@@ -74,9 +74,15 @@ def variant_type_labels(cols, hmer_len: np.ndarray) -> np.ndarray:
     )
 
 
-def allele_freq_hist(table: VariantTable, vtype: np.ndarray, nbins: int = 100, sample: int = 0) -> pd.DataFrame:
-    """Per-variant-type AF histogram (VAF from FORMAT/VAF|AF, else AD/DP)."""
-    af = _compute_af(table, sample)
+def allele_freq_hist(table: VariantTable, vtype: np.ndarray, nbins: int = 100, sample: int = 0,
+                     af: np.ndarray | None = None) -> pd.DataFrame:
+    """Per-variant-type AF histogram (VAF from FORMAT/VAF|AF, else AD/DP).
+
+    ``af`` accepts a precomputed allele-fraction vector so callers that
+    also need it (the AF scatters) pay the per-record parse once.
+    """
+    if af is None:
+        af = _compute_af(table, sample)
     result = {}
     edges = np.linspace(0, 1, nbins + 1)
     for group in pd.unique(vtype):
