@@ -34,6 +34,7 @@ TOOLS: dict[str, str] = {
     "convert_h5_to_json": "variantcalling_tpu.pipelines.misc.convert_h5_to_json",
     "annotate_contig": "variantcalling_tpu.pipelines.vcfbed.annotate_contig",
     "intersect_bed_regions": "variantcalling_tpu.pipelines.vcfbed.intersect_bed_regions",
+    "find_runs_bed": "variantcalling_tpu.pipelines.misc.find_runs_bed",
     "index_vcf_file": "variantcalling_tpu.pipelines.misc.index_vcf_file",
     "remove_vcf_duplicates": "variantcalling_tpu.pipelines.misc.remove_vcf_duplicates",
     "remove_empty_files": "variantcalling_tpu.pipelines.misc.remove_empty_files",

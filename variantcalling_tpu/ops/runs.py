@@ -1,0 +1,99 @@
+"""Homopolymer run-length kernels: parallel scans instead of byte loops.
+
+The reference consumes homopolymer-runs BED artifacts (filter_variants
+--runs_file, run_comparison --runs_intervals) produced by external
+tooling, and samples hpol loci with per-position Python
+(ugvc/scripts/collect_hpol_table.py:65-117). Here run detection over a
+whole contig is a single device program:
+
+- ``run_lengths``: for every position, the length of the homopolymer run
+  CONTINUING rightward from it — a suffix recurrence
+  ``s[i] = eq[i] * (1 + s[i+1])`` computed with one
+  ``lax.associative_scan`` (O(log N) depth, no sequential walk);
+- ``run_starts``: boundary mask (position differs from its predecessor);
+- :func:`find_runs` assembles (start, length) pairs for runs of at least
+  ``min_length`` of real bases (code < 4).
+
+The same kernel runs position-sharded over a mesh via
+:mod:`variantcalling_tpu.parallel.halo` — each shard sees a right halo so
+runs crossing shard edges keep their full length (up to the halo cap).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _suffix_run(eq: jnp.ndarray) -> jnp.ndarray:
+    """s[i] = number of consecutive True at eq[i:], stopping at the first
+    False — a forward consecutive-True scan over the flipped array.
+
+    The associative form carries (count-at-segment-end, segment-all-True):
+    appending segment b to a gives count = b.count (+ a.count only when
+    ALL of b is True, so the run reaches back into a).
+    """
+
+    def comb(a, b):
+        ca, aa = a
+        cb, ab = b
+        return cb + jnp.where(ab, ca, 0), aa & ab
+
+    flipped = jnp.flip(eq)
+    counts, _ = jax.lax.associative_scan(comb, (flipped.astype(jnp.int32), flipped))
+    return jnp.flip(counts)
+
+
+def run_lengths(codes: jnp.ndarray) -> jnp.ndarray:
+    """(N,) int32: homopolymer run length extending rightward from each
+    position (the run the position belongs to, measured from it)."""
+    eq = codes[1:] == codes[:-1]
+    suffix = _suffix_run(eq)
+    return jnp.concatenate([1 + suffix, jnp.ones(1, jnp.int32)])
+
+
+def run_starts(codes: jnp.ndarray) -> jnp.ndarray:
+    """(N,) bool: position starts a run (differs from its predecessor)."""
+    return jnp.concatenate([jnp.ones(1, bool), codes[1:] != codes[:-1]])
+
+
+@jax.jit
+def _runs_program(codes: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    return run_starts(codes), run_lengths(codes)
+
+
+def select_runs(codes: np.ndarray, starts: np.ndarray, lengths: np.ndarray,
+                min_length: int) -> tuple[np.ndarray, np.ndarray]:
+    """(starts0, exact lengths) of real-base runs >= min_length from a
+    per-position (starts, lengths) scan — the ONE selection rule shared by
+    the single-device and sharded paths.
+
+    Sharded scans cap a length at the halo when a run crosses more than
+    one shard edge; since ``lengths`` is defined at EVERY position, a
+    capped run is stitched exactly by hopping to the continuation
+    (``lengths[s + len]``) while the base keeps matching. Only candidate
+    runs (already >= min_length) stitch, so the host loop touches a
+    handful of positions. Correctness requires halo >= min_length (a
+    capped length is always >= halo, so no qualifying run is missed).
+    """
+    codes = np.asarray(codes)
+    idx = np.nonzero(starts & (lengths >= min_length) & (codes < 4))[0]
+    ln = lengths[idx].astype(np.int64)
+    n = len(codes)
+    for k in range(len(idx)):
+        s = idx[k]
+        while s + ln[k] < n and codes[s + ln[k]] == codes[s]:
+            ln[k] += int(lengths[s + ln[k]])
+    return idx, ln
+
+
+def find_runs(codes: np.ndarray, min_length: int) -> tuple[np.ndarray, np.ndarray]:
+    """(starts0, lengths) of homopolymer runs >= min_length (real bases only).
+
+    ``codes`` is the uint8-encoded contig (A..T = 0..3, N = 4); the scan
+    runs on device, only the boundary masks return to the host.
+    """
+    starts, lengths = jax.device_get(_runs_program(jnp.asarray(codes)))
+    return select_runs(codes, starts, lengths, min_length)

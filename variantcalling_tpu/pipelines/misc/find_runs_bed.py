@@ -1,0 +1,75 @@
+"""find_runs_bed — homopolymer-runs BED from a reference FASTA, on device.
+
+The reference treats the runs file (filter_variants --runs_file,
+run_comparison --runs_intervals) as an externally produced artifact; this
+tool generates it natively. Per contig the encoded sequence goes to the
+device once and run detection is a single parallel-scan program
+(ops/runs.find_runs); multi-device processes shard the position axis over
+the mesh with halo exchange (parallel/halo.sharded_run_lengths) — the
+framework's sequence-parallel path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+import jax
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.io.fasta import FastaReader, encode_seq
+from variantcalling_tpu.ops import runs as rops
+
+
+def parse_args(argv):
+    ap = argparse.ArgumentParser(prog="find_runs_bed", description=run.__doc__)
+    ap.add_argument("--reference", required=True, help="indexed reference FASTA")
+    ap.add_argument("--output_bed", required=True)
+    ap.add_argument("--min_length", type=int, default=10,
+                    help="minimum homopolymer run length to emit")
+    ap.add_argument("--contigs", nargs="*", default=None,
+                    help="restrict to these contigs (default: all)")
+    ap.add_argument("--halo", type=int, default=256,
+                    help="shard halo for the multi-device scan (must be >= min_length; "
+                         "longer runs are stitched exactly on the host)")
+    return ap.parse_args(argv)
+
+
+def contig_runs(codes: np.ndarray, min_length: int, halo: int = 256) -> tuple[np.ndarray, np.ndarray]:
+    """(starts0, exact lengths) for one contig; sharded scan on multi-device.
+
+    Both paths share ops/runs.select_runs, which also stitches any
+    halo-capped lengths — the emitted BED is identical on 1 or N devices.
+    """
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        from variantcalling_tpu.parallel.halo import sharded_run_lengths
+        from variantcalling_tpu.parallel.mesh import make_mesh
+
+        if halo < min_length:
+            raise ValueError(f"--halo {halo} must be >= --min_length {min_length}")
+        starts, lengths = sharded_run_lengths(codes, make_mesh(n_model=1), halo=halo)
+        return rops.select_runs(codes, starts, lengths, min_length)
+    return rops.find_runs(codes, min_length)
+
+
+def run(argv) -> int:
+    """Write a BED of homopolymer runs >= min_length."""
+    args = parse_args(argv)
+    n_total = 0
+    with FastaReader(args.reference) as fasta, open(args.output_bed, "w") as out:
+        contigs = args.contigs or fasta.references
+        for contig in contigs:
+            seq = encode_seq(fasta.fetch(contig, 0, fasta.get_reference_length(contig)))
+            starts, lengths = contig_runs(seq, args.min_length, halo=args.halo)
+            for s, ln in zip(starts, lengths):
+                out.write(f"{contig}\t{int(s)}\t{int(s + ln)}\n")
+            n_total += len(starts)
+    logger.info("%d runs >= %dbp -> %s", n_total, args.min_length, args.output_bed)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
