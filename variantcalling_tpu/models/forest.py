@@ -228,9 +228,12 @@ def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_predictor(forest: FlatForest, n_features: int | None = None):
-    """Best inference strategy for the active backend: GEMM encoding on
-    TPU-class devices when trees are small enough for the routing matmul,
-    else the gather walk. Returns a jittable fn(x) -> scores."""
+    """Best inference strategy for the active backend: the pallas fused
+    per-tree kernel on TPU (VCTPU_PALLAS=0 opts out), the jnp GEMM
+    encoding on other accelerators, the gather walk on CPU / big trees.
+    Returns a jittable fn(x) -> scores."""
+    import os
+
     gf = to_gemm(forest, n_features)
     try:
         backend = jax.default_backend()
@@ -238,6 +241,19 @@ def make_predictor(forest: FlatForest, n_features: int | None = None):
         backend = "cpu"
     use_gemm = gf.n_leaves <= GEMM_MAX_LEAVES and backend != "cpu"
     if use_gemm:
+        if backend == "tpu" and os.environ.get("VCTPU_PALLAS", "1") != "0":
+            try:
+                from variantcalling_tpu.models.forest_pallas import make_gemm_pallas_predictor
+
+                fn = make_gemm_pallas_predictor(gf)
+                # lowering failures only surface at the first call — warm up
+                # HERE so the documented fallback holds for every caller,
+                # not just ones that wrap their own calls
+                n_feat = gf.a.shape[1]
+                jax.block_until_ready(jax.jit(fn)(jnp.zeros((1, n_feat), jnp.float32)))
+                return fn
+            except Exception:  # noqa: BLE001 — kernel gaps fall back to jnp GEMM
+                pass
         return lambda x: predict_score_gemm(gf, x)
     return lambda x: predict_score(forest, x)
 

@@ -1,0 +1,126 @@
+"""Pallas TPU kernel for GEMM-forest inference.
+
+The jnp formulation (models/forest.predict_score_gemm) scans trees with
+three matmuls per step; each step's (N, I) decision and (N, L) routing
+intermediates round-trip through HBM unless XLA happens to fuse them.
+This kernel keeps the WHOLE per-tree chain in VMEM:
+
+    grid = (variant tiles, trees); per step the (TILE_N, F) feature tile
+    and tree t's tables sit in VMEM, and
+
+        xf    = x @ a[t]          (MXU, HIGHEST precision feature pick)
+        d     = xf <= thr[t]      (VPU)
+        match = d @ m2[t] + c[t]  (MXU; exact small ints)
+        hit   = match == plen[t]  (VPU)
+        out  += hit @ value[t]    (MXU accumulate into the output block)
+
+    Only the (TILE_N, 1) score block ever leaves VMEM — per-tree
+    intermediates never touch HBM. Trees iterate innermost, so the output
+    block revisits and accumulates (TPU grids run sequentially).
+
+Integration: models/forest.make_predictor routes here on TPU backends
+(VCTPU_PALLAS=0 opts out); CPU tests run the same kernel in interpreter
+mode. Forests with missing-value routing (default_left) use the jnp path
+— NaN-bearing inputs need the extra mask matmul.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+TILE_N = 512
+
+
+def _tree_step_kernel(x_ref, a_ref, thr_ref, m2_ref, c_ref, plen_ref, val_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    x = x_ref[:]  # (TILE_N, F)
+    a = a_ref[0]  # (F, I)
+    # feature pick must keep f32 values exact (thresholds compare tightly)
+    xf = jax.lax.dot_general(x, a, (((1,), (0,)), ((), ())),
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+    d = (xf <= thr_ref[0][None, :]).astype(jnp.float32)  # (TILE_N, I)
+    # routing operands are exact small integers — default precision is safe
+    match = jax.lax.dot_general(d, m2_ref[0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    match = match + c_ref[0][None, :]
+    hit = (match == plen_ref[0][None, :]).astype(jnp.float32)  # (TILE_N, L)
+    s = jax.lax.dot_general(hit, val_ref[0][:, None], (((1,), (0,)), ((), ())),
+                            precision=jax.lax.Precision.HIGHEST,
+                            preferred_element_type=jnp.float32)  # (TILE_N, 1)
+    out_ref[:] += s
+
+
+def _margin_pallas(tables, x, interpret: bool) -> jnp.ndarray:
+    """Summed per-tree margins for a PADDED (N, F) f32 matrix."""
+    from jax.experimental import pallas as pl
+
+    a, thr, m2, c, plen, value = tables
+    t, f, i = a.shape
+    l = m2.shape[2]
+    n = x.shape[0]
+    assert n % TILE_N == 0
+
+    out = pl.pallas_call(
+        _tree_step_kernel,
+        grid=(n // TILE_N, t),
+        in_specs=[
+            pl.BlockSpec((TILE_N, f), lambda bi, ti: (bi, 0)),
+            pl.BlockSpec((1, f, i), lambda bi, ti: (ti, 0, 0)),
+            pl.BlockSpec((1, i), lambda bi, ti: (ti, 0)),
+            pl.BlockSpec((1, i, l), lambda bi, ti: (ti, 0, 0)),
+            pl.BlockSpec((1, l), lambda bi, ti: (ti, 0)),
+            pl.BlockSpec((1, l), lambda bi, ti: (ti, 0)),
+            pl.BlockSpec((1, l), lambda bi, ti: (ti, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_N, 1), lambda bi, ti: (bi, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        interpret=interpret,
+    )(x, a, thr, m2, c, plen, value)
+    return out[:, 0]
+
+
+def make_gemm_pallas_predictor(gf, interpret: bool | None = None):
+    """fn(x) -> scores for a GemmForest, running the pallas kernel.
+
+    Raises ValueError for forests the kernel does not cover (missing-value
+    routing); callers fall back to the jnp GEMM path.
+    """
+    if gf.dleft is not None:
+        raise ValueError("pallas forest kernel does not implement default_left routing")
+    if interpret is None:
+        try:
+            interpret = jax.default_backend() != "tpu"
+        except Exception:  # noqa: BLE001
+            interpret = True
+    tables = (
+        jnp.asarray(gf.a),
+        jnp.asarray(gf.thr),
+        jnp.asarray(gf.m2),
+        jnp.asarray(gf.c),
+        jnp.asarray(gf.plen),
+        jnp.asarray(gf.value),
+    )
+    n_trees = gf.m2.shape[0]
+    agg, base = gf.aggregation, gf.base_score
+
+    def predict(x):
+        n = x.shape[0]
+        pad = (-n) % TILE_N
+        xp = jnp.pad(x.astype(jnp.float32), ((0, pad), (0, 0)))
+        total = _margin_pallas(tables, xp, interpret)[:n]
+        if agg == "mean":
+            return total / n_trees
+        if agg == "logit_sum":
+            return jax.nn.sigmoid(total + base)
+        raise ValueError(f"unknown aggregation {agg!r}")
+
+    return predict

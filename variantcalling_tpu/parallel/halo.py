@@ -21,9 +21,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from variantcalling_tpu.parallel.mesh import DATA_AXIS
+from variantcalling_tpu.parallel.mesh import DATA_AXIS, pad_to_multiple
 
 
 def halo_exchange_1d(block: jnp.ndarray, halo_left: int, halo_right: int,
@@ -57,7 +57,8 @@ def halo_exchange_1d(block: jnp.ndarray, halo_left: int, halo_right: int,
 
 
 def sharded_run_lengths(codes: np.ndarray, mesh: Mesh, halo: int = 256,
-                        fill: int = 255) -> tuple[np.ndarray, np.ndarray]:
+                        fill: int = 255,
+                        min_halo: int | None = None) -> tuple[np.ndarray, np.ndarray]:
     """(run_starts bool, run_lengths int32) for a position-sharded genome.
 
     The sequence is padded to a dp multiple with an OUT-OF-BAND code
@@ -75,12 +76,15 @@ def sharded_run_lengths(codes: np.ndarray, mesh: Mesh, halo: int = 256,
 
     n = len(codes)
     n_dp = mesh.shape[DATA_AXIS]
-    pad = (-n) % n_dp
-    padded = np.concatenate([np.asarray(codes, dtype=np.uint8),
-                             np.full(pad, fill, np.uint8)]) if pad else np.asarray(codes, np.uint8)
+    padded, _ = pad_to_multiple(np.asarray(codes, np.uint8), n_dp, fill=fill)
     # a halo is at most one whole neighbor block (ppermute moves block
     # edges, not transitive chains)
     halo = min(halo, len(padded) // n_dp)
+    if min_halo is not None and halo < min_halo:
+        raise ValueError(
+            f"effective halo {halo} (shards of {len(padded) // n_dp}) is below the "
+            f"caller's correctness floor {min_halo}; use fewer shards or the "
+            "single-device scan for short sequences")
 
     def body(local):
         ext = halo_exchange_1d(local, 1, halo, fill=fill)

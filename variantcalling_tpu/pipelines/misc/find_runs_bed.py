@@ -44,13 +44,16 @@ def contig_runs(codes: np.ndarray, min_length: int, halo: int = 256) -> tuple[np
     halo-capped lengths — the emitted BED is identical on 1 or N devices.
     """
     n_dev = len(jax.devices())
-    if n_dev > 1:
+    # tiny contigs (alt/decoy scaffolds) single-device: their shard blocks
+    # would clamp the halo below the select_runs correctness floor
+    if n_dev > 1 and len(codes) >= n_dev * max(min_length, 64):
         from variantcalling_tpu.parallel.halo import sharded_run_lengths
         from variantcalling_tpu.parallel.mesh import make_mesh
 
         if halo < min_length:
             raise ValueError(f"--halo {halo} must be >= --min_length {min_length}")
-        starts, lengths = sharded_run_lengths(codes, make_mesh(n_model=1), halo=halo)
+        starts, lengths = sharded_run_lengths(codes, make_mesh(n_model=1), halo=halo,
+                                              min_halo=min_length)
         return rops.select_runs(codes, starts, lengths, min_length)
     return rops.find_runs(codes, min_length)
 
