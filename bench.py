@@ -79,13 +79,16 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 
 def device_throughput(tile: int, n_tiles: int) -> dict:
     # the TPU forest path may route through the pallas kernel
-    # (models/forest_pallas); if its lowering fails on this hardware,
-    # disable it (env honored by every later phase too) and retry on the
-    # jnp GEMM path so the bench still lands a device number
+    # (models/forest_pallas). make_predictor already warms it up and falls
+    # back on lowering failures; this guard covers EXECUTION-time kernel
+    # faults only — identified by name, so unrelated failures (OOM, bad
+    # args) surface instead of being blamed on the kernel
     try:
         return _device_throughput_impl(tile, n_tiles)
-    except Exception:
-        if os.environ.get("VCTPU_PALLAS", "1") == "0":
+    except Exception as e:
+        blame = f"{type(e).__name__}: {e}".lower()
+        if os.environ.get("VCTPU_PALLAS", "1") == "0" or \
+                not any(k in blame for k in ("pallas", "mosaic")):
             raise
         os.environ["VCTPU_PALLAS"] = "0"
         print("BENCH_PHASE hot retrying with VCTPU_PALLAS=0", flush=True)

@@ -434,3 +434,32 @@ def test_no_gt_report_scatter_and_stats(tmp_path):
     rc = report_wo_gt.run(["--input_h5", prefix + ".h5", "--html_output", html])
     assert rc == 0
     assert "Variants statistics" in open(html).read()
+
+
+def test_nexusplt_interactive_html(tmp_path):
+    """Line figures export as self-contained interactive SVG pages (the
+    mpld3-html analog, reference nexusplt.py:41-89); figures without line
+    data fall back to the embedded-png page."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from variantcalling_tpu.reports import nexusplt
+
+    fig, ax = plt.subplots()
+    ax.plot([1, 2, 3], [4.0, 5.0, 6.0], label="recall")
+    ax.plot([1, 2, 3], [1.0, 0.5, 0.25], label="precision")
+    (path,) = nexusplt.save(fig, "curves", str(tmp_path), formats=("html",))
+    text = open(path).read()
+    assert "<svg" not in text  # svg is built by the script at view time
+    assert "polyline" in text and "render(document" in text
+    assert '"label": "recall"' in text and "base64," in text
+    plt.close(fig)
+
+    fig2, ax2 = plt.subplots()
+    ax2.bar([1, 2], [3, 4])  # bars carry no line data
+    (path2,) = nexusplt.save(fig2, "bars", str(tmp_path), formats=("html",))
+    text2 = open(path2).read()
+    assert "render(document" not in text2 and "base64," in text2
+    plt.close(fig2)

@@ -1,9 +1,14 @@
 """Figure saver: png / html / json export (reference ugvc/reports/nexusplt.py:41-89).
 
 The reference saves matplotlib figures as png, mpld3 html, and mpld3 json.
-mpld3 is not in this image, so html embeds the png (self-contained report
-fragment) and json serializes the axes data (lines/labels/limits) — enough
-for downstream dashboards to re-plot.
+mpld3 is not in this image, so:
+
+- ``html`` renders the serialized line data as an INTERACTIVE inline-SVG
+  page (hover readout of the nearest data point, click-to-toggle series)
+  — the mpld3-html equivalent with zero dependencies — with the static
+  png embedded as a fallback when a figure carries no line data;
+- ``json`` serializes the axes data (lines/labels/limits), enough for
+  downstream dashboards to re-plot.
 """
 
 from __future__ import annotations
@@ -23,14 +28,22 @@ def save(fig, name: str, outdir: str = ".", formats: tuple[str, ...] = ("png",))
         if fmt == "png":
             fig.savefig(path, format="png", bbox_inches="tight", dpi=120)
         elif fmt == "html":
+            try:  # non-numeric (e.g. datetime) axes cannot serialize
+                data = _fig_to_dict(fig)
+                interactive = any(ax["lines"] for ax in data["axes"])
+            except (TypeError, ValueError):
+                interactive = False
             buf = io.BytesIO()
             fig.savefig(buf, format="png", bbox_inches="tight", dpi=120)
             b64 = base64.b64encode(buf.getvalue()).decode()
             with open(path, "w") as fh:
-                fh.write(
-                    f'<html><body><img alt="{name}" '
-                    f'src="data:image/png;base64,{b64}"/></body></html>'
-                )
+                if interactive:
+                    fh.write(_interactive_html(name, data, b64))
+                else:  # no serializable line data: static fallback page
+                    fh.write(
+                        f'<html><body><img alt="{name}" '
+                        f'src="data:image/png;base64,{b64}"/></body></html>'
+                    )
         elif fmt == "json":
             with open(path, "w") as fh:
                 json.dump(_fig_to_dict(fig), fh)
@@ -46,6 +59,80 @@ def save_all(figures: dict, outdir: str = ".", formats: tuple[str, ...] = ("png"
     for name, fig in figures.items():
         out.extend(save(fig, name, outdir, formats))
     return out
+
+
+_PALETTE = ["#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+            "#8c564b", "#e377c2", "#7f7f7f", "#bcbd22", "#17becf"]
+
+_JS = """
+function render(figEl, FIG) {
+  const W = 560, H = 320, M = {l: 55, r: 12, t: 28, b: 40};
+  FIG.axes.forEach((ax) => {
+    if (!ax.lines.length) return;
+    const svgNS = 'http://www.w3.org/2000/svg';
+    const wrap = document.createElement('div');
+    const svg = document.createElementNS(svgNS, 'svg');
+    svg.setAttribute('width', W); svg.setAttribute('height', H);
+    svg.style.border = '1px solid #ccc'; svg.style.background = '#fff';
+    const [x0, x1] = ax.xlim, [y0, y1] = ax.ylim;
+    const sx = v => M.l + (v - x0) / (x1 - x0 || 1) * (W - M.l - M.r);
+    const sy = v => H - M.b - (v - y0) / (y1 - y0 || 1) * (H - M.t - M.b);
+    const txt = (s, x, y, a) => { const t = document.createElementNS(svgNS, 'text');
+      t.textContent = s; t.setAttribute('x', x); t.setAttribute('y', y);
+      t.setAttribute('font-size', '11'); if (a) t.setAttribute('text-anchor', a);
+      svg.appendChild(t); return t; };
+    txt(ax.title, W / 2, 16, 'middle');
+    txt(ax.xlabel, W / 2, H - 8, 'middle');
+    txt(ax.ylabel, 12, H / 2, 'middle').setAttribute('transform',
+      `rotate(-90 12 ${H / 2})`);
+    const tip = txt('', 0, 0); tip.setAttribute('font-weight', 'bold');
+    const polys = ax.lines.map((ln, li) => {
+      const p = document.createElementNS(svgNS, 'polyline');
+      p.setAttribute('points', ln.x.map((v, i) => `${sx(v)},${sy(ln.y[i])}`).join(' '));
+      p.setAttribute('fill', 'none'); p.setAttribute('stroke', PALETTE[li % PALETTE.length]);
+      p.setAttribute('stroke-width', '1.6'); svg.appendChild(p); return p; });
+    svg.addEventListener('mousemove', (ev) => {
+      const r = svg.getBoundingClientRect();
+      const mx = ev.clientX - r.left, my = ev.clientY - r.top;
+      let best = null, bd = 1e18;
+      ax.lines.forEach((ln, li) => ln.x.forEach((v, i) => {
+        const d = (sx(v) - mx) ** 2 + (sy(ln.y[i]) - my) ** 2;
+        if (d < bd) { bd = d; best = [v, ln.y[i], li]; } }));
+      if (best && bd < 900) {
+        tip.textContent = `${ax.lines[best[2]].label || 'series ' + best[2]}: ` +
+          `(${best[0].toPrecision(4)}, ${best[1].toPrecision(4)})`;
+        tip.setAttribute('x', M.l + 4); tip.setAttribute('y', M.t + 2);
+      } else tip.textContent = ''; });
+    const legend = document.createElement('div');
+    ax.lines.forEach((ln, li) => {
+      const b = document.createElement('span');
+      b.textContent = '\\u25A0 ' + (ln.label || 'series ' + li);
+      b.style.color = PALETTE[li % PALETTE.length];
+      b.style.cursor = 'pointer'; b.style.marginRight = '10px';
+      b.onclick = () => { const hid = polys[li].style.display === 'none';
+        polys[li].style.display = hid ? '' : 'none';
+        b.style.opacity = hid ? 1 : 0.35; };
+      legend.appendChild(b); });
+    wrap.appendChild(svg); wrap.appendChild(legend); figEl.appendChild(wrap);
+  });
+}
+"""
+
+
+def _interactive_html(name: str, data: dict, png_b64: str) -> str:
+    """Self-contained interactive page: SVG lines + hover readout +
+    legend toggles, static png fallback behind a details fold."""
+    return (
+        "<html><head><meta charset='utf-8'>"
+        f"<title>{name}</title></head><body>\n"
+        f"<div id='fig'></div>\n"
+        f"<details><summary>static image</summary>"
+        f"<img alt='{name}' src='data:image/png;base64,{png_b64}'/></details>\n"
+        f"<script>\nconst PALETTE = {json.dumps(_PALETTE)};\n"
+        f"const FIG = {json.dumps(data)};\n{_JS}\n"
+        "render(document.getElementById('fig'), FIG);\n"
+        "</script></body></html>\n"
+    )
 
 
 def _fig_to_dict(fig) -> dict:
