@@ -76,3 +76,21 @@ def test_percentiles_and_stats():
     assert abs(st["mean"] - 20) < 1e-5
     assert st["median"] == 10
     assert st["percent_larger_than_20x"] == 50.0
+
+
+def test_depth_histogram_matmul_matches_bincount(rng):
+    """The MXU matmul histogram (TPU path) is count-exact vs bincount,
+    with and without masks, incl. non-chunk-multiple lengths."""
+    import jax.numpy as jnp
+
+    from variantcalling_tpu.ops import coverage as cops
+
+    d = rng.integers(0, 1200, size=30000).astype(np.int32)  # some beyond clip
+    mask = rng.random(30000) < 0.7
+    for m in (None, mask):
+        ref = np.asarray(cops.depth_histogram(jnp.asarray(d), None if m is None else jnp.asarray(m),
+                                              method="bincount"))
+        got = np.asarray(cops.depth_histogram(jnp.asarray(d), None if m is None else jnp.asarray(m),
+                                              method="matmul"))
+        np.testing.assert_array_equal(got, ref)
+    assert ref.sum() <= 30000

@@ -37,17 +37,50 @@ def binned_mean(depth: jnp.ndarray, window: int) -> jnp.ndarray:
     return sums / counts
 
 
+#: chunk length for the accelerator histogram path (one-hot rows per matmul)
+_HIST_CHUNK = 1 << 13
+
+
 def depth_histogram(depth: jnp.ndarray, mask: jnp.ndarray | None = None,
-                    max_depth: int = MAX_DEPTH_BIN) -> jnp.ndarray:
-    """(max_depth+1,) float histogram of clipped depth, optionally masked."""
+                    max_depth: int = MAX_DEPTH_BIN, method: str | None = None) -> jnp.ndarray:
+    """(max_depth+1,) float histogram of clipped depth, optionally masked.
+
+    ``method``: "bincount" (scatter-add — fine on CPU), "matmul" (chunked
+    one-hot x ones contraction — scatter-add SERIALIZES on TPU, the same
+    cliff the GBT trainer documents at models/boosting.py:99; the MXU path
+    keeps histogramming at matmul rate), or None to pick by backend.
+    """
+    if method is None:
+        try:
+            method = "bincount" if jax.default_backend() == "cpu" else "matmul"
+        except Exception:  # noqa: BLE001 — backend probe must not break tracing
+            method = "bincount"
     clipped = jnp.clip(depth, 0, max_depth)
+    n_bins = max_depth + 1
     if mask is not None:
         # masked-out positions route to a sacrificial bin then get dropped
         clipped = jnp.where(mask, clipped, max_depth + 1)
-        hist = jnp.bincount(clipped, length=max_depth + 2)[: max_depth + 1]
+        n_bins = max_depth + 2
+    if method == "bincount":
+        hist = jnp.bincount(clipped, length=n_bins)
     else:
-        hist = jnp.bincount(clipped, length=max_depth + 1)
-    return hist.astype(jnp.float32)
+        n = clipped.shape[0]
+        pad = (-n) % _HIST_CHUNK
+        # padding routes to an extra sacrificial column
+        chunks = jnp.pad(clipped, (0, pad), constant_values=n_bins).reshape(-1, _HIST_CHUNK)
+        ones = jnp.ones((_HIST_CHUNK,), jnp.bfloat16)
+
+        def step(acc, chunk):
+            oh = jax.nn.one_hot(chunk, n_bins + 1, dtype=jnp.bfloat16)  # (CH, B+1)
+            part = jax.lax.dot_general(ones, oh, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+            # per-chunk sums are exact in f32 (<= CH); accumulate as int32
+            # so whole-genome counts never hit the f32 integer ceiling
+            return acc + part.astype(jnp.int32), None
+
+        hist, _ = jax.lax.scan(step, jnp.zeros(n_bins + 1, jnp.int32), chunks)
+        hist = hist[:n_bins]
+    return hist[: max_depth + 1].astype(jnp.float32)
 
 
 def percentiles_from_histogram(hist: jnp.ndarray, qs: jnp.ndarray) -> jnp.ndarray:
