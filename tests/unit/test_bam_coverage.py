@@ -81,10 +81,6 @@ def test_percentiles_and_stats():
 def test_depth_histogram_matmul_matches_bincount(rng):
     """The MXU matmul histogram (TPU path) is count-exact vs bincount,
     with and without masks, incl. non-chunk-multiple lengths."""
-    import jax.numpy as jnp
-
-    from variantcalling_tpu.ops import coverage as cops
-
     d = rng.integers(0, 1200, size=30000).astype(np.int32)  # some beyond clip
     mask = rng.random(30000) < 0.7
     for m in (None, mask):

@@ -63,7 +63,7 @@ def depth_histogram(depth: jnp.ndarray, mask: jnp.ndarray | None = None,
         n_bins = max_depth + 2
     if method == "bincount":
         hist = jnp.bincount(clipped, length=n_bins)
-    else:
+    elif method == "matmul":
         n = clipped.shape[0]
         pad = (-n) % _HIST_CHUNK
         # padding routes to an extra sacrificial column
@@ -74,12 +74,16 @@ def depth_histogram(depth: jnp.ndarray, mask: jnp.ndarray | None = None,
             oh = jax.nn.one_hot(chunk, n_bins + 1, dtype=jnp.bfloat16)  # (CH, B+1)
             part = jax.lax.dot_general(ones, oh, (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
-            # per-chunk sums are exact in f32 (<= CH); accumulate as int32
-            # so whole-genome counts never hit the f32 integer ceiling
+            # per-chunk sums are exact in f32 (<= CH); int32 accumulation
+            # is exact to 2^31-1 per bin — one contig (<= 250M positions)
+            # can never overflow it; whole-GENOME single calls should go
+            # per-contig (as coverage_analysis does)
             return acc + part.astype(jnp.int32), None
 
         hist, _ = jax.lax.scan(step, jnp.zeros(n_bins + 1, jnp.int32), chunks)
         hist = hist[:n_bins]
+    else:
+        raise ValueError(f"unknown method {method!r}")
     return hist[: max_depth + 1].astype(jnp.float32)
 
 
