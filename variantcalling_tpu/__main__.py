@@ -96,6 +96,15 @@ def main(argv: list[str] | None = None) -> int:
     except ModuleNotFoundError as e:
         print(f"tool {tool!r} is not available yet: {e}", file=sys.stderr)
         return 3
+    # multi-host launch: VCTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID in the
+    # env turn any tool into one rank of a global mesh (parallel/distributed).
+    # Gated on the env so plain runs keep the lazy-import fast path.
+    import os
+
+    if os.environ.get("VCTPU_COORDINATOR") or os.environ.get("VCTPU_AUTO_DISTRIBUTED"):
+        from variantcalling_tpu.parallel.distributed import init_from_env
+
+        init_from_env()
     result = module.run(argv[1:])
     # tools may return rich results (e.g. vcfeval_flavors' rows); only
     # int-like returns are exit codes

@@ -512,7 +512,7 @@ def standard_genome_sharding():
     depending on which consumer ran first (round-2 VERDICT weak #6).
     Routing through this helper makes the key identical by construction.
     """
-    if len(jax.devices()) <= 1:
+    if len(jax.local_devices()) <= 1:
         return None
     from variantcalling_tpu.parallel.mesh import make_mesh, replicated
 

@@ -1,0 +1,139 @@
+"""Multi-host runtime: jax.distributed + global meshes + host-local bridging.
+
+The reference's only "distribution" is the filesystem between cromwell
+tasks (SURVEY §2.4/§5.8). Here multi-host scale rides JAX's distributed
+runtime: every host (process) initializes against one coordinator, the
+mesh spans ALL hosts' devices, and cross-host reductions are the same XLA
+collectives the single-host mesh uses — psum over ICI within a slice, DCN
+between slices, never the filesystem.
+
+Wire-up is env-driven so every CLI tool becomes multi-host without new
+flags: launch N copies of the same command with
+
+    VCTPU_COORDINATOR=host0:9731 VCTPU_NUM_PROCESSES=N VCTPU_PROCESS_ID=i
+
+(or rely on JAX's own cluster auto-detection on TPU pods, where
+``jax.distributed.initialize()`` needs no arguments).
+
+Proven end to end by tests/system/test_multihost.py: two actual
+processes, each holding 4 virtual CPU devices, form one 8-device mesh
+and psum host-local SEC sample shards into the identical cohort tensor
+on both hosts.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+_INITIALIZED = False
+
+
+def init_from_env() -> bool:
+    """Initialize jax.distributed when the env asks for it; idempotent.
+
+    Returns True when running multi-host (after initialization)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return jax.process_count() > 1
+    coord = os.environ.get("VCTPU_COORDINATOR")
+    if coord:
+        missing = [k for k in ("VCTPU_NUM_PROCESSES", "VCTPU_PROCESS_ID")
+                   if k not in os.environ]
+        if missing:
+            raise SystemExit(
+                f"VCTPU_COORDINATOR is set but {', '.join(missing)} is not — a "
+                "multi-host launch needs all three of VCTPU_COORDINATOR, "
+                "VCTPU_NUM_PROCESSES, VCTPU_PROCESS_ID")
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["VCTPU_NUM_PROCESSES"]),
+            process_id=int(os.environ["VCTPU_PROCESS_ID"]),
+        )
+        _INITIALIZED = True
+        return True
+    if os.environ.get("VCTPU_AUTO_DISTRIBUTED") == "1":
+        # TPU pods: coordinator/topology come from the cluster environment
+        jax.distributed.initialize()
+        _INITIALIZED = True
+        return jax.process_count() > 1
+    return False
+
+
+def global_mesh(n_model: int = 1) -> Mesh:
+    """(dp, mp) mesh over EVERY host's devices (jax.devices() is global
+    after jax.distributed.initialize)."""
+    devices = jax.devices()
+    n_data = len(devices) // n_model
+    return Mesh(np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model),
+                (DATA_AXIS, MODEL_AXIS))
+
+
+def host_local_to_global(local: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """Each host contributes its local block of the leading axis; the
+    result is one global sharded array."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(local, mesh, spec)
+
+
+def replicated_to_host(arr: jax.Array) -> np.ndarray:
+    """Fetch a replicated global array on any host."""
+    return np.asarray(arr.addressable_data(0))
+
+
+def allgather_concat(local: np.ndarray) -> np.ndarray:
+    """Concatenate every host's (possibly different-length) 1-D array.
+
+    Two collectives: lengths first, then the value arrays padded to the
+    max length (process_allgather needs uniform shapes). Single-process
+    returns the input unchanged.
+    """
+    if jax.process_count() <= 1:
+        return np.asarray(local)
+    from jax.experimental import multihost_utils
+
+    local = np.asarray(local)
+    lengths = multihost_utils.process_allgather(np.asarray([len(local)]))
+    lengths = np.asarray(lengths).reshape(-1)
+    m = int(lengths.max())
+    padded = np.pad(local, (0, m - len(local)))
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate([gathered[p, : int(lengths[p])] for p in range(len(lengths))])
+
+
+def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = None) -> np.ndarray:
+    """Cohort (L, A) sum of per-sample (S_local, L, A) counts held by EACH
+    host — BASELINE config 5 at pod scale: the sample axis spans hosts and
+    the reduction is one psum over the global mesh (ICI/DCN), no host
+    gather, no intermediate files.
+
+    Every host must call this collectively (same (L, A) trailing shape;
+    S_local may differ per host and need not divide the local device
+    count — zero rows pad it, and zeros are invisible to the sum); each
+    host returns the full cohort tensor.
+    """
+    mesh = mesh or global_mesh(n_model=1)
+    local_counts = np.asarray(local_counts)
+    n_local_dev = len(jax.local_devices())
+    pad = (-local_counts.shape[0]) % n_local_dev
+    if pad:
+        local_counts = np.concatenate(
+            [local_counts, np.zeros((pad, *local_counts.shape[1:]), local_counts.dtype)])
+    arr = host_local_to_global(local_counts, mesh, P(DATA_AXIS, None, None))
+
+    @jax.jit
+    def reduce(x):
+        return jax.lax.with_sharding_constraint(
+            x.sum(axis=0, dtype=jnp.float32), NamedSharding(mesh, P(None, None)))
+
+    with mesh:
+        out = reduce(arr)
+    return replicated_to_host(out)

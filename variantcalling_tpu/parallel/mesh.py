@@ -28,11 +28,15 @@ MODEL_AXIS = "mp"
 
 
 def make_mesh(n_data: int | None = None, n_model: int = 1, devices=None) -> Mesh:
-    """Create a (dp, mp) mesh over available devices.
+    """Create a (dp, mp) mesh over this process's LOCAL devices.
 
-    ``n_data=None`` uses all devices not claimed by ``n_model``.
+    ``n_data=None`` uses all devices not claimed by ``n_model``. Pipelines
+    run rank-local under multi-host launches (each rank owns its own
+    inputs and fetches its own outputs); meshes spanning every host's
+    devices are built explicitly via parallel.distributed.global_mesh for
+    collective reductions.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    devices = list(devices if devices is not None else jax.local_devices())
     if n_data is None:
         n_data = len(devices) // n_model
     use = n_data * n_model

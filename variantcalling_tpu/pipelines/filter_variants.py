@@ -262,7 +262,7 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
     """
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh, replicated
 
-    n_dev = len(jax.devices())
+    n_dev = len(jax.local_devices())
     mesh = make_mesh(n_model=1) if n_dev > 1 else None
     shard2 = data_sharding(mesh, 2) if mesh is not None else None
     chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
@@ -363,7 +363,7 @@ def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray
 
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
 
-    n_dev = len(jax.devices())
+    n_dev = len(jax.local_devices())
     mesh = make_mesh(n_model=1) if n_dev > 1 else None
     sharding = data_sharding(mesh, 2) if mesh is not None else None
     chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)

@@ -43,7 +43,7 @@ def contig_runs(codes: np.ndarray, min_length: int, halo: int = 256) -> tuple[np
     Both paths share ops/runs.select_runs, which also stitches any
     halo-capped lengths — the emitted BED is identical on 1 or N devices.
     """
-    n_dev = len(jax.devices())
+    n_dev = len(jax.local_devices())
     # tiny contigs (alt/decoy scaffolds) single-device: their shard blocks
     # would clamp the halo below the select_runs correctness floor
     if n_dev > 1 and len(codes) >= n_dev * max(min_length, 64):

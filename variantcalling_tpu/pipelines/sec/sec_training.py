@@ -61,7 +61,36 @@ def run(argv: list[str]) -> int:
             seen_count[k] = seen_count.get(k, 0) + 1
         logger.info("%s: %d loci", path, len(keys))
 
-    if args.use_mesh and per_sample:
+    multi_host = False
+    try:
+        import jax
+
+        multi_host = jax.process_count() > 1
+    except Exception:  # noqa: BLE001 — no jax runtime means single-host
+        pass
+
+    seen_global = None
+    if multi_host and per_sample:
+        # pod-scale cohort (BASELINE config 5): each RANK holds its own
+        # sample files. Ranks agree on the global locus union (allgather),
+        # then one psum over the global mesh builds the cohort counts AND
+        # the per-locus sample-presence tally used by --min_samples.
+        from variantcalling_tpu.parallel import distributed as dist
+
+        local_keys = np.unique(np.concatenate([k for k, _ in per_sample]))
+        all_keys = np.unique(dist.allgather_concat(local_keys))
+        n_alleles = per_sample[0][1].shape[1]
+        dense = np.zeros((len(per_sample), len(all_keys), n_alleles + 1), dtype=np.float32)
+        for s, (keys, counts) in enumerate(per_sample):
+            at = np.searchsorted(all_keys, keys)
+            dense[s, at, :n_alleles] = counts
+            dense[s, at, n_alleles] = 1.0  # presence column rides the same psum
+        total = dist.aggregate_counts_across_hosts(dense)
+        seen_global = total[:, n_alleles]
+        n_total = int(dist.allgather_concat(np.asarray([len(per_sample)])).sum())
+        db = SecDb(contigs=contigs, keys=all_keys,
+                   counts=total[:, :n_alleles].astype(np.float32), n_samples=n_total)
+    elif args.use_mesh and per_sample:
         # dense (S, L, A) over the union of loci -> one mesh psum
         from variantcalling_tpu.parallel.mesh import make_mesh
         from variantcalling_tpu.sec.aggregate import aggregate_on_mesh
@@ -76,7 +105,10 @@ def run(argv: list[str]) -> int:
     else:
         db = merge_sample_counts(contigs, per_sample)
 
-    keep = np.asarray([seen_count.get(int(k), 0) >= args.min_samples for k in db.keys])
+    if seen_global is not None:
+        keep = seen_global >= args.min_samples
+    else:
+        keep = np.asarray([seen_count.get(int(k), 0) >= args.min_samples for k in db.keys])
     db = SecDb(contigs=db.contigs, keys=db.keys[keep], counts=db.counts[keep], n_samples=db.n_samples)
     db.save(args.output_file)
     logger.info("SEC DB: %d loci from %d samples -> %s", len(db), db.n_samples, args.output_file)

@@ -85,7 +85,7 @@ def run(argv) -> int:
         n_layers=args.n_layers,
         learning_rate=args.learning_rate,
     )
-    n_dev = len(jax.devices())
+    n_dev = len(jax.local_devices())
     mesh = make_mesh(n_model=1) if n_dev > 1 else None
     params = dan.init_params(cfg, jax.random.PRNGKey(args.seed))
     optimizer = dan.make_optimizer(cfg)

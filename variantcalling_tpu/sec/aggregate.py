@@ -5,6 +5,9 @@ scale the natural layout is samples sharded across devices and the cohort
 noise tensor produced by one ``psum`` over the sample axis — ICI-resident,
 no host gather. ``aggregate_on_mesh`` is that program: a shard_map whose
 per-device body sums its local sample slab and psums across the mesh.
+Multi-HOST cohorts (each host holding its own sample files) go through
+``parallel.distributed.aggregate_counts_across_hosts``, the same psum
+over a global mesh spanning every host's devices.
 """
 
 from __future__ import annotations
