@@ -91,26 +91,33 @@ def test_two_process_global_mesh_psum(tmp_path):
 def test_two_rank_sec_training_cli(tmp_path):
     """Full sec_training CLI on two ranks, each holding its own sample
     VCFs: both must write the SAME cohort DB spanning all four samples —
-    the reference's cohort build has no multi-node mode at all."""
-    from tests.fixtures import make_genome, write_fasta  # noqa: F401 (genome unused; loci synthetic)
+    the reference's cohort build has no multi-node mode at all.
 
-    # four tiny sample VCFs: loci at 100/200 shared, 300 host1-only
-    def sample_vcf(path, loci_ad):
-        lines = ["##fileformat=VCFv4.2", "##contig=<ID=chr1,length=10000>",
-                 '##FORMAT=<ID=GT,Number=1,Type=String,Description="g">',
-                 '##FORMAT=<ID=AD,Number=R,Type=Integer,Description="a">',
-                 "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS"]
-        for pos, ad in loci_ad:
-            lines.append(f"chr1\t{pos}\t.\tA\tG\t50\tPASS\t.\tGT:AD\t0/1:{ad}")
+    The ranks deliberately see DIFFERENT contig sets (rank 0 only chr2,
+    rank 1 chr1+chr2 in a different index order): packed keys encode the
+    contig index, so the cohort is only correct if ranks canonicalize
+    contigs before the union."""
+
+    # tiny sample VCFs; loci given as (contig, pos, ad)
+    def sample_vcf(path, contig_decl, loci_ad):
+        lines = ["##fileformat=VCFv4.2"]
+        lines += [f"##contig=<ID={c},length=10000>" for c in contig_decl]
+        lines += ['##FORMAT=<ID=GT,Number=1,Type=String,Description="g">',
+                  '##FORMAT=<ID=AD,Number=R,Type=Integer,Description="a">',
+                  "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS"]
+        for c, pos, ad in loci_ad:
+            lines.append(f"{c}\t{pos}\t.\tA\tG\t50\tPASS\t.\tGT:AD\t0/1:{ad}")
         open(path, "w").write("\n".join(lines) + "\n")
 
     samples = {
-        0: [("s0a", [(100, "20,5"), (200, "30,2")]), ("s0b", [(100, "18,7")])],
-        1: [("s1a", [(100, "25,3"), (300, "10,10")]), ("s1b", [(200, "22,4"), (300, "12,8")])],
+        0: [("s0a", ["chr2"], [("chr2", 100, "20,5"), ("chr2", 200, "30,2")]),
+            ("s0b", ["chr2"], [("chr2", 100, "18,7")])],
+        1: [("s1a", ["chr1", "chr2"], [("chr1", 50, "25,3"), ("chr2", 100, "9,1")]),
+            ("s1b", ["chr1", "chr2"], [("chr1", 50, "22,4"), ("chr2", 200, "12,8")])],
     }
     for pid, ss in samples.items():
-        for name, loci in ss:
-            sample_vcf(str(tmp_path / f"{name}.vcf"), loci)
+        for name, contig_decl, loci in ss:
+            sample_vcf(str(tmp_path / f"{name}.vcf"), contig_decl, loci)
 
     port = _free_port()
     env_base = {k: v for k, v in os.environ.items()
@@ -120,7 +127,7 @@ def test_two_rank_sec_training_cli(tmp_path):
                     PYTHONPATH=_REPO)
     procs = []
     for pid, ss in samples.items():
-        inputs = [str(tmp_path / f"{n}.vcf") for n, _ in ss]
+        inputs = [str(tmp_path / f"{n}.vcf") for n, _, _ in ss]
         cmd = [sys.executable, "-m", "variantcalling_tpu", "sec_training",
                "--inputs", *inputs, "--min_samples", "2",
                "--output_file", str(tmp_path / f"db_{pid}.h5")]
@@ -137,8 +144,14 @@ def test_two_rank_sec_training_cli(tmp_path):
     db0 = SecDb.load(str(tmp_path / "db_0.h5"))
     db1 = SecDb.load(str(tmp_path / "db_1.h5"))
     assert db0.n_samples == db1.n_samples == 4
+    assert db0.contigs == db1.contigs == ["chr1", "chr2"]
     np.testing.assert_array_equal(db0.keys, db1.keys)
     np.testing.assert_allclose(db0.counts, db1.counts)
-    # loci 100 (3 samples), 200 (2), 300 (2) all pass min_samples=2, and
-    # counts span samples from BOTH ranks (e.g. locus 100: 20+18+25 ref)
+    # chr1:50 (2 samples), chr2:100 (3), chr2:200 (2) all pass min_samples=2
     assert len(db0) == 3
+    idx = {c: i for i, c in enumerate(db0.contigs)}
+    decoded = {(int(k) >> 40, int(k) & ((1 << 40) - 1)) for k in db0.keys}
+    assert decoded == {(idx["chr1"], 50), (idx["chr2"], 100), (idx["chr2"], 200)}
+    # cross-rank merge at chr2:100: ref counts 20+18+9 from three samples
+    row = db0.counts[list(db0.keys).index((idx["chr2"] << 40) | 100)]
+    assert row[0] == 20 + 18 + 9

@@ -199,3 +199,33 @@ def test_genome_cache_key_shared_across_consumers(tmp_path):
     # ...and now BOTH consumers see the cache hit with the same key
     assert _genome_resident_worthwhile(tiny, fasta, sharding=sh)
     assert _genome_resident_worthwhile(tiny, fasta, sharding=standard_genome_sharding())
+
+
+def test_flow_signature_matches_scan_reference(rng):
+    """The closed-form flow signature must agree with the sequential flow
+    scan on flow count AND zero-pattern comparison for random haplotype
+    pairs, incl. N-truncated rows (contig edges)."""
+    fo = jnp.asarray([0, 2, 1, 3], dtype=jnp.int32)  # TGCA order as codes
+    n, L = 3000, 9
+    ref = rng.integers(0, 4, size=(n, L)).astype(np.uint8)
+    alt = ref.copy()
+    alt[:, L // 2] = rng.integers(0, 4, size=n)  # center substitution
+    # sprinkle Ns to exercise truncation
+    ref[rng.random((n, L)) < 0.02] = 4
+    alt[: n // 2, :] = np.where(rng.random((n // 2, L)) < 0.02, 4, alt[: n // 2, :])
+
+    max_flows = 4 * L + 4
+    for hap in (ref, alt):
+        flows_ref, key_ref = fops._flow_keys(jnp.asarray(hap), fo, max_flows)
+        flows_new, _sig = fops._flow_signature(jnp.asarray(hap), fo)
+        np.testing.assert_array_equal(np.asarray(flows_new), np.asarray(flows_ref))
+
+    fr, kr = fops._flow_keys(jnp.asarray(ref), fo, max_flows)
+    fa, ka = fops._flow_keys(jnp.asarray(alt), fo, max_flows)
+    _, sr = fops._flow_signature(jnp.asarray(ref), fo)
+    _, sa = fops._flow_signature(jnp.asarray(alt), fo)
+    old_change = np.asarray(jnp.any((kr == 0) != (ka == 0), axis=1))
+    new_change = np.asarray(jnp.any(sr != sa, axis=1))
+    # the comparisons only matter where flow counts agree (else status=2)
+    same_flows = np.asarray(fr) == np.asarray(fa)
+    np.testing.assert_array_equal(new_change[same_flows], old_change[same_flows])

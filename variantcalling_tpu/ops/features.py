@@ -124,6 +124,40 @@ def _flow_key_length(seq: jnp.ndarray, flow_order: jnp.ndarray, max_flows: int) 
     return _flow_keys(seq, flow_order, max_flows)[0]
 
 
+_SIG_PAD = 1 << 20  # sentinel for "no run here" in flow signatures
+
+
+def _flow_signature(hap: jnp.ndarray, fo: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(flow count, sorted nonzero-flow positions) per row — closed form.
+
+    Equivalent to :func:`_flow_keys` but WITHOUT the sequential flow scan:
+    each maximal base run consumes ``d`` flows — the cyclic distance from
+    the previous run's flow-cycle position (first run: position + 1) — so
+    the flow count is a masked cumsum over run starts and the nonzero-key
+    flow positions are exactly those cumulative values. Two keys share a
+    zero/nonzero pattern iff their sorted position arrays match (runs are
+    strictly increasing, so sets compare as sorted vectors). The 40-step
+    ``lax.scan`` this replaces was ~95% of CPU featurization time.
+    """
+    n, L = hap.shape
+    idx = jnp.arange(L)[None, :]
+    lookup = jnp.zeros(N + 1, jnp.int32).at[fo].set(jnp.arange(4, dtype=jnp.int32))
+    pos = lookup[hap]  # flow-cycle position of each base (N rows masked below)
+    is_n = hap == N
+    eff = jnp.where(jnp.any(is_n, axis=1), jnp.argmax(is_n, axis=1), L).astype(jnp.int32)
+    valid = idx < eff[:, None]
+    prev_pos = jnp.concatenate([jnp.full((n, 1), -1, jnp.int32), pos[:, :-1]], axis=1)
+    start = jnp.concatenate(
+        [jnp.ones((n, 1), bool), hap[:, 1:] != hap[:, :-1]], axis=1) & valid
+    # consecutive runs have different bases, so the cyclic distance is 1..3
+    # (never 0); the first run pays its position + 1 flows from cycle start
+    d = jnp.where(idx == 0, pos + 1, jnp.mod(pos - prev_pos, 4))
+    cum = jnp.cumsum(jnp.where(start, d, 0), axis=1)
+    flows = jnp.max(jnp.where(start, cum, 0), axis=1)
+    sig = jnp.sort(jnp.where(start, cum, _SIG_PAD), axis=1)
+    return flows, sig
+
+
 def _scan_fixed(body, carry, length):
     import jax
 
@@ -154,15 +188,15 @@ def cycle_skip_status(
     VarReport.v0 'cycleskip SNP' category).
     """
     fo = jnp.asarray([{"A": A, "C": C, "G": G, "T": T}[c] for c in flow_order], dtype=jnp.int32)
-    L = 2 * context + 1
     left = windows[:, center - context : center]
     right = windows[:, center + 1 : center + 1 + context]
     ref_hap = jnp.concatenate([left, ref_code[:, None], right], axis=1)
     alt_hap = jnp.concatenate([left, alt_code[:, None], right], axis=1)
-    max_flows = 4 * L + 4
-    ref_flows, ref_key = _flow_keys(ref_hap, fo, max_flows)
-    alt_flows, alt_key = _flow_keys(alt_hap, fo, max_flows)
+    ref_flows, ref_sig = _flow_signature(ref_hap, fo)
+    alt_flows, alt_sig = _flow_signature(alt_hap, fo)
     skip = ref_flows != alt_flows
-    zero_pattern_change = jnp.any((ref_key == 0) != (alt_key == 0), axis=1)
+    # same flow count: the key's zero/nonzero pattern changes iff the sets
+    # of run-carrying flow positions differ
+    zero_pattern_change = jnp.any(ref_sig != alt_sig, axis=1)
     status = jnp.where(skip, 2, jnp.where(zero_pattern_change, 1, 0))
     return jnp.where(is_snp, status, -1).astype(jnp.int32)

@@ -59,7 +59,7 @@ def init_from_env() -> bool:
         )
         _INITIALIZED = True
         return True
-    if os.environ.get("VCTPU_AUTO_DISTRIBUTED") == "1":
+    if os.environ.get("VCTPU_AUTO_DISTRIBUTED"):  # any truthy value, matching the CLI gate
         # TPU pods: coordinator/topology come from the cluster environment
         jax.distributed.initialize()
         _INITIALIZED = True
@@ -89,24 +89,42 @@ def replicated_to_host(arr: jax.Array) -> np.ndarray:
     return np.asarray(arr.addressable_data(0))
 
 
+def allgather_strings(local: list[str]) -> list[str]:
+    """Every host's strings, rank order preserved (duplicates kept).
+
+    Encoded as newline-terminated bytes so rank boundaries cannot merge
+    adjacent names; empty ranks contribute nothing.
+    """
+    if jax.process_count() <= 1:
+        return list(local)
+    blob = "".join(s + "\n" for s in local).encode()
+    gathered = allgather_concat(np.frombuffer(blob, dtype=np.uint8))
+    text = bytes(bytearray(gathered.tolist())).decode()
+    return [s for s in text.split("\n") if s]
+
+
 def allgather_concat(local: np.ndarray) -> np.ndarray:
     """Concatenate every host's (possibly different-length) 1-D array.
 
-    Two collectives: lengths first, then the value arrays padded to the
-    max length (process_allgather needs uniform shapes). Single-process
-    returns the input unchanged.
+    Two collectives: byte lengths first, then the value BYTES padded to
+    the max length (process_allgather needs uniform shapes, and jax
+    without x64 would silently truncate int64 values — packed locus keys
+    exceed int32, so the wire format is uint8). Single-process returns
+    the input unchanged.
     """
+    local = np.ascontiguousarray(local)
     if jax.process_count() <= 1:
-        return np.asarray(local)
+        return local
     from jax.experimental import multihost_utils
 
-    local = np.asarray(local)
-    lengths = multihost_utils.process_allgather(np.asarray([len(local)]))
-    lengths = np.asarray(lengths).reshape(-1)
+    raw = local.view(np.uint8).reshape(-1) if local.size else np.zeros(0, np.uint8)
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(raw)], dtype=np.int32))).reshape(-1)
     m = int(lengths.max())
-    padded = np.pad(local, (0, m - len(local)))
+    padded = np.pad(raw, (0, m - len(raw)))
     gathered = np.asarray(multihost_utils.process_allgather(padded))
-    return np.concatenate([gathered[p, : int(lengths[p])] for p in range(len(lengths))])
+    blob = b"".join(gathered[p, : int(lengths[p])].tobytes() for p in range(len(lengths)))
+    return np.frombuffer(blob, dtype=local.dtype)
 
 
 def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = None) -> np.ndarray:

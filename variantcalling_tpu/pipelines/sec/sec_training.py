@@ -41,6 +41,14 @@ def run(argv: list[str]) -> int:
     args = parse_args(argv)
     region = bedio.read_intervals(args.relevant_coords) if args.relevant_coords else None
 
+    multi_host = False
+    try:
+        import jax
+
+        multi_host = jax.process_count() > 1
+    except Exception:  # noqa: BLE001 — no jax runtime means single-host
+        pass
+
     contigs: list[str] = []
     per_sample = []
     seen_count: dict[int, int] = {}
@@ -57,39 +65,52 @@ def run(argv: list[str]) -> int:
         order = np.argsort(keys)
         keys, counts = keys[order], counts[order]
         per_sample.append((keys, counts))
-        for k in keys.tolist():
-            seen_count[k] = seen_count.get(k, 0) + 1
+        if not multi_host:  # multi-host presence rides the psum instead
+            for k in keys.tolist():
+                seen_count[k] = seen_count.get(k, 0) + 1
         logger.info("%s: %d loci", path, len(keys))
 
-    multi_host = False
-    try:
-        import jax
-
-        multi_host = jax.process_count() > 1
-    except Exception:  # noqa: BLE001 — no jax runtime means single-host
-        pass
-
     seen_global = None
-    if multi_host and per_sample:
+    if multi_host:
         # pod-scale cohort (BASELINE config 5): each RANK holds its own
-        # sample files. Ranks agree on the global locus union (allgather),
-        # then one psum over the global mesh builds the cohort counts AND
-        # the per-locus sample-presence tally used by --min_samples.
+        # sample files. Contig names canonicalize first (keys pack the
+        # contig INDEX, and per-rank index orders differ), ranks agree on
+        # the global locus union (allgather), then one psum over the
+        # global mesh builds the cohort counts AND the per-locus
+        # sample-presence tally used by --min_samples. EVERY rank joins
+        # every collective — an input-less rank contributes zero shards
+        # rather than deadlocking the others.
         from variantcalling_tpu.parallel import distributed as dist
+        from variantcalling_tpu.sec.db import N_ALLELE_SLOTS
 
-        local_keys = np.unique(np.concatenate([k for k, _ in per_sample]))
+        global_contigs = sorted(set(dist.allgather_strings(contigs)))
+        remap = np.asarray([global_contigs.index(c) for c in contigs], dtype=np.int64) \
+            if contigs else np.zeros(0, dtype=np.int64)
+        def _repack(k, c):
+            k2 = (remap[k >> 40] << 40) | (k & ((1 << 40) - 1))
+            order = np.argsort(k2)
+            return k2[order], c[order]
+
+        per_sample = [_repack(k, c) for k, c in per_sample]
+        contigs = global_contigs
+
+        local_keys = np.unique(np.concatenate([k for k, _ in per_sample])) \
+            if per_sample else np.zeros(0, dtype=np.int64)
         all_keys = np.unique(dist.allgather_concat(local_keys))
-        n_alleles = per_sample[0][1].shape[1]
-        dense = np.zeros((len(per_sample), len(all_keys), n_alleles + 1), dtype=np.float32)
+        dense = np.zeros((len(per_sample), len(all_keys), N_ALLELE_SLOTS + 1), dtype=np.float32)
         for s, (keys, counts) in enumerate(per_sample):
             at = np.searchsorted(all_keys, keys)
-            dense[s, at, :n_alleles] = counts
-            dense[s, at, n_alleles] = 1.0  # presence column rides the same psum
-        total = dist.aggregate_counts_across_hosts(dense)
-        seen_global = total[:, n_alleles]
+            dense[s, at, :N_ALLELE_SLOTS] = counts
+            dense[s, at, N_ALLELE_SLOTS] = 1.0  # presence column rides the same psum
         n_total = int(dist.allgather_concat(np.asarray([len(per_sample)])).sum())
-        db = SecDb(contigs=contigs, keys=all_keys,
-                   counts=total[:, :n_alleles].astype(np.float32), n_samples=n_total)
+        if len(all_keys):
+            total = dist.aggregate_counts_across_hosts(dense)
+            seen_global = total[:, N_ALLELE_SLOTS]
+            counts_total = total[:, :N_ALLELE_SLOTS].astype(np.float32)
+        else:  # whole cohort empty: consistent empty DB on every rank
+            seen_global = np.zeros(0, dtype=np.float32)
+            counts_total = np.zeros((0, N_ALLELE_SLOTS), dtype=np.float32)
+        db = SecDb(contigs=contigs, keys=all_keys, counts=counts_total, n_samples=n_total)
     elif args.use_mesh and per_sample:
         # dense (S, L, A) over the union of loci -> one mesh psum
         from variantcalling_tpu.parallel.mesh import make_mesh
