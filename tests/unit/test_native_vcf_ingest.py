@@ -260,16 +260,11 @@ def test_fuzz_native_python_parser_parity(tmp_path, rng):
                 fmt, sample = "GT:AD", f"0/1:{ad}"
             lines.append(f"{c}\t{pos}\t.\t{ref}\t{alt}\t{qual}\t{filt}\t{info}\t{fmt}\t{sample}")
         path = str(tmp_path / f"fuzz{trial}.vcf")
-        open(path, "w").write("\n".join(lines) + "\n")
+        (tmp_path / f"fuzz{trial}.vcf").write_text("\n".join(lines) + "\n")
 
         tn = vcfmod._read_vcf_native(path)
         assert tn is not None, "native parse unexpectedly unavailable"
-        orig = vcfmod._read_vcf_native
-        vcfmod._read_vcf_native = lambda p, drop_format=False: None
-        try:
-            tp = vcfmod.read_vcf(path)
-        finally:
-            vcfmod._read_vcf_native = orig
+        tp = _python_read(path)
 
         assert len(tn) == len(tp) == n
         np.testing.assert_array_equal(np.asarray(tn.chrom), np.asarray(tp.chrom))
