@@ -84,3 +84,54 @@ def test_trained_model_scores_in_filter(tmp_path, rng):
     tp_mean = score[df["classify"] == "tp"].mean()
     fp_mean = score[df["classify"] == "fp"].mean()
     assert tp_mean > fp_mean + 0.2
+
+
+def test_train_models_resume_skips_fitted_grid_cells(tmp_path, rng, monkeypatch):
+    """A rerun with --resume reuses models checkpointed in the partial
+    pickle instead of refitting them (stage-artifact recovery)."""
+    df = _concordance_frame(rng, n=1500)
+    inp = str(tmp_path / "comp.h5")
+    write_hdf(df, inp, key="all", mode="w")
+    prefix = str(tmp_path / "model")
+
+    # first run: leave a partial checkpoint behind by failing after 2 models
+    from variantcalling_tpu.models import boosting as boosting_mod
+
+    real_fit = boosting_mod.fit
+    calls = {"n": 0}
+
+    def exploding_fit(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("simulated crash mid-grid")
+        return real_fit(*a, **kw)
+
+    monkeypatch.setattr(boosting_mod, "fit", exploding_fit)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="simulated crash"):
+        train_models.run(["--input_file", inp, "--output_file_prefix", prefix,
+                          "--n_trees", "8", "--tree_depth", "3"])
+    import os
+
+    assert os.path.exists(prefix + ".partial.pkl")
+    fitted_before = set(load_models(prefix + ".partial.pkl"))
+    assert fitted_before  # at least the first rf + threshold landed
+
+    # resume: previously fitted cells are NOT refitted
+    refits = {"n": 0}
+
+    def counting_fit(*a, **kw):
+        refits["n"] += 1
+        return real_fit(*a, **kw)
+
+    monkeypatch.setattr(boosting_mod, "fit", counting_fit)
+    rc = train_models.run(["--input_file", inp, "--output_file_prefix", prefix,
+                           "--resume", "--n_trees", "8", "--tree_depth", "3"])
+    assert rc == 0
+    n_rf_total = 4  # 2 gt modes x 2 hpol modes
+    assert refits["n"] == n_rf_total - 1  # the checkpointed rf was skipped
+    models = load_models(prefix + ".pkl")
+    assert fitted_before <= set(models)
+    assert len([k for k in models if k.startswith("rf_")]) == n_rf_total
+    assert not os.path.exists(prefix + ".partial.pkl")  # superseded

@@ -10,6 +10,7 @@ or a fitted sklearn classifier (converted to FlatForest on load).
 
 from __future__ import annotations
 
+import os
 import pickle
 
 from variantcalling_tpu.models.forest import FlatForest, from_sklearn
@@ -28,8 +29,12 @@ def standard_model_names(families=("rf", "threshold")) -> list[str]:
 
 
 def save_models(path: str, models: dict[str, object]) -> None:
-    with open(path, "wb") as fh:
+    """Atomic write (tmp + rename): a crash mid-write must never leave a
+    truncated pickle — checkpoint consumers resume from this file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
         pickle.dump(models, fh)
+    os.replace(tmp, path)
 
 
 def load_models(path: str) -> dict[str, object]:

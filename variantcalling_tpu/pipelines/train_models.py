@@ -15,6 +15,7 @@ training_prep.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import numpy as np
@@ -27,7 +28,7 @@ from variantcalling_tpu.io.vcf import read_vcf
 from variantcalling_tpu.models import boosting
 from variantcalling_tpu.models import forest as forest_mod
 from variantcalling_tpu.models import threshold as threshold_mod
-from variantcalling_tpu.models.registry import MODEL_NAME_PATTERN, save_models
+from variantcalling_tpu.models.registry import MODEL_NAME_PATTERN, load_models, save_models
 from variantcalling_tpu.pipelines.training_prep import (
     blacklist_membership,
     labels_from_approximate_gt,
@@ -66,6 +67,8 @@ def parse_args(argv: list[str]):
     ap.add_argument("--ignore_filter_status", action="store_true")
     ap.add_argument("--n_trees", type=int, default=100)
     ap.add_argument("--tree_depth", type=int, default=6)
+    ap.add_argument("--resume", action="store_true",
+                    help="skip grid cells already fitted in <prefix>.partial.pkl")
     ap.add_argument("--verbosity", default="INFO")
     return ap.parse_args(argv)
 
@@ -171,8 +174,47 @@ def run(argv: list[str]) -> int:
     train_m = ~holdout
 
     cfg = boosting.BoostConfig(n_trees=args.n_trees, depth=args.tree_depth)
+    # checkpoint/resume over the model grid (the reference's stage-artifact
+    # convention, SURVEY §5.4): every fitted model lands in the partial
+    # pickle immediately, and a rerun skips grid cells already fitted —
+    # a crash mid-grid costs one model, not the whole run
+    partial_pkl = f"{args.output_file_prefix}.partial.pkl"
+    meta_path = f"{args.output_file_prefix}.partial.meta.json"
+    fingerprint = {
+        "input_file": os.path.abspath(args.input_file),
+        "input_mtime": os.path.getmtime(args.input_file),
+        "input_size": os.path.getsize(args.input_file),
+        "n_trees": args.n_trees, "tree_depth": args.tree_depth,
+        "mutect": args.mutect, "contigs": args.list_of_contigs_to_read,
+        "exome_weight": args.exome_weight,
+    }
     models: dict[str, object] = {}
     results = []
+    if args.resume and os.path.exists(partial_pkl):
+        import json as _json
+
+        try:
+            old_fp = _json.load(open(meta_path)) if os.path.exists(meta_path) else None
+            if old_fp != fingerprint:
+                logger.warning("--resume: checkpoint was fitted under different "
+                               "settings/input (%s); refitting from scratch", meta_path)
+            else:
+                models = load_models(partial_pkl)
+                logger.info("resuming: %d models already fitted in %s", len(models), partial_pkl)
+        except Exception as e:  # noqa: BLE001 — a bad checkpoint must not kill the rerun
+            logger.warning("--resume: could not read %s (%s); refitting from scratch",
+                           partial_pkl, e)
+            models = {}
+
+    def checkpoint(key: str, model, m: np.ndarray, lab: np.ndarray) -> None:
+        models[key] = model
+        results.append(_train_metrics(key, model, x[m], lab[m], list(names)))
+        save_models(partial_pkl, models)
+        import json as _json
+
+        with open(meta_path, "w") as fh:
+            _json.dump(fingerprint, fh)
+
     for gt_name, lab in (("ignore_gt", label), ("use_gt", label_gt)):
         for hpol_name, hmask in (("incl_hpol_runs", np.ones(len(x), bool)), ("excl_hpol_runs", ~in_hpol)):
             m = train_m & hmask
@@ -180,18 +222,25 @@ def run(argv: list[str]) -> int:
                 logger.warning("skipping %s/%s: degenerate training subset (%d rows)", gt_name, hpol_name, m.sum())
                 continue
             fkey = MODEL_NAME_PATTERN.format(family="rf", gt=gt_name, hpol=hpol_name)
-            forest = boosting.fit(x[m], lab[m], sample_weight=weight[m], cfg=cfg, feature_names=list(names))
-            models[fkey] = forest
-            results.append(_train_metrics(fkey, forest, x[m], lab[m], list(names)))
+            if fkey in models:
+                results.append(_train_metrics(fkey, models[fkey], x[m], lab[m], list(names)))
+            else:
+                forest = boosting.fit(x[m], lab[m], sample_weight=weight[m], cfg=cfg, feature_names=list(names))
+                checkpoint(fkey, forest, m, lab)
             tkey = MODEL_NAME_PATTERN.format(family="threshold", gt=gt_name, hpol=hpol_name)
-            cand = ["tlod", "sor"] if args.mutect else ["qual", "sor"]
-            tmodel = threshold_mod.fit_threshold_model(x[m], lab[m], list(names), candidate_features=cand,
-                                                       sample_weight=weight[m])
-            models[tkey] = tmodel
-            results.append(_train_metrics(tkey, tmodel, x[m], lab[m], list(names)))
+            if tkey in models:
+                results.append(_train_metrics(tkey, models[tkey], x[m], lab[m], list(names)))
+            else:
+                cand = ["tlod", "sor"] if args.mutect else ["qual", "sor"]
+                tmodel = threshold_mod.fit_threshold_model(x[m], lab[m], list(names), candidate_features=cand,
+                                                           sample_weight=weight[m])
+                checkpoint(tkey, tmodel, m, lab)
 
     pkl = f"{args.output_file_prefix}.pkl"
     save_models(pkl, models)
+    for stale in (partial_pkl, meta_path):
+        if os.path.exists(stale):
+            os.remove(stale)  # the finished pickle supersedes the checkpoint
     res_df = pd.DataFrame(results)
     out_h5 = f"{args.output_file_prefix}.h5"
     write_hdf(res_df, out_h5, key="training_results", mode="w")
