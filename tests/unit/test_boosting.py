@@ -154,8 +154,13 @@ def _grow_tree_ref(binned, g, h, cfg):
     return feats, bins_out
 
 
-def test_grow_tree_split_parity_with_naive_histograms(rng):
-    """Levels >= 1 must pick the same splits as a naive per-node segment-sum.
+import pytest
+
+
+@pytest.mark.parametrize("use_matmul", [True, False])
+def test_grow_tree_split_parity_with_naive_histograms(rng, use_matmul):
+    """Levels >= 1 must pick the same splits as a naive per-node segment-sum,
+    for BOTH histogram strategies (MXU matmul and CPU scatter).
 
     Regression test for the histogram unpack transpose (round-2 advisor
     high finding): the MXU histogram matmul flattens the lhs as (g/h,
@@ -170,7 +175,7 @@ def test_grow_tree_split_parity_with_naive_histograms(rng):
     h = (rng.integers(1, 9, size=n) / 8.0).astype(np.float32)
 
     feats, bins_, _leaf, _node = jax.jit(
-        lambda bn, gg, hh: boosting._grow_tree(bn, None, gg, hh, cfg)
+        lambda bn, gg, hh: boosting._grow_tree(bn, None, gg, hh, cfg, use_matmul=use_matmul)
     )(jnp.asarray(binned), jnp.asarray(g), jnp.asarray(h))
     feats, bins_ = np.asarray(feats), np.asarray(bins_)
 

@@ -69,7 +69,46 @@ def bin_features(x: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 BOH_RESIDENT_MAX_BYTES = 4 << 30
 
 
-def _grow_tree(binned, boh, g, h, cfg: BoostConfig):
+def _hist_matmul(binned, boh, gh16, node_id, n_nodes, f, b):
+    """(node, feature, bin) g/h histograms as ONE MXU matmul:
+    lhs (N, 2*2^l) carries g/h masked by node one-hot, rhs (N, F*B) is the
+    per-feature bin one-hot — their contraction over N yields both
+    gradient and hessian histograms at systolic-array rate. Under pjit the
+    N contraction is where XLA inserts the cross-device psum (BASELINE
+    config 3). bf16 operands, f32 accumulation: one-hot entries are exact
+    in bf16; g/h lose ~3 decimal digits, far below split-gain contrasts."""
+    n = binned.shape[0]
+    noh = jax.nn.one_hot(node_id, n_nodes, dtype=jnp.bfloat16)  # (N, 2^l)
+    lhs = (gh16[:, :, None] * noh[:, None, :]).reshape(n, 2 * n_nodes)
+    rhs = boh if boh is not None else \
+        jax.nn.one_hot(binned, b, dtype=jnp.bfloat16).reshape(n, f * b)
+    hist2 = jax.lax.dot_general(
+        lhs, rhs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (2*2^l, F*B)
+    # lhs columns flatten as (gh, node) — index = gh * n_nodes + node —
+    # so the row axis unpacks gh-major
+    hist2 = hist2.reshape(2, n_nodes, f, b)
+    return hist2[0], hist2[1]
+
+
+def _hist_scatter(binned, g, h, node_id, n_nodes, f, b):
+    """The same histograms via per-feature segment-sums (scatter-add).
+
+    CPU-only strategy: scatter-add is fast there and skips the big bf16
+    one-hot matmuls, while on TPU it would serialize (the documented ~60x
+    cliff). Sums accumulate in f32 like the matmul path."""
+    seg = node_id[:, None] * b + binned  # (N, F) segment id per feature
+    gh = jnp.stack([g, h], axis=-1)  # one scatter pass carries both sums
+
+    def per_feature(col):
+        return jax.ops.segment_sum(gh, col, num_segments=n_nodes * b)  # (nodes*b, 2)
+
+    ghs = jax.vmap(per_feature, in_axes=1, out_axes=0)(seg)  # (F, nodes*b, 2)
+    ghs = ghs.reshape(f, n_nodes, b, 2).transpose(3, 1, 0, 2)  # (2, nodes, F, b)
+    return ghs[0], ghs[1]
+
+
+def _grow_tree(binned, boh, g, h, cfg: BoostConfig, use_matmul: bool = True):
     """One complete depth-D tree. Returns (feat (D, L), bin (D, L), leaf (2^D,)).
 
     ``feat[l, k]`` / ``bin[l, k]`` describe the split of node k at level l
@@ -81,6 +120,8 @@ def _grow_tree(binned, boh, g, h, cfg: BoostConfig):
     l only 2^l nodes exist, so the histogram matmul's lhs is (N, 2*2^l) —
     the per-tree FLOP count is half what a constant 2*2^D-wide lhs costs,
     and the dominant rhs read is amortized against one hoisted one-hot.
+    ``use_matmul`` picks the histogram strategy (MXU matmul on
+    accelerators, segment-sum scatter on CPU).
     """
     n, f = binned.shape
     b = cfg.n_bins
@@ -92,27 +133,10 @@ def _grow_tree(binned, boh, g, h, cfg: BoostConfig):
     feat_rows, bin_rows = [], []
     for level in range(cfg.depth):
         n_nodes = 1 << level
-        # histograms over (node, feature, bin) as ONE MXU matmul:
-        # lhs (N, 2*2^l) carries g/h masked by node one-hot, rhs (N, F*B)
-        # is the per-feature bin one-hot — their contraction over N yields
-        # both gradient and hessian histograms at systolic-array rate.
-        # (segment_sum lowers to scatter-add, which serializes on TPU: the
-        # same fit ran ~60x slower that way.) Under pjit the N contraction
-        # is where XLA inserts the cross-device psum (BASELINE config 3).
-        # bf16 operands, f32 accumulation: one-hot entries are exact in
-        # bf16; g/h lose ~3 decimal digits, far below split-gain contrasts
-        noh = jax.nn.one_hot(node_id, n_nodes, dtype=jnp.bfloat16)  # (N, 2^l)
-        lhs = (gh16[:, :, None] * noh[:, None, :]).reshape(n, 2 * n_nodes)
-        rhs = boh if boh is not None else \
-            jax.nn.one_hot(binned, b, dtype=jnp.bfloat16).reshape(n, f * b)
-        hist2 = jax.lax.dot_general(
-            lhs, rhs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (2*2^l, F*B)
-        # lhs columns flatten as (gh, node) — index = gh * n_nodes + node —
-        # so the row axis unpacks gh-major
-        hist2 = hist2.reshape(2, n_nodes, f, b)
-        hist_g = hist2[0]
-        hist_h = hist2[1]
+        if use_matmul:
+            hist_g, hist_h = _hist_matmul(binned, boh, gh16, node_id, n_nodes, f, b)
+        else:
+            hist_g, hist_h = _hist_scatter(binned, g, h, node_id, n_nodes, f, b)
 
         gl = jnp.cumsum(hist_g, axis=2)  # left sums for split at bin <= j
         hl = jnp.cumsum(hist_h, axis=2)
@@ -162,17 +186,19 @@ last_fit_diag: dict = {}
 _TRAIN_CACHE: dict[BoostConfig, object] = {}
 
 
-def _jitted_train(cfg: BoostConfig):
-    """jit(train) cached per config — a fresh jit object per fit() would
-    recompile the whole T-tree program on every call (seconds per fit)."""
-    fn = _TRAIN_CACHE.get(cfg)
+def _jitted_train(cfg: BoostConfig, use_matmul: bool):
+    """jit(train) cached per (config, histogram strategy) — a fresh jit
+    object per fit() would recompile the whole T-tree program on every
+    call (seconds per fit)."""
+    key = (cfg, use_matmul)
+    fn = _TRAIN_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(_make_train(cfg))
-        _TRAIN_CACHE[cfg] = fn
+        fn = jax.jit(_make_train(cfg, use_matmul))
+        _TRAIN_CACHE[key] = fn
     return fn
 
 
-def _make_train(cfg: BoostConfig):
+def _make_train(cfg: BoostConfig, use_matmul: bool = True):
     """The jittable whole-fit program: (binned, y01, w) -> tree arrays.
 
     Under a mesh with dp-sharded inputs, the per-level histogram
@@ -196,14 +222,14 @@ def _make_train(cfg: BoostConfig):
             n_shards = 1
         boh_bytes = 2 * n * f * cfg.n_bins // max(n_shards, 1)
         boh = jax.nn.one_hot(binned, cfg.n_bins, dtype=jnp.bfloat16).reshape(n, f * cfg.n_bins) \
-            if boh_bytes <= BOH_RESIDENT_MAX_BYTES else None
+            if use_matmul and boh_bytes <= BOH_RESIDENT_MAX_BYTES else None
 
         def tree_step(t, carry):
             margin, all_feats, all_bins, all_leaves = carry
             p = jax.nn.sigmoid(margin)
             g = w * (p - y01)
             h = jnp.maximum(w * p * (1.0 - p), 1e-12)
-            feats, bins, leaf, node_id = _grow_tree(binned, boh, g, h, cfg)
+            feats, bins, leaf, node_id = _grow_tree(binned, boh, g, h, cfg, use_matmul=use_matmul)
             margin = margin + leaf[node_id]
             all_feats = jax.lax.dynamic_update_index_in_dim(all_feats, feats, t, 0)
             all_bins = jax.lax.dynamic_update_index_in_dim(all_bins, bins, t, 0)
@@ -290,7 +316,18 @@ def fit(
         binned = jnp.asarray(host_binned) if host_binned is not None else \
             bin_features(x if isinstance(x, jax.Array) else jnp.asarray(x), edges_d)
 
-    train = _jitted_train(cfg)
+    # histogram strategy follows the devices the fit actually runs on
+    # (mesh > device input > default device), not the process default
+    try:
+        if mesh is not None:
+            platform = mesh.devices.flat[0].platform
+        elif isinstance(x, jax.Array):
+            platform = next(iter(x.devices())).platform
+        else:
+            platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 — device probe must not break the fit
+        platform = "cpu"
+    train = _jitted_train(cfg, use_matmul=platform != "cpu")
     ctx = mesh if mesh is not None else nullcontext()
     with ctx:
         if diag:
