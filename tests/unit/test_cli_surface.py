@@ -1,0 +1,55 @@
+"""Every registered CLI tool must import and expose a runnable surface.
+
+The reference CLI registers ~30 tools through simppl (ugvc/__main__.py:
+43-105); this framework registers its full map lazily — which means an
+import error in any tool module would only surface when a user invokes
+it. This smoke locks the whole surface: each module imports, exposes
+run(argv), and (where it defines a parser builder) constructs its
+argparse parser.
+"""
+
+import importlib
+
+import pytest
+
+from variantcalling_tpu.__main__ import TOOLS
+
+
+@pytest.mark.parametrize("tool", sorted(TOOLS))
+def test_tool_imports_and_exposes_run(tool):
+    module = importlib.import_module(TOOLS[tool])
+    assert callable(getattr(module, "run", None)), f"{tool} lacks run(argv)"
+    for builder in ("get_parser", "parse_args"):
+        fn = getattr(module, builder, None)
+        if fn is None:
+            continue
+        if builder == "get_parser":
+            assert fn() is not None
+        else:
+            # parse_args(argv) with --help would sys.exit; just confirm
+            # empty argv raises SystemExit (required args) or returns a
+            # namespace — either proves the parser constructs
+            try:
+                fn([])
+            except SystemExit:
+                pass
+            except TypeError:
+                # subcommand-style tools take (argv, command); constructing
+                # the module was the point of this smoke
+                pass
+        break
+
+
+def test_cli_help_lists_every_tool(capsys):
+    from variantcalling_tpu.__main__ import main
+
+    assert main(["--help"]) == 0
+    out = capsys.readouterr().out
+    for tool in TOOLS:
+        assert tool in out
+
+
+def test_unknown_tool_is_a_clean_error(capsys):
+    from variantcalling_tpu.__main__ import main
+
+    assert main(["definitely_not_a_tool"]) == 2
