@@ -274,3 +274,29 @@ def test_fused_threshold_model_matches_direct_predict(tmp_path):
     hf_dev = host_featurize(table, fasta, compute_windows=False)
     fused_dev = fused_featurize_score(model, hf_dev, "TGCA", table=table, fasta=fasta)
     np.testing.assert_allclose(fused_dev, ref, atol=1e-6)
+
+
+def test_filter_pipeline_output_is_byte_deterministic(synthetic_world):
+    """Two runs over the same inputs must write byte-identical VCFs —
+    guards nondeterminism creep (unordered dicts, unstable sorts, device
+    scheduling) in the flagship path."""
+    import gzip
+
+    w = synthetic_world
+    outs = []
+    for tag in ("det_a", "det_b"):
+        out = w["tmp"] / f"{tag}.vcf.gz"
+        rc = fvp.run([
+            "--input_file", w["vcf"],
+            "--model_file", w["model"],
+            "--model_name", "rf_model_ignore_gt_incl_hpol_runs",
+            "--runs_file", w["runs"],
+            "--blacklist", w["blacklist"],
+            "--reference_file", w["fasta"],
+            "--output_file", str(out),
+            "--annotate_intervals", w["lcr"],
+            "--backend", "cpu",
+        ])
+        assert rc == 0
+        outs.append(gzip.open(out, "rb").read())
+    assert outs[0] == outs[1]
