@@ -29,13 +29,17 @@ assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
 
 pid = jax.process_index()
-# RAGGED host-local shards: host 0 carries 3 samples, host 1 carries 4 —
-# neither divides 4 local devices evenly on host 0 (exercises padding)
-n_local = 3 if pid == 0 else 4
-local = np.stack([np.full((6, 4), 10 * pid + s, dtype=np.float32) for s in range(n_local)])
+# RAGGED host-local shards from the env (host0,host1 sample counts) —
+# covers unequal padded row counts (5 vs 4) and an EMPTY rank (0 vs 4),
+# both of which desynced the global shape before the per-device
+# shard-size agreement in aggregate_counts_across_hosts
+shards = [int(s) for s in os.environ["VCTPU_TEST_SHARDS"].split(",")]
+n_local = shards[pid]
+local = (np.stack([np.full((6, 4), 10 * pid + s, dtype=np.float32) for s in range(n_local)])
+         if n_local else np.zeros((0, 6, 4), dtype=np.float32))
 cohort = dist.aggregate_counts_across_hosts(local)
-# sum over all 7 samples: (0+1+2) + (10+11+12+13) = 49 per cell
-np.testing.assert_allclose(cohort, np.full((6, 4), 49.0))
+expect = sum(10 * h + s for h in range(2) for s in range(shards[h]))
+np.testing.assert_allclose(cohort, np.full((6, 4), float(expect)))
 
 # ragged key allgather: union across hosts
 keys = np.asarray([1, 5, 9] if pid == 0 else [2, 5], dtype=np.int64)
@@ -51,7 +55,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_global_mesh_psum(tmp_path):
+def _run_two_workers(shards: str) -> None:
     port = _free_port()
     env_base = {
         k: v for k, v in os.environ.items()
@@ -63,12 +67,12 @@ def test_two_process_global_mesh_psum(tmp_path):
         VCTPU_COORDINATOR=f"127.0.0.1:{port}",
         VCTPU_NUM_PROCESSES="2",
         VCTPU_TEST_REPO=_REPO,
+        VCTPU_TEST_SHARDS=shards,
     )
-    script = _WORKER
     procs = []
     for pid in range(2):
         env = dict(env_base, VCTPU_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen([sys.executable, "-c", script], env=env,
+        procs.append(subprocess.Popen([sys.executable, "-c", _WORKER], env=env,
                                       stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                                       text=True))
     outs = []
@@ -80,12 +84,30 @@ def test_two_process_global_mesh_psum(tmp_path):
                 q.kill()
             raise
         outs.append((p.returncode, out, err))
+    sums = set()
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-1500:]}"
         assert "WORKER_OK" in out, out
-    # both hosts saw the identical complete cohort (6*4 cells of 49)
-    for rc, out, err in outs:
-        assert "1176.0" in out, out
+        sums.add(out.split("WORKER_OK")[1].split()[1])
+    # both hosts saw the identical complete cohort
+    assert len(sums) == 1, sums
+
+
+def test_two_process_global_mesh_psum(tmp_path):
+    _run_two_workers("3,4")
+
+
+def test_ragged_padded_shards_5_vs_4(tmp_path):
+    """5-vs-4 samples on 4-device hosts: padded row counts differ (8 vs 4)
+    unless hosts agree on the per-device shard size first."""
+    _run_two_workers("5,4")
+
+
+def test_empty_rank_joins_collective(tmp_path):
+    """A rank holding ZERO samples must still join the psum and receive
+    the full cohort (previously: silent all-zero cohort on the empty rank
+    and a Gloo deadlock on the other)."""
+    _run_two_workers("0,4")
 
 
 def test_two_rank_sec_training_cli(tmp_path):

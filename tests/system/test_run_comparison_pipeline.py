@@ -140,3 +140,38 @@ def test_concordance_tool_gc_mode(tmp_path, rng):
     assert native.loc[1001, "classify"] == "tp"
     assert gc.loc[1001, "classify"] == "fp"
     assert gc.loc[1000, "classify"] == "fn"  # truth-side unmatched under GC
+
+
+def test_gc_mode_fp_call_keeps_unmatched_truth_gt(tmp_path, rng):
+    """A GC-mode fp call co-located with a truth record sharing NO alt
+    allele must report gt_ground_truth './.' (call_truth_idx stays -1,
+    matching the native matcher's unmatched semantics) — not the GT of
+    the unrelated co-located truth record."""
+    genome = make_genome(rng, {"chr1": 2000})
+    fasta_path = str(tmp_path / "ref.fa")
+    write_fasta(fasta_path, genome)
+    contigs = {"chr1": 2000}
+
+    ref_b = genome["chr1"][100]
+    alts = [b for b in "ACGT" if b != ref_b]
+    truth_recs = [{"chrom": "chr1", "pos": 101, "ref": ref_b, "alts": [alts[0]],
+                   "qual": 50.0, "gt": (1, 1)}]
+    # same position, DIFFERENT alt allele -> no allele overlap
+    call_recs = [{"chrom": "chr1", "pos": 101, "ref": ref_b, "alts": [alts[1]],
+                  "qual": 50.0, "gt": (0, 1)}]
+    truth_vcf, calls_vcf = str(tmp_path / "t.vcf"), str(tmp_path / "c.vcf")
+    write_vcf(truth_vcf, truth_recs, contigs)
+    write_vcf(calls_vcf, call_recs, contigs)
+    hc = str(tmp_path / "hc.bed")
+    open(hc, "w").write("chr1\t0\t2000\n")
+
+    assert rc.run([
+        "--input_prefix", calls_vcf, "--output_file", str(tmp_path / "gc.h5"),
+        "--output_interval", str(tmp_path / "iv.bed"),
+        "--gtr_vcf", truth_vcf, "--highconf_intervals", hc,
+        "--reference", fasta_path, "--concordance_tool", "GC",
+    ]) == 0
+    df = read_hdf(str(tmp_path / "gc.h5"), key="chr1")
+    fp = df[df["classify"] == "fp"]
+    assert len(fp) == 1
+    assert fp.iloc[0]["gt_ground_truth"] == "./."  # NOT the co-located 1/1

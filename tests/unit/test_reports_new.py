@@ -463,3 +463,38 @@ def test_nexusplt_interactive_html(tmp_path):
     text2 = open(path2).read()
     assert "render(document" not in text2 and "base64," in text2
     plt.close(fig2)
+
+
+def test_nexusplt_html_escapes_hostile_labels(tmp_path):
+    """Figure names and series labels come from report inputs (sample
+    names, file stems): a label containing </script> or quotes must not
+    terminate the data block or inject markup into a shared artifact."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from variantcalling_tpu.reports import nexusplt
+
+    hostile = '</script><script>alert(1)</script>'
+    fig, ax = plt.subplots()
+    ax.plot([1, 2], [3, 4], label=hostile)
+    ax.set_title('t"><img src=x onerror=alert(2)>')
+    (path,) = nexusplt.save(fig, 'fig"<&name', str(tmp_path), formats=("html",))
+    text = open(path).read()
+    # no '<' from the label survives into the data block (covers both
+    # '</script>' close-out and the '<!--' double-escaped-state trick),
+    # and the name is entity-escaped everywhere
+    assert "alert(1)</script>" not in text
+    assert '\\u003c/script>' in text  # JSON-escaped inside the data block
+    assert 'fig&quot;&lt;&amp;name' in text and 'fig"<&name' not in text
+    # the data still round-trips
+    import json as _json
+    payload = text.split("id='fig-data'>", 1)[1].split("</script>", 1)[0]
+    assert _json.loads(payload)["axes"][0]["lines"][0]["label"] == hostile
+
+    # a path-traversal name must not write outside outdir
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="escapes"):
+        nexusplt.save(fig, "../evil", str(tmp_path), formats=("png",))
+    plt.close(fig)

@@ -157,6 +157,41 @@ def test_registry_loads_bare_json_and_pickled_dict(model, tmp_path, rng):
         np.testing.assert_allclose(np.asarray(predict_score(m, x)), expect, atol=1e-6)
 
 
+def test_recycled_node_ids_keep_full_depth(rng):
+    """Pruned xgboost trees recycle deleted node ids, so a child can have a
+    SMALLER id than its parent. Depth derivation must not assume id order
+    is topological — an underestimated max_depth truncates the fixed-round
+    walk at an internal node (score silently 0.0 there)."""
+    # node 1 (internal) is a child of node 3, which is a child of node 0:
+    # ids 1 and 2 precede their ancestors, as after pruning + id reuse.
+    #        0: f0<0.5 ── right ──> 4: leaf -0.1
+    #        └ left ──> 3: f1<0.5 ── right ──> 5: leaf +0.3
+    #                   └ left ──> 1: f2<0.5 ─ left/right ─> 2: +0.7 / 6: -0.9
+    t = _xgb_tree(left=[3, 2, -1, 1, -1, -1, -1],
+                  right=[4, 6, -1, 5, -1, -1, -1],
+                  cond=[0.5, 0.5, 0.7, 0.5, -0.1, 0.3, -0.9],
+                  sidx=[0, 2, 0, 1, 0, 0, 0],
+                  default_left=[0] * 7)
+    mj = _model_json([t], base_score=0.5)
+    forest = from_xgboost_json(mj)
+    assert forest.max_depth >= 4  # 3 edges root->leaf
+    x = rng.normal(0, 1.5, size=(64, 3)).astype(np.float32)
+    x[0] = [0.0, 0.0, 0.0]  # routes to the depth-3 leaf (+0.7)
+    expect = _ref_predict(mj, x)
+    np.testing.assert_allclose(np.asarray(predict_score(forest, x)), expect, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(predict_score_gemm(to_gemm(forest, 3), x)),
+                               expect, atol=1e-6)
+
+
+def test_cyclic_child_pointers_raise():
+    """Corrupt child arrays (a node pointing back at itself/an ancestor)
+    must raise, not hang — the BFS is bounded and deduplicated."""
+    t = _xgb_tree(left=[1, 0, -1], right=[2, 2, -1],  # node 1 points back at 0
+                  cond=[0.5, 0.5, 0.1], sidx=[0, 1, 0], default_left=[0, 0, 0])
+    with pytest.raises(ValueError, match="cyclic"):
+        from_xgboost_json(_model_json([t]))
+
+
 def test_unsupported_models_raise(model):
     import copy
 

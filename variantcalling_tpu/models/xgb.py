@@ -110,14 +110,29 @@ def from_xgboost_json(source, feature_names: list[str] | None = None,
         # for leaves, split_conditions holds the leaf value (eta included)
         value[ti, :nc] = np.where(is_leaf, cond, 0.0)
         default_left[ti, :nc] = ~is_leaf & dl
-        # tree_param.depth is optional; derive from the child arrays
-        # (xgboost allocates children after their parent, so id order is
-        # a valid topological order)
+        # tree_param.depth is optional; derive from the child arrays by
+        # BFS from the root. A plain id-order pass would assume children
+        # have larger ids than their parent, but pruned models
+        # (tree_param.num_deleted > 0) recycle node ids, so a child can
+        # precede its parent — underestimating depth and truncating the
+        # fixed-round traversal at an internal node
         depth = np.zeros(nc, dtype=np.int32)
-        for node in range(nc):
-            if not is_leaf[node]:
-                depth[lc[node]] = depth[node] + 1
-                depth[rc[node]] = depth[node] + 1
+        frontier = [0]
+        level = 0
+        while frontier:
+            level += 1
+            if level > nc:  # a tree of nc nodes has < nc levels
+                raise ValueError("malformed model: cyclic child pointers")
+            nxt = set()
+            for node in frontier:
+                if not is_leaf[node]:
+                    depth[lc[node]] = depth[node] + 1
+                    depth[rc[node]] = depth[node] + 1
+                    nxt.add(int(lc[node]))
+                    nxt.add(int(rc[node]))
+            # dedup bounds the frontier at nc, so converging/cyclic child
+            # pointers hit the level guard instead of growing the frontier
+            frontier = sorted(nxt)
         max_depth = max(max_depth, int(depth.max()) + 1)
 
     names = feature_names

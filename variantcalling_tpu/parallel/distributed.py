@@ -134,14 +134,23 @@ def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = 
     gather, no intermediate files.
 
     Every host must call this collectively (same (L, A) trailing shape;
-    S_local may differ per host and need not divide the local device
-    count — zero rows pad it, and zeros are invisible to the sum); each
-    host returns the full cohort tensor.
+    S_local may differ per host — including ZERO — and need not divide the
+    local device count: hosts agree on one per-device shard size (the
+    global max, via process_allgather) and zero-pad to it, so every
+    device holds the same-shape block and zeros are invisible to the
+    sum); each host returns the full cohort tensor.
     """
     mesh = mesh or global_mesh(n_model=1)
     local_counts = np.asarray(local_counts)
     n_local_dev = len(jax.local_devices())
-    pad = (-local_counts.shape[0]) % n_local_dev
+    # host_local_array_to_global_array derives the GLOBAL shape from each
+    # process's own local block, so ragged hosts (5-vs-4 samples, or an
+    # empty rank) must first agree on a common per-device shard size —
+    # otherwise ranks disagree on the global array and the collective
+    # deadlocks (or an empty rank silently returns zeros)
+    per_dev = -(-local_counts.shape[0] // n_local_dev)  # ceil; 0 for empty
+    per_dev = int(allgather_concat(np.asarray([per_dev], dtype=np.int32)).max())
+    pad = per_dev * n_local_dev - local_counts.shape[0]
     if pad:
         local_counts = np.concatenate(
             [local_counts, np.zeros((pad, *local_counts.shape[1:]), local_counts.dtype)])

@@ -177,7 +177,10 @@ def genotype_concordance_match(calls: VariantTable, truth: VariantTable) -> _GCR
                     break
                 if best < 0:
                     best = j
-        call_truth_idx[i] = best if best >= 0 else cands[0]
+        # unmatched calls keep -1 (same semantics as the native matcher);
+        # annotating fp calls with an unrelated co-located truth GT made
+        # the call_truth_gt column mean different things per tool
+        call_truth_idx[i] = best
         if best >= 0:
             call_tp[i] = truth_tp[best] = True
             if exact:

@@ -14,6 +14,7 @@ mpld3 is not in this image, so:
 from __future__ import annotations
 
 import base64
+import html as _html
 import io
 import json
 import os
@@ -25,6 +26,11 @@ def save(fig, name: str, outdir: str = ".", formats: tuple[str, ...] = ("png",))
     written = []
     for fmt in formats:
         path = os.path.join(outdir, f"{name}.{fmt}")
+        # names come from report inputs (sample names, file stems) — a
+        # '../'-carrying name must not write outside outdir
+        if os.path.commonpath([os.path.abspath(outdir), os.path.abspath(path)]) \
+                != os.path.abspath(outdir):
+            raise ValueError(f"figure name escapes output directory: {name!r}")
         if fmt == "png":
             fig.savefig(path, format="png", bbox_inches="tight", dpi=120)
         elif fmt == "html":
@@ -41,7 +47,7 @@ def save(fig, name: str, outdir: str = ".", formats: tuple[str, ...] = ("png",))
                     fh.write(_interactive_html(name, data, b64))
                 else:  # no serializable line data: static fallback page
                     fh.write(
-                        f'<html><body><img alt="{name}" '
+                        f'<html><body><img alt="{_html.escape(name, quote=True)}" '
                         f'src="data:image/png;base64,{b64}"/></body></html>'
                     )
         elif fmt == "json":
@@ -121,15 +127,29 @@ function render(figEl, FIG) {
 
 def _interactive_html(name: str, data: dict, png_b64: str) -> str:
     """Self-contained interactive page: SVG lines + hover readout +
-    legend toggles, static png fallback behind a details fold."""
+    legend toggles, static png fallback behind a details fold.
+
+    Figure names and axis/series labels come from report inputs (sample
+    names, file stems), so everything interpolated into markup is
+    html-escaped, and the figure data rides in a JSON script block with
+    ``</`` escaped — a label containing ``</script>`` or quotes must not
+    break (or script-inject) a shared report artifact."""
+    safe_name = _html.escape(name, quote=True)
+    # <-escape EVERY '<' (json.dumps only emits '<' inside strings):
+    # '</script>' would close the data block, and '<!--' would flip the
+    # parser into the double-escaped script state so the real close tag
+    # stops terminating it
+    fig_json = json.dumps(data).replace("<", "\\u003c")
     return (
         "<html><head><meta charset='utf-8'>"
-        f"<title>{name}</title></head><body>\n"
+        f"<title>{safe_name}</title></head><body>\n"
         f"<div id='fig'></div>\n"
         f"<details><summary>static image</summary>"
-        f"<img alt='{name}' src='data:image/png;base64,{png_b64}'/></details>\n"
+        f"<img alt='{safe_name}' src='data:image/png;base64,{png_b64}'/></details>\n"
+        f"<script type='application/json' id='fig-data'>{fig_json}</script>\n"
         f"<script>\nconst PALETTE = {json.dumps(_PALETTE)};\n"
-        f"const FIG = {json.dumps(data)};\n{_JS}\n"
+        "const FIG = JSON.parse(document.getElementById('fig-data').textContent);\n"
+        f"{_JS}\n"
         "render(document.getElementById('fig'), FIG);\n"
         "</script></body></html>\n"
     )
