@@ -105,6 +105,12 @@ def main(argv: list[str] | None = None) -> int:
         from variantcalling_tpu.parallel.distributed import init_from_env
 
         init_from_env()
+    # per-file CLI invocations must not re-pay jit compiles: persist XLA
+    # executables across processes (~/.cache/vctpu/xla, VCTPU_COMPILE_CACHE
+    # overrides, empty disables)
+    from variantcalling_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     result = module.run(argv[1:])
     # tools may return rich results (e.g. vcfeval_flavors' rows); only
     # int-like returns are exit codes

@@ -312,22 +312,33 @@ def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW
     One contig-sequence encode per contig, then a vectorized gather — the
     host-side analog of the reference's per-record pyfaidx fetches.
     """
+    import pandas as pd
+
+    from variantcalling_tpu import native
+
     n = len(table)
     out = np.full((n, 2 * radius + 1), 4, dtype=np.uint8)
-    chrom = np.asarray(table.chrom)
+    # hash factorize beats one object-array string compare per contig
+    codes, uniques = pd.factorize(np.asarray(table.chrom), use_na_sentinel=False)
     pos0 = table.pos - 1
-    for contig in dict.fromkeys(chrom.tolist()):
-        m = chrom == contig
+    one_contig = len(uniques) == 1 and uniques[0] in fasta.references
+    for ui, contig in enumerate(uniques):
         if contig not in fasta.references:
             continue
-        seq = encode_seq(fasta.fetch(contig, 0, fasta.get_reference_length(contig)))
-        padded = np.concatenate([np.full(radius, 4, np.uint8), seq, np.full(radius, 4, np.uint8)])
-        centers = pos0[m].astype(np.int64) + radius
-        idx = centers[:, None] + np.arange(-radius, radius + 1)[None, :]
-        # positions beyond the contig (wrong reference build / truncated
-        # FASTA) read as N instead of crashing the whole ingest
-        valid = (idx >= 0) & (idx < len(padded))
-        out[m] = np.where(valid, padded[np.clip(idx, 0, len(padded) - 1)], 4)
+        seq = fasta.fetch_encoded(contig)
+        sub = (pos0 if one_contig else pos0[codes == ui]).astype(np.int64)
+        rows = native.gather_windows_contig(seq, sub, radius)
+        if rows is None:
+            # numpy fallback: padded fancy-index gather; positions beyond
+            # the contig (wrong reference build / truncated FASTA) read as
+            # N instead of crashing the whole ingest
+            padded = np.concatenate([np.full(radius, 4, np.uint8), seq, np.full(radius, 4, np.uint8)])
+            idx = (sub + radius)[:, None] + np.arange(-radius, radius + 1)[None, :]
+            valid = (idx >= 0) & (idx < len(padded))
+            rows = np.where(valid, padded[np.clip(idx, 0, len(padded) - 1)], 4)
+        if one_contig:  # no mask copy: the gather IS the output
+            return rows
+        out[codes == ui] = rows
     return out
 
 

@@ -83,6 +83,26 @@ class FastaReader:
         else:
             self._index = build_fai(path)
         self._fh = open(path, "rb")
+        self._encoded: dict[str, np.ndarray] = {}
+
+    #: byte budget for the encoded-contig cache (default 4 GB covers a
+    #: whole human genome; VCTPU_FASTA_CACHE_BYTES tunes it down for
+    #: memory-constrained workers — 0 disables caching entirely)
+    _ENC_CACHE_BYTES = int(os.environ.get("VCTPU_FASTA_CACHE_BYTES", 4 << 30))
+
+    def fetch_encoded(self, chrom: str) -> np.ndarray:
+        """Whole-contig uint8 codes (A0 C1 G2 T3 N4), cached per contig —
+        repeated window gathers re-read one array instead of re-decoding
+        the FASTA text each time. The cache is byte-bounded (FIFO)."""
+        got = self._encoded.get(chrom)
+        if got is None:
+            got = encode_seq(self.fetch(chrom, 0, self.get_reference_length(chrom)))
+            if len(got) <= self._ENC_CACHE_BYTES:
+                total = sum(len(v) for v in self._encoded.values()) + len(got)
+                while self._encoded and total > self._ENC_CACHE_BYTES:
+                    total -= len(self._encoded.pop(next(iter(self._encoded))))
+                self._encoded[chrom] = got
+        return got
 
     @property
     def references(self) -> list[str]:

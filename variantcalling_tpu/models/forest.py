@@ -227,13 +227,22 @@ def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
     raise ValueError(f"unknown aggregation {gf.aggregation!r}")
 
 
+#: Strategy chosen by the most recent make_predictor call — bench logs it
+#: so a silent pallas->gemm (or gemm->gather) fallback is visible in the
+#: captured perf evidence instead of invisibly changing what was measured.
+last_strategy: str = "none"
+
+
 def make_predictor(forest: FlatForest, n_features: int | None = None):
     """Best inference strategy for the active backend: the pallas fused
     per-tree kernel on TPU (VCTPU_PALLAS=0 opts out), the jnp GEMM
-    encoding on other accelerators, the gather walk on CPU / big trees.
-    Returns a jittable fn(x) -> scores."""
+    encoding on other accelerators, the gather walk on CPU / big trees
+    (the filter pipeline routes CPU single-device scoring through the
+    native C++ walk before reaching here). Returns a jittable fn(x) ->
+    scores; records the choice in :data:`last_strategy`."""
     import os
 
+    global last_strategy
     gf = to_gemm(forest, n_features)
     try:
         backend = jax.default_backend()
@@ -251,11 +260,59 @@ def make_predictor(forest: FlatForest, n_features: int | None = None):
                 # not just ones that wrap their own calls
                 n_feat = gf.a.shape[1]
                 jax.block_until_ready(jax.jit(fn)(jnp.zeros((1, n_feat), jnp.float32)))
+                last_strategy = "pallas"
                 return fn
             except Exception:  # noqa: BLE001 — kernel gaps fall back to jnp GEMM
                 pass
+        last_strategy = "gemm"
         return lambda x: predict_score_gemm(gf, x)
+    last_strategy = "gather"
     return lambda x: predict_score(forest, x)
+
+
+def native_host_predictor(forest: FlatForest):
+    """CPU fast path: the exact predict_score walk in C++ as a plain HOST
+    function (numpy in, numpy out) — ~5x XLA:CPU's fused-gather lowering
+    on one core. Callers split their program at the feature matrix and
+    run this outside jit (a pure_callback inside the async chunk pipeline
+    can deadlock XLA:CPU's single-threaded callback executor). Returns
+    None when the native library is unavailable or the aggregation is
+    unknown; use only on the CPU backend (accelerators keep GEMM/pallas)."""
+    from variantcalling_tpu import native
+
+    if not native.available() or forest.aggregation not in ("mean", "logit_sum"):
+        return None
+    feat = np.ascontiguousarray(forest.feature, dtype=np.int32)
+    thr = np.ascontiguousarray(forest.threshold, dtype=np.float32)
+    left = np.ascontiguousarray(forest.left, dtype=np.int32)
+    right = np.ascontiguousarray(forest.right, dtype=np.int32)
+    value = np.ascontiguousarray(forest.value, dtype=np.float32)
+    dl = None if forest.default_left is None else \
+        np.ascontiguousarray(forest.default_left, dtype=np.uint8)
+    agg, base, depth = forest.aggregation, forest.base_score, forest.max_depth
+
+    def fn(x: np.ndarray) -> np.ndarray:
+        out = native.forest_predict(np.asarray(x), feat, thr, left, right,
+                                    value, dl, depth, agg, base)
+        if out is None:  # library vanished mid-process: jnp walk fallback
+            return np.asarray(predict_score(forest, jnp.asarray(x)))
+        return out
+
+    return fn
+
+
+def use_native_cpu_forest() -> bool:
+    """True when the CPU backend should route forest inference through the
+    native walk: single local device (the sharded mesh path must stay
+    XLA-collective) and not opted out via VCTPU_NATIVE_FOREST=0."""
+    import os
+
+    if os.environ.get("VCTPU_NATIVE_FOREST", "1") == "0":
+        return False
+    try:
+        return jax.default_backend() == "cpu" and len(jax.local_devices()) == 1
+    except Exception:  # noqa: BLE001 — backend probe failure: stay on jnp
+        return False
 
 
 def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: float = 0.5) -> FlatForest:

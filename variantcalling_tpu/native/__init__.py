@@ -24,6 +24,8 @@ _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "src", "vctpu_native.cc")
 _SRC_CRAM = os.path.join(_DIR, "src", "vctpu_cram.cc")
 _SRC_MATCH = os.path.join(_DIR, "src", "vctpu_match.cc")
+_SRC_GBT = os.path.join(_DIR, "src", "vctpu_gbt.cc")
+_SRC_FEAT = os.path.join(_DIR, "src", "vctpu_features.cc")
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
@@ -35,9 +37,30 @@ _i64p = ctypes.POINTER(ctypes.c_int64)
 _i8p = ctypes.POINTER(ctypes.c_int8)
 
 
+_CXXFLAGS = ["-O3", "-march=native", "-funroll-loops", "-shared", "-fPIC", "-std=c++17"]
+
+
+def _cpu_tag() -> str:
+    """ISA fingerprint folded into the build cache key: -march=native
+    binaries must not be reused by a host lacking the builder's
+    extensions (shared site-packages / NFS homes / mixed pods)."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
 def _build() -> str | None:
     hasher = hashlib.sha256()
-    for src in (_SRC, _SRC_CRAM, _SRC_MATCH):
+    hasher.update(" ".join(_CXXFLAGS).encode())  # flag changes rebuild too
+    hasher.update(_cpu_tag().encode())  # so does a different host ISA
+    for src in (_SRC, _SRC_CRAM, _SRC_MATCH, _SRC_GBT, _SRC_FEAT):
         with open(src, "rb") as fh:
             hasher.update(fh.read())
     tag = hasher.hexdigest()[:12]
@@ -46,7 +69,8 @@ def _build() -> str | None:
         return out
     # per-process tmp name keeps os.replace atomic under concurrent builds
     tmp = f"{out}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC, _SRC_CRAM, _SRC_MATCH, "-lz"]
+    cmd = ["g++", *_CXXFLAGS, "-o", tmp,
+           _SRC, _SRC_CRAM, _SRC_MATCH, _SRC_GBT, _SRC_FEAT, "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, out)
@@ -129,6 +153,40 @@ def get_lib() -> ctypes.CDLL | None:
             _i8p, _u8p, _f32p, _f32p, _f32p,
             _u8p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
             _u8p, _i32p, ctypes.c_int32, _f64p,
+        ]
+        lib.vctpu_bin_features.restype = _i64
+        lib.vctpu_bin_features.argtypes = [
+            _f32p, _i64, ctypes.c_int32, _f32p, ctypes.c_int32, _u8p,
+        ]
+        lib.vctpu_gather_windows.restype = _i64
+        lib.vctpu_gather_windows.argtypes = [
+            _u8p, _i64, _i64p, _i64, ctypes.c_int32, _u8p,
+        ]
+        lib.vctpu_format_float_info.restype = _i64
+        lib.vctpu_format_float_info.argtypes = [
+            _f64p, _i64, _u8p, _i64, _u8p, _i64, _i64p,
+        ]
+        lib.vctpu_featurize_windows.restype = _i64
+        lib.vctpu_featurize_windows.argtypes = [
+            _u8p, _i64, ctypes.c_int32, ctypes.c_int32,
+            _u8p, _i32p, _i32p, _i32p, _u8p, _i32p,
+            _i32p, _i32p, _f32p, _i32p, _i32p, _i32p,
+        ]
+        lib.vctpu_forest_predict.restype = _i64
+        lib.vctpu_forest_predict.argtypes = [
+            _f32p, _i64, ctypes.c_int32,
+            _i32p, _f32p, _i32p, _i32p, _f32p, _u8p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_float,
+            _f32p,
+        ]
+        lib.vctpu_gbt_fit.restype = _i64
+        lib.vctpu_gbt_fit.argtypes = [
+            _u8p, _f32p, _f32p,
+            _i64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            _i32p, _i32p, _f32p,
         ]
         _LIB = lib
         return _LIB
@@ -321,8 +379,9 @@ def vcf_assemble(
         return None
     n = len(line_spans)
     src = np.ascontiguousarray(_u8view(buf))
-    fb = np.frombuffer(filt_blob or b"\x00", dtype=np.uint8)
-    sb = np.frombuffer(sfx_blob or b"\x00", dtype=np.uint8)
+    # bytes OR uint8 ndarray blobs (ndarray: no copy, no bool ambiguity)
+    fb = np.ascontiguousarray(_u8view(filt_blob)) if len(filt_blob) else np.zeros(1, np.uint8)
+    sb = np.ascontiguousarray(_u8view(sfx_blob)) if len(sfx_blob) else np.zeros(1, np.uint8)
     cap = int(
         (line_spans[:, 1] - line_spans[:, 0]).sum() + len(filt_blob) + len(sfx_blob) + 4 * n + 64
     )
@@ -495,3 +554,163 @@ def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -
         p.ctypes.data_as(_i64p), len(p), out.ctypes.data_as(_u8p),
     )
     return out
+
+
+def bin_features(x: np.ndarray, edges: np.ndarray) -> np.ndarray | None:
+    """searchsorted-left quantile binning (NaN -> last bin), uint8 out;
+    exact match for the numpy/jnp binning in models/boosting."""
+    lib = get_lib()
+    if lib is None or edges.shape[1] > 255:
+        return None
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    xx = np.ascontiguousarray(x, dtype=np.float32)
+    ee = np.ascontiguousarray(edges, dtype=np.float32)
+    n, f = xx.shape
+    out = np.empty((n, f), dtype=np.uint8)
+    rc = lib.vctpu_bin_features(
+        xx.ctypes.data_as(_f32p), n, f,
+        ee.ctypes.data_as(_f32p), ee.shape[1], out.ctypes.data_as(_u8p),
+    )
+    return out if rc == 0 else None
+
+
+def featurize_windows(windows: np.ndarray, center: int,
+                      is_indel: np.ndarray, indel_nuc: np.ndarray,
+                      ref_code: np.ndarray, alt_code: np.ndarray,
+                      is_snp: np.ndarray, flow_order: np.ndarray) -> dict | None:
+    """Native window featurization (ops/features.py device-kernel twin);
+    returns the DEVICE_FEATURES columns dict or None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    ww = np.ascontiguousarray(windows, dtype=np.uint8)
+    n, w = ww.shape
+    ii = np.ascontiguousarray(is_indel, dtype=np.uint8)
+    nu = np.ascontiguousarray(indel_nuc, dtype=np.int32)
+    rc_ = np.ascontiguousarray(ref_code, dtype=np.int32)
+    ac = np.ascontiguousarray(alt_code, dtype=np.int32)
+    sn = np.ascontiguousarray(is_snp, dtype=np.uint8)
+    fo = np.ascontiguousarray(flow_order, dtype=np.int32)
+    hl = np.empty(n, dtype=np.int32)
+    hn = np.empty(n, dtype=np.int32)
+    gc = np.empty(n, dtype=np.float32)
+    cy = np.empty(n, dtype=np.int32)
+    lm = np.empty(n, dtype=np.int32)
+    rm = np.empty(n, dtype=np.int32)
+    rc = lib.vctpu_featurize_windows(
+        ww.ctypes.data_as(_u8p), n, w, center,
+        ii.ctypes.data_as(_u8p), nu.ctypes.data_as(_i32p),
+        rc_.ctypes.data_as(_i32p), ac.ctypes.data_as(_i32p),
+        sn.ctypes.data_as(_u8p), fo.ctypes.data_as(_i32p),
+        hl.ctypes.data_as(_i32p), hn.ctypes.data_as(_i32p),
+        gc.ctypes.data_as(_f32p), cy.ctypes.data_as(_i32p),
+        lm.ctypes.data_as(_i32p), rm.ctypes.data_as(_i32p),
+    )
+    if rc != 0:
+        return None
+    return {"hmer_indel_length": hl, "hmer_indel_nuc": hn, "gc_content": gc,
+            "cycleskip_status": cy, "left_motif": lm, "right_motif": rm}
+
+
+def gather_windows_contig(seq: np.ndarray, pos0: np.ndarray, radius: int) -> np.ndarray | None:
+    """(n, 2r+1) uint8 windows over one encoded contig (out-of-range -> N)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    s = np.ascontiguousarray(seq, dtype=np.uint8)
+    p = np.ascontiguousarray(pos0, dtype=np.int64)
+    out = np.empty((len(p), 2 * radius + 1), dtype=np.uint8)
+    rc = lib.vctpu_gather_windows(
+        s.ctypes.data_as(_u8p), len(s), p.ctypes.data_as(_i64p), len(p),
+        radius, out.ctypes.data_as(_u8p),
+    )
+    return out if rc == 0 else None
+
+
+def format_float_info(vals: np.ndarray, prefix: bytes) -> tuple[np.ndarray, np.ndarray] | None:
+    """Render b";KEY=<%g>" per non-NaN value (empty for NaN); returns
+    (byte buffer, (n+1,) offsets) or None when unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    _f64p = ctypes.POINTER(ctypes.c_double)
+    v = np.ascontiguousarray(vals, dtype=np.float64)
+    n = len(v)
+    cap = n * (len(prefix) + 32) + 64
+    buf = np.empty(cap, dtype=np.uint8)
+    offs = np.empty(n + 1, dtype=np.int64)
+    p = np.frombuffer(prefix, dtype=np.uint8) if prefix else np.zeros(0, np.uint8)
+    total = lib.vctpu_format_float_info(
+        v.ctypes.data_as(_f64p), n, p.ctypes.data_as(_u8p), len(p),
+        buf.ctypes.data_as(_u8p), cap, offs.ctypes.data_as(_i64p),
+    )
+    if total < 0:
+        return None
+    return buf[:total], offs
+
+
+def forest_predict(x: np.ndarray, feat: np.ndarray, thr: np.ndarray,
+                   left: np.ndarray, right: np.ndarray, value: np.ndarray,
+                   default_left: np.ndarray | None, max_depth: int,
+                   aggregation: str, base_score: float) -> np.ndarray | None:
+    """Native gather-walk forest inference (models/forest.predict_score
+    semantics); returns (n,) float32 scores or None when unavailable."""
+    lib = get_lib()
+    if lib is None or aggregation not in ("mean", "logit_sum"):
+        return None
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    xx = np.ascontiguousarray(x, dtype=np.float32)
+    ff = np.ascontiguousarray(feat, dtype=np.int32)
+    tt = np.ascontiguousarray(thr, dtype=np.float32)
+    ll = np.ascontiguousarray(left, dtype=np.int32)
+    rr = np.ascontiguousarray(right, dtype=np.int32)
+    vv = np.ascontiguousarray(value, dtype=np.float32)
+    dl = None if default_left is None else np.ascontiguousarray(default_left, dtype=np.uint8)
+    n, f = xx.shape
+    t, m = ff.shape
+    out = np.empty(n, dtype=np.float32)
+    rc = lib.vctpu_forest_predict(
+        xx.ctypes.data_as(_f32p), n, f,
+        ff.ctypes.data_as(_i32p), tt.ctypes.data_as(_f32p),
+        ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
+        vv.ctypes.data_as(_f32p),
+        None if dl is None else dl.ctypes.data_as(_u8p),
+        t, m, max_depth, 0 if aggregation == "mean" else 1, base_score,
+        out.ctypes.data_as(_f32p),
+    )
+    return out if rc == 0 else None
+
+
+def gbt_fit(binned: np.ndarray, y: np.ndarray, w: np.ndarray | None,
+            n_trees: int, depth: int, n_bins: int,
+            learning_rate: float, reg_lambda: float, min_child_weight: float,
+            base_score: float):
+    """Native histogram-GBT fit (src/vctpu_gbt.cc) — the CPU-fallback twin
+    of models/boosting's jitted trainer (partitioned samples + sibling-
+    subtraction histograms). Returns (feats, bins, leaves) shaped exactly
+    like the jitted trainer's outputs, or None when the library is
+    unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    bn = np.ascontiguousarray(binned, dtype=np.uint8)
+    yy = np.ascontiguousarray(y, dtype=np.float32)
+    ww = None if w is None else np.ascontiguousarray(w, dtype=np.float32)
+    n, f = bn.shape
+    leaves = 1 << depth
+    feats = np.empty((n_trees, depth, leaves), dtype=np.int32)
+    bins = np.empty((n_trees, depth, leaves), dtype=np.int32)
+    vals = np.empty((n_trees, leaves), dtype=np.float32)
+    rc = lib.vctpu_gbt_fit(
+        bn.ctypes.data_as(_u8p), yy.ctypes.data_as(_f32p),
+        None if ww is None else ww.ctypes.data_as(_f32p),
+        n, f, n_bins, n_trees, depth,
+        learning_rate, reg_lambda, min_child_weight, base_score,
+        feats.ctypes.data_as(_i32p), bins.ctypes.data_as(_i32p),
+        vals.ctypes.data_as(_f32p),
+    )
+    if rc != 0:
+        return None
+    return feats, bins, vals

@@ -1,0 +1,204 @@
+// Window featurization — CPU twin of ops/features.py's device kernels.
+//
+// The TPU path computes these as one fused XLA program so feature tensors
+// never leave HBM (ops/features.py: gc_content, hmer_indel_features,
+// motif_codes, cycle_skip_status; reference semantics per
+// ugbio_core.vcfbed.variant_annotation / ugvc cycleskip column).  On a
+// single CPU core the same math is a single pass over each 41-byte window
+// row here, ~10x XLA:CPU's multi-kernel lowering.  Semantics are an EXACT
+// match of the jitted kernels (locked by tests/unit parity tests):
+//
+// - gc_content: GC fraction over +-10 around the anchor, N excluded from
+//   the denominator (int counts, f32 divide — bitwise-identical result).
+// - hmer: run length of the reference homopolymer starting at center+1,
+//   capped at min(40, window end); hmer iff indel with single-nucleotide
+//   unit matching the base at center+1.
+// - motifs: base-5 packed k=5-mers adjacent to the anchor.
+// - cycle-skip: flow-signature comparison of ref vs alt local haplotype
+//   (context 4): differing flow counts -> 2, same count but different
+//   run-carrying flow positions -> 1, else 0; non-SNP -> -1.  The flow
+//   signature is the closed form of ops/features._flow_signature: each
+//   maximal base run consumes (pos - prev_pos) mod 4 flows (first run:
+//   pos + 1), truncated at the first N.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+
+namespace {
+
+constexpr int32_t BASE_N = 4;
+
+// flow signature of one haplotype: returns run count, fills cums[] with
+// the (strictly increasing) cumulative flow position of each run.
+// lookup[base] = flow-cycle position of base in the flow order.
+inline int32_t flow_signature(const uint8_t* hap, int32_t len,
+                              const int32_t* lookup, int32_t* cums) {
+    int32_t eff = len;
+    for (int32_t i = 0; i < len; ++i) {
+        if (hap[i] == BASE_N) { eff = i; break; }
+    }
+    int32_t n_runs = 0, cum = 0;
+    int32_t prev_pos = -1;
+    uint8_t prev_base = 255;
+    for (int32_t i = 0; i < eff; ++i) {
+        const int32_t pos = lookup[hap[i]];
+        if (i == 0 || hap[i] != prev_base) {  // run start
+            const int32_t d = (i == 0) ? pos + 1 : ((pos - prev_pos) % 4 + 4) % 4;
+            cum += d;
+            cums[n_runs++] = cum;
+        }
+        prev_base = hap[i];
+        prev_pos = pos;
+    }
+    return n_runs;
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns 0 on success, <0 on bad arguments.
+int64_t vctpu_featurize_windows(
+    const uint8_t* windows,     // (n, w) base codes A0 C1 G2 T3 N4
+    int64_t n, int32_t w, int32_t center,
+    const uint8_t* is_indel,    // (n,)
+    const int32_t* indel_nuc,   // (n,) 0..3 single-nuc unit, else 4
+    const int32_t* ref_code,    // (n,)
+    const int32_t* alt_code,    // (n,)
+    const uint8_t* is_snp,      // (n,)
+    const int32_t* flow_order,  // (4,) base codes in flow-cycle order
+    int32_t* hmer_len,          // out (n,)
+    int32_t* hmer_nuc,          // out (n,)
+    float* gc,                  // out (n,)
+    int32_t* cyc,               // out (n,)
+    int32_t* left_motif,        // out (n,)
+    int32_t* right_motif)       // out (n,)
+{
+    constexpr int32_t GC_RADIUS = 10, MOTIF_K = 5, CONTEXT = 4, MAX_RUN = 40;
+    if (n < 0 || w <= 0 || center < GC_RADIUS || center + GC_RADIUS >= w ||
+        center < MOTIF_K || center + MOTIF_K >= w ||
+        center < CONTEXT || center + CONTEXT >= w)
+        return -1;
+    int32_t lookup[5] = {0, 0, 0, 0, 0};  // N unused (runs truncate first)
+    for (int32_t p = 0; p < 4; ++p) {
+        if (flow_order[p] < 0 || flow_order[p] > 3) return -2;
+        lookup[flow_order[p]] = p;
+    }
+
+    const int32_t hap_len = 2 * CONTEXT + 1;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* row = windows + (size_t)i * w;
+
+        // gc_content over +-GC_RADIUS
+        int32_t n_gc = 0, n_base = 0;
+        for (int32_t j = center - GC_RADIUS; j <= center + GC_RADIUS; ++j) {
+            const uint8_t b = row[j];
+            n_gc += (b == 1) | (b == 2);   // C or G
+            n_base += b != BASE_N;
+        }
+        gc[i] = (float)n_gc / (float)(n_base > 1 ? n_base : 1);
+
+        // hmer run at center+1, capped at the window edge like the jitted
+        // kernel (span = windows[:, start:start+max_run])
+        const int32_t start = center + 1;
+        const int32_t span = (w - start) < MAX_RUN ? (w - start) : MAX_RUN;
+        const uint8_t base0 = row[start];
+        int32_t run = 1;
+        while (run < span && row[start + run] == base0) ++run;
+        const bool hmer = is_indel[i] && indel_nuc[i] < 4 &&
+                          indel_nuc[i] == (int32_t)base0;
+        hmer_len[i] = hmer ? run : 0;
+        hmer_nuc[i] = hmer ? indel_nuc[i] : BASE_N;
+
+        // base-5 packed motifs adjacent to the anchor
+        int32_t lm = 0, rm = 0;
+        for (int32_t j = 0; j < MOTIF_K; ++j) {
+            lm = lm * 5 + row[center - MOTIF_K + j];
+            rm = rm * 5 + row[center + 1 + j];
+        }
+        left_motif[i] = lm;
+        right_motif[i] = rm;
+
+        // cycle-skip status (SNPs only)
+        if (!is_snp[i]) {
+            cyc[i] = -1;
+            continue;
+        }
+        uint8_t ref_hap[2 * CONTEXT + 1], alt_hap[2 * CONTEXT + 1];
+        for (int32_t j = 0; j < CONTEXT; ++j) {
+            ref_hap[j] = alt_hap[j] = row[center - CONTEXT + j];
+            ref_hap[CONTEXT + 1 + j] = alt_hap[CONTEXT + 1 + j] = row[center + 1 + j];
+        }
+        ref_hap[CONTEXT] = (uint8_t)ref_code[i];
+        alt_hap[CONTEXT] = (uint8_t)alt_code[i];
+        int32_t ref_cums[2 * CONTEXT + 1], alt_cums[2 * CONTEXT + 1];
+        const int32_t nr = flow_signature(ref_hap, hap_len, lookup, ref_cums);
+        const int32_t na = flow_signature(alt_hap, hap_len, lookup, alt_cums);
+        const int32_t ref_flows = nr ? ref_cums[nr - 1] : 0;
+        const int32_t alt_flows = na ? alt_cums[na - 1] : 0;
+        if (ref_flows != alt_flows) {
+            cyc[i] = 2;
+        } else {
+            bool diff = nr != na;
+            for (int32_t j = 0; !diff && j < nr; ++j)
+                diff = ref_cums[j] != alt_cums[j];
+            cyc[i] = diff ? 1 : 0;
+        }
+    }
+    return 0;
+}
+
+// Reference-window gather for one contig: out[i] = seq[pos0[i]-radius ..
+// pos0[i]+radius], out-of-contig positions read as N (code 4) — the
+// C++ twin of featurize.gather_windows' padded fancy-index gather.
+int64_t vctpu_gather_windows(
+    const uint8_t* seq, int64_t seq_len,
+    const int64_t* pos0, int64_t n, int32_t radius,
+    uint8_t* out)  // (n, 2*radius+1)
+{
+    if (n < 0 || radius <= 0 || seq_len < 0) return -1;
+    const int32_t w = 2 * radius + 1;
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t c = pos0[i];
+        uint8_t* row = out + (size_t)i * w;
+        const int64_t lo = c - radius, hi = c + radius + 1;
+        if (lo >= 0 && hi <= seq_len) {  // fully inside: straight copy
+            const uint8_t* s = seq + lo;
+            for (int32_t j = 0; j < w; ++j) row[j] = s[j];
+        } else {
+            for (int32_t j = 0; j < w; ++j) {
+                const int64_t p = lo + j;
+                row[j] = (p >= 0 && p < seq_len) ? seq[p] : 4;
+            }
+        }
+    }
+    return 0;
+}
+
+// Per-record ";KEY=<%g>" INFO suffixes for one float column (NaN ->
+// empty) — the filter pipeline's TREE_SCORE writeback formatter, printf
+// %g exactly like numpy's b"%g" so the byte-splicing output is unchanged.
+// Returns total bytes written, or -1 when cap is too small.
+int64_t vctpu_format_float_info(
+    const double* vals, int64_t n,
+    const uint8_t* prefix, int64_t prefix_len,  // b";KEY="
+    uint8_t* out_buf, int64_t cap,
+    int64_t* out_offs)                          // (n+1,)
+{
+    int64_t pos = 0;
+    out_offs[0] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const double v = vals[i];
+        if (!std::isnan(v)) {
+            if (pos + prefix_len + 32 > cap) return -1;
+            for (int64_t j = 0; j < prefix_len; ++j) out_buf[pos + j] = prefix[j];
+            pos += prefix_len;
+            pos += std::snprintf((char*)out_buf + pos, 32, "%g", v);
+        }
+        out_offs[i + 1] = pos;
+    }
+    return pos;
+}
+
+}  // extern "C"

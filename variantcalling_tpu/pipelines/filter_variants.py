@@ -196,7 +196,16 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
     if hit is not None and hit[0] is model:
         return hit[1]
 
-    predictor = _raw_predictor(model, feature_names)
+    # CPU single-device: split the program at the feature matrix and run
+    # the forest walk in C++ on the host (~5x XLA:CPU's gather lowering);
+    # the jitted part then computes features only. Accelerators keep the
+    # fully fused on-device program (features never leave HBM).
+    native_fn = None
+    if isinstance(model, FlatForest) and forest_mod.use_native_cpu_forest():
+        ordered = forest_mod.with_feature_order(model, feature_names)
+        native_fn = forest_mod.native_host_predictor(ordered)
+    predictor = (lambda xx: xx) if native_fn is not None else \
+        _raw_predictor(model, feature_names)
     host_names = [f for f in feature_names if f not in DEVICE_FEATURES]
     host_idx = {f: i for i, f in enumerate(host_names)}
 
@@ -222,7 +231,7 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
     else:
         fn = body
 
-    jitted = (jax.jit(fn), host_names)
+    jitted = (jax.jit(fn), host_names, native_fn)
     _cache_put(key, (model, jitted))
     return jitted
 
@@ -248,6 +257,34 @@ def _narrow_column(a: np.ndarray) -> np.ndarray:
     return a.astype(np.float32, copy=False)
 
 
+def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.ndarray | None:
+    """All-native CPU hot path: numpy window gather + C++ featurize + C++
+    forest walk; returns scores or None to fall back to the jitted path."""
+    from variantcalling_tpu import native
+    from variantcalling_tpu.featurize import CENTER, DEVICE_FEATURES, gather_windows
+    from variantcalling_tpu.ops.features import A, C, G, T
+
+    nf = forest_mod.native_host_predictor(
+        forest_mod.with_feature_order(model, hf.names))
+    if nf is None or not native.available():
+        return None
+    windows = hf.windows
+    if windows is None:
+        if table is None or fasta is None:
+            return None
+        windows = gather_windows(table, fasta)
+    alle = hf.alle
+    fo = np.asarray([{"A": A, "C": C, "G": G, "T": T}[c] for c in flow_order],
+                    dtype=np.int32)
+    dev = native.featurize_windows(windows, CENTER, alle.is_indel, alle.indel_nuc,
+                                   alle.ref_code, alle.alt_code, alle.is_snp, fo)
+    if dev is None:
+        return None
+    cols = [np.asarray(dev[f] if f in dev else hf.cols[f], dtype=np.float32)
+            for f in hf.names]
+    return nf(np.stack(cols, axis=1))
+
+
 def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
                           fasta: FastaReader | None = None) -> np.ndarray:
     """Chunked fused featurize+score over a HostFeatures batch; returns scores.
@@ -261,6 +298,16 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
     encode/upload is paid.
     """
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+
+    # CPU single-device: the whole hot path (window gather -> featurize ->
+    # forest walk) runs in the native engine — one pass per 41-byte window
+    # row in C++, ~10x XLA:CPU's multi-kernel lowering, exact-parity with
+    # the jitted kernels (tests/unit/test_native_featurize.py). Meshes and
+    # accelerators keep the fused on-device program below.
+    if isinstance(model, FlatForest) and forest_mod.use_native_cpu_forest():
+        score = _native_cpu_featurize_score(model, hf, flow_order, table, fasta)
+        if score is not None:
+            return score
 
     n_dev = len(jax.local_devices())
     mesh = make_mesh(n_model=1) if n_dev > 1 else None
@@ -298,8 +345,8 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
             else:
                 gpos_fill = packed_position_fill(genome)
 
-    fn, host_names = _fused_program(model, hf.names, flow_order,
-                                    genome_resident=genome_resident)
+    fn, host_names, native_fn = _fused_program(model, hf.names, flow_order,
+                                               genome_resident=genome_resident)
     host_cols = tuple(_narrow_column(hf.cols[f]) for f in host_names)
 
     from variantcalling_tpu.featurize import _bucket
@@ -308,6 +355,13 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
     n = len(table) if table is not None else len(windows)
     out = np.empty(n, dtype=np.float32)
     pending: list[tuple[int, int, object]] = []
+
+    # on the native-CPU split, the jit returns the FEATURE MATRIX and the
+    # C++ walk finishes on the host; accelerators return device scores
+    def finish(res, k):
+        arr = np.asarray(res)[:k]
+        return native_fn(arr) if native_fn is not None else arr
+
     for lo in range(0, n, chunk_size):
         hi = min(lo + chunk_size, n)
         # power-of-two bucket (rounded up to a dp multiple) so distinct batch
@@ -342,10 +396,10 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
         else:
             pending.append((lo, hi, fn(prep(windows, fill=4), *common)))
         while len(pending) > 2:
-            plo, phi, score = pending.pop(0)
-            out[plo:phi] = np.asarray(score)[: phi - plo]
-    for lo, hi, score in pending:
-        out[lo:hi] = np.asarray(score)[: hi - lo]
+            plo, phi, res = pending.pop(0)
+            out[plo:phi] = finish(res, phi - plo)
+    for lo, hi, res in pending:
+        out[lo:hi] = finish(res, hi - lo)
     return out
 
 
@@ -359,6 +413,11 @@ def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray
     if not isinstance(model, (FlatForest, ThresholdModel)):
         # raw sklearn estimator that escaped conversion
         return np.asarray(model.predict_proba(x)[:, 1])
+    if isinstance(model, FlatForest) and forest_mod.use_native_cpu_forest():
+        nf = forest_mod.native_host_predictor(
+            forest_mod.with_feature_order(model, feature_names))
+        if nf is not None:  # C++ walk, no device round-trip on CPU
+            return nf(np.ascontiguousarray(x, dtype=np.float32))
     fn = _predictor_for(model, feature_names)
 
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh

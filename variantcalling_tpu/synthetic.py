@@ -107,3 +107,49 @@ def hot_path_args(n: int, seed: int = 1):
         jnp.asarray(is_indel),
         jnp.asarray(indel_nuc),
     )
+
+
+def native_hot_path(forest: FlatForest):
+    """CPU twin of :func:`fused_hot_path`: the SAME 12 features and forest
+    walk, computed by the native engine over host numpy arrays — the stage
+    the filter pipeline actually runs on a single-core CPU fallback
+    (pipelines/filter_variants._native_cpu_featurize_score). Returns a
+    host fn with fused_hot_path's signature, or None when the native
+    library is unavailable."""
+    from variantcalling_tpu import native
+    from variantcalling_tpu.models.forest import native_host_predictor
+
+    nf = native_host_predictor(forest)
+    if nf is None or not native.available():
+        return None
+    fo = np.asarray([3, 2, 1, 0], dtype=np.int32)  # TGCA
+
+    def fwd(windows, qual, dp, sor, af, gq, is_het, is_indel, indel_nuc):
+        n = len(qual)
+        zeros = np.zeros(n, np.int32)
+        no_snp = np.zeros(n, np.uint8)  # cycle-skip unused by this feature set
+        dev = native.featurize_windows(windows, windows.shape[1] // 2,
+                                       is_indel, indel_nuc, zeros, zeros, no_snp, fo)
+        if dev is None:
+            return None
+        x = np.stack([
+            qual, dp, sor, af, gq, is_het,
+            np.asarray(is_indel, np.float32),
+            dev["hmer_indel_length"].astype(np.float32),
+            dev["hmer_indel_nuc"].astype(np.float32),
+            dev["gc_content"],
+            (dev["left_motif"] % 125).astype(np.float32),
+            (dev["right_motif"] % 125).astype(np.float32),
+        ], axis=1)
+        return nf(x)
+
+    return fwd
+
+
+def host_hot_path_args(n: int, seed: int = 1):
+    """Host numpy positional args for native_hot_path (same distribution
+    as hot_path_args)."""
+    rng = np.random.default_rng(seed)
+    windows, scalars, is_indel, indel_nuc = synthetic_batch(n, rng)
+    return (windows, scalars["qual"], scalars["dp"], scalars["sor"],
+            scalars["af"], scalars["gq"], scalars["is_het"], is_indel, indel_nuc)

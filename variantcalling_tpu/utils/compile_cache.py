@@ -1,0 +1,60 @@
+"""Persistent XLA compilation cache for every CLI entry point.
+
+The flagship pipelines are CLI tools invoked once per file (reference
+docs/howto-callset-filter.md's per-callset invocations), so without a
+persistent cache each process re-pays the full jit compile of the fused
+featurize+score program (~4s on CPU, 20-40s first-compile on TPU through
+the tunnel) before touching a single variant. JAX's compilation cache
+persists compiled executables on disk keyed by (HLO, jaxlib, flags,
+device kind); warm CLI invocations then deserialize in ~0.1-0.5s.
+
+Cache location: ``$VCTPU_COMPILE_CACHE`` if set (empty string disables),
+else ``~/.cache/vctpu/xla``. Enabling is idempotent and never fatal — a
+read-only home directory simply leaves caching off.
+
+Note: XLA:CPU logs a benign machine-feature mismatch (E-level,
+``+prefer-no-scatter``/``+prefer-no-gather``) when loading AOT results;
+these are XLA-internal pseudo-features, not real ISA bits. We leave
+stderr untouched — suppressing C++ E-logs would also hide real faults.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_ENABLED = False
+
+
+def enable_persistent_cache() -> bool:
+    """Point JAX's compilation cache at a persistent directory; returns
+    True when enabled (idempotent).
+
+    When jax is not imported yet (the CLI dispatch fast path — many tools
+    are pandas-only and must not pay a jax import at startup), the cache
+    is configured through JAX's environment knobs, which jax reads at
+    import time; only an already-imported jax needs config.update."""
+    global _ENABLED
+    if _ENABLED:
+        return True
+    path = os.environ.get("VCTPU_COMPILE_CACHE")
+    if path == "":
+        return False
+    if path is None:
+        path = os.path.join(os.path.expanduser("~"), ".cache", "vctpu", "xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        if "jax" not in sys.modules:
+            os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
+            # the fused pipeline programs compile in 1-5s; cache anything
+            # that takes meaningful time so warm CLI runs skip it
+            os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+        else:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — caching is best-effort, never fatal
+        return False
+    _ENABLED = True
+    return True
