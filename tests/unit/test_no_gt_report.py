@@ -179,3 +179,79 @@ def test_full_analysis_pipeline(tmp_path):
     assert motifs["size"].sum() == 30
     cs = read_hdf(prefix + ".h5", key="callable_size")
     assert int(cs["callable_size"].iloc[0]) == 5000
+
+
+def test_somatic_analysis_three_catalogs_and_control(tmp_path, rng):
+    """somatic_analysis emits SBS96+ID83+DBS78 matrices (case + control
+    columns), fits exposures per catalog on-device, and writes the
+    case-vs-control enrichment table (reference run_no_gt_report.py:
+    334-595 SigProfiler stage incl. control cohort)."""
+    import pandas as pd
+
+    from variantcalling_tpu.pipelines import run_no_gt_report as rng_mod
+    from variantcalling_tpu.reports.signatures import dbs78_labels, id83_labels
+    from variantcalling_tpu.utils.h5_utils import read_hdf
+
+    genome = ("GGAACCCCGTTGGATCGATCGGGGGGAACT" + "ACGT" * 200)
+    (tmp_path / "ref.fa").write_text(
+        ">chr1\n" + "\n".join(genome[i:i + 60] for i in range(0, len(genome), 60)) + "\n")
+
+    def write(path, recs):
+        lines = ["##fileformat=VCFv4.2", f"##contig=<ID=chr1,length={len(genome)}>",
+                 "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+        for p, r, a in recs:
+            lines.append(f"chr1\t{p}\t.\t{r}\t{a}\t50\tPASS\t.")
+        path.write_text("\n".join(lines) + "\n")
+
+    # case: SNVs + the engineered indel + doublet
+    case = [(40, "G", "T"), (52, "G", "A"), (4, "AC", "A"), (14, "GA", "TG")]
+    ctrl = [(44, "G", "T"), (8, "CG", "C")]
+    write(tmp_path / "case.vcf", sorted(case))
+    write(tmp_path / "ctrl.vcf", sorted(ctrl))
+
+    # tiny catalogs: identity-ish 2-signature matrices over each label set
+    def catalog(labels, path):
+        k = np.zeros((len(labels), 2))
+        k[: len(labels) // 2, 0] = 1.0
+        k[len(labels) // 2:, 1] = 1.0
+        pd.DataFrame({"Type": labels, "SigA": k[:, 0], "SigB": k[:, 1]}).to_csv(
+            path, sep="\t", index=False)
+
+    from variantcalling_tpu.reports.no_gt_stats import motif_index_96
+
+    sbs_labels = [f"{m[0]}[{m[1]}>{a}]{m[2]}" for (m, a) in motif_index_96()]
+    catalog(sbs_labels, tmp_path / "sbs.tsv")
+    catalog(id83_labels(), tmp_path / "id.tsv")
+    catalog(dbs78_labels(), tmp_path / "dbs.tsv")
+
+    prefix = str(tmp_path / "som")
+    assert rng_mod.run([
+        "somatic_analysis", "--input_file", str(tmp_path / "case.vcf"),
+        "--reference", str(tmp_path / "ref.fa"), "--output_prefix", prefix,
+        "--signatures_file", str(tmp_path / "sbs.tsv"),
+        "--id_signatures_file", str(tmp_path / "id.tsv"),
+        "--dbs_signatures_file", str(tmp_path / "dbs.tsv"),
+        "--control_vcfs", str(tmp_path / "ctrl.vcf"),
+    ]) == 0
+
+    for cat, n_ch in (("SBS96", 96), ("ID83", 83), ("DBS78", 78)):
+        m = pd.read_csv(f"{prefix}.{cat}.all", sep="\t")
+        assert len(m) == n_ch
+        assert list(m.columns) == ["MutationType", "som", "ctrl"]
+    id_m = pd.read_csv(f"{prefix}.ID83.all", sep="\t").set_index("MutationType")
+    assert id_m.loc["1:Del:C:3", "som"] == 1
+    assert id_m.loc["1:Del:C:3", "ctrl"] == 0
+    assert id_m.loc["1:Del:G:0", "ctrl"] if "1:Del:G:0" in id_m.index else True
+    dbs_m = pd.read_csv(f"{prefix}.DBS78.all", sep="\t").set_index("MutationType")
+    assert dbs_m.loc["TC>CA", "som"] == 1
+    # the adjacent SNV pair became a doublet and must NOT also count in
+    # SBS96: only the two isolated SNVs remain there
+    sbs_m = pd.read_csv(f"{prefix}.SBS96.all", sep="\t").set_index("MutationType")
+    assert sbs_m["som"].sum() == 2
+
+    exp = read_hdf(f"{prefix}.h5", key="signature_exposures")
+    assert set(exp["catalog"]) <= {"SBS96", "ID83", "DBS78"}
+    assert {"SBS96", "ID83", "DBS78"} <= set(exp["catalog"])
+    assert {"som", "ctrl"} <= set(exp["sample"])
+    cmp_tbl = read_hdf(f"{prefix}.h5", key="signature_control_comparison")
+    assert {"case_fraction", "control_mean_fraction", "enrichment"} <= set(cmp_tbl.columns)

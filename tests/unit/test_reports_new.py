@@ -369,6 +369,39 @@ def test_mrd_data_analysis_full_sections(tmp_path):
         assert section.lower() in text.lower()
 
 
+def test_mrd_control_signature_sections(tmp_path):
+    """Cells 30-34: each control signature VCF gets its own mutation-type
+    and allele-fraction sections/keys (signature_type != 'matched')."""
+    from variantcalling_tpu.pipelines import mrd_data_analysis
+    from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+    sig, fm = _mrd_world(tmp_path)
+    # control = the matched signature file copied under a new name (content
+    # is irrelevant to the wiring; keys/sections are derived from the stem)
+    ctrl = str(tmp_path / "db_control.vcf")
+    open(ctrl, "w").write(open(sig).read())
+    h5 = str(tmp_path / "mrd.h5")
+    write_hdf(pd.DataFrame([{
+        "n_signature_loci": 20, "n_supporting_reads": 20, "n_trials": 1000,
+        "tumor_fraction": 1e-3, "tf_ci_low": 5e-4, "tf_ci_high": 2e-3,
+        "expected_background_reads": 0.1, "mrd_detected": True,
+    }]), h5, key="mrd_summary", mode="w")
+    out = str(tmp_path / "out.h5")
+    html = str(tmp_path / "mrd.html")
+    rc = mrd_data_analysis.run([
+        "--mrd_summary_h5", h5, "--featuremap", fm, "--signature_vcf", sig,
+        "--control_signature_vcfs", ctrl,
+        "--coverage_per_locus", "30", "--html_output", html, "--h5_output", out,
+    ])
+    assert rc == 0
+    keys = set(list_keys(out))
+    assert "mutation_types_db_control" in keys, sorted(keys)
+    assert "allele_fractions_db_control" in keys, sorted(keys)
+    cm = read_hdf(out, key="mutation_types_db_control")
+    assert (cm["signature"] == "db_control").all()
+    assert "db_control" in open(html).read()
+
+
 def test_joint_report_af_spectrum(tmp_path):
     """Cohort AF spectrum section (notebook 'Allele Frequency')."""
     from variantcalling_tpu.pipelines import joint_calling_report

@@ -47,3 +47,115 @@ def test_assignment_table_metadata(rng):
     assert list(tbl["signature"]) == ["SBS1", "SBS3"]  # zero dropped, sorted by mass
     assert tbl.iloc[0]["description"] == "clock-like"
     np.testing.assert_allclose(tbl["fraction"].sum(), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# ID83 / DBS78 channels (reference run_no_gt_report.py:334-595 generates all
+# three catalogs via SigProfilerMatrixGenerator; channels re-derived here)
+# ---------------------------------------------------------------------------
+
+def test_id83_label_set():
+    from variantcalling_tpu.reports.signatures import id83_labels
+
+    labels = id83_labels()
+    assert len(labels) == 83 and len(set(labels)) == 83
+    for known in ("1:Del:C:0", "1:Ins:T:5", "2:Del:R:0", "5:Ins:R:5",
+                  "2:Del:M:1", "5:Del:M:5"):
+        assert known in labels, known
+
+
+def test_dbs78_label_set():
+    from variantcalling_tpu.reports.signatures import dbs78_labels
+
+    labels = dbs78_labels()
+    assert len(labels) == 78 and len(set(labels)) == 78
+    refs = {l.split(">")[0] for l in labels}
+    assert refs == {"AC", "AT", "CC", "CG", "CT", "GC", "TA", "TC", "TG", "TT"}
+    # palindromic refs fold alts: 6 each; others carry all 9
+    from collections import Counter
+
+    per_ref = Counter(l.split(">")[0] for l in labels)
+    for r in ("AT", "TA", "CG", "GC"):
+        assert per_ref[r] == 6, (r, per_ref[r])
+    for r in ("AC", "CC", "CT", "TC", "TG", "TT"):
+        assert per_ref[r] == 9, (r, per_ref[r])
+
+
+def test_classify_indel_id83_engineered():
+    from variantcalling_tpu.reports.signatures import classify_indel_id83
+
+    # del one C from a C4 homopolymer: 3 additional copies follow
+    assert classify_indel_id83("AC", "A", "CCCG", "TA") == "1:Del:C:3"
+    # ins T next to TT
+    assert classify_indel_id83("A", "AT", "TTGA", "CC") == "1:Ins:T:2"
+    # A-deletion folds to T (pyrimidine fold)
+    assert classify_indel_id83("CA", "C", "AAGT", "GG") == "1:Del:T:2"
+    # 2bp del at a repeat: ATAT follows the deleted AT
+    assert classify_indel_id83("GAT", "G", "ATATCC", "AA") == "2:Del:R:2"
+    # 2bp del, no repeat, 1bp microhomology with the right flank
+    # (left_ctx ends AT the anchor base 'G' by convention)
+    assert classify_indel_id83("GTG", "G", "TCAA", "AG") == "2:Del:M:1"
+    # 2bp del, no repeat, left-flank microhomology: deleted TG preceded by
+    # ...AG (anchor G == unit suffix) -> mh 1
+    assert classify_indel_id83("GTG", "G", "CCAA", "AG") == "2:Del:M:1"
+    # reviewer case: deleted TG after anchor A (left-aligned) must NOT
+    # claim left microhomology against the base before the anchor
+    assert classify_indel_id83("ATG", "A", "CCTT", "CGA") == "2:Del:R:0"
+    # 6bp del, no repeat, no mh -> 5+ bucket
+    assert classify_indel_id83("GACGTCA", "G", "TTTTTTTT", "TT") == "5:Del:R:0"
+    # 3bp ins with one existing copy in ref
+    assert classify_indel_id83("G", "GACG", "ACGTTT", "CC") == "3:Ins:R:1"
+    # non-indels / complex records are skipped
+    assert classify_indel_id83("A", "C", "TTTT", "GG") is None
+    assert classify_indel_id83("AT", "CG", "TTTT", "GG") is None
+
+
+def test_classify_doublet_dbs78_engineered():
+    from variantcalling_tpu.reports.signatures import classify_doublet_dbs78
+
+    assert classify_doublet_dbs78("AC", "GT") == "AC>GT"
+    # GT is not canonical: fold to AC (rc), alt CA -> TG
+    assert classify_doublet_dbs78("GT", "CA") == "AC>TG"
+    # palindromic ref: alt folds to lexicographic min(alt, rc(alt))
+    assert classify_doublet_dbs78("AT", "GC") == "AT>GC"
+    assert classify_doublet_dbs78("CG", "TA") == "CG>TA"
+    # single-position changes are not doublets
+    assert classify_doublet_dbs78("AC", "AT") is None
+    assert classify_doublet_dbs78("AC", "GC") is None
+
+
+def test_id83_and_dbs78_matrices_from_vcf(tmp_path):
+    """End-to-end channel counting: engineered genome + VCF with known
+    indel/doublet classes, including an adjacent-SNV pair merged into a
+    doublet."""
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.reports.signatures import dbs78_matrix, id83_matrix
+
+    #        pos: 123456789012345678901234567890
+    genome = "GGAACCCCGTTGGATCGATCGGGGGGAACT" + "ACGT" * 30
+    (tmp_path / "ref.fa").write_text(">chr1\n" + genome + "\n")
+    recs = [
+        # del one C from the C4 run at pos 5-8 (anchor A at pos 4)
+        ("chr1", 4, "AC", "A"),        # 1:Del:C:3
+        # explicit doublet MNP
+        ("chr1", 14, "GA", "TG"),      # GA>TG -> rc fold: TC>CA
+        # adjacent SNV pair C>T then G>A at 19,20 -> CG>TA
+        ("chr1", 19, "C", "T"),
+        ("chr1", 20, "G", "A"),
+    ]
+    lines = ["##fileformat=VCFv4.2", f"##contig=<ID=chr1,length={len(genome)}>",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for c, p, r, a in recs:
+        lines.append(f"{c}\t{p}\t.\t{r}\t{a}\t50\tPASS\t.")
+    (tmp_path / "calls.vcf").write_text("\n".join(lines) + "\n")
+
+    table = read_vcf(str(tmp_path / "calls.vcf"))
+    fasta = FastaReader(str(tmp_path / "ref.fa"))
+    indels = [(c, p, r, a) for c, p, r, a in recs if len(r) != len(a)]
+    id_m = id83_matrix(indels, fasta)
+    assert id_m.sum() == 1 and id_m["1:Del:C:3"] == 1
+    dbs_m = dbs78_matrix(table)
+    assert dbs_m.sum() == 2
+    assert dbs_m["TC>CA"] == 1  # GA>TG folded
+    assert dbs_m["CG>TA"] == 1  # merged adjacent SNVs

@@ -326,7 +326,8 @@ def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW
         if contig not in fasta.references:
             continue
         seq = fasta.fetch_encoded(contig)
-        sub = (pos0 if one_contig else pos0[codes == ui]).astype(np.int64)
+        m = None if one_contig else codes == ui
+        sub = (pos0 if one_contig else pos0[m]).astype(np.int64)
         rows = native.gather_windows_contig(seq, sub, radius)
         if rows is None:
             # numpy fallback: padded fancy-index gather; positions beyond
@@ -338,7 +339,7 @@ def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW
             rows = np.where(valid, padded[np.clip(idx, 0, len(padded) - 1)], 4)
         if one_contig:  # no mask copy: the gather IS the output
             return rows
-        out[codes == ui] = rows
+        out[m] = rows
     return out
 
 

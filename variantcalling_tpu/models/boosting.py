@@ -317,7 +317,10 @@ def fit(
     # output layout as the jitted program. Checked BEFORE any device
     # placement so the fallback pays zero XLA transfers. Mesh / device-
     # resident fits stay on the jitted path (that's the TPU/pod program).
+    # caller-supplied edges must agree with cfg.n_bins (bin ids reach
+    # edges.shape[1], and the native kernel indexes histograms by them)
     if platform == "cpu" and mesh is None and host_binned is not None and not diag \
+            and np.asarray(edges).shape[1] == cfg.n_bins - 1 \
             and os.environ.get("VCTPU_NATIVE_GBT", "1") != "0":
         from variantcalling_tpu import native
 

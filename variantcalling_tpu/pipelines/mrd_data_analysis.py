@@ -56,6 +56,10 @@ def parse_args(argv):
     ap.add_argument("--mrd_summary_h5", required=True, help="mrd_analysis output")
     ap.add_argument("--featuremap", default=None, help="scored featuremap (srsnv_inference)")
     ap.add_argument("--signature_vcf", default=None)
+    ap.add_argument("--control_signature_vcfs", nargs="*", default=None,
+                    help="control signature VCFs (notebook cells 30-34: the "
+                         "signature_type != 'matched' analyses) — mutation-type "
+                         "and allele-fraction sections per control")
     ap.add_argument("--read_filter_query", default=None,
                     help="pandas query over featuremap INFO columns (e.g. 'ML_QUAL >= 40')")
     ap.add_argument("--signature_filter_query", default=None,
@@ -298,6 +302,37 @@ def run(argv) -> int:
 
             add_figure_safe(rep, _af_fig, "AF figure")
             save(afh, "allele_fractions")
+
+        # --- control signature analyses (cells 30-34): the notebook
+        # repeats the mutation-type and allele-fraction sections for every
+        # signature with signature_type != 'matched' ----------------------
+        seen_names: set[str] = set()
+        for path in (args.control_signature_vcfs or []):
+            base = path.split("/")[-1].removesuffix(".gz").removesuffix(".vcf")
+            name, k = base, 2
+            while name in seen_names:  # same filename from two dirs
+                name = f"{base}_{k}"
+                k += 1
+            seen_names.add(name)
+            ctrl = read_vcf(path)
+            cmut = mutation_type_counts(ctrl)
+            if len(cmut):
+                rep.add_section(f"Control signature '{name}' — mutation types")
+                rep.add_table(cmut)
+                save(cmut.assign(signature=name), f"mutation_types_{name}")
+            cafh = af_histogram(ctrl)
+            if cafh["n_variants"].sum():
+                rep.add_section(f"Control signature '{name}' — allele fractions")
+
+                def _caf_fig(plt, _h=cafh):
+                    fig, ax = plt.subplots(figsize=(7, 3))
+                    ax.bar(_h["af_bin_low"], _h["n_variants"], width=0.018)
+                    ax.set_xlabel("Allele fraction")
+                    ax.set_ylabel("# variants")
+                    return fig
+
+                add_figure_safe(rep, _caf_fig, f"AF figure ({name})")
+                save(cafh.assign(signature=name), f"allele_fractions_{name}")
 
         # --- tumor fractions, filtered x unfiltered (cells 19-29) ---------
         denom = args.coverage_per_locus or float(row.get("coverage_per_locus", 1.0) or 1.0)

@@ -103,41 +103,130 @@ def run_eval_tables_only(args) -> None:
         mode = "a"
 
 
+def _somatic_matrices(vcf_path: str, reference: str) -> dict[str, pd.Series]:
+    """SBS96 + ID83 + DBS78 channel counts for one callset (the three
+    catalogs the reference's SigProfiler stage generates,
+    run_no_gt_report.py:334-595)."""
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.reports import signatures as sigmod
+
+    table = read_vcf(vcf_path)
+    cols, windows, _hmer_len, _hmer_nuc = no_gt_stats._annotate(table, reference)
+    # adjacent-SNV pairs reclassify as doublets and leave SBS96 (the
+    # SigProfilerMatrixGenerator convention: one catalog per mutation)
+    dbs, paired = sigmod.dbs78_matrix(table, return_paired=True)
+    snp_motifs = no_gt_stats.snp_statistics(table, cols, windows, exclude=paired)
+    sbs = pd.Series(snp_motifs.values,
+                    index=[f"{m[0]}[{m[1]}>{a}]{m[2]}" for (m, a) in snp_motifs.index],
+                    name="size")
+    fasta = FastaReader(reference)
+    chrom = np.asarray(table.chrom)
+    refs = np.asarray(table.ref)
+    alts = np.asarray(table.alt)
+    indels = ((chrom[i], int(table.pos[i]), refs[i].upper(), alts[i].split(",")[0].upper())
+              for i in range(len(table))
+              if len(refs[i]) != len(alts[i].split(",")[0]))
+    return {
+        "SBS96": sbs,
+        "ID83": sigmod.id83_matrix(indels, fasta),
+        "DBS78": dbs,
+    }
+
+
+def _unique_name(base: str, taken: set[str]) -> str:
+    """Basename-derived sample names must not collide (two control paths
+    with the same filename would silently overwrite each other)."""
+    name, k = base, 2
+    while name in taken:
+        name = f"{base}_{k}"
+        k += 1
+    return name
+
+
+def _fit_catalog(counts_by_sample: dict[str, pd.Series], catalog_path: str,
+                 metadata: dict | None, catalog_name: str) -> pd.DataFrame:
+    """Device KL-NNLS exposures for every sample against one catalog."""
+    from variantcalling_tpu.reports import signatures as sigmod
+
+    catalog = sigmod.load_signature_matrix(catalog_path)
+    samples = list(counts_by_sample)
+    labels = list(next(iter(counts_by_sample.values())).index)
+    catalog = catalog.reindex(labels).fillna(0.0)  # align channel order
+    mat = np.stack([counts_by_sample[s].values for s in samples])
+    exposures = sigmod.sparsify_exposures(
+        sigmod.fit_signatures(mat, catalog.to_numpy()))
+    tbl = sigmod.assignment_table(exposures, list(catalog.columns), metadata, samples)
+    tbl.insert(1, "catalog", catalog_name)
+    return tbl
+
+
 def run_somatic_analysis(args) -> None:
-    """96-channel SBS matrix (+ optional SigProfiler assignment when installed)."""
-    table = read_vcf(args.input_file)
-    cols, windows, hmer_len, _hmer_nuc = no_gt_stats._annotate(table, args.reference)
-    snp_motifs = no_gt_stats.snp_statistics(table, cols, windows)
-    # SBS96 channel labels: C>A style with flanks, e.g. A[C>A]G
-    labels = [f"{m[0]}[{m[1]}>{a}]{m[2]}" for (m, a) in snp_motifs.index]
-    sbs = pd.DataFrame({"MutationType": labels, args.output_prefix.split("/")[-1]: snp_motifs.values})
-    sbs_path = f"{args.output_prefix}.SBS96.all"
-    sbs.to_csv(sbs_path, sep="\t", index=False)
-    logger.info("wrote SBS96 matrix: %s", sbs_path)
-    if getattr(args, "signatures_file", None):
-        # native device fitting: KL-NNLS against the provided catalog
+    """SBS96 + ID83 + DBS78 matrices, device NNLS fitting per catalog, and
+    an optional control cohort (reference cells: control signature
+    analysis — exposures for every control plus a case-vs-control
+    enrichment table)."""
+    prefix_name = args.output_prefix.split("/")[-1]
+    case = _somatic_matrices(args.input_file, args.reference)
+    controls = {}
+    for path in (getattr(args, "control_vcfs", None) or []):
+        name = _unique_name(
+            path.split("/")[-1].removesuffix(".gz").removesuffix(".vcf"),
+            set(controls) | {prefix_name})
+        controls[name] = _somatic_matrices(path, args.reference)
+
+    out_h5 = f"{args.output_prefix}.h5"
+    h5_mode = "a"
+    for cat in ("SBS96", "ID83", "DBS78"):
+        df = pd.DataFrame({"MutationType": list(case[cat].index),
+                           prefix_name: case[cat].values})
+        for name, mats in controls.items():
+            df[name] = mats[cat].values
+        path = f"{args.output_prefix}.{cat}.all"
+        df.to_csv(path, sep="\t", index=False)
+        logger.info("wrote %s matrix: %s", cat, path)
+
+    catalog_paths = {
+        "SBS96": getattr(args, "signatures_file", None),
+        "ID83": getattr(args, "id_signatures_file", None),
+        "DBS78": getattr(args, "dbs_signatures_file", None),
+    }
+    if any(catalog_paths.values()):
         from variantcalling_tpu.reports import signatures as sigmod
 
-        catalog = sigmod.load_signature_matrix(args.signatures_file)
-        catalog = catalog.reindex(labels).fillna(0.0)  # align channel order
-        exposures = sigmod.fit_signatures(snp_motifs.values[None, :], catalog.to_numpy())
-        exposures = sigmod.sparsify_exposures(exposures)
-        meta = (
-            sigmod.load_signature_metadata(args.signatures_metadata)
-            if getattr(args, "signatures_metadata", None)
-            else None
-        )
-        tbl = sigmod.assignment_table(
-            exposures, list(catalog.columns), meta, [args.output_prefix.split("/")[-1]]
-        )
-        write_hdf(tbl, f"{args.output_prefix}.h5", key="signature_exposures", mode="a")
-        logger.info("fitted %d active signatures (device NNLS)", int((exposures > 0).sum()))
+        meta = (sigmod.load_signature_metadata(args.signatures_metadata)
+                if getattr(args, "signatures_metadata", None) else None)
+        tables = []
+        for cat, cpath in catalog_paths.items():
+            if not cpath:
+                continue
+            by_sample = {prefix_name: case[cat]}
+            by_sample.update({name: mats[cat] for name, mats in controls.items()})
+            tables.append(_fit_catalog(by_sample, cpath, meta, cat))
+        tbl = pd.concat(tables, ignore_index=True)
+        write_hdf(tbl, out_h5, key="signature_exposures", mode=h5_mode)
+        logger.info("fitted exposures over %d catalog(s), %d sample(s)",
+                    len(tables), 1 + len(controls))
+        if controls:
+            # case-vs-control enrichment: fraction of mutations per
+            # signature in the case against the control-cohort mean
+            frac = tbl.pivot_table(index=["catalog", "signature"],
+                                   columns="sample", values="fraction",
+                                   fill_value=0.0)
+            ctrl_cols = [c for c in frac.columns if c != prefix_name]
+            case_frac = frac.get(prefix_name, pd.Series(0.0, index=frac.index))
+            ctrl_mean = frac[ctrl_cols].mean(axis=1)
+            cmp_tbl = pd.DataFrame({
+                "case_fraction": case_frac,
+                "control_mean_fraction": ctrl_mean,
+                "enrichment": case_frac / ctrl_mean.clip(lower=1e-9),
+            }).reset_index()
+            write_hdf(cmp_tbl, out_h5, key="signature_control_comparison", mode="a")
         return
     try:  # optional external signature assignment (reference :334-595)
         from SigProfilerAssignment import Analyzer as Analyze  # type: ignore
 
         Analyze.cosmic_fit(
-            samples=sbs_path,
+            samples=f"{args.output_prefix}.SBS96.all",
             output=f"{args.output_prefix}_sig",
             input_type="matrix",
             cosmic_version=float(args.cosmic_version),
@@ -179,9 +268,16 @@ def run(argv: list[str]) -> int:
     som.add_argument("--output_prefix", required=True)
     som.add_argument("--cosmic_version", type=str, default="3.3")
     som.add_argument("--signatures_file", default=None,
-                     help="COSMIC-style signature matrix (tsv) -> native device NNLS fitting")
+                     help="COSMIC-style SBS96 signature matrix (tsv) -> native device NNLS fitting")
+    som.add_argument("--id_signatures_file", default=None,
+                     help="COSMIC ID83 signature matrix (tsv)")
+    som.add_argument("--dbs_signatures_file", default=None,
+                     help="COSMIC DBS78 signature matrix (tsv)")
     som.add_argument("--signatures_metadata", default=None,
                      help="cosmic_signatures json (descriptions/links) for annotation")
+    som.add_argument("--control_vcfs", nargs="*", default=None,
+                     help="control-cohort VCFs: exposures fitted per control plus a "
+                          "case-vs-control enrichment table (signature_control_comparison)")
     som.set_defaults(func=run_somatic_analysis)
 
     args = ap.parse_args(argv)

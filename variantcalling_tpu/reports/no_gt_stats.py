@@ -146,9 +146,16 @@ def _fold_table() -> np.ndarray:
     return out
 
 
-def snp_statistics(table: VariantTable, cols, windows: np.ndarray, center: int = 12) -> pd.Series:
-    """96-class folded SNP motif counts as one device bincount."""
+def snp_statistics(table: VariantTable, cols, windows: np.ndarray, center: int = 12,
+                   exclude: np.ndarray | None = None) -> pd.Series:
+    """96-class folded SNP motif counts as one device bincount.
+
+    ``exclude`` masks records already consumed elsewhere (adjacent-SNV
+    pairs reclassified as DBS78 doublets must not also count as SBS96 —
+    the SigProfilerMatrixGenerator convention)."""
     m = cols.is_snp & (cols.ref_code < 4) & (cols.alt_code < 4)
+    if exclude is not None:
+        m = m & ~exclude
     left = windows[m, center - 1].astype(np.int64)
     mid = cols.ref_code[m].astype(np.int64)
     right = windows[m, center + 1].astype(np.int64)

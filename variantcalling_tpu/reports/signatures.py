@@ -115,6 +115,193 @@ def extract_signatures(
     return w, h.T
 
 
+_COMP = {"A": "T", "C": "G", "G": "C", "T": "A"}
+
+
+def _rc(s: str) -> str:
+    return "".join(_COMP[c] for c in reversed(s))
+
+
+def id83_labels() -> list[str]:
+    """The 83 COSMIC indel channels, SigProfiler label layout
+    ``{len}:{Del|Ins}:{C|T|R|M}:{n}`` (len 5 means 5+, n 5 means 5+)."""
+    labels = []
+    for kind in ("Del", "Ins"):
+        for base in ("C", "T"):
+            labels += [f"1:{kind}:{base}:{n}" for n in range(6)]
+    for kind in ("Del", "Ins"):
+        for ln in (2, 3, 4, 5):
+            labels += [f"{ln}:{kind}:R:{n}" for n in range(6)]
+    for ln, max_mh in ((2, 1), (3, 2), (4, 3), (5, 5)):
+        labels += [f"{ln}:Del:M:{m}" for m in range(1, max_mh + 1)]
+    assert len(labels) == 83
+    return labels
+
+
+def _repeat_count(seq: str, unit: str) -> int:
+    """Copies of ``unit`` at the start of ``seq``."""
+    n = 0
+    u = len(unit)
+    while seq[n * u : (n + 1) * u] == unit:
+        n += 1
+    return n
+
+
+def classify_indel_id83(ref: str, alt: str, right_ctx: str, left_ctx: str) -> str | None:
+    """COSMIC ID83 channel for a left-anchored simple indel, or None.
+
+    ``right_ctx`` is the reference sequence immediately AFTER the record's
+    REF span; ``left_ctx`` ends AT (and includes) the anchor base at POS —
+    the deleted segment's true left neighbor. Classification follows
+    the SigProfilerMatrixGenerator scheme (reference run_no_gt_report.py:
+    334-595 delegates to it): 1-bp indels bucket by pyrimidine-folded base
+    and adjacent homopolymer run; longer indels by repeat count of the
+    unit; repeat-free deletions by microhomology with the flanks.
+    """
+    if len(ref) == len(alt) or not ref or not alt or ref[0] != alt[0]:
+        return None
+    if len(ref) > 1 and len(alt) > 1:
+        return None  # complex substitution, not a simple indel
+    kind = "Del" if len(ref) > len(alt) else "Ins"
+    unit = (ref if kind == "Del" else alt)[1:]
+    if not unit or any(c not in "ACGT" for c in unit):
+        return None
+    ln = len(unit)
+    lb = min(ln, 5)
+    # reference sequence following the indel site: for a deletion the
+    # context after the deleted copy; for an insertion right after POS
+    following = right_ctx
+    if ln == 1:
+        base = unit if unit in ("C", "T") else _COMP[unit]
+        # additional copies of the base adjacent in the reference
+        n = min(_repeat_count(following, unit), 5)
+        return f"1:{kind}:{base}:{n}"
+    n = min(_repeat_count(following, unit), 5)
+    if kind == "Del" and n == 0:
+        # microhomology: shared prefix with the right flank or shared
+        # suffix with the left flank
+        mh_r = 0
+        while mh_r < ln - 1 and mh_r < len(following) and unit[mh_r] == following[mh_r]:
+            mh_r += 1
+        mh_l = 0
+        while (mh_l < ln - 1 and mh_l < len(left_ctx)
+               and unit[ln - 1 - mh_l] == left_ctx[len(left_ctx) - 1 - mh_l]):
+            mh_l += 1
+        mh = max(mh_r, mh_l)
+        if mh > 0:
+            max_mh = {2: 1, 3: 2, 4: 3, 5: 5}[lb]
+            return f"{lb}:Del:M:{min(mh, max_mh)}"
+    return f"{lb}:{kind}:R:{n}"
+
+
+def id83_matrix(records, fasta) -> pd.Series:
+    """83-channel indel counts for an iterable of (chrom, pos, ref, alt).
+
+    ``pos`` is 1-based (VCF); reference context comes from ``fasta``."""
+    labels = id83_labels()
+    idx = {l: i for i, l in enumerate(labels)}
+    counts = np.zeros(83, dtype=np.int64)
+    for chrom, pos, ref, alt in records:
+        if chrom not in fasta.references:
+            continue
+        end = pos - 1 + len(ref)
+        right = fasta.fetch(chrom, end, end + 6 * max(len(ref), len(alt)))
+        # left flank INCLUDES the anchor base (the deleted segment starts
+        # right after it) — excluding it compared microhomology against
+        # sequence one base removed from the deletion
+        left = fasta.fetch(chrom, max(0, pos - 1 - 6), pos)
+        ch = classify_indel_id83(ref, alt, right.upper(), left.upper())
+        if ch is not None:
+            counts[idx[ch]] += 1
+    return pd.Series(counts, index=labels, name="size")
+
+
+_DBS_CANON_REFS = ("AC", "AT", "CC", "CG", "CT", "GC", "TA", "TC", "TG", "TT")
+
+
+def dbs78_labels() -> list[str]:
+    """The 78 COSMIC doublet channels ('AC>CA' style): canonical ref
+    doublets with revcomp folding; palindromic refs (AT/CG/GC/TA) fold
+    the alt to the lexicographic minimum of (alt, revcomp(alt))."""
+    out = []
+    for ref in _DBS_CANON_REFS:
+        seen = set()
+        for a0 in "ACGT":
+            for a1 in "ACGT":
+                if a0 == ref[0] or a1 == ref[1]:
+                    continue
+                alt = a0 + a1
+                if _rc(ref) == ref:
+                    alt = min(alt, _rc(alt))
+                if alt not in seen:
+                    seen.add(alt)
+                    out.append(f"{ref}>{alt}")
+    assert len(out) == 78
+    return out
+
+
+def classify_doublet_dbs78(ref: str, alt: str) -> str | None:
+    """Canonical DBS78 channel for a 2-bp REF/ALT pair, or None."""
+    if len(ref) != 2 or len(alt) != 2 or ref == alt:
+        return None
+    if any(c not in "ACGT" for c in ref + alt):
+        return None
+    if alt[0] == ref[0] or alt[1] == ref[1]:
+        return None  # not a true doublet substitution at both positions
+    if ref not in _DBS_CANON_REFS:  # exactly one of {ref, rc(ref)} is canonical
+        ref, alt = _rc(ref), _rc(alt)
+        if ref not in _DBS_CANON_REFS:
+            return None
+    if _rc(ref) == ref:
+        alt = min(alt, _rc(alt))
+    return f"{ref}>{alt}"
+
+
+def dbs78_matrix(table, return_paired: bool = False):
+    """78-channel doublet counts from a VariantTable: explicit 2-bp MNP
+    records plus ADJACENT SNV pairs merged into doublets (the
+    SigProfilerMatrixGenerator convention).
+
+    ``return_paired=True`` additionally returns the boolean mask of SNV
+    records consumed as doublet halves — callers exclude them from the
+    SBS96 matrix so each mutation is counted in exactly one catalog."""
+    labels = dbs78_labels()
+    idx = {l: i for i, l in enumerate(labels)}
+    counts = np.zeros(78, dtype=np.int64)
+    chrom = np.asarray(table.chrom)
+    pos = np.asarray(table.pos)
+    refs = np.asarray(table.ref)
+    alts = np.asarray(table.alt)
+    n = len(pos)
+    is_snv = np.zeros(n, dtype=bool)
+    for i in range(n):
+        r, a = refs[i], alts[i].split(",")[0]
+        if len(r) == 2 and len(a) == 2:
+            ch = classify_doublet_dbs78(r.upper(), a.upper())
+            if ch is not None:
+                counts[idx[ch]] += 1
+        elif len(r) == 1 and len(a) == 1 and r in "ACGT" and a in "ACGT":
+            is_snv[i] = True
+    # adjacent SNV pairs (sorted input): greedy left-to-right pairing
+    paired = np.zeros(n, dtype=bool)
+    i = 0
+    while i < n - 1:
+        j = i + 1
+        if (is_snv[i] and is_snv[j] and chrom[i] == chrom[j]
+                and int(pos[j]) == int(pos[i]) + 1):
+            ch = classify_doublet_dbs78(
+                (refs[i] + refs[j]).upper(),
+                (alts[i].split(",")[0] + alts[j].split(",")[0]).upper())
+            if ch is not None:
+                counts[idx[ch]] += 1
+                paired[i] = paired[j] = True
+            i += 2
+            continue
+        i += 1
+    series = pd.Series(counts, index=labels, name="size")
+    return (series, paired) if return_paired else series
+
+
 def cosine_similarity_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """(Ka, Kb) cosine similarities between signature columns."""
     an = a / np.maximum(np.linalg.norm(a, axis=0, keepdims=True), _EPS)
