@@ -74,5 +74,9 @@ def _coerce(model: object) -> object:
     if isinstance(model, dict) and "learner" in model:
         return from_xgboost_json(model)
     if hasattr(model, "tree_") or hasattr(model, "estimators_"):
-        return from_sklearn(model)
+        # the fitted column order MUST ride along: the pipeline reorders
+        # model features onto its own feature layout by NAME, and a
+        # nameless forest scores positionally against the wrong columns
+        fni = getattr(model, "feature_names_in_", None)
+        return from_sklearn(model, feature_names=None if fni is None else list(fni))
     return model
