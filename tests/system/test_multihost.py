@@ -177,3 +177,66 @@ def test_two_rank_sec_training_cli(tmp_path):
     # cross-rank merge at chr2:100: ref counts 20+18+9 from three samples
     row = db0.counts[list(db0.keys).index((idx["chr2"] << 40) | 100)]
     assert row[0] == 20 + 18 + 9
+
+
+def test_two_rank_filter_variants_pipeline_cli(tmp_path):
+    """Full flagship filter_variants_pipeline on TWO ranks (4 virtual
+    devices each): ranks score contiguous slices on their local meshes,
+    allgather scores+filters, and BOTH write byte-identical full outputs
+    — matching a single-process run of the same inputs."""
+    import bench
+
+    d = str(tmp_path)
+    bench.make_fixtures(d, n=6000, genome_len=300_000)
+    # a model pickle the CLI can load
+    import pickle
+
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    model = synthetic_forest(np.random.default_rng(0), n_trees=10, depth=5)
+    with open(f"{d}/model.pkl", "wb") as fh:
+        pickle.dump({"rf_model_ignore_gt_incl_hpol_runs": model}, fh)
+
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS", "PYTHONSTARTUP")}
+    env_base.update(JAX_PLATFORMS="cpu", XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                    VCTPU_COORDINATOR=f"127.0.0.1:{port}", VCTPU_NUM_PROCESSES="2",
+                    PYTHONPATH=_REPO)
+    procs = []
+    for pid in range(2):
+        cmd = [sys.executable, "-m", "variantcalling_tpu", "filter_variants_pipeline",
+               "--input_file", f"{d}/calls.vcf", "--model_file", f"{d}/model.pkl",
+               "--model_name", "rf_model_ignore_gt_incl_hpol_runs",
+               "--reference_file", f"{d}/ref.fa",
+               "--output_file", f"{d}/out_rank{pid}.vcf"]
+        env = dict(env_base, VCTPU_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(cmd, env=env, cwd=_REPO,
+                                      stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                                      text=True))
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:  # a wedged rank must not leak its peer
+                q.kill()
+            raise
+        assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err[-2000:]}"
+
+    a = open(f"{d}/out_rank0.vcf", "rb").read()
+    b = open(f"{d}/out_rank1.vcf", "rb").read()
+    assert a == b and a.count(b"TREE_SCORE=") == 6000
+
+    # single-process run must produce the same bytes
+    env1 = dict(env_base)
+    for k in ("VCTPU_COORDINATOR", "VCTPU_NUM_PROCESSES"):
+        env1.pop(k, None)
+    p1 = subprocess.run(
+        [sys.executable, "-m", "variantcalling_tpu", "filter_variants_pipeline",
+         "--input_file", f"{d}/calls.vcf", "--model_file", f"{d}/model.pkl",
+         "--model_name", "rf_model_ignore_gt_incl_hpol_runs",
+         "--reference_file", f"{d}/ref.fa",
+         "--output_file", f"{d}/out_single.vcf"],
+        env=env1, cwd=_REPO, capture_output=True, text=True, timeout=300)
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    assert open(f"{d}/out_single.vcf", "rb").read() == a

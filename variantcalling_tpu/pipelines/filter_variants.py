@@ -552,9 +552,28 @@ def run(argv: list[str]) -> int:
     annotate = {_interval_name(p): bedio.read_intervals(p) for p in args.annotate_intervals}
     blacklist = read_blacklist(args.blacklist) if args.blacklist else None
 
+    # multi-host launch (VCTPU_COORDINATOR set -> __main__ initialized
+    # jax.distributed): ranks score CONTIGUOUS slices of the callset on
+    # their local-device meshes, then allgather scores+filters so every
+    # rank holds the full result and writes an identical file. Work is
+    # sharded by variant range, collectives ride the global mesh.
+    try:
+        n_proc = jax.process_count()
+    except Exception:  # noqa: BLE001 — uninitialized backend == single process
+        n_proc = 1
+    work = table
+    if n_proc > 1:
+        bounds = np.linspace(0, len(table), n_proc + 1).astype(np.int64)
+        pid = jax.process_index()
+        mask = np.zeros(len(table), dtype=bool)
+        mask[bounds[pid]:bounds[pid + 1]] = True
+        work = _subset(table, mask)
+        logger.info("rank %d/%d scoring variants [%d, %d)", pid, n_proc,
+                    int(bounds[pid]), int(bounds[pid + 1]))
+
     with stage("featurize+score"):
         score, filters = filter_variants(
-            table,
+            work,
             model,
             fasta,
             runs_file=args.runs_file,
@@ -566,6 +585,16 @@ def run(argv: list[str]) -> int:
             flow_order=args.flow_order,
             is_mutect=args.is_mutect,
         )
+
+    if n_proc > 1:
+        from variantcalling_tpu.parallel import distributed as dist
+
+        # keep the score's own dtype: a float32 cast here could round a
+        # float64 score differently than the single-process run writes it
+        score = dist.allgather_concat(np.asarray(score))
+        filters = np.asarray(dist.allgather_strings([str(f) for f in filters]),
+                             dtype=object)
+        assert len(score) == len(table), (len(score), len(table))
 
     table.header.ensure_filter(LOW_SCORE, "Model score below threshold")
     table.header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
