@@ -172,6 +172,10 @@ def get_lib() -> ctypes.CDLL | None:
             _u8p, _i32p, _i32p, _i32p, _u8p, _i32p,
             _i32p, _i32p, _f32p, _i32p, _i32p, _i32p,
         ]
+        lib.vctpu_build_matrix.restype = _i64
+        lib.vctpu_build_matrix.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), _i32p, _i64, ctypes.c_int32, _f32p,
+        ]
         lib.vctpu_forest_predict.restype = _i64
         lib.vctpu_forest_predict.argtypes = [
             _f32p, _i64, ctypes.c_int32,
@@ -648,6 +652,35 @@ def format_float_info(vals: np.ndarray, prefix: bytes) -> tuple[np.ndarray, np.n
     if total < 0:
         return None
     return buf[:total], offs
+
+
+_MATRIX_DTYPES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+                  np.dtype(np.float64): 2, np.dtype(np.uint8): 3,
+                  np.dtype(np.bool_): 4}
+
+
+def build_matrix(cols: list[np.ndarray]) -> np.ndarray | None:
+    """(n, f) float32 matrix from per-column arrays without numpy's
+    per-column temporaries; None -> numpy fallback."""
+    lib = get_lib()
+    if lib is None or not cols:
+        return None
+    arrs = []
+    codes = np.empty(len(cols), dtype=np.int32)
+    n = len(cols[0])
+    for j, c in enumerate(cols):
+        a = np.ascontiguousarray(c)
+        code = _MATRIX_DTYPES.get(a.dtype)
+        if code is None or a.ndim != 1 or len(a) != n:
+            return None
+        arrs.append(a)
+        codes[j] = code
+    ptrs = (ctypes.c_void_p * len(arrs))(*[a.ctypes.data for a in arrs])
+    out = np.empty((n, len(arrs)), dtype=np.float32)
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    rc = lib.vctpu_build_matrix(ptrs, codes.ctypes.data_as(_i32p), n, len(arrs),
+                                out.ctypes.data_as(_f32p))
+    return out if rc == 0 else None
 
 
 def forest_predict(x: np.ndarray, feat: np.ndarray, thr: np.ndarray,

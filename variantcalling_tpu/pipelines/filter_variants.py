@@ -280,9 +280,11 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
                                    alle.ref_code, alle.alt_code, alle.is_snp, fo)
     if dev is None:
         return None
-    cols = [np.asarray(dev[f] if f in dev else hf.cols[f], dtype=np.float32)
-            for f in hf.names]
-    return nf(np.stack(cols, axis=1))
+    raw = [np.asarray(dev[f] if f in dev else hf.cols[f]) for f in hf.names]
+    x = native.build_matrix(raw)
+    if x is None:  # unsupported column dtype: numpy assembly
+        x = np.stack([c.astype(np.float32, copy=False) for c in raw], axis=1)
+    return nf(x)
 
 
 def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
