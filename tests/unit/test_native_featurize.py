@@ -167,3 +167,32 @@ print("PIPE_OK")
     a = (tmp_path / "out_native.vcf").read_bytes()
     b = (tmp_path / "out_jit.vcf").read_bytes()
     assert a == b
+
+
+def test_gather_windows_interleaved_contigs(tmp_path, rng):
+    """Unsorted VCFs (contig runs interleaved) take the boolean-mask path;
+    windows must land on the right rows either way."""
+    from variantcalling_tpu.featurize import gather_windows
+    from variantcalling_tpu.io.fasta import FastaReader, encode_seq
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    g1 = "".join(rng.choice(list("ACGT"), 300))
+    g2 = "".join(rng.choice(list("ACGT"), 300))
+    (tmp_path / "ref.fa").write_text(f">chr1\n{g1}\n>chr2\n{g2}\n")
+    recs = [("chr1", 60), ("chr2", 80), ("chr1", 120), ("chr2", 200), ("chr1", 250)]
+    lines = ["##fileformat=VCFv4.2",
+             "##contig=<ID=chr1,length=300>", "##contig=<ID=chr2,length=300>",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    genome = {"chr1": g1, "chr2": g2}
+    for c, p in recs:
+        lines.append(f"{c}\t{p}\t.\t{genome[c][p-1]}\tA\t50\tPASS\t.")
+    (tmp_path / "in.vcf").write_text("\n".join(lines) + "\n")
+    table = read_vcf(str(tmp_path / "in.vcf"))
+    fasta = FastaReader(str(tmp_path / "ref.fa"))
+    windows = gather_windows(table, fasta)
+    for i, (c, p) in enumerate(recs):
+        enc = encode_seq(genome[c])
+        center = windows.shape[1] // 2
+        assert windows[i, center] == enc[p - 1], (i, c, p)
+        np.testing.assert_array_equal(
+            windows[i, center - 5:center + 6], enc[p - 6:p + 5])

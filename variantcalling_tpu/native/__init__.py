@@ -617,14 +617,21 @@ def featurize_windows(windows: np.ndarray, center: int,
             "cycleskip_status": cy, "left_motif": lm, "right_motif": rm}
 
 
-def gather_windows_contig(seq: np.ndarray, pos0: np.ndarray, radius: int) -> np.ndarray | None:
-    """(n, 2r+1) uint8 windows over one encoded contig (out-of-range -> N)."""
+def gather_windows_contig(seq: np.ndarray, pos0: np.ndarray, radius: int,
+                          out: np.ndarray | None = None) -> np.ndarray | None:
+    """(n, 2r+1) uint8 windows over one encoded contig (out-of-range -> N).
+
+    ``out`` lets callers gather straight into a slice of a larger window
+    tensor (contiguous uint8, right shape) — no intermediate copy."""
     lib = get_lib()
     if lib is None:
         return None
     s = np.ascontiguousarray(seq, dtype=np.uint8)
     p = np.ascontiguousarray(pos0, dtype=np.int64)
-    out = np.empty((len(p), 2 * radius + 1), dtype=np.uint8)
+    shape = (len(p), 2 * radius + 1)
+    if out is None or out.shape != shape or out.dtype != np.uint8 \
+            or not out.flags["C_CONTIGUOUS"]:
+        out = np.empty(shape, dtype=np.uint8)
     rc = lib.vctpu_gather_windows(
         s.ctypes.data_as(_u8p), len(s), p.ctypes.data_as(_i64p), len(p),
         radius, out.ctypes.data_as(_u8p),
