@@ -182,8 +182,9 @@ def test_two_rank_sec_training_cli(tmp_path):
 def test_two_rank_filter_variants_pipeline_cli(tmp_path):
     """Full flagship filter_variants_pipeline on TWO ranks (4 virtual
     devices each): ranks score contiguous slices on their local meshes,
-    allgather scores+filters, and BOTH write byte-identical full outputs
-    — matching a single-process run of the same inputs."""
+    allgather scores+filters, and rank 0 alone writes the shared output
+    path (non-zero ranks delegate — concurrent identical writes would
+    race on a shared filesystem) — matching a single-process run."""
     import bench
 
     d = str(tmp_path)
@@ -209,11 +210,12 @@ def test_two_rank_filter_variants_pipeline_cli(tmp_path):
                "--input_file", f"{d}/calls.vcf", "--model_file", f"{d}/model.pkl",
                "--model_name", "rf_model_ignore_gt_incl_hpol_runs",
                "--reference_file", f"{d}/ref.fa",
-               "--output_file", f"{d}/out_rank{pid}.vcf"]
+               "--output_file", f"{d}/out_shared.vcf"]
         env = dict(env_base, VCTPU_PROCESS_ID=str(pid))
         procs.append(subprocess.Popen(cmd, env=env, cwd=_REPO,
                                       stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                                       text=True))
+    rank_logs = []
     for p in procs:
         try:
             out, err = p.communicate(timeout=300)
@@ -222,10 +224,12 @@ def test_two_rank_filter_variants_pipeline_cli(tmp_path):
                 q.kill()
             raise
         assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err[-2000:]}"
+        rank_logs.append(out + err)
 
-    a = open(f"{d}/out_rank0.vcf", "rb").read()
-    b = open(f"{d}/out_rank1.vcf", "rb").read()
-    assert a == b and a.count(b"TREE_SCORE=") == 6000
+    a = open(f"{d}/out_shared.vcf", "rb").read()
+    assert a.count(b"TREE_SCORE=") == 6000
+    # exactly one rank wrote; the other delegated (no shared-FS write race)
+    assert sum("delegated to rank 0" in log for log in rank_logs) == 1
 
     # single-process run must produce the same bytes
     env1 = dict(env_base)

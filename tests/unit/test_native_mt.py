@@ -1,0 +1,159 @@
+"""Thread-count invariance of the sharded native engine.
+
+The BGZF codec, VCF scanner, and record assembler shard across threads
+(native/src/vctpu_threads.h) with the contract that output is
+byte-identical to the serial path for ANY thread count — shard boundaries
+land on block/line edges and every shard writes a disjoint output range.
+These tests force VCTPU_NATIVE_THREADS to several values over inputs big
+enough to cross the sharding thresholds (>=4096 records/thread for the
+scanner, >=65536 records for the assembler) and assert exact equality,
+including the shard-merged CHROM dictionary code order. The fast %g
+formatter is locked against printf over adversarial values.
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from variantcalling_tpu import native
+
+
+@pytest.fixture(autouse=True)
+def _native(monkeypatch):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    yield
+
+
+def _set_threads(monkeypatch, n: int) -> None:
+    monkeypatch.setenv("VCTPU_NATIVE_THREADS", str(n))
+
+
+def _big_vcf_bytes(n: int, rng) -> bytes:
+    """~n records over 3 contigs, sorted, with FORMAT + INFO variety."""
+    lines = [
+        b"##fileformat=VCFv4.2",
+        b'##INFO=<ID=SOR,Number=1,Type=Float,Description="s">',
+        b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tS1",
+    ]
+    per = n // 3
+    bases = np.frombuffer(b"ACGT", dtype="S1")
+    for ci, contig in enumerate([b"chr1", b"chr2", b"chrX"]):
+        m = per + (n - 3 * per if ci == 2 else 0)
+        pos = np.sort(rng.choice(np.arange(1, 50_000_000), size=m, replace=False))
+        ref = bases[rng.integers(0, 4, m)]
+        alt = bases[rng.integers(0, 4, m)]
+        qual = np.char.mod(b"%.2f", rng.uniform(0, 99, m))
+        sor = np.char.add(b"SOR=", np.char.mod(b"%.3f", rng.uniform(0, 4, m)))
+        gt = np.where(rng.random(m) < 0.5, b"0/1", b"1|1").astype("S3")
+        dp = np.char.mod(b"%d", rng.integers(1, 99, m))
+        tab = np.full(m, b"\t", "S1")
+        acc = np.full(m, contig, dtype="S4")
+        for part in (tab, np.char.mod(b"%d", pos), tab, np.full(m, b".", "S1"),
+                     tab, ref, tab, alt, tab, qual, tab, np.full(m, b".", "S1"),
+                     tab, sor, tab, np.full(m, b"GT:DP", "S5"), tab, gt,
+                     np.full(m, b":", "S1"), dp):
+            acc = np.char.add(acc, part)
+        lines.extend(acc.tolist())
+    return b"\n".join(lines) + b"\n"
+
+
+N_REC = 70_000  # > 65536 (assembler threshold) and > 4 * 4096 (scanner)
+
+
+@pytest.fixture(scope="module")
+def vcf_bytes():
+    return _big_vcf_bytes(N_REC, np.random.default_rng(11))
+
+
+def _parse(buf):
+    out = native.vcf_parse(np.frombuffer(buf, dtype=np.uint8), 1)
+    assert out is not None and out["n"] == N_REC
+    return out
+
+
+def test_vcf_parse_thread_invariance(vcf_bytes, monkeypatch):
+    _set_threads(monkeypatch, 1)
+    serial = _parse(vcf_bytes)
+    for t in (2, 5):
+        _set_threads(monkeypatch, t)
+        mt = _parse(vcf_bytes)
+        assert mt["chroms"] == serial["chroms"] == ["chr1", "chr2", "chrX"]
+        for key, ref in serial.items():
+            if isinstance(ref, np.ndarray):
+                np.testing.assert_array_equal(mt[key], ref, err_msg=f"{key}@T={t}")
+
+
+def test_vcf_assemble_thread_invariance(vcf_bytes, monkeypatch):
+    from variantcalling_tpu.io.vcf import FactorizedColumn, _encode_column_factorized
+
+    _set_threads(monkeypatch, 1)
+    parsed = _parse(vcf_bytes)
+    buf = np.frombuffer(vcf_bytes, dtype=np.uint8)
+    rng = np.random.default_rng(3)
+    filt = FactorizedColumn(rng.integers(0, 2, N_REC), ["PASS", "LOW_SCORE"])
+    fb, fo = _encode_column_factorized(filt, N_REC)
+    sfx_buf, sfx_offs = native.format_float_info(
+        np.round(rng.uniform(0, 1, N_REC), 4), b";TREE_SCORE=")
+
+    def assemble():
+        return native.vcf_assemble(
+            buf, parsed["line_spans"], parsed["filter_spans"], parsed["info_spans"],
+            parsed["tail_spans"], fb, fo, sfx_buf, sfx_offs)
+
+    serial = assemble()
+    assert serial is not None and len(serial) > N_REC * 20
+    for t in (2, 5):
+        _set_threads(monkeypatch, t)
+        np.testing.assert_array_equal(assemble(), serial, err_msg=f"T={t}")
+
+
+def test_bgzf_thread_invariance_and_roundtrip(monkeypatch):
+    rng = np.random.default_rng(7)
+    # mixed compressibility, > many 65280-byte chunks
+    data = (rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+            + b"AC" * (1 << 20) + rng.integers(0, 4, 1 << 19, dtype=np.uint8).tobytes())
+    _set_threads(monkeypatch, 1)
+    serial = native.bgzf_compress(data)
+    for t in (2, 6):
+        _set_threads(monkeypatch, t)
+        assert native.bgzf_compress(data) == serial, f"T={t}"
+        assert native.bgzf_decompress(serial) == data, f"T={t}"
+    # an independent decoder accepts the framing (BGZF is valid multi-member gzip)
+    assert gzip.decompress(serial) == data
+    # the parallel inflate path rejects corrupt payloads instead of
+    # returning garbage (CRC verification per block)
+    corrupt = bytearray(serial)
+    corrupt[300] ^= 0xFF
+    assert native.bgzf_decompress(bytes(corrupt)) is None
+
+
+def test_format_float_info_matches_printf_g():
+    vals = np.array([0.0, -0.0, 1.0, -1.0, 0.1234, -0.1234, 99.9999, 12.3,
+                     0.0001, 0.00005, 1e-7, 123456.789, -123456.789, 1e20,
+                     np.inf, -np.inf, 0.5, 2.25, 3.0001, 7.77, 1.5e-5,
+                     99.99995, 33.333333333, 100.0, -100.0, 0.001])
+    buf, offs = native.format_float_info(vals, b";K=")
+    got = [bytes(buf[offs[i]:offs[i + 1]]).decode() for i in range(len(vals))]
+    want = [";K=%g" % v for v in vals]
+    assert got == want
+    # NaN renders as an empty suffix (key omitted for missing scores)
+    buf, offs = native.format_float_info(np.array([1.5, np.nan, 2.5]), b";K=")
+    assert offs.tolist() == [0, 6, 6, 12]
+
+
+def test_fast_float_parse_matches_strtod(tmp_path, monkeypatch):
+    """QUAL strings in every shape must parse bit-identically to Python's
+    float() (the strtod reference): plain decimals (fast path), exponents,
+    long digit strings, and signs (fallback path)."""
+    quals = ["0", "1", "-1", "3.14159", "0.000001", "12345678901234567890",
+             "1e2", "1E-3", "+7.5", "2.5e10", "99.99", "0.1", ".5", "5.",
+             "170.17", "1234567.891", "31.045"]
+    lines = ["##fileformat=VCFv4.2", "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for i, q in enumerate(quals):
+        lines.append(f"chr1\t{i + 1}\t.\tA\tC\t{q}\t.\t.")
+    buf = ("\n".join(lines) + "\n").encode()
+    out = native.vcf_parse(np.frombuffer(buf, dtype=np.uint8), 0)
+    assert out is not None
+    np.testing.assert_array_equal(out["qual"], np.asarray([float(q) for q in quals]))

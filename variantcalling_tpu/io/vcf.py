@@ -173,6 +173,53 @@ class NativeAux:
         )
 
 
+class FactorizedColumn:
+    """Low-cardinality string column held as (codes, uniques).
+
+    The filter pipeline's FILTER column has <=6 distinct values over 5M
+    records; carrying integer codes end to end skips the ~1.3s
+    pd.factorize of an object array on the writeback hot path. Quacks
+    enough like an object array (len/iter/getitem/== str/np.asarray) that
+    report code and tests can treat it as one.
+    """
+
+    __slots__ = ("codes", "uniques")
+
+    def __init__(self, codes: np.ndarray, uniques: list[str]):
+        self.codes = np.ascontiguousarray(codes, dtype=np.int32)
+        self.uniques = list(uniques)
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def __iter__(self):
+        u = self.uniques
+        return (u[c] for c in self.codes)
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            return self.uniques[self.codes[i]]
+        return FactorizedColumn(self.codes[i], self.uniques)
+
+    def __eq__(self, other):  # vectorized `filters == "PASS"`
+        if isinstance(other, str):
+            try:
+                return self.codes == self.uniques.index(other)
+            except ValueError:
+                return np.zeros(len(self.codes), dtype=bool)
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else ~eq
+
+    def __array__(self, dtype=None, copy=None):
+        return np.asarray(self.uniques, dtype=object)[self.codes]
+
+    def to_object(self) -> np.ndarray:
+        return self.__array__()
+
+
 class _LazyCols:
     """Deferred string columns: (name -> (n,2) span array) into a shared buffer.
 
@@ -502,11 +549,11 @@ def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | Non
     lazy = _LazyCols(
         bufb,
         {
-            "vid": parsed["field_spans"][:, 0, :],
-            "ref": parsed["field_spans"][:, 1, :],
-            "alt": parsed["field_spans"][:, 2, :],
-            "filters": parsed["field_spans"][:, 3, :],
-            "info": parsed["field_spans"][:, 4, :],
+            "vid": parsed["id_spans"],
+            "ref": parsed["ref_spans"],
+            "alt": parsed["alt_spans"],
+            "filters": parsed["filter_spans"],
+            "info": parsed["info_spans"],
         },
     )
 
@@ -537,9 +584,9 @@ def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | Non
         aux = NativeAux(
             buf=buf_np,
             line_spans=parsed["line_spans"],
-            tail_spans=parsed["field_spans"][:, 5, :],
-            info_spans=parsed["field_spans"][:, 4, :],
-            filter_spans=parsed["field_spans"][:, 3, :],
+            tail_spans=parsed["tail_spans"],
+            info_spans=parsed["info_spans"],
+            filter_spans=parsed["filter_spans"],
             gt=parsed["gt"],
             gt_phased=parsed["gt_phased"],
             gq=parsed["gq"],
@@ -840,10 +887,14 @@ def _encode_column_factorized(values, n: int) -> tuple[np.ndarray, np.ndarray]:
 
     FILTER columns repeat a handful of values (PASS/LOW_SCORE/...), so a
     hash factorize + per-unique vectorized byte fill beats 1M per-record
-    Python encodes ~10x on the writeback hot path."""
-    import pandas as pd
+    Python encodes ~10x on the writeback hot path. A
+    :class:`FactorizedColumn` skips the factorize entirely."""
+    if isinstance(values, FactorizedColumn):
+        codes, uniques = values.codes, values.uniques
+    else:
+        import pandas as pd
 
-    codes, uniques = pd.factorize(np.asarray(values, dtype=object), use_na_sentinel=False)
+        codes, uniques = pd.factorize(np.asarray(values, dtype=object), use_na_sentinel=False)
     # factorize normalizes None to float NaN — both mean "missing" (.)
     enc = [(MISSING if u is None or u == "" or (isinstance(u, float) and np.isnan(u))
             else str(u)).encode() for u in uniques]

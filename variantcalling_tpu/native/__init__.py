@@ -105,6 +105,8 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_bgzf_uncompressed_size.argtypes = [_u8p, _i64]
         lib.vctpu_gzip_inflate.restype = _i64
         lib.vctpu_gzip_inflate.argtypes = [_u8p, _i64, _u8p, _i64]
+        lib.vctpu_bgzf_inflate.restype = _i64
+        lib.vctpu_bgzf_inflate.argtypes = [_u8p, _i64, _u8p, _i64]
         lib.vctpu_bgzf_compress.restype = _i64
         lib.vctpu_bgzf_compress.argtypes = [_u8p, _i64, _u8p, _i64, ctypes.c_int]
         lib.vctpu_bam_depth.restype = _i64
@@ -148,7 +150,8 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_vcf_parse.restype = _i64
         lib.vctpu_vcf_parse.argtypes = [
             _u8p, _i64, _i64, _i64, ctypes.c_int32,
-            _i64p, _i64p, _i64p, _f64p,
+            _i64p, _i64p, _i64p, _i64p, _i64p, _i64p, _i64p,
+            _i64p, _f64p,
             _i32p, _u8p, _i32p,
             _i8p, _u8p, _f32p, _f32p, _f32p,
             _u8p, _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
@@ -224,7 +227,12 @@ def bgzf_decompress_array(data) -> np.ndarray | None:
             cap *= 4
         return None
     dst = np.empty(max(int(size), 1), dtype=np.uint8)
-    n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
+    # block-parallel path first (per-member raw inflate at prefix-summed
+    # offsets); -2 means the payload itself is corrupt — the serial gzip
+    # walk would fail on it too, so fall back only on -1 (framing)
+    n = lib.vctpu_bgzf_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
+    if n == -1:
+        n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
     if n != size:
         return None
     return dst[:n]
@@ -305,10 +313,18 @@ def vcf_parse(buf, n_samples: int) -> dict | None:
     n = int(n)
     uniq_cap = 4096
     f32, f64, i64, i32 = np.float32, np.float64, np.int64, np.int32
+    # every span column is its own contiguous (n, 2) buffer: downstream
+    # consumers (NativeAux, the assemble call) use them directly with no
+    # strided-slice copies (round-4 writeback profile: 1.2s at 5M records)
     out = {
         "n": n,
         "line_spans": np.empty((n, 2), dtype=i64),
-        "field_spans": np.empty((n, 6, 2), dtype=i64),
+        "id_spans": np.empty((n, 2), dtype=i64),
+        "ref_spans": np.empty((n, 2), dtype=i64),
+        "alt_spans": np.empty((n, 2), dtype=i64),
+        "filter_spans": np.empty((n, 2), dtype=i64),
+        "info_spans": np.empty((n, 2), dtype=i64),
+        "tail_spans": np.empty((n, 2), dtype=i64),
         "pos": np.empty(n, dtype=i64),
         "qual": np.empty(n, dtype=f64),
         "chrom_codes": np.empty(n, dtype=i32),
@@ -343,7 +359,10 @@ def vcf_parse(buf, n_samples: int) -> dict | None:
     _i8p = ctypes.POINTER(ctypes.c_int8)
     rc = lib.vctpu_vcf_parse(
         src, len(src_arr), first_off.value, n, int(n_samples),
-        p(out["line_spans"], _i64p), p(out["field_spans"], _i64p),
+        p(out["line_spans"], _i64p), p(out["id_spans"], _i64p),
+        p(out["ref_spans"], _i64p), p(out["alt_spans"], _i64p),
+        p(out["filter_spans"], _i64p), p(out["info_spans"], _i64p),
+        p(out["tail_spans"], _i64p),
         p(out["pos"], _i64p), p(out["qual"], _f64p),
         p(out["chrom_codes"], _i32p), p(uniq_buf, _u8p), uniq_n,
         p(out["gt"], _i8p), p(out["gt_phased"], _u8p),

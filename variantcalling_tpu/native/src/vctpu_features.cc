@@ -176,6 +176,41 @@ int64_t vctpu_gather_windows(
     return 0;
 }
 
+namespace {
+
+// %g-identical fast formatter for |v| < 100 where v is exactly the
+// nearest double to k/10^4 for integer k: at most 6 significant digits,
+// fixed notation, trailing zeros trimmed — precisely what printf %g
+// emits for this domain. The filter pipeline's TREE_SCORE column
+// (np.round(score, 4)) lands here, avoiding ~300ns of snprintf per
+// record on the 5M writeback path. Returns length or 0 (use snprintf).
+inline int fast_g4(double v, char* out) {
+    if (!(v > -100.0 && v < 100.0)) return 0;
+    if (v == 0.0 && std::signbit(v)) return 0;  // %g prints -0.0 as "-0"
+    const long long k = std::llround(v * 10000.0);
+    if ((double)k / 10000.0 != v) return 0;  // not an exact 4-decimal value
+    int len = 0;
+    long long a = k;
+    if (a < 0) {
+        out[len++] = '-';
+        a = -a;
+    }
+    const long long ip = a / 10000, fp = a % 10000;
+    if (ip >= 10) out[len++] = (char)('0' + ip / 10);
+    out[len++] = (char)('0' + ip % 10);
+    if (fp) {
+        char d[4] = {(char)('0' + fp / 1000), (char)('0' + (fp / 100) % 10),
+                     (char)('0' + (fp / 10) % 10), (char)('0' + fp % 10)};
+        int last = 3;
+        while (d[last] == '0') --last;  // fp != 0 -> terminates
+        out[len++] = '.';
+        for (int j = 0; j <= last; ++j) out[len++] = d[j];
+    }
+    return len;
+}
+
+}  // namespace
+
 // Per-record ";KEY=<%g>" INFO suffixes for one float column (NaN ->
 // empty) — the filter pipeline's TREE_SCORE writeback formatter, printf
 // %g exactly like numpy's b"%g" so the byte-splicing output is unchanged.
@@ -194,7 +229,8 @@ int64_t vctpu_format_float_info(
             if (pos + prefix_len + 32 > cap) return -1;
             for (int64_t j = 0; j < prefix_len; ++j) out_buf[pos + j] = prefix[j];
             pos += prefix_len;
-            pos += std::snprintf((char*)out_buf + pos, 32, "%g", v);
+            int fl = fast_g4(v, (char*)out_buf + pos);
+            pos += fl ? fl : std::snprintf((char*)out_buf + pos, 32, "%g", v);
         }
         out_offs[i + 1] = pos;
     }

@@ -7,15 +7,25 @@
 // via ctypes (no pybind11 in the image). Python fallbacks live beside every
 // call site (io/bam.py, io/bgzf.py); this library is the measured path.
 //
+// Both formats are block-parallel by design (BGZF: independent gzip
+// members; VCF: independent record lines), so the hot entry points shard
+// across threads (vctpu_threads.h) with byte-identical output to the
+// serial path. VCTPU_NATIVE_THREADS controls the fan-out.
+//
 // Build: g++ -O3 -shared -fPIC vctpu_native.cc -lz  (see native/__init__.py)
 
 #include <zlib.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
+#include <vector>
+
+#include "vctpu_threads.h"
 
 namespace {
 
@@ -106,51 +116,125 @@ int64_t vctpu_gzip_inflate(const uint8_t* src, int64_t n, uint8_t* dst, int64_t 
     return out_off;
 }
 
+// Block-parallel BGZF inflate: every member's output offset is known up
+// front from the ISIZE prefix sum, so blocks decompress concurrently into
+// disjoint ranges of dst (raw deflate payload + CRC verification — the
+// same integrity check zlib's gzip mode performs on the serial path).
+// Returns bytes written; -1 when the stream is not pure BGZF framing or
+// cap is too small (caller falls back to vctpu_gzip_inflate); -2 on
+// corrupt payload (bad deflate stream, ISIZE, or CRC mismatch).
+int64_t vctpu_bgzf_inflate(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap) try {
+    struct Block { int64_t off, bsize, out_off; uint32_t isize; };
+    std::vector<Block> blocks;
+    blocks.reserve((size_t)(n / 4096) + 1);
+    int64_t off = 0, total = 0;
+    while (off < n) {
+        int64_t bsize = bgzf_block_size(src, n, off);
+        if (bsize < 28 || off + bsize > n) return -1;
+        uint32_t isize;
+        std::memcpy(&isize, src + off + bsize - 4, 4);
+        blocks.push_back({off, bsize, total, isize});
+        total += isize;
+        off += bsize;
+    }
+    if (off != n || total > cap) return -1;
+    std::atomic<int> failed{0};
+    vctpu::for_shards((int64_t)blocks.size(), vctpu::nthreads(),
+                      [&](int, int64_t lo, int64_t hi) {
+        z_stream zs;
+        std::memset(&zs, 0, sizeof zs);
+        if (inflateInit2(&zs, -15) != Z_OK) {  // raw deflate per member
+            failed.store(1);
+            return;
+        }
+        for (int64_t b = lo; b < hi && !failed.load(std::memory_order_relaxed); ++b) {
+            const Block& blk = blocks[b];
+            uint16_t xlen = (uint16_t)src[blk.off + 10] | ((uint16_t)src[blk.off + 11] << 8);
+            int64_t payload = blk.off + 12 + xlen;
+            int64_t clen = blk.bsize - 12 - xlen - 8;
+            if (clen < 0) { failed.store(1); break; }
+            zs.next_in = const_cast<uint8_t*>(src) + payload;
+            zs.avail_in = (uInt)clen;
+            zs.next_out = dst + blk.out_off;
+            zs.avail_out = blk.isize;
+            int ret = inflate(&zs, Z_FINISH);
+            if (ret != Z_STREAM_END || zs.avail_out != 0) { failed.store(1); break; }
+            uint32_t crc_want;
+            std::memcpy(&crc_want, src + blk.off + blk.bsize - 8, 4);
+            if ((uint32_t)crc32(0L, dst + blk.out_off, blk.isize) != crc_want) {
+                failed.store(1);
+                break;
+            }
+            if (inflateReset2(&zs, -15) != Z_OK) { failed.store(1); break; }
+        }
+        inflateEnd(&zs);
+    });
+    return failed.load() ? -2 : total;
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
+}
+
 // Deflate src into independent BGZF blocks (<=65280B payload each) with the
-// BC extra field + canonical EOF sentinel. Returns bytes written or -1.
-int64_t vctpu_bgzf_compress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap, int level) {
+// BC extra field + canonical EOF sentinel. Chunks are independent, so they
+// compress in parallel into fixed-size scratch slots and compact serially —
+// output bytes are identical to the serial path. Returns bytes written or -1.
+int64_t vctpu_bgzf_compress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t cap, int level) try {
     static const uint8_t EOF_BLOCK[28] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00,
                                           0x42, 0x43, 0x02, 0x00, 0x1b, 0x00, 0x03, 0x00, 0, 0, 0,
                                           0, 0, 0, 0, 0};
     const int64_t CHUNK = 65280;
-    int64_t in_off = 0, out_off = 0;
-    while (in_off < n) {
-        int64_t len = std::min(CHUNK, n - in_off);
-        z_stream zs;
-        std::memset(&zs, 0, sizeof zs);
-        if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK) return -1;
-        uint8_t body[1 << 17];
-        zs.next_in = const_cast<uint8_t*>(src) + in_off;
-        zs.avail_in = (uInt)len;
-        zs.next_out = body;
-        zs.avail_out = sizeof body;
-        int ret = deflate(&zs, Z_FINISH);
-        int64_t deflated = (int64_t)(sizeof body) - zs.avail_out;
-        deflateEnd(&zs);
-        if (ret != Z_STREAM_END) return -1;
-        int64_t bsize = deflated + 26;  // header(18) + crc/isize(8)
-        if (bsize > 0xFFFF + 1) return -1;
-        if (out_off + bsize > cap) return -1;
-        uint8_t* h = dst + out_off;
-        const uint8_t head[12] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00};
-        std::memcpy(h, head, 12);
-        h[12] = 'B';
-        h[13] = 'C';
-        h[14] = 2;
-        h[15] = 0;
-        uint16_t bs16 = (uint16_t)(bsize - 1);
-        std::memcpy(h + 16, &bs16, 2);
-        std::memcpy(h + 18, body, deflated);
-        uint32_t crc = (uint32_t)crc32(0L, src + in_off, (uInt)len);
-        uint32_t isize = (uint32_t)len;
-        std::memcpy(h + 18 + deflated, &crc, 4);
-        std::memcpy(h + 22 + deflated, &isize, 4);
-        out_off += bsize;
-        in_off += len;
+    const int64_t SLOT = 66560;  // header + compressBound(65280) + trailer, padded
+    const int64_t n_chunks = n > 0 ? (n + CHUNK - 1) / CHUNK : 0;
+    // uninitialized scratch: every kept byte is written by deflate below,
+    // and a value-initializing vector would memset ~1.02x the input first
+    std::unique_ptr<uint8_t[]> scratch(new (std::nothrow) uint8_t[(size_t)(n_chunks * SLOT)]);
+    if (n_chunks > 0 && !scratch) return -1;  // caller falls back to Python
+    std::vector<int64_t> sizes((size_t)n_chunks, -1);
+    vctpu::for_shards(n_chunks, vctpu::nthreads(), [&](int, int64_t lo, int64_t hi) {
+        for (int64_t c = lo; c < hi; ++c) {
+            const int64_t in_off = c * CHUNK;
+            const int64_t len = std::min(CHUNK, n - in_off);
+            z_stream zs;
+            std::memset(&zs, 0, sizeof zs);
+            if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK) return;
+            uint8_t* h = scratch.get() + c * SLOT;
+            zs.next_in = const_cast<uint8_t*>(src) + in_off;
+            zs.avail_in = (uInt)len;
+            zs.next_out = h + 18;
+            zs.avail_out = (uInt)(SLOT - 26);
+            int ret = deflate(&zs, Z_FINISH);
+            int64_t deflated = (int64_t)(SLOT - 26) - zs.avail_out;
+            deflateEnd(&zs);
+            if (ret != Z_STREAM_END) return;  // sizes[c] stays -1 -> error
+            int64_t bsize = deflated + 26;    // header(18) + crc/isize(8)
+            if (bsize > 0xFFFF + 1) return;
+            const uint8_t head[12] = {0x1f, 0x8b, 0x08, 0x04, 0, 0, 0, 0, 0, 0xff, 0x06, 0x00};
+            std::memcpy(h, head, 12);
+            h[12] = 'B';
+            h[13] = 'C';
+            h[14] = 2;
+            h[15] = 0;
+            uint16_t bs16 = (uint16_t)(bsize - 1);
+            std::memcpy(h + 16, &bs16, 2);
+            uint32_t crc = (uint32_t)crc32(0L, src + in_off, (uInt)len);
+            uint32_t isize = (uint32_t)len;
+            std::memcpy(h + 18 + deflated, &crc, 4);
+            std::memcpy(h + 22 + deflated, &isize, 4);
+            sizes[c] = bsize;
+        }
+    });
+    int64_t out_off = 0;
+    for (int64_t c = 0; c < n_chunks; ++c) {
+        if (sizes[c] < 0) return -1;
+        if (out_off + sizes[c] > cap) return -1;
+        std::memcpy(dst + out_off, scratch.get() + c * SLOT, sizes[c]);
+        out_off += sizes[c];
     }
     if (out_off + 28 > cap) return -1;
     std::memcpy(dst + out_off, EOF_BLOCK, 28);
     return out_off + 28;
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
 }
 
 // Walk uncompressed BAM alignment records (buf starts at the first record,
@@ -238,6 +322,10 @@ int64_t vctpu_bam_depth(const uint8_t* buf, int64_t n, const int64_t* contig_sta
 // SURVEY.md §3.1); numeric fields, sample-0 FORMAT numerics, hot INFO keys
 // and allele classification all come out as flat arrays ready for device
 // transfer, so the Python layer only materializes strings it actually uses.
+// Records are independent lines, so the scan shards across threads: byte
+// ranges aligned at line starts, per-shard record counts prefix-summed into
+// disjoint output ranges, per-shard CHROM dictionaries merged in shard
+// order (first-appearance code order is preserved exactly).
 // ---------------------------------------------------------------------------
 
 namespace {
@@ -252,8 +340,7 @@ inline int base_code(uint8_t c) {
     }
 }
 
-inline double parse_double(const uint8_t* s, int64_t len) {
-    if (len <= 0 || (len == 1 && s[0] == '.')) return NAN;
+double parse_double_slow(const uint8_t* s, int64_t len) {
     char tmp[64];
     int64_t m = len < 63 ? len : 63;
     std::memcpy(tmp, s, m);
@@ -262,6 +349,41 @@ inline double parse_double(const uint8_t* s, int64_t len) {
     double v = strtod(tmp, &end);
     if (end == tmp) return NAN;
     return v;
+}
+
+// Fast decimal parse for the overwhelmingly common VCF shape
+// [+-]digits[.digits] with <=15 significant digits: an exactly-held
+// integer mantissa divided by an exact power of ten is correctly rounded,
+// so the result is bit-identical to strtod. Everything else (exponents,
+// inf/nan, long digit strings) falls back to strtod.
+inline double parse_double(const uint8_t* s, int64_t len) {
+    if (len <= 0 || (len == 1 && s[0] == '.')) return NAN;
+    static const double P10[16] = {1.0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7,
+                                   1e8, 1e9, 1e10, 1e11, 1e12, 1e13, 1e14, 1e15};
+    int64_t i = 0;
+    bool neg = false;
+    if (s[0] == '-' || s[0] == '+') {
+        neg = s[0] == '-';
+        i = 1;
+    }
+    uint64_t mant = 0;
+    int digits = 0, frac = 0;
+    bool dot = false;
+    for (; i < len; ++i) {
+        uint8_t c = s[i];
+        if (c >= '0' && c <= '9') {
+            if (++digits > 15) return parse_double_slow(s, len);
+            mant = mant * 10 + (c - '0');
+            frac += dot;
+        } else if (c == '.' && !dot) {
+            dot = true;
+        } else {
+            return parse_double_slow(s, len);
+        }
+    }
+    if (digits == 0) return parse_double_slow(s, len);
+    double v = (double)mant / P10[frac];
+    return neg ? -v : v;
 }
 
 inline int64_t parse_i64(const uint8_t* s, int64_t len) {
@@ -276,55 +398,61 @@ inline int64_t parse_i64(const uint8_t* s, int64_t len) {
     return neg ? -v : v;
 }
 
-}  // namespace
+// All output column pointers of the VCF scan, so the per-shard worker and
+// the serial path share one record-parsing core.
+struct VcfOut {
+    int64_t* line_spans;
+    int64_t* id_spans;
+    int64_t* ref_spans;
+    int64_t* alt_spans;
+    int64_t* filter_spans;
+    int64_t* info_spans;
+    int64_t* tail_spans;
+    int64_t* pos;
+    double* qual;
+    int32_t* chrom_codes;
+    int8_t* gt;
+    uint8_t* gt_phased;
+    float* gq;
+    float* dpf;
+    float* ad;
+    uint8_t* aclass;
+    int32_t* indel_length;
+    int32_t* indel_nuc;
+    int32_t* ref_code;
+    int32_t* alt_code;
+    int32_t* n_alts;
+    int32_t* ref_len_out;
+    const uint8_t* keys;
+    const int32_t* key_lens;
+    int32_t n_keys;
+    double* info_vals;
+    int32_t n_samples;
+};
 
-extern "C" {
-
-// Number of record lines (not starting with '#') and offset of the first one.
-int64_t vctpu_vcf_count(const uint8_t* buf, int64_t n, int64_t* first_rec_off) {
-    int64_t off = 0, count = 0;
-    *first_rec_off = n;
-    while (off < n) {
-        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', n - off);
-        int64_t end = nl ? (nl - buf) : n;
-        if (end > off && buf[off] != '#') {
-            if (count == 0) *first_rec_off = off;
-            count++;
+// Parse record lines in buf[start..limit) writing rows [rec_base,
+// rec_base+max_rec) of the output columns; CHROM codes go through the
+// given dictionary (chrom_uniq: 64B slots, *n_uniq entries, uniq_cap max).
+// Returns records parsed, or -1 on malformed input / dictionary overflow.
+int64_t vcf_parse_range(const uint8_t* buf, int64_t start, int64_t limit,
+                        int64_t rec_base, int64_t max_rec, const VcfOut& o,
+                        uint8_t* chrom_uniq, int32_t uniq_cap, int32_t* n_uniq_io) {
+    int32_t n_uniq = *n_uniq_io;
+    int64_t off = start, parsed = 0;
+    while (off < limit && parsed < max_rec) {
+        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', limit - off);
+        int64_t line_end = nl ? (nl - buf) : limit;
+        int64_t end = line_end;
+        if (end > off && buf[end - 1] == '\r') end--;  // CRLF
+        if (end <= off || buf[off] == '#') {
+            off = line_end + 1;
+            continue;
         }
-        off = end + 1;
-    }
-    return count;
-}
+        const int64_t rec = rec_base + parsed;
+        o.line_spans[rec * 2] = off;
+        o.line_spans[rec * 2 + 1] = end;
 
-// One-pass columnar parse. All output arrays are caller-allocated for
-// n_rec records (from vctpu_vcf_count). Returns records parsed or -1.
-//
-// field_spans layout per record: 6 x (start, end) byte spans —
-//   [0]=ID [1]=REF [2]=ALT [3]=FILTER [4]=INFO [5]=FORMAT..line-end (tail)
-// aclass bitmask: 1=snp 2=indel 4=ins 8=first-alt-prefixed-by-ref
-// gt/gq/dp/ad are sample-0 FORMAT numerics (NaN/-1 when missing);
-// ad = (ref_count, alt1_count, total). info_vals = (n_rec, n_keys) doubles
-// for the requested INFO keys (first element of comma lists; Flag -> 1).
-int64_t vctpu_vcf_parse(
-    const uint8_t* buf, int64_t n, int64_t start_off, int64_t n_rec, int32_t n_samples,
-    int64_t* line_spans, int64_t* field_spans, int64_t* pos, double* qual,
-    int32_t* chrom_codes, uint8_t* chrom_uniq, int32_t* uniq_inout,
-    int8_t* gt, uint8_t* gt_phased, float* gq, float* dpf, float* ad,
-    uint8_t* aclass, int32_t* indel_length, int32_t* indel_nuc,
-    int32_t* ref_code, int32_t* alt_code, int32_t* n_alts, int32_t* ref_len_out,
-    const uint8_t* keys, const int32_t* key_lens, int32_t n_keys, double* info_vals) {
-    const int32_t uniq_cap = *uniq_inout;
-    int32_t n_uniq = 0;
-    int64_t off = start_off, rec = 0;
-    while (off < n && rec < n_rec) {
-        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', n - off);
-        int64_t end = nl ? (nl - buf) : n;
-        if (end > off && buf[off + (end - off) - 1] == '\r') end--;  // CRLF
-        if (end <= off || buf[off] == '#') { off = (nl ? nl - buf : n) + 1; continue; }
-        line_spans[rec * 2] = off;
-        line_spans[rec * 2 + 1] = end;
-
-        // tokenize up to 10 tab-separated spans: CHROM POS ID REF ALT QUAL FILTER INFO [FORMAT samples...]
+        // tokenize up to 9 tab-separated spans: CHROM POS ID REF ALT QUAL FILTER INFO [FORMAT samples...]
         int64_t fs[9][2];
         int nf = 0;
         int64_t p = off;
@@ -355,16 +483,16 @@ int64_t vctpu_vcf_parse(
                 std::memcpy(name, buf + fs[0][0], cl);
                 code = n_uniq++;
             }
-            chrom_codes[rec] = code;
+            o.chrom_codes[rec] = code;
         }
-        pos[rec] = parse_i64(buf + fs[1][0], fs[1][1] - fs[1][0]);
-        qual[rec] = parse_double(buf + fs[5][0], fs[5][1] - fs[5][0]);
-        field_spans[rec * 12 + 0] = fs[2][0];  field_spans[rec * 12 + 1] = fs[2][1];   // ID
-        field_spans[rec * 12 + 2] = fs[3][0];  field_spans[rec * 12 + 3] = fs[3][1];   // REF
-        field_spans[rec * 12 + 4] = fs[4][0];  field_spans[rec * 12 + 5] = fs[4][1];   // ALT
-        field_spans[rec * 12 + 6] = fs[6][0];  field_spans[rec * 12 + 7] = fs[6][1];   // FILTER
-        field_spans[rec * 12 + 8] = fs[7][0];  field_spans[rec * 12 + 9] = fs[7][1];   // INFO
-        field_spans[rec * 12 + 10] = tail_start; field_spans[rec * 12 + 11] = end;     // tail
+        o.pos[rec] = parse_i64(buf + fs[1][0], fs[1][1] - fs[1][0]);
+        o.qual[rec] = parse_double(buf + fs[5][0], fs[5][1] - fs[5][0]);
+        o.id_spans[rec * 2] = fs[2][0];     o.id_spans[rec * 2 + 1] = fs[2][1];
+        o.ref_spans[rec * 2] = fs[3][0];    o.ref_spans[rec * 2 + 1] = fs[3][1];
+        o.alt_spans[rec * 2] = fs[4][0];    o.alt_spans[rec * 2 + 1] = fs[4][1];
+        o.filter_spans[rec * 2] = fs[6][0]; o.filter_spans[rec * 2 + 1] = fs[6][1];
+        o.info_spans[rec * 2] = fs[7][0];   o.info_spans[rec * 2 + 1] = fs[7][1];
+        o.tail_spans[rec * 2] = tail_start; o.tail_spans[rec * 2 + 1] = end;
 
         // ---- allele classification (parity: featurize.classify_alleles) ----
         {
@@ -372,7 +500,7 @@ int64_t vctpu_vcf_parse(
             int64_t rl = fs[3][1] - fs[3][0];
             const uint8_t* alt = buf + fs[4][0];
             int64_t al_full = fs[4][1] - fs[4][0];
-            ref_len_out[rec] = (int32_t)rl;
+            o.ref_len_out[rec] = (int32_t)rl;
             uint8_t cls = 0;
             int32_t ilen = 0, inuc = 4, rc = 4, ac = 4, na = 0;
             if (!(al_full == 0 || (al_full == 1 && alt[0] == '.'))) {
@@ -413,17 +541,17 @@ int64_t vctpu_vcf_parse(
                     }
                 }
             }
-            aclass[rec] = cls;
-            indel_length[rec] = ilen;
-            indel_nuc[rec] = inuc;
-            ref_code[rec] = rc;
-            alt_code[rec] = ac;
-            n_alts[rec] = na;
+            o.aclass[rec] = cls;
+            o.indel_length[rec] = ilen;
+            o.indel_nuc[rec] = inuc;
+            o.ref_code[rec] = rc;
+            o.alt_code[rec] = ac;
+            o.n_alts[rec] = na;
         }
 
         // ---- INFO numeric keys ----
-        if (n_keys > 0) {
-            for (int32_t k = 0; k < n_keys; k++) info_vals[rec * n_keys + k] = NAN;
+        if (o.n_keys > 0) {
+            for (int32_t k = 0; k < o.n_keys; k++) o.info_vals[rec * o.n_keys + k] = NAN;
             int64_t ip = fs[7][0], ie = fs[7][1];
             if (!(ie - ip == 1 && buf[ip] == '.')) {
                 while (ip < ie) {
@@ -432,16 +560,16 @@ int64_t vctpu_vcf_parse(
                     const uint8_t* eq = (const uint8_t*)std::memchr(buf + ip, '=', ee - ip);
                     int64_t klen = eq ? (eq - buf - ip) : (ee - ip);
                     int64_t koff = 0;
-                    for (int32_t k = 0; k < n_keys; k++) {
-                        int32_t kl = key_lens[k];
-                        if (kl == klen && std::memcmp(keys + koff, buf + ip, klen) == 0) {
+                    for (int32_t k = 0; k < o.n_keys; k++) {
+                        int32_t kl = o.key_lens[k];
+                        if (kl == klen && std::memcmp(o.keys + koff, buf + ip, klen) == 0) {
                             if (!eq) {
-                                info_vals[rec * n_keys + k] = 1.0;  // Flag
+                                o.info_vals[rec * o.n_keys + k] = 1.0;  // Flag
                             } else {
                                 int64_t vs = ip + klen + 1;
                                 const uint8_t* comma = (const uint8_t*)std::memchr(buf + vs, ',', ee - vs);
                                 int64_t ve = comma ? (comma - buf) : ee;
-                                info_vals[rec * n_keys + k] = parse_double(buf + vs, ve - vs);
+                                o.info_vals[rec * o.n_keys + k] = parse_double(buf + vs, ve - vs);
                             }
                             break;
                         }
@@ -453,10 +581,10 @@ int64_t vctpu_vcf_parse(
         }
 
         // ---- FORMAT sample-0 numerics (GT / GQ / DP / AD) ----
-        gt[rec * 2] = -1; gt[rec * 2 + 1] = -1; gt_phased[rec] = 0;
-        gq[rec] = NAN; dpf[rec] = NAN;
-        ad[rec * 3] = NAN; ad[rec * 3 + 1] = NAN; ad[rec * 3 + 2] = NAN;
-        if (n_samples > 0 && tail_start < end) {
+        o.gt[rec * 2] = -1; o.gt[rec * 2 + 1] = -1; o.gt_phased[rec] = 0;
+        o.gq[rec] = NAN; o.dpf[rec] = NAN;
+        o.ad[rec * 3] = NAN; o.ad[rec * 3 + 1] = NAN; o.ad[rec * 3 + 2] = NAN;
+        if (o.n_samples > 0 && tail_start < end) {
             // FORMAT keys
             const uint8_t* ftab = (const uint8_t*)std::memchr(buf + tail_start, '\t', end - tail_start);
             int64_t fend = ftab ? (ftab - buf) : end;
@@ -497,10 +625,10 @@ int64_t vctpu_vcf_parse(
                         int64_t a_len = sep >= 0 ? sep : l;
                         if (!(a_len == 1 && s[0] == '.')) {
                             int64_t v = parse_i64(s, a_len);
-                            if (v >= -128 && v <= 127) gt[rec * 2] = (int8_t)v;
+                            if (v >= -128 && v <= 127) o.gt[rec * 2] = (int8_t)v;
                         }
                         if (sep >= 0) {
-                            gt_phased[rec] = s[sep] == '|';
+                            o.gt_phased[rec] = s[sep] == '|';
                             int64_t b_len = l - sep - 1;
                             // second diploid slot only (extra ploidy ignored)
                             const uint8_t* b = s + sep + 1;
@@ -509,13 +637,13 @@ int64_t vctpu_vcf_parse(
                                 if (b[i] == '/' || b[i] == '|') { b2 = i; break; }
                             if (!(b2 == 1 && b[0] == '.')) {
                                 int64_t v = parse_i64(b, b2);
-                                if (v >= -128 && v <= 127) gt[rec * 2 + 1] = (int8_t)v;
+                                if (v >= -128 && v <= 127) o.gt[rec * 2 + 1] = (int8_t)v;
                             }
                         }
                     } else if (idx == gq_i) {
-                        gq[rec] = (float)parse_double(buf + vp, ve - vp);
+                        o.gq[rec] = (float)parse_double(buf + vp, ve - vp);
                     } else if (idx == dp_i) {
-                        dpf[rec] = (float)parse_double(buf + vp, ve - vp);
+                        o.dpf[rec] = (float)parse_double(buf + vp, ve - vp);
                     } else if (idx == ad_i && ve > vp) {
                         double total = 0;
                         int ai = 0;
@@ -528,12 +656,12 @@ int64_t vctpu_vcf_parse(
                             if (v == v) {  // not NaN
                                 any = true;
                                 if (v > 0) total += v;
-                                if (ai < 2) ad[rec * 3 + ai] = (float)v;
+                                if (ai < 2) o.ad[rec * 3 + ai] = (float)v;
                             }
                             ai++;
                             ap = ae + 1;
                         }
-                        if (any) ad[rec * 3 + 2] = (float)total;
+                        if (any) o.ad[rec * 3 + 2] = (float)total;
                     }
                     idx++;
                     if (!colon || ve >= send) break;
@@ -541,11 +669,148 @@ int64_t vctpu_vcf_parse(
                 }
             }
         }
-        rec++;
-        off = (nl ? nl - buf : n) + 1;
+        parsed++;
+        off = line_end + 1;
     }
+    *n_uniq_io = n_uniq;
+    return parsed;
+}
+
+// Count record lines (non-empty, not '#') in buf[start..limit).
+int64_t count_records_range(const uint8_t* buf, int64_t start, int64_t limit) {
+    int64_t off = start, count = 0;
+    while (off < limit) {
+        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', limit - off);
+        int64_t end = nl ? (nl - buf) : limit;
+        if (end > off && buf[off] != '#') count++;
+        off = end + 1;
+    }
+    return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of record lines (not starting with '#') and offset of the first one.
+int64_t vctpu_vcf_count(const uint8_t* buf, int64_t n, int64_t* first_rec_off) {
+    int64_t off = 0, count = 0;
+    *first_rec_off = n;
+    while (off < n) {
+        const uint8_t* nl = (const uint8_t*)std::memchr(buf + off, '\n', n - off);
+        int64_t end = nl ? (nl - buf) : n;
+        if (end > off && buf[off] != '#') {
+            if (count == 0) *first_rec_off = off;
+            count++;
+        }
+        off = end + 1;
+    }
+    return count;
+}
+
+// One-pass columnar parse, sharded across threads. All output arrays are
+// caller-allocated for n_rec records (from vctpu_vcf_count); each span
+// array is an independent contiguous (n_rec, 2) int64 buffer of [start,
+// end) byte offsets. Returns records parsed or -1.
+//
+// aclass bitmask: 1=snp 2=indel 4=ins 8=first-alt-prefixed-by-ref
+// gt/gq/dp/ad are sample-0 FORMAT numerics (NaN/-1 when missing);
+// ad = (ref_count, alt1_count, total). info_vals = (n_rec, n_keys) doubles
+// for the requested INFO keys (first element of comma lists; Flag -> 1).
+int64_t vctpu_vcf_parse(
+    const uint8_t* buf, int64_t n, int64_t start_off, int64_t n_rec, int32_t n_samples,
+    int64_t* line_spans, int64_t* id_spans, int64_t* ref_spans, int64_t* alt_spans,
+    int64_t* filter_spans, int64_t* info_spans, int64_t* tail_spans,
+    int64_t* pos, double* qual,
+    int32_t* chrom_codes, uint8_t* chrom_uniq, int32_t* uniq_inout,
+    int8_t* gt, uint8_t* gt_phased, float* gq, float* dpf, float* ad,
+    uint8_t* aclass, int32_t* indel_length, int32_t* indel_nuc,
+    int32_t* ref_code, int32_t* alt_code, int32_t* n_alts, int32_t* ref_len_out,
+    const uint8_t* keys, const int32_t* key_lens, int32_t n_keys, double* info_vals) try {
+    const int32_t uniq_cap = *uniq_inout;
+    VcfOut o = {line_spans, id_spans, ref_spans, alt_spans, filter_spans, info_spans,
+                tail_spans, pos, qual, chrom_codes, gt, gt_phased, gq, dpf, ad,
+                aclass, indel_length, indel_nuc, ref_code, alt_code, n_alts,
+                ref_len_out, keys, key_lens, n_keys, info_vals, n_samples};
+
+    int t_count = vctpu::nthreads();
+    if (t_count > 1 && n_rec >= (int64_t)t_count * 4096) {
+        // byte-shard [start_off, n) at line boundaries
+        std::vector<int64_t> bounds;
+        bounds.push_back(start_off);
+        const int64_t span = n - start_off;
+        for (int t = 1; t < t_count; ++t) {
+            int64_t b = start_off + span * t / t_count;
+            if (b < bounds.back()) b = bounds.back();
+            const uint8_t* nl = (const uint8_t*)std::memchr(buf + b, '\n', n - b);
+            b = nl ? (nl - buf) + 1 : n;
+            if (b > bounds.back()) bounds.push_back(b);
+        }
+        bounds.push_back(n);
+        const int shards = (int)bounds.size() - 1;
+        std::vector<int64_t> counts(shards), bases(shards + 1, 0);
+        vctpu::for_shards((int64_t)shards, shards, [&](int, int64_t lo, int64_t hi) {
+            for (int64_t s = lo; s < hi; ++s)
+                counts[s] = count_records_range(buf, bounds[s], bounds[s + 1]);
+        });
+        for (int s = 0; s < shards; ++s) bases[s + 1] = bases[s] + counts[s];
+        if (bases[shards] != n_rec) return -1;
+
+        std::vector<std::vector<uint8_t>> uniq(shards);
+        std::vector<int32_t> uniq_n(shards, 0);
+        std::vector<int64_t> parsed(shards, -1);
+        vctpu::for_shards((int64_t)shards, shards, [&](int, int64_t lo, int64_t hi) {
+            for (int64_t s = lo; s < hi; ++s) {
+                uniq[s].assign((size_t)uniq_cap * 64, 0);
+                parsed[s] = vcf_parse_range(buf, bounds[s], bounds[s + 1], bases[s],
+                                            counts[s], o, uniq[s].data(), uniq_cap,
+                                            &uniq_n[s]);
+            }
+        });
+        // merge per-shard CHROM dictionaries in shard order (preserves
+        // global first-appearance code order), then remap shard codes
+        int32_t n_uniq = 0;
+        std::vector<std::vector<int32_t>> remap(shards);
+        for (int s = 0; s < shards; ++s) {
+            if (parsed[s] != counts[s]) return -1;
+            remap[s].resize(uniq_n[s]);
+            for (int32_t u = 0; u < uniq_n[s]; ++u) {
+                const uint8_t* name = uniq[s].data() + (int64_t)u * 64;
+                int32_t code = -1;
+                for (int32_t g = 0; g < n_uniq; ++g) {
+                    if (std::memcmp(chrom_uniq + (int64_t)g * 64, name, 64) == 0) {
+                        code = g;
+                        break;
+                    }
+                }
+                if (code < 0) {
+                    if (n_uniq >= uniq_cap) return -1;
+                    std::memcpy(chrom_uniq + (int64_t)n_uniq * 64, name, 64);
+                    code = n_uniq++;
+                }
+                remap[s][u] = code;
+            }
+        }
+        vctpu::for_shards((int64_t)shards, shards, [&](int, int64_t lo, int64_t hi) {
+            for (int64_t s = lo; s < hi; ++s) {
+                bool identity = true;
+                for (int32_t u = 0; u < uniq_n[s]; ++u) identity &= remap[s][u] == u;
+                if (identity) continue;
+                for (int64_t r = bases[s]; r < bases[s + 1]; ++r)
+                    chrom_codes[r] = remap[s][chrom_codes[r]];
+            }
+        });
+        *uniq_inout = n_uniq;
+        return n_rec;
+    }
+
+    int32_t n_uniq = 0;
+    int64_t rc = vcf_parse_range(buf, start_off, n, 0, n_rec, o, chrom_uniq, uniq_cap, &n_uniq);
+    if (rc < 0) return -1;
     *uniq_inout = n_uniq;
-    return rec;
+    return rc;
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
 }
 
 }  // extern "C"
@@ -573,35 +838,35 @@ void vctpu_interval_membership(const int64_t* starts, const int64_t* ends, int64
 
 }  // extern "C"
 
-extern "C" {
+namespace {
 
-// Assemble VCF record lines for writeback: the CHROM..QUAL head and the
-// FORMAT/sample tail are copied verbatim from the original parse buffer
-// (spans from vctpu_vcf_parse); the FILTER column is replaced and an INFO
-// suffix spliced in (";K=V" blob per record; replaces a missing "." INFO).
-// Returns bytes written, or -1 when out_cap is too small.
-int64_t vctpu_vcf_assemble(
-    const uint8_t* buf, int64_t buf_len, int64_t n,
-    const int64_t* line_spans,    // (n,2) full record line [start,end)
-    const int64_t* filter_spans,  // (n,2) original FILTER field
-    const int64_t* info_spans,    // (n,2) original INFO field
-    const int64_t* tail_spans,    // (n,2) FORMAT..line-end ([s==e] if none)
-    const uint8_t* filt_blob, const int64_t* filt_offs,  // n+1 offsets
-    const uint8_t* sfx_blob, const int64_t* sfx_offs,    // n+1 offsets
-    uint8_t* out, int64_t out_cap) {
-    int64_t w = 0;
-    for (int64_t i = 0; i < n; i++) {
+// Bytes one assembled record will occupy (mirrors assemble_range exactly).
+inline int64_t assemble_need(const uint8_t* buf, int64_t i,
+                             const int64_t* line_spans, const int64_t* filter_spans,
+                             const int64_t* info_spans, const int64_t* tail_spans,
+                             const int64_t* filt_offs, const int64_t* sfx_offs) {
+    int64_t head = filter_spans[i * 2] - line_spans[i * 2];
+    int64_t info_s = info_spans[i * 2], info_e = info_spans[i * 2 + 1];
+    int64_t tail = tail_spans[i * 2 + 1] - tail_spans[i * 2];
+    int64_t flt = filt_offs[i + 1] - filt_offs[i];
+    int64_t sfx = sfx_offs[i + 1] - sfx_offs[i];
+    bool info_missing = (info_e - info_s == 1 && buf[info_s] == '.');
+    int64_t body = info_missing && sfx > 0 ? sfx - 1 : (info_e - info_s) + sfx;
+    return head + flt + 1 + body + (tail > 0 ? 1 + tail : 0) + 1;
+}
+
+void assemble_range(const uint8_t* buf, int64_t lo, int64_t hi, int64_t w,
+                    const int64_t* line_spans, const int64_t* filter_spans,
+                    const int64_t* info_spans, const int64_t* tail_spans,
+                    const uint8_t* filt_blob, const int64_t* filt_offs,
+                    const uint8_t* sfx_blob, const int64_t* sfx_offs, uint8_t* out) {
+    for (int64_t i = lo; i < hi; i++) {
         int64_t head_s = line_spans[i * 2], head_e = filter_spans[i * 2];
         int64_t info_s = info_spans[i * 2], info_e = info_spans[i * 2 + 1];
         int64_t tail_s = tail_spans[i * 2], tail_e = tail_spans[i * 2 + 1];
         int64_t flt_s = filt_offs[i], flt_e = filt_offs[i + 1];
         int64_t sfx_s = sfx_offs[i], sfx_e = sfx_offs[i + 1];
-        if (head_s < 0 || head_e > buf_len || head_e < head_s) return -2;
         bool info_missing = (info_e - info_s == 1 && buf[info_s] == '.');
-        int64_t need = (head_e - head_s) + (flt_e - flt_s) + 1 +
-                       (info_e - info_s) + (sfx_e - sfx_s) +
-                       (tail_e > tail_s ? 1 + (tail_e - tail_s) : 0) + 1;
-        if (w + need > out_cap) return -1;
         memcpy(out + w, buf + head_s, head_e - head_s);  // "...QUAL\t"
         w += head_e - head_s;
         memcpy(out + w, filt_blob + flt_s, flt_e - flt_s);
@@ -624,7 +889,56 @@ int64_t vctpu_vcf_assemble(
         }
         out[w++] = '\n';
     }
-    return w;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Assemble VCF record lines for writeback: the CHROM..QUAL head and the
+// FORMAT/sample tail are copied verbatim from the original parse buffer
+// (spans from vctpu_vcf_parse); the FILTER column is replaced and an INFO
+// suffix spliced in (";K=V" blob per record; replaces a missing "." INFO).
+// Two passes, both sharded: exact per-record sizes (prefix-summed into
+// shard output offsets), then parallel copies into disjoint ranges.
+// Returns bytes written, -1 when out_cap is too small, -2 on bad spans.
+int64_t vctpu_vcf_assemble(
+    const uint8_t* buf, int64_t buf_len, int64_t n,
+    const int64_t* line_spans,    // (n,2) full record line [start,end)
+    const int64_t* filter_spans,  // (n,2) original FILTER field
+    const int64_t* info_spans,    // (n,2) original INFO field
+    const int64_t* tail_spans,    // (n,2) FORMAT..line-end ([s==e] if none)
+    const uint8_t* filt_blob, const int64_t* filt_offs,  // n+1 offsets
+    const uint8_t* sfx_blob, const int64_t* sfx_offs,    // n+1 offsets
+    uint8_t* out, int64_t out_cap) try {
+    const int t_count = vctpu::nthreads();
+    std::atomic<int> bad{0};
+    const int max_shards = (t_count > 1 && n >= 65536) ? t_count : 1;
+    std::vector<int64_t> sizes(max_shards, 0);
+    int used = vctpu::for_shards(n, max_shards, [&](int t, int64_t lo, int64_t hi) {
+        int64_t total = 0;
+        for (int64_t i = lo; i < hi; i++) {
+            int64_t head_s = line_spans[i * 2], head_e = filter_spans[i * 2];
+            if (head_s < 0 || head_e > buf_len || head_e < head_s) {
+                bad.store(1, std::memory_order_relaxed);
+                return;
+            }
+            total += assemble_need(buf, i, line_spans, filter_spans, info_spans,
+                                   tail_spans, filt_offs, sfx_offs);
+        }
+        sizes[t] = total;
+    });
+    if (bad.load()) return -2;
+    std::vector<int64_t> w_base(used + 1, 0);
+    for (int t = 0; t < used; ++t) w_base[t + 1] = w_base[t] + sizes[t];
+    if (w_base[used] > out_cap) return -1;
+    vctpu::for_shards(n, max_shards, [&](int t, int64_t lo, int64_t hi) {
+        assemble_range(buf, lo, hi, w_base[t], line_spans, filter_spans, info_spans,
+                       tail_spans, filt_blob, filt_offs, sfx_blob, sfx_offs, out);
+    });
+    return w_base[used];
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
 }
 
 }  // extern "C"

@@ -1,0 +1,58 @@
+// Shard-parallel execution for the native host engine.
+//
+// The reference parallelizes host work by forking whole processes around
+// external binaries (e.g. samtools fan-out, coverage_analysis.py:653-683
+// in /root/reference); this engine threads WITHIN the process so flat
+// output arrays are produced in place with no IPC or merge copies. Every
+// user splits its work into contiguous shards whose outputs land in
+// disjoint ranges of preallocated buffers, so no locks are needed and the
+// result is byte-identical to the serial path regardless of thread count.
+//
+// VCTPU_NATIVE_THREADS caps the shard count (default: hardware
+// concurrency). On a single-core host the helpers degrade to a plain
+// serial call with zero overhead.
+
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+namespace vctpu {
+
+inline int nthreads() {
+    const char* e = std::getenv("VCTPU_NATIVE_THREADS");
+    long n = e ? std::strtol(e, nullptr, 10) : (long)std::thread::hardware_concurrency();
+    if (n < 1) n = 1;
+    if (n > 128) n = 128;
+    return (int)n;
+}
+
+// Run f(shard, lo, hi) over [0, n) split into at most max_shards
+// contiguous ranges. Shard 0 runs on the calling thread. Returns the
+// number of shards actually used.
+template <class F>
+inline int for_shards(int64_t n, int max_shards, F&& f) {
+    int t_count = max_shards;
+    if ((int64_t)t_count > n) t_count = n > 0 ? (int)n : 1;
+    if (t_count <= 1) {
+        f(0, (int64_t)0, n);
+        return 1;
+    }
+    const int64_t per = (n + t_count - 1) / t_count;
+    std::vector<std::thread> workers;
+    workers.reserve(t_count - 1);
+    for (int t = 1; t < t_count; ++t) {
+        const int64_t lo = (int64_t)t * per;
+        const int64_t hi = std::min(n, lo + per);
+        if (lo >= hi) break;
+        workers.emplace_back([&f, t, lo, hi] { f(t, lo, hi); });
+    }
+    f(0, (int64_t)0, std::min(per, n));
+    for (auto& w : workers) w.join();
+    return 1 + (int)workers.size();
+}
+
+}  // namespace vctpu

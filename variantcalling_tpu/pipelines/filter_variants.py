@@ -26,7 +26,7 @@ from variantcalling_tpu import logger
 from variantcalling_tpu.featurize import host_featurize
 from variantcalling_tpu.io import bed as bedio
 from variantcalling_tpu.io.fasta import FastaReader
-from variantcalling_tpu.io.vcf import VariantTable, read_vcf, write_vcf
+from variantcalling_tpu.io.vcf import FactorizedColumn, VariantTable, read_vcf, write_vcf
 from variantcalling_tpu.models import forest as forest_mod
 from variantcalling_tpu.models import threshold as threshold_mod
 from variantcalling_tpu.models.forest import FlatForest
@@ -248,6 +248,8 @@ def _narrow_column(a: np.ndarray) -> np.ndarray:
         return a
     small = a.astype(np.uint8, copy=True) if a.dtype.kind in "iu" else None
     if small is None and a.dtype.kind == "f":
+        if not np.isfinite(a).all():  # NaN/inf: the uint8 probe cast is UB
+            return a.astype(np.float32, copy=False)
         small = a.astype(np.uint8)
         if not np.array_equal(small.astype(a.dtype), a):
             return a.astype(np.float32, copy=False)
@@ -526,13 +528,15 @@ def filter_variants(
             gs, ge = coords.globalize_intervals(runs)
             hpol_near = iops.distance_to_nearest(gpos, gs, ge) <= hpol_dist
 
-    # vectorized FILTER assembly (no per-record Python on the 5M path):
+    # FILTER assembly as integer codes over the 6 possible values (no
+    # per-record Python and no factorize on the 5M writeback path):
     # COHORT_FP beats LOW_SCORE; HPOL_RUN appends with ';'
-    base = np.where(cohort_fp, COHORT_FP, np.where(low, LOW_SCORE, ""))
-    base = base.astype(object)
-    hp = np.where(base == "", HPOL_RUN, base + (";" + HPOL_RUN))
-    filters = np.where(hpol_near, hp, base)
-    filters = np.where(filters == "", PASS, filters).astype(object)
+    base_idx = np.where(cohort_fp, 1, np.where(low, 2, 0)).astype(np.int32)
+    filters = FactorizedColumn(
+        base_idx + 3 * hpol_near,
+        [PASS, COHORT_FP, LOW_SCORE, HPOL_RUN,
+         f"{COHORT_FP};{HPOL_RUN}", f"{LOW_SCORE};{HPOL_RUN}"],
+    )
     return score, filters
 
 
@@ -594,9 +598,22 @@ def run(argv: list[str]) -> int:
         # keep the score's own dtype: a float32 cast here could round a
         # float64 score differently than the single-process run writes it
         score = dist.allgather_concat(np.asarray(score))
-        filters = np.asarray(dist.allgather_strings([str(f) for f in filters]),
-                             dtype=object)
+        # the FILTER uniques table is a fixed literal identical on every
+        # rank, so only the int32 codes cross the wire — writeback stays
+        # integer-only (no 5M-string gather, no re-factorize)
+        filters = FactorizedColumn(dist.allgather_concat(filters.codes),
+                                   filters.uniques)
         assert len(score) == len(table), (len(score), len(table))
+        if jax.process_index() != 0 and not os.environ.get("VCTPU_ALL_RANKS_WRITE"):
+            # every rank holds the full result, but only rank 0 touches the
+            # output path: concurrent identical-byte writes to a shared
+            # filesystem race benignly at best (truncate-then-write), and a
+            # straggler could transiently truncate a finished file.
+            # VCTPU_ALL_RANKS_WRITE=1 restores every-rank writes for
+            # deployments whose output path is per-host local disk.
+            logger.info("rank %d/%d: writeback delegated to rank 0",
+                        jax.process_index(), n_proc)
+            return 0
 
     table.header.ensure_filter(LOW_SCORE, "Model score below threshold")
     table.header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
