@@ -95,6 +95,30 @@ def test_encode_revcomp():
     assert fastaio.revcomp("AAGCT") == "AGCTT"
 
 
+def test_fetch_encoded_vectorized_parity(tmp_path):
+    """The whole-contig vectorized encode (raw bytes -> reshape newline
+    strip) must equal encode_seq(fetch(...)) for every line layout: exact
+    multiples, odd tails, single-line contigs, CRLF endings, lowercase."""
+    rng = np.random.default_rng(5)
+    cases = {
+        "exact": ("".join(rng.choice(list("ACGT"), 120)), 60, "\n"),
+        "tail": ("".join(rng.choice(list("ACGT"), 145)), 60, "\n"),
+        "short": ("".join(rng.choice(list("acgtn"), 37)), 60, "\n"),
+        "crlf": ("".join(rng.choice(list("ACGT"), 130)), 50, "\r\n"),
+        "one": ("A", 60, "\n"),
+    }
+    path = tmp_path / "multi.fa"
+    with open(path, "wb") as fh:
+        for name, (seq, width, eol) in cases.items():
+            fh.write(f">{name}\n".encode())
+            for i in range(0, len(seq), width):
+                fh.write((seq[i : i + width] + eol).encode())
+    fr = fastaio.FastaReader(str(path))
+    for name, (seq, _, _) in cases.items():
+        want = fastaio.encode_seq(seq.upper())
+        np.testing.assert_array_equal(fr.fetch_encoded(name), want, err_msg=name)
+
+
 def test_bed_ops(tmp_path):
     bed = tmp_path / "a.bed"
     bed.write_text("chr1\t10\t20\nchr1\t15\t30\nchr1\t40\t50\nchr2\t5\t8\n")

@@ -71,6 +71,57 @@ def test_gather_windows_contig_matches_numpy(rng):
     np.testing.assert_array_equal(rows, expect)
 
 
+def test_featurize_gather_fused_matches_two_step(rng, tmp_path):
+    """Fused gather+featurize == gather_windows -> featurize_windows on a
+    multi-contig table with contig-edge anchors, a missing contig (all-N
+    windows), and an unsorted-contig interleave (mask scatter path)."""
+    from variantcalling_tpu.featurize import (classify_alleles, featurize_gather_fused,
+                                              gather_windows)
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf
+
+    bases = "ACGT"
+    seqs = {"chr1": "".join(rng.choice(list(bases), 3000)),
+            "chr2": "".join(rng.choice(list(bases), 900))}
+    fa = tmp_path / "g.fa"
+    with open(fa, "w") as fh:
+        for c, s in seqs.items():
+            fh.write(f">{c}\n")
+            for i in range(0, len(s), 60):
+                fh.write(s[i : i + 60] + "\n")
+
+    for interleave in (False, True):
+        recs = []
+        for c, length in (("chr1", 3000), ("chr2", 900), ("chrMISSING", 500)):
+            pos = sorted(set([1, 2, length, length - 1] +
+                             [int(p) for p in rng.integers(1, length + 1, 60)]))
+            for p in pos:
+                ref = seqs.get(c, "A" * (length + 1))[p - 1] if c in seqs else "A"
+                alt = bases[(bases.index(ref) + 1) % 4]
+                if rng.random() < 0.3:
+                    alt = ref + alt  # insertion
+                recs.append((c, p, ref, alt))
+        if interleave:
+            recs = recs[::2] + recs[1::2]  # contigs no longer contiguous runs
+        vcf = tmp_path / f"t{int(interleave)}.vcf"
+        with open(vcf, "w") as fh:
+            fh.write("##fileformat=VCFv4.2\n#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n")
+            for c, p, r, a in recs:
+                fh.write(f"{c}\t{p}\t.\t{r}\t{a}\t50\t.\t.\n")
+        table = read_vcf(str(vcf))
+        reader = FastaReader(str(fa))
+        alle = classify_alleles(table)
+        fo = np.asarray([{"A": A, "C": C, "G": G, "T": T}[c] for c in "TGCA"], np.int32)
+        fused = featurize_gather_fused(table, reader, alle, fo)
+        assert fused is not None
+        win = gather_windows(table, reader)
+        two_step = native.featurize_windows(win, CENTER, alle.is_indel, alle.indel_nuc,
+                                            alle.ref_code, alle.alt_code, alle.is_snp, fo)
+        for k in DEVICE_FEATURES:
+            np.testing.assert_array_equal(fused[k], two_step[k],
+                                          err_msg=f"{k} interleave={interleave}")
+
+
 def test_forest_predict_matches_jax_walk(rng):
     """Native walk == predict_score for mean and logit_sum aggregations,
     NaN-right routing without default_left, and default_left routing."""

@@ -306,27 +306,38 @@ def windows_on_device(genome_blocks, block, off, radius: int = WINDOW_RADIUS):
     return jnp.where(valid, vals, 4).astype(jnp.uint8)
 
 
+def _contig_runs(chrom, n: int):
+    """Factorized contig column + contiguous-run bounds (or None).
+
+    Sorted VCFs put each contig in ONE contiguous run, so per-contig work
+    can slice row ranges instead of boolean-masking (a mask pass + scatter
+    costs ~4 full sweeps of a window tensor at 5M variants). Shared by
+    :func:`gather_windows` and :func:`featurize_gather_fused` so the fused
+    fast path and its fallback can never disagree on contig handling.
+    Returns (codes, uniques, bounds) with bounds None when runs are not
+    contiguous (callers fall back to masks)."""
+    import pandas as pd
+
+    codes, uniques = pd.factorize(np.asarray(chrom), use_na_sentinel=False)
+    change = np.flatnonzero(codes[1:] != codes[:-1]) + 1 if n > 1 else np.empty(0, np.int64)
+    contiguous = len(change) == len(uniques) - 1
+    bounds = np.concatenate([[0], change, [n]]) if contiguous else None
+    return codes, uniques, bounds
+
+
 def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW_RADIUS) -> np.ndarray:
     """(N, 2*radius+1) uint8 reference windows centered on each variant anchor.
 
     One contig-sequence encode per contig, then a vectorized gather — the
     host-side analog of the reference's per-record pyfaidx fetches.
     """
-    import pandas as pd
-
     from variantcalling_tpu import native
 
     n = len(table)
     out = np.full((n, 2 * radius + 1), 4, dtype=np.uint8)
-    # hash factorize beats one object-array string compare per contig
-    codes, uniques = pd.factorize(np.asarray(table.chrom), use_na_sentinel=False)
+    codes, uniques, bounds = _contig_runs(table.chrom, n)
+    contiguous = bounds is not None
     pos0 = table.pos - 1
-    # sorted VCFs put each contig in ONE contiguous run: slice instead of
-    # boolean-mask (a mask pass + scatter costs ~4 full sweeps of the
-    # window tensor at 5M variants)
-    change = np.flatnonzero(codes[1:] != codes[:-1]) + 1 if n > 1 else np.empty(0, np.int64)
-    contiguous = len(change) == len(uniques) - 1
-    bounds = np.concatenate([[0], change, [n]]) if contiguous else None
 
     def gather_one(seq, sub, target=None):
         rows = native.gather_windows_contig(seq, sub, radius, out=target)
@@ -354,6 +365,53 @@ def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW
             m = codes == ui
             out[m] = gather_one(seq, pos0[m].astype(np.int64, copy=False))
     return out
+
+
+def featurize_gather_fused(table: VariantTable, fasta: FastaReader, alle,
+                           flow_order: np.ndarray,
+                           radius: int = WINDOW_RADIUS) -> dict | None:
+    """The six window-derived DEVICE_FEATURES columns via the fused native
+    gather+featurize kernel — the (N, 2r+1) window tensor is never
+    materialized (two full sweeps of it saved on the 5M CPU hot path).
+    Mirrors :func:`gather_windows`' contig handling exactly: per-contig
+    contiguous runs when the VCF is sorted, scatter via masks otherwise,
+    contigs missing from the FASTA read as all-N. Returns None when the
+    native kernel is unavailable (caller gathers + featurizes separately).
+    """
+    from variantcalling_tpu import native
+
+    if not native.available():
+        return None
+    n = len(table)
+    outs = (np.empty(n, np.int32), np.empty(n, np.int32), np.empty(n, np.float32),
+            np.empty(n, np.int32), np.empty(n, np.int32), np.empty(n, np.int32))
+    codes, uniques, bounds = _contig_runs(table.chrom, n)
+    contiguous = bounds is not None
+    pos0 = table.pos - 1
+    aux = (alle.is_indel, alle.indel_nuc, alle.ref_code, alle.alt_code, alle.is_snp)
+    empty = np.empty(0, dtype=np.uint8)  # missing contig -> every window all-N
+    for ui, contig in enumerate(uniques):
+        seq = fasta.fetch_encoded(contig) if contig in fasta.references else empty
+        if contiguous:
+            lo, hi = int(bounds[ui]), int(bounds[ui + 1])
+            ok = native.featurize_gather(
+                seq, pos0[lo:hi].astype(np.int64, copy=False), radius,
+                *(a[lo:hi] for a in aux), flow_order,
+                tuple(o[lo:hi] for o in outs))
+        else:
+            m = codes == ui
+            sub_outs = tuple(np.empty(int(m.sum()), o.dtype) for o in outs)
+            ok = native.featurize_gather(
+                seq, pos0[m].astype(np.int64, copy=False), radius,
+                *(a[m] for a in aux), flow_order, sub_outs)
+            if ok:
+                for o, so in zip(outs, sub_outs):
+                    o[m] = so
+        if not ok:
+            return None
+    hl, hn, gc, cy, lm, rm = outs
+    return {"hmer_indel_length": hl, "hmer_indel_nuc": hn, "gc_content": gc,
+            "cycleskip_status": cy, "left_motif": lm, "right_motif": rm}
 
 
 @dataclass

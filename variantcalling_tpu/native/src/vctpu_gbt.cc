@@ -23,6 +23,8 @@
 #include <cstring>
 #include <vector>
 
+#include "vctpu_threads.h"
+
 extern "C" {
 
 // Quantile binning: out[i,j] = searchsorted(edges[j], x[i,j], side='left'),
@@ -37,25 +39,27 @@ int64_t vctpu_bin_features(
     uint8_t* out)          // (n, f)
 {
     if (n < 0 || f <= 0 || n_edges <= 0 || n_edges > 255) return -1;
-    for (int64_t i = 0; i < n; ++i) {
-        const float* row = x + (size_t)i * f;
-        uint8_t* orow = out + (size_t)i * f;
-        for (int32_t j = 0; j < f; ++j) {
-            const float v = row[j];
-            const float* e = edges + (size_t)j * n_edges;
-            if (std::isnan(v)) {
-                orow[j] = (uint8_t)n_edges;
-                continue;
+    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
+        for (int64_t i = r_lo; i < r_hi; ++i) {
+            const float* row = x + (size_t)i * f;
+            uint8_t* orow = out + (size_t)i * f;
+            for (int32_t j = 0; j < f; ++j) {
+                const float v = row[j];
+                const float* e = edges + (size_t)j * n_edges;
+                if (std::isnan(v)) {
+                    orow[j] = (uint8_t)n_edges;
+                    continue;
+                }
+                // branch-light binary search: first index with e[idx] >= v
+                int32_t lo = 0, hi = n_edges;
+                while (lo < hi) {
+                    const int32_t mid = (lo + hi) >> 1;
+                    if (e[mid] < v) lo = mid + 1; else hi = mid;
+                }
+                orow[j] = (uint8_t)lo;
             }
-            // branch-light binary search: first index with e[idx] >= v
-            int32_t lo = 0, hi = n_edges;
-            while (lo < hi) {
-                const int32_t mid = (lo + hi) >> 1;
-                if (e[mid] < v) lo = mid + 1; else hi = mid;
-            }
-            orow[j] = (uint8_t)lo;
         }
-    }
+    });
     return 0;
 }
 
@@ -96,8 +100,10 @@ int64_t vctpu_forest_predict(
 
     // walk two trees concurrently per row: the per-tree pointer chase is
     // a serial dependency chain, so interleaving two independent chains
-    // hides node-load latency (~20% on one core)
-    for (int64_t i = 0; i < n; ++i) {
+    // hides node-load latency (~20% on one core); rows are independent,
+    // so the outer loop shards across threads
+    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
+    for (int64_t i = r_lo; i < r_hi; ++i) {
         const float* row = x + (size_t)i * f;
         float acc = 0.0f;
         int32_t ti = 0;
@@ -144,6 +150,7 @@ int64_t vctpu_forest_predict(
         out[i] = aggregation == 0 ? acc * inv_t
                                   : 1.0f / (1.0f + std::exp(-(acc + base_score)));
     }
+    });
     return 0;
 }
 
@@ -160,9 +167,12 @@ int64_t vctpu_build_matrix(
         if (dtypes[j] < 0 || dtypes[j] > 4) return -2;
     // row-blocked: a full per-column pass would sweep the whole (n, f)
     // matrix f times (≈7 GB of traffic at 5M x 19); per block the output
-    // tile stays L2-resident so the matrix is written once
+    // tile stays L2-resident so the matrix is written once. Row shards
+    // write disjoint ranges, so blocks also spread across threads.
     const int64_t BLOCK = 8192;
-    for (int64_t lo = 0; lo < n; lo += BLOCK) {
+    vctpu::for_shards((n + BLOCK - 1) / BLOCK, vctpu::nthreads(),
+                      [&](int, int64_t b_lo, int64_t b_hi) {
+    for (int64_t lo = b_lo * BLOCK; lo < b_hi * BLOCK && lo < n; lo += BLOCK) {
         const int64_t hi = lo + BLOCK < n ? lo + BLOCK : n;
         for (int32_t j = 0; j < f; ++j) {
             float* dst = out + (size_t)lo * f + j;
@@ -190,6 +200,7 @@ int64_t vctpu_build_matrix(
             }
         }
     }
+    }, 2);
     return 0;
 }
 

@@ -139,6 +139,7 @@ int64_t vctpu_bgzf_inflate(const uint8_t* src, int64_t n, uint8_t* dst, int64_t 
     }
     if (off != n || total > cap) return -1;
     std::atomic<int> failed{0};
+    // blocks are heavyweight (~64KB inflate each): shard at fine grain
     vctpu::for_shards((int64_t)blocks.size(), vctpu::nthreads(),
                       [&](int, int64_t lo, int64_t hi) {
         z_stream zs;
@@ -168,7 +169,7 @@ int64_t vctpu_bgzf_inflate(const uint8_t* src, int64_t n, uint8_t* dst, int64_t 
             if (inflateReset2(&zs, -15) != Z_OK) { failed.store(1); break; }
         }
         inflateEnd(&zs);
-    });
+    }, 16);
     return failed.load() ? -2 : total;
 } catch (...) {
     return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
@@ -222,7 +223,7 @@ int64_t vctpu_bgzf_compress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t
             std::memcpy(h + 22 + deflated, &isize, 4);
             sizes[c] = bsize;
         }
-    });
+    }, 16);
     int64_t out_off = 0;
     for (int64_t c = 0; c < n_chunks; ++c) {
         if (sizes[c] < 0) return -1;

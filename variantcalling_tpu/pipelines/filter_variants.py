@@ -270,22 +270,31 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
         forest_mod.with_feature_order(model, hf.names))
     if nf is None or not native.available():
         return None
-    windows = hf.windows
-    if windows is None:
-        if table is None or fasta is None:
-            return None
-        windows = gather_windows(table, fasta)
+    if hf.windows is None and (table is None or fasta is None):
+        return None
     alle = hf.alle
     fo = np.asarray([{"A": A, "C": C, "G": G, "T": T}[c] for c in flow_order],
                     dtype=np.int32)
-    dev = native.featurize_windows(windows, CENTER, alle.is_indel, alle.indel_nuc,
-                                   alle.ref_code, alle.alt_code, alle.is_snp, fo)
+    dev = None
+    if hf.windows is None:
+        # fused gather+featurize: windows stream out of the encoded contig
+        # without ever materializing the (N, 41) tensor
+        from variantcalling_tpu.featurize import featurize_gather_fused
+
+        dev = featurize_gather_fused(table, fasta, alle, fo)
+    if dev is None:
+        windows = hf.windows if hf.windows is not None else gather_windows(table, fasta)
+        dev = native.featurize_windows(windows, CENTER, alle.is_indel, alle.indel_nuc,
+                                       alle.ref_code, alle.alt_code, alle.is_snp, fo)
     if dev is None:
         return None
     raw = [np.asarray(dev[f] if f in dev else hf.cols[f]) for f in hf.names]
     x = native.build_matrix(raw)
     if x is None:  # unsupported column dtype: numpy assembly
         x = np.stack([c.astype(np.float32, copy=False) for c in raw], axis=1)
+    # no XLA program exists on this path — record that for perf evidence
+    # (bench distinguishes real jit compile from plain warmup by this)
+    forest_mod.last_strategy = "native-cpp"
     return nf(x)
 
 
