@@ -288,6 +288,72 @@ def e2e_5m_pipeline(parent_dir: str) -> dict:
     return out
 
 
+G3_LEN = 3_100_000_000  # hg38-scale genome (BASELINE "30x WGS" operating point)
+G3_CONTIGS = 24
+G3_COV_BP = 1 << 30  # ~1.07 Gbp depth vector for the at-scale coverage reduce
+
+
+def genome3g_pipeline(parent_dir: str) -> dict:
+    """The reference's real operating point: a 3.1 Gbp / 24-contig genome
+    (hg38 scale) under the 5M-variant filter end to end, plus the 1 kb
+    coverage reduction over >1 Gbp of depth, with peak RSS asserted
+    against the reference's >=32 GB machine sizing
+    (/root/reference/docs/howto-callset-filter.md:9). Fails loudly if any
+    stage silently falls back (strategy is recorded from the run)."""
+    import resource
+
+    d = os.path.join(parent_dir, "g3")
+    os.makedirs(d, exist_ok=True)
+    t0 = time.perf_counter()
+    make_fixtures_fast(d, n=5_000_000, genome_len=G3_LEN, n_contigs=G3_CONTIGS)
+    fixture_s = time.perf_counter() - t0
+    print("BENCH_PHASE genome3g fixtures done", flush=True)
+    out = e2e_pipeline(d)
+    out["genome_bp"] = G3_LEN
+    out["n_contigs"] = G3_CONTIGS
+    out["fixture_s"] = round(fixture_s, 1)
+    print("BENCH_PHASE genome3g filter done", flush=True)
+
+    # 30x-shaped coverage reduce over >1 Gbp as ONE jitted program (the
+    # 134 Mbp fixture tiled up: the measured reductions depend on array
+    # scale, not sample draws)
+    import jax
+    import jax.numpy as jnp
+
+    from variantcalling_tpu.ops import coverage as cov
+
+    depth = np.tile(coverage_fixture(), G3_COV_BP // COV_LEN)
+
+    @jax.jit
+    def step(dv):
+        means = cov.binned_mean(dv, COV_WINDOW)
+        hist = cov.depth_histogram(dv)
+        pct = cov.percentiles_from_histogram(hist, jnp.asarray([0.05, 0.25, 0.5, 0.75, 0.95]))
+        return means.sum() + hist.sum() + pct.sum()
+
+    dvec = jax.device_put(depth)
+    float(step(dvec))  # compile
+    t0 = time.perf_counter()
+    checksum = float(step(dvec))
+    cov_dt = time.perf_counter() - t0
+    assert np.isfinite(checksum)
+    out["coverage_1g"] = {"bp": len(depth), "window": COV_WINDOW,
+                          "bp_per_sec": round(len(depth) / cov_dt)}
+    del dvec, depth
+
+    rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20)
+    out["peak_rss_gb"] = round(rss_gb, 2)
+    # the reference sizes the filtering pipeline for a >=32 GB machine;
+    # the whole 3.1 Gbp run (genome resident + 5M callset + 1 Gbp depth)
+    # must fit the same box. On failure the metrics ride inside the
+    # error so the measured record survives the phase machinery.
+    out["rss_under_32gb"] = bool(rss_gb < 32.0)
+    if not out["rss_under_32gb"]:
+        raise AssertionError(
+            f"peak RSS {rss_gb:.1f} GB exceeds the reference's 32 GB sizing: {json.dumps(out)}")
+    return out
+
+
 def train_fixture() -> tuple[np.ndarray, np.ndarray]:
     """One dataset for BOTH the device fit and the sklearn baseline — a
     drifted copy would silently compare different workloads."""
@@ -443,6 +509,9 @@ def child_main(fixture_dir: str) -> None:
     phase("sec", sec_aggregate, min_remaining=25)
     phase("e2e", lambda: e2e_pipeline(fixture_dir), min_remaining=100)
     phase("e2e_5m", lambda: e2e_5m_pipeline(fixture_dir), min_remaining=180)
+    # the at-scale proof needs ~4 min of fixtures+run; only attempted when
+    # the budget clearly allows (standalone: python bench.py --genome3g)
+    phase("genome3g", lambda: genome3g_pipeline(fixture_dir), min_remaining=280)
 
 
 # --------------------------------------------------------------------------
@@ -711,5 +780,12 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         sys.path.insert(0, _REPO)
         child_main(sys.argv[2])
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--genome3g":
+        # standalone at-scale run (the in-budget bench may skip the phase);
+        # caller controls the env (CPU-scrub or real device)
+        sys.path.insert(0, _REPO)
+        with tempfile.TemporaryDirectory(prefix="vctpu_g3_") as d:
+            print(json.dumps({"metric": "genome3g", **genome3g_pipeline(d)}))
         sys.exit(0)
     main()

@@ -1,0 +1,182 @@
+"""Matcher search-cap measurement + dedup-BFS equivalence.
+
+VERDICT r4 flagged the bounded haplotype search as a silent-accuracy
+risk: clusters beyond the caps degrade to exact-only matching with no
+measurement of how often. The caps are now MAX_CLUSTER_VARIANTS=16 /
+MAX_HETS=12 via a dedup-BFS (exact, not approximate, within
+PHASING_BEAM), and MatchResult counts every capped cluster. These tests
+(1) prove the BFS enumerates the same {hapA, hapB} sets as the old
+exhaustive 2^hets search, (2) show a >8-variant cluster that the old
+caps dropped now matches, and (3) measure the fallback rate at germline
+(~1/1000 bp) and dense somatic (~1/150 bp) densities — the dense rate
+must stay under 0.1% of variants.
+"""
+
+import numpy as np
+import pytest
+
+from variantcalling_tpu.comparison import matcher as M
+
+
+def _exhaustive_diploid(side, idx, lo, window):
+    """Reference implementation: all 2^hets masks, explicitly enumerated.
+
+    NOTE: the ORIGINAL production code marked hom edits with the sentinel
+    ``which == 2``, which collided with het slot 2 — in any cluster with
+    >= 3 het edits the third het was silently applied to BOTH haplotypes.
+    This reference uses an unambiguous hom flag (as the production BFS now
+    does), so it checks enumeration strategy, not that old bug.
+    """
+    n_hets, applied = 0, []  # (s0, e0, alt, hom, slot)
+    for k in idx:
+        g = [int(a) for a in side.gt[k] if a >= 0]
+        alleles = sorted({a for a in g if a > 0}) or ([1] if side.alts[k] else [])
+        for ai in alleles:
+            if ai - 1 >= len(side.alts[k]):
+                return None
+            alt = side.alts[k][ai - 1]
+            if alt in (".", "", "*", "<NON_REF>") or alt.startswith("<"):
+                continue
+            s0 = int(side.pos[k]) - lo
+            e0 = s0 + len(side.ref[k])
+            hom = len(g) >= 2 and g.count(ai) == len([a for a in g if a > 0]) and 0 not in g
+            applied.append((s0, e0, alt, hom, None if hom else n_hets))
+            n_hets += not hom
+    if n_hets > 12:
+        return None
+    out = set()
+    for mask in range(1 << n_hets):
+        hap0, hap1 = [], []
+        for s0, e0, alt, hom, slot in applied:
+            if hom:
+                hap0.append((s0, e0, alt))
+                hap1.append((s0, e0, alt))
+            else:
+                (hap0 if (mask >> slot) & 1 == 0 else hap1).append((s0, e0, alt))
+        a = M._apply(window, hap0)
+        b = M._apply(window, hap1)
+        if a is None or b is None:
+            continue
+        out.add(frozenset((a, b)) if a != b else frozenset((a,)))
+    return out or None
+
+
+def _random_side(rng, seq, n, mean_gap, het_frac=0.6):
+    pos, p = [], 100
+    while len(pos) < n:
+        p += 1 + int(rng.exponential(mean_gap))
+        if p > len(seq) - 100:
+            break
+        pos.append(p)
+    refs, alts, gts = [], [], []
+    for p in pos:
+        r = seq[p - 1]
+        if rng.random() < 0.25:  # indel
+            if rng.random() < 0.5:
+                ref, alt = r, r + "ACGT"[rng.integers(4)]
+            else:
+                ref, alt = seq[p - 1 : p + 1 + int(rng.integers(3))], r
+        else:
+            ref, alt = r, "ACGT"[("ACGT".index(r) + 1 + rng.integers(3)) % 4]
+        refs.append(ref)
+        alts.append([alt])
+        gts.append([0, 1] if rng.random() < het_frac else [1, 1])
+    return M.make_side(np.asarray(pos[: len(refs)], np.int64), refs, alts,
+                       np.asarray(gts, np.int8))
+
+
+def test_beam_bfs_equals_exhaustive_enumeration(rng):
+    """Within the old caps the dedup-BFS must produce the exact same
+    {hapA, hapB} sequence sets as the 2^hets enumeration."""
+    seq = "".join(rng.choice(list("ACGT"), 400))
+    checked = 0
+    for _ in range(200):
+        n = int(rng.integers(1, 7))
+        side = _random_side(rng, seq, n, mean_gap=12)
+        if len(side.pos) == 0:
+            continue
+        idx = list(range(len(side.pos)))
+        lo = max(int(side.pos[0]) - 10, 1)
+        hi = max(int(side.pos[i]) + len(side.ref[i]) for i in idx) + 10
+        window = seq[lo - 1 : hi - 1]
+        got, capped = M._diploid_haplotypes(side, idx, lo, window)
+        want = _exhaustive_diploid(side, idx, lo, window)
+        assert not capped
+        assert got == want
+        checked += got is not None
+    assert checked > 50  # the comparison actually exercised real clusters
+
+
+def test_cluster_beyond_old_caps_now_matches(rng):
+    """A 10-variant cluster (old cap: 8) with representation differences
+    matches via the widened search, on both the Python and native paths,
+    with zero fallback."""
+    seq = "".join(rng.choice(list("ACGT"), 300))
+    # 10 het SNVs, 3 bp apart: one cluster of 10 per side
+    pos = np.arange(100, 130, 3, dtype=np.int64)
+    refs = [seq[p - 1] for p in pos]
+    alts = [["ACGT"[("ACGT".index(r) + 1) % 4]] for r in refs]
+    gt = np.asarray([[0, 1]] * len(pos), np.int8)
+    calls = M.make_side(pos, refs, [list(a) for a in alts], gt)
+    # truth: same variants, but the LAST one joined with an extra hom SNV
+    # is absent so exact join fails for it -> haplotype search must engage
+    truth = M.make_side(pos.copy(), list(refs), [list(a) for a in alts], gt.copy())
+    # poison the exact stage: represent every truth SNV padded with its
+    # following reference base (same normalized key is restored by trim);
+    # use an UNNORMALIZED padded form the exact join still resolves --
+    # instead shift representation where trim cannot restore it: turn the
+    # first SNV into an MNP covering two bases with the second base ref
+    truth.ref[0] = seq[int(pos[0]) - 1 : int(pos[0]) + 1]
+    truth.alts[0] = [alts[0][0] + seq[int(pos[0])]]
+    r_py = M._match_contig_py(calls, truth, seq)
+    assert r_py.call_tp.all() and r_py.truth_tp.all()
+    assert r_py.fallback_variants == 0
+    res_nat = M._match_contig_native(calls, truth, seq, True)
+    if res_nat is not None:
+        np.testing.assert_array_equal(res_nat.call_tp, r_py.call_tp)
+        np.testing.assert_array_equal(res_nat.truth_tp, r_py.truth_tp)
+        assert res_nat.fallback_variants == r_py.fallback_variants
+
+
+@pytest.mark.parametrize("mean_gap,max_rate", [(1000, 0.0005), (150, 0.001)])
+def test_fallback_rate_by_density(rng, mean_gap, max_rate):
+    """Exact-only degradation rate at germline (~1/1000 bp) and dense
+    somatic (~1/150 bp) variant densities: < 0.05% / < 0.1% of variants.
+
+    ~15% of sites are representation-divergent (calls carry two adjacent
+    SNVs where truth carries one joined MNP), so residue clusters form at
+    density and the haplotype search genuinely engages — the fallback
+    counters measure the bounded search, not an idle exact join."""
+    genome_len = 2_000_000
+    seq = "".join(rng.choice(list("ACGT"), genome_len))
+    c_pos, c_ref, c_alt, c_gt = [], [], [], []
+    t_pos, t_ref, t_alt, t_gt = [], [], [], []
+    p = 100
+    n_split = 0
+    while True:
+        p += 2 + int(rng.exponential(mean_gap))  # min gap 2: a split site
+        if p > genome_len - 100:                 # consumes p and p+1
+            break
+        r1, r2 = seq[p - 1], seq[p]
+        a1 = "ACGT"[("ACGT".index(r1) + 1 + int(rng.integers(3))) % 4]
+        if rng.random() < 0.15:
+            # calls: two adjacent SNVs; truth: one joined hom MNP record
+            a2 = "ACGT"[("ACGT".index(r2) + 1 + int(rng.integers(3))) % 4]
+            for q, rr, aa in ((p, r1, a1), (p + 1, r2, a2)):
+                c_pos.append(q); c_ref.append(rr); c_alt.append([aa]); c_gt.append([1, 1])
+            t_pos.append(p); t_ref.append(r1 + r2); t_alt.append([a1 + a2]); t_gt.append([1, 1])
+            n_split += 1
+            p += 1  # the pair consumed p+1 too
+        else:
+            gt = [0, 1] if rng.random() < 0.6 else [1, 1]
+            c_pos.append(p); c_ref.append(r1); c_alt.append([a1]); c_gt.append(gt)
+            t_pos.append(p); t_ref.append(r1); t_alt.append([a1]); t_gt.append(list(gt))
+    calls = M.make_side(np.asarray(c_pos, np.int64), c_ref, c_alt, np.asarray(c_gt, np.int8))
+    truth = M.make_side(np.asarray(t_pos, np.int64), t_ref, t_alt, np.asarray(t_gt, np.int8))
+    assert n_split > 50  # the haplotype search is genuinely exercised
+    res = M.match_contig(calls, truth, seq)
+    total = len(calls.pos) + len(truth.pos)
+    rate = res.fallback_variants / total
+    assert rate <= max_rate, (res.fallback_clusters, res.fallback_variants, total)
+    # every divergent representation is rescued; the whole set matches
+    assert res.call_tp.all() and res.truth_tp.all()

@@ -159,3 +159,34 @@ def test_id83_and_dbs78_matrices_from_vcf(tmp_path):
     assert dbs_m.sum() == 2
     assert dbs_m["TC>CA"] == 1  # GA>TG folded
     assert dbs_m["CG>TA"] == 1  # merged adjacent SNVs
+
+
+def test_dbs78_excludes_mnv_runs_of_three_plus(tmp_path):
+    """Runs of >=3 consecutive SNVs are multi-base substitutions under the
+    SigProfilerMatrixGenerator convention: no doublet is greedily carved
+    out of them, and every member is flagged for SBS96 exclusion."""
+    import numpy as np
+
+    from variantcalling_tpu.io.vcf import read_vcf
+    from variantcalling_tpu.reports.signatures import dbs78_matrix
+
+    recs = [
+        ("chr1", 10, "C", "T"), ("chr1", 11, "G", "A"), ("chr1", 12, "A", "C"),  # run of 3
+        ("chr1", 30, "C", "T"), ("chr1", 31, "G", "A"),                          # true doublet
+        ("chr1", 50, "A", "G"),                                                  # lone SNV
+        ("chr2", 5, "C", "T"), ("chr2", 6, "G", "A"),
+        ("chr2", 7, "T", "C"), ("chr2", 8, "A", "G"),                            # run of 4
+    ]
+    lines = ["##fileformat=VCFv4.2", "##contig=<ID=chr1,length=1000>",
+             "##contig=<ID=chr2,length=1000>",
+             "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"]
+    for c, p, r, a in recs:
+        lines.append(f"{c}\t{p}\t.\t{r}\t{a}\t50\tPASS\t.")
+    (tmp_path / "m.vcf").write_text("\n".join(lines) + "\n")
+    table = read_vcf(str(tmp_path / "m.vcf"))
+    dbs, consumed = dbs78_matrix(table, return_paired=True)
+    # only the length-2 run counts as a doublet
+    assert dbs.sum() == 1 and dbs["CG>TA"] == 1
+    # runs of 3 and 4 + the doublet halves are consumed; the lone SNV is not
+    np.testing.assert_array_equal(
+        consumed, [True, True, True, True, True, False, True, True, True, True])

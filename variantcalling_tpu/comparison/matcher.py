@@ -30,8 +30,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-MAX_CLUSTER_VARIANTS = 8  # per side; larger clusters fall back to exact-only
-MAX_HETS = 6  # 2^6 phasings per side, mirrors vcfeval's bounded search
+MAX_CLUSTER_VARIANTS = 16  # per side; larger clusters fall back to exact-only
+MAX_HETS = 12  # het edits per side considered by the phasing search
+#: state cap for the dedup-BFS phasing search (_diploid_haplotypes): states
+#: are UNORDERED partial haplotype pairs deduplicated per step, so the
+#: mask/~mask symmetry plus equal-prefix merges keep real clusters far
+#: below 2^hets; 4096 admits every h <= 13 exactly and more when merges
+#: occur. Overflow falls back to exact-only matching (counted in stats).
+PHASING_BEAM = 4096
 CLUSTER_GAP = 30  # bp between cluster members
 FLANK = 10  # reference padding around a cluster
 
@@ -107,6 +113,12 @@ class MatchResult:
     truth_tp_gt: np.ndarray
     # per-call index of matched truth record (-1 = none) for gt/error columns
     call_truth_idx: np.ndarray
+    # search-cap accounting (allele pass): clusters that degraded to
+    # exact-only because of MAX_CLUSTER_VARIANTS / MAX_HETS / PHASING_BEAM,
+    # and the variants they contained — the silent-accuracy risk VERDICT
+    # r4 flagged is now measurable (see tests/unit/test_matcher_density.py)
+    fallback_clusters: int = 0
+    fallback_variants: int = 0
 
 
 def match_contig(calls: SideVariants, truth: SideVariants, ref_seq: str,
@@ -136,8 +148,9 @@ def _match_contig_native(calls: SideVariants, truth: SideVariants, ref_seq: str,
     )
     if out is None:
         return None
-    call_tp, call_tp_gt, truth_tp, truth_tp_gt, idx = out
-    return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, idx)
+    call_tp, call_tp_gt, truth_tp, truth_tp_gt, idx, stats = out
+    return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, idx,
+                       fallback_clusters=int(stats[0]), fallback_variants=int(stats[1]))
 
 
 def _match_contig_py(calls: SideVariants, truth: SideVariants, ref_seq: str,
@@ -188,6 +201,7 @@ def _match_contig_py(calls: SideVariants, truth: SideVariants, ref_seq: str,
         # skipping stage 3; docs/run_comparison_pipeline.md:78)
         return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
 
+    fb_clusters = fb_variants = 0
     failed: set = set()  # pass-1 clusters that already failed; identical
     # pass-2 clusters (no gt-only members joined) are skipped, not re-searched
     for level in ("allele", "genotype"):
@@ -206,6 +220,9 @@ def _match_contig_py(calls: SideVariants, truth: SideVariants, ref_seq: str,
             if level == "allele":
                 failed.add(ckey)  # removed below on success
             if len(c_idx) > MAX_CLUSTER_VARIANTS or len(t_idx) > MAX_CLUSTER_VARIANTS:
+                if level == "allele":
+                    fb_clusters += 1
+                    fb_variants += len(c_idx) + len(t_idx)
                 continue
             lo = min(min(int(calls.pos[i]) for i in c_idx), min(int(truth.pos[j]) for j in t_idx)) - FLANK
             hi = max(
@@ -214,9 +231,12 @@ def _match_contig_py(calls: SideVariants, truth: SideVariants, ref_seq: str,
             ) + FLANK
             lo = max(lo, 1)
             window = ref_seq[lo - 1 : hi - 1]
-            haps_c = _diploid_haplotypes(calls, c_idx, lo, window)
-            haps_t = _diploid_haplotypes(truth, t_idx, lo, window)
+            haps_c, capped_c = _diploid_haplotypes(calls, c_idx, lo, window)
+            haps_t, capped_t = _diploid_haplotypes(truth, t_idx, lo, window)
             if haps_c is None or haps_t is None:
+                if (capped_c or capped_t) and level == "allele":
+                    fb_clusters += 1
+                    fb_variants += len(c_idx) + len(t_idx)
                 continue
             if haps_c & haps_t:
                 failed.discard(ckey)
@@ -227,7 +247,8 @@ def _match_contig_py(calls: SideVariants, truth: SideVariants, ref_seq: str,
                     truth_tp[j] = True
                     truth_tp_gt[j] = True
 
-    return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx)
+    return MatchResult(call_tp, call_tp_gt, truth_tp, truth_tp_gt, call_truth_idx,
+                       fallback_clusters=fb_clusters, fallback_variants=fb_variants)
 
 
 def match_tables(calls, truth, fasta) -> MatchResult:
@@ -268,6 +289,8 @@ def match_tables(calls, truth, fasta) -> MatchResult:
         res.call_tp_gt[cm] = r.call_tp_gt
         res.truth_tp[tm] = r.truth_tp
         res.truth_tp_gt[tm] = r.truth_tp_gt
+        res.fallback_clusters += r.fallback_clusters
+        res.fallback_variants += r.fallback_variants
         # remap per-contig truth indices to global
         t_global = np.nonzero(tm)[0]
         matched = r.call_truth_idx >= 0
@@ -313,52 +336,79 @@ def _clusters(calls: SideVariants, truth: SideVariants, un_c: np.ndarray, un_t: 
         yield cur_c, cur_t
 
 
+def _extend_hap(hap: tuple[str, int], window: str, s0: int, e0: int, alt: str):
+    """Append one edit to a partial haplotype (built string, consumed-ref
+    position); None on overlap/out-of-window — the incremental equivalent
+    of :func:`_apply`'s validity check."""
+    built, cur = hap
+    if s0 < cur or e0 > len(window) or s0 < 0:
+        return None
+    return (built + window[cur:s0] + alt, e0)
+
+
 def _diploid_haplotypes(side: SideVariants, idx: list[int], lo: int, window: str) -> set | None:
     """All {hap_a, hap_b} sequence pairs over the window, one per phasing.
 
-    Returns None when the phasing space is too large or variants overlap
-    (can't be replayed consistently).
+    Enumerated by a dedup-BFS over sorted edits instead of 2^hets masks:
+    the state set holds UNORDERED partial-haplotype pairs, so the
+    mask/~mask symmetry and equal-prefix merges collapse the space —
+    exact (not approximate) whenever the state count stays within
+    PHASING_BEAM, which covers every cluster the old exhaustive search
+    could do and far larger ones. Returns (pairs, capped): pairs is None
+    when no phasing can be replayed OR the search was capped (MAX_HETS /
+    beam overflow); capped distinguishes the two so callers can count the
+    exact-only degradations.
     """
-    hets = []
-    applied = []  # (start0, end0, alt, which) which: 2=both, 0/1 het slot
+    n_hets = 0
+    applied = []  # (start0, end0, alt, both_haps)
     for k in idx:
         g = [int(a) for a in side.gt[k] if a >= 0]
         alleles = sorted({a for a in g if a > 0}) or ([1] if side.alts[k] else [])
         for ai in alleles:
             if ai - 1 >= len(side.alts[k]):
-                return None
+                return None, False
             alt = side.alts[k][ai - 1]
             if alt in (".", "", "*", "<NON_REF>") or alt.startswith("<"):
                 continue
             s0 = int(side.pos[k]) - lo
             e0 = s0 + len(side.ref[k])
             hom = len(g) >= 2 and g.count(ai) == len([a for a in g if a > 0]) and 0 not in g
-            if hom:
-                applied.append((s0, e0, alt, 2))
+            applied.append((s0, e0, alt, hom))
+            n_hets += not hom
+    if n_hets > MAX_HETS:
+        return None, True
+
+    # sorted edit order == _apply's replay order, so incremental overlap
+    # rejection drops exactly the phasings the exhaustive search dropped
+    applied.sort(key=lambda e: (e[0], e[1], e[2]))
+    states: set = {(("", 0), ("", 0))}
+    for s0, e0, alt, both in applied:
+        new: set = set()
+        for a, b in states:
+            if both:
+                na = _extend_hap(a, window, s0, e0, alt)
+                nb = _extend_hap(b, window, s0, e0, alt)
+                if na is not None and nb is not None:
+                    new.add((na, nb) if na <= nb else (nb, na))
             else:
-                applied.append((s0, e0, alt, len(hets)))
-                hets.append(k)
-    if len(hets) > MAX_HETS:
-        return None
+                na = _extend_hap(a, window, s0, e0, alt)
+                if na is not None:
+                    new.add((na, b) if na <= b else (b, na))
+                nb = _extend_hap(b, window, s0, e0, alt)
+                if nb is not None:
+                    new.add((a, nb) if a <= nb else (nb, a))
+        if not new:
+            return None, False  # no phasing can replay these edits
+        if len(new) > PHASING_BEAM:
+            return None, True  # search capped: caller degrades to exact-only
+        states = new
 
     out = set()
-    for mask in range(1 << len(hets)):
-        hap0, hap1 = [], []
-        ok = True
-        for s0, e0, alt, which in applied:
-            if which == 2:
-                hap0.append((s0, e0, alt))
-                hap1.append((s0, e0, alt))
-            else:
-                target = hap0 if (mask >> which) & 1 == 0 else hap1
-                target.append((s0, e0, alt))
-        a = _apply(window, hap0)
-        b = _apply(window, hap1)
-        if a is None or b is None:
-            ok = False
-        if ok:
-            out.add(frozenset((a, b)) if a != b else frozenset((a,)))
-    return out if out else None
+    for (abuilt, acur), (bbuilt, bcur) in states:
+        a = abuilt + window[acur:]
+        b = bbuilt + window[bcur:]
+        out.add(frozenset((a, b)) if a != b else frozenset((a,)))
+    return (out if out else None), False
 
 
 def _apply(window: str, edits: list[tuple[int, int, str]]) -> str | None:

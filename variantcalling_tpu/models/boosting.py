@@ -92,15 +92,8 @@ def _hist_matmul(binned, boh, gh16, node_id, n_nodes, f, b):
     return hist2[0], hist2[1]
 
 
-def _hist_scatter(binned, g, h, node_id, n_nodes, f, b):
-    """The same histograms via ONE fused segment-sum over (node, feature,
-    bin) ids (scatter-add).
-
-    CPU-only strategy: scatter-add is fast there and skips the big bf16
-    one-hot matmuls, while on TPU it would serialize (the documented ~60x
-    cliff). A single flattened scatter over N*F elements runs ~1.7x
-    faster on XLA CPU than F per-feature segment-sums. Sums accumulate
-    in f32 like the matmul path."""
+def _hist_scatter_slab(binned, g, h, node_id, n_nodes, f, b):
+    """One flat segment-sum over (node, feature, bin) ids for <=SLAB rows."""
     n = binned.shape[0]
     # id = node*(F*B) + feature*B + bin, one flat scatter for all features
     seg = (node_id[:, None] * (f * b) + jnp.arange(f, dtype=jnp.int32) * b
@@ -109,6 +102,45 @@ def _hist_scatter(binned, g, h, node_id, n_nodes, f, b):
     ghs = jax.ops.segment_sum(gh, seg, num_segments=n_nodes * f * b)  # (nodes*F*b, 2)
     ghs = ghs.reshape(n_nodes, f, b, 2)
     return ghs[..., 0], ghs[..., 1]
+
+
+#: rows per scatter slab: the flattened ids + (g,h) broadcast cost
+#: ~12 B * rows * F of temporaries — at 5M x 19 an unchunked scatter
+#: materializes ~1.5 GB; slabs bound it to ~120 MB.
+_SCATTER_SLAB = 1 << 19
+
+
+def _hist_scatter(binned, g, h, node_id, n_nodes, f, b):
+    """The same histograms via fused segment-sums over (node, feature,
+    bin) ids (scatter-add), in bounded row slabs.
+
+    CPU-only strategy: scatter-add is fast there and skips the big bf16
+    one-hot matmuls, while on TPU it would serialize (the documented ~60x
+    cliff). A single flattened scatter over N*F elements runs ~1.7x
+    faster on XLA CPU than F per-feature segment-sums. Sums accumulate
+    in f32 like the matmul path; large N scans over slabs so the
+    flattened temporaries stay ~120 MB regardless of N (padded rows
+    carry g = h = 0, adding exactly nothing)."""
+    n = binned.shape[0]
+    if n <= _SCATTER_SLAB:
+        return _hist_scatter_slab(binned, g, h, node_id, n_nodes, f, b)
+    pad = (-n) % _SCATTER_SLAB
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+        node_id = jnp.pad(node_id, (0, pad))
+    k = (n + pad) // _SCATTER_SLAB
+    slabs = (binned.reshape(k, _SCATTER_SLAB, f), g.reshape(k, _SCATTER_SLAB),
+             h.reshape(k, _SCATTER_SLAB), node_id.reshape(k, _SCATTER_SLAB))
+
+    def body(acc, sl):
+        hg, hh = _hist_scatter_slab(sl[0], sl[1], sl[2], sl[3], n_nodes, f, b)
+        return (acc[0] + hg, acc[1] + hh), None
+
+    init = (jnp.zeros((n_nodes, f, b), g.dtype), jnp.zeros((n_nodes, f, b), h.dtype))
+    (hist_g, hist_h), _ = jax.lax.scan(body, init, slabs)
+    return hist_g, hist_h
 
 
 def _grow_tree(binned, boh, g, h, cfg: BoostConfig, use_matmul: bool = True):
@@ -295,9 +327,15 @@ def fit(
 
         host_binned = native.bin_features(x, np.asarray(edges, dtype=np.float32))
         if host_binned is None:
+            # bin at float32 like the native kernel (and the device path's
+            # f32 features): a float64 comparison against an edge could
+            # land a borderline value one bin off depending on which path
+            # happened to run
+            x32 = np.asarray(x, dtype=np.float32)
+            e32 = np.asarray(edges, dtype=np.float32)
             host_binned = np.empty(x.shape, dtype=np.uint8)
             for j in range(x.shape[1]):
-                host_binned[:, j] = np.searchsorted(edges[j], x[:, j])
+                host_binned[:, j] = np.searchsorted(e32[j], x32[:, j])
 
     # histogram strategy follows the devices the fit actually runs on
     # (mesh > device input > default device), not the process default

@@ -133,7 +133,7 @@ def get_lib() -> ctypes.CDLL | None:
             _i64, _i64p, _u8p, _i64p, _u8p, _i64p, _i8p,
             _i64, _i64p, _u8p, _i64p, _u8p, _i64p, _i8p,
             ctypes.c_int32,
-            _u8p, _u8p, _u8p, _u8p, _i64p,
+            _u8p, _u8p, _u8p, _u8p, _i64p, _i64p,
         ]
         lib.vctpu_cram_pileup.restype = _i64
         lib.vctpu_cram_pileup.argtypes = [
@@ -556,6 +556,7 @@ def match_contig_native(ref_seq: str, c_pos, c_ref, c_alt, c_gt,
     truth_tp = np.zeros(max(nt, 1), dtype=np.uint8)
     truth_tp_gt = np.zeros(max(nt, 1), dtype=np.uint8)
     idx = np.full(max(nc, 1), -1, dtype=np.int64)
+    stats = np.zeros(2, dtype=np.int64)  # capped clusters, variants in them
     rc = lib.vctpu_match_contig(
         seq.ctypes.data_as(_u8p), len(ref_seq),
         nc, cp.ctypes.data_as(_i64p), crb.ctypes.data_as(_u8p), cro.ctypes.data_as(_i64p),
@@ -565,12 +566,12 @@ def match_contig_native(ref_seq: str, c_pos, c_ref, c_alt, c_gt,
         1 if haplotype_rescue else 0,
         call_tp.ctypes.data_as(_u8p), call_tp_gt.ctypes.data_as(_u8p),
         truth_tp.ctypes.data_as(_u8p), truth_tp_gt.ctypes.data_as(_u8p),
-        idx.ctypes.data_as(_i64p),
+        idx.ctypes.data_as(_i64p), stats.ctypes.data_as(_i64p),
     )
     if rc != 0:
         return None
     return (call_tp[:nc].astype(bool), call_tp_gt[:nc].astype(bool),
-            truth_tp[:nt].astype(bool), truth_tp_gt[:nt].astype(bool), idx[:nc])
+            truth_tp[:nt].astype(bool), truth_tp_gt[:nt].astype(bool), idx[:nc], stats)
 
 
 def interval_membership(starts: np.ndarray, ends: np.ndarray, pos: np.ndarray) -> np.ndarray | None:

@@ -20,8 +20,9 @@
 
 namespace vmatch {
 
-static const int MAX_CLUSTER_VARIANTS = 8;  // mirror matcher.py:33-36
-static const int MAX_HETS = 6;
+static const int MAX_CLUSTER_VARIANTS = 16;  // mirror matcher.py caps
+static const int MAX_HETS = 12;
+static const size_t PHASING_BEAM = 4096;  // dedup-BFS state cap (matcher.py)
 static const int64_t CLUSTER_GAP = 30;
 static const int64_t FLANK = 10;
 
@@ -142,15 +143,49 @@ static bool apply_edits(const std::string& window,
     return true;
 }
 
-// matcher.py::_diploid_haplotypes — all {hapA, hapB} pairs over phasings
+// One partial haplotype of the dedup-BFS: sequence built so far + the
+// reference position consumed through (matcher.py::_extend_hap).
+struct PartialHap {
+    std::string built;
+    int64_t cur = 0;
+    bool operator<(const PartialHap& o) const {
+        if (built != o.built) return built < o.built;
+        return cur < o.cur;
+    }
+    bool operator==(const PartialHap& o) const { return built == o.built && cur == o.cur; }
+};
+
+static bool extend_hap(const PartialHap& h, const std::string& window, int64_t s0, int64_t e0,
+                       const std::string& alt, PartialHap& out) {
+    if (s0 < h.cur || e0 > (int64_t)window.size() || s0 < 0) return false;
+    out.built.assign(h.built);
+    out.built.append(window, h.cur, s0 - h.cur);
+    out.built.append(alt);
+    out.cur = e0;
+    return true;
+}
+
+// matcher.py::_diploid_haplotypes — all {hapA, hapB} pairs over phasings,
+// enumerated by a dedup-BFS over sorted edits (unordered partial pairs,
+// deduplicated per step) instead of 2^hets masks. Exact whenever the
+// state count stays within PHASING_BEAM. Returns false with capped=true
+// when the search hit MAX_HETS / the beam (caller counts the exact-only
+// degradation); false with capped=false when no phasing replays.
 static bool diploid_haplotypes(const std::vector<Variant>& side, const std::vector<int>& idx,
                                int64_t lo, const std::string& window,
-                               std::set<std::pair<std::string, std::string>>& out) {
+                               std::set<std::pair<std::string, std::string>>& out,
+                               bool& capped) {
     struct Edit {
         int64_t s0, e0;
         std::string alt;
-        int which;  // 2 = both haps, else het slot
+        bool both;
+        bool operator<(const Edit& o) const {
+            if (s0 != o.s0) return s0 < o.s0;
+            if (e0 != o.e0) return e0 < o.e0;
+            return alt < o.alt;
+        }
     };
+    capped = false;
     std::vector<Edit> applied;
     int n_hets = 0;
     for (int k : idx) {
@@ -177,32 +212,56 @@ static bool diploid_haplotypes(const std::vector<Variant>& side, const std::vect
                 if (a == ai) count_ai++;
             }
             bool hom = (int)g.size() >= 2 && count_ai == nz && !has_ref;
-            if (hom) {
-                applied.push_back({s0, e0, alt, 2});
-            } else {
-                applied.push_back({s0, e0, alt, n_hets});
-                n_hets++;
-            }
+            applied.push_back({s0, e0, alt, hom});
+            if (!hom) n_hets++;
         }
     }
-    if (n_hets > MAX_HETS) return false;
+    if (n_hets > MAX_HETS) {
+        capped = true;
+        return false;
+    }
+    std::sort(applied.begin(), applied.end());
 
-    out.clear();
-    std::string a, b;
-    for (int mask = 0; mask < (1 << n_hets); mask++) {
-        std::vector<std::tuple<int64_t, int64_t, std::string>> hap0, hap1;
-        for (const Edit& e : applied) {
-            if (e.which == 2) {
-                hap0.emplace_back(e.s0, e.e0, e.alt);
-                hap1.emplace_back(e.s0, e.e0, e.alt);
-            } else if (((mask >> e.which) & 1) == 0) {
-                hap0.emplace_back(e.s0, e.e0, e.alt);
+    using State = std::pair<PartialHap, PartialHap>;  // kept ordered (a <= b)
+    std::set<State> states;
+    states.insert({PartialHap{}, PartialHap{}});
+    PartialHap na, nb;
+    for (const Edit& e : applied) {
+        std::set<State> next;
+        for (const State& st : states) {
+            if (e.both) {
+                if (extend_hap(st.first, window, e.s0, e.e0, e.alt, na) &&
+                    extend_hap(st.second, window, e.s0, e.e0, e.alt, nb)) {
+                    if (nb < na) std::swap(na, nb);
+                    next.insert({na, nb});
+                }
             } else {
-                hap1.emplace_back(e.s0, e.e0, e.alt);
+                if (extend_hap(st.first, window, e.s0, e.e0, e.alt, na)) {
+                    nb = st.second;
+                    if (nb < na) std::swap(na, nb);
+                    next.insert({na, nb});
+                }
+                if (extend_hap(st.second, window, e.s0, e.e0, e.alt, nb)) {
+                    na = st.first;
+                    if (nb < na) std::swap(na, nb);
+                    next.insert({na, nb});
+                }
             }
         }
-        if (!apply_edits(window, hap0, a)) continue;
-        if (!apply_edits(window, hap1, b)) continue;
+        if (next.empty()) return false;  // no phasing can replay these edits
+        if (next.size() > PHASING_BEAM) {
+            capped = true;
+            return false;
+        }
+        states.swap(next);
+    }
+
+    out.clear();
+    for (const State& st : states) {
+        std::string a = st.first.built;
+        a.append(window, st.first.cur, window.size() - st.first.cur);
+        std::string b = st.second.built;
+        b.append(window, st.second.cur, window.size() - st.second.cur);
         if (a <= b)
             out.insert({a, b});
         else
@@ -254,7 +313,8 @@ static std::vector<Cluster> make_clusters(const std::vector<Variant>& calls,
 static void match_contig(const std::string& ref_seq, std::vector<Variant>& calls,
                          std::vector<Variant>& truth, uint8_t* call_tp, uint8_t* call_tp_gt,
                          uint8_t* truth_tp, uint8_t* truth_tp_gt, int64_t* call_truth_idx,
-                         bool haplotype_rescue) {
+                         bool haplotype_rescue, int64_t* stats) {
+    stats[0] = stats[1] = 0;  // capped clusters / variants in them (allele pass)
     size_t nc = calls.size(), nt = truth.size();
     std::fill(call_tp, call_tp + nc, 0);
     std::fill(call_tp_gt, call_tp_gt + nc, 0);
@@ -309,8 +369,13 @@ static void match_contig(const std::string& ref_seq, std::vector<Variant>& calls
             if (failed.count(ckey)) continue;
             if (level == 0) failed.insert(ckey);  // removed below on success
             if ((int)cl.c_idx.size() > MAX_CLUSTER_VARIANTS ||
-                (int)cl.t_idx.size() > MAX_CLUSTER_VARIANTS)
+                (int)cl.t_idx.size() > MAX_CLUSTER_VARIANTS) {
+                if (level == 0) {
+                    stats[0] += 1;
+                    stats[1] += (int64_t)cl.c_idx.size() + (int64_t)cl.t_idx.size();
+                }
                 continue;
+            }
             int64_t lo = INT64_MAX, hi = INT64_MIN;
             for (int i : cl.c_idx) {
                 lo = std::min(lo, calls[i].pos);
@@ -329,8 +394,18 @@ static void match_contig(const std::string& ref_seq, std::vector<Variant>& calls
             std::string window = ref_seq.substr(
                 std::min<int64_t>(w_lo, (int64_t)ref_seq.size()), w_hi - w_lo);
             std::set<std::pair<std::string, std::string>> hc, ht;
-            if (!diploid_haplotypes(calls, cl.c_idx, lo, window, hc)) continue;
-            if (!diploid_haplotypes(truth, cl.t_idx, lo, window, ht)) continue;
+            bool cap_c = false, cap_t = false;
+            // both sides always evaluated (python parity: capped_t counts
+            // even when the call side already failed un-capped)
+            bool ok_c = diploid_haplotypes(calls, cl.c_idx, lo, window, hc, cap_c);
+            bool ok_t = diploid_haplotypes(truth, cl.t_idx, lo, window, ht, cap_t);
+            if (!ok_c || !ok_t) {
+                if ((cap_c || cap_t) && level == 0) {
+                    stats[0] += 1;
+                    stats[1] += (int64_t)cl.c_idx.size() + (int64_t)cl.t_idx.size();
+                }
+                continue;
+            }
             bool inter = false;
             for (const auto& p : hc)
                 if (ht.count(p)) {
@@ -394,14 +469,14 @@ int64_t vctpu_match_contig(
     const uint8_t* t_alt_blob, const int64_t* t_alt_offs, const int8_t* t_gt,
     int32_t haplotype_rescue,
     uint8_t* call_tp, uint8_t* call_tp_gt, uint8_t* truth_tp, uint8_t* truth_tp_gt,
-    int64_t* call_truth_idx) {
+    int64_t* call_truth_idx, int64_t* stats) {
     try {
         std::string seq((const char*)ref_seq, ref_len);
         std::vector<vmatch::Variant> calls, truth;
         vmatch::unpack(calls, n_calls, c_pos, c_ref_blob, c_ref_offs, c_alt_blob, c_alt_offs, c_gt);
         vmatch::unpack(truth, n_truth, t_pos, t_ref_blob, t_ref_offs, t_alt_blob, t_alt_offs, t_gt);
         vmatch::match_contig(seq, calls, truth, call_tp, call_tp_gt, truth_tp, truth_tp_gt,
-                             call_truth_idx, haplotype_rescue != 0);
+                             call_truth_idx, haplotype_rescue != 0, stats);
         return 0;
     } catch (...) {
         return -1;

@@ -260,11 +260,14 @@ def classify_doublet_dbs78(ref: str, alt: str) -> str | None:
 def dbs78_matrix(table, return_paired: bool = False):
     """78-channel doublet counts from a VariantTable: explicit 2-bp MNP
     records plus ADJACENT SNV pairs merged into doublets (the
-    SigProfilerMatrixGenerator convention).
+    SigProfilerMatrixGenerator convention). Runs of >=3 consecutive SNVs
+    are multi-base substitutions under that convention — they enter
+    NEITHER catalog (no greedy doublet + leftover-SBS split).
 
     ``return_paired=True`` additionally returns the boolean mask of SNV
-    records consumed as doublet halves — callers exclude them from the
-    SBS96 matrix so each mutation is counted in exactly one catalog."""
+    records consumed as doublet halves or longer-MNV members — callers
+    exclude them from the SBS96 matrix so each mutation is counted in
+    exactly one catalog (or none, for >=3-runs)."""
     labels = dbs78_labels()
     idx = {l: i for i, l in enumerate(labels)}
     counts = np.zeros(78, dtype=np.int64)
@@ -282,22 +285,29 @@ def dbs78_matrix(table, return_paired: bool = False):
                 counts[idx[ch]] += 1
         elif len(r) == 1 and len(a) == 1 and r in "ACGT" and a in "ACGT":
             is_snv[i] = True
-    # adjacent SNV pairs (sorted input): greedy left-to-right pairing
+    # maximal runs of adjacent SNVs (sorted input): length 2 -> doublet,
+    # length >=3 -> multi-base substitution, excluded from both catalogs
     paired = np.zeros(n, dtype=bool)
     i = 0
-    while i < n - 1:
-        j = i + 1
-        if (is_snv[i] and is_snv[j] and chrom[i] == chrom[j]
-                and int(pos[j]) == int(pos[i]) + 1):
+    while i < n:
+        if not is_snv[i]:
+            i += 1
+            continue
+        j = i
+        while (j + 1 < n and is_snv[j + 1] and chrom[j + 1] == chrom[j]
+               and int(pos[j + 1]) == int(pos[j]) + 1):
+            j += 1
+        run = j - i + 1
+        if run == 2:
             ch = classify_doublet_dbs78(
-                (refs[i] + refs[j]).upper(),
-                (alts[i].split(",")[0] + alts[j].split(",")[0]).upper())
+                (refs[i] + refs[i + 1]).upper(),
+                (alts[i].split(",")[0] + alts[i + 1].split(",")[0]).upper())
             if ch is not None:
                 counts[idx[ch]] += 1
-                paired[i] = paired[j] = True
-            i += 2
-            continue
-        i += 1
+                paired[i] = paired[i + 1] = True
+        elif run >= 3:
+            paired[i : j + 1] = True  # consumed by the MNV, counted nowhere
+        i = j + 1
     series = pd.Series(counts, index=labels, name="size")
     return (series, paired) if return_paired else series
 
