@@ -212,10 +212,12 @@ def comp_header_block() -> bytes:
 
 def write_cram(path: str, sam_header: str, records: list[dict],
                method: int = RAW, slice_start: int = 1) -> None:
-    """records: {flag, pos (1-based), read_len, mapq, name, features}.
+    """records: {flag, pos (1-based), read_len, mapq, name, features, quals}.
 
     features: list of (code:str, read_pos:int, payload) where payload is an
-    int for D/RS/PD/HC/BS, bytes for IN/SC.
+    int for D/RS/PD/HC/BS/Q, bytes for IN/SC, (base, qual) for B.
+    quals: optional list of read_len phred ints -> stored as a full quality
+    array (CF bit 0x1), the htslib-written layout `-q` depth filters read.
     """
     streams: dict[str, bytearray] = {k: bytearray() for k in IDS}
 
@@ -228,8 +230,9 @@ def write_cram(path: str, sam_header: str, records: list[dict],
     last_pos = slice_start
     n_bases = 0
     for i, r in enumerate(records):
+        quals = r.get("quals")
         put_int("BF", r.get("flag", 0))
-        put_int("CF", 0)  # not detached, no mate downstream, no qual array
+        put_int("CF", 1 if quals is not None else 0)
         put_int("RL", r["read_len"])
         n_bases += r["read_len"]
         put_int("AP", r["pos"] - last_pos)
@@ -261,12 +264,23 @@ def write_cram(path: str, sam_header: str, records: list[dict],
                     streams["SC"] += bytes(payload) + b"\t"
                 elif code == "i":
                     put_byte("BA", payload)
+                elif code == "B":
+                    put_byte("BA", payload[0])
+                    put_byte("QS", payload[1])
+                elif code == "Q":
+                    put_byte("QS", payload)
                 else:
                     raise ValueError(code)
             put_int("MQ", r.get("mapq", 60))
+            if quals is not None:
+                for q in quals:
+                    put_byte("QS", q)
         else:
             for _ in range(r["read_len"]):
                 put_byte("BA", ord("N"))
+            if quals is not None:
+                for q in quals:
+                    put_byte("QS", q)
 
     ext_blocks = b""
     used_ids = []

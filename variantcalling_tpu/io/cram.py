@@ -12,18 +12,21 @@ Base-level pileup (fingerprinting) is served by the decoder's
 reconstruction path: native.cram_pileup rebuilds aligned bases from the
 reference + SM substitution matrix (comparison/pileup_caller).
 
+Depth runs feature-aware in the decoder (native.cram_depth): per-base
+quality filtering (``-q``) applies to aligned read bases from the
+record's quality array (missing qualities pass, as samtools treats '*'),
+deletions cover iff ``-J``, and N (reference-skip) ops never cover —
+full samtools-depth parity with the BAM walker.
+
 Limitations (explicit, raised or logged — never silent): CRAM 3.1 codecs
-and bzip2/lzma blocks are unsupported; per-base-quality depth filtering
-(-q) is not applied to CRAM inputs; N (reference-skip) ops count toward
-the depth span (DNA pipelines — this framework's domain — do not emit N
-ops).
+and bzip2/lzma blocks are unsupported.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from variantcalling_tpu import logger, native
+from variantcalling_tpu import native
 from variantcalling_tpu.io.bam import EXCLUDE_FLAGS, BamHeader
 
 
@@ -81,39 +84,38 @@ def depth_diff_arrays(
     include_deletions: bool = True,
     regions: list[str] | None = None,
 ) -> tuple[BamHeader, dict[str, np.ndarray]]:
-    """CRAM counterpart of io.bam.depth_diff_arrays (same contract).
-
-    ``include_deletions`` matches -J semantics at the span level: the CRAM
-    record span already covers D/N ops; without -J per-op splitting would
-    need feature-level spans (the decoder folds them into one span), so the
-    flag only logs when it would differ.
-    """
-    if min_bq > 0:
-        logger.warning("CRAM depth: per-base-quality filter (-q %d) not applied to CRAM inputs",
-                       min_bq)
-    if not include_deletions:
-        logger.warning("CRAM depth: spans include deletions (samtools depth -J semantics)")
-    header, recs = cram_records(path)
+    """CRAM counterpart of io.bam.depth_diff_arrays (same contract,
+    including the per-base ``-q`` filter — the decoder walks alignment
+    features with the record's quality array, so CRAM and BAM depth agree
+    on mixed-quality data)."""
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    header = header_from_buffer(buf, path)
     region_contigs = {r.split(":")[0] for r in regions} if regions else None
 
-    keep = (recs["flags"] & EXCLUDE_FLAGS) == 0
-    keep &= recs["ref_id"] >= 0
-    keep &= recs["mapq"] >= min_mapq
-    keep &= recs["read_len"] >= min_read_length
-    ref_id = recs["ref_id"][keep]
-    start0 = recs["pos"][keep] - 1  # CRAM positions are 1-based
-    span = np.maximum(recs["span"][keep], 0)
-
-    diffs: dict[str, np.ndarray] = {}
+    starts = np.full(len(header.references), -1, dtype=np.int64)
+    lens = np.zeros(len(header.references), dtype=np.int64)
+    off = 0
     for rid, name in enumerate(header.references):
         if region_contigs is not None and name not in region_contigs:
             continue
-        m = ref_id == rid
-        diff = np.zeros(header.lengths[name] + 1, dtype=np.int32)
-        if m.any():
-            s = np.clip(start0[m], 0, len(diff) - 1)
-            e = np.clip(start0[m] + span[m], 0, len(diff) - 1)
-            np.add.at(diff, s, 1)
-            np.add.at(diff, e, -1)
-        diffs[name] = diff
+        starts[rid] = off
+        lens[rid] = header.lengths[name]
+        off += header.lengths[name] + 1
+    diff_flat = np.zeros(max(off, 1), dtype=np.int32)
+    n = native.cram_depth(
+        buf, starts, lens, diff_flat,
+        min_bq=min_bq, min_mapq=min_mapq, min_read_length=min_read_length,
+        include_deletions=include_deletions, exclude_flags=EXCLUDE_FLAGS,
+    )
+    if n is None or n < 0:
+        raise ValueError(
+            f"cannot decode CRAM records of {path}: unsupported codec or "
+            "malformed stream (supported: CRAM 3.0, raw/gzip/rANS blocks)"
+        )
+    diffs: dict[str, np.ndarray] = {}
+    for rid, name in enumerate(header.references):
+        if starts[rid] < 0:
+            continue
+        diffs[name] = diff_flat[starts[rid] : starts[rid] + header.lengths[name] + 1]
     return header, diffs

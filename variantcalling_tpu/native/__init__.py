@@ -143,6 +143,11 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_cram_scan.argtypes = [
             _u8p, _i64, _i64, _i32p, _i64p, _i32p, _i32p, _i32p, _i32p,
         ]
+        lib.vctpu_cram_depth.restype = _i64
+        lib.vctpu_cram_depth.argtypes = [
+            _u8p, _i64, _i64p, _i64p, ctypes.c_int32, _i32p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
+        ]
         lib.vctpu_vcf_count.restype = _i64
         lib.vctpu_vcf_count.argtypes = [_u8p, _i64, _i64p]
         _f32p = ctypes.POINTER(ctypes.c_float)
@@ -497,6 +502,37 @@ def cram_scan(buf, max_records: int) -> dict | None:
     if n < 0:
         return None
     return {k: v[:n] for k, v in out.items()}
+
+
+def cram_depth(
+    buf,
+    contig_starts: np.ndarray,
+    contig_lens: np.ndarray,
+    diff_flat: np.ndarray,
+    *,
+    min_bq: int = 0,
+    min_mapq: int = 0,
+    min_read_length: int = 0,
+    include_deletions: bool = True,
+    exclude_flags: int = 0x704,
+) -> int | None:
+    """Accumulate samtools-depth-semantics diffs over a CRAM buffer (the
+    CRAM twin of :func:`bam_depth`, including the per-base ``-q`` filter);
+    None when unavailable, negative handled by the caller."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    starts = np.ascontiguousarray(contig_starts, dtype=np.int64)
+    lens = np.ascontiguousarray(contig_lens, dtype=np.int64)
+    assert diff_flat.dtype == np.int32 and diff_flat.flags["C_CONTIGUOUS"]
+    src_arr = np.ascontiguousarray(_u8view(buf))
+    n = lib.vctpu_cram_depth(
+        src_arr.ctypes.data_as(_u8p), len(src_arr),
+        starts.ctypes.data_as(_i64p), lens.ctypes.data_as(_i64p), len(starts),
+        diff_flat.ctypes.data_as(_i32p),
+        min_bq, min_mapq, min_read_length, int(include_deletions), exclude_flags,
+    )
+    return int(n)
 
 
 def cram_pileup(buf, target_ref: int, start0: int, end0: int, ref_seq: str) -> np.ndarray | None:

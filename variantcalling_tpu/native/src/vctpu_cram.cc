@@ -502,6 +502,22 @@ struct PileupCtx {
     int32_t* counts;  // (end0-start0, 4) row-major
 };
 
+// depth accumulation target: per-contig difference arrays with samtools
+// depth -a -J -q -Q -l semantics — the CRAM twin of vctpu_bam_depth.
+// Aligned (read-backed) positions pass the per-base quality filter from
+// the record's quality array (missing qualities read as 0xFF = pass, as
+// samtools treats '*' quals); deletions cover iff include_del; N
+// (reference skips) never cover.
+struct DepthCtx {
+    const int64_t* contig_starts;  // per ref_id offset into diff_flat, -1 skip
+    const int64_t* contig_lens;
+    int32_t n_refs;
+    int32_t* diff_flat;
+    int32_t min_bq, min_mapq, min_len;
+    int32_t include_del;
+    uint32_t exclude_flags;
+};
+
 static inline int base_code(uint8_t ch) {
     switch (ch) {
         case 'A': case 'a': return 0;
@@ -613,7 +629,8 @@ static bool get_enc(const CompHeader& h, const char* k, Encoding& e) {
 // decode all records of one slice; returns count or -1
 static int64_t decode_slice(const CompHeader& h, int container_ref,
                             const std::vector<Block>& blocks, RecOut out, int64_t out_off,
-                            int64_t max_records, PileupCtx* pc = nullptr) {
+                            int64_t max_records, PileupCtx* pc = nullptr,
+                            DepthCtx* dc = nullptr) {
     // slice header is blocks[0]
     Cursor sh{blocks[0].data.data(), blocks[0].data.data() + blocks[0].data.size()};
     int32_t slice_ref = sh.itf8();
@@ -656,6 +673,10 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
 
     int64_t last_pos = slice_start;
     std::vector<uint8_t> scratch;
+    // depth bookkeeping (hoisted: cleared per record)
+    struct Seg { int64_t ref_start, read_start, len; int kind; };  // kind 1 = deletion
+    std::vector<Seg> segs;
+    std::vector<uint8_t> squal;
     for (int32_t r = 0; r < n_records; r++) {
         if (out_off + r >= max_records) return -4;  // caller grows the buffers
         int32_t bf, cf, ri = container_ref, rl, ap, v;
@@ -708,18 +729,34 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
             // base reconstruction for pileup: bases between features match
             // the reference; X applies the SM substitution matrix
             bool do_pile = pc != nullptr && ri == pc->target_ref && (bf & 0x704) == 0;
+            bool do_depth = dc != nullptr && ri >= 0 && ri < dc->n_refs &&
+                            dc->contig_starts[ri] >= 0 &&
+                            ((uint32_t)bf & dc->exclude_flags) == 0 && rl >= dc->min_len;
+            const bool want_q = do_depth && dc->min_bq > 0;
+            const bool track = do_pile || do_depth;
+            segs.clear();
+            if (want_q) squal.assign((size_t)rl, 0xFF);  // missing quals pass -q
             int64_t fabs_pos = 0;  // absolute 1-based in-read feature position
             int64_t rcur = 1;      // next read position to emit
             int64_t refp = pos;    // its reference position (1-based)
             auto ref_char = [&](int64_t p1) -> int {
                 return (p1 >= 1 && p1 <= pc->ref_len) ? base_code(pc->ref_seq[p1 - 1]) : 4;
             };
+            auto set_q = [&](int64_t read_pos1, uint8_t q) {
+                if (want_q && read_pos1 >= 1 && read_pos1 <= rl) squal[read_pos1 - 1] = q;
+            };
+            auto aligned_run = [&](int64_t n) {  // n read bases consuming ref
+                if (n <= 0) return;
+                if (do_depth) segs.push_back({refp, rcur, n, 0});
+                rcur += n;
+                refp += n;
+            };
             auto emit_matches = [&](int64_t upto) {
-                while (rcur < upto) {
-                    pileup_add(pc, refp, ref_char(refp));
-                    rcur++;
-                    refp++;
-                }
+                int64_t n = upto - rcur;
+                if (n <= 0) return;
+                if (do_pile)
+                    for (int64_t t = 0; t < n; t++) pileup_add(pc, refp + t, ref_char(refp + t));
+                aligned_run(n);
             };
             for (int32_t f = 0; f < fn; f++) {
                 uint8_t fc;
@@ -727,17 +764,15 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
                 if (!decode_byte(eFC, s, fc)) return -1;
                 if (!decode_int(eFP, s, fp)) return -1;
                 fabs_pos += fp;
-                if (do_pile) emit_matches(fabs_pos);
+                if (track) emit_matches(fabs_pos);
                 uint8_t bb;
                 switch (fc) {
                     case 'B':
                         if (!hBA || !decode_byte(eBA, s, bb)) return -1;
-                        if (do_pile) {
-                            pileup_add(pc, refp, base_code(bb));
-                            rcur++;
-                            refp++;
-                        }
+                        if (do_pile) pileup_add(pc, refp, base_code(bb));
+                        if (track) aligned_run(1);
                         if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                        set_q(fabs_pos, bb);
                         break;
                     case 'X':
                         if (!hBS || !decode_int(eBS, s, v)) return -1;
@@ -745,34 +780,35 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
                             int rc = ref_char(refp);
                             int alt = rc < 4 ? h.sub[rc][v & 3] : 4;
                             pileup_add(pc, refp, alt);
-                            rcur++;
-                            refp++;
                         }
+                        if (track) aligned_run(1);
                         break;
                     case 'I':
                         if (!hIN || !decode_byte_array(eIN, s, scratch)) return -1;
                         ins += (int32_t)scratch.size();
-                        if (do_pile) rcur += (int64_t)scratch.size();
+                        if (track) rcur += (int64_t)scratch.size();
                         break;
                     case 'S':
                         if (!hSC || !decode_byte_array(eSC, s, scratch)) return -1;
                         soft += (int32_t)scratch.size();
-                        if (do_pile) rcur += (int64_t)scratch.size();
+                        if (track) rcur += (int64_t)scratch.size();
                         break;
                     case 'D':
                         if (!hDL || !decode_int(eDL, s, v)) return -1;
                         dels += v;
-                        if (do_pile) refp += v;
+                        if (do_depth && dc->include_del && v > 0)
+                            segs.push_back({refp, rcur, v, 1});
+                        if (track) refp += v;
                         break;
                     case 'i':
                         if (!hBA || !decode_byte(eBA, s, bb)) return -1;
                         ins += 1;
-                        if (do_pile) rcur += 1;
+                        if (track) rcur += 1;
                         break;
-                    case 'N':
+                    case 'N':  // reference skip: never covers (samtools parity)
                         if (!hRS || !decode_int(eRS, s, v)) return -1;
                         skips += v;
-                        if (do_pile) refp += v;
+                        if (track) refp += v;
                         break;
                     case 'P':
                         if (!hPD || !decode_int(ePD, s, v)) return -1;
@@ -783,31 +819,66 @@ static int64_t decode_slice(const CompHeader& h, int container_ref,
                         break;
                     case 'Q':
                         if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                        set_q(fabs_pos, bb);
                         break;
                     case 'b':
                         if (!hBB || !decode_byte_array(eBB, s, scratch)) return -1;
-                        if (do_pile) {
-                            for (uint8_t sb : scratch) {
-                                pileup_add(pc, refp, base_code(sb));
-                                rcur++;
-                                refp++;
-                            }
-                        }
+                        if (do_pile)
+                            for (size_t t = 0; t < scratch.size(); t++)
+                                pileup_add(pc, refp + (int64_t)t, base_code(scratch[t]));
+                        if (track) aligned_run((int64_t)scratch.size());
                         break;
                     case 'q':
                         if (!hQQ || !decode_byte_array(eQQ, s, scratch)) return -1;
+                        for (size_t t = 0; t < scratch.size(); t++)
+                            set_q(fabs_pos + (int64_t)t, scratch[t]);
                         break;
                     default:
                         return -1;
                 }
             }
-            if (do_pile) emit_matches((int64_t)rl + 1);
+            if (track) emit_matches((int64_t)rl + 1);
             span = rl - soft - ins + dels + skips;
             if (!hMQ || !decode_int(eMQ, s, mapq)) return -1;
             if (cf & 0x1) {  // quality scores stored as array
                 for (int32_t q = 0; q < rl; q++) {
                     uint8_t bb;
                     if (!hQS || !decode_byte(eQS, s, bb)) return -1;
+                    if (want_q) squal[q] = bb;
+                }
+            }
+            if (do_depth && mapq >= dc->min_mapq) {
+                const int64_t base = dc->contig_starts[ri];
+                const int64_t clen = dc->contig_lens[ri];
+                for (const Seg& sg : segs) {
+                    const int64_t ref0 = sg.ref_start - 1;  // 0-based
+                    if (ref0 >= clen) continue;
+                    if (sg.kind == 1 || dc->min_bq <= 0) {
+                        const int64_t s0 = ref0 < 0 ? 0 : ref0;
+                        const int64_t e0 = std::min(ref0 + sg.len, clen);
+                        if (e0 > s0) {
+                            dc->diff_flat[base + s0] += 1;
+                            dc->diff_flat[base + e0] -= 1;
+                        }
+                    } else {
+                        // RLE (qual >= min_bq) into diff updates, clamped by
+                        // contig and quality-array bounds (vctpu_bam_depth
+                        // run-length semantics)
+                        int64_t run_s = -1;
+                        int64_t max_j = std::min(sg.len, clen - ref0);
+                        max_j = std::min(max_j, (int64_t)squal.size() - (sg.read_start - 1));
+                        for (int64_t j = 0; j <= max_j; j++) {
+                            bool okq = j < max_j && ref0 + j >= 0 &&
+                                       (int32_t)squal[sg.read_start - 1 + j] >= dc->min_bq;
+                            if (okq && run_s < 0) {
+                                run_s = j;
+                            } else if (!okq && run_s >= 0) {
+                                dc->diff_flat[base + ref0 + run_s] += 1;
+                                dc->diff_flat[base + ref0 + j] -= 1;
+                                run_s = -1;
+                            }
+                        }
+                    }
                 }
             }
         } else {  // unmapped: bases then quals
@@ -915,7 +986,8 @@ int64_t vctpu_cram_count(const uint8_t* buf, int64_t len) {
 static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_records,
                               int32_t* ref_id, int64_t* pos, int32_t* span, int32_t* mapq,
                               int32_t* flags, int32_t* read_len,
-                              cram::PileupCtx* pctx = nullptr) {
+                              cram::PileupCtx* pctx = nullptr,
+                              cram::DepthCtx* dctx = nullptr) {
     using namespace cram;
     if (len < 26 || memcmp(buf, "CRAM", 4) != 0) return -1;
     if (buf[4] != 3) return -2;
@@ -955,12 +1027,18 @@ static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_recor
             c = Cursor{body + cont_len, buf + len};
             continue;
         }
-        // pileup-only walks skip single-ref containers off the target contig
-        // wholesale — per-region fingerprinting must not decode the genome
+        // pileup/depth-only walks skip single-ref containers whose contig
+        // contributes nothing — per-region work must not decode the genome
         // (multi-ref containers, ref == -2, still decode)
-        if (pctx != nullptr && ref_id == nullptr && ref >= 0 && ref != pctx->target_ref) {
-            c = Cursor{body + cont_len, buf + len};
-            continue;
+        if (ref_id == nullptr && ref >= 0) {
+            bool skip = pctx != nullptr && dctx == nullptr && ref != pctx->target_ref;
+            if (dctx != nullptr && pctx == nullptr &&
+                (ref >= dctx->n_refs || dctx->contig_starts[ref] < 0))
+                skip = true;
+            if (skip) {
+                c = Cursor{body + cont_len, buf + len};
+                continue;
+            }
         }
         Cursor cc{body, body + cont_len};
         Block chb;
@@ -986,7 +1064,7 @@ static int64_t cram_scan_impl(const uint8_t* buf, int64_t len, int64_t max_recor
                 blocks.push_back(std::move(db));
             }
             RecOut out{ref_id, pos, span, mapq, flags, read_len};
-            int64_t n = decode_slice(h, ref, blocks, out, total, max_records, pctx);
+            int64_t n = decode_slice(h, ref, blocks, out, total, max_records, pctx, dctx);
             if (n < 0) return n == -4 ? -4 : -1;
             total += n;
         }
@@ -1021,6 +1099,28 @@ int64_t vctpu_cram_pileup(const uint8_t* buf, int64_t len, int32_t target_ref,
         cram::PileupCtx ctx{target_ref, start0, end0, ref_seq, ref_len, counts};
         return cram_scan_impl(buf, len, INT64_MAX, nullptr, nullptr, nullptr, nullptr,
                               nullptr, nullptr, &ctx);
+    } catch (...) {
+        return -1;
+    }
+}
+
+// Per-contig depth difference arrays with samtools depth -a -J -q -Q -l
+// semantics (the CRAM twin of vctpu_bam_depth; reference call site
+// coverage_analysis.py:674-678 — the `-q` base-quality filter applies to
+// aligned read bases from the record's quality array, deletions cover iff
+// include_del, N skips never cover). diff_flat holds the selected contigs
+// back to back; contig_starts[ref_id] is that contig's (length+1)-long
+// region offset or -1 to skip. Returns records seen, negative on error.
+int64_t vctpu_cram_depth(const uint8_t* buf, int64_t len,
+                         const int64_t* contig_starts, const int64_t* contig_lens,
+                         int32_t n_refs, int32_t* diff_flat,
+                         int32_t min_bq, int32_t min_mapq, int32_t min_len,
+                         int32_t include_del, uint32_t exclude_flags) {
+    try {
+        cram::DepthCtx ctx{contig_starts, contig_lens, n_refs, diff_flat,
+                           min_bq, min_mapq, min_len, include_del, exclude_flags};
+        return cram_scan_impl(buf, len, INT64_MAX, nullptr, nullptr, nullptr, nullptr,
+                              nullptr, nullptr, nullptr, &ctx);
     } catch (...) {
         return -1;
     }
