@@ -5,10 +5,12 @@ claim leg (any process importing jax under the default PYTHONPATH blocks at
 interpreter start with zero output).  The wedge is environmental, but the
 *evidence* protocol is ours: this loop probes the tunnel cheaply every
 ~30 min for the whole round, appends every outcome to ``TPU_PROBE_LOG.md``
-(committed), and on the FIRST successful probe immediately runs the full
-``bench.py`` against the real device, writes ``BENCH_TPU.json``, and
-commits both.  Either the round ends with a captured TPU bench, or with a
-timestamped log proving the tunnel stayed wedged the entire time.
+(committed), and on the FIRST successful probe captures in two stages:
+``bench.py --tpu-only`` (<5 min, device phases only — a brief recovery
+window still lands a chip number) committed immediately, then the full
+``bench.py`` upgrading ``BENCH_TPU.json`` if the tunnel holds.  Either
+the round ends with a captured TPU bench, or with a timestamped log
+proving the tunnel stayed wedged the entire time.
 
 Safety rules (see docs/perf_notes.md):
 - exactly ONE TPU-touching child at a time (probe and bench are serialized
@@ -47,8 +49,10 @@ def _log(line: str) -> None:
         with open(LOG, "w") as fh:
             fh.write("# TPU probe log\n\n"
                      "One line per probe of the axon TPU tunnel (cheap device-init "
-                     "child, 130s deadline). On first success the full `bench.py` "
-                     "runs on the real chip and lands in `BENCH_TPU.json`.\n\n")
+                     "child, 130s deadline). On first success `bench.py --tpu-only` "
+                     "captures a fast chip number into `BENCH_TPU.json` (committed "
+                     "immediately), then the full `bench.py` upgrades it if the "
+                     "tunnel holds.\n\n")
     with open(LOG, "a") as fh:
         fh.write(line.rstrip() + "\n")
 
@@ -88,32 +92,47 @@ def probe_once() -> tuple[bool, str]:
 
 
 def run_bench_and_commit(probe_detail: str) -> bool:
-    _log(f"- {_now()} — **PROBE OK** ({probe_detail}); running full bench "
-         f"(deadline {BENCH_TIMEOUT_S}s)")
-    env = dict(os.environ)
-    env["VCTPU_BENCH_TIMEOUT"] = "720"
-    rc, out, err = _run_group([sys.executable, "bench.py"], BENCH_TIMEOUT_S, env=env)
-    line = next((l for l in out.splitlines() if l.strip().startswith("{")), None)
-    if line is None:
-        _log(f"- {_now()} — bench produced no JSON (rc={rc}); stderr tail: "
-             f"`{(err or '')[-200:].strip()}`")
-        return False
-    try:
-        parsed = json.loads(line)
-    except json.JSONDecodeError:
-        _log(f"- {_now()} — bench JSON unparsable (rc={rc})")
-        return False
-    device = str(parsed.get("device", "?"))
-    tpu_side = "tpu" in device.lower()
-    with open(BENCH_OUT, "w") as fh:
-        json.dump({"captured_at": _now(), "probe": probe_detail,
-                   "on_tpu": tpu_side, "result": parsed}, fh, indent=1)
-        fh.write("\n")
-    _log(f"- {_now()} — bench done: device=`{device}` value={parsed.get('value')} "
-         f"{parsed.get('unit', '')} vs_baseline={parsed.get('vs_baseline')} → "
-         f"`BENCH_TPU.json`")
-    _commit(f"Capture {'TPU' if tpu_side else 'post-probe'} bench via probe loop")
-    return tpu_side
+    """Two-stage capture: `bench.py --tpu-only` first (<5 min, device
+    phases only — a brief tunnel-recovery window still lands a chip
+    number), committed immediately; then the full bench upgrades the
+    artifact if the tunnel holds."""
+    captured = False
+    # tpu-only worst case: fixtures + 280s child timeout + parent sklearn
+    # headline baseline — 420s covers it so a mid-run re-wedge still
+    # yields the child's partial JSON instead of a SIGKILLed parent
+    for label, args, deadline in (("tpu-only", ["--tpu-only"], 420),
+                                  ("full", [], BENCH_TIMEOUT_S)):
+        _log(f"- {_now()} — **PROBE OK** ({probe_detail}); running {label} bench "
+             f"(deadline {deadline}s)")
+        env = dict(os.environ)
+        env["VCTPU_BENCH_TIMEOUT"] = "720"
+        rc, out, err = _run_group([sys.executable, "bench.py", *args], deadline, env=env)
+        line = next((l for l in out.splitlines() if l.strip().startswith("{")), None)
+        if line is None:
+            _log(f"- {_now()} — {label} bench produced no JSON (rc={rc}); stderr tail: "
+                 f"`{(err or '')[-200:].strip()}`")
+            if label == "tpu-only":
+                continue  # the window may still fit the full attempt
+            return captured
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            _log(f"- {_now()} — {label} bench JSON unparsable (rc={rc})")
+            continue
+        device = str(parsed.get("device", "?"))
+        tpu_side = "tpu" in device.lower()
+        if label == "full" and captured and not tpu_side:
+            return True  # keep the tpu-only capture; don't overwrite with CPU
+        with open(BENCH_OUT, "w") as fh:
+            json.dump({"captured_at": _now(), "probe": probe_detail, "stage": label,
+                       "on_tpu": tpu_side, "result": parsed}, fh, indent=1)
+            fh.write("\n")
+        _log(f"- {_now()} — {label} bench done: device=`{device}` value={parsed.get('value')} "
+             f"{parsed.get('unit', '')} vs_baseline={parsed.get('vs_baseline')} → "
+             f"`BENCH_TPU.json`")
+        _commit(f"Capture {'TPU' if tpu_side else 'post-probe'} {label} bench via probe loop")
+        captured = captured or tpu_side
+    return captured
 
 
 def _commit(msg: str) -> None:
