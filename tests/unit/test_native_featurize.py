@@ -150,6 +150,36 @@ def test_forest_predict_matches_jax_walk(rng):
     np.testing.assert_allclose(nf2(x), ref2, atol=2e-7)
 
 
+def test_matrix_forest_predict_bit_identical_to_two_step(rng):
+    """The fused column->tile->walk path must produce bit-identical scores
+    to build_matrix + forest_predict over mixed column dtypes (f32/f64/
+    i32/uint8/bool incl. NaN routing with and without default_left)."""
+    import dataclasses
+
+    from variantcalling_tpu.models import forest as fm2
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    n, f = 100_000, 7
+    cols = [rng.random(n).astype(np.float32),
+            rng.random(n).astype(np.float64),
+            rng.integers(-5, 90, n).astype(np.int32),
+            rng.integers(0, 200, n).astype(np.uint8),
+            (rng.random(n) < 0.5),
+            np.where(rng.random(n) < 0.1, np.nan, rng.random(n)).astype(np.float32),
+            rng.random(n).astype(np.float32)]
+    for with_dl in (False, True):
+        forest = synthetic_forest(rng, n_trees=9, depth=5, n_features=f)
+        if with_dl:
+            forest = dataclasses.replace(
+                forest,
+                default_left=(rng.random(forest.feature.shape) < 0.5).astype(np.uint8))
+        x = native.build_matrix(cols)
+        two_step = fm2.native_host_predictor(forest)(x)
+        fused = fm2.native_cols_predictor(forest)(cols)
+        assert fused is not None
+        np.testing.assert_array_equal(fused, two_step, err_msg=f"dl={with_dl}")
+
+
 def test_format_float_info_matches_numpy_g(rng):
     """';KEY=%g' rendering matches np.char.mod byte-for-byte (NaN -> empty)."""
     vals = np.round(rng.random(5000) * 100, 4)

@@ -19,7 +19,15 @@ deletions cover iff ``-J``, and N (reference-skip) ops never cover —
 full samtools-depth parity with the BAM walker.
 
 Limitations (explicit, raised or logged — never silent): CRAM 3.1 codecs
-and bzip2/lzma blocks are unsupported.
+(rANS-Nx16, adaptive arithmetic, fqzcomp, name tokenizer) and bzip2/lzma
+blocks are unsupported — decoding raises with a clear message. The 3.1
+codecs are deliberately deferred, not forgotten: in this zero-egress
+environment a from-memory rANS-Nx16 implementation could only ever be
+validated against a same-author encoder, the exact correlated-risk
+failure mode the hand-transcribed interop fixtures
+(tests/unit/test_interop_fixtures.py) exist to eliminate; htslib's
+default write format remains CRAM 3.0, which this decoder covers in
+full (including the ``-q`` per-base-quality depth semantics).
 """
 
 from __future__ import annotations

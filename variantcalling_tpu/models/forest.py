@@ -301,6 +301,34 @@ def native_host_predictor(forest: FlatForest):
     return fn
 
 
+def native_cols_predictor(forest: FlatForest):
+    """CPU fast path over raw feature COLUMNS: the native engine tiles the
+    column->matrix transpose L2-resident and walks each tile immediately,
+    so the (n, f) float32 matrix never materializes (at 5M x 19 that is
+    ~760 MB of skipped DRAM traffic vs build_matrix + the row walk).
+    Bit-identical scores to :func:`native_host_predictor`. Returns None
+    when unavailable; fn returns None when a column dtype is unsupported
+    (caller falls back to the two-step path)."""
+    from variantcalling_tpu import native
+
+    if not native.available() or forest.aggregation not in ("mean", "logit_sum"):
+        return None
+    feat = np.ascontiguousarray(forest.feature, dtype=np.int32)
+    thr = np.ascontiguousarray(forest.threshold, dtype=np.float32)
+    left = np.ascontiguousarray(forest.left, dtype=np.int32)
+    right = np.ascontiguousarray(forest.right, dtype=np.int32)
+    value = np.ascontiguousarray(forest.value, dtype=np.float32)
+    dl = None if forest.default_left is None else \
+        np.ascontiguousarray(forest.default_left, dtype=np.uint8)
+    agg, base, depth = forest.aggregation, forest.base_score, forest.max_depth
+
+    def fn(cols: list[np.ndarray]) -> np.ndarray | None:
+        return native.matrix_forest_predict(cols, feat, thr, left, right, value,
+                                            dl, depth, agg, base)
+
+    return fn
+
+
 def use_native_cpu_forest() -> bool:
     """True when the CPU backend should route forest inference through the
     native walk: single local device (the sharded mesh path must stay

@@ -198,6 +198,15 @@ def get_lib() -> ctypes.CDLL | None:
             ctypes.c_int32, ctypes.c_float,
             _f32p,
         ]
+        lib.vctpu_matrix_forest_predict.restype = _i64
+        lib.vctpu_matrix_forest_predict.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), _i32p, _i64, ctypes.c_int32,
+            _i32p, ctypes.POINTER(ctypes.c_float), _i32p, _i32p,
+            ctypes.POINTER(ctypes.c_float), _u8p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float),
+        ]
         lib.vctpu_gbt_fit.restype = _i64
         lib.vctpu_gbt_fit.argtypes = [
             _u8p, _f32p, _f32p,
@@ -769,11 +778,11 @@ _MATRIX_DTYPES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
                   np.dtype(np.bool_): 4}
 
 
-def build_matrix(cols: list[np.ndarray]) -> np.ndarray | None:
-    """(n, f) float32 matrix from per-column arrays without numpy's
-    per-column temporaries; None -> numpy fallback."""
-    lib = get_lib()
-    if lib is None or not cols:
+def _marshal_cols(cols: list[np.ndarray]):
+    """(void* array, dtype codes, n, keep-alive refs) for typed column
+    arrays; None when any dtype/shape is unsupported. Shared by every
+    column-consuming kernel so they cannot diverge on what they accept."""
+    if not cols:
         return None
     arrs = []
     codes = np.empty(len(cols), dtype=np.int32)
@@ -786,9 +795,34 @@ def build_matrix(cols: list[np.ndarray]) -> np.ndarray | None:
         arrs.append(a)
         codes[j] = code
     ptrs = (ctypes.c_void_p * len(arrs))(*[a.ctypes.data for a in arrs])
-    out = np.empty((n, len(arrs)), dtype=np.float32)
+    return ptrs, codes, n, arrs
+
+
+def _marshal_forest(feat, thr, left, right, value, default_left):
+    """Contiguous typed copies of the packed-forest arrays (shared by the
+    forest-walk entry points)."""
+    return (np.ascontiguousarray(feat, dtype=np.int32),
+            np.ascontiguousarray(thr, dtype=np.float32),
+            np.ascontiguousarray(left, dtype=np.int32),
+            np.ascontiguousarray(right, dtype=np.int32),
+            np.ascontiguousarray(value, dtype=np.float32),
+            None if default_left is None
+            else np.ascontiguousarray(default_left, dtype=np.uint8))
+
+
+def build_matrix(cols: list[np.ndarray]) -> np.ndarray | None:
+    """(n, f) float32 matrix from per-column arrays without numpy's
+    per-column temporaries; None -> numpy fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    marshalled = _marshal_cols(cols)
+    if marshalled is None:
+        return None
+    ptrs, codes, n, _arrs = marshalled
+    out = np.empty((n, len(cols)), dtype=np.float32)
     _f32p = ctypes.POINTER(ctypes.c_float)
-    rc = lib.vctpu_build_matrix(ptrs, codes.ctypes.data_as(_i32p), n, len(arrs),
+    rc = lib.vctpu_build_matrix(ptrs, codes.ctypes.data_as(_i32p), n, len(cols),
                                 out.ctypes.data_as(_f32p))
     return out if rc == 0 else None
 
@@ -804,17 +838,43 @@ def forest_predict(x: np.ndarray, feat: np.ndarray, thr: np.ndarray,
         return None
     _f32p = ctypes.POINTER(ctypes.c_float)
     xx = np.ascontiguousarray(x, dtype=np.float32)
-    ff = np.ascontiguousarray(feat, dtype=np.int32)
-    tt = np.ascontiguousarray(thr, dtype=np.float32)
-    ll = np.ascontiguousarray(left, dtype=np.int32)
-    rr = np.ascontiguousarray(right, dtype=np.int32)
-    vv = np.ascontiguousarray(value, dtype=np.float32)
-    dl = None if default_left is None else np.ascontiguousarray(default_left, dtype=np.uint8)
+    ff, tt, ll, rr, vv, dl = _marshal_forest(feat, thr, left, right, value, default_left)
     n, f = xx.shape
     t, m = ff.shape
     out = np.empty(n, dtype=np.float32)
     rc = lib.vctpu_forest_predict(
         xx.ctypes.data_as(_f32p), n, f,
+        ff.ctypes.data_as(_i32p), tt.ctypes.data_as(_f32p),
+        ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
+        vv.ctypes.data_as(_f32p),
+        None if dl is None else dl.ctypes.data_as(_u8p),
+        t, m, max_depth, 0 if aggregation == "mean" else 1, base_score,
+        out.ctypes.data_as(_f32p),
+    )
+    return out if rc == 0 else None
+
+
+def matrix_forest_predict(cols: list[np.ndarray], feat: np.ndarray, thr: np.ndarray,
+                          left: np.ndarray, right: np.ndarray, value: np.ndarray,
+                          default_left: np.ndarray | None, max_depth: int,
+                          aggregation: str, base_score: float) -> np.ndarray | None:
+    """Fused column->matrix->forest inference: L2-resident row tiles are
+    built from the typed column pointers and walked immediately, so the
+    full (n, f) float32 matrix never exists. Bit-identical scores to
+    build_matrix + forest_predict; None -> caller uses the two-step path."""
+    lib = get_lib()
+    if lib is None or aggregation not in ("mean", "logit_sum"):
+        return None
+    marshalled = _marshal_cols(cols)
+    if marshalled is None:
+        return None
+    ptrs, codes, n, _arrs = marshalled
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    ff, tt, ll, rr, vv, dl = _marshal_forest(feat, thr, left, right, value, default_left)
+    t, m = ff.shape
+    out = np.empty(n, dtype=np.float32)
+    rc = lib.vctpu_matrix_forest_predict(
+        ptrs, codes.ctypes.data_as(_i32p), n, len(cols),
         ff.ctypes.data_as(_i32p), tt.ctypes.data_as(_f32p),
         ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
         vv.ctypes.data_as(_f32p),

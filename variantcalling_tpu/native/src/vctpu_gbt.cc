@@ -18,6 +18,7 @@
 // trainer: feats/bins (T, D, 2^D) int32 with -1 = dead node, leaves
 // (T, 2^D) float32 — models/boosting._to_flat_forest consumes both.
 
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -63,52 +64,43 @@ int64_t vctpu_bin_features(
     return 0;
 }
 
-// Forest inference, CPU twin of models/forest.predict_score: the exact
-// gather-walk semantics (x <= thr goes left; NaN takes default_left when
-// provided, else right; walk runs max_depth rounds with leaf self-loop;
-// mean or sigmoid(sum + base) aggregation), as a per-sample pointer walk
-// over a packed node array — 3-5x XLA:CPU's fused-gather lowering on one
-// core. aggregation: 0 = mean (RF proba), 1 = logit_sum (GBT margin).
-int64_t vctpu_forest_predict(
-    const float* x, int64_t n, int32_t f,
-    const int32_t* feat, const float* thr,
-    const int32_t* left, const int32_t* right, const float* value,
-    const uint8_t* default_left,  // (t, m) or nullptr
-    int32_t t, int32_t m, int32_t max_depth,
-    int32_t aggregation, float base_score,
-    float* out)
-{
-    if (n < 0 || f <= 0 || t <= 0 || m <= 0 || max_depth <= 0) return -1;
-    if (aggregation != 0 && aggregation != 1) return -1;
+namespace {
 
-    struct Node {
-        float thr;
-        float value;
-        int32_t feat;
-        int32_t left;
-        int32_t right;
-        int32_t dl;
-    };
-    // pack the five SoA arrays into one cache-friendly node table
-    std::vector<Node> nodes((size_t)t * m);
-    for (int64_t k = 0; k < (int64_t)t * m; ++k) {
+struct Node {
+    float thr;
+    float value;
+    int32_t feat;
+    int32_t left;
+    int32_t right;
+    int32_t dl;
+};
+
+// pack the five SoA arrays into one cache-friendly node table
+inline void pack_nodes(std::vector<Node>& nodes, const int32_t* feat, const float* thr,
+                       const int32_t* left, const int32_t* right, const float* value,
+                       const uint8_t* default_left, int64_t count) {
+    nodes.resize((size_t)count);
+    for (int64_t k = 0; k < count; ++k) {
         nodes[k] = {thr[k], value[k], feat[k], left[k], right[k],
                     default_left ? (int32_t)default_left[k] : -1};
     }
-    const bool has_dl = default_left != nullptr;
-    const float inv_t = 1.0f / (float)t;
+}
 
-    // walk two trees concurrently per row: the per-tree pointer chase is
-    // a serial dependency chain, so interleaving two independent chains
-    // hides node-load latency (~20% on one core); rows are independent,
-    // so the outer loop shards across threads
-    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
-    for (int64_t i = r_lo; i < r_hi; ++i) {
+// walk rows [0, count) of a row-major tile; out is per-row. Walks two
+// trees concurrently per row: the per-tree pointer chase is a serial
+// dependency chain, so interleaving two independent chains hides
+// node-load latency (~20% on one core). Accumulation order is the exact
+// sequential tree order, so scores stay bit-identical across strategies.
+inline void forest_walk_tile(const Node* nodes, const float* x, int64_t count, int32_t f,
+                             int32_t t, int32_t m, int32_t max_depth, bool has_dl,
+                             int32_t aggregation, float base_score, float* out) {
+    const float inv_t = 1.0f / (float)t;
+    for (int64_t i = 0; i < count; ++i) {
         const float* row = x + (size_t)i * f;
         float acc = 0.0f;
         int32_t ti = 0;
         for (; ti + 1 < t; ti += 2) {
-            const Node* ta = nodes.data() + (size_t)ti * m;
+            const Node* ta = nodes + (size_t)ti * m;
             const Node* tb = ta + m;
             int32_t ia = 0, ib = 0;
             for (int32_t d = 0; d < max_depth; ++d) {
@@ -127,14 +119,11 @@ int64_t vctpu_forest_predict(
                     ib = gl ? nb.left : nb.right;
                 }
             }
-            // two statements, not one sum: keeps the EXACT sequential
-            // accumulation order of the unrolled loop, so scores stay
-            // bit-identical to the pre-interleave walk
             acc += ta[ia].value;
             acc += tb[ib].value;
         }
         for (; ti < t; ++ti) {  // odd tail tree
-            const Node* tree = nodes.data() + (size_t)ti * m;
+            const Node* tree = nodes + (size_t)ti * m;
             int32_t idx = 0;
             for (int32_t d = 0; d < max_depth; ++d) {
                 const Node& nd = tree[idx];
@@ -150,8 +139,112 @@ int64_t vctpu_forest_predict(
         out[i] = aggregation == 0 ? acc * inv_t
                                   : 1.0f / (1.0f + std::exp(-(acc + base_score)));
     }
+}
+
+// fill rows [lo, hi) of a row-major f32 tile from typed column pointers
+// (dtypes: 0 f32, 1 i32, 2 f64, 3/4 uint8/bool); dst row 0 = source row lo
+inline void fill_tile(const void* const* cols, const int32_t* dtypes, int32_t f,
+                      int64_t lo, int64_t hi, float* dst) {
+    for (int32_t j = 0; j < f; ++j) {
+        float* d = dst + j;
+        switch (dtypes[j]) {
+            case 0: {
+                const float* s = (const float*)cols[j] + lo;
+                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = s[i];
+                break;
+            }
+            case 1: {
+                const int32_t* s = (const int32_t*)cols[j] + lo;
+                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = (float)s[i];
+                break;
+            }
+            case 2: {
+                const double* s = (const double*)cols[j] + lo;
+                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = (float)s[i];
+                break;
+            }
+            default: {  // 3/4: uint8 / bool
+                const uint8_t* s = (const uint8_t*)cols[j] + lo;
+                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = (float)s[i];
+                break;
+            }
+        }
+    }
+}
+
+}  // namespace
+
+// Forest inference, CPU twin of models/forest.predict_score: the exact
+// gather-walk semantics (x <= thr goes left; NaN takes default_left when
+// provided, else right; walk runs max_depth rounds with leaf self-loop;
+// mean or sigmoid(sum + base) aggregation), as a per-sample pointer walk
+// over a packed node array — 3-5x XLA:CPU's fused-gather lowering on one
+// core. aggregation: 0 = mean (RF proba), 1 = logit_sum (GBT margin).
+int64_t vctpu_forest_predict(
+    const float* x, int64_t n, int32_t f,
+    const int32_t* feat, const float* thr,
+    const int32_t* left, const int32_t* right, const float* value,
+    const uint8_t* default_left,  // (t, m) or nullptr
+    int32_t t, int32_t m, int32_t max_depth,
+    int32_t aggregation, float base_score,
+    float* out) try
+{
+    if (n < 0 || f <= 0 || t <= 0 || m <= 0 || max_depth <= 0) return -1;
+    if (aggregation != 0 && aggregation != 1) return -1;
+    std::vector<Node> nodes;
+    pack_nodes(nodes, feat, thr, left, right, value, default_left, (int64_t)t * m);
+    const bool has_dl = default_left != nullptr;
+    vctpu::for_shards(n, vctpu::nthreads(), [&](int, int64_t r_lo, int64_t r_hi) {
+        forest_walk_tile(nodes.data(), x + (size_t)r_lo * f, r_hi - r_lo, f,
+                         t, m, max_depth, has_dl, aggregation, base_score, out + r_lo);
     });
     return 0;
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
+}
+
+// Fused column->matrix->forest: each shard builds an L2-resident row tile
+// from the typed column pointers and walks it immediately, so the full
+// (n, f) float32 matrix never exists — at 5M x 19 that skips ~760 MB of
+// DRAM write+read traffic versus vctpu_build_matrix + vctpu_forest_predict.
+// Scores are bit-identical to the two-step path (same fills, same walk).
+int64_t vctpu_matrix_forest_predict(
+    const void* const* cols, const int32_t* dtypes, int64_t n, int32_t f,
+    const int32_t* feat, const float* thr,
+    const int32_t* left, const int32_t* right, const float* value,
+    const uint8_t* default_left,
+    int32_t t, int32_t m, int32_t max_depth,
+    int32_t aggregation, float base_score,
+    float* out) try
+{
+    if (n < 0 || f <= 0 || t <= 0 || m <= 0 || max_depth <= 0) return -1;
+    if (aggregation != 0 && aggregation != 1) return -1;
+    for (int32_t j = 0; j < f; ++j)
+        if (dtypes[j] < 0 || dtypes[j] > 4) return -2;
+    std::vector<Node> nodes;
+    pack_nodes(nodes, feat, thr, left, right, value, default_left, (int64_t)t * m);
+    const bool has_dl = default_left != nullptr;
+    const int64_t BLOCK = 8192;
+    std::atomic<int> failed{0};
+    vctpu::for_shards((n + BLOCK - 1) / BLOCK, vctpu::nthreads(),
+                      [&](int, int64_t b_lo, int64_t b_hi) {
+        std::vector<float> tile;
+        try {
+            tile.resize((size_t)BLOCK * f);
+        } catch (...) {
+            failed.store(1);
+            return;
+        }
+        for (int64_t lo = b_lo * BLOCK; lo < b_hi * BLOCK && lo < n; lo += BLOCK) {
+            const int64_t hi = lo + BLOCK < n ? lo + BLOCK : n;
+            fill_tile(cols, dtypes, f, lo, hi, tile.data());
+            forest_walk_tile(nodes.data(), tile.data(), hi - lo, f, t, m, max_depth,
+                             has_dl, aggregation, base_score, out + lo);
+        }
+    }, 2);
+    return failed.load() ? -1 : 0;
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
 }
 
 // Assemble the (n, f) float32 feature matrix from per-column pointers —
@@ -168,38 +261,16 @@ int64_t vctpu_build_matrix(
     // row-blocked: a full per-column pass would sweep the whole (n, f)
     // matrix f times (≈7 GB of traffic at 5M x 19); per block the output
     // tile stays L2-resident so the matrix is written once. Row shards
-    // write disjoint ranges, so blocks also spread across threads.
+    // write disjoint ranges, so blocks also spread across threads. The
+    // fill itself is the SAME helper the fused matrix+forest path uses,
+    // so the two paths cannot diverge on dtype handling.
     const int64_t BLOCK = 8192;
     vctpu::for_shards((n + BLOCK - 1) / BLOCK, vctpu::nthreads(),
                       [&](int, int64_t b_lo, int64_t b_hi) {
-    for (int64_t lo = b_lo * BLOCK; lo < b_hi * BLOCK && lo < n; lo += BLOCK) {
-        const int64_t hi = lo + BLOCK < n ? lo + BLOCK : n;
-        for (int32_t j = 0; j < f; ++j) {
-            float* dst = out + (size_t)lo * f + j;
-            switch (dtypes[j]) {
-                case 0: {
-                    const float* s = (const float*)cols[j] + lo;
-                    for (int64_t i = 0; i < hi - lo; ++i) dst[(size_t)i * f] = s[i];
-                    break;
-                }
-                case 1: {
-                    const int32_t* s = (const int32_t*)cols[j] + lo;
-                    for (int64_t i = 0; i < hi - lo; ++i) dst[(size_t)i * f] = (float)s[i];
-                    break;
-                }
-                case 2: {
-                    const double* s = (const double*)cols[j] + lo;
-                    for (int64_t i = 0; i < hi - lo; ++i) dst[(size_t)i * f] = (float)s[i];
-                    break;
-                }
-                default: {  // 3/4: uint8 / bool
-                    const uint8_t* s = (const uint8_t*)cols[j] + lo;
-                    for (int64_t i = 0; i < hi - lo; ++i) dst[(size_t)i * f] = (float)s[i];
-                    break;
-                }
-            }
+        for (int64_t lo = b_lo * BLOCK; lo < b_hi * BLOCK && lo < n; lo += BLOCK) {
+            const int64_t hi = lo + BLOCK < n ? lo + BLOCK : n;
+            fill_tile(cols, dtypes, f, lo, hi, out + (size_t)lo * f);
         }
-    }
     }, 2);
     return 0;
 }

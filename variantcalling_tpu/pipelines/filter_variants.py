@@ -266,9 +266,8 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
     from variantcalling_tpu.featurize import CENTER, DEVICE_FEATURES, gather_windows
     from variantcalling_tpu.ops.features import A, C, G, T
 
-    nf = forest_mod.native_host_predictor(
-        forest_mod.with_feature_order(model, hf.names))
-    if nf is None or not native.available():
+    ordered = forest_mod.with_feature_order(model, hf.names)
+    if not native.available() or ordered.aggregation not in ("mean", "logit_sum"):
         return None
     if hf.windows is None and (table is None or fasta is None):
         return None
@@ -289,13 +288,21 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
     if dev is None:
         return None
     raw = [np.asarray(dev[f] if f in dev else hf.cols[f]) for f in hf.names]
-    x = native.build_matrix(raw)
-    if x is None:  # unsupported column dtype: numpy assembly
-        x = np.stack([c.astype(np.float32, copy=False) for c in raw], axis=1)
+    # fused column->tile->walk first: no (n, f) matrix ever materializes
+    cf = forest_mod.native_cols_predictor(ordered)
+    score = cf(raw) if cf is not None else None
+    if score is None:
+        nf = forest_mod.native_host_predictor(ordered)
+        if nf is None:
+            return None
+        x = native.build_matrix(raw)
+        if x is None:  # unsupported column dtype: numpy assembly
+            x = np.stack([c.astype(np.float32, copy=False) for c in raw], axis=1)
+        score = nf(x)
     # no XLA program exists on this path — record that for perf evidence
     # (bench distinguishes real jit compile from plain warmup by this)
     forest_mod.last_strategy = "native-cpp"
-    return nf(x)
+    return score
 
 
 def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
