@@ -1,0 +1,118 @@
+"""Reading the REFERENCE stack's pytables h5 artifacts without pytables.
+
+Every tabular artifact the reference persists is pandas ``to_hdf``
+(pytables 'fixed' format); a user migrating an existing workflow brings
+those files along. ``h5_utils.read_hdf`` decodes that layout directly
+with h5py. Two fixture sources:
+
+- a REAL pytables file committed (non-LFS) in the reference checkout —
+  an actual third-party-written byte stream, the same correlated-risk
+  break as tests/unit/test_interop_fixtures.py;
+- a hand-built non-empty frame following the documented pandas fixed
+  layout (axis0/axis1, per-dtype blockN_items/blockN_values, object
+  blocks as one pickled ndarray in a VLArray-style object dataset).
+"""
+
+import os
+import pickle
+
+import h5py
+import numpy as np
+import pytest
+
+from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+REAL = ("/root/reference/test/resources/unit/comparison/"
+        "test_vcf_pipeline_utils/annotate_concordance_h5_input.hdf")
+
+
+@pytest.mark.skipif(not os.path.exists(REAL), reason="reference checkout absent")
+def test_real_reference_pytables_artifact():
+    """The reference repo's committed concordance h5 (pytables fixed
+    format, 4 dtype blocks incl. pickled-object columns) parses into the
+    exact 25-column frame the reference's own loader would build."""
+    assert list_keys(REAL) == ["concordance"]
+    df = read_hdf(REAL, key="concordance")
+    assert df.shape[0] == 0  # the committed fixture is an empty template
+    assert list(df.columns[:12]) == [
+        "chrom", "pos", "ref", "alleles", "gt_ultima", "gt_ground_truth",
+        "sync", "call", "base", "indel", "classify", "classify_gt"]
+    assert len(df.columns) == 25
+    # the "all" pseudo-key concat also sees pytables groups
+    df_all = read_hdf(REAL, key="all")
+    assert len(df_all.columns) == 25
+
+
+def _obj_pickle_ds(f, name, arr, transposed=False):
+    """Store an object ndarray the way pytables VLArrays do: one pickled
+    ndarray as a uint8 stream, PSEUDOATOM attr marking the encoding."""
+    blob = np.frombuffer(pickle.dumps(arr), dtype=np.uint8)
+    ds = f.create_dataset(name, shape=(1,), dtype=h5py.vlen_dtype(np.uint8))
+    ds[0] = blob
+    ds.attrs["PSEUDOATOM"] = np.bytes_(b"object")
+    if transposed:
+        ds.attrs["transposed"] = np.int64(1)
+    return ds
+
+
+def test_hand_built_pytables_fixed_frame(tmp_path):
+    """Non-empty fixed-format frame in the layout pandas ACTUALLY writes
+    (GenericFixed.write_array): block values stored TRANSPOSED as
+    (n_rows, n_items) with the ``transposed`` attr, pure-string columns
+    as fixed-width 'S' arrays, mixed-object blocks pickled."""
+    p = str(tmp_path / "ref_style.h5")
+    pos = np.asarray([100.0, 250.0, 900.0])
+    qual = np.asarray([50.0, 12.5, 77.0])
+    chroms = np.asarray([b"chr1", b"chr1", b"chr2"], dtype="S4")
+    objs = np.asarray(["PASS", "LOW", "PASS"], dtype=object)
+    with h5py.File(p, "w") as f:
+        g = f.create_group("concordance")
+        g.attrs["pandas_type"] = np.bytes_(b"frame")
+        g.attrs["encoding"] = np.bytes_(b"UTF-8")
+        g.attrs["nblocks"] = np.int64(3)
+        g.create_dataset("axis0", data=np.asarray(
+            [b"chrom", b"pos", b"qual", b"filter"]))
+        _obj_pickle_ds(g, "axis1", np.asarray([10, 11, 12]))
+        # numeric block: pandas writes value.T with transposed=True
+        g.create_dataset("block0_items", data=np.asarray([b"pos", b"qual"]))
+        d0 = g.create_dataset("block0_values", data=np.stack([pos, qual]).T)
+        d0.attrs["transposed"] = np.int64(1)
+        # pure-string block: fixed-width 'S', also transposed on disk
+        g.create_dataset("block1_items", data=np.asarray([b"chrom"]))
+        d1 = g.create_dataset("block1_values", data=chroms.reshape(3, 1))
+        d1.attrs["transposed"] = np.int64(1)
+        # mixed-object block: one pickled ndarray of the TRANSPOSED values
+        g.create_dataset("block2_items", data=np.asarray([b"filter"]))
+        _obj_pickle_ds(g, "block2_values", objs.reshape(3, 1), transposed=True)
+
+    df = read_hdf(p, key="concordance")
+    assert list(df.columns) == ["chrom", "pos", "qual", "filter"]  # axis0 order
+    np.testing.assert_array_equal(df["pos"].to_numpy(), pos)
+    np.testing.assert_array_equal(df["qual"].to_numpy(), qual)
+    assert list(df["chrom"]) == ["chr1", "chr1", "chr2"]  # decoded, not bytes
+    assert list(df["filter"]) == ["PASS", "LOW", "PASS"]
+    assert list(df.index) == [10, 11, 12]
+    assert list_keys(p) == ["concordance"]
+
+
+REAL_BGZF = ("/root/reference/test/resources/unit/filtering/test_spandel/"
+             "ref_fragment.fa.gz")
+
+
+@pytest.mark.skipif(not os.path.exists(REAL_BGZF), reason="reference checkout absent")
+def test_real_htslib_bgzf_stream_decodes():
+    """An actual htslib-bgzip-written BGZF stream (the reference repo's
+    chr21 fragment, committed non-LFS) through the native block-parallel
+    inflate: the first real third-party BGZF bytes to enter the decoder."""
+    import gzip
+
+    from variantcalling_tpu import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    data = open(REAL_BGZF, "rb").read()
+    assert data[:4] == b"\x1f\x8b\x08\x04" and data[12:14] == b"BC"  # BGZF framing
+    want = gzip.decompress(data)  # independent zlib path
+    got = native.bgzf_decompress(data)
+    assert got == want
+    assert want.startswith(b">chr21") and len(want) == 671029

@@ -116,7 +116,78 @@ def _write_ds(g: h5py.Group, name: str, data) -> None:
         g.create_dataset(name, data=data)
 
 
+def _is_pytables_frame(g) -> bool:
+    return (isinstance(g, h5py.Group)
+            and g.attrs.get("pandas_type", b"") in (b"frame", "frame"))
+
+
+def _is_frame_group(g) -> bool:
+    """A group this store can decode: native layout or pytables frame —
+    the single recognition rule list_keys and read_hdf("all") share."""
+    return isinstance(g, h5py.Group) and (
+        _FORMAT_ATTR in g.attrs or _is_pytables_frame(g))
+
+
+def _read_pytables_frame(g: h5py.Group) -> pd.DataFrame:
+    """Decode a pandas ``to_hdf(format='fixed')`` frame written by the
+    REFERENCE stack (pytables) — every tabular artifact the reference
+    persists uses this layout (evaluate_concordance.py:101-105 etc.), so
+    a user migrating an existing workflow can read their h5 files without
+    pytables installed. Layout: ``axis0`` = columns, ``axis1`` = index,
+    ``blockN_items``/``blockN_values`` per dtype block. pandas writes
+    block values TRANSPOSED (``transposed`` attr, (n_rows, n_items) on
+    disk), stores pure-string columns as fixed-width 'S' arrays in the
+    file's declared encoding, and mixed-object blocks as ONE pickled
+    ndarray in a VLArray (the same pickle trust model as the reference's
+    own model registry)."""
+    import pickle
+
+    encoding = g.attrs.get("encoding", b"utf-8")
+    encoding = encoding.decode() if isinstance(encoding, bytes) else str(encoding)
+
+    def to_str(v):
+        return v.decode(encoding, "replace") if isinstance(v, bytes) else v
+
+    def arr(ds):
+        a = ds[:]
+        if a.dtype == object or ds.attrs.get("PSEUDOATOM") is not None:
+            parts = [pickle.loads(bytes(bytearray(e))) for e in a]
+            a = np.asarray(parts[0] if len(parts) == 1 else np.concatenate(parts))
+        if ds.attrs.get("transposed", False):
+            a = a.T
+        return a
+
+    def destring(col: np.ndarray) -> np.ndarray:
+        if col.dtype.kind == "S" or (
+                col.dtype == object and len(col) and isinstance(col[0], bytes)):
+            return np.asarray([to_str(v) for v in col], dtype=object)
+        return col
+
+    nblocks = int(g.attrs.get("nblocks", 0))
+    order = [to_str(x) for x in g["axis0"][:]]
+    idx = arr(g["axis1"]) if "axis1" in g else np.empty(0)
+    n_rows = len(idx)
+    cols: dict = {}
+    for b in range(nblocks):
+        items = [to_str(x) for x in g[f"block{b}_items"][:]]
+        values = arr(g[f"block{b}_values"])  # (n_items, n_rows) after un-transpose
+        if values.ndim != 2:
+            values = values.reshape(len(items), -1)
+        for j, name in enumerate(items):
+            # an empty frame stores (1, 1) placeholder blocks: every
+            # column is empty regardless of the stored atom
+            col = values[j, :n_rows] if j < values.shape[0] and n_rows else \
+                np.empty(0, dtype=values.dtype)
+            cols[name] = destring(np.asarray(col))
+    df = pd.DataFrame({name: cols[name] for name in order if name in cols})
+    if n_rows == len(df):
+        df.index = [to_str(v) for v in idx]
+    return df
+
+
 def _read_frame(g: h5py.Group) -> pd.DataFrame:
+    if _FORMAT_ATTR not in g.attrs and _is_pytables_frame(g):
+        return _read_pytables_frame(g)
     kinds = json.loads(g.attrs["kinds"])
     names = json.loads(g.attrs["columns"])
     cols = {}
@@ -130,7 +201,7 @@ def _read_frame(g: h5py.Group) -> pd.DataFrame:
 
 def list_keys(path: str) -> list[str]:
     with h5py.File(path, "r") as f:
-        return sorted(k for k in f.keys() if isinstance(f[k], h5py.Group) and _FORMAT_ATTR in f[k].attrs)
+        return sorted(k for k in f.keys() if _is_frame_group(f[k]))
 
 
 def read_hdf(path: str, key: str = "all", skip_keys: list[str] | None = None, columns_subset=None) -> pd.DataFrame:
@@ -150,7 +221,7 @@ def read_hdf(path: str, key: str = "all", skip_keys: list[str] | None = None, co
             frames = [
                 _read_frame(f[k])
                 for k in sorted(f.keys())
-                if k not in skip and isinstance(f[k], h5py.Group) and _FORMAT_ATTR in f[k].attrs
+                if k not in skip and _is_frame_group(f[k])
             ]
             if not frames:
                 raise KeyError(f"no frames in {path}")
