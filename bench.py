@@ -121,22 +121,31 @@ def _device_throughput_impl(tile: int, n_tiles: int) -> dict:
             host_tiles = [host_hot_path_args(tile, seed=s) for s in range(n_tiles)]
             first = nhp(*host_tiles[0])  # warm (allocators, code paths)
             if first is not None:
-                t0 = time.perf_counter()
-                checksum = sum(float(nhp(*args).sum()) for args in host_tiles)
-                dt = time.perf_counter() - t0
-                assert np.isfinite(checksum)
+                # best of two timed passes: the shared single-core host
+                # shows ±30% noise between runs, and peak throughput is
+                # the number the roofline comparisons need
+                best = None
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    checksum = sum(float(nhp(*args).sum()) for args in host_tiles)
+                    dt = time.perf_counter() - t0
+                    assert np.isfinite(checksum)
+                    best = dt if best is None else min(best, dt)
                 return {"tile": tile, "n_tiles": n_tiles,
-                        "vps": round(tile * n_tiles / dt), "strategy": "native-cpp"}
+                        "vps": round(tile * n_tiles / best), "strategy": "native-cpp"}
 
     hot = fused_hot_path(forest)
     step = jax.jit(lambda *a: hot(*a).sum())  # device-side checksum sync
     tiles = [jax.device_put(hot_path_args(tile, seed=s)) for s in range(n_tiles)]
     float(step(*tiles[0]))  # compile
-    t0 = time.perf_counter()
-    outs = [step(*args) for args in tiles]  # pipelined dispatch
-    checksum = sum(float(o) for o in outs)  # scalar fetches force completion
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
+    dt = None
+    for _ in range(2):  # best of two: same estimator as the CPU fallback
+        t0 = time.perf_counter()
+        outs = [step(*args) for args in tiles]  # pipelined dispatch
+        checksum = sum(float(o) for o in outs)  # scalar fetches force completion
+        d = time.perf_counter() - t0
+        assert np.isfinite(checksum)
+        dt = d if dt is None else min(dt, d)
     out = {"tile": tile, "n_tiles": n_tiles, "vps": round(tile * n_tiles / dt),
            # which inference strategy actually won (pallas can silently
            # fall back to gemm at lowering time — VERDICT r3 weak #6)
@@ -604,9 +613,14 @@ def cpu_baseline_throughput(n_features: int = 12) -> float:
     n_pred = 200_000
     x_pred = rng.random((n_pred, n_features)).astype(np.float32)
     clf.predict_proba(x_pred[:1000])  # warm
-    t0 = time.perf_counter()
-    clf.predict_proba(x_pred)
-    dt = time.perf_counter() - t0
+    # best of two, matching the measured side's estimator — an asymmetric
+    # single-shot baseline on this noisy host would bias vs_baseline
+    dt = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        clf.predict_proba(x_pred)
+        d = time.perf_counter() - t0
+        dt = d if dt is None else min(dt, d)
     return n_pred / dt
 
 
