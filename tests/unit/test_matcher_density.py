@@ -86,13 +86,16 @@ def _random_side(rng, seq, n, mean_gap, het_frac=0.6):
 
 
 def test_beam_bfs_equals_exhaustive_enumeration(rng):
-    """Within the old caps the dedup-BFS must produce the exact same
-    {hapA, hapB} sequence sets as the 2^hets enumeration."""
-    seq = "".join(rng.choice(list("ACGT"), 400))
-    checked = 0
-    for _ in range(200):
-        n = int(rng.integers(1, 7))
-        side = _random_side(rng, seq, n, mean_gap=12)
+    """The dedup-BFS must produce the exact same {hapA, hapB} sequence
+    sets as the 2^hets enumeration — both inside the old caps (<=6 hets)
+    and in the NEWLY reachable 7-10 het territory the old search
+    refused, where the reference enumerates up to 1024 masks."""
+    seq = "".join(rng.choice(list("ACGT"), 600))
+    checked = big_checked = 0
+    for trial in range(260):
+        n = int(rng.integers(1, 7)) if trial < 200 else int(rng.integers(7, 11))
+        side = _random_side(rng, seq, n, mean_gap=12,
+                            het_frac=0.6 if trial < 200 else 1.0)
         if len(side.pos) == 0:
             continue
         idx = list(range(len(side.pos)))
@@ -104,7 +107,9 @@ def test_beam_bfs_equals_exhaustive_enumeration(rng):
         assert not capped
         assert got == want
         checked += got is not None
+        big_checked += got is not None and n >= 7
     assert checked > 50  # the comparison actually exercised real clusters
+    assert big_checked > 20  # including beyond the old 6-het cap
 
 
 def test_cluster_beyond_old_caps_now_matches(rng):
