@@ -77,6 +77,22 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # child: all jax work
 # --------------------------------------------------------------------------
 
+
+def best_of(fn, n: int = 2) -> float:
+    """Minimum wall time over n calls of fn (fn must sync + self-check).
+
+    Every measured phase AND its CPU baseline use this one estimator, so
+    ratios compare like with like on this noisy shared host.
+    """
+    best = None
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
 def device_throughput(tile: int, n_tiles: int) -> dict:
     # the TPU forest path may route through the pallas kernel
     # (models/forest_pallas). make_predictor already warms it up and falls
@@ -121,16 +137,11 @@ def _device_throughput_impl(tile: int, n_tiles: int) -> dict:
             host_tiles = [host_hot_path_args(tile, seed=s) for s in range(n_tiles)]
             first = nhp(*host_tiles[0])  # warm (allocators, code paths)
             if first is not None:
-                # best of two timed passes: the shared single-core host
-                # shows ±30% noise between runs, and peak throughput is
-                # the number the roofline comparisons need
-                best = None
-                for _ in range(2):
-                    t0 = time.perf_counter()
+                def run_tiles():
                     checksum = sum(float(nhp(*args).sum()) for args in host_tiles)
-                    dt = time.perf_counter() - t0
                     assert np.isfinite(checksum)
-                    best = dt if best is None else min(best, dt)
+
+                best = best_of(run_tiles)
                 return {"tile": tile, "n_tiles": n_tiles,
                         "vps": round(tile * n_tiles / best), "strategy": "native-cpp"}
 
@@ -138,14 +149,13 @@ def _device_throughput_impl(tile: int, n_tiles: int) -> dict:
     step = jax.jit(lambda *a: hot(*a).sum())  # device-side checksum sync
     tiles = [jax.device_put(hot_path_args(tile, seed=s)) for s in range(n_tiles)]
     float(step(*tiles[0]))  # compile
-    dt = None
-    for _ in range(2):  # best of two: same estimator as the CPU fallback
-        t0 = time.perf_counter()
+
+    def run_tiles():
         outs = [step(*args) for args in tiles]  # pipelined dispatch
         checksum = sum(float(o) for o in outs)  # scalar fetches force completion
-        d = time.perf_counter() - t0
         assert np.isfinite(checksum)
-        dt = d if dt is None else min(dt, d)
+
+    dt = best_of(run_tiles)
     out = {"tile": tile, "n_tiles": n_tiles, "vps": round(tile * n_tiles / dt),
            # which inference strategy actually won (pallas can silently
            # fall back to gemm at lowering time — VERDICT r3 weak #6)
@@ -390,10 +400,12 @@ def train_wallclock() -> dict:
     x, y = train_fixture()
     cfg = boosting.BoostConfig(n_trees=N_TREES, depth=DEPTH, n_bins=64)
     boosting.fit(x, y, cfg=cfg)  # compile
-    t0 = time.perf_counter()
-    forest = boosting.fit(x, y, cfg=cfg)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(float(forest.value.sum()))
+
+    def fit_once():
+        forest = boosting.fit(x, y, cfg=cfg)
+        assert np.isfinite(float(forest.value.sum()))
+
+    dt = best_of(fit_once)
     return {"n": TRAIN_N, "n_features": TRAIN_F, "n_trees": N_TREES,
             "wallclock_s": round(dt, 3)}
 
@@ -427,10 +439,11 @@ def coverage_reduce() -> dict:
 
     d = jax.device_put(depth)
     float(step(d))  # compile
-    t0 = time.perf_counter()
-    checksum = float(step(d))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
+
+    def reduce_once():
+        assert np.isfinite(float(step(d)))
+
+    dt = best_of(reduce_once)
     return {"bp": COV_LEN, "window": COV_WINDOW, "bp_per_sec": round(COV_LEN / dt)}
 
 
@@ -456,17 +469,20 @@ def sec_aggregate() -> dict:
 
         mesh = make_mesh(n_model=1)
         aggregate_on_mesh(counts, mesh)  # compile
-        t0 = time.perf_counter()
-        out = aggregate_on_mesh(counts, mesh)
-        dt = time.perf_counter() - t0
+
+        def agg_once():
+            assert np.isfinite(np.asarray(aggregate_on_mesh(counts, mesh)).sum())
+
+        dt = best_of(agg_once)
     else:
         step = jax.jit(lambda x: jnp.sum(x, axis=0))
         d = jax.device_put(counts)
         np.asarray(step(d))  # compile
-        t0 = time.perf_counter()
-        out = np.asarray(step(d))
-        dt = time.perf_counter() - t0
-    assert np.isfinite(out.sum())
+
+        def agg_once():
+            assert np.isfinite(np.asarray(step(d)).sum())
+
+        dt = best_of(agg_once)
     return {"samples": SEC_SAMPLES, "loci": SEC_LOCI, "alleles": SEC_ALLELES,
             "counts_per_sec": round(counts.size / dt)}
 
@@ -613,15 +629,7 @@ def cpu_baseline_throughput(n_features: int = 12) -> float:
     n_pred = 200_000
     x_pred = rng.random((n_pred, n_features)).astype(np.float32)
     clf.predict_proba(x_pred[:1000])  # warm
-    # best of two, matching the measured side's estimator — an asymmetric
-    # single-shot baseline on this noisy host would bias vs_baseline
-    dt = None
-    for _ in range(2):
-        t0 = time.perf_counter()
-        clf.predict_proba(x_pred)
-        d = time.perf_counter() - t0
-        dt = d if dt is None else min(dt, d)
-    return n_pred / dt
+    return n_pred / best_of(lambda: clf.predict_proba(x_pred))
 
 
 def cpu_train_baseline() -> float:
@@ -629,10 +637,12 @@ def cpu_train_baseline() -> float:
     from sklearn.ensemble import HistGradientBoostingClassifier
 
     x, y = train_fixture()
-    clf = HistGradientBoostingClassifier(max_iter=N_TREES, max_depth=DEPTH, max_bins=64)
-    t0 = time.perf_counter()
-    clf.fit(x, y.astype(int))
-    return time.perf_counter() - t0
+
+    def fit_once():
+        clf = HistGradientBoostingClassifier(max_iter=N_TREES, max_depth=DEPTH, max_bins=64)
+        clf.fit(x, y.astype(int))
+
+    return best_of(fit_once)
 
 
 def cpu_coverage_baseline() -> float:
@@ -640,25 +650,26 @@ def cpu_coverage_baseline() -> float:
     generous to the baseline (the reference's actual path is subprocess
     text pipes). Returns bp/sec."""
     depth = coverage_fixture()
-    t0 = time.perf_counter()
-    n_win = len(depth) // COV_WINDOW
-    means = depth[: n_win * COV_WINDOW].reshape(n_win, COV_WINDOW).mean(axis=1)
-    hist = np.bincount(np.clip(depth, 0, 1000), minlength=1001)
-    cdf = np.cumsum(hist) / hist.sum()
-    pct = np.searchsorted(cdf, [0.05, 0.25, 0.5, 0.75, 0.95])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(means.sum() + pct.sum())
-    return len(depth) / dt
+
+    def reduce_once():
+        n_win = len(depth) // COV_WINDOW
+        means = depth[: n_win * COV_WINDOW].reshape(n_win, COV_WINDOW).mean(axis=1)
+        hist = np.bincount(np.clip(depth, 0, 1000), minlength=1001)
+        cdf = np.cumsum(hist) / hist.sum()
+        pct = np.searchsorted(cdf, [0.05, 0.25, 0.5, 0.75, 0.95])
+        assert np.isfinite(means.sum() + pct.sum())
+
+    return len(depth) / best_of(reduce_once)
 
 
 def cpu_sec_baseline() -> float:
     """numpy cohort-sum on this host; counts/sec."""
     counts = sec_fixture()
-    t0 = time.perf_counter()
-    out = counts.sum(axis=0)
-    dt = time.perf_counter() - t0
-    assert np.isfinite(out.sum())
-    return counts.size / dt
+
+    def sum_once():
+        assert np.isfinite(counts.sum(axis=0).sum())
+
+    return counts.size / best_of(sum_once)
 
 
 def _cpu_env() -> dict[str, str]:
