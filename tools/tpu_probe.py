@@ -151,7 +151,7 @@ def _commit(msg: str) -> None:
 def main() -> None:
     global INTERVAL_S  # noqa: PLW0603 — slowed down once a capture lands
     deadline = time.time() + float(os.environ.get("VCTPU_PROBE_HOURS", "11.5")) * 3600
-    _log(f"\n## Round-4 probe session started {_now()} "
+    _log(f"\n## Probe session started {_now()} "
          f"(interval {INTERVAL_S}s, pid {os.getpid()})\n")
     n = 0
     while time.time() < deadline:
