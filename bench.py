@@ -178,52 +178,129 @@ def gemm_flops_per_variant(gf) -> int:
     return int(2 * t * (f * i + i * l))
 
 
+def _fvp_args(vcf_in: str, out_path: str):
+    """Namespace matching filter_variants.get_parser() defaults for the
+    direct run_streaming call (no CLI subprocess inside the timed region)."""
+    import argparse
+
+    return argparse.Namespace(
+        input_file=vcf_in, output_file=out_path, runs_file=None,
+        hpol_filter_length_dist=[10, 10], blacklist=None,
+        blacklist_cg_insertions=False, annotate_intervals=[],
+        flow_order="TGCA", is_mutect=False, limit_to_contig=None,
+    )
+
+
 def e2e_pipeline(fixture_dir: str) -> dict:
-    """The real filter pipeline, staged: ingest -> featurize+score -> writeback."""
+    """The real filter pipeline end to end via the STREAMING executor
+    (pipelines/filter_variants.run_streaming): chunked ingest, fused
+    featurize+score and ordered writeback overlapped on the bounded-queue
+    stage pipeline, with the FASTA encode riding the prefetch thread.
+
+    Accounting (round-5 VERDICT item 4 — warmup must not hide a serial
+    genome encode): ``warmup_s`` is ONLY the .fai index + model/native
+    warm + first-chunk scoring; the whole-genome encode overlaps inside
+    the measured runs. ``first_run_s`` is the cold run (overlapped encode
+    + persistent .venc cache write), ``steady_run_s`` the warm run that
+    defines ``e2e_vps``, and ``wallclock_s`` the honest single-shot
+    cost (warmup + cold run) a fresh CLI invocation would pay.
+    """
     from variantcalling_tpu.io.fasta import FastaReader
-    from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+    from variantcalling_tpu.io.vcf import VcfChunkReader
     from variantcalling_tpu.models import forest as forest_mod
-    from variantcalling_tpu.pipelines.filter_variants import filter_variants
+    from variantcalling_tpu.pipelines.filter_variants import (filter_variants,
+                                                              run_streaming)
     from variantcalling_tpu.synthetic import synthetic_forest
 
     vcf_in = os.path.join(fixture_dir, "calls.vcf.gz")
     if not os.path.exists(vcf_in):
         vcf_in = os.path.join(fixture_dir, "calls.vcf")
-    t0 = time.perf_counter()
-    table = read_vcf(vcf_in)
-    t1 = time.perf_counter()
-    print("BENCH_PHASE e2e ingest done", flush=True)
-    fasta = FastaReader(os.path.join(fixture_dir, "ref.fa"))
-    model = synthetic_forest(np.random.default_rng(0), n_trees=N_TREES, depth=DEPTH)
-    # warm-up run: jit compile on device paths; on the native-CPU path
-    # (no jitted program at all) it only pays imports + the per-contig
-    # genome encode, so its cost is labeled warmup, not compile
-    filter_variants(table, model, fasta)
-    t1b = time.perf_counter()
-    print("BENCH_PHASE e2e warmup done", flush=True)
-    score, filters = filter_variants(table, model, fasta)  # steady state
-    t2 = time.perf_counter()
     out_path = os.path.join(fixture_dir, "out.vcf")
+
+    t0 = time.perf_counter()
+    fasta = FastaReader(os.path.join(fixture_dir, "ref.fa"))  # .fai build
+    model = synthetic_forest(np.random.default_rng(0), n_trees=N_TREES, depth=DEPTH)
+    # warm code paths (native engine load, predictor wiring, jit on device
+    # backends) on ONE small chunk — encodes only that chunk's contig.
+    # Chunked ingest needs the native engine; without it the serial
+    # fallback below measures the jit/python path as before.
+    from variantcalling_tpu import native
+
+    if native.available():
+        first_chunk = next(iter(VcfChunkReader(vcf_in, chunk_bytes=256 << 10)))
+        filter_variants(first_chunk, model, fasta)
+    t1 = time.perf_counter()
+    print("BENCH_PHASE e2e warmup done", flush=True)
+
+    stats = run_streaming(_fvp_args(vcf_in, out_path), model, fasta, {}, None)
+    t2 = time.perf_counter()
+    print("BENCH_PHASE e2e cold streaming run done", flush=True)
+    if stats is None:  # streaming ineligible (e.g. forced serial): serial run
+        return _e2e_serial(vcf_in, out_path, model, fasta, t0, t1)
+
+    # steady state is best-of-2 — the same estimator every other phase
+    # uses (this shared host swings ±30% between minutes)
+    steady = None
+    for _ in range(2):
+        ts = time.perf_counter()
+        stats2 = run_streaming(_fvp_args(vcf_in, out_path), model, fasta, {}, None)
+        dt = time.perf_counter() - ts
+        steady = dt if steady is None else min(steady, dt)
+
+    n = stats2["n"]
+    strategy = forest_mod.last_strategy
+    warmup = round(t1 - t0, 3)
+    return {
+        "n": n,
+        "strategy": strategy,
+        "mode": stats2["mode"],
+        "chunks": stats2["chunks"],
+        "warmup_s": warmup,  # .fai + model + first-chunk warm; NO genome encode
+        # actual XLA compile inside the warmup: the native-cpp strategy
+        # never traces a program (scores come from the C++ engine), so its
+        # warmup is index build + engine load + first-touch, not compile
+        "compile_s": 0.0 if strategy == "native-cpp" else warmup,
+        "first_run_s": round(t2 - t1, 3),  # cold: overlapped encode + .venc write
+        "steady_run_s": round(steady, 3),
+        "wallclock_s": round(t2 - t0, 3),  # single-shot all-in (warmup + cold)
+        "e2e_vps": round(n / steady),
+        "single_shot_vps": round(n / (t2 - t0)),
+    }
+
+
+def _e2e_serial(vcf_in: str, out_path: str, model, fasta, t0: float, t1: float) -> dict:
+    """Fallback measurement through the serial whole-table path (kept for
+    VCTPU_THREADS=1 and non-native/jit runs so the bench still reports a
+    comparable number). Round-5 accounting: the first scoring run is
+    warmup (jit compile / engine first-touch, excluded from e2e_vps), the
+    second is steady state."""
+    from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+    from variantcalling_tpu.models import forest as forest_mod
+    from variantcalling_tpu.pipelines.filter_variants import filter_variants
+
+    ta = time.perf_counter()
+    table = read_vcf(vcf_in)
+    tb = time.perf_counter()
+    filter_variants(table, model, fasta)  # warmup: compile / first-touch
+    tb2 = time.perf_counter()
+    score, filters = filter_variants(table, model, fasta)  # steady state
+    tc = time.perf_counter()
     table.header.ensure_filter("LOW_SCORE", "Model score below threshold")
     table.header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
     write_vcf(out_path, table, new_filters=filters,
               extra_info={"TREE_SCORE": np.round(score, 4)}, verbatim_core=True)
-    t3 = time.perf_counter()
+    td = time.perf_counter()
     n = len(table)
-    warm_wall = (t1 - t0) + (t2 - t1b) + (t3 - t2)
     strategy = forest_mod.last_strategy
-    warmup = round(t1b - t1, 3)
+    warm_wall = (tb - ta) + (tc - tb2) + (td - tc)
     return {
-        "n": n,
-        "strategy": strategy,
-        "ingest_s": round(t1 - t0, 3),
-        "warmup_s": warmup,  # one-time cost, excluded from e2e_vps
-        # actual XLA compile inside the warmup: the native-cpp strategy
-        # never traces a program (scores come from the C++ engine), so its
-        # warmup is imports + FASTA encode + first-touch, not compile
-        "compile_s": 0.0 if strategy == "native-cpp" else warmup,
-        "featurize_score_s": round(t2 - t1b, 3),
-        "writeback_s": round(t3 - t2, 3),
+        "n": n, "strategy": strategy, "mode": "serial",
+        "warmup_s": round((t1 - t0) + (tb2 - tb), 3),
+        "compile_s": 0.0 if strategy == "native-cpp" else round(tb2 - tb, 3),
+        "ingest_s": round(tb - ta, 3),
+        "featurize_score_s": round(tc - tb2, 3),
+        "writeback_s": round(td - tc, 3),
+        "wallclock_s": round(td - t0, 3),
         "e2e_vps": round(n / warm_wall),
     }
 
@@ -238,21 +315,32 @@ def make_fixtures_fast(d: str, n: int, genome_len: int, n_contigs: int = 4,
     bases = np.frombuffer(b"ACGT", dtype="S1")
     clen = genome_len // n_contigs
     contigs = [f"chr{i + 1}" for i in range(n_contigs)]
-    enc = {}
+    # ONE random contig body reused for every contig: the pipeline measures
+    # throughput, not biology, and regenerating 3.1 Gbp of random bases 24x
+    # dominated the genome3g fixture cost (round-5 VERDICT item 6: the
+    # in-bench genome3g never finished its budget)
+    arr = rng.integers(0, 4, size=clen).astype(np.uint8)
+    enc = {c: arr for c in contigs}
+    seq = bases[arr].view(np.uint8)
+    k = clen // 60
+    body = np.concatenate(
+        [seq[: k * 60].reshape(k, 60),
+         np.full((k, 1), ord("\n"), np.uint8)], axis=1).tobytes()
+    tail = seq[k * 60:]
+    tail_b = tail.tobytes() + b"\n" if len(tail) else b""
+    fai_lines = []
     with open(os.path.join(d, "ref.fa"), "wb") as fh:
         for c in contigs:
-            arr = rng.integers(0, 4, size=clen).astype(np.uint8)
-            enc[c] = arr
             fh.write(f">{c}\n".encode())
-            seq = bases[arr].view(np.uint8)
-            k = clen // 60
-            rows = np.concatenate(
-                [seq[: k * 60].reshape(k, 60),
-                 np.full((k, 1), ord("\n"), np.uint8)], axis=1)
-            fh.write(rows.tobytes())
-            tail = seq[k * 60:]
-            if len(tail):
-                fh.write(tail.tobytes() + b"\n")
+            # reference FASTAs ship indexed (the CLI flag is "Indexed
+            # reference FASTA file"), so the fixture writes the .fai too —
+            # the pipeline's warmup then measures what production pays
+            fai_lines.append(f"{c}\t{clen}\t{fh.tell()}\t60\t61\n")
+            fh.write(body)
+            if tail_b:
+                fh.write(tail_b)
+    with open(os.path.join(d, "ref.fa.fai"), "wt") as fh:
+        fh.writelines(fai_lines)
 
     per = n // n_contigs
     header = ["##fileformat=VCFv4.2"]
@@ -268,8 +356,19 @@ def make_fixtures_fast(d: str, n: int, genome_len: int, n_contigs: int = 4,
         fh.write(("\n".join(header) + "\n").encode())
         for ci, c in enumerate(contigs):
             m = per + (n - per * n_contigs if ci == n_contigs - 1 else 0)
-            pos = np.sort(rng.choice(
-                np.arange(100, clen - 100, dtype=np.int64), size=m, replace=False)) + 1
+            # unique sorted positions WITHOUT materializing a clen-sized
+            # arange (rng.choice(replace=False) permutes the whole contig —
+            # ~1 GB and seconds per contig at hg38 scale): oversample,
+            # dedupe, then thin uniformly back to m
+            cand = np.unique(rng.integers(100, clen - 100, size=m + m // 32 + 64,
+                                          dtype=np.int64))
+            while len(cand) < m:  # dense callsets: top up until m distinct
+                extra = rng.integers(100, clen - 100, size=2 * (m - len(cand)) + 64,
+                                     dtype=np.int64)
+                cand = np.unique(np.concatenate([cand, extra]))
+            if len(cand) > m:
+                cand = cand[np.sort(rng.choice(len(cand), size=m, replace=False))]
+            pos = cand + 1
             ref_codes = enc[c][pos - 1]
             shift = rng.integers(1, 4, m).astype(np.uint8)
             alt_codes = (ref_codes + shift) % 4
@@ -340,32 +439,48 @@ def genome3g_pipeline(parent_dir: str) -> dict:
     out["fixture_s"] = round(fixture_s, 1)
     print("BENCH_PHASE genome3g filter done", flush=True)
 
-    # 30x-shaped coverage reduce over >1 Gbp as ONE jitted program (the
-    # 134 Mbp fixture tiled up: the measured reductions depend on array
-    # scale, not sample draws)
+    # 30x-shaped coverage reduce over >1 Gbp (the 134 Mbp fixture tiled up:
+    # the measured reductions depend on array scale, not sample draws). On
+    # the CPU fallback this runs the single-pass host engine — the jitted
+    # CPU lowering's multi-GB temporaries were the 123 -> 48.6 Mbp/s
+    # genome-scale cliff; accelerators keep the one jitted program.
     import jax
-    import jax.numpy as jnp
-
-    from variantcalling_tpu.ops import coverage as cov
 
     depth = np.tile(coverage_fixture(), G3_COV_BP // COV_LEN)
+    qs = np.asarray([0.05, 0.25, 0.5, 0.75, 0.95])
+    if jax.default_backend() == "cpu":
+        from variantcalling_tpu import native
+        from variantcalling_tpu.ops import coverage as cov
 
-    @jax.jit
-    def step(dv):
-        means = cov.binned_mean(dv, COV_WINDOW)
-        hist = cov.depth_histogram(dv)
-        pct = cov.percentiles_from_histogram(hist, jnp.asarray([0.05, 0.25, 0.5, 0.75, 0.95]))
-        return means.sum() + hist.sum() + pct.sum()
+        t0 = time.perf_counter()
+        h = cov.host_coverage_stats(depth, COV_WINDOW, qs=qs)
+        cov_dt = time.perf_counter() - t0
+        assert np.isfinite(float(h["means"].sum() + h["percentiles"].sum()))
+        strategy = "native-cpp" if native.available() else "numpy-tiled"
+    else:
+        import jax.numpy as jnp
 
-    dvec = jax.device_put(depth)
-    float(step(dvec))  # compile
-    t0 = time.perf_counter()
-    checksum = float(step(dvec))
-    cov_dt = time.perf_counter() - t0
-    assert np.isfinite(checksum)
+        from variantcalling_tpu.ops import coverage as cov
+
+        @jax.jit
+        def step(dv):
+            means = cov.binned_mean(dv, COV_WINDOW)
+            hist = cov.depth_histogram(dv)
+            pct = cov.percentiles_from_histogram(hist, jnp.asarray(qs))
+            return means.sum() + hist.sum() + pct.sum()
+
+        dvec = jax.device_put(depth)
+        float(step(dvec))  # compile
+        t0 = time.perf_counter()
+        checksum = float(step(dvec))
+        cov_dt = time.perf_counter() - t0
+        assert np.isfinite(checksum)
+        del dvec
+        strategy = "jit"
     out["coverage_1g"] = {"bp": len(depth), "window": COV_WINDOW,
+                          "strategy": strategy,
                           "bp_per_sec": round(len(depth) / cov_dt)}
-    del dvec, depth
+    del depth
 
     rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20)
     out["peak_rss_gb"] = round(rss_gb, 2)
@@ -418,22 +533,39 @@ def coverage_fixture() -> np.ndarray:
 
 
 def coverage_reduce() -> dict:
-    """BASELINE config 4 on device: 1 kb binned means + depth histogram +
-    percentiles over a chr1-scale depth vector, as ONE jitted program —
-    the reference's `samtools depth | awk` + pyBigWig loops + awk re-bin
-    (coverage_analysis.py:653-683, 745-786, 798-856)."""
+    """BASELINE config 4: 1 kb binned means + depth histogram + percentiles
+    over a chr1-scale depth vector — the reference's `samtools depth | awk`
+    + pyBigWig loops + awk re-bin (coverage_analysis.py:653-683, 745-786,
+    798-856). Accelerators run it as ONE jitted program; the CPU fallback
+    runs the single-pass tiled host engine (ops/coverage.host_coverage_stats
+    — the jitted CPU lowering was numpy-parity, round-5 VERDICT item 3)."""
     import jax
-    import jax.numpy as jnp
 
     from variantcalling_tpu.ops import coverage as cov
 
     depth = coverage_fixture()
+    qs = np.asarray([0.05, 0.25, 0.5, 0.75, 0.95])
+
+    if jax.default_backend() == "cpu":
+        from variantcalling_tpu import native
+
+        def reduce_once():
+            h = cov.host_coverage_stats(depth, COV_WINDOW, qs=qs)
+            assert np.isfinite(float(h["means"].sum() + h["percentiles"].sum()))
+
+        reduce_once()  # warm (allocators, native lib load)
+        dt = best_of(reduce_once)
+        return {"bp": COV_LEN, "window": COV_WINDOW,
+                "strategy": "native-cpp" if native.available() else "numpy-tiled",
+                "bp_per_sec": round(COV_LEN / dt)}
+
+    import jax.numpy as jnp
 
     @jax.jit
     def step(d):
         means = cov.binned_mean(d, COV_WINDOW)
         hist = cov.depth_histogram(d)
-        pct = cov.percentiles_from_histogram(hist, jnp.asarray([0.05, 0.25, 0.5, 0.75, 0.95]))
+        pct = cov.percentiles_from_histogram(hist, jnp.asarray(qs))
         # scalar checksum: one 4-byte fetch syncs the whole program
         return means.sum() + hist.sum() + pct.sum()
 
@@ -444,7 +576,80 @@ def coverage_reduce() -> dict:
         assert np.isfinite(float(step(d)))
 
     dt = best_of(reduce_once)
-    return {"bp": COV_LEN, "window": COV_WINDOW, "bp_per_sec": round(COV_LEN / dt)}
+    return {"bp": COV_LEN, "window": COV_WINDOW, "strategy": "jit",
+            "bp_per_sec": round(COV_LEN / dt)}
+
+
+def host_scaling(fixture_dir: str) -> dict:
+    """Measured thread-scaling of the three host stages (ingest /
+    featurize+score / writeback) plus the streaming executor, at
+    VCTPU_NATIVE_THREADS=1 vs all cores, on the 1M fixture.
+
+    Replaces the asserted "~N× on N cores" claim (docs/perf_notes.md,
+    round-5 VERDICT item 5) with a committed measurement. Byte-identity
+    across thread counts is locked by tests/unit/test_native_mt.py; this
+    records the SPEED side.
+    """
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import read_vcf, write_vcf
+    from variantcalling_tpu.pipelines.filter_variants import (filter_variants,
+                                                              run_streaming)
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    vcf_in = os.path.join(fixture_dir, "calls.vcf.gz")
+    if not os.path.exists(vcf_in):
+        vcf_in = os.path.join(fixture_dir, "calls.vcf")
+    out_path = os.path.join(fixture_dir, "out_scaling.vcf")
+    cores = os.cpu_count() or 1
+    model = synthetic_forest(np.random.default_rng(0), n_trees=N_TREES, depth=DEPTH)
+    fasta = FastaReader(os.path.join(fixture_dir, "ref.fa"))
+    for c in fasta.references:
+        fasta.fetch_encoded(c)  # scaling measures the stages, not the encode
+
+    def stage_walls() -> dict[str, float]:
+        t0 = time.perf_counter()
+        table = read_vcf(vcf_in)
+        t1 = time.perf_counter()
+        score, filters = filter_variants(table, model, fasta)
+        t2 = time.perf_counter()
+        table.header.ensure_filter("LOW_SCORE", "x")
+        table.header.ensure_info("TREE_SCORE", "1", "Float", "x")
+        write_vcf(out_path, table, new_filters=filters,
+                  extra_info={"TREE_SCORE": np.round(score, 4)}, verbatim_core=True)
+        t3 = time.perf_counter()
+        walls = {"ingest": t1 - t0, "featurize_score": t2 - t1, "writeback": t3 - t2}
+        ts = time.perf_counter()
+        stream = run_streaming(_fvp_args(vcf_in, out_path), model, fasta, {}, None)
+        # VCTPU_THREADS=1 selects the serial path by design, so that leg's
+        # end-to-end IS the serial stage total — the streaming row then
+        # reads as "serial e2e vs overlapped e2e"
+        walls["streaming_e2e"] = (time.perf_counter() - ts) if stream is not None \
+            else walls["ingest"] + walls["featurize_score"] + walls["writeback"]
+        return walls
+
+    prev_nat = os.environ.get("VCTPU_NATIVE_THREADS")
+    prev_thr = os.environ.get("VCTPU_THREADS")
+    try:
+        os.environ["VCTPU_NATIVE_THREADS"] = "1"
+        os.environ["VCTPU_THREADS"] = "1"  # single-thread leg: serial pipeline
+        stage_walls()  # warm
+        one = stage_walls()
+        os.environ["VCTPU_NATIVE_THREADS"] = str(cores)
+        os.environ.pop("VCTPU_THREADS", None)
+        many = stage_walls()
+    finally:
+        for k, v in (("VCTPU_NATIVE_THREADS", prev_nat), ("VCTPU_THREADS", prev_thr)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    table = {}
+    for k in one:
+        table[k] = {"t1_s": round(one[k], 3), f"t{cores}_s": round(many[k], 3),
+                    "speedup": round(one[k] / many[k], 2) if many[k] == many[k] and many[k] > 0 else None}
+    # the streaming single-thread leg runs the SERIAL path by design
+    # (VCTPU_THREADS=1 selects it), so its row is serial-vs-streaming
+    return {"cores": cores, "stages": table}
 
 
 def sec_fixture() -> np.ndarray:
@@ -552,14 +757,21 @@ def child_main(fixture_dir: str) -> None:
         phase("coverage", coverage_reduce, min_remaining=30)
     if want("sec"):
         phase("sec", sec_aggregate, min_remaining=25)
+    if want("scaling") and cpu:
+        # host-stage thread scaling (CPU engine legs; device phases are
+        # unaffected by VCTPU_NATIVE_THREADS)
+        phase("scaling", lambda: host_scaling(fixture_dir), min_remaining=50)
     if want("e2e"):
-        phase("e2e", lambda: e2e_pipeline(fixture_dir), min_remaining=100)
+        phase("e2e", lambda: e2e_pipeline(fixture_dir), min_remaining=70)
+    # budgets rebalanced so the committed per-round artifact is
+    # self-contained (round-5 VERDICT item 6: genome3g died mid-phase):
+    # streaming e2e_5m ≈ fixture 50s + runs ~25s, genome3g ≈ fixture ~100s
+    # + run ~40s — both fit the default 450s child budget with the device
+    # phases' ~60s in front
     if want("e2e_5m"):
-        phase("e2e_5m", lambda: e2e_5m_pipeline(fixture_dir), min_remaining=180)
-    # the at-scale proof needs ~4 min of fixtures+run; only attempted when
-    # the budget clearly allows (standalone: python bench.py --genome3g)
+        phase("e2e_5m", lambda: e2e_5m_pipeline(fixture_dir), min_remaining=120)
     if want("genome3g"):
-        phase("genome3g", lambda: genome3g_pipeline(fixture_dir), min_remaining=280)
+        phase("genome3g", lambda: genome3g_pipeline(fixture_dir), min_remaining=160)
 
 
 # --------------------------------------------------------------------------
@@ -747,7 +959,9 @@ def _has_numbers(child: dict | None) -> bool:
 
 def main(tpu_only: bool = False) -> None:
     with tempfile.TemporaryDirectory(prefix="vctpu_bench_") as d:
-        make_fixtures(d)
+        # vectorized writer (seconds, not phase budget); 4 contigs so the
+        # 1M e2e/scaling legs exercise multi-contig chunking
+        make_fixtures_fast(d, n=E2E_N, genome_len=E2E_GENOME)
         budget = int(os.environ.get("VCTPU_BENCH_TIMEOUT", "480"))
         if tpu_only:
             # fast chip capture for brief tunnel-recovery windows: device
@@ -807,7 +1021,8 @@ def main(tpu_only: bool = False) -> None:
         out["value"] = hot.get("vps", 0)
         out["device"] = child.get("device", "?")
         out["attempt"] = label
-        for k in ("hot_small", "hot", "e2e", "e2e_5m", "skipped", "phase_errors", "incomplete"):
+        for k in ("hot_small", "hot", "e2e", "e2e_5m", "genome3g", "scaling",
+                  "skipped", "phase_errors", "incomplete"):
             if k in child:
                 out[k] = child[k]
         def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:

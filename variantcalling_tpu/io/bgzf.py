@@ -46,16 +46,27 @@ class BgzfWriter:
         self._buf = bytearray()
         self._level = level
 
-    def write(self, data: str | bytes) -> int:
+    def write(self, data: str | bytes | memoryview) -> int:
         if isinstance(data, str):
             data = data.encode("utf-8")
+        n_in = len(data)
+        # large-write fast path (the streaming executor hands multi-MB
+        # chunk bodies): compress straight from the caller's buffer instead
+        # of round-tripping every byte through the bytearray twice
+        if not self._buf and n_in >= MAX_BLOCK_DATA:
+            view = memoryview(data)
+            n_full = (n_in // MAX_BLOCK_DATA) * MAX_BLOCK_DATA
+            self._fh.write(self._compress_blocks(bytes(view[:n_full])))
+            if n_full < n_in:
+                self._buf += view[n_full:]
+            return n_in
         self._buf += data
         if len(self._buf) >= MAX_BLOCK_DATA:
             n_full = (len(self._buf) // MAX_BLOCK_DATA) * MAX_BLOCK_DATA
             chunk = bytes(self._buf[:n_full])
             del self._buf[:n_full]
             self._fh.write(self._compress_blocks(chunk))
-        return len(data)
+        return n_in
 
     def _compress_blocks(self, chunk: bytes) -> bytes:
         """Compress a multiple-of-block-size payload (C path when built)."""

@@ -226,13 +226,14 @@ class _LazyCols:
     Materialization decodes buffer slices once per column on first access;
     row subsets just subset the span arrays, so a pipeline that never
     touches REF/ALT/INFO strings never pays for them. The backing is the
-    SAME bytes object the NativeAux buffer views (np.frombuffer), so
-    laziness adds no memory beyond the span arrays.
+    SAME bytes object the NativeAux buffer views (np.frombuffer) — or a
+    uint8 array/memmap on the chunked-ingest path — so laziness adds no
+    memory beyond the span arrays.
     """
 
     __slots__ = ("buf", "spans")
 
-    def __init__(self, buf: bytes, spans: dict):
+    def __init__(self, buf, spans: dict):
         self.buf = buf
         self.spans = spans
 
@@ -242,6 +243,10 @@ class _LazyCols:
     def materialize(self, name: str) -> np.ndarray:
         spans = self.spans[name].tolist()
         buf = self.buf
+        if isinstance(buf, np.ndarray):
+            # one decode-side copy per chunk, cached so sibling columns
+            # (vid/ref/alt/filters/info) don't re-copy the same buffer
+            self.buf = buf = bytes(memoryview(buf))
         out = np.empty(len(spans), dtype=object)
         for i, (a, b) in enumerate(spans):
             out[i] = buf[a:b].decode("latin-1")
@@ -495,6 +500,31 @@ class VariantTable:
         return out
 
 
+def parse_header_bytes(bufb: bytes) -> tuple[VcfHeader, int]:
+    """Parse the '#' header region of a VCF byte buffer.
+
+    Returns (header, offset of the first record line). Shared by the
+    whole-file native ingest and the chunked streaming reader so the two
+    can never disagree on header handling.
+    """
+    header = VcfHeader()
+    off, n = 0, len(bufb)
+    while off < n:
+        nl = bufb.find(b"\n", off)
+        end = nl if nl >= 0 else n
+        if end > off and bufb[off : off + 1] != b"#":
+            break
+        line = bufb[off:end].decode("utf-8", "replace")
+        if line.startswith("##"):
+            header.add_meta_line(line)
+        elif line.startswith("#"):
+            cols = line.rstrip("\r").split("\t")
+            if len(cols) > 9:
+                header.samples = cols[9:]
+        off = end + 1
+    return header, min(off, n)
+
+
 def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | None:
     """Whole-file ingest through the C++ one-pass scanner (native/src).
 
@@ -522,25 +552,23 @@ def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | Non
             bufb = fh.read()
     buf_np = np.frombuffer(bufb, dtype=np.uint8)
 
-    header = VcfHeader()
-    off, n = 0, len(bufb)
-    while off < n:
-        nl = bufb.find(b"\n", off)
-        end = nl if nl >= 0 else n
-        if end > off and bufb[off : off + 1] != b"#":
-            break
-        line = bufb[off:end].decode("utf-8", "replace")
-        if line.startswith("##"):
-            header.add_meta_line(line)
-        elif line.startswith("#"):
-            cols = line.rstrip("\r").split("\t")
-            if len(cols) > 9:
-                header.samples = cols[9:]
-        off = end + 1
+    header, _ = parse_header_bytes(bufb)
 
     parsed = native.vcf_parse(buf_np, len(header.samples))
     if parsed is None:
         return None
+    return _table_from_parsed(parsed, header, bufb, buf_np, drop_format)
+
+
+def _table_from_parsed(parsed: dict, header: VcfHeader, bufb, buf_np: np.ndarray,
+                       drop_format: bool) -> VariantTable:
+    """Assemble a VariantTable from a native scan result over ``buf_np``.
+
+    ``bufb`` backs the lazy string columns (bytes for whole-file ingest, a
+    uint8 view for chunked ingest). Shared by :func:`_read_vcf_native` and
+    :class:`VcfChunkReader` so whole-file and chunked tables are built
+    identically.
+    """
     nrec = parsed["n"]
 
     # the five record string columns stay lazy (spans into the shared byte
@@ -556,6 +584,8 @@ def _read_vcf_native(path: str, drop_format: bool = False) -> VariantTable | Non
             "info": parsed["info_spans"],
         },
     )
+
+    from variantcalling_tpu import native
 
     chrom_names = np.array(parsed["chroms"] + [""], dtype=object)
     if drop_format:
@@ -726,6 +756,135 @@ def read_vcf(
             sc[i, :] = tup
         table.sample_cols = sc
     return table
+
+
+#: default streaming chunk size (bytes of VCF text per pipeline item);
+#: ~16 MB is ~80-250K records of a typical callset — large enough that the
+#: native per-chunk scan still shards across threads, small enough that a
+#: few in-flight chunks bound pipeline memory at O(100 MB) and the stage
+#: pipeline load-balances (the 5M sweep: 16 MB ≈ 0.88M v/s vs 32 MB ≈
+#: 0.73M v/s on a 2-core host — coarser chunks idle the overlap at the
+#: head and tail of the run)
+STREAM_CHUNK_BYTES = int(os.environ.get("VCTPU_STREAM_CHUNK_BYTES", 16 << 20))
+
+
+class VcfChunkReader:
+    """Line-aligned chunked native VCF ingest for the streaming executor.
+
+    Iterating yields :class:`VariantTable` chunks in file order, each
+    parsed by the same native scanner + table assembly the whole-file path
+    uses (so per-chunk tables are indistinguishable from row-slices of the
+    whole-file table). Sources:
+
+    - plain ``.vcf``: a memory map, sliced at line boundaries — the file
+      never fully materializes in anonymous memory, so peak RSS does not
+      scale with input size;
+    - ``.gz``/``.bgz``: streamed decompression (zlib releases the GIL), one
+      independent bytes buffer per chunk with partial-line carry — again
+      O(chunk) resident, not O(file).
+
+    One-shot: the underlying stream is consumed by iteration. Requires
+    the native library (callers gate on ``native.available()``); a
+    mid-stream scan failure raises rather than silently degrading.
+    """
+
+    def __init__(self, path: str, chunk_bytes: int = 0):
+        from variantcalling_tpu import native
+
+        if not native.available():
+            raise RuntimeError("VcfChunkReader requires the native engine")
+        self.path = str(path)
+        self.chunk_bytes = int(chunk_bytes) or STREAM_CHUNK_BYTES
+        self._gz = self.path.endswith((".gz", ".bgz"))
+        self._mm: np.ndarray | None = None
+        self._fh = None
+        self._pending = b""
+        if self._gz:
+            self._fh = gzip.open(self.path, "rb")
+            head = b""
+            while True:
+                block = self._fh.read(self.chunk_bytes)
+                head += block
+                header, first_off = parse_header_bytes(head)
+                # complete when a record line begins, or the stream ended
+                if not block or (first_off < len(head) and head[first_off : first_off + 1] != b"#"):
+                    break
+            self.header = header
+            self._pending = head[first_off:]
+        else:
+            size = os.path.getsize(self.path)
+            self._mm = (np.memmap(self.path, dtype=np.uint8, mode="r")
+                        if size else np.empty(0, dtype=np.uint8))
+            cap = 1 << 20
+            while True:
+                head = bytes(memoryview(self._mm[: min(cap, size)]))
+                header, first_off = parse_header_bytes(head)
+                if (first_off < len(head) and head[first_off : first_off + 1] != b"#") \
+                        or cap >= size:
+                    break
+                cap *= 8
+            self.header = header
+            self._first_off = first_off
+
+    def _parse_chunk(self, buf_np: np.ndarray, lazy_buf) -> VariantTable:
+        from variantcalling_tpu import native
+
+        parsed = native.vcf_parse(buf_np, len(self.header.samples))
+        if parsed is None:
+            raise RuntimeError(f"native VCF scan failed mid-stream in {self.path}")
+        return _table_from_parsed(parsed, self.header, lazy_buf, buf_np,
+                                  drop_format=False)
+
+    def __iter__(self):
+        if self._gz:
+            yield from self._iter_gz()
+        else:
+            yield from self._iter_mm()
+
+    def _iter_mm(self):
+        mm = self._mm
+        n = len(mm)
+        off = self._first_off
+        while off < n:
+            end = min(off + self.chunk_bytes, n)
+            if end < n:
+                # align to the next newline (probe window grows for the
+                # pathological all-one-line case)
+                probe = 1 << 16
+                while True:
+                    w = mm[end: min(end + probe, n)]
+                    hits = np.flatnonzero(w == 0x0A)
+                    if len(hits):
+                        end = end + int(hits[0]) + 1
+                        break
+                    if end + probe >= n:
+                        end = n
+                        break
+                    probe *= 8
+            view = mm[off:end]
+            yield self._parse_chunk(view, view)
+            off = end
+
+    def _iter_gz(self):
+        carry = self._pending
+        self._pending = b""
+        while True:
+            block = self._fh.read(self.chunk_bytes)
+            if not block:
+                break
+            block = carry + block
+            cut = block.rfind(b"\n")
+            if cut < 0:
+                carry = block
+                continue
+            carry = block[cut + 1 :]
+            chunk = block[: cut + 1]
+            buf_np = np.frombuffer(chunk, dtype=np.uint8)
+            yield self._parse_chunk(buf_np, chunk)
+        if carry:
+            buf_np = np.frombuffer(carry, dtype=np.uint8)
+            yield self._parse_chunk(buf_np, carry)
+        self._fh.close()
 
 
 def format_qual(q: float) -> str:
@@ -910,18 +1069,13 @@ def _encode_column_factorized(values, n: int) -> tuple[np.ndarray, np.ndarray]:
     return buf, offs
 
 
-def _write_assembled_native(out, table: VariantTable, new_filters, extra_info) -> bool:
-    """Native record assembly (verbatim CHROM..QUAL head; see write_vcf),
-    streamed in record chunks through ONE reused output buffer — a
-    whole-callset buffer would touch ~400 MB of fresh pages at 5M records
-    and then sweep them again for the file write; chunking keeps the
-    working set page-warm. Returns False (nothing written) when the
-    native engine is unavailable."""
+def _filter_info_blobs(table: VariantTable, new_filters, extra_info):
+    """(filt_buf, filt_offs, sfx_buf, sfx_offs) for native record assembly.
+
+    Shared by the whole-table writeback and the per-chunk streaming
+    renderer so the two produce identical bytes by construction."""
     from variantcalling_tpu import native
 
-    aux = table.aux
-    if aux is None or aux.buf is None or not native.available():
-        return False
     n = len(table)
     filters = new_filters if new_filters is not None else table.filters
     filt_buf, filt_offs = _encode_column_factorized(filters, n)
@@ -942,6 +1096,49 @@ def _write_assembled_native(out, table: VariantTable, new_filters, extra_info) -
         sfx_offs = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(np.fromiter(map(len, suffix), dtype=np.int64, count=n), out=sfx_offs[1:])
         sfx_buf = np.frombuffer(b"".join(suffix), dtype=np.uint8)
+    return filt_buf, filt_offs, sfx_buf, sfx_offs
+
+
+def assemble_table_bytes(table: VariantTable, new_filters=None, extra_info=None,
+                         out: np.ndarray | None = None) -> np.ndarray | None:
+    """Render one table's record body as a uint8 array via the native
+    engine (the streaming executor's per-chunk writeback stage). Returns
+    None when the native engine or the parse buffer is unavailable —
+    callers fall back to :func:`render_table_bytes_python`."""
+    from variantcalling_tpu import native
+
+    aux = table.aux
+    if aux is None or aux.buf is None or not native.available():
+        return None
+    filt_buf, filt_offs, sfx_buf, sfx_offs = _filter_info_blobs(table, new_filters, extra_info)
+    return native.vcf_assemble(
+        aux.buf, aux.line_spans, aux.filter_spans, aux.info_spans, aux.tail_spans,
+        filt_buf, filt_offs, sfx_buf, sfx_offs, out=out)
+
+
+def render_table_bytes_python(table: VariantTable, new_filters=None,
+                              extra_info=None) -> bytes:
+    """Python twin of :func:`assemble_table_bytes` (same bytes as the
+    per-record writer path), for engines without the native library."""
+    sink = _io.BytesIO()
+    _write_records_fast(sink, table, new_filters, extra_info)
+    return sink.getvalue()
+
+
+def _write_assembled_native(out, table: VariantTable, new_filters, extra_info) -> bool:
+    """Native record assembly (verbatim CHROM..QUAL head; see write_vcf),
+    streamed in record chunks through ONE reused output buffer — a
+    whole-callset buffer would touch ~400 MB of fresh pages at 5M records
+    and then sweep them again for the file write; chunking keeps the
+    working set page-warm. Returns False (nothing written) when the
+    native engine is unavailable."""
+    from variantcalling_tpu import native
+
+    aux = table.aux
+    if aux is None or aux.buf is None or not native.available():
+        return False
+    n = len(table)
+    filt_buf, filt_offs, sfx_buf, sfx_offs = _filter_info_blobs(table, new_filters, extra_info)
 
     # blob offsets are absolute, so chunk slices pass the full blobs with
     # an offsets window; spans slice to contiguous row ranges

@@ -207,6 +207,15 @@ def get_lib() -> ctypes.CDLL | None:
             ctypes.c_int32, ctypes.c_float,
             ctypes.POINTER(ctypes.c_float),
         ]
+        lib.vctpu_fasta_encode.restype = _i64
+        lib.vctpu_fasta_encode.argtypes = [
+            _u8p, _i64, _i64, _i64, _i64, _u8p,
+        ]
+        lib.vctpu_coverage_stats.restype = _i64
+        lib.vctpu_coverage_stats.argtypes = [
+            _i32p, _i64, _i64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), _i64p,
+        ]
         lib.vctpu_gbt_fit.restype = _i64
         lib.vctpu_gbt_fit.argtypes = [
             _u8p, _f32p, _f32p,
@@ -883,6 +892,54 @@ def matrix_forest_predict(cols: list[np.ndarray], feat: np.ndarray, thr: np.ndar
         out.ctypes.data_as(_f32p),
     )
     return out if rc == 0 else None
+
+
+def fasta_encode(raw: np.ndarray, line_bases: int, line_width: int,
+                 length: int, out: np.ndarray | None = None) -> np.ndarray | None:
+    """Threaded FASTA body encode (newline strip + ACGT->0..3 table, else 4).
+
+    ``raw`` is the contig's byte region starting at its .fai offset; the
+    result is byte-identical to the numpy reshape+lookup fallback in
+    io/fasta._encode_contig. ``out`` lets callers encode into a slice of a
+    preallocated whole-genome buffer. None -> numpy fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(_u8view(raw))
+    if out is None or len(out) != length or out.dtype != np.uint8 \
+            or not out.flags["C_CONTIGUOUS"]:
+        out = np.empty(length, dtype=np.uint8)
+    rc = lib.vctpu_fasta_encode(
+        src.ctypes.data_as(_u8p), len(src),
+        int(line_bases), int(line_width), int(length),
+        out.ctypes.data_as(_u8p),
+    )
+    return out if rc == 0 else None
+
+
+def coverage_stats(data: np.ndarray, window: int, max_bin: int = 1000,
+                   from_diffs: bool = False) -> tuple[np.ndarray, np.ndarray] | None:
+    """Fused single-pass coverage reduce: (per-window f32 means,
+    (max_bin+1,) int64 clipped histogram). ``from_diffs`` treats ``data``
+    as a difference array (running cumsum = depth) so the bam/cram depth
+    path reduces without materializing the depth vector. None -> fallback."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    d = np.ascontiguousarray(data, dtype=np.int32)
+    n = len(d)
+    n_win = -(-n // window) if n else 0
+    means = np.empty(n_win, dtype=np.float32)
+    hist = np.empty(max_bin + 1, dtype=np.int64)
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    rc = lib.vctpu_coverage_stats(
+        d.ctypes.data_as(_i32p), n, int(window), int(max_bin),
+        int(bool(from_diffs)),
+        means.ctypes.data_as(_f32p), hist.ctypes.data_as(_i64p),
+    )
+    if rc != 0:
+        return None
+    return means, hist
 
 
 def gbt_fit(binned: np.ndarray, y: np.ndarray, w: np.ndarray | None,

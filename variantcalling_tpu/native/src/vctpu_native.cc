@@ -943,3 +943,127 @@ int64_t vctpu_vcf_assemble(
 }
 
 }  // extern "C"
+
+namespace {
+
+struct BaseTable {
+    uint8_t t[256];
+    BaseTable() {
+        for (int i = 0; i < 256; ++i) t[i] = 4;
+        t[(int)'A'] = t[(int)'a'] = 0;
+        t[(int)'C'] = t[(int)'c'] = 1;
+        t[(int)'G'] = t[(int)'g'] = 2;
+        t[(int)'T'] = t[(int)'t'] = 3;
+    }
+};
+const BaseTable kBase;
+
+}  // namespace
+
+extern "C" {
+
+// FASTA body 2-bit-class encode: strip the newline framing and map
+// ACGTacgt -> 0..3 (anything else 4). ``buf`` points at the first sequence
+// byte of one contig (the .fai offset is applied by the caller); the body
+// is line_bases content bytes per line_width-byte stride, last line may be
+// short. Sharded over OUTPUT positions (pure map, disjoint writes), so the
+// result is byte-identical to the serial walk at any thread count.
+// Returns 0, or -1 when the framing doesn't cover ``length`` bases.
+int64_t vctpu_fasta_encode(const uint8_t* buf, int64_t buf_len,
+                           int64_t line_bases, int64_t line_width,
+                           int64_t length, uint8_t* out) try {
+    if (length <= 0) return length == 0 ? 0 : -1;
+    if (line_bases <= 0 || line_width < line_bases) return -1;
+    const int64_t last_line = (length - 1) / line_bases;
+    const int64_t need =
+        last_line * line_width + ((length - 1) - last_line * line_bases) + 1;
+    if (need > buf_len) return -1;
+    const int64_t gap = line_width - line_bases;
+    vctpu::for_shards(length, vctpu::nthreads(), [&](int, int64_t lo, int64_t hi) {
+        int64_t line = lo / line_bases;
+        int64_t col = lo - line * line_bases;
+        const uint8_t* src = buf + line * line_width + col;
+        for (int64_t i = lo; i < hi; ++i) {
+            out[i] = kBase.t[*src++];
+            if (++col == line_bases) {
+                col = 0;
+                src += gap;
+            }
+        }
+    }, 1 << 16);
+    return 0;
+} catch (...) {
+    return -1;
+}
+
+// Fused coverage reduce: per-window mean + clipped depth histogram in ONE
+// pass over the depth vector, sharded at window-aligned boundaries with
+// per-shard histograms merged at the end (the ops/coverage.py jitted
+// program runs three kernels and a second sweep; at genome scale the
+// multi-pass working set falls out of cache — this streams it once in
+// cache-sized window tiles). ``from_diffs`` != 0 treats the input as a
+// difference array whose running cumsum is the depth — the bam/cram depth
+// path can reduce without ever materializing the depth vector (a cheap
+// per-shard total pre-pass seeds each shard's running depth).
+//
+// means_out: ceil(n/window) float32 (tail window averages its remainder —
+// binned_mean semantics). While every window SUM stays exactly
+// representable in f32 (< 2^24 — always true at WGS depth scales, e.g.
+// 60x over 1 kb windows sums to ~6e4) the result is bit-identical to the
+// jitted f32-accumulation kernel; beyond that the exact int64 sum with
+// ONE final rounding here is more accurate than f32 accumulation, not
+// equal to it. hist_out: (max_bin+1) int64, depths clipped into
+// [0, max_bin]. Returns 0, -1 on bad args.
+int64_t vctpu_coverage_stats(const int32_t* data, int64_t n, int64_t window,
+                             int32_t max_bin, int32_t from_diffs,
+                             float* means_out, int64_t* hist_out) try {
+    if (n < 0 || window <= 0 || max_bin < 0) return -1;
+    const int64_t n_win = n ? (n + window - 1) / window : 0;
+    const int bins = max_bin + 1;
+    for (int b = 0; b < bins; ++b) hist_out[b] = 0;
+    if (n == 0) return 0;
+    const int t_count = vctpu::nthreads();
+    const int max_shards = (t_count > 1 && n_win >= 8) ? t_count : 1;
+    std::vector<int64_t> base(max_shards + 1, 0);
+    if (from_diffs) {
+        // pre-pass: per-shard diff totals -> running-depth offset per shard
+        // (shard ranges are identical across both for_shards calls: same
+        // n_win / max_shards / min_per_shard)
+        std::vector<int64_t> tot(max_shards, 0);
+        const int used = vctpu::for_shards(n_win, max_shards,
+                                           [&](int t, int64_t wlo, int64_t whi) {
+            const int64_t lo = wlo * window, hi = std::min(n, whi * window);
+            int64_t s = 0;
+            for (int64_t i = lo; i < hi; ++i) s += data[i];
+            tot[t] = s;
+        }, 1);
+        for (int t = 0; t < used; ++t) base[t + 1] = base[t] + tot[t];
+    }
+    std::vector<std::vector<int64_t>> hists(max_shards);
+    vctpu::for_shards(n_win, max_shards, [&](int t, int64_t wlo, int64_t whi) {
+        std::vector<int64_t>& h = hists[t];
+        h.assign(bins, 0);
+        int64_t run = base[t];
+        for (int64_t w = wlo; w < whi; ++w) {
+            const int64_t lo = w * window, hi = std::min(n, lo + window);
+            int64_t sum = 0;
+            for (int64_t i = lo; i < hi; ++i) {
+                const int64_t d = from_diffs ? (run += data[i]) : data[i];
+                sum += d;
+                const int64_t b = d < 0 ? 0 : (d > max_bin ? max_bin : d);
+                ++h[b];
+            }
+            // f32/f32 divide: bit-identical to the jitted binned_mean
+            // while the exact sum fits f32 (see header comment)
+            means_out[w] = (float)sum / (float)(hi - lo);
+        }
+    }, 1);
+    for (auto& h : hists)
+        if ((int)h.size() == bins)
+            for (int b = 0; b < bins; ++b) hist_out[b] += h[b];
+    return 0;
+} catch (...) {
+    return -1;
+}
+
+}  // extern "C"

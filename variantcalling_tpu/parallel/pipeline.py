@@ -1,0 +1,183 @@
+"""Bounded-queue ordered stage executor — the host-side streaming pipeline.
+
+The flagship filter path used to run its three host stages strictly in
+sequence (whole-file ingest -> featurize+score -> whole-file writeback),
+so end-to-end wall time was the SUM of the stages even though each stage
+leaves cores idle (ingest/writeback are I/O-and-glue heavy, scoring is
+compute heavy). This executor runs the stages as a chunked pipeline over
+sequence-numbered items: one worker thread per stage, bounded queues
+between stages, results consumed strictly in submission order. Stage time
+then hides behind the slowest stage instead of summing — the same
+argument the GPU variant-calling pipeline literature makes for overlapping
+I/O around the compute kernel (PAPERS.md, "Optimizing the Variant Calling
+Pipeline Execution ... Using GPU-Enabled Machines"; GenPIP's stage fusion).
+
+Design rules:
+
+- one thread per stage, FIFO queues: per-stage order is preserved by
+  construction, so output ordering needs no reorder buffer — items leave
+  the last stage in exactly the order the source yielded them (each item
+  carries its sequence number and the consumer asserts it);
+- bounded queues (``queue_depth``): at most ``queue_depth`` items wait
+  between any two stages, so peak memory is O(stages * queue_depth *
+  chunk), never O(input);
+- ``VCTPU_THREADS=1`` (or a single-core host) degrades to a plain serial
+  loop through the same stage callables — byte-identical results, no
+  threads, no queues;
+- a stage exception cancels the whole pipeline promptly (stop event +
+  queue drain) and re-raises in the consumer.
+
+The GIL is not a problem here: stage bodies are native engine calls,
+numpy, and file I/O, all of which release it.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from collections.abc import Callable, Iterable, Iterator
+
+_SENTINEL = object()
+
+
+def resolve_threads() -> int:
+    """Pipeline thread policy: VCTPU_THREADS overrides, else cpu count.
+
+    ``VCTPU_THREADS=1`` is the documented switch for "run the serial
+    path"; invalid values fall back to auto so a typo can't crash a run.
+    """
+    env = os.environ.get("VCTPU_THREADS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return os.cpu_count() or 1
+
+
+class StagePipeline:
+    """Run items through ``stages`` (list of callables) with stage overlap.
+
+    ``run(source)`` yields ``stages[-1](...stages[0](item))`` for every
+    item of ``source``, in source order. With >1 resolved threads each
+    stage runs in its own worker thread connected by bounded queues; with
+    1 thread the same callables run inline (the serial path).
+    """
+
+    def __init__(self, stages: list[Callable], queue_depth: int = 2,
+                 threads: int | None = None):
+        if not stages:
+            raise ValueError("StagePipeline needs at least one stage")
+        self.stages = list(stages)
+        self.queue_depth = max(1, int(queue_depth))
+        self.threads = resolve_threads() if threads is None else max(1, int(threads))
+
+    @property
+    def parallel(self) -> bool:
+        return self.threads > 1
+
+    # -- serial path -------------------------------------------------------
+
+    def _run_serial(self, source: Iterable) -> Iterator:
+        for item in source:
+            for fn in self.stages:
+                item = fn(item)
+            yield item
+
+    # -- threaded path -----------------------------------------------------
+
+    def run(self, source: Iterable) -> Iterator:
+        if not self.parallel:
+            yield from self._run_serial(source)
+            return
+
+        stop = threading.Event()
+        queues = [queue.Queue(maxsize=self.queue_depth)
+                  for _ in range(len(self.stages) + 1)]
+
+        def _put(q: queue.Queue, item) -> bool:
+            # bounded put that stays responsive to cancellation
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        # NOTE error relay: a failing stage/source puts an (_SENTINEL, exc)
+        # tuple downstream and exits — it does NOT set the stop event, or
+        # the next stage could observe stop before draining the error and
+        # the consumer would see a bare cancellation instead of the real
+        # exception. Only the consumer sets stop (on error or completion);
+        # upstream workers blocked on full queues unblock when it drains.
+
+        def _feed() -> None:
+            try:
+                for seq, item in enumerate(source):
+                    if not _put(queues[0], (seq, item)):
+                        return
+                _put(queues[0], _SENTINEL)
+            except BaseException as e:  # noqa: BLE001 — relay to the consumer
+                _put(queues[0], (_SENTINEL, e))
+
+        def _stage(i: int, fn: Callable) -> None:
+            q_in, q_out = queues[i], queues[i + 1]
+            try:
+                while not stop.is_set():
+                    try:
+                        got = q_in.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    if got is _SENTINEL or (isinstance(got, tuple) and got[0] is _SENTINEL):
+                        _put(q_out, got)
+                        return
+                    seq, item = got
+                    _put(q_out, (seq, fn(item)))
+            except BaseException as e:  # noqa: BLE001 — relay to the consumer
+                _put(q_out, (_SENTINEL, e))
+
+        workers = [threading.Thread(target=_feed, name="pipe-src", daemon=True)]
+        workers += [
+            threading.Thread(target=_stage, args=(i, fn),
+                             name=f"pipe-stage{i}", daemon=True)
+            for i, fn in enumerate(self.stages)
+        ]
+        for w in workers:
+            w.start()
+        expect = 0
+        try:
+            while True:
+                try:
+                    got = queues[-1].get(timeout=0.1)
+                except queue.Empty:
+                    if stop.is_set():
+                        # a failed stage may have died before relaying
+                        raise RuntimeError("stage pipeline cancelled")
+                    continue
+                if got is _SENTINEL:
+                    return
+                if isinstance(got, tuple) and got[0] is _SENTINEL:
+                    raise got[1]
+                seq, item = got
+                # single-thread-per-stage FIFO makes this a hard invariant
+                assert seq == expect, (seq, expect)
+                expect += 1
+                yield item
+        finally:
+            stop.set()
+            for q in queues:  # unblock any worker parked on a full queue
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+            for w in workers:
+                w.join(timeout=5.0)
+
+
+def run_pipeline(source: Iterable, stages: list[Callable],
+                 queue_depth: int = 2, threads: int | None = None) -> Iterator:
+    """Convenience wrapper: ``StagePipeline(stages, ...).run(source)``."""
+    return StagePipeline(stages, queue_depth=queue_depth, threads=threads).run(source)

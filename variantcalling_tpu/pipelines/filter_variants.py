@@ -461,6 +461,136 @@ def score_variants(model, x: np.ndarray, feature_names: list[str]) -> np.ndarray
     return out
 
 
+class FilterContext:
+    """Chunk-invariant scoring state for the filter pipeline.
+
+    Built once per run (model wiring, blacklist, hpol runs file, interval
+    sets), then :meth:`score_table` is applied to the whole table (serial
+    path) or to each streamed chunk (streaming executor). Every product is
+    row-local by construction — a variant's TREE_SCORE and FILTER depend
+    only on that variant's record plus this shared state — which is what
+    makes chunked scoring bit-identical to whole-table scoring.
+    """
+
+    def __init__(
+        self,
+        model,
+        fasta: FastaReader,
+        runs_file: str | None = None,
+        hpol_length: int = 10,
+        hpol_dist: int = 10,
+        blacklist: tuple[np.ndarray, np.ndarray] | None = None,
+        blacklist_cg_insertions: bool = False,
+        annotate_intervals: dict[str, bedio.IntervalSet] | None = None,
+        flow_order: str = "TGCA",
+        is_mutect: bool = False,
+    ):
+        self.model = model
+        self.fasta = fasta
+        self.hpol_length = hpol_length
+        self.hpol_dist = hpol_dist
+        self.blacklist = blacklist
+        self.blacklist_cg_insertions = blacklist_cg_insertions
+        self.annotate_intervals = annotate_intervals
+        self.flow_order = flow_order
+        self.is_mutect = is_mutect
+        # xgboost models define missing-value semantics on NaN (default_left
+        # routing): zero-filling absent fields would walk the wrong branch
+        self.keep_nan = getattr(model, "default_left", None) is not None
+        self.extra_info = ["TLOD"] if is_mutect else []
+        # hpol runs load once (length-filtered); globalization waits for the
+        # first table so contig lengths come from its header exactly as the
+        # single-shot path did
+        self._runs: bedio.IntervalSet | None = None
+        if runs_file:
+            runs = bedio.read_bed(runs_file)
+            keep = (runs.end - runs.start) >= hpol_length
+            self._runs = bedio.IntervalSet(runs.chrom[keep], runs.start[keep], runs.end[keep])
+        self._runs_global: tuple | None = None
+
+    def _hpol_near(self, table: VariantTable) -> np.ndarray | None:
+        if self._runs is None or not len(self._runs):
+            return None
+        if self._runs_global is None:
+            contig_lengths = table.header.contig_lengths or {
+                c: self.fasta.get_reference_length(c) for c in self.fasta.references
+            }
+            coords = iops.GenomeCoords(contig_lengths)
+            self._runs_global = (coords, *coords.globalize_intervals(self._runs))
+        coords, gs, ge = self._runs_global
+        gpos = coords.globalize(np.asarray(table.chrom), table.pos - 1)
+        return iops.distance_to_nearest(gpos, gs, ge) <= self.hpol_dist
+
+    def score_table(self, table: VariantTable) -> tuple[np.ndarray, np.ndarray]:
+        """Score one table (whole callset or one streamed chunk); returns
+        (tree_score float array, FILTER FactorizedColumn)."""
+        model, fasta = self.model, self.fasta
+        # host windows are needed only by the cg-insertion check and the
+        # raw-sklearn fallback; the fused path gathers windows from the
+        # device-resident genome instead — unless the job is too small to
+        # justify the whole-genome HBM upload (_genome_resident_worthwhile)
+        from variantcalling_tpu.featurize import (_genome_resident_worthwhile,
+                                                  standard_genome_sharding)
+
+        genome_sharding = standard_genome_sharding()
+        needs_host_windows = (
+            self.blacklist_cg_insertions
+            or not isinstance(model, (FlatForest, ThresholdModel))
+            or not _genome_resident_worthwhile(table, fasta, sharding=genome_sharding)
+        )
+        hf = host_featurize(table, fasta, annotate_intervals=self.annotate_intervals,
+                            extra_info_fields=self.extra_info,
+                            compute_windows=needs_host_windows, keep_nan=self.keep_nan)
+        if self.is_mutect and "TLOD" in hf.cols:
+            hf.cols["tlod"] = hf.cols.pop("TLOD")
+            hf.names[hf.names.index("TLOD")] = "tlod"
+        if isinstance(model, (FlatForest, ThresholdModel)):
+            # fused featurize+score: window features and the forest walk run
+            # as one device program, only TREE_SCORE returns to the host
+            score = fused_featurize_score(model, hf, self.flow_order, table=table, fasta=fasta)
+        else:  # raw sklearn estimator: materialize the matrix from the same hf
+            from variantcalling_tpu.featurize import materialize_features
+
+            fs = materialize_features(hf, flow_order=self.flow_order)
+            score = score_variants(model, fs.matrix(), fs.feature_names)
+
+        pass_thr = getattr(model, "pass_threshold", 0.5)
+        n = len(table)
+        low = score < pass_thr
+
+        cohort_fp = np.zeros(n, dtype=bool)
+        blacklist = self.blacklist
+        if blacklist is not None and len(blacklist[0]):
+            # vectorized (chrom, pos) join: map chroms to small ints, pack into
+            # one int64 key, sorted-membership — no per-record Python on the 5M path
+            chroms = {c: i for i, c in enumerate(dict.fromkeys(np.concatenate([blacklist[0], table.chrom]).tolist()))}
+            cidx_bl = np.fromiter((chroms[c] for c in blacklist[0]), dtype=np.int64, count=len(blacklist[0]))
+            cidx_tb = np.fromiter((chroms[c] for c in table.chrom), dtype=np.int64, count=n)
+            key_bl = np.sort((cidx_bl << 40) | blacklist[1].astype(np.int64))
+            key_tb = (cidx_tb << 40) | table.pos.astype(np.int64)
+            loc = np.searchsorted(key_bl, key_tb)
+            loc = np.minimum(loc, len(key_bl) - 1)
+            cohort_fp = key_bl[loc] == key_tb
+        if self.blacklist_cg_insertions and hf.windows is not None:
+            from variantcalling_tpu.featurize import CENTER
+
+            cohort_fp |= _is_cg_insertion(table, hf.windows, CENTER)
+
+        near = self._hpol_near(table)
+        hpol_near = near if near is not None else np.zeros(n, dtype=bool)
+
+        # FILTER assembly as integer codes over the 6 possible values (no
+        # per-record Python and no factorize on the 5M writeback path):
+        # COHORT_FP beats LOW_SCORE; HPOL_RUN appends with ';'
+        base_idx = np.where(cohort_fp, 1, np.where(low, 2, 0)).astype(np.int32)
+        filters = FactorizedColumn(
+            base_idx + 3 * hpol_near,
+            [PASS, COHORT_FP, LOW_SCORE, HPOL_RUN,
+             f"{COHORT_FP};{HPOL_RUN}", f"{LOW_SCORE};{HPOL_RUN}"],
+        )
+        return score, filters
+
+
 def filter_variants(
     table: VariantTable,
     model,
@@ -475,85 +605,146 @@ def filter_variants(
     is_mutect: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Core: returns (tree_score float array, new FILTER object array)."""
-    extra_info = ["TLOD"] if is_mutect else []
-    # host windows are needed only by the cg-insertion check and the raw-
-    # sklearn fallback; the fused path gathers windows from the device-
-    # resident genome instead — unless the job is too small to justify the
-    # whole-genome HBM upload (featurize._genome_resident_worthwhile)
-    from variantcalling_tpu.featurize import (_genome_resident_worthwhile,
-                                              standard_genome_sharding)
-
-    genome_sharding = standard_genome_sharding()
-    needs_host_windows = (
-        blacklist_cg_insertions
-        or not isinstance(model, (FlatForest, ThresholdModel))
-        or not _genome_resident_worthwhile(table, fasta, sharding=genome_sharding)
+    ctx = FilterContext(
+        model, fasta, runs_file=runs_file, hpol_length=hpol_length,
+        hpol_dist=hpol_dist, blacklist=blacklist,
+        blacklist_cg_insertions=blacklist_cg_insertions,
+        annotate_intervals=annotate_intervals, flow_order=flow_order,
+        is_mutect=is_mutect,
     )
-    # xgboost models define missing-value semantics on NaN (default_left
-    # routing): zero-filling absent fields would walk the wrong branch
-    keep_nan = getattr(model, "default_left", None) is not None
-    hf = host_featurize(table, fasta, annotate_intervals=annotate_intervals,
-                        extra_info_fields=extra_info,
-                        compute_windows=needs_host_windows, keep_nan=keep_nan)
-    if is_mutect and "TLOD" in hf.cols:
-        hf.cols["tlod"] = hf.cols.pop("TLOD")
-        hf.names[hf.names.index("TLOD")] = "tlod"
-    if isinstance(model, (FlatForest, ThresholdModel)):
-        # fused featurize+score: window features and the forest walk run as
-        # one device program, only TREE_SCORE returns to the host
-        score = fused_featurize_score(model, hf, flow_order, table=table, fasta=fasta)
-    else:  # raw sklearn estimator: materialize the matrix from the same hf
-        from variantcalling_tpu.featurize import materialize_features
+    return ctx.score_table(table)
 
-        fs = materialize_features(hf, flow_order=flow_order)
-        score = score_variants(model, fs.matrix(), fs.feature_names)
 
-    pass_thr = getattr(model, "pass_threshold", 0.5)
-    n = len(table)
-    low = score < pass_thr
+def _ensure_output_header(header) -> None:
+    """The filter pipeline's header additions — ONE place so the serial and
+    streaming writers emit identical header bytes."""
+    header.ensure_filter(LOW_SCORE, "Model score below threshold")
+    header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
+    header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
+    header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
 
-    cohort_fp = np.zeros(n, dtype=bool)
-    if blacklist is not None and len(blacklist[0]):
-        # vectorized (chrom, pos) join: map chroms to small ints, pack into
-        # one int64 key, sorted-membership — no per-record Python on the 5M path
-        chroms = {c: i for i, c in enumerate(dict.fromkeys(np.concatenate([blacklist[0], table.chrom]).tolist()))}
-        cidx_bl = np.fromiter((chroms[c] for c in blacklist[0]), dtype=np.int64, count=len(blacklist[0]))
-        cidx_tb = np.fromiter((chroms[c] for c in table.chrom), dtype=np.int64, count=n)
-        key_bl = np.sort((cidx_bl << 40) | blacklist[1].astype(np.int64))
-        key_tb = (cidx_tb << 40) | table.pos.astype(np.int64)
-        loc = np.searchsorted(key_bl, key_tb)
-        loc = np.minimum(loc, len(key_bl) - 1)
-        cohort_fp = key_bl[loc] == key_tb
-    if blacklist_cg_insertions and hf.windows is not None:
-        from variantcalling_tpu.featurize import CENTER
 
-        cohort_fp |= _is_cg_insertion(table, hf.windows, CENTER)
+def streaming_eligible(args_limit_to_contig=None) -> bool:
+    """The streaming executor runs when host threads are available
+    (``VCTPU_THREADS`` != 1, ``VCTPU_STREAM`` != 0), the native engine is
+    built, and the job is single-process / whole-file. Anything else —
+    including ``VCTPU_THREADS=1`` — cleanly selects the serial path."""
+    from variantcalling_tpu import native
+    from variantcalling_tpu.parallel.pipeline import resolve_threads
 
-    hpol_near = np.zeros(n, dtype=bool)
-    if runs_file:
-        runs = bedio.read_bed(runs_file)
-        # only runs of length >= hpol_length are marked
-        keep = (runs.end - runs.start) >= hpol_length
-        runs = bedio.IntervalSet(runs.chrom[keep], runs.start[keep], runs.end[keep])
-        if len(runs):
-            contig_lengths = table.header.contig_lengths or {
-                c: fasta.get_reference_length(c) for c in fasta.references
-            }
-            coords = iops.GenomeCoords(contig_lengths)
-            gpos = coords.globalize(np.asarray(table.chrom), table.pos - 1)
-            gs, ge = coords.globalize_intervals(runs)
-            hpol_near = iops.distance_to_nearest(gpos, gs, ge) <= hpol_dist
+    if os.environ.get("VCTPU_STREAM", "1") == "0" or resolve_threads() <= 1:
+        return False
+    if not native.available() or args_limit_to_contig:
+        return False
+    try:
+        if jax.process_count() > 1:
+            return False
+    except Exception:  # noqa: BLE001 — uninitialized backend == single process
+        pass
+    return True
 
-    # FILTER assembly as integer codes over the 6 possible values (no
-    # per-record Python and no factorize on the 5M writeback path):
-    # COHORT_FP beats LOW_SCORE; HPOL_RUN appends with ';'
-    base_idx = np.where(cohort_fp, 1, np.where(low, 2, 0)).astype(np.int32)
-    filters = FactorizedColumn(
-        base_idx + 3 * hpol_near,
-        [PASS, COHORT_FP, LOW_SCORE, HPOL_RUN,
-         f"{COHORT_FP};{HPOL_RUN}", f"{LOW_SCORE};{HPOL_RUN}"],
+
+def run_streaming(args, model, fasta: FastaReader, annotate, blacklist) -> dict | None:
+    """Chunked three-stage streaming execution: BGZF/VCF chunk ingest ->
+    fused featurize+score -> ordered VCF writeback, overlapped on the
+    bounded-queue stage executor (parallel/pipeline.py).
+
+    The FASTA 2-bit encode rides a prefetch thread (threaded native encode
+    + persistent ``.venc`` cache), so the genome encode hides behind
+    scoring instead of serializing in front of the run — the round-5
+    "warmup cliff". Output is byte-identical to the serial path: chunks
+    are sequence-numbered, written strictly in order, and every stage runs
+    the same code the whole-table path runs.
+
+    Returns a stats dict, or None when ineligible (caller runs serial).
+    """
+    import threading
+
+    from variantcalling_tpu.io.vcf import (VcfChunkReader, assemble_table_bytes,
+                                           render_table_bytes_python)
+    from variantcalling_tpu.parallel.pipeline import StagePipeline
+
+    if not streaming_eligible(args.limit_to_contig):
+        return None
+
+    reader = VcfChunkReader(args.input_file)
+    header = reader.header
+    _ensure_output_header(header)
+    ctx = FilterContext(
+        model, fasta, runs_file=args.runs_file,
+        hpol_length=args.hpol_filter_length_dist[0],
+        hpol_dist=args.hpol_filter_length_dist[1],
+        blacklist=blacklist,
+        blacklist_cg_insertions=args.blacklist_cg_insertions,
+        annotate_intervals=annotate, flow_order=args.flow_order,
+        is_mutect=args.is_mutect,
     )
-    return score, filters
+
+    # kill the warmup cliff: encode (and persist) the genome on a prefetch
+    # thread; scoring's per-contig fetch_encoded waits only for the contig
+    # it needs, so encode overlaps scoring instead of preceding it. The
+    # cancel event stops the prefetch between contigs once the run is done
+    # (a tiny job on a huge genome must not block on untouched contigs),
+    # and the join guarantees process exit never kills a .venc write
+    # mid-file.
+    prefetch_cancel = threading.Event()
+    prefetch = threading.Thread(target=fasta.encode_all, name="genome-prefetch",
+                                kwargs={"cancel": prefetch_cancel}, daemon=True)
+    prefetch.start()
+
+    def score_stage(table):
+        score, filters = ctx.score_table(table)
+        return table, score, filters
+
+    def render_stage(item):
+        table, score, filters = item
+        extra = {"TREE_SCORE": np.round(score, 4)}
+        body = assemble_table_bytes(table, new_filters=filters, extra_info=extra)
+        if body is None:  # native hiccup mid-run: Python renderer, same bytes
+            body = render_table_bytes_python(table, new_filters=filters, extra_info=extra)
+        return body, len(table), int(np.sum(filters.codes == 0))
+
+    out_path = args.output_file
+    if str(out_path).endswith(".gz"):
+        from variantcalling_tpu.io.bgzf import BgzfWriter
+
+        sink = BgzfWriter(out_path)
+    else:
+        sink = open(out_path, "wb")
+    n_total = n_pass = n_chunks = 0
+    pipe = StagePipeline([score_stage, render_stage], queue_depth=2)
+    try:
+        with sink:
+            for line in header.lines:
+                sink.write((line + "\n").encode())
+            sink.write((header.column_header() + "\n").encode())
+            for body, k, p in pipe.run(iter(reader)):
+                sink.write(memoryview(body) if isinstance(body, np.ndarray) else body)
+                n_total += k
+                n_pass += p
+                n_chunks += 1
+    except BaseException:
+        prefetch_cancel.set()
+        try:  # never leave a half-written output behind a raised error
+            os.remove(out_path)
+        except OSError:
+            pass
+        prefetch.join()
+        raise
+    # stop the prefetch at the next contig boundary and wait it out: the
+    # persist (if it got that far) finishes atomically, and nothing is
+    # left running when the caller (or the process) moves on
+    prefetch_cancel.set()
+    prefetch.join()
+    if str(out_path).endswith(".gz"):
+        from variantcalling_tpu.io.tabix import build_tabix_index
+
+        try:
+            build_tabix_index(str(out_path))
+        except (ValueError, OSError):
+            pass  # unsorted/odd inputs: the VCF itself is still valid
+    return {"n": n_total, "n_pass": n_pass, "chunks": n_chunks,
+            "mode": "streaming" if pipe.parallel else "serial-chunked"}
 
 
 def run(argv: list[str]) -> int:
@@ -563,16 +754,31 @@ def run(argv: list[str]) -> int:
 
     from variantcalling_tpu.utils.trace import report, stage
 
+    model = load_model(args.model_file, args.model_name)
+    fasta = FastaReader(args.reference_file)
+    annotate = {_interval_name(p): bedio.read_intervals(p) for p in args.annotate_intervals}
+    blacklist = read_blacklist(args.blacklist) if args.blacklist else None
+
+    # streaming executor first: overlapped ingest/score/writeback with
+    # byte-identical output; falls through to the serial path when
+    # ineligible (VCTPU_THREADS=1, multi-process, region-limited, no
+    # native engine)
+    if streaming_eligible(args.limit_to_contig):
+        logger.info("streaming %s", args.input_file)
+        with stage("stream"):
+            stats = run_streaming(args, model, fasta, annotate, blacklist)
+        if stats is not None:
+            logger.debug("%s", report())
+            logger.info("wrote %s: %d variants, %d PASS", args.output_file,
+                        stats["n"], stats["n_pass"])
+            return 0
+
     logger.info("reading %s", args.input_file)
     with stage("ingest"):
         table = read_vcf(args.input_file)
     if args.limit_to_contig:
         keep = np.asarray(table.chrom) == args.limit_to_contig
         table = _subset(table, keep)
-    model = load_model(args.model_file, args.model_name)
-    fasta = FastaReader(args.reference_file)
-    annotate = {_interval_name(p): bedio.read_intervals(p) for p in args.annotate_intervals}
-    blacklist = read_blacklist(args.blacklist) if args.blacklist else None
 
     # multi-host launch (VCTPU_COORDINATOR set -> __main__ initialized
     # jax.distributed): ranks score CONTIGUOUS slices of the callset on
@@ -631,10 +837,7 @@ def run(argv: list[str]) -> int:
                         jax.process_index(), n_proc)
             return 0
 
-    table.header.ensure_filter(LOW_SCORE, "Model score below threshold")
-    table.header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
-    table.header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
-    table.header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
+    _ensure_output_header(table.header)
     with stage("writeback"):
         # verbatim_core: this pipeline never edits CHROM..QUAL, so record
         # assembly can splice FILTER/TREE_SCORE between original byte spans
