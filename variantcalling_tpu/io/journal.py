@@ -1,0 +1,220 @@
+"""Chunk journal for resumable, atomic streaming writeback.
+
+The streaming filter executor writes its output through a two-file
+protocol so an interrupted run (crash, OOM-kill, SIGKILL) never leaves a
+partial file at the destination and can RESUME instead of recomputing:
+
+- ``<out>.partial``  — the output bytes as they accumulate; renamed onto
+  the destination (``os.replace``, atomic on POSIX) only after the last
+  chunk landed. The destination path either holds a previous complete
+  file or nothing — never a torn write.
+- ``<out>.journal``  — one JSON line per committed chunk (sequence
+  number, record/pass counts, body length, CRC32), after a header line
+  binding the journal to the exact input file (size + mtime_ns), chunk
+  size and output header bytes. Appended and flushed after the chunk's
+  bytes are in the partial file, so the journal never claims more than
+  the partial file holds (the reverse — partial ahead of journal — is
+  healed by truncation on resume).
+
+Resume contract (``pipelines/filter_variants.run_streaming``): chunk
+boundaries are a pure function of (input bytes, chunk_bytes), every
+per-variant product is row-local, and the journal pins both — so
+"skip the journaled chunks, truncate the partial file to the journaled
+watermark, continue" reproduces the uninterrupted output byte for byte
+(locked by ``tests/unit/test_streaming_faults.py``).
+
+Anything suspicious — signature mismatch, truncated journal line, CRC
+mismatch, partial file shorter than the watermark — degrades to a fresh
+run; resume is an optimization, never a correctness risk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+
+from variantcalling_tpu import logger
+
+JOURNAL_SUFFIX = ".journal"
+PARTIAL_SUFFIX = ".partial"
+_VERSION = 1
+
+
+def partial_path(out_path: str) -> str:
+    return str(out_path) + PARTIAL_SUFFIX
+
+
+def journal_path(out_path: str) -> str:
+    return str(out_path) + JOURNAL_SUFFIX
+
+
+def input_signature(path: str) -> list[int]:
+    st = os.stat(path)
+    return [int(st.st_size), int(st.st_mtime_ns)]
+
+
+@dataclass
+class ResumeState:
+    """What a valid journal + partial file pair lets us skip."""
+
+    chunks: int  # complete chunks already in the partial file
+    watermark: int  # byte offset in the partial file after those chunks
+    n_records: int
+    n_pass: int
+
+
+@dataclass
+class ChunkJournal:
+    """Writer/loader for the ``<out>.journal`` sidecar."""
+
+    out_path: str
+    _fh: object | None = field(default=None, repr=False)
+
+    # -- writing -----------------------------------------------------------
+
+    def begin(self, meta: dict) -> None:
+        """Start a FRESH journal with the run-identity header line."""
+        meta = dict(meta, version=_VERSION)
+        self._fh = open(journal_path(self.out_path), "w", encoding="utf-8")
+        self._fh.write(json.dumps(meta, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def reopen(self) -> None:
+        """Append to an existing journal (resume path)."""
+        self._fh = open(journal_path(self.out_path), "a", encoding="utf-8")
+
+    def append(self, seq: int, records: int, passed: int, body_len: int,
+               crc: int) -> None:
+        assert self._fh is not None, "journal not started"
+        self._fh.write(json.dumps(
+            {"seq": seq, "records": records, "pass": passed,
+             "body_len": body_len, "crc": crc}) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def finish(self) -> None:
+        """Successful completion: the journal has served its purpose."""
+        self.close()
+        try:
+            os.remove(journal_path(self.out_path))
+        except OSError:
+            pass
+
+    # -- loading -----------------------------------------------------------
+
+    @staticmethod
+    def load(out_path: str) -> tuple[dict, list[dict]] | None:
+        """(meta, entries) from an existing journal; None when absent or
+        unreadable. A truncated/corrupt LAST line (killed mid-append) is
+        dropped; corruption earlier than that invalidates the journal."""
+        try:
+            with open(journal_path(out_path), encoding="utf-8") as fh:
+                lines = fh.read().splitlines()
+        except OSError:
+            return None
+        if not lines:
+            return None
+        try:
+            meta = json.loads(lines[0])
+        except ValueError:
+            return None
+        if not isinstance(meta, dict) or meta.get("version") != _VERSION:
+            return None
+        entries: list[dict] = []
+        for i, line in enumerate(lines[1:]):
+            try:
+                e = json.loads(line)
+            except ValueError:
+                if i == len(lines) - 2:  # torn tail line: drop it
+                    break
+                return None
+            if not isinstance(e, dict) or e.get("seq") != len(entries):
+                return None  # out-of-order / duplicated entries: distrust all
+            entries.append(e)
+        return meta, entries
+
+
+def try_resume(out_path: str, meta: dict) -> ResumeState | None:
+    """Validate journal + partial file against this run's identity ``meta``
+    and prepare the partial file for continuation.
+
+    On success the partial file is TRUNCATED to the journaled watermark
+    (healing a torn final chunk) and a :class:`ResumeState` is returned;
+    ANY mismatch or malformation returns None (fresh run) — a corrupt
+    journal must never be able to crash every subsequent run.
+    """
+    try:
+        return _try_resume(out_path, meta)
+    except (KeyError, ValueError, TypeError, OSError):
+        # journal parses as JSON but is structurally wrong (missing
+        # fields, non-numeric values): suspicious -> fresh run
+        logger.info("streaming resume: malformed journal — fresh run")
+        return None
+
+
+def _try_resume(out_path: str, meta: dict) -> ResumeState | None:
+    loaded = ChunkJournal.load(out_path)
+    if loaded is None:
+        return None
+    jmeta, entries = loaded
+    expect = dict(meta, version=_VERSION)
+    if {k: jmeta.get(k) for k in expect} != expect:
+        logger.info("streaming resume: journal identity mismatch — fresh run")
+        return None
+    if not entries:
+        return None
+    part = partial_path(out_path)
+    try:
+        size = os.path.getsize(part)
+    except OSError:
+        return None
+    watermark = int(meta["header_len"]) + sum(int(e["body_len"]) for e in entries)
+    if size < watermark:
+        logger.info("streaming resume: partial file behind the journal — fresh run")
+        return None
+    # spot-verify the LAST journaled chunk's bytes (cheap; whole-prefix
+    # verification would re-read everything a resume is meant to skip)
+    last = entries[-1]
+    try:
+        with open(part, "rb") as fh:
+            fh.seek(watermark - int(last["body_len"]))
+            tail = fh.read(int(last["body_len"]))
+    except OSError:
+        return None
+    if zlib.crc32(tail) != int(last["crc"]):
+        logger.info("streaming resume: chunk CRC mismatch — fresh run")
+        return None
+    if size > watermark:  # torn final chunk beyond the journal: heal it
+        with open(part, "r+b") as fh:
+            fh.truncate(watermark)
+    # heal the journal itself too: a SIGKILL mid-append can leave a torn
+    # (newline-less) tail line that load() dropped — appending after it
+    # would glue valid JSON onto garbage and poison the NEXT resume.
+    # Rewriting meta + the validated entries makes reopen()-append safe.
+    j = ChunkJournal(out_path)
+    j.begin(jmeta)
+    for e in entries:
+        j.append(int(e["seq"]), int(e["records"]), int(e["pass"]),
+                 int(e["body_len"]), int(e["crc"]))
+    j.close()
+    return ResumeState(
+        chunks=len(entries), watermark=watermark,
+        n_records=sum(int(e["records"]) for e in entries),
+        n_pass=sum(int(e["pass"]) for e in entries),
+    )
+
+
+def discard(out_path: str) -> None:
+    """Remove journal + partial file (non-resumable failure, or a fresh
+    run superseding stale leftovers)."""
+    for p in (journal_path(out_path), partial_path(out_path)):
+        try:
+            os.remove(p)
+        except OSError:
+            pass

@@ -795,6 +795,10 @@ class VcfChunkReader:
             raise RuntimeError("VcfChunkReader requires the native engine")
         self.path = str(path)
         self.chunk_bytes = int(chunk_bytes) or STREAM_CHUNK_BYTES
+        #: chunks to advance WITHOUT parsing (journal resume: their output
+        #: bytes are already committed). Boundaries are computed exactly as
+        #: for parsed chunks, so the continuation is byte-faithful.
+        self._skip = 0
         self._gz = self.path.endswith((".gz", ".bgz"))
         self._mm: np.ndarray | None = None
         self._fh = None
@@ -826,14 +830,29 @@ class VcfChunkReader:
             self.header = header
             self._first_off = first_off
 
+    def skip(self, n_chunks: int) -> None:
+        """Advance the first ``n_chunks`` chunk boundaries without parsing
+        them (journal resume — their rendered bytes are already on disk).
+        Must be called before iteration starts."""
+        self._skip = max(0, int(n_chunks))
+
     def _parse_chunk(self, buf_np: np.ndarray, lazy_buf) -> VariantTable:
         from variantcalling_tpu import native
+        from variantcalling_tpu.parallel.pipeline import retry_transient
+        from variantcalling_tpu.utils import faults
 
-        parsed = native.vcf_parse(buf_np, len(self.header.samples))
-        if parsed is None:
-            raise RuntimeError(f"native VCF scan failed mid-stream in {self.path}")
-        return _table_from_parsed(parsed, self.header, lazy_buf, buf_np,
-                                  drop_format=False)
+        def attempt() -> VariantTable:
+            # injection point "io.chunk_read": a transient IO error here is
+            # retried (parse is a pure function of the already-read buffer,
+            # so a retry is always safe)
+            faults.check("io.chunk_read")
+            parsed = native.vcf_parse(buf_np, len(self.header.samples))
+            if parsed is None:
+                raise RuntimeError(f"native VCF scan failed mid-stream in {self.path}")
+            return _table_from_parsed(parsed, self.header, lazy_buf, buf_np,
+                                      drop_format=False)
+
+        return retry_transient(attempt, f"chunk read ({self.path})")
 
     def __iter__(self):
         if self._gz:
@@ -861,8 +880,11 @@ class VcfChunkReader:
                         end = n
                         break
                     probe *= 8
-            view = mm[off:end]
-            yield self._parse_chunk(view, view)
+            if self._skip > 0:
+                self._skip -= 1
+            else:
+                view = mm[off:end]
+                yield self._parse_chunk(view, view)
             off = end
 
     def _iter_gz(self):
@@ -879,11 +901,17 @@ class VcfChunkReader:
                 continue
             carry = block[cut + 1 :]
             chunk = block[: cut + 1]
+            if self._skip > 0:
+                self._skip -= 1
+                continue
             buf_np = np.frombuffer(chunk, dtype=np.uint8)
             yield self._parse_chunk(buf_np, chunk)
         if carry:
-            buf_np = np.frombuffer(carry, dtype=np.uint8)
-            yield self._parse_chunk(buf_np, carry)
+            if self._skip > 0:
+                self._skip -= 1
+            else:
+                buf_np = np.frombuffer(carry, dtype=np.uint8)
+                yield self._parse_chunk(buf_np, carry)
         self._fh.close()
 
 

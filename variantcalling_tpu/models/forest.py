@@ -56,13 +56,23 @@ class FlatForest:
         )
 
 
-def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
-    """TREE_SCORE in [0,1] for a (N, F) feature matrix (jit-safe).
+def predict_margin(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Raw per-variant leaf-value SUM in canonical tree order (jit-safe).
 
     Traversal: ``max_depth`` rounds of gathers; each round every (variant,
     tree) pair advances one level (leaves self-loop), so control flow is
     static and XLA lowers the whole forest to fused gathers — no
     per-variant Python, no host sync.
+
+    The accumulation is a SEQUENTIAL fori_loop over trees (t=0,1,...,T-1)
+    rather than ``jnp.sum``: XLA's reduce reassociates f32 sums into
+    SIMD-lane partials whose grouping varies with backend and device
+    count, which made jit scores differ from the native C++ walk (and
+    from themselves across mesh shapes) by 1 ulp — the round-5 multihost
+    byte-parity flake. A loop-carried dependency cannot be reassociated,
+    and the native walk accumulates in the same order
+    (``native/src/vctpu_gbt.cc`` forest_walk_tile), so the two engines'
+    sums are bit-identical (tests/unit/test_engine_contract.py).
     """
     feat, thr, left, right, value = forest.astuple()
     dl = None if forest.default_left is None else jnp.asarray(forest.default_left)
@@ -83,10 +93,47 @@ def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     idx0 = jnp.zeros((n, t), dtype=jnp.int32)
     idx = jax.lax.fori_loop(0, forest.max_depth, body, idx0)
     leaf_vals = value[tree_ids, idx]  # (N, T)
+
+    def acc_body(ti, acc):
+        return acc + leaf_vals[:, ti]
+
+    return jax.lax.fori_loop(0, t, acc_body,
+                             jnp.zeros(n, dtype=leaf_vals.dtype))
+
+
+def finalize_margin(margin: np.ndarray, forest: FlatForest) -> np.ndarray:
+    """SHARED host finalization margin -> TREE_SCORE — the single place
+    that turns a canonical-order leaf sum into the score, used by BOTH
+    scoring engines so the final bits cannot depend on the engine.
+
+    ``mean`` divides (IEEE division is correctly rounded, so either side
+    could do it); ``logit_sum`` applies the sigmoid HERE because exp is
+    implementation-defined — XLA's logistic and libm's expf disagree in
+    the last ulp on ~4% of inputs, so neither engine may bake it in.
+    """
+    m = np.asarray(margin, dtype=np.float32)
     if forest.aggregation == "mean":
-        return jnp.mean(leaf_vals, axis=1)
+        return m / np.float32(forest.n_trees)
     if forest.aggregation == "logit_sum":
-        return jax.nn.sigmoid(jnp.sum(leaf_vals, axis=1) + forest.base_score)
+        z = m + np.float32(forest.base_score)
+        return (np.float32(1.0) / (np.float32(1.0) + np.exp(-z))).astype(np.float32)
+    raise ValueError(f"unknown aggregation {forest.aggregation!r}")
+
+
+def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
+    """TREE_SCORE in [0,1] for a (N, F) feature matrix (jit-safe).
+
+    Device-finalized convenience wrapper over :func:`predict_margin` —
+    accelerator callers keep everything on device. The engine-parity
+    paths (pipelines/filter_variants) instead fetch the margin and
+    finalize on the host via :func:`finalize_margin`, because the device
+    sigmoid's exp is not bit-portable.
+    """
+    margin = predict_margin(forest, x)
+    if forest.aggregation == "mean":
+        return margin / forest.n_trees
+    if forest.aggregation == "logit_sum":
+        return jax.nn.sigmoid(margin + forest.base_score)
     raise ValueError(f"unknown aggregation {forest.aggregation!r}")
 
 
@@ -270,14 +317,19 @@ def make_predictor(forest: FlatForest, n_features: int | None = None):
     return lambda x: predict_score(forest, x)
 
 
-def native_host_predictor(forest: FlatForest):
+def native_host_predictor(forest: FlatForest, strict: bool = False):
     """CPU fast path: the exact predict_score walk in C++ as a plain HOST
     function (numpy in, numpy out) — ~5x XLA:CPU's fused-gather lowering
     on one core. Callers split their program at the feature matrix and
     run this outside jit (a pure_callback inside the async chunk pipeline
     can deadlock XLA:CPU's single-threaded callback executor). Returns
     None when the native library is unavailable or the aggregation is
-    unknown; use only on the CPU backend (accelerators keep GEMM/pallas)."""
+    unknown; use only on the CPU backend (accelerators keep GEMM/pallas).
+
+    ``strict=True`` (the pinned-native engine paths): a mid-run native
+    failure RAISES instead of silently computing the margin via XLA —
+    an output stamped ``##vctpu_engine=native`` must never contain
+    jit-scored rows (engine contract, docs/robustness.md)."""
     from variantcalling_tpu import native
 
     if not native.available() or forest.aggregation not in ("mean", "logit_sum"):
@@ -289,14 +341,25 @@ def native_host_predictor(forest: FlatForest):
     value = np.ascontiguousarray(forest.value, dtype=np.float32)
     dl = None if forest.default_left is None else \
         np.ascontiguousarray(forest.default_left, dtype=np.uint8)
-    agg, base, depth = forest.aggregation, forest.base_score, forest.max_depth
+    depth = forest.max_depth
 
     def fn(x: np.ndarray) -> np.ndarray:
-        out = native.forest_predict(np.asarray(x), feat, thr, left, right,
-                                    value, dl, depth, agg, base)
-        if out is None:  # library vanished mid-process: jnp walk fallback
-            return np.asarray(predict_score(forest, jnp.asarray(x)))
-        return out
+        # raw canonical-order sums from the C++ walk; finalization happens
+        # in the SHARED host code so the bits match the jit engine exactly
+        margin = native.forest_predict(np.asarray(x), feat, thr, left, right,
+                                       value, dl, depth, "sum", 0.0)
+        if margin is None:
+            if strict:
+                from variantcalling_tpu.engine import EngineError
+
+                raise EngineError(
+                    "the native forest walk failed mid-run with the engine "
+                    "pinned to native — refusing to silently score on the "
+                    "jit walk. See docs/robustness.md.")
+            # opportunistic callers: jnp walk fallback (bit-identical, the
+            # canonical-order margin is engine-independent by construction)
+            margin = np.asarray(predict_margin(forest, jnp.asarray(x)))
+        return finalize_margin(margin, forest)
 
     return fn
 
@@ -320,27 +383,16 @@ def native_cols_predictor(forest: FlatForest):
     value = np.ascontiguousarray(forest.value, dtype=np.float32)
     dl = None if forest.default_left is None else \
         np.ascontiguousarray(forest.default_left, dtype=np.uint8)
-    agg, base, depth = forest.aggregation, forest.base_score, forest.max_depth
+    depth = forest.max_depth
 
     def fn(cols: list[np.ndarray]) -> np.ndarray | None:
-        return native.matrix_forest_predict(cols, feat, thr, left, right, value,
-                                            dl, depth, agg, base)
+        margin = native.matrix_forest_predict(cols, feat, thr, left, right, value,
+                                              dl, depth, "sum", 0.0)
+        if margin is None:
+            return None
+        return finalize_margin(margin, forest)
 
     return fn
-
-
-def use_native_cpu_forest() -> bool:
-    """True when the CPU backend should route forest inference through the
-    native walk: single local device (the sharded mesh path must stay
-    XLA-collective) and not opted out via VCTPU_NATIVE_FOREST=0."""
-    import os
-
-    if os.environ.get("VCTPU_NATIVE_FOREST", "1") == "0":
-        return False
-    try:
-        return jax.default_backend() == "cpu" and len(jax.local_devices()) == 1
-    except Exception:  # noqa: BLE001 — backend probe failure: stay on jnp
-        return False
 
 
 def from_sklearn(clf, feature_names: list[str] | None = None, pass_threshold: float = 0.5) -> FlatForest:

@@ -87,6 +87,13 @@ def _build() -> str | None:
 
 def get_lib() -> ctypes.CDLL | None:
     """Compile (once, cached by source hash) and load the native library."""
+    from variantcalling_tpu.utils import faults
+
+    # injection point "native.build": simulates a build/load failure (even
+    # when a cached .so exists) so REQUIRE_NATIVE / engine-resolution
+    # failure paths are testable on a host whose toolchain works
+    if faults.should_fire("native.build"):
+        return None
     global _LIB, _TRIED
     with _LOCK:
         if _TRIED:
@@ -841,9 +848,11 @@ def forest_predict(x: np.ndarray, feat: np.ndarray, thr: np.ndarray,
                    default_left: np.ndarray | None, max_depth: int,
                    aggregation: str, base_score: float) -> np.ndarray | None:
     """Native gather-walk forest inference (models/forest.predict_score
-    semantics); returns (n,) float32 scores or None when unavailable."""
+    semantics); returns (n,) float32 scores or None when unavailable.
+    ``aggregation="sum"`` returns the RAW canonical-order leaf sums
+    (no mean/sigmoid) — the engine-parity path finalizes on the host."""
     lib = get_lib()
-    if lib is None or aggregation not in ("mean", "logit_sum"):
+    if lib is None or aggregation not in ("mean", "logit_sum", "sum"):
         return None
     _f32p = ctypes.POINTER(ctypes.c_float)
     xx = np.ascontiguousarray(x, dtype=np.float32)
@@ -857,7 +866,7 @@ def forest_predict(x: np.ndarray, feat: np.ndarray, thr: np.ndarray,
         ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
         vv.ctypes.data_as(_f32p),
         None if dl is None else dl.ctypes.data_as(_u8p),
-        t, m, max_depth, 0 if aggregation == "mean" else 1, base_score,
+        t, m, max_depth, {"mean": 0, "logit_sum": 1, "sum": 2}[aggregation], base_score,
         out.ctypes.data_as(_f32p),
     )
     return out if rc == 0 else None
@@ -870,9 +879,11 @@ def matrix_forest_predict(cols: list[np.ndarray], feat: np.ndarray, thr: np.ndar
     """Fused column->matrix->forest inference: L2-resident row tiles are
     built from the typed column pointers and walked immediately, so the
     full (n, f) float32 matrix never exists. Bit-identical scores to
-    build_matrix + forest_predict; None -> caller uses the two-step path."""
+    build_matrix + forest_predict; None -> caller uses the two-step path.
+    ``aggregation="sum"`` returns raw canonical-order leaf sums (the
+    engine-parity path finalizes on the host)."""
     lib = get_lib()
-    if lib is None or aggregation not in ("mean", "logit_sum"):
+    if lib is None or aggregation not in ("mean", "logit_sum", "sum"):
         return None
     marshalled = _marshal_cols(cols)
     if marshalled is None:
@@ -888,7 +899,7 @@ def matrix_forest_predict(cols: list[np.ndarray], feat: np.ndarray, thr: np.ndar
         ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
         vv.ctypes.data_as(_f32p),
         None if dl is None else dl.ctypes.data_as(_u8p),
-        t, m, max_depth, 0 if aggregation == "mean" else 1, base_score,
+        t, m, max_depth, {"mean": 0, "logit_sum": 1, "sum": 2}[aggregation], base_score,
         out.ctypes.data_as(_f32p),
     )
     return out if rc == 0 else None

@@ -90,11 +90,16 @@ inline void pack_nodes(std::vector<Node>& nodes, const int32_t* feat, const floa
 // trees concurrently per row: the per-tree pointer chase is a serial
 // dependency chain, so interleaving two independent chains hides
 // node-load latency (~20% on one core). Accumulation order is the exact
-// sequential tree order, so scores stay bit-identical across strategies.
+// sequential tree order (t=0,1,...,T-1) — the CANONICAL order the jit
+// engine's fori_loop accumulation also uses, so sums are bit-identical
+// across engines (the engine contract, docs/robustness.md).
+// aggregation: 0 = mean (sum / t; division is IEEE-correctly-rounded so
+// both engines agree bit-for-bit), 1 = logit_sum (sigmoid(sum + base);
+// exp is implementation-defined — engine-parity callers use mode 2 and
+// finalize on the host instead), 2 = raw sum (no finalization).
 inline void forest_walk_tile(const Node* nodes, const float* x, int64_t count, int32_t f,
                              int32_t t, int32_t m, int32_t max_depth, bool has_dl,
                              int32_t aggregation, float base_score, float* out) {
-    const float inv_t = 1.0f / (float)t;
     for (int64_t i = 0; i < count; ++i) {
         const float* row = x + (size_t)i * f;
         float acc = 0.0f;
@@ -136,8 +141,9 @@ inline void forest_walk_tile(const Node* nodes, const float* x, int64_t count, i
             }
             acc += tree[idx].value;
         }
-        out[i] = aggregation == 0 ? acc * inv_t
-                                  : 1.0f / (1.0f + std::exp(-(acc + base_score)));
+        out[i] = aggregation == 0 ? acc / (float)t
+               : aggregation == 1 ? 1.0f / (1.0f + std::exp(-(acc + base_score)))
+                                  : acc;
     }
 }
 
@@ -190,7 +196,7 @@ int64_t vctpu_forest_predict(
     float* out) try
 {
     if (n < 0 || f <= 0 || t <= 0 || m <= 0 || max_depth <= 0) return -1;
-    if (aggregation != 0 && aggregation != 1) return -1;
+    if (aggregation < 0 || aggregation > 2) return -1;
     std::vector<Node> nodes;
     pack_nodes(nodes, feat, thr, left, right, value, default_left, (int64_t)t * m);
     const bool has_dl = default_left != nullptr;
@@ -218,7 +224,7 @@ int64_t vctpu_matrix_forest_predict(
     float* out) try
 {
     if (n < 0 || f <= 0 || t <= 0 || m <= 0 || max_depth <= 0) return -1;
-    if (aggregation != 0 && aggregation != 1) return -1;
+    if (aggregation < 0 || aggregation > 2) return -1;
     for (int32_t j = 0; j < f; ++j)
         if (dtypes[j] < 0 || dtypes[j] > 4) return -2;
     std::vector<Node> nodes;

@@ -112,6 +112,12 @@ def allgather_concat(local: np.ndarray) -> np.ndarray:
     exceed int32, so the wire format is uint8). Single-process returns
     the input unchanged.
     """
+    # injection point "dist.rank_timeout": THIS rank enters the collective
+    # late (cancellable delay) — proves a slow rank delays but does not
+    # corrupt/deadlock the gather (the collective itself synchronizes)
+    from variantcalling_tpu.utils import faults
+
+    faults.check("dist.rank_timeout")
     local = np.ascontiguousarray(local)
     if jax.process_count() <= 1:
         return local

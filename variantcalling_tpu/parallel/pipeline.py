@@ -25,7 +25,13 @@ Design rules:
   loop through the same stage callables — byte-identical results, no
   threads, no queues;
 - a stage exception cancels the whole pipeline promptly (stop event +
-  queue drain) and re-raises in the consumer.
+  queue drain) and re-raises in the consumer;
+- a WATCHDOG (``timeout`` / ``VCTPU_STAGE_TIMEOUT_S``) bounds how long the
+  consumer waits without any pipeline progress: a hung stage (wedged
+  native call, dead filesystem) raises :class:`StageTimeoutError` naming
+  the stuck stage instead of deadlocking the run, with queues drained and
+  every joinable worker joined on the way out (failure semantics locked
+  by ``tests/unit/test_streaming_faults.py``).
 
 The GIL is not a problem here: stage bodies are native engine calls,
 numpy, and file I/O, all of which release it.
@@ -36,9 +42,22 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from collections.abc import Callable, Iterable, Iterator
 
+from variantcalling_tpu import logger
+from variantcalling_tpu.utils import faults
+
 _SENTINEL = object()
+
+#: default per-run watchdog deadline (seconds of NO pipeline progress);
+#: generous — chunks normally flow every few hundred ms, and a legitimate
+#: slow stage still heartbeats by finishing items. 0 disables.
+DEFAULT_STAGE_TIMEOUT_S = 900.0
+
+
+class StageTimeoutError(RuntimeError):
+    """The pipeline made no progress within the watchdog deadline."""
 
 
 def resolve_threads() -> int:
@@ -56,6 +75,57 @@ def resolve_threads() -> int:
     return os.cpu_count() or 1
 
 
+def resolve_stage_timeout() -> float:
+    """Watchdog deadline from ``VCTPU_STAGE_TIMEOUT_S`` (0 disables);
+    invalid values fall back to the default so a typo can't disable the
+    watchdog silently."""
+    env = os.environ.get("VCTPU_STAGE_TIMEOUT_S", "").strip()
+    if env:
+        try:
+            return max(0.0, float(env))
+        except ValueError:
+            pass
+    return DEFAULT_STAGE_TIMEOUT_S
+
+
+def retry_transient(fn: Callable, what: str, attempts: int | None = None,
+                    backoff_s: float | None = None,
+                    retry_on: tuple[type[BaseException], ...] = (OSError,)):
+    """Run ``fn()`` with bounded retry + exponential backoff on transient
+    IO errors — the streaming executor's recovery primitive for chunk
+    reads and sink writes (docs/robustness.md failure matrix).
+
+    ``attempts`` counts TOTAL tries (default ``VCTPU_IO_RETRIES``+1 = 3);
+    backoff doubles from ``backoff_s`` (default ``VCTPU_IO_BACKOFF_S`` =
+    0.05s). Non-retryable exceptions propagate immediately; the last
+    retryable failure propagates after the budget is spent.
+    """
+    if attempts is None:
+        try:
+            attempts = 1 + max(0, int(os.environ.get("VCTPU_IO_RETRIES", "2")))
+        except ValueError:
+            attempts = 3
+    if backoff_s is None:
+        try:
+            backoff_s = max(0.0, float(os.environ.get("VCTPU_IO_BACKOFF_S", "0.05")))
+        except ValueError:
+            backoff_s = 0.05
+    last: BaseException | None = None
+    for k in range(max(1, attempts)):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop is the point
+            last = e
+            if k + 1 >= attempts:
+                break
+            delay = backoff_s * (2 ** k)
+            logger.warning("transient error in %s (attempt %d/%d): %s — retrying in %.2fs",
+                           what, k + 1, attempts, e, delay)
+            if delay:
+                time.sleep(delay)
+    raise last  # type: ignore[misc]
+
+
 class StagePipeline:
     """Run items through ``stages`` (list of callables) with stage overlap.
 
@@ -66,12 +136,18 @@ class StagePipeline:
     """
 
     def __init__(self, stages: list[Callable], queue_depth: int = 2,
-                 threads: int | None = None):
+                 threads: int | None = None, timeout: float | None = None):
         if not stages:
             raise ValueError("StagePipeline needs at least one stage")
         self.stages = list(stages)
         self.queue_depth = max(1, int(queue_depth))
         self.threads = resolve_threads() if threads is None else max(1, int(threads))
+        self.timeout = resolve_stage_timeout() if timeout is None else max(0.0, float(timeout))
+        #: threads that refused to join within the cleanup grace period on
+        #: the most recent run (a truly wedged native call cannot be
+        #: interrupted from Python; they are daemons and die with the
+        #: process). Empty after a clean run.
+        self.unjoined: list[str] = []
 
     @property
     def parallel(self) -> bool:
@@ -81,6 +157,8 @@ class StagePipeline:
 
     def _run_serial(self, source: Iterable) -> Iterator:
         for item in source:
+            faults.check("pipeline.stage")
+            faults.check("pipeline.stage_hang")
             for fn in self.stages:
                 item = fn(item)
             yield item
@@ -95,6 +173,9 @@ class StagePipeline:
         stop = threading.Event()
         queues = [queue.Queue(maxsize=self.queue_depth)
                   for _ in range(len(self.stages) + 1)]
+        # per-stage heartbeat: monotonic time the stage last STARTED an
+        # item, None while idle — lets the watchdog name the stuck stage
+        busy_since: list[float | None] = [None] * len(self.stages)
 
         def _put(q: queue.Queue, item) -> bool:
             # bounded put that stays responsive to cancellation
@@ -134,7 +215,16 @@ class StagePipeline:
                         _put(q_out, got)
                         return
                     seq, item = got
-                    _put(q_out, (seq, fn(item)))
+                    busy_since[i] = time.monotonic()
+                    try:
+                        # injection points: the watchdog/error contracts are
+                        # proven against these (tests/unit/test_streaming_faults.py)
+                        faults.check("pipeline.stage")
+                        faults.check("pipeline.stage_hang")
+                        out = fn(item)
+                    finally:
+                        busy_since[i] = None
+                    _put(q_out, (seq, out))
             except BaseException as e:  # noqa: BLE001 — relay to the consumer
                 _put(q_out, (_SENTINEL, e))
 
@@ -147,6 +237,7 @@ class StagePipeline:
         for w in workers:
             w.start()
         expect = 0
+        last_progress = time.monotonic()
         try:
             while True:
                 try:
@@ -155,7 +246,10 @@ class StagePipeline:
                     if stop.is_set():
                         # a failed stage may have died before relaying
                         raise RuntimeError("stage pipeline cancelled")
+                    if self.timeout and time.monotonic() - last_progress > self.timeout:
+                        raise StageTimeoutError(self._watchdog_message(busy_since, workers))
                     continue
+                last_progress = time.monotonic()
                 if got is _SENTINEL:
                     return
                 if isinstance(got, tuple) and got[0] is _SENTINEL:
@@ -167,14 +261,39 @@ class StagePipeline:
                 yield item
         finally:
             stop.set()
+            # release any injected hang so its thread can observe stop and
+            # join below (no-op outside fault-injection runs)
+            faults.cancel_hangs()
             for q in queues:  # unblock any worker parked on a full queue
                 try:
                     while True:
                         q.get_nowait()
                 except queue.Empty:
                     pass
+            self.unjoined = []
             for w in workers:
                 w.join(timeout=5.0)
+                if w.is_alive():
+                    self.unjoined.append(w.name)
+            if self.unjoined:
+                # a wedged native call cannot be interrupted from Python;
+                # the daemon thread dies with the process. Surface it —
+                # silence here would hide a leak.
+                logger.warning("stage pipeline: %d worker(s) did not join: %s",
+                               len(self.unjoined), ", ".join(self.unjoined))
+
+    def _watchdog_message(self, busy_since: list[float | None],
+                          workers: list[threading.Thread]) -> str:
+        now = time.monotonic()
+        stuck = [
+            f"stage {i} ({getattr(self.stages[i], '__name__', 'stage')}) busy {now - t:.1f}s"
+            for i, t in enumerate(busy_since) if t is not None
+        ]
+        alive = [w.name for w in workers if w.is_alive()]
+        detail = "; ".join(stuck) if stuck else "no stage reports busy (source stalled?)"
+        return (f"stage pipeline watchdog: no progress for {self.timeout:.0f}s — "
+                f"{detail}; alive workers: {', '.join(alive) or 'none'}. "
+                "Raise VCTPU_STAGE_TIMEOUT_S for legitimately slow stages.")
 
 
 def run_pipeline(source: Iterable, stages: list[Callable],

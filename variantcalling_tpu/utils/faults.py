@@ -1,0 +1,225 @@
+"""Named fault-injection points for robustness testing.
+
+The streaming filter executor, the scoring-engine contract and the
+distributed layer all make failure-semantics promises (docs/robustness.md):
+a hung stage trips a watchdog, a transient chunk-read error is retried, a
+missing native engine under ``VCTPU_REQUIRE_NATIVE=1`` fails loudly instead
+of silently degrading, an interrupted run never leaves a partial output at
+the destination. Promises like these rot unless the failures themselves are
+reproducible — so the failure sites call :func:`check` (or
+:func:`should_fire`) on a NAMED injection point, and tests (or an operator,
+via the ``VCTPU_FAULTS`` env var) arm exactly the failure they want.
+
+Design rules:
+
+- **Zero cost when disarmed.** ``check()`` is a single module-flag test
+  when nothing is armed; production hot paths pay one attribute load.
+- **Injected faults look like real faults.** A chunk-read fault raises
+  ``OSError(EIO)``, a writeback fault ``OSError(ENOSPC)`` — the handling
+  code cannot tell them from the real thing, so the test proves the real
+  recovery path.
+- **Hangs are cancellable.** An injected hang waits on an event, not a
+  bare ``sleep``, so a watchdog that aborts the pipeline can release the
+  hung thread (:func:`cancel_hangs`) and still join every worker — the
+  "no deadlock, all threads joined" contract stays testable.
+- **Deterministic arming.** A fault fires a fixed number of times
+  (``times``), then disarms itself; "fail twice then succeed" retry tests
+  need no sleeps or probability.
+
+Env syntax (comma-separated)::
+
+    VCTPU_FAULTS="io.chunk_read:2,pipeline.stage_hang@30,native.build"
+
+``point[:times][@seconds]`` — ``times`` defaults to 1 for raising faults
+and unlimited for ``native.build`` (an unavailable engine stays
+unavailable); ``@seconds`` turns the point into a delay/hang of that
+length (cancellable).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+
+#: Catalog of injection points: name -> (description, exception factory).
+#: ``None`` factory means the point is availability-style: sites ask
+#: :func:`should_fire` and handle the failure themselves (no raise).
+POINTS: dict[str, tuple[str, object]] = {
+    "native.build": (
+        "native engine build/load failure (native.get_lib returns None)",
+        None,
+    ),
+    "io.chunk_read": (
+        "transient IO error reading/parsing one streaming ingest chunk",
+        lambda: OSError(errno.EIO, "injected fault: chunk read error"),
+    ),
+    "pipeline.stage": (
+        "exception inside a streaming pipeline stage body",
+        lambda: RuntimeError("injected fault: stage exception"),
+    ),
+    "pipeline.stage_hang": (
+        "hung/slow streaming pipeline stage (cancellable wait)",
+        None,  # delay-style: arm with seconds
+    ),
+    "io.writeback": (
+        "writeback IO error (ENOSPC) on the streaming output sink",
+        lambda: OSError(errno.ENOSPC, "injected fault: no space left on device"),
+    ),
+    "dist.rank_timeout": (
+        "one rank entering a collective late (cancellable delay)",
+        None,  # delay-style
+    ),
+}
+
+_LOCK = threading.Lock()
+_ARMED: dict[str, "_Fault"] = {}
+_HANG_CANCEL = threading.Event()
+#: fast-path flag — hot sites check this before taking the lock
+_ACTIVE = False
+
+
+class _Fault:
+    __slots__ = ("point", "times", "seconds", "after", "fired")
+
+    def __init__(self, point: str, times: int | None, seconds: float | None,
+                 after: int = 0):
+        self.point = point
+        self.times = times
+        self.seconds = seconds
+        self.after = after  # free passes before the first firing
+        self.fired = 0
+
+    def _take(self) -> bool:
+        """Consume one firing; False once the budget is spent."""
+        if self.after > 0:
+            self.after -= 1
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+def _refresh_active() -> None:
+    global _ACTIVE
+    _ACTIVE = bool(_ARMED)
+
+
+def arm(point: str, times: int | None = 1, seconds: float | None = None,
+        after: int = 0) -> None:
+    """Arm ``point`` to fire ``times`` times (None = unlimited).
+
+    ``seconds`` turns a raising point into a delay and is the wait length
+    for delay-style points (``pipeline.stage_hang``, ``dist.rank_timeout``).
+    ``after`` grants that many free passes before the first firing — for
+    "succeed N times, then fail" mid-stream scenarios.
+    """
+    if point not in POINTS:
+        raise KeyError(f"unknown fault point {point!r}; see faults.POINTS")
+    with _LOCK:
+        _ARMED[point] = _Fault(point, times, seconds, after=after)
+        _refresh_active()
+    # a newly armed hang must actually hang: clear any cancel latch left
+    # behind by a previous pipeline teardown
+    _HANG_CANCEL.clear()
+
+
+def disarm(point: str) -> None:
+    with _LOCK:
+        _ARMED.pop(point, None)
+        _refresh_active()
+
+
+def reset() -> None:
+    """Disarm everything and clear the hang-cancel latch (test teardown)."""
+    with _LOCK:
+        _ARMED.clear()
+        _refresh_active()
+    _HANG_CANCEL.clear()
+
+
+def fired(point: str) -> int:
+    """How many times ``point`` has fired (0 when never armed)."""
+    with _LOCK:
+        f = _ARMED.get(point)
+        return f.fired if f is not None else 0
+
+
+def cancel_hangs() -> None:
+    """Release every in-flight injected hang (watchdog/teardown path).
+
+    Hangs armed AFTER this call wait normally again once :func:`reset`
+    clears the latch.
+    """
+    _HANG_CANCEL.set()
+
+
+def should_fire(point: str) -> bool:
+    """Availability-style query: does ``point`` fire now? (no raise/sleep).
+
+    Used by sites that express the fault themselves — e.g. the native
+    library loader returns None for a "build failure"."""
+    if not _ACTIVE:
+        return False
+    with _LOCK:
+        f = _ARMED.get(point)
+        return f is not None and f._take()
+
+
+def check(point: str) -> None:
+    """Fire ``point`` if armed: sleep for delay-style points (cancellable),
+    raise the catalogued exception otherwise. No-op when disarmed."""
+    if not _ACTIVE:
+        return
+    with _LOCK:
+        f = _ARMED.get(point)
+        if f is None or not f._take():
+            return
+        seconds = f.seconds
+    _desc, exc_factory = POINTS[point]
+    if seconds is not None:
+        # cancellable: a watchdog that aborts the run can release us so
+        # the owning thread still joins
+        _HANG_CANCEL.wait(seconds)
+        if exc_factory is None:
+            return
+    if exc_factory is None:
+        return
+    raise exc_factory()
+
+
+def _arm_from_env() -> None:
+    """Parse ``VCTPU_FAULTS`` (see module docstring) — once at import, so
+    subprocess-based tests can arm faults without touching test APIs."""
+    spec = os.environ.get("VCTPU_FAULTS", "").strip()
+    if not spec:
+        return
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        seconds = None
+        if "@" in item:
+            item, sec_s = item.split("@", 1)
+            try:
+                seconds = float(sec_s)
+            except ValueError:
+                seconds = None
+        times: int | None = 1
+        explicit_times = ":" in item
+        if explicit_times:
+            item, times_s = item.split(":", 1)
+            try:
+                times = int(times_s)
+            except ValueError:
+                times = 1
+            if times <= 0:
+                times = None  # 0 / negative = unlimited
+        if item == "native.build" and not explicit_times:
+            times = None  # an unavailable engine stays unavailable
+        if item in POINTS:
+            arm(item, times=times, seconds=seconds)
+
+
+_arm_from_env()
