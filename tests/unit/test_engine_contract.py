@@ -179,7 +179,9 @@ def test_score_bytes_identical_native_vs_jit_10k(parity_world):
 
 def test_cli_output_byte_identical_native_vs_jit(parity_world):
     """Full CLI under VCTPU_ENGINE=native vs =jit: identical bytes except
-    the ##vctpu_engine header line that names the engine."""
+    the ##vctpu_engine / ##vctpu_forest_strategy header lines that name
+    the scoring configuration (the native engine's C++ walk records
+    native-cpp; the jit engine records its resolved XLA strategy)."""
     w = parity_world
     d = w["dir"]
     env0 = {k: v for k, v in os.environ.items() if k not in ("PYTHONPATH",)}
@@ -197,10 +199,14 @@ def test_cli_output_byte_identical_native_vs_jit(parity_world):
         assert p.returncode == 0, p.stderr[-2000:]
         outs[name] = open(f"{d}/out_{name}.vcf", "rb").read()
         assert f"##vctpu_engine={name}".encode() in outs[name]
+    # provenance: each output names the full scoring configuration
+    assert b"##vctpu_forest_strategy=native-cpp" in outs["native"]
+    assert b"##vctpu_forest_strategy=gather" in outs["jit"]  # cpu auto
 
     def body(b: bytes) -> bytes:
         return b"\n".join(line for line in b.split(b"\n")
-                          if not line.startswith(b"##vctpu_engine="))
+                          if not line.startswith(b"##vctpu_engine=")
+                          and not line.startswith(b"##vctpu_forest_strategy="))
 
     assert body(outs["native"]) == body(outs["jit"])
     assert outs["native"].count(b"TREE_SCORE=") == w["n"]

@@ -319,6 +319,18 @@ def test_keys_run_no_gt_report(tmp_path):
         "--dbs_signatures_file", str(tmp_path / "dbs.tsv")]) == 0
     check_inventory("run_no_gt_report", prefix + ".h5")
 
+    # the new ID83/DBS78 spectra (report_parity cells 24-27): full channel
+    # inventory in the COSMIC label layout, counts consistent with the
+    # callset (ints, non-negative)
+    from variantcalling_tpu.utils.h5_utils import read_hdf
+
+    id83 = read_hdf(prefix + ".h5", key="id83_channels")
+    assert list(id83["channel"]) == id83_labels()
+    assert (id83["size"] >= 0).all()
+    dbs = read_hdf(prefix + ".h5", key="dbs78_channels")
+    assert list(dbs["channel"]) == dbs78_labels()
+    assert (dbs["size"] >= 0).all()
+
 
 def test_keys_mrd_data_analysis(tmp_path):
     from tests.unit.test_reports_new import _mrd_world

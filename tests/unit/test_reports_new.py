@@ -463,10 +463,15 @@ def test_no_gt_report_scatter_and_stats(tmp_path):
     assert int(stats["count"].sum()) == 120
     scatter = read_hdf(prefix + ".h5", key="af_scatter")
     assert {"chrom", "pos", "af", "dp"}.issubset(scatter.columns)
+    # ID83/DBS78 spectra flow from full_analysis into the renderer too
+    assert {"id83_channels", "dbs78_channels"}.issubset(keys)
     html = str(tmp_path / "w.html")
     rc = report_wo_gt.run(["--input_h5", prefix + ".h5", "--html_output", html])
     assert rc == 0
-    assert "Variants statistics" in open(html).read()
+    text = open(html).read()
+    assert "Variants statistics" in text
+    assert "Indel ID83 channel spectrum" in text
+    assert "Doublet DBS78 channel spectrum" in text
 
 
 def test_nexusplt_interactive_html(tmp_path):

@@ -305,6 +305,49 @@ def test_resume_rejects_stale_journal(stream_fault_world, clean_bytes, monkeypat
     assert journal_mod.ChunkJournal.load(out) is None
 
 
+def test_resume_rejects_forest_strategy_change(stream_fault_world, clean_bytes,
+                                               monkeypatch):
+    """The resume identity pins the FULL scoring configuration: a run
+    interrupted under one VCTPU_FOREST_STRATEGY and resumed under another
+    RESTARTS (resumed_chunks == 0) instead of splicing — and since every
+    strategy is byte-parity-locked, the fresh run's bytes still match the
+    clean oracle (which doubles as strategy parity through the whole
+    streaming pipeline)."""
+    w = stream_fault_world
+    out = f"{w['dir']}/strat_change.vcf"
+    faults.arm("io.writeback", times=None, after=3)
+    with pytest.raises(OSError):
+        _run_stream(w, out, monkeypatch)
+    assert len(open(out + ".journal").read().splitlines()) - 1 >= 1
+    faults.reset()
+    monkeypatch.setenv("VCTPU_FOREST_STRATEGY", "gemm")
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["resumed_chunks"] == 0
+    assert stats["n"] == w["n"]
+    assert open(out, "rb").read().replace(
+        b"##vctpu_forest_strategy=gemm", b"##vctpu_forest_strategy=gather") \
+        == clean_bytes
+
+
+def test_resume_accepts_same_forest_strategy(stream_fault_world, clean_bytes,
+                                             monkeypatch):
+    """Control for the identity test: the SAME strategy resumes."""
+    w = stream_fault_world
+    out = f"{w['dir']}/strat_same.vcf"
+    monkeypatch.setenv("VCTPU_FOREST_STRATEGY", "wide")
+    faults.arm("io.writeback", times=None, after=3)
+    with pytest.raises(OSError):
+        _run_stream(w, out, monkeypatch)
+    committed = len(open(out + ".journal").read().splitlines()) - 1
+    assert committed >= 1
+    faults.reset()
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["resumed_chunks"] == committed
+    assert open(out, "rb").read().replace(
+        b"##vctpu_forest_strategy=wide", b"##vctpu_forest_strategy=gather") \
+        == clean_bytes
+
+
 def test_malformed_journal_degrades_to_fresh_run(tmp_path):
     """A journal whose lines parse as JSON but lack fields must not crash
     resume — it degrades to a fresh run (docs/robustness.md contract)."""

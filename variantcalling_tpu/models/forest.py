@@ -56,6 +56,25 @@ class FlatForest:
         )
 
 
+def sequential_tree_sum(per_tree: jnp.ndarray) -> jnp.ndarray:
+    """(N, T) per-tree leaf margins -> (N,) canonical-order sum.
+
+    THE one reduction every inference strategy (gather walk, scan GEMM,
+    wide GEMM, pallas) funnels through: a loop-carried fori_loop over
+    trees t=0,1,...,T-1. XLA cannot reassociate a loop-carried f32 sum,
+    and the native C++ walk accumulates in the same order, so any path
+    that produces bit-exact per-tree leaf values produces bit-identical
+    margins (the round-5 multihost byte-parity fix, see predict_margin).
+    """
+    n, t = per_tree.shape
+
+    def acc_body(ti, acc):
+        return acc + per_tree[:, ti]
+
+    return jax.lax.fori_loop(0, t, acc_body,
+                             jnp.zeros(n, dtype=per_tree.dtype))
+
+
 def predict_margin(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     """Raw per-variant leaf-value SUM in canonical tree order (jit-safe).
 
@@ -93,12 +112,7 @@ def predict_margin(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     idx0 = jnp.zeros((n, t), dtype=jnp.int32)
     idx = jax.lax.fori_loop(0, forest.max_depth, body, idx0)
     leaf_vals = value[tree_ids, idx]  # (N, T)
-
-    def acc_body(ti, acc):
-        return acc + leaf_vals[:, ti]
-
-    return jax.lax.fori_loop(0, t, acc_body,
-                             jnp.zeros(n, dtype=leaf_vals.dtype))
+    return sequential_tree_sum(leaf_vals)
 
 
 def finalize_margin(margin: np.ndarray, forest: FlatForest) -> np.ndarray:
@@ -129,12 +143,8 @@ def predict_score(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     finalize on the host via :func:`finalize_margin`, because the device
     sigmoid's exp is not bit-portable.
     """
-    margin = predict_margin(forest, x)
-    if forest.aggregation == "mean":
-        return margin / forest.n_trees
-    if forest.aggregation == "logit_sum":
-        return jax.nn.sigmoid(margin + forest.base_score)
-    raise ValueError(f"unknown aggregation {forest.aggregation!r}")
+    return _device_finalize(predict_margin(forest, x), forest.aggregation,
+                            forest.n_trees, forest.base_score)
 
 
 @dataclass
@@ -224,16 +234,31 @@ def to_gemm(forest: FlatForest, n_features: int | None = None) -> GemmForest:
 
 
 # beyond this many leaves per tree the (N,I)@(I,L) routing matmul costs more
-# than the gather walk saves; fall back to the gather traversal
+# than the gather walk saves; the AUTO strategy falls back to the gather
+# traversal (an explicit VCTPU_FOREST_STRATEGY override is honored anyway)
 GEMM_MAX_LEAVES = 512
 
 
-def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
-    """TREE_SCORE via the matmul formulation (jit/pjit-safe, MXU-bound).
+def _device_finalize(margin: jnp.ndarray, aggregation: str, n_trees: int,
+                     base_score: float) -> jnp.ndarray:
+    """Margin -> score ON DEVICE (accelerator convenience; NOT bit-portable
+    for logit_sum — engine-parity callers use the host finalize_margin)."""
+    if aggregation == "mean":
+        return margin / n_trees
+    if aggregation == "logit_sum":
+        return jax.nn.sigmoid(margin + base_score)
+    raise ValueError(f"unknown aggregation {aggregation!r}")
+
+
+def predict_margin_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Raw canonical-order margin via the matmul formulation (jit-safe).
 
     Scans over trees so peak memory is O(N * (I+L)) rather than
     O(T * N * L): each step is two (N,·)@(·,·) matmuls that tile cleanly
-    onto the systolic array.
+    onto the systolic array. The scan carry accumulates per-tree leaf
+    values in tree order — the same loop-carried (non-reassociable)
+    sequence :func:`sequential_tree_sum` runs — so margins are
+    bit-identical to the gather walk and the native C++ engine.
     """
     missing = gf.dleft is not None
     tables = (
@@ -267,54 +292,407 @@ def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
         return acc + s, None
 
     total, _ = jax.lax.scan(per_tree, jnp.zeros(x.shape[0], dtype=jnp.float32), tables)
-    if gf.aggregation == "mean":
-        return total / gf.m2.shape[0]
-    if gf.aggregation == "logit_sum":
-        return jax.nn.sigmoid(total + gf.base_score)
-    raise ValueError(f"unknown aggregation {gf.aggregation!r}")
+    return total
 
 
-#: Strategy chosen by the most recent make_predictor call — bench logs it
-#: so a silent pallas->gemm (or gemm->gather) fallback is visible in the
-#: captured perf evidence instead of invisibly changing what was measured.
-last_strategy: str = "none"
+def predict_score_gemm(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    """TREE_SCORE via the matmul formulation (device-finalized wrapper)."""
+    return _device_finalize(predict_margin_gemm(gf, x), gf.aggregation,
+                            gf.m2.shape[0], gf.base_score)
 
 
-def make_predictor(forest: FlatForest, n_features: int | None = None):
-    """Best inference strategy for the active backend: the pallas fused
-    per-tree kernel on TPU (VCTPU_PALLAS=0 opts out), the jnp GEMM
-    encoding on other accelerators, the gather walk on CPU / big trees
-    (the filter pipeline routes CPU single-device scoring through the
-    native C++ walk before reaching here). Returns a jittable fn(x) ->
-    scores; records the choice in :data:`last_strategy`."""
+# --------------------------------------------------------------------------
+# wide-contraction encoding: all trees per MXU pass
+# --------------------------------------------------------------------------
+
+#: default N-chunk of the wide driver (VCTPU_WIDE_CHUNK overrides): bounds
+#: the decision tensor at O(chunk * T*I) and the routing intermediate at
+#: O(chunk * G*L), so 5M-variant scoring never materializes (N, T*L)
+WIDE_CHUNK = 1 << 14
+WIDE_CHUNK_ENV = "VCTPU_WIDE_CHUNK"
+#: tree-group blocking knob (G trees per routing block; VCTPU_WIDE_BLOCK)
+WIDE_BLOCK_ENV = "VCTPU_WIDE_BLOCK"
+
+
+def _int_env(name: str) -> int | None:
+    """Positive-integer env knob, or None when unset. A malformed value is
+    a configuration error (EngineError, CLI exit 2) like a bad
+    VCTPU_ENGINE/VCTPU_FOREST_STRATEGY — never a mid-run ValueError
+    traceback from inside a jit trace."""
     import os
 
-    global last_strategy
-    gf = to_gemm(forest, n_features)
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
     try:
-        backend = jax.default_backend()
-    except Exception:  # backend init failure must not break program construction
-        backend = "cpu"
-    use_gemm = gf.n_leaves <= GEMM_MAX_LEAVES and backend != "cpu"
-    if use_gemm:
-        if backend == "tpu" and os.environ.get("VCTPU_PALLAS", "1") != "0":
-            try:
-                from variantcalling_tpu.models.forest_pallas import make_gemm_pallas_predictor
+        v = int(raw)
+    except ValueError:
+        v = 0
+    if v <= 0:
+        from variantcalling_tpu.engine import EngineError
 
-                fn = make_gemm_pallas_predictor(gf)
-                # lowering failures only surface at the first call — warm up
-                # HERE so the documented fallback holds for every caller,
-                # not just ones that wrap their own calls
-                n_feat = gf.a.shape[1]
-                jax.block_until_ready(jax.jit(fn)(jnp.zeros((1, n_feat), jnp.float32)))
-                last_strategy = "pallas"
-                return fn
-            except Exception:  # noqa: BLE001 — kernel gaps fall back to jnp GEMM
-                pass
-        last_strategy = "gemm"
-        return lambda x: predict_score_gemm(gf, x)
-    last_strategy = "gather"
-    return lambda x: predict_score(forest, x)
+        raise EngineError(
+            f"{name}={raw!r} is not a positive integer")
+    return v
+
+
+def default_tree_block(n_internal: int) -> int:
+    """G such that the routing contraction dim G*I fills one 128-lane MXU
+    tile: the block-diagonal operand wastes O(G^2) dense FLOPs, so G grows
+    only until the contraction lanes are full (docs/perf_notes.md roofline:
+    G=4 for I=31 -> K=124, 97% lane fill vs 24% for the per-tree scan)."""
+    return max(1, 128 // max(n_internal, 1))
+
+
+def resolved_tree_block(n_internal: int, n_trees: int,
+                        tree_block: int | None = None) -> int:
+    """The G :func:`to_wide` will actually pack with (arg beats the
+    VCTPU_WIDE_BLOCK env beats the MXU-fill default; clamped to T) —
+    shared with bench's FLOP attribution so MFU math cannot drift from
+    the packing."""
+    if tree_block is None:
+        tree_block = _int_env(WIDE_BLOCK_ENV) or default_tree_block(n_internal)
+    return max(1, min(int(tree_block), n_trees))
+
+
+@dataclass
+class WideGemmForest:
+    """Block-packed wide-contraction forest (all trees per MXU pass).
+
+    The per-tree scan (``predict_margin_gemm``) issues (N,F)@(F,I) and
+    (N,I)@(I,L) matmuls whose contraction dims fill 9-24% of the 128-lane
+    MXU. This encoding packs trees side by side so one pass computes every
+    tree: the feature pick becomes (N,F)@(F,Tp*I) (K stays F but the
+    output tile is Tp*I lanes wide), and routing becomes a BLOCK-DIAGONAL
+    (N,G*I)@(G*I,G*L) contraction over groups of G trees. Trees are padded
+    to Tp = ceil(T/G)*G with never-matching dummies (plen=-1, value=0);
+    padding never enters the margin reduction (sliced off before
+    :func:`sequential_tree_sum`), so the canonical tree order is exactly
+    the real trees'.
+    """
+
+    a: np.ndarray  # f32 (B, F, G*I) per-block feature selectors
+    thr: np.ndarray  # f32 (B, G*I)
+    m2: np.ndarray  # f32 (B, G*I, G*L) block-diagonal routing
+    c: np.ndarray  # f32 (B, G*L)
+    plen: np.ndarray  # f32 (B, G*L); -1 for padded leaves AND padded trees
+    value: np.ndarray  # f32 (B, G, L)
+    dleft: np.ndarray | None  # f32 (B, G*I) or None
+    n_trees: int  # real T — the slice fed to sequential_tree_sum
+    tree_block: int  # G
+    aggregation: str
+    base_score: float
+
+    @property
+    def n_blocks(self) -> int:
+        return self.m2.shape[0]
+
+
+def to_wide(gf: GemmForest, tree_block: int | None = None) -> WideGemmForest:
+    """Pack a GemmForest into block-diagonal wide operands (host, once)."""
+    t, f, i = gf.a.shape
+    l = gf.m2.shape[2]
+    g = resolved_tree_block(i, t, tree_block)
+    b = -(-t // g)
+    tp = b * g
+
+    def pad_trees(arr, fill=0.0):
+        if tp == t:
+            return arr
+        width = [(0, tp - t)] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, width, constant_values=fill)
+
+    a_p = pad_trees(gf.a)  # (Tp, F, I)
+    thr_p = pad_trees(gf.thr)
+    m2_p = pad_trees(gf.m2).reshape(b, g, i, l)
+    c_p = pad_trees(gf.c)
+    plen_p = pad_trees(gf.plen, fill=-1.0)  # padded trees: no leaf matches
+    value_p = pad_trees(gf.value)
+    a_w = np.ascontiguousarray(
+        a_p.reshape(b, g, f, i).transpose(0, 2, 1, 3).reshape(b, f, g * i))
+    m2_w = np.zeros((b, g * i, g * l), dtype=np.float32)
+    for gi in range(g):
+        m2_w[:, gi * i:(gi + 1) * i, gi * l:(gi + 1) * l] = m2_p[:, gi]
+    dleft_w = None if gf.dleft is None else \
+        pad_trees(gf.dleft).reshape(b, g * i)
+    return WideGemmForest(
+        a=a_w, thr=thr_p.reshape(b, g * i), m2=m2_w,
+        c=c_p.reshape(b, g * l), plen=plen_p.reshape(b, g * l),
+        value=value_p.reshape(b, g, l), dleft=dleft_w,
+        n_trees=t, tree_block=g,
+        aggregation=gf.aggregation, base_score=gf.base_score)
+
+
+def wide_chunk() -> int:
+    return _int_env(WIDE_CHUNK_ENV) or WIDE_CHUNK
+
+
+def predict_pertree_margin_wide(wf: WideGemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    """(N, T) per-tree leaf margins via the wide-contraction formulation
+    for ONE chunk (no internal N-chunking — see predict_margin_wide).
+
+    Exactness: the feature pick runs at HIGHEST precision (threshold
+    compares must see exact f32 values); the routing operands are exact
+    small integers (bf16-safe); the leaf pick multiplies a 0/1 one-hot by
+    the f32 leaf values and reduces over leaves — all-but-one terms are
+    exact +0.0, so the per-tree margin is the exact leaf value regardless
+    of reduction order. Bit-identical per-tree margins => bit-identical
+    canonical-order sums.
+    """
+    missing = wf.dleft is not None
+    n = x.shape[0]
+    b = wf.n_blocks
+    g = wf.tree_block
+    gi = wf.thr.shape[1]
+    a = jnp.asarray(wf.a).transpose(1, 0, 2).reshape(wf.a.shape[1], b * gi)
+    thr = jnp.asarray(wf.thr).reshape(b * gi)
+    if missing:
+        x_miss = jnp.isnan(x).astype(jnp.float32)
+        x = jnp.nan_to_num(x, nan=0.0)
+    # ONE wide feature pick for every tree: (N,F)@(F,Tp*I)
+    xf = jnp.dot(x, a, precision=jax.lax.Precision.HIGHEST)
+    d = (xf <= thr[None, :]).astype(jnp.float32)
+    if missing:
+        mf = jnp.dot(x_miss, a)  # exact 0/1 matmul
+        d = jnp.where(mf > 0.5, jnp.asarray(wf.dleft).reshape(b * gi)[None, :], d)
+    d_blocks = d.reshape(n, b, gi).transpose(1, 0, 2)  # (B, N, G*I)
+
+    def per_block(_, blk):
+        db, m2b, cb, plenb, valb = blk
+        # block-diagonal routing: (N,G*I)@(G*I,G*L), exact small ints
+        match = jnp.dot(db, m2b) + cb[None, :]
+        onehot = (match == plenb[None, :]).astype(jnp.float32)  # (N, G*L)
+        # per-tree leaf pick: one exact f32 survives per (variant, tree)
+        # (explicit leaf dim — reshape(-1) cannot infer it when n == 0)
+        margins = jnp.einsum("ngl,gl->ng",
+                             onehot.reshape(n, g, valb.shape[1]), valb,
+                             precision=jax.lax.Precision.HIGHEST)
+        return None, margins
+
+    xs = (d_blocks, jnp.asarray(wf.m2), jnp.asarray(wf.c),
+          jnp.asarray(wf.plen), jnp.asarray(wf.value))
+    _, per_tree = jax.lax.scan(per_block, None, xs)  # (B, N, G)
+    return per_tree.transpose(1, 0, 2).reshape(n, b * g)[:, :wf.n_trees]
+
+
+def predict_margin_wide(wf: WideGemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Raw canonical-order margin via wide contractions (jit-safe).
+
+    N-chunked driver: chunks of :func:`wide_chunk` variants run through
+    ``lax.map`` so peak memory stays O(chunk * T*I) however large N is
+    (the pipeline's outer 256k chunks would otherwise materialize a
+    ~1.2 GB decision tensor at T=40). Rows are independent, so chunking
+    cannot change any variant's bits.
+    """
+    n = x.shape[0]
+    chunk = wide_chunk()
+
+    def chunk_margin(xc):
+        return sequential_tree_sum(predict_pertree_margin_wide(wf, xc))
+
+    if n <= chunk:
+        return chunk_margin(x)
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    out = jax.lax.map(chunk_margin, xp.reshape(-1, chunk, x.shape[1]))
+    return out.reshape(-1)[:n]
+
+
+def predict_score_wide(wf: WideGemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    """TREE_SCORE via wide contractions (device-finalized wrapper)."""
+    return _device_finalize(predict_margin_wide(wf, x), wf.aggregation,
+                            wf.n_trees, wf.base_score)
+
+
+#: Strategy chosen by the most recent make_predictor/make_margin_predictor
+#: call — bench logs it so a silent pallas->wide (or wide->gather) fallback
+#: is visible in the captured perf evidence instead of invisibly changing
+#: what was measured.
+last_strategy: str = "none"
+
+#: explicit strategy override: {auto,gather,gemm,wide,pallas}
+FOREST_STRATEGY_ENV = "VCTPU_FOREST_STRATEGY"
+FOREST_STRATEGIES = ("auto", "gather", "gemm", "wide", "pallas")
+#: the VCF header key the filter pipeline records the resolved strategy
+#: under (next to ##vctpu_engine=; part of the chunk-journal resume identity)
+STRATEGY_HEADER_KEY = "vctpu_forest_strategy"
+
+
+def requested_strategy() -> str:
+    """The env-requested strategy; raises EngineError on a bad value (the
+    same fail-loudly style as a bad VCTPU_ENGINE)."""
+    import os
+
+    raw = os.environ.get(FOREST_STRATEGY_ENV, "auto").strip().lower() or "auto"
+    if raw not in FOREST_STRATEGIES:
+        from variantcalling_tpu.engine import EngineError
+
+        raise EngineError(
+            f"{FOREST_STRATEGY_ENV}={raw!r} is not a valid forest strategy; "
+            f"choose one of {'/'.join(FOREST_STRATEGIES)}")
+    return raw
+
+
+def validate_strategy_env() -> None:
+    """Up-front validation of EVERY strategy-related env knob (strategy
+    name, wide chunk, wide block) — FilterContext calls this once per run
+    so a malformed value exits 2 with a clear message before any scoring,
+    on every engine, instead of surfacing mid-run from inside a jit
+    trace."""
+    requested_strategy()
+    _int_env(WIDE_CHUNK_ENV)
+    _int_env(WIDE_BLOCK_ENV)
+
+
+def _backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # backend init failure must not break program construction
+        return "cpu"
+
+
+def max_tree_leaves(forest: FlatForest) -> int:
+    """Reachable leaves of the biggest tree, WITHOUT the O(T * nodes)
+    Python traversal :func:`to_gemm` performs: every stored internal node
+    is reachable and the trees are full binary (sklearn/xgboost/boosting
+    ingest all guarantee both), so leaves = internal nodes + 1 — padding
+    rows are feature=LEAF and do not count as internal. Matches
+    ``to_gemm(forest).n_leaves`` (asserted in tests) at vectorized cost."""
+    return int((forest.feature != LEAF).sum(axis=1).max()) + 1
+
+
+def resolve_strategy(forest: FlatForest, n_features: int | None = None,
+                     backend: str | None = None) -> str:
+    """The concrete strategy a run will score with (never ``auto``) —
+    resolved ONCE per run by the filter pipeline, recorded in the output
+    header and the chunk-journal resume identity, and then PINNED: the
+    predictor build honors it or fails loudly, so the recorded name can
+    never silently diverge from the program that scored.
+
+    Auto policy: CPU keeps the gather walk (the pipeline routes CPU
+    single-device scoring through the native C++ engine before reaching
+    here; this is the jit engine's CPU program). Accelerators take the
+    wide-contraction GEMM; TPUs take the pallas wide-block kernel when
+    enabled (VCTPU_PALLAS=0 opts out) and the forest has no missing-value
+    routing (the kernel's known gap). Trees beyond GEMM_MAX_LEAVES fall
+    back to the gather walk everywhere.
+    """
+    import os
+
+    req = requested_strategy()
+    if req != "auto":
+        return req
+    backend = backend or _backend()
+    if backend == "cpu":
+        return "gather"
+    if max_tree_leaves(forest) > GEMM_MAX_LEAVES:
+        return "gather"
+    if backend == "tpu" and os.environ.get("VCTPU_PALLAS", "1") != "0" \
+            and forest.default_left is None:
+        return "pallas"
+    return "wide"
+
+
+def _build_margin_program(strategy: str, forest: FlatForest,
+                          n_features: int | None):
+    """fn(x) -> canonical-order margin for one concrete strategy.
+
+    Raises on anything the strategy cannot serve (pallas lowering gaps,
+    bad env values) — the CALLER decides whether that is a loud failure
+    (explicitly requested strategy) or an auto fallback.
+    """
+    if strategy == "gather":
+        return lambda x: predict_margin(forest, x)
+    gf = to_gemm(forest, n_features)
+    if strategy == "gemm":
+        return lambda x: predict_margin_gemm(gf, x)
+    if strategy == "wide":
+        wf = to_wide(gf)
+        return lambda x: predict_margin_wide(wf, x)
+    if strategy == "pallas":
+        from variantcalling_tpu.models.forest_pallas import \
+            make_wide_pallas_margin_predictor
+
+        fn = make_wide_pallas_margin_predictor(gf)
+        # lowering failures only surface at the first call — warm up HERE
+        # so a gap is attributable to construction, not to a random caller
+        n_feat = gf.a.shape[1]
+        jax.block_until_ready(jax.jit(fn)(jnp.zeros((1, n_feat), jnp.float32)))
+        return fn
+    raise ValueError(f"unknown forest strategy {strategy!r}")
+
+
+#: auto-mode fallback order after the resolved strategy fails to build
+_AUTO_FALLBACK = ("wide", "gemm", "gather")
+
+
+def make_margin_predictor(forest: FlatForest, n_features: int | None = None,
+                          strategy: str | None = None):
+    """jittable fn(x) -> canonical-order margin, by strategy.
+
+    ``strategy=None`` reads ``VCTPU_FOREST_STRATEGY`` (default ``auto``).
+    An EXPLICITLY requested strategy (argument or env, not ``auto``) that
+    cannot build FAILS LOUDLY with EngineError (exit-2 style at the CLI) —
+    the PR-2 contract: a pinned configuration is honored or the run dies,
+    never silently degraded (the old ``make_predictor`` swallowed pallas
+    lowering failures with a bare except). Auto mode keeps the documented
+    fallback chain (pallas -> wide -> gemm -> gather), each hop recorded
+    in :data:`last_strategy`.
+
+    Every strategy returns the SAME bits: bit-exact per-tree leaf margins
+    reduced in canonical tree order (:func:`sequential_tree_sum` /
+    the scan carry), finalized by the caller through the one shared
+    :func:`finalize_margin`.
+    """
+    global last_strategy
+    from variantcalling_tpu.engine import EngineError
+
+    req = strategy if strategy is not None else requested_strategy()
+    explicit = req != "auto"
+    if explicit and req not in FOREST_STRATEGIES:
+        raise EngineError(
+            f"forest strategy {req!r} is not one of "
+            f"{'/'.join(FOREST_STRATEGIES[1:])}")
+    resolved = req if explicit else resolve_strategy(forest, n_features)
+    try:
+        fn = _build_margin_program(resolved, forest, n_features)
+    except Exception as e:  # noqa: BLE001 — fate decided by explicitness
+        if explicit:
+            raise EngineError(
+                f"forest strategy '{resolved}' was explicitly requested "
+                f"({FOREST_STRATEGY_ENV} or a pinned run configuration) but "
+                f"cannot serve this forest/backend: {type(e).__name__}: {e}. "
+                "Refusing to silently fall back — rerun with "
+                f"{FOREST_STRATEGY_ENV}=auto to opt into fallback. "
+                "See docs/models.md.") from e
+        fn = None
+        for fb in _AUTO_FALLBACK:
+            if fb == resolved:
+                continue
+            try:
+                fn = _build_margin_program(fb, forest, n_features)
+                resolved = fb
+                break
+            except Exception:  # noqa: BLE001 — keep walking the chain
+                continue
+        if fn is None:
+            raise
+    last_strategy = resolved
+    return fn
+
+
+def make_predictor(forest: FlatForest, n_features: int | None = None,
+                   strategy: str | None = None):
+    """Device-finalized fn(x) -> scores (accelerator/bench convenience):
+    the strategy-resolved margin program plus the on-device finalize.
+    Engine-parity callers (the filter pipeline) use
+    :func:`make_margin_predictor` + host :func:`finalize_margin` instead,
+    because the device sigmoid's exp is not bit-portable. Records the
+    choice in :data:`last_strategy`."""
+    fn = make_margin_predictor(forest, n_features, strategy=strategy)
+    agg, base = forest.aggregation, forest.base_score
+    n_trees = forest.n_trees
+    return lambda x: _device_finalize(fn(x), agg, n_trees, base)
 
 
 def native_host_predictor(forest: FlatForest, strict: bool = False):

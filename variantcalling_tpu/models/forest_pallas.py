@@ -18,10 +18,24 @@ This kernel keeps the WHOLE per-tree chain in VMEM:
     intermediates never touch HBM. Trees iterate innermost, so the output
     block revisits and accumulates (TPU grids run sequentially).
 
-Integration: models/forest.make_predictor routes here on TPU backends
-(VCTPU_PALLAS=0 opts out); CPU tests run the same kernel in interpreter
-mode. Forests with missing-value routing (default_left) use the jnp path
-— NaN-bearing inputs need the extra mask matmul.
+Two kernels live here:
+
+- the original per-tree kernel (``make_gemm_pallas_predictor``): grid
+  (variant tiles, trees), output block accumulates the margin across the
+  sequential tree-innermost grid — kept for reference/fallback;
+- the WIDE-BLOCK kernel (``make_wide_pallas_margin_predictor``): grid
+  (variant tiles, tree blocks) over the block-diagonal wide encoding
+  (``models/forest.to_wide``). Each step computes G trees per MXU pass
+  and emits a (TILE_N, G) per-tree margin block; the canonical-order tree
+  reduction runs OUTSIDE the kernel through the one shared
+  ``forest.sequential_tree_sum``, so margins are bit-identical to the
+  gather walk, the jnp GEMM paths and the native C++ engine.
+
+Integration: the ``pallas`` entry of the models/forest strategy registry
+(``VCTPU_FOREST_STRATEGY``; auto prefers it on TPU, VCTPU_PALLAS=0 opts
+out) builds the wide-block kernel; CPU tests run the same kernels in
+interpreter mode. Forests with missing-value routing (default_left) use
+the jnp paths — NaN-bearing inputs need the extra mask matmul.
 """
 
 from __future__ import annotations
@@ -88,6 +102,96 @@ def _margin_pallas(tables, x, interpret: bool) -> jnp.ndarray:
     return out[:, 0]
 
 
+def _wide_block_kernel(x_ref, a_ref, thr_ref, m2_ref, c_ref, plen_ref,
+                       val_ref, out_ref):
+    """One (variant tile, tree block) step of the WIDE strategy: the whole
+    per-block chain — wide feature pick, compare, block-diagonal routing,
+    per-tree leaf pick — stays in VMEM; only the (TILE_N, G) per-tree
+    margin block leaves. No cross-step accumulation: each grid step owns
+    its output block, and the canonical-order tree reduction happens
+    OUTSIDE the kernel through the shared forest.sequential_tree_sum."""
+    x = x_ref[:]  # (TILE_N, F)
+    a = a_ref[0]  # (F, G*I)
+    # feature pick must keep f32 values exact (thresholds compare tightly)
+    xf = jax.lax.dot_general(x, a, (((1,), (0,)), ((), ())),
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
+    d = (xf <= thr_ref[0][None, :]).astype(jnp.float32)  # (TILE_N, G*I)
+    # block-diagonal routing: operands are exact small integers
+    match = jax.lax.dot_general(d, m2_ref[0], (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+    match = match + c_ref[0][None, :]
+    hit = (match == plen_ref[0][None, :]).astype(jnp.float32)  # (TILE_N, G*L)
+    val = val_ref[0]  # (G, L)
+    g, l = val.shape
+    # per-tree leaf pick on the VPU: exactly one hit per (variant, tree),
+    # every other term is an exact +0.0 — bit-exact in any reduction order
+    out_ref[:] = jnp.sum(hit.reshape(x.shape[0], g, l) * val[None, :, :],
+                         axis=2)
+
+
+def make_wide_pallas_margin_predictor(gf, tree_block: int | None = None,
+                                      interpret: bool | None = None):
+    """fn(x) -> canonical-order margin for a GemmForest, running the
+    wide-block kernel (grid over (variant tile, tree block); all of a
+    block's operands VMEM-resident).
+
+    Raises ValueError for forests the kernel does not cover (missing-value
+    routing); the auto strategy falls back to the jnp wide path, an
+    explicit ``pallas`` request fails loudly (models/forest registry).
+    """
+    from jax.experimental import pallas as pl
+
+    from variantcalling_tpu.models import forest as forest_mod
+
+    if gf.dleft is not None:
+        raise ValueError("pallas forest kernel does not implement default_left routing")
+    if interpret is None:
+        try:
+            interpret = jax.default_backend() != "tpu"
+        except Exception:  # noqa: BLE001
+            interpret = True
+    wf = forest_mod.to_wide(gf, tree_block)
+    b, f, gi = wf.a.shape
+    gl = wf.m2.shape[2]
+    g = wf.tree_block
+    tables = (
+        jnp.asarray(wf.a),
+        jnp.asarray(wf.thr),
+        jnp.asarray(wf.m2),
+        jnp.asarray(wf.c),
+        jnp.asarray(wf.plen),
+        jnp.asarray(wf.value),
+    )
+    n_trees = wf.n_trees
+
+    def predict(x):
+        n = x.shape[0]
+        if n == 0:  # a zero-size grid cannot dispatch
+            return jnp.zeros((0,), jnp.float32)
+        pad = (-n) % TILE_N
+        xp = jnp.pad(x.astype(jnp.float32), ((0, pad), (0, 0)))
+        per_tree = pl.pallas_call(
+            _wide_block_kernel,
+            grid=(xp.shape[0] // TILE_N, b),
+            in_specs=[
+                pl.BlockSpec((TILE_N, f), lambda bi, ti: (bi, 0)),
+                pl.BlockSpec((1, f, gi), lambda bi, ti: (ti, 0, 0)),
+                pl.BlockSpec((1, gi), lambda bi, ti: (ti, 0)),
+                pl.BlockSpec((1, gi, gl), lambda bi, ti: (ti, 0, 0)),
+                pl.BlockSpec((1, gl), lambda bi, ti: (ti, 0)),
+                pl.BlockSpec((1, gl), lambda bi, ti: (ti, 0)),
+                pl.BlockSpec((1, g, gl // g), lambda bi, ti: (ti, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((TILE_N, g), lambda bi, ti: (bi, ti)),
+            out_shape=jax.ShapeDtypeStruct((xp.shape[0], b * g), jnp.float32),
+            interpret=interpret,
+        )(xp, *tables)
+        return forest_mod.sequential_tree_sum(per_tree[:, :n_trees])[:n]
+
+    return predict
+
+
 def make_gemm_pallas_predictor(gf, interpret: bool | None = None):
     """fn(x) -> scores for a GemmForest, running the pallas kernel.
 
@@ -114,6 +218,8 @@ def make_gemm_pallas_predictor(gf, interpret: bool | None = None):
 
     def predict(x):
         n = x.shape[0]
+        if n == 0:  # a zero-size grid cannot dispatch
+            return jnp.zeros((0,), jnp.float32)
         pad = (-n) % TILE_N
         xp = jnp.pad(x.astype(jnp.float32), ((0, pad), (0, 0)))
         total = _margin_pallas(tables, xp, interpret)[:n]

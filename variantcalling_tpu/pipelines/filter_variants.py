@@ -155,45 +155,51 @@ def _cache_put(key: tuple, value: tuple) -> None:
     _PREDICTOR_CACHE[key] = value
 
 
-def _raw_predictor(model, feature_names: list[str]):
+def _strategy_token(strategy: str | None) -> tuple:
+    """Predictor-cache key component: the pinned strategy (or the live env
+    request) PLUS the wide-path knobs — tests flip these between calls,
+    and a cached program compiled under the old values must not answer
+    for the new."""
+    return (strategy or os.environ.get(forest_mod.FOREST_STRATEGY_ENV, "auto"),
+            os.environ.get(forest_mod.WIDE_CHUNK_ENV, ""),
+            os.environ.get(forest_mod.WIDE_BLOCK_ENV, ""))
+
+
+def _raw_predictor(model, feature_names: list[str], strategy: str | None = None):
     """-> (program, host_finalize|None).
 
     ``program`` is jit-safe; ``host_finalize`` (if set) turns its fetched
-    output into TREE_SCOREs on the host. FlatForests on the CPU backend
-    return canonical-order MARGINS from the device program and finalize
-    through :func:`forest_mod.finalize_margin` — the same shared code the
-    native engine uses, so the two engines' score bits are identical by
-    construction (sigmoid/exp is not bit-portable across XLA and libm).
-    Accelerators keep fully device-finalized programs (pallas/GEMM).
+    output into TREE_SCOREs on the host. FlatForests return canonical-order
+    MARGINS from the strategy-resolved device program
+    (:func:`forest_mod.make_margin_predictor` — gather walk, scan GEMM,
+    wide-contraction GEMM or the pallas wide-block kernel, all bit-identical)
+    and finalize through :func:`forest_mod.finalize_margin` — the same
+    shared code the native engine uses, so every engine/strategy's score
+    bits are identical by construction (sigmoid/exp is not bit-portable
+    across XLA and libm). ``strategy`` pins the run-level resolution
+    (FilterContext); None reads ``VCTPU_FOREST_STRATEGY``.
     """
     if isinstance(model, FlatForest):
         ordered = forest_mod.with_feature_order(model, feature_names)
-        try:
-            backend = jax.default_backend()
-        except Exception:  # noqa: BLE001 — backend probe failure: assume cpu
-            backend = "cpu"
-        if backend == "cpu":
-            forest_mod.last_strategy = "gather"
-            return (lambda xx: forest_mod.predict_margin(ordered, xx),
-                    lambda m: forest_mod.finalize_margin(m, ordered))
-        # GEMM (MXU) encoding on TPU / accelerators
-        return forest_mod.make_predictor(ordered, len(feature_names)), None
+        program = forest_mod.make_margin_predictor(
+            ordered, len(feature_names), strategy=strategy)
+        return program, (lambda m: forest_mod.finalize_margin(m, ordered))
     return (lambda xx: threshold_mod.predict_score(model, xx, feature_names)), None
 
 
-def _predictor_for(model, feature_names: list[str]):
-    key = ("x", id(model), tuple(feature_names))
+def _predictor_for(model, feature_names: list[str], strategy: str | None = None):
+    key = ("x", id(model), tuple(feature_names), _strategy_token(strategy))
     hit = _PREDICTOR_CACHE.get(key)
     if hit is not None and hit[0] is model:
         return hit[1]
-    program, finalize = _raw_predictor(model, feature_names)
+    program, finalize = _raw_predictor(model, feature_names, strategy=strategy)
     pair = (jax.jit(program), finalize)
     _cache_put(key, (model, pair))
     return pair
 
 
 def _fused_program(model, feature_names: list[str], flow_order: str,
-                   genome_resident: bool = False):
+                   genome_resident: bool = False, strategy: str | None = None):
     """One jitted device program: windows + host columns -> TREE_SCORE.
 
     Fuses the window featurization kernels (gc/hmer/motif/cycle-skip) with
@@ -212,7 +218,8 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
     from variantcalling_tpu.featurize import (CENTER, DEVICE_FEATURES,
                                               device_feature_dict, windows_from_packed)
 
-    key = ("fused", id(model), tuple(feature_names), flow_order, genome_resident)
+    key = ("fused", id(model), tuple(feature_names), flow_order,
+           genome_resident, _strategy_token(strategy))
     hit = _PREDICTOR_CACHE.get(key)
     if hit is not None and hit[0] is model:
         return hit[1]
@@ -221,9 +228,9 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
     # into one device program (engine contract, docs/robustness.md — the
     # native engine short-circuits in fused_featurize_score and never
     # reaches here, so no native split hides inside the "jit" engine).
-    # On CPU the program returns margins and `finalize` (shared with the
+    # FlatForest programs return margins and `finalize` (shared with the
     # native engine) produces the final score bits on the host.
-    predictor, finalize = _raw_predictor(model, feature_names)
+    predictor, finalize = _raw_predictor(model, feature_names, strategy=strategy)
     host_names = [f for f in feature_names if f not in DEVICE_FEATURES]
     host_idx = {f: i for i, f in enumerate(host_names)}
 
@@ -332,7 +339,8 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
 
 def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
                           fasta: FastaReader | None = None,
-                          engine: engine_mod.EngineDecision | None = None) -> np.ndarray:
+                          engine: engine_mod.EngineDecision | None = None,
+                          strategy: str | None = None) -> np.ndarray:
     """Chunked fused featurize+score over a HostFeatures batch; returns scores.
 
     With ``table``+``fasta`` and no precomputed host windows, the
@@ -405,7 +413,8 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
                 gpos_fill = packed_position_fill(genome)
 
     fn, host_names, finalize = _fused_program(model, hf.names, flow_order,
-                                              genome_resident=genome_resident)
+                                              genome_resident=genome_resident,
+                                              strategy=strategy)
     host_cols = tuple(_narrow_column(hf.cols[f]) for f in host_names)
 
     from variantcalling_tpu.featurize import _bucket
@@ -464,7 +473,8 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
 
 
 def score_variants(model, x: np.ndarray, feature_names: list[str],
-                   engine: engine_mod.EngineDecision | None = None) -> np.ndarray:
+                   engine: engine_mod.EngineDecision | None = None,
+                   strategy: str | None = None) -> np.ndarray:
     """Jitted chunked scoring, sharded over the mesh dp axis; returns TREE_SCORE per row.
 
     Multi-device: the feature chunk is device_put with a dp sharding and the
@@ -487,7 +497,7 @@ def score_variants(model, x: np.ndarray, feature_names: list[str],
                 "aggregation). Refusing to silently fall back to the jit "
                 "engine; rerun with VCTPU_ENGINE=jit. See docs/robustness.md.")
         return nf(np.ascontiguousarray(x, dtype=np.float32))  # C++ walk
-    fn, finalize = _predictor_for(model, feature_names)
+    fn, finalize = _predictor_for(model, feature_names, strategy=strategy)
 
     from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
 
@@ -555,6 +565,23 @@ class FilterContext:
             eng = replace(eng, name="jit",
                           reason=f"{type(model).__name__} has no native scorer")
         self.engine = eng
+        # the run-level FOREST STRATEGY (VCTPU_FOREST_STRATEGY): resolved
+        # once here, recorded next to ##vctpu_engine= in the output header
+        # and in the chunk-journal resume identity, then PINNED into every
+        # scoring call — the predictor build honors it or raises
+        # (EngineError, exit 2), so the recorded name can never silently
+        # diverge from the program that scored. The native engine's C++
+        # walk has no XLA strategy; it records "native-cpp" — but a
+        # MALFORMED env value (strategy name, wide chunk/block knobs) is a
+        # configuration error on every engine (same rule as a bad
+        # VCTPU_ENGINE), so validate them all up front.
+        forest_mod.validate_strategy_env()
+        if eng.name == "native":
+            self.forest_strategy = "native-cpp"
+        elif isinstance(model, FlatForest):
+            self.forest_strategy = forest_mod.resolve_strategy(model)
+        else:
+            self.forest_strategy = "jit"  # threshold/sklearn program
         self.model = model
         self.fasta = fasta
         self.hpol_length = hpol_length
@@ -614,17 +641,22 @@ class FilterContext:
         if self.is_mutect and "TLOD" in hf.cols:
             hf.cols["tlod"] = hf.cols.pop("TLOD")
             hf.names[hf.names.index("TLOD")] = "tlod"
+        # pin the run-level strategy into the predictor build (registry
+        # names only — "native-cpp"/"jit" rides the engine decision)
+        strat = self.forest_strategy \
+            if self.forest_strategy in forest_mod.FOREST_STRATEGIES else None
         if isinstance(model, (FlatForest, ThresholdModel)):
             # fused featurize+score: window features and the forest walk run
             # as one device program, only TREE_SCORE returns to the host
             score = fused_featurize_score(model, hf, self.flow_order, table=table,
-                                          fasta=fasta, engine=self.engine)
+                                          fasta=fasta, engine=self.engine,
+                                          strategy=strat)
         else:  # raw sklearn estimator: materialize the matrix from the same hf
             from variantcalling_tpu.featurize import materialize_features
 
             fs = materialize_features(hf, flow_order=self.flow_order)
             score = score_variants(model, fs.matrix(), fs.feature_names,
-                                   engine=self.engine)
+                                   engine=self.engine, strategy=strat)
 
         pass_thr = getattr(model, "pass_threshold", 0.5)
         n = len(table)
@@ -688,27 +720,37 @@ def filter_variants(
     return ctx.score_table(table)
 
 
-def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = None) -> None:
+def _replace_or_append_meta(header, prefix: str, line: str) -> None:
+    """A stale line inherited from a previously-filtered input must not
+    mislabel THIS run: replace in place (position preserved), append when
+    absent."""
+    replaced = False
+    for i, old in enumerate(header.lines):
+        if old.startswith(prefix):
+            header.lines[i] = line
+            replaced = True
+    if not replaced:
+        header.add_meta_line(line)
+
+
+def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = None,
+                          strategy: str | None = None) -> None:
     """The filter pipeline's header additions — ONE place so the serial and
     streaming writers emit identical header bytes. Records the scoring
-    engine (``##vctpu_engine=...``) so every output file names the engine
-    that produced it (engine contract, docs/robustness.md)."""
+    engine (``##vctpu_engine=...``) and, when known, the resolved forest
+    strategy (``##vctpu_forest_strategy=...``) so every output file names
+    the full scoring configuration that produced it (engine contract,
+    docs/robustness.md)."""
     header.ensure_filter(LOW_SCORE, "Model score below threshold")
     header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
     header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
     header.ensure_info("TREE_SCORE", "1", "Float", "Filtering model confidence score")
     eng = engine or engine_mod.resolve()
-    prefix = f"##{engine_mod.HEADER_KEY}="
-    # a stale line inherited from a previously-filtered input must not
-    # mislabel THIS run's engine: replace in place (position preserved),
-    # append when absent
-    replaced = False
-    for i, line in enumerate(header.lines):
-        if line.startswith(prefix):
-            header.lines[i] = eng.header_line()
-            replaced = True
-    if not replaced:
-        header.add_meta_line(eng.header_line())
+    _replace_or_append_meta(header, f"##{engine_mod.HEADER_KEY}=",
+                            eng.header_line())
+    if strategy is not None:
+        key = forest_mod.STRATEGY_HEADER_KEY
+        _replace_or_append_meta(header, f"##{key}=", f"##{key}={strategy}")
 
 
 def streaming_eligible(args_limit_to_contig=None) -> bool:
@@ -821,7 +863,7 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
         annotate_intervals=annotate, flow_order=args.flow_order,
         is_mutect=args.is_mutect, engine=engine,
     )
-    _ensure_output_header(header, engine=ctx.engine)
+    _ensure_output_header(header, engine=ctx.engine, strategy=ctx.forest_strategy)
 
     # kill the warmup cliff: encode (and persist) the genome on a prefetch
     # thread; scoring's per-contig fetch_encoded waits only for the contig
@@ -886,6 +928,12 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
                 "annotate_intervals": sorted(
                     os.path.abspath(p) for p in (args.annotate_intervals or [])),
                 "engine": ctx.engine.name,
+                # committed chunks carry the old run's strategy: even though
+                # every strategy is parity-tested byte-identical, the resume
+                # identity pins the FULL scoring configuration (PR-2
+                # contract) — a run resumed under a different
+                # VCTPU_FOREST_STRATEGY restarts instead of splicing
+                "forest_strategy": ctx.forest_strategy,
             },
         }
         resume = journal_mod.try_resume(out_path, meta)
@@ -1085,7 +1133,8 @@ def run(argv: list[str]) -> int:
                         jax.process_index(), n_proc)
             return 0
 
-    _ensure_output_header(table.header, engine=ctx.engine)
+    _ensure_output_header(table.header, engine=ctx.engine,
+                          strategy=ctx.forest_strategy)
     with stage("writeback"):
         # verbatim_core: this pipeline never edits CHROM..QUAL, so record
         # assembly can splice FILTER/TREE_SCORE between original byte spans

@@ -27,6 +27,8 @@ SECTION_TITLES = {
     "af_hist": "Allele-frequency histogram",
     "af_scatter": "Allele frequency along the genome / vs depth",
     "snp_motifs": "SNP 96-motif spectrum",
+    "id83_channels": "Indel ID83 channel spectrum",
+    "dbs78_channels": "Doublet DBS78 channel spectrum",
     "signature_exposures": "Mutational signature exposures",
 }
 
@@ -43,6 +45,34 @@ def parse_args(argv):
 _SBS_CLASS_COLORS = {
     "C>A": "#03bcee", "C>G": "#010101", "C>T": "#e32926",
     "T>A": "#cac9c9", "T>C": "#a1ce63", "T>G": "#ebc6c4",
+}
+
+# ID83 group colors keyed on the SigProfiler label prefix — 1-bp indels by
+# folded base, longer indels by repeat class, microhomology deletions
+_ID83_GROUP_COLORS = {
+    "1:Del:C": "#fdbe6f", "1:Del:T": "#ff8001", "1:Ins:C": "#b0dd8b",
+    "1:Ins:T": "#36a12e", "Del:R": "#fca8a5", "Ins:R": "#aec7e8",
+    "Del:M": "#b9a2ca",
+}
+
+
+def _id83_color(label: str) -> str:
+    parts = str(label).split(":")
+    if len(parts) != 4:
+        return "#888888"
+    ln, kind, cls = parts[0], parts[1], parts[2]
+    if ln == "1":
+        return _ID83_GROUP_COLORS.get(f"1:{kind}:{cls}", "#888888")
+    if cls == "M":
+        return _ID83_GROUP_COLORS["Del:M"]
+    return _ID83_GROUP_COLORS.get(f"{kind}:R", "#888888")
+
+
+# DBS78 ref-doublet group colors (10 canonical refs, COSMIC palette order)
+_DBS_REF_COLORS = {
+    "AC": "#03bcee", "AT": "#0266cc", "CC": "#a1ce63", "CG": "#016501",
+    "CT": "#fd9898", "GC": "#e32926", "TA": "#fcc9b4", "TC": "#fd8001",
+    "TG": "#cb98fd", "TT": "#4c0199",
 }
 
 
@@ -72,6 +102,21 @@ def _figure_for(key: str, df: pd.DataFrame):
         ax.set_xticks(np.arange(0, len(counts), 16))
         ax.set_xlabel("96 trinucleotide channels")
         ax.set_ylabel("# SNVs")
+        return fig
+    if key in ("id83_channels", "dbs78_channels") and len(num) and "channel" in df.columns:
+        counts = num.iloc[:, 0].to_numpy()
+        labels = df["channel"].astype(str)
+        colors = ([_id83_color(lab) for lab in labels] if key == "id83_channels"
+                  else [_DBS_REF_COLORS.get(str(lab).split(">")[0], "#888888")
+                        for lab in labels])
+        fig, ax = plt.subplots(figsize=(14, 3))
+        ax.bar(np.arange(len(counts)), counts, color=colors, width=0.8)
+        step = 6 if key == "id83_channels" else 9
+        ax.set_xticks(np.arange(0, len(counts), step))
+        ax.set_xticklabels(labels[::step], fontsize=6, rotation=90)
+        ax.set_xlabel("83 COSMIC indel channels" if key == "id83_channels"
+                      else "78 COSMIC doublet channels")
+        ax.set_ylabel("# indels" if key == "id83_channels" else "# doublets")
         return fig
     if key in ("ins_del_hete", "ins_del_homo") and len(num):
         plot_df = num

@@ -7,7 +7,9 @@ in-process device reductions (reports/variant_eval); the SigProfiler
 somatic stage reduces to the 96-channel SBS matrix (signature assignment
 needs the external SigProfiler package and is gated on its presence).
 Outputs the same HDF5 key layout (``ins_del_hete``, ``ins_del_homo``,
-``af_hist``, ``snp_motifs``, ``eval_<Table>``, ``callable_size``).
+``af_hist``, ``snp_motifs``, ``eval_<Table>``, ``callable_size``) plus the
+ID83/DBS78 channel spectra (``id83_channels``, ``dbs78_channels``) the
+notebook's signature cells render alongside SBS96.
 """
 
 from __future__ import annotations
@@ -60,11 +62,25 @@ def run_full_analysis(args) -> None:
     logger.info("snp motif statistics")
     snp_motifs = no_gt_stats.snp_statistics(table, cols, windows)
 
+    # ID83 / DBS78 channel spectra (notebook cells 24-27 render all three
+    # COSMIC catalogs, not just SBS96 — the docs/report_parity.md gap):
+    # same classifiers the somatic stage uses (reports/signatures.py)
+    logger.info("ID83/DBS78 channel spectra")
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.reports import signatures as sigmod
+
+    id83 = sigmod.id83_matrix(_indel_records(table), FastaReader(args.reference))
+    dbs78 = sigmod.dbs78_matrix(table)
+
     write_hdf(ins_del["hete"].T.reset_index(names="hmer_len"), out_h5, key="ins_del_hete", mode=mode)
     write_hdf(ins_del["homo"].T.reset_index(names="hmer_len"), out_h5, key="ins_del_homo", mode="a")
     write_hdf(af_df, out_h5, key="af_hist", mode="a")
     motif_df = snp_motifs.reset_index()
     write_hdf(motif_df, out_h5, key="snp_motifs", mode="a")
+    write_hdf(id83.rename_axis("channel").reset_index(), out_h5,
+              key="id83_channels", mode="a")
+    write_hdf(dbs78.rename_axis("channel").reset_index(), out_h5,
+              key="dbs78_channels", mode="a")
     for name, tbl in eval_tables.items():
         write_hdf(tbl, out_h5, key=f"eval_{name}", mode="a")
 
@@ -103,6 +119,19 @@ def run_eval_tables_only(args) -> None:
         mode = "a"
 
 
+def _indel_records(table):
+    """(chrom, pos, REF, first-ALT) tuples for the ID83 classifier — the
+    one place that encodes the first-allele + length-mismatch convention,
+    shared by full_analysis and the somatic stage."""
+    chrom = np.asarray(table.chrom)
+    refs = np.asarray(table.ref)
+    alts = np.asarray(table.alt)
+    return ((chrom[i], int(table.pos[i]), refs[i].upper(),
+             alts[i].split(",")[0].upper())
+            for i in range(len(table))
+            if len(refs[i]) != len(alts[i].split(",")[0]))
+
+
 def _somatic_matrices(vcf_path: str, reference: str) -> dict[str, pd.Series]:
     """SBS96 + ID83 + DBS78 channel counts for one callset (the three
     catalogs the reference's SigProfiler stage generates,
@@ -119,16 +148,9 @@ def _somatic_matrices(vcf_path: str, reference: str) -> dict[str, pd.Series]:
     sbs = pd.Series(snp_motifs.values,
                     index=[f"{m[0]}[{m[1]}>{a}]{m[2]}" for (m, a) in snp_motifs.index],
                     name="size")
-    fasta = FastaReader(reference)
-    chrom = np.asarray(table.chrom)
-    refs = np.asarray(table.ref)
-    alts = np.asarray(table.alt)
-    indels = ((chrom[i], int(table.pos[i]), refs[i].upper(), alts[i].split(",")[0].upper())
-              for i in range(len(table))
-              if len(refs[i]) != len(alts[i].split(",")[0]))
     return {
         "SBS96": sbs,
-        "ID83": sigmod.id83_matrix(indels, fasta),
+        "ID83": sigmod.id83_matrix(_indel_records(table), FastaReader(reference)),
         "DBS78": dbs,
     }
 
