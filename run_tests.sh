@@ -13,6 +13,26 @@
 # (tools/flakehunt.sh is the general-purpose hunter).
 set -o pipefail
 cd "$(dirname "$0")"
+
+# -- tier-0 lint stage (docs/static_analysis.md) ---------------------------
+# vctpu-lint enforces the engine-determinism contract invariants (raw
+# VCTPU_* environ reads, silent broad-except fallbacks, unordered
+# tree-sum reductions, tracer host syncs, unbounded subprocesses); it
+# runs BEFORE pytest and new findings fail the whole run. ruff (pyflakes
+# + import order, [tool.ruff] in pyproject.toml) rides along when
+# installed — the hermetic test container does not ship it.
+echo "lint stage: python -m tools.vctpu_lint"
+env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.vctpu_lint || {
+  echo "vctpu-lint found new findings — failing before pytest" >&2
+  exit 1
+}
+if command -v ruff >/dev/null 2>&1; then
+  echo "lint stage: ruff check"
+  ruff check variantcalling_tpu tools tests || exit 1
+else
+  echo "lint stage: ruff not installed — skipped"
+fi
+
 rc=0
 env PYTHONPATH= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \

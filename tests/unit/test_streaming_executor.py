@@ -40,8 +40,14 @@ def test_resolve_threads_env(monkeypatch):
     assert resolve_threads() == 1
     monkeypatch.setenv("VCTPU_THREADS", "7")
     assert resolve_threads() == 7
+    # knob-registry contract (ISSUE 4): a malformed value is a
+    # configuration error (EngineError, CLI exit 2) on every engine —
+    # the old fall-back-to-auto behavior silently changed the executor
+    from variantcalling_tpu.engine import EngineError
+
     monkeypatch.setenv("VCTPU_THREADS", "bogus")
-    assert resolve_threads() >= 1  # invalid value falls back to auto
+    with pytest.raises(EngineError, match="not a positive integer"):
+        resolve_threads()
     monkeypatch.delenv("VCTPU_THREADS")
     assert resolve_threads() == (os.cpu_count() or 1)
 

@@ -1,0 +1,394 @@
+"""vctpu-lint self-tests: golden expected-findings per checker (positive
+AND negative fixtures), suppression-comment handling, baseline
+round-trip, CLI exit codes, and the acceptance-criteria seeded
+regressions (a raw VCTPU_* environ read, a bare ``except: pass``
+fallback, a ``jnp.sum`` over the tree axis) — each must be caught.
+
+ISSUE 4 tentpole satellite."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from tools import vctpu_lint as lint
+from tools.vctpu_lint import baseline as baseline_mod
+from tools.vctpu_lint.__main__ import main as lint_main
+
+
+def run(src: str, path: str = "variantcalling_tpu/snippet.py",
+        select: set[str] | None = None) -> list[lint.Finding]:
+    return lint.lint_source(path, textwrap.dedent(src), select)
+
+
+def codes(src: str, **kw) -> list[str]:
+    return [f.code for f in run(src, **kw)]
+
+
+# ---------------------------------------------------------------------------
+# VCT001 raw-environ
+# ---------------------------------------------------------------------------
+
+
+def test_vct001_environ_get_flagged():
+    fs = run('''
+        import os
+        chunk = os.environ.get("VCTPU_STREAM_CHUNK_BYTES", "1024")
+        ''')
+    assert [f.code for f in fs] == ["VCT001"]
+    assert "VCTPU_STREAM_CHUNK_BYTES" in fs[0].message
+    assert "knobs" in fs[0].message
+
+
+def test_vct001_subscript_getenv_membership_flagged():
+    src = '''
+        import os
+        a = os.environ["VCTPU_X"]
+        b = os.getenv("VCTPU_Y")
+        c = "VCTPU_Z" in os.environ
+        '''
+    assert codes(src) == ["VCT001", "VCT001", "VCT001"]
+
+
+def test_vct001_non_vctpu_and_registry_exempt():
+    # non-VCTPU env reads are fine anywhere
+    assert codes('''
+        import os
+        os.environ.get("JAX_PLATFORMS")
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/x")
+        ''') == []
+    # the knob registry itself is the sanctioned reader
+    assert codes('''
+        import os
+        raw = os.environ.get("VCTPU_ENGINE")
+        ''', path="variantcalling_tpu/knobs.py") == []
+
+
+# ---------------------------------------------------------------------------
+# VCT002 silent-fallback
+# ---------------------------------------------------------------------------
+
+
+def test_vct002_bare_except_pass_flagged():
+    # the acceptance-criteria seeded regression: bare except, swallowed
+    fs = run('''
+        try:
+            score()
+        except:
+            pass
+        ''')
+    assert [f.code for f in fs] == ["VCT002"]
+    assert "bare except" in fs[0].message
+
+
+def test_vct002_broad_exception_swallow_flagged():
+    assert codes('''
+        try:
+            build()
+        except Exception:
+            result = None
+        ''') == ["VCT002"]
+    # broad type hiding inside a tuple is still broad
+    assert codes('''
+        try:
+            build()
+        except (ValueError, Exception):
+            result = None
+        ''') == ["VCT002"]
+
+
+def test_vct002_compliant_forms_not_flagged():
+    # re-raise (incl. conditional), EngineError, and degrade.record are
+    # the three sanctioned outcomes
+    assert codes('''
+        try:
+            build()
+        except Exception as e:
+            if explicit:
+                raise EngineError("no") from e
+            log(e)
+            raise
+        ''') == []
+    assert codes('''
+        from variantcalling_tpu.utils import degrade
+        try:
+            probe()
+        except Exception as e:
+            degrade.record("test.probe", e, fallback="default")
+            value = None
+        ''') == []
+    # narrow excepts are outside VCT002's scope
+    assert codes('''
+        try:
+            open(p)
+        except OSError:
+            pass
+        ''') == []
+
+
+# ---------------------------------------------------------------------------
+# VCT003 unordered-reduction
+# ---------------------------------------------------------------------------
+
+
+def test_vct003_tree_axis_sum_flagged():
+    # the acceptance-criteria seeded regression: jnp.sum over tree margins
+    fs = run('''
+        import jax.numpy as jnp
+        def finalize(per_tree):
+            return jnp.sum(per_tree, axis=0)
+        ''')
+    assert [f.code for f in fs] == ["VCT003"]
+    assert "sequential_tree_sum" in fs[0].message
+
+
+def test_vct003_method_sum_and_margin_names_flagged():
+    assert codes('''
+        def total(tree_margins):
+            return tree_margins.sum(axis=0)
+        ''') == ["VCT003"]
+    assert codes('''
+        import jax.numpy as jnp
+        m = jnp.sum(margins)
+        ''') == ["VCT003"]
+
+
+def test_vct003_sequential_tree_sum_exempt_and_negatives():
+    # the one sanctioned reducer
+    assert codes('''
+        import jax.numpy as jnp
+        def sequential_tree_sum(per_tree):
+            import jax
+            return per_tree.sum(axis=0)
+        ''') == []
+    # sums over non-tree data are fine
+    assert codes('''
+        import jax.numpy as jnp
+        depth = jnp.sum(counts, axis=1)
+        n = (forest.feature != LEAF).sum(axis=1)
+        total = df["n_meth"].sum()
+        ''') == []
+
+
+# ---------------------------------------------------------------------------
+# VCT004 tracer host-sync
+# ---------------------------------------------------------------------------
+
+
+def test_vct004_item_float_asarray_in_jit_flagged():
+    src = '''
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def bad(x):
+            v = x.item()
+            f = float(x)
+            a = np.asarray(x)
+            return v + f
+        '''
+    assert codes(src) == ["VCT004", "VCT004", "VCT004"]
+
+
+def test_vct004_partial_jit_and_negatives():
+    assert codes('''
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def bad(x, n):
+            return x.tolist()
+        ''') == ["VCT004"]
+    # outside jit: host syncs are fine; inside jit: jnp/constants are fine
+    assert codes('''
+        import jax
+        import jax.numpy as jnp
+
+        def host(x):
+            return float(x)
+
+        @jax.jit
+        def good(x):
+            return jnp.asarray(x) * float(2)
+        ''') == []
+
+
+# ---------------------------------------------------------------------------
+# VCT005 unbounded-subprocess
+# ---------------------------------------------------------------------------
+
+
+def test_vct005_run_without_timeout_flagged():
+    assert codes('''
+        import subprocess
+        subprocess.run(["beagle"], capture_output=True)
+        ''') == ["VCT005"]
+    assert codes('''
+        import subprocess
+        subprocess.run(["x"], timeout=60)
+        ''') == []
+
+
+def test_vct005_popen_and_thread_rules():
+    # Popen with no bounded wait in the function
+    assert codes('''
+        import subprocess
+        def go():
+            p = subprocess.Popen(["x"])
+            return p.wait()
+        ''') == ["VCT005"]
+    # bounded communicate makes it compliant
+    assert codes('''
+        import subprocess
+        def go():
+            p = subprocess.Popen(["x"])
+            out, err = p.communicate(timeout=30)
+        ''') == []
+    # non-daemon thread in a module with no join path
+    assert codes('''
+        import threading
+        t = threading.Thread(target=work)
+        t.start()
+        ''') == ["VCT005"]
+    assert codes('''
+        import threading
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        ''') == []
+    assert codes('''
+        import threading
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        ''') == []
+
+
+# ---------------------------------------------------------------------------
+# suppression comments, syntax errors, select
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_comment_silences_one_code():
+    src = '''
+        import os
+        x = os.environ.get("VCTPU_X")  # vctpu-lint: disable=VCT001 — test fixture
+        y = os.environ.get("VCTPU_Y")
+        '''
+    fs = run(src)
+    assert [(f.code, "VCTPU_Y" in f.message) for f in fs] == [("VCT001", True)]
+
+
+def test_suppression_all_and_wrong_code():
+    assert run('''
+        try:
+            f()
+        except Exception:  # vctpu-lint: disable=all — fixture
+            pass
+        ''') == []
+    # a disable for a DIFFERENT code does not silence the finding
+    assert codes('''
+        try:
+            f()
+        except Exception:  # vctpu-lint: disable=VCT001
+            pass
+        ''') == ["VCT002"]
+
+
+def test_syntax_error_is_vct000():
+    fs = run("def broken(:\n    pass\n")
+    assert [f.code for f in fs] == ["VCT000"]
+
+
+def test_select_runs_only_requested_checkers():
+    src = '''
+        import os
+        x = os.environ.get("VCTPU_X")
+        try:
+            f()
+        except:
+            pass
+        '''
+    assert codes(src, select={"VCT002"}) == ["VCT002"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + CLI
+# ---------------------------------------------------------------------------
+
+_DIRTY = '''import os
+x = os.environ.get("VCTPU_X")
+try:
+    f()
+except:
+    pass
+'''
+
+
+def test_baseline_round_trip(tmp_path):
+    snippet = tmp_path / "dirty.py"
+    snippet.write_text(_DIRTY)
+    bl = tmp_path / "baseline.json"
+
+    # 1) dirty file with empty baseline -> exit 1, findings printed
+    assert lint_main([str(snippet), "--baseline", str(bl)]) == 1
+
+    # 2) write the baseline -> exit 0 afterwards (same findings grandfathered)
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    data = json.loads(bl.read_text())
+    assert {e["code"] for e in data["entries"]} == {"VCT001", "VCT002"}
+    assert all(e["justification"] == "TODO" for e in data["entries"])
+    assert lint_main([str(snippet), "--baseline", str(bl)]) == 0
+
+    # 3) a NEW finding is still caught
+    snippet.write_text(_DIRTY + 'y = os.environ.get("VCTPU_NEW")\n')
+    assert lint_main([str(snippet), "--baseline", str(bl)]) == 1
+
+    # 4) --write-baseline round-trips justifications by fingerprint
+    entries = json.loads(bl.read_text())["entries"]
+    for e in entries:
+        e["justification"] = f"why {e['code']}"
+    bl.write_text(json.dumps({"version": 1, "entries": entries}))
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    kept = {e["code"]: e["justification"]
+            for e in json.loads(bl.read_text())["entries"]}
+    assert kept["VCT002"] == "why VCT002"
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    snippet = tmp_path / "drift.py"
+    snippet.write_text(_DIRTY)
+    bl = tmp_path / "baseline.json"
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    # unrelated edit shifts every line; fingerprints (code, path, text) hold
+    snippet.write_text("# a new leading comment\n" + _DIRTY)
+    assert lint_main([str(snippet), "--baseline", str(bl)]) == 0
+
+
+def test_cli_unknown_select_is_usage_error(tmp_path):
+    assert lint_main(["--select", "VCT999", str(tmp_path)]) == 2
+
+
+def test_cli_list_checkers(capsys):
+    assert lint_main(["--list-checkers"]) == 0
+    out = capsys.readouterr().out
+    for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005"):
+        assert code in out
+
+
+# ---------------------------------------------------------------------------
+# the real tree stays clean (the acceptance gate, in-process)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("target", ["variantcalling_tpu", "tools"])
+def test_repo_tree_is_clean(target):
+    findings = lint.lint_paths([target])
+    new, _old, _stale = baseline_mod.partition(
+        findings, baseline_mod.load(baseline_mod.DEFAULT_BASELINE))
+    assert not new, "new lint findings:\n" + "\n".join(
+        f.render() for f in new)

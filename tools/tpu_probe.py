@@ -35,7 +35,11 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_PROBE_LOG.md")
 BENCH_OUT = os.path.join(REPO, "BENCH_TPU.json")
-INTERVAL_S = int(os.environ.get("VCTPU_PROBE_INTERVAL", "1800"))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+from variantcalling_tpu import knobs  # noqa: E402 — needs REPO on sys.path
+
+INTERVAL_S = 1800  # overridden from VCTPU_PROBE_INTERVAL in main()
 PROBE_TIMEOUT_S = 130
 BENCH_TIMEOUT_S = 900
 
@@ -136,21 +140,27 @@ def run_bench_and_commit(probe_detail: str) -> bool:
 
 
 def _commit(msg: str) -> None:
-    """Best-effort commit; retries around a busy index, never blocks the loop."""
+    """Best-effort commit; retries around a busy index, never blocks the
+    loop — a hung git (stale lock, slow NFS) counts as one failed try,
+    not a session-killing exception."""
     for _ in range(8):
-        add = subprocess.run(["git", "add", "TPU_PROBE_LOG.md", "BENCH_TPU.json"],
-                             cwd=REPO, capture_output=True)
-        if add.returncode == 0:
-            com = subprocess.run(["git", "commit", "-m", msg, "--no-verify"],
-                                 cwd=REPO, capture_output=True)
-            if com.returncode == 0 or b"nothing to commit" in com.stdout:
-                return
+        try:
+            add = subprocess.run(["git", "add", "TPU_PROBE_LOG.md", "BENCH_TPU.json"],
+                                 cwd=REPO, capture_output=True, timeout=60)
+            if add.returncode == 0:
+                com = subprocess.run(["git", "commit", "-m", msg, "--no-verify"],
+                                     cwd=REPO, capture_output=True, timeout=60)
+                if com.returncode == 0 or b"nothing to commit" in com.stdout:
+                    return
+        except (OSError, subprocess.SubprocessError):
+            pass  # hung/absent git is one failed try; retried below
         time.sleep(20)
 
 
 def main() -> None:
     global INTERVAL_S  # noqa: PLW0603 — slowed down once a capture lands
-    deadline = time.time() + float(os.environ.get("VCTPU_PROBE_HOURS", "11.5")) * 3600
+    INTERVAL_S = knobs.get_int("VCTPU_PROBE_INTERVAL")
+    deadline = time.time() + knobs.get_float("VCTPU_PROBE_HOURS") * 3600
     _log(f"\n## Probe session started {_now()} "
          f"(interval {INTERVAL_S}s, pid {os.getpid()})\n")
     n = 0

@@ -1,0 +1,170 @@
+"""vctpu-lint — AST invariant checkers for the engine-determinism contract.
+
+PR 2 and PR 3 each root-caused a whole bug class by hand (silent engine
+degradation through bare ``except`` fallbacks; byte-parity drift from XLA
+reassociating unordered tree-sum reductions) and codified the fix as a
+convention. Conventions rot; this package makes them machine-checked.
+Stdlib ``ast`` only — no new dependencies.
+
+Architecture (docs/static_analysis.md has the checker catalog and the
+historical incident each code encodes):
+
+- :class:`Checker` subclasses register themselves via :func:`register`;
+  each owns one ``VCTxxx`` code and emits :class:`Finding`\\ s.
+- Suppression is per line: a trailing ``# vctpu-lint: disable=VCT002``
+  comment (comma-separated codes, or ``all``) silences findings anchored
+  to that physical line. Every suppression should say why.
+- The committed baseline (:mod:`tools.vctpu_lint.baseline`) grandfathers
+  justified findings by (code, path, normalized source line) — line
+  numbers may drift, the fingerprint survives. New findings fail the run.
+
+CLI: ``python -m tools.vctpu_lint [paths]`` — exit 0 clean, 1 on new
+findings, 2 on usage/internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+#: matches a per-line suppression comment; group 1 is the code list
+_SUPPRESS_RE = re.compile(r"#\s*vctpu-lint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+—|\s+--|$)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit, anchored to a source line."""
+
+    code: str
+    path: str  # repo-relative, posix separators
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    line_text: str  # stripped source text of ``line`` (baseline fingerprint)
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.code, self.path, self.line_text)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.code} {self.message}"
+
+
+class Checker(ast.NodeVisitor):
+    """Base class: one invariant, one code.
+
+    Subclasses set ``code``/``name``/``description`` and implement
+    ``visit_*`` methods, calling :meth:`report` on violations. The file's
+    source lines and path are available as ``self.lines`` / ``self.path``.
+    """
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+
+    def __init__(self, path: str, lines: list[str]):
+        self.path = path
+        self.lines = lines
+        self.findings: list[Finding] = []
+
+    def report(self, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        text = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            code=self.code, path=self.path, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            line_text=text))
+
+    # subclasses may override to skip whole files (e.g. the knob registry
+    # is the one sanctioned environ reader)
+    def applies_to(self, path: str) -> bool:
+        return True
+
+
+CHECKERS: list[type[Checker]] = []
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    if any(c.code == cls.code for c in CHECKERS):
+        raise ValueError(f"duplicate checker code {cls.code}")
+    CHECKERS.append(cls)
+    return cls
+
+
+def _suppressed_codes(line_text: str) -> set[str]:
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return set()
+    return {c.strip().upper() for c in m.group(1).split(",") if c.strip()}
+
+
+def lint_source(path: str, source: str,
+                select: set[str] | None = None) -> list[Finding]:
+    """Run every registered checker over one file's source text.
+
+    ``path`` is used for reporting and per-checker file exemptions; it
+    does not need to exist on disk (tests lint snippets directly).
+    Returns findings sorted by (line, col, code), with per-line
+    suppression comments already applied. A syntax error becomes a
+    single ``VCT000`` finding — a file the linter cannot parse must not
+    pass silently.
+    """
+    norm = path.replace(os.sep, "/")
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        line = e.lineno or 1
+        text = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+        return [Finding("VCT000", norm, line, (e.offset or 1) - 1,
+                        f"syntax error: {e.msg}", text)]
+    findings: list[Finding] = []
+    for cls in CHECKERS:
+        if select is not None and cls.code not in select:
+            continue
+        checker = cls(norm, lines)
+        if not checker.applies_to(norm):
+            continue
+        checker.visit(tree)
+        findings.extend(checker.findings)
+    kept = []
+    for f in findings:
+        codes = _suppressed_codes(f.line_text)
+        if "ALL" in codes or f.code in codes:
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.line, f.col, f.code))
+    return kept
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def lint_paths(paths: list[str],
+               select: set[str] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        findings.extend(lint_source(os.path.relpath(path), source, select))
+    return findings
+
+
+# registration side effect: import the checker suite
+from tools.vctpu_lint import checkers as _checkers  # noqa: E402,F401
+
+__all__ = ["Finding", "Checker", "CHECKERS", "register", "lint_source",
+           "lint_paths", "iter_python_files"]

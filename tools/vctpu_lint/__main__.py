@@ -1,0 +1,89 @@
+"""CLI: ``python -m tools.vctpu_lint [paths] [options]``.
+
+Exit codes: 0 clean (all findings baselined), 1 new findings, 2
+usage/internal error. ``run_tests.sh`` runs this as the tier-0 lint
+stage before pytest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.vctpu_lint import CHECKERS, lint_paths
+from tools.vctpu_lint import baseline as baseline_mod
+
+DEFAULT_PATHS = ["variantcalling_tpu", "tools"]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.vctpu_lint",
+        description="AST invariant checkers for the engine-determinism "
+                    "contract (docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help=f"files/directories to lint (default: "
+                             f"{' '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                        help="baseline file (default: the committed "
+                             "tools/vctpu_lint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, baselined or not")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current findings "
+                             "(new entries get justification TODO)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated codes to run (e.g. "
+                             "VCT001,VCT003)")
+    parser.add_argument("--list-checkers", action="store_true",
+                        help="print the checker catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_checkers:
+        for cls in sorted(CHECKERS, key=lambda c: c.code):
+            print(f"{cls.code} {cls.name}: {cls.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+        known = {cls.code for cls in CHECKERS} | {"VCT000"}
+        bad = select - known
+        if bad:
+            print(f"unknown checker code(s): {', '.join(sorted(bad))}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        findings = lint_paths(paths, select)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        baseline_mod.write(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    allowed = baseline_mod.load(args.baseline) if not args.no_baseline \
+        else baseline_mod.load("/nonexistent")
+    new, old, stale = baseline_mod.partition(findings, allowed)
+    for f in new:
+        print(f.render())
+    if old:
+        print(f"({len(old)} baselined finding(s) suppressed — "
+              f"see {args.baseline})")
+    for (code, path, text), n in sorted(stale.items()):
+        print(f"stale baseline entry ({n}x): {code} {path}: {text!r} — "
+              "delete it", file=sys.stderr)
+    if new:
+        print(f"{len(new)} new finding(s). Fix them, add a per-line "
+              "'# vctpu-lint: disable=<code> — reason' suppression, or "
+              "(with justification) extend the baseline.", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Committed suppression baseline for vctpu-lint.
+
+The baseline grandfathers *justified* existing findings so the linter
+can gate on NEW findings from day one. Entries are fingerprinted by
+(code, path, normalized source-line text) — stable across unrelated
+edits that shift line numbers — with a ``count`` (identical lines can
+legitimately repeat) and a mandatory human ``justification``. Policy
+(docs/static_analysis.md): shrinking the baseline is always welcome;
+growing it needs the same justification a suppression comment would.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+
+from tools.vctpu_lint import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def load(path: str) -> Counter:
+    """fingerprint -> allowed count. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    allowed: Counter = Counter()
+    for entry in data.get("entries", []):
+        fp = (entry["code"], entry["path"], entry["line_text"])
+        allowed[fp] += int(entry.get("count", 1))
+    return allowed
+
+
+def write(path: str, findings: list[Finding],
+          justifications: dict[tuple, str] | None = None) -> None:
+    """Regenerate the baseline from the given findings, carrying over
+    justifications for fingerprints that survive (new entries get TODO —
+    replace it before committing)."""
+    old: dict[tuple, str] = {}
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as fh:
+            for entry in json.load(fh).get("entries", []):
+                fp = (entry["code"], entry["path"], entry["line_text"])
+                old[fp] = entry.get("justification", "TODO")
+    if justifications:
+        old.update(justifications)
+    counts = Counter(f.fingerprint() for f in findings)
+    entries = [
+        {"code": code, "path": fpath, "line_text": text, "count": n,
+         "justification": old.get((code, fpath, text), "TODO")}
+        for (code, fpath, text), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def partition(findings: list[Finding],
+              allowed: Counter) -> tuple[list[Finding], list[Finding], Counter]:
+    """Split findings into (new, baselined); also return the unused
+    baseline budget (stale entries worth deleting)."""
+    budget = Counter(allowed)
+    new: list[Finding] = []
+    old: list[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    stale = Counter({fp: n for fp, n in budget.items() if n > 0})
+    return new, old, stale

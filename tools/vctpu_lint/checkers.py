@@ -1,0 +1,357 @@
+"""The vctpu-lint checker suite: five codes, five hard-won invariants.
+
+Each checker's docstring names the historical incident it encodes; the
+full catalog (with suppression policy and how to add a checker) is
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.vctpu_lint import Checker, register
+
+#: the one module allowed to read VCTPU_* environment variables
+KNOB_REGISTRY_PATH = "variantcalling_tpu/knobs.py"
+
+#: the one function allowed to reduce over the tree/margin axis
+SEQUENTIAL_TREE_SUM = "sequential_tree_sum"
+
+#: identifier tokens that mark an array as per-tree/margin data (VCT003)
+_TREE_TOKENS = {"tree", "trees", "margin", "margins", "pertree"}
+
+#: sanctioned degradation-recorder calls (VCT002): module.attr spellings
+_DEGRADE_CALLS = {("degrade", "record")}
+
+
+def _is_environ(node: ast.expr) -> bool:
+    """True for ``os.environ`` / bare ``environ`` (any import spelling)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "environ"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _const_str(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@register
+class RawEnvironChecker(Checker):
+    """VCT001 — a ``VCTPU_*`` environment read outside the typed knob
+    registry.
+
+    Incident: before PR 4 the tree had ~39 ad-hoc ``os.environ`` reads in
+    14 modules, each with its own parse, default and failure mode — a
+    malformed value crashed mid-run on one engine and was silently
+    ignored on another, and a typo'd name configured nothing at all.
+    ``variantcalling_tpu/knobs.py`` is now the single parse point
+    (declared type/default/validator, malformed values exit 2 on every
+    engine, unknown names warn at startup); everything else must go
+    through it.
+    """
+
+    code = "VCT001"
+    name = "raw-environ"
+    description = "VCTPU_* environment read outside variantcalling_tpu/knobs.py"
+
+    def applies_to(self, path: str) -> bool:
+        return not path.endswith(KNOB_REGISTRY_PATH)
+
+    def _flag_if_knob(self, node: ast.AST, key: ast.expr | None) -> None:
+        name = _const_str(key) if key is not None else None
+        if name is not None and name.startswith("VCTPU_"):
+            self.report(node, f"raw environment read of {name} — declare it "
+                              "in variantcalling_tpu/knobs.py and use "
+                              "knobs.get/get_bool/get_int/get_float/get_str")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in ("get", "pop", "setdefault") \
+                and _is_environ(func.value) and node.args:
+            self._flag_if_knob(node, node.args[0])
+        elif (isinstance(func, ast.Name) and func.id == "getenv") \
+                or (isinstance(func, ast.Attribute) and func.attr == "getenv"):
+            if node.args:
+                self._flag_if_knob(node, node.args[0])
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if _is_environ(node.value):
+            self._flag_if_knob(node, node.slice)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # "VCTPU_X" in os.environ / not in os.environ
+        if len(node.ops) == 1 and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and _is_environ(node.comparators[0]):
+            self._flag_if_knob(node, node.left)
+        self.generic_visit(node)
+
+
+@register
+class SilentFallbackChecker(Checker):
+    """VCT002 — a broad ``except`` that swallows and continues.
+
+    Incident: the round-5 byte-parity flake traced to
+    ``_native_cpu_featurize_score`` returning None on ANY exception (a
+    bare except around the native build), silently flipping the scoring
+    engine per call under suite load. PR 2's contract: degradation is
+    either loud (re-raise / EngineError, exit 2) or recorded
+    (``utils.degrade.record`` — visible in the log and the in-process
+    event trail). A broad handler that does neither is this finding.
+    """
+
+    code = "VCT002"
+    name = "silent-fallback"
+    description = ("except:/except Exception: swallows without re-raising, "
+                   "raising EngineError, or calling degrade.record")
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        def broad_name(n: ast.expr) -> bool:
+            return isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+
+        if handler.type is None:
+            return True
+        if broad_name(handler.type):
+            return True
+        return isinstance(handler.type, ast.Tuple) \
+            and any(broad_name(e) for e in handler.type.elts)
+
+    @staticmethod
+    def _is_compliant(handler: ast.ExceptHandler) -> bool:
+        for stmt in handler.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return True
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                    owner = node.func.value
+                    owner_name = owner.id if isinstance(owner, ast.Name) else \
+                        owner.attr if isinstance(owner, ast.Attribute) else ""
+                    if (owner_name, node.func.attr) in _DEGRADE_CALLS:
+                        return True
+        return False
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self._is_broad(node) and not self._is_compliant(node):
+            what = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            self.report(node, f"{what} swallows and continues — re-raise, "
+                              "raise EngineError, or route through "
+                              "utils.degrade.record(...)")
+        self.generic_visit(node)
+
+
+@register
+class UnorderedReductionChecker(Checker):
+    """VCT003 — an unordered reduction over a tree/margin axis.
+
+    Incident: the round-5 multihost parity flake's root cause — XLA
+    reassociates f32 ``jnp.sum`` reductions, so the tree-margin sum
+    drifted by 1 ulp across device counts and engines. PR 2 pinned ALL
+    margin reductions to canonical sequential tree order through the one
+    shared ``forest.sequential_tree_sum``; any other ``jnp.sum``/
+    ``.sum()`` over an array named like per-tree/margin data can
+    reintroduce the drift.
+    """
+
+    code = "VCT003"
+    name = "unordered-reduction"
+    description = ("jnp.sum/.sum over a tree/margin-named axis outside "
+                   "forest.sequential_tree_sum")
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__(path, lines)
+        self._func_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _tree_named(expr: ast.expr) -> str | None:
+        """The first identifier in ``expr`` whose _-tokens hit the
+        tree/margin vocabulary, or None."""
+        for node in ast.walk(expr):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.arg):
+                name = node.arg
+            if name and _TREE_TOKENS & set(name.lower().split("_")):
+                return name
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if SEQUENTIAL_TREE_SUM in self._func_stack:
+            self.generic_visit(node)
+            return
+        func = node.func
+        operand: ast.expr | None = None
+        if isinstance(func, ast.Attribute) and func.attr == "sum":
+            owner = func.value
+            if isinstance(owner, ast.Name) and owner.id in ("jnp", "np", "numpy", "jax"):
+                operand = node.args[0] if node.args else None
+            else:
+                operand = owner  # method form: per_tree.sum(axis=...)
+        if operand is not None:
+            hit = self._tree_named(operand)
+            if hit is not None:
+                self.report(node, f"unordered sum over {hit!r} — per-tree/"
+                                  "margin reductions must go through "
+                                  "forest.sequential_tree_sum (XLA "
+                                  "reassociation drifts f32 bits)")
+        self.generic_visit(node)
+
+
+@register
+class TracerHostSyncChecker(Checker):
+    """VCT004 — host synchronization inside a jitted function.
+
+    Incident class: ``.item()`` / ``float()`` / ``np.asarray`` on a
+    tracer either fails at trace time (ConcretizationTypeError, often
+    only on the accelerator path that actually jits) or — worse, via
+    ``io_callback``-style escapes — forces a device sync per call in the
+    hot loop. The engine contract keeps device programs pure: fetch once
+    at the boundary, finalize on the host (``forest.finalize_margin``).
+    """
+
+    code = "VCT004"
+    name = "tracer-host-sync"
+    description = (".item()/float()/np.asarray on values inside "
+                   "@jax.jit/pjit-decorated functions")
+
+    _SYNC_METHODS = ("item", "tolist", "block_until_ready")
+    _SYNC_BUILTINS = ("float", "int", "bool", "complex")
+
+    @staticmethod
+    def _is_jit_expr(expr: ast.expr) -> bool:
+        """jit / jax.jit / pjit / partial(jax.jit, ...) / jax.jit(...)"""
+        if isinstance(expr, ast.Name):
+            return expr.id in ("jit", "pjit")
+        if isinstance(expr, ast.Attribute):
+            return expr.attr in ("jit", "pjit")
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if isinstance(func, (ast.Name, ast.Attribute)):
+                fname = func.id if isinstance(func, ast.Name) else func.attr
+                if fname == "partial":
+                    return bool(expr.args) and \
+                        TracerHostSyncChecker._is_jit_expr(expr.args[0])
+                return fname in ("jit", "pjit")
+        return False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if any(self._is_jit_expr(d) for d in node.decorator_list):
+            self._scan_jit_body(node)
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _scan_jit_body(self, func: ast.FunctionDef) -> None:
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in self._SYNC_METHODS:
+                    self.report(node, f".{f.attr}() inside @jit-decorated "
+                                      f"'{func.name}' forces a host sync / "
+                                      "fails on tracers — fetch outside the "
+                                      "jitted program")
+                elif f.attr in ("asarray", "array") and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("np", "numpy"):
+                    self.report(node, f"np.{f.attr}() inside @jit-decorated "
+                                      f"'{func.name}' materializes on host — "
+                                      "use jnp inside traced code")
+                elif f.attr == "device_get":
+                    self.report(node, f"device_get inside @jit-decorated "
+                                      f"'{func.name}'")
+            elif isinstance(f, ast.Name) and f.id in self._SYNC_BUILTINS \
+                    and node.args and not isinstance(node.args[0], ast.Constant):
+                self.report(node, f"{f.id}() on a traced value inside "
+                                  f"@jit-decorated '{func.name}' raises "
+                                  "ConcretizationTypeError at trace time")
+
+
+@register
+class UnboundedSubprocessChecker(Checker):
+    """VCT005 — an external process or worker thread with no bounded wait.
+
+    Incident class: the streaming executor's watchdog exists because a
+    wedged stage (native build under load, a stuck beagle, a TPU claim
+    leg dialing a dead relay — TPU_PROBE_LOG.md) turns a pipeline into a
+    zombie. Every ``subprocess`` call carries ``timeout=``; every
+    ``Popen`` has a ``communicate(timeout=)``/``wait(timeout=)`` in its
+    function; every pipeline thread is a daemon or has a join path.
+    """
+
+    code = "VCT005"
+    name = "unbounded-subprocess"
+    description = ("subprocess call without timeout=, or thread with no "
+                   "join path")
+
+    _WAIT_FNS = ("run", "call", "check_output", "check_call")
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__(path, lines)
+        self._func_stack: list[ast.AST] = []
+
+    def visit_Module(self, node: ast.Module) -> None:
+        self._module = node
+        self._module_has_join = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "join" for n in ast.walk(node))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _enclosing_has_bounded_wait(self) -> bool:
+        scope = self._func_stack[-1] if self._func_stack else self._module
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("communicate", "wait") \
+                    and any(kw.arg == "timeout" for kw in n.keywords):
+                return True
+        return False
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "subprocess":
+            if func.attr in self._WAIT_FNS:
+                if not any(kw.arg == "timeout" for kw in node.keywords):
+                    self.report(node, f"subprocess.{func.attr} without "
+                                      "timeout= can hang the pipeline "
+                                      "forever — bound it (see "
+                                      "VCTPU_SUBPROC_TIMEOUT_S)")
+            elif func.attr == "Popen" and not self._enclosing_has_bounded_wait():
+                self.report(node, "subprocess.Popen with no "
+                                  "communicate(timeout=)/wait(timeout=) in "
+                                  "this function")
+        elif isinstance(func, ast.Attribute) and func.attr == "Thread" \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "threading":
+            daemon = any(kw.arg == "daemon" and
+                         isinstance(kw.value, ast.Constant) and
+                         kw.value.value is True for kw in node.keywords)
+            if not daemon and not self._module_has_join:
+                self.report(node, "non-daemon threading.Thread in a module "
+                                  "with no .join() — a crashed parent leaks "
+                                  "the worker")
+        self.generic_visit(node)

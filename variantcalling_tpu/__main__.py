@@ -12,6 +12,7 @@ import sys
 
 # tool name -> module path (module must expose run(argv))
 TOOLS: dict[str, str] = {
+    "knobs": "variantcalling_tpu.knobs",
     "filter_variants_pipeline": "variantcalling_tpu.pipelines.filter_variants",
     "train_models_pipeline": "variantcalling_tpu.pipelines.train_models",
     "training_prep_pipeline": "variantcalling_tpu.pipelines.training_prep",
@@ -91,27 +92,44 @@ def main(argv: list[str] | None = None) -> int:
     if tool not in TOOLS:
         print(f"unknown tool: {tool!r}; run with --help for the tool list", file=sys.stderr)
         return 2
+    # configuration errors (EngineError — e.g. a malformed VCTPU_* knob
+    # parsed during tool import or startup) exit 2 with the message, not
+    # a traceback: the knob-registry contract at the dispatch level
+    from variantcalling_tpu.engine import EngineError
+
     try:
         module = importlib.import_module(TOOLS[tool])
     except ModuleNotFoundError as e:
         print(f"tool {tool!r} is not available yet: {e}", file=sys.stderr)
         return 3
-    # multi-host launch: VCTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID in the
-    # env turn any tool into one rank of a global mesh (parallel/distributed).
-    # Gated on the env so plain runs keep the lazy-import fast path.
-    import os
+    except EngineError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    # unknown VCTPU_* variables are almost always typos of real knobs —
+    # warn (with a closest-match suggestion) before the tool runs, so
+    # VCTPU_FOERST_STRATEGY=wide can no longer be silently ignored
+    from variantcalling_tpu import knobs
 
-    if os.environ.get("VCTPU_COORDINATOR") or os.environ.get("VCTPU_AUTO_DISTRIBUTED"):
-        from variantcalling_tpu.parallel.distributed import init_from_env
+    knobs.warn_unknown_env()
+    try:
+        # multi-host launch: VCTPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID in
+        # the env turn any tool into one rank of a global mesh
+        # (parallel/distributed). Gated on the env so plain runs keep the
+        # lazy-import fast path.
+        if knobs.get_str("VCTPU_COORDINATOR") or knobs.get_bool("VCTPU_AUTO_DISTRIBUTED"):
+            from variantcalling_tpu.parallel.distributed import init_from_env
 
-        init_from_env()
-    # per-file CLI invocations must not re-pay jit compiles: persist XLA
-    # executables across processes (~/.cache/vctpu/xla, VCTPU_COMPILE_CACHE
-    # overrides, empty disables)
-    from variantcalling_tpu.utils.compile_cache import enable_persistent_cache
+            init_from_env()
+        # per-file CLI invocations must not re-pay jit compiles: persist XLA
+        # executables across processes (~/.cache/vctpu/xla, VCTPU_COMPILE_CACHE
+        # overrides, empty disables)
+        from variantcalling_tpu.utils.compile_cache import enable_persistent_cache
 
-    enable_persistent_cache()
-    result = module.run(argv[1:])
+        enable_persistent_cache()
+        result = module.run(argv[1:])
+    except EngineError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     # tools may return rich results (e.g. vcfeval_flavors' rows); only
     # int-like returns are exit codes
     return result if isinstance(result, int) else 0

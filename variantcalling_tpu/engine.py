@@ -40,17 +40,15 @@ module; ``VCTPU_ENGINE=jit`` is the documented spelling).
 
 from __future__ import annotations
 
-import os
 import threading
 from dataclasses import dataclass, replace
 
-from variantcalling_tpu import logger
+from variantcalling_tpu import knobs, logger
+from variantcalling_tpu.utils import degrade
 
 ENGINE_ENV = "VCTPU_ENGINE"
 REQUIRE_ENV = "VCTPU_REQUIRE_NATIVE"
 HEADER_KEY = "vctpu_engine"
-
-_CHOICES = ("auto", "native", "jit")
 
 
 class EngineError(RuntimeError):
@@ -75,14 +73,8 @@ _RESOLVED: EngineDecision | None = None
 
 
 def _requested() -> str:
-    req = os.environ.get(ENGINE_ENV, "auto").strip().lower() or "auto"
-    if req not in _CHOICES:
-        raise EngineError(
-            f"{ENGINE_ENV}={req!r} is not a valid engine; choose one of "
-            f"{'/'.join(_CHOICES)}")
-    require = os.environ.get(REQUIRE_ENV, "").strip().lower() \
-        not in ("", "0", "false", "no", "off")
-    if require:
+    req = knobs.get_str(ENGINE_ENV)
+    if knobs.get_bool(REQUIRE_ENV):
         if req == "jit":
             raise EngineError(
                 f"{REQUIRE_ENV}=1 conflicts with {ENGINE_ENV}=jit — drop one")
@@ -100,13 +92,14 @@ def _auto_wants_native() -> bool:
     """The auto policy (unchanged from the pre-contract
     ``use_native_cpu_forest``): single local CPU device — the sharded mesh
     path and accelerators stay on XLA."""
-    if os.environ.get("VCTPU_NATIVE_FOREST", "1") == "0":
+    if not knobs.get_bool("VCTPU_NATIVE_FOREST"):
         return False
     try:
         import jax
 
         return jax.default_backend() == "cpu" and len(jax.local_devices()) == 1
-    except Exception:  # noqa: BLE001 — backend probe failure: stay on jit
+    except Exception as e:  # noqa: BLE001 — backend probe failure: stay on jit
+        degrade.record("engine.backend_probe", e, fallback="auto resolves to jit")
         return False
 
 
@@ -169,7 +162,8 @@ def resolve_for_run() -> EngineDecision:
         import jax
 
         n_proc = jax.process_count()
-    except Exception:  # noqa: BLE001 — uninitialized backend == single process
+    except Exception as e:  # noqa: BLE001 — uninitialized backend == single process
+        degrade.record("engine.process_count_probe", e, fallback="n_proc=1")
         n_proc = 1
     if n_proc <= 1:
         if local_error is not None:

@@ -16,13 +16,14 @@ keyed on (path, mtime, size) so repeat runs skip the encode entirely
 
 from __future__ import annotations
 
-import io as _io
 import json
 import os
 import threading
 from dataclasses import dataclass
 
 import numpy as np
+
+from variantcalling_tpu import knobs, logger
 
 
 @dataclass
@@ -90,8 +91,8 @@ def build_fai(path: str) -> dict[str, _FaiEntry]:
             for n in order:
                 e = entries[n]
                 out.write(f"{n}\t{e.length}\t{e.offset}\t{e.line_bases}\t{e.line_width}\n")
-    except OSError:
-        pass
+    except OSError as e:
+        logger.debug("not caching .fai beside %s: %s", path, e)
     return entries
 
 
@@ -126,10 +127,14 @@ class FastaReader:
         self._venc_offsets: dict[str, tuple[int, int]] = {}
         self._load_persistent_cache()
 
-    #: byte budget for the encoded-contig cache (default 4 GB covers a
-    #: whole human genome; VCTPU_FASTA_CACHE_BYTES tunes it down for
-    #: memory-constrained workers — 0 disables caching entirely)
-    _ENC_CACHE_BYTES = int(os.environ.get("VCTPU_FASTA_CACHE_BYTES", 4 << 30))
+    @property
+    def _ENC_CACHE_BYTES(self) -> int:
+        """Byte budget for the encoded-contig cache (default 4 GB covers
+        a whole human genome; VCTPU_FASTA_CACHE_BYTES tunes it down for
+        memory-constrained workers — 0 disables caching entirely).
+        Resolved lazily so a malformed value surfaces as a validated
+        configuration error, never an import-time traceback."""
+        return knobs.get_int("VCTPU_FASTA_CACHE_BYTES")
 
     # -- persistent encoded-genome cache ----------------------------------
 
@@ -139,7 +144,7 @@ class FastaReader:
                 "mtime_ns": st.st_mtime_ns, "size": st.st_size}
 
     def _venc_path(self) -> str:
-        d = os.environ.get("VCTPU_GENOME_CACHE_DIR", "")
+        d = knobs.get_str("VCTPU_GENOME_CACHE_DIR")
         if d:
             import hashlib
 
@@ -155,7 +160,7 @@ class FastaReader:
         hit costs no decode and no up-front RSS — repeat pipeline runs
         skip the encode entirely.
         """
-        if os.environ.get("VCTPU_GENOME_CACHE", "1") == "0":
+        if not knobs.get_bool("VCTPU_GENOME_CACHE"):
             return
         p = self._venc_path()
         try:
@@ -182,7 +187,8 @@ class FastaReader:
             if ok and len(offsets) == len(self._index):
                 self._venc = mm
                 self._venc_offsets = offsets
-        except (OSError, ValueError, json.JSONDecodeError):
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            logger.warning("ignoring unreadable genome cache %s: %s", p, e)
             return
 
     def _persist_encoded(self) -> bool:
@@ -192,7 +198,7 @@ class FastaReader:
         is silently skipped — the cache is an accelerator, not a
         dependency.
         """
-        if os.environ.get("VCTPU_GENOME_CACHE", "1") == "0" or self._venc is not None:
+        if not knobs.get_bool("VCTPU_GENOME_CACHE") or self._venc is not None:
             return False
         with self._enc_lock:
             have_all = all(c in self._encoded for c in self._index)
@@ -215,7 +221,8 @@ class FastaReader:
                     fh.write(memoryview(np.ascontiguousarray(arrays[name])))
             os.replace(tmp, p)
             return True
-        except OSError:
+        except OSError as e:
+            logger.warning("could not persist genome cache %s: %s", p, e)
             try:
                 if os.path.exists(tmp):
                     os.remove(tmp)

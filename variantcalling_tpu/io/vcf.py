@@ -23,6 +23,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from variantcalling_tpu import knobs
+
 MISSING = "."
 
 
@@ -765,7 +767,7 @@ def read_vcf(
 #: pipeline load-balances (the 5M sweep: 16 MB ≈ 0.88M v/s vs 32 MB ≈
 #: 0.73M v/s on a 2-core host — coarser chunks idle the overlap at the
 #: head and tail of the run)
-STREAM_CHUNK_BYTES = int(os.environ.get("VCTPU_STREAM_CHUNK_BYTES", 16 << 20))
+STREAM_CHUNK_BYTES = 16 << 20
 
 
 class VcfChunkReader:
@@ -794,7 +796,13 @@ class VcfChunkReader:
         if not native.available():
             raise RuntimeError("VcfChunkReader requires the native engine")
         self.path = str(path)
-        self.chunk_bytes = int(chunk_bytes) or STREAM_CHUNK_BYTES
+        # arg beats the env knob beats the (test-patchable) module
+        # default; resolved here, not at import, so a malformed value is
+        # caught by run()'s up-front knobs.validate_all() instead of an
+        # import-time traceback
+        env_chunk = knobs.get_int("VCTPU_STREAM_CHUNK_BYTES") \
+            if knobs.raw("VCTPU_STREAM_CHUNK_BYTES") is not None else None
+        self.chunk_bytes = int(chunk_bytes) or env_chunk or STREAM_CHUNK_BYTES
         #: chunks to advance WITHOUT parsing (journal resume: their output
         #: bytes are already committed). Boundaries are computed exactly as
         #: for parsed chunks, so the continuation is byte-faithful.

@@ -1,0 +1,371 @@
+"""Typed registry of every ``VCTPU_*`` environment knob.
+
+PR 2 (engine contract) and PR 3 (forest strategies) each ended with the
+same lesson: an env knob that is parsed ad hoc at its point of use is a
+determinism hole — a malformed value surfaces as a mid-run traceback on
+one engine and a silent fallback on another, and a typo
+(``VCTPU_FOERST_STRATEGY=wide``) configures nothing at all without a
+word of warning. This module is the fix, mechanically enforced by the
+``vctpu-lint`` VCT001 checker (docs/static_analysis.md): **every**
+``VCTPU_*`` read in the tree goes through this registry, and this module
+is the only file allowed to touch ``os.environ`` for a ``VCTPU_`` key.
+
+Contract (the PR 3 ``validate_strategy_env`` rule, extended to every
+knob):
+
+- each knob declares its name, type, default, bounds/choices and help in
+  :data:`REGISTRY`;
+- parsing happens in ONE place (:func:`get`); a malformed value raises
+  :class:`~variantcalling_tpu.engine.EngineError` — CLI exit code 2 on
+  every engine and every forest strategy, never a mid-run ``ValueError``
+  from inside a jit trace (``filter_variants.run`` calls
+  :func:`validate_all` up front);
+- unknown ``VCTPU_*`` variables are reported at CLI startup with a
+  closest-match suggestion (:func:`warn_unknown_env`);
+- ``vctpu knobs`` dumps the resolved value and source of every knob, and
+  the filter pipeline records the explicitly-set scoring knobs in the
+  output VCF header next to ``##vctpu_engine=`` (:func:`header_line`).
+
+Booleans accept ``1/true/yes/on`` and ``0/false/no/off`` (case
+insensitive); a set-but-empty variable means "unset" except for ``str``
+knobs, where the empty string is meaningful (``VCTPU_COMPILE_CACHE=""``
+disables the cache).
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+from dataclasses import dataclass
+from typing import Any
+
+from variantcalling_tpu import logger
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+def _config_error(msg: str) -> Exception:
+    # EngineError is the one exception class the CLIs map to exit code 2;
+    # imported lazily because engine.py imports this module at its top.
+    from variantcalling_tpu.engine import EngineError
+
+    return EngineError(msg)
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared ``VCTPU_*`` environment knob."""
+
+    name: str  # full env name, e.g. "VCTPU_THREADS"
+    kind: str  # "bool" | "int" | "float" | "str" | "enum"
+    default: Any  # typed default when unset (None = no value)
+    help: str
+    choices: tuple[str, ...] | None = None  # enum values
+    label: str | None = None  # enum error noun ("engine", "forest strategy")
+    positive: bool = False  # int must be > 0
+    minimum: float | None = None  # inclusive numeric lower bound
+    in_header: bool = False  # recorded in ##vctpu_knobs= when env-set
+
+
+def _k(*args, **kwargs) -> Knob:
+    return Knob(*args, **kwargs)
+
+
+#: Every knob the framework reads. Keep alphabetical within each group.
+REGISTRY: dict[str, Knob] = {k.name: k for k in (
+    # -- engine / scoring configuration (recorded via their own header
+    #    lines: ##vctpu_engine= / ##vctpu_forest_strategy=) --------------
+    _k("VCTPU_ENGINE", "enum", "auto",
+       "scoring engine contract: auto|native|jit (docs/robustness.md)",
+       choices=("auto", "native", "jit"), label="engine"),
+    _k("VCTPU_REQUIRE_NATIVE", "bool", False,
+       "fail loudly (exit 2) when the native scoring engine cannot load"),
+    _k("VCTPU_NATIVE_FOREST", "bool", True,
+       "legacy spelling of VCTPU_ENGINE=jit when 0 (predates engine.py)"),
+    _k("VCTPU_NO_NATIVE", "bool", False,
+       "disable the native C++ library entirely (build/load returns None)"),
+    _k("VCTPU_FOREST_STRATEGY", "enum", "auto",
+       "forest inference strategy: auto|gather|gemm|wide|pallas "
+       "(docs/models.md)",
+       choices=("auto", "gather", "gemm", "wide", "pallas"),
+       label="forest strategy"),
+    _k("VCTPU_PALLAS", "bool", True,
+       "allow the pallas wide-block kernel in strategy auto-resolution",
+       in_header=True),
+    _k("VCTPU_WIDE_CHUNK", "int", None,
+       "N-chunk of the wide-contraction driver (bounds the decision "
+       "tensor); default models/forest.WIDE_CHUNK", positive=True,
+       in_header=True),
+    _k("VCTPU_WIDE_BLOCK", "int", None,
+       "trees per block-diagonal routing block; default fills the "
+       "128-lane MXU", positive=True, in_header=True),
+    _k("VCTPU_NATIVE_GBT", "bool", True,
+       "allow the native partitioned-sample GBT trainer on CPU fits"),
+    # -- streaming executor / parallel host pipeline --------------------
+    _k("VCTPU_THREADS", "int", None,
+       "host pipeline threads; 1 selects the serial path; default cpu "
+       "count", positive=True),
+    _k("VCTPU_STREAM", "bool", True,
+       "allow the streaming (chunked, overlapped) filter executor"),
+    _k("VCTPU_STREAM_CHUNK_BYTES", "int", 16 << 20,
+       "bytes of VCF text per streaming pipeline item", positive=True),
+    _k("VCTPU_STAGE_TIMEOUT_S", "float", 900.0,
+       "streaming-stage watchdog deadline in seconds (0 disables)",
+       minimum=0.0),
+    _k("VCTPU_IO_RETRIES", "int", 2,
+       "bounded retries for transient ingest/writeback IO errors",
+       minimum=0),
+    _k("VCTPU_IO_BACKOFF_S", "float", 0.05,
+       "initial retry backoff in seconds (doubles per attempt)",
+       minimum=0.0),
+    _k("VCTPU_RESUME", "bool", True,
+       "resume interrupted plain-text runs from the chunk journal"),
+    # -- multi-host -----------------------------------------------------
+    _k("VCTPU_COORDINATOR", "str", None,
+       "host:port of rank 0 — presence turns any tool into one rank of "
+       "a global mesh (docs/distributed.md)"),
+    _k("VCTPU_NUM_PROCESSES", "int", None,
+       "total ranks of a multi-host launch", positive=True),
+    _k("VCTPU_PROCESS_ID", "int", None,
+       "this rank's id in a multi-host launch", minimum=0),
+    _k("VCTPU_AUTO_DISTRIBUTED", "bool", False,
+       "initialize jax.distributed from the cluster environment (TPU "
+       "pods)"),
+    _k("VCTPU_ALL_RANKS_WRITE", "bool", False,
+       "let every rank write its own output copy (default: rank 0 only)"),
+    # -- caches / IO ----------------------------------------------------
+    _k("VCTPU_COMPILE_CACHE", "str", None,
+       "persistent XLA compilation cache dir; empty string disables; "
+       "default ~/.cache/vctpu/xla"),
+    _k("VCTPU_GENOME_CACHE", "bool", True,
+       "persist the encoded genome as a .venc sidecar and memmap hits"),
+    _k("VCTPU_GENOME_CACHE_DIR", "str", "",
+       "directory for .venc sidecars (default: next to the FASTA)"),
+    _k("VCTPU_FASTA_CACHE_BYTES", "int", 4 << 30,
+       "byte budget of the in-memory encoded-contig cache (0 disables)",
+       minimum=0),
+    _k("VCTPU_CLOUD_TIMEOUT", "int", 600,
+       "seconds before a cloud-CLI localization attempt is killed",
+       positive=True),
+    _k("VCTPU_SUBPROC_TIMEOUT_S", "int", 3600,
+       "timeout for external tool subprocesses (beagle, …) — VCT005: no "
+       "subprocess runs unbounded", positive=True),
+    # -- diagnostics / test harness ------------------------------------
+    _k("VCTPU_TRACE", "bool", False,
+       "print every closed trace span at INFO level"),
+    _k("VCTPU_FAULTS", "str", "",
+       "fault-injection spec, e.g. io.chunk_read:2,pipeline.stage_hang@30 "
+       "(utils/faults.py)"),
+    _k("VCTPU_FLAKEHUNT", "bool", False,
+       "run_tests.sh: repeat flakehunt-marked tests 5x after the main run"),
+    _k("VCTPU_PROBE_INTERVAL", "int", 1800,
+       "tools/tpu_probe.py polling interval in seconds", positive=True),
+    _k("VCTPU_PROBE_HOURS", "float", 11.5,
+       "tools/tpu_probe.py total probe-loop duration in hours",
+       minimum=0.0),
+)}
+
+
+def raw(name: str) -> str | None:
+    """The raw env string (None when unset) — the registry's single
+    ``os.environ`` access point for ``VCTPU_*`` keys. Callers that need
+    the uninterpreted text (predictor-cache keys) use this instead of
+    touching the environment themselves."""
+    if name not in REGISTRY:
+        raise KeyError(f"{name} is not a registered VCTPU knob")
+    return os.environ.get(name)
+
+
+def _parse(knob: Knob, raw_value: str) -> Any:
+    text = raw_value.strip()
+    if knob.kind == "str":
+        return raw_value
+    if not text:  # set-but-empty == unset for non-str knobs
+        return knob.default
+    if knob.kind == "bool":
+        low = text.lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise _config_error(
+            f"{knob.name}={raw_value!r} is not a valid boolean; use one of "
+            f"{'/'.join(_TRUE)} or {'/'.join(_FALSE)}")
+    if knob.kind == "enum":
+        low = text.lower()
+        if low not in knob.choices:
+            noun = knob.label or knob.name
+            raise _config_error(
+                f"{knob.name}={low!r} is not a valid {noun}; choose one of "
+                f"{'/'.join(knob.choices)}")
+        return low
+    if knob.kind == "int":
+        try:
+            value = int(text)
+        except ValueError:
+            value = None
+        if knob.positive:
+            if value is None or value <= 0:
+                raise _config_error(
+                    f"{knob.name}={raw_value!r} is not a positive integer")
+        elif value is None:
+            raise _config_error(
+                f"{knob.name}={raw_value!r} is not an integer")
+    elif knob.kind == "float":
+        try:
+            value = float(text)
+        except ValueError:
+            raise _config_error(
+                f"{knob.name}={raw_value!r} is not a number") from None
+    else:  # pragma: no cover — registry construction guards kinds
+        raise _config_error(f"unknown knob kind {knob.kind!r} for {knob.name}")
+    if knob.minimum is not None and value < knob.minimum:
+        raise _config_error(
+            f"{knob.name}={raw_value!r} must be >= {knob.minimum}")
+    return value
+
+
+def get(name: str) -> Any:
+    """The typed, validated value of a registered knob (env beats the
+    declared default). The ONE parse point: a malformed value raises
+    ``EngineError`` here — exit code 2 at every CLI — regardless of
+    which engine or strategy the run would have used."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(f"{name} is not a registered VCTPU knob")
+    raw_value = raw(name)
+    if raw_value is None:
+        return knob.default
+    return _parse(knob, raw_value)
+
+
+def _typed(name: str, kinds: tuple[str, ...]) -> Any:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(f"{name} is not a registered VCTPU knob")
+    if knob.kind not in kinds:
+        raise TypeError(f"{name} is a {knob.kind} knob, not {'/'.join(kinds)}")
+    return get(name)
+
+
+def get_bool(name: str) -> bool:
+    return _typed(name, ("bool",))
+
+
+def get_int(name: str) -> int | None:
+    return _typed(name, ("int",))
+
+
+def get_float(name: str) -> float:
+    return _typed(name, ("float",))
+
+
+def get_str(name: str) -> str | None:
+    return _typed(name, ("str", "enum"))
+
+
+def source(name: str) -> str:
+    """Where the resolved value came from: ``"env"`` or ``"default"``."""
+    return "env" if raw(name) is not None else "default"
+
+
+def resolved() -> list[tuple[str, Any, str]]:
+    """(name, typed value, source) for every registered knob, sorted.
+    Raises on the first malformed value, like :func:`validate_all`."""
+    return [(name, get(name), source(name)) for name in sorted(REGISTRY)]
+
+
+def validate_all() -> None:
+    """Parse every registered knob, raising ``EngineError`` on the first
+    malformed value — the whole-registry extension of PR 3's
+    ``validate_strategy_env``: a bad knob exits 2 up front on every
+    engine, never mid-run from inside a trace."""
+    for name in REGISTRY:
+        get(name)
+
+
+def unknown_env() -> list[tuple[str, str | None]]:
+    """``VCTPU_*`` variables set in the environment but absent from the
+    registry, each with its closest registered name (typo detection) or
+    None when nothing is close."""
+    out: list[tuple[str, str | None]] = []
+    for key in sorted(os.environ):
+        if not key.startswith("VCTPU_") or key in REGISTRY:
+            continue
+        close = difflib.get_close_matches(key, REGISTRY, n=1, cutoff=0.6)
+        out.append((key, close[0] if close else None))
+    return out
+
+
+def warn_unknown_env() -> list[str]:
+    """Log a startup warning for every unknown ``VCTPU_*`` variable —
+    today ``VCTPU_FOERST_STRATEGY=wide`` silently configures nothing.
+    Returns the warning strings (for tests)."""
+    warnings = []
+    for key, suggestion in unknown_env():
+        msg = f"unknown environment variable {key} is ignored"
+        if suggestion:
+            msg += f" — did you mean {suggestion}?"
+        warnings.append(msg)
+        logger.warning("%s", msg)
+    return warnings
+
+
+HEADER_KEY = "vctpu_knobs"
+
+
+def header_line() -> str:
+    """``##vctpu_knobs=`` listing the explicitly-set scoring knobs
+    (``in_header=True``) — provenance next to ``##vctpu_engine=`` /
+    ``##vctpu_forest_strategy=``, which record the engine-selection knobs
+    in resolved form. Execution-only knobs (threads, timeouts, caches)
+    are excluded: they are byte-neutral by contract, and the streaming /
+    serial / resumed paths must emit identical header bytes under
+    differing values of them."""
+    parts = [f"{name}={get(name)}"
+             for name in sorted(REGISTRY)
+             if REGISTRY[name].in_header and raw(name) is not None]
+    return f"##{HEADER_KEY}=" + ",".join(parts)
+
+
+# --------------------------------------------------------------------------
+# ``vctpu knobs`` — dump the resolved registry
+# --------------------------------------------------------------------------
+
+
+def run(argv: list[str]) -> int:
+    """CLI: print every knob's resolved value and source.
+
+    ``--json`` emits a machine-readable dump. Exit 2 on a malformed
+    value (same as every other tool), after reporting WHICH knob."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="vctpu knobs",
+        description="dump the resolved VCTPU_* knob registry")
+    parser.add_argument("--json", action="store_true",
+                        help="emit JSON instead of aligned text")
+    args = parser.parse_args(argv)
+    from variantcalling_tpu.engine import EngineError
+
+    for msg in warn_unknown_env():
+        print(f"warning: {msg}")
+    try:
+        rows = resolved()
+    except EngineError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        import json
+
+        print(json.dumps({name: {"value": value, "source": src,
+                                 "help": REGISTRY[name].help}
+                          for name, value, src in rows}, indent=2))
+        return 0
+    width = max(len(name) for name, _, _ in rows)
+    for name, value, src in rows:
+        shown = "" if value is None else value
+        print(f"{name:<{width}}  {shown!s:<12} [{src:>7}]  {REGISTRY[name].help}")
+    return 0

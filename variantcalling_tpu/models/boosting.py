@@ -23,7 +23,6 @@ pipeline's gather-traversal kernel.
 
 from __future__ import annotations
 
-import os
 from contextlib import nullcontext
 from dataclasses import dataclass
 
@@ -32,7 +31,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from variantcalling_tpu import knobs
 from variantcalling_tpu.models.forest import LEAF, FlatForest
+from variantcalling_tpu.utils import degrade
 
 
 @dataclass(frozen=True)
@@ -253,7 +254,8 @@ def _make_train(cfg: BoostConfig, use_matmul: bool = True):
         # Per-device bytes under dp sharding = total / n_shards.
         try:
             n_shards = jax.device_count()
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            degrade.record("boosting.device_count_probe", e, fallback="n_shards=1")
             n_shards = 1
         boh_bytes = 2 * n * f * cfg.n_bins // max(n_shards, 1)
         boh = jax.nn.one_hot(binned, cfg.n_bins, dtype=jnp.bfloat16).reshape(n, f * cfg.n_bins) \
@@ -346,7 +348,8 @@ def fit(
             platform = next(iter(x.devices())).platform
         else:
             platform = jax.devices()[0].platform
-    except Exception:  # noqa: BLE001 — device probe must not break the fit
+    except Exception as e:  # noqa: BLE001 — device probe must not break the fit
+        degrade.record("boosting.platform_probe", e, fallback="platform=cpu")
         platform = "cpu"
 
     # CPU fallback with host inputs: the native partitioned-sample trainer
@@ -359,7 +362,7 @@ def fit(
     # edges.shape[1], and the native kernel indexes histograms by them)
     if platform == "cpu" and mesh is None and host_binned is not None and not diag \
             and np.asarray(edges).shape[1] == cfg.n_bins - 1 \
-            and os.environ.get("VCTPU_NATIVE_GBT", "1") != "0":
+            and knobs.get_bool("VCTPU_NATIVE_GBT"):
         from variantcalling_tpu import native
 
         w_arr = None if sample_weight is None else np.asarray(w, dtype=np.float32)

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from variantcalling_tpu.parallel.mesh import MODEL_AXIS
 
 MOTIF_VOCAB = 5**5  # base-5 packed 5-mers (A,C,G,T,N)
 

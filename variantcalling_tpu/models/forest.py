@@ -318,22 +318,11 @@ def _int_env(name: str) -> int | None:
     """Positive-integer env knob, or None when unset. A malformed value is
     a configuration error (EngineError, CLI exit 2) like a bad
     VCTPU_ENGINE/VCTPU_FOREST_STRATEGY — never a mid-run ValueError
-    traceback from inside a jit trace."""
-    import os
+    traceback from inside a jit trace. Parsing lives in the typed knob
+    registry (:mod:`variantcalling_tpu.knobs`)."""
+    from variantcalling_tpu import knobs
 
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return None
-    try:
-        v = int(raw)
-    except ValueError:
-        v = 0
-    if v <= 0:
-        from variantcalling_tpu.engine import EngineError
-
-        raise EngineError(
-            f"{name}={raw!r} is not a positive integer")
-    return v
+    return knobs.get_int(name)
 
 
 def default_tree_block(n_internal: int) -> int:
@@ -520,17 +509,11 @@ STRATEGY_HEADER_KEY = "vctpu_forest_strategy"
 
 def requested_strategy() -> str:
     """The env-requested strategy; raises EngineError on a bad value (the
-    same fail-loudly style as a bad VCTPU_ENGINE)."""
-    import os
+    same fail-loudly style as a bad VCTPU_ENGINE — parse and validation
+    live in the typed knob registry)."""
+    from variantcalling_tpu import knobs
 
-    raw = os.environ.get(FOREST_STRATEGY_ENV, "auto").strip().lower() or "auto"
-    if raw not in FOREST_STRATEGIES:
-        from variantcalling_tpu.engine import EngineError
-
-        raise EngineError(
-            f"{FOREST_STRATEGY_ENV}={raw!r} is not a valid forest strategy; "
-            f"choose one of {'/'.join(FOREST_STRATEGIES)}")
-    return raw
+    return knobs.get_str(FOREST_STRATEGY_ENV)
 
 
 def validate_strategy_env() -> None:
@@ -547,7 +530,10 @@ def validate_strategy_env() -> None:
 def _backend() -> str:
     try:
         return jax.default_backend()
-    except Exception:  # backend init failure must not break program construction
+    except Exception as e:  # backend init failure must not break program construction
+        from variantcalling_tpu.utils import degrade
+
+        degrade.record("forest.backend_probe", e, fallback='backend="cpu"')
         return "cpu"
 
 
@@ -577,7 +563,7 @@ def resolve_strategy(forest: FlatForest, n_features: int | None = None,
     routing (the kernel's known gap). Trees beyond GEMM_MAX_LEAVES fall
     back to the gather walk everywhere.
     """
-    import os
+    from variantcalling_tpu import knobs
 
     req = requested_strategy()
     if req != "auto":
@@ -587,7 +573,7 @@ def resolve_strategy(forest: FlatForest, n_features: int | None = None,
         return "gather"
     if max_tree_leaves(forest) > GEMM_MAX_LEAVES:
         return "gather"
-    if backend == "tpu" and os.environ.get("VCTPU_PALLAS", "1") != "0" \
+    if backend == "tpu" and knobs.get_bool("VCTPU_PALLAS") \
             and forest.default_left is None:
         return "pallas"
     return "wide"
@@ -663,8 +649,16 @@ def make_margin_predictor(forest: FlatForest, n_features: int | None = None,
                 f"({FOREST_STRATEGY_ENV} or a pinned run configuration) but "
                 f"cannot serve this forest/backend: {type(e).__name__}: {e}. "
                 "Refusing to silently fall back — rerun with "
-                f"{FOREST_STRATEGY_ENV}=auto to opt into fallback. "
+                f"{FOREST_STRATEGY_ENV}=auto to opt into fallback, or "
+                "VCTPU_PALLAS=0 if the pallas kernel cannot serve this "
+                "forest (the filter pipeline pins auto's resolution, so "
+                "re-running auto repeats this choice). "
                 "See docs/models.md.") from e
+        from variantcalling_tpu.utils import degrade
+
+        degrade.record("forest.auto_fallback", e,
+                       fallback=f"auto-resolved strategy {resolved!r} cannot "
+                       "build; walking the fallback chain", warn=True)
         fn = None
         for fb in _AUTO_FALLBACK:
             if fb == resolved:
@@ -673,7 +667,12 @@ def make_margin_predictor(forest: FlatForest, n_features: int | None = None,
                 fn = _build_margin_program(fb, forest, n_features)
                 resolved = fb
                 break
-            except Exception:  # noqa: BLE001 — keep walking the chain
+            except Exception as fb_err:  # noqa: BLE001 — keep walking the chain
+                from variantcalling_tpu.utils import degrade
+
+                degrade.record("forest.auto_fallback", fb_err,
+                               fallback=f"strategy {fb!r} also failed; "
+                               "trying next in chain", warn=True)
                 continue
         if fn is None:
             raise

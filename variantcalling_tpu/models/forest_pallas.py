@@ -149,7 +149,11 @@ def make_wide_pallas_margin_predictor(gf, tree_block: int | None = None,
     if interpret is None:
         try:
             interpret = jax.default_backend() != "tpu"
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            from variantcalling_tpu.utils import degrade
+
+            degrade.record("forest_pallas.backend_probe", e,
+                           fallback="interpret=True")
             interpret = True
     wf = forest_mod.to_wide(gf, tree_block)
     b, f, gi = wf.a.shape
@@ -203,7 +207,11 @@ def make_gemm_pallas_predictor(gf, interpret: bool | None = None):
     if interpret is None:
         try:
             interpret = jax.default_backend() != "tpu"
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            from variantcalling_tpu.utils import degrade
+
+            degrade.record("forest_pallas.backend_probe", e,
+                           fallback="interpret=True")
             interpret = True
     tables = (
         jnp.asarray(gf.a),

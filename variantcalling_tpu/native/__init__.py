@@ -99,7 +99,9 @@ def get_lib() -> ctypes.CDLL | None:
         if _TRIED:
             return _LIB
         _TRIED = True
-        if os.environ.get("VCTPU_NO_NATIVE"):
+        from variantcalling_tpu import knobs
+
+        if knobs.get_bool("VCTPU_NO_NATIVE"):
             return None
         path = _build()
         if path is None:

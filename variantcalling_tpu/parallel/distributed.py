@@ -23,14 +23,13 @@ on both hosts.
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from variantcalling_tpu import knobs
 from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 _INITIALIZED = False
@@ -43,10 +42,10 @@ def init_from_env() -> bool:
     global _INITIALIZED
     if _INITIALIZED:
         return jax.process_count() > 1
-    coord = os.environ.get("VCTPU_COORDINATOR")
+    coord = knobs.get_str("VCTPU_COORDINATOR")
     if coord:
         missing = [k for k in ("VCTPU_NUM_PROCESSES", "VCTPU_PROCESS_ID")
-                   if k not in os.environ]
+                   if knobs.get_int(k) is None]
         if missing:
             raise SystemExit(
                 f"VCTPU_COORDINATOR is set but {', '.join(missing)} is not — a "
@@ -54,12 +53,12 @@ def init_from_env() -> bool:
                 "VCTPU_NUM_PROCESSES, VCTPU_PROCESS_ID")
         jax.distributed.initialize(
             coordinator_address=coord,
-            num_processes=int(os.environ["VCTPU_NUM_PROCESSES"]),
-            process_id=int(os.environ["VCTPU_PROCESS_ID"]),
+            num_processes=knobs.get_int("VCTPU_NUM_PROCESSES"),
+            process_id=knobs.get_int("VCTPU_PROCESS_ID"),
         )
         _INITIALIZED = True
         return True
-    if os.environ.get("VCTPU_AUTO_DISTRIBUTED"):  # any truthy value, matching the CLI gate
+    if knobs.get_bool("VCTPU_AUTO_DISTRIBUTED"):  # matching the CLI gate
         # TPU pods: coordinator/topology come from the cluster environment
         jax.distributed.initialize()
         _INITIALIZED = True

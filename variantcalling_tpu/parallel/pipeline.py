@@ -45,15 +45,16 @@ import threading
 import time
 from collections.abc import Callable, Iterable, Iterator
 
-from variantcalling_tpu import logger
+from variantcalling_tpu import knobs, logger
 from variantcalling_tpu.utils import faults
 
 _SENTINEL = object()
 
 #: default per-run watchdog deadline (seconds of NO pipeline progress);
 #: generous — chunks normally flow every few hundred ms, and a legitimate
-#: slow stage still heartbeats by finishing items. 0 disables.
-DEFAULT_STAGE_TIMEOUT_S = 900.0
+#: slow stage still heartbeats by finishing items. 0 disables. The value
+#: lives in the knob registry; this alias cannot drift from it.
+DEFAULT_STAGE_TIMEOUT_S = knobs.REGISTRY["VCTPU_STAGE_TIMEOUT_S"].default
 
 
 class StageTimeoutError(RuntimeError):
@@ -64,28 +65,20 @@ def resolve_threads() -> int:
     """Pipeline thread policy: VCTPU_THREADS overrides, else cpu count.
 
     ``VCTPU_THREADS=1`` is the documented switch for "run the serial
-    path"; invalid values fall back to auto so a typo can't crash a run.
-    """
-    env = os.environ.get("VCTPU_THREADS", "").strip()
-    if env:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
-    return os.cpu_count() or 1
+    path". A malformed value is a configuration error (EngineError, CLI
+    exit 2) like every other knob — the registry killed the old
+    fall-back-to-auto behavior, where a typo silently changed the
+    executor."""
+    n = knobs.get_int("VCTPU_THREADS")
+    return n if n is not None else (os.cpu_count() or 1)
 
 
 def resolve_stage_timeout() -> float:
-    """Watchdog deadline from ``VCTPU_STAGE_TIMEOUT_S`` (0 disables);
-    invalid values fall back to the default so a typo can't disable the
-    watchdog silently."""
-    env = os.environ.get("VCTPU_STAGE_TIMEOUT_S", "").strip()
-    if env:
-        try:
-            return max(0.0, float(env))
-        except ValueError:
-            pass
-    return DEFAULT_STAGE_TIMEOUT_S
+    """Watchdog deadline from ``VCTPU_STAGE_TIMEOUT_S`` (0 disables). A
+    malformed value is a configuration error (EngineError, CLI exit 2;
+    knob-registry contract) — it can neither disable the watchdog
+    silently nor be silently ignored."""
+    return knobs.get_float("VCTPU_STAGE_TIMEOUT_S")
 
 
 def retry_transient(fn: Callable, what: str, attempts: int | None = None,
@@ -101,15 +94,9 @@ def retry_transient(fn: Callable, what: str, attempts: int | None = None,
     retryable failure propagates after the budget is spent.
     """
     if attempts is None:
-        try:
-            attempts = 1 + max(0, int(os.environ.get("VCTPU_IO_RETRIES", "2")))
-        except ValueError:
-            attempts = 3
+        attempts = 1 + knobs.get_int("VCTPU_IO_RETRIES")
     if backoff_s is None:
-        try:
-            backoff_s = max(0.0, float(os.environ.get("VCTPU_IO_BACKOFF_S", "0.05")))
-        except ValueError:
-            backoff_s = 0.05
+        backoff_s = knobs.get_float("VCTPU_IO_BACKOFF_S")
     last: BaseException | None = None
     for k in range(max(1, attempts)):
         try:
@@ -200,7 +187,8 @@ class StagePipeline:
                     if not _put(queues[0], (seq, item)):
                         return
                 _put(queues[0], _SENTINEL)
-            except BaseException as e:  # noqa: BLE001 — relay to the consumer
+            # not a swallow: the consumer re-raises the relayed exception
+            except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
                 _put(queues[0], (_SENTINEL, e))
 
         def _stage(i: int, fn: Callable) -> None:
@@ -225,7 +213,8 @@ class StagePipeline:
                     finally:
                         busy_since[i] = None
                     _put(q_out, (seq, out))
-            except BaseException as e:  # noqa: BLE001 — relay to the consumer
+            # not a swallow: the consumer re-raises the relayed exception
+            except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
                 _put(q_out, (_SENTINEL, e))
 
         workers = [threading.Thread(target=_feed, name="pipe-src", daemon=True)]

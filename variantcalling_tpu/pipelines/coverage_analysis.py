@@ -31,6 +31,7 @@ import pandas as pd
 import jax.numpy as jnp
 
 from variantcalling_tpu import logger
+from variantcalling_tpu.utils import degrade
 from variantcalling_tpu.io import bed as bedio
 from variantcalling_tpu.io.bam import depth_diff_arrays, depth_vectors
 from variantcalling_tpu.ops import coverage as cops
@@ -171,6 +172,7 @@ def full_analysis(args) -> int:
                     out_path=f"{base}.w{w}.profile.png",
                 )
     except Exception as e:  # plotting must never fail the numeric outputs
+        degrade.record("coverage_analysis.plots", e, fallback="plots skipped")
         logger.warning("coverage plots skipped: %s", e)
     logger.info("wrote %s (histogram/stats/percentiles) + %d binned parquets", out_h5, len(windows))
     return 0

@@ -23,8 +23,9 @@ import jax
 import jax.numpy as jnp
 
 from variantcalling_tpu import engine as engine_mod
-from variantcalling_tpu import logger
+from variantcalling_tpu import knobs, logger
 from variantcalling_tpu.engine import EngineError
+from variantcalling_tpu.utils import degrade
 from variantcalling_tpu.featurize import host_featurize
 from variantcalling_tpu.io import bed as bedio
 from variantcalling_tpu.io.fasta import FastaReader
@@ -160,9 +161,9 @@ def _strategy_token(strategy: str | None) -> tuple:
     request) PLUS the wide-path knobs — tests flip these between calls,
     and a cached program compiled under the old values must not answer
     for the new."""
-    return (strategy or os.environ.get(forest_mod.FOREST_STRATEGY_ENV, "auto"),
-            os.environ.get(forest_mod.WIDE_CHUNK_ENV, ""),
-            os.environ.get(forest_mod.WIDE_BLOCK_ENV, ""))
+    return (strategy or knobs.raw(forest_mod.FOREST_STRATEGY_ENV) or "auto",
+            knobs.raw(forest_mod.WIDE_CHUNK_ENV) or "",
+            knobs.raw(forest_mod.WIDE_BLOCK_ENV) or "")
 
 
 def _raw_predictor(model, feature_names: list[str], strategy: str | None = None):
@@ -751,6 +752,19 @@ def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = Non
     if strategy is not None:
         key = forest_mod.STRATEGY_HEADER_KEY
         _replace_or_append_meta(header, f"##{key}=", f"##{key}={strategy}")
+    # explicitly-set scoring knobs (wide chunk/block, pallas opt-out):
+    # full provenance next to the engine/strategy lines. Execution-only
+    # knobs are excluded so streaming/serial/resumed runs stay
+    # byte-identical (knobs.header_line contract). With nothing set (the
+    # common case) no line is emitted — and a stale line inherited from a
+    # re-filtered input is REMOVED, so it cannot mislabel this run.
+    knob_line = knobs.header_line()
+    knob_prefix = f"##{knobs.HEADER_KEY}="
+    if knob_line != knob_prefix:
+        _replace_or_append_meta(header, knob_prefix, knob_line)
+    else:
+        header.lines[:] = [ln for ln in header.lines
+                           if not ln.startswith(knob_prefix)]
 
 
 def streaming_eligible(args_limit_to_contig=None) -> bool:
@@ -761,15 +775,16 @@ def streaming_eligible(args_limit_to_contig=None) -> bool:
     from variantcalling_tpu import native
     from variantcalling_tpu.parallel.pipeline import resolve_threads
 
-    if os.environ.get("VCTPU_STREAM", "1") == "0" or resolve_threads() <= 1:
+    if not knobs.get_bool("VCTPU_STREAM") or resolve_threads() <= 1:
         return False
     if not native.available() or args_limit_to_contig:
         return False
     try:
         if jax.process_count() > 1:
             return False
-    except Exception:  # noqa: BLE001 — uninitialized backend == single process
-        pass
+    except Exception as e:  # noqa: BLE001 — uninitialized backend == single process
+        degrade.record("pipeline.process_count_probe", e,
+                       fallback="assume single process")
     return True
 
 
@@ -897,7 +912,7 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
     # resume only for plain-text outputs: a killed BGZF writer's in-flight
     # block state is unrecoverable, so .gz runs restart (still atomic)
-    resume_enabled = not gz and os.environ.get("VCTPU_RESUME", "1") != "0"
+    resume_enabled = not gz and knobs.get_bool("VCTPU_RESUME")
     resume = None
     journal: journal_mod.ChunkJournal | None = None
     meta = None
@@ -1038,6 +1053,11 @@ def run(argv: list[str]) -> int:
     # agree on one engine across ranks so the allgathered score slices
     # cannot mix engines within one output file.
     try:
+        # whole-registry knob validation FIRST (docs/static_analysis.md):
+        # any malformed VCTPU_* value exits 2 here with a clear message,
+        # uniformly across engines and forest strategies, before any
+        # ingest or scoring work starts
+        knobs.validate_all()
         eng = engine_mod.resolve_for_run()
     except EngineError as e:
         logger.error("%s", e)
@@ -1082,7 +1102,8 @@ def run(argv: list[str]) -> int:
     # sharded by variant range, collectives ride the global mesh.
     try:
         n_proc = jax.process_count()
-    except Exception:  # noqa: BLE001 — uninitialized backend == single process
+    except Exception as e:  # noqa: BLE001 — uninitialized backend == single process
+        degrade.record("pipeline.process_count_probe", e, fallback="n_proc=1")
         n_proc = 1
     work = table
     if n_proc > 1:
@@ -1122,7 +1143,7 @@ def run(argv: list[str]) -> int:
         filters = FactorizedColumn(dist.allgather_concat(filters.codes),
                                    filters.uniques)
         assert len(score) == len(table), (len(score), len(table))
-        if jax.process_index() != 0 and not os.environ.get("VCTPU_ALL_RANKS_WRITE"):
+        if jax.process_index() != 0 and not knobs.get_bool("VCTPU_ALL_RANKS_WRITE"):
             # every rank holds the full result, but only rank 0 touches the
             # output path: concurrent identical-byte writes to a shared
             # filesystem race benignly at best (truncate-then-write), and a

@@ -62,7 +62,21 @@ def run_beagle(high_gq_vcf: str, cohort_vcf: str, plink_map: str, out_vcf: str,
         f"gt={high_gq_vcf}", f"ref={cohort_vcf}", f"map={plink_map}",
         f"out={prefix}", f"nthreads={nthreads}", "window=100",
     ]
-    proc = subprocess.run(cmd, capture_output=True, text=True)
+    # bounded like every external tool (VCT005): a wedged beagle must not
+    # hang the stage chain forever — and a timeout keeps this function's
+    # one failure shape (RuntimeError with diagnostics)
+    from variantcalling_tpu import knobs
+
+    timeout_s = knobs.get_int("VCTPU_SUBPROC_TIMEOUT_S")
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"")[-800:] if isinstance(e.stderr, (bytes, bytearray)) \
+            else (e.stderr or "")[-800:]
+        raise RuntimeError(
+            f"beagle timed out after {timeout_s}s (VCTPU_SUBPROC_TIMEOUT_S): "
+            f"{tail}") from e
     if proc.returncode != 0 or not os.path.exists(prefix + ".vcf.gz"):
         raise RuntimeError(f"beagle failed rc={proc.returncode}: {proc.stderr[-800:]}")
 

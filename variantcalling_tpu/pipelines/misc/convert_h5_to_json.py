@@ -11,8 +11,6 @@ import argparse
 import json
 import sys
 
-import pandas as pd
-
 from variantcalling_tpu import logger
 
 

@@ -46,8 +46,10 @@ def run(argv: list[str]) -> int:
         import jax
 
         multi_host = jax.process_count() > 1
-    except Exception:  # noqa: BLE001 — no jax runtime means single-host
-        pass
+    except Exception as e:  # noqa: BLE001 — no jax runtime means single-host
+        from variantcalling_tpu.utils import degrade
+
+        degrade.record("sec.process_count_probe", e, fallback="multi_host=False")
 
     contigs: list[str] = []
     per_sample = []

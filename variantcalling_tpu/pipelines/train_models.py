@@ -22,6 +22,7 @@ import numpy as np
 import pandas as pd
 
 from variantcalling_tpu import logger
+from variantcalling_tpu.utils import degrade
 from variantcalling_tpu.io import bed as bedio
 from variantcalling_tpu.io.fasta import FastaReader
 from variantcalling_tpu.io.vcf import read_vcf
@@ -202,6 +203,8 @@ def run(argv: list[str]) -> int:
                 models = load_models(partial_pkl)
                 logger.info("resuming: %d models already fitted in %s", len(models), partial_pkl)
         except Exception as e:  # noqa: BLE001 — a bad checkpoint must not kill the rerun
+            degrade.record("train_models.resume_checkpoint", e,
+                           fallback="refit from scratch")
             logger.warning("--resume: could not read %s (%s); refitting from scratch",
                            partial_pkl, e)
             models = {}

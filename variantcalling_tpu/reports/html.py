@@ -73,6 +73,7 @@ def add_figure_safe(rep: HtmlReport, build, what: str = "figure") -> None:
     backend selection + warn-on-failure pattern the report pipelines share.
     """
     from variantcalling_tpu import logger
+    from variantcalling_tpu.utils import degrade
 
     try:
         import matplotlib
@@ -85,4 +86,5 @@ def add_figure_safe(rep: HtmlReport, build, what: str = "figure") -> None:
             rep.add_figure(fig)
             plt.close(fig)
     except Exception as e:  # noqa: BLE001 — figures are presentation only
+        degrade.record("reports.figure", e, fallback=f"{what} skipped")
         logger.warning("%s skipped: %s", what, e)

@@ -14,6 +14,8 @@ from __future__ import annotations
 import os
 import subprocess
 
+from variantcalling_tpu import knobs, logger
+
 DEFAULT_CACHE = os.path.join(os.path.expanduser("~"), ".cache", "vctpu_cloud")
 
 
@@ -24,9 +26,6 @@ def is_remote(path: str) -> bool:
 def _local_target(uri: str, cache_dir: str) -> str:
     scheme, rest = uri.split("://", 1)
     return os.path.join(cache_dir, scheme, rest)
-
-
-DOWNLOAD_TIMEOUT_S = int(os.environ.get("VCTPU_CLOUD_TIMEOUT", "600"))
 
 
 def cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE, force: bool = False) -> str:
@@ -45,7 +44,8 @@ def cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE, force: bool = False) ->
     last_err: Exception | None = None
     for cmd in cmds:
         try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=DOWNLOAD_TIMEOUT_S)
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=knobs.get_int("VCTPU_CLOUD_TIMEOUT"))
             os.replace(tmp, target)
             return target
         except (OSError, subprocess.SubprocessError) as e:  # tool missing / copy failed / hung
@@ -54,10 +54,14 @@ def cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE, force: bool = False) ->
 
 
 def optional_cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE) -> str:
-    """cloud_sync that degrades to returning the URI unchanged."""
+    """cloud_sync that degrades to returning the URI unchanged — loudly:
+    the caller may be able to stream the URI itself, but the operator
+    should know localization failed rather than discover a slow or
+    failing remote read later."""
     try:
         return cloud_sync(uri, cache_dir)
-    except RuntimeError:
+    except RuntimeError as e:
+        logger.warning("cloud localization failed, passing URI through: %s", e)
         return uri
 
 

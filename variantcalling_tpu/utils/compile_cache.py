@@ -37,7 +37,9 @@ def enable_persistent_cache() -> bool:
     global _ENABLED
     if _ENABLED:
         return True
-    path = os.environ.get("VCTPU_COMPILE_CACHE")
+    from variantcalling_tpu import knobs
+
+    path = knobs.get_str("VCTPU_COMPILE_CACHE")
     if path == "":
         return False
     if path is None:
@@ -54,7 +56,11 @@ def enable_persistent_cache() -> bool:
 
             jax.config.update("jax_compilation_cache_dir", path)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:  # noqa: BLE001 — caching is best-effort, never fatal
+    except Exception as e:  # noqa: BLE001 — caching is best-effort, never fatal
+        from variantcalling_tpu.utils import degrade
+
+        degrade.record("compile_cache.enable", e,
+                       fallback="persistent XLA cache disabled", warn=True)
         return False
     _ENABLED = True
     return True

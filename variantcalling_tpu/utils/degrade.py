@@ -1,0 +1,60 @@
+"""Designated degradation recorder — the ONLY sanctioned way to swallow
+a broad exception.
+
+Round-5's worst bug was a bare ``except`` that silently flipped the
+scoring engine per call; PR 2 made engine degradation loud, and the
+``vctpu-lint`` VCT002 checker (docs/static_analysis.md) now flags every
+``except:`` / ``except Exception:`` that swallows and continues. Some
+swallows are legitimate — a backend probe on an uninitialized jax
+runtime, a best-effort cache write — but "legitimate" must still be
+**visible**: such a handler routes through :func:`record`, which logs the
+event with its fallback and keeps a bounded in-process trail
+(:data:`EVENTS`) so tests and operators can assert exactly which
+degradations a run took. A broad handler that neither re-raises, raises
+``EngineError``, nor calls ``degrade.record`` is a VCT002 finding.
+
+Scoring-path code must NOT use this to degrade the engine or strategy —
+those contracts fail loudly (``EngineError``, exit 2); :func:`record` is
+for probes and best-effort accelerators whose fallback cannot change
+output bytes.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from variantcalling_tpu import logger
+
+#: bounded trail of (point, exception repr, fallback) — newest last
+EVENTS: deque[tuple[str, str, str]] = deque(maxlen=256)
+_LOCK = threading.Lock()
+
+
+def record(point: str, exc: BaseException | None = None,
+           fallback: str = "", warn: bool = False) -> None:
+    """Record one sanctioned degradation.
+
+    ``point`` names the site (dotted, like a fault-injection point, e.g.
+    ``"engine.backend_probe"``); ``fallback`` says what the code does
+    instead. Routine probes (an uninitialized backend on a single host)
+    log at DEBUG; pass ``warn=True`` when a human should notice (a cache
+    that stopped persisting, an accelerator that stopped accelerating).
+    """
+    exc_text = "" if exc is None else f"{type(exc).__name__}: {exc}"
+    with _LOCK:
+        EVENTS.append((point, exc_text, fallback))
+    log = logger.warning if warn else logger.debug
+    log("degradation %s: %s -> %s", point, exc_text or "(no exception)",
+        fallback or "(continue)")
+
+
+def events_for(point: str) -> list[tuple[str, str, str]]:
+    """The recorded events for one point (tests)."""
+    with _LOCK:
+        return [e for e in EVENTS if e[0] == point]
+
+
+def clear_for_tests() -> None:
+    with _LOCK:
+        EVENTS.clear()

@@ -39,7 +39,6 @@ length (cancellable).
 from __future__ import annotations
 
 import errno
-import os
 import threading
 
 #: Catalog of injection points: name -> (description, exception factory).
@@ -192,7 +191,9 @@ def check(point: str) -> None:
 def _arm_from_env() -> None:
     """Parse ``VCTPU_FAULTS`` (see module docstring) — once at import, so
     subprocess-based tests can arm faults without touching test APIs."""
-    spec = os.environ.get("VCTPU_FAULTS", "").strip()
+    from variantcalling_tpu import knobs
+
+    spec = (knobs.get_str("VCTPU_FAULTS") or "").strip()
     if not spec:
         return
     for item in spec.split(","):

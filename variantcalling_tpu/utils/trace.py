@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 import time
 from dataclasses import dataclass, field
 
 from variantcalling_tpu import logger
+from variantcalling_tpu.utils import degrade
+from variantcalling_tpu import knobs
 
 
 @dataclass
@@ -61,7 +62,7 @@ def stage(name: str):
         dt = time.perf_counter() - t0
         TRACER._depth -= 1
         TRACER.spans.append(Span(name, dt, TRACER._depth))
-        if os.environ.get("VCTPU_TRACE"):
+        if knobs.get_bool("VCTPU_TRACE"):
             logger.info("stage %s: %.3fs", name, dt)
         else:
             logger.debug("stage %s: %.3fs", name, dt)
@@ -96,6 +97,7 @@ def device_trace(logdir: str):
         jax.profiler.start_trace(logdir)
         started = True
     except Exception as e:  # profiling unsupported on this backend/build
+        degrade.record("trace.device_trace_start", e, fallback="no device trace")
         logger.warning("device trace unavailable: %s", e)
         started = False
     try:
@@ -105,5 +107,7 @@ def device_trace(logdir: str):
             try:
                 jax.profiler.stop_trace()
                 logger.info("device trace written to %s", logdir)
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001
+                degrade.record("trace.device_trace_stop", e,
+                               fallback="trace may be incomplete")
                 logger.warning("device trace stop failed: %s", e)
