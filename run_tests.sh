@@ -33,6 +33,16 @@ else
   echo "lint stage: ruff not installed — skipped"
 fi
 
+# -- tier-0 obs schema stage (docs/observability.md) -----------------------
+# Generate a real obs run log and validate it against the COMMITTED event
+# schema (variantcalling_tpu/obs/event_schema.json): writer/schema drift
+# fails the run before pytest, like a lint finding.
+echo "obs schema stage: python -m tools.obs_schema_check"
+env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.obs_schema_check || {
+  echo "obs schema check failed — failing before pytest" >&2
+  exit 1
+}
+
 rc=0
 env PYTHONPATH= JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \

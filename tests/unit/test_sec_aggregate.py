@@ -1,0 +1,70 @@
+"""sec/aggregate padding path: non-divisible sample counts must reduce
+to exactly the plain ``np.sum`` of the REAL rows, and the pad rows must
+provably not leak into the cohort tensor (ISSUE 5 satellite).
+
+The padding logic is factored into ``pad_samples_to_devices`` so the
+leak-proof is testable WITHOUT a multi-device mesh (the tier-1 container
+may run on one device): the helper's contract — extra rows exist, extra
+rows are exactly zero, real rows untouched — plus the on-mesh equality
+tests cover both halves of the argument.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from variantcalling_tpu.sec.aggregate import (aggregate_on_mesh,
+                                              pad_samples_to_devices)
+
+
+def _counts(rng, s, l=6, a=4):
+    # distinct odd values per row: any leaked/duplicated/dropped row
+    # changes the float32 sum detectably
+    base = rng.integers(1, 1000, size=(s, l, a)).astype(np.float32)
+    return base + np.arange(s, dtype=np.float32)[:, None, None] * 1000
+
+
+def test_pad_helper_pads_with_exact_zeros(rng):
+    counts = _counts(rng, 5)
+    padded = pad_samples_to_devices(counts, 4)
+    assert padded.shape == (8, 6, 4)
+    np.testing.assert_array_equal(padded[:5], counts)  # real rows untouched
+    assert np.all(padded[5:] == 0)  # pad rows are the additive identity
+    # already divisible: the array passes through unchanged (same object)
+    divisible = counts[:4]
+    assert pad_samples_to_devices(divisible, 4) is divisible
+    assert pad_samples_to_devices(counts[:0], 4).shape == (0, 6, 4)
+
+
+@pytest.mark.parametrize("s", [1, 3, 5, 7])
+def test_single_device_mesh_equals_plain_sum(rng, s):
+    """Sample counts not divisible by the device count on a 1-device CPU
+    mesh: the cohort tensor must equal ``np.sum`` over the real rows
+    exactly (float32 accumulation on both sides)."""
+    counts = _counts(rng, s)
+    mesh = make_mesh(n_data=1, n_model=1, devices=jax.local_devices()[:1])
+    got = aggregate_on_mesh(counts, mesh)
+    expect = np.sum(counts.astype(np.float32), axis=0, dtype=np.float32)
+    np.testing.assert_array_equal(got, expect)
+
+
+@pytest.mark.skipif(len(jax.local_devices()) < 8,
+                    reason="padding across shards needs the 8-device mesh")
+@pytest.mark.parametrize("s", [1, 5, 9, 11])
+def test_multi_device_padded_rows_do_not_leak(rng, s):
+    """S not divisible by 8 forces real zero-pad rows onto real shards;
+    the psum over the padded tensor must still equal the plain sum of the
+    REAL rows — i.e. the pad rows contribute nothing."""
+    counts = _counts(rng, s)
+    mesh = make_mesh(n_data=8, n_model=1)
+    assert s % mesh.shape[DATA_AXIS] != 0  # the padding path actually runs
+    got = aggregate_on_mesh(counts, mesh)
+    expect = np.sum(counts.astype(np.float32), axis=0, dtype=np.float32)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_mesh_axes_are_the_declared_names():
+    mesh = make_mesh(n_data=1, n_model=1, devices=jax.local_devices()[:1])
+    assert mesh.axis_names == (DATA_AXIS, MODEL_AXIS)

@@ -266,6 +266,75 @@ def test_vct005_popen_and_thread_rules():
 
 
 # ---------------------------------------------------------------------------
+# VCT006 raw-timing
+# ---------------------------------------------------------------------------
+
+
+def test_vct006_raw_wallclock_timing_flagged():
+    fs = run('''
+        import time
+        t0 = time.perf_counter()
+        work()
+        dt = time.perf_counter() - t0
+        stamp = time.time()
+        ''')
+    assert [f.code for f in fs] == ["VCT006"] * 3
+    assert "trace.stage" in fs[0].message
+    # bare import form (from time import perf_counter)
+    assert codes('''
+        from time import perf_counter
+        t0 = perf_counter()
+        ''') == ["VCT006"]
+
+
+def test_vct006_aliased_imports_not_an_evasion():
+    # `import time as _time` — the exact spelling the executor uses —
+    # and renamed from-imports must hit like the canonical form
+    assert codes('''
+        import time as _time
+        t0 = _time.perf_counter()
+        ''') == ["VCT006"]
+    assert codes('''
+        from time import time as now, perf_counter as pc
+        a = now()
+        b = pc()
+        ''') == ["VCT006", "VCT006"]
+    # a foreign module that merely shares a clock method name is NOT time
+    assert codes('''
+        import mylib
+        t = mylib.perf_counter()
+        ''') == []
+
+
+def test_vct006_monotonic_sleep_and_nonlibrary_exempt():
+    # deadline checks and sleeps are not timing measurements
+    assert codes('''
+        import time
+        deadline = time.monotonic() + 5
+        time.sleep(0.1)
+        ''') == []
+    # only library code is in scope: bench/tools/tests own their stopwatches
+    src = '''
+        import time
+        t0 = time.perf_counter()
+        '''
+    assert codes(src, path="bench.py") == []
+    assert codes(src, path="tools/tpu_probe.py") == []
+    # the obs subsystem and trace.py ARE the timing layer
+    assert codes(src, path="variantcalling_tpu/obs/__init__.py") == []
+    assert codes(src, path="variantcalling_tpu/utils/trace.py") == []
+
+
+def test_vct006_suppression_for_sanctioned_sites():
+    # the executor's obs span timing carries a per-line suppression —
+    # the same escape hatch every checker honors
+    assert codes('''
+        import time
+        t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+        ''') == []
+
+
+# ---------------------------------------------------------------------------
 # suppression comments, syntax errors, select
 # ---------------------------------------------------------------------------
 
@@ -376,7 +445,7 @@ def test_cli_unknown_select_is_usage_error(tmp_path):
 def test_cli_list_checkers(capsys):
     assert lint_main(["--list-checkers"]) == 0
     out = capsys.readouterr().out
-    for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005"):
+    for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005", "VCT006"):
         assert code in out
 
 

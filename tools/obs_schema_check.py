@@ -1,0 +1,109 @@
+"""Tier-0 obs schema gate: generate a real obs run log and validate it
+against the COMMITTED event schema (run by run_tests.sh before pytest).
+
+The contract this guards: the schema artifact
+(``variantcalling_tpu/obs/event_schema.json``) and the event writer
+(``variantcalling_tpu/obs``) must never drift apart — an event the
+writer emits that the committed schema rejects fails the whole test run
+before pytest even starts, exactly like a lint finding. The generated
+log exercises every producer wired into the stream (manifest, trace
+spans incl. a worker thread, degradations, fault firings, metrics,
+heartbeat, run end) and the Perfetto exporter's invariants (sorted ts,
+ph/pid/tid on every trace event).
+
+Exit codes: 0 valid, 1 schema violations (printed), 2 internal error.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+
+def main() -> int:
+    from variantcalling_tpu import obs
+    from variantcalling_tpu.obs import export, schema
+    from variantcalling_tpu.utils import degrade, faults, trace
+
+    with tempfile.TemporaryDirectory(prefix="obs_schema_check_") as d:
+        path = os.path.join(d, "run.jsonl")
+        run = obs.start_run("obs_schema_check", force_path=path,
+                            argv=["--tier0"], inputs={"self": __file__})
+        if run is None:
+            print("obs_schema_check: start_run returned None", file=sys.stderr)
+            return 2
+        # one of every producer the stream unifies
+        with trace.stage("outer"):
+            with trace.stage("inner"):
+                pass
+        def _worker_span():
+            with trace.stage("worker-span"):
+                pass
+
+        worker = threading.Thread(target=_worker_span, daemon=True)
+        worker.start()
+        worker.join(timeout=10)
+        degrade.record("obs.schema_check_probe", ValueError("expected"),
+                       fallback="continue")
+        faults.arm("io.chunk_read", times=1)
+        try:
+            faults.check("io.chunk_read")
+        except OSError:
+            pass
+        finally:
+            faults.reset()
+        obs.counter("records").add(128)
+        obs.gauge("queue.stage0.depth").set(2)
+        obs.histogram("chunk.records").observe(128)
+        obs.event("heartbeat", "stream", chunks=1, records=128, vps=1000,
+                  pct=50.0, eta_s=1.0)
+        obs.event("journal", "resume_decision", outcome="fresh")
+        obs.end_run(run, "ok")
+
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        errors = schema.validate_lines(lines)
+        # the stream must actually contain every producer's kind — a
+        # silently-dropped event class would otherwise "validate"
+        import json
+
+        kinds = {json.loads(ln)["kind"] for ln in lines}
+        for required in ("manifest", "span", "degrade", "fault", "heartbeat",
+                         "journal", "metrics", "run_end"):
+            if required not in kinds:
+                errors.append(f"stream is missing a {required!r} event")
+        threads = {json.loads(ln).get("thread") for ln in lines
+                   if json.loads(ln)["kind"] == "span"}
+        if len(threads) < 2:
+            errors.append("spans from a worker thread did not land in the "
+                          f"stream (threads seen: {sorted(threads)})")
+
+        # exporter invariants (the acceptance-criteria Perfetto schema)
+        events = export.read_events(path)
+        trace_json = export.to_chrome_trace(events)
+        ts = [e["ts"] for e in trace_json["traceEvents"]]
+        if ts != sorted(ts):
+            errors.append("exported trace ts not monotonically sorted")
+        for e in trace_json["traceEvents"]:
+            missing = {"ph", "pid", "tid", "ts"} - set(e)
+            if missing:
+                errors.append(f"trace event missing {sorted(missing)}: {e}")
+                break
+        export.summarize(events)  # must not raise on a fresh log
+
+    if errors:
+        for err in errors:
+            print(f"obs_schema_check: {err}", file=sys.stderr)
+        print(f"obs_schema_check: {len(errors)} violation(s) — the writer "
+              "and variantcalling_tpu/obs/event_schema.json have drifted",
+              file=sys.stderr)
+        return 1
+    print("obs_schema_check: generated log validates against the committed "
+          f"schema (v{schema.SCHEMA_VERSION}, {len(lines)} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

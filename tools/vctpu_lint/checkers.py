@@ -23,6 +23,10 @@ _TREE_TOKENS = {"tree", "trees", "margin", "margins", "pertree"}
 #: sanctioned degradation-recorder calls (VCT002): module.attr spellings
 _DEGRADE_CALLS = {("degrade", "record")}
 
+#: library paths where ad-hoc wall-clock timing is sanctioned (VCT006):
+#: the obs subsystem and the trace module ARE the timing layer
+_TIMING_EXEMPT = ("variantcalling_tpu/obs/", "variantcalling_tpu/utils/trace.py")
+
 
 def _is_environ(node: ast.expr) -> bool:
     """True for ``os.environ`` / bare ``environ`` (any import spelling)."""
@@ -354,4 +358,77 @@ class UnboundedSubprocessChecker(Checker):
                 self.report(node, "non-daemon threading.Thread in a module "
                                   "with no .join() — a crashed parent leaks "
                                   "the worker")
+        self.generic_visit(node)
+
+
+@register
+class RawTimingChecker(Checker):
+    """VCT006 — ad-hoc wall-clock timing in library code outside the
+    obs/trace layer.
+
+    Incident class: before the obs subsystem (ISSUE 5) the tree had grown
+    four disconnected timing idioms — ``trace.py`` spans, the reference's
+    broken decorator, per-module ``time.time()`` deltas logged as free
+    text, and bench's own stopwatches. A raw ``time.time()`` /
+    ``time.perf_counter()`` measurement in library code is invisible to
+    ``vctpu obs``: it cannot land in the run stream, the summary, or the
+    Perfetto export, and it silently re-fragments the telemetry layer.
+    Wrap the region in ``trace.stage(...)`` (spans flow into obs) or
+    record through ``obs.span``/metrics; sanctioned low-level sites carry
+    a per-line suppression naming why.
+
+    Scope: ``variantcalling_tpu/`` only (the library), minus ``obs/`` and
+    ``utils/trace.py`` — which ARE the timing layer. ``time.monotonic``
+    deadline checks (watchdogs) and ``time.sleep`` are not timing and are
+    not flagged.
+    """
+
+    code = "VCT006"
+    name = "raw-timing"
+    description = ("time.time()/time.perf_counter() timing in library code "
+                   "outside obs/trace spans")
+
+    _CLOCKS = ("time", "perf_counter", "perf_counter_ns", "process_time")
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__(path, lines)
+        # any-import-spelling tracking (the VCT001 `_is_environ` rule):
+        # `import time as _time` and `from time import perf_counter as pc`
+        # must not evade the checker
+        self._time_aliases: set[str] = {"time"}
+        self._clock_names: set[str] = set()
+
+    def applies_to(self, path: str) -> bool:
+        if not path.startswith("variantcalling_tpu/"):
+            return False  # tools/tests/bench own their stopwatches
+        return not any(path.startswith(x) or path.endswith(x)
+                       for x in _TIMING_EXEMPT)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "time":
+                self._time_aliases.add(alias.asname or "time")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for alias in node.names:
+                if alias.name in self._CLOCKS:
+                    self._clock_names.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        clock = None
+        if isinstance(func, ast.Attribute) and func.attr in self._CLOCKS \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in self._time_aliases:
+            clock = f"{func.value.id}.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in self._clock_names:
+            clock = func.id  # from time import perf_counter [as pc]
+        if clock is not None:
+            self.report(node, f"raw {clock}() timing in library code — "
+                              "route it through trace.stage(...)/obs.span so "
+                              "the measurement lands in the run telemetry "
+                              "stream (docs/observability.md)")
         self.generic_visit(node)

@@ -135,6 +135,10 @@ def resolve() -> EngineDecision:
             decision = EngineDecision("jit", req, "auto: accelerator/mesh backend, "
                                       "VCTPU_NATIVE_FOREST=0, or no native library")
         logger.info("scoring engine resolved: %s (%s)", decision.name, decision.reason)
+        # NOTE: no obs event here — resolution is cached per process, so a
+        # cache-miss emission would vanish from every later run's stream.
+        # The per-run "resolve"/"engine" event is emitted by FilterContext,
+        # which pins the decision into each run.
         _RESOLVED = decision
         return decision
 
@@ -199,6 +203,11 @@ def resolve_for_run() -> EngineDecision:
             reason=f"ranks disagreed ({','.join(sorted(names))}): "
                    "pinning every rank to jit")
         logger.warning("scoring engine: %s", downgraded.reason)
+        from variantcalling_tpu import obs
+
+        if obs.active():
+            obs.event("resolve", "engine", value=downgraded.name,
+                      requested=downgraded.requested, reason=downgraded.reason)
         global _RESOLVED
         with _LOCK:
             _RESOLVED = downgraded  # the whole process follows the agreement

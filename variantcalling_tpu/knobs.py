@@ -152,6 +152,11 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "timeout for external tool subprocesses (beagle, …) — VCT005: no "
        "subprocess runs unbounded", positive=True),
     # -- diagnostics / test harness ------------------------------------
+    _k("VCTPU_OBS", "bool", False,
+       "record run telemetry (manifest + metrics + event log) to an obs "
+       "JSONL sidecar (docs/observability.md)"),
+    _k("VCTPU_OBS_PATH", "str", "",
+       "obs run-log path override; default <output_file>.obs.jsonl"),
     _k("VCTPU_TRACE", "bool", False,
        "print every closed trace span at INFO level"),
     _k("VCTPU_FAULTS", "str", "",
@@ -358,11 +363,13 @@ def run(argv: list[str]) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     if args.json:
-        import json
+        # the ONE CLI JSON-emission helper (shared with `vctpu obs
+        # summary --json`): same indent, ordering and newline contract
+        from variantcalling_tpu.utils.jsonio import emit_json
 
-        print(json.dumps({name: {"value": value, "source": src,
-                                 "help": REGISTRY[name].help}
-                          for name, value, src in rows}, indent=2))
+        emit_json({name: {"value": value, "source": src,
+                          "help": REGISTRY[name].help}
+                   for name, value, src in rows})
         return 0
     width = max(len(name) for name, _, _ in rows)
     for name, value, src in rows:

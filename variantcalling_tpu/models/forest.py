@@ -563,20 +563,26 @@ def resolve_strategy(forest: FlatForest, n_features: int | None = None,
     routing (the kernel's known gap). Trees beyond GEMM_MAX_LEAVES fall
     back to the gather walk everywhere.
     """
-    from variantcalling_tpu import knobs
+    from variantcalling_tpu import knobs, obs
 
     req = requested_strategy()
     if req != "auto":
-        return req
-    backend = backend or _backend()
-    if backend == "cpu":
-        return "gather"
-    if max_tree_leaves(forest) > GEMM_MAX_LEAVES:
-        return "gather"
-    if backend == "tpu" and knobs.get_bool("VCTPU_PALLAS") \
-            and forest.default_left is None:
-        return "pallas"
-    return "wide"
+        resolved, why = req, "explicitly requested"
+    else:
+        backend = backend or _backend()
+        if backend == "cpu":
+            resolved, why = "gather", "auto: cpu backend keeps the gather walk"
+        elif max_tree_leaves(forest) > GEMM_MAX_LEAVES:
+            resolved, why = "gather", "auto: tree leaves exceed GEMM_MAX_LEAVES"
+        elif backend == "tpu" and knobs.get_bool("VCTPU_PALLAS") \
+                and forest.default_left is None:
+            resolved, why = "pallas", "auto: tpu backend, pallas enabled"
+        else:
+            resolved, why = "wide", f"auto: {backend} backend wide-contraction"
+    if obs.active():
+        obs.event("resolve", "forest_strategy", value=resolved,
+                  requested=req, reason=why)
+    return resolved
 
 
 def _build_margin_program(strategy: str, forest: FlatForest,

@@ -1,0 +1,229 @@
+"""obs — the runtime telemetry subsystem every pipeline run writes through.
+
+The reference ``ugvc`` has essentially no observability (its one
+profiling primitive is an unused decorator that prints a negative
+duration); this repo's own stopgaps had fragmented the same way —
+``utils/trace.py`` spans, ``degrade.record`` degradations, fault-injection
+firings, journal/resume decisions and executor lifecycle each went to
+their own unstructured log lines. This package unifies them into ONE
+run-scoped, schema-versioned JSONL stream (docs/observability.md):
+
+- a **run manifest** (resolved knob registry, topology, input identity,
+  package version) opens every stream (:mod:`~variantcalling_tpu.obs.manifest`);
+- a **typed metrics registry** (counters/gauges/histograms with lock-free
+  recording from worker threads, :mod:`~variantcalling_tpu.obs.metrics`)
+  snapshots into the stream at run end;
+- **events** — trace spans, degradations, fault firings, retries,
+  journal/resume decisions, engine/strategy resolutions, heartbeats —
+  append in one globally ordered sequence (``seq``, monotonic ``ts``);
+- exporters turn any stream into a Chrome trace-event file for Perfetto
+  or a terminal roll-up (:mod:`~variantcalling_tpu.obs.export`,
+  ``vctpu obs export`` / ``vctpu obs summary``).
+
+Contract (locked by ``tests/unit/test_obs.py``):
+
+- **output-neutral**: with ``VCTPU_OBS`` on or off, every pipeline's
+  output bytes are identical — obs writes only its own sidecar;
+- **cheap when off**: every hook bottoms out in one module-bool check
+  (:func:`active`); hot-path overhead when ON stays under the 2% budget
+  (bench ``obs_overhead_pct``);
+- **one ordered stream**: events from any thread serialize through one
+  lock that also takes the timestamp, so file order, ``seq`` order and
+  ``ts`` order agree.
+
+Knobs: ``VCTPU_OBS=1`` enables recording; ``VCTPU_OBS_PATH`` overrides
+the sidecar path (default: ``<output_file>.obs.jsonl`` next to the
+pipeline output).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from variantcalling_tpu import knobs, logger
+from variantcalling_tpu.obs.metrics import NOOP, MetricsRegistry
+from variantcalling_tpu.obs.schema import SCHEMA_VERSION
+
+OBS_ENV = "VCTPU_OBS"
+OBS_PATH_ENV = "VCTPU_OBS_PATH"
+
+#: flush the stream every this many events (plus manifest and run end) —
+#: a crash loses at most one flush window, without per-event fsync cost
+FLUSH_EVERY = 32
+
+#: module fast flag — hot sites check this before doing ANY other work
+_ACTIVE = False
+_RUN: "ObsRun | None" = None
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """Is obs recording requested by the environment (``VCTPU_OBS``)?"""
+    return knobs.get_bool(OBS_ENV)
+
+
+def active() -> bool:
+    """Is a run stream currently open? The ONE check every hot-path hook
+    performs before paying any obs cost."""
+    return _ACTIVE
+
+
+class ObsRun:
+    """One open run stream: file handle, ordered event writer, metrics."""
+
+    def __init__(self, path: str, tool: str):
+        self.path = path
+        self.tool = tool
+        self.metrics = MetricsRegistry()
+        self._fh = open(path, "w", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._since_flush = 0
+        # ts is derived from ONE wall anchor plus the monotonic clock so
+        # the stream's timestamps can never move backwards (NTP steps the
+        # wall clock; perf_counter does not step)
+        self._t0_wall = time.time()
+        self._t0_mono = time.perf_counter()
+
+    def _emit(self, kind: str, name: str, fields: dict, flush: bool = False) -> None:
+        pid = os.getpid()
+        tid = threading.get_ident()
+        with self._lock:
+            # timestamped INSIDE the lock: file order == seq order == ts order
+            t = time.perf_counter() - self._t0_mono
+            event = dict(fields)  # extras first; the envelope wins on collision
+            event.update(v=SCHEMA_VERSION, seq=self._seq,
+                         ts=round(self._t0_wall + t, 6), t=round(t, 6),
+                         kind=kind, name=name, pid=pid, tid=tid)
+            self._seq += 1
+            try:
+                self._fh.write(json.dumps(event) + "\n")
+                self._since_flush += 1
+                if flush or self._since_flush >= FLUSH_EVERY:
+                    self._fh.flush()
+                    self._since_flush = 0
+            except ValueError:
+                # a straggler event racing end_run's file close: telemetry
+                # must never throw into the recording (worker) thread
+                pass
+
+    def close(self, status: str) -> None:
+        with self._lock:
+            dur = time.perf_counter() - self._t0_mono
+        self._emit("metrics", "final", self.metrics.snapshot())
+        self._emit("run_end", self.tool, {"status": status,
+                                          "dur": round(dur, 6)}, flush=True)
+        self._fh.close()
+
+
+def _rank_suffixed(path: str) -> str:
+    """Multi-rank runs must not interleave one file: rank N > 0 writes
+    ``<path>.rankN``."""
+    try:
+        import jax
+
+        rank = jax.process_index()
+    except Exception:  # noqa: BLE001 # vctpu-lint: disable=VCT002 — uninitialized backend == rank 0, recorded in the manifest topology instead
+        rank = 0
+    return f"{path}.rank{rank}" if rank else path
+
+
+def start_run(tool: str, default_path: str | None = None,
+              argv: list[str] | None = None,
+              inputs: dict[str, str] | None = None,
+              force_path: str | None = None) -> ObsRun | None:
+    """Open a run stream and emit its manifest; returns None when obs is
+    disabled or a run is already active (the caller that got the ObsRun
+    back owns :func:`end_run`; joiners just record into the open stream).
+
+    ``force_path`` bypasses the ``VCTPU_OBS`` gate — for the tier-0
+    schema check and tests that must record regardless of environment.
+    """
+    global _ACTIVE, _RUN
+    if force_path is None and not enabled():
+        return None
+    with _LOCK:
+        if _RUN is not None:
+            return None  # join the open stream, don't nest
+        path = force_path or knobs.get_str(OBS_PATH_ENV) or default_path
+        if not path:
+            return None  # nowhere to write (no output file context)
+        path = _rank_suffixed(path)
+        from variantcalling_tpu.obs.manifest import build_manifest
+
+        try:
+            run = ObsRun(path, tool)
+        except OSError as e:
+            logger.warning("obs: cannot open run log %s: %s — recording "
+                           "disabled for this run", path, e)
+            return None
+        run._emit("manifest", tool, build_manifest(tool, argv=argv,
+                                                   inputs=inputs), flush=True)
+        _RUN = run
+        _ACTIVE = True
+        logger.info("obs: recording run telemetry to %s", path)
+        return run
+
+
+def end_run(run: ObsRun | None, status: str = "ok") -> None:
+    """Close the stream opened by the matching :func:`start_run` (no-op
+    for joiners, who were handed None)."""
+    global _ACTIVE, _RUN
+    if run is None:
+        return
+    with _LOCK:
+        if _RUN is not run:
+            return
+        _ACTIVE = False
+        _RUN = None
+    try:
+        run.close(status)
+    except OSError as e:  # a full disk must not mask the run's own error
+        logger.warning("obs: failed to finalize run log %s: %s", run.path, e)
+
+
+def event(kind: str, name: str, **fields) -> None:
+    """Append one event to the open stream (no-op when inactive).
+
+    ``fields`` must be JSON-serializable; keep them small — this is a
+    telemetry stream, not a data channel."""
+    if not _ACTIVE:
+        return
+    run = _RUN
+    if run is not None:
+        run._emit(kind, name, fields)
+
+
+def span(name: str, dur: float, thread: str, depth: int = 0, **fields) -> None:
+    """Record one closed wall-clock span (called by ``utils.trace`` and
+    the stage executor). ``dur`` in seconds."""
+    if not _ACTIVE:
+        return
+    run = _RUN
+    if run is not None:
+        run._emit("span", name, dict(fields, dur=round(dur, 6),
+                                     thread=thread, depth=depth))
+
+
+def counter(name: str):
+    """The named counter of the open run, or a shared no-op."""
+    run = _RUN if _ACTIVE else None
+    return run.metrics.counter(name) if run is not None else NOOP
+
+
+def gauge(name: str):
+    run = _RUN if _ACTIVE else None
+    return run.metrics.gauge(name) if run is not None else NOOP
+
+
+def histogram(name: str):
+    run = _RUN if _ACTIVE else None
+    return run.metrics.histogram(name) if run is not None else NOOP
+
+
+def current() -> ObsRun | None:
+    """The open run (tests/manifest introspection)."""
+    return _RUN

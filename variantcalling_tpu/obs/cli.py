@@ -1,0 +1,77 @@
+"""CLI: ``vctpu obs <export|summary>`` — open any obs run log in
+Perfetto, or roll it up in the terminal.
+
+Exit codes follow the repo-wide CLI contract: 0 success, 2 usage error /
+unreadable or malformed log (argparse's own usage failures also exit 2).
+Covered by ``tests/unit/test_obs.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from variantcalling_tpu.obs import export as export_mod
+from variantcalling_tpu.utils.jsonio import emit_json
+
+
+def get_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="vctpu obs",
+        description="inspect/export obs run telemetry (docs/observability.md)")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    exp = sub.add_parser("export",
+                         help="convert a run log to a Perfetto-loadable "
+                              "Chrome trace-event file")
+    exp.add_argument("log", help="obs run log (JSONL)")
+    exp.add_argument("--format", default="perfetto", choices=["perfetto"],
+                     help="output format (perfetto == Chrome trace events)")
+    exp.add_argument("-o", "--output", default=None,
+                     help="output path (default: <log>.trace.json)")
+
+    summ = sub.add_parser("summary",
+                          help="terminal roll-up: per-stage time, throughput, "
+                               "degradations, slowest chunks")
+    summ.add_argument("log", help="obs run log (JSONL)")
+    summ.add_argument("--json", action="store_true",
+                      help="emit the summary as JSON")
+    return ap
+
+
+def _load(path: str) -> list[dict]:
+    return export_mod.read_events(path)
+
+
+def run(argv: list[str]) -> int:
+    args = get_parser().parse_args(argv)
+    try:
+        events = _load(args.log)
+    except (OSError, export_mod.ObsLogError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.command == "export":
+        out_path = args.output or f"{args.log}.trace.json"
+        trace = export_mod.to_chrome_trace(events)
+        try:
+            import json
+
+            with open(out_path, "w", encoding="utf-8") as fh:
+                json.dump(trace, fh)  # compact: trace files get big
+                fh.write("\n")
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {out_path}: {len(trace['traceEvents'])} trace events "
+              "(open in https://ui.perfetto.dev)")
+        return 0
+    summary = export_mod.summarize(events)
+    if args.json:
+        emit_json(summary)
+    else:
+        print(export_mod.render_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

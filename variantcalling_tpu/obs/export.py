@@ -1,0 +1,210 @@
+"""Exporters: obs JSONL -> Chrome trace-event JSON (Perfetto) or a
+terminal summary.
+
+The Chrome trace-event format (the ``traceEvents`` array Perfetto and
+``chrome://tracing`` both load) is the lingua franca of the JAX stack's
+profiling UIs — ``jax.profiler`` device traces land in the same viewer —
+so exporting the host-side obs stream there puts pipeline stages, chunk
+spans, degradations and fault firings on the SAME timeline a device
+trace uses (open both in one Perfetto session via "Open trace file").
+
+Mapping (validated by ``tests/unit/test_obs.py`` and the tier-0 schema
+stage):
+
+- ``span``       -> ``ph: "X"`` complete events (``ts`` = span start in
+  µs since run start, ``dur`` = µs), one track per recording thread;
+- ``degrade`` / ``fault`` / ``retry`` / ``journal`` / ``resolve`` /
+  ``stage`` -> ``ph: "i"`` instant events (thread scope);
+- ``heartbeat``  -> ``ph: "C"`` counter tracks (records, chunks, vps);
+- manifest/tool  -> ``ph: "M"`` process/thread name metadata.
+
+Every emitted event carries ``pid``/``tid``/``ph``/``ts``; the list is
+sorted by ``ts`` so consumers that stream it see a monotonically
+consistent timeline.
+"""
+
+from __future__ import annotations
+
+import json
+
+from variantcalling_tpu.obs.schema import SCHEMA_VERSION
+
+#: event kinds rendered as instant markers on their thread's track
+_INSTANT_KINDS = ("degrade", "fault", "retry", "journal", "resolve", "stage")
+
+#: envelope fields not repeated into a trace event's args
+_ENVELOPE = ("v", "seq", "ts", "t", "kind", "name", "pid", "tid")
+
+
+class ObsLogError(ValueError):
+    """The file is not a readable obs run log."""
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse one obs JSONL log; raises :class:`ObsLogError` on garbage
+    (missing file surfaces as OSError for the CLI to map to exit 2)."""
+    events: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except ValueError as e:
+                raise ObsLogError(f"{path}:{i}: not JSON: {e}") from None
+            if not isinstance(event, dict) or "kind" not in event:
+                raise ObsLogError(f"{path}:{i}: not an obs event")
+            events.append(event)
+    if not events:
+        raise ObsLogError(f"{path}: empty obs log")
+    version = events[0].get("v")
+    if version != SCHEMA_VERSION:
+        raise ObsLogError(f"{path}: schema version {version!r} != "
+                          f"{SCHEMA_VERSION} (regenerate or upgrade)")
+    return events
+
+
+def _args_of(event: dict) -> dict:
+    return {k: v for k, v in event.items() if k not in _ENVELOPE}
+
+
+def to_chrome_trace(events: list[dict]) -> dict:
+    """The ``{"traceEvents": [...]}`` object Perfetto loads."""
+    trace: list[dict] = []
+    manifest = next((e for e in events if e.get("kind") == "manifest"), None)
+    pids = {e.get("pid", 0) for e in events}
+    tool = (manifest or {}).get("tool", "vctpu")
+    threads: dict[tuple, str] = {}
+    for e in events:
+        key = (e.get("pid", 0), e.get("tid", 0))
+        name = e.get("thread") if e.get("kind") == "span" else None
+        if key not in threads or (name and threads[key] == "thread"):
+            threads[key] = name or "thread"
+    for pid in sorted(pids):
+        trace.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                      "ts": 0, "args": {"name": tool}})
+    for (pid, tid), name in sorted(threads.items()):
+        trace.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                      "ts": 0, "args": {"name": name}})
+
+    for e in events:
+        kind = e.get("kind")
+        pid, tid = e.get("pid", 0), e.get("tid", 0)
+        t_us = float(e.get("t", 0.0)) * 1e6
+        if kind == "span":
+            dur_us = float(e.get("dur", 0.0)) * 1e6
+            trace.append({"name": e.get("name", "span"), "ph": "X", "cat": "span",
+                          "ts": max(0.0, t_us - dur_us), "dur": dur_us,
+                          "pid": pid, "tid": tid, "args": _args_of(e)})
+        elif kind in _INSTANT_KINDS:
+            trace.append({"name": f"{kind}:{e.get('name', '')}", "ph": "i",
+                          "cat": kind, "s": "t", "ts": t_us,
+                          "pid": pid, "tid": tid, "args": _args_of(e)})
+        elif kind == "heartbeat":
+            for track in ("records", "chunks", "vps"):
+                if track in e:
+                    trace.append({"name": track, "ph": "C", "ts": t_us,
+                                  "pid": pid, "tid": tid,
+                                  "args": {track: e[track]}})
+    trace.sort(key=lambda ev: (ev["ts"], 0 if ev["ph"] == "M" else 1))
+    return {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "otherData": {"tool": tool, "schema_version": SCHEMA_VERSION,
+                      "source": "variantcalling_tpu obs"},
+    }
+
+
+def summarize(events: list[dict]) -> dict:
+    """Terminal roll-up: per-stage time, throughput, degradations,
+    slowest chunks, final metrics."""
+    manifest = next((e for e in events if e.get("kind") == "manifest"), None)
+    run_end = next((e for e in reversed(events)
+                    if e.get("kind") == "run_end"), None)
+    metrics = next((e for e in reversed(events)
+                    if e.get("kind") == "metrics"), None)
+
+    stages: dict[str, dict] = {}
+    chunk_spans: list[dict] = []
+    for e in events:
+        if e.get("kind") != "span":
+            continue
+        name = e.get("name", "span")
+        dur = float(e.get("dur", 0.0))
+        s = stages.setdefault(name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        s["count"] += 1
+        s["total_s"] += dur
+        s["max_s"] = max(s["max_s"], dur)
+        if "chunk" in e:
+            chunk_spans.append(e)
+    for s in stages.values():
+        s["total_s"] = round(s["total_s"], 6)
+        s["mean_s"] = round(s["total_s"] / s["count"], 6)
+        s["max_s"] = round(s["max_s"], 6)
+
+    degradations: dict[str, int] = {}
+    faults: dict[str, int] = {}
+    for e in events:
+        if e.get("kind") == "degrade":
+            degradations[e.get("name", "?")] = \
+                degradations.get(e.get("name", "?"), 0) + 1
+        elif e.get("kind") == "fault":
+            faults[e.get("name", "?")] = faults.get(e.get("name", "?"), 0) + 1
+
+    slowest = sorted(chunk_spans, key=lambda e: -float(e.get("dur", 0.0)))[:5]
+    heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
+    records = heartbeats[-1].get("records") if heartbeats else None
+    dur = float(run_end.get("dur", 0.0)) if run_end else None
+
+    return {
+        "run": {
+            "tool": (manifest or {}).get("tool"),
+            "version": (manifest or {}).get("version"),
+            "status": run_end.get("status") if run_end else "incomplete",
+            "duration_s": round(dur, 3) if dur is not None else None,
+            "events": len(events),
+        },
+        "stages": dict(sorted(stages.items())),
+        "throughput": {
+            "records": records,
+            "records_per_s": round(records / dur) if records and dur else None,
+        },
+        "degradations": degradations,
+        "faults": faults,
+        "slowest_chunks": [{"name": e.get("name"), "chunk": e.get("chunk"),
+                            "dur_s": round(float(e.get("dur", 0.0)), 6)}
+                           for e in slowest],
+        "metrics": _args_of(metrics) if metrics else {},
+    }
+
+
+def render_summary(summary: dict) -> str:
+    """Human-readable roll-up (``vctpu obs summary`` without ``--json``)."""
+    run = summary["run"]
+    lines = [f"run: {run.get('tool')} v{run.get('version')} — "
+             f"{run.get('status')} in {run.get('duration_s')}s "
+             f"({run.get('events')} events)"]
+    if summary["stages"]:
+        lines.append("stages (total / mean / max seconds):")
+        width = max(len(n) for n in summary["stages"])
+        for name, s in summary["stages"].items():
+            lines.append(f"  {name:<{width}}  x{s['count']:<5} "
+                         f"{s['total_s']:>9.3f} {s['mean_s']:>9.4f} "
+                         f"{s['max_s']:>9.4f}")
+    tp = summary["throughput"]
+    if tp.get("records"):
+        lines.append(f"throughput: {tp['records']} records"
+                     + (f" ({tp['records_per_s']}/s)"
+                        if tp.get("records_per_s") else ""))
+    if summary["degradations"]:
+        lines.append("degradations: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(summary["degradations"].items())))
+    if summary["faults"]:
+        lines.append("injected faults: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(summary["faults"].items())))
+    if summary["slowest_chunks"]:
+        lines.append("slowest chunks: " + ", ".join(
+            f"{c['name']}#{c['chunk']} {c['dur_s']:.3f}s"
+            for c in summary["slowest_chunks"]))
+    return "\n".join(lines)

@@ -1,0 +1,80 @@
+"""Run manifest: everything needed to reproduce/attribute a run, emitted
+as the FIRST event of every obs stream.
+
+The resume journal taught the shape (io/journal.py meta): a telemetry
+stream whose header does not pin the configuration that produced it is
+unattributable after the fact. The manifest records:
+
+- the resolved **knob registry** — every ``VCTPU_*`` knob's typed value
+  and whether it came from the environment or the declared default
+  (``knobs.resolved()``; malformed knobs raised before obs started);
+- **topology** — backend, device/process counts, rank, hostname, cpu
+  count — the mesh context multi-chip diagnosis needs;
+- **input identity** — path, size, mtime_ns per labeled input (same
+  signature the chunk journal binds to);
+- the package **version** and the tool's argv.
+
+Engine and forest-strategy decisions are NOT here: they resolve after
+run start and land as ``resolve`` events in the stream, so the manifest
+never claims a decision that was actually made later.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+from variantcalling_tpu import __version__, knobs
+
+
+def _topology() -> dict:
+    topo: dict = {
+        "hostname": socket.gethostname(),
+        "cpu_count": os.cpu_count() or 1,
+    }
+    try:
+        import jax
+
+        topo.update(
+            backend=jax.default_backend(),
+            devices=len(jax.devices()),
+            local_devices=len(jax.local_devices()),
+            process_count=jax.process_count(),
+            process_index=jax.process_index(),
+        )
+    except Exception as e:  # noqa: BLE001 — an uninitialized backend must not kill telemetry
+        from variantcalling_tpu.utils import degrade
+
+        degrade.record("obs.topology_probe", e,
+                       fallback="manifest topology omits jax fields")
+    return topo
+
+
+def _input_identity(inputs: dict[str, str] | None) -> dict:
+    out: dict = {}
+    for label, path in (inputs or {}).items():
+        entry: dict = {"path": os.path.abspath(path)}
+        try:
+            st = os.stat(path)
+            entry.update(size=int(st.st_size), mtime_ns=int(st.st_mtime_ns))
+        except OSError:
+            entry["missing"] = True
+        out[label] = entry
+    return out
+
+
+def build_manifest(tool: str, argv: list[str] | None = None,
+                   inputs: dict[str, str] | None = None) -> dict:
+    """The manifest event body (the envelope is added by the writer)."""
+    return {
+        "tool": tool,
+        "version": __version__,
+        "argv": list(argv) if argv is not None else None,
+        "knobs": {name: {"value": value if isinstance(
+                             value, (bool, int, float, str, type(None)))
+                         else str(value),
+                         "source": src}
+                  for name, value, src in knobs.resolved()},
+        "topology": _topology(),
+        "inputs": _input_identity(inputs),
+    }

@@ -1,0 +1,198 @@
+"""Typed metrics registry: counters, gauges, and small histograms.
+
+Recording happens on the streaming executor's worker threads while the
+hot path is scoring millions of variants, so the design rule is the same
+as :mod:`variantcalling_tpu.utils.faults`: **near-zero cost, no shared
+lock on the record path**.
+
+- :class:`Counter` keeps one cell per recording thread (dict item
+  assignment is atomic under the GIL) and sums the cells at snapshot
+  time — increments are lock-free and never lost to a read-modify-write
+  race between threads.
+- :class:`Gauge` is a single atomic assignment, with a monotonic
+  ``peak`` kept per thread the same way counters are.
+- :class:`Histogram` tracks count/sum/min/max plus a bounded ring of
+  recent samples (the "time series" view: enough to see per-chunk
+  variants/sec drift without unbounded memory). Observations are
+  per-thread merged at snapshot, like counters.
+
+A registry belongs to one obs run; ``snapshot()`` is called once at run
+end (and by ``vctpu obs summary`` via the emitted ``metrics`` event), so
+snapshot-side merging can afford to walk the per-thread cells.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: recent-sample ring size per histogram per thread (the merged snapshot
+#: interleaves threads; 64 per thread bounds memory at any fan-out)
+RECENT = 64
+
+
+class Counter:
+    """Monotonic counter; ``add`` is lock-free (per-thread cells)."""
+
+    __slots__ = ("name", "_cells")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cells: dict[int, float] = {}
+
+    def add(self, n: float = 1) -> None:
+        tid = threading.get_ident()
+        cells = self._cells
+        cells[tid] = cells.get(tid, 0) + n
+
+    @property
+    def value(self) -> float:
+        return sum(self._cells.values())
+
+    def snapshot(self):
+        v = self.value
+        return int(v) if float(v).is_integer() else v
+
+
+class Gauge:
+    """Last-write-wins value plus the per-run peak."""
+
+    __slots__ = ("name", "value", "_peaks")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: float = 0
+        self._peaks: dict[int, float] = {}
+
+    def set(self, v: float) -> None:
+        self.value = v
+        tid = threading.get_ident()
+        peaks = self._peaks
+        prev = peaks.get(tid)
+        if prev is None or v > prev:
+            peaks[tid] = v
+
+    @property
+    def peak(self) -> float:
+        return max(self._peaks.values(), default=0)
+
+    def snapshot(self) -> dict:
+        def num(v):
+            return int(v) if float(v).is_integer() else v
+
+        return {"value": num(self.value), "peak": num(self.peak)}
+
+
+class _HistCell:
+    __slots__ = ("count", "total", "vmin", "vmax", "recent")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin: float | None = None
+        self.vmax: float | None = None
+        self.recent: list[float] = []
+
+
+class Histogram:
+    """count/sum/min/max + a bounded recent-sample ring, per thread."""
+
+    __slots__ = ("name", "_cells")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cells: dict[int, _HistCell] = {}
+
+    def observe(self, v: float) -> None:
+        tid = threading.get_ident()
+        cell = self._cells.get(tid)
+        if cell is None:
+            # dict item assignment is atomic; each thread only writes its
+            # own key, so concurrent first-observations cannot clobber
+            self._cells[tid] = cell = _HistCell()
+        cell.count += 1
+        cell.total += v
+        if cell.vmin is None or v < cell.vmin:
+            cell.vmin = v
+        if cell.vmax is None or v > cell.vmax:
+            cell.vmax = v
+        cell.recent.append(v)
+        if len(cell.recent) > RECENT:
+            del cell.recent[0]
+
+    def snapshot(self) -> dict:
+        cells = list(self._cells.values())
+        count = sum(c.count for c in cells)
+        total = sum(c.total for c in cells)
+        mins = [c.vmin for c in cells if c.vmin is not None]
+        maxs = [c.vmax for c in cells if c.vmax is not None]
+        recent: list[float] = []
+        for c in cells:
+            recent.extend(c.recent)
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "mean": round(total / count, 6) if count else 0,
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+            "recent": [round(v, 6) for v in recent[-RECENT:]],
+        }
+
+
+class _Noop:
+    """Shared do-nothing metric for the obs-disabled fast path — callers
+    can record unconditionally without branching on ``obs.active()``."""
+
+    __slots__ = ()
+    name = "noop"
+    value = 0
+    peak = 0
+
+    def add(self, n: float = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+NOOP = _Noop()
+
+
+class MetricsRegistry:
+    """One run's named metrics. Creation takes a lock (rare); recording
+    through the returned objects does not (hot)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._hists: dict[str, Histogram] = {}
+
+    def _get(self, table: dict, name: str, cls):
+        metric = table.get(name)
+        if metric is None:
+            with self._lock:
+                metric = table.setdefault(name, cls(name))
+        return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._get(self._counters, name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(self._gauges, name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(self._hists, name, Histogram)
+
+    def snapshot(self) -> dict:
+        """{counters, gauges, histograms} — the ``metrics`` event body."""
+        return {
+            "counters": {n: c.snapshot()
+                         for n, c in sorted(self._counters.items())},
+            "gauges": {n: g.snapshot()
+                       for n, g in sorted(self._gauges.items())},
+            "histograms": {n: h.snapshot()
+                           for n, h in sorted(self._hists.items())},
+        }

@@ -122,14 +122,20 @@ def allgather_concat(local: np.ndarray) -> np.ndarray:
         return local
     from jax.experimental import multihost_utils
 
-    raw = local.view(np.uint8).reshape(-1) if local.size else np.zeros(0, np.uint8)
-    lengths = np.asarray(multihost_utils.process_allgather(
-        np.asarray([len(raw)], dtype=np.int32))).reshape(-1)
-    m = int(lengths.max())
-    padded = np.pad(raw, (0, m - len(raw)))
-    gathered = np.asarray(multihost_utils.process_allgather(padded))
-    blob = b"".join(gathered[p, : int(lengths[p])].tobytes() for p in range(len(lengths)))
-    return np.frombuffer(blob, dtype=local.dtype)
+    from variantcalling_tpu.utils.trace import stage
+
+    # collective timing: a straggling rank shows up as a long span here
+    # on every OTHER rank (the gather synchronizes), so the obs streams
+    # localize multi-host skew without a pod-level profiler
+    with stage("dist.allgather_concat"):
+        raw = local.view(np.uint8).reshape(-1) if local.size else np.zeros(0, np.uint8)
+        lengths = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(raw)], dtype=np.int32))).reshape(-1)
+        m = int(lengths.max())
+        padded = np.pad(raw, (0, m - len(raw)))
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        blob = b"".join(gathered[p, : int(lengths[p])].tobytes() for p in range(len(lengths)))
+        return np.frombuffer(blob, dtype=local.dtype)
 
 
 def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = None) -> np.ndarray:
@@ -145,6 +151,8 @@ def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = 
     device holds the same-shape block and zeros are invisible to the
     sum); each host returns the full cohort tensor.
     """
+    from variantcalling_tpu.utils.trace import stage
+
     mesh = mesh or global_mesh(n_model=1)
     local_counts = np.asarray(local_counts)
     n_local_dev = len(jax.local_devices())
@@ -166,6 +174,7 @@ def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = 
         return jax.lax.with_sharding_constraint(
             x.sum(axis=0, dtype=jnp.float32), NamedSharding(mesh, P(None, None)))
 
-    with mesh:
-        out = reduce(arr)
-    return replicated_to_host(out)
+    with stage("dist.aggregate_counts_psum"):
+        with mesh:
+            out = reduce(arr)
+        return replicated_to_host(out)

@@ -27,15 +27,28 @@ from variantcalling_tpu.parallel.mesh import DATA_AXIS, pad_to_multiple
 
 
 def halo_exchange_1d(block: jnp.ndarray, halo_left: int, halo_right: int,
-                     axis_name: str = DATA_AXIS, fill=0) -> jnp.ndarray:
+                     axis_name: str = DATA_AXIS, fill=0,
+                     n_shards: int | None = None) -> jnp.ndarray:
     """Pad a shard's local block with its neighbors' edges (traceable,
     call inside a shard_map body).
 
     Boundary shards (no neighbor on that side) read ``fill``. ppermute
     delivers zeros to devices with no source, so non-zero fills overwrite
     by shard index.
+
+    ``n_shards`` must be the STATIC mesh-axis size (the ppermute
+    permutation is a Python list, not a traced value). Callers that know
+    their mesh pass it explicitly — ``jax.lax.axis_size`` only exists on
+    newer jax releases (0.4.37 lacks it), and a ``psum(1)`` substitute
+    would be traced, so the explicit parameter is the portable spelling.
     """
-    n_shards = jax.lax.axis_size(axis_name)
+    if n_shards is None:
+        axis_size = getattr(jax.lax, "axis_size", None)
+        if axis_size is None:
+            raise TypeError(
+                "halo_exchange_1d needs n_shards= on this jax version "
+                "(jax.lax.axis_size is unavailable); pass the mesh axis size")
+        n_shards = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     parts = [block]
     if halo_left:
@@ -87,7 +100,7 @@ def sharded_run_lengths(codes: np.ndarray, mesh: Mesh, halo: int = 256,
             "single-device scan for short sequences")
 
     def body(local):
-        ext = halo_exchange_1d(local, 1, halo, fill=fill)
+        ext = halo_exchange_1d(local, 1, halo, fill=fill, n_shards=n_dp)
         starts = rops.run_starts(ext)[1:-halo] if halo else rops.run_starts(ext)[1:]
         lengths = rops.run_lengths(ext)[1:-halo] if halo else rops.run_lengths(ext)[1:]
         return starts, lengths

@@ -45,7 +45,7 @@ import threading
 import time
 from collections.abc import Callable, Iterable, Iterator
 
-from variantcalling_tpu import knobs, logger
+from variantcalling_tpu import knobs, logger, obs
 from variantcalling_tpu.utils import faults
 
 _SENTINEL = object()
@@ -106,6 +106,10 @@ def retry_transient(fn: Callable, what: str, attempts: int | None = None,
             if k + 1 >= attempts:
                 break
             delay = backoff_s * (2 ** k)
+            if obs.active():
+                obs.event("retry", what, attempt=k + 1, attempts=attempts,
+                          error=f"{type(e).__name__}: {e}")
+                obs.counter("io.retries").add(1)
             logger.warning("transient error in %s (attempt %d/%d): %s — retrying in %.2fs",
                            what, k + 1, attempts, e, delay)
             if delay:
@@ -142,19 +146,46 @@ class StagePipeline:
 
     # -- serial path -------------------------------------------------------
 
+    def _stage_name(self, i: int) -> str:
+        return getattr(self.stages[i], "__name__", None) or f"stage{i}"
+
     def _run_serial(self, source: Iterable) -> Iterator:
-        for item in source:
+        for seq, item in enumerate(source):
             faults.check("pipeline.stage")
             faults.check("pipeline.stage_hang")
-            for fn in self.stages:
-                item = fn(item)
+            for i, fn in enumerate(self.stages):
+                if obs.active():
+                    t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+                    item = fn(item)
+                    obs.span(self._stage_name(i), time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs span timing
+                             threading.current_thread().name, chunk=seq)
+                else:
+                    item = fn(item)
             yield item
 
     # -- threaded path -----------------------------------------------------
 
     def run(self, source: Iterable) -> Iterator:
+        if obs.active():
+            obs.event("stage", "pipeline_start",
+                      stages=[self._stage_name(i) for i in range(len(self.stages))],
+                      threads=self.threads, queue_depth=self.queue_depth,
+                      mode="threaded" if self.parallel else "serial",
+                      # the serial loop runs no watchdog — report 0 so the
+                      # stream never claims a deadline that cannot fire
+                      watchdog_s=self.timeout if self.parallel else 0)
+            if self.timeout and self.parallel:
+                obs.counter("watchdog.armed").add(1)
         if not self.parallel:
-            yield from self._run_serial(source)
+            done = 0
+            try:
+                for item in self._run_serial(source):
+                    done += 1
+                    yield item
+            finally:
+                if obs.active():  # lifecycle closes on EVERY exit path
+                    obs.event("stage", "pipeline_end", chunks=done,
+                              unjoined=[])
             return
 
         stop = threading.Event()
@@ -209,7 +240,16 @@ class StagePipeline:
                         # proven against these (tests/unit/test_streaming_faults.py)
                         faults.check("pipeline.stage")
                         faults.check("pipeline.stage_hang")
-                        out = fn(item)
+                        if obs.active():
+                            t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+                            out = fn(item)
+                            obs.span(self._stage_name(i), time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs span timing
+                                     threading.current_thread().name, chunk=seq)
+                            # queue pressure AFTER this stage produced:
+                            # depth ~= items waiting for the next stage
+                            obs.gauge(f"queue.stage{i}.depth").set(q_out.qsize())
+                        else:
+                            out = fn(item)
                     finally:
                         busy_since[i] = None
                     _put(q_out, (seq, out))
@@ -236,7 +276,11 @@ class StagePipeline:
                         # a failed stage may have died before relaying
                         raise RuntimeError("stage pipeline cancelled")
                     if self.timeout and time.monotonic() - last_progress > self.timeout:
-                        raise StageTimeoutError(self._watchdog_message(busy_since, workers))
+                        msg = self._watchdog_message(busy_since, workers)
+                        if obs.active():
+                            obs.event("stage", "watchdog_fire", detail=msg)
+                            obs.counter("watchdog.fired").add(1)
+                        raise StageTimeoutError(msg)
                     continue
                 last_progress = time.monotonic()
                 if got is _SENTINEL:
@@ -270,6 +314,9 @@ class StagePipeline:
                 # silence here would hide a leak.
                 logger.warning("stage pipeline: %d worker(s) did not join: %s",
                                len(self.unjoined), ", ".join(self.unjoined))
+            if obs.active():
+                obs.event("stage", "pipeline_end", chunks=expect,
+                          unjoined=list(self.unjoined))
 
     def _watchdog_message(self, busy_since: list[float | None],
                           workers: list[threading.Thread]) -> str:

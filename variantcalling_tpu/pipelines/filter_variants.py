@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from variantcalling_tpu import engine as engine_mod
-from variantcalling_tpu import knobs, logger
+from variantcalling_tpu import knobs, logger, obs
 from variantcalling_tpu.engine import EngineError
 from variantcalling_tpu.utils import degrade
 from variantcalling_tpu.featurize import host_featurize
@@ -566,6 +566,12 @@ class FilterContext:
             eng = replace(eng, name="jit",
                           reason=f"{type(model).__name__} has no native scorer")
         self.engine = eng
+        # per-RUN resolution event: engine.resolve() caches per process,
+        # so emitting here (where the run pins its engine) is the only way
+        # every run's stream records the decision that scored it
+        if obs.active():
+            obs.event("resolve", "engine", value=eng.name,
+                      requested=eng.requested, reason=eng.reason)
         # the run-level FOREST STRATEGY (VCTPU_FOREST_STRATEGY): resolved
         # once here, recorded next to ##vctpu_engine= in the output header
         # and in the chunk-journal resume identity, then PINNED into every
@@ -579,6 +585,10 @@ class FilterContext:
         forest_mod.validate_strategy_env()
         if eng.name == "native":
             self.forest_strategy = "native-cpp"
+            if obs.active():
+                obs.event("resolve", "forest_strategy", value="native-cpp",
+                          requested="-", reason="native engine: C++ walk, no "
+                          "XLA strategy")
         elif isinstance(model, FlatForest):
             self.forest_strategy = forest_mod.resolve_strategy(model)
         else:
@@ -856,6 +866,30 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
     Returns a stats dict, or None when ineligible (caller runs serial).
     """
+    if not streaming_eligible(args.limit_to_contig):
+        return None
+
+    # telemetry: callers that came through run() already opened the obs
+    # run (start_run returns None and events just join it); direct
+    # callers (bench legs, tests) get their own stream here
+    inputs = {"input": args.input_file}
+    if getattr(args, "model_file", None):
+        inputs["model"] = args.model_file
+    obs_run = obs.start_run("filter_variants_pipeline",
+                            default_path=str(args.output_file) + ".obs.jsonl",
+                            inputs=inputs)
+    try:
+        stats = _run_streaming_impl(args, model, fasta, annotate, blacklist,
+                                    engine=engine)
+    except BaseException as e:
+        obs.end_run(obs_run, f"error: {type(e).__name__}")
+        raise
+    obs.end_run(obs_run, "ok")
+    return stats
+
+
+def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
+                        engine: engine_mod.EngineDecision | None = None) -> dict:
     import threading
     import zlib
 
@@ -863,9 +897,6 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
     from variantcalling_tpu.io.vcf import (VcfChunkReader, assemble_table_bytes,
                                            render_table_bytes_python)
     from variantcalling_tpu.parallel.pipeline import StagePipeline
-
-    if not streaming_eligible(args.limit_to_contig):
-        return None
 
     reader = VcfChunkReader(args.input_file)
     header = reader.header
@@ -959,6 +990,10 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
         journal_mod.discard(out_path)  # stale leftovers from older runs
         sink = BgzfWriter(part_path)
+        if obs.active():
+            obs.event("journal", "resume_decision", outcome="disabled",
+                      reason="gz output: BGZF block state does not survive "
+                             "a kill")
     elif resume is not None:
         n_chunks = resume.chunks
         n_total = resume.n_records
@@ -969,16 +1004,39 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
         journal.reopen()
         logger.info("streaming resume: %d chunks (%d records) already committed",
                     resume.chunks, resume.n_records)
+        if obs.active():
+            obs.event("journal", "resume_decision", outcome="resumed",
+                      chunks=resume.chunks, records=resume.n_records,
+                      watermark=resume.watermark)
     else:
         journal_mod.discard(out_path)
         sink = open(part_path, "wb")
         if resume_enabled:
             journal = journal_mod.ChunkJournal(out_path)
             journal.begin(meta)
+        if obs.active():
+            obs.event("journal", "resume_decision",
+                      outcome="fresh" if resume_enabled else "opted_out",
+                      journaling=resume_enabled)
 
     pipe = StagePipeline([score_stage, render_stage], queue_depth=2)
     gen = pipe.run(iter(reader))
     ok = False
+    # heartbeat bookkeeping (obs only). Progress (pct) counts ALL
+    # committed chunks incl. resumed ones; rate (vps) and ETA use only
+    # THIS session's work over this session's elapsed time, so a resumed
+    # run neither inflates its rate nor stalls its ETA. Chunk boundaries
+    # are a pure function of (input bytes, chunk_bytes) — but only for
+    # PLAIN-TEXT inputs: a .gz reader consumes chunk_bytes of
+    # decompressed text while getsize() is compressed, so gz runs emit
+    # heartbeats without pct/eta rather than a clamped-to-100 lie.
+    import time as _time
+
+    input_bytes = os.path.getsize(args.input_file)
+    bytes_comparable = not args.input_file.endswith(".gz")
+    resumed_chunks = n_chunks
+    resumed_records = n_total
+    t_start = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs heartbeat timing
     try:
         with sink:
             if resume is None:
@@ -989,6 +1047,25 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
                 n_total += k
                 n_pass += p
                 n_chunks += 1
+                if obs.active():
+                    obs.counter("records").add(k)
+                    obs.counter("records_pass").add(p)
+                    obs.histogram("chunk.records").observe(k)
+                    elapsed = _time.perf_counter() - t_start  # vctpu-lint: disable=VCT006 — obs heartbeat timing
+                    hb = {"chunks": n_chunks, "records": n_total,
+                          "records_pass": n_pass,
+                          "vps": round((n_total - resumed_records) / elapsed)
+                          if elapsed > 0 else 0}
+                    if bytes_comparable:
+                        done = min(n_chunks * reader.chunk_bytes, input_bytes)
+                        session_done = min(
+                            (n_chunks - resumed_chunks) * reader.chunk_bytes,
+                            input_bytes)
+                        hb["pct"] = round(100.0 * done / input_bytes, 2)
+                        hb["eta_s"] = round(
+                            elapsed * (input_bytes - done) / session_done, 2) \
+                            if 0 < session_done and done < input_bytes else 0.0
+                    obs.event("heartbeat", "stream", **hb)
                 if journal is not None:
                     # the journal must never claim bytes still sitting in
                     # the Python write buffer — a SIGKILL would then leave
@@ -1022,10 +1099,14 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
                 logger.info("streaming run failed after %d chunks; partial "
                             "output + journal kept for resume at %s",
                             n_chunks, part_path)
+                if obs.active():
+                    obs.event("journal", "kept_for_resume", chunks=n_chunks)
 
     if journal is not None:
         journal.finish()
     os.replace(part_path, out_path)  # atomic commit
+    if obs.active():
+        obs.event("journal", "committed", chunks=n_chunks, records=n_total)
     if gz:
         from variantcalling_tpu.io.tabix import build_tabix_index
 
@@ -1044,6 +1125,39 @@ def run(argv: list[str]) -> int:
     if args.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    # whole-registry knob validation FIRST (docs/static_analysis.md): any
+    # malformed VCTPU_* value exits 2 here with a clear message, uniformly
+    # across engines and forest strategies, before any ingest or scoring
+    # work starts — and before obs opens a run stream (a run that cannot
+    # start leaves no half-written telemetry)
+    try:
+        knobs.validate_all()
+    except EngineError as e:
+        logger.error("%s", e)
+        return 2
+
+    # the run manifest opens the telemetry stream (VCTPU_OBS=1): resolved
+    # knobs, topology, input identity, argv — then every span/degradation/
+    # resolution/heartbeat of this run lands in the same ordered JSONL
+    # (docs/observability.md). Output bytes are identical either way.
+    obs_run = obs.start_run(
+        "filter_variants_pipeline",
+        default_path=str(args.output_file) + ".obs.jsonl", argv=argv,
+        inputs={"input": args.input_file, "model": args.model_file,
+                "reference": args.reference_file})
+    status = "error"
+    try:
+        rc = _run_impl(args)
+        status = "ok" if rc == 0 else f"exit {rc}"
+        return rc
+    except BaseException as e:
+        status = f"error: {type(e).__name__}"
+        raise
+    finally:
+        obs.end_run(obs_run, status)
+
+
+def _run_impl(args) -> int:
     from variantcalling_tpu.utils.trace import report, stage
 
     # resolve the scoring engine ONCE, up front (engine contract,
@@ -1053,11 +1167,6 @@ def run(argv: list[str]) -> int:
     # agree on one engine across ranks so the allgathered score slices
     # cannot mix engines within one output file.
     try:
-        # whole-registry knob validation FIRST (docs/static_analysis.md):
-        # any malformed VCTPU_* value exits 2 here with a clear message,
-        # uniformly across engines and forest strategies, before any
-        # ingest or scoring work starts
-        knobs.validate_all()
         eng = engine_mod.resolve_for_run()
     except EngineError as e:
         logger.error("%s", e)

@@ -21,19 +21,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from variantcalling_tpu.parallel.mesh import DATA_AXIS
 
 
+def pad_samples_to_devices(sample_counts: np.ndarray, n_dev: int) -> np.ndarray:
+    """Zero-pad the sample axis to a multiple of ``n_dev`` so the (S, L, A)
+    tensor shards evenly over the mesh data axis.
+
+    The padding rows are all-zero BY CONSTRUCTION — the additive identity
+    of the cohort sum — so they cannot leak into the cohort tensor
+    (locked by ``tests/unit/test_sec_aggregate.py``: non-divisible sample
+    counts must equal the plain ``np.sum`` over the real rows exactly).
+    """
+    s = sample_counts.shape[0]
+    pad = (-s) % n_dev
+    if not pad:
+        return sample_counts
+    return np.concatenate(
+        [sample_counts,
+         np.zeros((pad, *sample_counts.shape[1:]), sample_counts.dtype)],
+        axis=0)
+
+
 def aggregate_on_mesh(sample_counts: np.ndarray, mesh: Mesh) -> np.ndarray:
     """(S, L, A) per-sample count tensors -> (L, A) cohort sum via psum.
 
     Samples shard over the mesh data axis (padded to a multiple); the
     result is replicated on every device.
     """
-    s = sample_counts.shape[0]
-    n_dev = mesh.shape[DATA_AXIS]
-    pad = (-s) % n_dev
-    if pad:
-        sample_counts = np.concatenate(
-            [sample_counts, np.zeros((pad, *sample_counts.shape[1:]), sample_counts.dtype)], axis=0
-        )
+    from variantcalling_tpu.utils.trace import stage
+
+    sample_counts = pad_samples_to_devices(np.asarray(sample_counts),
+                                           mesh.shape[DATA_AXIS])
     arr = jax.device_put(jnp.asarray(sample_counts), NamedSharding(mesh, P(DATA_AXIS, None, None)))
 
     @jax.jit
@@ -42,6 +58,8 @@ def aggregate_on_mesh(sample_counts: np.ndarray, mesh: Mesh) -> np.ndarray:
             jnp.sum(x, axis=0, dtype=jnp.float32), NamedSharding(mesh, P(None, None))
         )
 
-    with mesh:
-        out = reduce(arr)
-    return np.asarray(out)
+    # collective timing flows into the obs stream (docs/observability.md)
+    with stage("sec.aggregate_on_mesh"):
+        with mesh:
+            out = reduce(arr)
+        return np.asarray(out)

@@ -44,6 +44,14 @@ def record(point: str, exc: BaseException | None = None,
     exc_text = "" if exc is None else f"{type(exc).__name__}: {exc}"
     with _LOCK:
         EVENTS.append((point, exc_text, fallback))
+    # every sanctioned degradation also lands in the obs run stream (one
+    # ordered log with spans/faults/lifecycle — docs/observability.md)
+    from variantcalling_tpu import obs
+
+    if obs.active():
+        obs.event("degrade", point, exc=exc_text, fallback=fallback,
+                  warn=bool(warn))
+        obs.counter("degradations").add(1)
     log = logger.warning if warn else logger.debug
     log("degradation %s: %s -> %s", point, exc_text or "(no exception)",
         fallback or "(continue)")

@@ -154,6 +154,20 @@ def cancel_hangs() -> None:
     _HANG_CANCEL.set()
 
 
+def _record_firing(point: str, style: str, seconds: float | None = None) -> None:
+    """Every injected-fault firing lands in the obs run stream (when one
+    is active) — the unified log shows exactly which failures a test or
+    chaos run injected, next to the spans/retries they provoked."""
+    from variantcalling_tpu import obs
+
+    if obs.active():
+        fields = {"style": style}
+        if seconds is not None:
+            fields["seconds"] = seconds
+        obs.event("fault", point, **fields)
+        obs.counter("faults.fired").add(1)
+
+
 def should_fire(point: str) -> bool:
     """Availability-style query: does ``point`` fire now? (no raise/sleep).
 
@@ -163,7 +177,10 @@ def should_fire(point: str) -> bool:
         return False
     with _LOCK:
         f = _ARMED.get(point)
-        return f is not None and f._take()
+        fire = f is not None and f._take()
+    if fire:
+        _record_firing(point, "availability")
+    return fire
 
 
 def check(point: str) -> None:
@@ -177,6 +194,8 @@ def check(point: str) -> None:
             return
         seconds = f.seconds
     _desc, exc_factory = POINTS[point]
+    _record_firing(point, "delay" if seconds is not None else "raise",
+                   seconds=seconds)
     if seconds is not None:
         # cancellable: a watchdog that aborts the run can release us so
         # the owning thread still joins

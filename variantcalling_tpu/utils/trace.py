@@ -7,7 +7,14 @@ makes tracing first-class here:
 
 - ``stage(name)`` / ``@timed``: nested wall-clock spans collected into a
   process-global table every pipeline can dump (``report()``), enabled by
-  default (near-zero overhead), logged at DEBUG.
+  default (near-zero overhead), logged at DEBUG. Span collection is
+  THREAD-AWARE: nesting depth lives in a ``threading.local`` (streaming
+  worker threads used to interleave through one shared ``_depth`` and
+  corrupt the whole table's indentation) and each span records the thread
+  that closed it; ``report()`` renders per-thread groups.
+- every closed span also lands in the obs event stream when a run is
+  active (:mod:`variantcalling_tpu.obs`) — trace spans, degradations and
+  executor lifecycle unify into ONE ordered JSONL log.
 - ``device_trace(logdir)``: context manager around ``jax.profiler`` —
   captures an XLA trace (HLO timelines, fusion views) viewable in
   TensorBoard/Perfetto; no-op if profiling is unavailable.
@@ -18,10 +25,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 from dataclasses import dataclass, field
 
-from variantcalling_tpu import logger
+from variantcalling_tpu import logger, obs
 from variantcalling_tpu.utils import degrade
 from variantcalling_tpu import knobs
 
@@ -31,20 +39,43 @@ class Span:
     name: str
     seconds: float
     depth: int
+    thread: str = "MainThread"
+
+
+class _ThreadState(threading.local):
+    depth = 0
 
 
 @dataclass
 class _Tracer:
+    """Process-global span table; append is thread-safe, depth is
+    per-thread (a worker's nesting cannot corrupt another's)."""
+
     spans: list[Span] = field(default_factory=list)
-    _depth: int = 0
+    _local: _ThreadState = field(default_factory=_ThreadState, repr=False)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def clear(self) -> None:
-        self.spans.clear()
+        with self._lock:
+            self.spans.clear()
 
     def report(self) -> str:
+        """Per-thread groups: the main thread's spans first (unlabeled,
+        the historical format), every worker thread after, labeled."""
+        with self._lock:
+            spans = list(self.spans)
+        threads = ["MainThread"] + sorted(
+            {s.thread for s in spans} - {"MainThread"})
         lines = ["stage timings:"]
-        for s in self.spans:
-            lines.append(f"  {'  ' * s.depth}{s.name}: {s.seconds:.3f}s")
+        for t in threads:
+            mine = [s for s in spans if s.thread == t]
+            if not mine:
+                continue
+            if t != "MainThread":
+                lines.append(f"  [thread {t}]")
+            pad = "  " if t == "MainThread" else "    "
+            for s in mine:
+                lines.append(f"{pad}{'  ' * s.depth}{s.name}: {s.seconds:.3f}s")
         return "\n".join(lines)
 
 
@@ -53,15 +84,21 @@ TRACER = _Tracer()
 
 @contextlib.contextmanager
 def stage(name: str):
-    """Nested wall-clock span; spans land in TRACER.spans in close order."""
-    TRACER._depth += 1
+    """Nested wall-clock span; spans land in TRACER.spans in close order
+    (per thread), and in the obs stream when a run is active."""
+    local = TRACER._local
+    local.depth += 1
     t0 = time.perf_counter()
     try:
         yield
     finally:
         dt = time.perf_counter() - t0
-        TRACER._depth -= 1
-        TRACER.spans.append(Span(name, dt, TRACER._depth))
+        local.depth -= 1
+        thread = threading.current_thread().name
+        with TRACER._lock:
+            TRACER.spans.append(Span(name, dt, local.depth, thread))
+        if obs.active():
+            obs.span(name, dt, thread, depth=local.depth)
         if knobs.get_bool("VCTPU_TRACE"):
             logger.info("stage %s: %.3fs", name, dt)
         else:
@@ -100,6 +137,8 @@ def device_trace(logdir: str):
         degrade.record("trace.device_trace_start", e, fallback="no device trace")
         logger.warning("device trace unavailable: %s", e)
         started = False
+    if started and obs.active():
+        obs.event("stage", "device_trace_start", logdir=logdir)
     try:
         yield
     finally:
@@ -107,6 +146,7 @@ def device_trace(logdir: str):
             try:
                 jax.profiler.stop_trace()
                 logger.info("device trace written to %s", logdir)
+                obs.event("stage", "device_trace_stop", logdir=logdir)
             except Exception as e:  # noqa: BLE001
                 degrade.record("trace.device_trace_stop", e,
                                fallback="trace may be incomplete")
