@@ -181,9 +181,28 @@ def _device_throughput_impl(tile: int, n_tiles: int,
             forest_mod.to_gemm(forest, N_HOT_FEATURES), strategy=flops_strategy)
         out["flops_per_variant"] = flops_v
         out["mfu_pct"] = round(out["vps"] * flops_v / TPU_PEAK_FLOPS * 100, 3)
+    # runtime MFU attribution (obs v2): the XLA compiler's OWN FLOP count
+    # for the compiled fused program — what docs/perf_notes.md's MFU table
+    # now reads (the analytic projection above stays for the roofline
+    # derivation). Covers featurize + forest, which the projection omits.
+    ca = xla_flops(step, *tiles[0])
+    if ca:
+        out["flops_per_variant_xla"] = round(ca / (tile * 1.0), 1)
+        out["mfu_pct_xla"] = round(
+            out["vps"] * out["flops_per_variant_xla"] / TPU_PEAK_FLOPS * 100, 3)
     if strat_rows is not None:
         out["strategies"] = strat_rows
     return out
+
+
+def xla_flops(jitted, *args) -> float | None:
+    """Compiled-program FLOPs via the obs profiler's cost-analysis helper
+    (one lower+compile against the cached shapes; None when the backend
+    has no cost model)."""
+    from variantcalling_tpu.obs import profile as profile_mod
+
+    ca = profile_mod.xla_cost_analysis(jitted, *args)
+    return ca.get("flops") if ca else None
 
 
 def gemm_flops_per_variant(gf, strategy: str = "gemm",
@@ -272,6 +291,15 @@ def strategy_rows(forest, n: int) -> dict:
             row["mfu_basis"] = ("measured v5e chip" if backend == "tpu" else
                                 "v5e-projected from CPU-fallback vps "
                                 "(attribution plumbing, not a chip claim)")
+        # runtime FLOPs for EVERY strategy (gather included — the XLA
+        # cost model counts the walk the analytic projection cannot);
+        # docs/perf_notes.md's MFU table reads these _xla columns now
+        flops_xla = xla_flops(fn, x)
+        if flops_xla:
+            row["flops_per_variant_xla"] = round(flops_xla / n, 1)
+            row["mfu_pct_xla"] = round(
+                row["vps"] * row["flops_per_variant_xla"] / TPU_PEAK_FLOPS
+                * 100, 3)
         rows[strat] = row
     return rows
 
@@ -403,19 +431,32 @@ def _e2e_serial(vcf_in: str, out_path: str, model, fasta, t0: float, t1: float) 
     }
 
 
-def obs_overhead(fixture_dir: str) -> dict:
-    """Hot-path cost of VCTPU_OBS=1 (ISSUE 5 acceptance: < 2%).
+#: paired off/on repetitions for the obs-overhead measurement; the
+#: reported overhead is the MEDIAN of the per-pair deltas
+OBS_OVERHEAD_PAIRS = 5
 
-    Runs the streaming e2e leg with obs off and on (best-of-2 each, same
-    estimator every phase uses on this ±30% shared host), ASSERTS output
-    byte-identity (a parity break fails the phase loudly, it is never
-    just recorded), and reports ``obs_overhead_pct`` plus the recorded
-    run log's event count. The overhead number itself is recorded, not
-    gated — host noise on a shared box can exceed the 2% budget
-    spuriously; the committed BENCH json is the auditable trail. The obs
-    run log for the leg lands next to the fixture outputs
-    (<out>.obs.jsonl) exactly as a production run's would.
+
+def obs_overhead(fixture_dir: str) -> dict:
+    """Hot-path cost of VCTPU_OBS=1 WITH profiling (budget: <= 2%).
+
+    Measured as MEDIAN-OF-5 PAIRED runs: each pair runs the streaming
+    leg obs-off then obs-on back to back and records the per-pair
+    percentage delta; the phase reports the median plus the full band
+    (min..max of the pair deltas). BENCH_r08's single-shot delta
+    reported −3.51% — a meaningless negative "overhead" that was pure
+    host noise straddling two separate best-of-2 windows; pairing puts
+    both legs inside the same noise window and the median defeats the
+    outlier pairs. The profiler (per-stage attribution + resource
+    sampler + heartbeats) is ON for every on-leg — the budget covers obs
+    v2, not just the PR 5 event stream. Output byte-identity is ASSERTED
+    on every pair (a parity break fails the phase loudly, it is never
+    just recorded). The overhead number itself is recorded, not gated —
+    host noise on a shared box can exceed the budget spuriously; the
+    committed BENCH json is the auditable trail, and tools/bench_gate.py
+    applies the 2% budget with that context.
     """
+    import statistics
+
     from variantcalling_tpu.io.fasta import FastaReader
     from variantcalling_tpu.pipelines.filter_variants import run_streaming
     from variantcalling_tpu.synthetic import synthetic_forest
@@ -428,21 +469,19 @@ def obs_overhead(fixture_dir: str) -> dict:
 
     def leg(obs_on: bool, out_name: str) -> tuple[float, dict | None]:
         out_path = os.path.join(fixture_dir, out_name)
-        saved = {k: os.environ.get(k) for k in ("VCTPU_OBS", "VCTPU_OBS_PATH")}
+        saved = {k: os.environ.get(k)
+                 for k in ("VCTPU_OBS", "VCTPU_OBS_PATH", "VCTPU_OBS_PROFILE")}
         if obs_on:
             os.environ["VCTPU_OBS"] = "1"
+            os.environ["VCTPU_OBS_PROFILE"] = "1"  # the budget covers obs v2
         else:
             os.environ.pop("VCTPU_OBS", None)
         os.environ.pop("VCTPU_OBS_PATH", None)
         try:
-            best = stats = None
-            for _ in range(2):
-                t0 = time.perf_counter()
-                stats = run_streaming(_fvp_args(vcf_in, out_path), model,
-                                      fasta, {}, None)
-                dt = time.perf_counter() - t0
-                best = dt if best is None else min(best, dt)
-            return best, stats
+            t0 = time.perf_counter()
+            stats = run_streaming(_fvp_args(vcf_in, out_path), model,
+                                  fasta, {}, None)
+            return time.perf_counter() - t0, stats
         finally:
             for k, v in saved.items():
                 if v is None:
@@ -458,27 +497,43 @@ def obs_overhead(fixture_dir: str) -> dict:
         # report WHY instead of crashing on a missing output file
         return {"skipped": "streaming ineligible on this host "
                            "(VCTPU_THREADS=1 or no native engine)"}
-    off_s, _ = leg(False, "out_obs_off.vcf")
-    on_s, stats = leg(True, "out_obs_on.vcf")
-    with open(os.path.join(fixture_dir, "out_obs_off.vcf"), "rb") as fh:
-        off_bytes = fh.read()
-    with open(os.path.join(fixture_dir, "out_obs_on.vcf"), "rb") as fh:
-        on_bytes = fh.read()
-    if off_bytes != on_bytes:
-        # output-neutrality is the obs contract; a break must fail the
-        # phase (phase_errors in BENCH json), never be silently recorded
-        raise RuntimeError(
-            "VCTPU_OBS=1 changed filter output bytes — obs must be "
-            "output-neutral (docs/observability.md)")
-    log_path = os.path.join(fixture_dir, "out_obs_on.vcf.obs.jsonl")
+
+    off_path = os.path.join(fixture_dir, "out_obs_off.vcf")
+    on_path = os.path.join(fixture_dir, "out_obs_on.vcf")
+    pair_pcts: list[float] = []
+    off_times: list[float] = []
+    on_times: list[float] = []
+    stats = None
+    for _ in range(OBS_OVERHEAD_PAIRS):
+        off_s, _ = leg(False, "out_obs_off.vcf")
+        on_s, stats = leg(True, "out_obs_on.vcf")
+        off_times.append(off_s)
+        on_times.append(on_s)
+        pair_pcts.append(100.0 * (on_s - off_s) / off_s)
+        with open(off_path, "rb") as fh:
+            off_bytes = fh.read()
+        with open(on_path, "rb") as fh:
+            on_bytes = fh.read()
+        if off_bytes != on_bytes:
+            # output-neutrality is the obs contract; a break must fail the
+            # phase (phase_errors in BENCH json), never be silently recorded
+            raise RuntimeError(
+                "VCTPU_OBS=1 changed filter output bytes — obs must be "
+                "output-neutral (docs/observability.md)")
+    log_path = on_path + ".obs.jsonl"
     with open(log_path, encoding="utf-8") as fh:
         events = sum(1 for line in fh if line.strip())
     return {
         "n": stats["n"] if stats else 0,
-        "off_s": round(off_s, 3),
-        "on_s": round(on_s, 3),
-        "obs_overhead_pct": round(100.0 * (on_s - off_s) / off_s, 2),
-        "bytes_identical": off_bytes == on_bytes,
+        "pairs": OBS_OVERHEAD_PAIRS,
+        "off_s_median": round(statistics.median(off_times), 3),
+        "on_s_median": round(statistics.median(on_times), 3),
+        "obs_overhead_pct": round(statistics.median(pair_pcts), 2),
+        "obs_overhead_band_pct": [round(min(pair_pcts), 2),
+                                  round(max(pair_pcts), 2)],
+        "obs_overhead_pairs_pct": [round(p, 2) for p in pair_pcts],
+        "profile_enabled": True,
+        "bytes_identical": True,  # asserted above on every pair
         "events": events,
     }
 
@@ -894,6 +949,39 @@ def _engine_name() -> str:
         return f"unresolved ({type(e).__name__})"
 
 
+#: phases that stream the real pipeline: each gets its own obs run log
+#: (force-path, independent of VCTPU_OBS) whose bottleneck roll-up is
+#: attached to the phase row — every committed BENCH json then carries
+#: its own attribution. The `obs` phase is deliberately EXCLUDED (it
+#: measures off-vs-on itself — an ambient stream would contaminate the
+#: off leg), as is `scaling` (its serial legs compare raw stage walls).
+OBS_ATTRIBUTED_PHASES = ("e2e", "e2e_5m", "genome3g")
+
+
+def _phase_attribution(log_path: str) -> dict | None:
+    """Compact bottleneck roll-up of one phase's obs log for the BENCH
+    artifact (full log stays on disk next to the fixtures)."""
+    from variantcalling_tpu.obs import export as obs_export
+
+    events = obs_export.read_events(log_path)
+    b = obs_export.bottleneck(events)
+    if b["limiting_stage"] is None:
+        return None
+    out = {"limiting_stage": b["limiting_stage"],
+           "limiting_work_pct": b["limiting_work_pct"],
+           "wall_s": b["wall_s"], "source": b["source"],
+           "stages": {name: {k: s[k] for k in
+                             ("work_pct", "wait_in_pct", "wait_out_pct",
+                              "other_pct") if k in s} | (
+                                  {"vps": s["vps"]} if "vps" in s else {})
+                      for name, s in b["stages"].items()}}
+    if "cost_analysis" in b:
+        out["cost_analysis"] = b["cost_analysis"]
+    if "resources" in b:
+        out["resources"] = b["resources"]
+    return out
+
+
 def child_main(fixture_dir: str) -> None:
     t_start = time.time()
     budget = float(os.environ.get("VCTPU_BENCH_CHILD_BUDGET", "420"))
@@ -911,6 +999,12 @@ def child_main(fixture_dir: str) -> None:
             emit()
             return
         print(f"BENCH_PHASE {name} start (remaining {remaining:.0f}s)", flush=True)
+        obs_run = obs_log = None
+        if name in OBS_ATTRIBUTED_PHASES:
+            from variantcalling_tpu import obs as obs_mod
+
+            obs_log = os.path.join(fixture_dir, f"obs_{name}.jsonl")
+            obs_run = obs_mod.start_run(f"bench.{name}", force_path=obs_log)
         t0 = time.perf_counter()
         try:
             out = fn()
@@ -926,6 +1020,18 @@ def child_main(fixture_dir: str) -> None:
             result.setdefault("phase_errors", {})[name] = f"{type(e).__name__}: {e}"[:300]
             print(f"BENCH_PHASE {name} FAILED after {time.perf_counter() - t0:.1f}s: "
                   f"{e}", flush=True)
+        finally:
+            if obs_run is not None:
+                from variantcalling_tpu import obs as obs_mod
+
+                obs_mod.end_run(obs_run, "ok")
+                try:
+                    attribution = _phase_attribution(obs_log)
+                    if attribution and isinstance(result.get(name), dict):
+                        result[name]["attribution"] = attribution
+                except Exception as e:  # noqa: BLE001 — attribution is telemetry, never fatal to the phase
+                    print(f"BENCH_PHASE {name} attribution failed: {e}",
+                          flush=True)
         emit()
 
     print("BENCH_PHASE init start", flush=True)

@@ -1,0 +1,158 @@
+"""tools/bench_gate.py — the continuous bench regression sentry: golden
+pass/fail fixtures (seeded ≥10% regression MUST fail, the committed
+baseline against itself MUST pass), median-of-k reduction, the absolute
+obs-overhead budget, and CLI exit codes (ISSUE 6 acceptance)."""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+import pytest
+
+from tools import bench_gate
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: a representative bench artifact covering every gated metric family
+GOLDEN = {
+    "value": 2_000_000,
+    "hot": {"vps": 2_000_000},
+    "e2e": {"e2e_vps": 800_000, "single_shot_vps": 750_000},
+    "scaling": {"streaming_vps_t2": 820_000},
+    "coverage": {"bp_per_sec": 500_000_000},
+    "train": {"wallclock_s": 2.5},
+    "obs": {"obs_overhead_pct": 0.9},
+}
+
+
+def test_identical_artifacts_pass():
+    report = bench_gate.gate(copy.deepcopy(GOLDEN), copy.deepcopy(GOLDEN))
+    assert report["regressed"] is False
+    assert all(not c["regressed"] for c in report["checks"])
+
+
+@pytest.mark.parametrize("path,factor", [
+    ("value", 0.90),                      # exactly -10%: beyond the 8% band
+    ("e2e.e2e_vps", 0.85),
+    ("scaling.streaming_vps_t2", 0.80),
+])
+def test_seeded_ten_pct_regression_fails(path, factor):
+    cand = copy.deepcopy(GOLDEN)
+    node = cand
+    parts = path.split(".")
+    for p in parts[:-1]:
+        node = node[p]
+    node[parts[-1]] = node[parts[-1]] * factor
+    report = bench_gate.gate(cand, GOLDEN)
+    assert report["regressed"] is True
+    bad = {c["metric"] for c in report["checks"] if c["regressed"]}
+    assert path in bad
+
+
+def test_lower_is_better_direction_and_improvements_pass():
+    cand = copy.deepcopy(GOLDEN)
+    cand["train"]["wallclock_s"] = 3.5  # 40% slower fit: regression
+    assert bench_gate.gate(cand, GOLDEN)["regressed"] is True
+    cand = copy.deepcopy(GOLDEN)
+    cand["train"]["wallclock_s"] = 1.0  # faster is never a regression
+    cand["value"] = 3_000_000
+    assert bench_gate.gate(cand, GOLDEN)["regressed"] is False
+
+
+def test_obs_overhead_budget_is_absolute():
+    # the 2% budget needs no baseline: 2.4% overhead fails even if the
+    # baseline was worse
+    cand = copy.deepcopy(GOLDEN)
+    cand["obs"]["obs_overhead_pct"] = 2.4
+    base = copy.deepcopy(GOLDEN)
+    base["obs"]["obs_overhead_pct"] = 3.0
+    report = bench_gate.gate(cand, base)
+    assert report["regressed"] is True
+    budget = next(c for c in report["checks"]
+                  if c["metric"] == "obs.obs_overhead_pct")
+    assert budget["direction"] == "budget" and budget["regressed"]
+    # a negative (noise-floor) overhead is inside the budget
+    cand["obs"]["obs_overhead_pct"] = -0.5
+    assert bench_gate.gate(cand, GOLDEN)["regressed"] is False
+
+
+def test_median_of_k_lists_reduce_by_median():
+    cand = copy.deepcopy(GOLDEN)
+    base = copy.deepcopy(GOLDEN)
+    # median 2.0M == baseline: one lucky and one unlucky run cancel
+    cand["value"] = [1_900_000, 2_000_000, 2_100_000]
+    assert bench_gate.gate(cand, base)["regressed"] is False
+    # median 10% down: the outlier-lucky run cannot save it
+    cand["value"] = [1_700_000, 1_800_000, 2_300_000]
+    report = bench_gate.gate(cand, base)
+    assert report["regressed"] is True
+    assert bench_gate.resolve_path(cand, "value") == 1_800_000
+
+
+def test_missing_metrics_skip_never_fail():
+    cand = {"value": 2_000_000}  # a reduced bench ran only the hot phase
+    report = bench_gate.gate(cand, GOLDEN)
+    assert report["regressed"] is False
+    assert "e2e.e2e_vps" in report["skipped"]
+
+
+def test_tolerance_override_widens_every_band():
+    cand = copy.deepcopy(GOLDEN)
+    cand["value"] = GOLDEN["value"] * 0.85
+    assert bench_gate.gate(cand, GOLDEN)["regressed"] is True
+    assert bench_gate.gate(cand, GOLDEN,
+                           tolerance_override=0.30)["regressed"] is False
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (golden pass/fail fixtures on disk)
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", GOLDEN)
+    cand_ok = _write(tmp_path, "ok.json", GOLDEN)
+    bad = copy.deepcopy(GOLDEN)
+    bad["e2e"]["e2e_vps"] = int(GOLDEN["e2e"]["e2e_vps"] * 0.88)  # -12%
+    cand_bad = _write(tmp_path, "bad.json", bad)
+
+    assert bench_gate.main([cand_ok, base]) == 0
+    out = capsys.readouterr().out
+    assert "within the noise bands" in out
+    assert bench_gate.main([cand_bad, base]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "e2e.e2e_vps" in out
+    # --json report parses and carries the verdict
+    assert bench_gate.main(["--json", cand_bad, base]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["regressed"] is True
+    # usage / IO errors exit 2
+    assert bench_gate.main([]) == 2
+    assert bench_gate.main([str(tmp_path / "missing.json"), base]) == 2
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("not json")
+    assert bench_gate.main([str(garbage), base]) == 2
+
+
+def test_cli_gates_committed_baseline_against_itself():
+    """Acceptance: zero on the committed baseline (no self-regression)."""
+    newest = bench_gate.newest_committed_baseline()
+    assert newest is not None and os.path.exists(newest)
+    assert bench_gate.main([newest, newest]) == 0
+
+
+def test_newest_committed_baseline_picks_highest_round():
+    newest = bench_gate.newest_committed_baseline()
+    rounds = [int(n[len("BENCH_r"):-len(".json")])
+              for n in os.listdir(_REPO)
+              if n.startswith("BENCH_r") and n.endswith(".json")
+              and n[len("BENCH_r"):-len(".json")].isdigit()]
+    assert os.path.basename(newest) == f"BENCH_r{max(rounds):02d}.json"

@@ -182,7 +182,8 @@ def test_stream_is_ordered_and_schema_valid_from_threads(tmp_path):
     assert schema_mod.validate_lines(lines) == []  # seq/ts order included
     events = _events(path)
     assert [e["seq"] for e in events] == list(range(len(events)))
-    assert len(events) == 1 + 4 * 200 + 2  # manifest + spam + metrics/run_end
+    # manifest + spam + sampler watermark (obs v2) + metrics/run_end
+    assert len(events) == 1 + 4 * 200 + 3
 
 
 def test_end_run_snapshots_metrics(tmp_path):
@@ -380,6 +381,10 @@ def test_filter_output_byte_identical_with_obs(stream_world, tmp_path,
         else:
             monkeypatch.delenv("VCTPU_THREADS", raising=False)
         monkeypatch.setenv("VCTPU_OBS", "1" if obs_on else "0")
+        # the acceptance criterion covers obs v2: byte parity holds with
+        # the attribution profiler ON (per-stage stats, sampler, runtime
+        # cost_analysis on the jit engine)
+        monkeypatch.setenv("VCTPU_OBS_PROFILE", "1")
         try:
             rc = fvp_run([
                 "--input_file", f"{w['dir']}/calls.vcf",
@@ -400,10 +405,19 @@ def test_filter_output_byte_identical_with_obs(stream_world, tmp_path,
     lines = open(sidecar, encoding="utf-8").read().splitlines()
     assert schema_mod.validate_lines(lines) == []
     # the run recorded its resolved engine in the stream
-    resolves = [json.loads(ln) for ln in lines
-                if json.loads(ln)["kind"] == "resolve"]
+    events = [json.loads(ln) for ln in lines]
+    resolves = [e for e in events if e["kind"] == "resolve"]
     values = {e["name"]: e["value"] for e in resolves}
     assert values.get("engine", engine) == engine
+    # obs v2: profiling was enabled, so the attribution landed too —
+    # per-stage profile events on the streaming executor, the resource
+    # watermark on every run, and compiler-measured FLOPs on jit runs
+    profile_names = {e["name"] for e in events if e["kind"] == "profile"}
+    assert "resources" in profile_names
+    if threads is None:  # streaming: the executor fed the profiler
+        assert {"stage", "pipeline"} <= profile_names
+    if engine == "jit":
+        assert "cost_analysis" in profile_names
 
 
 # ---------------------------------------------------------------------------

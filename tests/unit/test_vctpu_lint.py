@@ -335,6 +335,58 @@ def test_vct006_suppression_for_sanctioned_sites():
 
 
 # ---------------------------------------------------------------------------
+# VCT007 undeclared-event-kind
+# ---------------------------------------------------------------------------
+
+
+def test_vct007_undeclared_kind_flagged():
+    fs = run('''
+        from variantcalling_tpu import obs
+        obs.event("brand_new_kind", "x", value=1)
+        ''')
+    assert [f.code for f in fs] == ["VCT007"]
+    assert "brand_new_kind" in fs[0].message
+    assert "event_schema.json" in fs[0].message
+
+
+def test_vct007_declared_kinds_pass():
+    # every committed kind is fine, through both the public emit and the
+    # writer-internal _emit spelling
+    assert codes('''
+        from variantcalling_tpu import obs
+        obs.event("heartbeat", "stream", chunks=1, records=2)
+        obs.event("profile", "stage", stage="ingest")
+        obs.event("journal", "resume_decision", outcome="fresh")
+        run._emit("manifest", "tool", {})
+        ''') == []
+
+
+def test_vct007_internal_emit_flagged_and_nonliteral_ignored():
+    assert codes('''
+        run._emit("mystery", "tool", {})
+        ''') == ["VCT007"]
+    # non-literal kinds are the schema validator's job, not the linter's
+    assert codes('''
+        from variantcalling_tpu import obs
+        obs.event(kind_var, "x")
+        ''') == []
+
+
+def test_vct007_tests_exempt_and_schema_is_source_of_truth():
+    # tests exercise deliberately-bogus kinds
+    assert codes('''
+        from variantcalling_tpu import obs
+        obs.event("bogus", "x")
+        ''', path="tests/unit/test_whatever.py") == []
+    # the checker reads the COMMITTED artifact: every kind it accepts is
+    # a key of event_schema.json
+    from tools.vctpu_lint.checkers import UndeclaredEventKindChecker
+
+    kinds = UndeclaredEventKindChecker.schema_kinds()
+    assert {"manifest", "span", "profile", "metrics", "run_end"} <= set(kinds)
+
+
+# ---------------------------------------------------------------------------
 # suppression comments, syntax errors, select
 # ---------------------------------------------------------------------------
 
@@ -445,7 +497,8 @@ def test_cli_unknown_select_is_usage_error(tmp_path):
 def test_cli_list_checkers(capsys):
     assert lint_main(["--list-checkers"]) == 0
     out = capsys.readouterr().out
-    for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005", "VCT006"):
+    for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005", "VCT006",
+                 "VCT007"):
         assert code in out
 
 

@@ -1,0 +1,251 @@
+"""Continuous bench regression sentry: gate a fresh BENCH json against a
+committed baseline with explicit per-metric noise bands.
+
+Eight BENCH_*.json snapshots accumulated (r01..r08) with nothing
+comparing them — a throughput regression would land silently and only a
+human diff would catch it. This tool is the gate:
+
+- ``python -m tools.bench_gate CANDIDATE BASELINE`` compares two bench
+  JSON artifacts over the :data:`METRICS` registry (each metric names
+  its direction and its noise band) and **exits 1 on any regression
+  beyond the band**, 0 when clean, 2 on usage/IO errors.
+- ``python -m tools.bench_gate --run`` runs a fresh reduced bench
+  (``VCTPU_BENCH_PHASES=hot_small,hot,e2e,obs`` — the phases the gate
+  reads) and compares it against the newest committed ``BENCH_r*.json``
+  (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
+  opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
+
+Noise bands are explicit and per metric because the signals differ: the
+hot path is best-of-2 on a shared ±noise host, the obs overhead is a
+median-of-5 paired measurement with its band committed next to it, and
+e2e runs best-of-2 steady-state. A metric whose candidate value is a
+LIST is reduced by median first (median-of-k runs gate on the median,
+not the luckiest run). The default bands are deliberately tighter than
+the 10%-regression acceptance floor; raise per-run with
+``--tolerance-pct`` on noisy hosts.
+
+The sibling sentry for run *telemetry* (per-stage attribution) is
+``vctpu obs diff A B`` — same exit-code contract, obs logs instead of
+bench JSON. Catalog/docs: docs/observability.md "The regression sentry".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the gate's metric registry: (dotted path into the bench JSON,
+#: direction, noise band as a fraction). "higher"/"lower" compare against
+#: the baseline; "budget" is an ABSOLUTE cap — the band IS the budget and
+#: no baseline value is needed (the obs overhead contract is ≤2%
+#: regardless of history).
+METRICS: tuple[tuple[str, str, float], ...] = (
+    ("value", "higher", 0.08),                   # hot-path v/s (headline)
+    ("hot.vps", "higher", 0.08),
+    ("e2e.e2e_vps", "higher", 0.08),
+    ("e2e.single_shot_vps", "higher", 0.10),
+    ("e2e_5m.e2e_5m_vps", "higher", 0.10),
+    ("scaling.streaming_vps_t2", "higher", 0.10),
+    ("coverage.bp_per_sec", "higher", 0.10),
+    ("train.wallclock_s", "lower", 0.10),
+    ("obs.obs_overhead_pct", "budget", 2.0),     # the PR 5 <2% contract
+)
+
+
+def resolve_path(doc: dict, dotted: str):
+    """Value at ``a.b.c`` in a nested dict, or None; list values reduce
+    by median (median-of-k gating)."""
+    node = doc
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, list):
+        nums = [v for v in node if isinstance(v, (int, float))
+                and not isinstance(v, bool)]
+        return statistics.median(nums) if nums else None
+    if isinstance(node, (int, float)) and not isinstance(node, bool):
+        return node
+    return None
+
+
+def gate(candidate: dict, baseline: dict,
+         tolerance_override: float | None = None) -> dict:
+    """The comparison report; ``report["regressed"]`` drives exit codes.
+
+    Metrics absent from either artifact are listed as skipped, never
+    failed — a reduced bench run gates only the phases it ran.
+    """
+    checks: list[dict] = []
+    skipped: list[str] = []
+    for dotted, direction, band in METRICS:
+        tol = tolerance_override if tolerance_override is not None else band
+        cand = resolve_path(candidate, dotted)
+        if direction == "budget":
+            if cand is None:
+                skipped.append(dotted)
+                continue
+            checks.append({
+                "metric": dotted, "candidate": cand, "budget": band,
+                "direction": "budget",
+                "regressed": bool(cand > band),
+            })
+            continue
+        base = resolve_path(baseline, dotted)
+        if cand is None or base is None or base == 0:
+            skipped.append(dotted)
+            continue
+        ratio = cand / base
+        regressed = (ratio < 1 - tol) if direction == "higher" \
+            else (ratio > 1 + tol)
+        checks.append({
+            "metric": dotted, "candidate": cand, "baseline": base,
+            "direction": direction, "delta_pct": round(100 * (ratio - 1), 2),
+            "tolerance_pct": round(100 * tol, 2), "regressed": regressed,
+        })
+    return {
+        "checks": checks,
+        "skipped": skipped,
+        "regressed": any(c["regressed"] for c in checks),
+    }
+
+
+def render(report: dict) -> str:
+    lines = ["bench gate:"]
+    for c in report["checks"]:
+        mark = "REGRESSED" if c["regressed"] else "ok"
+        if c["direction"] == "budget":
+            lines.append(f"  {c['metric']:<28} {c['candidate']:>12} "
+                         f"(budget <= {c['budget']})  {mark}")
+        else:
+            lines.append(f"  {c['metric']:<28} {c['baseline']:>12} -> "
+                         f"{c['candidate']:>12}  {c['delta_pct']:+7.2f}% "
+                         f"(band ±{c['tolerance_pct']}%, {c['direction']} "
+                         f"is better)  {mark}")
+    if report["skipped"]:
+        lines.append(f"  skipped (absent in one artifact): "
+                     f"{', '.join(report['skipped'])}")
+    lines.append("result: " + ("REGRESSION beyond the noise band"
+                               if report["regressed"] else
+                               "within the noise bands"))
+    return "\n".join(lines)
+
+
+def _env_baseline() -> str | None:
+    """VCTPU_BENCH_BASELINE (declared in the knob registry; read raw here
+    because the gate must not import the package it is gating)."""
+    return os.environ.get("VCTPU_BENCH_BASELINE")  # vctpu-lint: disable=VCT001 — tools-side read of a registry-declared knob
+
+
+def newest_committed_baseline() -> str | None:
+    """The highest-numbered committed BENCH_rNN.json in the repo root."""
+    best: tuple[int, str] | None = None
+    for name in os.listdir(_REPO):
+        if name.startswith("BENCH_r") and name.endswith(".json"):
+            digits = name[len("BENCH_r"):-len(".json")]
+            if digits.isdigit():
+                cand = (int(digits), os.path.join(_REPO, name))
+                if best is None or cand > best:
+                    best = cand
+    return best[1] if best else None
+
+
+def run_fresh_bench(timeout_s: int = 420) -> dict | None:
+    """A reduced fresh bench (the gate's phases only) on the CPU engine;
+    returns its parsed JSON or None with the failure printed."""
+    env = dict(os.environ)
+    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,e2e,obs"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")], env=env,
+            cwd=_REPO, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        print(f"bench_gate: fresh bench timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    print(f"bench_gate: fresh bench produced no JSON (rc={proc.returncode}): "
+          f"{(proc.stderr or proc.stdout)[-400:]}", file=sys.stderr)
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.bench_gate",
+        description="gate a bench JSON against a committed baseline "
+                    "(docs/observability.md)")
+    ap.add_argument("candidate", nargs="?",
+                    help="candidate bench JSON (omit with --run)")
+    ap.add_argument("baseline", nargs="?",
+                    help="baseline bench JSON (default: newest committed "
+                         "BENCH_r*.json, or VCTPU_BENCH_BASELINE)")
+    ap.add_argument("--run", action="store_true",
+                    help="run a fresh reduced bench as the candidate")
+    ap.add_argument("--tolerance-pct", type=float, default=None,
+                    help="override EVERY relative metric's noise band")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON")
+    args = ap.parse_args(argv)
+
+    if args.run:
+        if args.candidate and args.baseline:
+            print("--run takes at most a baseline path", file=sys.stderr)
+            return 2
+        baseline_path = args.candidate or args.baseline
+        candidate = run_fresh_bench()
+        if candidate is None:
+            return 2
+    else:
+        if not args.candidate:
+            ap.print_usage(sys.stderr)
+            return 2
+        baseline_path = args.baseline
+        try:
+            with open(args.candidate, encoding="utf-8") as fh:
+                candidate = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_gate: cannot read candidate: {e}", file=sys.stderr)
+            return 2
+
+    baseline_path = baseline_path or _env_baseline() \
+        or newest_committed_baseline()
+    if not baseline_path:
+        print("bench_gate: no baseline (no committed BENCH_r*.json and no "
+              "VCTPU_BENCH_BASELINE)", file=sys.stderr)
+        return 2
+    try:
+        with open(baseline_path, encoding="utf-8") as fh:
+            baseline = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_gate: cannot read baseline: {e}", file=sys.stderr)
+        return 2
+
+    report = gate(candidate, baseline,
+                  tolerance_override=(args.tolerance_pct / 100.0
+                                      if args.tolerance_pct is not None
+                                      else None))
+    report["baseline_path"] = os.path.relpath(baseline_path, _REPO)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"baseline: {report['baseline_path']}")
+        print(render(report))
+    return 1 if report["regressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

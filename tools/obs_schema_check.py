@@ -60,6 +60,11 @@ def main() -> int:
         obs.event("heartbeat", "stream", chunks=1, records=128, vps=1000,
                   pct=50.0, eta_s=1.0)
         obs.event("journal", "resume_decision", outcome="fresh")
+        # obs v2 profile producers (attribution events + bottleneck surface)
+        obs.event("profile", "stage", stage="score_stage", work_s=0.5,
+                  wait_in_s=0.1, wait_out_s=0.0, items=1, records=128)
+        obs.event("profile", "pipeline", wall_s=0.6, records=128,
+                  stages=["score_stage"], bytes_in=1024, bytes_out=2048)
         obs.end_run(run, "ok")
 
         with open(path, encoding="utf-8") as fh:
@@ -69,13 +74,21 @@ def main() -> int:
         # silently-dropped event class would otherwise "validate"
         import json
 
-        kinds = {json.loads(ln)["kind"] for ln in lines}
+        parsed = [json.loads(ln) for ln in lines]
+        kinds = {e["kind"] for e in parsed}
         for required in ("manifest", "span", "degrade", "fault", "heartbeat",
-                         "journal", "metrics", "run_end"):
+                         "journal", "profile", "metrics", "run_end"):
             if required not in kinds:
                 errors.append(f"stream is missing a {required!r} event")
-        threads = {json.loads(ln).get("thread") for ln in lines
-                   if json.loads(ln)["kind"] == "span"}
+        # histogram snapshots must carry the SLO percentiles (obs v2)
+        metrics_ev = [e for e in parsed if e["kind"] == "metrics"]
+        hists = metrics_ev[-1]["histograms"] if metrics_ev else {}
+        for hname, snap in hists.items():
+            missing_pcts = {"p50", "p95", "p99"} - set(snap)
+            if missing_pcts:
+                errors.append(f"histogram {hname!r} snapshot missing "
+                              f"{sorted(missing_pcts)}")
+        threads = {e.get("thread") for e in parsed if e["kind"] == "span"}
         if len(threads) < 2:
             errors.append("spans from a worker thread did not land in the "
                           f"stream (threads seen: {sorted(threads)})")
@@ -92,6 +105,10 @@ def main() -> int:
                 errors.append(f"trace event missing {sorted(missing)}: {e}")
                 break
         export.summarize(events)  # must not raise on a fresh log
+        b = export.bottleneck(events)  # nor the obs v2 roll-up
+        if b.get("limiting_stage") != "score_stage":
+            errors.append("bottleneck roll-up did not name the profiled "
+                          f"stage (got {b.get('limiting_stage')!r})")
 
     if errors:
         for err in errors:

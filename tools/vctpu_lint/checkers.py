@@ -8,6 +8,8 @@ docs/static_analysis.md.
 from __future__ import annotations
 
 import ast
+import json
+import os
 
 from tools.vctpu_lint import Checker, register
 
@@ -26,6 +28,11 @@ _DEGRADE_CALLS = {("degrade", "record")}
 #: library paths where ad-hoc wall-clock timing is sanctioned (VCT006):
 #: the obs subsystem and the trace module ARE the timing layer
 _TIMING_EXEMPT = ("variantcalling_tpu/obs/", "variantcalling_tpu/utils/trace.py")
+
+#: the committed obs event-schema artifact VCT007 checks against
+_EVENT_SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "variantcalling_tpu", "obs", "event_schema.json")
 
 
 def _is_environ(node: ast.expr) -> bool:
@@ -431,4 +438,70 @@ class RawTimingChecker(Checker):
                               "route it through trace.stage(...)/obs.span so "
                               "the measurement lands in the run telemetry "
                               "stream (docs/observability.md)")
+        self.generic_visit(node)
+
+
+@register
+class UndeclaredEventKindChecker(Checker):
+    """VCT007 — an obs event emitted with a kind the committed schema
+    does not declare.
+
+    Incident class: the obs contract lives in the COMMITTED
+    ``variantcalling_tpu/obs/event_schema.json`` — the tier-0 schema
+    stage, the exporters and external consumers all validate against
+    that one artifact. The tier-0 stage only exercises the producers it
+    generates, so a NEW ``obs.event("brand_new_kind", ...)`` call deep
+    in a pipeline would ship events no consumer recognizes and no
+    schema review ever saw (the PR 6 ``profile`` kind landed exactly
+    this way — code first, schema almost forgotten). This checker makes
+    the artifact the source of truth at lint time: every string-literal
+    kind passed to ``obs.event(...)`` / ``*._emit(...)`` must exist in
+    the committed ``kinds`` table; adding a kind is a reviewable diff to
+    the schema file FIRST.
+
+    Non-literal kinds are not flagged (the schema validator still
+    catches them at the tier-0 stage / in tests).
+    """
+
+    code = "VCT007"
+    name = "undeclared-event-kind"
+    description = ("obs.event/._emit called with an event kind missing from "
+                   "the committed event_schema.json")
+
+    _schema_kinds: frozenset[str] | None = None
+
+    @classmethod
+    def schema_kinds(cls) -> frozenset[str]:
+        if cls._schema_kinds is None:
+            try:
+                with open(_EVENT_SCHEMA_PATH, encoding="utf-8") as fh:
+                    cls._schema_kinds = frozenset(json.load(fh)["kinds"])
+            except (OSError, ValueError, KeyError):
+                # a missing/garbled artifact is the schema stage's finding,
+                # not a reason to flag every emit site
+                cls._schema_kinds = frozenset()
+        return cls._schema_kinds
+
+    def applies_to(self, path: str) -> bool:
+        # producers live in the library and tools; tests exercise
+        # deliberately-bogus kinds
+        return not path.startswith("tests/")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        is_emit = False
+        if isinstance(func, ast.Attribute):
+            if func.attr == "event" and isinstance(func.value, ast.Name) \
+                    and func.value.id == "obs":
+                is_emit = True  # obs.event("kind", "name", ...)
+            elif func.attr == "_emit":
+                is_emit = True  # run._emit("kind", "name", {...})
+        if is_emit and node.args:
+            kind = _const_str(node.args[0])
+            kinds = self.schema_kinds()
+            if kind is not None and kinds and kind not in kinds:
+                self.report(node, f"event kind {kind!r} is not declared in "
+                                  "variantcalling_tpu/obs/event_schema.json — "
+                                  "add it to the committed schema (a "
+                                  "reviewable diff) before emitting it")
         self.generic_visit(node)

@@ -157,6 +157,21 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "JSONL sidecar (docs/observability.md)"),
     _k("VCTPU_OBS_PATH", "str", "",
        "obs run-log path override; default <output_file>.obs.jsonl"),
+    _k("VCTPU_OBS_PROFILE", "bool", True,
+       "obs v2 attribution when VCTPU_OBS=1: per-stage work/wait "
+       "profile, RSS/CPU watermark sampler, runtime cost_analysis "
+       "(docs/observability.md)"),
+    _k("VCTPU_OBS_SAMPLE_S", "float", 0.05,
+       "resource-watermark sampler interval in seconds", minimum=0.001),
+    _k("VCTPU_OBS_JAXPROF", "bool", False,
+       "capture a jax.profiler device trace (<run log>.jaxprof/) "
+       "alongside the obs stream for side-by-side Perfetto loading"),
+    _k("VCTPU_BENCH_GATE", "bool", False,
+       "run_tests.sh: run the opt-in bench regression gate stage "
+       "(tools/bench_gate.py) before pytest"),
+    _k("VCTPU_BENCH_BASELINE", "str", "",
+       "bench_gate baseline JSON path; default: newest committed "
+       "BENCH_r*.json"),
     _k("VCTPU_TRACE", "bool", False,
        "print every closed trace span at INFO level"),
     _k("VCTPU_FAULTS", "str", "",

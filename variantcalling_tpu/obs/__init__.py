@@ -33,13 +33,26 @@ Contract (locked by ``tests/unit/test_obs.py``):
 
 Knobs: ``VCTPU_OBS=1`` enables recording; ``VCTPU_OBS_PATH`` overrides
 the sidecar path (default: ``<output_file>.obs.jsonl`` next to the
-pipeline output).
+pipeline output); ``VCTPU_OBS_PROFILE`` (default on) adds the obs v2
+performance-attribution layer (:mod:`~variantcalling_tpu.obs.profile`:
+per-stage work/wait attribution, RSS/CPU watermark sampler, runtime
+cost_analysis); ``VCTPU_OBS_JAXPROF=1`` additionally captures a
+``jax.profiler`` device trace next to the run log so host and device
+timelines load side by side in Perfetto.
+
+Abnormal exits: the first ``start_run`` registers an ``atexit`` hook and
+a SIGTERM handler that flush the metrics snapshot and ``run_end`` event
+before the process dies, so only a SIGKILL can truncate a stream (the
+PR 2 SIGKILL tests own that case — resume recovers the output, and
+``vctpu obs summary`` reports a truncated stream as ``incomplete``).
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import signal
 import threading
 import time
 
@@ -49,6 +62,7 @@ from variantcalling_tpu.obs.schema import SCHEMA_VERSION
 
 OBS_ENV = "VCTPU_OBS"
 OBS_PATH_ENV = "VCTPU_OBS_PATH"
+JAXPROF_ENV = "VCTPU_OBS_JAXPROF"
 
 #: flush the stream every this many events (plus manifest and run end) —
 #: a crash loses at most one flush window, without per-event fsync cost
@@ -57,7 +71,10 @@ FLUSH_EVERY = 32
 #: module fast flag — hot sites check this before doing ANY other work
 _ACTIVE = False
 _RUN: "ObsRun | None" = None
-_LOCK = threading.Lock()
+# re-entrant: the SIGTERM flush handler may fire while the main thread is
+# already inside start_run/end_run — a plain Lock would self-deadlock the
+# dying process
+_LOCK = threading.RLock()
 
 
 def enabled() -> bool:
@@ -78,8 +95,17 @@ class ObsRun:
         self.path = path
         self.tool = tool
         self.metrics = MetricsRegistry()
+        #: obs v2 attachments, owned by start_run/end_run: the resource
+        #: watermark sampler and the jax.profiler trace dir (if any)
+        self.sampler = None
+        self.jaxprof_dir: str | None = None
+        #: (strategy, kind) pairs whose cost_analysis already emitted —
+        #: the per-chunk scoring loop must pay the lower+compile ONCE
+        self.cost_recorded: set = set()
         self._fh = open(path, "w", encoding="utf-8")
-        self._lock = threading.Lock()
+        # re-entrant for the same reason as the module _LOCK: the SIGTERM
+        # flush can land while this thread is mid-_emit
+        self._lock = threading.RLock()
         self._seq = 0
         self._since_flush = 0
         # ts is derived from ONE wall anchor plus the monotonic clock so
@@ -164,6 +190,14 @@ def start_run(tool: str, default_path: str | None = None,
                                                    inputs=inputs), flush=True)
         _RUN = run
         _ACTIVE = True
+        _register_flush_handlers()
+        if knobs.get_bool(profile_mod().PROFILE_ENV):
+            # RSS/CPU watermark sampler (obs v2): daemon thread, stopped
+            # (and its watermark event emitted) by end_run
+            run.sampler = profile_mod().ResourceSampler(run)
+            run.sampler.start()
+        if knobs.get_bool(JAXPROF_ENV):
+            _start_jaxprof(run)
         logger.info("obs: recording run telemetry to %s", path)
         return run
 
@@ -177,12 +211,112 @@ def end_run(run: ObsRun | None, status: str = "ok") -> None:
     with _LOCK:
         if _RUN is not run:
             return
+        # attachments stop while the stream still accepts events (the
+        # sampler's watermark event must precede the metrics snapshot)
+        if run.sampler is not None:
+            try:
+                run.sampler.stop()
+            except RuntimeError:  # never started (racing interpreter exit)
+                pass
+            run.sampler = None
+        if run.jaxprof_dir is not None:
+            _stop_jaxprof(run)
         _ACTIVE = False
         _RUN = None
     try:
         run.close(status)
     except OSError as e:  # a full disk must not mask the run's own error
         logger.warning("obs: failed to finalize run log %s: %s", run.path, e)
+
+
+def profile_mod():
+    """The profiler module, imported lazily (it imports this package)."""
+    from variantcalling_tpu.obs import profile
+
+    return profile
+
+
+def _start_jaxprof(run: ObsRun) -> None:
+    """``VCTPU_OBS_JAXPROF=1``: capture a ``jax.profiler`` device trace
+    for the whole run into ``<run log>.jaxprof/``. The device trace and
+    the Perfetto export of this stream share the host wall clock (the
+    stream's ``ts`` is wall-anchored) and the pid/tid convention (real
+    OS ids on both sides), so the two files load side by side in one
+    Perfetto session ("Open trace file" twice)."""
+    from variantcalling_tpu.utils import degrade
+
+    logdir = run.path + ".jaxprof"
+    try:
+        import jax
+
+        jax.profiler.start_trace(logdir)
+    except Exception as e:  # noqa: BLE001 — profiling must not kill the run
+        degrade.record("obs.jaxprof_start", e, fallback="no device trace")
+        return
+    run.jaxprof_dir = logdir
+    run._emit("profile", "jaxprof_start", {"logdir": logdir})
+
+
+def _stop_jaxprof(run: ObsRun) -> None:
+    from variantcalling_tpu.utils import degrade
+
+    logdir, run.jaxprof_dir = run.jaxprof_dir, None
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+        run._emit("profile", "jaxprof_stop", {"logdir": logdir})
+        logger.info("obs: jax.profiler device trace written to %s", logdir)
+    except Exception as e:  # noqa: BLE001 — a failed stop must not mask the run's exit
+        degrade.record("obs.jaxprof_stop", e, fallback="device trace may be "
+                       "incomplete")
+
+
+# -- abnormal-exit flush (satellite: no silently truncated streams) --------
+
+_ATEXIT_REGISTERED = False
+_SIGTERM_REGISTERED = False
+
+
+def _flush_open_run(status: str) -> None:
+    run = _RUN
+    if run is not None:
+        end_run(run, status)
+
+
+def _atexit_flush() -> None:
+    # a tool that crashed between start_run and its finally (or that
+    # never had one) still gets its metrics snapshot and run_end written
+    _flush_open_run("atexit")
+
+
+def _register_flush_handlers() -> None:
+    """Idempotent: atexit once; SIGTERM only when the process still has
+    the default disposition (a host app's own handler must win) and only
+    from the main thread (signal.signal raises elsewhere). The SIGTERM
+    attempt RETRIES on later start_runs — a first run opened from a
+    worker thread must not permanently forfeit the flush for runs the
+    main thread opens afterwards."""
+    global _ATEXIT_REGISTERED, _SIGTERM_REGISTERED
+    if not _ATEXIT_REGISTERED:
+        _ATEXIT_REGISTERED = True
+        atexit.register(_atexit_flush)
+    if not _SIGTERM_REGISTERED:
+        try:
+            if threading.current_thread() is threading.main_thread() \
+                    and signal.getsignal(signal.SIGTERM) is signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, _sigterm_flush)
+                _SIGTERM_REGISTERED = True
+        except (ValueError, OSError):  # exotic platform / embedded interp
+            pass
+
+
+def _sigterm_flush(signum, frame) -> None:
+    _flush_open_run("sigterm")
+    # restore the default disposition and re-deliver so the exit code
+    # still says "killed by SIGTERM" — obs observes, it never rescues
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
 
 
 def event(kind: str, name: str, **fields) -> None:

@@ -1,9 +1,16 @@
-"""CLI: ``vctpu obs <export|summary>`` — open any obs run log in
-Perfetto, or roll it up in the terminal.
+"""CLI: ``vctpu obs <export|summary|bottleneck|diff>`` — open any obs
+run log in Perfetto, roll it up in the terminal, name the limiting
+stage, or diff two runs with a noise band.
+
+Multi-rank runs are merged transparently: every subcommand reads the
+given log PLUS any ``.rankN`` sibling logs (one timeline, rank as the
+Perfetto pid — docs/observability.md "Multi-host runs").
 
 Exit codes follow the repo-wide CLI contract: 0 success, 2 usage error /
 unreadable or malformed log (argparse's own usage failures also exit 2).
-Covered by ``tests/unit/test_obs.py``.
+``diff`` additionally exits 1 when the candidate regresses beyond the
+noise band — the sentry contract shared with ``tools/bench_gate.py``.
+Covered by ``tests/unit/test_obs.py`` / ``test_obs_profile.py``.
 """
 
 from __future__ import annotations
@@ -36,17 +43,41 @@ def get_parser() -> argparse.ArgumentParser:
     summ.add_argument("log", help="obs run log (JSONL)")
     summ.add_argument("--json", action="store_true",
                       help="emit the summary as JSON")
+
+    bott = sub.add_parser("bottleneck",
+                          help="per-stage work/wait attribution: name the "
+                               "limiting stage (obs v2 profile events)")
+    bott.add_argument("log", help="obs run log (JSONL)")
+    bott.add_argument("--json", action="store_true",
+                      help="emit the attribution as JSON")
+
+    diff = sub.add_parser("diff",
+                          help="compare a candidate run against a baseline "
+                               "run with an explicit noise band; exit 1 on "
+                               "regression")
+    diff.add_argument("candidate", help="candidate obs run log")
+    diff.add_argument("baseline", help="baseline obs run log")
+    diff.add_argument("--tolerance-pct", type=float,
+                      default=100.0 * export_mod.DIFF_TOLERANCE,
+                      help="noise band as a percentage (default %(default)s)")
+    diff.add_argument("--json", action="store_true",
+                      help="emit the diff report as JSON")
     return ap
 
 
 def _load(path: str) -> list[dict]:
-    return export_mod.read_events(path)
+    # read_run merges .rankN siblings into one timeline
+    return export_mod.read_run(path)
 
 
 def run(argv: list[str]) -> int:
     args = get_parser().parse_args(argv)
     try:
-        events = _load(args.log)
+        if args.command == "diff":
+            candidate = _load(args.candidate)
+            baseline = _load(args.baseline)
+        else:
+            events = _load(args.log)
     except (OSError, export_mod.ObsLogError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -65,6 +96,21 @@ def run(argv: list[str]) -> int:
         print(f"wrote {out_path}: {len(trace['traceEvents'])} trace events "
               "(open in https://ui.perfetto.dev)")
         return 0
+    if args.command == "bottleneck":
+        b = export_mod.bottleneck(events)
+        if args.json:
+            emit_json(b)
+        else:
+            print(export_mod.render_bottleneck(b))
+        return 0
+    if args.command == "diff":
+        report = export_mod.diff_runs(candidate, baseline,
+                                      tolerance=args.tolerance_pct / 100.0)
+        if args.json:
+            emit_json(report)
+        else:
+            print(export_mod.render_diff(report))
+        return 1 if report["regressed"] else 0
     summary = export_mod.summarize(events)
     if args.json:
         emit_json(summary)

@@ -25,7 +25,9 @@ consistent timeline.
 
 from __future__ import annotations
 
+import glob
 import json
+import re
 
 from variantcalling_tpu.obs.schema import SCHEMA_VERSION
 
@@ -65,6 +67,37 @@ def read_events(path: str) -> list[dict]:
     return events
 
 
+def read_run(path: str) -> list[dict]:
+    """Read one RUN: the given log plus any ``.rankN`` sibling logs a
+    multi-host run wrote next to it, merged into one timeline.
+
+    Rank 0's path is the base path; every rank N > 0 wrote
+    ``<path>.rankN`` (obs._rank_suffixed). With siblings present every
+    event gains a ``rank`` field and its Perfetto ``pid`` becomes the
+    rank, so the exported trace shows one process track per rank; a
+    single-rank run returns exactly :func:`read_events` (no ``rank``
+    field, OS pid preserved).
+    """
+    siblings: list[tuple[int, str]] = []
+    for p in glob.glob(glob.escape(path) + ".rank*"):
+        m = re.match(r".*\.rank(\d+)$", p)
+        if m:
+            siblings.append((int(m.group(1)), p))
+    events = read_events(path)
+    if not siblings:
+        return events
+    merged: list[dict] = []
+    for rank, rank_path in [(0, path)] + sorted(siblings):
+        rank_events = events if rank == 0 else read_events(rank_path)
+        for e in rank_events:
+            e = dict(e, rank=rank)
+            e["pid"] = rank  # rank as Perfetto pid: one track per rank
+            merged.append(e)
+    merged.sort(key=lambda e: (e.get("ts", 0), e.get("rank", 0),
+                               e.get("seq", 0)))
+    return merged
+
+
 def _args_of(event: dict) -> dict:
     return {k: v for k, v in event.items() if k not in _ENVELOPE}
 
@@ -81,9 +114,13 @@ def to_chrome_trace(events: list[dict]) -> dict:
         name = e.get("thread") if e.get("kind") == "span" else None
         if key not in threads or (name and threads[key] == "thread"):
             threads[key] = name or "thread"
+    ranked = any("rank" in e for e in events)
     for pid in sorted(pids):
+        # rank-merged timelines use the rank AS the pid (read_run), so
+        # the process track is labeled by rank
+        name = f"{tool} (rank {pid})" if ranked else tool
         trace.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-                      "ts": 0, "args": {"name": tool}})
+                      "ts": 0, "args": {"name": name}})
     for (pid, tid), name in sorted(threads.items()):
         trace.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                       "ts": 0, "args": {"name": name}})
@@ -154,7 +191,14 @@ def summarize(events: list[dict]) -> dict:
 
     slowest = sorted(chunk_spans, key=lambda e: -float(e.get("dur", 0.0)))[:5]
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
-    records = heartbeats[-1].get("records") if heartbeats else None
+    # multi-rank merged timelines (read_run): each rank reported its own
+    # progress — total records is the SUM of every rank's last heartbeat
+    last_hb_by_rank: dict = {}
+    for e in heartbeats:
+        last_hb_by_rank[e.get("rank", 0)] = e
+    records = sum(e.get("records", 0) for e in last_hb_by_rank.values()) \
+        if last_hb_by_rank else None
+    ranks = sorted({e.get("rank", 0) for e in events})
     dur = float(run_end.get("dur", 0.0)) if run_end else None
 
     return {
@@ -164,6 +208,7 @@ def summarize(events: list[dict]) -> dict:
             "status": run_end.get("status") if run_end else "incomplete",
             "duration_s": round(dur, 3) if dur is not None else None,
             "events": len(events),
+            "ranks": len(ranks),
         },
         "stages": dict(sorted(stages.items())),
         "throughput": {
@@ -177,6 +222,208 @@ def summarize(events: list[dict]) -> dict:
                            for e in slowest],
         "metrics": _args_of(metrics) if metrics else {},
     }
+
+
+# ---------------------------------------------------------------------------
+# bottleneck attribution (obs v2): who is the limiting stage?
+# ---------------------------------------------------------------------------
+
+
+def bottleneck(events: list[dict]) -> dict:
+    """Roll the ``profile`` events up into a per-stage wall-clock
+    attribution and NAME the limiting stage.
+
+    Source of truth is the ``profile``/``stage`` + ``profile``/``pipeline``
+    events the streaming executor emits (work vs queue-wait vs
+    backpressure-wait per stage); a log without them (a serial run
+    predating profiling, or ``VCTPU_OBS_PROFILE=0``) falls back to
+    depth-0 trace spans — work attribution only, waits unknown. Every
+    stage's ``work/wait_in/wait_out/other`` percentages sum to ~100% of
+    the pipeline wall clock (``other`` = the stage thread's untracked
+    time: startup, teardown, span bookkeeping). The limiting stage is
+    the one with the largest work share — in a pipelined executor its
+    work IS the wall clock floor, so it is the stage ROADMAP item 1 must
+    shrink.
+    """
+    stage_events = [e for e in events
+                    if e.get("kind") == "profile" and e.get("name") == "stage"]
+    pipe_events = [e for e in events
+                   if e.get("kind") == "profile" and e.get("name") == "pipeline"]
+    run_end = next((e for e in reversed(events)
+                    if e.get("kind") == "run_end"), None)
+
+    stages: dict[str, dict] = {}
+    if stage_events:
+        source = "profile"
+        wall = sum(float(e.get("wall_s", 0.0)) for e in pipe_events) or \
+            (float(run_end.get("dur", 0.0)) if run_end else 0.0)
+        records = sum(int(e.get("records", 0)) for e in pipe_events)
+        for e in stage_events:  # several pipelines in one stream: sum
+            s = stages.setdefault(e.get("stage", "?"), {
+                "work_s": 0.0, "wait_in_s": 0.0, "wait_out_s": 0.0,
+                "items": 0, "bytes_in": 0, "bytes_out": 0})
+            s["work_s"] += float(e.get("work_s", 0.0))
+            s["wait_in_s"] += float(e.get("wait_in_s", 0.0))
+            s["wait_out_s"] += float(e.get("wait_out_s", 0.0))
+            s["items"] += int(e.get("items", 0))
+            s["bytes_in"] += int(e.get("bytes_in", 0))
+            s["bytes_out"] += int(e.get("bytes_out", 0))
+    else:
+        # fallback: depth-0 spans (serial runs, profiling off) — honest
+        # about what it is: work only, waits unattributable
+        source = "spans"
+        records = 0
+        wall = float(run_end.get("dur", 0.0)) if run_end else 0.0
+        for e in events:
+            if e.get("kind") != "span" or e.get("depth", 0) != 0:
+                continue
+            s = stages.setdefault(e.get("name", "span"), {
+                "work_s": 0.0, "wait_in_s": 0.0, "wait_out_s": 0.0,
+                "items": 0, "bytes_in": 0, "bytes_out": 0})
+            s["work_s"] += float(e.get("dur", 0.0))
+            s["items"] += 1
+
+    for s in stages.values():
+        tracked = s["work_s"] + s["wait_in_s"] + s["wait_out_s"]
+        s["other_s"] = max(0.0, wall - tracked) if source == "profile" else 0.0
+        for key in ("work", "wait_in", "wait_out", "other"):
+            s[f"{key}_pct"] = round(100.0 * s[f"{key}_s"] / wall, 1) \
+                if wall > 0 else 0.0
+            s[f"{key}_s"] = round(s[f"{key}_s"], 6)
+        if records and s["work_s"] > 0:
+            # standalone throughput: what the stage sustains while busy
+            s["vps"] = round(records / s["work_s"])
+
+    limiting = max(stages, key=lambda n: stages[n]["work_s"]) if stages else None
+    out = {
+        "source": source,
+        "wall_s": round(wall, 6),
+        "records": records or None,
+        "e2e_vps": round(records / wall) if records and wall > 0 else None,
+        "limiting_stage": limiting,
+        "limiting_work_pct": stages[limiting]["work_pct"] if limiting else None,
+        "stages": dict(sorted(stages.items(),
+                              key=lambda kv: -kv[1]["work_s"])),
+    }
+    cost = [e for e in events if e.get("kind") == "profile"
+            and e.get("name") == "cost_analysis"]
+    if cost:
+        out["cost_analysis"] = _args_of(cost[-1])
+    res = [e for e in events if e.get("kind") == "profile"
+           and e.get("name") == "resources"]
+    if res:
+        out["resources"] = _args_of(res[-1])
+    return out
+
+
+def render_bottleneck(b: dict) -> str:
+    """Human-readable attribution table (``vctpu obs bottleneck``)."""
+    lines = []
+    if b["limiting_stage"] is not None:
+        lines.append(f"limiting stage: {b['limiting_stage']} "
+                     f"({b['limiting_work_pct']:.1f}% of {b['wall_s']:.3f}s "
+                     f"wall working)")
+    else:
+        lines.append("no stage attribution in this log")
+    if b.get("e2e_vps"):
+        lines.append(f"throughput: {b['records']} records, "
+                     f"{b['e2e_vps']}/s end to end")
+    if b["stages"]:
+        width = max(len(n) for n in b["stages"])
+        lines.append(f"  {'stage':<{width}}  {'work%':>6} {'wait-in%':>8} "
+                     f"{'wait-out%':>9} {'other%':>6} {'work_s':>9} "
+                     f"{'v/s-alone':>10}  bytes")
+        for name, s in b["stages"].items():
+            byt = []
+            if s.get("bytes_in"):
+                byt.append(f"{s['bytes_in'] / (1 << 20):.1f}MB in")
+            if s.get("bytes_out"):
+                byt.append(f"{s['bytes_out'] / (1 << 20):.1f}MB out")
+            lines.append(
+                f"  {name:<{width}}  {s['work_pct']:>6.1f} "
+                f"{s['wait_in_pct']:>8.1f} {s['wait_out_pct']:>9.1f} "
+                f"{s['other_pct']:>6.1f} {s['work_s']:>9.3f} "
+                f"{s.get('vps', '-'):>10}  {' '.join(byt)}")
+    if b["source"] == "spans":
+        lines.append("(span fallback: work attribution only — rerun with "
+                     "VCTPU_OBS=1 + profiling for wait attribution)")
+    ca = b.get("cost_analysis")
+    if ca and ca.get("flops_per_variant"):
+        lines.append(f"scoring program ({ca.get('strategy')}): "
+                     f"{ca['flops_per_variant']:.0f} FLOP/variant measured by "
+                     f"XLA cost_analysis; v5e roofline "
+                     f"{ca.get('roofline_vps_v5e', 0)} v/s")
+    res = b.get("resources")
+    if res:
+        lines.append(f"watermarks: rss {res.get('rss_peak_mb')} MB peak, "
+                     f"host cpu {res.get('cpu_peak_pct')}% peak "
+                     f"({res.get('samples')} samples)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# run diff (regression sentry): A vs baseline B with explicit noise bands
+# ---------------------------------------------------------------------------
+
+#: default per-metric tolerance (fraction) for `vctpu obs diff`
+DIFF_TOLERANCE = 0.08
+
+
+def diff_runs(candidate: list[dict], baseline: list[dict],
+              tolerance: float = DIFF_TOLERANCE) -> dict:
+    """Compare a candidate run against a baseline run with an explicit
+    noise band; the sentry half of `vctpu obs diff A B`.
+
+    Regressions (beyond ``tolerance``, a fraction): wall clock up,
+    end-to-end throughput down, or any shared stage's work seconds up.
+    Improvements are reported, never fatal. Returns the report dict;
+    ``report["regressed"]`` drives the CLI exit code.
+    """
+    cand, base = bottleneck(candidate), bottleneck(baseline)
+    checks: list[dict] = []
+
+    def check(metric: str, new, old, higher_is_better: bool) -> None:
+        if not new or not old:
+            return
+        ratio = new / old
+        if higher_is_better:
+            regressed = ratio < 1 - tolerance
+        else:
+            regressed = ratio > 1 + tolerance
+        checks.append({"metric": metric, "candidate": new, "baseline": old,
+                       "delta_pct": round(100.0 * (ratio - 1), 2),
+                       "tolerance_pct": round(100.0 * tolerance, 2),
+                       "regressed": regressed})
+
+    check("wall_s", cand["wall_s"], base["wall_s"], higher_is_better=False)
+    check("e2e_vps", cand.get("e2e_vps"), base.get("e2e_vps"),
+          higher_is_better=True)
+    for name in sorted(set(cand["stages"]) & set(base["stages"])):
+        check(f"stage.{name}.work_s", cand["stages"][name]["work_s"],
+              base["stages"][name]["work_s"], higher_is_better=False)
+    return {
+        "tolerance_pct": round(100.0 * tolerance, 2),
+        "limiting_stage": {"candidate": cand["limiting_stage"],
+                           "baseline": base["limiting_stage"]},
+        "checks": checks,
+        "regressed": any(c["regressed"] for c in checks),
+    }
+
+
+def render_diff(report: dict) -> str:
+    lines = [f"obs diff (noise band ±{report['tolerance_pct']}%):"]
+    for c in report["checks"]:
+        mark = "REGRESSED" if c["regressed"] else "ok"
+        lines.append(f"  {c['metric']:<28} {c['baseline']:>12} -> "
+                     f"{c['candidate']:>12}  {c['delta_pct']:+7.2f}%  {mark}")
+    ls = report["limiting_stage"]
+    if ls["candidate"] != ls["baseline"]:
+        lines.append(f"  limiting stage moved: {ls['baseline']} -> "
+                     f"{ls['candidate']}")
+    lines.append("result: " + ("REGRESSION beyond the noise band"
+                               if report["regressed"] else
+                               "within the noise band"))
+    return "\n".join(lines)
 
 
 def render_summary(summary: dict) -> str:

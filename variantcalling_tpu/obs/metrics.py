@@ -11,10 +11,15 @@ lock on the record path**.
   race between threads.
 - :class:`Gauge` is a single atomic assignment, with a monotonic
   ``peak`` kept per thread the same way counters are.
-- :class:`Histogram` tracks count/sum/min/max plus a bounded ring of
-  recent samples (the "time series" view: enough to see per-chunk
-  variants/sec drift without unbounded memory). Observations are
-  per-thread merged at snapshot, like counters.
+- :class:`Histogram` tracks count/sum/min/max, a bounded ring of recent
+  samples (the "time series" view: enough to see per-chunk variants/sec
+  drift without unbounded memory), and a FIXED-BUCKET log-spaced count
+  array (HDR-histogram style): every observation lands in one of
+  :data:`N_BUCKETS` geometric buckets spanning 1 µs .. ~10⁹, so the
+  snapshot can report p50/p95/p99 with bounded relative error
+  (≤ ~4.4%, half a bucket) and bounded memory regardless of sample
+  count — the substrate for per-stage latency SLOs (``vctpu serve``).
+  Observations are per-thread merged at snapshot, like counters.
 
 A registry belongs to one obs run; ``snapshot()`` is called once at run
 end (and by ``vctpu obs summary`` via the emitted ``metrics`` event), so
@@ -23,11 +28,56 @@ snapshot-side merging can afford to walk the per-thread cells.
 
 from __future__ import annotations
 
+import math
 import threading
 
 #: recent-sample ring size per histogram per thread (the merged snapshot
 #: interleaves threads; 64 per thread bounds memory at any fan-out)
 RECENT = 64
+
+#: fixed log-spaced bucket geometry: bucket i's inclusive upper bound is
+#: ``HIST_MIN * HIST_FACTOR**i``. FACTOR = 2**0.125 bounds the quantile
+#: estimate's relative error at sqrt(FACTOR)-1 ≈ 4.4% (geometric-midpoint
+#: reporting, half a bucket) at 400 int cells per recording thread —
+#: HDR-histogram resolution without per-sample storage (range 1µs..~10⁹).
+HIST_MIN = 1e-6
+HIST_FACTOR = 2.0 ** 0.125
+N_BUCKETS = 400
+_LOG_FACTOR = math.log(HIST_FACTOR)
+
+#: percentiles published in every histogram snapshot (serve-SLO substrate)
+SNAPSHOT_QUANTILES = (0.5, 0.95, 0.99)
+
+
+def bucket_index(v: float) -> int:
+    """The fixed bucket a value lands in (0 = underflow, N-1 = overflow)."""
+    if v <= HIST_MIN:
+        return 0
+    idx = int(math.log(v / HIST_MIN) / _LOG_FACTOR) + 1
+    return idx if idx < N_BUCKETS else N_BUCKETS - 1
+
+
+def bucket_bound(i: int) -> float:
+    """Bucket ``i``'s inclusive upper bound."""
+    return HIST_MIN * HIST_FACTOR ** i
+
+
+def quantile_from_buckets(buckets: list[int], count: int, q: float) -> float | None:
+    """Quantile estimate from a merged bucket-count array: find the
+    bucket holding the q-th ranked sample and report its geometric
+    midpoint (half-bucket worst-case error)."""
+    if count <= 0:
+        return None
+    rank = q * count
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= rank:
+            hi = bucket_bound(i)
+            if i == 0:
+                return hi
+            return math.sqrt(bucket_bound(i - 1) * hi)
+    return bucket_bound(N_BUCKETS - 1)
 
 
 class Counter:
@@ -83,7 +133,7 @@ class Gauge:
 
 
 class _HistCell:
-    __slots__ = ("count", "total", "vmin", "vmax", "recent")
+    __slots__ = ("count", "total", "vmin", "vmax", "recent", "buckets")
 
     def __init__(self):
         self.count = 0
@@ -91,10 +141,12 @@ class _HistCell:
         self.vmin: float | None = None
         self.vmax: float | None = None
         self.recent: list[float] = []
+        self.buckets = [0] * N_BUCKETS
 
 
 class Histogram:
-    """count/sum/min/max + a bounded recent-sample ring, per thread."""
+    """count/sum/min/max + fixed log buckets (p50/p95/p99) + a bounded
+    recent-sample ring, per thread."""
 
     __slots__ = ("name", "_cells")
 
@@ -115,9 +167,25 @@ class Histogram:
             cell.vmin = v
         if cell.vmax is None or v > cell.vmax:
             cell.vmax = v
+        cell.buckets[bucket_index(v)] += 1
         cell.recent.append(v)
         if len(cell.recent) > RECENT:
             del cell.recent[0]
+
+    def merged_buckets(self) -> tuple[list[int], int]:
+        """(summed bucket counts, total count) across recording threads."""
+        cells = list(self._cells.values())
+        merged = [0] * N_BUCKETS
+        for c in cells:
+            for i, n in enumerate(c.buckets):
+                if n:
+                    merged[i] += n
+        return merged, sum(c.count for c in cells)
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-resolution quantile (≤ ~4.4% relative error)."""
+        merged, count = self.merged_buckets()
+        return quantile_from_buckets(merged, count, q)
 
     def snapshot(self) -> dict:
         cells = list(self._cells.values())
@@ -128,7 +196,8 @@ class Histogram:
         recent: list[float] = []
         for c in cells:
             recent.extend(c.recent)
-        return {
+        merged, _ = self.merged_buckets()
+        out = {
             "count": count,
             "sum": round(total, 6),
             "mean": round(total / count, 6) if count else 0,
@@ -136,6 +205,10 @@ class Histogram:
             "max": max(maxs) if maxs else None,
             "recent": [round(v, 6) for v in recent[-RECENT:]],
         }
+        for q in SNAPSHOT_QUANTILES:
+            est = quantile_from_buckets(merged, count, q)
+            out[f"p{int(q * 100)}"] = round(est, 9) if est is not None else None
+        return out
 
 
 class _Noop:

@@ -1,0 +1,293 @@
+"""Performance-attribution profiler (obs v2): where wall-clock, bytes
+and device FLOPs actually go.
+
+PR 5's event stream records *what happened*; this module records *what
+it cost*. The post-PR-5 diagnosis (ROADMAP) is that the system is
+host-IO-bound — streaming e2e ~0.7–0.86M v/s against a 2.25M v/s hot
+path — but nothing could attribute the gap. The GPU-cluster
+variant-calling pipeline work (arXiv 2509.09058, PAPERS.md) gets its
+speedups from per-stage utilization profiling *before* parallelizing;
+this is that layer:
+
+- :class:`StageProfiler` / :class:`StageStats` — per-stage wall-clock
+  attribution for the streaming executor: **work** (inside the stage
+  callable) vs **wait-in** (blocked on the upstream queue) vs
+  **wait-out** (backpressured on the downstream queue), plus
+  items/records/bytes in/out. The executor (``parallel/pipeline.py``)
+  and the filter writeback loop feed it; :meth:`StageProfiler.emit`
+  lands one schema-versioned ``profile``/``stage`` event per stage plus
+  a ``profile``/``pipeline`` wall event. ``vctpu obs bottleneck`` rolls
+  them up and names the limiting stage.
+- :class:`ResourceSampler` — a daemon thread sampling process RSS and
+  host-CPU utilization every ``VCTPU_OBS_SAMPLE_S`` seconds into run
+  gauges (``proc.rss_mb`` / ``proc.cpu_pct``, peaks kept by the gauge),
+  with a final ``profile``/``resources`` watermark event.
+- :func:`xla_cost_analysis` / :func:`record_scoring_cost` — runtime
+  MFU/roofline attribution: FLOPs from the XLA compiler's
+  ``cost_analysis`` on the *compiled* scoring program (replacing
+  bench.py's analytic projection with the compiler's own count),
+  emitted as a ``profile``/``cost_analysis`` event per run with the
+  resolved strategy.
+
+Everything here is gated on ``enabled()`` — obs recording must be on
+(``VCTPU_OBS=1``) AND profiling not opted out (``VCTPU_OBS_PROFILE``,
+default on). The PR 5 contracts hold with profiling enabled: output
+bytes are identical, and total obs+profile overhead stays inside the 2%
+budget (bench ``obs_overhead_pct``, now median-of-5 paired runs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from variantcalling_tpu import knobs, obs
+
+PROFILE_ENV = "VCTPU_OBS_PROFILE"
+SAMPLE_ENV = "VCTPU_OBS_SAMPLE_S"
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def enabled() -> bool:
+    """Profiling is on: an obs run is open and not opted out."""
+    return obs.active() and knobs.get_bool(PROFILE_ENV)
+
+
+class StageStats:
+    """One stage's attribution accumulators.
+
+    Each stage of the executor runs on exactly ONE thread, so plain
+    float adds need no lock on the record path (the snapshot reader
+    crosses threads only after the pipeline joined its workers).
+    """
+
+    __slots__ = ("name", "work_s", "wait_in_s", "wait_out_s",
+                 "items", "records", "bytes_in", "bytes_out")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.work_s = 0.0
+        self.wait_in_s = 0.0
+        self.wait_out_s = 0.0
+        self.items = 0
+        self.records = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+    def add_work(self, dt: float, items: int = 1,
+                 bytes_in: int = 0, bytes_out: int = 0) -> None:
+        self.work_s += dt
+        self.items += items
+        self.bytes_in += bytes_in
+        self.bytes_out += bytes_out
+
+    def add_wait_in(self, dt: float) -> None:
+        self.wait_in_s += dt
+
+    def add_wait_out(self, dt: float) -> None:
+        self.wait_out_s += dt
+
+    def snapshot(self) -> dict:
+        out = {
+            "stage": self.name,
+            "work_s": round(self.work_s, 6),
+            "wait_in_s": round(self.wait_in_s, 6),
+            "wait_out_s": round(self.wait_out_s, 6),
+            "items": self.items,
+        }
+        if self.records:
+            out["records"] = self.records
+            if self.work_s > 0:
+                # the stage's standalone throughput: what it could sustain
+                # if it never waited — the number ROADMAP item 1 must move
+                out["vps"] = round(self.records / self.work_s)
+        if self.bytes_in:
+            out["bytes_in"] = self.bytes_in
+        if self.bytes_out:
+            out["bytes_out"] = self.bytes_out
+        return out
+
+
+class StageProfiler:
+    """Per-stage attribution for one pipeline run; stages are created on
+    demand and keyed by name, so the executor and its caller (which owns
+    e.g. the writeback loop) can feed the same profile."""
+
+    def __init__(self):
+        self._stages: dict[str, StageStats] = {}
+        self._lock = threading.Lock()
+
+    def stage(self, name: str) -> StageStats:
+        s = self._stages.get(name)
+        if s is None:
+            with self._lock:
+                s = self._stages.setdefault(name, StageStats(name))
+        return s
+
+    def set_records(self, n: int) -> None:
+        """Every stage of a linear pipeline saw all N records."""
+        for s in self._stages.values():
+            s.records = n
+
+    def emit(self, wall_s: float, records: int | None = None) -> None:
+        """Write the attribution into the open obs stream: one
+        ``profile``/``stage`` event per stage (executor order is not
+        meaningful here — ``vctpu obs bottleneck`` sorts by work share)
+        plus the ``profile``/``pipeline`` wall event the roll-up divides
+        by."""
+        if records is not None:
+            self.set_records(records)
+        if not obs.active():
+            return
+        total_in = total_out = 0
+        for name in self._stages:
+            snap = self._stages[name].snapshot()
+            total_in += snap.get("bytes_in", 0)
+            total_out += snap.get("bytes_out", 0)
+            obs.event("profile", "stage", **snap)
+        obs.event("profile", "pipeline", wall_s=round(wall_s, 6),
+                  stages=sorted(self._stages),
+                  records=records if records is not None else 0,
+                  bytes_in=total_in, bytes_out=total_out)
+
+
+def _rss_bytes() -> int:
+    """Current RSS from /proc (Linux); 0 when unreadable (the gauge then
+    just never moves — telemetry must not throw)."""
+    try:
+        with open("/proc/self/statm", "rb") as fh:
+            return int(fh.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+class ResourceSampler(threading.Thread):
+    """Daemon thread: RSS + process-CPU utilization watermarks.
+
+    ``proc.rss_mb`` and ``proc.cpu_pct`` gauges update every interval;
+    the Gauge keeps the peak, so the metrics snapshot carries the run's
+    high-water marks even though only the last sample's value survives.
+    ``cpu_pct`` is process CPU time over wall time — >100 means multiple
+    cores busy (the streaming executor's whole point), so the watermark
+    doubles as a parallelism check against the ``scaling`` bench rows.
+    """
+
+    def __init__(self, run, interval_s: float | None = None):
+        super().__init__(name="obs-sampler", daemon=True)
+        # NB: attribute names must dodge the Thread API (run/_stop are
+        # Thread internals)
+        self.obs_run = run
+        self.interval_s = (knobs.get_float(SAMPLE_ENV)
+                           if interval_s is None else interval_s)
+        self._halt = threading.Event()
+        self.samples = 0
+        # run-start baseline: the final sample in stop() measures the
+        # WHOLE run against it, so a run shorter than one interval still
+        # gets a real CPU utilization (the gauge keeps the peak of both)
+        self._t0 = time.perf_counter()
+        self._cpu0 = time.process_time()
+
+    def sample_once(self, t_prev: float, cpu_prev: float) -> tuple[float, float]:
+        t_now = time.perf_counter()
+        cpu_now = time.process_time()
+        rss = _rss_bytes()
+        if rss:
+            self.obs_run.metrics.gauge("proc.rss_mb").set(
+                round(rss / (1 << 20), 2))
+        dt = t_now - t_prev
+        if dt > 0:
+            self.obs_run.metrics.gauge("proc.cpu_pct").set(
+                round(100.0 * (cpu_now - cpu_prev) / dt, 1))
+        self.samples += 1
+        return t_now, cpu_now
+
+    def run(self) -> None:  # noqa: A003 — Thread API
+        t_prev, cpu_prev = time.perf_counter(), time.process_time()
+        while not self._halt.wait(self.interval_s):
+            t_prev, cpu_prev = self.sample_once(t_prev, cpu_prev)
+
+    def stop(self) -> None:
+        """Stop sampling, take one final sample, and emit the watermark
+        event (called by ``obs.end_run`` before the metrics snapshot so
+        the peaks land in it)."""
+        self._halt.set()
+        self.join(timeout=2.0)
+        # final sample: whole-run averages against the start baseline —
+        # catches a run shorter than one interval, and the gauges keep
+        # the max of this and every periodic sample
+        self.sample_once(self._t0, self._cpu0)
+        g_rss = self.obs_run.metrics.gauge("proc.rss_mb")
+        g_cpu = self.obs_run.metrics.gauge("proc.cpu_pct")
+        obs.event("profile", "resources", rss_peak_mb=g_rss.peak,
+                  cpu_peak_pct=g_cpu.peak, samples=self.samples,
+                  interval_s=self.interval_s)
+
+
+# ---------------------------------------------------------------------------
+# runtime MFU / roofline attribution (XLA cost_analysis)
+# ---------------------------------------------------------------------------
+
+#: v5e peak bf16 throughput — the MFU denominator bench.py uses; kept in
+#: one place so the run-time and bench-time numbers cannot disagree
+TPU_PEAK_FLOPS = 197e12
+
+
+def xla_cost_analysis(jitted, *args) -> dict | None:
+    """FLOPs/bytes from the XLA compiler for ``jitted(*args)``.
+
+    ``args`` may be real arrays or ``jax.ShapeDtypeStruct``\\ s — only
+    shapes/dtypes matter. Returns ``{"flops": float, "bytes_accessed":
+    float}`` or None when the backend/build has no cost model (recorded
+    as a degradation, never raised: attribution is telemetry).
+    """
+    from variantcalling_tpu.utils import degrade
+
+    try:
+        compiled = jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out = {"flops": float(ca.get("flops", 0.0) or 0.0)}
+        if ca.get("bytes accessed"):
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        return out
+    except Exception as e:  # noqa: BLE001 — attribution is telemetry, never fatal
+        degrade.record("obs.cost_analysis", e,
+                       fallback="no runtime FLOP attribution for this run")
+        return None
+
+
+def record_scoring_cost(strategy: str, jitted, args, n_variants: int) -> None:
+    """Emit the run's ``profile``/``cost_analysis`` event: measured (not
+    projected) FLOPs per variant for the compiled scoring program that
+    actually ran, named by the resolved forest strategy.
+
+    Emitted ONCE per (run, strategy): the streaming executor scores per
+    chunk, and a per-chunk lower+compile would wreck the <2% overhead
+    budget — the first chunk's shapes stand for the run (steady-state
+    chunks share one bucketed shape by design). ``args`` are one chunk's
+    call arguments — shapes only are read.
+    """
+    if not enabled():
+        return
+    run = obs.current()
+    if run is None or (strategy, "cost") in run.cost_recorded:
+        return
+    run.cost_recorded.add((strategy, "cost"))
+    import jax
+
+    shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+    cost = xla_cost_analysis(jitted, *shapes)
+    if cost is None:
+        return
+    fields = dict(cost, strategy=strategy, n=int(n_variants))
+    if n_variants > 0 and cost["flops"] > 0:
+        fpv = cost["flops"] / n_variants
+        fields["flops_per_variant"] = round(fpv, 1)
+        # the v5e roofline this program could reach at 100% MXU duty —
+        # docs/perf_notes.md divides measured v/s by this for run MFU
+        fields["roofline_vps_v5e"] = round(TPU_PEAK_FLOPS / fpv)
+    obs.event("profile", "cost_analysis", **fields)

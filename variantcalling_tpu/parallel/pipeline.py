@@ -50,6 +50,37 @@ from variantcalling_tpu.utils import faults
 
 _SENTINEL = object()
 
+
+def _get_timed(q: queue.Queue, stats) -> tuple[bool, object]:
+    """One bounded (0.1s) queue get, with the blocked time accounted to
+    ``stats.wait_in`` when profiling — the ONE spelling of the wait-in
+    attribution (stage workers and the consumer share it, so the
+    accounting cannot drift between copies). Returns ``(ok, item)``."""
+    if stats is None:
+        try:
+            return True, q.get(timeout=0.1)
+        except queue.Empty:
+            return False, None
+    t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs queue-wait attribution
+    try:
+        return True, q.get(timeout=0.1)
+    except queue.Empty:
+        return False, None
+    finally:
+        stats.add_wait_in(time.perf_counter() - t0)  # vctpu-lint: disable=VCT006 — obs queue-wait attribution
+
+
+def _put_timed(put: Callable, q: queue.Queue, item, stats) -> bool:
+    """One bounded put through ``put`` (the pipeline's cancellable
+    ``_put``), with the blocked time accounted to ``stats.wait_out``
+    when profiling — the one spelling of backpressure attribution."""
+    if stats is None:
+        return put(q, item)
+    t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs backpressure-wait attribution
+    ok = put(q, item)
+    stats.add_wait_out(time.perf_counter() - t0)  # vctpu-lint: disable=VCT006 — obs backpressure-wait attribution
+    return ok
+
 #: default per-run watchdog deadline (seconds of NO pipeline progress);
 #: generous — chunks normally flow every few hundred ms, and a legitimate
 #: slow stage still heartbeats by finishing items. 0 disables. The value
@@ -127,13 +158,24 @@ class StagePipeline:
     """
 
     def __init__(self, stages: list[Callable], queue_depth: int = 2,
-                 threads: int | None = None, timeout: float | None = None):
+                 threads: int | None = None, timeout: float | None = None,
+                 profiler=None, source_name: str = "source",
+                 consumer_name: str = "consume"):
         if not stages:
             raise ValueError("StagePipeline needs at least one stage")
         self.stages = list(stages)
         self.queue_depth = max(1, int(queue_depth))
         self.threads = resolve_threads() if threads is None else max(1, int(threads))
         self.timeout = resolve_stage_timeout() if timeout is None else max(0.0, float(timeout))
+        #: obs v2 attribution (obs/profile.StageProfiler) — the executor
+        #: feeds work vs queue-wait vs backpressure-wait per stage into
+        #: it; the CALLER owns emit() (it knows the run's wall clock and
+        #: record count). ``source_name``/``consumer_name`` label the
+        #: feed thread's reads and the consumer loop's waits (the filter
+        #: passes "ingest"/"writeback").
+        self.profiler = profiler
+        self.source_name = source_name
+        self.consumer_name = consumer_name
         #: threads that refused to join within the cleanup grace period on
         #: the most recent run (a truly wedged native call cannot be
         #: interrupted from Python; they are daemons and die with the
@@ -149,19 +191,58 @@ class StagePipeline:
     def _stage_name(self, i: int) -> str:
         return getattr(self.stages[i], "__name__", None) or f"stage{i}"
 
+    def _active_profiler(self):
+        """The attribution sink for this run, or None (profiling rides
+        the obs run: no stream, or ``VCTPU_OBS_PROFILE=0``, no cost)."""
+        if self.profiler is None or not obs.active():
+            return None
+        return self.profiler if obs.profile_mod().enabled() else None
+
+    def _record_stage_work(self, name: str, dt: float, seq: int, prof) -> None:
+        """One stage item closed: span + latency histogram + attribution."""
+        obs.span(name, dt, threading.current_thread().name, chunk=seq)
+        obs.histogram(f"stage.{name}.s").observe(dt)
+        if prof is not None:
+            prof.stage(name).add_work(dt)
+
+    def _next_timed(self, it: Iterator, seq: int, prof) -> tuple[bool, object]:
+        """One source read, timed into the source stage when obs is on
+        (shared by the serial loop and the feed thread). ``(ok, item)``."""
+        if not obs.active():
+            try:
+                return True, next(it)
+            except StopIteration:
+                return False, None
+        t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+        try:
+            item = next(it)
+        except StopIteration:
+            return False, None
+        self._record_stage_work(self.source_name,
+                                time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
+        return True, item
+
     def _run_serial(self, source: Iterable) -> Iterator:
-        for seq, item in enumerate(source):
+        prof = self._active_profiler()
+        it = iter(source)
+        seq = 0
+        while True:
+            ok, item = self._next_timed(it, seq, prof)
+            if not ok:
+                break
             faults.check("pipeline.stage")
             faults.check("pipeline.stage_hang")
             for i, fn in enumerate(self.stages):
                 if obs.active():
                     t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
                     item = fn(item)
-                    obs.span(self._stage_name(i), time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs span timing
-                             threading.current_thread().name, chunk=seq)
+                    self._record_stage_work(
+                        self._stage_name(i),
+                        time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
                 else:
                     item = fn(item)
             yield item
+            seq += 1
 
     # -- threaded path -----------------------------------------------------
 
@@ -212,11 +293,20 @@ class StagePipeline:
         # exception. Only the consumer sets stop (on error or completion);
         # upstream workers blocked on full queues unblock when it drains.
 
+        prof = self._active_profiler()
+
         def _feed() -> None:
+            src = prof.stage(self.source_name) if prof is not None else None
             try:
-                for seq, item in enumerate(source):
-                    if not _put(queues[0], (seq, item)):
+                it = iter(source)
+                seq = 0
+                while True:
+                    ok, item = self._next_timed(it, seq, prof)
+                    if not ok:
+                        break
+                    if not _put_timed(_put, queues[0], (seq, item), src):
                         return
+                    seq += 1
                 _put(queues[0], _SENTINEL)
             # not a swallow: the consumer re-raises the relayed exception
             except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
@@ -224,11 +314,11 @@ class StagePipeline:
 
         def _stage(i: int, fn: Callable) -> None:
             q_in, q_out = queues[i], queues[i + 1]
+            stats = prof.stage(self._stage_name(i)) if prof is not None else None
             try:
                 while not stop.is_set():
-                    try:
-                        got = q_in.get(timeout=0.1)
-                    except queue.Empty:
+                    ok, got = _get_timed(q_in, stats)
+                    if not ok:
                         continue
                     if got is _SENTINEL or (isinstance(got, tuple) and got[0] is _SENTINEL):
                         _put(q_out, got)
@@ -243,8 +333,9 @@ class StagePipeline:
                         if obs.active():
                             t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
                             out = fn(item)
-                            obs.span(self._stage_name(i), time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs span timing
-                                     threading.current_thread().name, chunk=seq)
+                            self._record_stage_work(
+                                self._stage_name(i),
+                                time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
                             # queue pressure AFTER this stage produced:
                             # depth ~= items waiting for the next stage
                             obs.gauge(f"queue.stage{i}.depth").set(q_out.qsize())
@@ -252,7 +343,7 @@ class StagePipeline:
                             out = fn(item)
                     finally:
                         busy_since[i] = None
-                    _put(q_out, (seq, out))
+                    _put_timed(_put, q_out, (seq, out), stats)
             # not a swallow: the consumer re-raises the relayed exception
             except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
                 _put(q_out, (_SENTINEL, e))
@@ -267,11 +358,11 @@ class StagePipeline:
             w.start()
         expect = 0
         last_progress = time.monotonic()
+        consume = prof.stage(self.consumer_name) if prof is not None else None
         try:
             while True:
-                try:
-                    got = queues[-1].get(timeout=0.1)
-                except queue.Empty:
+                ok, got = _get_timed(queues[-1], consume)
+                if not ok:
                     if stop.is_set():
                         # a failed stage may have died before relaying
                         raise RuntimeError("stage pipeline cancelled")

@@ -460,16 +460,26 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
         )
         if genome_resident:
             # padding positions sit past the genome end -> all-N windows
-            pending.append((lo, hi, fn(genome.blocks,
-                                       prep(gpos_all, fill=gpos_fill),
-                                       *common)))
+            call_args = (genome.blocks, prep(gpos_all, fill=gpos_fill), *common)
         else:
-            pending.append((lo, hi, fn(prep(windows, fill=4), *common)))
+            call_args = (prep(windows, fill=4), *common)
+        pending.append((lo, hi, fn(*call_args)))
+        last_call = (call_args, target)
         while len(pending) > 2:
             plo, phi, res = pending.pop(0)
             out[plo:phi] = finish(res, phi - plo)
     for lo, hi, res in pending:
         out[lo:hi] = finish(res, hi - lo)
+    if n and obs.active() and isinstance(model, FlatForest):
+        # runtime MFU/roofline attribution (obs v2): the XLA compiler's
+        # own FLOP count for the compiled fused program that scored this
+        # run, per resolved strategy — replaces bench.py's analytic
+        # projection with a measurement. Post-loop so the lower+compile
+        # walk never sits in the chunk cadence; shapes only are read.
+        from variantcalling_tpu.obs import profile as profile_mod
+
+        profile_mod.record_scoring_cost(
+            forest_mod.last_strategy, fn, last_call[0], last_call[1])
     return out
 
 
@@ -1019,7 +1029,17 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                       outcome="fresh" if resume_enabled else "opted_out",
                       journaling=resume_enabled)
 
-    pipe = StagePipeline([score_stage, render_stage], queue_depth=2)
+    # obs v2 attribution: the executor feeds per-stage work/queue-wait/
+    # backpressure into the profiler; this loop adds writeback work and
+    # the IO byte totals. One emit at commit time -> `vctpu obs
+    # bottleneck` names the limiting stage (ROADMAP item 1's metric).
+    from variantcalling_tpu.obs import profile as profile_mod
+
+    prof = profile_mod.StageProfiler() if profile_mod.enabled() else None
+    wb = prof.stage("writeback") if prof is not None else None
+    pipe = StagePipeline([score_stage, render_stage], queue_depth=2,
+                         profiler=prof, source_name="ingest",
+                         consumer_name="writeback")
     gen = pipe.run(iter(reader))
     ok = False
     # heartbeat bookkeeping (obs only). Progress (pct) counts ALL
@@ -1043,7 +1063,13 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 _sink_write(sink, header_bytes)
             for body, k, p in gen:
                 data = memoryview(body) if isinstance(body, np.ndarray) else body
-                _sink_write(sink, data)
+                if wb is not None:
+                    t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs writeback attribution
+                    _sink_write(sink, data)
+                    wb.add_work(_time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs writeback attribution
+                                bytes_out=len(data))
+                else:
+                    _sink_write(sink, data)
                 n_total += k
                 n_pass += p
                 n_chunks += 1
@@ -1107,6 +1133,15 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     os.replace(part_path, out_path)  # atomic commit
     if obs.active():
         obs.event("journal", "committed", chunks=n_chunks, records=n_total)
+    if prof is not None:
+        # ingest byte attribution: the reader consumes chunk_bytes of
+        # (decompressed) text per chunk; cap at the file size only when
+        # the two are comparable (plain-text inputs, heartbeat contract)
+        approx = n_chunks * reader.chunk_bytes
+        prof.stage("ingest").bytes_in = \
+            min(approx, input_bytes) if bytes_comparable else approx
+        prof.emit(wall_s=_time.perf_counter() - t_start,  # vctpu-lint: disable=VCT006 — obs profile wall clock
+                  records=n_total - resumed_records)
     if gz:
         from variantcalling_tpu.io.tabix import build_tabix_index
 
