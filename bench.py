@@ -816,6 +816,104 @@ def coverage_reduce() -> dict:
             "bp_per_sec": round(COV_LEN / dt)}
 
 
+#: decompressed payload cap for the io microbench phase (big enough that
+#: per-shard overheads vanish, small enough to stay in the phase budget)
+IO_BENCH_PAYLOAD = 32 << 20
+IO_BENCH_THREADS = (1, 2, 4)
+
+
+def io_microbench(fixture_dir: str) -> dict:
+    """Host-IO layer microbench (parallel-IO satellite): BGZF
+    decompress-only, chunk-parse-only and BGZF compress-only throughput
+    at 1/2/4 IO workers, in MB/s of decompressed VCF text.
+
+    These isolate the three parallel host-IO primitives from the e2e
+    pipeline, so an IO-layer regression (a re-serialized shard loop, a
+    lost zero-copy) gates independently of e2e noise in
+    tools/bench_gate.py. Worker counts above the core count still get
+    measured — oversubscription behavior is part of the contract.
+    """
+    from variantcalling_tpu import knobs
+    from variantcalling_tpu.io import bgzf as bgzf_mod
+    from variantcalling_tpu.io.vcf import VcfChunkReader
+    from variantcalling_tpu.parallel.pipeline import IoPool, imap_ordered
+
+    with open(os.path.join(fixture_dir, "calls.vcf"), "rb") as fh:
+        text = fh.read(IO_BENCH_PAYLOAD)
+    text = text[: text.rfind(b"\n") + 1]
+    mb = len(text) / (1 << 20)
+    plain_path = os.path.join(fixture_dir, "io_bench.vcf")
+    with open(plain_path, "wb") as fh:
+        fh.write(text)
+    gz_blob = None
+
+    saved = {k: os.environ.get(k)
+             for k in ("VCTPU_IO_THREADS", "VCTPU_NATIVE_THREADS")}
+    out: dict = {"payload_mb": round(mb, 1),
+                 "decompress_mb_s": {}, "parse_mb_s": {}, "compress_mb_s": {}}
+    try:
+        for t in IO_BENCH_THREADS:
+            # pin BOTH fan-outs to t so each leg measures one worker count
+            # (the native compressor shards by VCTPU_NATIVE_THREADS, the
+            # Python paths by the IO pool)
+            os.environ["VCTPU_IO_THREADS"] = str(t)
+            os.environ["VCTPU_NATIVE_THREADS"] = str(t)
+            pool = IoPool(t) if t > 1 else None
+            try:
+                def compress_once():
+                    nonlocal gz_blob
+                    cc = bgzf_mod.BgzfChunkCompressor(pool=pool)
+                    gz_blob = cc.add(text) + cc.finish()
+
+                dt = best_of(compress_once)
+                out["compress_mb_s"][f"t{t}"] = round(mb / dt, 1)
+
+                spans = bgzf_mod.scan_block_spans(gz_blob)
+                # the production shard-packing rule AND the production
+                # shard size — the microbench must measure the exact
+                # shard shape the ingest path builds
+                groups = bgzf_mod.group_spans(
+                    spans, knobs.get_int("VCTPU_IO_SHARD_BYTES"))
+
+                def decompress_once():
+                    if pool is None:
+                        n = sum(len(bgzf_mod.inflate_spans(gz_blob, g))
+                                for g in groups)
+                    else:
+                        n = sum(len(b) for b in imap_ordered(
+                            pool, lambda g: bgzf_mod.inflate_spans(gz_blob, g),
+                            groups, window=t + 2))
+                    assert n == len(text)
+
+                dt = best_of(decompress_once)
+                out["decompress_mb_s"][f"t{t}"] = round(mb / dt, 1)
+
+                def parse_once():
+                    n = sum(len(tb) for tb in VcfChunkReader(
+                        plain_path, chunk_bytes=4 << 20, io_threads=t))
+                    assert n > 0
+
+                parse_once()  # warm (page cache, allocators)
+                dt = best_of(parse_once)
+                out["parse_mb_s"][f"t{t}"] = round(mb / dt, 1)
+            finally:
+                if pool is not None:
+                    pool.shutdown()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        # a derived 32 MB truncation must not accumulate next to the
+        # committed fixtures (or get globbed as a real input later)
+        try:
+            os.remove(plain_path)
+        except OSError:
+            pass
+    return out
+
+
 def host_scaling(fixture_dir: str) -> dict:
     """Measured thread-scaling of the three host stages (ingest /
     featurize+score / writeback) plus the streaming executor, at
@@ -858,27 +956,45 @@ def host_scaling(fixture_dir: str) -> dict:
                   extra_info={"TREE_SCORE": np.round(score, 4)}, verbatim_core=True)
         t3 = time.perf_counter()
         walls = {"ingest": t1 - t0, "featurize_score": t2 - t1, "writeback": t3 - t2}
-        ts = time.perf_counter()
-        stream = run_streaming(_fvp_args(vcf_in, out_path), model, fasta, {}, None)
+        # best-of-2, the same estimator every other phase uses (this
+        # shared host swings ±30% between minutes — a single-shot
+        # streaming leg made the committed t2/t1 ratio a coin flip)
+        stream_best = None
+        for _ in range(2):
+            ts = time.perf_counter()
+            stream = run_streaming(_fvp_args(vcf_in, out_path), model, fasta, {}, None)
+            if stream is None:
+                break
+            dt = time.perf_counter() - ts
+            stream_best = dt if stream_best is None else min(stream_best, dt)
         # VCTPU_THREADS=1 selects the serial path by design, so that leg's
         # end-to-end IS the serial stage total — the streaming row then
         # reads as "serial e2e vs overlapped e2e"
-        walls["streaming_e2e"] = (time.perf_counter() - ts) if stream is not None \
+        walls["streaming_e2e"] = stream_best if stream_best is not None \
             else walls["ingest"] + walls["featurize_score"] + walls["writeback"]
         return walls
 
     prev_nat = os.environ.get("VCTPU_NATIVE_THREADS")
     prev_thr = os.environ.get("VCTPU_THREADS")
+    prev_io = os.environ.get("VCTPU_IO_THREADS")
     try:
         os.environ["VCTPU_NATIVE_THREADS"] = "1"
         os.environ["VCTPU_THREADS"] = "1"  # single-thread leg: serial pipeline
+        # the IO fan-out is a SEPARATE knob (parallel-IO PR): without this
+        # pin the "serial" leg would still inflate/parse/score/compress on
+        # the worker pool and the committed speedup would compare parallel
+        # against parallel
+        os.environ["VCTPU_IO_THREADS"] = "1"
         stage_walls()  # warm
         one = stage_walls()
         os.environ["VCTPU_NATIVE_THREADS"] = str(cores)
         os.environ.pop("VCTPU_THREADS", None)
+        os.environ.pop("VCTPU_IO_THREADS", None)
         many = stage_walls()
     finally:
-        for k, v in (("VCTPU_NATIVE_THREADS", prev_nat), ("VCTPU_THREADS", prev_thr)):
+        for k, v in (("VCTPU_NATIVE_THREADS", prev_nat),
+                     ("VCTPU_THREADS", prev_thr),
+                     ("VCTPU_IO_THREADS", prev_io)):
             if v is None:
                 os.environ.pop(k, None)
             else:
@@ -963,12 +1079,19 @@ def _phase_attribution(log_path: str) -> dict | None:
     artifact (full log stays on disk next to the fixtures)."""
     from variantcalling_tpu.obs import export as obs_export
 
+    from variantcalling_tpu.parallel.pipeline import resolve_io_threads
+
     events = obs_export.read_events(log_path)
     b = obs_export.bottleneck(events)
     if b["limiting_stage"] is None:
         return None
+    # io_threads records which IO LAYOUT produced this attribution:
+    # bench_gate's absolute ingest-feed budget only applies to the
+    # parallel layout (with io_threads=1 the feed thread legitimately
+    # does the decompress+parse work)
     out = {"limiting_stage": b["limiting_stage"],
            "limiting_work_pct": b["limiting_work_pct"],
+           "io_threads": resolve_io_threads(),
            "wall_s": b["wall_s"], "source": b["source"],
            "stages": {name: {k: s[k] for k in
                              ("work_pct", "wait_in_pct", "wait_out_pct",
@@ -1074,6 +1197,11 @@ def child_main(fixture_dir: str) -> None:
         phase("coverage", coverage_reduce, min_remaining=30)
     if want("sec"):
         phase("sec", sec_aggregate, min_remaining=25)
+    if want("io") and cpu:
+        # host-IO layer microbench (decompress/parse/compress MB/s at
+        # 1/2/4 IO workers) — CPU engine legs; the parallel host-IO
+        # paths are host-side by definition
+        phase("io", lambda: io_microbench(fixture_dir), min_remaining=40)
     if want("scaling") and cpu:
         # host-stage thread scaling (CPU engine legs; device phases are
         # unaffected by VCTPU_NATIVE_THREADS)
@@ -1342,8 +1470,9 @@ def main(tpu_only: bool = False) -> None:
         out["value"] = hot.get("vps", 0)
         out["device"] = child.get("device", "?")
         out["attempt"] = label
-        for k in ("hot_small", "hot", "e2e", "obs", "e2e_5m", "genome3g",
-                  "scaling", "skipped", "phase_errors", "incomplete"):
+        for k in ("hot_small", "hot", "io", "e2e", "obs", "e2e_5m",
+                  "genome3g", "scaling", "skipped", "phase_errors",
+                  "incomplete"):
             if k in child:
                 out[k] = child[k]
         def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:

@@ -78,6 +78,33 @@ def test_obs_overhead_budget_is_absolute():
     assert bench_gate.gate(cand, GOLDEN)["regressed"] is False
 
 
+def test_ingest_feed_budget_skips_on_serial_io_layout():
+    """The absolute ingest-feed budget (the "fan-out quietly
+    re-serialized" tripwire) applies to the parallel IO layout only: on a
+    serial-layout row (io_threads=1 — single-core host or pinned) the
+    feed legitimately does the decompress+parse work, so the budget is
+    skipped, not failed."""
+    cand = copy.deepcopy(GOLDEN)
+    cand["e2e"]["attribution"] = {
+        "io_threads": 4,
+        "stages": {"ingest": {"work_pct": 41.0}},
+    }
+    report = bench_gate.gate(cand, GOLDEN)
+    assert report["regressed"] is True
+    bad = next(c for c in report["checks"]
+               if c["metric"] == "e2e.attribution.stages.ingest.work_pct")
+    assert bad["direction"] == "budget" and bad["regressed"]
+    # the identical attribution from the serial layout: skipped
+    cand["e2e"]["attribution"]["io_threads"] = 1
+    report = bench_gate.gate(cand, GOLDEN)
+    assert report["regressed"] is False
+    assert any("serial IO layout" in s for s in report["skipped"])
+    # an artifact predating the layout field keeps gating (parallel was
+    # the only layout that ever committed one)
+    del cand["e2e"]["attribution"]["io_threads"]
+    assert bench_gate.gate(cand, GOLDEN)["regressed"] is True
+
+
 def test_median_of_k_lists_reduce_by_median():
     cand = copy.deepcopy(GOLDEN)
     base = copy.deepcopy(GOLDEN)

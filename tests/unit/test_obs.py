@@ -351,7 +351,9 @@ def test_streaming_run_unifies_all_event_classes(stream_world, tmp_path,
     metrics = [e for e in events if e["kind"] == "metrics"][-1]
     assert metrics["counters"]["records"] == w["n"]
     assert metrics["counters"]["faults.fired"] == 2
-    assert "queue.stage0.depth" in metrics["gauges"]
+    # queue pressure gauge: per-stage queues in the serial-IO layout,
+    # the head queue in the pooled parallel layout
+    assert any(k.startswith("queue.") for k in metrics["gauges"])
 
 
 # ---------------------------------------------------------------------------

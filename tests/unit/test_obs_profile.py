@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -143,6 +144,32 @@ def test_stage_profiler_emit_shapes(tmp_path):
     assert pipe["stages"] == ["ingest", "score"]
 
 
+def test_set_records_skips_worker_stages(tmp_path):
+    """Byte-only worker rows (``inflate.wN``) keep their accumulated
+    records — even zero. Assigning the run total to each of k workers
+    would inflate the merged family's records (and its standalone v/s)
+    k-fold in the bottleneck roll-up."""
+    run, path = _open_run(tmp_path)
+    prof = profile_mod.StageProfiler()
+    prof.stage("ingest").add_work(0.2)
+    for w in range(4):
+        prof.stage(f"inflate.w{w}").add_work(0.1, bytes_in=1000)
+    prof.stage("parse.w0").add_work(0.1, records=600)
+    prof.emit(wall_s=1.0, records=1000)
+    obs.end_run(run, "ok")
+    stages = {e["stage"]: e for e in _events(path)
+              if e["kind"] == "profile" and e["name"] == "stage"}
+    assert stages["ingest"]["records"] == 1000  # linear stage: run total
+    assert all("records" not in stages[f"inflate.w{w}"] for w in range(4))
+    assert stages["parse.w0"]["records"] == 600  # its own share, untouched
+    b = export_mod.bottleneck(export_mod.read_run(path))
+    assert b["stages"]["inflate"]["workers"] == 4
+    # the roll-up falls back to the run total ONCE for the whole family
+    # (all records' bytes passed through inflate): 1000/(0.4/4) — the
+    # pre-fix per-worker clobber summed 4x1000 and reported 40000
+    assert b["stages"]["inflate"]["vps"] == 10_000
+
+
 def test_profiler_disabled_by_knob(tmp_path, monkeypatch):
     monkeypatch.setenv("VCTPU_OBS_PROFILE", "0")
     run, path = _open_run(tmp_path)
@@ -259,14 +286,25 @@ def test_streaming_run_emits_stage_attribution(stream_world, tmp_path,
     events = _events(path)
     stages = {e["stage"]: e for e in events
               if e["kind"] == "profile" and e["name"] == "stage"}
-    # the four attribution stages of the filter pipeline, by name
-    assert {"ingest", "score_stage", "render_stage", "writeback"} \
-        <= set(stages)
-    # every stage processed every chunk and carries the record total
-    for s in stages.values():
-        assert s["items"] == stats["chunks"]
-        assert s["records"] == w["n"]
+    # the attribution stages of the filter pipeline, by name: ingest and
+    # writeback always; scoring/render either as dedicated stage rows
+    # (serial-IO layout) or as per-worker families (parallel layout,
+    # VCTPU_IO_THREADS > 1 — parse.wN / score_stage.wN / render_stage.wN)
+    assert {"ingest", "writeback"} <= set(stages)
+    for base in ("score_stage", "render_stage"):
+        family = [s for n, s in stages.items()
+                  if n == base or re.match(rf"{base}\.w\d+$", n)]
+        assert family, base
+        assert sum(s["items"] for s in family) == stats["chunks"]
+        assert sum(s.get("records", 0) for s in family) == w["n"]
+    parse = [s for n, s in stages.items() if re.match(r"parse\.w\d+$", n)]
+    if parse:  # parallel-IO layout: workers cover every chunk and record
+        assert sum(s["items"] for s in parse) == stats["chunks"]
+        assert sum(s.get("records", 0) for s in parse) == w["n"]
+    assert stages["ingest"]["items"] == stats["chunks"]
     assert stages["ingest"]["bytes_in"] > 0
+    assert stages["writeback"]["items"] == stats["chunks"]
+    assert stages["writeback"]["records"] == w["n"]
     assert stages["writeback"]["bytes_out"] > 0
     pipe = next(e for e in events
                 if e["kind"] == "profile" and e["name"] == "pipeline")
@@ -276,9 +314,11 @@ def test_streaming_run_emits_stage_attribution(stream_world, tmp_path,
     metrics = [e for e in events if e["kind"] == "metrics"][-1]
     hist = metrics["histograms"]["stage.score_stage.s"]
     assert hist["count"] == stats["chunks"] and hist["p50"] is not None
-    # the roll-up attributes the run and fractions close to 100%
+    # the roll-up attributes the run and fractions close to 100% —
+    # worker families merge into one row normalized by worker count
     b = export_mod.bottleneck(events)
-    assert b["limiting_stage"] in stages
+    assert not any(re.match(r".*\.w\d+$", n) for n in b["stages"])
+    assert b["limiting_stage"] in b["stages"]
     for name, s in b["stages"].items():
         total = s["work_pct"] + s["wait_in_pct"] + s["wait_out_pct"] \
             + s["other_pct"]

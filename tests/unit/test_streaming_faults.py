@@ -348,6 +348,109 @@ def test_resume_accepts_same_forest_strategy(stream_fault_world, clean_bytes,
         == clean_bytes
 
 
+def test_resume_survives_io_thread_count_change(stream_fault_world, clean_bytes,
+                                                monkeypatch):
+    """Chunk boundaries are identical at every VCTPU_IO_THREADS setting,
+    so a run interrupted under one worker count RESUMES under another
+    (the journal identity does not — and must not — pin the pool size)."""
+    w = stream_fault_world
+    out = f"{w['dir']}/io_change.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "4")
+    faults.arm("io.writeback", times=None, after=3)
+    with pytest.raises(OSError):
+        _run_stream(w, out, monkeypatch)
+    committed = len(open(out + ".journal").read().splitlines()) - 1
+    assert committed >= 1
+    faults.reset()
+    monkeypatch.setenv("VCTPU_IO_THREADS", "1")
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["resumed_chunks"] == committed
+    assert open(out, "rb").read() == clean_bytes
+
+
+# ---------------------------------------------------------------------------
+# parallel host IO: worker death mid-decompress / mid-compress
+# ---------------------------------------------------------------------------
+
+
+def _bgzf_input(w) -> str:
+    from variantcalling_tpu.io.bgzf import BgzfWriter
+
+    path = f"{w['dir']}/calls.vcf.gz"
+    if not os.path.exists(path):
+        with open(f"{w['dir']}/calls.vcf", "rb") as fh, \
+                BgzfWriter(path) as out:
+            out.write(fh.read())
+    return path
+
+
+def test_transient_shard_decompress_retried(stream_fault_world, clean_bytes,
+                                            monkeypatch):
+    """A transient IO error inside a parallel BGZF inflate worker is
+    retried (inflate is a pure function of the mapped bytes) and the run
+    completes byte-identically."""
+    from variantcalling_tpu.pipelines.filter_variants import run_streaming
+
+    w = stream_fault_world
+    inp = _bgzf_input(w)
+    out = f"{w['dir']}/shard_retry.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "4")
+    monkeypatch.setenv("VCTPU_IO_BACKOFF_S", "0.01")
+    monkeypatch.setenv("VCTPU_STREAM_CHUNK_BYTES", str(1 << 15))
+    faults.arm("io.shard_decompress", times=2)
+    args = _stream_args(w, out)
+    args.input_file = inp
+    stats = run_streaming(args, w["model"], w["fasta"], {}, None)
+    assert stats is not None and stats["n"] == w["n"]
+    assert faults.fired("io.shard_decompress") == 2
+    assert open(out, "rb").read() == clean_bytes
+
+
+def test_persistent_shard_decompress_death_fails_clean(stream_fault_world,
+                                                       monkeypatch):
+    """An IO worker dying on every inflate attempt fails the run cleanly:
+    the real error surfaces, nothing lands at the destination, and no
+    pipeline threads leak."""
+    from variantcalling_tpu.pipelines.filter_variants import run_streaming
+
+    w = stream_fault_world
+    inp = _bgzf_input(w)
+    out = f"{w['dir']}/shard_dead.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "4")
+    monkeypatch.setenv("VCTPU_IO_BACKOFF_S", "0.01")
+    monkeypatch.setenv("VCTPU_STREAM_CHUNK_BYTES", str(1 << 15))
+    faults.arm("io.shard_decompress", times=None)
+    args = _stream_args(w, out)
+    args.input_file = inp
+    with pytest.raises(OSError, match="shard inflate"):
+        run_streaming(args, w["model"], w["fasta"], {}, None)
+    assert not os.path.exists(out)
+    assert not [t for t in threading.enumerate() if t.name.startswith("pipe-")]
+    # the error surfaced from the reader CONSTRUCTOR (the header scan is
+    # the first shard read): its pool workers must be released too — a
+    # long-lived process retrying runs must not accumulate idle daemons
+    time.sleep(0.2)  # bounded pool joins finish
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("vctpu-io-")]
+
+
+def test_compress_worker_death_is_atomic(stream_fault_world, monkeypatch):
+    """A worker death mid-BGZF-compress on the writeback side fails the
+    run with the torn .partial discarded — the destination is never
+    touched (gz outputs: atomic, non-resumable)."""
+    w = stream_fault_world
+    out = f"{w['dir']}/compress_dead.vcf.gz"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "2")
+    faults.arm("io.shard_compress", times=1)
+    with pytest.raises(OSError, match="shard compress"):
+        _run_stream(w, out, monkeypatch)
+    assert not os.path.exists(out)
+    assert not os.path.exists(out + ".partial")
+    faults.reset()
+    stats = _run_stream(w, out, monkeypatch)  # rerun heals
+    assert stats is not None and stats["n"] == w["n"]
+
+
 def test_malformed_journal_degrades_to_fresh_run(tmp_path):
     """A journal whose lines parse as JSON but lack fields must not crash
     resume — it degrades to a fresh run (docs/robustness.md contract)."""
@@ -396,6 +499,10 @@ def test_sigkill_midstream_then_resume_byte_identical(stream_fault_world, tmp_pa
     env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
     env.update(PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
                VCTPU_STREAM_CHUNK_BYTES=str(1 << 15),
+               # the kill must land while the PARALLEL host-IO machinery
+               # is live (pool workers mid-chunk) — resume then proves
+               # the journal contract under parallel writeback
+               VCTPU_IO_THREADS="4",
                # slow each chunk so the kill lands mid-stream
                VCTPU_FAULTS="pipeline.stage_hang:999@0.3")
     env.pop("XLA_FLAGS", None)

@@ -494,11 +494,63 @@ def test_cli_unknown_select_is_usage_error(tmp_path):
     assert lint_main(["--select", "VCT999", str(tmp_path)]) == 2
 
 
+# ---------------------------------------------------------------------------
+# VCT008 unsequenced-write
+# ---------------------------------------------------------------------------
+
+PIPE = "variantcalling_tpu/pipelines/snippet.py"
+
+
+def test_vct008_direct_sink_write_flagged():
+    fs = run("""
+        def commit(sink, data):
+            sink.write(data)
+        """, path=PIPE)
+    assert [f.code for f in fs] == ["VCT008"]
+    assert "_sink_write" in fs[0].message
+
+
+def test_vct008_partial_handle_and_os_replace_flagged():
+    assert codes("""
+        import os
+        def finish(partial_fh, out):
+            partial_fh.writelines([b"x"])
+            os.replace(out + ".partial", out)
+        """, path=PIPE) == ["VCT008", "VCT008"]
+
+
+def test_vct008_sanctioned_committer_and_other_writers_pass():
+    # the committer itself is the sanctioned writer; report/stderr writers
+    # and non-sink handles are not streaming output paths
+    assert codes("""
+        import sys
+        def _sink_write(sink, data):
+            def attempt():
+                sink.write(data)
+            attempt()
+        def report(fh):
+            fh.write("<html>")
+            sys.stderr.write("done")
+        """, path=PIPE) == []
+
+
+def test_vct008_scoped_to_pipelines_and_suppressible():
+    # io/ writer classes are the sanctioned layer below the committer
+    assert codes("""
+        def flush(sink, data):
+            sink.write(data)
+        """, path="variantcalling_tpu/io/bgzf.py") == []
+    assert codes("""
+        import os
+        os.replace(a, b)  # vctpu-lint: disable=VCT008 — sanctioned atomic commit
+        """, path=PIPE) == []
+
+
 def test_cli_list_checkers(capsys):
     assert lint_main(["--list-checkers"]) == 0
     out = capsys.readouterr().out
     for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005", "VCT006",
-                 "VCT007"):
+                 "VCT007", "VCT008"):
         assert code in out
 
 

@@ -10,10 +10,17 @@ human diff would catch it. This tool is the gate:
   its direction and its noise band) and **exits 1 on any regression
   beyond the band**, 0 when clean, 2 on usage/IO errors.
 - ``python -m tools.bench_gate --run`` runs a fresh reduced bench
-  (``VCTPU_BENCH_PHASES=hot_small,hot,e2e,obs`` — the phases the gate
+  (``VCTPU_BENCH_PHASES=hot_small,hot,io,e2e,obs`` — the phases the gate
   reads) and compares it against the newest committed ``BENCH_r*.json``
   (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
   opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
+
+The gate also reads the per-stage ATTRIBUTION the streaming bench rows
+embed (``e2e.attribution`` — the same roll-up ``vctpu obs bottleneck
+--json`` prints): the limiting-stage work fraction gates relatively, and
+the ingest FEED row's work share has an absolute 25%-of-wall budget —
+the tripwire for "e2e unchanged but the parallel ingest fan-out quietly
+re-serialized" (docs/streaming_executor.md "Parallel host IO").
 
 Noise bands are explicit and per metric because the signals differ: the
 hot path is best-of-2 on a shared ±noise host, the obs overhead is a
@@ -55,7 +62,34 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("coverage.bp_per_sec", "higher", 0.10),
     ("train.wallclock_s", "lower", 0.10),
     ("obs.obs_overhead_pct", "budget", 2.0),     # the PR 5 <2% contract
+    # -- host-IO layer (parallel-IO PR): the io phase isolates the three
+    #    IO primitives, so an IO regression (a re-serialized shard loop,
+    #    a lost zero-copy) gates independently of e2e noise ------------
+    ("io.decompress_mb_s.t1", "higher", 0.10),
+    ("io.decompress_mb_s.t2", "higher", 0.10),
+    ("io.parse_mb_s.t1", "higher", 0.10),
+    ("io.parse_mb_s.t2", "higher", 0.10),
+    ("io.compress_mb_s.t1", "higher", 0.10),
+    ("io.compress_mb_s.t2", "higher", 0.10),
+    # -- limiting-stage attribution (the `vctpu obs bottleneck --json`
+    #    roll-up each streaming bench row embeds as `attribution`):
+    #    catches "e2e unchanged but ingest quietly re-serialized". The
+    #    ingest FEED row's work share is an absolute budget — with the
+    #    parallel layout on, the feed only drains the worker pool (its
+    #    work lives in the parse.wN/score_stage.wN families), so feed
+    #    work above 25% of wall means the fan-out silently collapsed.
+    ("e2e.attribution.stages.ingest.work_pct", "budget", 25.0),
+    ("e2e.attribution.limiting_work_pct", "lower", 0.20),
 )
+
+#: the ingest-feed budget assumes the PARALLEL IO layout (the feed only
+#: drains the worker pool). On a serial-layout run — single-core host or
+#: VCTPU_IO_THREADS=1 — the feed thread legitimately does the
+#: decompress+parse work, so the budget would fail spuriously; the bench
+#: row records which layout produced the attribution and the gate skips
+#: the budget when it was serial.
+_INGEST_BUDGET_METRIC = "e2e.attribution.stages.ingest.work_pct"
+_IO_LAYOUT_GUARD = "e2e.attribution.io_threads"
 
 
 def resolve_path(doc: dict, dotted: str):
@@ -91,6 +125,11 @@ def gate(candidate: dict, baseline: dict,
             if cand is None:
                 skipped.append(dotted)
                 continue
+            if dotted == _INGEST_BUDGET_METRIC:
+                layout = resolve_path(candidate, _IO_LAYOUT_GUARD)
+                if layout is not None and layout <= 1:
+                    skipped.append(f"{dotted} (serial IO layout)")
+                    continue
             checks.append({
                 "metric": dotted, "candidate": cand, "budget": band,
                 "direction": "budget",
@@ -160,7 +199,7 @@ def run_fresh_bench(timeout_s: int = 420) -> dict | None:
     """A reduced fresh bench (the gate's phases only) on the CPU engine;
     returns its parsed JSON or None with the failure printed."""
     env = dict(os.environ)
-    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,e2e,obs"
+    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,io,e2e,obs"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

@@ -505,3 +505,83 @@ class UndeclaredEventKindChecker(Checker):
                                   "add it to the committed schema (a "
                                   "reviewable diff) before emitting it")
         self.generic_visit(node)
+
+
+#: the one function allowed to write bytes to a streaming output sink
+#: (VCT008): retry-wrapped + rewind-guarded, called only by the committer
+_SANCTIONED_SINK_WRITER = "_sink_write"
+
+#: receiver-name tokens that mark a handle/path as streaming OUTPUT state
+#: (VCT008): the committer's sink and the .partial file handle
+_SINK_TOKENS = ("sink", "partial")
+
+
+@register
+class UnsequencedWriteChecker(Checker):
+    """VCT008 — an unsequenced write to a streaming output path.
+
+    Invariant from the parallel host-IO PR (docs/streaming_executor.md
+    "Parallel host IO"): with ingest, scoring and BGZF compression fanned
+    out across worker pools, every byte that reaches a streaming OUTPUT
+    path must flow through the ONE sequenced committer —
+    ``_sink_write`` (bounded retry + rewind guard) draining chunks in
+    sequence order — and the destination is only ever touched by the
+    single sanctioned ``os.replace`` atomic commit. A direct
+    ``sink.write(...)`` bypasses the retry/rewind contract (a transient
+    ENOSPC then duplicates or drops bytes mid-file), and a second
+    ``os.replace`` onto an output path can commit a torn or
+    out-of-order file. Scope: ``variantcalling_tpu/pipelines/`` (the
+    layer that owns streaming output paths); report writers and io/
+    writer classes are the sanctioned layer below. Sanctioned sites
+    carry inline suppressions naming why, like VCT006's.
+    """
+
+    code = "VCT008"
+    name = "unsequenced-write"
+    description = ("direct sink/partial write or os.replace on a streaming "
+                   "output path outside the sanctioned committer")
+
+    def __init__(self, path: str, lines: list[str]):
+        super().__init__(path, lines)
+        self._funcs: list[str] = []
+
+    def applies_to(self, path: str) -> bool:
+        return "variantcalling_tpu/pipelines/" in path
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._funcs.append(node.name)
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _sink_named(expr: ast.expr) -> str | None:
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        if name is not None and any(t in name.lower() for t in _SINK_TOKENS):
+            return name
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "replace" and isinstance(func.value, ast.Name) \
+                    and func.value.id == "os":
+                self.report(node, "os.replace in pipeline code — only the "
+                                  "streaming committer's single atomic "
+                                  "commit may rename onto an output path "
+                                  "(suppress at the one sanctioned site)")
+            elif func.attr in ("write", "writelines") \
+                    and _SANCTIONED_SINK_WRITER not in self._funcs:
+                sink = self._sink_named(func.value)
+                if sink is not None:
+                    self.report(node, f"direct {sink}.{func.attr}() on a "
+                                      "streaming output sink — route bytes "
+                                      "through the sequenced committer "
+                                      f"({_SANCTIONED_SINK_WRITER}: bounded "
+                                      "retry + rewind guard, chunk order)")
+        self.generic_visit(node)

@@ -306,7 +306,7 @@ def windows_on_device(genome_blocks, block, off, radius: int = WINDOW_RADIUS):
     return jnp.where(valid, vals, 4).astype(jnp.uint8)
 
 
-def _contig_runs(chrom, n: int):
+def _contig_runs(table_or_chrom, n: int):
     """Factorized contig column + contiguous-run bounds (or None).
 
     Sorted VCFs put each contig in ONE contiguous run, so per-contig work
@@ -315,7 +315,34 @@ def _contig_runs(chrom, n: int):
     :func:`gather_windows` and :func:`featurize_gather_fused` so the fused
     fast path and its fallback can never disagree on contig handling.
     Returns (codes, uniques, bounds) with bounds None when runs are not
-    contiguous (callers fall back to masks)."""
+    contiguous (callers fall back to masks).
+
+    Accepts the :class:`VariantTable` itself when available: the native
+    scan already factorized CHROM into integer codes, and re-factorizing
+    1M Python strings per chunk was ~15% of the streaming score stage's
+    GIL-holding glue (the per-chunk pandas factorize on the hot path).
+    """
+    chrom = table_or_chrom
+    codes = getattr(table_or_chrom, "chrom_codes", None)
+    if codes is not None:
+        names = table_or_chrom.chrom_names
+        change = np.flatnonzero(codes[1:] != codes[:-1]) + 1 if n > 1 \
+            else np.empty(0, np.int64)
+        starts = np.concatenate([[0], change]).astype(np.int64) if n else \
+            np.empty(0, np.int64)
+        run_codes = codes[starts] if n else np.empty(0, codes.dtype)
+        if len(np.unique(run_codes)) == len(run_codes):
+            # each contig appears in exactly one run (the sorted case):
+            # remap the dictionary codes to appearance order so callers'
+            # enumerate(uniques) indexing matches the mask codes
+            uniques = np.asarray([names[c] for c in run_codes], dtype=object)
+            lut = np.zeros(len(names), dtype=np.int64)
+            lut[run_codes] = np.arange(len(run_codes))
+            bounds = np.concatenate([starts, [n]])
+            return lut[codes], uniques, bounds
+        chrom = table_or_chrom.chrom  # unsorted chunk: factorize below
+    elif not isinstance(table_or_chrom, np.ndarray) and hasattr(table_or_chrom, "chrom"):
+        chrom = table_or_chrom.chrom
     import pandas as pd
 
     codes, uniques = pd.factorize(np.asarray(chrom), use_na_sentinel=False)
@@ -335,7 +362,7 @@ def gather_windows(table: VariantTable, fasta: FastaReader, radius: int = WINDOW
 
     n = len(table)
     out = np.full((n, 2 * radius + 1), 4, dtype=np.uint8)
-    codes, uniques, bounds = _contig_runs(table.chrom, n)
+    codes, uniques, bounds = _contig_runs(table, n)
     contiguous = bounds is not None
     pos0 = table.pos - 1
 
@@ -385,7 +412,7 @@ def featurize_gather_fused(table: VariantTable, fasta: FastaReader, alle,
     n = len(table)
     outs = (np.empty(n, np.int32), np.empty(n, np.int32), np.empty(n, np.float32),
             np.empty(n, np.int32), np.empty(n, np.int32), np.empty(n, np.int32))
-    codes, uniques, bounds = _contig_runs(table.chrom, n)
+    codes, uniques, bounds = _contig_runs(table, n)
     contiguous = bounds is not None
     pos0 = table.pos - 1
     aux = (alle.is_indel, alle.indel_nuc, alle.ref_code, alle.alt_code, alle.is_snp)

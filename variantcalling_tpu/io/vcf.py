@@ -19,6 +19,8 @@ from __future__ import annotations
 import gzip
 import io as _io
 import os
+import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -281,9 +283,17 @@ class VariantTable:
         sample_cols: np.ndarray | None = None,
         aux: NativeAux | None = None,
         lazy_cols: "_LazyCols | None" = None,
+        chrom_codes: np.ndarray | None = None,
+        chrom_names: np.ndarray | None = None,
     ):
         self.header = header
         self.chrom = chrom
+        #: native-ingest bonus: the scan's integer CHROM dictionary codes
+        #: (+ name table), so per-chunk contig grouping (featurize
+        #: _contig_runs) never re-factorizes 1M Python strings on the
+        #: scoring hot path
+        self.chrom_codes = chrom_codes
+        self.chrom_names = chrom_names
         self.pos = pos
         self._vid = vid
         self._ref = ref
@@ -353,6 +363,8 @@ class VariantTable:
         return VariantTable(
             header=self.header,
             chrom=self.chrom[keep],
+            chrom_codes=self.chrom_codes[keep] if self.chrom_codes is not None else None,
+            chrom_names=self.chrom_names,
             pos=self.pos[keep],
             vid=self._vid[keep] if self._vid is not None else None,
             ref=self._ref[keep] if self._ref is not None else None,
@@ -641,6 +653,8 @@ def _table_from_parsed(parsed: dict, header: VcfHeader, bufb, buf_np: np.ndarray
     return VariantTable(
         header=header,
         chrom=chrom_names[parsed["chrom_codes"]] if nrec else np.empty(0, dtype=object),
+        chrom_codes=np.ascontiguousarray(parsed["chrom_codes"]) if nrec else None,
+        chrom_names=chrom_names,
         pos=parsed["pos"],
         vid=eager["vid"],
         ref=eager["ref"],
@@ -761,13 +775,87 @@ def read_vcf(
 
 
 #: default streaming chunk size (bytes of VCF text per pipeline item);
-#: ~16 MB is ~80-250K records of a typical callset — large enough that the
-#: native per-chunk scan still shards across threads, small enough that a
-#: few in-flight chunks bound pipeline memory at O(100 MB) and the stage
-#: pipeline load-balances (the 5M sweep: 16 MB ≈ 0.88M v/s vs 32 MB ≈
-#: 0.73M v/s on a 2-core host — coarser chunks idle the overlap at the
-#: head and tail of the run)
-STREAM_CHUNK_BYTES = 16 << 20
+#: ~8 MB is ~40-120K records of a typical callset. The parallel host-IO
+#: layout re-tuned this down from 16 MB: chunks are now the fan-out
+#: granularity of the worker pool, and finer chunks pack the ordered
+#: window better (1M leg: 8 MB ≈ 1.19M v/s vs 16 MB ≈ 1.08M; 5M leg:
+#: 1.15M vs 1.13M on the 2-core container) while a few in-flight chunks
+#: still bound pipeline memory at O(100 MB)
+STREAM_CHUNK_BYTES = 8 << 20
+
+
+class _ParallelBgzfStream:
+    """File-like ``read(n)`` over a BGZF file, inflated shard-parallel.
+
+    BGZF members are independent deflate streams, so the compressed file
+    splits at block boundaries (:func:`bgzf.scan_block_spans`) into
+    shards of ~``VCTPU_IO_SHARD_BYTES`` decompressed bytes each, inflated
+    on the IO worker pool and reassembled strictly in file order — the
+    decompressed byte stream is identical to a serial ``gzip.open`` read,
+    so chunk boundaries (and therefore journal resume identity) cannot
+    depend on the worker count. Raises ``ValueError`` when the file is
+    not cleanly BGZF-framed (plain gzip): callers fall back to the serial
+    stream.
+    """
+
+    def __init__(self, path: str, pool, profiler=None):
+        from variantcalling_tpu.io import bgzf as bgzf_mod
+
+        size = os.path.getsize(path)
+        self.path = str(path)
+        self._mm = (np.memmap(path, dtype=np.uint8, mode="r")
+                    if size else np.empty(0, dtype=np.uint8))
+        spans = bgzf_mod.scan_block_spans(self._mm) if size else []
+        if spans is None:
+            raise ValueError(f"{path}: not BGZF-framed")
+        groups = bgzf_mod.group_spans(spans,
+                                      knobs.get_int("VCTPU_IO_SHARD_BYTES"))
+        from variantcalling_tpu.parallel.pipeline import imap_ordered
+
+        self._profiler = profiler
+        self._shards = imap_ordered(pool, self._inflate, groups,
+                                    window=pool.threads + 2)
+        self._buf = bytearray()
+        self._eof = False
+
+    def _inflate(self, spans) -> bytes:
+        from variantcalling_tpu.io import bgzf as bgzf_mod
+        from variantcalling_tpu.parallel.pipeline import retry_transient
+        from variantcalling_tpu.utils import faults
+
+        def attempt() -> bytes:
+            # injection point "io.shard_decompress": inflate is a pure
+            # function of the mapped bytes, so a transient error here is
+            # always safely retryable; a persistent one propagates through
+            # the future and fails the run cleanly
+            faults.check("io.shard_decompress")
+            return bgzf_mod.inflate_spans(self._mm, spans)
+
+        if self._profiler is None:
+            return retry_transient(attempt, f"bgzf shard inflate ({self.path})")
+        t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs per-worker attribution
+        out = retry_transient(attempt, f"bgzf shard inflate ({self.path})")
+        worker = threading.current_thread().name.rsplit("-", 1)[-1]
+        self._profiler.stage(f"inflate.{worker}").add_work(
+            time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs per-worker attribution
+            bytes_in=sum(s[1] for s in spans), bytes_out=len(out))
+        return out
+
+    def read(self, n: int) -> bytes:
+        while len(self._buf) < n and not self._eof:
+            nxt = next(self._shards, None)
+            if nxt is None:
+                self._eof = True
+                break
+            self._buf += nxt
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def close(self) -> None:
+        self._shards.close()
+        self._buf.clear()
+        self._mm = None
 
 
 class VcfChunkReader:
@@ -785,13 +873,24 @@ class VcfChunkReader:
       independent bytes buffer per chunk with partial-line carry — again
       O(chunk) resident, not O(file).
 
+    With ``VCTPU_IO_THREADS`` > 1 (default: cpu count) ingest goes
+    PARALLEL (docs/streaming_executor.md "Parallel host IO"): BGZF input
+    inflates shard-parallel (:class:`_ParallelBgzfStream`) and chunk
+    PARSE — the dominant ingest cost on plain text too — fans out over
+    the IO worker pool, reassembled into canonical sequence order before
+    the tables leave the iterator. Chunk boundaries are computed by the
+    same serial rules either way, so the yielded chunk sequence (and the
+    journal resume identity) is byte-identical at every worker count.
+
     One-shot: the underlying stream is consumed by iteration. Requires
     the native library (callers gate on ``native.available()``); a
     mid-stream scan failure raises rather than silently degrading.
     """
 
-    def __init__(self, path: str, chunk_bytes: int = 0):
+    def __init__(self, path: str, chunk_bytes: int = 0,
+                 io_threads: int | None = None, profiler=None):
         from variantcalling_tpu import native
+        from variantcalling_tpu.parallel.pipeline import resolve_io_threads
 
         if not native.available():
             raise RuntimeError("VcfChunkReader requires the native engine")
@@ -803,6 +902,11 @@ class VcfChunkReader:
         env_chunk = knobs.get_int("VCTPU_STREAM_CHUNK_BYTES") \
             if knobs.raw("VCTPU_STREAM_CHUNK_BYTES") is not None else None
         self.chunk_bytes = int(chunk_bytes) or env_chunk or STREAM_CHUNK_BYTES
+        self.io_threads = (resolve_io_threads() if io_threads is None
+                          else max(1, int(io_threads)))
+        self.profiler = profiler
+        self._pool = None
+        self._pool_shared = False
         #: chunks to advance WITHOUT parsing (journal resume: their output
         #: bytes are already committed). Boundaries are computed exactly as
         #: for parsed chunks, so the continuation is byte-faithful.
@@ -812,17 +916,25 @@ class VcfChunkReader:
         self._fh = None
         self._pending = b""
         if self._gz:
-            self._fh = gzip.open(self.path, "rb")
-            head = b""
-            while True:
-                block = self._fh.read(self.chunk_bytes)
-                head += block
-                header, first_off = parse_header_bytes(head)
-                # complete when a record line begins, or the stream ended
-                if not block or (first_off < len(head) and head[first_off : first_off + 1] != b"#"):
-                    break
-            self.header = header
-            self._pending = head[first_off:]
+            # a failing header scan (e.g. a persistent shard-inflate error
+            # surfacing through the parallel stream) must release the
+            # already-started pool workers — close() is unreachable from
+            # callers when the constructor itself raises
+            try:
+                self._fh = self._open_gz_stream()
+                head = b""
+                while True:
+                    block = self._fh.read(self.chunk_bytes)
+                    head += block
+                    header, first_off = parse_header_bytes(head)
+                    # complete when a record line begins, or the stream ended
+                    if not block or (first_off < len(head) and head[first_off : first_off + 1] != b"#"):
+                        break
+                self.header = header
+                self._pending = head[first_off:]
+            except BaseException:
+                self.close()
+                raise
         else:
             size = os.path.getsize(self.path)
             self._mm = (np.memmap(self.path, dtype=np.uint8, mode="r")
@@ -837,6 +949,55 @@ class VcfChunkReader:
                 cap *= 8
             self.header = header
             self._first_off = first_off
+
+    def _open_gz_stream(self):
+        """The decompressed-byte source for ``.gz`` input: shard-parallel
+        BGZF inflate when the IO pool is on and the file is BGZF-framed,
+        the serial gzip stream otherwise (plain single-member gzip has no
+        split points). Both yield the identical byte stream."""
+        if self.io_threads > 1:
+            try:
+                return _ParallelBgzfStream(self.path, self._ensure_pool(),
+                                           profiler=self.profiler)
+            except ValueError:
+                pass  # not BGZF-framed: one deflate stream, serial inflate
+        return gzip.open(self.path, "rb")
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from variantcalling_tpu.parallel.pipeline import IoPool
+
+            self._pool = IoPool(self.io_threads)
+        return self._pool
+
+    def shared_pool(self):
+        """The run-scoped IO pool, marked EXTERNALLY SHARED: the streaming
+        executor hands it to work that outlives ingest (the chunk_worker
+        fan-out and the writeback compress stage), so iteration exhaustion
+        must no longer shut it down — a tail-chunk compress submitted to a
+        dead pool would block forever. The run owner's :meth:`close` (in
+        its teardown finally, after the pipeline drains) tears it down."""
+        self._pool_shared = True
+        return self._ensure_pool()
+
+    def _close_stream(self) -> None:
+        """Release the input stream only (idempotent)."""
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def close(self) -> None:
+        """Release the IO pool and the input stream (idempotent). Full
+        unshared iteration closes implicitly; error paths and pool-sharing
+        run owners call this so abandoned runs never accumulate idle pool
+        workers."""
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+        self._close_stream()
 
     def skip(self, n_chunks: int) -> None:
         """Advance the first ``n_chunks`` chunk boundaries without parsing
@@ -863,12 +1024,44 @@ class VcfChunkReader:
         return retry_transient(attempt, f"chunk read ({self.path})")
 
     def __iter__(self):
-        if self._gz:
-            yield from self._iter_gz()
-        else:
-            yield from self._iter_mm()
+        raw = self._raw_gz() if self._gz else self._raw_mm()
+        if self.io_threads <= 1:
+            for buf_np, lazy_buf in raw:
+                yield self._parse_chunk(buf_np, lazy_buf)
+            return
+        # parallel chunk parse: the native scan releases the GIL, so
+        # chunks genuinely parse concurrently on the IO pool; the ordered
+        # window reassembles them into canonical sequence order before
+        # they leave the iterator, so downstream consumers (the stage
+        # pipeline, the journal) see exactly the serial chunk stream
+        from variantcalling_tpu.parallel.pipeline import imap_ordered
 
-    def _iter_mm(self):
+        try:
+            yield from imap_ordered(self._ensure_pool(), self._parse_worker,
+                                    raw, window=self.io_threads + 1)
+        finally:
+            if self._pool_shared:
+                # the pool outlives ingest (shared with the compress stage
+                # and the chunk fan-out); the run owner shuts it down
+                self._close_stream()
+            else:
+                self.close()
+
+    def _parse_worker(self, raw: tuple) -> VariantTable:
+        buf_np, lazy_buf = raw
+        if self.profiler is None:
+            return self._parse_chunk(buf_np, lazy_buf)
+        t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs per-worker attribution
+        table = self._parse_chunk(buf_np, lazy_buf)
+        worker = threading.current_thread().name.rsplit("-", 1)[-1]
+        self.profiler.stage(f"parse.{worker}").add_work(
+            time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs per-worker attribution
+            bytes_in=len(buf_np), records=len(table))
+        return table
+
+    def _raw_mm(self):
+        """(buf_np, lazy_buf) chunk buffers in file order (plain text):
+        the SAME boundary rule at every ``VCTPU_IO_THREADS`` setting."""
         mm = self._mm
         n = len(mm)
         off = self._first_off
@@ -892,10 +1085,14 @@ class VcfChunkReader:
                 self._skip -= 1
             else:
                 view = mm[off:end]
-                yield self._parse_chunk(view, view)
+                yield view, view
             off = end
 
-    def _iter_gz(self):
+    def _raw_gz(self):
+        """(buf_np, lazy_buf) chunk buffers from the decompressed stream —
+        the boundary rule reads fixed-size windows off ``self._fh``, so it
+        is identical whether the stream is the serial gzip reader or the
+        shard-parallel BGZF inflater."""
         carry = self._pending
         self._pending = b""
         while True:
@@ -912,14 +1109,12 @@ class VcfChunkReader:
             if self._skip > 0:
                 self._skip -= 1
                 continue
-            buf_np = np.frombuffer(chunk, dtype=np.uint8)
-            yield self._parse_chunk(buf_np, chunk)
+            yield np.frombuffer(chunk, dtype=np.uint8), chunk
         if carry:
             if self._skip > 0:
                 self._skip -= 1
             else:
-                buf_np = np.frombuffer(carry, dtype=np.uint8)
-                yield self._parse_chunk(buf_np, carry)
+                yield np.frombuffer(carry, dtype=np.uint8), carry
         self._fh.close()
 
 

@@ -108,8 +108,19 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "count", positive=True),
     _k("VCTPU_STREAM", "bool", True,
        "allow the streaming (chunked, overlapped) filter executor"),
-    _k("VCTPU_STREAM_CHUNK_BYTES", "int", 16 << 20,
+    _k("VCTPU_STREAM_CHUNK_BYTES", "int", 8 << 20,
        "bytes of VCF text per streaming pipeline item", positive=True),
+    _k("VCTPU_IO_THREADS", "int", None,
+       "host-IO worker pool size (sharded BGZF inflate, parallel chunk "
+       "parse, writeback block compress); 1 disables parallel IO; "
+       "default cpu count", positive=True),
+    _k("VCTPU_IO_SHARD_BYTES", "int", 4 << 20,
+       "decompressed bytes per parallel BGZF inflate shard "
+       "(docs/streaming_executor.md)", positive=True),
+    _k("VCTPU_NATIVE_THREADS", "int", None,
+       "native engine kernel fan-out cap (C++ for_shards; read by the "
+       "native library directly); default hardware concurrency",
+       positive=True),
     _k("VCTPU_STAGE_TIMEOUT_S", "float", 900.0,
        "streaming-stage watchdog deadline in seconds (0 disables)",
        minimum=0.0),

@@ -282,16 +282,24 @@ def bgzf_decompress(data: bytes) -> bytes | None:
     return None if out is None else out.tobytes()
 
 
-def bgzf_compress(data: bytes, level: int = 6) -> bytes | None:
-    """Deflate into BGZF blocks (+EOF sentinel); None → Python fallback."""
+def bgzf_compress(data, level: int = 6) -> bytes | None:
+    """Deflate a bytes-like buffer into BGZF blocks (+EOF sentinel);
+    None → Python fallback. Zero-copy on the way in: the engine deflates
+    straight from the caller's buffer (bytes, memoryview, uint8 array) —
+    the streaming writeback hands multi-MB chunk bodies through here and
+    an extra materialization would double the write path's memory
+    traffic."""
     lib = get_lib()
     if lib is None:
         return None
-    src = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(data or b"\x00")
-    n_blocks = len(data) // 65280 + 1
-    cap = len(data) + n_blocks * 128 + 64
+    src_arr = np.ascontiguousarray(_u8view(data))
+    n_in = len(src_arr)
+    src = src_arr.ctypes.data_as(_u8p) if n_in else \
+        (ctypes.c_uint8 * 1).from_buffer_copy(b"\x00")
+    n_blocks = n_in // 65280 + 1
+    cap = n_in + n_blocks * 128 + 64
     dst = np.empty(cap, dtype=np.uint8)
-    n = lib.vctpu_bgzf_compress(src, len(data), dst.ctypes.data_as(_u8p), cap, level)
+    n = lib.vctpu_bgzf_compress(src, n_in, dst.ctypes.data_as(_u8p), cap, level)
     if n < 0:
         return None
     return dst[:n].tobytes()

@@ -258,16 +258,32 @@ def bottleneck(events: list[dict]) -> dict:
         wall = sum(float(e.get("wall_s", 0.0)) for e in pipe_events) or \
             (float(run_end.get("dur", 0.0)) if run_end else 0.0)
         records = sum(int(e.get("records", 0)) for e in pipe_events)
+        # parallel host-IO pools profile one stage PER WORKER
+        # (parse.w0, inflate.w1, ...; docs/streaming_executor.md): merge
+        # each family into one row and remember its worker count — the
+        # percentage denominator becomes workers × wall, so a stage's
+        # work/wait/other fractions still sum to ~100% of ITS capacity
+        # and the table keeps reading as fractions of wall-clock
+        worker_re = re.compile(r"^(.+)\.w(\d+)$")
         for e in stage_events:  # several pipelines in one stream: sum
-            s = stages.setdefault(e.get("stage", "?"), {
+            name = e.get("stage", "?")
+            m = worker_re.match(name)
+            base = m.group(1) if m else name
+            s = stages.setdefault(base, {
                 "work_s": 0.0, "wait_in_s": 0.0, "wait_out_s": 0.0,
-                "items": 0, "bytes_in": 0, "bytes_out": 0})
+                "items": 0, "bytes_in": 0, "bytes_out": 0,
+                "stage_records": 0, "_workers": set()})
+            if m:
+                s["_workers"].add(m.group(2))
             s["work_s"] += float(e.get("work_s", 0.0))
             s["wait_in_s"] += float(e.get("wait_in_s", 0.0))
             s["wait_out_s"] += float(e.get("wait_out_s", 0.0))
             s["items"] += int(e.get("items", 0))
             s["bytes_in"] += int(e.get("bytes_in", 0))
             s["bytes_out"] += int(e.get("bytes_out", 0))
+            s["stage_records"] += int(e.get("records", 0)) if m else 0
+        for s in stages.values():
+            s["workers"] = max(1, len(s.pop("_workers")))
     else:
         # fallback: depth-0 spans (serial runs, profiling off) — honest
         # about what it is: work only, waits unattributable
@@ -284,17 +300,29 @@ def bottleneck(events: list[dict]) -> dict:
             s["items"] += 1
 
     for s in stages.values():
+        k = s.get("workers", 1)
+        capacity = wall * k  # a k-worker family can spend k×wall working
         tracked = s["work_s"] + s["wait_in_s"] + s["wait_out_s"]
-        s["other_s"] = max(0.0, wall - tracked) if source == "profile" else 0.0
+        s["other_s"] = max(0.0, capacity - tracked) if source == "profile" \
+            else 0.0
         for key in ("work", "wait_in", "wait_out", "other"):
-            s[f"{key}_pct"] = round(100.0 * s[f"{key}_s"] / wall, 1) \
-                if wall > 0 else 0.0
+            s[f"{key}_pct"] = round(100.0 * s[f"{key}_s"] / capacity, 1) \
+                if capacity > 0 else 0.0
             s[f"{key}_s"] = round(s[f"{key}_s"], 6)
-        if records and s["work_s"] > 0:
-            # standalone throughput: what the stage sustains while busy
-            s["vps"] = round(records / s["work_s"])
+        n_rec = s.pop("stage_records", 0) or records
+        if n_rec and s["work_s"] > 0:
+            # standalone throughput: what the stage (all its workers
+            # together) sustains while busy
+            s["vps"] = round(n_rec / (s["work_s"] / k))
 
-    limiting = max(stages, key=lambda n: stages[n]["work_s"]) if stages else None
+    # the limiting stage is the largest per-capacity work share: a
+    # k-worker family's wall-clock floor is work_s / k, so families rank
+    # by normalized work (== work_pct ranking)
+    def _norm_work(s: dict) -> float:
+        return s["work_s"] / s.get("workers", 1)
+
+    limiting = max(stages, key=lambda n: _norm_work(stages[n])) \
+        if stages else None
     out = {
         "source": source,
         "wall_s": round(wall, 6),
@@ -303,7 +331,7 @@ def bottleneck(events: list[dict]) -> dict:
         "limiting_stage": limiting,
         "limiting_work_pct": stages[limiting]["work_pct"] if limiting else None,
         "stages": dict(sorted(stages.items(),
-                              key=lambda kv: -kv[1]["work_s"])),
+                              key=lambda kv: -_norm_work(kv[1]))),
     }
     cost = [e for e in events if e.get("kind") == "profile"
             and e.get("name") == "cost_analysis"]
@@ -329,7 +357,12 @@ def render_bottleneck(b: dict) -> str:
         lines.append(f"throughput: {b['records']} records, "
                      f"{b['e2e_vps']}/s end to end")
     if b["stages"]:
-        width = max(len(n) for n in b["stages"])
+        def label(n: str, s: dict) -> str:
+            k = s.get("workers", 1)
+            return f"{n} x{k}" if k > 1 else n  # merged IO-pool family
+
+        labels = {n: label(n, s) for n, s in b["stages"].items()}
+        width = max(len(v) for v in labels.values())
         lines.append(f"  {'stage':<{width}}  {'work%':>6} {'wait-in%':>8} "
                      f"{'wait-out%':>9} {'other%':>6} {'work_s':>9} "
                      f"{'v/s-alone':>10}  bytes")
@@ -340,7 +373,7 @@ def render_bottleneck(b: dict) -> str:
             if s.get("bytes_out"):
                 byt.append(f"{s['bytes_out'] / (1 << 20):.1f}MB out")
             lines.append(
-                f"  {name:<{width}}  {s['work_pct']:>6.1f} "
+                f"  {labels[name]:<{width}}  {s['work_pct']:>6.1f} "
                 f"{s['wait_in_pct']:>8.1f} {s['wait_out_pct']:>9.1f} "
                 f"{s['other_pct']:>6.1f} {s['work_s']:>9.3f} "
                 f"{s.get('vps', '-'):>10}  {' '.join(byt)}")

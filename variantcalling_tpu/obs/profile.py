@@ -39,6 +39,7 @@ budget (bench ``obs_overhead_pct``, now median-of-5 paired runs).
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
@@ -46,6 +47,10 @@ from variantcalling_tpu import knobs, obs
 
 PROFILE_ENV = "VCTPU_OBS_PROFILE"
 SAMPLE_ENV = "VCTPU_OBS_SAMPLE_S"
+
+#: per-worker stage rows of the parallel host-IO pools (``<name>.w<idx>``)
+#: — the same family spelling obs/export.py's bottleneck merge matches
+_WORKER_STAGE_RE = re.compile(r"\.w\d+$")
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
@@ -60,7 +65,12 @@ class StageStats:
 
     Each stage of the executor runs on exactly ONE thread, so plain
     float adds need no lock on the record path (the snapshot reader
-    crosses threads only after the pipeline joined its workers).
+    crosses threads only after the pipeline joined its workers). The
+    parallel host-IO pools keep the same invariant by keying one stage
+    PER WORKER (``parse.w0``, ``inflate.w1``, …): each pool worker feeds
+    only its own stats object, and ``vctpu obs bottleneck`` re-merges
+    the ``<name>.w<idx>`` family into one row normalized by worker count
+    so the fractions still sum to ~100% of wall.
     """
 
     __slots__ = ("name", "work_s", "wait_in_s", "wait_out_s",
@@ -77,14 +87,17 @@ class StageStats:
         self.bytes_out = 0
 
     def add_work(self, dt: float, items: int = 1,
-                 bytes_in: int = 0, bytes_out: int = 0) -> None:
+                 bytes_in: int = 0, bytes_out: int = 0,
+                 records: int = 0) -> None:
         self.work_s += dt
         self.items += items
         self.bytes_in += bytes_in
         self.bytes_out += bytes_out
+        self.records += records
 
-    def add_wait_in(self, dt: float) -> None:
+    def add_wait_in(self, dt: float, items: int = 0) -> None:
         self.wait_in_s += dt
+        self.items += items
 
     def add_wait_out(self, dt: float) -> None:
         self.wait_out_s += dt
@@ -127,9 +140,16 @@ class StageProfiler:
         return s
 
     def set_records(self, n: int) -> None:
-        """Every stage of a linear pipeline saw all N records."""
-        for s in self._stages.values():
-            s.records = n
+        """Every stage of a linear pipeline saw all N records. Worker
+        stages (``<name>.w<idx>`` — the parallel host-IO pools) keep the
+        per-worker counts they accumulated themselves — INCLUDING a
+        byte-only zero (e.g. ``inflate.wN``): each worker saw only its
+        share, and assigning the run total to k workers would inflate the
+        merged family's records (and its reported standalone v/s) k-fold
+        in ``vctpu obs bottleneck``."""
+        for name, s in self._stages.items():
+            if not s.records and not _WORKER_STAGE_RE.search(name):
+                s.records = n
 
     def emit(self, wall_s: float, records: int | None = None) -> None:
         """Write the attribution into the open obs stream: one

@@ -104,6 +104,119 @@ def resolve_threads() -> int:
     return n if n is not None else (os.cpu_count() or 1)
 
 
+def resolve_io_threads() -> int:
+    """Host-IO worker policy for the parallel ingest/writeback paths
+    (sharded BGZF inflate, chunk-parse fan-out, writeback block
+    compress): ``VCTPU_IO_THREADS`` overrides, else cpu count. ``1``
+    disables parallel IO — the serial code paths run inline, no pool.
+    A malformed value is a configuration error (EngineError, exit 2;
+    knob-registry contract)."""
+    n = knobs.get_int("VCTPU_IO_THREADS")
+    return n if n is not None else (os.cpu_count() or 1)
+
+
+class _IoFuture:
+    """Minimal future for :class:`IoPool` (result/exception + done event)."""
+
+    __slots__ = ("_done", "_result", "_exc")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result = None
+        self._exc: BaseException | None = None
+
+    def result(self, timeout: float | None = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("IO task did not complete in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class IoPool:
+    """Tiny DAEMON-thread worker pool for the parallel host-IO paths.
+
+    Unlike ``concurrent.futures.ThreadPoolExecutor`` (non-daemon workers,
+    joined at interpreter exit), these workers are daemons: a truly
+    wedged native/zlib call inside one cannot block process exit — the
+    same policy the stage executor applies to its workers (the watchdog
+    names the stuck stage; an unjoinable thread dies with the process).
+    Worker threads are named ``<name>-w<idx>`` so the obs profiler can
+    attribute per-worker work (docs/observability.md).
+    """
+
+    def __init__(self, threads: int, name: str = "vctpu-io"):
+        self.threads = max(1, int(threads))
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self.unjoined: list[str] = []
+        self._workers = [
+            threading.Thread(target=self._loop, name=f"{name}-w{i}", daemon=True)
+            for i in range(self.threads)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            try:
+                fut._result = fn(*args)
+            # not a swallow: result() re-raises in the consumer
+            except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed through the future and re-raised at result()
+                fut._exc = e
+            finally:
+                fut._done.set()
+
+    def submit(self, fn: Callable, *args) -> _IoFuture:
+        fut = _IoFuture()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Stop the workers (bounded join — a wedged worker is recorded
+        in ``unjoined`` and abandoned, mirroring StagePipeline)."""
+        for _ in self._workers:
+            self._q.put(None)
+        self.unjoined = []
+        for w in self._workers:
+            w.join(timeout=timeout)
+            if w.is_alive():
+                self.unjoined.append(w.name)
+        if self.unjoined:
+            logger.warning("IO pool: %d worker(s) did not join: %s",
+                           len(self.unjoined), ", ".join(self.unjoined))
+
+
+def imap_ordered(pool: IoPool, fn: Callable, items: Iterable,
+                 window: int) -> Iterator:
+    """Map ``fn`` over ``items`` on ``pool``, yielding results strictly
+    in submission order with at most ``window`` tasks in flight — the
+    ordered-reassembly primitive of the parallel host-IO paths (shard
+    inflate, chunk parse, block compress). The bounded window keeps peak
+    memory at O(window × item); a failed task re-raises at its ordinal
+    position (downstream consumers see the same exception order a serial
+    loop would)."""
+    from collections import deque
+
+    pending: deque[_IoFuture] = deque()
+    it = iter(items)
+    exhausted = False
+    while True:
+        while not exhausted and len(pending) < max(1, window):
+            try:
+                item = next(it)
+            except StopIteration:
+                exhausted = True
+                break
+            pending.append(pool.submit(fn, item))
+        if not pending:
+            return
+        yield pending.popleft().result()
+
+
 def resolve_stage_timeout() -> float:
     """Watchdog deadline from ``VCTPU_STAGE_TIMEOUT_S`` (0 disables). A
     malformed value is a configuration error (EngineError, CLI exit 2;
@@ -160,9 +273,13 @@ class StagePipeline:
     def __init__(self, stages: list[Callable], queue_depth: int = 2,
                  threads: int | None = None, timeout: float | None = None,
                  profiler=None, source_name: str = "source",
-                 consumer_name: str = "consume"):
-        if not stages:
-            raise ValueError("StagePipeline needs at least one stage")
+                 consumer_name: str = "consume",
+                 source_pooled: bool = False):
+        if stages is None:
+            raise ValueError("StagePipeline needs a stage list")
+        # an EMPTY stage list is legal with a pooled source (parallel
+        # host IO): the pipeline is then source -> bounded queue ->
+        # consumer, and the watchdog/error/teardown contracts still hold
         self.stages = list(stages)
         self.queue_depth = max(1, int(queue_depth))
         self.threads = resolve_threads() if threads is None else max(1, int(threads))
@@ -176,6 +293,11 @@ class StagePipeline:
         self.profiler = profiler
         self.source_name = source_name
         self.consumer_name = consumer_name
+        #: True when the source is an ordered drain of a worker pool
+        #: (parallel host IO): time blocked in next() is then QUEUE-WAIT
+        #: on the pool, not work — the workers attribute the real work
+        #: under their own ``<stage>.w<idx>`` profile rows
+        self.source_pooled = source_pooled
         #: threads that refused to join within the cleanup grace period on
         #: the most recent run (a truly wedged native call cannot be
         #: interrupted from Python; they are daemons and die with the
@@ -218,8 +340,16 @@ class StagePipeline:
             item = next(it)
         except StopIteration:
             return False, None
-        self._record_stage_work(self.source_name,
-                                time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
+        dt = time.perf_counter() - t0  # vctpu-lint: disable=VCT006 — obs span timing
+        if self.source_pooled:
+            # pooled source: blocked-on-pool time is wait-in, not work
+            obs.span(self.source_name, dt, threading.current_thread().name,
+                     chunk=seq)
+            obs.histogram(f"stage.{self.source_name}.s").observe(dt)
+            if prof is not None:
+                prof.stage(self.source_name).add_wait_in(dt, items=1)
+        else:
+            self._record_stage_work(self.source_name, dt, seq, prof)
         return True, item
 
     def _run_serial(self, source: Iterable) -> Iterator:
@@ -306,6 +436,10 @@ class StagePipeline:
                         break
                     if not _put_timed(_put, queues[0], (seq, item), src):
                         return
+                    if obs.active():
+                        # queue pressure at the pipeline head (with an
+                        # empty stage list this is the ONLY queue)
+                        obs.gauge("queue.source.depth").set(queues[0].qsize())
                     seq += 1
                 _put(queues[0], _SENTINEL)
             # not a swallow: the consumer re-raises the relayed exception

@@ -901,14 +901,25 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                         engine: engine_mod.EngineDecision | None = None) -> dict:
     import threading
+    import time as _time
     import zlib
 
+    from variantcalling_tpu.utils import faults
     from variantcalling_tpu.io import journal as journal_mod
     from variantcalling_tpu.io.vcf import (VcfChunkReader, assemble_table_bytes,
                                            render_table_bytes_python)
     from variantcalling_tpu.parallel.pipeline import StagePipeline
 
-    reader = VcfChunkReader(args.input_file)
+    # obs v2 attribution: created BEFORE the reader so the parallel-IO
+    # worker pools (shard inflate / chunk parse) attribute their work
+    # from the very first shard; the executor feeds per-stage work/
+    # queue-wait/backpressure into the same profile and this loop adds
+    # writeback work and the IO byte totals. One emit at commit time ->
+    # `vctpu obs bottleneck` names the limiting stage (ROADMAP item 1).
+    from variantcalling_tpu.obs import profile as profile_mod
+
+    prof = profile_mod.StageProfiler() if profile_mod.enabled() else None
+    reader = VcfChunkReader(args.input_file, profiler=prof)
     header = reader.header
     ctx = FilterContext(
         model, fasta, runs_file=args.runs_file,
@@ -937,6 +948,35 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         score, filters = ctx.score_table(table)
         return table, score, filters
 
+    def _timed_worker(fn, stage_name, item, n_records):
+        """Run one stage callable on an IO-pool worker with the same
+        span/histogram telemetry the executor would emit for that stage,
+        plus a per-worker attribution row (``<stage>.w<idx>``)."""
+        if not obs.active():
+            return fn(item)
+        t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+        out = fn(item)
+        dt = _time.perf_counter() - t0  # vctpu-lint: disable=VCT006 — obs span timing
+        tname = threading.current_thread().name
+        obs.span(stage_name, dt, tname)
+        obs.histogram(f"stage.{stage_name}.s").observe(dt)
+        if prof is not None:
+            prof.stage(f"{stage_name}.{tname.rsplit('-', 1)[-1]}").add_work(
+                dt, records=n_records)
+        return out
+
+    def chunk_worker(table):
+        """The pooled per-chunk body (parallel layout): featurize+score
+        then native record render, one task per chunk — chunk c's Python
+        glue overlaps chunk c+1's native kernels instead of serializing
+        on dedicated stage threads. The executor's fault-injection points
+        keep firing per chunk so the watchdog/error contracts stay
+        testable in this layout."""
+        faults.check("pipeline.stage")
+        faults.check("pipeline.stage_hang")
+        scored = _timed_worker(score_stage, "score_stage", table, len(table))
+        return _timed_worker(render_stage, "render_stage", scored, len(table))
+
     def render_stage(item):
         table, score, filters = item
         extra = {"TREE_SCORE": np.round(score, 4)}
@@ -950,6 +990,27 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     part_path = journal_mod.partial_path(out_path)
     header_bytes = (b"".join((line + "\n").encode() for line in header.lines)
                     + (header.column_header() + "\n").encode())
+
+    # parallel writeback (gz outputs): rendered chunk bodies compress to
+    # BGZF blocks in their own pipeline stage — block framing tracked by
+    # a deterministic carry identical to the serial BgzfWriter's, deflate
+    # fanned out (native block-sharded compressor, or the IO pool) — and
+    # the consumer below is the sequenced single-writer merge: it drains
+    # compressed chunks strictly in sequence order through the same
+    # .partial + os.replace atomic path plain outputs use.
+    compressor = None
+    if gz:
+        from variantcalling_tpu.io.bgzf import BgzfChunkCompressor
+        from variantcalling_tpu.parallel.pipeline import resolve_io_threads
+
+        compress_pool = (reader.shared_pool() if resolve_io_threads() > 1
+                         else None)
+        compressor = BgzfChunkCompressor(pool=compress_pool)
+
+        def compress_stage(item):
+            body, k, p = item
+            data = memoryview(body) if isinstance(body, np.ndarray) else body
+            return compressor.add(data), k, p
 
     # resume only for plain-text outputs: a killed BGZF writer's in-flight
     # block state is unrecoverable, so .gz runs restart (still atomic)
@@ -996,10 +1057,11 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
 
     n_total = n_pass = n_chunks = 0
     if gz:
-        from variantcalling_tpu.io.bgzf import BgzfWriter
-
         journal_mod.discard(out_path)  # stale leftovers from older runs
-        sink = BgzfWriter(part_path)
+        # the compress stage produces finished BGZF blocks; the committer
+        # writes them raw (and rewindably, so transient write errors are
+        # retryable — the old in-consumer BgzfWriter could not rewind)
+        sink = open(part_path, "wb")
         if obs.active():
             obs.event("journal", "resume_decision", outcome="disabled",
                       reason="gz output: BGZF block state does not survive "
@@ -1029,18 +1091,32 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                       outcome="fresh" if resume_enabled else "opted_out",
                       journaling=resume_enabled)
 
-    # obs v2 attribution: the executor feeds per-stage work/queue-wait/
-    # backpressure into the profiler; this loop adds writeback work and
-    # the IO byte totals. One emit at commit time -> `vctpu obs
-    # bottleneck` names the limiting stage (ROADMAP item 1's metric).
-    from variantcalling_tpu.obs import profile as profile_mod
-
-    prof = profile_mod.StageProfiler() if profile_mod.enabled() else None
     wb = prof.stage("writeback") if prof is not None else None
-    pipe = StagePipeline([score_stage, render_stage], queue_depth=2,
+    # the parallel layout (VCTPU_IO_THREADS > 1): scoring AND record
+    # render ride the SAME ordered-window fan-out as chunk parse — all
+    # per-chunk work shares the IO pool, reassembled into canonical
+    # sequence order before the stream enters the stage pipeline, so the
+    # committer sees exactly the serial chunk sequence. Only the
+    # order-dependent tail stays sequenced: the BGZF carry (compress
+    # stage) and the single-writer commit. The serial-IO layout
+    # (VCTPU_IO_THREADS=1) keeps the dedicated score/render stage
+    # threads, as before.
+    source_pooled = reader.io_threads > 1
+    if source_pooled:
+        from variantcalling_tpu.parallel.pipeline import imap_ordered
+
+        source = imap_ordered(reader.shared_pool(), chunk_worker,
+                              iter(reader), window=reader.io_threads + 2)
+        stages = []
+    else:
+        source = iter(reader)
+        stages = [score_stage, render_stage]
+    if compressor is not None:
+        stages.append(compress_stage)
+    pipe = StagePipeline(stages, queue_depth=2,
                          profiler=prof, source_name="ingest",
-                         consumer_name="writeback")
-    gen = pipe.run(iter(reader))
+                         consumer_name="writeback", source_pooled=source_pooled)
+    gen = pipe.run(source)
     ok = False
     # heartbeat bookkeeping (obs only). Progress (pct) counts ALL
     # committed chunks incl. resumed ones; rate (vps) and ETA use only
@@ -1050,8 +1126,6 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # PLAIN-TEXT inputs: a .gz reader consumes chunk_bytes of
     # decompressed text while getsize() is compressed, so gz runs emit
     # heartbeats without pct/eta rather than a clamped-to-100 lie.
-    import time as _time
-
     input_bytes = os.path.getsize(args.input_file)
     bytes_comparable = not args.input_file.endswith(".gz")
     resumed_chunks = n_chunks
@@ -1060,7 +1134,15 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     try:
         with sink:
             if resume is None:
-                _sink_write(sink, header_bytes)
+                if compressor is not None:
+                    # the header rides the SAME block stream the chunk
+                    # bodies do (it usually just seeds the carry — the
+                    # serial BgzfWriter buffered it identically). Safe
+                    # ordering: the compress stage has not started — the
+                    # pipeline workers spin up on the first next() below.
+                    _sink_write(sink, compressor.add(header_bytes))
+                else:
+                    _sink_write(sink, header_bytes)
             for body, k, p in gen:
                 data = memoryview(body) if isinstance(body, np.ndarray) else body
                 if wb is not None:
@@ -1100,15 +1182,21 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                     sink.flush()
                     journal.append(n_chunks - 1, k, p, len(data),
                                    zlib.crc32(data))
+            if compressor is not None:
+                # the final partial block + EOF sentinel — the committer
+                # (this thread) is the only writer, in sequence order
+                _sink_write(sink, compressor.finish())
         ok = True
     finally:
         # guaranteed teardown on EVERY exit path: stage workers drained and
-        # joined (generator close runs StagePipeline's finally), prefetch
-        # cancelled and joined (a dying process must not kill a .venc
-        # persist mid-file), journal handle closed.
+        # joined (generator close runs StagePipeline's finally), the IO
+        # worker pool shut down, prefetch cancelled and joined (a dying
+        # process must not kill a .venc persist mid-file), journal handle
+        # closed.
         try:
             gen.close()
         finally:
+            reader.close()
             prefetch_cancel.set()
             prefetch.join()
         if journal is not None:
@@ -1130,7 +1218,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
 
     if journal is not None:
         journal.finish()
-    os.replace(part_path, out_path)  # atomic commit
+    os.replace(part_path, out_path)  # vctpu-lint: disable=VCT008 — THE one sanctioned atomic commit
     if obs.active():
         obs.event("journal", "committed", chunks=n_chunks, records=n_total)
     if prof is not None:

@@ -65,6 +65,14 @@ POINTS: dict[str, tuple[str, object]] = {
         "writeback IO error (ENOSPC) on the streaming output sink",
         lambda: OSError(errno.ENOSPC, "injected fault: no space left on device"),
     ),
+    "io.shard_decompress": (
+        "IO worker death mid-BGZF-shard-inflate (parallel ingest)",
+        lambda: OSError(errno.EIO, "injected fault: shard inflate error"),
+    ),
+    "io.shard_compress": (
+        "worker death mid-BGZF-block-compress (parallel writeback)",
+        lambda: OSError(errno.EIO, "injected fault: shard compress error"),
+    ),
     "dist.rank_timeout": (
         "one rank entering a collective late (cancellable delay)",
         None,  # delay-style
