@@ -170,6 +170,40 @@ def test_set_records_skips_worker_stages(tmp_path):
     assert b["stages"]["inflate"]["vps"] == 10_000
 
 
+def test_bottleneck_merges_score_device_family(tmp_path):
+    """Mesh-sharded scoring profiles one row PER DEVICE (``score.dN``,
+    parallel/shard_score.megabatch_stream); the roll-up merges the
+    family exactly like the ``.wN`` worker families — lane count in
+    ``workers`` (plus the ``devices`` marker), capacity normalized to
+    lanes x wall so fractions still read against wall-clock, records
+    summed across device shares."""
+    run, path = _open_run(tmp_path)
+    prof = profile_mod.StageProfiler()
+    prof.stage("ingest").add_work(0.2)
+    # 2 devices in lockstep: each carries the 4.0s dispatch wall and its
+    # half of the records (megabatch shards are same-shape)
+    for dev in range(2):
+        prof.stage(f"score.d{dev}").add_work(4.0, records=5_000)
+    prof.stage("writeback").add_work(0.5)
+    prof.emit(wall_s=10.0, records=10_000)
+    obs.end_run(run, "ok")
+    stages = {e["stage"]: e for e in _events(path)
+              if e["kind"] == "profile" and e["name"] == "stage"}
+    # set_records must not clobber per-device shares (the .wN rule)
+    assert stages["score.d0"]["records"] == 5_000
+    assert stages["ingest"]["records"] == 10_000
+    b = export_mod.bottleneck(export_mod.read_run(path))
+    fam = b["stages"]["score"]
+    assert fam["workers"] == 2 and fam["devices"] == 2
+    assert "devices" not in b["stages"]["ingest"]
+    # capacity = 2 x 10s wall; each lane worked 4s -> 40% of capacity
+    assert fam["work_pct"] == 40.0
+    # standalone v/s: all 10k records over the 4s lockstep dispatch wall
+    assert fam["vps"] == 2_500
+    assert b["limiting_stage"] == "score"
+    assert "score x2" in export_mod.render_bottleneck(b)
+
+
 def test_profiler_disabled_by_knob(tmp_path, monkeypatch):
     monkeypatch.setenv("VCTPU_OBS_PROFILE", "0")
     run, path = _open_run(tmp_path)

@@ -1,0 +1,349 @@
+"""Mesh-sharded scoring (ISSUE 8 tentpole): device-data-parallel filter
+hot path over ``shard_map`` with device-count byte parity.
+
+Locks the contracts the mesh dispatch must keep:
+
+- **Byte parity**: streaming CLI output records are byte-identical at
+  forced device counts {1, 2, 4} x {native, jit} engines x {gather,
+  wide} strategies — only the ``##vctpu_*`` header lines name the
+  configuration (the PR 2 invariant extended to the mesh layout).
+- **Canonical unpack**: megabatch packing across chunks changes WHO
+  scores, never the bits — packed scores equal per-chunk scores exactly,
+  in chunk order.
+- **Plan resolution**: explicit ``VCTPU_MESH_DEVICES`` is honored or
+  fails loudly; auto keeps 1 device on cpu; the native engine always
+  resolves 1 (host walk, nothing to shard).
+- **Forced-host route**: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+  in a fresh subprocess produces the same record bytes as the in-process
+  mesh (the container-visible path to multi-device testing).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("variantcalling_tpu.native")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _engine_cache_isolated():
+    yield
+    from variantcalling_tpu import engine as engine_mod
+
+    engine_mod.reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def mesh_world(tmp_path_factory):
+    import bench
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    d = str(tmp_path_factory.mktemp("meshscore"))
+    bench.make_fixtures(d, n=5000, genome_len=250_000)
+    model = synthetic_forest(np.random.default_rng(0), n_trees=8, depth=4)
+    with open(f"{d}/model.pkl", "wb") as fh:
+        pickle.dump({"m": model}, fh)
+    return {"dir": d, "n": 5000, "model": model,
+            "fasta": FastaReader(f"{d}/ref.fa")}
+
+
+def _stream(w, out, monkeypatch, engine, devices, strategy=None,
+            io_threads=2):
+    import argparse
+
+    from variantcalling_tpu import engine as engine_mod
+    from variantcalling_tpu.io import vcf as vcf_mod
+    from variantcalling_tpu.pipelines.filter_variants import run_streaming
+
+    monkeypatch.setattr(vcf_mod, "STREAM_CHUNK_BYTES", 1 << 15)
+    monkeypatch.setenv("VCTPU_ENGINE", engine)
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", str(devices))
+    monkeypatch.setenv("VCTPU_IO_THREADS", str(io_threads))
+    if strategy is None:
+        monkeypatch.delenv("VCTPU_FOREST_STRATEGY", raising=False)
+    else:
+        monkeypatch.setenv("VCTPU_FOREST_STRATEGY", strategy)
+    engine_mod.reset_for_tests()
+    args = argparse.Namespace(
+        input_file=f"{w['dir']}/calls.vcf", output_file=out, runs_file=None,
+        hpol_filter_length_dist=[10, 10], blacklist=None,
+        blacklist_cg_insertions=False, annotate_intervals=[],
+        flow_order="TGCA", is_mutect=False, limit_to_contig=None)
+    return run_streaming(args, w["model"], w["fasta"], {}, None)
+
+
+def _modulo_header(data: bytes) -> bytes:
+    """Everything except the ``##vctpu_*`` configuration lines — the one
+    place engines/strategies/mesh layouts may differ."""
+    return b"\n".join(ln for ln in data.split(b"\n")
+                      if not ln.startswith(b"##vctpu_"))
+
+
+# ---------------------------------------------------------------------------
+# plan resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_plan_auto_cpu_is_single_device(monkeypatch):
+    from variantcalling_tpu.parallel import shard_score
+
+    monkeypatch.delenv("VCTPU_MESH_DEVICES", raising=False)
+    plan = shard_score.resolve_plan("jit")
+    assert plan.devices == 1 and plan.requested == "auto"
+    assert shard_score.mesh_for(plan) is None
+
+
+def test_resolve_plan_explicit_honored_and_meshed(monkeypatch):
+    from variantcalling_tpu.parallel import shard_score
+    from variantcalling_tpu.parallel.mesh import DATA_AXIS
+
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "4")
+    plan = shard_score.resolve_plan("jit")
+    assert plan.devices == 4 and plan.requested == "4"
+    mesh = shard_score.mesh_for(plan)
+    assert mesh.shape[DATA_AXIS] == 4
+    # one Mesh object per size per process (jit caches key on identity)
+    assert shard_score.mesh_for(plan) is mesh
+    assert plan.header_line() == "##vctpu_mesh=dp=4"
+
+
+def test_resolve_plan_native_engine_has_no_mesh(monkeypatch):
+    from variantcalling_tpu.parallel import shard_score
+
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "4")
+    plan = shard_score.resolve_plan("native")
+    assert plan.devices == 1
+    assert "native" in plan.reason
+
+
+def test_resolve_plan_overcommit_fails_loudly(monkeypatch):
+    from variantcalling_tpu.engine import EngineError
+    from variantcalling_tpu.parallel import shard_score
+
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "99")
+    with pytest.raises(EngineError, match="VCTPU_MESH_DEVICES=99"):
+        shard_score.resolve_plan("jit")
+
+
+def test_megabatch_rows_default_and_override(monkeypatch):
+    from variantcalling_tpu.parallel import shard_score
+
+    monkeypatch.delenv("VCTPU_MESH_MEGABATCH_ROWS", raising=False)
+    assert shard_score.resolve_megabatch_rows(2) == \
+        2 * shard_score.MEGABATCH_ROWS_PER_DEVICE
+    monkeypatch.setenv("VCTPU_MESH_MEGABATCH_ROWS", "777")
+    assert shard_score.resolve_megabatch_rows(2) == 777
+
+
+def test_unpack_scores_slices_in_canonical_order():
+    from variantcalling_tpu.parallel import shard_score
+
+    packed = np.arange(10, dtype=np.float32)
+    parts = shard_score.unpack_scores(packed, [3, 0, 7])
+    assert [len(p) for p in parts] == [3, 0, 7]
+    assert np.array_equal(np.concatenate(parts), packed)
+    with pytest.raises(ValueError):
+        shard_score.unpack_scores(packed, [3, 3])
+
+
+# ---------------------------------------------------------------------------
+# packed megabatch == per-chunk scoring, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _filter_context(w, monkeypatch, devices, strategy="gather"):
+    from variantcalling_tpu import engine as engine_mod
+    from variantcalling_tpu.pipelines.filter_variants import FilterContext
+
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", str(devices))
+    monkeypatch.setenv("VCTPU_FOREST_STRATEGY", strategy)
+    engine_mod.reset_for_tests()
+    return FilterContext(w["model"], w["fasta"])
+
+
+def test_score_packed_matches_per_chunk_bitwise(mesh_world, monkeypatch):
+    from variantcalling_tpu.io.vcf import VcfChunkReader
+
+    w = mesh_world
+    ctx = _filter_context(w, monkeypatch, devices=2)
+    assert ctx.mesh_plan.devices == 2
+    tables = list(VcfChunkReader(f"{w['dir']}/calls.vcf",
+                                 chunk_bytes=1 << 15, io_threads=1))
+    assert len(tables) > 2
+    pairs = [(t, ctx.host_features(t)) for t in tables]
+    packed = ctx.score_packed(pairs)
+    assert [len(t) for t, _, _ in packed] == [len(t) for t in tables]
+    for (table, score, filters), (t0, hf) in zip(packed, pairs):
+        ref_score, ref_filters = ctx.score_table(t0)
+        assert np.array_equal(score, ref_score)  # bitwise
+        assert np.array_equal(filters.codes, ref_filters.codes)
+
+
+def test_megabatch_stream_groups_and_attributes_devices(mesh_world,
+                                                        monkeypatch):
+    from variantcalling_tpu.io.vcf import VcfChunkReader
+    from variantcalling_tpu.obs import profile as profile_mod
+    from variantcalling_tpu.parallel import shard_score
+
+    w = mesh_world
+    ctx = _filter_context(w, monkeypatch, devices=2)
+    tables = list(VcfChunkReader(f"{w['dir']}/calls.vcf",
+                                 chunk_bytes=1 << 15, io_threads=1))
+    prof = profile_mod.StageProfiler()
+    prepped = ((t, ctx.host_features(t)) for t in tables)
+    scored = list(shard_score.megabatch_stream(prepped, ctx, profiler=prof))
+    assert [len(t) for t, _, _ in scored] == [len(t) for t in tables]
+    assert sum(len(t) for t, _, _ in scored) == w["n"]
+    # per-device attribution rows exist and carry the record shares
+    rows = {name: s for name, s in prof._stages.items()
+            if name.startswith("score.d")}
+    assert set(rows) == {"score.d0", "score.d1"}
+    assert sum(s.records for s in rows.values()) == w["n"]
+    # tiny megabatch target: every chunk becomes its own dispatch, and
+    # the bits STILL match the single-group run (packing is bit-neutral)
+    monkeypatch.setenv("VCTPU_MESH_MEGABATCH_ROWS", "1")
+    scored_tiny = list(shard_score.megabatch_stream(
+        ((t, ctx.host_features(t)) for t in tables), ctx))
+    for (_, s_a, f_a), (_, s_b, f_b) in zip(scored, scored_tiny):
+        assert np.array_equal(s_a, s_b)
+        assert np.array_equal(f_a.codes, f_b.codes)
+
+
+def test_serial_io_mesh_layout_attribution_not_double_counted(mesh_world,
+                                                              monkeypatch,
+                                                              tmp_path):
+    """VCTPU_IO_THREADS=1 with a >1-device mesh: the megabatch dispatch
+    runs inside the executor feed's next(), so the pipeline must book
+    its feed-blocked time as ingest QUEUE-WAIT (the pooled-source rule)
+    — the featurize/score walls already belong to the featurize/score.dN
+    rows recorded inside the source chain. Before the fix the whole
+    scoring wall was double-counted as ingest WORK, misnaming the
+    limiting stage."""
+    import json
+
+    from variantcalling_tpu import obs
+    from variantcalling_tpu.obs import export as export_mod
+
+    w = mesh_world
+    path = str(tmp_path / "mesh_serial.jsonl")
+    run = obs.start_run("test_tool", force_path=path)
+    assert run is not None
+    try:
+        out = str(tmp_path / "mesh_serial.vcf")
+        stats = _stream(w, out, monkeypatch, "jit", 2, io_threads=1)
+        assert stats is not None and stats["n"] == w["n"]
+    finally:
+        obs.end_run(run, "ok")
+    events = [json.loads(ln) for ln in open(path, encoding="utf-8")
+              if ln.strip()]
+    b = export_mod.bottleneck(events)
+    stages = b["stages"]
+    # the score.dN family merged at device capacity
+    assert stages["score"]["devices"] == 2
+    assert stages["score"]["work_s"] > 0
+    # ingest carries the reader's own parse work plus feed QUEUE-WAIT on
+    # the scoring chain — wait_in (and its per-item count) only exist on
+    # the pooled-source rule, so these are the regression tripwires: the
+    # old non-pooled branch booked the whole megabatch wall as ingest
+    # work with zero wait and zero items
+    assert stages["ingest"]["wait_in_s"] > 0
+    assert stages["ingest"]["items"] == stats["chunks"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: byte parity at forced device counts x engine x strategy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.flakehunt
+@pytest.mark.parametrize("engine", ["native", "jit"])
+def test_streaming_byte_parity_device_count_matrix(mesh_world, monkeypatch,
+                                                   engine):
+    """Acceptance: CLI output records byte-identical at forced device
+    counts {1,2,4}, per engine, across two forest strategies (jit; the
+    native engine has no XLA strategy) — modulo the ``##vctpu_*`` header
+    lines naming the configuration. Ordering-sensitive under the pooled
+    layouts: flakehunt repeats it."""
+    w = mesh_world
+    d = w["dir"]
+    strategies = ("gather", "wide") if engine == "jit" else (None,)
+    oracle = None
+    for strategy in strategies:
+        for devices in (1, 2, 4):
+            out = f"{d}/mesh_{engine}_{strategy}_{devices}.vcf"
+            stats = _stream(w, out, monkeypatch, engine, devices,
+                            strategy=strategy)
+            assert stats is not None and stats["n"] == w["n"], \
+                (engine, strategy, devices)
+            data = open(out, "rb").read()
+            mesh_lines = [ln for ln in data.split(b"\n")
+                          if ln.startswith(b"##vctpu_mesh=")]
+            if engine == "jit" and devices > 1:
+                # >1-device runs name their layout exactly once
+                assert mesh_lines == [b"##vctpu_mesh=dp=%d" % devices]
+            else:
+                # single-device plans (and every native run — nothing to
+                # shard) emit NO mesh line
+                assert mesh_lines == []
+            body = _modulo_header(data)
+            if oracle is None:
+                oracle = body
+            else:
+                assert body == oracle, (engine, strategy, devices)
+
+
+@pytest.mark.flakehunt
+def test_streaming_parity_native_vs_meshed_jit_modulo_header(mesh_world,
+                                                             monkeypatch):
+    """Cross-engine x cross-mesh: the native host walk and a 4-device
+    shard_map jit run produce identical records."""
+    w = mesh_world
+    d = w["dir"]
+    outs = {}
+    for name, engine, devices in (("native", "native", 1),
+                                  ("jit4", "jit", 4)):
+        out = f"{d}/cross_{name}.vcf"
+        assert _stream(w, out, monkeypatch, engine, devices) is not None
+        outs[name] = open(out, "rb").read()
+    assert _modulo_header(outs["native"]) == _modulo_header(outs["jit4"])
+
+
+def test_forced_host_device_count_subprocess_parity(mesh_world, monkeypatch,
+                                                    tmp_path):
+    """The documented container route: a FRESH process forced to 4 host
+    devices (XLA_FLAGS) scoring on a 4-device mesh emits the same record
+    bytes as the in-process single-device run — proving the env route
+    end to end, not just the in-process mesh slicing."""
+    w = mesh_world
+    out_ref = f"{w['dir']}/sub_ref.vcf"
+    assert _stream(w, out_ref, monkeypatch, "jit", 1) is not None
+
+    out = str(tmp_path / "sub_mesh.vcf")
+    child = (
+        "from variantcalling_tpu.pipelines.filter_variants import run\n"
+        f"raise SystemExit(run(['--input_file', {w['dir'] + '/calls.vcf'!r},\n"
+        f" '--model_file', {w['dir'] + '/model.pkl'!r}, '--model_name', 'm',\n"
+        f" '--reference_file', {w['dir'] + '/ref.fa'!r},\n"
+        f" '--output_file', {out!r}, '--backend', 'cpu']))\n")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env.update(PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               VCTPU_ENGINE="jit", VCTPU_MESH_DEVICES="4",
+               VCTPU_STREAM_CHUNK_BYTES=str(1 << 15))
+    p = subprocess.run([sys.executable, "-c", child], env=env, cwd=_REPO,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stderr[-2000:]
+    data = open(out, "rb").read()
+    assert b"##vctpu_mesh=dp=4" in data
+    assert _modulo_header(data) == _modulo_header(open(out_ref, "rb").read())

@@ -348,6 +348,67 @@ def test_resume_accepts_same_forest_strategy(stream_fault_world, clean_bytes,
         == clean_bytes
 
 
+def test_resume_rejects_mesh_device_count_change(stream_fault_world,
+                                                 clean_bytes, monkeypatch):
+    """The mesh layout is part of the resume identity (the design the
+    tentpole pins): record bytes are device-count-invariant, but the
+    HEADER names the layout (##vctpu_mesh=dp=N when N > 1), so a run
+    interrupted on a 2-device scoring mesh and resumed single-device
+    RESTARTS cleanly (resumed_chunks == 0) instead of splicing two
+    headers. The fresh run's records still match the native oracle —
+    device-count parity through the whole streaming pipeline."""
+    from variantcalling_tpu import engine as engine_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/mesh_change.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "2")
+    engine_mod.reset_for_tests()
+    try:
+        faults.arm("io.writeback", times=None, after=3)
+        with pytest.raises(OSError):
+            _run_stream(w, out, monkeypatch)
+        assert len(open(out + ".journal").read().splitlines()) - 1 >= 1
+        faults.reset()
+        monkeypatch.setenv("VCTPU_MESH_DEVICES", "1")
+        stats = _run_stream(w, out, monkeypatch)
+        assert stats is not None and stats["resumed_chunks"] == 0
+        assert stats["n"] == w["n"]
+        # the single-device restart emits no mesh line, so its bytes equal
+        # the oracle exactly (the 8-forced-device test env auto-resolves
+        # the oracle's engine to jit/gather, same as the explicit pin)
+        assert open(out, "rb").read() == clean_bytes
+    finally:
+        engine_mod.reset_for_tests()
+
+
+def test_resume_accepts_same_mesh_device_count(stream_fault_world,
+                                               clean_bytes, monkeypatch):
+    """Control for the identity test: the SAME 2-device mesh resumes
+    (resumed_chunks == committed) and the continuation is byte-identical
+    to the oracle modulo the configuration header lines."""
+    from variantcalling_tpu import engine as engine_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/mesh_same.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "2")
+    engine_mod.reset_for_tests()
+    try:
+        faults.arm("io.writeback", times=None, after=3)
+        with pytest.raises(OSError):
+            _run_stream(w, out, monkeypatch)
+        committed = len(open(out + ".journal").read().splitlines()) - 1
+        assert committed >= 1
+        faults.reset()
+        stats = _run_stream(w, out, monkeypatch)
+        assert stats is not None and stats["resumed_chunks"] == committed
+        assert open(out, "rb").read().replace(
+            b"##vctpu_mesh=dp=2\n", b"") == clean_bytes
+    finally:
+        engine_mod.reset_for_tests()
+
+
 def test_resume_survives_io_thread_count_change(stream_fault_world, clean_bytes,
                                                 monkeypatch):
     """Chunk boundaries are identical at every VCTPU_IO_THREADS setting,

@@ -546,11 +546,139 @@ def test_vct008_scoped_to_pipelines_and_suppressible():
         """, path=PIPE) == []
 
 
+# ---------------------------------------------------------------------------
+# VCT009 shardmap-margin-reduction
+# ---------------------------------------------------------------------------
+
+
+def test_vct009_psum_over_margins_in_shard_map_body_flagged():
+    fs = run("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x, margins):
+            return jax.lax.psum(margins, "dp")
+
+        prog = shard_map(body, mesh=None, in_specs=(), out_specs=())
+        """)
+    assert [f.code for f in fs] == ["VCT009"]
+    assert "psum" in fs[0].message
+    assert "sequential_tree_sum" in fs[0].message
+
+
+def test_vct009_jnp_sum_over_scores_in_shard_program_body_flagged():
+    # the repo's own wrapper installs shard_map bodies too; score-named
+    # arrays are in the vocabulary (the mesh path moves scores around)
+    assert codes("""
+        import jax.numpy as jnp
+        from variantcalling_tpu.parallel import shard_score
+
+        def per_device(score_block):
+            return jnp.sum(score_block, axis=0)
+
+        fn = shard_score.shard_program(per_device, mesh, n_data_args=1)
+        """) == ["VCT009"]
+    # method form (VCT003 also fires on the tree/margin vocabulary —
+    # both codes own this line; select isolates the shard_map rule)
+    assert codes("""
+        from jax.experimental.shard_map import shard_map
+
+        def body(tree_margins):
+            return tree_margins.sum(axis=1)
+
+        f = shard_map(body, mesh=m, in_specs=(), out_specs=())
+        """, select={"VCT009"}) == ["VCT009"]
+
+
+def test_vct009_resolves_aliased_bodies():
+    # the production install shape (pipelines/filter_variants.py): the
+    # body binds through an intermediate name before shard_program —
+    # aliases resolve transitively, conditional rebinds scan every source
+    assert codes("""
+        import jax
+        from variantcalling_tpu.parallel import shard_score
+
+        def body(x, margins):
+            return jax.lax.psum(margins, "dp")
+
+        def build(mesh, cond):
+            if cond:
+                fn = body
+            else:
+                fn = other_body
+            fn = fn
+            return shard_score.shard_program(fn, mesh, n_data_args=1)
+        """, select={"VCT009"}) == ["VCT009"]
+    # an aliased lambda body is still a body
+    assert codes("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        fn = lambda margins: jax.lax.psum(margins, "dp")
+        prog = shard_map(fn, mesh=None, in_specs=(), out_specs=())
+        """, select={"VCT009"}) == ["VCT009"]
+    # aliasing alone doesn't widen the net: a never-installed function
+    # stays unscanned even when an unrelated alias of it exists
+    assert codes("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return x
+
+        def loose(margins):
+            return jax.lax.psum(margins, "dp")
+
+        other = loose
+        prog = shard_map(body, mesh=None, in_specs=(), out_specs=())
+        """, select={"VCT009"}) == []
+
+
+def test_vct009_sanctioned_and_unrelated_sums_pass():
+    # margins merged through the sanctioned site, psum over non-margin
+    # data (the SEC cohort counts), and sums OUTSIDE shard_map bodies
+    # are all fine (VCT003 owns the outside-world rule)
+    assert codes("""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+
+        def body(x, counts):
+            m = sequential_tree_sum(x)
+            return m + jax.lax.psum(counts, "dp")
+
+        prog = shard_map(body, mesh=None, in_specs=(), out_specs=())
+
+        def not_a_body(weights):
+            return jnp.sum(weights)
+        """, select={"VCT009"}) == []
+    # a lambda body is still a body
+    assert codes("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        prog = shard_map(lambda margins: jax.lax.psum(margins, "dp"),
+                         mesh=None, in_specs=(), out_specs=())
+        """, select={"VCT009"}) == ["VCT009"]
+
+
+def test_vct009_suppressible():
+    assert codes("""
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(margins):
+            return jax.lax.psum(margins, "dp")  # vctpu-lint: disable=VCT009 — test fixture
+
+        prog = shard_map(body, mesh=None, in_specs=(), out_specs=())
+        """) == []
+
+
 def test_cli_list_checkers(capsys):
     assert lint_main(["--list-checkers"]) == 0
     out = capsys.readouterr().out
     for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005", "VCT006",
-                 "VCT007", "VCT008"):
+                 "VCT007", "VCT008", "VCT009"):
         assert code in out
 
 

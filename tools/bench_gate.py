@@ -10,8 +10,8 @@ human diff would catch it. This tool is the gate:
   its direction and its noise band) and **exits 1 on any regression
   beyond the band**, 0 when clean, 2 on usage/IO errors.
 - ``python -m tools.bench_gate --run`` runs a fresh reduced bench
-  (``VCTPU_BENCH_PHASES=hot_small,hot,io,e2e,obs`` — the phases the gate
-  reads) and compares it against the newest committed ``BENCH_r*.json``
+  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs`` — the phases the
+  gate reads) and compares it against the newest committed ``BENCH_r*.json``
   (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
   opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
 
@@ -71,6 +71,15 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("io.parse_mb_s.t2", "higher", 0.10),
     ("io.compress_mb_s.t1", "higher", 0.10),
     ("io.compress_mb_s.t2", "higher", 0.10),
+    # -- mesh device-scaling (mesh-sharded scoring PR): the d1 leg pins
+    #    VCTPU_MESH_DEVICES=1 on the same forced 2-device backend (the
+    #    honest baseline), so a shard_map dispatch regression or a
+    #    collapsed d2 speedup gates here independently of e2e noise.
+    #    The ratio's band is wide: on a 2-core shared container d2
+    #    measures partition overhead against ~zero spare cores. --------
+    ("mesh.vps.d1", "higher", 0.15),
+    ("mesh.vps.d2", "higher", 0.15),
+    ("mesh.scaling_d2_over_d1", "higher", 0.25),
     # -- limiting-stage attribution (the `vctpu obs bottleneck --json`
     #    roll-up each streaming bench row embeds as `attribution`):
     #    catches "e2e unchanged but ingest quietly re-serialized". The
@@ -199,7 +208,7 @@ def run_fresh_bench(timeout_s: int = 420) -> dict | None:
     """A reduced fresh bench (the gate's phases only) on the CPU engine;
     returns its parsed JSON or None with the failure printed."""
     env = dict(os.environ)
-    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,io,e2e,obs"
+    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,io,mesh,e2e,obs"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

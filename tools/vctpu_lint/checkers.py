@@ -585,3 +585,150 @@ class UnsequencedWriteChecker(Checker):
                                       f"({_SANCTIONED_SINK_WRITER}: bounded "
                                       "retry + rewind guard, chunk order)")
         self.generic_visit(node)
+
+
+#: call names that install a function as a per-device shard_map body
+#: (VCT009): jax's shard_map itself plus the repo's own wrapper
+_SHARD_MAP_WRAPPERS = ("shard_map", "shard_program")
+
+#: identifier tokens marking an array as margin/score data (VCT009):
+#: VCT003's tree/margin vocabulary plus the score spellings the
+#: mesh-sharded scoring path moves around
+_MARGIN_TOKENS = _TREE_TOKENS | {"score", "scores"}
+
+
+@register
+class ShardMapMarginReductionChecker(Checker):
+    """VCT009 — a cross-device (or unordered) reduction over margin/score
+    data inside a ``shard_map`` body.
+
+    Incident class: the PR 2 cross-device-count parity flake — XLA
+    reassociating f32 margin sums made score bits depend on the device
+    count. The mesh-sharded scoring path (parallel/shard_score.py) is
+    safe BECAUSE its ``shard_map`` bodies are pure data-parallel maps:
+    per-tree margins reduce inside each device's program through the one
+    sanctioned ``forest.sequential_tree_sum`` and devices exchange
+    nothing. A ``jax.lax.psum`` over margins/scores inside a shard_map
+    body reintroduces exactly the incident (a cross-device sum whose
+    grouping varies with mesh shape), and a ``jnp.sum``/``.sum()`` there
+    is the VCT003 reassociation hole in its most dangerous location.
+    Bodies are found structurally: any function (or lambda) passed as
+    the first argument to ``shard_map`` / ``shard_program``, plus every
+    function nested inside it.
+    """
+
+    code = "VCT009"
+    name = "shardmap-margin-reduction"
+    description = ("psum/sum over margin/score-named arrays inside a "
+                   "shard_map body outside sequential_tree_sum")
+
+    @staticmethod
+    def _margin_named(expr: ast.expr) -> str | None:
+        for node in ast.walk(expr):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.arg):
+                name = node.arg
+            if name and _MARGIN_TOKENS & set(name.lower().split("_")):
+                return name
+        return None
+
+    def visit_Module(self, node: ast.Module) -> None:
+        # pass 1: collect shard_map body functions — first argument of
+        # every shard_map/shard_program call (Name reference or inline
+        # lambda), resolved against every FunctionDef in the module.
+        # Simple name aliases resolve transitively (``fn = body;
+        # shard_map(fn, ...)`` scans ``body`` — the exact shape of the
+        # production install site in pipelines/filter_variants.py, where
+        # the fused body binds through an intermediate before
+        # shard_program); conditional rebinds add every source, erring
+        # toward scanning too much (suppressions exist for false hits)
+        body_names: set[str] = set()
+        lambdas: list[ast.Lambda] = []
+        aliases: dict[str, set[str]] = {}
+        named_lambdas: dict[str, list[ast.Lambda]] = {}
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Name):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.setdefault(t.id, set()).add(n.value.id)
+                continue
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        named_lambdas.setdefault(t.id, []).append(n.value)
+                continue
+            if isinstance(n, ast.AnnAssign) and isinstance(n.value, ast.Name) \
+                    and isinstance(n.target, ast.Name):
+                aliases.setdefault(n.target.id, set()).add(n.value.id)
+                continue
+            if not (isinstance(n, ast.Call) and n.args):
+                continue
+            f = n.func
+            fname = f.id if isinstance(f, ast.Name) else \
+                f.attr if isinstance(f, ast.Attribute) else ""
+            if fname not in _SHARD_MAP_WRAPPERS:
+                continue
+            first = n.args[0]
+            if isinstance(first, ast.Name):
+                body_names.add(first.id)
+            elif isinstance(first, ast.Lambda):
+                lambdas.append(first)
+        frontier = list(body_names)
+        while frontier:
+            name = frontier.pop()
+            lambdas.extend(named_lambdas.get(name, ()))
+            for src in aliases.get(name, ()):
+                if src not in body_names:
+                    body_names.add(src)
+                    frontier.append(src)
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name in body_names:
+                self._scan_body(n)
+        for lam in lambdas:
+            self._scan_body(lam)
+
+    def _scan_body(self, func: ast.AST) -> None:
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == SEQUENTIAL_TREE_SUM:
+                continue  # the sanctioned merge site: don't descend
+            stack.extend(ast.iter_child_nodes(n))
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            fname = f.id if isinstance(f, ast.Name) else \
+                f.attr if isinstance(f, ast.Attribute) else ""
+            if fname == "psum":
+                hit = self._margin_named(n.args[0]) if n.args else None
+                if hit is not None:
+                    self.report(n, f"psum over {hit!r} inside a shard_map "
+                                   "body — a cross-device margin/score sum "
+                                   "makes output bits depend on the device "
+                                   "count (the PR 2 parity incident); merge "
+                                   "per-device margins through "
+                                   "forest.sequential_tree_sum and "
+                                   "concatenate over dp instead")
+            elif fname == "sum":
+                operand = None
+                if isinstance(f, ast.Attribute):
+                    owner = f.value
+                    if isinstance(owner, ast.Name) and \
+                            owner.id in ("jnp", "np", "numpy", "jax"):
+                        operand = n.args[0] if n.args else None
+                    else:
+                        operand = owner  # method form: margins.sum(...)
+                if operand is not None:
+                    hit = self._margin_named(operand)
+                    if hit is not None:
+                        self.report(n, f"unordered sum over {hit!r} inside "
+                                       "a shard_map body — XLA reassociates "
+                                       "f32 reductions per shard shape; "
+                                       "margin/score reductions must go "
+                                       "through forest.sequential_tree_sum")

@@ -612,20 +612,30 @@ def host_featurize(
     return HostFeatures(alle=alle, windows=windows, cols=cols, names=names)
 
 
-def standard_genome_sharding():
+def standard_genome_sharding(mesh=None):
     """The ONE sharding every consumer passes to device_genome: replicated
-    over the (dp, mp) mesh on multi-device processes, None single-device.
+    over ``mesh`` when the caller resolved a run scoring mesh (the
+    filter pipeline's >1-device mesh plan), else the process-default
+    policy (replicate over the full (dp, mp) local mesh on multi-device
+    processes, None single-device). Mesh-plan callers route their
+    possibly-None mesh through here unconditionally — a single-device
+    plan falls through to the SAME default policy as every no-arg
+    consumer, so the cache key cannot split on who uploaded first.
 
     All genome-cache keys include the sharding, so consumers that chose
     shardings independently would split the cache — and the small-job
     guard (_genome_resident_worthwhile) would answer differently
     depending on which consumer ran first (round-2 VERDICT weak #6).
-    Routing through this helper makes the key identical by construction.
+    Routing through this helper makes the key identical by construction;
+    mesh-plan callers must pass the SAME resolved mesh everywhere
+    (FilterContext does).
     """
-    if len(jax.local_devices()) <= 1:
-        return None
     from variantcalling_tpu.parallel.mesh import make_mesh, replicated
 
+    if mesh is not None:
+        return replicated(mesh)
+    if len(jax.local_devices()) <= 1:
+        return None
     return replicated(make_mesh(n_model=1))
 
 

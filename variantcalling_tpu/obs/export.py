@@ -259,12 +259,15 @@ def bottleneck(events: list[dict]) -> dict:
             (float(run_end.get("dur", 0.0)) if run_end else 0.0)
         records = sum(int(e.get("records", 0)) for e in pipe_events)
         # parallel host-IO pools profile one stage PER WORKER
-        # (parse.w0, inflate.w1, ...; docs/streaming_executor.md): merge
-        # each family into one row and remember its worker count — the
-        # percentage denominator becomes workers × wall, so a stage's
-        # work/wait/other fractions still sum to ~100% of ITS capacity
-        # and the table keeps reading as fractions of wall-clock
-        worker_re = re.compile(r"^(.+)\.w(\d+)$")
+        # (parse.w0, inflate.w1, ...) and the mesh-sharded scoring path
+        # one PER DEVICE (score.d0, score.d1, ...;
+        # docs/streaming_executor.md): merge each family into one row
+        # and remember its lane count — the percentage denominator
+        # becomes lanes × wall, so a stage's work/wait/other fractions
+        # still sum to ~100% of ITS capacity and the table keeps reading
+        # as fractions of wall-clock. Device families additionally carry
+        # ``devices`` (a device lane is hardware, not a host thread).
+        worker_re = re.compile(r"^(.+)\.([wd])(\d+)$")
         for e in stage_events:  # several pipelines in one stream: sum
             name = e.get("stage", "?")
             m = worker_re.match(name)
@@ -274,7 +277,9 @@ def bottleneck(events: list[dict]) -> dict:
                 "items": 0, "bytes_in": 0, "bytes_out": 0,
                 "stage_records": 0, "_workers": set()})
             if m:
-                s["_workers"].add(m.group(2))
+                s["_workers"].add(m.group(2) + m.group(3))
+                if m.group(2) == "d":
+                    s["_device_family"] = True
             s["work_s"] += float(e.get("work_s", 0.0))
             s["wait_in_s"] += float(e.get("wait_in_s", 0.0))
             s["wait_out_s"] += float(e.get("wait_out_s", 0.0))
@@ -284,6 +289,8 @@ def bottleneck(events: list[dict]) -> dict:
             s["stage_records"] += int(e.get("records", 0)) if m else 0
         for s in stages.values():
             s["workers"] = max(1, len(s.pop("_workers")))
+            if s.pop("_device_family", False):
+                s["devices"] = s["workers"]  # device lanes, not host threads
     else:
         # fallback: depth-0 spans (serial runs, profiling off) — honest
         # about what it is: work only, waits unattributable

@@ -49,8 +49,10 @@ PROFILE_ENV = "VCTPU_OBS_PROFILE"
 SAMPLE_ENV = "VCTPU_OBS_SAMPLE_S"
 
 #: per-worker stage rows of the parallel host-IO pools (``<name>.w<idx>``)
-#: — the same family spelling obs/export.py's bottleneck merge matches
-_WORKER_STAGE_RE = re.compile(r"\.w\d+$")
+#: and per-device rows of the mesh-sharded scoring path
+#: (``<name>.d<idx>``) — the same family spellings obs/export.py's
+#: bottleneck merge matches
+_WORKER_STAGE_RE = re.compile(r"\.[wd]\d+$")
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 

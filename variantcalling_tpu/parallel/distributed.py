@@ -26,8 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from variantcalling_tpu import knobs
 from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -151,7 +150,7 @@ def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = 
     device holds the same-shape block and zeros are invisible to the
     sum); each host returns the full cohort tensor.
     """
-    from variantcalling_tpu.utils.trace import stage
+    from variantcalling_tpu.parallel.mesh import mesh_sum_leading
 
     mesh = mesh or global_mesh(n_model=1)
     local_counts = np.asarray(local_counts)
@@ -168,13 +167,7 @@ def aggregate_counts_across_hosts(local_counts: np.ndarray, mesh: Mesh | None = 
         local_counts = np.concatenate(
             [local_counts, np.zeros((pad, *local_counts.shape[1:]), local_counts.dtype)])
     arr = host_local_to_global(local_counts, mesh, P(DATA_AXIS, None, None))
-
-    @jax.jit
-    def reduce(x):
-        return jax.lax.with_sharding_constraint(
-            x.sum(axis=0, dtype=jnp.float32), NamedSharding(mesh, P(None, None)))
-
-    with stage("dist.aggregate_counts_psum"):
-        with mesh:
-            out = reduce(arr)
-        return replicated_to_host(out)
+    # the reduction itself is the ONE shared device-put + replicated mesh
+    # sum (parallel/mesh.mesh_sum_leading) — identical program to the
+    # single-host SEC aggregation, here over a global multi-host mesh
+    return mesh_sum_leading(mesh, arr, "dist.aggregate_counts_psum")

@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "dp"
@@ -66,6 +67,44 @@ def pad_to_multiple(x: np.ndarray, multiple: int, axis: int = 0, fill=0) -> tupl
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, target - n)
     return np.pad(x, widths, constant_values=fill), n
+
+
+def mesh_sum_leading(mesh: Mesh, arr, stage_name: str) -> np.ndarray:
+    """Sum a dp-sharded tensor over its LEADING axis into a replicated
+    result — THE one device-put + mesh-sum reduction both cohort
+    aggregations share (``sec.aggregate.aggregate_on_mesh`` for
+    single-host sample shards, ``distributed.aggregate_counts_across_hosts``
+    for a global mesh spanning every host's devices).
+
+    ``arr`` is either a HOST array (device_put here with the dp-leading
+    sharding — its leading axis must already divide the mesh dp size;
+    callers own their padding rule) or an already-global ``jax.Array``
+    (the multi-host path built via host_local_to_global). The reduction
+    is one jitted ``sum(axis=0)`` constrained to a replicated output —
+    psum over ICI/DCN on real meshes — accumulated in f32; the wall time
+    lands in the obs stream under ``stage_name``.
+    """
+    from variantcalling_tpu.utils.trace import stage
+
+    if not isinstance(arr, jax.Array):
+        arr = jax.device_put(
+            np.asarray(arr), data_sharding(mesh, np.asarray(arr).ndim))
+    rep = NamedSharding(mesh, P(*([None] * (arr.ndim - 1))))
+
+    @jax.jit
+    def reduce(x):
+        return jax.lax.with_sharding_constraint(
+            jnp.sum(x, axis=0, dtype=jnp.float32), rep)
+
+    # collective timing flows into the obs stream (docs/observability.md)
+    with stage(stage_name):
+        with mesh:
+            out = reduce(arr)
+        # replicated fetch works for local meshes and global multi-host
+        # ones (in-function import: distributed top-imports this module)
+        from variantcalling_tpu.parallel.distributed import replicated_to_host
+
+        return replicated_to_host(out)
 
 
 def shard_batch(mesh: Mesh, arrays: dict[str, np.ndarray]) -> tuple[dict[str, jax.Array], int]:

@@ -1,0 +1,247 @@
+"""Device-data-parallel scoring dispatch — the filter hot path on a mesh.
+
+After the parallel host-IO work (docs/streaming_executor.md "Parallel
+host IO") the streaming filter executor is compute-bound on SCORING; this
+module is ROADMAP item 2's answer: score on more than one device. The
+run resolves ONE data-parallel mesh plan (``VCTPU_MESH_DEVICES``, next to
+the engine and forest-strategy decisions), the fused featurize+score
+program runs inside a ``shard_map`` over the mesh ``dp`` axis — each
+device scores its shard of a device-count-multiple megabatch with the
+run's pinned strategy — and per-chunk scores unpack back into canonical
+chunk order before render/writeback.
+
+Byte parity is the hard invariant (the PR 2 contract, extended to the
+mesh layout): ``shard_map`` over the data axis is a pure MAP — every
+variant's per-tree margins still reduce through the ONE shared
+``forest.sequential_tree_sum`` inside its device's program and finalize
+through ``forest.finalize_margin`` on the host, and devices exchange
+NOTHING (no psum over margins — vctpu-lint VCT009 guards the merge
+site), so output bytes are identical at every device count x engine x
+strategy. The mesh layout is still recorded: ``##vctpu_mesh=dp=N``
+header provenance when N > 1, the journal resume identity pins the
+device count (a resume under a different count RESTARTS cleanly — the
+header bytes differ, so splicing is impossible by construction), and
+per-device obs attribution rides ``score.dN`` profile rows.
+
+Testable on CPU: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+provides N virtual devices (tests/conftest.py forces 8), so the parity
+matrix runs in any container; real multi-host meshes light up through
+the PR 5 collectives capability probe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+
+from variantcalling_tpu import knobs, logger, obs
+
+#: VCF header key recording the mesh layout of a >1-device run
+MESH_HEADER_KEY = "vctpu_mesh"
+
+#: default per-device megabatch rows when VCTPU_MESH_MEGABATCH_ROWS unset
+MEGABATCH_ROWS_PER_DEVICE = 1 << 14
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """The run-level scoring-mesh decision — resolved ONCE per run by
+    ``FilterContext`` next to the engine and forest strategy, then pinned
+    into every scoring dispatch, the output header and the journal
+    resume identity."""
+
+    devices: int  # resolved dp size; 1 == single-device (no mesh program)
+    requested: str  # "auto" or the explicit VCTPU_MESH_DEVICES value
+    reason: str  # human-readable resolution rationale
+
+    def header_line(self) -> str:
+        return f"##{MESH_HEADER_KEY}=dp={self.devices}"
+
+
+#: per-process mesh cache: (device count) -> Mesh. Meshes are cheap but
+#: NamedSharding/jit caches key on mesh identity — one object per size
+#: keeps every consumer (genome upload, chunk device_put, shard_map
+#: program) on literally the same mesh.
+_MESH_CACHE: dict[int, object] = {}
+
+
+def resolve_plan(engine_name: str) -> MeshPlan:
+    """Resolve the scoring-mesh plan for a run scored by ``engine_name``.
+
+    Policy (mirrors ``forest.resolve_strategy``): an EXPLICIT
+    ``VCTPU_MESH_DEVICES`` is honored or the run dies loudly
+    (EngineError, exit 2) — never silently clamped. Auto keeps one
+    device on the cpu backend (forced-host CPU meshes are a test/bench
+    construct, opted into explicitly) and takes every local device on
+    accelerators. The native C++ engine scores on the host — it has no
+    XLA program to shard, so any requested mesh resolves to 1 with the
+    reason recorded (the parity matrix still runs native legs at forced
+    device counts; they are byte-identical by construction).
+    """
+    from variantcalling_tpu.engine import EngineError
+
+    req = knobs.get_int("VCTPU_MESH_DEVICES")
+    requested = "auto" if req is None else str(req)
+    if engine_name == "native":
+        return MeshPlan(1, requested,
+                        "native engine: host C++ walk, no XLA program")
+    n_local = len(jax.local_devices())
+    if req is not None:
+        if req > n_local:
+            raise EngineError(
+                f"VCTPU_MESH_DEVICES={req} exceeds the {n_local} local "
+                "device(s) — shrink the request or force host devices "
+                "(XLA_FLAGS=--xla_force_host_platform_device_count=N). "
+                "See docs/streaming_executor.md 'Mesh-sharded scoring'.")
+        return MeshPlan(req, requested, "explicitly requested")
+    try:
+        backend = jax.default_backend()
+    except Exception as e:  # backend init failure: single device, recorded
+        from variantcalling_tpu.utils import degrade
+
+        degrade.record("shard_score.backend_probe", e, fallback="devices=1")
+        return MeshPlan(1, requested, "auto: backend probe failed")
+    if backend == "cpu":
+        return MeshPlan(1, requested,
+                        "auto: cpu backend scores single-device "
+                        "(set VCTPU_MESH_DEVICES to force a host mesh)")
+    return MeshPlan(n_local, requested,
+                    f"auto: {backend} backend, all {n_local} local devices")
+
+
+def mesh_for(plan: MeshPlan):
+    """The (dp, mp=1) Mesh of a >1-device plan (None for devices == 1).
+
+    One Mesh object per device count per process — jit/NamedSharding
+    caches key on mesh identity, so every consumer must share it."""
+    if plan.devices <= 1:
+        return None
+    mesh = _MESH_CACHE.get(plan.devices)
+    if mesh is None:
+        from variantcalling_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=plan.devices, n_model=1,
+                         devices=jax.local_devices()[: plan.devices])
+        _MESH_CACHE[plan.devices] = mesh
+    return mesh
+
+
+def shard_program(fn, mesh, n_data_args: int, replicated_leading: int = 0):
+    """Wrap an UNJITTED scoring program body in a ``shard_map`` over the
+    mesh data axis: the first ``replicated_leading`` arguments are
+    replicated (the HBM-resident genome), the next ``n_data_args``
+    shard their leading axis over ``dp`` (pytree-prefix specs, so a
+    tuple-of-columns argument shards every leaf). The output is the
+    per-variant margin/score vector, concatenated over ``dp``.
+
+    This is a pure data-parallel MAP — the body contains no collectives;
+    per-tree margins reduce inside each device's program through the one
+    sanctioned ``forest.sequential_tree_sum`` (vctpu-lint VCT009 flags
+    any cross-device margin reduction introduced here later)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from variantcalling_tpu.parallel.mesh import DATA_AXIS
+
+    dp = P(DATA_AXIS)
+    in_specs = tuple([P()] * replicated_leading + [dp] * n_data_args)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=dp)
+
+
+def resolve_megabatch_rows(devices: int) -> int:
+    """Target rows per streaming megabatch: enough to fill every device's
+    shard (``MEGABATCH_ROWS_PER_DEVICE`` each) unless overridden."""
+    rows = knobs.get_int("VCTPU_MESH_MEGABATCH_ROWS")
+    if rows is not None:
+        return rows
+    return max(1, devices) * MEGABATCH_ROWS_PER_DEVICE
+
+
+def pack_lengths(lengths: list[int]) -> list[tuple[int, int]]:
+    """(start, stop) slices of each chunk inside the packed megabatch —
+    canonical chunk order is the packing order, so unpacking is pure
+    slicing (no reorder)."""
+    spans = []
+    lo = 0
+    for n in lengths:
+        spans.append((lo, lo + n))
+        lo += n
+    return spans
+
+
+def unpack_scores(scores: np.ndarray, lengths: list[int]) -> list[np.ndarray]:
+    """Split one packed megabatch score vector back into per-chunk score
+    arrays, in canonical chunk order."""
+    total = sum(lengths)
+    if len(scores) != total:
+        raise ValueError(
+            f"packed scores have {len(scores)} rows, chunks sum to {total}")
+    return [scores[lo:hi] for lo, hi in pack_lengths(lengths)]
+
+
+def megabatch_stream(prepped, ctx, profiler=None):
+    """Pack the streaming executor's chunk stream into device-count-sized
+    megabatches, score each with ONE mesh dispatch, and yield per-chunk
+    ``(table, score, filters)`` items in canonical chunk order.
+
+    ``prepped`` yields ``(table, host_features)`` pairs in chunk order
+    (host featurization fans out on the IO pool upstream). Consecutive
+    chunks accumulate until the megabatch target
+    (:func:`resolve_megabatch_rows`); the group scores through
+    ``FilterContext.score_packed`` — one padded, dp-sharded device
+    dispatch — and scores unpack back per chunk by slicing, so the
+    stage downstream (render/writeback) sees exactly the serial chunk
+    sequence. Per-device obs attribution: every dispatch adds a
+    ``score.dN`` profile row per device (the devices run the same-shape
+    shards in lockstep, so each device row carries the dispatch wall and
+    its share of the records; ``vctpu obs bottleneck`` merges the family
+    like the ``.wN`` worker families).
+    """
+    import threading
+    import time as _time
+
+    devices = ctx.mesh_plan.devices
+    target = resolve_megabatch_rows(devices)
+
+    def flush(group):
+        rows = sum(len(t) for t, _ in group)
+        t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs score-dispatch attribution
+        scored = ctx.score_packed(group)
+        dt = _time.perf_counter() - t0  # vctpu-lint: disable=VCT006 — obs score-dispatch attribution
+        if obs.active():
+            obs.span("score_stage", dt, threading.current_thread().name)
+            obs.histogram("stage.score_stage.s").observe(dt)
+        if profiler is not None:
+            share = rows // devices
+            for d in range(devices):
+                # lockstep data-parallel shards: each device works the
+                # dispatch wall on its share of the rows; the family
+                # merges to one `score xN` row at N-device capacity
+                profiler.stage(f"score.d{d}").add_work(
+                    dt, records=share + (rows - share * devices
+                                         if d == devices - 1 else 0))
+        yield from scored
+
+    group: list = []
+    rows = 0
+    for table, hf in prepped:
+        group.append((table, hf))
+        rows += len(table)
+        if rows >= target:
+            yield from flush(group)
+            group, rows = [], 0
+    if group:
+        yield from flush(group)
+
+
+def log_plan(plan: MeshPlan) -> None:
+    """Record the per-run mesh resolution (obs resolve event + log line),
+    the same shape the engine/strategy decisions emit."""
+    if obs.active():
+        obs.event("resolve", "mesh", value=str(plan.devices),
+                  requested=plan.requested, reason=plan.reason)
+    if plan.devices > 1:
+        logger.info("scoring mesh: dp=%d (%s)", plan.devices, plan.reason)

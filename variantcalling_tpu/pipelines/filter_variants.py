@@ -188,19 +188,29 @@ def _raw_predictor(model, feature_names: list[str], strategy: str | None = None)
     return (lambda xx: threshold_mod.predict_score(model, xx, feature_names)), None
 
 
-def _predictor_for(model, feature_names: list[str], strategy: str | None = None):
-    key = ("x", id(model), tuple(feature_names), _strategy_token(strategy))
+def _predictor_for(model, feature_names: list[str], strategy: str | None = None,
+                   mesh=None):
+    key = ("x", id(model), tuple(feature_names), _strategy_token(strategy), mesh)
     hit = _PREDICTOR_CACHE.get(key)
     if hit is not None and hit[0] is model:
         return hit[1]
     program, finalize = _raw_predictor(model, feature_names, strategy=strategy)
+    if mesh is not None:
+        # data-parallel mesh plan (>1 device): the SAME program body runs
+        # per device over its dp shard of the feature matrix — a pure
+        # map, margins never cross devices (docs/streaming_executor.md
+        # "Mesh-sharded scoring")
+        from variantcalling_tpu.parallel import shard_score
+
+        program = shard_score.shard_program(program, mesh, n_data_args=1)
     pair = (jax.jit(program), finalize)
     _cache_put(key, (model, pair))
     return pair
 
 
 def _fused_program(model, feature_names: list[str], flow_order: str,
-                   genome_resident: bool = False, strategy: str | None = None):
+                   genome_resident: bool = False, strategy: str | None = None,
+                   mesh=None):
     """One jitted device program: windows + host columns -> TREE_SCORE.
 
     Fuses the window featurization kernels (gc/hmer/motif/cycle-skip) with
@@ -220,7 +230,7 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
                                               device_feature_dict, windows_from_packed)
 
     key = ("fused", id(model), tuple(feature_names), flow_order,
-           genome_resident, _strategy_token(strategy))
+           genome_resident, _strategy_token(strategy), mesh)
     hit = _PREDICTOR_CACHE.get(key)
     if hit is not None and hit[0] is model:
         return hit[1]
@@ -256,6 +266,17 @@ def _fused_program(model, feature_names: list[str], flow_order: str,
                         is_indel, indel_nuc, ref_code, alt_code, is_snp)
     else:
         fn = body
+
+    if mesh is not None:
+        # the mesh-sharded layout: the SAME fused body runs per device
+        # over its dp shard (genome replicated, every data argument's
+        # leading axis sharded) — a pure map with no collectives, so
+        # per-row score bits cannot depend on the device count
+        from variantcalling_tpu.parallel import shard_score
+
+        fn = shard_score.shard_program(
+            fn, mesh, n_data_args=7,
+            replicated_leading=1 if genome_resident else 0)
 
     jitted = (jax.jit(fn), host_names, finalize)
     _cache_put(key, (model, jitted))
@@ -338,50 +359,50 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
     return score
 
 
-def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
+class _FusedInputs:
+    """One chunk's prepared inputs for the fused featurize+score program —
+    the unit :func:`_dispatch_fused` packs into device megabatches
+    (parallel/shard_score.py). ``program`` is the cached
+    ``(_fused_program)`` triple; chunks sharing it concatenate into one
+    megabatch, chunks that resolved a different layout dispatch alone."""
+
+    __slots__ = ("n", "program", "genome", "gpos", "gpos_fill", "windows",
+                 "host_cols", "alle", "model")
+
+    def __init__(self, n, program, genome, gpos, gpos_fill, windows,
+                 host_cols, alle, model):
+        self.n = n
+        self.program = program
+        self.genome = genome
+        self.gpos = gpos
+        self.gpos_fill = gpos_fill
+        self.windows = windows
+        self.host_cols = host_cols
+        self.alle = alle
+        self.model = model
+
+
+def _prepare_fused_inputs(model, hf, flow_order: str,
+                          table: VariantTable | None = None,
                           fasta: FastaReader | None = None,
-                          engine: engine_mod.EngineDecision | None = None,
-                          strategy: str | None = None) -> np.ndarray:
-    """Chunked fused featurize+score over a HostFeatures batch; returns scores.
+                          strategy: str | None = None,
+                          plan=None) -> _FusedInputs:
+    """Host half of the fused scoring path for ONE chunk: window/genome
+    layout decision, program build (strategy + mesh pinned), narrowed
+    host columns.
 
     With ``table``+``fasta`` and no precomputed host windows, the
     device-resident-genome path runs: the encoded genome lives in HBM
-    (featurize.device_genome) and windows are gathered inside the fused
-    program from 4-byte PACKED uint32 global positions. Genomes whose
-    positions cannot pack into 4 bytes (> ~4 Gbp incl. N gaps) fall back
-    to the host window gather — checked from contig lengths before any
-    encode/upload is paid.
-
-    The scoring engine is the RUN-LEVEL decision from
-    :mod:`variantcalling_tpu.engine` (``VCTPU_ENGINE``): ``native`` runs
-    the whole hot path in the C++ engine and RAISES if it cannot
-    (never a silent jit fallback — output bytes must not depend on which
-    engine happened to load); ``jit`` never touches the native scorer.
+    (featurize.device_genome, replicated over the run's scoring mesh)
+    and windows are gathered inside the fused program from 4-byte PACKED
+    uint32 global positions. Genomes whose positions cannot pack into 4
+    bytes (> ~4 Gbp incl. N gaps) fall back to the host window gather —
+    checked from contig lengths before any encode/upload is paid.
     """
-    from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+    from variantcalling_tpu.parallel import shard_score
 
-    eng = engine or engine_mod.resolve()
-    # native engine: window gather -> featurize -> forest walk in C++ —
-    # one pass per 41-byte window row, ~10x XLA:CPU's multi-kernel
-    # lowering, byte-parity with the jit engine locked by
-    # tests/unit/test_engine_contract.py. Meshes and accelerators resolve
-    # to jit and keep the fused on-device program below.
-    if isinstance(model, FlatForest) and eng.name == "native":
-        score = _native_cpu_featurize_score(model, hf, flow_order, table, fasta)
-        if score is None:
-            raise EngineError(
-                "the resolved scoring engine 'native' could not serve this "
-                "batch (native library unloadable mid-run, unsupported "
-                "aggregation, or windows unavailable). Refusing to silently "
-                "fall back to the jit engine — rerun with VCTPU_ENGINE=jit "
-                "to opt into the jitted scorer. See docs/robustness.md.")
-        return score
-
-    n_dev = len(jax.local_devices())
-    mesh = make_mesh(n_model=1) if n_dev > 1 else None
-    shard2 = data_sharding(mesh, 2) if mesh is not None else None
-    chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
-
+    plan = plan or shard_score.resolve_plan("jit")
+    mesh = shard_score.mesh_for(plan)
     windows = hf.windows
     genome = gpos_all = None
     gpos_fill = 0
@@ -399,12 +420,14 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
             genome_resident = False
             windows = gather_windows(table, fasta)
         else:
-            # replicate the genome across the mesh so chunk dispatches never
-            # reshard the multi-GB array; the helper keeps the cache key
-            # identical across every consumer
+            # replicate the genome across the run mesh so chunk dispatches
+            # never reshard the multi-GB array (a 1-device plan falls
+            # through to the process-default policy); the helper keeps
+            # the cache key identical across every consumer
             from variantcalling_tpu.featurize import standard_genome_sharding
 
-            genome = device_genome(fasta, sharding=standard_genome_sharding())
+            genome = device_genome(
+                fasta, sharding=standard_genome_sharding(mesh))
             blk_all, off_all = globalize_positions(table, genome)
             gpos_all = pack_global_positions(blk_all, off_all, genome)
             if gpos_all is None:  # safety net: packable() and the packer disagree
@@ -413,15 +436,60 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
             else:
                 gpos_fill = packed_position_fill(genome)
 
-    fn, host_names, finalize = _fused_program(model, hf.names, flow_order,
-                                              genome_resident=genome_resident,
-                                              strategy=strategy)
-    host_cols = tuple(_narrow_column(hf.cols[f]) for f in host_names)
-
-    from variantcalling_tpu.featurize import _bucket
-
-    alle = hf.alle
+    program = _fused_program(model, hf.names, flow_order,
+                             genome_resident=genome_resident,
+                             strategy=strategy, mesh=mesh)
+    host_cols = tuple(_narrow_column(hf.cols[f]) for f in program[1])
     n = len(table) if table is not None else len(windows)
+    return _FusedInputs(n, program, genome, gpos_all, gpos_fill, windows,
+                        host_cols, hf.alle, model)
+
+
+def _dispatch_fused(inputs: list[_FusedInputs], plan) -> np.ndarray:
+    """Score one or more prepared chunks as padded device megabatches;
+    returns the PACKED ``(sum(n),)`` score vector in chunk order (callers
+    split per chunk with ``shard_score.unpack_scores``).
+
+    Every input must share the same compiled program (the caller groups
+    by ``program`` identity). The megabatch is cut into power-of-two
+    buckets rounded up to a dp multiple — ``shard_map`` requires
+    dp-divisible shapes and distinct batch sizes must reuse compiled
+    programs instead of retracing — and padding rows are dropped on
+    unpack. Scoring is row-local, so the packed scores are bit-identical
+    to per-chunk dispatch at any device count (the mesh parity matrix in
+    tests/unit/test_shard_score.py locks this).
+    """
+    from variantcalling_tpu.featurize import _bucket
+    from variantcalling_tpu.parallel import shard_score
+    from variantcalling_tpu.parallel.mesh import data_sharding
+
+    first = inputs[0]
+    fn, _host_names, finalize = first.program
+    mesh = shard_score.mesh_for(plan)
+    n_dev = plan.devices
+    shard2 = data_sharding(mesh, 2) if mesh is not None else None
+    chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
+
+    def cat(arrs):
+        return np.asarray(arrs[0]) if len(arrs) == 1 else \
+            np.concatenate([np.asarray(a) for a in arrs])
+
+    genome_resident = first.gpos is not None
+    genome = first.genome
+    gpos_fill = first.gpos_fill
+    if genome_resident:
+        gpos_all, windows = cat([i.gpos for i in inputs]), None
+    else:
+        gpos_all, windows = None, cat([i.windows for i in inputs])
+    host_cols = tuple(cat([i.host_cols[k] for i in inputs])
+                      for k in range(len(first.host_cols)))
+    is_indel = cat([i.alle.is_indel for i in inputs])
+    indel_nuc = cat([i.alle.indel_nuc for i in inputs])
+    ref_code = cat([i.alle.ref_code for i in inputs])
+    alt_code = cat([i.alle.alt_code for i in inputs])
+    is_snp = cat([i.alle.is_snp for i in inputs])
+
+    n = sum(i.n for i in inputs)
     out = np.empty(n, dtype=np.float32)
     pending: list[tuple[int, int, object]] = []
 
@@ -452,11 +520,11 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
         # (plus the resident genome) instead of the whole dataset
         common = (
             tuple(prep(c) for c in host_cols),
-            prep(alle.is_indel),
-            prep(alle.indel_nuc, fill=4),
-            prep(alle.ref_code, fill=4),
-            prep(alle.alt_code, fill=4),
-            prep(alle.is_snp),
+            prep(is_indel),
+            prep(indel_nuc, fill=4),
+            prep(ref_code, fill=4),
+            prep(alt_code, fill=4),
+            prep(is_snp),
         )
         if genome_resident:
             # padding positions sit past the genome end -> all-N windows
@@ -470,7 +538,7 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
             out[plo:phi] = finish(res, phi - plo)
     for lo, hi, res in pending:
         out[lo:hi] = finish(res, hi - lo)
-    if n and obs.active() and isinstance(model, FlatForest):
+    if n and obs.active() and isinstance(first.model, FlatForest):
         # runtime MFU/roofline attribution (obs v2): the XLA compiler's
         # own FLOP count for the compiled fused program that scored this
         # run, per resolved strategy — replaces bench.py's analytic
@@ -483,16 +551,59 @@ def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None
     return out
 
 
+def fused_featurize_score(model, hf, flow_order: str, table: VariantTable | None = None,
+                          fasta: FastaReader | None = None,
+                          engine: engine_mod.EngineDecision | None = None,
+                          strategy: str | None = None,
+                          plan=None) -> np.ndarray:
+    """Chunked fused featurize+score over a HostFeatures batch; returns scores.
+
+    The scoring engine is the RUN-LEVEL decision from
+    :mod:`variantcalling_tpu.engine` (``VCTPU_ENGINE``): ``native`` runs
+    the whole hot path in the C++ engine and RAISES if it cannot
+    (never a silent jit fallback — output bytes must not depend on which
+    engine happened to load); ``jit`` never touches the native scorer.
+    ``plan`` pins the run-level scoring-mesh decision
+    (``FilterContext.mesh_plan``); None resolves per call — with >1
+    devices the fused program runs inside a ``shard_map`` over the mesh
+    dp axis (parallel/shard_score.py), byte-identical to single-device.
+    """
+    eng = engine or engine_mod.resolve()
+    # native engine: window gather -> featurize -> forest walk in C++ —
+    # one pass per 41-byte window row, ~10x XLA:CPU's multi-kernel
+    # lowering, byte-parity with the jit engine locked by
+    # tests/unit/test_engine_contract.py. Meshes and accelerators resolve
+    # to jit and keep the fused on-device program below.
+    if isinstance(model, FlatForest) and eng.name == "native":
+        score = _native_cpu_featurize_score(model, hf, flow_order, table, fasta)
+        if score is None:
+            raise EngineError(
+                "the resolved scoring engine 'native' could not serve this "
+                "batch (native library unloadable mid-run, unsupported "
+                "aggregation, or windows unavailable). Refusing to silently "
+                "fall back to the jit engine — rerun with VCTPU_ENGINE=jit "
+                "to opt into the jitted scorer. See docs/robustness.md.")
+        return score
+
+    from variantcalling_tpu.parallel import shard_score
+
+    plan = plan or shard_score.resolve_plan(eng.name)
+    fi = _prepare_fused_inputs(model, hf, flow_order, table=table, fasta=fasta,
+                               strategy=strategy, plan=plan)
+    return _dispatch_fused([fi], plan)
+
+
 def score_variants(model, x: np.ndarray, feature_names: list[str],
                    engine: engine_mod.EngineDecision | None = None,
-                   strategy: str | None = None) -> np.ndarray:
+                   strategy: str | None = None, plan=None) -> np.ndarray:
     """Jitted chunked scoring, sharded over the mesh dp axis; returns TREE_SCORE per row.
 
-    Multi-device: the feature chunk is device_put with a dp sharding and the
-    scoring program partitions over the variants axis (model arrays are
-    replicated); single device degrades to plain jit. The scoring engine
-    follows the run-level contract (``VCTPU_ENGINE``): ``native`` runs the
-    C++ walk or raises — never a silent jit fallback.
+    Multi-device (a >1-device mesh plan): the feature chunk is device_put
+    with a dp sharding and the scoring program runs in a ``shard_map``
+    over the variants axis (model arrays replicated); a single-device
+    plan degrades to plain jit. The scoring engine follows the run-level
+    contract (``VCTPU_ENGINE``): ``native`` runs the C++ walk or raises —
+    never a silent jit fallback.
     """
     if not isinstance(model, (FlatForest, ThresholdModel)):
         # raw sklearn estimator that escaped conversion
@@ -508,12 +619,15 @@ def score_variants(model, x: np.ndarray, feature_names: list[str],
                 "aggregation). Refusing to silently fall back to the jit "
                 "engine; rerun with VCTPU_ENGINE=jit. See docs/robustness.md.")
         return nf(np.ascontiguousarray(x, dtype=np.float32))  # C++ walk
-    fn, finalize = _predictor_for(model, feature_names, strategy=strategy)
 
-    from variantcalling_tpu.parallel.mesh import data_sharding, make_mesh
+    from variantcalling_tpu.parallel import shard_score
+    from variantcalling_tpu.parallel.mesh import data_sharding
 
-    n_dev = len(jax.local_devices())
-    mesh = make_mesh(n_model=1) if n_dev > 1 else None
+    plan = plan or shard_score.resolve_plan(eng.name)
+    mesh = shard_score.mesh_for(plan)
+    fn, finalize = _predictor_for(model, feature_names, strategy=strategy,
+                                  mesh=mesh)
+    n_dev = plan.devices
     sharding = data_sharding(mesh, 2) if mesh is not None else None
     chunk_size = max(CHUNK, n_dev) - (CHUNK % n_dev if n_dev > 1 else 0)
 
@@ -603,6 +717,19 @@ class FilterContext:
             self.forest_strategy = forest_mod.resolve_strategy(model)
         else:
             self.forest_strategy = "jit"  # threshold/sklearn program
+        # the run-level SCORING MESH (VCTPU_MESH_DEVICES): resolved once
+        # here next to the engine and strategy, recorded as
+        # ##vctpu_mesh= in the output header when >1 device and pinned
+        # into the chunk-journal resume identity — then every scoring
+        # dispatch of the run shards over exactly this mesh
+        # (parallel/shard_score.py). Output bytes are identical at every
+        # device count by construction (pure data-parallel map; parity
+        # matrix in tests/unit/test_shard_score.py), so the header line
+        # is the only byte that names the layout.
+        from variantcalling_tpu.parallel import shard_score
+
+        self.mesh_plan = shard_score.resolve_plan(eng.name)
+        shard_score.log_plan(self.mesh_plan)
         self.model = model
         self.fasta = fasta
         self.hpol_length = hpol_length
@@ -639,9 +766,23 @@ class FilterContext:
         gpos = coords.globalize(np.asarray(table.chrom), table.pos - 1)
         return iops.distance_to_nearest(gpos, gs, ge) <= self.hpol_dist
 
-    def score_table(self, table: VariantTable) -> tuple[np.ndarray, np.ndarray]:
-        """Score one table (whole callset or one streamed chunk); returns
-        (tree_score float array, FILTER FactorizedColumn)."""
+    @property
+    def mesh(self):
+        """The run's scoring Mesh (None for a single-device plan)."""
+        from variantcalling_tpu.parallel import shard_score
+
+        return shard_score.mesh_for(self.mesh_plan)
+
+    def _pinned_strategy(self) -> str | None:
+        # pin the run-level strategy into the predictor build (registry
+        # names only — "native-cpp"/"jit" rides the engine decision)
+        return self.forest_strategy \
+            if self.forest_strategy in forest_mod.FOREST_STRATEGIES else None
+
+    def host_features(self, table: VariantTable):
+        """Host featurization for one table/chunk — the CPU half of
+        scoring, shared by :meth:`score_table` and the mesh megabatch
+        path (it fans out on the IO pool in the streaming executor)."""
         model, fasta = self.model, self.fasta
         # host windows are needed only by the cg-insertion check and the
         # raw-sklearn fallback; the fused path gathers windows from the
@@ -650,7 +791,8 @@ class FilterContext:
         from variantcalling_tpu.featurize import (_genome_resident_worthwhile,
                                                   standard_genome_sharding)
 
-        genome_sharding = standard_genome_sharding()
+        mesh = self.mesh
+        genome_sharding = standard_genome_sharding(mesh)
         needs_host_windows = (
             self.blacklist_cg_insertions
             or not isinstance(model, (FlatForest, ThresholdModel))
@@ -662,23 +804,89 @@ class FilterContext:
         if self.is_mutect and "TLOD" in hf.cols:
             hf.cols["tlod"] = hf.cols.pop("TLOD")
             hf.names[hf.names.index("TLOD")] = "tlod"
-        # pin the run-level strategy into the predictor build (registry
-        # names only — "native-cpp"/"jit" rides the engine decision)
-        strat = self.forest_strategy \
-            if self.forest_strategy in forest_mod.FOREST_STRATEGIES else None
+        return hf
+
+    def _score_hf(self, table: VariantTable, hf) -> np.ndarray:
+        model, fasta = self.model, self.fasta
+        strat = self._pinned_strategy()
         if isinstance(model, (FlatForest, ThresholdModel)):
             # fused featurize+score: window features and the forest walk run
             # as one device program, only TREE_SCORE returns to the host
-            score = fused_featurize_score(model, hf, self.flow_order, table=table,
-                                          fasta=fasta, engine=self.engine,
-                                          strategy=strat)
-        else:  # raw sklearn estimator: materialize the matrix from the same hf
-            from variantcalling_tpu.featurize import materialize_features
+            return fused_featurize_score(model, hf, self.flow_order, table=table,
+                                         fasta=fasta, engine=self.engine,
+                                         strategy=strat, plan=self.mesh_plan)
+        # raw sklearn estimator: materialize the matrix from the same hf
+        from variantcalling_tpu.featurize import materialize_features
 
-            fs = materialize_features(hf, flow_order=self.flow_order)
-            score = score_variants(model, fs.matrix(), fs.feature_names,
-                                   engine=self.engine, strategy=strat)
+        fs = materialize_features(hf, flow_order=self.flow_order)
+        return score_variants(model, fs.matrix(), fs.feature_names,
+                              engine=self.engine, strategy=strat,
+                              plan=self.mesh_plan)
 
+    def score_table(self, table: VariantTable) -> tuple[np.ndarray, np.ndarray]:
+        """Score one table (whole callset or one streamed chunk); returns
+        (tree_score float array, FILTER FactorizedColumn)."""
+        hf = self.host_features(table)
+        score = self._score_hf(table, hf)
+        return score, self.assemble_filters(table, score, hf)
+
+    def score_packed(self, pairs) -> list[tuple]:
+        """Score a GROUP of consecutive chunks as one packed megabatch —
+        the mesh-sharded streaming path (shard_score.megabatch_stream).
+
+        ``pairs`` is ``[(table, host_features), ...]`` in canonical chunk
+        order. Chunks whose prepared inputs share one compiled program
+        concatenate into a single padded, dp-sharded dispatch; scores
+        unpack back per chunk by slicing (scoring is row-local, so the
+        packed bits equal per-chunk dispatch bits — the streaming==serial
+        invariant, now also across packing). Chunks that resolved a
+        different program layout (e.g. a host-window tail next to
+        genome-resident neighbors) score alone, preserving order.
+        Returns ``[(table, score, filters), ...]``.
+        """
+        model = self.model
+        if self.mesh_plan.devices <= 1 or self.engine.name == "native" \
+                or not isinstance(model, (FlatForest, ThresholdModel)):
+            out = []
+            for table, hf in pairs:
+                score = self._score_hf(table, hf)
+                out.append((table, score, self.assemble_filters(table, score, hf)))
+            return out
+        from variantcalling_tpu.parallel import shard_score
+
+        strat = self._pinned_strategy()
+        prepped = [
+            (table, hf,
+             _prepare_fused_inputs(model, hf, self.flow_order, table=table,
+                                   fasta=self.fasta, strategy=strat,
+                                   plan=self.mesh_plan))
+            for table, hf in pairs]
+        out = []
+        run: list = []  # consecutive chunks sharing one compiled program
+
+        def flush_run():
+            if not run:
+                return
+            scores = _dispatch_fused([fi for _, _, fi in run], self.mesh_plan)
+            for (table, hf, fi), score in zip(
+                    run, shard_score.unpack_scores(
+                        scores, [fi.n for _, _, fi in run])):
+                out.append((table, score,
+                            self.assemble_filters(table, score, hf)))
+            run.clear()
+
+        for item in prepped:
+            if run and item[2].program is not run[-1][2].program:
+                flush_run()
+            run.append(item)
+        flush_run()
+        return out
+
+    def assemble_filters(self, table: VariantTable, score: np.ndarray,
+                         hf) -> FactorizedColumn:
+        """FILTER assembly from a table's scores — row-local, shared by
+        the per-chunk and packed-megabatch paths."""
+        model = self.model
         pass_thr = getattr(model, "pass_threshold", 0.5)
         n = len(table)
         low = score < pass_thr
@@ -708,12 +916,11 @@ class FilterContext:
         # per-record Python and no factorize on the 5M writeback path):
         # COHORT_FP beats LOW_SCORE; HPOL_RUN appends with ';'
         base_idx = np.where(cohort_fp, 1, np.where(low, 2, 0)).astype(np.int32)
-        filters = FactorizedColumn(
+        return FactorizedColumn(
             base_idx + 3 * hpol_near,
             [PASS, COHORT_FP, LOW_SCORE, HPOL_RUN,
              f"{COHORT_FP};{HPOL_RUN}", f"{LOW_SCORE};{HPOL_RUN}"],
         )
-        return score, filters
 
 
 def filter_variants(
@@ -755,13 +962,15 @@ def _replace_or_append_meta(header, prefix: str, line: str) -> None:
 
 
 def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = None,
-                          strategy: str | None = None) -> None:
+                          strategy: str | None = None,
+                          mesh_plan=None) -> None:
     """The filter pipeline's header additions — ONE place so the serial and
     streaming writers emit identical header bytes. Records the scoring
-    engine (``##vctpu_engine=...``) and, when known, the resolved forest
-    strategy (``##vctpu_forest_strategy=...``) so every output file names
-    the full scoring configuration that produced it (engine contract,
-    docs/robustness.md)."""
+    engine (``##vctpu_engine=...``), the resolved forest strategy
+    (``##vctpu_forest_strategy=...``) and — for >1-device runs — the
+    scoring-mesh layout (``##vctpu_mesh=dp=N``) so every output file
+    names the full scoring configuration that produced it (engine
+    contract, docs/robustness.md)."""
     header.ensure_filter(LOW_SCORE, "Model score below threshold")
     header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
     header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
@@ -772,6 +981,18 @@ def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = Non
     if strategy is not None:
         key = forest_mod.STRATEGY_HEADER_KEY
         _replace_or_append_meta(header, f"##{key}=", f"##{key}={strategy}")
+    # mesh provenance: >1-device runs record the dp layout; single-device
+    # runs emit NO line (and strip a stale one inherited from a
+    # re-filtered input) — record bytes are identical at every device
+    # count, so the header line is the only byte naming the layout
+    from variantcalling_tpu.parallel.shard_score import MESH_HEADER_KEY
+
+    mesh_prefix = f"##{MESH_HEADER_KEY}="
+    if mesh_plan is not None and mesh_plan.devices > 1:
+        _replace_or_append_meta(header, mesh_prefix, mesh_plan.header_line())
+    else:
+        header.lines[:] = [ln for ln in header.lines
+                           if not ln.startswith(mesh_prefix)]
     # explicitly-set scoring knobs (wide chunk/block, pallas opt-out):
     # full provenance next to the engine/strategy lines. Execution-only
     # knobs are excluded so streaming/serial/resumed runs stay
@@ -930,7 +1151,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         annotate_intervals=annotate, flow_order=args.flow_order,
         is_mutect=args.is_mutect, engine=engine,
     )
-    _ensure_output_header(header, engine=ctx.engine, strategy=ctx.forest_strategy)
+    _ensure_output_header(header, engine=ctx.engine, strategy=ctx.forest_strategy,
+                          mesh_plan=ctx.mesh_plan)
 
     # kill the warmup cliff: encode (and persist) the genome on a prefetch
     # thread; scoring's per-contig fetch_encoded waits only for the contig
@@ -1051,6 +1273,13 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 # contract) — a run resumed under a different
                 # VCTPU_FOREST_STRATEGY restarts instead of splicing
                 "forest_strategy": ctx.forest_strategy,
+                # the mesh layout is provenance (##vctpu_mesh= when >1
+                # device): record bytes are device-count-invariant, but
+                # the HEADER byte differs — a resume under a different
+                # VCTPU_MESH_DEVICES RESTARTS cleanly (the header_crc
+                # would mismatch anyway; pinning it here makes the
+                # decision explicit, tests/unit/test_streaming_faults.py)
+                "mesh_devices": ctx.mesh_plan.devices,
             },
         }
         resume = journal_mod.try_resume(out_path, meta)
@@ -1101,8 +1330,77 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # stage) and the single-writer commit. The serial-IO layout
     # (VCTPU_IO_THREADS=1) keeps the dedicated score/render stage
     # threads, as before.
+    #
+    # MESH layout (ctx.mesh_plan.devices > 1, docs/streaming_executor.md
+    # "Mesh-sharded scoring"): host featurization still fans out per
+    # chunk on the IO pool, but the DEVICE dispatch packs consecutive
+    # chunks into device-count-sized megabatches scored by ONE shard_map
+    # program over the mesh dp axis (shard_score.megabatch_stream), with
+    # per-chunk scores unpacked back into canonical chunk order before
+    # the pooled render fan-out. The chunk sequence, journal identity
+    # and output bytes are identical to the single-device layouts.
     source_pooled = reader.io_threads > 1
-    if source_pooled:
+    mesh_scoring = ctx.mesh_plan.devices > 1
+    if mesh_scoring:
+        from variantcalling_tpu.parallel import shard_score
+        from variantcalling_tpu.parallel.pipeline import imap_ordered
+
+        def prep_worker(table):
+            faults.check("pipeline.stage")
+            faults.check("pipeline.stage_hang")
+            return table, _timed_worker(ctx.host_features, "featurize_stage",
+                                        table, len(table))
+
+        def render_worker(item):
+            return _timed_worker(render_stage, "render_stage", item,
+                                 len(item[0]))
+
+        if source_pooled:
+            window = reader.io_threads + 2
+            prepped = imap_ordered(reader.shared_pool(), prep_worker,
+                                   iter(reader), window=window)
+            scored = shard_score.megabatch_stream(prepped, ctx, profiler=prof)
+            source = imap_ordered(reader.shared_pool(), render_worker,
+                                  scored, window=window)
+            stages = []
+        else:
+            def timed_tables():
+                # serial-IO mesh layout: the reader's inflate/parse work
+                # is attributed HERE, per table — the executor's feed
+                # sees the whole featurize+score megabatch wall in its
+                # next(), and that wall already belongs to the
+                # featurize_stage/score.dN rows recorded inside this
+                # source chain; booking it as ingest work again would
+                # double-count it (the pipeline books its feed-blocked
+                # time as queue-wait instead: source_pooled below)
+                it = iter(reader)
+                while True:
+                    if obs.active():
+                        t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+                        try:
+                            table = next(it)
+                        except StopIteration:
+                            return
+                        dt = _time.perf_counter() - t0  # vctpu-lint: disable=VCT006 — obs span timing
+                        obs.span("ingest", dt,
+                                 threading.current_thread().name)
+                        obs.histogram("stage.ingest.s").observe(dt)
+                        if prof is not None:
+                            # items=0: the executor feed counts the
+                            # pulled items on this row (the pooled-source
+                            # rule) — work seconds only here
+                            prof.stage("ingest").add_work(dt, items=0)
+                    else:
+                        try:
+                            table = next(it)
+                        except StopIteration:
+                            return
+                    yield table
+
+            source = shard_score.megabatch_stream(
+                map(prep_worker, timed_tables()), ctx, profiler=prof)
+            stages = [render_stage]
+    elif source_pooled:
         from variantcalling_tpu.parallel.pipeline import imap_ordered
 
         source = imap_ordered(reader.shared_pool(), chunk_worker,
@@ -1115,7 +1413,12 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         stages.append(compress_stage)
     pipe = StagePipeline(stages, queue_depth=2,
                          profiler=prof, source_name="ingest",
-                         consumer_name="writeback", source_pooled=source_pooled)
+                         # mesh serial-IO counts too: the source chain
+                         # attributes its own ingest/featurize/score work
+                         # (timed_tables + _timed_worker + score.dN), so
+                         # feed-blocked time is queue-wait, never work
+                         consumer_name="writeback",
+                         source_pooled=source_pooled or mesh_scoring)
     gen = pipe.run(source)
     ok = False
     # heartbeat bookkeeping (obs only). Progress (pct) counts ALL
@@ -1387,7 +1690,8 @@ def _run_impl(args) -> int:
             return 0
 
     _ensure_output_header(table.header, engine=ctx.engine,
-                          strategy=ctx.forest_strategy)
+                          strategy=ctx.forest_strategy,
+                          mesh_plan=ctx.mesh_plan)
     with stage("writeback"):
         # verbatim_core: this pipeline never edits CHROM..QUAL, so record
         # assembly can splice FILTER/TREE_SCORE between original byte spans

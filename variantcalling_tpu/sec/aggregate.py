@@ -14,9 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from variantcalling_tpu.parallel.mesh import DATA_AXIS
 
@@ -44,22 +42,12 @@ def aggregate_on_mesh(sample_counts: np.ndarray, mesh: Mesh) -> np.ndarray:
     """(S, L, A) per-sample count tensors -> (L, A) cohort sum via psum.
 
     Samples shard over the mesh data axis (padded to a multiple); the
-    result is replicated on every device.
+    result is replicated on every device. The device-put + replicated
+    mesh sum is the shared :func:`parallel.mesh.mesh_sum_leading` — one
+    reduction for this and the multi-host cohort aggregation.
     """
-    from variantcalling_tpu.utils.trace import stage
+    from variantcalling_tpu.parallel.mesh import mesh_sum_leading
 
     sample_counts = pad_samples_to_devices(np.asarray(sample_counts),
                                            mesh.shape[DATA_AXIS])
-    arr = jax.device_put(jnp.asarray(sample_counts), NamedSharding(mesh, P(DATA_AXIS, None, None)))
-
-    @jax.jit
-    def reduce(x):
-        return jax.lax.with_sharding_constraint(
-            jnp.sum(x, axis=0, dtype=jnp.float32), NamedSharding(mesh, P(None, None))
-        )
-
-    # collective timing flows into the obs stream (docs/observability.md)
-    with stage("sec.aggregate_on_mesh"):
-        with mesh:
-            out = reduce(arr)
-        return np.asarray(out)
+    return mesh_sum_leading(mesh, sample_counts, "sec.aggregate_on_mesh")
