@@ -17,12 +17,14 @@ cd "$(dirname "$0")"
 # -- tier-0 lint stage (docs/static_analysis.md) ---------------------------
 # vctpu-lint enforces the engine-determinism contract invariants (raw
 # VCTPU_* environ reads, silent broad-except fallbacks, unordered
-# tree-sum reductions, tracer host syncs, unbounded subprocesses); it
-# runs BEFORE pytest and new findings fail the whole run. ruff (pyflakes
-# + import order, [tool.ruff] in pyproject.toml) rides along when
-# installed — the hermetic test container does not ship it.
-echo "lint stage: python -m tools.vctpu_lint"
-env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.vctpu_lint || {
+# tree-sum reductions, tracer host syncs, unbounded subprocesses,
+# whole-program concurrency discipline); it runs BEFORE pytest and new
+# findings fail the whole run. --json renders findings + per-checker
+# wall time structured in the log. ruff (pyflakes + import order,
+# [tool.ruff] in pyproject.toml) rides along when installed — the
+# hermetic test container does not ship it.
+echo "lint stage: python -m tools.vctpu_lint --json"
+env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.vctpu_lint --json || {
   echo "vctpu-lint found new findings — failing before pytest" >&2
   exit 1
 }
@@ -32,6 +34,22 @@ if command -v ruff >/dev/null 2>&1; then
 else
   echo "lint stage: ruff not installed — skipped"
 fi
+
+# -- tier-0 jaxpr audit stage (docs/static_analysis.md) --------------------
+# Trace every registered scoring program (forest strategies x
+# shard_program at dp in {1,2} + the coverage reduce kernels) with
+# ShapeDtypeStructs on the CPU backend and walk the closed jaxprs
+# against the COMMITTED contract (tools/jaxpr_audit/contract.json): no
+# host callbacks, no collectives/tree-axis reductions outside the
+# sanctioned sequential_tree_sum loop, no f64, and the program-layout
+# census within its committed budget. Post-trace contract breaks fail
+# the run before pytest, like a lint finding (sub-30s, trace only — no
+# compile).
+echo "jaxpr audit stage: python -m tools.jaxpr_audit"
+env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.jaxpr_audit || {
+  echo "jaxpr audit found contract violations — failing before pytest" >&2
+  exit 1
+}
 
 # -- tier-0 obs schema stage (docs/observability.md) -----------------------
 # Generate a real obs run log and validate it against the COMMITTED event

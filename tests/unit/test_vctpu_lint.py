@@ -246,23 +246,17 @@ def test_vct005_popen_and_thread_rules():
             p = subprocess.Popen(["x"])
             out, err = p.communicate(timeout=30)
         ''') == []
-    # non-daemon thread in a module with no join path
-    assert codes('''
+    # the non-daemon-thread clause lives SOLELY in VCT010 rule 2 now —
+    # one defect must not yield two findings needing two suppression
+    # codes, and VCT010 is strictly stricter (a join path does not
+    # excuse a non-daemon worker outside parallel/pipeline.py)
+    src = '''
         import threading
         t = threading.Thread(target=work)
         t.start()
-        ''') == ["VCT005"]
-    assert codes('''
-        import threading
-        t = threading.Thread(target=work, daemon=True)
-        t.start()
-        ''') == []
-    assert codes('''
-        import threading
-        t = threading.Thread(target=work)
-        t.start()
-        t.join()
-        ''') == []
+        '''
+    assert codes(src, select={"VCT005"}) == []
+    assert codes(src) == ["VCT010"]
 
 
 # ---------------------------------------------------------------------------
@@ -678,7 +672,7 @@ def test_cli_list_checkers(capsys):
     assert lint_main(["--list-checkers"]) == 0
     out = capsys.readouterr().out
     for code in ("VCT001", "VCT002", "VCT003", "VCT004", "VCT005", "VCT006",
-                 "VCT007", "VCT008", "VCT009"):
+                 "VCT007", "VCT008", "VCT009", "VCT010"):
         assert code in out
 
 
@@ -694,3 +688,986 @@ def test_repo_tree_is_clean(target):
         findings, baseline_mod.load(baseline_mod.DEFAULT_BASELINE))
     assert not new, "new lint findings:\n" + "\n".join(
         f.render() for f in new)
+
+
+# ---------------------------------------------------------------------------
+# VCT010 concurrency-discipline (snippet mode: throwaway one-module index)
+# ---------------------------------------------------------------------------
+
+
+def test_vct010_unlocked_mutation_from_pool_task_flagged():
+    fs = run('''
+        _CACHE = {}
+
+        def task(x):
+            _CACHE[x] = 1
+
+        pool.submit(task, 3)
+        ''', select={"VCT010"})
+    assert [f.code for f in fs] == ["VCT010"]
+    assert "_CACHE" in fs[0].message
+    assert "submit" in fs[0].message
+
+
+def test_vct010_locked_mutation_stays_clean():
+    assert codes('''
+        import threading
+
+        _CACHE = {}
+        _LOCK = threading.Lock()
+
+        def task(x):
+            with _LOCK:
+                _CACHE[x] = 1
+
+        pool.submit(task, 3)
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_sanctioned_queue_handoff_stays_clean():
+    # handing results across threads through queue.Queue IS the
+    # sanctioned pattern — not a race
+    assert codes('''
+        import queue
+
+        _RESULTS = queue.Queue()
+
+        def task(x):
+            _RESULTS.put(x)
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_mutation_without_thread_entry_stays_clean():
+    # same mutation, never installed as a thread entry: main-thread-only
+    # code owns its module state
+    assert codes('''
+        _CACHE = {}
+
+        def warm(x):
+            _CACHE[x] = 1
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_imap_ordered_task_and_thread_target_are_entries():
+    assert codes('''
+        _SEEN = []
+
+        def parse(chunk):
+            _SEEN.append(chunk)
+            return chunk
+
+        out = imap_ordered(pool, parse, chunks)
+        ''', select={"VCT010"}) == ["VCT010"]
+    assert codes('''
+        import threading
+
+        _STATE = {}
+
+        def worker():
+            _STATE["k"] = 1
+
+        t = threading.Thread(target=worker, daemon=True)
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_stage_pipeline_stage_fn_is_an_entry():
+    assert codes('''
+        _TALLY = {}
+
+        def render_stage(item):
+            _TALLY[item] = 1
+            return item
+
+        pipe = StagePipeline([render_stage], source)
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_submitted_lambda_mutation_flagged():
+    assert codes('''
+        _EVENTS = []
+        pool.submit(lambda: _EVENTS.append(1))
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_lock_order_inversion_flagged():
+    fs = run('''
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A:
+                with B:
+                    pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+        ''', select={"VCT010"})
+    assert [f.code for f in fs] == ["VCT010"]
+    assert "lock order" in fs[0].message
+
+
+def test_vct010_consistent_lock_order_stays_clean():
+    assert codes('''
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with A:
+                with B:
+                    pass
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_lock_order_through_call_edge_flagged():
+    # one leg of the inversion acquires the inner lock in a CALLEE —
+    # only the resolved call graph sees it
+    assert codes('''
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def inner_b():
+            with B:
+                pass
+
+        def ab():
+            with A:
+                inner_b()
+
+        def ba():
+            with B:
+                with A:
+                    pass
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_multi_item_with_acquisition_order():
+    # `with A, B:` acquires left-to-right — one With statement's items
+    # are ordered exactly like nested With statements
+    assert codes('''
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A, B:
+                pass
+
+        def ba():
+            with B:
+                with A:
+                    pass
+        ''', select={"VCT010"}) == ["VCT010"]
+    assert codes('''
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def ab():
+            with A, B:
+                pass
+
+        def ba():
+            with B, A:
+                pass
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_lock_order_through_from_import_spelling():
+    # `from a import _LOCK` must unify with module a's own identity —
+    # a cross-module inversion through the from-import spelling is the
+    # same deadlock as the a._LOCK attribute spelling
+    fs = [f for f in lint.lint_sources({
+        "variantcalling_tpu/la.py": '''
+import threading
+
+_LOCK = threading.Lock()
+_OTHER_LOCK = threading.Lock()
+
+def fwd():
+    with _LOCK:
+        with _OTHER_LOCK:
+            pass
+''',
+        "variantcalling_tpu/lb.py": '''
+from variantcalling_tpu.la import _LOCK, _OTHER_LOCK
+
+def rev():
+    with _OTHER_LOCK:
+        with _LOCK:
+            pass
+''',
+    }) if f.code == "VCT010"]
+    assert len(fs) == 1 and "lock order" in fs[0].message
+
+
+def test_vct010_lock_order_through_call_cycle_flagged():
+    # the inner acquisition sits on a CALL CYCLE (cyc_g <-> cyc_h) and
+    # is first reached from a held call that enters the cycle at cyc_g;
+    # a memoized recursive walk cuts the cycle there and caches cyc_h
+    # as lock-free, hiding the A->B leg from the later caller_b held
+    # call — only a fixpoint over the call graph sees it
+    assert codes('''
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+        X = threading.Lock()
+
+        def caller_a():
+            with X:
+                cyc_g()
+
+        def caller_b():
+            with A:
+                cyc_h()
+
+        def cyc_g():
+            with B:
+                pass
+            cyc_h()
+
+        def cyc_h():
+            cyc_g()
+
+        def zz_inverse():
+            with B:
+                with A:
+                    pass
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_non_daemon_thread_outside_pipeline_flagged():
+    src = '''
+        import threading
+
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        '''
+    fs = run(src, select={"VCT010"})
+    assert [f.code for f in fs] == ["VCT010"]
+    assert "non-daemon" in fs[0].message
+    # the executor module owns the join/watchdog discipline
+    assert codes(src, path="variantcalling_tpu/parallel/pipeline.py",
+                 select={"VCT010"}) == []
+    # daemon workers are fine anywhere
+    assert codes('''
+        import threading
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_per_thread_cells_module_exempt():
+    assert codes('''
+        _CELLS = {}
+
+        def observe(v):
+            _CELLS[v] = 1
+
+        pool.submit(observe, 2)
+        ''', path="variantcalling_tpu/obs/metrics.py",
+        select={"VCT010"}) == []
+
+
+def test_vct010_suppressible():
+    assert codes('''
+        _DIAG = {}
+
+        def task(x):
+            _DIAG[x] = 1  # vctpu-lint: disable=VCT010 — GIL-atomic diagnostic, last write wins by design
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == []
+
+
+# ---------------------------------------------------------------------------
+# project model: whole-program index + cross-module resolution
+# ---------------------------------------------------------------------------
+
+
+def run_sources(sources: dict[str, str],
+                select: set[str] | None = None) -> list[lint.Finding]:
+    return lint.lint_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()}, select)
+
+
+def test_project_index_resolves_cross_module_names():
+    from tools.vctpu_lint.project import ProjectIndex
+
+    idx = ProjectIndex.build({
+        "variantcalling_tpu/a.py": textwrap.dedent('''
+            def helper():
+                pass
+            '''),
+        "variantcalling_tpu/b.py": textwrap.dedent('''
+            from variantcalling_tpu.a import helper as h
+
+            def caller():
+                h()
+            '''),
+    })
+    key = idx.resolve_name("variantcalling_tpu/b.py", "h")
+    assert key == ("variantcalling_tpu/a.py", "helper")
+    caller = idx.modules["variantcalling_tpu/b.py"].functions["caller"]
+    assert key in caller.calls
+    assert idx.reaches(("variantcalling_tpu/b.py", "caller"), key)
+
+
+def test_project_index_registers_thread_entries_and_traced_bodies():
+    from tools.vctpu_lint.project import ProjectIndex
+
+    idx = ProjectIndex.build({
+        "variantcalling_tpu/work.py": textwrap.dedent('''
+            def task(x):
+                return x
+
+            def body(x):
+                return x
+            '''),
+        "variantcalling_tpu/pipelines/drive.py": textwrap.dedent('''
+            from variantcalling_tpu.work import task, body
+            from variantcalling_tpu.parallel import shard_score
+
+            def go(pool, mesh):
+                pool.submit(task, 1)
+                return shard_score.shard_program(body, mesh, n_data_args=1)
+            '''),
+    })
+    assert ("variantcalling_tpu/work.py", "task") in idx.thread_entries
+    assert ("variantcalling_tpu/work.py", "body") in idx.traced_bodies
+    assert idx.traced_bodies_in("variantcalling_tpu/work.py") == {"body"}
+    assert idx.pipeline_submitted_tasks("variantcalling_tpu/work.py") \
+        == {"task"}
+
+
+def test_vct009_cross_module_alias_body_flagged():
+    # the PR-8 incident shape generalized: the shard_map body lives in
+    # ONE module, the install site (through a from-import) in ANOTHER —
+    # invisible to any per-file view
+    fs = run_sources({
+        "variantcalling_tpu/bodies.py": '''
+            import jax
+
+            def fused_body(x, margins):
+                return jax.lax.psum(margins, "dp")
+            ''',
+        "variantcalling_tpu/install.py": '''
+            from variantcalling_tpu.bodies import fused_body
+            from variantcalling_tpu.parallel import shard_score
+
+            prog = shard_score.shard_program(fused_body, mesh, n_data_args=1)
+            ''',
+    }, select={"VCT009"})
+    assert [(f.path, f.code) for f in fs] \
+        == [("variantcalling_tpu/bodies.py", "VCT009")]
+    # per-file view of the body module alone: NOT flagged (no install in
+    # sight) — the cross-module finding is the project model's
+    assert run('''
+        import jax
+
+        def fused_body(x, margins):
+            return jax.lax.psum(margins, "dp")
+        ''', select={"VCT009"}) == []
+
+
+def test_vct008_pool_task_sink_write_flagged_outside_pipelines():
+    # the whole per-chunk body fans out on the IO pool: a sink write
+    # inside such a task is a pipeline write wherever the function lives
+    fs = run_sources({
+        "variantcalling_tpu/io/helpers.py": '''
+            import os
+
+            def commit_task(tmp, out_path):
+                os.replace(tmp, out_path)
+            ''',
+        "variantcalling_tpu/pipelines/some_pipe.py": '''
+            from variantcalling_tpu.io.helpers import commit_task
+
+            def run(pool, tmp, out):
+                pool.submit(commit_task, tmp, out)
+            ''',
+    }, select={"VCT008"})
+    assert [(f.path, f.code) for f in fs] \
+        == [("variantcalling_tpu/io/helpers.py", "VCT008")]
+    # the same io-layer write NOT submitted from pipelines stays the
+    # sanctioned layer below
+    assert run_sources({
+        "variantcalling_tpu/io/helpers.py": '''
+            import os
+
+            def commit_task(tmp, out_path):
+                os.replace(tmp, out_path)
+            ''',
+    }, select={"VCT008"}) == []
+
+
+def test_vct002_helper_routed_degrade_is_compliant_with_project():
+    sources = {
+        "variantcalling_tpu/utils/degrade.py": '''
+            def record(point, exc, **kw):
+                pass
+            ''',
+        "variantcalling_tpu/utils/notify.py": '''
+            from variantcalling_tpu.utils import degrade
+
+            def note_failure(e):
+                degrade.record("worker", e)
+            ''',
+        "variantcalling_tpu/worker.py": '''
+            from variantcalling_tpu.utils.notify import note_failure
+
+            def go():
+                try:
+                    risky()
+                except Exception as e:
+                    note_failure(e)
+            ''',
+    }
+    assert run_sources(sources, select={"VCT002"}) == []
+    # the per-file view of worker.py alone cannot see through the helper
+    assert codes(sources["variantcalling_tpu/worker.py"],
+                 path="variantcalling_tpu/worker.py",
+                 select={"VCT002"}) == ["VCT002"]
+    # a helper that does NOT route to degrade.record stays a finding
+    # even with the whole program in view
+    bad = dict(sources)
+    bad["variantcalling_tpu/utils/notify.py"] = '''
+        def note_failure(e):
+            print(e)
+        '''
+    fs = run_sources(bad, select={"VCT002"})
+    assert [(f.path, f.code) for f in fs] \
+        == [("variantcalling_tpu/worker.py", "VCT002")]
+
+
+def test_vct010_cross_module_pool_task_mutation_flagged():
+    # the ISSUE 9 incident class: state mutated from code reachable ONLY
+    # through a pool task submitted in another module
+    fs = run_sources({
+        "variantcalling_tpu/state.py": '''
+            _SHARED = {}
+
+            def poke(k):
+                _SHARED[k] = 1
+            ''',
+        "variantcalling_tpu/pipelines/fanout.py": '''
+            from variantcalling_tpu.state import poke
+
+            def run(pool):
+                pool.submit(poke, "a")
+            ''',
+    }, select={"VCT010"})
+    assert [(f.path, f.code) for f in fs] \
+        == [("variantcalling_tpu/state.py", "VCT010")]
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json, --update-baseline --justify, nonexistent path
+# ---------------------------------------------------------------------------
+
+
+def test_cli_nonexistent_path_is_exit_2(capsys):
+    # os.walk on a missing dir yields nothing: before the check this
+    # linted ZERO files and passed vacuously
+    assert lint_main(["definitely/not/a/path"]) == 2
+    assert "does not exist" in capsys.readouterr().err
+
+
+def test_cli_json_output(tmp_path, capsys):
+    snippet = tmp_path / "dirty.py"
+    snippet.write_text(_DIRTY)
+    bl = tmp_path / "baseline.json"
+    assert lint_main([str(snippet), "--baseline", str(bl), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["new"] == 2 and doc["exit"] == 1
+    assert {f["code"] for f in doc["findings"]} == {"VCT001", "VCT002"}
+    assert all(f["status"] == "new" for f in doc["findings"])
+    # per-checker wall time rides along for every registered checker
+    by_code = {c["code"]: c for c in doc["checkers"]}
+    assert "VCT010" in by_code
+    assert all(c["wall_s"] >= 0 for c in doc["checkers"])
+    # clean tree -> exit 0, empty findings, machine-readable all the same
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert lint_main([str(clean), "--baseline", str(bl), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == [] and doc["exit"] == 0
+
+
+def test_cli_update_baseline_requires_justify(tmp_path, capsys):
+    snippet = tmp_path / "dirty.py"
+    snippet.write_text(_DIRTY)
+    bl = tmp_path / "baseline.json"
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--update-baseline"]) == 2
+    assert "--justify" in capsys.readouterr().err
+    assert not bl.exists()
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--update-baseline", "--justify",
+                      "fixture debt, tracked in ISSUE-9"]) == 0
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries and all(e["justification"]
+                           == "fixture debt, tracked in ISSUE-9"
+                           for e in entries)
+    assert lint_main([str(snippet), "--baseline", str(bl)]) == 0
+
+
+def test_vct010_thread_ctor_import_spellings_flagged():
+    # any import spelling counts (the VCT001/VCT004 convention): a
+    # from-import or module alias must not evade the non-daemon rule
+    assert codes('''
+        from threading import Thread
+
+        t = Thread(target=work)
+        t.start()
+        ''', select={"VCT010"}) == ["VCT010"]
+    assert codes('''
+        import threading as th
+
+        t = th.Thread(target=work)
+        t.start()
+        ''', select={"VCT010"}) == ["VCT010"]
+    assert codes('''
+        from threading import Thread
+
+        t = Thread(target=work, daemon=True)
+        t.start()
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_caller_holds_the_lock_pattern_clean():
+    # a helper whose EVERY call site sits inside a lock span is
+    # protected by its callers — not a finding
+    assert codes('''
+        import threading
+
+        _C = {}
+        _L = threading.Lock()
+
+        def helper(k):
+            _C[k] = 1
+
+        def task(k):
+            with _L:
+                helper(k)
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == []
+    # ...but ONE unlocked call site anywhere re-arms the rule
+    assert codes('''
+        import threading
+
+        _C = {}
+        _L = threading.Lock()
+
+        def helper(k):
+            _C[k] = 1
+
+        def task(k):
+            with _L:
+                helper(k)
+
+        def sloppy(k):
+            helper(k)
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+    # ...and a helper handed to the pool DIRECTLY is an entry — its
+    # locked internal call sites do not protect the pool's invocation
+    assert codes('''
+        import threading
+
+        _C = {}
+        _L = threading.Lock()
+
+        def helper(k):
+            _C[k] = 1
+
+        def main_path(k):
+            with _L:
+                helper(k)
+
+        pool.submit(helper, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_cli_update_baseline_merges_out_of_scope_entries(tmp_path, capsys):
+    # a scoped --update-baseline must not silently delete other files'
+    # justified debt from the baseline
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text('import os\nx = os.environ.get("VCTPU_A")\n')
+    b.write_text('import os\ny = os.environ.get("VCTPU_B")\n')
+    bl = tmp_path / "baseline.json"
+    assert lint_main([str(a), str(b), "--baseline", str(bl),
+                      "--update-baseline", "--justify", "legacy pair"]) == 0
+    capsys.readouterr()
+    assert lint_main([str(a), "--baseline", str(bl),
+                      "--update-baseline", "--justify", "a only"]) == 0
+    entries = json.loads(bl.read_text())["entries"]
+    assert len(entries) == 2
+    # b.py's entry survived, and a.py kept its ORIGINAL justification
+    assert {e["justification"] for e in entries} == {"legacy pair"}
+    assert lint_main([str(b), "--baseline", str(bl)]) == 0
+
+
+def test_cli_update_baseline_replaces_todo_placeholder(tmp_path, capsys):
+    # --write-baseline stamps new entries with the TODO placeholder; the
+    # sanctioned --update-baseline --justify flow must be able to replace
+    # it — TODO is not a human justification, and keeping it silently
+    # defeats the policy the flag enforces
+    snippet = tmp_path / "dirty.py"
+    snippet.write_text(_DIRTY)
+    bl = tmp_path / "baseline.json"
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--write-baseline"]) == 0
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries and all(e["justification"] == "TODO" for e in entries)
+    capsys.readouterr()
+    assert lint_main([str(snippet), "--baseline", str(bl),
+                      "--update-baseline", "--justify",
+                      "real reason at last"]) == 0
+    entries = json.loads(bl.read_text())["entries"]
+    assert entries and all(e["justification"] == "real reason at last"
+                           for e in entries)
+
+
+def test_vct010_pool_task_via_lambda_wrapper_flagged():
+    # pool.submit(lambda: poke(x)) runs poke on a worker exactly like
+    # pool.submit(poke, x) — the lambda's CALL TARGETS must enter thread
+    # reachability, not just the lambda's own body
+    src = '''
+        _SHARED = {}
+
+        def poke(k):
+            _SHARED[k] = 1
+
+        def main(pool):
+            pool.submit(lambda: poke("a"))
+        '''
+    fs = run(src, select={"VCT010"})
+    assert [f.code for f in fs] == ["VCT010"]
+    assert "_SHARED" in fs[0].message
+
+
+def test_vct010_class_level_state_flagged_any_spelling():
+    # class-declared attrs live on the class OBJECT — shared across
+    # instances and threads whichever spelling the mutation uses
+    assert codes('''
+        class Stats:
+            counts = {}
+
+        def task(k):
+            Stats.counts[k] = 1
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+    assert codes('''
+        import threading
+
+        class Stats:
+            counts = {}
+
+            def work(self):
+                self.counts["k"] = 1
+
+            def run(self):
+                threading.Thread(target=self.work, daemon=True).start()
+        ''', select={"VCT010"}) == ["VCT010"]
+    # mutator-method spelling on declared class state
+    assert codes('''
+        class Stats:
+            seen = []
+
+        def task(k):
+            Stats.seen.append(k)
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_class_state_locked_and_instance_state_clean():
+    # holding the lock sanctions the class-state write, and plain
+    # per-instance attrs (bound in __init__, usually thread-confined)
+    # stay out of scope
+    assert codes('''
+        import threading
+
+        class Stats:
+            counts = {}
+            _lock = threading.Lock()
+
+            def __init__(self):
+                self.mine = {}
+
+            def work(self):
+                with Stats._lock:
+                    Stats.counts["k"] = 1
+                self.mine["k"] = 1
+
+            def run(self):
+                threading.Thread(target=self.work, daemon=True).start()
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_del_and_tuple_targets_are_mutations():
+    # `del _CACHE[x]` is eviction — the same mutation .pop() spells
+    # (the _PREDICTOR_CACHE race class) — and unpacking targets hide
+    # subscript writes inside a Tuple node
+    assert codes('''
+        _CACHE = {}
+
+        def task(x):
+            del _CACHE[x]
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+    assert codes('''
+        _A = {}
+
+        def task(k):
+            _A[k], x = 1, 2
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+    # a LOCAL bound through tuple unpacking is not module state, and a
+    # locked del is sanctioned
+    assert codes('''
+        import threading
+
+        cache = {}
+        _L = threading.Lock()
+
+        def task(k):
+            cache, x = {}, 1
+            cache[k] = 1
+
+        def evict(k):
+            with _L:
+                del cache[k]
+
+        pool.submit(task, 1)
+        pool.submit(evict, 1)
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_lock_name_needs_word_boundary():
+    # "clock"/"blocker" contain the substring "lock" but are NOT locks —
+    # a with-block over them must not sanction a shared-state mutation
+    assert codes('''
+        _C = {}
+
+        def task(k, clk):
+            with clk.clock:
+                _C[k] = 1
+
+        pool.submit(task, 1, c)
+        ''', select={"VCT010"}) == ["VCT010"]
+    # every real naming convention still counts as a lock span
+    assert codes('''
+        import threading
+
+        _C = {}
+        _MESH_CACHE_LOCK = threading.Lock()
+
+        def task(k):
+            with _MESH_CACHE_LOCK:
+                _C[k] = 1
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_branch_bound_module_state_and_locks_indexed():
+    # module bindings hide in branches exactly like defs do: the
+    # native-fallback idiom binds the cache (or the lock guarding it)
+    # inside `except ImportError:` — both must be indexed
+    assert codes('''
+        try:
+            from native import cache as _CACHE
+        except ImportError:
+            _CACHE = {}
+
+        def task(x):
+            _CACHE[x] = 1
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+    # a lock bound in a branch is a recognized lock (no false positive
+    # for the correctly locked mutation; 'MUTEX' has no 'lock' in its
+    # spelling so only module_locks registration can sanction it)
+    assert codes('''
+        import threading
+
+        _C = {}
+        try:
+            _MUTEX = threading.Lock()
+        except Exception:
+            _MUTEX = threading.Lock()
+
+        def task(x):
+            with _MUTEX:
+                _C[x] = 1
+
+        pool.submit(task, 1)
+        ''', select={"VCT010"}) == []
+
+
+def test_cli_update_baseline_reports_merged_entry_count(tmp_path, capsys):
+    # the merge path retains out-of-scope entries — the CLI must report
+    # the number of entries the baseline now HOLDS, not this run's
+    # finding count
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text('import os\nx = os.environ.get("VCTPU_A")\n')
+    b.write_text('import os\ny = os.environ.get("VCTPU_B")\n')
+    bl = tmp_path / "baseline.json"
+    assert lint_main([str(a), str(b), "--baseline", str(bl),
+                      "--update-baseline", "--justify", "pair"]) == 0
+    capsys.readouterr()
+    assert lint_main([str(a), "--baseline", str(bl),
+                      "--update-baseline", "--justify", "a only"]) == 0
+    out = capsys.readouterr().out
+    assert "2 entries" in out and "1 finding(s) from this run" in out
+    # --json on the write path emits the structured form
+    assert lint_main([str(a), "--baseline", str(bl), "--json",
+                      "--update-baseline", "--justify", "a only"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["action"] == "update-baseline"
+    assert doc["entries"] == 2 and doc["run_findings"] == 1
+
+
+def test_vct010_def_in_except_handler_indexed():
+    # the repo's own native-fallback idiom defines functions in `except
+    # ImportError:` handlers — a def the index cannot see is a def no
+    # checker scans, so every branch shape must be walked
+    assert codes('''
+        _CACHE = {}
+
+        try:
+            from native import parse
+        except ImportError:
+            def parse(x):
+                _CACHE[x] = 1
+
+        def main(pool):
+            pool.submit(parse, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+    # else-branch defs too
+    assert codes('''
+        _CACHE = {}
+
+        if fast:
+            pass
+        else:
+            def parse(x):
+                _CACHE[x] = 1
+
+        pool.submit(parse, 1)
+        ''', select={"VCT010"}) == ["VCT010"]
+
+
+def test_vct010_nested_def_scanned_under_own_key_only():
+    # a nested helper whose only call site sits inside a lock span is
+    # caller-protected — the enclosing function's scan must not walk
+    # into the nested body and re-report it unlocked
+    assert codes('''
+        import threading
+
+        _C = {}
+        _L = threading.Lock()
+
+        def task():
+            def inner():
+                _C[1] = 2
+            with _L:
+                inner()
+
+        pool.submit(task)
+        ''', select={"VCT010"}) == []
+    # ...and the unlocked variant reports exactly ONCE, not once per
+    # enclosing scope
+    fs = run('''
+        import threading
+
+        _C = {}
+
+        def task():
+            def inner():
+                _C[1] = 2
+            inner()
+
+        pool.submit(task)
+        ''', select={"VCT010"})
+    assert [f.code for f in fs] == ["VCT010"]
+
+
+def test_vct010_lambda_submit_is_an_unlocked_call_site():
+    # an entry lambda's invocation of a helper is an UNLOCKED call site
+    # (the pool holds no lock; a lambda body cannot) — it must re-arm
+    # the caller-holds-the-lock exemption even when every other call
+    # site is lock-protected
+    assert codes('''
+        import threading
+
+        _C = {}
+        _L = threading.Lock()
+
+        def helper(k):
+            _C[k] = 1
+
+        def main_path(k):
+            with _L:
+                helper(k)
+
+        def go(pool):
+            pool.submit(lambda: helper(1))
+        ''', select={"VCT010"}) == ["VCT010"]
+    # ...but a lambda wrapping the LOCKED path stays clean
+    assert codes('''
+        import threading
+
+        _C = {}
+        _L = threading.Lock()
+
+        def helper(k):
+            _C[k] = 1
+
+        def main_path(k):
+            with _L:
+                helper(k)
+
+        def go(pool):
+            pool.submit(lambda: main_path(1))
+        ''', select={"VCT010"}) == []
+
+
+def test_vct010_traced_body_lambda_not_a_thread_entry():
+    # a jit/shard_map body runs on the MAIN thread — host effects inside
+    # it are VCT004's domain, not a thread-reachability finding
+    assert codes('''
+        import jax
+
+        _STATS = {}
+
+        prog = jax.jit(lambda x: _STATS.setdefault("n", x))
+        ''', select={"VCT010"}) == []

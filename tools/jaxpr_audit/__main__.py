@@ -1,0 +1,8 @@
+"""``python -m tools.jaxpr_audit`` — the tier-0 jaxpr audit stage."""
+
+import sys
+
+from tools.jaxpr_audit import main
+
+if __name__ == "__main__":
+    sys.exit(main())

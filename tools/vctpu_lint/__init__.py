@@ -57,15 +57,22 @@ class Checker(ast.NodeVisitor):
     Subclasses set ``code``/``name``/``description`` and implement
     ``visit_*`` methods, calling :meth:`report` on violations. The file's
     source lines and path are available as ``self.lines`` / ``self.path``.
+
+    ``self.project`` is the whole-program :class:`~tools.vctpu_lint.project.
+    ProjectIndex` when the caller linted a full tree (``lint_paths`` /
+    ``lint_sources``), or None in snippet mode — project-aware checkers
+    must degrade gracefully to the per-file view so ``lint_source`` keeps
+    working on snippets.
     """
 
     code: str = ""
     name: str = ""
     description: str = ""
 
-    def __init__(self, path: str, lines: list[str]):
+    def __init__(self, path: str, lines: list[str], project=None):
         self.path = path
         self.lines = lines
+        self.project = project
         self.findings: list[Finding] = []
 
     def report(self, node: ast.AST, message: str) -> None:
@@ -100,16 +107,24 @@ def _suppressed_codes(line_text: str) -> set[str]:
 
 
 def lint_source(path: str, source: str,
-                select: set[str] | None = None) -> list[Finding]:
+                select: set[str] | None = None,
+                project=None,
+                timings: dict[str, float] | None = None) -> list[Finding]:
     """Run every registered checker over one file's source text.
 
     ``path`` is used for reporting and per-checker file exemptions; it
     does not need to exist on disk (tests lint snippets directly).
-    Returns findings sorted by (line, col, code), with per-line
-    suppression comments already applied. A syntax error becomes a
-    single ``VCT000`` finding — a file the linter cannot parse must not
-    pass silently.
+    ``project`` is an optional whole-program index
+    (:class:`tools.vctpu_lint.project.ProjectIndex`) enabling the
+    cross-module checks; without one, project-aware checkers fall back
+    to the per-file view. ``timings`` (when given) accumulates
+    per-checker wall seconds by code. Returns findings sorted by (line,
+    col, code), with per-line suppression comments already applied. A
+    syntax error becomes a single ``VCT000`` finding — a file the linter
+    cannot parse must not pass silently.
     """
+    import time
+
     norm = path.replace(os.sep, "/")
     lines = source.splitlines()
     try:
@@ -123,10 +138,14 @@ def lint_source(path: str, source: str,
     for cls in CHECKERS:
         if select is not None and cls.code not in select:
             continue
-        checker = cls(norm, lines)
+        checker = cls(norm, lines, project=project)
         if not checker.applies_to(norm):
             continue
+        t0 = time.perf_counter()
         checker.visit(tree)
+        if timings is not None:
+            timings[cls.code] = timings.get(cls.code, 0.0) \
+                + (time.perf_counter() - t0)
         findings.extend(checker.findings)
     kept = []
     for f in findings:
@@ -139,12 +158,23 @@ def lint_source(path: str, source: str,
 
 
 def iter_python_files(paths: list[str]) -> list[str]:
-    """Expand files/directories into a sorted list of .py files."""
+    """Expand files/directories into a sorted list of .py files.
+
+    A path that exists as neither file nor directory RAISES
+    FileNotFoundError — ``os.walk`` on a missing directory yields
+    nothing, and before this check a typo'd path argument linted zero
+    files and exited 0, i.e. the lint gate silently passed without
+    looking at anything (the CLI maps the raise to exit 2).
+    """
     out: list[str] = []
     for p in paths:
         if os.path.isfile(p):
             out.append(p)
             continue
+        if not os.path.isdir(p):
+            raise FileNotFoundError(
+                f"lint path does not exist: {p!r} (a missing path would "
+                "otherwise lint zero files and pass vacuously)")
         for root, dirs, files in os.walk(p):
             dirs[:] = sorted(d for d in dirs
                              if d not in ("__pycache__", ".git"))
@@ -153,18 +183,35 @@ def iter_python_files(paths: list[str]) -> list[str]:
     return sorted(set(out))
 
 
-def lint_paths(paths: list[str],
-               select: set[str] | None = None) -> list[Finding]:
+def lint_sources(sources: dict[str, str],
+                 select: set[str] | None = None,
+                 timings: dict[str, float] | None = None) -> list[Finding]:
+    """Lint a ``{repo-relative path: source}`` mapping as ONE program:
+    builds the whole-program index once, then runs every checker per
+    file with the project view attached (the multi-module twin of
+    :func:`lint_source`; tests feed synthetic trees through it)."""
+    from tools.vctpu_lint.project import ProjectIndex
+
+    index = ProjectIndex.build(sources)
     findings: list[Finding] = []
+    for path, source in sorted(sources.items()):
+        findings.extend(lint_source(path, source, select, project=index,
+                                    timings=timings))
+    return findings
+
+
+def lint_paths(paths: list[str],
+               select: set[str] | None = None,
+               timings: dict[str, float] | None = None) -> list[Finding]:
+    sources: dict[str, str] = {}
     for path in iter_python_files(paths):
         with open(path, encoding="utf-8") as fh:
-            source = fh.read()
-        findings.extend(lint_source(os.path.relpath(path), source, select))
-    return findings
+            sources[os.path.relpath(path).replace(os.sep, "/")] = fh.read()
+    return lint_sources(sources, select, timings=timings)
 
 
 # registration side effect: import the checker suite
 from tools.vctpu_lint import checkers as _checkers  # noqa: E402,F401
 
 __all__ = ["Finding", "Checker", "CHECKERS", "register", "lint_source",
-           "lint_paths", "iter_python_files"]
+           "lint_sources", "lint_paths", "iter_python_files"]

@@ -1,19 +1,28 @@
 """CLI: ``python -m tools.vctpu_lint [paths] [options]``.
 
 Exit codes: 0 clean (all findings baselined), 1 new findings, 2
-usage/internal error. ``run_tests.sh`` runs this as the tier-0 lint
-stage before pytest.
+usage/internal error (including a nonexistent path argument — linting
+zero files must never pass vacuously). ``run_tests.sh`` runs this as the
+tier-0 lint stage before pytest, with ``--json`` so failures render
+structured in the log.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import time
 
-from tools.vctpu_lint import CHECKERS, lint_paths
+from tools.vctpu_lint import CHECKERS, Finding, lint_paths
 from tools.vctpu_lint import baseline as baseline_mod
 
 DEFAULT_PATHS = ["variantcalling_tpu", "tools"]
+
+
+def _finding_dict(f: Finding, status: str) -> dict:
+    return {"code": f.code, "path": f.path, "line": f.line, "col": f.col + 1,
+            "message": f.message, "line_text": f.line_text, "status": status}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -31,7 +40,19 @@ def main(argv: list[str] | None = None) -> int:
                         help="report every finding, baselined or not")
     parser.add_argument("--write-baseline", action="store_true",
                         help="regenerate the baseline from current findings "
-                             "(new entries get justification TODO)")
+                             "(new entries get justification TODO — replace "
+                             "before committing; prefer --update-baseline)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="grandfather the current findings into the "
+                             "baseline; REQUIRES --justify — a finding "
+                             "nobody can justify should be fixed, not "
+                             "baselined")
+    parser.add_argument("--justify", default=None, metavar="REASON",
+                        help="justification string recorded on every entry "
+                             "--update-baseline adds")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output: findings + per-"
+                             "checker wall time")
     parser.add_argument("--select", default=None,
                         help="comma-separated codes to run (e.g. "
                              "VCT001,VCT003)")
@@ -54,21 +75,81 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
 
+    if args.update_baseline and not args.justify:
+        print("--update-baseline refuses to grandfather findings without "
+              "--justify \"<reason>\" — a finding nobody can justify should "
+              "be fixed, not baselined (docs/static_analysis.md suppression "
+              "policy)", file=sys.stderr)
+        return 2
+
     paths = args.paths or DEFAULT_PATHS
+    timings: dict[str, float] = {}
+    t0 = time.perf_counter()
     try:
-        findings = lint_paths(paths, select)
+        findings = lint_paths(paths, select, timings=timings)
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    wall_s = time.perf_counter() - t0
 
-    if args.write_baseline:
-        baseline_mod.write(args.baseline, findings)
-        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+    if args.write_baseline or args.update_baseline:
+        justifications = None
+        if args.update_baseline:
+            justifications = {f.fingerprint(): args.justify for f in findings}
+        # --update-baseline MERGES (entries outside this run's path/select
+        # scope survive); --write-baseline replaces, shrinkage included
+        n_entries = baseline_mod.write(args.baseline, findings,
+                                       justifications=justifications,
+                                       merge=args.update_baseline)
+        if args.as_json:
+            json.dump({"version": 1,
+                       "action": "update-baseline" if args.update_baseline
+                       else "write-baseline",
+                       "baseline": args.baseline,
+                       "entries": n_entries,
+                       "run_findings": len(findings),
+                       "exit": 0}, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            print(f"baseline now holds {n_entries} entr"
+                  f"{'y' if n_entries == 1 else 'ies'} "
+                  f"({len(findings)} finding(s) from this run) -> "
+                  f"{args.baseline}")
         return 0
 
     allowed = baseline_mod.load(args.baseline) if not args.no_baseline \
         else baseline_mod.load("/nonexistent")
     new, old, stale = baseline_mod.partition(findings, allowed)
+
+    if args.as_json:
+        by_code = sorted(CHECKERS, key=lambda c: c.code)
+        doc = {
+            "version": 1,
+            "paths": paths,
+            "wall_s": round(wall_s, 6),
+            "checkers": [
+                {"code": cls.code, "name": cls.name,
+                 "wall_s": round(timings.get(cls.code, 0.0), 6)}
+                for cls in by_code
+            ],
+            "findings": [_finding_dict(f, "new") for f in new]
+            + [_finding_dict(f, "baselined") for f in old],
+            "stale_baseline_entries": [
+                {"code": code, "path": path, "line_text": text, "count": n}
+                for (code, path, text), n in sorted(stale.items())
+            ],
+            "new": len(new),
+            "baselined": len(old),
+            "exit": 1 if new else 0,
+        }
+        json.dump(doc, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        if new:
+            print(f"{len(new)} new finding(s) — see the JSON findings "
+                  "array above", file=sys.stderr)
+            return 1
+        return 0
+
     for f in new:
         print(f.render())
     if old:
@@ -80,7 +161,8 @@ def main(argv: list[str] | None = None) -> int:
     if new:
         print(f"{len(new)} new finding(s). Fix them, add a per-line "
               "'# vctpu-lint: disable=<code> — reason' suppression, or "
-              "(with justification) extend the baseline.", file=sys.stderr)
+              "(with justification) extend the baseline via "
+              "--update-baseline --justify \"<reason>\".", file=sys.stderr)
         return 1
     return 0
 

@@ -35,19 +35,39 @@ def load(path: str) -> Counter:
 
 
 def write(path: str, findings: list[Finding],
-          justifications: dict[tuple, str] | None = None) -> None:
+          justifications: dict[tuple, str] | None = None,
+          merge: bool = False) -> int:
     """Regenerate the baseline from the given findings, carrying over
     justifications for fingerprints that survive (new entries get TODO —
-    replace it before committing)."""
+    replace it before committing).
+
+    ``merge=True`` (the ``--update-baseline`` flow) UNIONS with the
+    existing baseline instead of replacing it: entries for paths or
+    checkers outside this run's scope survive (a scoped
+    ``--update-baseline a.py`` must not silently delete b.py's justified
+    debt), and a fingerprint present in both keeps the larger count.
+    Shrinking the baseline stays a deliberate act (``--write-baseline``
+    on the full tree, or hand-editing the artifact)."""
     old: dict[tuple, str] = {}
+    old_counts: Counter = Counter()
     if os.path.exists(path):
         with open(path, encoding="utf-8") as fh:
             for entry in json.load(fh).get("entries", []):
                 fp = (entry["code"], entry["path"], entry["line_text"])
                 old[fp] = entry.get("justification", "TODO")
+                old_counts[fp] += int(entry.get("count", 1))
     if justifications:
-        old.update(justifications)
+        # never overwrite an existing human justification with the batch
+        # --justify string (the original reason is the better record) —
+        # but the auto-generated TODO placeholder is not a justification,
+        # so --update-baseline --justify must be able to replace it
+        for fp, why in justifications.items():
+            if old.get(fp, "TODO") == "TODO":
+                old[fp] = why
     counts = Counter(f.fingerprint() for f in findings)
+    if merge:
+        for fp, n in old_counts.items():
+            counts[fp] = max(counts[fp], n)
     entries = [
         {"code": code, "path": fpath, "line_text": text, "count": n,
          "justification": old.get((code, fpath, text), "TODO")}
@@ -56,6 +76,7 @@ def write(path: str, findings: list[Finding],
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({"version": 1, "entries": entries}, fh, indent=2)
         fh.write("\n")
+    return len(entries)
 
 
 def partition(findings: list[Finding],

@@ -12,9 +12,13 @@ import json
 import os
 
 from tools.vctpu_lint import Checker, register
+from tools.vctpu_lint import project as project_mod
 
 #: the one module allowed to read VCTPU_* environment variables
 KNOB_REGISTRY_PATH = "variantcalling_tpu/knobs.py"
+
+#: dotted module of the designated degradation recorder (VCT002)
+_DEGRADE_MODULE = "variantcalling_tpu.utils.degrade"
 
 #: the one function allowed to reduce over the tree/margin axis
 SEQUENTIAL_TREE_SUM = "sequential_tree_sum"
@@ -119,6 +123,36 @@ class SilentFallbackChecker(Checker):
     description = ("except:/except Exception: swallows without re-raising, "
                    "raising EngineError, or calling degrade.record")
 
+    def __init__(self, path: str, lines: list[str], project=None):
+        super().__init__(path, lines, project)
+        #: (owner, attr) call spellings that count as degrade.record —
+        #: the default plus whatever this module's imports alias it to
+        self._degrade_attrs: set[tuple[str, str]] = set(_DEGRADE_CALLS)
+        #: bare-name spellings (``from ...degrade import record as r``)
+        self._degrade_names: set[str] = set()
+
+    def visit_Module(self, node: ast.Module) -> None:
+        # resolve the recorder through the module's OWN import spellings
+        # (shared project-model resolution, not the one hard-coded
+        # ``degrade.record`` shape): a degrade path reached through
+        # ``from variantcalling_tpu.utils.degrade import record as _rec``
+        # used to be invisible and the handler got flagged anyway
+        for n in ast.walk(node):
+            if isinstance(n, ast.Import):
+                for alias in n.names:
+                    if alias.name == _DEGRADE_MODULE:
+                        local = alias.asname or alias.name.split(".")[-1]
+                        self._degrade_attrs.add((local, "record"))
+            elif isinstance(n, ast.ImportFrom) and n.module:
+                for alias in n.names:
+                    if n.module == _DEGRADE_MODULE and alias.name == "record":
+                        self._degrade_names.add(alias.asname or "record")
+                    elif alias.name == "degrade" and \
+                            f"{n.module}.degrade" == _DEGRADE_MODULE:
+                        self._degrade_attrs.add(
+                            (alias.asname or "degrade", "record"))
+        self.generic_visit(node)
+
     @staticmethod
     def _is_broad(handler: ast.ExceptHandler) -> bool:
         def broad_name(n: ast.expr) -> bool:
@@ -131,19 +165,44 @@ class SilentFallbackChecker(Checker):
         return isinstance(handler.type, ast.Tuple) \
             and any(broad_name(e) for e in handler.type.elts)
 
-    @staticmethod
-    def _is_compliant(handler: ast.ExceptHandler) -> bool:
+    def _is_compliant(self, handler: ast.ExceptHandler) -> bool:
         for stmt in handler.body:
             for node in ast.walk(stmt):
                 if isinstance(node, ast.Raise):
                     return True
-                if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-                    owner = node.func.value
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Name) and \
+                        func.id in self._degrade_names:
+                    return True
+                if isinstance(func, ast.Attribute):
+                    owner = func.value
                     owner_name = owner.id if isinstance(owner, ast.Name) else \
                         owner.attr if isinstance(owner, ast.Attribute) else ""
-                    if (owner_name, node.func.attr) in _DEGRADE_CALLS:
+                    if (owner_name, func.attr) in self._degrade_attrs:
                         return True
+                if self._routes_to_degrade(func):
+                    return True
         return False
+
+    def _routes_to_degrade(self, func: ast.expr) -> bool:
+        """Project-aware compliance: the handler calls a helper from
+        which ``utils.degrade.record`` is transitively reachable over the
+        resolved call graph — a degrade path one call away (e.g. the
+        retry bookkeeping helpers pool tasks route failures through) used
+        to be invisible to the per-file view and got flagged anyway."""
+        if self.project is None:
+            return False
+        target = self.project.function_key(_DEGRADE_MODULE, "record")
+        if target is None:
+            return False
+        name = func.id if isinstance(func, ast.Name) \
+            else project_mod._dotted(func)
+        if not name:
+            return False
+        got = self.project.resolve_name(self.path, name)
+        return got is not None and self.project.reaches(got, target)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         if self._is_broad(node) and not self._is_compliant(node):
@@ -173,8 +232,8 @@ class UnorderedReductionChecker(Checker):
     description = ("jnp.sum/.sum over a tree/margin-named axis outside "
                    "forest.sequential_tree_sum")
 
-    def __init__(self, path: str, lines: list[str]):
-        super().__init__(path, lines)
+    def __init__(self, path: str, lines: list[str], project=None):
+        super().__init__(path, lines, project)
         self._func_stack: list[str] = []
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -303,25 +362,25 @@ class UnboundedSubprocessChecker(Checker):
     leg dialing a dead relay — TPU_PROBE_LOG.md) turns a pipeline into a
     zombie. Every ``subprocess`` call carries ``timeout=``; every
     ``Popen`` has a ``communicate(timeout=)``/``wait(timeout=)`` in its
-    function; every pipeline thread is a daemon or has a join path.
+    function. (The non-daemon-thread clause this checker used to carry
+    moved wholesale into VCT010 rule 2, which is strictly stricter —
+    outside ``parallel/pipeline.py`` a join path does not excuse a
+    non-daemon worker — and one defect must not yield two findings
+    needing two suppression codes.)
     """
 
     code = "VCT005"
     name = "unbounded-subprocess"
-    description = ("subprocess call without timeout=, or thread with no "
-                   "join path")
+    description = "subprocess call without timeout= or bounded wait"
 
     _WAIT_FNS = ("run", "call", "check_output", "check_call")
 
-    def __init__(self, path: str, lines: list[str]):
-        super().__init__(path, lines)
+    def __init__(self, path: str, lines: list[str], project=None):
+        super().__init__(path, lines, project)
         self._func_stack: list[ast.AST] = []
 
     def visit_Module(self, node: ast.Module) -> None:
         self._module = node
-        self._module_has_join = any(
-            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
-            and n.func.attr == "join" for n in ast.walk(node))
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
@@ -355,16 +414,6 @@ class UnboundedSubprocessChecker(Checker):
                 self.report(node, "subprocess.Popen with no "
                                   "communicate(timeout=)/wait(timeout=) in "
                                   "this function")
-        elif isinstance(func, ast.Attribute) and func.attr == "Thread" \
-                and isinstance(func.value, ast.Name) \
-                and func.value.id == "threading":
-            daemon = any(kw.arg == "daemon" and
-                         isinstance(kw.value, ast.Constant) and
-                         kw.value.value is True for kw in node.keywords)
-            if not daemon and not self._module_has_join:
-                self.report(node, "non-daemon threading.Thread in a module "
-                                  "with no .join() — a crashed parent leaks "
-                                  "the worker")
         self.generic_visit(node)
 
 
@@ -397,8 +446,8 @@ class RawTimingChecker(Checker):
 
     _CLOCKS = ("time", "perf_counter", "perf_counter_ns", "process_time")
 
-    def __init__(self, path: str, lines: list[str]):
-        super().__init__(path, lines)
+    def __init__(self, path: str, lines: list[str], project=None):
+        super().__init__(path, lines, project)
         # any-import-spelling tracking (the VCT001 `_is_environ` rule):
         # `import time as _time` and `from time import perf_counter as pc`
         # must not evade the checker
@@ -532,8 +581,12 @@ class UnsequencedWriteChecker(Checker):
     ``os.replace`` onto an output path can commit a torn or
     out-of-order file. Scope: ``variantcalling_tpu/pipelines/`` (the
     layer that owns streaming output paths); report writers and io/
-    writer classes are the sanctioned layer below. Sanctioned sites
-    carry inline suppressions naming why, like VCT006's.
+    writer classes are the sanctioned layer below — EXCEPT functions the
+    project index registers as pool tasks submitted FROM a pipelines
+    module (with the whole per-chunk body fanned out on the IO pool, a
+    sink write inside such a task is a pipeline write wherever the
+    function happens to live). Sanctioned sites carry inline
+    suppressions naming why, like VCT006's.
     """
 
     code = "VCT008"
@@ -541,19 +594,39 @@ class UnsequencedWriteChecker(Checker):
     description = ("direct sink/partial write or os.replace on a streaming "
                    "output path outside the sanctioned committer")
 
-    def __init__(self, path: str, lines: list[str]):
-        super().__init__(path, lines)
+    def __init__(self, path: str, lines: list[str], project=None):
+        super().__init__(path, lines, project)
         self._funcs: list[str] = []
+        self._qual: list[str] = []
+        #: qualnames (in this module) of pool tasks submitted from
+        #: pipelines code — outside pipelines/, ONLY these are in scope
+        self._task_quals: set[str] = set()
+        if project is not None and "variantcalling_tpu/pipelines/" not in path:
+            self._task_quals = project.pipeline_submitted_tasks(path)
 
     def applies_to(self, path: str) -> bool:
-        return "variantcalling_tpu/pipelines/" in path
+        return "variantcalling_tpu/pipelines/" in path or bool(self._task_quals)
+
+    def _in_scope(self) -> bool:
+        if "variantcalling_tpu/pipelines/" in self.path:
+            return True
+        qual = ".".join(self._qual)
+        return any(qual == t or qual.startswith(t + ".")
+                   for t in self._task_quals)
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._funcs.append(node.name)
+        self._qual.append(node.name)
         self.generic_visit(node)
+        self._qual.pop()
         self._funcs.pop()
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._qual.append(node.name)
+        self.generic_visit(node)
+        self._qual.pop()
 
     @staticmethod
     def _sink_named(expr: ast.expr) -> str | None:
@@ -568,7 +641,7 @@ class UnsequencedWriteChecker(Checker):
 
     def visit_Call(self, node: ast.Call) -> None:
         func = node.func
-        if isinstance(func, ast.Attribute):
+        if isinstance(func, ast.Attribute) and self._in_scope():
             if func.attr == "replace" and isinstance(func.value, ast.Name) \
                     and func.value.id == "os":
                 self.report(node, "os.replace in pipeline code — only the "
@@ -586,10 +659,6 @@ class UnsequencedWriteChecker(Checker):
                                       "retry + rewind guard, chunk order)")
         self.generic_visit(node)
 
-
-#: call names that install a function as a per-device shard_map body
-#: (VCT009): jax's shard_map itself plus the repo's own wrapper
-_SHARD_MAP_WRAPPERS = ("shard_map", "shard_program")
 
 #: identifier tokens marking an array as margin/score data (VCT009):
 #: VCT003's tree/margin vocabulary plus the score spellings the
@@ -614,7 +683,13 @@ class ShardMapMarginReductionChecker(Checker):
     is the VCT003 reassociation hole in its most dangerous location.
     Bodies are found structurally: any function (or lambda) passed as
     the first argument to ``shard_map`` / ``shard_program``, plus every
-    function nested inside it.
+    function nested inside it — resolution (simple-name aliases, aliased
+    lambdas, conditional rebinds) is the project model's
+    :func:`~tools.vctpu_lint.project.installed_bodies`, shared with the
+    whole-program index. With a project index attached, bodies installed
+    FROM ANOTHER MODULE (``from here import body; shard_program(body,
+    ...)`` elsewhere) are scanned too — the cross-module alias shape the
+    per-file view missed.
     """
 
     code = "VCT009"
@@ -639,52 +714,16 @@ class ShardMapMarginReductionChecker(Checker):
     def visit_Module(self, node: ast.Module) -> None:
         # pass 1: collect shard_map body functions — first argument of
         # every shard_map/shard_program call (Name reference or inline
-        # lambda), resolved against every FunctionDef in the module.
-        # Simple name aliases resolve transitively (``fn = body;
-        # shard_map(fn, ...)`` scans ``body`` — the exact shape of the
-        # production install site in pipelines/filter_variants.py, where
-        # the fused body binds through an intermediate before
-        # shard_program); conditional rebinds add every source, erring
-        # toward scanning too much (suppressions exist for false hits)
-        body_names: set[str] = set()
-        lambdas: list[ast.Lambda] = []
-        aliases: dict[str, set[str]] = {}
-        named_lambdas: dict[str, list[ast.Lambda]] = {}
-        for n in ast.walk(node):
-            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Name):
-                for t in n.targets:
-                    if isinstance(t, ast.Name):
-                        aliases.setdefault(t.id, set()).add(n.value.id)
-                continue
-            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
-                for t in n.targets:
-                    if isinstance(t, ast.Name):
-                        named_lambdas.setdefault(t.id, []).append(n.value)
-                continue
-            if isinstance(n, ast.AnnAssign) and isinstance(n.value, ast.Name) \
-                    and isinstance(n.target, ast.Name):
-                aliases.setdefault(n.target.id, set()).add(n.value.id)
-                continue
-            if not (isinstance(n, ast.Call) and n.args):
-                continue
-            f = n.func
-            fname = f.id if isinstance(f, ast.Name) else \
-                f.attr if isinstance(f, ast.Attribute) else ""
-            if fname not in _SHARD_MAP_WRAPPERS:
-                continue
-            first = n.args[0]
-            if isinstance(first, ast.Name):
-                body_names.add(first.id)
-            elif isinstance(first, ast.Lambda):
-                lambdas.append(first)
-        frontier = list(body_names)
-        while frontier:
-            name = frontier.pop()
-            lambdas.extend(named_lambdas.get(name, ()))
-            for src in aliases.get(name, ()):
-                if src not in body_names:
-                    body_names.add(src)
-                    frontier.append(src)
+        # lambda), aliases resolved transitively through the shared
+        # project-model machinery (``fn = body; shard_map(fn, ...)``
+        # scans ``body``; conditional rebinds add every source, erring
+        # toward scanning too much — suppressions exist for false hits)
+        body_names, lambdas = project_mod.installed_bodies(node)
+        if self.project is not None:
+            # cross-module installs: functions of THIS module registered
+            # as shard_map bodies anywhere in the project
+            for qual in self.project.traced_bodies_in(self.path):
+                body_names.add(qual.split(".")[-1])
         for n in ast.walk(node):
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and n.name in body_names:
@@ -732,3 +771,63 @@ class ShardMapMarginReductionChecker(Checker):
                                        "f32 reductions per shard shape; "
                                        "margin/score reductions must go "
                                        "through forest.sequential_tree_sum")
+
+
+@register
+class ConcurrencyDisciplineChecker(Checker):
+    """VCT010 — concurrency discipline over the thread-reachable graph.
+
+    Incident class: with the per-chunk body fanned out on the IO pool
+    (PR 7) and megabatches scored through shard_map (PR 8), more of the
+    tree executes off the main thread every PR — and the last unsequenced-
+    write incident was reachable ONLY through a pool task, invisible to
+    any per-file checker. Using the project model's thread-entry registry
+    (``threading.Thread(target=...)``, ``IoPool``-style ``.submit``,
+    ``imap_ordered`` task fns, ``StagePipeline`` stage callables) and the
+    resolved call graph, three rules:
+
+    1. **Unlocked shared mutation.** Module/class state mutated from
+       thread-reachable code without a lock held and outside the
+       sanctioned handoffs — ``queue.Queue`` objects, ``imap_ordered``'s
+       ordered reassembly, and the per-thread cells in ``obs/metrics.py``
+       (one cell per recording thread, merged at snapshot — sanctioned by
+       design, not by lock).
+    2. **Non-daemon thread construction** outside ``parallel/pipeline.py``
+       — the one module owning the join/watchdog discipline; everywhere
+       else a non-daemon worker wedged in a native call blocks process
+       exit (the IoPool docstring's rule, now machine-checked).
+    3. **Lock-order inversion.** Two locks acquired in both orders
+       anywhere in the reachable graph (nested ``with`` blocks, including
+       through resolved call edges) — the static shadow of a deadlock.
+
+    Benign racy writes (GIL-atomic diagnostics like
+    ``forest.last_strategy``) carry per-line suppressions naming why,
+    like VCT006's sanctioned stopwatch sites.
+
+    Scope: the library and tools (everything linted); in snippet mode
+    (no project index) the checker builds a throwaway single-module
+    index, so fixtures stay one file.
+    """
+
+    code = "VCT010"
+    name = "concurrency-discipline"
+    description = ("unlocked shared mutation from thread-reachable code, "
+                   "non-daemon threads outside parallel/pipeline.py, or "
+                   "inconsistent lock order")
+
+    def visit_Module(self, node: ast.Module) -> None:
+        index = self.project
+        if index is None:
+            index = project_mod.ProjectIndex.build_single(
+                self.path, node, self.lines)
+        for path, line, message in index.concurrency_findings():
+            if path == self.path:
+                self.report(_Anchor(line), message)
+
+
+class _Anchor:
+    """Minimal node stand-in anchoring a project-level finding to a line."""
+
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset

@@ -1,0 +1,1275 @@
+"""Whole-program project model — the shared analysis substrate of vctpu-lint.
+
+Before this module every checker saw ONE file at a time, and the last
+three incident classes were exactly the bugs a per-file view cannot see:
+a ``shard_map`` body bound through an alias in another module, an
+unsequenced sink write reachable only through a pool task, and unlocked
+shared-state mutation that only happens on a worker thread. The project
+model is a ONE-PASS index over every linted source:
+
+- per-module defs (functions/methods by qualname), imports (local name
+  -> dotted module), and simple name aliases (``fn = body``) — the
+  alias-resolution machinery VCT009 grew in PR 8, promoted from a
+  private checker detail to shared infrastructure;
+- a call-edge graph (callee names resolved through imports, aliases,
+  ``self.``-method dispatch and one-hop local construction);
+- a registry of THREAD-ENTRY POINTS: ``threading.Thread(target=...)``,
+  ``IoPool``-style ``.submit(fn, ...)``, ``imap_ordered(pool, fn, ...)``
+  and ``StagePipeline([stage, ...])`` stage callables — everything the
+  runtime may execute off the main thread;
+- a registry of TRACED-BODY SITES: functions installed as
+  ``shard_map``/``shard_program`` bodies or passed to ``jax.jit``,
+  resolved through cross-module aliases.
+
+Checkers opt in through ``self.project`` (set by :func:`lint_source`
+when the caller built an index); ``lint_source`` without a project still
+works on snippets — VCT010 then builds a throwaway single-module index,
+so golden fixtures stay one file.
+
+Everything here is stdlib ``ast`` — no imports of the library under
+analysis, no new dependencies.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+#: call names that install a function as a per-device shard_map body:
+#: jax's shard_map itself plus the repo's own wrapper (shared with VCT009)
+SHARD_MAP_WRAPPERS = ("shard_map", "shard_program")
+
+#: call names that install a function as a jit-traced program body
+JIT_WRAPPERS = ("jit", "pjit")
+
+#: the one module allowed to construct non-daemon threads (it owns the
+#: watchdog/join discipline the rest of the tree delegates to)
+THREAD_OWNER_PATH = "variantcalling_tpu/parallel/pipeline.py"
+
+#: paths whose state mutations are sanctioned by DESIGN rather than by a
+#: lock: the obs metrics registry keeps one cell per recording thread
+#: (dict item assignment is atomic under the GIL) and merges at snapshot
+PER_THREAD_CELL_PATHS = ("variantcalling_tpu/obs/metrics.py",)
+
+#: constructor spellings of the sanctioned cross-thread handoff objects
+#: (queue.Queue / queue.SimpleQueue / queue.LifoQueue): mutating one of
+#: these from a worker IS the handoff, not a race
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+#: method names that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add", "update",
+             "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+             "clear", "sort", "reverse"}
+
+#: constructor spellings of lock-like objects
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def _call_name(func: ast.expr) -> str:
+    """Last identifier of a call target (``a.b.c`` -> ``c``)."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _dotted(expr: ast.expr) -> str | None:
+    """``a.b.c`` attribute chains as a dotted string, else None."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def collect_aliases(tree: ast.AST) -> tuple[dict[str, set[str]],
+                                            dict[str, list[ast.Lambda]]]:
+    """Simple name-alias and named-lambda tables for one module.
+
+    ``aliases[name]`` is every Name source ``name`` was assigned from
+    (conditional rebinds collect every source — erring toward scanning
+    too much); ``named_lambdas[name]`` is every lambda bound to ``name``.
+    This is VCT009's PR-8 alias machinery, hoisted here so every checker
+    and the project index share one resolution."""
+    aliases: dict[str, set[str]] = {}
+    named_lambdas: dict[str, list[ast.Lambda]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Name):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    aliases.setdefault(t.id, set()).add(n.value.id)
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    named_lambdas.setdefault(t.id, []).append(n.value)
+        elif isinstance(n, ast.AnnAssign) and isinstance(n.value, ast.Name) \
+                and isinstance(n.target, ast.Name):
+            aliases.setdefault(n.target.id, set()).add(n.value.id)
+    return aliases, named_lambdas
+
+
+def resolve_alias_closure(names: set[str], aliases: dict[str, set[str]],
+                          named_lambdas: dict[str, list[ast.Lambda]] | None = None
+                          ) -> tuple[set[str], list[ast.Lambda]]:
+    """Expand ``names`` through the alias graph transitively; collect any
+    lambdas reachable under an aliased name along the way."""
+    out = set(names)
+    lambdas: list[ast.Lambda] = []
+    frontier = list(names)
+    while frontier:
+        name = frontier.pop()
+        if named_lambdas:
+            lambdas.extend(named_lambdas.get(name, ()))
+        for src in aliases.get(name, ()):
+            if src not in out:
+                out.add(src)
+                frontier.append(src)
+    return out, lambdas
+
+
+def installed_bodies(tree: ast.AST, wrappers: tuple[str, ...] = SHARD_MAP_WRAPPERS
+                     ) -> tuple[set[str], list[ast.Lambda]]:
+    """Names (alias-resolved) and inline lambdas installed as the first
+    argument of any ``wrappers`` call in one module — the body-collection
+    pass VCT009 and the project index share."""
+    aliases, named_lambdas = collect_aliases(tree)
+    body_names: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call) and n.args):
+            continue
+        if _call_name(n.func) not in wrappers:
+            continue
+        first = n.args[0]
+        if isinstance(first, ast.Name):
+            body_names.add(first.id)
+        elif isinstance(first, ast.Lambda):
+            lambdas.append(first)
+    resolved, alias_lambdas = resolve_alias_closure(body_names, aliases,
+                                                    named_lambdas)
+    return resolved, lambdas + alias_lambdas
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method/lambda in the index."""
+
+    module: str  # module path (posix, repo-relative)
+    qualname: str  # dotted within the module ("Cls.m", "outer.inner")
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    cls: str | None = None  # enclosing class name, if a method
+    calls: set[tuple[str, str]] = field(default_factory=set)  # resolved (module, qualname)
+    call_names: set[str] = field(default_factory=set)  # unresolved bare names
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.module, self.qualname)
+
+
+@dataclass
+class EntrySite:
+    """Where a function was installed as a thread entry / traced body."""
+
+    module: str  # module containing the INSTALL site
+    line: int
+    kind: str  # "thread" | "submit" | "imap" | "stage" | "shard_map" | "jit"
+
+
+@dataclass
+class ModuleInfo:
+    """Per-module slice of the index."""
+
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    #: local name -> dotted module ("forest_mod" -> "variantcalling_tpu.models.forest")
+    imports: dict[str, str] = field(default_factory=dict)
+    #: local name -> (dotted module, original name) for from-imports
+    from_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    aliases: dict[str, set[str]] = field(default_factory=dict)
+    named_lambdas: dict[str, list[ast.Lambda]] = field(default_factory=dict)
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    #: module-level mutable-state bindings: name -> ctor call name ("" unknown)
+    module_state: dict[str, str] = field(default_factory=dict)
+    #: class-level mutable-state bindings: "Cls.attr" -> ctor call name.
+    #: One dict per class OBJECT, shared by every instance — mutations
+    #: through ``Cls.attr`` / ``cls.attr`` / ``self.attr`` all land on it.
+    class_state: dict[str, str] = field(default_factory=dict)
+    #: module-level names bound to lock constructors
+    module_locks: set[str] = field(default_factory=set)
+    #: module-level names bound to queue constructors
+    module_queues: set[str] = field(default_factory=set)
+
+
+#: "lock" as a WORD in an identifier, any convention: lock/_lock/rlock/
+#: state_lock (snake), Lock/RLock/stateLock (camel), LOCK/_MESH_LOCK
+#: (caps). A bare substring test sanctioned `with self.clock:` and
+#: `with blocker:` as lock spans — phantom locks that both hide real
+#: races (rule 1) and manufacture lock-order findings (rule 3).
+_LOCKISH_RE = re.compile(
+    r"(?:^|_)r?lock(?:$|_|\d)|R?Lock|(?:^|_)R?LOCK(?:$|_|\d)")
+
+
+def _is_lockish(name: str) -> bool:
+    return bool(_LOCKISH_RE.search(name))
+
+
+def _walk_own_scope(root: ast.AST):
+    """Walk ``root``'s body WITHOUT descending into nested def scopes:
+    nested functions carry their own index keys and are scanned under
+    them (with their own lock spans and the caller-holds-the-lock
+    exemption) — scanning their bodies from the enclosing function both
+    double-reports and misses locks held around the nested call site.
+    Lambdas are NOT skipped: they have no index key of their own."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _branch_bodies(stmt: ast.stmt) -> list[list[ast.stmt]]:
+    """Every statement list a compound statement can hide a def in:
+    if/else, try/except/else/finally, with, and loop bodies."""
+    out: list[list[ast.stmt]] = []
+    for attr in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, attr, None)
+        if sub:
+            out.append(sub)
+    for handler in getattr(stmt, "handlers", []) or []:
+        if handler.body:
+            out.append(handler.body)
+    for case in getattr(stmt, "cases", []) or []:
+        if case.body:
+            out.append(case.body)
+    return out
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name of a repo-relative .py path."""
+    p = path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class ProjectIndex:
+    """The one-pass whole-program index (see module docstring).
+
+    Build with :meth:`build` from ``{path: source}``; every structure is
+    computed eagerly in one walk per module, except the concurrency
+    analysis (:meth:`concurrency_findings`) which runs lazily once and
+    is cached — checkers for N files share one analysis.
+    """
+
+    def __init__(self):
+        self.modules: dict[str, ModuleInfo] = {}  # by path
+        self._by_modname: dict[str, str] = {}  # dotted module -> path
+        #: thread-entry functions: key -> install sites
+        self.thread_entries: dict[tuple[str, str], list[EntrySite]] = {}
+        #: traced-body functions (shard_map/shard_program/jit): key -> sites
+        self.traced_bodies: dict[tuple[str, str], list[EntrySite]] = {}
+        #: lambdas installed as thread entries / traced bodies, per module
+        self.entry_lambdas: dict[str, list[tuple[ast.Lambda, EntrySite]]] = {}
+        self._concurrency: list | None = None
+        self._reachable: set[tuple[str, str]] | None = None
+        self._call_ctx: tuple[set, set] | None = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, sources: dict[str, str]) -> "ProjectIndex":
+        idx = cls()
+        parsed: dict[str, ast.Module] = {}
+        for path, source in sources.items():
+            norm = path.replace(os.sep, "/")
+            try:
+                parsed[norm] = ast.parse(source, filename=norm)
+            except SyntaxError:
+                continue  # lint_source reports VCT000 for it
+        for norm, tree in parsed.items():
+            idx._index_module(norm, tree, sources.get(norm, ""))
+        for norm in parsed:
+            idx._collect_entries(norm)
+        for info in idx.modules.values():
+            for fn in info.functions.values():
+                idx._resolve_calls(info, fn)
+        return idx
+
+    @classmethod
+    def build_single(cls, path: str, tree: ast.Module,
+                     lines: list[str]) -> "ProjectIndex":
+        """A throwaway one-module index (snippet mode for VCT010)."""
+        idx = cls()
+        idx._index_module(path.replace(os.sep, "/"), tree, "\n".join(lines))
+        idx._collect_entries(path.replace(os.sep, "/"))
+        for info in idx.modules.values():
+            for fn in info.functions.values():
+                idx._resolve_calls(info, fn)
+        return idx
+
+    def _index_module(self, path: str, tree: ast.Module, source: str) -> None:
+        info = ModuleInfo(path=path, tree=tree, lines=source.splitlines())
+        self.modules[path] = info
+        self._by_modname[module_name_for(path)] = path
+        info.aliases, info.named_lambdas = collect_aliases(tree)
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Import):
+                for alias in n.names:
+                    if alias.asname:
+                        info.imports[alias.asname] = alias.name
+                    else:
+                        # `import a.b.c` binds only `a` — map the first
+                        # segment to ITSELF (references spell the full
+                        # dotted path, resolved by longest-module-prefix
+                        # in resolve_name). Mapping `a` to the full
+                        # dotted module would misresolve `a.b.c.fn` and
+                        # let two imports sharing a first segment
+                        # clobber each other.
+                        head = alias.name.split(".")[0]
+                        info.imports[head] = head
+            elif isinstance(n, ast.ImportFrom) and n.module and n.level == 0:
+                for alias in n.names:
+                    info.from_imports[alias.asname or alias.name] = \
+                        (n.module, alias.name)
+        # module-level state/lock/queue bindings — through every branch
+        # shape, like defs: the native-fallback idiom binds `_CACHE = {}`
+        # (or the lock guarding it) inside `except ImportError:` blocks
+        self._collect_module_bindings(info, tree.body)
+        # class-level state bindings (``class Stats: counts = {}``): one
+        # dict per class OBJECT — shared state exactly like a module
+        # global, whichever spelling (Cls.attr / cls.attr / self.attr)
+        # the mutation uses
+        self._collect_class_state(info, tree.body, prefix="")
+        # functions (incl. nested + methods), by qualname
+        self._walk_functions(info, tree.body, prefix="", cls=None)
+
+    def _collect_module_bindings(self, info: ModuleInfo,
+                                 body: list[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # function locals / class attrs are not module state
+            targets: list[ast.expr] = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                ctor = _call_name(value.func) if isinstance(value, ast.Call) else ""
+                if ctor in _LOCK_CTORS:
+                    info.module_locks.add(t.id)
+                elif ctor in _QUEUE_CTORS:
+                    info.module_queues.add(t.id)
+                elif isinstance(value, (ast.Dict, ast.List, ast.Set, ast.Call)):
+                    info.module_state[t.id] = ctor
+            for sub in _branch_bodies(stmt):
+                self._collect_module_bindings(info, sub)
+
+    def _collect_class_state(self, info: ModuleInfo, body: list[ast.stmt],
+                             prefix: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                qual = f"{prefix}{stmt.name}"
+                for cs in stmt.body:
+                    targets: list[ast.expr] = []
+                    value = None
+                    if isinstance(cs, ast.Assign):
+                        targets, value = cs.targets, cs.value
+                    elif isinstance(cs, ast.AnnAssign) and cs.value is not None:
+                        targets, value = [cs.target], cs.value
+                    for t in targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        ctor = _call_name(value.func) \
+                            if isinstance(value, ast.Call) else ""
+                        if ctor in _LOCK_CTORS or ctor in _QUEUE_CTORS:
+                            continue
+                        if isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                              ast.Call)):
+                            info.class_state[f"{qual}.{t.id}"] = ctor
+                self._collect_class_state(info, stmt.body, prefix=f"{qual}.")
+            else:
+                for sub in _branch_bodies(stmt):
+                    self._collect_class_state(info, sub, prefix)
+
+    def _walk_functions(self, info: ModuleInfo, body: list[ast.stmt],
+                        prefix: str, cls: str | None) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                fi = FunctionInfo(module=info.path, qualname=qual,
+                                  node=stmt, cls=cls)
+                info.functions[qual] = fi
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call):
+                        name = _call_name(n.func)
+                        if name:
+                            fi.call_names.add(name)
+                self._walk_functions(info, stmt.body, prefix=f"{qual}.",
+                                     cls=cls)
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk_functions(info, stmt.body,
+                                     prefix=f"{prefix}{stmt.name}.",
+                                     cls=f"{prefix}{stmt.name}")
+            else:
+                # EVERY branch a def can hide in: if/else, try/except/
+                # else/finally, with, loop bodies — the repo's own
+                # native-fallback idiom defines functions in `except
+                # ImportError:` handlers, and a function the index
+                # cannot see is a function no checker scans
+                for sub in _branch_bodies(stmt):
+                    self._walk_functions(info, sub, prefix, cls)
+
+    # -- name resolution ---------------------------------------------------
+
+    def resolve_name(self, module_path: str, name: str,
+                     scope: str = "",
+                     _seen: frozenset = frozenset()) -> tuple[str, str] | None:
+        """Resolve a bare or dotted name in ``module_path`` to a function
+        key ``(module, qualname)``, following from-imports and simple
+        aliases across modules. ``scope`` is the dotted qualname of the
+        enclosing function/class at the reference site (nested siblings
+        resolve through it); a bare name that matches exactly one
+        function's last segment resolves to it as a final fallback —
+        erring toward finding the definition."""
+        if (module_path, name) in _seen:
+            return None
+        _seen = _seen | {(module_path, name)}
+        info = self.modules.get(module_path)
+        if info is None:
+            return None
+        if "." in name:
+            head, rest = name.split(".", 1)
+            target_mod = info.imports.get(head)
+            if target_mod is None and head in info.from_imports:
+                src_mod, orig = info.from_imports[head]
+                target_mod = f"{src_mod}.{orig}"
+            if target_mod is not None:
+                # longest-module-prefix resolution: `a.b.c.fn` through
+                # `import a.b.c` must land in module a.b.c, not in
+                # whatever module the first segment alone names
+                got = self._resolve_absolute(f"{target_mod}.{rest}", _seen)
+                if got is not None:
+                    return got
+            # Cls.method within this module
+            if name in info.functions:
+                return (module_path, name)
+            return None
+        # enclosing scopes, innermost first: outer.inner sees its siblings
+        parts = scope.split(".") if scope else []
+        for i in range(len(parts), -1, -1):
+            cand = ".".join(parts[:i] + [name])
+            if cand in info.functions:
+                return (module_path, cand)
+        if name in info.from_imports:
+            src_mod, orig = info.from_imports[name]
+            tpath = self._by_modname.get(src_mod)
+            if tpath is not None:
+                return self.resolve_name(tpath, orig, _seen=_seen)
+        for src in self.modules[module_path].aliases.get(name, ()):
+            got = self.resolve_name(module_path, src, scope, _seen)
+            if got is not None:
+                return got
+        # last resort: a unique last-segment match in this module
+        hits = [q for q in info.functions
+                if q.rsplit(".", 1)[-1] == name]
+        if len(hits) == 1:
+            return (module_path, hits[0])
+        return None
+
+    def _resolve_absolute(self, dotted: str,
+                          _seen: frozenset = frozenset()
+                          ) -> tuple[str, str] | None:
+        """Resolve an ABSOLUTE dotted reference (module path + qualname)
+        by matching the longest indexed module prefix, then resolving
+        the remainder inside that module."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            tpath = self._by_modname.get(".".join(parts[:i]))
+            if tpath is not None:
+                return self.resolve_name(tpath, ".".join(parts[i:]),
+                                         _seen=_seen)
+        return None
+
+    def _resolve_calls(self, info: ModuleInfo, fn: FunctionInfo) -> None:
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            func = n.func
+            if isinstance(func, ast.Name):
+                got = self.resolve_name(info.path, func.id,
+                                        scope=fn.qualname)
+                if got is not None:
+                    fn.calls.add(got)
+            elif isinstance(func, ast.Attribute):
+                owner = func.value
+                if isinstance(owner, ast.Name) and owner.id in ("self", "cls") \
+                        and fn.cls is not None:
+                    cand = f"{fn.cls}.{func.attr}"
+                    if cand in info.functions:
+                        fn.calls.add((info.path, cand))
+                    continue
+                dotted = _dotted(func)
+                got = None
+                if dotted is not None:
+                    got = self.resolve_name(info.path, dotted,
+                                            scope=fn.qualname)
+                if got is None:
+                    # instance-method dispatch on a local object: resolve
+                    # through the method name when exactly ONE class in
+                    # the whole project defines it (``ctx.score_table``
+                    # -> FilterContext.score_table). Over-approximates —
+                    # reachability would rather scan too much.
+                    got = self._unique_method(func.attr)
+                if got is not None:
+                    fn.calls.add(got)
+
+    def _unique_method(self, name: str) -> tuple[str, str] | None:
+        """The one (module, qualname) method named ``name`` in the whole
+        project, or None when absent/ambiguous (cached)."""
+        cache = getattr(self, "_method_cache", None)
+        if cache is None:
+            cache = {}
+            for path, info in self.modules.items():
+                for qual, fi in info.functions.items():
+                    if fi.cls is None:
+                        continue
+                    short = qual.rsplit(".", 1)[-1]
+                    cache.setdefault(short, []).append((path, qual))
+            self._method_cache = cache
+        hits = cache.get(name, [])
+        return hits[0] if len(hits) == 1 else None
+
+    # -- entry registries --------------------------------------------------
+
+    def _register(self, table: dict, module_path: str, name_or_lambda,
+                  site: EntrySite, scope: str, cls: str | None) -> None:
+        if isinstance(name_or_lambda, ast.Lambda):
+            self.entry_lambdas.setdefault(module_path, []).append(
+                (name_or_lambda, site))
+            return
+        name = name_or_lambda
+        # self.method / cls.method installed as a callable
+        if cls is not None and (name.startswith("self.")
+                                or name.startswith("cls.")):
+            cand = f"{cls}.{name.split('.', 1)[1]}"
+            if cand in self.modules[module_path].functions:
+                table.setdefault((module_path, cand), []).append(site)
+                return
+        resolved, lambdas = resolve_alias_closure(
+            {name}, self.modules[module_path].aliases,
+            self.modules[module_path].named_lambdas)
+        for lam in lambdas:
+            self.entry_lambdas.setdefault(module_path, []).append((lam, site))
+        for nm in resolved:
+            got = self.resolve_name(module_path, nm, scope=scope)
+            if got is not None:
+                table.setdefault(got, []).append(site)
+
+    def _collect_entries(self, module_path: str) -> None:
+        info = self.modules[module_path]
+
+        def walk(node: ast.AST, scope: str, cls: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    inner = f"{scope}.{child.name}" if scope else child.name
+                    walk(child, inner, cls)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    inner = f"{scope}.{child.name}" if scope else child.name
+                    walk(child, inner, inner)
+                    continue
+                if isinstance(child, ast.Call):
+                    self._entry_call(info, child, scope, cls)
+                walk(child, scope, cls)
+
+        walk(info.tree, "", None)
+
+    def _entry_call(self, info: ModuleInfo, n: ast.Call, scope: str,
+                    cls: str | None) -> None:
+        module_path = info.path
+        fname = _call_name(n.func)
+        line = getattr(n, "lineno", 1)
+        # threading.Thread(target=fn)
+        if fname == "Thread":
+            for kw in n.keywords:
+                if kw.arg == "target":
+                    tgt = self._callable_ref(kw.value)
+                    if tgt is not None:
+                        self._register(
+                            self.thread_entries, module_path, tgt,
+                            EntrySite(module_path, line, "thread"),
+                            scope, cls)
+        # <pool>.submit(fn, ...)
+        elif fname == "submit" and isinstance(n.func, ast.Attribute) \
+                and n.args:
+            tgt = self._callable_ref(n.args[0])
+            if tgt is not None:
+                self._register(self.thread_entries, module_path, tgt,
+                               EntrySite(module_path, line, "submit"),
+                               scope, cls)
+        # imap_ordered(pool, fn, items, ...)
+        elif fname == "imap_ordered" and len(n.args) >= 2:
+            tgt = self._callable_ref(n.args[1])
+            if tgt is not None:
+                self._register(self.thread_entries, module_path, tgt,
+                               EntrySite(module_path, line, "imap"),
+                               scope, cls)
+        # StagePipeline([f, g], ...) / run_pipeline(src, [f, g])
+        elif fname in ("StagePipeline", "run_pipeline") and n.args:
+            arg = n.args[0] if fname == "StagePipeline" else \
+                (n.args[1] if len(n.args) > 1 else None)
+            for tgt in self._stage_list_refs(info, n, arg):
+                self._register(self.thread_entries, module_path, tgt,
+                               EntrySite(module_path, line, "stage"),
+                               scope, cls)
+        # shard_map(fn, ...) / shard_program(fn, ...) / jax.jit(fn)
+        elif fname in SHARD_MAP_WRAPPERS and n.args:
+            tgt = self._callable_ref(n.args[0])
+            if tgt is not None:
+                self._register(self.traced_bodies, module_path, tgt,
+                               EntrySite(module_path, line, "shard_map"),
+                               scope, cls)
+        elif fname in JIT_WRAPPERS and n.args:
+            tgt = self._callable_ref(n.args[0])
+            if tgt is not None:
+                self._register(self.traced_bodies, module_path, tgt,
+                               EntrySite(module_path, line, "jit"),
+                               scope, cls)
+
+    @staticmethod
+    def _callable_ref(expr: ast.expr):
+        """A Name string, dotted string, or Lambda node — else None."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            return expr.id
+        dotted = _dotted(expr)
+        return dotted
+
+    def _stage_list_refs(self, info: ModuleInfo, call: ast.Call,
+                         arg: ast.expr | None) -> list:
+        """Callable refs inside a stage-list argument: a list literal of
+        names, or a name whose local assignments/appends build one."""
+        refs: list = []
+
+        def harvest(elts):
+            for e in elts:
+                tgt = self._callable_ref(e)
+                if tgt is not None:
+                    refs.append(tgt)
+
+        if isinstance(arg, (ast.List, ast.Tuple)):
+            harvest(arg.elts)
+        elif isinstance(arg, ast.Name):
+            # scan the whole module for `<name> = [...]` and
+            # `<name>.append(fn)` — over-approximates across scopes,
+            # erring toward scanning too much
+            for n in ast.walk(info.tree):
+                if isinstance(n, ast.Assign) and isinstance(n.value, (ast.List, ast.Tuple)) \
+                        and any(isinstance(t, ast.Name) and t.id == arg.id
+                                for t in n.targets):
+                    harvest(n.value.elts)
+                elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in ("append", "insert", "extend") \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == arg.id and n.args:
+                    harvest(n.args[-1:])
+        return refs
+
+    # -- reachability ------------------------------------------------------
+
+    def thread_reachable(self) -> set[tuple[str, str]]:
+        """Function keys reachable from any thread-entry point over the
+        resolved call graph (the entry points themselves included)."""
+        if self._reachable is not None:
+            return self._reachable
+        seen: set[tuple[str, str]] = set()
+        frontier = list(self.thread_entries)
+        # calls made INSIDE entry lambdas reach their targets too:
+        # ``pool.submit(lambda: poke(x))`` runs poke on a worker exactly
+        # like ``pool.submit(poke, x)`` does
+        for path, lams in self.entry_lambdas.items():
+            info = self.modules[path]
+            for lam, site in lams:
+                if site.kind in ("shard_map", "jit"):
+                    continue  # traced bodies are not thread entries
+                pseudo = FunctionInfo(module=path, qualname="<lambda>",
+                                      node=lam)
+                self._resolve_calls(info, pseudo)
+                frontier.extend(pseudo.calls)
+        while frontier:
+            key = frontier.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            info = self.modules.get(key[0])
+            fn = info.functions.get(key[1]) if info else None
+            if fn is None:
+                continue
+            for callee in fn.calls:
+                if callee not in seen:
+                    frontier.append(callee)
+        self._reachable = seen
+        return seen
+
+    def function_key(self, dotted_module: str,
+                     qualname: str) -> tuple[str, str] | None:
+        """The index key of ``qualname`` in ``dotted_module``, or None
+        when that module/function is not part of the linted sources."""
+        path = self._by_modname.get(dotted_module)
+        if path is None:
+            return None
+        return (path, qualname) \
+            if qualname in self.modules[path].functions else None
+
+    def reaches(self, start: tuple[str, str],
+                target: tuple[str, str]) -> bool:
+        """True when ``target`` is reachable from ``start`` over the
+        resolved call graph (``start`` itself included). VCT002 uses this
+        to accept broad-except handlers that route through a helper which
+        transitively calls ``utils.degrade.record`` — a degrade path one
+        call away used to be invisible to the per-file view."""
+        seen: set[tuple[str, str]] = set()
+        frontier = [start]
+        while frontier:
+            key = frontier.pop()
+            if key == target:
+                return True
+            if key in seen:
+                continue
+            seen.add(key)
+            info = self.modules.get(key[0])
+            fn = info.functions.get(key[1]) if info else None
+            if fn is None:
+                continue
+            frontier.extend(c for c in fn.calls if c not in seen)
+        return False
+
+    def pipeline_submitted_tasks(self, module_path: str) -> set[str]:
+        """Qualnames in ``module_path`` registered as thread entries whose
+        INSTALL site lives under ``variantcalling_tpu/pipelines/`` — the
+        pool tasks VCT008 must scan even outside the pipelines layer."""
+        out: set[str] = set()
+        for (mod, qual), sites in self.thread_entries.items():
+            if mod != module_path:
+                continue
+            if any("variantcalling_tpu/pipelines/" in s.module for s in sites):
+                out.add(qual)
+        return out
+
+    def traced_bodies_in(self, module_path: str) -> set[str]:
+        """Qualnames in ``module_path`` installed as shard_map/shard_program
+        bodies anywhere in the project (cross-module installs included)."""
+        return {qual for (mod, qual), sites in self.traced_bodies.items()
+                if mod == module_path
+                and any(s.kind == "shard_map" for s in sites)}
+
+    # -- concurrency analysis (VCT010) -------------------------------------
+
+    def concurrency_findings(self) -> list[tuple[str, int, str]]:
+        """The whole-program VCT010 analysis, cached: returns
+        ``(path, line, message)`` tuples for
+
+        1. module/class state mutated from thread-reachable code without a
+           lock held or a sanctioned handoff (queue objects; the
+           per-thread cells in obs/metrics.py are exempt by design);
+        2. non-daemon ``threading.Thread`` construction outside
+           ``parallel/pipeline.py``;
+        3. statically inconsistent lock acquisition order (two locks taken
+           in both orders anywhere in the thread-reachable graph).
+        """
+        if self._concurrency is not None:
+            return self._concurrency
+        findings: list[tuple[str, int, str]] = []
+        reachable = self.thread_reachable()
+        # rule 1: unlocked shared-state mutation from thread-reachable code
+        locked_callees, unlocked_callees = self._call_contexts()
+        for key in sorted(reachable):
+            info = self.modules.get(key[0])
+            fn = info.functions.get(key[1]) if info else None
+            if fn is None or info.path in PER_THREAD_CELL_PATHS:
+                continue
+            if key not in self.thread_entries \
+                    and key in locked_callees \
+                    and key not in unlocked_callees:
+                # caller-holds-the-lock: every known call site sits
+                # inside a lock span (and the function is not itself
+                # handed to a pool/thread), so its mutations are
+                # lock-protected by its callers
+                continue
+            findings.extend(self._scan_mutations(info, fn))
+        for path, lams in self.entry_lambdas.items():
+            info = self.modules[path]
+            if info.path in PER_THREAD_CELL_PATHS:
+                continue
+            for lam, site in lams:
+                if site.kind in ("shard_map", "jit"):
+                    # traced bodies run on the MAIN thread (VCT004 owns
+                    # host effects inside them) — calling them
+                    # thread-reachable is a false positive
+                    continue
+                pseudo = FunctionInfo(module=path, qualname="<lambda>",
+                                      node=lam)
+                findings.extend(self._scan_mutations(info, pseudo))
+        # rule 2: non-daemon thread construction outside the owner module.
+        # Any import spelling counts (the VCT001/VCT004 convention):
+        # `threading.Thread`, `import threading as th; th.Thread`, and
+        # `from threading import Thread [as T]` must not evade the rule.
+        for path, info in self.modules.items():
+            if path.endswith(THREAD_OWNER_PATH) or path == THREAD_OWNER_PATH:
+                continue
+            thread_names = {local for local, (mod, orig)
+                            in info.from_imports.items()
+                            if mod == "threading" and orig == "Thread"}
+            threading_aliases = {local for local, mod in info.imports.items()
+                                 if mod == "threading"}
+            for n in ast.walk(info.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                is_thread_ctor = (
+                    isinstance(n.func, ast.Name)
+                    and n.func.id in thread_names) or (
+                    isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "Thread"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in threading_aliases)
+                if is_thread_ctor:
+                    daemon = any(kw.arg == "daemon"
+                                 and isinstance(kw.value, ast.Constant)
+                                 and kw.value.value is True
+                                 for kw in n.keywords)
+                    if not daemon:
+                        findings.append((
+                            path, getattr(n, "lineno", 1),
+                            "non-daemon threading.Thread outside "
+                            "parallel/pipeline.py — worker threads are "
+                            "daemons (a wedged native call must not block "
+                            "process exit; docs/streaming_executor.md) or "
+                            "live in the executor module that owns the "
+                            "join/watchdog discipline"))
+        # rule 3: lock-order inversion anywhere in the project
+        findings.extend(self._lock_order_findings())
+        findings.sort()
+        self._concurrency = findings
+        return findings
+
+    # .. rule 1 helpers ....................................................
+
+    def _scan_mutations(self, info: ModuleInfo,
+                        fn: FunctionInfo) -> list[tuple[str, int, str]]:
+        out: list[tuple[str, int, str]] = []
+        globals_declared: set[str] = set()
+        locals_bound: set[str] = set()
+        node = fn.node
+        args = node.args if hasattr(node, "args") else None
+        if args is not None:
+            for a in list(args.args) + list(args.posonlyargs) + list(args.kwonlyargs):
+                locals_bound.add(a.arg)
+            if args.vararg:
+                locals_bound.add(args.vararg.arg)
+            if args.kwarg:
+                locals_bound.add(args.kwarg.arg)
+        for n in _walk_own_scope(node):
+            if isinstance(n, ast.Global):
+                globals_declared.update(n.names)
+            elif isinstance(n, ast.Assign):
+                stack_t = list(n.targets)
+                while stack_t:
+                    t = stack_t.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        stack_t.extend(t.elts)
+                    elif isinstance(t, ast.Name):
+                        locals_bound.add(t.id)
+            elif isinstance(n, (ast.For, ast.comprehension)):
+                stack_t = [n.target]
+                while stack_t:
+                    t = stack_t.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        stack_t.extend(t.elts)
+                    elif isinstance(t, ast.Name):
+                        locals_bound.add(t.id)
+
+        def is_module_state(name: str) -> bool:
+            if name in globals_declared:
+                return True
+            if name in locals_bound:
+                return False
+            return name in info.module_state
+
+        def owner_name(expr: ast.expr) -> str | None:
+            """The base identifier a mutation lands on, when it is module
+            or imported-module state; None when local/unknown."""
+            if isinstance(expr, ast.Name):
+                return expr.id if is_module_state(expr.id) else None
+            if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+                base = expr.value.id
+                if base in ("self", "cls"):
+                    # class-declared attrs live on the class OBJECT —
+                    # shared across instances and threads no matter the
+                    # spelling. Plain per-instance attrs (bound in
+                    # __init__) are out of scope: they are usually
+                    # thread-confined, and flagging every self.x write
+                    # would bury the real shared-state findings.
+                    cand = f"{fn.cls}.{expr.attr}" if fn.cls else None
+                    return cand if cand in info.class_state else None
+                if base in locals_bound:
+                    return None
+                if f"{base}.{expr.attr}" in info.class_state:
+                    return f"{base}.{expr.attr}"
+                if base in info.imports or base in info.from_imports:
+                    return f"{base}.{expr.attr}"
+                if is_module_state(base):
+                    return f"{base}.{expr.attr}"
+            return None
+
+        held = self._lock_spans(info, node, fn.cls)
+
+        def locked(line: int) -> bool:
+            return any(lo <= line <= hi for lo, hi, _ in held)
+
+        def sanctioned(name: str) -> bool:
+            base = name.split(".")[0]
+            return base in info.module_queues
+
+        for n in _walk_own_scope(node):
+            line = getattr(n, "lineno", 0)
+            hit: str | None = None
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.Delete)):
+                if isinstance(n, ast.Assign):
+                    targets = n.targets
+                elif isinstance(n, ast.AugAssign):
+                    targets = [n.target]
+                else:
+                    # `del _CACHE[x]` is eviction — the same mutation
+                    # .pop() spells (the _PREDICTOR_CACHE race class)
+                    targets = n.targets
+                # descend into tuple/list unpacking targets
+                flat: list[ast.expr] = []
+                stack_t = list(targets)
+                while stack_t:
+                    t = stack_t.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        stack_t.extend(t.elts)
+                    else:
+                        flat.append(t)
+                for t in flat:
+                    if isinstance(t, ast.Subscript):
+                        hit = owner_name(t.value)
+                    elif isinstance(t, ast.Attribute):
+                        # mod.attr = ... on an imported module or module
+                        # object is module-state mutation
+                        hit = owner_name(t)
+                    elif isinstance(t, ast.Name) and isinstance(n, ast.Assign) \
+                            and t.id in globals_declared:
+                        hit = t.id
+                    elif isinstance(t, ast.Name) and isinstance(n, ast.AugAssign) \
+                            and is_module_state(t.id):
+                        hit = t.id
+                    if hit:
+                        break
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in _MUTATORS:
+                recv = n.func.value
+                if isinstance(recv, ast.Name):
+                    # plain-Name receivers: ``STATE.append(x)`` is a
+                    # mutation, ``np.char.add(a, b)`` is a pure library
+                    # call (filtered by owner_name)
+                    hit = owner_name(recv)
+                elif isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name):
+                    # dotted receivers only when the attr is DECLARED
+                    # class state (``Stats.counts.append`` /
+                    # ``self.counts.append``) — anything else dotted is
+                    # indistinguishable from a pure library call
+                    base = recv.value.id
+                    cand = f"{fn.cls}.{recv.attr}" \
+                        if base in ("self", "cls") and fn.cls \
+                        else f"{base}.{recv.attr}"
+                    if cand in info.class_state:
+                        hit = cand
+            if hit and not locked(line) and not sanctioned(hit):
+                out.append((
+                    info.path, line,
+                    f"shared state {hit!r} mutated from thread-reachable "
+                    f"code ({fn.qualname}, reached via "
+                    f"{self._entry_kinds(fn.key)}) without a lock — hold "
+                    "the owning lock, hand off through queue.Queue/"
+                    "imap_ordered, or keep per-thread cells "
+                    "(obs/metrics.py pattern)"))
+        return out
+
+    def _entry_kinds(self, key: tuple[str, str]) -> str:
+        kinds = {s.kind for s in self.thread_entries.get(key, [])}
+        return "/".join(sorted(kinds)) if kinds else "the thread pool"
+
+    def _lock_spans(self, info: ModuleInfo, node: ast.AST,
+                    cls: str | None = None) -> list[tuple[int, int, str]]:
+        """(first line, last line, lock id) of every with-block over a
+        lock-like object inside ``node``."""
+        spans: list[tuple[int, int, str]] = []
+        for n in ast.walk(node):
+            if not isinstance(n, (ast.With, ast.AsyncWith)):
+                continue
+            for item in n.items:
+                lock = self._lock_id(info, item.context_expr, cls)
+                if lock is not None:
+                    spans.append((n.lineno,
+                                  getattr(n, "end_lineno", n.lineno), lock))
+        return spans
+
+    def _lock_id(self, info: ModuleInfo, expr: ast.expr,
+                 cls: str | None = None) -> str | None:
+        """A stable identity for a lock expression, or None when the
+        expression is not lock-like. Heuristics: module-level names bound
+        to Lock()/RLock()..., ``self``/``cls`` attributes or bare names
+        whose spelling contains "lock". Identities are SCOPED — module
+        path for module locks and bare names, enclosing class for
+        ``self.`` attributes, owner module for locks reached through an
+        import — so two unrelated classes' conventionally-named
+        ``self.state_lock`` never collide into one identity (a
+        cross-class collision manufactures lock-order inversions
+        between locks that can never deadlock each other)."""
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in info.module_locks:
+                return f"{info.path}:{name}"
+            if name in info.from_imports:
+                # `from a import _LOCK` must unify with module a's own
+                # `with _LOCK:` identity, exactly like the `a._LOCK`
+                # attribute spelling below — otherwise a cross-module
+                # inversion through the from-import spelling never
+                # matches its other leg
+                src_mod, orig = info.from_imports[name]
+                tpath = self._by_modname.get(src_mod)
+                if tpath is not None:
+                    owner = self.modules[tpath]
+                    if orig in owner.module_locks or _is_lockish(orig):
+                        return f"{tpath}:{orig}"
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                if _is_lockish(name):
+                    return f"{info.path}:{cls or '<anon>'}.self.{name}"
+                return None
+            if _is_lockish(name):
+                # a lock reached through an import unifies with the
+                # owner module's identity (`mod._LOCK` == that module's
+                # `with _LOCK:`); otherwise scope the dotted chain to
+                # this module
+                if isinstance(base, ast.Name):
+                    mod = info.imports.get(base.id)
+                    if mod is None and base.id in info.from_imports:
+                        src, orig = info.from_imports[base.id]
+                        mod = f"{src}.{orig}"
+                    tpath = self._by_modname.get(mod) if mod else None
+                    if tpath is not None:
+                        return f"{tpath}:{name}"
+                dotted = _dotted(expr)
+                if dotted is not None:
+                    return f"{info.path}:{dotted}"
+        if name is not None and _is_lockish(name):
+            return f"{info.path}:{name}"
+        return None
+
+    # .. rule 3: lock-order ................................................
+
+    def _direct_lock_pairs(self, info: ModuleInfo, fn: FunctionInfo
+                           ) -> tuple[list[tuple[str, str, int]],
+                                      list[tuple[str, int, tuple[str, str]]]]:
+        """(ordered lock pairs taken nested in this function,
+        (held lock, line, callee) for calls made under a lock)."""
+        pairs: list[tuple[str, str, int]] = []
+        held_calls: list[tuple[str, int, tuple[str, str]]] = []
+
+        def walk(node: ast.AST, held: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)) and child is not fn.node:
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    locks = [self._lock_id(info, it.context_expr,
+                                           fn.cls)
+                             for it in child.items]
+                    locks = [x for x in locks if x is not None]
+                    for outer in held:
+                        for inner in locks:
+                            if outer != inner:
+                                pairs.append((outer, inner, child.lineno))
+                    # ``with A, B:`` acquires left-to-right — the items
+                    # of ONE With statement are ordered pairs exactly
+                    # like nested With statements are
+                    for i, outer in enumerate(locks):
+                        for inner in locks[i + 1:]:
+                            if outer != inner:
+                                pairs.append((outer, inner, child.lineno))
+                    walk(child, held + locks)
+                    continue
+                if isinstance(child, ast.Call) and held:
+                    got = self._call_target(info, fn, child)
+                    if got is not None:
+                        for lock in held:
+                            held_calls.append((lock, child.lineno, got))
+                walk(child, held)
+
+        walk(fn.node, [])
+        return pairs, held_calls
+
+    def _call_target(self, info: ModuleInfo, fn: FunctionInfo,
+                     call: ast.Call) -> tuple[str, str] | None:
+        """Resolve one call expression to a function key (Name through
+        the module tables, ``self.``/``cls.`` through the enclosing
+        class, dotted chains through imports) — the shared resolution of
+        the lock-order and call-context passes."""
+        name = _call_name(call.func)
+        if isinstance(call.func, ast.Name):
+            return self.resolve_name(info.path, name)
+        if isinstance(call.func, ast.Attribute):
+            owner = call.func.value
+            if isinstance(owner, ast.Name) and owner.id in ("self", "cls") \
+                    and fn.cls is not None:
+                cand = f"{fn.cls}.{name}"
+                if cand in info.functions:
+                    return (info.path, cand)
+                return None
+            dotted = _dotted(call.func)
+            if dotted is not None:
+                return self.resolve_name(info.path, dotted)
+        return None
+
+    def _call_contexts(self) -> tuple[set[tuple[str, str]],
+                                      set[tuple[str, str]]]:
+        """(callees with >=1 call site under a lock, callees with >=1
+        call site NOT under a lock), over every function in the project
+        (cached). Rule 1 uses this to accept the caller-holds-the-lock
+        pattern: a helper whose EVERY known call site is inside a lock
+        span is protected by its callers — flagging it would punish
+        correct locking the 'hold the owning lock' remediation cannot
+        express."""
+        if self._call_ctx is not None:
+            return self._call_ctx
+        locked: set[tuple[str, str]] = set()
+        unlocked: set[tuple[str, str]] = set()
+        for info in self.modules.values():
+            for fn in info.functions.values():
+
+                def walk(node: ast.AST, held: bool,
+                         info=info, fn=fn) -> None:
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)) \
+                                and child is not fn.node:
+                            continue
+                        now = held
+                        if isinstance(child, (ast.With, ast.AsyncWith)):
+                            if any(self._lock_id(info, it.context_expr,
+                                                 fn.cls)
+                                   is not None for it in child.items):
+                                now = True
+                        if isinstance(child, ast.Call):
+                            got = self._call_target(info, fn, child)
+                            if got is not None:
+                                (locked if held else unlocked).add(got)
+                        walk(child, now)
+
+                walk(fn.node, False)
+        # calls made inside entry lambdas are UNLOCKED call sites by
+        # construction (a lambda body cannot hold a with-block, and the
+        # pool invokes it with no lock held) — without them,
+        # ``pool.submit(lambda: helper(1))`` would leave helper's only
+        # scanned call site lock-protected and wrongly exempt it
+        for path, lams in self.entry_lambdas.items():
+            info = self.modules[path]
+            for lam, site in lams:
+                if site.kind in ("shard_map", "jit"):
+                    continue
+                pseudo = FunctionInfo(module=path, qualname="<lambda>",
+                                      node=lam)
+                self._resolve_calls(info, pseudo)
+                unlocked.update(pseudo.calls)
+        self._call_ctx = (locked, unlocked)
+        return self._call_ctx
+
+    def _transitive_lock_map(self) -> dict[tuple[str, str], set[str]]:
+        """Every lock each function may acquire, transitively.
+
+        Computed as a fixpoint over the whole call graph rather than a
+        recursive memoized walk: recursion has to cut call cycles, and
+        any result memoized while a cycle was cut under-reports locks
+        for every function on the cycle.
+        """
+        acquired: dict[tuple[str, str], set[str]] = {}
+        calls: dict[tuple[str, str], tuple] = {}
+        for _path, info in self.modules.items():
+            for fn in info.functions.values():
+                acquired[fn.key] = {
+                    lock for _lo, _hi, lock in
+                    self._lock_spans(info, fn.node, fn.cls)}
+                calls[fn.key] = tuple(fn.calls)
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in calls.items():
+                acc = acquired[key]
+                before = len(acc)
+                for callee in callees:
+                    got = acquired.get(callee)
+                    if got:
+                        acc |= got
+                if len(acc) != before:
+                    changed = True
+        return acquired
+
+    def _lock_order_findings(self) -> list[tuple[str, int, str]]:
+        # collect ordered pairs: (outer, inner) -> first (path, line)
+        ordered: dict[tuple[str, str], tuple[str, int]] = {}
+        transitive = self._transitive_lock_map()
+        # EVERY function in the project, not just thread-reachable ones:
+        # an inversion between the main thread and a worker is still an
+        # inversion
+        scope: set[tuple[str, str]] = set()
+        for path, info in self.modules.items():
+            for fn in info.functions.values():
+                scope.add(fn.key)
+        for key in sorted(scope):
+            info = self.modules.get(key[0])
+            fn = info.functions.get(key[1]) if info else None
+            if fn is None:
+                continue
+            pairs, held_calls = self._direct_lock_pairs(info, fn)
+            for outer, inner, line in pairs:
+                ordered.setdefault((outer, inner), (info.path, line))
+            for lock, line, callee in held_calls:
+                for inner in transitive.get(callee, ()):
+                    if inner != lock:
+                        ordered.setdefault((lock, inner), (info.path, line))
+        out: list[tuple[str, int, str]] = []
+        seen: set[frozenset] = set()
+        for (a, b), (path, line) in sorted(ordered.items()):
+            if (b, a) in ordered and frozenset((a, b)) not in seen:
+                seen.add(frozenset((a, b)))
+                rpath, rline = ordered[(b, a)]
+                out.append((
+                    path, line,
+                    f"inconsistent lock order: {a!r} then {b!r} here, but "
+                    f"{b!r} then {a!r} at {rpath}:{rline} — two threads "
+                    "taking these in opposite orders deadlock; pick one "
+                    "global order"))
+        return out

@@ -10,6 +10,7 @@ features on device, fused with classifier inference.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import partial
 
@@ -133,6 +134,14 @@ def classify_alleles(table: VariantTable) -> AlleleColumns:
 # of one genome; ~3.1GB HBM each for hg38).
 _DEVICE_GENOME_CACHE: dict = {}
 _DEVICE_GENOME_MAX = 2
+# chunk featurization fans out on the IO pool (vctpu-lint VCT010): a
+# per-KEY build lock makes a cache miss build-once-wait-rest — two
+# workers racing the SAME genome would otherwise both encode and upload
+# ~3.1GB to HBM — while builds of DISTINCT keys (different fasta/radius/
+# sharding) proceed concurrently instead of queueing behind a multi-
+# second upload they do not want. The global lock only guards the dicts.
+_DEVICE_GENOME_LOCK = threading.Lock()
+_DEVICE_GENOME_KEYLOCKS: dict = {}
 # tables below this size featurize through the host window gather — a tiny
 # job must not pay a whole-genome encode + HBM upload
 GENOME_RESIDENT_MIN_VARIANTS = 100_000
@@ -175,6 +184,29 @@ def device_genome(fasta: FastaReader, radius: int = WINDOW_RADIUS,
     hit = _DEVICE_GENOME_CACHE.get(key)
     if hit is not None:
         return hit
+    with _DEVICE_GENOME_LOCK:
+        hit = _DEVICE_GENOME_CACHE.get(key)
+        if hit is not None:
+            return hit
+        # one small Lock per distinct key for the process lifetime —
+        # a handful of genomes, never evicted (evicting one while a
+        # builder holds it would let a third thread double-build)
+        key_lock = _DEVICE_GENOME_KEYLOCKS.setdefault(key, threading.Lock())
+    with key_lock:
+        with _DEVICE_GENOME_LOCK:
+            hit = _DEVICE_GENOME_CACHE.get(key)  # re-check: the builder we waited on
+            if hit is not None:
+                return hit
+        out = _build_device_genome(fasta, radius, sharding)
+        with _DEVICE_GENOME_LOCK:
+            while len(_DEVICE_GENOME_CACHE) >= _DEVICE_GENOME_MAX:
+                _DEVICE_GENOME_CACHE.pop(next(iter(_DEVICE_GENOME_CACHE)))
+            _DEVICE_GENOME_CACHE[key] = out
+    return out
+
+
+def _build_device_genome(fasta: FastaReader, radius: int,
+                         sharding) -> DeviceGenome:
     gap = np.full(2 * radius, 4, dtype=np.uint8)
     parts = [gap]
     offsets: dict[str, int] = {}
@@ -195,10 +227,7 @@ def device_genome(fasta: FastaReader, radius: int = WINDOW_RADIUS,
             flat_arr = np.concatenate([flat_arr, np.full(pad, 4, dtype=np.uint8)])
         flat_arr = flat_arr.reshape(-1, _GBLOCK)
     arr = jax.device_put(flat_arr, sharding) if sharding is not None else jax.device_put(flat_arr)
-    while len(_DEVICE_GENOME_CACHE) >= _DEVICE_GENOME_MAX:
-        _DEVICE_GENOME_CACHE.pop(next(iter(_DEVICE_GENOME_CACHE)))
-    _DEVICE_GENOME_CACHE[key] = out = DeviceGenome(arr, offsets, lengths, use_flat)
-    return out
+    return DeviceGenome(arr, offsets, lengths, use_flat)
 
 
 def globalize_positions(table: VariantTable, genome: DeviceGenome,

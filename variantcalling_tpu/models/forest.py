@@ -682,7 +682,7 @@ def make_margin_predictor(forest: FlatForest, n_features: int | None = None,
                 continue
         if fn is None:
             raise
-    last_strategy = resolved
+    last_strategy = resolved  # vctpu-lint: disable=VCT010 — run-scoped diagnostic; GIL-atomic store, the strategy is pinned per run so every writer agrees
     return fn
 
 

@@ -31,6 +31,7 @@ the PR 5 collectives capability probe.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -64,8 +65,12 @@ class MeshPlan:
 #: per-process mesh cache: (device count) -> Mesh. Meshes are cheap but
 #: NamedSharding/jit caches key on mesh identity — one object per size
 #: keeps every consumer (genome upload, chunk device_put, shard_map
-#: program) on literally the same mesh.
+#: program) on literally the same mesh. The lock (vctpu-lint VCT010)
+#: keeps pool workers racing a cache miss from minting TWO Mesh objects
+#: for one size — distinct identities would silently double every jit
+#: cache entry keyed on the mesh.
 _MESH_CACHE: dict[int, object] = {}
+_MESH_CACHE_LOCK = threading.Lock()
 
 
 def resolve_plan(engine_name: str) -> MeshPlan:
@@ -123,9 +128,12 @@ def mesh_for(plan: MeshPlan):
     if mesh is None:
         from variantcalling_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(n_data=plan.devices, n_model=1,
-                         devices=jax.local_devices()[: plan.devices])
-        _MESH_CACHE[plan.devices] = mesh
+        with _MESH_CACHE_LOCK:
+            mesh = _MESH_CACHE.get(plan.devices)
+            if mesh is None:
+                mesh = make_mesh(n_data=plan.devices, n_model=1,
+                                 devices=jax.local_devices()[: plan.devices])
+                _MESH_CACHE[plan.devices] = mesh
     return mesh
 
 

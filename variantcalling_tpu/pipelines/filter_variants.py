@@ -16,6 +16,7 @@ import argparse
 import os
 import pickle
 import sys
+import threading
 
 import numpy as np
 
@@ -150,10 +151,17 @@ _PREDICTOR_CACHE: dict[tuple, tuple[object, object]] = {}
 _PREDICTOR_CACHE_MAX = 8
 
 
+#: chunk prep/scoring fans out on the IO pool (vctpu-lint VCT010): the
+#: eviction loop's pop-next-iter is NOT atomic — two workers inserting
+#: concurrently could pop the same key (KeyError) or evict past the cap
+_PREDICTOR_CACHE_LOCK = threading.Lock()
+
+
 def _cache_put(key: tuple, value: tuple) -> None:
-    while len(_PREDICTOR_CACHE) >= _PREDICTOR_CACHE_MAX:
-        _PREDICTOR_CACHE.pop(next(iter(_PREDICTOR_CACHE)))
-    _PREDICTOR_CACHE[key] = value
+    with _PREDICTOR_CACHE_LOCK:
+        while len(_PREDICTOR_CACHE) >= _PREDICTOR_CACHE_MAX:
+            _PREDICTOR_CACHE.pop(next(iter(_PREDICTOR_CACHE)))
+        _PREDICTOR_CACHE[key] = value
 
 
 def _strategy_token(strategy: str | None) -> tuple:
@@ -355,7 +363,7 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
         score = nf(x)
     # no XLA program exists on this path — record that for perf evidence
     # (bench distinguishes real jit compile from plain warmup by this)
-    forest_mod.last_strategy = "native-cpp"
+    forest_mod.last_strategy = "native-cpp"  # vctpu-lint: disable=VCT010 — run-scoped diagnostic; GIL-atomic store, every concurrent chunk writes the same value
     return score
 
 
