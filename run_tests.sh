@@ -35,6 +35,20 @@ else
   echo "lint stage: ruff not installed — skipped"
 fi
 
+# -- opt-in chaos smoke stage (docs/robustness.md) -------------------------
+# VCTPU_CHAOS=1: 10 fixed-seed chaos schedules over the streaming filter
+# executor (tools/chaoshunt — fault classes x layouts x fresh/resumed,
+# every invariant checked, violating schedules delta-shrunk to a repro
+# JSON). Bounded (~2 min); the full ≥50-seed campaign is the local
+# pre-merge sweep: python -m tools.chaoshunt --seeds 50.
+if [ "${VCTPU_CHAOS:-0}" != "0" ]; then
+  echo "chaos smoke stage: python -m tools.chaoshunt --seeds 10 --json"
+  env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.chaoshunt --seeds 10 --json || {
+    echo "chaoshunt found an invariant violation — failing before pytest (see the repro JSON above)" >&2
+    exit 1
+  }
+fi
+
 # -- tier-0 jaxpr audit stage (docs/static_analysis.md) --------------------
 # Trace every registered scoring program (forest strategies x
 # shard_program at dp in {1,2} + the coverage reduce kernels) with

@@ -36,6 +36,35 @@ def rng():
     return np.random.default_rng(0)
 
 
+def assert_no_stream_leaks(dirs=(), grace_s: float = 3.0) -> None:
+    """The chaos invariant, enforced on the regular suite (ISSUE 10): no
+    ``vctpu-*``/``pipe-*``/``genome-prefetch`` thread survives a test
+    (pool/worker joins are time-bounded, so a short grace window is
+    legitimate) and no stray ``.partial``/``.journal``/``.quarantine``
+    sidecar is left in the watched fixture directories. The streaming
+    test modules install this as an autouse fixture."""
+    import glob
+    import threading
+    import time
+
+    def leaked():
+        return sorted(
+            t.name for t in threading.enumerate()
+            if t.name.startswith(("vctpu-", "pipe-", "genome-prefetch")))
+
+    deadline = time.time() + grace_s
+    names = leaked()
+    while names and time.time() < deadline:
+        time.sleep(0.05)
+        names = leaked()
+    assert not names, f"leaked executor threads: {names}"
+    strays = []
+    for d in dirs:
+        for pattern in ("*.partial", "*.journal", "*.quarantine"):
+            strays += glob.glob(os.path.join(str(d), pattern))
+    assert not strays, f"stray streaming sidecar files: {strays}"
+
+
 def get_resource_dir(test_file: str) -> pathlib.Path:
     """Map tests/<tier>/<name>.py → tests/resources/<tier>/<name>/ (reference convention, conftest.py:1-9)."""
     p = pathlib.Path(test_file).resolve()

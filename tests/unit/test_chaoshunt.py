@@ -1,0 +1,262 @@
+"""tools/chaoshunt — the seeded chaos campaign harness (ISSUE 10).
+
+Unit layers (schedule drawing, env-grammar rendering, normalization,
+invariant checking, shrink candidates) run without subprocesses; the
+end-to-end layer proves the acceptance criteria on tiny fixtures: a
+clean schedule passes every invariant, and a DELIBERATELY seeded
+regression (a non-atomic commit) is caught and delta-shrunk to a
+minimal repro JSON that replays.
+"""
+
+import json
+import os
+
+import pytest
+
+from tests.conftest import assert_no_stream_leaks
+from tools.chaoshunt import harness
+from variantcalling_tpu.utils import faults
+
+_WATCHED_DIRS: list[str] = []
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel():
+    yield
+    assert_no_stream_leaks(_WATCHED_DIRS)
+
+
+# ---------------------------------------------------------------------------
+# schedule drawing + serialization
+# ---------------------------------------------------------------------------
+
+
+def test_draw_schedule_is_seed_deterministic():
+    for seed in range(20):
+        a, b = harness.draw_schedule(seed), harness.draw_schedule(seed)
+        assert a.to_json() == b.to_json()
+    # the layout matrix cycles: every third seed covers each layout
+    layouts = {harness.draw_schedule(s).layout for s in range(6)}
+    assert layouts == {"serial", "io4", "mesh2"}
+
+
+def test_fault_spec_renders_the_env_grammar():
+    spec = harness.FaultSpec("io.writeback", times=None, after=3)
+    assert spec.spec() == "io.writeback:0+3"
+    spec = harness.FaultSpec("pipeline.stage_hang", times=2, seconds=0.5)
+    assert spec.spec() == "pipeline.stage_hang:2@0.5"
+    # ... and the grammar round-trips through the real parser
+    os.environ["VCTPU_FAULTS"] = "io.writeback:0+3,pipeline.stage_hang:2@0.5"
+    try:
+        faults.reset()
+        faults._arm_from_env()
+        assert faults._ARMED["io.writeback"].times is None
+        assert faults._ARMED["io.writeback"].after == 3
+        assert faults._ARMED["pipeline.stage_hang"].times == 2
+        assert faults._ARMED["pipeline.stage_hang"].seconds == 0.5
+    finally:
+        del os.environ["VCTPU_FAULTS"]
+        faults.reset()
+
+
+def test_schedule_json_roundtrip():
+    sched = harness.draw_schedule(7)
+    again = harness.Schedule.from_json(
+        json.loads(json.dumps(sched.to_json())))
+    assert again.to_json() == sched.to_json()
+
+
+def test_drawn_fault_points_exist_in_the_catalog():
+    for seed in range(60):
+        for f in harness.draw_schedule(seed).faults:
+            assert f.point in faults.POINTS, f.point
+
+
+def test_normalize_strips_only_provenance_headers():
+    data = (b"##fileformat=VCFv4.2\n##vctpu_engine=native\n"
+            b"##vctpu_forest_strategy=gather\n##vctpu_mesh=dp=2\n"
+            b"##vctpu_knobs=VCTPU_PALLAS=False\n#CHROM\npos1\n")
+    out = harness.normalize_output(data)
+    assert b"vctpu_engine" not in out and b"vctpu_mesh" not in out
+    assert b"##fileformat" in out and b"pos1" in out
+
+
+def test_simplifications_shrink_monotonically():
+    sched = harness.Schedule(
+        seed=1, layout="mesh2",
+        faults=[harness.FaultSpec("io.writeback", times=None, after=2),
+                harness.FaultSpec("pipeline.stage", times=3)],
+        kill_after_chunks=2)
+    cands = list(harness._simplifications(sched))
+    assert any(c.kill_after_chunks is None for c in cands)
+    assert any(len(c.faults) == 1 for c in cands)
+    assert any(c.layout == "serial" for c in cands)
+    # every candidate is strictly "smaller or simpler", never bigger
+    for c in cands:
+        assert len(c.faults) <= len(sched.faults)
+
+
+# ---------------------------------------------------------------------------
+# invariant checker (synthetic legs, no subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _fx(tmp_path, ref=b"##h\nrec\n"):
+    return harness.Fixtures(dir=str(tmp_path), input_vcf="i", model="m",
+                            ref="r", reference_norm=ref)
+
+
+def _leg(**kw):
+    leg = {"rc": 0, "killed": False, "status": {"leaked": []},
+           "out_exists": True, "partial": False, "journal": False,
+           "quarantine": False}
+    leg.update(kw)
+    return leg
+
+
+def test_check_leg_success_requires_reference_bytes(tmp_path):
+    out = str(tmp_path / "o.vcf")
+    open(out, "wb").write(b"##h\nrec\n")
+    fx = _fx(tmp_path)
+    assert harness._check_leg(_leg(), fx, out, "fresh", None) == []
+    open(out, "wb").write(b"##h\nDIFFERENT\n")
+    v = harness._check_leg(_leg(), fx, out, "fresh", None)
+    assert any("bytes differ" in m for m in v)
+
+
+def test_check_leg_success_flags_stray_sidecars(tmp_path):
+    out = str(tmp_path / "o.vcf")
+    open(out, "wb").write(b"##h\nrec\n")
+    v = harness._check_leg(_leg(partial=True, journal=True), _fx(tmp_path),
+                           out, "fresh", None)
+    assert any("stray" in m for m in v)
+
+
+def test_check_leg_failure_must_not_touch_destination(tmp_path):
+    out = str(tmp_path / "o.vcf")
+    open(out, "wb").write(b"torn")
+    v = harness._check_leg(_leg(rc=1, out_exists=True), _fx(tmp_path),
+                           out, "fresh", None)
+    assert any("left bytes at the destination" in m for m in v)
+    # ... but a PREVIOUS complete file surviving intact is fine
+    v = harness._check_leg(_leg(rc=1, out_exists=True), _fx(tmp_path),
+                           out, "fresh", b"torn")
+    assert v == []
+
+
+def test_check_leg_failure_flags_unpaired_sidecar(tmp_path):
+    out = str(tmp_path / "o.vcf")
+    v = harness._check_leg(
+        _leg(rc=1, out_exists=False, partial=True, journal=False),
+        _fx(tmp_path), out, "fresh", None)
+    assert any("unpaired" in m for m in v)
+    v = harness._check_leg(
+        _leg(rc=1, out_exists=False, partial=True, journal=True),
+        _fx(tmp_path), out, "fresh", None)
+    assert v == []
+
+
+def test_check_leg_flags_leaked_threads_and_quarantine(tmp_path):
+    out = str(tmp_path / "o.vcf")
+    open(out, "wb").write(b"##h\nrec\n")
+    v = harness._check_leg(
+        _leg(status={"leaked": ["vctpu-io-w0"]}), _fx(tmp_path),
+        out, "fresh", None)
+    assert any("leaked threads" in m for m in v)
+    v = harness._check_leg(_leg(quarantine=True), _fx(tmp_path),
+                           out, "fresh", None)
+    assert any(".quarantine" in m for m in v)
+
+
+def test_kill_leg_rejects_torn_destination_accepts_complete(tmp_path):
+    """SIGKILL may land at any instant — even right after the atomic
+    commit. Torn destination bytes are the violation; a COMPLETE
+    destination (the kill landed post-commit) is legitimate."""
+    out = str(tmp_path / "o.vcf")
+    open(out, "wb").write(b"half-a-fil")  # torn
+    v = harness._check_leg(_leg(rc=None, killed=True, out_exists=True),
+                           _fx(tmp_path), out, "fresh", None)
+    assert any("TORN bytes" in m for m in v)
+    open(out, "wb").write(b"##h\nrec\n")  # the complete reference bytes
+    v = harness._check_leg(_leg(rc=None, killed=True, out_exists=True),
+                           _fx(tmp_path), out, "fresh", None)
+    assert v == []
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_usage_errors_exit_2(capsys):
+    from tools.chaoshunt.__main__ import run
+
+    assert run(["--seeds", "0"]) == 2
+    assert run(["--sabotage", "/no/such/snippet.py"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# end to end: clean campaign green; seeded regression caught + shrunk
+# ---------------------------------------------------------------------------
+
+
+def _pick_seed(layout="serial", max_faults=1, no_kill=True) -> int:
+    """A deterministic seed whose drawn schedule is small (keeps the
+    subprocess budget of the e2e tests bounded)."""
+    for seed in range(200):
+        s = harness.draw_schedule(seed)
+        if s.layout != layout or len(s.faults) > max_faults:
+            continue
+        if no_kill and s.kill_after_chunks is not None:
+            continue
+        if any(f.seconds and f.seconds > 1 for f in s.faults):
+            continue  # long-hang schedules cost wall time
+        return seed
+    raise AssertionError("no small schedule in the first 200 seeds")
+
+
+def test_campaign_clean_schedule_green(tmp_path):
+    seed = _pick_seed()
+    report = harness.run_campaign([seed], workdir=str(tmp_path),
+                                  records=700, log=lambda *a: None)
+    assert report["seeds"] == 1
+    assert report["violating_schedules"] == 0, report["schedules"]
+    assert report["repro"] is None
+
+
+def test_campaign_catches_nonatomic_commit_and_shrinks(tmp_path):
+    """Acceptance (ISSUE 10): a deliberately seeded regression — the
+    atomic commit made NON-atomic — is caught by the invariants and
+    delta-shrunk to a minimal repro JSON that replays."""
+    sabotage = tmp_path / "sabotage.py"
+    sabotage.write_text(
+        "import os\n"
+        "_real = os.replace\n"
+        "def _torn(src, dst, **kw):\n"
+        "    if str(dst).endswith('.vcf'):\n"
+        "        data = open(src, 'rb').read()\n"
+        "        open(dst, 'wb').write(data[: len(data) // 2])\n"
+        "        raise OSError(5, 'sabotaged commit')\n"
+        "    return _real(src, dst, **kw)\n"
+        "os.replace = _torn\n")
+    seed = _pick_seed()
+    report = harness.run_campaign(
+        [seed], workdir=str(tmp_path), records=700,
+        sabotage=str(sabotage), log=lambda *a: None)
+    assert report["violating_schedules"] == 1
+    assert any("destination" in v or "rerun failed" in v
+               for v in report["schedules"][0]["violations"])
+    # the shrunk repro is MINIMAL: the sabotage fires on every commit,
+    # so delta-shrinking strips the schedule down to no faults at all
+    assert report["repro"] and os.path.exists(report["repro"])
+    repro = json.load(open(report["repro"]))
+    assert repro["schedule"]["faults"] == []
+    assert repro["schedule"]["kill_after_chunks"] is None
+    assert repro["violations"]
+    # ... and the repro JSON replays through the public replay API
+    # (without the sabotage the product is healthy, so the replay is
+    # expected to come back clean — replayability is what's proven)
+    result = harness.replay(report["repro"],
+                            workdir=str(tmp_path / "replay"),
+                            log=lambda *a: None)
+    assert result["violations"] == []

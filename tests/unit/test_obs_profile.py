@@ -566,6 +566,35 @@ def test_sigterm_flush_writes_run_end_and_still_dies_by_signal(tmp_path):
     assert events[-1]["status"] == "sigterm"
 
 
+def test_sigint_flush_writes_run_end_and_still_dies_by_signal(tmp_path):
+    """ISSUE 10 satellite: Ctrl-C previously exited without flushing
+    metrics/run_end (Python's default SIGINT handler raises
+    KeyboardInterrupt wherever the main thread happens to be). The
+    first start_run now registers a SIGINT flush with the same
+    re-deliver-default-handler pattern as SIGTERM."""
+    log = str(tmp_path / "sigint.jsonl")
+    proc = subprocess.Popen([sys.executable, "-c", _FLUSH_SCRIPT, log],
+                            env=_flush_env(), cwd=_REPO,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    # re-delivered with the default disposition: killed-by-SIGINT
+    assert rc == -signal.SIGINT
+    events = _events(tmp_path / "sigint.jsonl")
+    assert events[-1]["kind"] == "run_end"
+    assert events[-1]["status"] == "sigint"
+    metrics = [e for e in events if e["kind"] == "metrics"][-1]
+    assert metrics["counters"]["records"] == 7
+    assert schema_mod.validate_lines(
+        open(log, encoding="utf-8").read().splitlines()) == []
+
+
 # ---------------------------------------------------------------------------
 # `vctpu obs diff` sentry: noise bands, exit codes
 # ---------------------------------------------------------------------------

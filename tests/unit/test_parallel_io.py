@@ -41,6 +41,19 @@ def _engine_cache_isolated():
     engine_mod.reset_for_tests()
 
 
+#: directories the leak sentinel sweeps after every test (chaos
+#: invariant on the regular suite — tests/conftest.assert_no_stream_leaks)
+_WATCHED_DIRS: list[str] = []
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel():
+    yield
+    from tests.conftest import assert_no_stream_leaks
+
+    assert_no_stream_leaks(_WATCHED_DIRS)
+
+
 # ---------------------------------------------------------------------------
 # BGZF layer: block scan, shard inflate, chunk compressor framing
 # ---------------------------------------------------------------------------
@@ -168,6 +181,7 @@ def vcf_world(tmp_path_factory):
         text = fh.read()
     with bgzf_mod.BgzfWriter(f"{d}/calls.vcf.gz") as w:
         w.write(text)
+    _WATCHED_DIRS.append(d)
     return {"dir": d, "n": 5000}
 
 

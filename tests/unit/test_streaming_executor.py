@@ -109,12 +109,26 @@ def test_stage_pipeline_bounded_inflight():
 # ---------------------------------------------------------------------------
 
 
+#: directories the leak sentinel sweeps after every test (chaos
+#: invariant on the regular suite — tests/conftest.assert_no_stream_leaks)
+_WATCHED_DIRS: list[str] = []
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel():
+    yield
+    from tests.conftest import assert_no_stream_leaks
+
+    assert_no_stream_leaks(_WATCHED_DIRS)
+
+
 @pytest.fixture(scope="module")
 def stream_world(tmp_path_factory):
     """Shuffled multi-contig callset + trained model: contig runs are NOT
     contiguous, so chunk scoring exercises the mask path too."""
     rng = np.random.default_rng(17)
     tmp = tmp_path_factory.mktemp("stream")
+    _WATCHED_DIRS.append(str(tmp))
     contigs = {"chr1": 24000, "chr2": 16000, "chr3": 9000}
     genome = fixtures.make_genome(rng, contigs)
     fasta_path = tmp / "ref.fa"

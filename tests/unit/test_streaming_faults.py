@@ -1,9 +1,16 @@
 """Fault-tolerant streaming executor (ISSUE 2 tentpole): watchdog,
 transient-IO retry, guaranteed join/drain, atomic output commit, and
 chunk-journal resume — each proven against injected faults
-(variantcalling_tpu/utils/faults.py), not hand-waved."""
+(variantcalling_tpu/utils/faults.py), not hand-waved.
+
+ISSUE 10 extends this with the SUPERVISED RECOVERY LADDER
+(docs/robustness.md): chunk re-dispatch, watchdog v2 (stack dump + one
+wedged-chunk retry), device-OOM megabatch-shrink -> dp=1 degradation,
+opt-in poison-chunk quarantine, commit-ENOSPC resume, and journal v2
+(fsync knob, full-prefix resume verification)."""
 
 import argparse
+import json
 import os
 import pickle
 import signal
@@ -15,12 +22,19 @@ import time
 import numpy as np
 import pytest
 
+from tests.conftest import assert_no_stream_leaks
 from variantcalling_tpu.parallel.pipeline import (StagePipeline,
                                                   StageTimeoutError,
+                                                  on_final_attempt,
+                                                  retry_chunk,
                                                   retry_transient)
 from variantcalling_tpu.utils import faults
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: directories the leak sentinel sweeps after every test (the chaos
+#: invariant enforced on the regular suite — ISSUE 10 satellite)
+_WATCHED_DIRS: list[str] = []
 
 
 @pytest.fixture(autouse=True)
@@ -28,6 +42,15 @@ def _clean_faults():
     faults.reset()
     yield
     faults.reset()
+
+
+@pytest.fixture(autouse=True)
+def _leak_sentinel():
+    """No ``vctpu-*``/``pipe-*`` thread and no stray
+    ``.partial``/``.journal``/``.quarantine`` sidecar survives any test
+    in this module."""
+    yield
+    assert_no_stream_leaks(_WATCHED_DIRS)
 
 
 # ---------------------------------------------------------------------------
@@ -172,6 +195,7 @@ def stream_fault_world(tmp_path_factory):
     model = synthetic_forest(np.random.default_rng(0), n_trees=8, depth=4)
     with open(f"{d}/model.pkl", "wb") as fh:
         pickle.dump({"m": model}, fh)
+    _WATCHED_DIRS.append(d)  # leak sentinel sweeps this dir per test
     return {"dir": d, "model": model,
             "fasta": FastaReader(f"{d}/ref.fa"), "n": 4000}
 
@@ -251,15 +275,27 @@ def test_persistent_writeback_failure_is_atomic(stream_fault_world, monkeypatch)
     assert not os.path.exists(out + ".partial") and not os.path.exists(out + ".journal")
 
 
-def test_hung_score_stage_fails_clean_no_partial_at_destination(
-        stream_fault_world, monkeypatch):
+def test_hung_score_stage_recovers_via_watchdog_v2(
+        stream_fault_world, clean_bytes, monkeypatch):
+    """Watchdog v2 (recovery ladder): a CANCELLABLE hang (the injected
+    kind — a wait the teardown can release) no longer kills the run. The
+    first deadline expiry dumps every thread's stack into the obs
+    stream, releases the hang, re-dispatches the wedged chunk once, and
+    the run completes byte-identically. The abort path is still proven
+    by test_watchdog_v2_aborts_when_truly_wedged below."""
     w = stream_fault_world
     out = f"{w['dir']}/hung.vcf"
     monkeypatch.setenv("VCTPU_STAGE_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("VCTPU_OBS", "1")
     faults.arm("pipeline.stage_hang", times=1, seconds=120)
-    with pytest.raises(StageTimeoutError):
-        _run_stream(w, out, monkeypatch)
-    assert not os.path.exists(out)
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["n"] == w["n"]
+    assert open(out, "rb").read() == clean_bytes
+    events = [json.loads(ln) for ln in open(out + ".obs.jsonl")]
+    retries = [e for e in events
+               if e["kind"] == "recovery" and e["name"] == "watchdog_retry"]
+    assert retries, "watchdog v2 never fired"
+    assert "Thread" in retries[0]["stacks"]  # the faulthandler dump
     assert not [t for t in threading.enumerate() if t.name.startswith("pipe-")]
 
 
@@ -600,6 +636,469 @@ def test_sigkill_midstream_then_resume_byte_identical(stream_fault_world, tmp_pa
     assert p3.returncode == 0, p3.stderr[-2000:]
     assert resumed == open(out2, "rb").read()
     assert not os.path.exists(jpath)
+
+
+# ---------------------------------------------------------------------------
+# supervised recovery ladder (ISSUE 10 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _obs_events(path):
+    return [json.loads(ln) for ln in open(path, encoding="utf-8")]
+
+
+def test_env_arming_after_grammar(monkeypatch):
+    """VCTPU_FAULTS grows `+after` free passes so subprocess harnesses
+    (tools/chaoshunt) can schedule mid-stream failures."""
+    monkeypatch.setenv("VCTPU_FAULTS", "io.writeback:0+3,pipeline.chunk:2+1")
+    faults.reset()
+    faults._arm_from_env()
+    assert faults._ARMED["io.writeback"].times is None
+    assert faults._ARMED["io.writeback"].after == 3
+    assert faults._ARMED["pipeline.chunk"].times == 2
+    assert faults._ARMED["pipeline.chunk"].after == 1
+    faults.reset()
+
+
+def test_retry_delay_deterministic_per_worker_jitter():
+    from variantcalling_tpu.parallel.pipeline import _retry_delay
+
+    d0 = _retry_delay(1, 0.05, "vctpu-io-w0")
+    assert d0 == _retry_delay(1, 0.05, "vctpu-io-w0")  # deterministic
+    fleet = {_retry_delay(1, 0.05, f"vctpu-io-w{i}") for i in range(8)}
+    assert len(fleet) > 1  # workers do NOT stampede in lockstep
+    base = 0.05 * 2
+    assert all(base <= d < 1.5 * base for d in fleet)  # bounded
+    assert _retry_delay(0, 0.0, "x") == 0.0  # zero backoff stays zero
+
+
+def test_retry_chunk_recovers_then_respects_budget(monkeypatch):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return "ok"
+
+    assert retry_chunk(flaky, "t") == "ok"
+    assert calls["n"] == 2
+    monkeypatch.setenv("VCTPU_CHUNK_RETRIES", "0")
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="boom"):
+        retry_chunk(flaky, "t")
+    assert calls["n"] == 1  # zero retries == first-strike failure
+
+
+def test_retry_chunk_passes_contract_errors_through():
+    from variantcalling_tpu.engine import EngineError
+
+    calls = {"n": 0}
+
+    def config_error():
+        calls["n"] += 1
+        raise EngineError("bad knob")
+
+    with pytest.raises(EngineError):
+        retry_chunk(config_error, "t")
+    assert calls["n"] == 1  # configuration errors are never re-dispatched
+
+    calls["n"] = 0
+
+    def watchdog():
+        calls["n"] += 1
+        raise StageTimeoutError("wedged")
+
+    with pytest.raises(StageTimeoutError):
+        retry_chunk(watchdog, "t")
+    assert calls["n"] == 1
+
+
+def test_on_final_attempt_visible_to_chunk_bodies():
+    """The quarantine guard diverts only once the re-dispatch budget is
+    spent — it learns the attempt through pipeline.on_final_attempt."""
+    seen = []
+
+    def body():
+        seen.append(on_final_attempt())
+        raise RuntimeError("poison")
+
+    with pytest.raises(RuntimeError):
+        retry_chunk(body, "t")  # default budget: 1 retry
+    assert seen == [False, True]
+    assert on_final_attempt()  # restored outside the ladder
+
+
+def test_supervised_pipeline_retries_stage_fault_threaded():
+    faults.arm("pipeline.stage", times=1)
+    pipe = StagePipeline([lambda x: x + 1], threads=2, timeout=30,
+                         recover=True)
+    assert list(pipe.run(range(8))) == list(range(1, 9))
+    assert faults.fired("pipeline.stage") == 1
+    assert pipe.unjoined == []
+
+
+def test_supervised_pipeline_retries_stage_fault_serial():
+    faults.arm("pipeline.stage", times=1)
+    pipe = StagePipeline([lambda x: x + 1], threads=1, recover=True)
+    assert list(pipe.run(range(8))) == list(range(1, 9))
+    assert faults.fired("pipeline.stage") == 1
+
+
+def test_supervised_pipeline_persistent_fault_still_fails_loud():
+    faults.arm("pipeline.stage", times=None)
+    pipe = StagePipeline([lambda x: x], threads=2, timeout=30, recover=True)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        list(pipe.run(range(8)))
+    assert pipe.unjoined == []
+
+
+def test_supervised_pipeline_never_redispatches_stateful_stage():
+    """A stage marked ``retry_safe = False`` (the BGZF compressor's
+    block carry — re-running it would absorb the same bytes twice) is
+    excluded from the ladder: its failure stays first-strike fail-loud
+    even in supervised mode, threaded AND serial."""
+    calls = {"n": 0}
+
+    def stateful(x):
+        calls["n"] += 1
+        raise OSError("carry torn")
+
+    stateful.retry_safe = False
+    pipe = StagePipeline([stateful], threads=2, timeout=30, recover=True)
+    with pytest.raises(OSError, match="carry torn"):
+        list(pipe.run(range(8)))
+    assert calls["n"] == 1  # exactly one strike, no re-dispatch
+    calls["n"] = 0
+    pipe = StagePipeline([lambda x: x, stateful], threads=1, recover=True)
+    with pytest.raises(OSError, match="carry torn"):
+        list(pipe.run(range(8)))
+    # serial path: the stateful stage alone is excluded (per-stage, like
+    # the threaded path) — its first strike is final
+    assert calls["n"] == 1
+
+
+def test_serial_supervised_retries_pure_stage_despite_stateful_neighbor():
+    """Serial mode must keep the retry budget for PURE stages even when a
+    stateful stage sits later in the chain (single-thread .gz layout):
+    only the stateful stage itself is excluded from re-dispatch."""
+    flaky_calls = {"n": 0}
+
+    def flaky(x):
+        flaky_calls["n"] += 1
+        if flaky_calls["n"] == 1:
+            raise RuntimeError("transient")
+        return x
+
+    stateful_seen = []
+
+    def stateful(x):
+        stateful_seen.append(x)
+        return x
+
+    stateful.retry_safe = False
+    pipe = StagePipeline([flaky, stateful], threads=1, recover=True)
+    assert list(pipe.run(range(4))) == list(range(4))
+    assert flaky_calls["n"] == 5  # item 0 retried once, 1-3 clean
+    assert stateful_seen == list(range(4))  # exactly once per item
+
+
+def test_watchdog_redispatch_duplicates_drop_before_downstream_stage():
+    """A watchdog re-dispatch can deliver the wedged chunk TWICE (the
+    one-shot retry plus the woken worker). Downstream stages must see
+    each sequence number exactly once — a stateful stage after the
+    wedged one would otherwise absorb the chunk's bytes twice."""
+    seen: list[int] = []
+
+    def downstream(x):
+        seen.append(x)
+        return x
+
+    faults.arm("pipeline.stage_hang", times=1, seconds=120)
+    pipe = StagePipeline([lambda x: x, downstream], threads=3, timeout=0.4,
+                         recover=True)
+    out = list(pipe.run(range(4)))
+    assert out == list(range(4))
+    assert pipe.watchdog_retried
+    assert sorted(seen) == list(range(4))  # no duplicate ever reached it
+
+
+def test_watchdog_v2_recovers_cancellable_hang():
+    """First deadline expiry: stacks dumped, hangs cancelled, wedged
+    chunk re-dispatched — the run COMPLETES instead of aborting."""
+    faults.arm("pipeline.stage_hang", times=1, seconds=120)
+    pipe = StagePipeline([lambda x: x], threads=2, timeout=0.4, recover=True)
+    t0 = time.monotonic()
+    assert list(pipe.run(range(4))) == list(range(4))
+    assert pipe.watchdog_retried
+    assert time.monotonic() - t0 < 20
+    assert pipe.unjoined == []
+
+
+def test_watchdog_v2_aborts_when_truly_wedged():
+    """A stage wedged in an UNcancellable call (bare sleep — the stand-in
+    for a dead native call) still aborts: the single watchdog retry
+    re-dispatches the chunk, no progress follows, the second deadline
+    raises StageTimeoutError with every joinable worker joined."""
+    def wedge(x):
+        time.sleep(2.5)
+        return x
+
+    pipe = StagePipeline([wedge], threads=2, timeout=0.3, recover=True)
+    t0 = time.monotonic()
+    with pytest.raises(StageTimeoutError, match="no progress"):
+        list(pipe.run(range(4)))
+    assert pipe.watchdog_retried
+    assert time.monotonic() - t0 < 30
+
+
+def test_streaming_transient_stage_fault_recovers(stream_fault_world,
+                                                  clean_bytes, monkeypatch):
+    """Acceptance (ISSUE 10): a transient chunk failure recovers WITHOUT
+    a run abort, with a recorded `recovery` event, on the pooled layout."""
+    w = stream_fault_world
+    out = f"{w['dir']}/chunk_retry.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "4")
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    faults.arm("pipeline.stage", times=1)
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["n"] == w["n"]
+    assert faults.fired("pipeline.stage") == 1
+    assert open(out, "rb").read() == clean_bytes
+    retries = [e for e in _obs_events(out + ".obs.jsonl")
+               if e["kind"] == "recovery" and e["name"] == "chunk_retry"]
+    assert len(retries) == 1 and retries[0]["attempt"] == 1
+
+
+def test_streaming_zero_chunk_retries_fails_first_strike(
+        stream_fault_world, monkeypatch):
+    w = stream_fault_world
+    out = f"{w['dir']}/no_retry.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "4")
+    monkeypatch.setenv("VCTPU_CHUNK_RETRIES", "0")
+    faults.arm("pipeline.stage", times=1)
+    with pytest.raises(RuntimeError, match="injected fault"):
+        _run_stream(w, out, monkeypatch)
+    assert not os.path.exists(out)
+    # failed resumable run keeps the journal+partial pair: clean it so
+    # the leak sentinel's "no strays" invariant holds for this module
+    from variantcalling_tpu.io import journal as journal_mod
+
+    journal_mod.discard(out)
+
+
+def test_quarantine_default_off_poison_chunk_fails_loud(
+        stream_fault_world, monkeypatch):
+    """Byte parity stays untouchable by default: a deterministic chunk
+    failure kills the run even after the re-dispatch budget."""
+    from variantcalling_tpu.io import journal as journal_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/poison_loud.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "1")
+    faults.arm("pipeline.chunk", times=None)
+    with pytest.raises(RuntimeError, match="chunk scoring failure"):
+        _run_stream(w, out, monkeypatch)
+    assert not os.path.exists(out)
+    assert not os.path.exists(out + ".quarantine")
+    journal_mod.discard(out)
+
+
+def test_quarantine_diverts_poison_chunk(stream_fault_world, clean_bytes,
+                                         monkeypatch):
+    """VCTPU_QUARANTINE=1: a chunk that fails deterministically through
+    the whole re-dispatch budget (N strikes) diverts its ORIGINAL records
+    to <out>.quarantine; the main output holds exactly the clean bytes
+    minus that chunk, and the diversion is loud (degrade + recovery
+    event + stats)."""
+    from variantcalling_tpu.utils import degrade
+
+    w = stream_fault_world
+    out = f"{w['dir']}/poison_quar.vcf"
+    monkeypatch.setenv("VCTPU_IO_THREADS", "1")  # deterministic chunk order
+    monkeypatch.setenv("VCTPU_QUARANTINE", "1")
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    degrade.clear_for_tests()
+    # 2 strikes == 1 attempt + 1 re-dispatch of chunk 0, then quarantine
+    faults.arm("pipeline.chunk", times=2)
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None
+    assert stats["quarantined_chunks"] == 1
+    assert stats["n"] == w["n"]  # quarantined records still counted
+    out_bytes = open(out, "rb").read()
+    q_bytes = open(out + ".quarantine", "rb").read()
+    clean_recs = [ln for ln in clean_bytes.split(b"\n")
+                  if ln and not ln.startswith(b"#")]
+    out_recs = [ln for ln in out_bytes.split(b"\n")
+                if ln and not ln.startswith(b"#")]
+    q_recs = [ln for ln in q_bytes.split(b"\n") if ln]
+    assert len(q_recs) == stats["quarantined_records"] > 0
+    # main output == clean minus the quarantined (first) chunk's records
+    assert out_recs == clean_recs[len(q_recs):]
+    # quarantined records are the ORIGINAL lines (no TREE_SCORE added)
+    assert not any(b"TREE_SCORE" in ln for ln in q_recs)
+    assert degrade.events_for("stream.quarantine")
+    quar = [e for e in _obs_events(out + ".obs.jsonl")
+            if e["kind"] == "recovery" and e["name"] == "quarantine"]
+    assert len(quar) == 1 and quar[0]["records"] == len(q_recs)
+    os.remove(out + ".quarantine")  # sentinel: no stray sidecars
+
+
+def test_mesh_oom_megabatch_shrink_recovers(stream_fault_world, clean_bytes,
+                                            monkeypatch):
+    """Device OOM on a mesh megabatch dispatch: the ladder shrinks the
+    megabatch and re-dispatches chunk by chunk — the run completes
+    byte-identically (modulo the mesh header line) with the recovery
+    recorded."""
+    from variantcalling_tpu import engine as engine_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/oom_shrink.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "2")
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    engine_mod.reset_for_tests()
+    try:
+        faults.arm("xla.dispatch_oom", times=1)
+        stats = _run_stream(w, out, monkeypatch)
+        assert stats is not None and stats["n"] == w["n"]
+        assert open(out, "rb").read().replace(
+            b"##vctpu_mesh=dp=2\n", b"") == clean_bytes
+        events = _obs_events(out + ".obs.jsonl")
+        assert [e for e in events if e["kind"] == "recovery"
+                and e["name"] == "megabatch_shrink"]
+        assert not [e for e in events if e["name"] == "dp_degrade"]
+    finally:
+        engine_mod.reset_for_tests()
+
+
+def test_mesh_oom_persistent_degrades_to_dp1(stream_fault_world, clean_bytes,
+                                             monkeypatch):
+    """Acceptance (ISSUE 10): persistent device OOM degrades the run to
+    dp=1 with a recorded `recovery` event and a clean journal restart —
+    the completed output carries NO mesh header line and matches the
+    oracle exactly."""
+    from variantcalling_tpu import engine as engine_mod
+    from variantcalling_tpu.utils import degrade
+
+    w = stream_fault_world
+    out = f"{w['dir']}/oom_degrade.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "2")
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    engine_mod.reset_for_tests()
+    degrade.clear_for_tests()
+    try:
+        faults.arm("xla.dispatch_oom", times=None)
+        stats = _run_stream(w, out, monkeypatch)
+        assert stats is not None and stats["n"] == w["n"]
+        data = open(out, "rb").read()
+        assert b"##vctpu_mesh" not in data  # the dp=1 restart's header
+        assert data == clean_bytes
+        assert not os.path.exists(out + ".journal")
+        assert degrade.events_for("shard_score.device_oom")
+        events = _obs_events(out + ".obs.jsonl")
+        dg = [e for e in events if e["kind"] == "recovery"
+              and e["name"] == "dp_degrade"]
+        assert len(dg) == 1 and dg[0]["devices_from"] == 2 \
+            and dg[0]["devices_to"] == 1
+    finally:
+        engine_mod.reset_for_tests()
+
+
+def test_commit_enospc_keeps_journal_then_resume_completes(
+        stream_fault_world, clean_bytes, monkeypatch):
+    """ISSUE 10 satellite: ENOSPC at the atomic commit (os.replace). The
+    destination stays untouched, the JOURNAL is retained (finish() now
+    runs only after the rename landed), and the next run resumes —
+    skipping every chunk — to byte-identical output."""
+    w = stream_fault_world
+    out = f"{w['dir']}/commit_enospc.vcf"
+    faults.arm("io.commit", times=None)
+    with pytest.raises(OSError):
+        _run_stream(w, out, monkeypatch)
+    assert not os.path.exists(out)
+    assert os.path.exists(out + ".partial")
+    assert os.path.exists(out + ".journal")
+    faults.reset()
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None
+    assert stats["resumed_chunks"] == stats["chunks"]  # nothing recomputed
+    assert open(out, "rb").read() == clean_bytes
+    assert not os.path.exists(out + ".partial")
+    assert not os.path.exists(out + ".journal")
+
+
+def test_commit_enospc_transient_retried_in_run(stream_fault_world,
+                                                clean_bytes, monkeypatch):
+    w = stream_fault_world
+    out = f"{w['dir']}/commit_retry.vcf"
+    faults.arm("io.commit", times=1)
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None
+    assert faults.fired("io.commit") == 1
+    assert open(out, "rb").read() == clean_bytes
+
+
+def test_full_resume_verify_catches_early_corruption(stream_fault_world,
+                                                     clean_bytes,
+                                                     monkeypatch):
+    """Journal v2 (VCTPU_RESUME_VERIFY=full): a flipped byte in an EARLY
+    committed chunk — invisible to the default last-chunk spot check —
+    fails the full-prefix verification, so the run restarts fresh and
+    still produces correct bytes."""
+    from variantcalling_tpu.io import journal as journal_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/verify_full.vcf"
+    faults.arm("io.writeback", times=None, after=4)
+    with pytest.raises(OSError):
+        _run_stream(w, out, monkeypatch)
+    faults.reset()
+    jmeta = json.loads(open(out + ".journal", encoding="utf-8").readline())
+    assert len(open(out + ".journal").read().splitlines()) - 1 >= 2
+    # flip one byte INSIDE the FIRST chunk's region of the partial file
+    with open(out + ".partial", "r+b") as fh:
+        fh.seek(int(jmeta["header_len"]) + 5)
+        b = fh.read(1)
+        fh.seek(int(jmeta["header_len"]) + 5)
+        fh.write(bytes([b[0] ^ 1]))
+    # the default last-chunk spot check MISSES the early corruption ...
+    assert journal_mod.try_resume(out, jmeta) is not None
+    # ... full-prefix verification catches it and degrades to fresh
+    monkeypatch.setenv("VCTPU_RESUME_VERIFY", "full")
+    assert journal_mod.try_resume(out, jmeta) is None
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["resumed_chunks"] == 0
+    assert open(out, "rb").read() == clean_bytes
+
+
+def test_full_resume_verify_accepts_intact_prefix(stream_fault_world,
+                                                  clean_bytes, monkeypatch):
+    """Control: with an intact partial file, full verification RESUMES
+    (same chunks skipped as the default mode) byte-identically."""
+    w = stream_fault_world
+    out = f"{w['dir']}/verify_ok.vcf"
+    monkeypatch.setenv("VCTPU_RESUME_VERIFY", "full")
+    faults.arm("io.writeback", times=None, after=4)
+    with pytest.raises(OSError):
+        _run_stream(w, out, monkeypatch)
+    committed = len(open(out + ".journal").read().splitlines()) - 1
+    assert committed >= 1
+    faults.reset()
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["resumed_chunks"] == committed
+    assert open(out, "rb").read() == clean_bytes
+
+
+def test_journal_fsync_knob_is_byte_neutral(stream_fault_world, clean_bytes,
+                                            monkeypatch):
+    w = stream_fault_world
+    out = f"{w['dir']}/fsync.vcf"
+    monkeypatch.setenv("VCTPU_JOURNAL_FSYNC", "1")
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None
+    assert open(out, "rb").read() == clean_bytes
 
 
 def test_dist_rank_timeout_point_is_wired():

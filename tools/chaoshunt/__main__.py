@@ -1,0 +1,79 @@
+"""CLI: ``python -m tools.chaoshunt`` — seeded chaos campaign runner.
+
+Exit codes (the ``vctpu-lint`` contract): 0 every invariant held, 1 a
+violation was found (minimal repro JSON written), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def get_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.chaoshunt",
+        description="seeded chaos campaign over the streaming filter "
+                    "executor (docs/robustness.md)")
+    ap.add_argument("--seeds", type=int, default=10,
+                    help="number of seeded schedules (default %(default)s)")
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help="first seed (schedules are seed-deterministic)")
+    ap.add_argument("--records", type=int, default=2000,
+                    help="synthetic input size per run (default %(default)s)")
+    ap.add_argument("--out", default=None,
+                    help="work directory (default: a temp dir, removed "
+                         "when the campaign is clean)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable campaign report")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="skip delta-shrinking a violating schedule")
+    ap.add_argument("--replay", default=None, metavar="REPRO_JSON",
+                    help="replay one shrunk repro JSON instead of running "
+                         "a campaign")
+    ap.add_argument("--sabotage", default=None, metavar="SNIPPET_PY",
+                    help="python snippet exec'd in every child before the "
+                         "run — the harness SELF-TEST hook (seed a "
+                         "deliberate regression, assert it is caught)")
+    return ap
+
+
+def run(argv: list[str]) -> int:
+    args = get_parser().parse_args(argv)
+    if args.seeds <= 0:
+        print("error: --seeds must be positive", file=sys.stderr)
+        return 2
+    if args.sabotage and not __import__("os").path.exists(args.sabotage):
+        print(f"error: sabotage snippet {args.sabotage!r} does not exist",
+              file=sys.stderr)
+        return 2
+    from tools.chaoshunt import harness
+
+    log = (lambda *a, **k: None) if args.json else print
+    try:
+        if args.replay:
+            result = harness.replay(args.replay, workdir=args.out, log=log)
+            report = {"replay": result}
+            failed = bool(result["violations"])
+        else:
+            report = harness.run_campaign(
+                list(range(args.seed_base, args.seed_base + args.seeds)),
+                workdir=args.out, records=args.records,
+                sabotage=args.sabotage, shrink=not args.no_shrink, log=log)
+            failed = report["violating_schedules"] > 0
+    except (OSError, ValueError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        from variantcalling_tpu.utils.jsonio import emit_json
+
+        emit_json(report)
+    elif not args.replay:
+        print(f"chaoshunt: {report['seeds']} schedules, "
+              f"{report['violating_schedules']} violating, "
+              f"{report['wall_s']}s")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))

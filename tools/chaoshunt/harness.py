@@ -1,0 +1,556 @@
+"""Campaign engine for ``tools.chaoshunt`` (see package docstring).
+
+Every leg is a SUBPROCESS running the real CLI entry
+(``pipelines/filter_variants.run``) against small synthetic fixtures
+(``bench.make_fixtures``), with the schedule's faults armed through
+``VCTPU_FAULTS`` (the env grammar exists precisely so harnesses need no
+test API) and the layout pinned through the knob registry. A tiny driver
+wrapper maps exceptions to exit code 1, then self-reports leaked
+``vctpu-*``/``pipe-*`` threads into a status JSON — the one invariant an
+exit code cannot carry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: fault points a schedule may draw (site descriptions: utils/faults.py)
+TRANSIENT_POINTS = ("io.chunk_read", "io.writeback", "pipeline.stage",
+                    "pipeline.chunk")
+PERSISTENT_POINTS = ("io.writeback", "pipeline.stage", "pipeline.chunk",
+                     "io.chunk_read")
+LAYOUTS = ("serial", "io4", "mesh2")
+
+#: wall bound per child process (imports jax; the run itself is seconds)
+CHILD_TIMEOUT_S = 240
+
+_DRIVER = """\
+import json, sys, threading, time
+cfg = json.load(open(sys.argv[1]))
+if cfg.get("sabotage"):
+    exec(compile(open(cfg["sabotage"]).read(), "sabotage", "exec"), {})
+from variantcalling_tpu.pipelines.filter_variants import run
+err = None
+try:
+    rc = run(["--input_file", cfg["input"], "--model_file", cfg["model"],
+              "--model_name", "m", "--reference_file", cfg["ref"],
+              "--output_file", cfg["out"], "--backend", "cpu"])
+except SystemExit as e:
+    rc = int(e.code or 0)
+except BaseException as e:
+    rc, err = 1, f"{type(e).__name__}: {e}"
+def _leaked():
+    return sorted(t.name for t in threading.enumerate()
+                  if t.name.startswith(("vctpu-", "pipe-", "genome-prefetch")))
+deadline = time.time() + 3.0
+leaked = _leaked()
+while leaked and time.time() < deadline:
+    time.sleep(0.05)
+    leaked = _leaked()
+json.dump({"rc": rc, "error": err, "leaked": leaked},
+          open(cfg["status"], "w"))
+raise SystemExit(rc)
+"""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault of a schedule (``utils/faults.py`` env grammar)."""
+
+    point: str
+    times: int | None = 1  # None == unlimited (persistent)
+    seconds: float | None = None  # delay/hang length
+    after: int = 0  # free passes before the first firing
+
+    def spec(self) -> str:
+        s = self.point
+        s += f":{0 if self.times is None else self.times}"
+        if self.seconds is not None:
+            s += f"@{self.seconds}"
+        if self.after:
+            s += f"+{self.after}"
+        return s
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "FaultSpec":
+        return FaultSpec(point=d["point"], times=d.get("times"),
+                         seconds=d.get("seconds"),
+                         after=int(d.get("after", 0)))
+
+
+@dataclasses.dataclass
+class Schedule:
+    """One drawn chaos schedule: layout x faults x optional SIGKILL."""
+
+    seed: int
+    layout: str  # serial | io4 | mesh2
+    faults: list[FaultSpec] = dataclasses.field(default_factory=list)
+    kill_after_chunks: int | None = None  # SIGKILL once N chunks journaled
+
+    def faults_env(self) -> str:
+        return ",".join(f.spec() for f in self.faults)
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed, "layout": self.layout,
+                "faults": [f.to_json() for f in self.faults],
+                "kill_after_chunks": self.kill_after_chunks}
+
+    @staticmethod
+    def from_json(d: dict) -> "Schedule":
+        return Schedule(seed=int(d.get("seed", 0)),
+                        layout=d.get("layout", "serial"),
+                        faults=[FaultSpec.from_json(f)
+                                for f in d.get("faults", [])],
+                        kill_after_chunks=d.get("kill_after_chunks"))
+
+    def describe(self) -> str:
+        parts = [self.layout]
+        if self.faults:
+            parts.append(self.faults_env())
+        if self.kill_after_chunks is not None:
+            parts.append(f"SIGKILL@{self.kill_after_chunks}ch")
+        return " ".join(parts)
+
+
+def draw_schedule(seed: int) -> Schedule:
+    """Deterministic schedule for one seed: a layout (cycled so every
+    third seed covers each of serial/io4/mesh2) plus one fault class —
+    transient, persistent, hang (short delays, or a long cancellable
+    hang the v2 watchdog must recover), device-OOM (mesh only),
+    commit-ENOSPC, or a SIGKILL-at-random-progress leg."""
+    rng = random.Random(seed)
+    layout = LAYOUTS[seed % len(LAYOUTS)]
+    modes = ["transient", "persistent", "hang", "kill", "commit", "mixed"]
+    if layout == "mesh2":
+        modes.append("oom")
+    mode = rng.choice(modes)
+    faults: list[FaultSpec] = []
+    kill = None
+    if mode == "transient":
+        for _ in range(rng.randint(1, 2)):
+            faults.append(FaultSpec(rng.choice(TRANSIENT_POINTS),
+                                    times=rng.randint(1, 2),
+                                    after=rng.randint(0, 2)))
+    elif mode == "persistent":
+        faults.append(FaultSpec(rng.choice(PERSISTENT_POINTS), times=None,
+                                after=rng.randint(0, 3)))
+    elif mode == "hang":
+        if rng.random() < 0.5:
+            # short per-chunk delays: progress slows, nothing trips
+            faults.append(FaultSpec("pipeline.stage_hang",
+                                    times=rng.randint(1, 3),
+                                    seconds=round(rng.uniform(0.1, 0.4), 2)))
+        else:
+            # one LONG cancellable hang: the v2 watchdog must dump, cancel
+            # and recover the run (VCTPU_STAGE_TIMEOUT_S=2 below)
+            faults.append(FaultSpec("pipeline.stage_hang", times=1,
+                                    seconds=30,
+                                    after=rng.randint(0, 2)))
+    elif mode == "kill":
+        kill = rng.randint(1, 3)
+        if rng.random() < 0.5:  # slow the chunks so the kill lands mid-run
+            faults.append(FaultSpec("pipeline.stage_hang", times=None,
+                                    seconds=0.1))
+    elif mode == "commit":
+        faults.append(FaultSpec("io.commit",
+                                times=rng.choice([1, None])))
+    elif mode == "oom":
+        faults.append(FaultSpec("xla.dispatch_oom",
+                                times=rng.choice([1, 2, None]),
+                                after=rng.randint(0, 1)))
+    else:  # mixed: a transient plus a persistent or a kill
+        faults.append(FaultSpec(rng.choice(TRANSIENT_POINTS),
+                                times=rng.randint(1, 2)))
+        if rng.random() < 0.5:
+            faults.append(FaultSpec(rng.choice(PERSISTENT_POINTS),
+                                    times=None, after=rng.randint(1, 4)))
+        else:
+            kill = rng.randint(1, 3)
+    return Schedule(seed=seed, layout=layout, faults=faults,
+                    kill_after_chunks=kill)
+
+
+# ---------------------------------------------------------------------------
+# fixtures + reference
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Fixtures:
+    dir: str
+    input_vcf: str
+    model: str
+    ref: str
+    reference_norm: bytes  # normalized clean-run output bytes
+
+
+_PROVENANCE_PREFIXES = (b"##vctpu_engine=", b"##vctpu_forest_strategy=",
+                        b"##vctpu_mesh=", b"##vctpu_knobs=")
+
+
+def normalize_output(data: bytes) -> bytes:
+    """Strip the provenance header lines that legitimately differ across
+    engine/strategy/mesh layouts — record bytes are identical by the
+    byte-parity contract, so these lines are the ONLY tolerated delta."""
+    return b"\n".join(
+        ln for ln in data.split(b"\n")
+        if not ln.startswith(_PROVENANCE_PREFIXES))
+
+
+def _layout_env(layout: str) -> dict:
+    if layout == "serial":
+        return {"VCTPU_IO_THREADS": "1"}
+    if layout == "io4":
+        return {"VCTPU_IO_THREADS": "4"}
+    if layout == "mesh2":
+        return {"VCTPU_IO_THREADS": "4", "VCTPU_MESH_DEVICES": "2",
+                "VCTPU_ENGINE": "jit",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}
+    raise ValueError(f"unknown layout {layout!r}")
+
+
+def _child_env(layout: str, faults_spec: str = "") -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("VCTPU_") and k not in ("XLA_FLAGS",
+                                                       "PYTHONPATH")}
+    env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               VCTPU_STREAM_CHUNK_BYTES=str(1 << 14),
+               VCTPU_IO_BACKOFF_S="0.01",
+               VCTPU_STAGE_TIMEOUT_S="2")
+    env.update(_layout_env(layout))
+    if faults_spec:
+        env["VCTPU_FAULTS"] = faults_spec
+    return env
+
+
+def build_fixtures(workdir: str, records: int = 2000) -> Fixtures:
+    """Synthesize the input set once per campaign and produce the clean
+    byte reference (a fault-free, SABOTAGE-free serial-layout child run —
+    the oracle models the known-good behavior, so a ``--sabotage``
+    regression applies only to the legs under test)."""
+    import pickle
+
+    import numpy as np
+
+    import bench
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    d = os.path.join(workdir, "fixtures")
+    os.makedirs(d, exist_ok=True)
+    bench.make_fixtures(d, n=records, genome_len=150_000)
+    model = synthetic_forest(np.random.default_rng(0), n_trees=8, depth=4)
+    with open(os.path.join(d, "model.pkl"), "wb") as fh:
+        pickle.dump({"m": model}, fh)
+    fx = Fixtures(dir=d, input_vcf=os.path.join(d, "calls.vcf"),
+                  model=os.path.join(d, "model.pkl"),
+                  ref=os.path.join(d, "ref.fa"), reference_norm=b"")
+    out = os.path.join(d, "reference.vcf")
+    leg = run_leg(fx, out, "serial", "", None)
+    if leg["rc"] != 0:
+        raise RuntimeError(
+            f"chaoshunt: the fault-free reference run failed (rc={leg['rc']})"
+            + (f": {leg['status'].get('error')}" if leg.get("status") else ""))
+    fx.reference_norm = normalize_output(open(out, "rb").read())
+    return fx
+
+
+# ---------------------------------------------------------------------------
+# one leg = one subprocess run
+# ---------------------------------------------------------------------------
+
+
+def run_leg(fx: Fixtures, out: str, layout: str, faults_spec: str,
+            kill_after_chunks: int | None,
+            sabotage: str | None = None) -> dict:
+    """Run the filter CLI once in a subprocess; returns the leg record
+    (rc, killed, status, sidecar presence)."""
+    status_path = out + ".chaos_status.json"
+    cfg_path = out + ".chaos_cfg.json"
+    with open(cfg_path, "w", encoding="utf-8") as fh:
+        json.dump({"input": fx.input_vcf, "model": fx.model, "ref": fx.ref,
+                   "out": out, "status": status_path,
+                   "sabotage": sabotage}, fh)
+    env = _child_env(layout, faults_spec)
+    argv = [sys.executable, "-c", _DRIVER, cfg_path]
+    killed = False
+    if kill_after_chunks is None:
+        proc = subprocess.run(argv, env=env, cwd=REPO,  # noqa: S603
+                              capture_output=True, text=True,
+                              timeout=CHILD_TIMEOUT_S)
+        rc: int | None = proc.returncode
+        stderr = proc.stderr[-4000:]
+    else:
+        # SIGKILL-at-progress leg: watch the journal grow, then kill.
+        # Bounded: if the child finishes (or stalls) first, fall through.
+        p = subprocess.Popen(argv, env=env, cwd=REPO,  # noqa: S603
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        jpath = out + ".journal"
+        deadline = time.time() + CHILD_TIMEOUT_S
+        try:
+            while time.time() < deadline and p.poll() is None:
+                try:
+                    with open(jpath, encoding="utf-8") as fh:
+                        committed = max(0, len(fh.read().splitlines()) - 1)
+                except OSError:
+                    committed = 0
+                if committed >= kill_after_chunks:
+                    os.kill(p.pid, signal.SIGKILL)
+                    killed = True
+                    break
+                time.sleep(0.02)
+        finally:
+            if p.poll() is None and not killed:
+                os.kill(p.pid, signal.SIGKILL)
+                killed = True
+            p.wait(timeout=30)
+        rc = None if killed else p.returncode
+        stderr = ""
+    status = None
+    try:
+        with open(status_path, encoding="utf-8") as fh:
+            status = json.load(fh)
+    except (OSError, ValueError):
+        status = None
+    for p_ in (status_path, cfg_path):
+        try:
+            os.remove(p_)
+        except OSError:
+            pass
+    return {"rc": rc, "killed": killed, "status": status, "stderr": stderr,
+            "out_exists": os.path.exists(out),
+            "partial": os.path.exists(out + ".partial"),
+            "journal": os.path.exists(out + ".journal"),
+            "quarantine": os.path.exists(out + ".quarantine")}
+
+
+def _check_leg(leg: dict, fx: Fixtures, out: str, name: str,
+               prior_bytes: bytes | None) -> list[str]:
+    """The chaos invariants for one completed leg (package docstring)."""
+    v: list[str] = []
+    if leg["quarantine"]:
+        v.append(f"{name}: stray .quarantine sidecar (quarantine is off)")
+    if leg["killed"]:
+        # a SIGKILL may land at ANY instant — including after the atomic
+        # commit (the journal outlives the rename so resume can survive a
+        # commit-time crash, which widens exactly this window). The
+        # destination must then be absent, the COMPLETE output, or the
+        # intact previous file; torn bytes are the violation.
+        if leg["out_exists"]:
+            data = open(out, "rb").read()
+            if normalize_output(data) != fx.reference_norm \
+                    and (prior_bytes is None or data != prior_bytes):
+                v.append(f"{name}: SIGKILL left TORN bytes at the "
+                         "destination")
+        return v
+    if leg["rc"] == 0:
+        if not leg["out_exists"]:
+            v.append(f"{name}: success but no destination file")
+        elif normalize_output(open(out, "rb").read()) != fx.reference_norm:
+            v.append(f"{name}: success but bytes differ from the clean "
+                     "reference")
+        if leg["partial"] or leg["journal"]:
+            v.append(f"{name}: success left stray .partial/.journal")
+    else:
+        if leg["out_exists"]:
+            if prior_bytes is None:
+                v.append(f"{name}: failure (rc={leg['rc']}) left bytes at "
+                         "the destination")
+            elif open(out, "rb").read() != prior_bytes:
+                v.append(f"{name}: failure replaced the previous complete "
+                         "destination with different bytes")
+        if leg["partial"] != leg["journal"] and not out.endswith(".gz"):
+            v.append(f"{name}: failure left an unpaired sidecar "
+                     f"(partial={leg['partial']} journal={leg['journal']})")
+    if leg["status"] is not None and leg["status"].get("leaked"):
+        v.append(f"{name}: leaked threads {leg['status']['leaked']}")
+    return v
+
+
+def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
+                 sabotage: str | None = None) -> dict:
+    """One schedule end to end: the faulted fresh leg, then — whenever
+    the faulted leg left a resumable journal (or was killed) — a
+    fault-free RESUME leg that must complete byte-identically."""
+    out = os.path.join(workdir, f"seed{sched.seed}.vcf")
+    for suffix in ("", ".partial", ".journal", ".quarantine"):
+        try:
+            os.remove(out + suffix)
+        except OSError:
+            pass
+    violations: list[str] = []
+    legs: list[dict] = []
+    leg1 = run_leg(fx, out, sched.layout, sched.faults_env(),
+                   sched.kill_after_chunks, sabotage=sabotage)
+    legs.append(dict(leg1, name="fresh"))
+    violations += _check_leg(leg1, fx, out, "fresh", prior_bytes=None)
+    if leg1["killed"] or leg1["rc"] != 0:
+        # resume leg: same layout, no faults — the headline recovery
+        # invariant (byte-identical completion after any interruption)
+        leg2 = run_leg(fx, out, sched.layout, "", None, sabotage=sabotage)
+        legs.append(dict(leg2, name="resume"))
+        if leg2["rc"] != 0:
+            violations.append(
+                f"resume: rerun failed (rc={leg2['rc']}"
+                + (f", {leg2['status'].get('error')}" if leg2["status"]
+                   else "") + ")")
+        else:
+            violations += _check_leg(leg2, fx, out, "resume",
+                                     prior_bytes=None)
+    for suffix in ("", ".partial", ".journal", ".quarantine", ".obs.jsonl"):
+        try:
+            os.remove(out + suffix)
+        except OSError:
+            pass
+    return {"schedule": sched.to_json(), "describe": sched.describe(),
+            "legs": [{k: leg[k] for k in
+                      ("name", "rc", "killed", "partial", "journal")}
+                     for leg in legs],
+            "violations": violations}
+
+
+# ---------------------------------------------------------------------------
+# delta-shrink
+# ---------------------------------------------------------------------------
+
+
+def _simplifications(sched: Schedule):
+    """Candidate one-step simplifications, most aggressive first."""
+    if sched.kill_after_chunks is not None:
+        yield dataclasses.replace(sched, kill_after_chunks=None)
+    for i in range(len(sched.faults)):
+        yield dataclasses.replace(
+            sched, faults=sched.faults[:i] + sched.faults[i + 1:])
+    for i, f in enumerate(sched.faults):
+        if f.times is None or f.times > 1:
+            g = dataclasses.replace(f, times=1)
+            yield dataclasses.replace(
+                sched, faults=sched.faults[:i] + [g] + sched.faults[i + 1:])
+        if f.after:
+            g = dataclasses.replace(f, after=0)
+            yield dataclasses.replace(
+                sched, faults=sched.faults[:i] + [g] + sched.faults[i + 1:])
+    if sched.layout != "serial":
+        yield dataclasses.replace(sched, layout="serial")
+
+
+def shrink_schedule(sched: Schedule, fx: Fixtures, workdir: str,
+                    sabotage: str | None = None,
+                    budget: int = 24) -> tuple[Schedule, dict]:
+    """Greedy delta-shrink: keep applying any one-step simplification
+    that still violates an invariant, until none does (or the evaluation
+    budget is spent). Returns the minimal schedule + its failing result."""
+    current = sched
+    result = run_schedule(current, fx, workdir, sabotage=sabotage)
+    spent = 1
+    progress = True
+    while progress and spent < budget:
+        progress = False
+        for cand in _simplifications(current):
+            if spent >= budget:
+                break
+            r = run_schedule(cand, fx, workdir, sabotage=sabotage)
+            spent += 1
+            if r["violations"]:
+                current, result = cand, r
+                progress = True
+                break
+    return current, result
+
+
+# ---------------------------------------------------------------------------
+# campaign
+# ---------------------------------------------------------------------------
+
+
+def run_campaign(seeds: list[int], workdir: str | None = None,
+                 records: int = 2000, sabotage: str | None = None,
+                 shrink: bool = True, log=print) -> dict:
+    """Run one schedule per seed; on violations, delta-shrink the first
+    failing schedule and write the minimal repro JSON next to the report.
+    Returns the campaign report dict (see ``__main__`` for the exit-code
+    mapping)."""
+    t0 = time.time()
+    owns_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaoshunt-")
+    os.makedirs(workdir, exist_ok=True)
+    fx = build_fixtures(workdir, records=records)
+    results = []
+    first_violation: dict | None = None
+    for seed in seeds:
+        sched = draw_schedule(seed)
+        r = run_schedule(sched, fx, workdir, sabotage=sabotage)
+        results.append(r)
+        flag = "VIOLATION" if r["violations"] else "ok"
+        log(f"chaoshunt seed {seed:>4} [{sched.describe()}] -> {flag}")
+        for msg in r["violations"]:
+            log(f"  ! {msg}")
+        if r["violations"] and first_violation is None:
+            first_violation = r
+    repro_path = None
+    shrunk = None
+    if first_violation is not None and shrink:
+        log("chaoshunt: delta-shrinking the first violating schedule ...")
+        minimal, minimal_result = shrink_schedule(
+            Schedule.from_json(first_violation["schedule"]), fx, workdir,
+            sabotage=sabotage)
+        shrunk = {"schedule": minimal.to_json(),
+                  "describe": minimal.describe(),
+                  "violations": minimal_result["violations"]}
+        repro_path = os.path.join(workdir, "chaoshunt_repro.json")
+        with open(repro_path, "w", encoding="utf-8") as fh:
+            json.dump({"schedule": minimal.to_json(),
+                       "violations": minimal_result["violations"],
+                       "records": records}, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        log(f"chaoshunt: minimal repro [{minimal.describe()}] "
+            f"written to {repro_path}")
+    n_viol = sum(1 for r in results if r["violations"])
+    report = {
+        "seeds": len(seeds),
+        "violating_schedules": n_viol,
+        "schedules": results,
+        "shrunk": shrunk,
+        "repro": repro_path,
+        "workdir": workdir if (n_viol or not owns_workdir) else None,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    if owns_workdir and not n_viol:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
+def replay(repro_path: str, workdir: str | None = None,
+           log=print) -> dict:
+    """Replay one shrunk repro JSON (the campaign's output artifact)."""
+    with open(repro_path, encoding="utf-8") as fh:
+        repro = json.load(fh)
+    sched = Schedule.from_json(repro["schedule"])
+    owns = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaoshunt-replay-")
+    fx = build_fixtures(workdir, records=int(repro.get("records", 2000)))
+    result = run_schedule(sched, fx, workdir)
+    log(f"chaoshunt replay [{sched.describe()}] -> "
+        + ("VIOLATION" if result["violations"] else "ok"))
+    for msg in result["violations"]:
+        log(f"  ! {msg}")
+    if owns and not result["violations"]:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return result

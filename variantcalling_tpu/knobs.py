@@ -135,10 +135,28 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "bounded retries for transient ingest/writeback IO errors",
        minimum=0),
     _k("VCTPU_IO_BACKOFF_S", "float", 0.05,
-       "initial retry backoff in seconds (doubles per attempt)",
-       minimum=0.0),
+       "initial retry backoff in seconds (doubles per attempt, plus "
+       "bounded deterministic per-worker jitter)", minimum=0.0),
+    _k("VCTPU_CHUNK_RETRIES", "int", 1,
+       "bounded re-dispatches of a failed streaming chunk / megabatch "
+       "before the failure is final (recovery ladder, "
+       "docs/robustness.md); 0 fails on the first strike", minimum=0),
+    _k("VCTPU_QUARANTINE", "bool", False,
+       "divert deterministically-failing chunks to a <out>.quarantine "
+       "sidecar instead of failing the run (OPT-IN: changes which "
+       "records reach the output; default fails loudly — "
+       "docs/robustness.md recovery ladder)"),
     _k("VCTPU_RESUME", "bool", True,
        "resume interrupted plain-text runs from the chunk journal"),
+    _k("VCTPU_RESUME_VERIFY", "enum", "last",
+       "journal resume verification depth: last (spot-check the final "
+       "chunk's CRC) or full (re-read and CRC-check every journaled "
+       "chunk plus the header)", choices=("last", "full"),
+       label="resume verification mode"),
+    _k("VCTPU_JOURNAL_FSYNC", "bool", False,
+       "fsync the partial output and journal after every committed "
+       "chunk (durability over throughput; default relies on flush "
+       "ordering only)"),
     # -- multi-host -----------------------------------------------------
     _k("VCTPU_COORDINATOR", "str", None,
        "host:port of rank 0 — presence turns any tool into one rank of "
@@ -197,6 +215,9 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "(utils/faults.py)"),
     _k("VCTPU_FLAKEHUNT", "bool", False,
        "run_tests.sh: repeat flakehunt-marked tests 5x after the main run"),
+    _k("VCTPU_CHAOS", "bool", False,
+       "run_tests.sh: run the opt-in chaos smoke stage (tools/chaoshunt, "
+       "10 fixed seeds) after tier-0 lint"),
     _k("VCTPU_PROBE_INTERVAL", "int", 1800,
        "tools/tpu_probe.py polling interval in seconds", positive=True),
     _k("VCTPU_PROBE_HOURS", "float", 11.5,

@@ -40,11 +40,13 @@ cost_analysis); ``VCTPU_OBS_JAXPROF=1`` additionally captures a
 ``jax.profiler`` device trace next to the run log so host and device
 timelines load side by side in Perfetto.
 
-Abnormal exits: the first ``start_run`` registers an ``atexit`` hook and
-a SIGTERM handler that flush the metrics snapshot and ``run_end`` event
-before the process dies, so only a SIGKILL can truncate a stream (the
-PR 2 SIGKILL tests own that case — resume recovers the output, and
-``vctpu obs summary`` reports a truncated stream as ``incomplete``).
+Abnormal exits: the first ``start_run`` registers an ``atexit`` hook
+plus SIGTERM and SIGINT handlers that flush the metrics snapshot and
+``run_end`` event before the process dies (then re-deliver the signal
+with the default disposition — the exit code still says killed-by-
+signal), so only a SIGKILL can truncate a stream (the PR 2 SIGKILL
+tests own that case — resume recovers the output, and ``vctpu obs
+summary`` reports a truncated stream as ``incomplete``).
 """
 
 from __future__ import annotations
@@ -276,6 +278,7 @@ def _stop_jaxprof(run: ObsRun) -> None:
 
 _ATEXIT_REGISTERED = False
 _SIGTERM_REGISTERED = False
+_SIGINT_REGISTERED = False
 
 
 def _flush_open_run(status: str) -> None:
@@ -291,23 +294,37 @@ def _atexit_flush() -> None:
 
 
 def _register_flush_handlers() -> None:
-    """Idempotent: atexit once; SIGTERM only when the process still has
-    the default disposition (a host app's own handler must win) and only
-    from the main thread (signal.signal raises elsewhere). The SIGTERM
-    attempt RETRIES on later start_runs — a first run opened from a
-    worker thread must not permanently forfeit the flush for runs the
+    """Idempotent: atexit once; SIGTERM/SIGINT only when the process
+    still has the default disposition (a host app's own handler must
+    win; for SIGINT "default" is Python's ``default_int_handler``) and
+    only from the main thread (signal.signal raises elsewhere). The
+    signal attempts RETRY on later start_runs — a first run opened from
+    a worker thread must not permanently forfeit the flush for runs the
     main thread opens afterwards."""
-    global _ATEXIT_REGISTERED, _SIGTERM_REGISTERED
+    global _ATEXIT_REGISTERED, _SIGTERM_REGISTERED, _SIGINT_REGISTERED
     if not _ATEXIT_REGISTERED:
         _ATEXIT_REGISTERED = True
         atexit.register(_atexit_flush)
+    main = threading.current_thread() is threading.main_thread()
     if not _SIGTERM_REGISTERED:
         try:
-            if threading.current_thread() is threading.main_thread() \
-                    and signal.getsignal(signal.SIGTERM) is signal.SIG_DFL:
+            if main and signal.getsignal(signal.SIGTERM) is signal.SIG_DFL:
                 signal.signal(signal.SIGTERM, _sigterm_flush)
                 _SIGTERM_REGISTERED = True
         except (ValueError, OSError):  # exotic platform / embedded interp
+            pass
+    if not _SIGINT_REGISTERED:
+        # Ctrl-C previously tore the stream mid-write (no metrics, no
+        # run_end): Python's default SIGINT handler raises
+        # KeyboardInterrupt wherever the main thread happens to be, and
+        # a consumer loop blocked in a queue get dies without reaching
+        # end_run. Same re-deliver pattern as SIGTERM below.
+        try:
+            if main and signal.getsignal(signal.SIGINT) \
+                    is signal.default_int_handler:
+                signal.signal(signal.SIGINT, _sigint_flush)
+                _SIGINT_REGISTERED = True
+        except (ValueError, OSError):
             pass
 
 
@@ -317,6 +334,15 @@ def _sigterm_flush(signum, frame) -> None:
     # still says "killed by SIGTERM" — obs observes, it never rescues
     signal.signal(signal.SIGTERM, signal.SIG_DFL)
     os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _sigint_flush(signum, frame) -> None:
+    _flush_open_run("sigint")
+    # same pattern as SIGTERM: default disposition + re-deliver, so the
+    # parent still sees "killed by SIGINT" (WIFSIGNALED, exit -2) — obs
+    # observes, it never rescues
+    signal.signal(signal.SIGINT, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGINT)
 
 
 def event(kind: str, name: str, **fields) -> None:

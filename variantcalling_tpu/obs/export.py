@@ -182,12 +182,19 @@ def summarize(events: list[dict]) -> dict:
 
     degradations: dict[str, int] = {}
     faults: dict[str, int] = {}
+    recoveries: dict[str, int] = {}
     for e in events:
         if e.get("kind") == "degrade":
             degradations[e.get("name", "?")] = \
                 degradations.get(e.get("name", "?"), 0) + 1
         elif e.get("kind") == "fault":
             faults[e.get("name", "?")] = faults.get(e.get("name", "?"), 0) + 1
+        elif e.get("kind") == "recovery":
+            # recovery-ladder actions (chunk_retry / watchdog_retry /
+            # megabatch_shrink / megabatch_split / quarantine /
+            # dp_degrade) — docs/robustness.md
+            recoveries[e.get("name", "?")] = \
+                recoveries.get(e.get("name", "?"), 0) + 1
 
     slowest = sorted(chunk_spans, key=lambda e: -float(e.get("dur", 0.0)))[:5]
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
@@ -217,6 +224,7 @@ def summarize(events: list[dict]) -> dict:
         },
         "degradations": degradations,
         "faults": faults,
+        "recoveries": recoveries,
         "slowest_chunks": [{"name": e.get("name"), "chunk": e.get("chunk"),
                             "dur_s": round(float(e.get("dur", 0.0)), 6)}
                            for e in slowest],
@@ -490,6 +498,9 @@ def render_summary(summary: dict) -> str:
     if summary["faults"]:
         lines.append("injected faults: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(summary["faults"].items())))
+    if summary.get("recoveries"):
+        lines.append("recovery actions: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(summary["recoveries"].items())))
     if summary["slowest_chunks"]:
         lines.append("slowest chunks: " + ", ".join(
             f"{c['name']}#{c['chunk']} {c['dur_s']:.3f}s"

@@ -43,6 +43,7 @@ import os
 import queue
 import threading
 import time
+import zlib
 from collections.abc import Callable, Iterable, Iterator
 
 from variantcalling_tpu import knobs, logger, obs
@@ -90,6 +91,13 @@ DEFAULT_STAGE_TIMEOUT_S = knobs.REGISTRY["VCTPU_STAGE_TIMEOUT_S"].default
 
 class StageTimeoutError(RuntimeError):
     """The pipeline made no progress within the watchdog deadline."""
+
+
+class LadderEscalation(RuntimeError):
+    """Base class for recovery-ladder escalation signals (e.g. the mesh
+    dp-degrade restart): :func:`retry_chunk` passes these through
+    untouched — re-dispatching the same chunk cannot answer a signal
+    that says "change the run configuration"."""
 
 
 def resolve_threads() -> int:
@@ -225,6 +233,21 @@ def resolve_stage_timeout() -> float:
     return knobs.get_float("VCTPU_STAGE_TIMEOUT_S")
 
 
+def _retry_delay(attempt: int, backoff_s: float, who: str) -> float:
+    """Exponential backoff with bounded DETERMINISTIC jitter, seeded by
+    the retrying worker's identity: pool workers that hit the same
+    transient fault in lockstep (one shared-disk hiccup fans the same
+    error to every ``vctpu-io-w<N>``) would otherwise all sleep exactly
+    ``backoff_s * 2^k`` and stampede the sink together on wake. The
+    jitter spreads wakeups over [1x, 1.5x) of the base delay, is a pure
+    function of (worker name, attempt) — reproducible runs stay
+    reproducible, no RNG state — and is timing-only: output bytes can
+    never depend on it."""
+    base = backoff_s * (2 ** attempt)
+    frac = (zlib.crc32(f"{who}:{attempt}".encode()) % 1024) / 1024.0
+    return base * (1.0 + 0.5 * frac)
+
+
 def retry_transient(fn: Callable, what: str, attempts: int | None = None,
                     backoff_s: float | None = None,
                     retry_on: tuple[type[BaseException], ...] = (OSError,)):
@@ -234,8 +257,9 @@ def retry_transient(fn: Callable, what: str, attempts: int | None = None,
 
     ``attempts`` counts TOTAL tries (default ``VCTPU_IO_RETRIES``+1 = 3);
     backoff doubles from ``backoff_s`` (default ``VCTPU_IO_BACKOFF_S`` =
-    0.05s). Non-retryable exceptions propagate immediately; the last
-    retryable failure propagates after the budget is spent.
+    0.05s) with deterministic per-worker jitter (:func:`_retry_delay`).
+    Non-retryable exceptions propagate immediately; the last retryable
+    failure propagates after the budget is spent.
     """
     if attempts is None:
         attempts = 1 + knobs.get_int("VCTPU_IO_RETRIES")
@@ -249,7 +273,8 @@ def retry_transient(fn: Callable, what: str, attempts: int | None = None,
             last = e
             if k + 1 >= attempts:
                 break
-            delay = backoff_s * (2 ** k)
+            delay = _retry_delay(k, backoff_s,
+                                 threading.current_thread().name)
             if obs.active():
                 obs.event("retry", what, attempt=k + 1, attempts=attempts,
                           error=f"{type(e).__name__}: {e}")
@@ -259,6 +284,107 @@ def retry_transient(fn: Callable, what: str, attempts: int | None = None,
             if delay:
                 time.sleep(delay)
     raise last  # type: ignore[misc]
+
+
+# -- supervised chunk recovery (docs/robustness.md "Recovery ladder") ------
+
+#: per-thread re-dispatch context: quarantine guards divert a poison
+#: chunk only on the FINAL attempt of the budget, and they learn which
+#: attempt they are on through this cell (same thread by construction —
+#: retry_chunk runs its body inline)
+_RETRY_TLS = threading.local()
+
+
+def on_final_attempt() -> bool:
+    """True when the calling chunk body is on its LAST (or only) dispatch
+    attempt. Code not running under :func:`retry_chunk` is always final —
+    a guard outside the ladder quarantines on the first strike."""
+    return getattr(_RETRY_TLS, "final", True)
+
+
+def resolve_chunk_retries() -> int:
+    """Chunk re-dispatch budget (``VCTPU_CHUNK_RETRIES``, default 1)."""
+    return knobs.get_int("VCTPU_CHUNK_RETRIES")
+
+
+def retry_chunk(fn: Callable, what: str, seq: int | None = None):
+    """Task-level re-dispatch of a failed chunk body — the second rung of
+    the supervised recovery ladder (docs/robustness.md).
+
+    Chunk bodies (parse, featurize+score, render, the mesh megabatch
+    dispatch) are pure functions of their input, so re-running one cannot
+    change output bytes — it can only turn a transient failure (an IO
+    worker death, a flaky allocator, a cosmic-ray exception) into a
+    completed chunk instead of a dead run. Contract errors stay loud and
+    unretried: ``EngineError`` (configuration) and
+    :class:`StageTimeoutError` (watchdog) propagate immediately, as do
+    interpreter-exit exceptions. The final failure re-raises unchanged,
+    so callers — including the quarantine guards one rung up — see
+    exactly the exception a retry-free run would have seen.
+    """
+    from variantcalling_tpu.engine import EngineError
+
+    attempts = 1 + resolve_chunk_retries()
+    last: BaseException | None = None
+    prev = getattr(_RETRY_TLS, "final", True)
+    try:
+        for k in range(max(1, attempts)):
+            if k:
+                if obs.active():
+                    obs.event("recovery", "chunk_retry", what=what,
+                              attempt=k, retries=attempts - 1,
+                              chunk=-1 if seq is None else seq,
+                              error=f"{type(last).__name__}: {last}")
+                    obs.counter("recovery.chunk_retries").add(1)
+                logger.warning(
+                    "chunk failure in %s (attempt %d/%d): %s — re-dispatching",
+                    what, k, attempts, last)
+            _RETRY_TLS.final = k + 1 >= attempts  # vctpu-lint: disable=VCT010 — threading.local IS a per-thread cell (the obs/metrics pattern); no cross-thread visibility exists
+            try:
+                return fn()
+            except (EngineError, StageTimeoutError, LadderEscalation):
+                raise
+            # the final failure re-raises below — never a swallow
+            except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — bounded re-dispatch; the last failure re-raises after the loop
+                last = e
+    finally:
+        _RETRY_TLS.final = prev  # vctpu-lint: disable=VCT010 — threading.local IS a per-thread cell (the obs/metrics pattern); no cross-thread visibility exists
+    raise last  # type: ignore[misc]
+
+
+def record_quarantine(what: str, records: int, exc: BaseException) -> None:
+    """The loud-divert bookkeeping EVERY quarantine site shares (the
+    host-path guard in pipelines/filter_variants and the mesh dispatch
+    ladder in parallel/shard_score): a sanctioned degradation with
+    ``warn=True``, the ``recovery``/``quarantine`` obs event, and the
+    quarantined-chunks counter — one spelling, so the contract cannot
+    drift between paths."""
+    from variantcalling_tpu.utils import degrade
+
+    degrade.record("stream.quarantine", exc, warn=True,
+                   fallback=f"chunk of {records} records diverted to the "
+                            ".quarantine sidecar")
+    if obs.active():
+        obs.event("recovery", "quarantine", what=what, records=records,
+                  error=f"{type(exc).__name__}: {exc}")
+        obs.counter("recovery.quarantined_chunks").add(1)
+
+
+def _dump_thread_stacks() -> str:
+    """Every live thread's current Python stack (the same dump a fatal
+    signal would print), captured to a string so the v2 watchdog can put
+    it INTO the obs stream — a wedged production run's post-mortem then
+    carries the exact frames that were stuck, not just the stage name."""
+    import faulthandler
+    import tempfile
+
+    try:
+        with tempfile.TemporaryFile(mode="w+") as fh:
+            faulthandler.dump_traceback(file=fh, all_threads=True)
+            fh.seek(0)
+            return fh.read()
+    except (OSError, ValueError):
+        return "(thread-stack dump unavailable)"
 
 
 class StagePipeline:
@@ -274,7 +400,7 @@ class StagePipeline:
                  threads: int | None = None, timeout: float | None = None,
                  profiler=None, source_name: str = "source",
                  consumer_name: str = "consume",
-                 source_pooled: bool = False):
+                 source_pooled: bool = False, recover: bool = False):
         if stages is None:
             raise ValueError("StagePipeline needs a stage list")
         # an EMPTY stage list is legal with a pooled source (parallel
@@ -298,6 +424,20 @@ class StagePipeline:
         #: on the pool, not work — the workers attribute the real work
         #: under their own ``<stage>.w<idx>`` profile rows
         self.source_pooled = source_pooled
+        #: SUPERVISED mode — the streaming filter executor turns this on
+        #: (docs/robustness.md "Recovery ladder"): a failed stage item
+        #: re-dispatches through :func:`retry_chunk` before the failure
+        #: is final; the watchdog's FIRST expiry dumps all thread stacks
+        #: into the obs stream, releases injected hangs, re-dispatches
+        #: the wedged chunk once on a one-shot thread and grants one
+        #: more deadline (duplicate deliveries are dropped by sequence
+        #: number — chunk bodies are pure, so duplicates are
+        #: byte-identical). Off by default: bare pipelines keep the PR-2
+        #: fail-loud-on-first-strike semantics.
+        self.recover = bool(recover)
+        #: True when the v2 watchdog spent its single retry on the most
+        #: recent run (tests / post-mortem introspection)
+        self.watchdog_retried = False
         #: threads that refused to join within the cleanup grace period on
         #: the most recent run (a truly wedged native call cannot be
         #: interrupted from Python; they are daemons and die with the
@@ -352,6 +492,38 @@ class StagePipeline:
             self._record_stage_work(self.source_name, dt, seq, prof)
         return True, item
 
+    def _serial_stage_item(self, i: int, fn: Callable, seq: int, item, prof):
+        """One stage applied to one item on the serial path — injection
+        points fire PER STAGE, exactly like the threaded workers, so the
+        recovery ladder sees the same unit in both modes."""
+        faults.check("pipeline.stage")
+        faults.check("pipeline.stage_hang")
+        if not obs.active():
+            return fn(item)
+        t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+        out = fn(item)
+        self._record_stage_work(
+            self._stage_name(i),
+            time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
+        return out
+
+    def _apply_stages(self, item, seq: int, prof):
+        """One item through the serial stage chain, with PER-STAGE
+        re-dispatch in supervised mode — mirroring the threaded path: a
+        stage marked ``retry_safe = False`` (the stateful BGZF-carry
+        compressor) runs exactly once while every other stage keeps its
+        retry budget, so a single-thread .gz run still recovers
+        transient scoring failures."""
+        for i, fn in enumerate(self.stages):
+            if self.recover and getattr(fn, "retry_safe", True):
+                item = retry_chunk(
+                    lambda it_=item, i_=i, fn_=fn:
+                    self._serial_stage_item(i_, fn_, seq, it_, prof),
+                    self._stage_name(i), seq=seq)
+            else:
+                item = self._serial_stage_item(i, fn, seq, item, prof)
+        return item
+
     def _run_serial(self, source: Iterable) -> Iterator:
         prof = self._active_profiler()
         it = iter(source)
@@ -360,17 +532,7 @@ class StagePipeline:
             ok, item = self._next_timed(it, seq, prof)
             if not ok:
                 break
-            faults.check("pipeline.stage")
-            faults.check("pipeline.stage_hang")
-            for i, fn in enumerate(self.stages):
-                if obs.active():
-                    t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
-                    item = fn(item)
-                    self._record_stage_work(
-                        self._stage_name(i),
-                        time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
-                else:
-                    item = fn(item)
+            item = self._apply_stages(item, seq, prof)
             yield item
             seq += 1
 
@@ -405,6 +567,9 @@ class StagePipeline:
         # per-stage heartbeat: monotonic time the stage last STARTED an
         # item, None while idle — lets the watchdog name the stuck stage
         busy_since: list[float | None] = [None] * len(self.stages)
+        # the in-flight (seq, item) per stage — what the v2 watchdog
+        # re-dispatches when the owning worker is wedged (recover mode)
+        busy_item: list[tuple | None] = [None] * len(self.stages)
 
         def _put(q: queue.Queue, item) -> bool:
             # bounded put that stays responsive to cancellation
@@ -446,9 +611,32 @@ class StagePipeline:
             except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
                 _put(queues[0], (_SENTINEL, e))
 
+        def _run_stage_item(i: int, fn: Callable, seq: int, item):
+            """One stage item: injection points + timed stage body — the
+            unit the recovery ladder re-dispatches (the watchdog/error
+            contracts are proven against the injection points,
+            tests/unit/test_streaming_faults.py)."""
+            faults.check("pipeline.stage")
+            faults.check("pipeline.stage_hang")
+            if not obs.active():
+                return fn(item)
+            t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
+            out = fn(item)
+            self._record_stage_work(
+                self._stage_name(i),
+                time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
+            return out
+
         def _stage(i: int, fn: Callable) -> None:
             q_in, q_out = queues[i], queues[i + 1]
             stats = prof.stage(self._stage_name(i)) if prof is not None else None
+            # stateful stages (a ``retry_safe = False`` attribute on the
+            # callable — the BGZF compressor's carry is the one real
+            # case) must see each item EXACTLY once: no re-dispatch, and
+            # duplicates from an upstream watchdog re-dispatch dropped
+            # HERE, before the stage body, not only at the consumer
+            retryable = self.recover and getattr(fn, "retry_safe", True)
+            last_seq = -1
             try:
                 while not stop.is_set():
                     ok, got = _get_timed(q_in, stats)
@@ -458,29 +646,75 @@ class StagePipeline:
                         _put(q_out, got)
                         return
                     seq, item = got
+                    if self.recover and seq <= last_seq:
+                        # duplicate delivery from a watchdog re-dispatch
+                        # of the upstream stage: already processed
+                        continue
                     busy_since[i] = time.monotonic()
+                    busy_item[i] = got
                     try:
-                        # injection points: the watchdog/error contracts are
-                        # proven against these (tests/unit/test_streaming_faults.py)
-                        faults.check("pipeline.stage")
-                        faults.check("pipeline.stage_hang")
+                        if retryable:
+                            out = retry_chunk(
+                                lambda: _run_stage_item(i, fn, seq, item),
+                                self._stage_name(i), seq=seq)
+                        else:
+                            out = _run_stage_item(i, fn, seq, item)
+                        last_seq = seq
                         if obs.active():
-                            t0 = time.perf_counter()  # vctpu-lint: disable=VCT006 — obs span timing
-                            out = fn(item)
-                            self._record_stage_work(
-                                self._stage_name(i),
-                                time.perf_counter() - t0, seq, prof)  # vctpu-lint: disable=VCT006 — obs span timing
                             # queue pressure AFTER this stage produced:
                             # depth ~= items waiting for the next stage
                             obs.gauge(f"queue.stage{i}.depth").set(q_out.qsize())
-                        else:
-                            out = fn(item)
                     finally:
                         busy_since[i] = None
+                        busy_item[i] = None
                     _put_timed(_put, q_out, (seq, out), stats)
             # not a swallow: the consumer re-raises the relayed exception
             except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
                 _put(q_out, (_SENTINEL, e))
+
+        def _watchdog_recover() -> None:
+            """Watchdog v2, first expiry (recover mode): dump every
+            thread's stack into the obs stream, release injected hangs
+            (a cancellable wait resumes its stage normally), and
+            re-dispatch each wedged stage's in-flight chunk ONCE on a
+            one-shot thread — a truly wedged daemon cannot be
+            interrupted, but its chunk's result can still be delivered
+            (chunk bodies are pure; the consumer drops duplicate
+            sequence numbers). The run then gets one more full deadline
+            before the abort path runs as before."""
+            msg = self._watchdog_message(busy_since, workers)
+            stacks = _dump_thread_stacks()
+            logger.warning("stage pipeline watchdog: first deadline "
+                           "expired — re-dispatching the wedged chunk "
+                           "once before aborting. %s", msg)
+            if obs.active():
+                obs.event("recovery", "watchdog_retry", detail=msg,
+                          stacks=stacks[:20000])
+                obs.counter("recovery.watchdog_retries").add(1)
+            faults.cancel_hangs()
+            for i, got in enumerate(busy_item):
+                if got is None:
+                    continue
+                if not getattr(self.stages[i], "retry_safe", True):
+                    # a stateful stage (BGZF carry) cannot absorb the
+                    # same item twice: cancel+grace only, no re-dispatch
+                    continue
+                seq, item = got
+                fn, q_out = self.stages[i], queues[i + 1]
+
+                def _redispatch(i=i, fn=fn, seq=seq, item=item, q_out=q_out):
+                    try:
+                        out = _run_stage_item(i, fn, seq, item)
+                    # not a swallow: the consumer re-raises the relay
+                    except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed to the consumer and re-raised there
+                        _put(q_out, (_SENTINEL, e))
+                        return
+                    _put(q_out, (seq, out))
+
+                w = threading.Thread(target=_redispatch,
+                                     name=f"pipe-stage{i}-retry", daemon=True)
+                workers.append(w)
+                w.start()
 
         workers = [threading.Thread(target=_feed, name="pipe-src", daemon=True)]
         workers += [
@@ -492,6 +726,7 @@ class StagePipeline:
             w.start()
         expect = 0
         last_progress = time.monotonic()
+        self.watchdog_retried = False
         consume = prof.stage(self.consumer_name) if prof is not None else None
         try:
             while True:
@@ -501,6 +736,12 @@ class StagePipeline:
                         # a failed stage may have died before relaying
                         raise RuntimeError("stage pipeline cancelled")
                     if self.timeout and time.monotonic() - last_progress > self.timeout:
+                        if self.recover and not self.watchdog_retried:
+                            # v2: one supervised retry before the abort
+                            self.watchdog_retried = True
+                            _watchdog_recover()
+                            last_progress = time.monotonic()
+                            continue
                         msg = self._watchdog_message(busy_since, workers)
                         if obs.active():
                             obs.event("stage", "watchdog_fire", detail=msg)
@@ -513,6 +754,11 @@ class StagePipeline:
                 if isinstance(got, tuple) and got[0] is _SENTINEL:
                     raise got[1]
                 seq, item = got
+                if self.recover and seq < expect:
+                    # duplicate delivery: the wedged worker woke up after
+                    # the watchdog's re-dispatch already delivered its
+                    # chunk (both computed identical bytes — pure body)
+                    continue
                 # single-thread-per-stage FIFO makes this a hard invariant
                 assert seq == expect, (seq, expect)
                 expect += 1

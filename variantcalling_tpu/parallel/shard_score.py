@@ -39,9 +39,38 @@ import numpy as np
 import jax
 
 from variantcalling_tpu import knobs, logger, obs
+from variantcalling_tpu.parallel.pipeline import LadderEscalation
 
 #: VCF header key recording the mesh layout of a >1-device run
 MESH_HEADER_KEY = "vctpu_mesh"
+
+
+class MeshDegradeRestart(LadderEscalation):
+    """Device OOM survived the megabatch-shrink rung of the recovery
+    ladder: the streaming run must RESTART on a dp=1 plan. A mid-run mesh
+    change can never splice — the resume identity and the output header
+    both pin the mesh layout (PR-2 contract) — so the supervisor
+    (``pipelines/filter_variants.run_streaming``) discards the journal
+    and re-runs the whole stream single-device (docs/robustness.md
+    "Recovery ladder")."""
+
+    def __init__(self, devices: int, cause: BaseException):
+        super().__init__(
+            f"device OOM survived megabatch shrink at dp={devices}; "
+            f"degrading the run to dp=1 ({type(cause).__name__}: {cause})")
+        self.devices = devices
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception look like device-memory exhaustion? XLA
+    surfaces OOM as an ``XlaRuntimeError`` whose text leads with the
+    ``RESOURCE_EXHAUSTED`` status code (jaxlib does not export a stable
+    exception subclass for it), so classification is textual — plus
+    Python's own ``MemoryError`` for host-side allocation failures."""
+    if isinstance(exc, MemoryError):
+        return True
+    text = str(exc)
+    return "RESOURCE_EXHAUSTED" in text or "out of memory" in text.lower()
 
 #: default per-device megabatch rows when VCTPU_MESH_MEGABATCH_ROWS unset
 MEGABATCH_ROWS_PER_DEVICE = 1 << 14
@@ -207,15 +236,39 @@ def megabatch_stream(prepped, ctx, profiler=None):
     shards in lockstep, so each device row carries the dispatch wall and
     its share of the records; ``vctpu obs bottleneck`` merges the family
     like the ``.wN`` worker families).
+
+    SUPERVISED dispatch (docs/robustness.md "Recovery ladder"): a failed
+    megabatch never kills the run outright. Device OOM
+    (``RESOURCE_EXHAUSTED``) first SHRINKS the packing target (halved for
+    the rest of the stream) and re-dispatches the group chunk by chunk;
+    a chunk that still OOMs alone escalates to
+    :class:`MeshDegradeRestart` (the supervisor restarts the run at
+    dp=1). Any other megabatch failure re-dispatches chunk by chunk so a
+    poison chunk cannot take its group down with it; the poison chunk
+    itself gets the bounded ``retry_chunk`` budget and then either
+    fails the run loudly (default) or — ``VCTPU_QUARANTINE=1`` — yields
+    a ``(table, None, None)`` quarantine marker for the render stage to
+    divert. A ``(table, None)`` pair from upstream (featurize-stage
+    quarantine) passes through as the same marker, after flushing the
+    pending group so canonical chunk order is preserved.
     """
     import threading
     import time as _time
 
-    devices = ctx.mesh_plan.devices
-    target = resolve_megabatch_rows(devices)
+    from variantcalling_tpu.engine import EngineError
+    from variantcalling_tpu.parallel.pipeline import (StageTimeoutError,
+                                                      record_quarantine,
+                                                      retry_chunk)
+    from variantcalling_tpu.utils import faults
 
-    def flush(group):
+    devices = ctx.mesh_plan.devices
+    state = {"target": resolve_megabatch_rows(devices)}
+
+    def dispatch(group):
         rows = sum(len(t) for t, _ in group)
+        # injection point: the OOM/shrink/degrade ladder is proven
+        # against this (tests/unit/test_streaming_faults.py)
+        faults.check("xla.dispatch_oom")
         t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs score-dispatch attribution
         scored = ctx.score_packed(group)
         dt = _time.perf_counter() - t0  # vctpu-lint: disable=VCT006 — obs score-dispatch attribution
@@ -231,14 +284,78 @@ def megabatch_stream(prepped, ctx, profiler=None):
                 profiler.stage(f"score.d{d}").add_work(
                     dt, records=share + (rows - share * devices
                                          if d == devices - 1 else 0))
+        return scored
+
+    def quarantined(pair, exc):
+        table = pair[0]
+        record_quarantine("mesh chunk dispatch", len(table), exc)
+        return table, None, None
+
+    def chunk_supervised(pair):
+        """One chunk through the per-chunk ladder: bounded re-dispatch,
+        then OOM escalation or (opt-in) quarantine."""
+        try:
+            return retry_chunk(lambda: dispatch([pair]),
+                               "mesh chunk dispatch")
+        except (EngineError, StageTimeoutError):
+            raise
+        # routed through degrade.record (quarantine) or re-raised
+        except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — quarantine records via degrade.record; every other path re-raises
+            if is_oom(e):
+                raise MeshDegradeRestart(devices, e) from e
+            if not knobs.get_bool("VCTPU_QUARANTINE"):
+                raise
+            return [quarantined(pair, e)]
+
+    def flush(group):
+        try:
+            scored = dispatch(group)
+        except (EngineError, StageTimeoutError):
+            raise
+        # recovery ladder — every path below re-dispatches or re-raises
+        except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — ladder re-dispatches chunk by chunk; failures re-raise from chunk_supervised
+            if is_oom(e):
+                # rung: megabatch SHRINK — halve the packing target for
+                # the rest of the stream, re-dispatch chunk by chunk
+                state["target"] = max(1, state["target"] // 2)
+                if obs.active():
+                    obs.event("recovery", "megabatch_shrink",
+                              rows=sum(len(t) for t, _ in group),
+                              new_target=state["target"],
+                              error=f"{type(e).__name__}: {e}")
+                    obs.counter("recovery.megabatch_shrinks").add(1)
+                logger.warning(
+                    "mesh megabatch dispatch hit device OOM (%s); shrinking "
+                    "the megabatch target to %d rows and re-dispatching "
+                    "chunk by chunk", e, state["target"])
+            else:
+                # rung: megabatch SPLIT — one poison chunk must not take
+                # its whole group down with it
+                if obs.active():
+                    obs.event("recovery", "megabatch_split",
+                              chunks=len(group),
+                              error=f"{type(e).__name__}: {e}")
+                    obs.counter("recovery.megabatch_splits").add(1)
+            scored = []
+            for pair in group:
+                scored.extend(chunk_supervised(pair))
         yield from scored
 
     group: list = []
     rows = 0
     for table, hf in prepped:
+        if hf is None:
+            # featurize-stage quarantine marker from upstream: flush the
+            # pending group first (canonical chunk order), then pass the
+            # marker straight through to the render/quarantine path
+            if group:
+                yield from flush(group)
+                group, rows = [], 0
+            yield (table, None, None)
+            continue
         group.append((table, hf))
         rows += len(table)
-        if rows >= target:
+        if rows >= state["target"]:
             yield from flush(group)
             group, rows = [], 0
     if group:

@@ -44,6 +44,47 @@ HPOL_RUN = "HPOL_RUN"
 PASS = "PASS"
 CHUNK = 1 << 18
 
+#: sidecar collecting the ORIGINAL records of quarantined chunks
+#: (``VCTPU_QUARANTINE=1`` — docs/robustness.md "Recovery ladder")
+QUARANTINE_SUFFIX = ".quarantine"
+
+
+def quarantine_path(out_path: str) -> str:
+    return str(out_path) + QUARANTINE_SUFFIX
+
+
+def _guard_chunk(table, what: str, body):
+    """Rung 3 of the supervised recovery ladder for one chunk body.
+
+    Runs ``body()``; on failure either re-raises (the DEFAULT — byte
+    parity stays untouchable, a poison chunk fails the run loudly) or,
+    when ``VCTPU_QUARANTINE=1`` and this is the FINAL re-dispatch attempt
+    of the chunk's retry budget (:func:`pipeline.on_final_attempt`),
+    diverts the chunk by returning ``None`` — the render stage then
+    writes the ORIGINAL records to the ``<out>.quarantine`` sidecar and
+    zero bytes to the main output. Diversion is loud by construction: it
+    routes through ``degrade.record(warn=True)`` and a ``recovery`` obs
+    event, so no record can leave the output silently.
+    """
+    from variantcalling_tpu.parallel import pipeline as pipeline_mod
+    from variantcalling_tpu.utils import faults
+
+    try:
+        # injection point: deterministic per-chunk poison
+        # (tests/unit/test_streaming_faults.py, tools/chaoshunt)
+        faults.check("pipeline.chunk")
+        return body()
+    except (EngineError, pipeline_mod.StageTimeoutError,
+            pipeline_mod.LadderEscalation):
+        raise
+    # quarantine records via degrade.record; every other path re-raises
+    except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — opt-in quarantine routes through degrade.record(warn=True) in record_quarantine; default re-raises
+        if not knobs.get_bool("VCTPU_QUARANTINE") \
+                or not pipeline_mod.on_final_attempt():
+            raise
+        pipeline_mod.record_quarantine(what, len(table), e)
+        return None
+
 
 def get_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="filter_variants_pipeline", description="Filter VCF")
@@ -678,6 +719,7 @@ class FilterContext:
         flow_order: str = "TGCA",
         is_mutect: bool = False,
         engine: engine_mod.EngineDecision | None = None,
+        mesh_plan=None,
     ):
         # the run-level scoring engine (VCTPU_ENGINE): resolved once and
         # held here so every chunk of a run scores on the SAME engine.
@@ -734,9 +776,13 @@ class FilterContext:
         # device count by construction (pure data-parallel map; parity
         # matrix in tests/unit/test_shard_score.py), so the header line
         # is the only byte that names the layout.
+        # ``mesh_plan`` pins an externally-decided plan — the recovery
+        # ladder's dp=1 restart after device OOM (run_streaming) is the
+        # one caller; everything else resolves here as before
         from variantcalling_tpu.parallel import shard_score
 
-        self.mesh_plan = shard_score.resolve_plan(eng.name)
+        self.mesh_plan = mesh_plan if mesh_plan is not None \
+            else shard_score.resolve_plan(eng.name)
         shard_score.log_plan(self.mesh_plan)
         self.model = model
         self.fasta = fasta
@@ -1118,8 +1164,35 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
                             default_path=str(args.output_file) + ".obs.jsonl",
                             inputs=inputs)
     try:
-        stats = _run_streaming_impl(args, model, fasta, annotate, blacklist,
-                                    engine=engine)
+        from variantcalling_tpu.parallel import shard_score
+
+        try:
+            stats = _run_streaming_impl(args, model, fasta, annotate,
+                                        blacklist, engine=engine)
+        except shard_score.MeshDegradeRestart as e:
+            # recovery ladder, top rung: device OOM survived the
+            # megabatch shrink — restart the WHOLE stream on a dp=1
+            # plan. The journal restarts with it: the resume identity
+            # and the output header both pin the mesh layout, so the
+            # dp>1 partial can never splice into a dp=1 continuation.
+            from variantcalling_tpu.io import journal as journal_mod
+
+            degrade.record("shard_score.device_oom", e, warn=True,
+                           fallback="restarting the streaming run on a "
+                                    "dp=1 mesh plan")
+            if obs.active():
+                obs.event("recovery", "dp_degrade",
+                          devices_from=e.devices, devices_to=1)
+                obs.counter("recovery.dp_degrades").add(1)
+            logger.warning("%s — restarting the stream single-device", e)
+            journal_mod.discard(str(args.output_file))
+            plan1 = shard_score.MeshPlan(
+                1, "degraded",
+                f"recovery ladder: device OOM at dp={e.devices}, "
+                "degraded to dp=1")
+            stats = _run_streaming_impl(args, model, fasta, annotate,
+                                        blacklist, engine=engine,
+                                        mesh_plan=plan1)
     except BaseException as e:
         obs.end_run(obs_run, f"error: {type(e).__name__}")
         raise
@@ -1128,7 +1201,8 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
 
 def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
-                        engine: engine_mod.EngineDecision | None = None) -> dict:
+                        engine: engine_mod.EngineDecision | None = None,
+                        mesh_plan=None) -> dict:
     import threading
     import time as _time
     import zlib
@@ -1137,7 +1211,9 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     from variantcalling_tpu.io import journal as journal_mod
     from variantcalling_tpu.io.vcf import (VcfChunkReader, assemble_table_bytes,
                                            render_table_bytes_python)
-    from variantcalling_tpu.parallel.pipeline import StagePipeline
+    from variantcalling_tpu.parallel.pipeline import (StagePipeline,
+                                                      retry_chunk,
+                                                      retry_transient)
 
     # obs v2 attribution: created BEFORE the reader so the parallel-IO
     # worker pools (shard inflate / chunk parse) attribute their work
@@ -1157,7 +1233,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         blacklist=blacklist,
         blacklist_cg_insertions=args.blacklist_cg_insertions,
         annotate_intervals=annotate, flow_order=args.flow_order,
-        is_mutect=args.is_mutect, engine=engine,
+        is_mutect=args.is_mutect, engine=engine, mesh_plan=mesh_plan,
     )
     _ensure_output_header(header, engine=ctx.engine, strategy=ctx.forest_strategy,
                           mesh_plan=ctx.mesh_plan)
@@ -1175,7 +1251,15 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     prefetch.start()
 
     def score_stage(table):
-        score, filters = ctx.score_table(table)
+        # the chunk body rides the recovery ladder: the executor (serial
+        # layout) or chunk_worker (pooled layout) provides the bounded
+        # re-dispatch; the guard provides the opt-in quarantine rung —
+        # a diverted chunk flows on as a (table, None, None) marker
+        out = _guard_chunk(table, "score_stage",
+                           lambda: ctx.score_table(table))
+        if out is None:
+            return table, None, None
+        score, filters = out
         return table, score, filters
 
     def _timed_worker(fn, stage_name, item, n_records):
@@ -1201,19 +1285,34 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         glue overlaps chunk c+1's native kernels instead of serializing
         on dedicated stage threads. The executor's fault-injection points
         keep firing per chunk so the watchdog/error contracts stay
-        testable in this layout."""
-        faults.check("pipeline.stage")
-        faults.check("pipeline.stage_hang")
-        scored = _timed_worker(score_stage, "score_stage", table, len(table))
-        return _timed_worker(render_stage, "render_stage", scored, len(table))
+        testable in this layout. The whole body rides the recovery
+        ladder: bounded re-dispatch (``VCTPU_CHUNK_RETRIES``) around the
+        quarantine guard inside ``score_stage``."""
+        def body():
+            faults.check("pipeline.stage")
+            faults.check("pipeline.stage_hang")
+            scored = _timed_worker(score_stage, "score_stage", table,
+                                   len(table))
+            return _timed_worker(render_stage, "render_stage", scored,
+                                 len(table))
+
+        return retry_chunk(body, "chunk_worker")
 
     def render_stage(item):
         table, score, filters = item
+        if score is None:
+            # quarantined chunk (recovery ladder): ZERO bytes reach the
+            # main output; the ORIGINAL records (no TREE_SCORE, original
+            # FILTER) go to the <out>.quarantine sidecar for triage
+            qbody = assemble_table_bytes(table)
+            if qbody is None:
+                qbody = render_table_bytes_python(table)
+            return b"", len(table), 0, bytes(qbody)
         extra = {"TREE_SCORE": np.round(score, 4)}
         body = assemble_table_bytes(table, new_filters=filters, extra_info=extra)
         if body is None:  # native hiccup mid-run: Python renderer, same bytes
             body = render_table_bytes_python(table, new_filters=filters, extra_info=extra)
-        return body, len(table), int(np.sum(filters.codes == 0))
+        return body, len(table), int(np.sum(filters.codes == 0)), None
 
     out_path = str(args.output_file)
     gz = out_path.endswith(".gz")
@@ -1238,9 +1337,18 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         compressor = BgzfChunkCompressor(pool=compress_pool)
 
         def compress_stage(item):
-            body, k, p = item
+            body, k, p, q = item
+            if not len(body):  # quarantined chunk: nothing to compress
+                return b"", k, p, q
             data = memoryview(body) if isinstance(body, np.ndarray) else body
-            return compressor.add(data), k, p
+            return compressor.add(data), k, p, q
+
+        # the ONE stage that is NOT a pure chunk body: the compressor's
+        # block carry absorbs every byte it sees, so a re-dispatch (chunk
+        # retry or watchdog duplicate) would silently drop or duplicate
+        # compressed records — the executor must run it exactly once per
+        # item and fail loudly instead (the pre-ladder gz semantics)
+        compress_stage.retry_safe = False
 
     # resume only for plain-text outputs: a killed BGZF writer's in-flight
     # block state is unrecoverable, so .gz runs restart (still atomic)
@@ -1293,6 +1401,16 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         resume = journal_mod.try_resume(out_path, meta)
 
     n_total = n_pass = n_chunks = 0
+    q_path = quarantine_path(out_path)
+    if resume is None:
+        # fresh run: a stale quarantine sidecar from an older run must
+        # not mix its records with this run's diversions (a RESUMED run
+        # keeps it — journaled quarantined chunks are skipped, so their
+        # sidecar records are not regenerated)
+        try:
+            os.remove(q_path)
+        except OSError:
+            pass
     if gz:
         journal_mod.discard(out_path)  # stale leftovers from older runs
         # the compress stage produces finished BGZF blocks; the committer
@@ -1354,10 +1472,19 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         from variantcalling_tpu.parallel.pipeline import imap_ordered
 
         def prep_worker(table):
-            faults.check("pipeline.stage")
-            faults.check("pipeline.stage_hang")
-            return table, _timed_worker(ctx.host_features, "featurize_stage",
-                                        table, len(table))
+            def body():
+                faults.check("pipeline.stage")
+                faults.check("pipeline.stage_hang")
+                # hf None == featurize-stage quarantine marker; the
+                # megabatch stream passes it through to the render path
+                hf = _guard_chunk(
+                    table, "featurize_stage",
+                    lambda: _timed_worker(ctx.host_features,
+                                          "featurize_stage", table,
+                                          len(table)))
+                return table, hf
+
+            return retry_chunk(body, "featurize prep")
 
         def render_worker(item):
             return _timed_worker(render_stage, "render_stage", item,
@@ -1426,7 +1553,12 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                          # (timed_tables + _timed_worker + score.dN), so
                          # feed-blocked time is queue-wait, never work
                          consumer_name="writeback",
-                         source_pooled=source_pooled or mesh_scoring)
+                         source_pooled=source_pooled or mesh_scoring,
+                         # SUPERVISED mode (docs/robustness.md "Recovery
+                         # ladder"): stage-item re-dispatch, watchdog v2
+                         # (stack dump + one wedged-chunk retry before
+                         # abort), duplicate-delivery drop
+                         recover=True)
     gen = pipe.run(source)
     ok = False
     # heartbeat bookkeeping (obs only). Progress (pct) counts ALL
@@ -1441,6 +1573,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     bytes_comparable = not args.input_file.endswith(".gz")
     resumed_chunks = n_chunks
     resumed_records = n_total
+    n_quar_chunks = n_quar_records = 0
+    qsink = None
     t_start = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs heartbeat timing
     try:
         with sink:
@@ -1454,7 +1588,24 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                     _sink_write(sink, compressor.add(header_bytes))
                 else:
                     _sink_write(sink, header_bytes)
-            for body, k, p in gen:
+            for body, k, p, qbody in gen:
+                if qbody:
+                    # quarantined chunk: its ORIGINAL records append to
+                    # the sidecar (plain text, never compressed) and the
+                    # main output gets zero bytes for this chunk — the
+                    # journal entry below records body_len=0, so resume
+                    # stays consistent. The sidecar itself is BEST-EFFORT
+                    # triage, appended BEFORE the journal claims the
+                    # chunk: a kill inside that window re-processes the
+                    # chunk on resume, which can DUPLICATE records in the
+                    # sidecar — never lose them (the reverse order would
+                    # lose them from both outputs). docs/robustness.md.
+                    if qsink is None:
+                        qsink = open(q_path, "ab")
+                    _sink_write(qsink, qbody)
+                    qsink.flush()
+                    n_quar_chunks += 1
+                    n_quar_records += k
                 data = memoryview(body) if isinstance(body, np.ndarray) else body
                 if wb is not None:
                     t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs writeback attribution
@@ -1491,6 +1642,13 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                     # the partial file behind the watermark and resume
                     # would (safely but wastefully) start fresh
                     sink.flush()
+                    if journal_mod.fsync_enabled():
+                        # durability knob (VCTPU_JOURNAL_FSYNC): the
+                        # chunk's bytes reach the platter before the
+                        # journal claims them (journal.append fsyncs its
+                        # own line next) — a power cut can then cost at
+                        # most the in-flight chunk
+                        os.fsync(sink.fileno())
                     journal.append(n_chunks - 1, k, p, len(data),
                                    zlib.crc32(data))
             if compressor is not None:
@@ -1510,6 +1668,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             reader.close()
             prefetch_cancel.set()
             prefetch.join()
+        if qsink is not None:
+            qsink.close()
         if journal is not None:
             journal.close()
         if not ok:
@@ -1527,11 +1687,41 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 if obs.active():
                     obs.event("journal", "kept_for_resume", chunks=n_chunks)
 
+    def _commit():
+        # injection point "io.commit": fires BEFORE the rename, so an
+        # injected ENOSPC is cleanly retryable and a persistent one
+        # leaves journal + partial behind for resume
+        faults.check("io.commit")
+        os.replace(part_path, out_path)  # vctpu-lint: disable=VCT008 — THE one sanctioned atomic commit
+
+    # the journal outlives the commit attempt (recovery ladder): an
+    # ENOSPC on the rename itself must leave journal + partial behind so
+    # the NEXT run resumes (skipping every chunk) instead of recomputing
+    # — journal.finish() therefore runs only after the rename landed
+    try:
+        retry_transient(_commit, "output commit")
+    except BaseException:
+        if journal is None:
+            # non-resumable run: never leave droppings at the destination
+            try:
+                os.remove(part_path)
+            except OSError:
+                pass
+        else:
+            logger.info("output commit failed after %d chunks; partial "
+                        "output + journal kept for resume at %s",
+                        n_chunks, part_path)
+            if obs.active():
+                obs.event("journal", "kept_for_resume", chunks=n_chunks)
+        raise
     if journal is not None:
         journal.finish()
-    os.replace(part_path, out_path)  # vctpu-lint: disable=VCT008 — THE one sanctioned atomic commit
     if obs.active():
         obs.event("journal", "committed", chunks=n_chunks, records=n_total)
+    if n_quar_chunks:
+        logger.warning("quarantine: %d chunk(s), %d record(s) diverted to %s "
+                       "— the main output is INCOMPLETE by that many records",
+                       n_quar_chunks, n_quar_records, q_path)
     if prof is not None:
         # ingest byte attribution: the reader consumes chunk_bytes of
         # (decompressed) text per chunk; cap at the file size only when
@@ -1551,6 +1741,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     return {"n": n_total, "n_pass": n_pass, "chunks": n_chunks,
             "engine": ctx.engine.name,
             "resumed_chunks": resume.chunks if resume is not None else 0,
+            "quarantined_chunks": n_quar_chunks,
+            "quarantined_records": n_quar_records,
             "mode": "streaming" if pipe.parallel else "serial-chunked"}
 
 

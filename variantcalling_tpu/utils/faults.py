@@ -28,12 +28,15 @@ Design rules:
 
 Env syntax (comma-separated)::
 
-    VCTPU_FAULTS="io.chunk_read:2,pipeline.stage_hang@30,native.build"
+    VCTPU_FAULTS="io.chunk_read:2,pipeline.stage_hang@30,io.writeback:0+3"
 
-``point[:times][@seconds]`` — ``times`` defaults to 1 for raising faults
-and unlimited for ``native.build`` (an unavailable engine stays
-unavailable); ``@seconds`` turns the point into a delay/hang of that
-length (cancellable).
+``point[:times][@seconds][+after]`` — ``times`` defaults to 1 for raising
+faults and unlimited for ``native.build`` (an unavailable engine stays
+unavailable; 0 or negative also means unlimited); ``@seconds`` turns the
+point into a delay/hang of that length (cancellable); ``+after`` grants
+that many free passes before the first firing, so subprocess harnesses
+(tools/chaoshunt) can schedule mid-stream failures without touching test
+APIs.
 """
 
 from __future__ import annotations
@@ -60,6 +63,25 @@ POINTS: dict[str, tuple[str, object]] = {
     "pipeline.stage_hang": (
         "hung/slow streaming pipeline stage (cancellable wait)",
         None,  # delay-style: arm with seconds
+    ),
+    "pipeline.chunk": (
+        "per-chunk scoring failure inside the supervised recovery guard "
+        "(retried, then quarantined when VCTPU_QUARANTINE=1)",
+        lambda: RuntimeError("injected fault: chunk scoring failure"),
+    ),
+    "xla.dispatch_oom": (
+        "XLA device dispatch failure on a mesh megabatch "
+        "(RESOURCE_EXHAUSTED — triggers the megabatch-shrink/dp-degrade "
+        "rungs of the recovery ladder)",
+        lambda: RuntimeError(
+            "RESOURCE_EXHAUSTED: injected fault: device OOM during "
+            "scoring dispatch"),
+    ),
+    "io.commit": (
+        "ENOSPC at the atomic output commit (os.replace onto the "
+        "destination)",
+        lambda: OSError(errno.ENOSPC,
+                        "injected fault: no space left on device at commit"),
     ),
     "io.writeback": (
         "writeback IO error (ENOSPC) on the streaming output sink",
@@ -227,6 +249,13 @@ def _arm_from_env() -> None:
         item = item.strip()
         if not item:
             continue
+        after = 0
+        if "+" in item:
+            item, after_s = item.rsplit("+", 1)
+            try:
+                after = max(0, int(after_s))
+            except ValueError:
+                after = 0
         seconds = None
         if "@" in item:
             item, sec_s = item.split("@", 1)
@@ -247,7 +276,7 @@ def _arm_from_env() -> None:
         if item == "native.build" and not explicit_times:
             times = None  # an unavailable engine stays unavailable
         if item in POINTS:
-            arm(item, times=times, seconds=seconds)
+            arm(item, times=times, seconds=seconds, after=after)
 
 
 _arm_from_env()
