@@ -68,7 +68,11 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.jaxpr_audit || {
 # -- tier-0 obs schema stage (docs/observability.md) -----------------------
 # Generate a real obs run log and validate it against the COMMITTED event
 # schema (variantcalling_tpu/obs/event_schema.json): writer/schema drift
-# fails the run before pytest, like a lint finding.
+# fails the run before pytest, like a lint finding. The generated log
+# covers the live-telemetry kinds too (causal `trace` spans incl. a
+# fan-in dispatch, periodic `snapshot` metrics with rolling-window
+# quantiles, recovery trace linkage) and asserts the critical-path
+# engine names the seeded dominant edge.
 echo "obs schema stage: python -m tools.obs_schema_check"
 env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.obs_schema_check || {
   echo "obs schema check failed — failing before pytest" >&2

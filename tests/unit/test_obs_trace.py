@@ -1,0 +1,615 @@
+"""Live telemetry plane (ISSUE 11 tentpole): causal chunk tracing,
+critical-path attribution, rolling-window histograms, periodic in-run
+snapshots, log rotation, in-flight readers, tail and the Prometheus
+exposition."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from variantcalling_tpu import obs
+from variantcalling_tpu.obs import cli as obs_cli
+from variantcalling_tpu.obs import critical as critical_mod
+from variantcalling_tpu.obs import export as export_mod
+from variantcalling_tpu.obs import metrics as metrics_mod
+from variantcalling_tpu.obs import prom as prom_mod
+from variantcalling_tpu.obs import schema as schema_mod
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolated():
+    yield
+    run = obs.current()
+    if run is not None:
+        obs.end_run(run, "test-teardown")
+
+
+def _open_run(tmp_path, name="run.jsonl", **kw):
+    path = str(tmp_path / name)
+    run = obs.start_run("test_tool", force_path=path, **kw)
+    assert run is not None
+    return run, path
+
+
+def _events(path):
+    return [json.loads(ln) for ln in open(path, encoding="utf-8")
+            if ln.strip()]
+
+
+# ---------------------------------------------------------------------------
+# rolling-window histograms
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_quantile_ages_out_old_observations(monkeypatch):
+    """The windowed p95 means "recent": observations older than the
+    window leave the rolling estimate while the cumulative one keeps
+    them forever."""
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(metrics_mod.time, "monotonic", lambda: clock["t"])
+    h = metrics_mod.Histogram("stage.s", window_s=8.0)  # slot = 2s
+    for _ in range(100):
+        h.observe(10.0)  # an old stall
+    clock["t"] += 40.0  # every stall slot ages out of the window
+    for _ in range(100):
+        h.observe(0.001)
+    cum = h.quantile(0.95)
+    roll = h.rolling_quantile(0.95)
+    assert cum > 1.0  # all-of-run p95 still dominated by the stall
+    assert roll < 0.01  # rolling p95 sees only the recent regime
+    snap = h.snapshot()
+    assert snap["rolling"]["count"] == 100
+    assert snap["rolling"]["window_s"] == 8.0
+    assert snap["count"] == 200
+    assert snap["rolling"]["p95"] < 0.01
+
+
+def test_rolling_buckets_merge_across_threads():
+    h = metrics_mod.Histogram("x", window_s=60.0)
+
+    def work():
+        for _ in range(50):
+            h.observe(0.5)
+
+    ts = [threading.Thread(target=work) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    _, count = h.rolling_buckets()
+    assert count == 200
+
+
+def test_registry_window_plumbed_from_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_WINDOW_S", "17")
+    run, _ = _open_run(tmp_path)
+    assert run.metrics.window_s == 17.0
+    h = run.metrics.histogram("a.s")
+    assert h.window_s == 17.0
+    obs.end_run(run, "ok")
+
+
+# ---------------------------------------------------------------------------
+# trace API
+# ---------------------------------------------------------------------------
+
+
+def test_trace_span_chain_and_fanin(tmp_path):
+    run, path = _open_run(tmp_path)
+    assert obs.tracing()
+    t1, t2 = obs.new_trace(), obs.new_trace()
+    assert t1 != t2
+    r1 = obs.trace_span(t1, "ingest", 0.01)
+    r2 = obs.trace_span(t2, "ingest", 0.02)
+    # fan-in: one dispatch span, both chunks as parents
+    d = obs.trace_span(t1, "score_stage", 0.5, parents=[r1, r2],
+                       traces=[t1, t2], chunks=2)
+    # both cursors advanced to the dispatch span
+    w1 = obs.trace_span(t1, "writeback", 0.005)
+    w2 = obs.trace_span(t2, "writeback", 0.006)
+    obs.end_trace(t1)
+    obs.end_trace(t2)
+    assert run.traces == {}
+    obs.end_run(run, "ok")
+    events = _events(path)
+    assert schema_mod.validate_lines(
+        open(path, encoding="utf-8").read().splitlines()) == []
+    spans = {e["span_id"]: e for e in events if e["kind"] == "trace"}
+    assert spans[d]["parents"] == [r1, r2]
+    assert spans[d]["traces"] == [t1, t2]
+    assert spans[w1]["parents"] == [d]
+    assert spans[w2]["parents"] == [d]
+
+
+def test_trace_scope_binds_and_restores():
+    obs.set_current_trace(None)
+    assert obs.current_trace() is None
+    with obs.trace_scope("t1"):
+        assert obs.current_trace() == "t1"
+        with obs.trace_scope("t2"):
+            assert obs.current_trace() == "t2"
+        assert obs.current_trace() == "t1"
+    assert obs.current_trace() is None
+
+
+def test_tracing_off_by_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_TRACE", "0")
+    run, path = _open_run(tmp_path)
+    assert not obs.tracing()
+    assert obs.new_trace() is None
+    assert obs.trace_span("t0", "x", 0.1) is None
+    obs.end_run(run, "ok")
+    assert not [e for e in _events(path) if e["kind"] == "trace"]
+
+
+def test_trace_of_recognizes_tables_and_tuples():
+    class T:
+        pass
+
+    t = T()
+    t._obs_trace = "t7"
+    assert obs.trace_of(t) == "t7"
+    assert obs.trace_of((t, None, None)) == "t7"
+    assert obs.trace_of((b"body", 4, 2, None, "t9")) == "t9"
+    assert obs.trace_of((b"body", 4, 2, None, None)) is None
+    assert obs.trace_of("plain") is None
+
+
+# ---------------------------------------------------------------------------
+# critical-path engine (acceptance: synthetic log with known geometry)
+# ---------------------------------------------------------------------------
+
+
+def _env(seq, t, kind, name, **fields):
+    return dict(fields, v=schema_mod.SCHEMA_VERSION, seq=seq,
+                ts=1000.0 + t, t=t, kind=kind, name=name, pid=1, tid=1)
+
+
+def _synthetic_geometry(n_chunks=10):
+    """Known geometry: per chunk ingest 0.01s -> wait 0.04 -> score 0.5
+    -> render 0.05 -> wait 0.02 -> writeback 0.01. score work dominates
+    every path; per-stage profile rows match the trace sums exactly."""
+    events = []
+    seq = 0
+
+    def emit(t, kind, name, **fields):
+        nonlocal seq
+        events.append(_env(seq, round(t, 6), kind, name, **fields))
+        seq += 1
+
+    emit(0.0, "manifest", "synthetic", tool="synthetic", version="0",
+         knobs={}, topology={})
+    sid = 0
+    for i in range(n_chunks):
+        base = float(i)
+        tid = f"t{i}"
+
+        def span(end, name, dur, parents):
+            nonlocal sid
+            s = f"s{sid}"
+            sid += 1
+            emit(base + end, "trace", name, trace_id=tid, span_id=s,
+                 dur=dur, **({"parents": parents} if parents else {}))
+            return s
+
+        a = span(0.01, "ingest", 0.01, None)
+        b = span(0.55, "score_stage", 0.5, [a])     # waited 0.04
+        c = span(0.60, "render_stage", 0.05, [b])   # no wait
+        span(0.63, "writeback", 0.01, [c])          # waited 0.02
+    wall = float(n_chunks)
+    emit(wall, "profile", "stage", stage="ingest", work_s=0.01 * n_chunks,
+         wait_in_s=0.0, wait_out_s=0.0, items=n_chunks, records=0)
+    emit(wall, "profile", "stage", stage="score_stage",
+         work_s=0.5 * n_chunks, wait_in_s=0.04 * n_chunks, wait_out_s=0.0,
+         items=n_chunks, records=100 * n_chunks)
+    emit(wall, "profile", "stage", stage="render_stage",
+         work_s=0.05 * n_chunks, wait_in_s=0.0, wait_out_s=0.0,
+         items=n_chunks, records=100 * n_chunks)
+    emit(wall, "profile", "stage", stage="writeback",
+         work_s=0.01 * n_chunks, wait_in_s=0.02 * n_chunks, wait_out_s=0.0,
+         items=n_chunks, records=100 * n_chunks)
+    emit(wall, "profile", "pipeline", wall_s=wall,
+         records=100 * n_chunks, stages=["ingest", "score_stage",
+                                         "render_stage", "writeback"],
+         bytes_in=0, bytes_out=0)
+    emit(wall + 0.01, "run_end", "synthetic", status="ok", dur=wall)
+    return events
+
+
+def test_critical_path_names_dominant_edge_and_reconciles():
+    """Acceptance: the critical-path engine names score_stage as the
+    dominant p95 edge on known geometry, and its per-stage work sums
+    reconcile with the `obs bottleneck` attribution within tolerance."""
+    events = _synthetic_geometry()
+    cp = critical_mod.critical_path(events)
+    assert cp["chunks"] == 10
+    assert cp["dominant_edge"] == "score_stage.work"
+    assert cp["dominant_p95_edge"] == "score_stage.work"
+    # per-chunk latency: 0.63s end to end
+    assert cp["latency_p50_s"] == pytest.approx(0.63, abs=1e-6)
+    assert cp["latency_p95_s"] == pytest.approx(0.63, abs=1e-6)
+    edges = cp["edges"]
+    # work edges carry the stage durations, wait edges the gaps
+    assert edges["score_stage.work"]["total_s"] == pytest.approx(5.0)
+    assert edges["score_stage.wait"]["total_s"] == pytest.approx(0.4)
+    assert edges["writeback.wait"]["total_s"] == pytest.approx(0.2)
+    assert edges["render_stage.wait"]["total_s"] == pytest.approx(0.0)
+    # the shares sum to ~100
+    assert sum(d["share_pct"] for d in edges.values()) == pytest.approx(
+        100.0, abs=1.0)
+    # reconciliation with the profile attribution: exact on synthetic
+    recon = cp["reconciliation"]
+    for stage in ("ingest", "score_stage", "render_stage", "writeback"):
+        assert abs(recon[stage]["delta_pct"]) < 1.0, (stage, recon[stage])
+    assert cp["bottleneck_limiting_stage"] == "score_stage"
+    # and the rendered form mentions the verdict
+    text = critical_mod.render(cp)
+    assert "score_stage.work" in text and "reconciliation" in text
+
+
+def test_critical_path_fanin_picks_latest_parent():
+    """At megabatch fan-in the critical parent is the LATEST-arriving
+    member: the dispatch's wait edge measures the pack wait of the
+    chunk that held the batch up."""
+    events = [_env(0, 0.0, "manifest", "m", tool="m", version="0",
+                   knobs={}, topology={})]
+
+    def tr(seq, t, name, tid, sid, dur, parents=None, traces=None):
+        f = {"trace_id": tid, "span_id": sid, "dur": dur}
+        if parents:
+            f["parents"] = parents
+        if traces:
+            f["traces"] = traces
+        events.append(_env(seq, t, "trace", name, **f))
+
+    tr(1, 0.01, "ingest", "t0", "s0", 0.01)           # early chunk
+    tr(2, 0.30, "ingest", "t1", "s1", 0.01)           # the straggler
+    # dispatch starts at 0.40 (waited 0.10 on the straggler), runs 0.5
+    tr(3, 0.90, "score_stage", "t0", "s2", 0.5,
+       parents=["s0", "s1"], traces=["t0", "t1"])
+    tr(4, 0.95, "writeback", "t0", "s3", 0.01, parents=["s2"])
+    tr(5, 1.00, "writeback", "t1", "s4", 0.01, parents=["s2"])
+    events.append(_env(6, 1.2, "run_end", "m", status="ok", dur=1.2))
+
+    paths = {p["trace"]: p for p in critical_mod.chunk_paths(events)}
+    assert set(paths) == {"t0", "t1"}
+    # both chunks' critical paths go through the straggler's ingest
+    for tid in ("t0", "t1"):
+        stages = [e["edge"] for e in paths[tid]["edges"]]
+        assert "score_stage.work" in stages
+    # dispatch wait on t0's path = dispatch start (0.40) - straggler
+    # ingest end (0.30) = 0.10 — NOT the early chunk's much longer wait
+    waits = {e["edge"]: e["s"] for e in paths["t0"]["edges"]}
+    assert waits["score_stage.wait"] == pytest.approx(0.10, abs=1e-6)
+    # t0's root is the straggler's ingest (the critical parent), so its
+    # path latency spans from the straggler's start
+    assert paths["t0"]["latency_s"] == pytest.approx(0.95 - 0.29, abs=1e-6)
+
+
+def test_critical_path_empty_log_says_so():
+    events = [_env(0, 0.0, "manifest", "m", tool="m", version="0",
+                   knobs={}, topology={}),
+              _env(1, 1.0, "run_end", "m", status="ok", dur=1.0)]
+    cp = critical_mod.critical_path(events)
+    assert cp["chunks"] == 0
+    assert "VCTPU_OBS" in critical_mod.render(cp)
+
+
+def test_critical_path_cli(tmp_path, capsys):
+    path = str(tmp_path / "log.jsonl")
+    with open(path, "w", encoding="utf-8") as fh:
+        for e in _synthetic_geometry():
+            fh.write(json.dumps(e) + "\n")
+    assert obs_cli.run(["critical-path", path]) == 0
+    out = capsys.readouterr().out
+    assert "score_stage.work" in out
+    assert obs_cli.run(["critical-path", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["dominant_p95_edge"] == "score_stage.work"
+    assert obs_cli.run(["critical-path", str(tmp_path / "nope.jsonl")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# in-flight tolerance (truncated final line, missing run_end)
+# ---------------------------------------------------------------------------
+
+
+def _in_flight_log(tmp_path):
+    """A run log as a crash/SIGKILL leaves it: no run_end, final line
+    torn mid-JSON."""
+    run, path = _open_run(tmp_path, name="inflight.jsonl")
+    obs.counter("records").add(64)
+    obs.event("heartbeat", "stream", chunks=2, records=64, vps=100)
+    tid = obs.new_trace()
+    obs.trace_span(tid, "ingest", 0.01)
+    run._fh.flush()
+    # simulate the torn write of a dying process: no run_end, half a line
+    obs._ACTIVE = False
+    obs._TRACING = False
+    obs._RUN = None
+    run._fh.write('{"v": 1, "seq": 99, "ts": 1.0, "t": 1.0, "kind": "hea')
+    run._fh.close()
+    return path
+
+
+def test_readers_tolerate_in_flight_log(tmp_path, capsys):
+    path = _in_flight_log(tmp_path)
+    events = export_mod.read_run(path)  # must not raise
+    assert events and events[0]["kind"] == "manifest"
+    summary = export_mod.summarize(events)
+    assert summary["run"]["status"] == "in-flight"
+    assert summary["run"]["in_flight"] is True
+    assert summary["run"]["duration_s"] is not None
+    export_mod.bottleneck(events)  # no raise
+    critical_mod.critical_path(events)  # no raise
+    export_mod.to_chrome_trace(events)  # no raise
+    # every CLI reader exits 0 on the in-flight log
+    for argv in (["summary", path], ["bottleneck", path],
+                 ["critical-path", path], ["tail", path], ["prom", path],
+                 ["export", path]):
+        assert obs_cli.run(argv) == 0, argv
+    capsys.readouterr()
+
+
+def test_mid_file_garbage_still_raises(tmp_path):
+    path = str(tmp_path / "garbage.jsonl")
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(_env(0, 0.0, "manifest", "m", tool="m",
+                                 version="0", knobs={}, topology={})) + "\n")
+        fh.write("NOT JSON AT ALL\n")
+        fh.write(json.dumps(_env(1, 1.0, "run_end", "m", status="ok",
+                                 dur=1.0)) + "\n")
+    with pytest.raises(export_mod.ObsLogError):
+        export_mod.read_events(path)
+
+
+def test_diff_tolerates_in_flight_candidate(tmp_path):
+    path = _in_flight_log(tmp_path)
+    rc = obs_cli.run(["diff", path, path])
+    assert rc == 0  # identical logs: no regression, no stack trace
+
+
+# ---------------------------------------------------------------------------
+# log size cap + segment rotation
+# ---------------------------------------------------------------------------
+
+
+def test_rotation_segments_and_merged_read(tmp_path, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_MAX_MB", "1")
+    run, path = _open_run(tmp_path, name="rot.jsonl")
+    n = 9000  # ~1.4 MB of events at ~160 B each: at least one rollover
+    for i in range(n):
+        obs.event("journal", "resume_decision", outcome="fresh", i=i)
+    obs.end_run(run, "ok")
+    segs = [p for p in os.listdir(tmp_path)
+            if p.startswith("rot.jsonl.seg")]
+    assert segs, "no rotation segment was written"
+    assert os.path.getsize(path) <= (1 << 20) + 4096
+    events = export_mod.read_run(path)
+    # the merged stream is complete and still strictly seq-ordered
+    assert [e["seq"] for e in events] == list(range(len(events)))
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "manifest" and kinds[-1] == "run_end"
+    assert sum(1 for k in kinds if k == "journal") == n
+    # summary reads the rotated run like an unrotated one
+    assert export_mod.summarize(events)["run"]["status"] == "ok"
+
+
+def test_rotation_segments_validate_as_continuations(tmp_path, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_MAX_MB", "1")
+    run, path = _open_run(tmp_path, name="val.jsonl")
+    for i in range(9000):
+        obs.event("journal", "resume_decision", outcome="fresh", i=i)
+    obs.end_run(run, "ok")
+    seg = path + ".seg1"
+    assert os.path.exists(seg)
+    seg_lines = open(seg, encoding="utf-8").read().splitlines()
+    # standalone validation fails (no manifest, seq offset) but the
+    # continuation mode accepts exactly the rotation shape
+    assert schema_mod.validate_lines(seg_lines)
+    assert schema_mod.validate_lines(seg_lines, continuation=True) == []
+    base_lines = open(path, encoding="utf-8").read().splitlines()
+    assert schema_mod.validate_lines(base_lines) == []
+
+
+def test_rotation_unset_writes_one_file(tmp_path):
+    run, path = _open_run(tmp_path, name="plain.jsonl")
+    for i in range(100):
+        obs.event("journal", "resume_decision", outcome="fresh", i=i)
+    obs.end_run(run, "ok")
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("plain.jsonl.seg")]
+
+
+# ---------------------------------------------------------------------------
+# periodic snapshots (the live plane)
+# ---------------------------------------------------------------------------
+
+
+def test_periodic_snapshots_ride_flush_cadence(tmp_path, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_SNAPSHOT_S", "0.01")
+    run, path = _open_run(tmp_path, name="snap.jsonl")
+    for i in range(120):
+        obs.histogram("stage.score_stage.s").observe(0.01)
+        obs.event("journal", "resume_decision", outcome="fresh", i=i)
+        if i % 40 == 0:
+            time.sleep(0.02)
+    obs.end_run(run, "ok")
+    lines = open(path, encoding="utf-8").read().splitlines()
+    assert schema_mod.validate_lines(lines) == []
+    events = _events(path)
+    snaps = [e for e in events if e["kind"] == "snapshot"]
+    assert snaps, "no periodic snapshot landed"
+    assert events[-1]["kind"] == "run_end"  # snapshots never trail run_end
+    roll = snaps[-1]["histograms"]["stage.score_stage.s"]["rolling"]
+    assert roll["count"] > 0 and roll["p95"] is not None
+
+
+def test_snapshots_disabled_by_zero(tmp_path, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_SNAPSHOT_S", "0")
+    run, path = _open_run(tmp_path, name="nosnap.jsonl")
+    for i in range(200):
+        obs.event("journal", "resume_decision", outcome="fresh", i=i)
+    obs.end_run(run, "ok")
+    assert not [e for e in _events(path) if e["kind"] == "snapshot"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + textfile writer
+# ---------------------------------------------------------------------------
+
+
+def test_prom_exposition_shape(tmp_path):
+    run, path = _open_run(tmp_path, name="prom.jsonl")
+    obs.counter("records").add(128)
+    obs.gauge("queue.stage0.depth").set(512.5)
+    for _ in range(10):
+        obs.histogram("stage.score_stage.s").observe(0.25)
+    obs.event("heartbeat", "stream", chunks=3, records=128, vps=1000)
+    obs.end_run(run, "ok")
+    text = prom_mod.events_to_prom(export_mod.read_run(path))
+    assert 'vctpu_run_in_flight{tool="test_tool"} 0' in text
+    assert "vctpu_records_total 128" in text
+    assert "vctpu_queue_stage0_depth 512.5" in text
+    assert 'vctpu_stage_score_stage_s{quantile="0.95"}' in text
+    assert "vctpu_stage_score_stage_s_count 10" in text
+    assert 'vctpu_stage_score_stage_s_rolling{quantile="0.95"' in text
+    assert "vctpu_progress_records 128" in text
+    assert "vctpu_run_duration_seconds" in text
+    # in-flight log: the flag flips
+    text2 = prom_mod.snapshot_to_prom({"counters": {}, "gauges": {},
+                                       "histograms": {}})
+    assert "vctpu_run_in_flight" in text2 and "} 1" in text2
+
+
+def test_prom_textfile_writer_atomic(tmp_path):
+    target = str(tmp_path / "metrics.prom")
+    prom_mod.write_textfile(target, "vctpu_x 1\n")
+    assert open(target).read() == "vctpu_x 1\n"
+    prom_mod.write_textfile(target, "vctpu_x 2\n")
+    assert open(target).read() == "vctpu_x 2\n"
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith(".vctpu_prom_")]
+
+
+def test_prom_live_textfile_knob(tmp_path, monkeypatch):
+    target = str(tmp_path / "live.prom")
+    monkeypatch.setenv("VCTPU_OBS_PROM_FILE", target)
+    monkeypatch.setenv("VCTPU_OBS_SNAPSHOT_S", "0.01")
+    run, _ = _open_run(tmp_path, name="live.jsonl")
+    obs.counter("records").add(7)
+    for i in range(80):
+        obs.event("journal", "resume_decision", outcome="fresh", i=i)
+        if i == 40:
+            time.sleep(0.02)
+    obs.end_run(run, "ok")
+    text = open(target, encoding="utf-8").read()
+    # the final write happens at run close with the in-flight flag down
+    assert "vctpu_records_total 7" in text
+    assert "vctpu_run_in_flight" in text and "} 0" in text
+
+
+def test_prom_cli_output_file(tmp_path, capsys):
+    run, path = _open_run(tmp_path, name="promcli.jsonl")
+    obs.counter("records").add(3)
+    obs.end_run(run, "ok")
+    out_file = str(tmp_path / "o.prom")
+    assert obs_cli.run(["prom", path, "-o", out_file]) == 0
+    capsys.readouterr()
+    assert "vctpu_records_total 3" in open(out_file).read()
+
+
+# ---------------------------------------------------------------------------
+# tail
+# ---------------------------------------------------------------------------
+
+
+def test_tail_state_and_cli(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("VCTPU_OBS_SNAPSHOT_S", "0.01")
+    run, path = _open_run(tmp_path, name="tail.jsonl")
+    for _ in range(40):
+        obs.histogram("stage.score_stage.s").observe(0.1)
+        obs.event("heartbeat", "stream", chunks=1, records=100, vps=500,
+                  pct=25.0, eta_s=3.0)
+    time.sleep(0.02)
+    obs.event("recovery", "chunk_retry", what="score_stage", attempt=1,
+              retries=1, chunk=0, trace_id="t0", error="X")
+    obs.end_run(run, "ok")
+    state = obs_cli.tail_state(export_mod.read_run(path))
+    assert state["progress"]["records"] == 100
+    assert state["recoveries"] == {"chunk_retry": 1}
+    assert state["run"]["status"] == "ok"
+    assert obs_cli.run(["tail", path]) == 0
+    out = capsys.readouterr().out
+    assert "progress:" in out and "chunk_retry" in out
+    assert obs_cli.run(["tail", path, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["progress"]["vps"] == 500
+
+
+def test_tail_follow_reads_growing_log_to_run_end(tmp_path, capsys):
+    """--follow consumes a log that is still being appended (including a
+    torn line that is later completed) and returns at run_end."""
+    path = str(tmp_path / "follow.jsonl")
+    manifest = _env(0, 0.0, "manifest", "m", tool="m", version="0",
+                    knobs={}, topology={})
+    hb = _env(1, 0.5, "heartbeat", "stream", chunks=1, records=10, vps=100)
+    end = _env(2, 1.0, "run_end", "m", status="ok", dur=1.0)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps(manifest) + "\n")
+        fh.write(json.dumps(hb) + "\n")
+        half = json.dumps(end)
+        fh.write(half[:20])
+        fh.flush()
+
+        def finish():
+            time.sleep(0.2)
+            fh.write(half[20:] + "\n")
+            fh.flush()
+
+        t = threading.Thread(target=finish)
+        t.start()
+        rc = obs_cli.run(["tail", path, "--follow", "--interval-s", "0.05"])
+        t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "heartbeat:" in out and "run_end: ok" in out
+
+
+def test_tail_in_flight_status(tmp_path, capsys):
+    path = _in_flight_log(tmp_path)
+    state = obs_cli.tail_state(export_mod.read_run(path))
+    assert state["run"]["status"] == "in-flight"
+
+
+# ---------------------------------------------------------------------------
+# Perfetto flow arrows
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_renders_flow_arrows(tmp_path):
+    run, path = _open_run(tmp_path, name="flow.jsonl")
+    tid = obs.new_trace()
+    a = obs.trace_span(tid, "ingest", 0.01)
+    obs.trace_span(tid, "score_stage", 0.2)
+    obs.end_run(run, "ok")
+    trace_json = export_mod.to_chrome_trace(export_mod.read_run(path))
+    evs = trace_json["traceEvents"]
+    slices = [e for e in evs if e.get("cat") == "trace" and e["ph"] == "X"]
+    assert len(slices) == 2
+    starts = [e for e in evs if e.get("cat") == "trace.flow"
+              and e["ph"] == "s"]
+    finishes = [e for e in evs if e.get("cat") == "trace.flow"
+                and e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"]
+    assert finishes[0]["bp"] == "e"
+    # the whole list is still ts-sorted (exporter invariant)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    assert a is not None

@@ -1112,3 +1112,227 @@ def test_dist_rank_timeout_point_is_wired():
     assert time.monotonic() - t0 >= 0.15
     np.testing.assert_array_equal(out, [1, 2, 3])
     assert faults.fired("dist.rank_timeout") == 1
+
+
+# ---------------------------------------------------------------------------
+# causal trace linkage under faults (ISSUE 11): every recovery-ladder
+# action names the trace of the chunk it recovers, and the trace id
+# resolves to that chunk's span DAG
+# ---------------------------------------------------------------------------
+
+
+def _trace_spans_by_id(events):
+    out = {}
+    for e in events:
+        if e["kind"] != "trace":
+            continue
+        for tid in (e.get("traces") or [e.get("trace_id")]):
+            out.setdefault(tid, []).append(e)
+    return out
+
+
+def test_streaming_chunk_traces_form_complete_dags(
+        stream_fault_world, clean_bytes, monkeypatch):
+    """A clean streaming run: every chunk's trace walks from the
+    sequenced-commit terminal span back to its ingest root, and the
+    critical-path engine reconstructs one path per chunk."""
+    from variantcalling_tpu.obs import critical as critical_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/traced.vcf"
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["n"] == w["n"]
+    assert open(out, "rb").read() == clean_bytes
+    events = _obs_events(out + ".obs.jsonl")
+    by_trace = _trace_spans_by_id(events)
+    assert len(by_trace) == stats["chunks"]
+    for tid, spans in by_trace.items():
+        names = [s["name"] for s in spans if s.get("trace_id") == tid
+                 or tid in (s.get("traces") or ())]
+        assert "ingest" in names and "writeback" in names, (tid, names)
+    cp = critical_mod.critical_path(events)
+    assert cp["chunks"] == stats["chunks"]
+    # each path must span ingest -> writeback (root chosen correctly)
+    for p in critical_mod.chunk_paths(events):
+        assert p["edges"][0]["edge"] == "ingest.work"
+        assert p["edges"][-1]["edge"] == "writeback.work"
+
+
+def test_chunk_retry_event_links_to_chunk_trace(
+        stream_fault_world, clean_bytes, monkeypatch):
+    """Acceptance (trace linkage): a transient chunk failure's
+    `recovery`/`chunk_retry` event carries the original chunk's
+    trace_id, and that id resolves to the chunk's spans."""
+    w = stream_fault_world
+    out = f"{w['dir']}/trace_retry.vcf"
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    monkeypatch.setenv("VCTPU_IO_THREADS", "1")
+    faults.arm("pipeline.chunk", times=1)  # one strike, then recovered
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["n"] == w["n"]
+    assert open(out, "rb").read() == clean_bytes
+    events = _obs_events(out + ".obs.jsonl")
+    retries = [e for e in events
+               if e["kind"] == "recovery" and e["name"] == "chunk_retry"]
+    assert retries, "no chunk_retry event"
+    by_trace = _trace_spans_by_id(events)
+    for e in retries:
+        assert "trace_id" in e, e
+        spans = by_trace.get(e["trace_id"])
+        assert spans, f"retry trace {e['trace_id']} resolves to no spans"
+        # the recovered chunk still completed: its DAG has the terminal
+        assert "writeback" in {s["name"] for s in spans}
+
+
+def test_quarantine_event_links_to_chunk_trace(
+        stream_fault_world, monkeypatch):
+    """Acceptance (trace linkage): the quarantine diversion names the
+    poisoned chunk's trace, which resolves to its ingest root."""
+    w = stream_fault_world
+    out = f"{w['dir']}/trace_quar.vcf"
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    monkeypatch.setenv("VCTPU_IO_THREADS", "1")
+    monkeypatch.setenv("VCTPU_QUARANTINE", "1")
+    faults.arm("pipeline.chunk", times=2)  # through the whole budget
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["quarantined_chunks"] == 1
+    events = _obs_events(out + ".obs.jsonl")
+    quar = [e for e in events
+            if e["kind"] == "recovery" and e["name"] == "quarantine"]
+    assert len(quar) == 1 and "trace_id" in quar[0]
+    spans = _trace_spans_by_id(events).get(quar[0]["trace_id"])
+    assert spans and "ingest" in {s["name"] for s in spans}
+    os.remove(out + ".quarantine")
+
+
+def test_mesh_fanin_spans_list_every_member_chunk(
+        stream_fault_world, clean_bytes, monkeypatch):
+    """Acceptance: a megabatch dispatch span is a FAN-IN — it lists
+    every member chunk's trace in `traces` and parents each member's
+    preceding span, so every chunk's DAG walks through the shared
+    dispatch."""
+    from variantcalling_tpu import engine as engine_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/trace_mesh.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "2")
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    engine_mod.reset_for_tests()
+    try:
+        stats = _run_stream(w, out, monkeypatch)
+        assert stats is not None and stats["n"] == w["n"]
+        events = _obs_events(out + ".obs.jsonl")
+        fanin = [e for e in events if e["kind"] == "trace"
+                 and e["name"] == "score_stage" and e.get("traces")]
+        assert fanin, "no fan-in dispatch span"
+        # every chunk trace appears in exactly one dispatch's fan-in
+        member_tids = [t for e in fanin for t in e["traces"]]
+        assert sorted(member_tids) == sorted(set(member_tids))
+        assert len(member_tids) == stats["chunks"]
+        # each fan-in parents every member's preceding span
+        spans_by_id = {e["span_id"]: e for e in events
+                       if e["kind"] == "trace"}
+        for e in fanin:
+            assert len(e.get("parents", [])) == len(e["traces"]), e
+            parent_traces = {spans_by_id[p]["trace_id"]
+                             for p in e["parents"]}
+            assert parent_traces == set(e["traces"])
+        # and a multi-chunk megabatch actually happened in this layout
+        assert any(len(e["traces"]) > 1 for e in fanin)
+    finally:
+        engine_mod.reset_for_tests()
+
+
+def test_mesh_oom_shrink_event_links_member_traces(
+        stream_fault_world, clean_bytes, monkeypatch):
+    """Acceptance (trace linkage): the OOM shrink rung's recovery event
+    lists the member chunks' trace_ids, each resolving to real spans,
+    and the per-chunk re-dispatches link their retries too."""
+    from variantcalling_tpu import engine as engine_mod
+
+    w = stream_fault_world
+    out = f"{w['dir']}/trace_oom.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "jit")
+    monkeypatch.setenv("VCTPU_MESH_DEVICES", "2")
+    monkeypatch.setenv("VCTPU_OBS", "1")
+    engine_mod.reset_for_tests()
+    try:
+        faults.arm("xla.dispatch_oom", times=1)
+        stats = _run_stream(w, out, monkeypatch)
+        assert stats is not None and stats["n"] == w["n"]
+        events = _obs_events(out + ".obs.jsonl")
+        shrink = [e for e in events if e["kind"] == "recovery"
+                  and e["name"] == "megabatch_shrink"]
+        assert len(shrink) == 1
+        tids = shrink[0].get("trace_ids")
+        assert tids, "shrink event carries no member traces"
+        by_trace = _trace_spans_by_id(events)
+        for tid in tids:
+            spans = by_trace.get(tid)
+            assert spans, f"shrink member {tid} resolves to no spans"
+            assert "ingest" in {s["name"] for s in spans}
+    finally:
+        engine_mod.reset_for_tests()
+
+
+def test_megabatch_split_links_traces_unit():
+    """The non-OOM SPLIT rung (driven directly): the group failure event
+    lists every member's trace, and the poison chunk's per-chunk retry
+    links its own trace via the bound scope."""
+    import tempfile
+
+    from variantcalling_tpu import obs
+    from variantcalling_tpu.parallel import shard_score
+
+    class _Tab:
+        def __init__(self, n):
+            self._n = n
+
+        def __len__(self):
+            return self._n
+
+    class _Plan:
+        devices = 2
+
+    class _Ctx:
+        mesh_plan = _Plan()
+
+        def __init__(self):
+            self.calls = 0
+
+        def score_packed(self, group):
+            self.calls += 1
+            if len(group) > 1:
+                raise RuntimeError("poison in the group")  # non-OOM
+            return [(t, "score", "filters") for t, _ in group]
+
+    d = tempfile.mkdtemp()
+    run = obs.start_run("split_unit", force_path=f"{d}/r.jsonl")
+    assert run is not None
+    try:
+        ctx = _Ctx()
+        pairs = []
+        for i in range(3):
+            t = _Tab(12000)  # 3 x 12000 crosses the 32768-row target: ONE group
+            t._obs_trace = obs.new_trace()
+            obs.trace_span(t._obs_trace, "ingest", 0.001)
+            pairs.append((t, f"hf{i}"))
+        out = list(shard_score.megabatch_stream(iter(pairs), ctx))
+        assert len(out) == 3  # split re-dispatched chunk by chunk
+    finally:
+        obs.end_run(run, "ok")
+    events = _obs_events(f"{d}/r.jsonl")
+    split = [e for e in events if e["kind"] == "recovery"
+             and e["name"] == "megabatch_split"]
+    assert len(split) == 1
+    tids = split[0]["trace_ids"]
+    assert len(tids) == 3
+    by_trace = _trace_spans_by_id(events)
+    assert all(tid in by_trace for tid in tids)
+    # the per-chunk fan-in spans after the split: one per chunk
+    fanin = [e for e in events if e["kind"] == "trace"
+             and e["name"] == "score_stage"]
+    assert len(fanin) == 3
+    assert [e["traces"] for e in fanin] == [[t] for t in tids]

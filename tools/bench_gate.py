@@ -62,6 +62,11 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("coverage.bp_per_sec", "higher", 0.10),
     ("train.wallclock_s", "lower", 0.10),
     ("obs.obs_overhead_pct", "budget", 2.0),     # the PR 5 <2% contract
+    # the overhead number must have been measured WITH the live plane ON
+    # (causal tracing; periodic snapshots ride the same legs) — a zero
+    # trace count means the budget gated a cheaper configuration than
+    # the one production runs pay (docs/observability.md)
+    ("obs.trace_events", "nonzero", 0.0),
     # -- host-IO layer (parallel-IO PR): the io phase isolates the three
     #    IO primitives, so an IO regression (a re-serialized shard loop,
     #    a lost zero-copy) gates independently of e2e noise ------------
@@ -130,6 +135,19 @@ def gate(candidate: dict, baseline: dict,
     for dotted, direction, band in METRICS:
         tol = tolerance_override if tolerance_override is not None else band
         cand = resolve_path(candidate, dotted)
+        if direction == "nonzero":
+            # a presence tripwire, not a comparison: the candidate must
+            # have measured a strictly positive value (no baseline read,
+            # so pre-feature baselines never fail it retroactively)
+            if cand is None:
+                skipped.append(dotted)
+                continue
+            checks.append({
+                "metric": dotted, "candidate": cand,
+                "direction": "nonzero",
+                "regressed": not cand > 0,
+            })
+            continue
         if direction == "budget":
             if cand is None:
                 skipped.append(dotted)
@@ -168,7 +186,10 @@ def render(report: dict) -> str:
     lines = ["bench gate:"]
     for c in report["checks"]:
         mark = "REGRESSED" if c["regressed"] else "ok"
-        if c["direction"] == "budget":
+        if c["direction"] == "nonzero":
+            lines.append(f"  {c['metric']:<28} {c['candidate']:>12} "
+                         f"(must be > 0)  {mark}")
+        elif c["direction"] == "budget":
             lines.append(f"  {c['metric']:<28} {c['candidate']:>12} "
                          f"(budget <= {c['budget']})  {mark}")
         else:

@@ -65,11 +65,34 @@ def main() -> int:
                   wait_in_s=0.1, wait_out_s=0.0, items=1, records=128)
         obs.event("profile", "pipeline", wall_s=0.6, records=128,
                   stages=["score_stage"], bytes_in=1024, bytes_out=2048)
+        # causal-tracing producers (the live-telemetry plane): one chunk
+        # DAG — ingest root, a fan-in score dispatch, the sequenced
+        # commit — plus a recovery event carrying the trace linkage and
+        # an in-run periodic metrics snapshot (kind=snapshot)
+        errors_pre: list[str] = []
+        tid = obs.new_trace()
+        if tid is None:
+            errors_pre.append("tracing inactive under force_path "
+                              "(VCTPU_OBS_TRACE default must be on)")
+        else:
+            root = obs.trace_span(tid, "ingest", 0.01, records=128)
+            obs.trace_span(tid, "score_stage", 0.5, parents=[root],
+                           traces=[tid], chunks=1, rows=128)
+            with obs.trace_scope(tid):
+                obs.event("recovery", "chunk_retry", what="score_stage",
+                          attempt=1, retries=1, chunk=0,
+                          trace_id=obs.current_trace(), error="X: injected")
+            obs.trace_span(tid, "writeback", 0.02, chunk=0, bytes_out=2048)
+            obs.end_trace(tid)
+        run._last_snapshot -= 1e9  # open the throttle: snapshot NOW
+        if run._snapshot_s <= 0:
+            run._snapshot_s = 10.0
+        run._maybe_snapshot()
         obs.end_run(run, "ok")
 
         with open(path, encoding="utf-8") as fh:
             lines = fh.read().splitlines()
-        errors = schema.validate_lines(lines)
+        errors = errors_pre + schema.validate_lines(lines)
         # the stream must actually contain every producer's kind — a
         # silently-dropped event class would otherwise "validate"
         import json
@@ -77,9 +100,37 @@ def main() -> int:
         parsed = [json.loads(ln) for ln in lines]
         kinds = {e["kind"] for e in parsed}
         for required in ("manifest", "span", "degrade", "fault", "heartbeat",
-                         "journal", "profile", "metrics", "run_end"):
+                         "journal", "profile", "trace", "snapshot",
+                         "recovery", "metrics", "run_end"):
             if required not in kinds:
                 errors.append(f"stream is missing a {required!r} event")
+        # causal-trace integrity: the recovery event's trace_id must
+        # resolve to emitted trace spans, the fan-in span must list its
+        # member trace and parent, and the rolling-window quantiles must
+        # ride every histogram snapshot (live-plane contract)
+        trace_evs = [e for e in parsed if e["kind"] == "trace"]
+        span_ids = {e.get("span_id") for e in trace_evs}
+        for e in parsed:
+            if e["kind"] == "recovery" and "trace_id" in e:
+                if not any(t.get("trace_id") == e["trace_id"]
+                           for t in trace_evs):
+                    errors.append(f"recovery event trace_id {e['trace_id']!r}"
+                                  " resolves to no trace span")
+        for e in trace_evs:
+            for parent in e.get("parents", ()):
+                if parent not in span_ids:
+                    errors.append(f"trace span {e.get('span_id')!r} parent "
+                                  f"{parent!r} is not an emitted span")
+        fanin = [e for e in trace_evs if e.get("traces")]
+        if not fanin:
+            errors.append("no fan-in trace span (traces field) in the "
+                          "generated stream")
+        snap_evs = [e for e in parsed if e["kind"] == "snapshot"]
+        for e in snap_evs:
+            for hname, snap in (e.get("histograms") or {}).items():
+                if "rolling" not in snap:
+                    errors.append(f"snapshot histogram {hname!r} lacks the "
+                                  "rolling-window block")
         # histogram snapshots must carry the SLO percentiles (obs v2)
         metrics_ev = [e for e in parsed if e["kind"] == "metrics"]
         hists = metrics_ev[-1]["histograms"] if metrics_ev else {}
@@ -109,6 +160,18 @@ def main() -> int:
         if b.get("limiting_stage") != "score_stage":
             errors.append("bottleneck roll-up did not name the profiled "
                           f"stage (got {b.get('limiting_stage')!r})")
+        # the critical-path engine must walk the generated chunk DAG and
+        # name the seeded dominant edge (score_stage.work, dur 0.5)
+        from variantcalling_tpu.obs import critical
+
+        cp = critical.critical_path(events)
+        if cp.get("chunks") != 1:
+            errors.append(f"critical-path found {cp.get('chunks')} chunk "
+                          "trace(s), expected 1")
+        elif cp.get("dominant_p95_edge") != "score_stage.work":
+            errors.append("critical-path dominant edge is "
+                          f"{cp.get('dominant_p95_edge')!r}, expected "
+                          "'score_stage.work'")
 
     if errors:
         for err in errors:

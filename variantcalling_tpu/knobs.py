@@ -202,6 +202,27 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
     _k("VCTPU_OBS_JAXPROF", "bool", False,
        "capture a jax.profiler device trace (<run log>.jaxprof/) "
        "alongside the obs stream for side-by-side Perfetto loading"),
+    _k("VCTPU_OBS_TRACE", "bool", True,
+       "causal chunk tracing when VCTPU_OBS=1: per-chunk trace ids, "
+       "per-stage trace spans with parent links (the walkable DAG "
+       "vctpu obs critical-path consumes); 0 opts out "
+       "(docs/observability.md)"),
+    _k("VCTPU_OBS_SNAPSHOT_S", "float", 10.0,
+       "minimum seconds between periodic in-run metrics snapshots "
+       "(kind=snapshot, emitted on the event-flush cadence; the live "
+       "plane for vctpu obs tail/prom); 0 disables", minimum=0.0),
+    _k("VCTPU_OBS_WINDOW_S", "float", 60.0,
+       "rolling-window span of the windowed histogram quantiles "
+       "(rolling p50/p95/p99 mean 'the last ~window', not all-of-run)",
+       minimum=1.0),
+    _k("VCTPU_OBS_MAX_MB", "int", None,
+       "obs run-log size cap in MB: the stream rotates to .seg1/.seg2/"
+       "... segments at the cap (readers merge segments transparently); "
+       "unset = one unbounded file", positive=True),
+    _k("VCTPU_OBS_PROM_FILE", "str", "",
+       "Prometheus textfile-collector path: every periodic snapshot "
+       "atomically rewrites this file with the text exposition "
+       "(vctpu obs prom is the offline sibling)"),
     _k("VCTPU_BENCH_GATE", "bool", False,
        "run_tests.sh: run the opt-in bench regression gate stage "
        "(tools/bench_gate.py) before pytest"),

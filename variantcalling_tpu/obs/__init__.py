@@ -40,20 +40,35 @@ cost_analysis); ``VCTPU_OBS_JAXPROF=1`` additionally captures a
 ``jax.profiler`` device trace next to the run log so host and device
 timelines load side by side in Perfetto.
 
+The LIVE telemetry plane (docs/observability.md) rides the same gate:
+``VCTPU_OBS_TRACE`` (default on) threads a causal trace through every
+chunk's lifecycle — per-chunk trace ids, per-stage ``trace`` spans with
+parent links, megabatch fan-in, recovery linkage — the walkable DAG
+``vctpu obs critical-path`` consumes; ``VCTPU_OBS_SNAPSHOT_S`` emits
+periodic in-run ``snapshot`` metrics (rolling-window quantiles from
+``VCTPU_OBS_WINDOW_S``) on the event-flush cadence; ``VCTPU_OBS_MAX_MB``
+rotates the log to ``.segN`` segments at the cap; and
+``VCTPU_OBS_PROM_FILE`` atomically rewrites a Prometheus textfile on
+every snapshot (``vctpu obs tail --follow`` / ``vctpu obs prom`` are
+the reader-side faces).
+
 Abnormal exits: the first ``start_run`` registers an ``atexit`` hook
 plus SIGTERM and SIGINT handlers that flush the metrics snapshot and
 ``run_end`` event before the process dies (then re-deliver the signal
 with the default disposition — the exit code still says killed-by-
 signal), so only a SIGKILL can truncate a stream (the PR 2 SIGKILL
-tests own that case — resume recovers the output, and ``vctpu obs
-summary`` reports a truncated stream as ``incomplete``).
+tests own that case — resume recovers the output, and every obs reader
+tolerates the torn tail: ``vctpu obs summary``/``tail`` report such a
+stream as ``in-flight``).
 """
 
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
+import re
 import signal
 import threading
 import time
@@ -65,6 +80,11 @@ from variantcalling_tpu.obs.schema import SCHEMA_VERSION
 OBS_ENV = "VCTPU_OBS"
 OBS_PATH_ENV = "VCTPU_OBS_PATH"
 JAXPROF_ENV = "VCTPU_OBS_JAXPROF"
+TRACE_ENV = "VCTPU_OBS_TRACE"
+SNAPSHOT_ENV = "VCTPU_OBS_SNAPSHOT_S"
+WINDOW_ENV = "VCTPU_OBS_WINDOW_S"
+MAX_MB_ENV = "VCTPU_OBS_MAX_MB"
+PROM_FILE_ENV = "VCTPU_OBS_PROM_FILE"
 
 #: flush the stream every this many events (plus manifest and run end) —
 #: a crash loses at most one flush window, without per-event fsync cost
@@ -72,11 +92,18 @@ FLUSH_EVERY = 32
 
 #: module fast flag — hot sites check this before doing ANY other work
 _ACTIVE = False
+#: causal-tracing fast flag: True while a run with tracing is open
+#: (``VCTPU_OBS_TRACE``, default on) — the one check trace sites pay
+_TRACING = False
 _RUN: "ObsRun | None" = None
 # re-entrant: the SIGTERM flush handler may fire while the main thread is
 # already inside start_run/end_run — a plain Lock would self-deadlock the
 # dying process
 _LOCK = threading.RLock()
+
+#: trace-id spelling (``t<N>``, run-scoped) — obs.trace_of recognizes a
+#: bare id threaded through a stage-item tuple by this shape
+_TRACE_ID_RE = re.compile(r"^t\d+$")
 
 
 def enabled() -> bool:
@@ -96,7 +123,7 @@ class ObsRun:
     def __init__(self, path: str, tool: str):
         self.path = path
         self.tool = tool
-        self.metrics = MetricsRegistry()
+        self.metrics = MetricsRegistry(window_s=knobs.get_float(WINDOW_ENV))
         #: obs v2 attachments, owned by start_run/end_run: the resource
         #: watermark sampler and the jax.profiler trace dir (if any)
         self.sampler = None
@@ -104,6 +131,28 @@ class ObsRun:
         #: (strategy, kind) pairs whose cost_analysis already emitted —
         #: the per-chunk scoring loop must pay the lower+compile ONCE
         self.cost_recorded: set = set()
+        #: causal-tracing state (docs/observability.md "Causal chunk
+        #: tracing"): run-scoped id counters plus the per-trace cursor —
+        #: trace id -> last span id, so the next stage span of a chunk
+        #: knows its parent. Cursor writes are GIL-atomic dict item
+        #: assignments, and a chunk's stages execute strictly in
+        #: sequence (megabatch fan-in goes through ONE dispatch thread),
+        #: so no two threads ever race one trace's cursor.
+        self.tracing = knobs.get_bool(TRACE_ENV)
+        self.traces: dict[str, str] = {}
+        self._trace_n = itertools.count()
+        self._span_n = itertools.count()
+        #: live-plane state: periodic snapshot throttle + segment
+        #: rotation bookkeeping + the Prometheus textfile target
+        self._snapshot_s = knobs.get_float(SNAPSHOT_ENV)
+        self._last_snapshot = time.perf_counter()
+        self._in_snapshot = False
+        self._closing = False
+        max_mb = knobs.get_int(MAX_MB_ENV)
+        self._max_bytes = (max_mb or 0) << 20
+        self._bytes = 0
+        self._seg = 0
+        self.prom_path = knobs.get_str(PROM_FILE_ENV) or None
         self._fh = open(path, "w", encoding="utf-8")
         # re-entrant for the same reason as the module _LOCK: the SIGTERM
         # flush can land while this thread is mid-_emit
@@ -119,6 +168,7 @@ class ObsRun:
     def _emit(self, kind: str, name: str, fields: dict, flush: bool = False) -> None:
         pid = os.getpid()
         tid = threading.get_ident()
+        flushed = False
         with self._lock:
             # timestamped INSIDE the lock: file order == seq order == ts order
             t = time.perf_counter() - self._t0_mono
@@ -128,23 +178,96 @@ class ObsRun:
                          kind=kind, name=name, pid=pid, tid=tid)
             self._seq += 1
             try:
-                self._fh.write(json.dumps(event) + "\n")
+                line = json.dumps(event) + "\n"
+                self._fh.write(line)
+                self._bytes += len(line)
                 self._since_flush += 1
                 if flush or self._since_flush >= FLUSH_EVERY:
                     self._fh.flush()
                     self._since_flush = 0
+                    flushed = True
+                if self._max_bytes and self._bytes >= self._max_bytes:
+                    self._rotate()
             except ValueError:
                 # a straggler event racing end_run's file close: telemetry
                 # must never throw into the recording (worker) thread
                 pass
+        if flushed:
+            # the live plane rides the existing flush cadence: every
+            # FLUSH_EVERY events the throttle below may emit an in-run
+            # metrics snapshot (kind=snapshot) so an external tail/prom
+            # reader sees fresh rolling quantiles without a new thread
+            self._maybe_snapshot()
+
+    def _rotate(self) -> None:
+        """Segment rollover (``VCTPU_OBS_MAX_MB``): close the current
+        file and continue the SAME ordered stream (seq keeps counting)
+        in ``<path>.seg<N>`` — readers merge segments exactly like
+        ``.rankN`` siblings. Called with the event lock held."""
+        try:
+            nxt = open(f"{self.path}.seg{self._seg + 1}", "w",
+                       encoding="utf-8")
+        except OSError as e:
+            # rotation failing must never lose events: disable the cap
+            # and keep writing the current segment
+            self._max_bytes = 0
+            logger.warning("obs: cannot open rotation segment for %s: %s — "
+                           "size cap disabled for this run", self.path, e)
+            return
+        old, self._fh = self._fh, nxt
+        self._seg += 1
+        self._bytes = 0
+        self._since_flush = 0
+        try:
+            old.close()
+        except OSError:
+            pass
+
+    def _maybe_snapshot(self) -> None:
+        """Throttled periodic in-run metrics snapshot (the live plane's
+        heartbeat): at most one per ``VCTPU_OBS_SNAPSHOT_S``, emitted on
+        the event-flush cadence — an idle stream emits none, a busy one
+        emits on schedule. Also rewrites the Prometheus textfile when
+        ``VCTPU_OBS_PROM_FILE`` is set."""
+        if self._snapshot_s <= 0 or self._in_snapshot or self._closing:
+            return
+        now = time.perf_counter()
+        if now - self._last_snapshot < self._snapshot_s:
+            return
+        self._in_snapshot = True
+        try:
+            self._last_snapshot = now
+            snap = self.metrics.snapshot()
+            self._emit("snapshot", "metrics", snap, flush=True)
+            self._write_prom(snap, in_flight=True)
+        finally:
+            self._in_snapshot = False
+
+    def _write_prom(self, snap: dict, in_flight: bool) -> None:
+        if not self.prom_path:
+            return
+        from variantcalling_tpu.obs import prom
+        from variantcalling_tpu.utils import degrade
+
+        try:
+            prom.write_textfile(
+                self.prom_path,
+                prom.snapshot_to_prom(snap, tool=self.tool,
+                                      in_flight=in_flight))
+        except OSError as e:
+            degrade.record("obs.prom_write", e,
+                           fallback="Prometheus textfile skipped")
 
     def close(self, status: str) -> None:
+        self._closing = True  # run_end must be the stream's last event
         with self._lock:
             dur = time.perf_counter() - self._t0_mono
-        self._emit("metrics", "final", self.metrics.snapshot())
+        snap = self.metrics.snapshot()
+        self._emit("metrics", "final", snap)
         self._emit("run_end", self.tool, {"status": status,
                                           "dur": round(dur, 6)}, flush=True)
         self._fh.close()
+        self._write_prom(snap, in_flight=False)
 
 
 def _rank_suffixed(path: str) -> str:
@@ -170,7 +293,7 @@ def start_run(tool: str, default_path: str | None = None,
     ``force_path`` bypasses the ``VCTPU_OBS`` gate — for the tier-0
     schema check and tests that must record regardless of environment.
     """
-    global _ACTIVE, _RUN
+    global _ACTIVE, _RUN, _TRACING
     if force_path is None and not enabled():
         return None
     with _LOCK:
@@ -192,6 +315,7 @@ def start_run(tool: str, default_path: str | None = None,
                                                    inputs=inputs), flush=True)
         _RUN = run
         _ACTIVE = True
+        _TRACING = run.tracing
         _register_flush_handlers()
         if knobs.get_bool(profile_mod().PROFILE_ENV):
             # RSS/CPU watermark sampler (obs v2): daemon thread, stopped
@@ -207,7 +331,7 @@ def start_run(tool: str, default_path: str | None = None,
 def end_run(run: ObsRun | None, status: str = "ok") -> None:
     """Close the stream opened by the matching :func:`start_run` (no-op
     for joiners, who were handed None)."""
-    global _ACTIVE, _RUN
+    global _ACTIVE, _RUN, _TRACING
     if run is None:
         return
     with _LOCK:
@@ -224,6 +348,7 @@ def end_run(run: ObsRun | None, status: str = "ok") -> None:
         if run.jaxprof_dir is not None:
             _stop_jaxprof(run)
         _ACTIVE = False
+        _TRACING = False
         _RUN = None
     try:
         run.close(status)
@@ -366,6 +491,130 @@ def span(name: str, dur: float, thread: str, depth: int = 0, **fields) -> None:
     if run is not None:
         run._emit("span", name, dict(fields, dur=round(dur, 6),
                                      thread=thread, depth=depth))
+
+
+# -- causal chunk tracing (docs/observability.md "Causal chunk tracing") ---
+#
+# Every chunk gets a TRACE at ingest; every stage execution appends a
+# trace span carrying (trace_id, span_id, parents) so the chunk's full
+# history — including megabatch fan-in, retries and recovery actions —
+# is a walkable DAG. `vctpu obs critical-path` consumes it; the Perfetto
+# exporter renders the parent links as flow arrows.
+
+_TRACE_TLS = threading.local()
+
+
+def tracing() -> bool:
+    """Is causal tracing recording (an open run with VCTPU_OBS_TRACE on)?
+    The ONE check trace sites pay before any other work."""
+    return _TRACING
+
+
+def new_trace() -> str | None:
+    """Allocate a fresh run-scoped trace id (one per chunk, at ingest);
+    None when tracing is off."""
+    run = _RUN if _TRACING else None
+    if run is None:
+        return None
+    return f"t{next(run._trace_n)}"
+
+
+def trace_span(tid: str | None, name: str, dur: float,
+               parents: list[str] | None = None,
+               traces: list[str] | None = None, **fields) -> str | None:
+    """Record one causal span of trace ``tid`` and advance the trace's
+    cursor so the chunk's NEXT span parents to this one.
+
+    ``parents`` overrides the implicit parent (the trace's cursor);
+    ``traces`` marks a FAN-IN span (one megabatch dispatch serving many
+    chunks): the event lists every member trace id, its parents are each
+    member's cursor, and every member's cursor advances to this span —
+    the DAG edge set `vctpu obs critical-path` walks. Returns the new
+    span id (None when tracing is off)."""
+    run = _RUN if _TRACING else None
+    if run is None or tid is None:
+        return None
+    sid = f"s{next(run._span_n)}"
+    if parents is None:
+        last = run.traces.get(tid)
+        parents = [last] if last is not None else []
+    body = dict(fields, trace_id=tid, span_id=sid, dur=round(dur, 6))
+    if parents:
+        body["parents"] = list(parents)
+    if traces:
+        body["traces"] = list(traces)
+    run._emit("trace", name, body)
+    for t in (traces if traces else (tid,)):
+        run.traces[t] = sid
+    return sid
+
+
+def trace_cursor(tid: str | None) -> str | None:
+    """The trace's current last-span id (fan-in callers collect these as
+    the dispatch span's parents)."""
+    run = _RUN if _TRACING else None
+    if run is None or tid is None:
+        return None
+    return run.traces.get(tid)
+
+
+def end_trace(tid: str | None) -> None:
+    """Drop the trace's cursor (the chunk committed — its DAG is done);
+    keeps the per-run cursor table bounded at in-flight chunks."""
+    run = _RUN if _TRACING else None
+    if run is not None and tid is not None:
+        run.traces.pop(tid, None)
+
+
+def set_current_trace(tid: str | None) -> None:
+    """Bind ``tid`` as this thread's current chunk trace — recovery
+    sites (retry_chunk, quarantine) read it to link their events to the
+    chunk they are recovering."""
+    _TRACE_TLS.tid = tid  # vctpu-lint: disable=VCT010 — threading.local IS a per-thread cell (the obs/metrics pattern); no cross-thread visibility exists
+
+
+def current_trace() -> str | None:
+    """This thread's current chunk trace id (None outside a chunk body
+    or with tracing off)."""
+    return getattr(_TRACE_TLS, "tid", None)
+
+
+class trace_scope:
+    """Context manager: bind a chunk's trace id to this thread for the
+    duration of its stage body (restores the previous binding, so nested
+    bodies and pool workers reusing a thread stay correct)."""
+
+    __slots__ = ("tid", "_prev")
+
+    def __init__(self, tid: str | None):
+        self.tid = tid
+
+    def __enter__(self):
+        self._prev = getattr(_TRACE_TLS, "tid", None)
+        _TRACE_TLS.tid = self.tid  # vctpu-lint: disable=VCT010 — threading.local IS a per-thread cell (the obs/metrics pattern); no cross-thread visibility exists
+        return self.tid
+
+    def __exit__(self, *exc):
+        _TRACE_TLS.tid = self._prev  # vctpu-lint: disable=VCT010 — threading.local IS a per-thread cell (the obs/metrics pattern); no cross-thread visibility exists
+        return False
+
+
+def trace_of(item) -> str | None:
+    """Best-effort trace id of a stage item: the ``_obs_trace`` attribute
+    a traced chunk table carries, or — for the render/compress tuples —
+    a bare ``t<N>`` id threaded through the tuple. The watchdog uses this
+    to link its re-dispatch events to the wedged chunk's trace."""
+    tid = getattr(item, "_obs_trace", None)
+    if isinstance(tid, str):
+        return tid
+    if isinstance(item, tuple):
+        for x in item:
+            tid = getattr(x, "_obs_trace", None)
+            if isinstance(tid, str):
+                return tid
+            if isinstance(x, str) and _TRACE_ID_RE.match(x):
+                return x
+    return None
 
 
 def counter(name: str):

@@ -1,24 +1,36 @@
-"""CLI: ``vctpu obs <export|summary|bottleneck|diff>`` — open any obs
-run log in Perfetto, roll it up in the terminal, name the limiting
-stage, or diff two runs with a noise band.
+"""CLI: ``vctpu obs
+<export|summary|bottleneck|critical-path|diff|tail|prom>`` — open any
+obs run log in Perfetto, roll it up in the terminal, name the limiting
+stage or the dominant critical-path edge, diff two runs with a noise
+band, tail an in-flight run, or render a Prometheus text exposition.
 
-Multi-rank runs are merged transparently: every subcommand reads the
-given log PLUS any ``.rankN`` sibling logs (one timeline, rank as the
-Perfetto pid — docs/observability.md "Multi-host runs").
+Multi-rank runs and size-capped rotation segments are merged
+transparently: every subcommand reads the given log PLUS any ``.rankN``
+sibling logs and ``.segN`` rotation segments (one timeline, rank as the
+Perfetto pid — docs/observability.md "Multi-host runs" / "Log rotation").
+Every reader tolerates an IN-FLIGHT log — a truncated final line is
+dropped and a missing ``run_end`` reports status ``in-flight`` instead
+of stack-tracing (``tail --follow`` is built on exactly that).
 
 Exit codes follow the repo-wide CLI contract: 0 success, 2 usage error /
 unreadable or malformed log (argparse's own usage failures also exit 2).
 ``diff`` additionally exits 1 when the candidate regresses beyond the
 noise band — the sentry contract shared with ``tools/bench_gate.py``.
-Covered by ``tests/unit/test_obs.py`` / ``test_obs_profile.py``.
+Covered by ``tests/unit/test_obs.py`` / ``test_obs_profile.py`` /
+``test_obs_trace.py``.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
+import time
 
+from variantcalling_tpu.obs import critical as critical_mod
 from variantcalling_tpu.obs import export as export_mod
+from variantcalling_tpu.obs import prom as prom_mod
 from variantcalling_tpu.utils.jsonio import emit_json
 
 
@@ -51,6 +63,34 @@ def get_parser() -> argparse.ArgumentParser:
     bott.add_argument("--json", action="store_true",
                       help="emit the attribution as JSON")
 
+    crit = sub.add_parser("critical-path",
+                          help="per-chunk critical-path attribution from "
+                               "the causal trace DAG: which work/wait "
+                               "edges dominate p50/p95 chunk latency")
+    crit.add_argument("log", help="obs run log (JSONL)")
+    crit.add_argument("--json", action="store_true",
+                      help="emit the roll-up as JSON")
+
+    tail = sub.add_parser("tail",
+                          help="progress/SLO view of an (in-flight) run "
+                               "log; --follow keeps reading as it grows")
+    tail.add_argument("log", help="obs run log (JSONL; may be growing)")
+    tail.add_argument("--follow", action="store_true",
+                      help="poll the log until run_end (Ctrl-C to stop)")
+    tail.add_argument("--interval-s", type=float, default=1.0,
+                      help="--follow poll interval (default %(default)s)")
+    tail.add_argument("--json", action="store_true",
+                      help="emit the (non-follow) tail state as JSON")
+
+    pr = sub.add_parser("prom",
+                        help="Prometheus text exposition of the run's "
+                             "latest metrics state (in-flight snapshots "
+                             "included)")
+    pr.add_argument("log", help="obs run log (JSONL)")
+    pr.add_argument("-o", "--output", default=None,
+                    help="write atomically to this textfile-collector "
+                         "path instead of stdout")
+
     diff = sub.add_parser("diff",
                           help="compare a candidate run against a baseline "
                                "run with an explicit noise band; exit 1 on "
@@ -66,12 +106,173 @@ def get_parser() -> argparse.ArgumentParser:
 
 
 def _load(path: str) -> list[dict]:
-    # read_run merges .rankN siblings into one timeline
+    # read_run merges .rankN siblings AND .segN rotation segments
     return export_mod.read_run(path)
+
+
+def tail_state(events: list[dict]) -> dict:
+    """The compact progress/SLO view ``vctpu obs tail`` renders: run
+    status, last heartbeat, recovery counts, and the freshest rolling
+    quantiles (from the last periodic snapshot or final metrics)."""
+    summary = export_mod.summarize(events)
+    snap = next((e for e in reversed(events)
+                 if e.get("kind") in ("snapshot", "metrics")), None)
+    rolling = {}
+    if snap is not None:
+        for name, h in (snap.get("histograms") or {}).items():
+            r = h.get("rolling") if isinstance(h, dict) else None
+            if isinstance(r, dict) and r.get("count"):
+                rolling[name] = {k: r.get(k)
+                                 for k in ("window_s", "count", "p50",
+                                           "p95", "p99")}
+    # multi-rank merged timelines: each rank reported its own progress —
+    # SUM the per-rank last heartbeats (the summarize() rule), so the
+    # progress line and the summary's record total cannot contradict
+    last_hb_by_rank: dict = {}
+    for e in events:
+        if e.get("kind") == "heartbeat":
+            last_hb_by_rank[e.get("rank", 0)] = e
+    progress: dict = {}
+    if last_hb_by_rank:
+        hbs = list(last_hb_by_rank.values())
+        for key in ("chunks", "records", "records_pass"):
+            vals = [hb[key] for hb in hbs if key in hb]
+            if vals:
+                progress[key] = sum(vals)
+        for key in ("vps", "pct", "eta_s"):  # rate/pct don't sum: report
+            vals = [hb[key] for hb in hbs if key in hb]  # the mean
+            if vals:
+                progress[key] = round(sum(vals) / len(vals), 2)
+    return {
+        "run": summary["run"],
+        "progress": progress,
+        "recoveries": summary.get("recoveries", {}),
+        "degradations": summary.get("degradations", {}),
+        "rolling": rolling,
+        "snapshots": sum(1 for e in events if e.get("kind") == "snapshot"),
+    }
+
+
+def render_tail(state: dict) -> str:
+    run = state["run"]
+    lines = [f"run: {run.get('tool')} — {run.get('status')} "
+             f"({run.get('events')} events, {run.get('duration_s')}s)"]
+    p = state["progress"]
+    if p:
+        bits = [f"chunks={p.get('chunks')}", f"records={p.get('records')}"]
+        if "vps" in p:
+            bits.append(f"vps={p['vps']}")
+        if "pct" in p:
+            bits.append(f"pct={p['pct']}")
+        if "eta_s" in p:
+            bits.append(f"eta_s={p['eta_s']}")
+        lines.append("progress: " + " ".join(bits))
+    for name, r in sorted(state["rolling"].items()):
+        lines.append(f"rolling[{name}] (last ~{r.get('window_s')}s, "
+                     f"n={r.get('count')}): p50={r.get('p50')} "
+                     f"p95={r.get('p95')} p99={r.get('p99')}")
+    if state["recoveries"]:
+        lines.append("recovery actions: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(state["recoveries"].items())))
+    return "\n".join(lines)
+
+
+def _render_live_event(e: dict) -> str | None:
+    """One follow-mode line per interesting event (None = stay quiet)."""
+    kind = e.get("kind")
+    if kind == "heartbeat":
+        bits = [f"chunks={e.get('chunks')}", f"records={e.get('records')}"]
+        for key in ("vps", "pct", "eta_s"):
+            if key in e:
+                bits.append(f"{key}={e[key]}")
+        return "heartbeat: " + " ".join(bits)
+    if kind == "recovery":
+        extra = f" trace={e['trace_id']}" if "trace_id" in e else ""
+        return f"recovery: {e.get('name')}{extra}"
+    if kind == "degrade":
+        return f"degrade: {e.get('name')} ({e.get('fallback')})"
+    if kind == "snapshot":
+        # headline: the busiest rolling histogram, whatever the tool
+        # named its stages (the same generic rule tail_state applies)
+        best_name, best = None, None
+        for name, h in (e.get("histograms") or {}).items():
+            r = h.get("rolling") if isinstance(h, dict) else None
+            if isinstance(r, dict) and r.get("count"):
+                if best is None or r["count"] > best["count"]:
+                    best_name, best = name, r
+        if best is not None:
+            return (f"snapshot: rolling {best_name} p95={best.get('p95')} "
+                    f"(n={best['count']})")
+        return "snapshot: metrics"
+    if kind == "run_end":
+        return f"run_end: {e.get('status')} after {e.get('dur')}s"
+    return None
+
+
+def _follow(path: str, interval_s: float) -> int:
+    """Poll a growing JSONL (tolerating a partially-written final line),
+    printing live lines until ``run_end`` lands. A size-capped run
+    rotates to ``.segN`` — when the current file stops growing and the
+    next segment exists, the tail switches to it. A log that does not
+    exist YET is waited for (announced once — the run may not have
+    started); any other unreadable-path error exits 2 like every other
+    subcommand."""
+    import errno
+
+    current = path
+    seg = 0
+    offset = 0
+    buf = ""
+    announced_wait = False
+    while True:
+        try:
+            with open(current, encoding="utf-8") as fh:
+                fh.seek(offset)
+                chunk = fh.read()
+                offset = fh.tell()
+        except OSError as e:
+            if e.errno != errno.ENOENT:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            if not announced_wait:
+                # a silent spin on a typo'd path would look like a hung
+                # run: say what is being waited for, once
+                print(f"waiting for {current} (no such file yet)",
+                      file=sys.stderr)
+                announced_wait = True
+            time.sleep(interval_s)
+            continue
+        buf += chunk
+        *complete, buf = buf.split("\n")
+        for line in complete:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # torn line mid-file: the writer will re-land it
+            out = _render_live_event(e)
+            if out:
+                print(out, flush=True)
+            if e.get("kind") == "run_end":
+                return 0
+        if not chunk:
+            nxt = f"{path}.seg{seg + 1}"
+            if os.path.exists(nxt):
+                seg += 1
+                current, offset, buf = nxt, 0, ""
+                continue
+            time.sleep(interval_s)
 
 
 def run(argv: list[str]) -> int:
     args = get_parser().parse_args(argv)
+    if args.command == "tail" and args.follow:
+        try:
+            return _follow(args.log, args.interval_s)
+        except KeyboardInterrupt:
+            return 0
     try:
         if args.command == "diff":
             candidate = _load(args.candidate)
@@ -81,6 +282,32 @@ def run(argv: list[str]) -> int:
     except (OSError, export_mod.ObsLogError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.command == "critical-path":
+        cp = critical_mod.critical_path(events)
+        if args.json:
+            emit_json(cp)
+        else:
+            print(critical_mod.render(cp))
+        return 0
+    if args.command == "tail":
+        state = tail_state(events)
+        if args.json:
+            emit_json(state)
+        else:
+            print(render_tail(state))
+        return 0
+    if args.command == "prom":
+        text = prom_mod.events_to_prom(events)
+        if args.output:
+            try:
+                prom_mod.write_textfile(args.output, text)
+            except OSError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            print(f"wrote {args.output}")
+        else:
+            sys.stdout.write(text)
+        return 0
     if args.command == "export":
         out_path = args.output or f"{args.log}.trace.json"
         trace = export_mod.to_chrome_trace(events)

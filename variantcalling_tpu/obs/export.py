@@ -42,23 +42,41 @@ class ObsLogError(ValueError):
     """The file is not a readable obs run log."""
 
 
-def read_events(path: str) -> list[dict]:
-    """Parse one obs JSONL log; raises :class:`ObsLogError` on garbage
-    (missing file surfaces as OSError for the CLI to map to exit 2)."""
+def read_events(path: str, continuation: bool = False) -> list[dict]:
+    """Parse one obs JSONL file; raises :class:`ObsLogError` on garbage
+    (missing file surfaces as OSError for the CLI to map to exit 2).
+
+    A malformed or non-event FINAL line is DROPPED, not raised: an
+    in-flight or crashed run's last line is routinely a partial write,
+    and every reader (summary/bottleneck/diff/export/tail) must tolerate
+    it — mid-file garbage still raises. ``continuation=True`` marks a
+    rotation segment (``.segN``): an empty file is then legal (a run
+    killed right after rotating) and returns ``[]``.
+    """
     events: list[dict] = []
+    # streaming parse with ONE line of lookahead: a bad line is held as
+    # pending and only raised when a LATER non-empty line proves it was
+    # mid-file garbage — at EOF the held line is the torn tail and drops
+    pending_error: str | None = None
     with open(path, encoding="utf-8") as fh:
         for i, line in enumerate(fh, 1):
             line = line.strip()
             if not line:
                 continue
+            if pending_error is not None:
+                raise ObsLogError(pending_error)
             try:
                 event = json.loads(line)
             except ValueError as e:
-                raise ObsLogError(f"{path}:{i}: not JSON: {e}") from None
+                pending_error = f"{path}:{i}: not JSON: {e}"
+                continue
             if not isinstance(event, dict) or "kind" not in event:
-                raise ObsLogError(f"{path}:{i}: not an obs event")
+                pending_error = f"{path}:{i}: not an obs event"
+                continue
             events.append(event)
     if not events:
+        if continuation:
+            return []
         raise ObsLogError(f"{path}: empty obs log")
     version = events[0].get("v")
     if version != SCHEMA_VERSION:
@@ -67,28 +85,49 @@ def read_events(path: str) -> list[dict]:
     return events
 
 
+def _numbered_siblings(path: str, suffix: str) -> list[tuple[int, str]]:
+    """``(N, <path>.<suffix>N)`` sibling files in N order (rotation
+    segments and rank logs share the discovery shape)."""
+    out: list[tuple[int, str]] = []
+    for p in glob.glob(glob.escape(path) + f".{suffix}*"):
+        m = re.match(rf".*\.{suffix}(\d+)$", p)
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def read_log(path: str) -> list[dict]:
+    """One recording process's full stream: the base file plus any
+    ``.segN`` rotation segments (``VCTPU_OBS_MAX_MB``), concatenated in
+    rotation order — ``seq`` keeps counting across segments, so the
+    result is the same ordered stream an uncapped run would have
+    written."""
+    events = read_events(path)
+    for _, seg in _numbered_siblings(path, "seg"):
+        events.extend(read_events(seg, continuation=True))
+    return events
+
+
 def read_run(path: str) -> list[dict]:
-    """Read one RUN: the given log plus any ``.rankN`` sibling logs a
-    multi-host run wrote next to it, merged into one timeline.
+    """Read one RUN: the given log (merged across its rotation segments)
+    plus any ``.rankN`` sibling logs a multi-host run wrote next to it,
+    merged into one timeline.
 
     Rank 0's path is the base path; every rank N > 0 wrote
-    ``<path>.rankN`` (obs._rank_suffixed). With siblings present every
-    event gains a ``rank`` field and its Perfetto ``pid`` becomes the
-    rank, so the exported trace shows one process track per rank; a
-    single-rank run returns exactly :func:`read_events` (no ``rank``
-    field, OS pid preserved).
+    ``<path>.rankN`` (obs._rank_suffixed), each with its own optional
+    ``.segN`` rotation segments. With rank siblings present every event
+    gains a ``rank`` field and its Perfetto ``pid`` becomes the rank, so
+    the exported trace shows one process track per rank; a single-rank
+    run returns exactly :func:`read_log` (no ``rank`` field, OS pid
+    preserved).
     """
-    siblings: list[tuple[int, str]] = []
-    for p in glob.glob(glob.escape(path) + ".rank*"):
-        m = re.match(r".*\.rank(\d+)$", p)
-        if m:
-            siblings.append((int(m.group(1)), p))
-    events = read_events(path)
+    siblings = _numbered_siblings(path, "rank")
+    events = read_log(path)
     if not siblings:
         return events
     merged: list[dict] = []
     for rank, rank_path in [(0, path)] + sorted(siblings):
-        rank_events = events if rank == 0 else read_events(rank_path)
+        rank_events = events if rank == 0 else read_log(rank_path)
         for e in rank_events:
             e = dict(e, rank=rank)
             e["pid"] = rank  # rank as Perfetto pid: one track per rank
@@ -100,6 +139,13 @@ def read_run(path: str) -> list[dict]:
 
 def _args_of(event: dict) -> dict:
     return {k: v for k, v in event.items() if k not in _ENVELOPE}
+
+
+def _last_t(events: list[dict]) -> float:
+    """Run-relative offset of the last event — the wall-clock stand-in
+    for an in-flight log whose ``run_end`` has not landed yet."""
+    return max((float(e.get("t", 0.0)) for e in events
+                if isinstance(e.get("t"), (int, float))), default=0.0)
 
 
 def to_chrome_trace(events: list[dict]) -> dict:
@@ -125,6 +171,15 @@ def to_chrome_trace(events: list[dict]) -> dict:
         trace.append({"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                       "ts": 0, "args": {"name": name}})
 
+    # causal trace spans: ph X slices like ordinary spans, PLUS flow
+    # arrows (ph s/f pairs) along every parent link — Perfetto then draws
+    # the chunk DAG (megabatch fan-in included) across thread tracks
+    span_index: dict[str, dict] = {}
+    for e in events:
+        if e.get("kind") == "trace" and isinstance(e.get("span_id"), str):
+            span_index[e["span_id"]] = e
+    flow_id = 0
+
     for e in events:
         kind = e.get("kind")
         pid, tid = e.get("pid", 0), e.get("tid", 0)
@@ -134,6 +189,30 @@ def to_chrome_trace(events: list[dict]) -> dict:
             trace.append({"name": e.get("name", "span"), "ph": "X", "cat": "span",
                           "ts": max(0.0, t_us - dur_us), "dur": dur_us,
                           "pid": pid, "tid": tid, "args": _args_of(e)})
+        elif kind == "trace":
+            dur_us = float(e.get("dur", 0.0)) * 1e6
+            start_us = max(0.0, t_us - dur_us)
+            trace.append({"name": e.get("name", "trace"), "ph": "X",
+                          "cat": "trace", "ts": start_us, "dur": dur_us,
+                          "pid": pid, "tid": tid, "args": _args_of(e)})
+            for parent_id in e.get("parents", ()):
+                parent = span_index.get(parent_id)
+                if parent is None:
+                    continue
+                flow_id += 1
+                p_end = float(parent.get("t", 0.0)) * 1e6
+                p_dur = float(parent.get("dur", 0.0)) * 1e6
+                flow = {"name": "chunk", "cat": "trace.flow", "id": flow_id}
+                # the s/f pair binds to the slice CONTAINING its ts: put
+                # the start just inside the parent slice's end and the
+                # finish at the child slice's start
+                trace.append(dict(flow, ph="s",
+                                  ts=max(p_end - p_dur, p_end - 1.0),
+                                  pid=parent.get("pid", 0),
+                                  tid=parent.get("tid", 0)))
+                trace.append(dict(flow, ph="f", bp="e",
+                                  ts=min(start_us + 1.0, t_us),
+                                  pid=pid, tid=tid))
         elif kind in _INSTANT_KINDS:
             trace.append({"name": f"{kind}:{e.get('name', '')}", "ph": "i",
                           "cat": kind, "s": "t", "ts": t_us,
@@ -206,13 +285,17 @@ def summarize(events: list[dict]) -> dict:
     records = sum(e.get("records", 0) for e in last_hb_by_rank.values()) \
         if last_hb_by_rank else None
     ranks = sorted({e.get("rank", 0) for e in events})
-    dur = float(run_end.get("dur", 0.0)) if run_end else None
+    # no run_end == the run is still writing (or died by SIGKILL):
+    # report honestly as in-flight with the last event's offset standing
+    # in for the duration — a reader must never stack-trace on it
+    dur = float(run_end.get("dur", 0.0)) if run_end else _last_t(events)
 
     return {
         "run": {
             "tool": (manifest or {}).get("tool"),
             "version": (manifest or {}).get("version"),
-            "status": run_end.get("status") if run_end else "incomplete",
+            "status": run_end.get("status") if run_end else "in-flight",
+            "in_flight": run_end is None,
             "duration_s": round(dur, 3) if dur is not None else None,
             "events": len(events),
             "ranks": len(ranks),
@@ -264,7 +347,7 @@ def bottleneck(events: list[dict]) -> dict:
     if stage_events:
         source = "profile"
         wall = sum(float(e.get("wall_s", 0.0)) for e in pipe_events) or \
-            (float(run_end.get("dur", 0.0)) if run_end else 0.0)
+            (float(run_end.get("dur", 0.0)) if run_end else _last_t(events))
         records = sum(int(e.get("records", 0)) for e in pipe_events)
         # parallel host-IO pools profile one stage PER WORKER
         # (parse.w0, inflate.w1, ...) and the mesh-sharded scoring path
@@ -301,10 +384,11 @@ def bottleneck(events: list[dict]) -> dict:
                 s["devices"] = s["workers"]  # device lanes, not host threads
     else:
         # fallback: depth-0 spans (serial runs, profiling off) — honest
-        # about what it is: work only, waits unattributable
+        # about what it is: work only, waits unattributable. An in-flight
+        # log (no run_end) uses the last event's offset as the wall.
         source = "spans"
         records = 0
-        wall = float(run_end.get("dur", 0.0)) if run_end else 0.0
+        wall = float(run_end.get("dur", 0.0)) if run_end else _last_t(events)
         for e in events:
             if e.get("kind") != "span" or e.get("depth", 0) != 0:
                 continue

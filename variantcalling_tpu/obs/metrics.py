@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 
 #: recent-sample ring size per histogram per thread (the merged snapshot
 #: interleaves threads; 64 per thread bounds memory at any fan-out)
@@ -47,6 +48,17 @@ _LOG_FACTOR = math.log(HIST_FACTOR)
 
 #: percentiles published in every histogram snapshot (serve-SLO substrate)
 SNAPSHOT_QUANTILES = (0.5, 0.95, 0.99)
+
+#: rolling-window geometry: the window is cut into ROLL_SLOTS bucket
+#: rings rotated on the monotonic clock; the rolling quantile merges the
+#: last ROLL_SLOTS+1 slots (current partial slot included), so "rolling
+#: p95" covers between 1x and 1.25x of the configured window — recent by
+#: construction, never all-of-run like the cumulative buckets next to it
+ROLL_SLOTS = 4
+
+#: default rolling window span in seconds (VCTPU_OBS_WINDOW_S overrides
+#: per run via the MetricsRegistry constructor)
+DEFAULT_WINDOW_S = 60.0
 
 
 def bucket_index(v: float) -> int:
@@ -133,7 +145,8 @@ class Gauge:
 
 
 class _HistCell:
-    __slots__ = ("count", "total", "vmin", "vmax", "recent", "buckets")
+    __slots__ = ("count", "total", "vmin", "vmax", "recent", "buckets",
+                 "windows")
 
     def __init__(self):
         self.count = 0
@@ -142,17 +155,29 @@ class _HistCell:
         self.vmax: float | None = None
         self.recent: list[float] = []
         self.buckets = [0] * N_BUCKETS
+        #: rolling bucket rings: {slot ordinal: bucket counts}, bounded
+        #: to the last ROLL_SLOTS+2 slots (the windowed sibling of the
+        #: cumulative ``buckets`` array next to it)
+        self.windows: dict[int, list[int]] = {}
 
 
 class Histogram:
     """count/sum/min/max + fixed log buckets (p50/p95/p99) + a bounded
-    recent-sample ring, per thread."""
+    recent-sample ring, per thread — PLUS a rolling-window bucket ring
+    (``window_s``) so quantiles can mean "recent", not all-of-run
+    (the live-plane/SLO substrate: ``vctpu obs tail``/``prom``)."""
 
-    __slots__ = ("name", "_cells")
+    __slots__ = ("name", "window_s", "_slot_s", "_cells")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, window_s: float = DEFAULT_WINDOW_S):
         self.name = name
+        self.window_s = window_s
+        self._slot_s = max(window_s, 1e-3) / ROLL_SLOTS
         self._cells: dict[int, _HistCell] = {}
+
+    def _slot(self) -> int:
+        # the monotonic clock never steps; one clock read per observation
+        return int(time.monotonic() / self._slot_s)
 
     def observe(self, v: float) -> None:
         tid = threading.get_ident()
@@ -167,10 +192,44 @@ class Histogram:
             cell.vmin = v
         if cell.vmax is None or v > cell.vmax:
             cell.vmax = v
-        cell.buckets[bucket_index(v)] += 1
+        idx = bucket_index(v)
+        cell.buckets[idx] += 1
+        slot = self._slot()
+        ring = cell.windows.get(slot)
+        if ring is None:
+            cell.windows[slot] = ring = [0] * N_BUCKETS
+            if len(cell.windows) > ROLL_SLOTS + 2:
+                # prune rings that aged out of every possible window —
+                # only this thread writes this cell, so the delete races
+                # nothing (the snapshot reader tolerates either state)
+                for old in sorted(cell.windows)[:-(ROLL_SLOTS + 2)]:
+                    del cell.windows[old]
+        ring[idx] += 1
         cell.recent.append(v)
         if len(cell.recent) > RECENT:
             del cell.recent[0]
+
+    def rolling_buckets(self) -> tuple[list[int], int]:
+        """(summed bucket counts, count) over the rolling window: the
+        last ROLL_SLOTS complete slots plus the current partial one."""
+        floor = self._slot() - ROLL_SLOTS
+        merged = [0] * N_BUCKETS
+        count = 0
+        for c in list(self._cells.values()):
+            for slot, ring in list(c.windows.items()):
+                if slot < floor:
+                    continue
+                for i, n in enumerate(ring):
+                    if n:
+                        merged[i] += n
+                        count += n
+        return merged, count
+
+    def rolling_quantile(self, q: float) -> float | None:
+        """Windowed quantile — "recent" p50/p95/p99 next to the
+        cumulative :meth:`quantile`."""
+        merged, count = self.rolling_buckets()
+        return quantile_from_buckets(merged, count, q)
 
     def merged_buckets(self) -> tuple[list[int], int]:
         """(summed bucket counts, total count) across recording threads."""
@@ -208,6 +267,17 @@ class Histogram:
         for q in SNAPSHOT_QUANTILES:
             est = quantile_from_buckets(merged, count, q)
             out[f"p{int(q * 100)}"] = round(est, 9) if est is not None else None
+        # the windowed view rides next to the cumulative one: rolling
+        # p95 means "the last ~window_s", the substrate for in-flight
+        # SLO reads (vctpu obs tail / prom) where all-of-run quantiles
+        # would average away a current stall
+        roll_merged, roll_count = self.rolling_buckets()
+        rolling: dict = {"window_s": self.window_s, "count": roll_count}
+        for q in SNAPSHOT_QUANTILES:
+            est = quantile_from_buckets(roll_merged, roll_count, q)
+            rolling[f"p{int(q * 100)}"] = round(est, 9) \
+                if est is not None else None
+        out["rolling"] = rolling
         return out
 
 
@@ -235,9 +305,12 @@ NOOP = _Noop()
 
 class MetricsRegistry:
     """One run's named metrics. Creation takes a lock (rare); recording
-    through the returned objects does not (hot)."""
+    through the returned objects does not (hot). ``window_s`` sets every
+    histogram's rolling-window span (``VCTPU_OBS_WINDOW_S``; the module
+    stays knob-free so it imports standalone)."""
 
-    def __init__(self):
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S):
+        self.window_s = window_s
         self._lock = threading.Lock()
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
@@ -257,7 +330,12 @@ class MetricsRegistry:
         return self._get(self._gauges, name, Gauge)
 
     def histogram(self, name: str) -> Histogram:
-        return self._get(self._hists, name, Histogram)
+        metric = self._hists.get(name)
+        if metric is None:
+            with self._lock:
+                metric = self._hists.setdefault(
+                    name, Histogram(name, window_s=self.window_s))
+        return metric
 
     def snapshot(self) -> dict:
         """{counters, gauges, histograms} — the ``metrics`` event body."""

@@ -33,7 +33,12 @@ Everything here is gated on ``enabled()`` — obs recording must be on
 (``VCTPU_OBS=1``) AND profiling not opted out (``VCTPU_OBS_PROFILE``,
 default on). The PR 5 contracts hold with profiling enabled: output
 bytes are identical, and total obs+profile overhead stays inside the 2%
-budget (bench ``obs_overhead_pct``, now median-of-5 paired runs).
+budget (bench ``obs_overhead_pct``, now median-of-5 paired runs — since
+the live-telemetry plane the measured legs also carry causal tracing
+and periodic rolling-window snapshots, and the sampler's gauges ride
+those ``snapshot`` events mid-run, so an external ``vctpu obs
+tail``/``prom`` reader sees fresh RSS/CPU watermarks while the run is
+in flight, not just at ``run_end``).
 """
 
 from __future__ import annotations

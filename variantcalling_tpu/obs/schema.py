@@ -76,12 +76,18 @@ def validate_event(event: dict) -> list[str]:
     return errors
 
 
-def validate_lines(lines: list[str]) -> list[str]:
+def validate_lines(lines: list[str], continuation: bool = False) -> list[str]:
     """Schema errors for a whole JSONL log, prefixed with 1-based line
     numbers; also enforces the stream-level invariants (seq strictly
-    increasing from 0, ts monotonically non-decreasing, manifest first)."""
+    increasing from 0, ts monotonically non-decreasing, manifest first).
+
+    ``continuation=True`` validates a ROTATION SEGMENT
+    (``<log>.segN``, ``VCTPU_OBS_MAX_MB``): the manifest lives in the
+    base file and ``seq`` continues from wherever the previous segment
+    stopped, so those two checks anchor on the segment's first event
+    instead of the stream origin."""
     errors: list[str] = []
-    prev_seq = -1
+    prev_seq: int | None = None if continuation else -1
     prev_ts = None
     for i, line in enumerate(lines, 1):
         line = line.strip()
@@ -96,7 +102,7 @@ def validate_lines(lines: list[str]) -> list[str]:
             errors.append(f"line {i}: {err}")
         seq, ts = event.get("seq"), event.get("ts")
         if isinstance(seq, int):
-            if seq != prev_seq + 1:
+            if prev_seq is not None and seq != prev_seq + 1:
                 errors.append(f"line {i}: seq {seq} breaks the ordered "
                               f"stream (expected {prev_seq + 1})")
             prev_seq = seq
@@ -105,6 +111,6 @@ def validate_lines(lines: list[str]) -> list[str]:
                 errors.append(f"line {i}: ts moved backwards "
                               f"({ts} < {prev_ts})")
             prev_ts = ts
-        if i == 1 and event.get("kind") != "manifest":
+        if i == 1 and not continuation and event.get("kind") != "manifest":
             errors.append("line 1: stream must open with the run manifest")
     return errors

@@ -331,10 +331,18 @@ def retry_chunk(fn: Callable, what: str, seq: int | None = None):
         for k in range(max(1, attempts)):
             if k:
                 if obs.active():
-                    obs.event("recovery", "chunk_retry", what=what,
-                              attempt=k, retries=attempts - 1,
-                              chunk=-1 if seq is None else seq,
-                              error=f"{type(last).__name__}: {last}")
+                    fields = {"what": what, "attempt": k,
+                              "retries": attempts - 1,
+                              "chunk": -1 if seq is None else seq,
+                              "error": f"{type(last).__name__}: {last}"}
+                    # causal linkage: the re-dispatch names the trace of
+                    # the chunk it is recovering (the body bound it via
+                    # obs.trace_scope), so `obs critical-path`/triage can
+                    # walk from the recovery event to the chunk's DAG
+                    tid = obs.current_trace()
+                    if tid is not None:
+                        fields["trace_id"] = tid
+                    obs.event("recovery", "chunk_retry", **fields)
                     obs.counter("recovery.chunk_retries").add(1)
                 logger.warning(
                     "chunk failure in %s (attempt %d/%d): %s — re-dispatching",
@@ -352,21 +360,27 @@ def retry_chunk(fn: Callable, what: str, seq: int | None = None):
     raise last  # type: ignore[misc]
 
 
-def record_quarantine(what: str, records: int, exc: BaseException) -> None:
+def record_quarantine(what: str, records: int, exc: BaseException,
+                      trace_id: str | None = None) -> None:
     """The loud-divert bookkeeping EVERY quarantine site shares (the
     host-path guard in pipelines/filter_variants and the mesh dispatch
     ladder in parallel/shard_score): a sanctioned degradation with
-    ``warn=True``, the ``recovery``/``quarantine`` obs event, and the
-    quarantined-chunks counter — one spelling, so the contract cannot
-    drift between paths."""
+    ``warn=True``, the ``recovery``/``quarantine`` obs event — carrying
+    the diverted chunk's TRACE id so the event resolves to the chunk's
+    span DAG — and the quarantined-chunks counter: one spelling, so the
+    contract cannot drift between paths."""
     from variantcalling_tpu.utils import degrade
 
     degrade.record("stream.quarantine", exc, warn=True,
                    fallback=f"chunk of {records} records diverted to the "
                             ".quarantine sidecar")
     if obs.active():
-        obs.event("recovery", "quarantine", what=what, records=records,
-                  error=f"{type(exc).__name__}: {exc}")
+        fields = {"what": what, "records": records,
+                  "error": f"{type(exc).__name__}: {exc}"}
+        tid = trace_id if trace_id is not None else obs.current_trace()
+        if tid is not None:
+            fields["trace_id"] = tid
+        obs.event("recovery", "quarantine", **fields)
         obs.counter("recovery.quarantined_chunks").add(1)
 
 
@@ -516,10 +530,16 @@ class StagePipeline:
         transient scoring failures."""
         for i, fn in enumerate(self.stages):
             if self.recover and getattr(fn, "retry_safe", True):
-                item = retry_chunk(
-                    lambda it_=item, i_=i, fn_=fn:
-                    self._serial_stage_item(i_, fn_, seq, it_, prof),
-                    self._stage_name(i), seq=seq)
+                # bind the chunk's trace so the re-dispatch events the
+                # ladder emits resolve to the chunk they recover (the
+                # stage body's own scope has already unwound when the
+                # failure reaches this supervisor)
+                with obs.trace_scope(
+                        obs.trace_of(item) if obs.tracing() else None):
+                    item = retry_chunk(
+                        lambda it_=item, i_=i, fn_=fn:
+                        self._serial_stage_item(i_, fn_, seq, it_, prof),
+                        self._stage_name(i), seq=seq)
             else:
                 item = self._serial_stage_item(i, fn, seq, item, prof)
         return item
@@ -654,9 +674,14 @@ class StagePipeline:
                     busy_item[i] = got
                     try:
                         if retryable:
-                            out = retry_chunk(
-                                lambda: _run_stage_item(i, fn, seq, item),
-                                self._stage_name(i), seq=seq)
+                            # same trace binding as the serial supervisor:
+                            # ladder events name the chunk they recover
+                            with obs.trace_scope(
+                                    obs.trace_of(item)
+                                    if obs.tracing() else None):
+                                out = retry_chunk(
+                                    lambda: _run_stage_item(i, fn, seq, item),
+                                    self._stage_name(i), seq=seq)
                         else:
                             out = _run_stage_item(i, fn, seq, item)
                         last_seq = seq
@@ -688,8 +713,18 @@ class StagePipeline:
                            "expired — re-dispatching the wedged chunk "
                            "once before aborting. %s", msg)
             if obs.active():
+                # causal linkage: the wedged in-flight chunks' trace ids
+                # (the traced table / render tuple each stage holds), so
+                # the re-dispatch resolves to the chunk DAGs it revives
+                tids = []
+                for got in busy_item:
+                    if got is None:
+                        continue
+                    tid = obs.trace_of(got[1])
+                    if tid is not None:
+                        tids.append(tid)
                 obs.event("recovery", "watchdog_retry", detail=msg,
-                          stacks=stacks[:20000])
+                          stacks=stacks[:20000], trace_ids=tids)
                 obs.counter("recovery.watchdog_retries").add(1)
             faults.cancel_hangs()
             for i, got in enumerate(busy_item):

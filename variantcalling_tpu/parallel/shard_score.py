@@ -275,6 +275,19 @@ def megabatch_stream(prepped, ctx, profiler=None):
         if obs.active():
             obs.span("score_stage", dt, threading.current_thread().name)
             obs.histogram("stage.score_stage.s").observe(dt)
+        if obs.tracing():
+            # megabatch FAN-IN: one dispatch span, MANY chunk parents —
+            # the event lists every member trace id and parents to each
+            # member's last span, and every member's cursor advances to
+            # this span, so each chunk's DAG walks through the shared
+            # dispatch (docs/observability.md "Causal chunk tracing")
+            tids = [t for t in (getattr(tab, "_obs_trace", None)
+                                for tab, _ in group) if t is not None]
+            if tids:
+                parents = [c for c in (obs.trace_cursor(t) for t in tids)
+                           if c is not None]
+                obs.trace_span(tids[0], "score_stage", dt, parents=parents,
+                               traces=tids, chunks=len(group), rows=rows)
         if profiler is not None:
             share = rows // devices
             for d in range(devices):
@@ -293,19 +306,22 @@ def megabatch_stream(prepped, ctx, profiler=None):
 
     def chunk_supervised(pair):
         """One chunk through the per-chunk ladder: bounded re-dispatch,
-        then OOM escalation or (opt-in) quarantine."""
-        try:
-            return retry_chunk(lambda: dispatch([pair]),
-                               "mesh chunk dispatch")
-        except (EngineError, StageTimeoutError):
-            raise
-        # routed through degrade.record (quarantine) or re-raised
-        except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — quarantine records via degrade.record; every other path re-raises
-            if is_oom(e):
-                raise MeshDegradeRestart(devices, e) from e
-            if not knobs.get_bool("VCTPU_QUARANTINE"):
+        then OOM escalation or (opt-in) quarantine. The chunk's trace is
+        bound to the thread for the duration so every ladder event
+        (chunk_retry, quarantine) links to the chunk it recovers."""
+        with obs.trace_scope(getattr(pair[0], "_obs_trace", None)):
+            try:
+                return retry_chunk(lambda: dispatch([pair]),
+                                   "mesh chunk dispatch")
+            except (EngineError, StageTimeoutError):
                 raise
-            return [quarantined(pair, e)]
+            # routed through degrade.record (quarantine) or re-raised
+            except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — quarantine records via degrade.record; every other path re-raises
+                if is_oom(e):
+                    raise MeshDegradeRestart(devices, e) from e
+                if not knobs.get_bool("VCTPU_QUARANTINE"):
+                    raise
+                return [quarantined(pair, e)]
 
     def flush(group):
         try:
@@ -314,6 +330,10 @@ def megabatch_stream(prepped, ctx, profiler=None):
             raise
         # recovery ladder — every path below re-dispatches or re-raises
         except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — ladder re-dispatches chunk by chunk; failures re-raise from chunk_supervised
+            # causal linkage: every ladder event names the member chunks'
+            # traces — the failed megabatch is a fan-in of all of them
+            tids = [t for t in (getattr(tab, "_obs_trace", None)
+                                for tab, _ in group) if t is not None]
             if is_oom(e):
                 # rung: megabatch SHRINK — halve the packing target for
                 # the rest of the stream, re-dispatch chunk by chunk
@@ -322,6 +342,7 @@ def megabatch_stream(prepped, ctx, profiler=None):
                     obs.event("recovery", "megabatch_shrink",
                               rows=sum(len(t) for t, _ in group),
                               new_target=state["target"],
+                              trace_ids=tids,
                               error=f"{type(e).__name__}: {e}")
                     obs.counter("recovery.megabatch_shrinks").add(1)
                 logger.warning(
@@ -333,7 +354,7 @@ def megabatch_stream(prepped, ctx, profiler=None):
                 # its whole group down with it
                 if obs.active():
                     obs.event("recovery", "megabatch_split",
-                              chunks=len(group),
+                              chunks=len(group), trace_ids=tids,
                               error=f"{type(e).__name__}: {e}")
                     obs.counter("recovery.megabatch_splits").add(1)
             scored = []

@@ -53,6 +53,34 @@ def quarantine_path(out_path: str) -> str:
     return str(out_path) + QUARANTINE_SUFFIX
 
 
+def _traced_chunks(tables):
+    """Causal-tracing ingest boundary (docs/observability.md "Causal
+    chunk tracing"): every chunk table gets a run-scoped TRACE id here —
+    the root ``ingest`` span of its DAG — carried on the table object
+    (``_obs_trace``) so every downstream stage (featurize, score,
+    megabatch dispatch, render, compress, sequenced commit) and every
+    recovery-ladder action can link its span/event to the chunk. A
+    no-op pass-through when tracing is off (``obs.new_trace`` returns
+    None). The wrapper wraps ALL four streaming layouts' sources, so
+    trace ids are allocated in canonical chunk order everywhere."""
+    import time as _time
+
+    it = iter(tables)
+    while True:
+        t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs trace-span timing
+        try:
+            table = next(it)
+        except StopIteration:
+            return
+        tid = obs.new_trace()
+        if tid is not None:
+            table._obs_trace = tid
+            obs.trace_span(tid, "ingest",
+                           _time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                           records=len(table))
+        yield table
+
+
 def _guard_chunk(table, what: str, body):
     """Rung 3 of the supervised recovery ladder for one chunk body.
 
@@ -1254,9 +1282,18 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         # the chunk body rides the recovery ladder: the executor (serial
         # layout) or chunk_worker (pooled layout) provides the bounded
         # re-dispatch; the guard provides the opt-in quarantine rung —
-        # a diverted chunk flows on as a (table, None, None) marker
-        out = _guard_chunk(table, "score_stage",
-                           lambda: ctx.score_table(table))
+        # a diverted chunk flows on as a (table, None, None) marker.
+        # The chunk's trace binds to the thread for the duration so
+        # ladder events link to it, and the body emits its trace span.
+        tid = getattr(table, "_obs_trace", None)
+        with obs.trace_scope(tid):
+            t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs trace-span timing
+            out = _guard_chunk(table, "score_stage",
+                               lambda: ctx.score_table(table))
+            if tid is not None:
+                obs.trace_span(tid, "score_stage",
+                               _time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                               records=len(table))
         if out is None:
             return table, None, None
         score, filters = out
@@ -1296,10 +1333,18 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             return _timed_worker(render_stage, "render_stage", scored,
                                  len(table))
 
-        return retry_chunk(body, "chunk_worker")
+        # bind the chunk's trace for the whole pooled body so the
+        # re-dispatch events of the ladder name the chunk they recover
+        with obs.trace_scope(getattr(table, "_obs_trace", None)):
+            return retry_chunk(body, "chunk_worker")
 
     def render_stage(item):
         table, score, filters = item
+        # the trace id rides the rendered tuple from here on — the table
+        # is dropped after render, but compress + the sequenced commit
+        # still emit spans of this chunk's DAG
+        tid = getattr(table, "_obs_trace", None)
+        t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs trace-span timing
         if score is None:
             # quarantined chunk (recovery ladder): ZERO bytes reach the
             # main output; the ORIGINAL records (no TREE_SCORE, original
@@ -1307,12 +1352,21 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             qbody = assemble_table_bytes(table)
             if qbody is None:
                 qbody = render_table_bytes_python(table)
-            return b"", len(table), 0, bytes(qbody)
-        extra = {"TREE_SCORE": np.round(score, 4)}
-        body = assemble_table_bytes(table, new_filters=filters, extra_info=extra)
-        if body is None:  # native hiccup mid-run: Python renderer, same bytes
-            body = render_table_bytes_python(table, new_filters=filters, extra_info=extra)
-        return body, len(table), int(np.sum(filters.codes == 0)), None
+            out = b"", len(table), 0, bytes(qbody), tid
+        else:
+            extra = {"TREE_SCORE": np.round(score, 4)}
+            body = assemble_table_bytes(table, new_filters=filters,
+                                        extra_info=extra)
+            if body is None:  # native hiccup mid-run: Python renderer, same bytes
+                body = render_table_bytes_python(table, new_filters=filters,
+                                                 extra_info=extra)
+            out = (body, len(table), int(np.sum(filters.codes == 0)), None,
+                   tid)
+        if tid is not None:
+            obs.trace_span(tid, "render_stage",
+                           _time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                           records=len(table))
+        return out
 
     out_path = str(args.output_file)
     gz = out_path.endswith(".gz")
@@ -1337,11 +1391,17 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         compressor = BgzfChunkCompressor(pool=compress_pool)
 
         def compress_stage(item):
-            body, k, p, q = item
+            body, k, p, q, tid = item
             if not len(body):  # quarantined chunk: nothing to compress
-                return b"", k, p, q
+                return b"", k, p, q, tid
             data = memoryview(body) if isinstance(body, np.ndarray) else body
-            return compressor.add(data), k, p, q
+            t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs trace-span timing
+            out = compressor.add(data)
+            if tid is not None:
+                obs.trace_span(tid, "compress_stage",
+                               _time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                               bytes_in=len(data))
+            return out, k, p, q, tid
 
         # the ONE stage that is NOT a pure chunk body: the compressor's
         # block carry absorbs every byte it sees, so a re-dispatch (chunk
@@ -1484,7 +1544,15 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                                           len(table)))
                 return table, hf
 
-            return retry_chunk(body, "featurize prep")
+            tid = getattr(table, "_obs_trace", None)
+            with obs.trace_scope(tid):
+                t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                out = retry_chunk(body, "featurize prep")
+                if tid is not None:
+                    obs.trace_span(tid, "featurize_stage",
+                                   _time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                                   records=len(table))
+            return out
 
         def render_worker(item):
             return _timed_worker(render_stage, "render_stage", item,
@@ -1493,7 +1561,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         if source_pooled:
             window = reader.io_threads + 2
             prepped = imap_ordered(reader.shared_pool(), prep_worker,
-                                   iter(reader), window=window)
+                                   _traced_chunks(reader), window=window)
             scored = shard_score.megabatch_stream(prepped, ctx, profiler=prof)
             source = imap_ordered(reader.shared_pool(), render_worker,
                                   scored, window=window)
@@ -1533,16 +1601,18 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                     yield table
 
             source = shard_score.megabatch_stream(
-                map(prep_worker, timed_tables()), ctx, profiler=prof)
+                map(prep_worker, _traced_chunks(timed_tables())), ctx,
+                profiler=prof)
             stages = [render_stage]
     elif source_pooled:
         from variantcalling_tpu.parallel.pipeline import imap_ordered
 
         source = imap_ordered(reader.shared_pool(), chunk_worker,
-                              iter(reader), window=reader.io_threads + 2)
+                              _traced_chunks(reader),
+                              window=reader.io_threads + 2)
         stages = []
     else:
-        source = iter(reader)
+        source = _traced_chunks(reader)
         stages = [score_stage, render_stage]
     if compressor is not None:
         stages.append(compress_stage)
@@ -1588,7 +1658,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                     _sink_write(sink, compressor.add(header_bytes))
                 else:
                     _sink_write(sink, header_bytes)
-            for body, k, p, qbody in gen:
+            for body, k, p, qbody, trace_id in gen:
                 if qbody:
                     # quarantined chunk: its ORIGINAL records append to
                     # the sidecar (plain text, never compressed) and the
@@ -1607,11 +1677,19 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                     n_quar_chunks += 1
                     n_quar_records += k
                 data = memoryview(body) if isinstance(body, np.ndarray) else body
-                if wb is not None:
+                if wb is not None or trace_id is not None:
                     t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs writeback attribution
                     _sink_write(sink, data)
-                    wb.add_work(_time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs writeback attribution
-                                bytes_out=len(data))
+                    dt = _time.perf_counter() - t0  # vctpu-lint: disable=VCT006 — obs writeback attribution
+                    if wb is not None:
+                        wb.add_work(dt, bytes_out=len(data))
+                    if trace_id is not None:
+                        # the sequenced commit: the TERMINAL span of the
+                        # chunk's DAG (named like the profiler's consumer
+                        # stage so critical-path reconciles against it)
+                        obs.trace_span(trace_id, "writeback", dt,
+                                       chunk=n_chunks, bytes_out=len(data))
+                        obs.end_trace(trace_id)
                 else:
                     _sink_write(sink, data)
                 n_total += k
