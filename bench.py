@@ -1393,6 +1393,15 @@ def child_main(fixture_dir: str) -> None:
         phase("mesh", mesh_scaling, min_remaining=60)
     if want("e2e"):
         phase("e2e", lambda: e2e_pipeline(fixture_dir), min_remaining=70)
+        e2e_row, hot_row = result.get("e2e"), result.get("hot")
+        if isinstance(e2e_row, dict) and isinstance(hot_row, dict) \
+                and e2e_row.get("e2e_vps") and hot_row.get("vps"):
+            # the scoring-wall gap metric (ROADMAP item 4): streaming e2e
+            # as a fraction of the standalone scoring hot path — gated in
+            # tools/bench_gate.py so the gap can never silently reopen
+            e2e_row["e2e_over_hot"] = round(
+                e2e_row["e2e_vps"] / hot_row["vps"], 4)
+            emit()
     if want("obs"):
         # telemetry overhead on the SAME streaming leg (ISSUE 5: < 2%);
         # rides e2e's warm caches so both measured legs are steady-state

@@ -200,3 +200,13 @@ def write_bam(path: str, contigs: dict[str, int], reads: list[dict]) -> None:
         body += struct.pack("<i", len(rec)) + rec
     with gzip.open(path, "wb") as fh:
         fh.write(bytes(body))
+
+
+def strip_vctpu_header(data: bytes) -> bytes:
+    """Everything except the ``##vctpu_*`` configuration header lines —
+    the ONE place engines/strategies/mesh layouts may legitimately differ
+    between otherwise byte-identical filter outputs. The single spelling
+    of the parity-modulo-header rule, shared by every cross-configuration
+    byte-parity test."""
+    return b"\n".join(ln for ln in data.split(b"\n")
+                      if not ln.startswith(b"##vctpu_"))

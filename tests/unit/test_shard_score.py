@@ -82,11 +82,7 @@ def _stream(w, out, monkeypatch, engine, devices, strategy=None,
     return run_streaming(args, w["model"], w["fasta"], {}, None)
 
 
-def _modulo_header(data: bytes) -> bytes:
-    """Everything except the ``##vctpu_*`` configuration lines — the one
-    place engines/strategies/mesh layouts may differ."""
-    return b"\n".join(ln for ln in data.split(b"\n")
-                      if not ln.startswith(b"##vctpu_"))
+from tests.fixtures import strip_vctpu_header as _modulo_header  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

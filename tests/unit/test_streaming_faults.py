@@ -249,6 +249,31 @@ def test_transient_chunk_read_error_retried(stream_fault_world, clean_bytes, mon
     assert open(out, "rb").read() == clean_bytes
 
 
+def test_fused_chunk_body_survives_retry_redispatch(stream_fault_world,
+                                                    clean_bytes, monkeypatch):
+    """ISSUE 12 acceptance: the fused zero-wait chunk body (parse ->
+    fused native featurize+score -> render as ONE pooled task over a raw
+    buffer) is a pure retry-safe function of the held buffer — a
+    ``retry_chunk`` re-dispatch after a transient mid-body fault
+    re-parses and re-scores the chunk and the output stays
+    byte-identical to the clean run."""
+    w = stream_fault_world
+    out = f"{w['dir']}/retry_fused.vcf"
+    monkeypatch.setenv("VCTPU_ENGINE", "native")
+    monkeypatch.setenv("VCTPU_NATIVE_FUSED", "1")
+    monkeypatch.setenv("VCTPU_IO_THREADS", "4")  # the pooled raw layout
+    faults.arm("pipeline.chunk", times=1)
+    stats = _run_stream(w, out, monkeypatch)
+    assert stats is not None and stats["n"] == w["n"]
+    assert faults.fired("pipeline.chunk") == 1
+    # records + non-configuration header (the clean oracle may have
+    # resolved a different engine than this pinned fault leg)
+    from tests.fixtures import strip_vctpu_header
+
+    assert strip_vctpu_header(open(out, "rb").read()) == \
+        strip_vctpu_header(clean_bytes)
+
+
 def test_transient_writeback_enospc_retried(stream_fault_world, clean_bytes, monkeypatch):
     w = stream_fault_world
     out = f"{w['dir']}/retry_write.vcf"

@@ -69,11 +69,21 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("obs.trace_events", "nonzero", 0.0),
     # -- host-IO layer (parallel-IO PR): the io phase isolates the three
     #    IO primitives, so an IO regression (a re-serialized shard loop,
-    #    a lost zero-copy) gates independently of e2e noise ------------
+    #    a lost zero-copy) gates independently of e2e noise. The t1
+    #    (serial) legs are the code-regression sentinels and keep the
+    #    tight band; the t2 POOL legs for inflate/parse measure scheduler
+    #    placement as much as code on this 2-core container — three
+    #    rounds of evidence (r10: t2>t4 sample noise; r12: bimodal
+    #    ~350 vs ~520 MB/s committed note; r13: the pre-PR tree A/B'd
+    #    at 310 MB/s parse-t2 on the same day the PR tree measured 349,
+    #    while the r12 baseline recorded 491) — so their band admits the
+    #    slow placement mode instead of failing PRs for the box's mood.
+    #    A genuine pool regression (re-serialized fan-out) still fails:
+    #    it would drag t2 BELOW the t1 serial floor, far past -40%. ----
     ("io.decompress_mb_s.t1", "higher", 0.10),
-    ("io.decompress_mb_s.t2", "higher", 0.10),
+    ("io.decompress_mb_s.t2", "higher", 0.40),
     ("io.parse_mb_s.t1", "higher", 0.10),
-    ("io.parse_mb_s.t2", "higher", 0.10),
+    ("io.parse_mb_s.t2", "higher", 0.40),
     ("io.compress_mb_s.t1", "higher", 0.10),
     ("io.compress_mb_s.t2", "higher", 0.10),
     # -- mesh device-scaling (mesh-sharded scoring PR): the d1 leg pins
@@ -94,7 +104,28 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     #    work above 25% of wall means the fan-out silently collapsed.
     ("e2e.attribution.stages.ingest.work_pct", "budget", 25.0),
     ("e2e.attribution.limiting_work_pct", "lower", 0.20),
+    # -- scoring-wall gap (fused-native + zero-wait feed PR): streaming
+    #    e2e as a fraction of the standalone scoring hot path. Gated as
+    #    a RATIO so a win booked by "hot got slower" can never pass, and
+    #    the glue this PR removed can never silently grow back. --------
+    ("e2e.e2e_over_hot", "higher", 0.10),
 )
+
+#: string-valued tripwires: (dotted path, forbidden value). The metric
+#: registry above gates NUMBERS; these fail when a committed label
+#: regresses to a named bad state. The one entry: the critical-path
+#: engine must not name ``score_stage.wait`` the dominant p95 edge again
+#: — that edge was the scoring-wall diagnosis this PR's overlapped
+#: megabatch feed + fused native chunk body tore down (BENCH_r12 -> r13).
+FORBIDDEN_VALUES: tuple[tuple[str, str], ...] = (
+    ("e2e.critical_path.dominant_p95_edge", "score_stage.wait"),
+)
+
+
+def resolve_string(doc: dict, dotted: str) -> str | None:
+    """String value at ``a.b.c`` in a nested dict, or None."""
+    node = _walk_path(doc, dotted)
+    return node if isinstance(node, str) else None
 
 #: the ingest-feed budget assumes the PARALLEL IO layout (the feed only
 #: drains the worker pool). On a serial-layout run — single-core host or
@@ -106,14 +137,21 @@ _INGEST_BUDGET_METRIC = "e2e.attribution.stages.ingest.work_pct"
 _IO_LAYOUT_GUARD = "e2e.attribution.io_threads"
 
 
-def resolve_path(doc: dict, dotted: str):
-    """Value at ``a.b.c`` in a nested dict, or None; list values reduce
-    by median (median-of-k gating)."""
+def _walk_path(doc: dict, dotted: str):
+    """Node at ``a.b.c`` in a nested dict, or None — the ONE dotted-path
+    traversal the numeric metrics and the string tripwires share."""
     node = doc
     for part in dotted.split("."):
         if not isinstance(node, dict) or part not in node:
             return None
         node = node[part]
+    return node
+
+
+def resolve_path(doc: dict, dotted: str):
+    """Numeric value at ``a.b.c``, or None; list values reduce by median
+    (median-of-k gating)."""
+    node = _walk_path(doc, dotted)
     if isinstance(node, list):
         nums = [v for v in node if isinstance(v, (int, float))
                 and not isinstance(v, bool)]
@@ -175,6 +213,16 @@ def gate(candidate: dict, baseline: dict,
             "direction": direction, "delta_pct": round(100 * (ratio - 1), 2),
             "tolerance_pct": round(100 * tol, 2), "regressed": regressed,
         })
+    for dotted, forbidden in FORBIDDEN_VALUES:
+        cand = resolve_string(candidate, dotted)
+        if cand is None:
+            skipped.append(dotted)
+            continue
+        checks.append({
+            "metric": dotted, "candidate": cand, "forbidden": forbidden,
+            "direction": "forbid",
+            "regressed": cand == forbidden,
+        })
     return {
         "checks": checks,
         "skipped": skipped,
@@ -189,6 +237,9 @@ def render(report: dict) -> str:
         if c["direction"] == "nonzero":
             lines.append(f"  {c['metric']:<28} {c['candidate']:>12} "
                          f"(must be > 0)  {mark}")
+        elif c["direction"] == "forbid":
+            lines.append(f"  {c['metric']:<28} {c['candidate']:>12} "
+                         f"(must not be {c['forbidden']!r})  {mark}")
         elif c["direction"] == "budget":
             lines.append(f"  {c['metric']:<28} {c['candidate']:>12} "
                          f"(budget <= {c['budget']})  {mark}")

@@ -159,6 +159,7 @@ def build_programs(contract: dict) -> list[tuple[str, object, tuple, str]]:
                 fn = shard_score.shard_program(fn, mesh, n_data_args=1)
             programs.append((f"margin/{strategy}/dp={dp}", fn, (x_aval,),
                              "margin"))
+    programs.extend(build_fused_programs(contract))
     depth_aval = jax.ShapeDtypeStruct((4096,), jnp.int32)
     programs.append(("coverage/binned_mean",
                      lambda d: coverage.binned_mean(d, 100),
@@ -169,6 +170,63 @@ def build_programs(contract: dict) -> list[tuple[str, object, tuple, str]]:
             # bind via default arg: the loop variable must not leak
             lambda d, m=method: coverage.depth_histogram(d, method=m),
             (depth_aval,), "coverage"))
+    return programs
+
+
+def build_fused_programs(contract: dict) -> list[tuple[str, object, tuple, str]]:
+    """The streaming executor's REAL jit-engine scoring entry points
+    (``pipelines/filter_variants._fused_program``): featurize + forest
+    fused into one program, in both input layouts (host windows /
+    HBM-resident genome with packed uint32 positions), single-device and
+    shard_map-wrapped. These are the programs every overlapped megabatch
+    dispatch actually runs — auditing only the bare margin predictors
+    would let a callback/collective/f64 ride in through the featurize
+    half unchecked (contract ``fused_dispatch``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from variantcalling_tpu.featurize import (DEVICE_FEATURES, GENOME_BLOCK_BITS,
+                                              WINDOW_RADIUS)
+    from variantcalling_tpu.models.forest import FlatForest
+    from variantcalling_tpu.parallel import shard_score
+    from variantcalling_tpu.pipelines import filter_variants as fv
+
+    spec = contract.get("fused_dispatch")
+    if not spec:
+        return []
+    from variantcalling_tpu.featurize import BASE_FEATURES
+
+    base = audit_forest(contract)
+    # the fused program keys features by NAME: give the audit forest the
+    # pipeline's real feature order (window-derived columns included)
+    names = list(BASE_FEATURES)
+    forest = FlatForest(
+        feature=np.minimum(base.feature, len(names) - 1),
+        threshold=base.threshold, left=base.left, right=base.right,
+        value=base.value, max_depth=base.max_depth,
+        aggregation=base.aggregation, feature_names=names)
+    rows = int(contract["batch_rows"])
+    host_names = [f for f in names if f not in DEVICE_FEATURES]
+    host_avals = tuple(jax.ShapeDtypeStruct((rows,), jnp.float32)
+                       for _ in host_names)
+    aux = tuple(jax.ShapeDtypeStruct((rows,), jnp.uint8) for _ in range(5))
+    win_aval = jax.ShapeDtypeStruct((rows, 2 * WINDOW_RADIUS + 1), jnp.uint8)
+    genome_aval = jax.ShapeDtypeStruct((4, 1 << GENOME_BLOCK_BITS), jnp.uint8)
+    gpos_aval = jax.ShapeDtypeStruct((rows,), jnp.uint32)
+    programs: list[tuple[str, object, tuple, str]] = []
+    for variant in spec["variants"]:
+        for dp in spec["mesh_device_counts"]:
+            mesh = None
+            if dp > 1:
+                plan = shard_score.MeshPlan(dp, str(dp), "jaxpr audit")
+                mesh = shard_score.mesh_for(plan)
+            fn, _hosts, _fin = fv._fused_program(
+                forest, names, "TGCA", genome_resident=(variant == "genome"),
+                strategy="gather", mesh=mesh)
+            avals = ((genome_aval, gpos_aval) if variant == "genome"
+                     else (win_aval,)) + (host_avals,) + aux
+            programs.append((f"fused/{variant}/dp={dp}", fn, avals, "margin"))
     return programs
 
 

@@ -350,10 +350,19 @@ def _contig_runs(table_or_chrom, n: int):
     scan already factorized CHROM into integer codes, and re-factorizing
     1M Python strings per chunk was ~15% of the streaming score stage's
     GIL-holding glue (the per-chunk pandas factorize on the hot path).
+    The derived runs are MEMOIZED on the table — the scoring body asks
+    for them up to three times per chunk (window gather, fused
+    featurize, the fused native scorer), and re-deriving runs the parser
+    already knows was pure repeat work. Native-scan codes are assigned
+    in first-appearance order, so the sorted common case skips the
+    remap LUT pass entirely (codes returned as-is, zero copies).
     """
     chrom = table_or_chrom
     codes = getattr(table_or_chrom, "chrom_codes", None)
     if codes is not None:
+        memo = getattr(table_or_chrom, "_contig_runs_memo", None)
+        if memo is not None:
+            return memo
         names = table_or_chrom.chrom_names
         change = np.flatnonzero(codes[1:] != codes[:-1]) + 1 if n > 1 \
             else np.empty(0, np.int64)
@@ -365,10 +374,21 @@ def _contig_runs(table_or_chrom, n: int):
             # remap the dictionary codes to appearance order so callers'
             # enumerate(uniques) indexing matches the mask codes
             uniques = np.asarray([names[c] for c in run_codes], dtype=object)
-            lut = np.zeros(len(names), dtype=np.int64)
-            lut[run_codes] = np.arange(len(run_codes))
             bounds = np.concatenate([starts, [n]])
-            return lut[codes], uniques, bounds
+            if np.array_equal(run_codes, np.arange(len(run_codes))):
+                # native-scan codes already ARE appearance order (the
+                # parser assigns them first-seen): no LUT, no remap copy
+                out_codes = codes
+            else:
+                lut = np.zeros(len(names), dtype=np.int64)
+                lut[run_codes] = np.arange(len(run_codes))
+                out_codes = lut[codes]
+            memo = (out_codes, uniques, bounds)
+            try:
+                table_or_chrom._contig_runs_memo = memo
+            except AttributeError:
+                pass  # slotted/frozen table: memo is best-effort
+            return memo
         chrom = table_or_chrom.chrom  # unsorted chunk: factorize below
     elif not isinstance(table_or_chrom, np.ndarray) and hasattr(table_or_chrom, "chrom"):
         chrom = table_or_chrom.chrom

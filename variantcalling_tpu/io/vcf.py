@@ -1023,6 +1023,37 @@ class VcfChunkReader:
 
         return retry_transient(attempt, f"chunk read ({self.path})")
 
+    def iter_raw(self):
+        """Raw ``(buf_np, lazy_buf)`` chunk buffers in canonical chunk
+        order, WITHOUT parsing — the zero-wait chunk feed (ROADMAP item
+        4). The streaming executor's pooled layout maps its whole
+        per-chunk body (parse -> fused featurize+score -> render) over
+        these on the IO pool, so a chunk is parsed immediately before it
+        scores inside ONE task: no parsed table ever sits in a queue
+        between a parse worker and a score worker (the
+        ``score_stage.wait`` critical-path edge that dominated
+        BENCH_r12's p95). Boundaries are the same serial rule as
+        :meth:`__iter__` — byte parity and the journal resume identity
+        are unchanged. gz inputs still inflate shard-parallel inside the
+        raw generator. One-shot, like iteration; the same close
+        semantics apply (shared pools outlive exhaustion).
+        """
+        raw = self._raw_gz() if self._gz else self._raw_mm()
+        try:
+            yield from raw
+        finally:
+            if self._pool_shared:
+                self._close_stream()
+            else:
+                self.close()
+
+    def parse_chunk(self, buf_np: np.ndarray, lazy_buf) -> VariantTable:
+        """Parse one raw chunk buffer (``iter_raw``) into a
+        :class:`VariantTable` — the same native scan + per-worker
+        ``parse.wN`` attribution the internal pooled parse uses, exposed
+        for the executor's fused per-chunk body."""
+        return self._parse_worker((buf_np, lazy_buf))
+
     def __iter__(self):
         raw = self._raw_gz() if self._gz else self._raw_mm()
         if self.io_threads <= 1:

@@ -102,6 +102,10 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "128-lane MXU", positive=True, in_header=True),
     _k("VCTPU_NATIVE_GBT", "bool", True,
        "allow the native partitioned-sample GBT trainer on CPU fits"),
+    _k("VCTPU_NATIVE_FUSED", "bool", True,
+       "native engine: score each chunk via the single fused "
+       "parse->featurize->walk native call; 0 selects the unfused "
+       "byte-parity reference path (docs/perf_notes.md)"),
     _k("VCTPU_MESH_DEVICES", "int", None,
        "data-parallel mesh size for XLA scoring (shard_map over dp); 1 "
        "pins single-device, default auto — 1 on cpu, every local device "
@@ -109,6 +113,11 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
     _k("VCTPU_MESH_MEGABATCH_ROWS", "int", None,
        "rows per mesh scoring megabatch in the streaming executor; "
        "default 16384 x mesh devices", positive=True),
+    _k("VCTPU_MESH_OVERLAP", "bool", True,
+       "overlap megabatch packing with the in-flight scoring dispatch "
+       "(one group in flight on a dedicated dispatch worker); 0 keeps "
+       "the synchronous pack-then-score loop "
+       "(docs/streaming_executor.md)"),
     # -- streaming executor / parallel host pipeline --------------------
     _k("VCTPU_THREADS", "int", None,
        "host pipeline threads; 1 selects the serial path; default cpu "

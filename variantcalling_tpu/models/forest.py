@@ -46,15 +46,6 @@ class FlatForest:
     def n_trees(self) -> int:
         return self.feature.shape[0]
 
-    def astuple(self):
-        return (
-            jnp.asarray(self.feature),
-            jnp.asarray(self.threshold),
-            jnp.asarray(self.left),
-            jnp.asarray(self.right),
-            jnp.asarray(self.value),
-        )
-
 
 def sequential_tree_sum(per_tree: jnp.ndarray) -> jnp.ndarray:
     """(N, T) per-tree leaf margins -> (N,) canonical-order sum.
@@ -75,13 +66,48 @@ def sequential_tree_sum(per_tree: jnp.ndarray) -> jnp.ndarray:
                              jnp.zeros(n, dtype=per_tree.dtype))
 
 
+def _packed_node_table(forest: FlatForest) -> np.ndarray:
+    """(T*M, C) float32 packed node table for the gather walk: columns
+    [feature, threshold, left, right, value(, default_left)] with the
+    int32 columns BITCAST into the f32 lanes (a gather only moves bytes,
+    so the bitcast round-trip is exact). One table -> ONE gather per
+    traversal level instead of four or five — on XLA:CPU each rank-2
+    gather lowers to its own scalar loop nest, and collapsing them (plus
+    flattening the (T, M) indexing into 1-D takes) measured ~2.5x on the
+    gather strategy (docs/perf_notes.md "Closing the XLA:CPU gather
+    gap"). Built at trace time from host arrays, so it lands in the
+    compiled program as one constant.
+    """
+    def i32_as_f32(a):
+        # np.asarray first: boosting-trained forests hold concrete jax
+        # arrays, whose .astype lacks numpy's .view
+        return np.asarray(a, dtype=np.int32).reshape(-1).view(np.float32)
+
+    cols = [
+        i32_as_f32(forest.feature),
+        np.asarray(forest.threshold, dtype=np.float32).reshape(-1),
+        i32_as_f32(forest.left),
+        i32_as_f32(forest.right),
+        np.asarray(forest.value, dtype=np.float32).reshape(-1),
+    ]
+    if forest.default_left is not None:
+        cols.append(np.asarray(forest.default_left,
+                               dtype=np.float32).reshape(-1))
+    return np.stack(cols, axis=1)
+
+
 def predict_margin(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     """Raw per-variant leaf-value SUM in canonical tree order (jit-safe).
 
     Traversal: ``max_depth`` rounds of gathers; each round every (variant,
     tree) pair advances one level (leaves self-loop), so control flow is
-    static and XLA lowers the whole forest to fused gathers — no
-    per-variant Python, no host sync.
+    static — no per-variant Python, no host sync. Each round makes ONE
+    gather of the packed node table (:func:`_packed_node_table`) with
+    flat 1-D node ids, plus one flat take of the feature matrix — the
+    XLA:CPU-friendly lowering (the naive per-array ``take_along_axis``
+    formulation ran ~2.5x slower; docs/perf_notes.md). Flat int32
+    indexing bounds N*F and T*M to 2^31 — callers chunk the variants
+    axis (CHUNK = 2^18) far below that.
 
     The accumulation is a SEQUENTIAL fori_loop over trees (t=0,1,...,T-1)
     rather than ``jnp.sum``: XLA's reduce reassociates f32 sums into
@@ -90,28 +116,35 @@ def predict_margin(forest: FlatForest, x: jnp.ndarray) -> jnp.ndarray:
     from themselves across mesh shapes) by 1 ulp — the round-5 multihost
     byte-parity flake. A loop-carried dependency cannot be reassociated,
     and the native walk accumulates in the same order
-    (``native/src/vctpu_gbt.cc`` forest_walk_tile), so the two engines'
-    sums are bit-identical (tests/unit/test_engine_contract.py).
+    (``native/src/vctpu_forest_tile.h`` forest_walk_tile), so the two
+    engines' sums are bit-identical (tests/unit/test_engine_contract.py).
     """
-    feat, thr, left, right, value = forest.astuple()
-    dl = None if forest.default_left is None else jnp.asarray(forest.default_left)
+    t, m = forest.feature.shape
+    has_dl = forest.default_left is not None
+    ptab = jnp.asarray(_packed_node_table(forest))
     n = x.shape[0]
-    t = feat.shape[0]
-    tree_ids = jnp.arange(t)[None, :]  # (1, T)
+    xflat = jnp.asarray(x).reshape(-1)
+    fbase = (jnp.arange(n, dtype=jnp.int32) * x.shape[1])[:, None]  # (N, 1)
+    toff = (jnp.arange(t, dtype=jnp.int32) * m)[None, :]  # (1, T)
+
+    def unpack_i32(col):
+        return jax.lax.bitcast_convert_type(col, jnp.int32)
 
     def body(_, idx):
-        f = feat[tree_ids, idx]  # (N, T)
-        th = thr[tree_ids, idx]
-        xv = jnp.take_along_axis(x, jnp.maximum(f, 0), axis=1)  # (N, T)
+        rows = ptab[toff + idx]  # (N, T, C): the ONE node gather per level
+        f = unpack_i32(rows[..., 0])
+        th = rows[..., 1]
+        xv = xflat[fbase + jnp.maximum(f, 0)]  # (N, T)
         go_left = xv <= th
-        if dl is not None:  # missing (NaN) takes the node's default branch
-            go_left = jnp.where(jnp.isnan(xv), dl[tree_ids, idx], go_left)
-        nxt = jnp.where(go_left, left[tree_ids, idx], right[tree_ids, idx])
+        if has_dl:  # missing (NaN) takes the node's default branch
+            go_left = jnp.where(jnp.isnan(xv), rows[..., 5] != 0, go_left)
+        nxt = jnp.where(go_left, unpack_i32(rows[..., 2]),
+                        unpack_i32(rows[..., 3]))
         return jnp.where(f == LEAF, idx, nxt)
 
     idx0 = jnp.zeros((n, t), dtype=jnp.int32)
     idx = jax.lax.fori_loop(0, forest.max_depth, body, idx0)
-    leaf_vals = value[tree_ids, idx]  # (N, T)
+    leaf_vals = ptab[toff + idx][..., 4]  # (N, T)
     return sequential_tree_sum(leaf_vals)
 
 

@@ -26,6 +26,12 @@ _SRC_CRAM = os.path.join(_DIR, "src", "vctpu_cram.cc")
 _SRC_MATCH = os.path.join(_DIR, "src", "vctpu_match.cc")
 _SRC_GBT = os.path.join(_DIR, "src", "vctpu_gbt.cc")
 _SRC_FEAT = os.path.join(_DIR, "src", "vctpu_features.cc")
+_SRC_FUSED = os.path.join(_DIR, "src", "vctpu_fused.cc")
+#: shared inline headers — hashed into the build key (an edit must
+#: rebuild every TU that includes them) but not compiled standalone
+_HDRS = (os.path.join(_DIR, "src", "vctpu_threads.h"),
+         os.path.join(_DIR, "src", "vctpu_feat_row.h"),
+         os.path.join(_DIR, "src", "vctpu_forest_tile.h"))
 _LOCK = threading.Lock()
 _LIB: ctypes.CDLL | None = None
 _TRIED = False
@@ -60,7 +66,8 @@ def _build() -> str | None:
     hasher = hashlib.sha256()
     hasher.update(" ".join(_CXXFLAGS).encode())  # flag changes rebuild too
     hasher.update(_cpu_tag().encode())  # so does a different host ISA
-    for src in (_SRC, _SRC_CRAM, _SRC_MATCH, _SRC_GBT, _SRC_FEAT):
+    for src in (_SRC, _SRC_CRAM, _SRC_MATCH, _SRC_GBT, _SRC_FEAT, _SRC_FUSED,
+                *_HDRS):
         with open(src, "rb") as fh:
             hasher.update(fh.read())
     tag = hasher.hexdigest()[:12]
@@ -70,7 +77,7 @@ def _build() -> str | None:
     # per-process tmp name keeps os.replace atomic under concurrent builds
     tmp = f"{out}.{os.getpid()}.tmp"
     cmd = ["g++", *_CXXFLAGS, "-o", tmp,
-           _SRC, _SRC_CRAM, _SRC_MATCH, _SRC_GBT, _SRC_FEAT, "-lz"]
+           _SRC, _SRC_CRAM, _SRC_MATCH, _SRC_GBT, _SRC_FEAT, _SRC_FUSED, "-lz"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
         os.replace(tmp, out)
@@ -198,6 +205,17 @@ def get_lib() -> ctypes.CDLL | None:
         lib.vctpu_build_matrix.restype = _i64
         lib.vctpu_build_matrix.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), _i32p, _i64, ctypes.c_int32, _f32p,
+        ]
+        lib.vctpu_fused_chunk_score.restype = _i64
+        lib.vctpu_fused_chunk_score.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), _i64p, _i64p, ctypes.c_int32,
+            _i64p, _i64, ctypes.c_int32,
+            _u8p, _i32p, _i32p, _i32p, _u8p, _i32p,
+            ctypes.POINTER(ctypes.c_void_p), _i32p, ctypes.c_int32, _i32p,
+            _i32p, _f32p, _i32p, _i32p, _f32p, _u8p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_float,
+            _f32p,
         ]
         lib.vctpu_forest_predict.restype = _i64
         lib.vctpu_forest_predict.argtypes = [
@@ -910,6 +928,87 @@ def matrix_forest_predict(cols: list[np.ndarray], feat: np.ndarray, thr: np.ndar
         vv.ctypes.data_as(_f32p),
         None if dl is None else dl.ctypes.data_as(_u8p),
         t, m, max_depth, {"mean": 0, "logit_sum": 1, "sum": 2}[aggregation], base_score,
+        out.ctypes.data_as(_f32p),
+    )
+    return out if rc == 0 else None
+
+
+def fused_chunk_score(run_seqs: list[np.ndarray], run_bounds: np.ndarray,
+                      pos0: np.ndarray, radius: int,
+                      is_indel, indel_nuc, ref_code, alt_code, is_snp,
+                      flow_order: np.ndarray,
+                      cols: list, dev_cols: np.ndarray,
+                      feat: np.ndarray, thr: np.ndarray, left: np.ndarray,
+                      right: np.ndarray, value: np.ndarray,
+                      default_left: np.ndarray | None, max_depth: int,
+                      aggregation: str, base_score: float) -> np.ndarray | None:
+    """ONE native call per chunk: contig-run window gather -> featurize ->
+    L2-tiled matrix fill -> forest walk, margins out (ROADMAP item 4).
+
+    ``run_seqs`` holds the encoded contig of each contiguous row run
+    (``run_bounds``, (n_runs+1,)); a contig missing from the FASTA passes
+    an empty array (all-N windows). ``cols`` lists the HOST feature
+    columns in feature order with ``None`` at the six window-derived
+    slots; ``dev_cols`` (6,) names each device feature's column index
+    (DEVICE_FEATURES order). ``aggregation="sum"`` returns raw
+    canonical-order leaf sums — the engine-parity path finalizes on the
+    host, exactly like :func:`matrix_forest_predict`. Margins are
+    bit-identical to the unfused reference (shared row featurize, shared
+    tile fill, shared walk). None -> caller uses the unfused path."""
+    lib = get_lib()
+    if lib is None or aggregation not in ("mean", "logit_sum", "sum"):
+        return None
+    n = len(pos0)
+    _f32p = ctypes.POINTER(ctypes.c_float)
+    # columns: typed pointers with dtype -1 at device-feature slots
+    arrs = []
+    codes = np.empty(len(cols), dtype=np.int32)
+    for j, c in enumerate(cols):
+        if c is None:
+            arrs.append(None)
+            codes[j] = -1
+            continue
+        a = np.ascontiguousarray(c)
+        code = _MATRIX_DTYPES.get(a.dtype)
+        if code is None or a.ndim != 1 or len(a) != n:
+            return None
+        arrs.append(a)
+        codes[j] = code
+    col_ptrs = (ctypes.c_void_p * len(cols))(
+        *[None if a is None else a.ctypes.data for a in arrs])
+    # contig runs: zero-copy pointers into the encoded contigs
+    seqs = [np.ascontiguousarray(_u8view(s), dtype=np.uint8) for s in run_seqs]
+    seq_ptrs = (ctypes.c_void_p * max(len(seqs), 1))(
+        *([s.ctypes.data for s in seqs] or [None]))
+    seq_lens = np.asarray([len(s) for s in seqs], dtype=np.int64)
+    bounds = np.ascontiguousarray(run_bounds, dtype=np.int64)
+    p = np.ascontiguousarray(pos0, dtype=np.int64)
+    ii = np.ascontiguousarray(is_indel, dtype=np.uint8)
+    nu = np.ascontiguousarray(indel_nuc, dtype=np.int32)
+    rc_ = np.ascontiguousarray(ref_code, dtype=np.int32)
+    ac = np.ascontiguousarray(alt_code, dtype=np.int32)
+    sn = np.ascontiguousarray(is_snp, dtype=np.uint8)
+    fo = np.ascontiguousarray(flow_order, dtype=np.int32)
+    dc = np.ascontiguousarray(dev_cols, dtype=np.int32)
+    ff, tt, ll, rr, vv, dl = _marshal_forest(feat, thr, left, right, value,
+                                             default_left)
+    t, m = ff.shape
+    out = np.empty(n, dtype=np.float32)
+    rc = lib.vctpu_fused_chunk_score(
+        seq_ptrs, seq_lens.ctypes.data_as(_i64p),
+        bounds.ctypes.data_as(_i64p), len(seqs),
+        p.ctypes.data_as(_i64p), n, radius,
+        ii.ctypes.data_as(_u8p), nu.ctypes.data_as(_i32p),
+        rc_.ctypes.data_as(_i32p), ac.ctypes.data_as(_i32p),
+        sn.ctypes.data_as(_u8p), fo.ctypes.data_as(_i32p),
+        col_ptrs, codes.ctypes.data_as(_i32p), len(cols),
+        dc.ctypes.data_as(_i32p),
+        ff.ctypes.data_as(_i32p), tt.ctypes.data_as(_f32p),
+        ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
+        vv.ctypes.data_as(_f32p),
+        None if dl is None else dl.ctypes.data_as(_u8p),
+        t, m, max_depth, {"mean": 0, "logit_sum": 1, "sum": 2}[aggregation],
+        base_score,
         out.ctypes.data_as(_f32p),
     )
     return out if rc == 0 else None

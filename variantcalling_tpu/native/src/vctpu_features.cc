@@ -24,128 +24,15 @@
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstring>
+#include <vector>
 
+#include "vctpu_feat_row.h"
 #include "vctpu_threads.h"
 
-namespace {
-
-constexpr int32_t BASE_N = 4;
-
-// flow signature of one haplotype: returns run count, fills cums[] with
-// the (strictly increasing) cumulative flow position of each run.
-// lookup[base] = flow-cycle position of base in the flow order.
-inline int32_t flow_signature(const uint8_t* hap, int32_t len,
-                              const int32_t* lookup, int32_t* cums) {
-    int32_t eff = len;
-    for (int32_t i = 0; i < len; ++i) {
-        if (hap[i] == BASE_N) { eff = i; break; }
-    }
-    int32_t n_runs = 0, cum = 0;
-    int32_t prev_pos = -1;
-    uint8_t prev_base = 255;
-    for (int32_t i = 0; i < eff; ++i) {
-        const int32_t pos = lookup[hap[i]];
-        if (i == 0 || hap[i] != prev_base) {  // run start
-            const int32_t d = (i == 0) ? pos + 1 : ((pos - prev_pos) % 4 + 4) % 4;
-            cum += d;
-            cums[n_runs++] = cum;
-        }
-        prev_base = hap[i];
-        prev_pos = pos;
-    }
-    return n_runs;
-}
-
-}  // namespace
-
-namespace {
-
-constexpr int32_t GC_RADIUS = 10, MOTIF_K = 5, CONTEXT = 4, MAX_RUN = 40;
-
-// One row of window featurization (shared by the materialized-window and
-// fused-gather entry points — the fused path never writes the window).
-inline void featurize_row(
-    const uint8_t* row, int32_t w, int32_t center, int64_t i,
-    const uint8_t* is_indel, const int32_t* indel_nuc,
-    const int32_t* ref_code, const int32_t* alt_code, const uint8_t* is_snp,
-    const int32_t* lookup,
-    int32_t* hmer_len, int32_t* hmer_nuc, float* gc, int32_t* cyc,
-    int32_t* left_motif, int32_t* right_motif) {
-    const int32_t hap_len = 2 * CONTEXT + 1;
-
-    // gc_content over +-GC_RADIUS
-    int32_t n_gc = 0, n_base = 0;
-    for (int32_t j = center - GC_RADIUS; j <= center + GC_RADIUS; ++j) {
-        const uint8_t b = row[j];
-        n_gc += (b == 1) | (b == 2);   // C or G
-        n_base += b != BASE_N;
-    }
-    gc[i] = (float)n_gc / (float)(n_base > 1 ? n_base : 1);
-
-    // hmer run at center+1, capped at the window edge like the jitted
-    // kernel (span = windows[:, start:start+max_run])
-    const int32_t start = center + 1;
-    const int32_t span = (w - start) < MAX_RUN ? (w - start) : MAX_RUN;
-    const uint8_t base0 = row[start];
-    int32_t run = 1;
-    while (run < span && row[start + run] == base0) ++run;
-    const bool hmer = is_indel[i] && indel_nuc[i] < 4 &&
-                      indel_nuc[i] == (int32_t)base0;
-    hmer_len[i] = hmer ? run : 0;
-    hmer_nuc[i] = hmer ? indel_nuc[i] : BASE_N;
-
-    // base-5 packed motifs adjacent to the anchor
-    int32_t lm = 0, rm = 0;
-    for (int32_t j = 0; j < MOTIF_K; ++j) {
-        lm = lm * 5 + row[center - MOTIF_K + j];
-        rm = rm * 5 + row[center + 1 + j];
-    }
-    left_motif[i] = lm;
-    right_motif[i] = rm;
-
-    // cycle-skip status (SNPs only)
-    if (!is_snp[i]) {
-        cyc[i] = -1;
-        return;
-    }
-    uint8_t ref_hap[2 * CONTEXT + 1], alt_hap[2 * CONTEXT + 1];
-    for (int32_t j = 0; j < CONTEXT; ++j) {
-        ref_hap[j] = alt_hap[j] = row[center - CONTEXT + j];
-        ref_hap[CONTEXT + 1 + j] = alt_hap[CONTEXT + 1 + j] = row[center + 1 + j];
-    }
-    ref_hap[CONTEXT] = (uint8_t)ref_code[i];
-    alt_hap[CONTEXT] = (uint8_t)alt_code[i];
-    int32_t ref_cums[2 * CONTEXT + 1], alt_cums[2 * CONTEXT + 1];
-    const int32_t nr = flow_signature(ref_hap, hap_len, lookup, ref_cums);
-    const int32_t na = flow_signature(alt_hap, hap_len, lookup, alt_cums);
-    const int32_t ref_flows = nr ? ref_cums[nr - 1] : 0;
-    const int32_t alt_flows = na ? alt_cums[na - 1] : 0;
-    if (ref_flows != alt_flows) {
-        cyc[i] = 2;
-    } else {
-        bool diff = nr != na;
-        for (int32_t j = 0; !diff && j < nr; ++j)
-            diff = ref_cums[j] != alt_cums[j];
-        cyc[i] = diff ? 1 : 0;
-    }
-}
-
-inline bool featurize_geometry_ok(int32_t w, int32_t center) {
-    return w > 0 && center >= GC_RADIUS && center + GC_RADIUS < w &&
-           center >= MOTIF_K && center + MOTIF_K < w &&
-           center >= CONTEXT && center + CONTEXT < w;
-}
-
-inline bool flow_lookup_init(const int32_t* flow_order, int32_t* lookup) {
-    for (int32_t p = 0; p < 5; ++p) lookup[p] = 0;  // N unused (runs truncate)
-    for (int32_t p = 0; p < 4; ++p) {
-        if (flow_order[p] < 0 || flow_order[p] > 3) return false;
-        lookup[flow_order[p]] = p;
-    }
-    return true;
-}
-
-}  // namespace
+using vctpu_feat::featurize_geometry_ok;
+using vctpu_feat::featurize_row;
+using vctpu_feat::flow_lookup_init;
 
 extern "C" {
 
@@ -291,7 +178,13 @@ inline int fast_g4(double v, char* out) {
 // Per-record ";KEY=<%g>" INFO suffixes for one float column (NaN ->
 // empty) — the filter pipeline's TREE_SCORE writeback formatter, printf
 // %g exactly like numpy's b"%g" so the byte-splicing output is unchanged.
-// Returns total bytes written, or -1 when cap is too small.
+// DELIBERATELY serial: a provisional-offset sharded variant was measured
+// 2x SLOWER at 2 threads (each shard writes into the sparse worst-case
+// region of the fresh output buffer and the compaction re-touches it —
+// page-fault traffic doubles, dwarfing the ~45ns/row format cost), and
+// in the streaming pipeline this call already parallelizes ACROSS chunks
+// on the IO pool (ctypes releases the GIL). Returns total bytes written,
+// or -1 when cap is too small.
 int64_t vctpu_format_float_info(
     const double* vals, int64_t n,
     const uint8_t* prefix, int64_t prefix_len,  // b";KEY="

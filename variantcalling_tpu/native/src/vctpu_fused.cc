@@ -1,0 +1,167 @@
+// Fused whole-chunk scoring: parse output -> window featurize -> forest
+// walk in ONE native call (ROADMAP item 4, "tear down the scoring wall").
+//
+// The pre-fusion native hot path crossed the ctypes boundary four times
+// per chunk (per-contig featurize_gather into six full columns, then the
+// column->tile->walk pass re-reading them), with Python glue between the
+// crossings serializing under the GIL while other chunk workers waited.
+// This entry runs the whole per-chunk scoring body tile-at-a-time: each
+// 8192-row tile fills its host feature columns (the SAME fill_tile the
+// matrix path uses), computes the six window-derived features straight
+// out of the encoded contig (the SAME featurize_row the per-contig path
+// uses — windows are never materialized), and walks the forest while the
+// tile is L2-hot. The six device-feature columns never exist as arrays,
+// saving two full sweeps of 24 B/variant, and the chunk makes ONE
+// boundary crossing.
+//
+// Margins are bit-identical to the unfused path by construction: same
+// featurize_row, same fill_tile casts, same forest_walk_tile accumulation
+// order (the engine contract, docs/robustness.md). The Python-side
+// unfused path stays in the tree as the byte-parity reference
+// (VCTPU_NATIVE_FUSED=0 selects it; the parity matrix in
+// tests/unit/test_fused_native.py locks fused == reference == jit).
+
+#include <atomic>
+#include <cstdint>
+#include <vector>
+
+#include "vctpu_feat_row.h"
+#include "vctpu_forest_tile.h"
+#include "vctpu_threads.h"
+
+using vctpu_feat::featurize_geometry_ok;
+using vctpu_feat::featurize_row;
+using vctpu_feat::flow_lookup_init;
+using vctpu_forest::Node;
+using vctpu_forest::fill_tile;
+using vctpu_forest::forest_walk_tile;
+using vctpu_forest::pack_nodes;
+
+extern "C" {
+
+// Score one chunk end to end. Rows are grouped into contig RUNS (sorted
+// VCFs put each contig in one contiguous row range — featurize._contig_runs):
+// run r covers rows [run_bounds[r], run_bounds[r+1]) and reads windows
+// from run_seqs[r] (encoded contig, len run_seq_lens[r]; a contig missing
+// from the FASTA passes len 0 and every window reads all-N, exactly like
+// the per-contig fallback). Host feature columns arrive as typed column
+// pointers in feature order; the six window-derived features name their
+// column slot via dev_cols (order: hmer_len, hmer_nuc, gc, cyc,
+// left_motif, right_motif) and carry dtype -1 in `dtypes` so fill_tile
+// skips them. aggregation: 0 mean / 1 logit_sum / 2 raw sum (engine-
+// parity callers use 2 and finalize on the host). Returns 0, or <0 on
+// bad arguments.
+int64_t vctpu_fused_chunk_score(
+    const void* const* run_seqs, const int64_t* run_seq_lens,
+    const int64_t* run_bounds, int32_t n_runs,
+    const int64_t* pos0, int64_t n, int32_t radius,
+    const uint8_t* is_indel, const int32_t* indel_nuc,
+    const int32_t* ref_code, const int32_t* alt_code, const uint8_t* is_snp,
+    const int32_t* flow_order,
+    const void* const* cols, const int32_t* dtypes, int32_t f,
+    const int32_t* dev_cols,  // (6,) column index per device feature, or -1
+    const int32_t* feat, const float* thr,
+    const int32_t* left, const int32_t* right, const float* value,
+    const uint8_t* default_left,
+    int32_t t, int32_t m, int32_t max_depth,
+    int32_t aggregation, float base_score,
+    float* out) try
+{
+    const int32_t w = 2 * radius + 1;
+    if (n < 0 || f <= 0 || t <= 0 || m <= 0 || max_depth <= 0) return -1;
+    if (aggregation < 0 || aggregation > 2) return -1;
+    if (n_runs < 0 || radius <= 0 || w > 512 || !featurize_geometry_ok(w, radius))
+        return -1;
+    if (n_runs > 0 && (run_bounds[0] != 0 || run_bounds[n_runs] != n)) return -1;
+    for (int32_t r = 0; r < n_runs; ++r)
+        if (run_bounds[r + 1] < run_bounds[r] || run_seq_lens[r] < 0) return -1;
+    for (int32_t j = 0; j < f; ++j)
+        if (dtypes[j] > 4) return -2;
+    for (int32_t k = 0; k < 6; ++k)
+        if (dev_cols[k] >= f) return -2;
+    int32_t lookup[5];
+    if (!flow_lookup_init(flow_order, lookup)) return -2;
+
+    std::vector<Node> nodes;
+    pack_nodes(nodes, feat, thr, left, right, value, default_left, (int64_t)t * m);
+    const bool has_dl = default_left != nullptr;
+
+    const int64_t BLOCK = 8192;
+    std::atomic<int> failed{0};
+    vctpu::for_shards((n + BLOCK - 1) / BLOCK, vctpu::nthreads(),
+                      [&](int, int64_t b_lo, int64_t b_hi) {
+        std::vector<float> tile;
+        std::vector<int32_t> di32;  // hl, hn, cyc, lm, rm per tile row
+        std::vector<float> dgc;
+        try {
+            tile.resize((size_t)BLOCK * f);
+            di32.resize((size_t)BLOCK * 5);
+            dgc.resize((size_t)BLOCK);
+        } catch (...) {
+            failed.store(1);
+            return;
+        }
+        int32_t* hl = di32.data();
+        int32_t* hn = hl + BLOCK;
+        int32_t* cy = hn + BLOCK;
+        int32_t* lm = cy + BLOCK;
+        int32_t* rm = lm + BLOCK;
+        int32_t run = 0;  // per-shard run cursor; rows ascend within a shard
+        for (int64_t lo = b_lo * BLOCK; lo < b_hi * BLOCK && lo < n; lo += BLOCK) {
+            const int64_t hi = lo + BLOCK < n ? lo + BLOCK : n;
+            fill_tile(cols, dtypes, f, lo, hi, tile.data());
+            // window features straight out of each row's contig run
+            while (run < n_runs && run_bounds[run + 1] <= lo) ++run;
+            int32_t rr = run;
+            uint8_t pad[512];
+            for (int64_t i = lo; i < hi; ++i) {
+                while (rr < n_runs && run_bounds[rr + 1] <= i) ++rr;
+                const uint8_t* seq = rr < n_runs
+                    ? (const uint8_t*)run_seqs[rr] : nullptr;
+                const int64_t seq_len = rr < n_runs ? run_seq_lens[rr] : 0;
+                const int64_t wlo = pos0[i] - radius;
+                const uint8_t* row;
+                if (seq != nullptr && wlo >= 0 && wlo + w <= seq_len) {
+                    row = seq + wlo;  // interior: zero-copy view
+                } else {
+                    for (int32_t j = 0; j < w; ++j) {
+                        const int64_t p = wlo + j;
+                        pad[j] = (seq != nullptr && p >= 0 && p < seq_len)
+                                 ? seq[p] : 4;
+                    }
+                    row = pad;
+                }
+                const int64_t li = i - lo;
+                featurize_row(row, w, radius, li,
+                              is_indel + lo, indel_nuc + lo, ref_code + lo,
+                              alt_code + lo, is_snp + lo, lookup,
+                              hl, hn, dgc.data(), cy, lm, rm);
+            }
+            // scatter the six device features into their tile columns —
+            // the same (float)int32 cast fill_tile's case 1 applies, so
+            // the assembled row bits match the unfused reference exactly
+            const int64_t count = hi - lo;
+            const int32_t* icols[5] = {hl, hn, cy, lm, rm};
+            const int32_t islot[5] = {dev_cols[0], dev_cols[1], dev_cols[3],
+                                      dev_cols[4], dev_cols[5]};
+            for (int32_t k = 0; k < 5; ++k) {
+                if (islot[k] < 0) continue;
+                float* d = tile.data() + islot[k];
+                const int32_t* s = icols[k];
+                for (int64_t i = 0; i < count; ++i) d[(size_t)i * f] = (float)s[i];
+            }
+            if (dev_cols[2] >= 0) {  // gc_content: float32 passthrough
+                float* d = tile.data() + dev_cols[2];
+                for (int64_t i = 0; i < count; ++i) d[(size_t)i * f] = dgc[i];
+            }
+            forest_walk_tile(nodes.data(), tile.data(), count, f, t, m,
+                             max_depth, has_dl, aggregation, base_score,
+                             out + lo);
+        }
+    }, 2);
+    return failed.load() ? -1 : 0;
+} catch (...) {
+    return -1;  // bad_alloc / thread-spawn failure must not cross the C ABI
+}
+
+}  // extern "C"

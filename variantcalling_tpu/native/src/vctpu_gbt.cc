@@ -24,6 +24,7 @@
 #include <cstring>
 #include <vector>
 
+#include "vctpu_forest_tile.h"
 #include "vctpu_threads.h"
 
 extern "C" {
@@ -64,121 +65,10 @@ int64_t vctpu_bin_features(
     return 0;
 }
 
-namespace {
-
-struct Node {
-    float thr;
-    float value;
-    int32_t feat;
-    int32_t left;
-    int32_t right;
-    int32_t dl;
-};
-
-// pack the five SoA arrays into one cache-friendly node table
-inline void pack_nodes(std::vector<Node>& nodes, const int32_t* feat, const float* thr,
-                       const int32_t* left, const int32_t* right, const float* value,
-                       const uint8_t* default_left, int64_t count) {
-    nodes.resize((size_t)count);
-    for (int64_t k = 0; k < count; ++k) {
-        nodes[k] = {thr[k], value[k], feat[k], left[k], right[k],
-                    default_left ? (int32_t)default_left[k] : -1};
-    }
-}
-
-// walk rows [0, count) of a row-major tile; out is per-row. Walks two
-// trees concurrently per row: the per-tree pointer chase is a serial
-// dependency chain, so interleaving two independent chains hides
-// node-load latency (~20% on one core). Accumulation order is the exact
-// sequential tree order (t=0,1,...,T-1) — the CANONICAL order the jit
-// engine's fori_loop accumulation also uses, so sums are bit-identical
-// across engines (the engine contract, docs/robustness.md).
-// aggregation: 0 = mean (sum / t; division is IEEE-correctly-rounded so
-// both engines agree bit-for-bit), 1 = logit_sum (sigmoid(sum + base);
-// exp is implementation-defined — engine-parity callers use mode 2 and
-// finalize on the host instead), 2 = raw sum (no finalization).
-inline void forest_walk_tile(const Node* nodes, const float* x, int64_t count, int32_t f,
-                             int32_t t, int32_t m, int32_t max_depth, bool has_dl,
-                             int32_t aggregation, float base_score, float* out) {
-    for (int64_t i = 0; i < count; ++i) {
-        const float* row = x + (size_t)i * f;
-        float acc = 0.0f;
-        int32_t ti = 0;
-        for (; ti + 1 < t; ti += 2) {
-            const Node* ta = nodes + (size_t)ti * m;
-            const Node* tb = ta + m;
-            int32_t ia = 0, ib = 0;
-            for (int32_t d = 0; d < max_depth; ++d) {
-                const Node& na = ta[ia];
-                const Node& nb = tb[ib];
-                if (na.feat >= 0) {
-                    const float xv = row[na.feat];
-                    bool gl = xv <= na.thr;  // NaN -> false (right)
-                    if (has_dl && std::isnan(xv) && na.dl >= 0) gl = na.dl != 0;
-                    ia = gl ? na.left : na.right;
-                }
-                if (nb.feat >= 0) {
-                    const float xv = row[nb.feat];
-                    bool gl = xv <= nb.thr;
-                    if (has_dl && std::isnan(xv) && nb.dl >= 0) gl = nb.dl != 0;
-                    ib = gl ? nb.left : nb.right;
-                }
-            }
-            acc += ta[ia].value;
-            acc += tb[ib].value;
-        }
-        for (; ti < t; ++ti) {  // odd tail tree
-            const Node* tree = nodes + (size_t)ti * m;
-            int32_t idx = 0;
-            for (int32_t d = 0; d < max_depth; ++d) {
-                const Node& nd = tree[idx];
-                if (nd.feat < 0) break;  // leaf (LEAF == -1) self-loops
-                const float xv = row[nd.feat];
-                bool go_left = xv <= nd.thr;
-                if (has_dl && std::isnan(xv) && nd.dl >= 0)
-                    go_left = nd.dl != 0;
-                idx = go_left ? nd.left : nd.right;
-            }
-            acc += tree[idx].value;
-        }
-        out[i] = aggregation == 0 ? acc / (float)t
-               : aggregation == 1 ? 1.0f / (1.0f + std::exp(-(acc + base_score)))
-                                  : acc;
-    }
-}
-
-// fill rows [lo, hi) of a row-major f32 tile from typed column pointers
-// (dtypes: 0 f32, 1 i32, 2 f64, 3/4 uint8/bool); dst row 0 = source row lo
-inline void fill_tile(const void* const* cols, const int32_t* dtypes, int32_t f,
-                      int64_t lo, int64_t hi, float* dst) {
-    for (int32_t j = 0; j < f; ++j) {
-        float* d = dst + j;
-        switch (dtypes[j]) {
-            case 0: {
-                const float* s = (const float*)cols[j] + lo;
-                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = s[i];
-                break;
-            }
-            case 1: {
-                const int32_t* s = (const int32_t*)cols[j] + lo;
-                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = (float)s[i];
-                break;
-            }
-            case 2: {
-                const double* s = (const double*)cols[j] + lo;
-                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = (float)s[i];
-                break;
-            }
-            default: {  // 3/4: uint8 / bool
-                const uint8_t* s = (const uint8_t*)cols[j] + lo;
-                for (int64_t i = 0; i < hi - lo; ++i) d[(size_t)i * f] = (float)s[i];
-                break;
-            }
-        }
-    }
-}
-
-}  // namespace
+using vctpu_forest::Node;
+using vctpu_forest::fill_tile;
+using vctpu_forest::forest_walk_tile;
+using vctpu_forest::pack_nodes;
 
 // Forest inference, CPU twin of models/forest.predict_score: the exact
 // gather-walk semantics (x <= thr goes left; NaN takes default_left when

@@ -237,6 +237,21 @@ def megabatch_stream(prepped, ctx, profiler=None):
     its share of the records; ``vctpu obs bottleneck`` merges the family
     like the ``.wN`` worker families).
 
+    ZERO-WAIT FEED (docs/streaming_executor.md "Overlapped megabatch
+    dispatch"): the scoring dispatch runs on a dedicated one-worker
+    dispatch pool with at most ONE group in flight — while group N
+    scores, this generator keeps pulling ``prepped`` and PACKS group
+    N+1, so the dispatch never sits idle waiting for the slowest member
+    of the next group to featurize (``score_stage.wait`` was the
+    dominant p95 critical-path edge before the overlap, BENCH_r12).
+    Results still yield strictly in canonical chunk order: group N's
+    scores are drained before group N+1's dispatch is submitted, and
+    memory stays bounded at two groups (one in flight + one packing).
+    ``VCTPU_MESH_OVERLAP=0`` restores the synchronous pack-then-score
+    loop. Recovery semantics are unchanged — the whole ladder runs
+    inside the dispatched body, and its escalations
+    (:class:`MeshDegradeRestart`) surface when the group is drained.
+
     SUPERVISED dispatch (docs/robustness.md "Recovery ladder"): a failed
     megabatch never kills the run outright. Device OOM
     (``RESOURCE_EXHAUSTED``) first SHRINKS the packing target (halved for
@@ -360,27 +375,56 @@ def megabatch_stream(prepped, ctx, profiler=None):
             scored = []
             for pair in group:
                 scored.extend(chunk_supervised(pair))
-        yield from scored
+        return list(scored)
+
+    from variantcalling_tpu.parallel.pipeline import IoPool
+
+    pool = IoPool(1, name="vctpu-mesh-dispatch") \
+        if knobs.get_bool("VCTPU_MESH_OVERLAP") else None
+    pending = None  # the ONE in-flight dispatch future (overlap mode)
+
+    def drain():
+        """Results of the in-flight dispatch, in order; re-raises its
+        failure (the ladder already ran inside the dispatched body)."""
+        nonlocal pending
+        if pending is None:
+            return []
+        out, pending = pending.result(), None
+        return out
 
     group: list = []
     rows = 0
-    for table, hf in prepped:
-        if hf is None:
-            # featurize-stage quarantine marker from upstream: flush the
-            # pending group first (canonical chunk order), then pass the
-            # marker straight through to the render/quarantine path
-            if group:
-                yield from flush(group)
+    try:
+        for table, hf in prepped:
+            if hf is None:
+                # featurize-stage quarantine marker from upstream: drain
+                # the in-flight dispatch and flush the pending group first
+                # (canonical chunk order), then pass the marker straight
+                # through to the render/quarantine path
+                yield from drain()
+                if group:
+                    yield from flush(group)
+                    group, rows = [], 0
+                yield (table, None, None)
+                continue
+            group.append((table, hf))
+            rows += len(table)
+            if rows >= state["target"]:
+                if pool is None:
+                    yield from flush(group)
+                else:
+                    # overlap: drain group N's results, hand group N+1 to
+                    # the dispatch worker, keep packing group N+2 from
+                    # ``prepped`` while it scores
+                    yield from drain()
+                    pending = pool.submit(flush, group)
                 group, rows = [], 0
-            yield (table, None, None)
-            continue
-        group.append((table, hf))
-        rows += len(table)
-        if rows >= state["target"]:
+        yield from drain()
+        if group:
             yield from flush(group)
-            group, rows = [], 0
-    if group:
-        yield from flush(group)
+    finally:
+        if pool is not None:
+            pool.shutdown()
 
 
 def log_plan(plan: MeshPlan) -> None:

@@ -383,6 +383,48 @@ def _narrow_column(a: np.ndarray) -> np.ndarray:
     return a.astype(np.float32, copy=False)
 
 
+def _fused_native_chunk_score(ordered, hf, fo: np.ndarray, table,
+                              fasta) -> np.ndarray | None:
+    """The single-call native chunk body (ROADMAP item 4): contig runs +
+    encoded contigs + host columns + forest go across the ctypes boundary
+    ONCE per chunk (``native.fused_chunk_score``) and canonical-order
+    margins come back — window gather, featurize, matrix fill and the
+    forest walk all happen tile-at-a-time in C++, with no intermediate
+    feature columns and no per-call Python between them. Margins are
+    bit-identical to the unfused reference path below (shared C++ row
+    featurize / tile fill / walk; locked by the parity matrix in
+    tests/unit/test_fused_native.py). Returns finalized scores, or None
+    when this chunk cannot take the fused path (unsorted chunk, no
+    native library) — the caller falls through to the reference path.
+    """
+    from variantcalling_tpu import native
+    from variantcalling_tpu.featurize import (CENTER, DEVICE_FEATURES,
+                                              _contig_runs)
+
+    n = len(table)
+    codes, uniques, bounds = _contig_runs(table, n)
+    if bounds is None:  # unsorted chunk: reference path masks per contig
+        return None
+    empty = np.empty(0, dtype=np.uint8)
+    seqs = [fasta.fetch_encoded(c) if c in fasta.references else empty
+            for c in uniques]
+    dev_cols = np.asarray(
+        [hf.names.index(k) if k in hf.names else -1 for k in DEVICE_FEATURES],
+        dtype=np.int32)
+    cols = [None if f in DEVICE_FEATURES else np.asarray(hf.cols[f])
+            for f in hf.names]
+    alle = hf.alle
+    margin = native.fused_chunk_score(
+        seqs, bounds, table.pos - 1, CENTER,
+        alle.is_indel, alle.indel_nuc, alle.ref_code, alle.alt_code,
+        alle.is_snp, fo, cols, dev_cols,
+        ordered.feature, ordered.threshold, ordered.left, ordered.right,
+        ordered.value, ordered.default_left, ordered.max_depth, "sum", 0.0)
+    if margin is None:
+        return None
+    return forest_mod.finalize_margin(margin, ordered)
+
+
 def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.ndarray | None:
     """All-native CPU hot path: numpy window gather + C++ featurize + C++
     forest walk; returns scores or None when the native engine cannot
@@ -405,6 +447,14 @@ def _native_cpu_featurize_score(model, hf, flow_order: str, table, fasta) -> np.
     alle = hf.alle
     fo = np.asarray([{"A": A, "C": C, "G": G, "T": T}[c] for c in flow_order],
                     dtype=np.int32)
+    if hf.windows is None and knobs.get_bool("VCTPU_NATIVE_FUSED"):
+        # the fused per-chunk entry: ONE native call for the whole
+        # parse-output -> featurize -> score body. The unfused path
+        # below stays as the byte-parity reference (VCTPU_NATIVE_FUSED=0)
+        score = _fused_native_chunk_score(ordered, hf, fo, table, fasta)
+        if score is not None:
+            forest_mod.last_strategy = "native-cpp"  # vctpu-lint: disable=VCT010 — run-scoped diagnostic; GIL-atomic store, every concurrent chunk writes the same value
+            return score
     dev = None
     if hf.windows is None:
         # fused gather+featurize: windows stream out of the encoded contig
@@ -1280,7 +1330,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
 
     def score_stage(table):
         # the chunk body rides the recovery ladder: the executor (serial
-        # layout) or chunk_worker (pooled layout) provides the bounded
+        # layout) or raw_chunk_worker (pooled layout) provides the bounded
         # re-dispatch; the guard provides the opt-in quarantine rung —
         # a diverted chunk flows on as a (table, None, None) marker.
         # The chunk's trace binds to the thread for the duration so
@@ -1316,27 +1366,52 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 dt, records=n_records)
         return out
 
-    def chunk_worker(table):
-        """The pooled per-chunk body (parallel layout): featurize+score
-        then native record render, one task per chunk — chunk c's Python
-        glue overlaps chunk c+1's native kernels instead of serializing
-        on dedicated stage threads. The executor's fault-injection points
-        keep firing per chunk so the watchdog/error contracts stay
-        testable in this layout. The whole body rides the recovery
-        ladder: bounded re-dispatch (``VCTPU_CHUNK_RETRIES``) around the
-        quarantine guard inside ``score_stage``."""
+    def raw_chunk_worker(item):
+        """The ZERO-WAIT pooled chunk body: parse -> fused featurize+
+        score -> render as ONE task over a RAW chunk buffer
+        (``VcfChunkReader.iter_raw``). A chunk is parsed immediately
+        before it scores on the same worker, so no parsed table ever
+        waits in a queue between a parse task and a score task — the
+        ``score_stage.wait`` edge that dominated the p95 critical path
+        (BENCH_r12) is gone structurally, not hidden. Parse rides inside
+        the chunk's retry budget (it is a pure function of the held
+        buffer, so re-dispatch cannot change bytes; its own transient-IO
+        retry stays inside ``parse_chunk``). Trace ids were allocated at
+        the raw feed in canonical chunk order; the ingest span is
+        emitted here with the parse duration — ONCE per chunk, whatever
+        the retry budget spends (a re-dispatched body re-parses but must
+        not grow a second root span), so the chunk DAG keeps the exact
+        shape every obs consumer expects."""
+        buf_np, lazy_buf, tid = item
+        ingest_span_emitted = [False]
+
         def body():
             faults.check("pipeline.stage")
             faults.check("pipeline.stage_hang")
+            t0 = _time.perf_counter()  # vctpu-lint: disable=VCT006 — obs trace-span timing
+            table = reader.parse_chunk(buf_np, lazy_buf)
+            if tid is not None:
+                table._obs_trace = tid
+                if not ingest_span_emitted[0]:
+                    ingest_span_emitted[0] = True
+                    obs.trace_span(tid, "ingest",
+                                   _time.perf_counter() - t0,  # vctpu-lint: disable=VCT006 — obs trace-span timing
+                                   records=len(table))
             scored = _timed_worker(score_stage, "score_stage", table,
                                    len(table))
             return _timed_worker(render_stage, "render_stage", scored,
                                  len(table))
 
-        # bind the chunk's trace for the whole pooled body so the
-        # re-dispatch events of the ladder name the chunk they recover
-        with obs.trace_scope(getattr(table, "_obs_trace", None)):
+        with obs.trace_scope(tid):
             return retry_chunk(body, "chunk_worker")
+
+    def _traced_raw(raws):
+        """Allocate trace ids at the raw feed, in canonical chunk order
+        (the ``_traced_chunks`` contract, kept for the raw layout — the
+        pooled workers parse concurrently, so allocation cannot wait
+        until parse time)."""
+        for buf_np, lazy_buf in raws:
+            yield buf_np, lazy_buf, obs.new_trace()
 
     def render_stage(item):
         table, score, filters = item
@@ -1607,8 +1682,12 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     elif source_pooled:
         from variantcalling_tpu.parallel.pipeline import imap_ordered
 
-        source = imap_ordered(reader.shared_pool(), chunk_worker,
-                              _traced_chunks(reader),
+        # the zero-wait feed: the in-flight window holds RAW BYTE
+        # buffers, and each pooled task runs the chunk's WHOLE body
+        # (parse -> fused featurize+score -> render) — nothing parsed
+        # ever queues between stages (ROADMAP item 4)
+        source = imap_ordered(reader.shared_pool(), raw_chunk_worker,
+                              _traced_raw(reader.iter_raw()),
                               window=reader.io_threads + 2)
         stages = []
     else:
