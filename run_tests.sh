@@ -79,6 +79,19 @@ env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.obs_schema_check || {
   exit 1
 }
 
+# -- opt-in profiler smoke stage (docs/observability.md) -------------------
+# VCTPU_PROF_SMOKE=1: profile a small real filter run with the obs v3
+# continuous sampler ON (VCTPU_OBS_CPUPROF) and assert a non-empty flame
+# export, a populated cpuledger, and byte-identical output vs an
+# unprofiled run. Bounded (~20s).
+if [ "${VCTPU_PROF_SMOKE:-0}" != "0" ]; then
+  echo "prof smoke stage: python -m tools.prof_smoke"
+  env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.prof_smoke || {
+    echo "prof smoke failed — the continuous-profiler lens is broken" >&2
+    exit 1
+  }
+fi
+
 # -- opt-in tier-0 bench regression gate (docs/observability.md) -----------
 # VCTPU_BENCH_GATE=1: run a fresh reduced bench (hot/e2e/obs phases) and
 # gate it against the newest committed BENCH_r*.json with the explicit

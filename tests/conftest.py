@@ -48,9 +48,13 @@ def assert_no_stream_leaks(dirs=(), grace_s: float = 3.0) -> None:
     import time
 
     def leaked():
+        # "vctpu-" covers the IO pools, mesh dispatch AND the obs v3
+        # continuous profiler ("vctpu-sampler"); "obs-sampler" is the
+        # obs v2 resource-watermark thread
         return sorted(
             t.name for t in threading.enumerate()
-            if t.name.startswith(("vctpu-", "pipe-", "genome-prefetch")))
+            if t.name.startswith(("vctpu-", "pipe-", "genome-prefetch",
+                                  "obs-sampler")))
 
     deadline = time.time() + grace_s
     names = leaked()

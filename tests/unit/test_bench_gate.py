@@ -19,11 +19,18 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 GOLDEN = {
     "value": 2_000_000,
     "hot": {"vps": 2_000_000},
-    "e2e": {"e2e_vps": 800_000, "single_shot_vps": 750_000},
+    "e2e": {"e2e_vps": 800_000, "single_shot_vps": 750_000,
+            # presence-tripwired metrics: a golden artifact whose e2e
+            # row exists must carry the ledger (absence FAILS by design)
+            "cpuledger": {"total_cpu_s_per_1m": 1.4,
+                          "stages": {"score": 0.4, "parse": 0.3,
+                                     "render": 0.3, "commit": 0.15}}},
     "scaling": {"streaming_vps_t2": 820_000},
     "coverage": {"bp_per_sec": 500_000_000},
     "train": {"wallclock_s": 2.5},
-    "obs": {"obs_overhead_pct": 0.9},
+    "obs": {"obs_overhead_pct": 0.9, "obs_overhead_quiet_pct": 0.4,
+            "cpuprof_overhead_pct": 1.1, "cpuprof_overhead_quiet_pct": 0.6,
+            "trace_events": 12, "sample_events": 9},
 }
 
 
@@ -63,19 +70,47 @@ def test_lower_is_better_direction_and_improvements_pass():
 
 def test_obs_overhead_budget_is_absolute():
     # the 2% budget needs no baseline: 2.4% overhead fails even if the
-    # baseline was worse
+    # baseline was worse. The budget reads the QUIET (least-noise) pair
+    # — the committed median next to it is the all-weather trail.
     cand = copy.deepcopy(GOLDEN)
-    cand["obs"]["obs_overhead_pct"] = 2.4
+    cand["obs"]["obs_overhead_quiet_pct"] = 2.4
     base = copy.deepcopy(GOLDEN)
-    base["obs"]["obs_overhead_pct"] = 3.0
+    base["obs"]["obs_overhead_quiet_pct"] = 3.0
     report = bench_gate.gate(cand, base)
     assert report["regressed"] is True
     budget = next(c for c in report["checks"]
-                  if c["metric"] == "obs.obs_overhead_pct")
+                  if c["metric"] == "obs.obs_overhead_quiet_pct")
     assert budget["direction"] == "budget" and budget["regressed"]
     # a negative (noise-floor) overhead is inside the budget
-    cand["obs"]["obs_overhead_pct"] = -0.5
+    cand["obs"]["obs_overhead_quiet_pct"] = -0.5
     assert bench_gate.gate(cand, GOLDEN)["regressed"] is False
+    # the obs v3 continuous profiler's marginal cost has its own budget
+    cand["obs"]["cpuprof_overhead_quiet_pct"] = 2.7
+    report = bench_gate.gate(cand, GOLDEN)
+    assert any(c["metric"] == "obs.cpuprof_overhead_quiet_pct"
+               and c["regressed"] for c in report["checks"])
+
+
+def test_presence_tripwire_fails_when_phase_ran_without_the_metric():
+    """The nonzero tripwires catch SILENT DROP-OUT: a candidate whose
+    e2e/obs phase ran (the row exists) but whose ledger/sample counts
+    are missing FAILS — while a reduced bench that never ran the phase
+    skips, never fails."""
+    import copy
+    cand = copy.deepcopy(GOLDEN)
+    del cand["e2e"]["cpuledger"]
+    report = bench_gate.gate(cand, GOLDEN)
+    bad = {c["metric"] for c in report["checks"] if c["regressed"]}
+    assert "e2e.cpuledger.total_cpu_s_per_1m" in bad
+    # a reduced bench without the phase skips instead
+    cand = copy.deepcopy(GOLDEN)
+    del cand["e2e"]
+    del cand["obs"]
+    report = bench_gate.gate(cand, GOLDEN)
+    assert not any(c["regressed"] and "cpuledger" in c["metric"]
+                   for c in report["checks"])
+    assert any("cpuledger" in s for s in report["skipped"])
+    assert any("sample_events" in s for s in report["skipped"])
 
 
 def test_ingest_feed_budget_skips_on_serial_io_layout():

@@ -332,6 +332,14 @@ def _in_flight_log(tmp_path):
     obs._ACTIVE = False
     obs._TRACING = False
     obs._RUN = None
+    # a real crash kills the daemon samplers with the process; in-process
+    # simulation must halt them explicitly (WITHOUT stop() — a dying
+    # process emits no watermark events) or they leak across tests
+    for attachment in (run.sampler, run.cpu_sampler):
+        if attachment is not None:
+            attachment._halt.set()
+            attachment.join(timeout=2.0)
+    run.sampler = run.cpu_sampler = None
     run._fh.write('{"v": 1, "seq": 99, "ts": 1.0, "t": 1.0, "kind": "hea')
     run._fh.close()
     return path

@@ -20,6 +20,7 @@ import os
 import sys
 import tempfile
 import threading
+import time
 
 
 def main() -> int:
@@ -88,6 +89,28 @@ def main() -> int:
         if run._snapshot_s <= 0:
             run._snapshot_s = 10.0
         run._maybe_snapshot()
+        # obs v3 continuous-profiler producer: a real (brief, high-Hz)
+        # sampling window over this process, so the generated stream
+        # carries genuine `sample` events + the cpuprof summary — the
+        # schema and every flame/ledger reader validate against the
+        # writer, not a synthetic imitation of it
+        from variantcalling_tpu.obs import sampler as sampler_mod
+
+        import zlib
+
+        cpu_sampler = sampler_mod.CpuSampler(run, hz=200.0)
+        cpu_sampler.start()
+        # GIL-RELEASING busy work (zlib, like the real BGZF engine): a
+        # pure-Python spin would hold the GIL and starve the sampler
+        # thread of the very samples this stage asserts. Spin until an
+        # on-CPU sample landed (bounded) — deterministic on any host.
+        t_spin = time.perf_counter()
+        payload = os.urandom(1 << 18)
+        with sampler_mod.native_span("schema_check_probe"):
+            while cpu_sampler.cpu_samples == 0 \
+                    and time.perf_counter() - t_spin < 5.0:
+                zlib.compress(payload, 6)
+        cpu_sampler.stop()
         obs.end_run(run, "ok")
 
         with open(path, encoding="utf-8") as fh:
@@ -101,7 +124,7 @@ def main() -> int:
         kinds = {e["kind"] for e in parsed}
         for required in ("manifest", "span", "degrade", "fault", "heartbeat",
                          "journal", "profile", "trace", "snapshot",
-                         "recovery", "metrics", "run_end"):
+                         "sample", "recovery", "metrics", "run_end"):
             if required not in kinds:
                 errors.append(f"stream is missing a {required!r} event")
         # causal-trace integrity: the recovery event's trace_id must
@@ -143,6 +166,38 @@ def main() -> int:
         if len(threads) < 2:
             errors.append("spans from a worker thread did not land in the "
                           f"stream (threads seen: {sorted(threads)})")
+        # continuous-profiler integrity (obs v3): the sampled window must
+        # have produced on-CPU samples, the cpuprof summary must follow
+        # the samples, and the flame/ledger readers must stand up on the
+        # generated stream (speedscope frame indices in range, ledger
+        # totals consistent with the sample fold)
+        sample_evs = [e for e in parsed if e["kind"] == "sample"]
+        if not any(e.get("cat") in ("gil", "native") for e in sample_evs):
+            errors.append("sampling window produced no on-CPU sample "
+                          "(cat gil/native) despite a busy spin")
+        if not any(e["kind"] == "profile" and e["name"] == "cpuprof"
+                   for e in parsed):
+            errors.append("no profile/cpuprof summary event after sampling")
+        from variantcalling_tpu.obs import sampler as sampler_reader
+
+        scope = sampler_reader.to_speedscope(parsed)
+        if scope is None:
+            errors.append("to_speedscope returned None on a sampled stream")
+        else:
+            n_frames = len(scope["shared"]["frames"])
+            for prof in scope["profiles"]:
+                if len(prof["samples"]) != len(prof["weights"]):
+                    errors.append("speedscope samples/weights length "
+                                  "mismatch")
+                for stack in prof["samples"]:
+                    if any(i >= n_frames for i in stack):
+                        errors.append("speedscope frame index out of range")
+                        break
+        ledger = sampler_reader.cpuledger(parsed)
+        if ledger is None:
+            errors.append("cpuledger returned None on a sampled stream")
+        elif ledger["cpu_samples"] <= 0:
+            errors.append("cpuledger counted no CPU samples")
 
         # exporter invariants (the acceptance-criteria Perfetto schema)
         events = export.read_events(path)

@@ -208,6 +208,19 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "(docs/observability.md)"),
     _k("VCTPU_OBS_SAMPLE_S", "float", 0.05,
        "resource-watermark sampler interval in seconds", minimum=0.001),
+    _k("VCTPU_OBS_CPUPROF", "bool", False,
+       "obs v3 continuous CPU sampling profiler when VCTPU_OBS=1: "
+       "whole-process stack samples + per-thread CPU clocks folded into "
+       "the sample event stream (vctpu obs flame / cpuledger; "
+       "docs/observability.md)"),
+    _k("VCTPU_OBS_CPUPROF_HZ", "float", 7.0,
+       "continuous-profiler sampling rate in Hz; the conservative "
+       "default fits the <=2% overhead budget on a saturated 2-core "
+       "host (every tick holds the GIL briefly) — raise it on hosts "
+       "with spare cores for finer flames", minimum=1.0),
+    _k("VCTPU_OBS_TAIL_POLL_S", "float", 1.0,
+       "vctpu obs tail --follow poll interval in seconds "
+       "(--interval-s overrides per invocation)", minimum=0.01),
     _k("VCTPU_OBS_JAXPROF", "bool", False,
        "capture a jax.profiler device trace (<run log>.jaxprof/) "
        "alongside the obs stream for side-by-side Perfetto loading"),

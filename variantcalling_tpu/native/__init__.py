@@ -20,6 +20,8 @@ import threading
 
 import numpy as np
 
+from variantcalling_tpu.obs.sampler import native_span
+
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "src", "vctpu_native.cc")
 _SRC_CRAM = os.path.join(_DIR, "src", "vctpu_cram.cc")
@@ -271,24 +273,26 @@ def bgzf_decompress_array(data) -> np.ndarray | None:
         return None
     src_arr = np.ascontiguousarray(_u8view(data))
     src = src_arr.ctypes.data_as(_u8p)
-    size = lib.vctpu_bgzf_uncompressed_size(src, len(src_arr))
-    if size < 0:
-        # not BGZF-framed: inflate with geometric capacity growth
-        cap = max(4 * len(src_arr), 1 << 16)
-        for _ in range(8):
-            dst = np.empty(cap, dtype=np.uint8)
-            n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), cap)
-            if n >= 0:
-                return dst[:n]
-            cap *= 4
-        return None
-    dst = np.empty(max(int(size), 1), dtype=np.uint8)
-    # block-parallel path first (per-member raw inflate at prefix-summed
-    # offsets); -2 means the payload itself is corrupt — the serial gzip
-    # walk would fail on it too, so fall back only on -1 (framing)
-    n = lib.vctpu_bgzf_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
-    if n == -1:
-        n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
+    with native_span("bgzf_inflate"):
+        size = lib.vctpu_bgzf_uncompressed_size(src, len(src_arr))
+        if size < 0:
+            # not BGZF-framed: inflate with geometric capacity growth
+            cap = max(4 * len(src_arr), 1 << 16)
+            for _ in range(8):
+                dst = np.empty(cap, dtype=np.uint8)
+                n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), cap)
+                if n >= 0:
+                    return dst[:n]
+                cap *= 4
+            return None
+        dst = np.empty(max(int(size), 1), dtype=np.uint8)
+        # block-parallel path first (per-member raw inflate at
+        # prefix-summed offsets); -2 means the payload itself is corrupt
+        # — the serial gzip walk would fail on it too, so fall back only
+        # on -1 (framing)
+        n = lib.vctpu_bgzf_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
+        if n == -1:
+            n = lib.vctpu_gzip_inflate(src, len(src_arr), dst.ctypes.data_as(_u8p), int(size))
     if n != size:
         return None
     return dst[:n]
@@ -317,7 +321,9 @@ def bgzf_compress(data, level: int = 6) -> bytes | None:
     n_blocks = n_in // 65280 + 1
     cap = n_in + n_blocks * 128 + 64
     dst = np.empty(cap, dtype=np.uint8)
-    n = lib.vctpu_bgzf_compress(src, n_in, dst.ctypes.data_as(_u8p), cap, level)
+    with native_span("bgzf_deflate"):
+        n = lib.vctpu_bgzf_compress(src, n_in, dst.ctypes.data_as(_u8p),
+                                    cap, level)
     if n < 0:
         return None
     return dst[:n].tobytes()
@@ -994,23 +1000,25 @@ def fused_chunk_score(run_seqs: list[np.ndarray], run_bounds: np.ndarray,
                                              default_left)
     t, m = ff.shape
     out = np.empty(n, dtype=np.float32)
-    rc = lib.vctpu_fused_chunk_score(
-        seq_ptrs, seq_lens.ctypes.data_as(_i64p),
-        bounds.ctypes.data_as(_i64p), len(seqs),
-        p.ctypes.data_as(_i64p), n, radius,
-        ii.ctypes.data_as(_u8p), nu.ctypes.data_as(_i32p),
-        rc_.ctypes.data_as(_i32p), ac.ctypes.data_as(_i32p),
-        sn.ctypes.data_as(_u8p), fo.ctypes.data_as(_i32p),
-        col_ptrs, codes.ctypes.data_as(_i32p), len(cols),
-        dc.ctypes.data_as(_i32p),
-        ff.ctypes.data_as(_i32p), tt.ctypes.data_as(_f32p),
-        ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
-        vv.ctypes.data_as(_f32p),
-        None if dl is None else dl.ctypes.data_as(_u8p),
-        t, m, max_depth, {"mean": 0, "logit_sum": 1, "sum": 2}[aggregation],
-        base_score,
-        out.ctypes.data_as(_f32p),
-    )
+    with native_span("fused_chunk_score"):
+        rc = lib.vctpu_fused_chunk_score(
+            seq_ptrs, seq_lens.ctypes.data_as(_i64p),
+            bounds.ctypes.data_as(_i64p), len(seqs),
+            p.ctypes.data_as(_i64p), n, radius,
+            ii.ctypes.data_as(_u8p), nu.ctypes.data_as(_i32p),
+            rc_.ctypes.data_as(_i32p), ac.ctypes.data_as(_i32p),
+            sn.ctypes.data_as(_u8p), fo.ctypes.data_as(_i32p),
+            col_ptrs, codes.ctypes.data_as(_i32p), len(cols),
+            dc.ctypes.data_as(_i32p),
+            ff.ctypes.data_as(_i32p), tt.ctypes.data_as(_f32p),
+            ll.ctypes.data_as(_i32p), rr.ctypes.data_as(_i32p),
+            vv.ctypes.data_as(_f32p),
+            None if dl is None else dl.ctypes.data_as(_u8p),
+            t, m, max_depth,
+            {"mean": 0, "logit_sum": 1, "sum": 2}[aggregation],
+            base_score,
+            out.ctypes.data_as(_f32p),
+        )
     return out if rc == 0 else None
 
 

@@ -36,7 +36,12 @@ the sidecar path (default: ``<output_file>.obs.jsonl`` next to the
 pipeline output); ``VCTPU_OBS_PROFILE`` (default on) adds the obs v2
 performance-attribution layer (:mod:`~variantcalling_tpu.obs.profile`:
 per-stage work/wait attribution, RSS/CPU watermark sampler, runtime
-cost_analysis); ``VCTPU_OBS_JAXPROF=1`` additionally captures a
+cost_analysis); ``VCTPU_OBS_CPUPROF=1`` starts the obs v3 continuous
+CPU sampling profiler (:mod:`~variantcalling_tpu.obs.sampler`:
+whole-process stack samples + per-thread CPU clocks folded into a
+``sample`` event stream at ``VCTPU_OBS_CPUPROF_HZ`` — ``vctpu obs
+flame`` / ``cpuledger`` are the readers); ``VCTPU_OBS_JAXPROF=1``
+additionally captures a
 ``jax.profiler`` device trace next to the run log so host and device
 timelines load side by side in Perfetto.
 
@@ -127,6 +132,9 @@ class ObsRun:
         #: obs v2 attachments, owned by start_run/end_run: the resource
         #: watermark sampler and the jax.profiler trace dir (if any)
         self.sampler = None
+        #: obs v3: the continuous CPU sampling profiler
+        #: (``VCTPU_OBS_CPUPROF``, obs/sampler.py), owned the same way
+        self.cpu_sampler = None
         self.jaxprof_dir: str | None = None
         #: (strategy, kind) pairs whose cost_analysis already emitted —
         #: the per-chunk scoring loop must pay the lower+compile ONCE
@@ -322,6 +330,12 @@ def start_run(tool: str, default_path: str | None = None,
             # (and its watermark event emitted) by end_run
             run.sampler = profile_mod().ResourceSampler(run)
             run.sampler.start()
+        if knobs.get_bool(sampler_mod().CPUPROF_ENV):
+            # continuous CPU sampling profiler (obs v3): daemon thread
+            # folding whole-process stack samples into the stream;
+            # stopped (final flush + cpuprof summary event) by end_run
+            run.cpu_sampler = sampler_mod().CpuSampler(run)
+            run.cpu_sampler.start()
         if knobs.get_bool(JAXPROF_ENV):
             _start_jaxprof(run)
         logger.info("obs: recording run telemetry to %s", path)
@@ -338,7 +352,13 @@ def end_run(run: ObsRun | None, status: str = "ok") -> None:
         if _RUN is not run:
             return
         # attachments stop while the stream still accepts events (the
-        # sampler's watermark event must precede the metrics snapshot)
+        # samplers' summary events must precede the metrics snapshot)
+        if run.cpu_sampler is not None:
+            try:
+                run.cpu_sampler.stop()
+            except RuntimeError:  # never started (racing interpreter exit)
+                pass
+            run.cpu_sampler = None
         if run.sampler is not None:
             try:
                 run.sampler.stop()
@@ -361,6 +381,13 @@ def profile_mod():
     from variantcalling_tpu.obs import profile
 
     return profile
+
+
+def sampler_mod():
+    """The continuous-profiler module, imported lazily (same reason)."""
+    from variantcalling_tpu.obs import sampler
+
+    return sampler
 
 
 def _start_jaxprof(run: ObsRun) -> None:

@@ -1,8 +1,11 @@
 """CLI: ``vctpu obs
-<export|summary|bottleneck|critical-path|diff|tail|prom>`` — open any
-obs run log in Perfetto, roll it up in the terminal, name the limiting
-stage or the dominant critical-path edge, diff two runs with a noise
-band, tail an in-flight run, or render a Prometheus text exposition.
+<export|summary|bottleneck|critical-path|flame|cpuledger|diff|tail|prom>``
+— open any obs run log in Perfetto, roll it up in the terminal, name
+the limiting stage or the dominant critical-path edge, export the
+continuous profiler's samples as a flame graph (``flame``; ``--diff``
+ranks per-frame CPU-share deltas between two runs), print the measured
+cpu-budget ledger (``cpuledger``), diff two runs with a noise band,
+tail an in-flight run, or render a Prometheus text exposition.
 
 Multi-rank runs and size-capped rotation segments are merged
 transparently: every subcommand reads the given log PLUS any ``.rankN``
@@ -28,9 +31,11 @@ import os
 import sys
 import time
 
+from variantcalling_tpu import knobs
 from variantcalling_tpu.obs import critical as critical_mod
 from variantcalling_tpu.obs import export as export_mod
 from variantcalling_tpu.obs import prom as prom_mod
+from variantcalling_tpu.obs import sampler as sampler_mod
 from variantcalling_tpu.utils.jsonio import emit_json
 
 
@@ -71,14 +76,46 @@ def get_parser() -> argparse.ArgumentParser:
     crit.add_argument("--json", action="store_true",
                       help="emit the roll-up as JSON")
 
+    fl = sub.add_parser("flame",
+                        help="export the continuous profiler's samples "
+                             "(VCTPU_OBS_CPUPROF) as speedscope JSON + "
+                             "collapsed stacks; --diff ranks per-frame "
+                             "CPU-share deltas between two runs")
+    fl.add_argument("log", nargs="+",
+                    help="obs run log (two logs with --diff: "
+                         "CANDIDATE BASELINE)")
+    fl.add_argument("--diff", action="store_true",
+                    help="compare two logs: ranked per-frame CPU "
+                         "self-share delta report (attribution, not a "
+                         "gate — always exits 0 on a readable pair)")
+    fl.add_argument("-o", "--output", default=None,
+                    help="speedscope output path "
+                         "(default <log>.speedscope.json)")
+    fl.add_argument("--collapsed", default=None,
+                    help="also write collapsed-stack text here "
+                         "(default <log>.collapsed.txt)")
+    fl.add_argument("--top", type=int, default=20,
+                    help="--diff: frames to report (default %(default)s)")
+    fl.add_argument("--json", action="store_true",
+                    help="--diff: emit the delta report as JSON")
+
+    cl = sub.add_parser("cpuledger",
+                        help="measured cpu-budget ledger from the "
+                             "continuous profiler's samples: cpu-s (and "
+                             "cpu-s per 1M variants) per stage")
+    cl.add_argument("log", help="obs run log (JSONL)")
+    cl.add_argument("--json", action="store_true",
+                    help="emit the ledger as JSON")
+
     tail = sub.add_parser("tail",
                           help="progress/SLO view of an (in-flight) run "
                                "log; --follow keeps reading as it grows")
     tail.add_argument("log", help="obs run log (JSONL; may be growing)")
     tail.add_argument("--follow", action="store_true",
                       help="poll the log until run_end (Ctrl-C to stop)")
-    tail.add_argument("--interval-s", type=float, default=1.0,
-                      help="--follow poll interval (default %(default)s)")
+    tail.add_argument("--interval-s", type=float, default=None,
+                      help="--follow poll interval (default: the "
+                           "VCTPU_OBS_TAIL_POLL_S knob, 1.0s)")
     tail.add_argument("--json", action="store_true",
                       help="emit the (non-follow) tail state as JSON")
 
@@ -258,6 +295,10 @@ def _follow(path: str, interval_s: float) -> int:
             if e.get("kind") == "run_end":
                 return 0
         if not chunk:
+            # the current file stopped growing: a size-capped writer may
+            # have rotated to the next segment(s) since the last poll —
+            # advance WITHOUT sleeping (and without re-reading anything
+            # already consumed; each segment is read once, from 0)
             nxt = f"{path}.seg{seg + 1}"
             if os.path.exists(nxt):
                 seg += 1
@@ -266,13 +307,79 @@ def _follow(path: str, interval_s: float) -> int:
             time.sleep(interval_s)
 
 
+def _flame(args) -> int:
+    """``vctpu obs flame`` / ``flame --diff`` (obs v3). Exit 2 when a
+    log is unreadable OR holds no ``sample`` events (an export of
+    nothing must fail loudly, not write an empty artifact)."""
+    if args.diff:
+        if len(args.log) != 2:
+            print("flame --diff takes exactly two logs: CANDIDATE "
+                  "BASELINE", file=sys.stderr)
+            return 2
+        try:
+            candidate, baseline = _load(args.log[0]), _load(args.log[1])
+        except (OSError, export_mod.ObsLogError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        for path, events in ((args.log[0], candidate),
+                             (args.log[1], baseline)):
+            if not any(e.get("kind") == "sample" for e in events):
+                print(f"error: {path} holds no sample events — rerun "
+                      "with VCTPU_OBS=1 VCTPU_OBS_CPUPROF=1",
+                      file=sys.stderr)
+                return 2
+        report = sampler_mod.diff_folds(candidate, baseline, top=args.top)
+        if args.json:
+            emit_json(report)
+        else:
+            print(sampler_mod.render_diff(report))
+        return 0
+    if len(args.log) != 1:
+        print("flame takes one log (two only with --diff)",
+              file=sys.stderr)
+        return 2
+    log = args.log[0]
+    try:
+        events = _load(log)
+    except (OSError, export_mod.ObsLogError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    scope = sampler_mod.to_speedscope(events, name=os.path.basename(log))
+    if scope is None:
+        print(f"error: {log} holds no sample events — rerun with "
+              "VCTPU_OBS=1 VCTPU_OBS_CPUPROF=1", file=sys.stderr)
+        return 2
+    out_path = args.output or f"{log}.speedscope.json"
+    collapsed_path = args.collapsed or f"{log}.collapsed.txt"
+    lines = sampler_mod.collapsed_lines(events)
+    try:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(scope, fh)  # compact: profiles get big
+            fh.write("\n")
+        with open(collapsed_path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    n = sum(sum(p["weights"]) for p in scope["profiles"])
+    print(f"wrote {out_path} ({n} samples, "
+          f"{len(scope['shared']['frames'])} frames — open in "
+          "https://speedscope.app) and "
+          f"{collapsed_path} ({len(lines)} collapsed stacks)")
+    return 0
+
+
 def run(argv: list[str]) -> int:
     args = get_parser().parse_args(argv)
     if args.command == "tail" and args.follow:
+        interval = args.interval_s if args.interval_s is not None \
+            else knobs.get_float("VCTPU_OBS_TAIL_POLL_S")
         try:
-            return _follow(args.log, args.interval_s)
+            return _follow(args.log, interval)
         except KeyboardInterrupt:
             return 0
+    if args.command == "flame":
+        return _flame(args)
     try:
         if args.command == "diff":
             candidate = _load(args.candidate)
@@ -282,6 +389,17 @@ def run(argv: list[str]) -> int:
     except (OSError, export_mod.ObsLogError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.command == "cpuledger":
+        ledger = sampler_mod.cpuledger(events)
+        if ledger is None:
+            print(f"error: {args.log} holds no sample events — rerun "
+                  "with VCTPU_OBS=1 VCTPU_OBS_CPUPROF=1", file=sys.stderr)
+            return 2
+        if args.json:
+            emit_json(ledger)
+        else:
+            print(sampler_mod.render_cpuledger(ledger))
+        return 0
     if args.command == "critical-path":
         cp = critical_mod.critical_path(events)
         if args.json:

@@ -95,7 +95,12 @@ def chunk_paths(events: list[dict]) -> list[dict]:
             parent = max(parents, key=lambda s: s["end"])
             edges.append({"edge": f"{cur['name']}.wait", "kind": "wait",
                           "stage": cur["name"],
-                          "s": max(0.0, cur["start"] - parent["end"])})
+                          "s": max(0.0, cur["start"] - parent["end"]),
+                          # absolute (run-relative) interval: the join
+                          # key the sampler's wait-edge reconciliation
+                          # overlaps CPU-sample windows against
+                          "t0": parent["end"],
+                          "t1": max(parent["end"], cur["start"])})
             cur = parent
         edges.reverse()
         paths.append({"trace": tid,
@@ -183,6 +188,27 @@ def critical_path(events: list[dict]) -> dict:
             recon[name] = entry
         out["reconciliation"] = recon
         out["bottleneck_limiting_stage"] = b.get("limiting_stage")
+
+    # obs v3 reconciliation: when the run carried the continuous CPU
+    # profiler, answer "what were the cores DOING during the dominant
+    # wait edges" by overlap-joining CPU-sample windows against the wait
+    # intervals collected above — the measured explanation the round-13
+    # `writeback.wait` diagnosis needed (docs/perf_notes.md)
+    from variantcalling_tpu.obs import sampler as sampler_mod
+
+    wait_edges = [name for name, d in p95_edges.items()
+                  if d["kind"] == "wait"][:3]
+    if wait_edges and any(e.get("kind") == "sample" for e in events):
+        intervals: dict[str, list[tuple[float, float]]] = {}
+        for p in paths:
+            for e in p["edges"]:
+                if e["kind"] == "wait" and e["edge"] in wait_edges \
+                        and e["s"] > 0 and "t0" in e:
+                    intervals.setdefault(e["edge"], []).append(
+                        (e["t0"], e["t1"]))
+        wait_cpu = sampler_mod.explain_waits(events, intervals)
+        if wait_cpu:
+            out["wait_cpu"] = wait_cpu
     return out
 
 
@@ -191,7 +217,7 @@ def compact(cp: dict) -> dict:
     ``attribution`` blob (the full edge table stays in the obs log)."""
     if cp.get("chunks", 0) == 0:
         return {"chunks": 0}
-    return {
+    out = {
         "chunks": cp["chunks"],
         "latency_p50_s": cp["latency_p50_s"],
         "latency_p95_s": cp["latency_p95_s"],
@@ -201,6 +227,16 @@ def compact(cp: dict) -> dict:
             name: d["share_pct"]
             for name, d in list(cp["p95_edges"].items())[:5]},
     }
+    # the "cores were running X" answer for the dominant wait edge
+    # (obs v3 reconciliation) rides into the committed bench row
+    dom = cp.get("dominant_p95_edge")
+    wc = (cp.get("wait_cpu") or {}).get(dom)
+    if wc:
+        out["dominant_p95_wait_cpu"] = {
+            "edge": dom,
+            "frames": wc["frames"][:3],
+        }
+    return out
 
 
 def render(cp: dict) -> str:
@@ -233,4 +269,13 @@ def render(cp: dict) -> str:
     if cp.get("bottleneck_limiting_stage"):
         lines.append(f"bottleneck limiting stage: "
                      f"{cp['bottleneck_limiting_stage']}")
+    wait_cpu = cp.get("wait_cpu")
+    if wait_cpu:
+        lines.append("cores were running (CPU samples joined against the "
+                     "wait intervals — obs v3 continuous profiler):")
+        for edge, wc in wait_cpu.items():
+            frames = ", ".join(f"{f['frame']} {f['share_pct']}%"
+                               for f in wc["frames"])
+            lines.append(f"  during {edge} ({wc['wait_s']:.3f}s waited): "
+                         f"{frames}")
     return "\n".join(lines)

@@ -199,6 +199,10 @@ class ResourceSampler(threading.Thread):
     ``cpu_pct`` is process CPU time over wall time — >100 means multiple
     cores busy (the streaming executor's whole point), so the watermark
     doubles as a parallelism check against the ``scaling`` bench rows.
+    ``proc.cpu_pct.<family>`` gauges break the same utilization down by
+    THREAD FAMILY (io pool, pipeline stages, committer, prefetch, obs)
+    from the per-task CPU clocks in ``/proc/self/task`` — the obs v3
+    per-thread accounting, visible in snapshots and ``vctpu obs prom``.
     """
 
     def __init__(self, run, interval_s: float | None = None):
@@ -215,6 +219,23 @@ class ResourceSampler(threading.Thread):
         # gets a real CPU utilization (the gauge keeps the peak of both)
         self._t0 = time.perf_counter()
         self._cpu0 = time.process_time()
+        # per-thread-FAMILY cpu baselines (obs v3 satellite): cumulative
+        # /proc/self/task cpu seconds per family at the previous scan,
+        # so a utilization gauge per family (proc.cpu_pct.<family>) can
+        # ride next to the process-wide one. Scanned on its OWN slower
+        # cadence (~1s): the scan enumerates threads + reads /proc per
+        # thread, too heavy for the 0.05s watermark tick
+        self._fam_prev: dict[str, float] = self._family_cpu()
+        self._fam_t_prev = time.perf_counter()
+
+    @staticmethod
+    def _family_cpu() -> dict[str, float]:
+        from variantcalling_tpu.obs import sampler as sampler_mod
+
+        try:
+            return sampler_mod.family_cpu_seconds()
+        except Exception:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — telemetry: no /proc on this platform just drops the per-family series
+            return {}
 
     def sample_once(self, t_prev: float, cpu_prev: float) -> tuple[float, float]:
         t_now = time.perf_counter()
@@ -227,6 +248,29 @@ class ResourceSampler(threading.Thread):
         if dt > 0:
             self.obs_run.metrics.gauge("proc.cpu_pct").set(
                 round(100.0 * (cpu_now - cpu_prev) / dt, 1))
+        # per-thread-family utilization from the per-task CPU clocks
+        # (pool workers / pipeline stages / committer / prefetch /
+        # obs): the same family spellings the continuous profiler
+        # attributes samples to, exported as gauges so snapshots and
+        # `vctpu obs prom` carry per-family series mid-run. Own ~1s
+        # cadence — see __init__.
+        fam_dt = t_now - self._fam_t_prev
+        # the final stop() sample forces a scan even below the ~1s
+        # cadence — a sub-second run still gets its per-family
+        # watermark — but never over a window shorter than 0.25s: the
+        # per-task clocks tick at 10ms, and dividing one quantum by a
+        # tiny window would commit a 20-40% phantom peak to the
+        # peak-keeping gauge
+        if fam_dt >= 0.25 and (fam_dt >= 1.0 or self._halt.is_set()):
+            fam_now = self._family_cpu()
+            for family, cpu_s in fam_now.items():
+                prev = self._fam_prev.get(family)
+                if prev is not None and cpu_s >= prev:
+                    self.obs_run.metrics.gauge(
+                        f"proc.cpu_pct.{family}").set(
+                        round(100.0 * (cpu_s - prev) / fam_dt, 1))
+            self._fam_prev = fam_now
+            self._fam_t_prev = t_now
         self.samples += 1
         return t_now, cpu_now
 

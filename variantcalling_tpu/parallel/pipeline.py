@@ -47,6 +47,7 @@ import zlib
 from collections.abc import Callable, Iterable, Iterator
 
 from variantcalling_tpu import knobs, logger, obs
+from variantcalling_tpu.obs import sampler as obs_sampler
 from variantcalling_tpu.utils import faults
 
 _SENTINEL = object()
@@ -165,6 +166,11 @@ class IoPool:
             w.start()
 
     def _loop(self) -> None:
+        # (no sampler registration needed here: the obs v3 profiler's
+        # name-based fallback already classifies "vctpu-io-wN"/"vctpu-
+        # mesh-dispatch-wN" workers; explicit registration is for
+        # threads whose NAME is not enough — pipeline stage workers and
+        # the committer)
         while True:
             item = self._q.get()
             if item is None:
@@ -611,6 +617,7 @@ class StagePipeline:
         prof = self._active_profiler()
 
         def _feed() -> None:
+            obs_sampler.register_current("pipe.src")
             src = prof.stage(self.source_name) if prof is not None else None
             try:
                 it = iter(source)
@@ -648,6 +655,9 @@ class StagePipeline:
             return out
 
         def _stage(i: int, fn: Callable) -> None:
+            # sampler attribution by STAGE name, not just thread index —
+            # the flame then reads "pipe.compress_stage", not "pipe-stage0"
+            obs_sampler.register_current(f"pipe.{self._stage_name(i)}")
             q_in, q_out = queues[i], queues[i + 1]
             stats = prof.stage(self._stage_name(i)) if prof is not None else None
             # stateful stages (a ``retry_safe = False`` attribute on the

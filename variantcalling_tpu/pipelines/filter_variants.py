@@ -1300,8 +1300,12 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # writeback work and the IO byte totals. One emit at commit time ->
     # `vctpu obs bottleneck` names the limiting stage (ROADMAP item 1).
     from variantcalling_tpu.obs import profile as profile_mod
+    from variantcalling_tpu.obs import sampler as sampler_mod
 
     prof = profile_mod.StageProfiler() if profile_mod.enabled() else None
+    # continuous-profiler attribution (obs v3): this thread runs the
+    # sequenced single-writer commit loop for the duration of the run
+    sampler_mod.register_current("committer")
     reader = VcfChunkReader(args.input_file, profiler=prof)
     header = reader.header
     ctx = FilterContext(
