@@ -431,6 +431,149 @@ def _e2e_serial(vcf_in: str, out_path: str, model, fasta, t0: float, t1: float) 
     }
 
 
+def serve_phase(fixture_dir: str) -> dict:
+    """``vctpu serve`` cold-vs-warm economics (ISSUE 14 / ROADMAP item 1):
+
+    - ``cold_s``    — one fresh CLI subprocess over the e2e callset: the
+      tax every batch invocation pays (interpreter + jax import, engine
+      load, genome touch, the run itself);
+    - ``warm_p50_s``/``warm_p99_s`` — the SAME work as a request against
+      the resident daemon (in-process Server, state pre-warmed), over
+      ``SERVE_WARM_REQS`` sequential requests;
+    - ``warm_over_cold`` — the headline ratio (gated < 1 in
+      tools/bench_gate.py: resident state must pay, every round);
+    - ``req_per_s_c4`` — sustained throughput at fixed concurrency 4
+      (2 requests per client, distinct outputs);
+    - ``bytes_identical`` — warm request output byte-equal to the cold
+      CLI output (same engine in both processes on this single-device
+      leg, so no header delta either).
+    """
+    import json as _json
+    import pickle
+    import subprocess
+    import threading
+    import urllib.request
+
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    vcf_in = os.path.join(fixture_dir, "calls.vcf")
+    ref_fa = os.path.join(fixture_dir, "ref.fa")
+    model_pkl = os.path.join(fixture_dir, "serve_model.pkl")
+    with open(model_pkl, "wb") as fh:
+        pickle.dump({"m": synthetic_forest(np.random.default_rng(0),
+                                           n_trees=N_TREES, depth=DEPTH)},
+                    fh)
+    cold_out = os.path.join(fixture_dir, "serve_cold.vcf")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.perf_counter()
+    proc = subprocess.run(  # noqa: S603
+        [sys.executable, "-m", "variantcalling_tpu",
+         "filter_variants_pipeline", "--input_file", vcf_in,
+         "--model_file", model_pkl, "--model_name", "m",
+         "--reference_file", ref_fa, "--output_file", cold_out,
+         "--backend", "cpu"],
+        env=env, timeout=240, capture_output=True)
+    cold_s = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(f"serve bench: cold CLI leg failed "
+                           f"(rc={proc.returncode}): "
+                           f"{proc.stderr.decode()[-400:]}")
+    cold_bytes = open(cold_out, "rb").read()
+
+    from variantcalling_tpu.serve.daemon import Server
+
+    server = Server(port=0)
+    server.start()
+    outs: list[str] = []
+
+    def request(out: str, timeout: float = 180.0) -> dict:
+        outs.append(out)
+        body = _json.dumps({"input": vcf_in, "model": model_pkl,
+                            "model_name": "m", "reference": ref_fa,
+                            "output": out}).encode()
+        req = urllib.request.Request(
+            server.address + "/v1/filter", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            payload = _json.loads(r.read())
+        if payload.get("status") != "ok":
+            raise RuntimeError(f"serve bench: request failed: {payload}")
+        return payload
+
+    try:
+        # warm the resident caches + first-request compile OUTSIDE the
+        # measured window (that cliff is exactly what cold_s prices)
+        request(os.path.join(fixture_dir, "serve_warm0.vcf"))
+        lat: list[float] = []
+        warm_out = os.path.join(fixture_dir, "serve_warm.vcf")
+        for _ in range(SERVE_WARM_REQS):
+            ts = time.perf_counter()
+            request(warm_out)
+            lat.append(time.perf_counter() - ts)
+        lat.sort()
+        warm_p50 = lat[len(lat) // 2]
+        warm_p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+        bytes_identical = open(warm_out, "rb").read() == cold_bytes
+
+        # sustained req/s at fixed concurrency 4 (distinct outputs so the
+        # requests exercise the full commit path concurrently)
+        errors: list[str] = []
+
+        def client(i: int) -> None:
+            try:
+                for j in range(2):
+                    request(os.path.join(fixture_dir,
+                                         f"serve_c{i}_{j}.vcf"))
+            except (OSError, RuntimeError) as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(4)]
+        ts = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        sustained_s = time.perf_counter() - ts
+        if any(t.is_alive() for t in threads):
+            # a wedged client must FAIL the phase, not silently gate a
+            # req/s number that never corresponded to 8 completed
+            # requests
+            raise RuntimeError("serve bench: sustained leg clients did "
+                               "not finish within the join bound")
+        if errors:
+            raise RuntimeError(f"serve bench: sustained leg failed: "
+                               f"{errors[0]}")
+        n = int(cold_bytes.count(b"\n")) - sum(
+            1 for ln in cold_bytes.split(b"\n") if ln.startswith(b"#"))
+    finally:
+        server.drain("bench")
+        from variantcalling_tpu.io import journal as journal_mod
+
+        for out in outs + [cold_out]:
+            targets = [out, out + ".journal", out + ".quarantine"]
+            targets += journal_mod.list_partials(out)
+            for p in targets:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+    return {
+        "n": n,
+        "cold_s": round(cold_s, 3),
+        "warm_p50_s": round(warm_p50, 3),
+        "warm_p99_s": round(warm_p99, 3),
+        "warm_over_cold": round(warm_p50 / cold_s, 4),
+        "req_per_s_c4": round(8 / sustained_s, 3),
+        "warm_reqs": SERVE_WARM_REQS,
+        "bytes_identical": int(bytes_identical),
+    }
+
+
+#: sequential warm requests the serve phase measures latency over
+SERVE_WARM_REQS = 10
+
+
 #: paired off/on repetitions for the obs-overhead measurement; the
 #: reported overhead is the MEDIAN of the per-pair deltas. 7 pairs with
 #: each leg BEST-OF-2 (was 5 pairs of single runs): on this shared
@@ -1507,6 +1650,11 @@ def child_main(fixture_dir: str) -> None:
         # plus the ISSUE 13 cpuprof marginal measurement);
         # rides e2e's warm caches so both measured legs are steady-state
         phase("obs", lambda: obs_overhead(fixture_dir), min_remaining=80)
+    if want("serve") and cpu:
+        # resident-daemon economics (ISSUE 14): cold CLI subprocess vs
+        # warm request latency through an in-process Server + sustained
+        # req/s at concurrency 4; warm_over_cold gated < 1
+        phase("serve", lambda: serve_phase(fixture_dir), min_remaining=90)
     # budgets rebalanced so the committed per-round artifact is
     # self-contained (round-5 VERDICT item 6: genome3g died mid-phase):
     # streaming e2e_5m ≈ fixture 50s + runs ~25s, genome3g ≈ fixture ~100s
@@ -1765,9 +1913,9 @@ def main(tpu_only: bool = False) -> None:
         out["value"] = hot.get("vps", 0)
         out["device"] = child.get("device", "?")
         out["attempt"] = label
-        for k in ("hot_small", "hot", "io", "mesh", "e2e", "obs", "e2e_5m",
-                  "genome3g", "scaling", "skipped", "phase_errors",
-                  "incomplete"):
+        for k in ("hot_small", "hot", "io", "mesh", "e2e", "obs", "serve",
+                  "e2e_5m", "genome3g", "scaling", "skipped",
+                  "phase_errors", "incomplete"):
             if k in child:
                 out[k] = child[k]
         def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:

@@ -49,6 +49,21 @@ if [ "${VCTPU_CHAOS:-0}" != "0" ]; then
   }
 fi
 
+# -- opt-in load smoke stage (docs/serving.md) -----------------------------
+# VCTPU_LOAD=1: 10 fixed-seed load×chaos schedules against a real
+# `vctpu serve` daemon (tools/loadhunt — ≥8 concurrent clients × fault
+# classes incl. poison chunk / native hang / dispatch OOM / mid-request
+# disconnect, plus overload schedules that must shed explicitly; every
+# SLO invariant checked, violations delta-shrunk to a repro JSON).
+# Bounded (~1 min); larger sweeps: python -m tools.loadhunt --seeds 50.
+if [ "${VCTPU_LOAD:-0}" != "0" ]; then
+  echo "load smoke stage: python -m tools.loadhunt --seeds 10 --json"
+  env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.loadhunt --seeds 10 --json || {
+    echo "loadhunt found an SLO invariant violation — failing before pytest (see the repro JSON above)" >&2
+    exit 1
+  }
+fi
+
 # -- tier-0 jaxpr audit stage (docs/static_analysis.md) --------------------
 # Trace every registered scoring program (forest strategies x
 # shard_program at dp in {1,2} + the coverage reduce kernels) with

@@ -64,7 +64,9 @@ def assert_no_stream_leaks(dirs=(), grace_s: float = 3.0) -> None:
     assert not names, f"leaked executor threads: {names}"
     strays = []
     for d in dirs:
-        for pattern in ("*.partial", "*.journal", "*.quarantine"):
+        # "*.partial*" also catches the unique-suffix partials
+        # (<out>.partial.<pid>-<hex>, ISSUE 14 atomic-commit fix)
+        for pattern in ("*.partial*", "*.journal", "*.quarantine"):
             strays += glob.glob(os.path.join(str(d), pattern))
     assert not strays, f"stray streaming sidecar files: {strays}"
 

@@ -32,6 +32,14 @@ from variantcalling_tpu.utils import faults
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def _partials(out: str) -> list[str]:
+    """Every partial next to ``out`` — legacy fixed name plus the
+    unique-suffix partials (ISSUE 14: ``<out>.partial.<pid>-<hex>``)."""
+    from variantcalling_tpu.io.journal import list_partials
+
+    return list_partials(out)
+
 #: directories the leak sentinel sweeps after every test (the chaos
 #: invariant enforced on the regular suite — ISSUE 10 satellite)
 _WATCHED_DIRS: list[str] = []
@@ -293,11 +301,11 @@ def test_persistent_writeback_failure_is_atomic(stream_fault_world, monkeypatch)
     with pytest.raises(OSError):
         _run_stream(w, out, monkeypatch)
     assert not os.path.exists(out)
-    assert os.path.exists(out + ".partial") and os.path.exists(out + ".journal")
+    assert _partials(out) and os.path.exists(out + ".journal")
     faults.reset()
     stats = _run_stream(w, out, monkeypatch)
     assert stats is not None and stats["n"] == w["n"]
-    assert not os.path.exists(out + ".partial") and not os.path.exists(out + ".journal")
+    assert not _partials(out) and not os.path.exists(out + ".journal")
 
 
 def test_hung_score_stage_recovers_via_watchdog_v2(
@@ -567,7 +575,7 @@ def test_compress_worker_death_is_atomic(stream_fault_world, monkeypatch):
     with pytest.raises(OSError, match="shard compress"):
         _run_stream(w, out, monkeypatch)
     assert not os.path.exists(out)
-    assert not os.path.exists(out + ".partial")
+    assert not _partials(out)
     faults.reset()
     stats = _run_stream(w, out, monkeypatch)  # rerun heals
     assert stats is not None and stats["n"] == w["n"]
@@ -1043,14 +1051,14 @@ def test_commit_enospc_keeps_journal_then_resume_completes(
     with pytest.raises(OSError):
         _run_stream(w, out, monkeypatch)
     assert not os.path.exists(out)
-    assert os.path.exists(out + ".partial")
+    assert _partials(out)
     assert os.path.exists(out + ".journal")
     faults.reset()
     stats = _run_stream(w, out, monkeypatch)
     assert stats is not None
     assert stats["resumed_chunks"] == stats["chunks"]  # nothing recomputed
     assert open(out, "rb").read() == clean_bytes
-    assert not os.path.exists(out + ".partial")
+    assert not _partials(out)
     assert not os.path.exists(out + ".journal")
 
 
@@ -1083,7 +1091,15 @@ def test_full_resume_verify_catches_early_corruption(stream_fault_world,
     jmeta = json.loads(open(out + ".journal", encoding="utf-8").readline())
     assert len(open(out + ".journal").read().splitlines()) - 1 >= 2
     # flip one byte INSIDE the FIRST chunk's region of the partial file
-    with open(out + ".partial", "r+b") as fh:
+    # (unique-suffix partial: the journal header names the token). The
+    # token is journal-internal state — drop it from the identity meta
+    # these direct try_resume calls pass, like the production caller's
+    # meta (try_resume RE-TOKENS the partial on success, so a stale
+    # token in expect would mismatch for the wrong reason).
+    from variantcalling_tpu.io import journal as _j
+
+    token = jmeta.pop("partial", None)
+    with open(_j.partial_path(out, token), "r+b") as fh:
         fh.seek(int(jmeta["header_len"]) + 5)
         b = fh.read(1)
         fh.seek(int(jmeta["header_len"]) + 5)

@@ -10,8 +10,8 @@ human diff would catch it. This tool is the gate:
   its direction and its noise band) and **exits 1 on any regression
   beyond the band**, 0 when clean, 2 on usage/IO errors.
 - ``python -m tools.bench_gate --run`` runs a fresh reduced bench
-  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs`` — the phases the
-  gate reads) and compares it against the newest committed ``BENCH_r*.json``
+  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve`` — the
+  phases the gate reads) and compares it against the newest committed ``BENCH_r*.json``
   (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
   opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
 
@@ -165,6 +165,19 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("e2e.cpuledger.stages.parse", "budget", 0.7),
     ("e2e.cpuledger.stages.render", "budget", 0.8),
     ("e2e.cpuledger.stages.commit", "budget", 0.6),
+    # -- vctpu serve (resident daemon PR): the warm/cold ratio is the
+    #    PROOF that resident state pays — a warm request must cost less
+    #    than a cold CLI invocation of the same work, every round, as an
+    #    ABSOLUTE budget (no baseline drift can excuse >= 1). The warm
+    #    latency and sustained-concurrency rows gate relatively with
+    #    wide bands (request latency on this shared 2-core box includes
+    #    the box's mood; the ratio is the code sentinel). bytes_identical
+    #    is a presence tripwire: the serve path must literally produce
+    #    the batch path's bytes or the phase must not pass at all. ------
+    ("serve.warm_over_cold", "budget", 1.0),
+    ("serve.warm_p50_s", "lower", 0.40),
+    ("serve.req_per_s_c4", "higher", 0.40),
+    ("serve.bytes_identical", "nonzero", 0.0),
 )
 
 #: string-valued tripwires: (dotted path, forbidden value). The metric
@@ -348,7 +361,7 @@ def run_fresh_bench(timeout_s: int = 420) -> dict | None:
     """A reduced fresh bench (the gate's phases only) on the CPU engine;
     returns its parsed JSON or None with the failure printed."""
     env = dict(os.environ)
-    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,io,mesh,e2e,obs"
+    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,io,mesh,e2e,obs,serve"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

@@ -330,9 +330,12 @@ def run_leg(fx: Fixtures, out: str, layout: str, faults_spec: str,
             os.remove(p_)
         except OSError:
             pass
+    from variantcalling_tpu.io import journal as journal_mod
+
     return {"rc": rc, "killed": killed, "status": status, "stderr": stderr,
             "out_exists": os.path.exists(out),
-            "partial": os.path.exists(out + ".partial"),
+            # unique-suffix partials (ISSUE 14): any <out>.partial* counts
+            "partial": bool(journal_mod.list_partials(out)),
             "journal": os.path.exists(out + ".journal"),
             "quarantine": os.path.exists(out + ".quarantine")}
 
@@ -380,17 +383,28 @@ def _check_leg(leg: dict, fx: Fixtures, out: str, name: str,
     return v
 
 
+def _remove_run_files(out: str, extra: tuple[str, ...] = ()) -> None:
+    """Sweep one leg's output + sidecars, including every unique-suffix
+    partial (``<out>.partial.<pid>-<hex>``, ISSUE 14)."""
+    from variantcalling_tpu.io import journal as journal_mod
+
+    targets = [out, out + ".journal", out + ".quarantine"]
+    targets += [out + s for s in extra]
+    targets += journal_mod.list_partials(out)
+    for p in targets:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
 def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
                  sabotage: str | None = None) -> dict:
     """One schedule end to end: the faulted fresh leg, then — whenever
     the faulted leg left a resumable journal (or was killed) — a
     fault-free RESUME leg that must complete byte-identically."""
     out = os.path.join(workdir, f"seed{sched.seed}.vcf")
-    for suffix in ("", ".partial", ".journal", ".quarantine"):
-        try:
-            os.remove(out + suffix)
-        except OSError:
-            pass
+    _remove_run_files(out)
     violations: list[str] = []
     legs: list[dict] = []
     leg1 = run_leg(fx, out, sched.layout, sched.faults_env(),
@@ -410,11 +424,7 @@ def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
         else:
             violations += _check_leg(leg2, fx, out, "resume",
                                      prior_bytes=None)
-    for suffix in ("", ".partial", ".journal", ".quarantine", ".obs.jsonl"):
-        try:
-            os.remove(out + suffix)
-        except OSError:
-            pass
+    _remove_run_files(out, (".obs.jsonl",))
     return {"schedule": sched.to_json(), "describe": sched.describe(),
             "legs": [{k: leg[k] for k in
                       ("name", "rc", "killed", "partial", "journal")}

@@ -1,0 +1,39 @@
+"""loadhunt — chaoshunt's closed-loop sibling for the ``vctpu serve``
+daemon (ISSUE 14; docs/serving.md "The load gate").
+
+chaoshunt proves the BATCH executor survives injected faults; loadhunt
+proves the DAEMON survives injected faults **under concurrent load** —
+the difference between "the run recovers" and "the service stays up for
+everyone else while one request dies". A campaign is seeded and fully
+deterministic in its schedule draw:
+
+- one real daemon subprocess per schedule (``vctpu serve --port 0``,
+  pinned admission knobs, 2 forced host devices so mesh requests work);
+- N ≥ 8 concurrent clients per schedule, each drawing a fault class:
+  clean, **poison chunk** (request-scoped ``pipeline.chunk``, retries
+  exhausted), **native hang** (``pipeline.stage_hang`` the watchdog
+  must recover), **dispatch OOM** (``xla.dispatch_oom`` on a scoped
+  dp=2 mesh — the shrink/degrade ladder), **commit ENOSPC**
+  (``io.commit`` persistent), **mid-request client disconnect** (the
+  socket closes before the response); every 4th seed is an OVERLOAD
+  schedule (clients ≫ slots+queue with per-chunk slowdowns) that must
+  produce explicit sheds;
+- SLO invariants checked per schedule: the daemon process NEVER exits,
+  every accepted-and-ok request's output is byte-identical to the cold
+  CLI reference modulo ``##vctpu_*`` headers, poisoned requests fail
+  with a distinct per-request error while concurrent requests complete,
+  overload produces explicit shed responses (bounded queue — a client
+  left hanging past its socket timeout is a violation), failed requests
+  leave paired-or-absent sidecars and never a destination file, and on
+  SIGTERM the daemon drains (exit 0, obs ``run_end`` status ``drain``,
+  self-reported leaked threads empty);
+- violations delta-shrink to a minimal repro JSON (``--replay``), the
+  chaoshunt convention; exit codes 0 clean / 1 violation / 2 usage.
+
+``run_tests.sh`` wires ``VCTPU_LOAD=1`` to a 10-seed smoke, mirroring
+``VCTPU_CHAOS=1``.
+"""
+
+from tools.loadhunt.harness import (ClientSpec, Schedule,  # noqa: F401
+                                    draw_schedule, run_campaign,
+                                    run_schedule)

@@ -1,0 +1,73 @@
+"""CLI: ``python -m tools.loadhunt`` — seeded load×chaos campaigns
+against a real ``vctpu serve`` daemon (package docstring).
+
+Exit codes (the chaoshunt/vctpu-lint contract): 0 every schedule green,
+1 at least one invariant violation (minimal repro JSON written), 2
+usage/setup errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.loadhunt import harness
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.loadhunt",
+        description="closed-loop load×chaos campaigns for vctpu serve "
+                    "(docs/serving.md)")
+    ap.add_argument("--seeds", type=int, default=10,
+                    help="run seeds 0..N-1 (default 10, the CI smoke)")
+    ap.add_argument("--seed-list", default=None,
+                    help="comma-separated explicit seeds (overrides "
+                         "--seeds)")
+    ap.add_argument("--records", type=int, default=2000,
+                    help="fixture callset size")
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here (default: temp dir, removed "
+                         "when green)")
+    ap.add_argument("--replay", default=None,
+                    help="re-run a shrunk repro JSON instead of a campaign")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="skip delta-shrinking violations")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the campaign report as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.replay:
+            r = harness.replay(args.replay, workdir=args.workdir)
+            if args.json:
+                print(json.dumps(r, indent=2, sort_keys=True))
+            return 1 if r["violations"] else 0
+        if args.seed_list:
+            seeds = [int(s) for s in args.seed_list.split(",") if s.strip()]
+        else:
+            seeds = list(range(args.seeds))
+        if not seeds:
+            print("loadhunt: no seeds", file=sys.stderr)
+            return 2
+        report = harness.run_campaign(seeds, workdir=args.workdir,
+                                      records=args.records,
+                                      shrink=not args.no_shrink)
+    except (OSError, RuntimeError, ValueError) as e:
+        print(f"loadhunt: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        compact = dict(report)
+        compact["schedules"] = [
+            {k: s[k] for k in ("describe", "violations")}
+            for s in report["schedules"]]
+        print(json.dumps(compact, indent=2, sort_keys=True))
+    print(f"loadhunt: {report['seeds']} seeds, "
+          f"{report['violating_schedules']} violating, "
+          f"{report['wall_s']}s")
+    return 1 if report["violating_schedules"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

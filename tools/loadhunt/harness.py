@@ -1,0 +1,619 @@
+"""Campaign engine for ``tools.loadhunt`` (see package docstring).
+
+Fixtures and the cold-CLI byte reference are shared with chaoshunt
+(``tools/chaoshunt/harness.build_fixtures`` — the same synthetic callset
+and the same ``normalize_output`` provenance-header rule), so the two
+harnesses can never disagree about what "byte-identical" means.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+from tools.chaoshunt.harness import (Fixtures, build_fixtures,
+                                     normalize_output)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: fault classes a client may draw; "expect" is the invariant class:
+#: ok (bytes must match), error (a distinct per-request error must come
+#: back, no destination), any (disconnect — the server may or may not
+#: finish; only the daemon-alive invariant applies)
+CLIENT_CLASSES = ("clean", "poison", "hang", "oom", "commit", "disconnect")
+
+#: admission knobs the daemon is pinned to (small, so overload schedules
+#: actually overload on a 2-core container)
+MAX_INFLIGHT = 2
+QUEUE_DEPTH = 4
+
+#: client-side socket timeout: the shed-not-hang invariant — a request
+#: the daemon neither answers nor sheds within this bound IS the hang
+CLIENT_TIMEOUT_S = 120
+#: wall bound for one whole schedule (daemon boot + clients + drain)
+SCHEDULE_TIMEOUT_S = 300
+
+
+@dataclasses.dataclass
+class ClientSpec:
+    """One concurrent client of a schedule."""
+
+    idx: int
+    fault: str  # CLIENT_CLASSES member
+    deadline_s: float = 60.0
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "ClientSpec":
+        return ClientSpec(idx=int(d["idx"]), fault=d.get("fault", "clean"),
+                          deadline_s=float(d.get("deadline_s", 60.0)))
+
+
+@dataclasses.dataclass
+class Schedule:
+    """One drawn load×chaos schedule: N concurrent clients × faults."""
+
+    seed: int
+    mode: str  # "mixed" | "overload"
+    clients: list[ClientSpec] = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed, "mode": self.mode,
+                "clients": [c.to_json() for c in self.clients]}
+
+    @staticmethod
+    def from_json(d: dict) -> "Schedule":
+        return Schedule(seed=int(d.get("seed", 0)),
+                        mode=d.get("mode", "mixed"),
+                        clients=[ClientSpec.from_json(c)
+                                 for c in d.get("clients", [])])
+
+    def describe(self) -> str:
+        kinds = {}
+        for c in self.clients:
+            kinds[c.fault] = kinds.get(c.fault, 0) + 1
+        inner = " ".join(f"{k}x{v}" for k, v in sorted(kinds.items()))
+        return f"{self.mode} n={len(self.clients)} [{inner}]"
+
+
+def draw_schedule(seed: int) -> Schedule:
+    """Deterministic schedule per seed. Every 4th seed is an OVERLOAD
+    draw (clients ≫ admission capacity, slowed chunks, short deadlines —
+    sheds are REQUIRED); the rest are MIXED draws of ≥ 8 concurrent
+    clients guaranteed to include the four headline fault classes
+    (poison, hang, OOM, disconnect) next to clean traffic."""
+    rng = random.Random(seed)
+    if seed % 4 == 3:
+        n = MAX_INFLIGHT + QUEUE_DEPTH + rng.randint(4, 8)
+        clients = [ClientSpec(i, "clean", deadline_s=20.0)
+                   for i in range(n)]
+        return Schedule(seed=seed, mode="overload", clients=clients)
+    n = rng.randint(8, 11)
+    faults = ["poison", "hang", "oom", "disconnect"]
+    extra_pool = ["clean", "clean", "clean", "poison", "commit", "hang"]
+    while len(faults) < n:
+        faults.append(rng.choice(extra_pool))
+    rng.shuffle(faults)
+    return Schedule(seed=seed, mode="mixed",
+                    clients=[ClientSpec(i, f) for i, f in enumerate(faults)])
+
+
+# ---------------------------------------------------------------------------
+# daemon management
+# ---------------------------------------------------------------------------
+
+
+def _daemon_env(overload: bool) -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("VCTPU_") and k not in ("XLA_FLAGS",
+                                                       "PYTHONPATH")}
+    env.update(
+        PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        # 2 forced host devices so scoped dp=2 mesh requests resolve
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+        VCTPU_STREAM_CHUNK_BYTES=str(1 << 14),
+        VCTPU_IO_BACKOFF_S="0.01",
+        VCTPU_STAGE_TIMEOUT_S="2",
+        VCTPU_SERVE_MAX_INFLIGHT=str(MAX_INFLIGHT),
+        VCTPU_SERVE_QUEUE_DEPTH=str(QUEUE_DEPTH),
+        VCTPU_SERVE_DRAIN_S="30",
+    )
+    if overload:
+        # slow the chunk cadence so the backlog actually builds: the
+        # injected delay rides the DAEMON env (process-global), every
+        # request pays ~0.15s per chunk body
+        env["VCTPU_FAULTS"] = "pipeline.stage_hang:0@0.15"
+    return env
+
+
+@dataclasses.dataclass
+class Daemon:
+    proc: subprocess.Popen
+    address: str
+    ready: dict
+    status_file: str
+    obs_log: str
+    log_path: str
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+def start_daemon(workdir: str, overload: bool) -> Daemon:
+    ready_file = os.path.join(workdir, "serve_ready.json")
+    status_file = os.path.join(workdir, "serve_status.json")
+    obs_log = os.path.join(workdir, "serve_obs.jsonl")
+    log_path = os.path.join(workdir, "serve_daemon.log")
+    for p in (ready_file, status_file):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    log_fh = open(log_path, "ab")
+    proc = subprocess.Popen(  # noqa: S603  # vctpu-lint: disable=VCT005 — the daemon is supervised: the ready-poll below is deadline-bounded and stop_daemon waits with timeout + kill
+        [sys.executable, "-m", "variantcalling_tpu", "serve",
+         "--port", "0", "--backend", "cpu",
+         "--ready-file", ready_file, "--status-file", status_file,
+         "--obs-log", obs_log],
+        env=_daemon_env(overload), cwd=REPO, stdout=log_fh, stderr=log_fh)
+    log_fh.close()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"loadhunt: daemon exited rc={proc.returncode} before "
+                f"listening (see {log_path})")
+        try:
+            with open(ready_file, encoding="utf-8") as fh:
+                ready = json.load(fh)
+            break
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    else:
+        proc.kill()
+        raise RuntimeError("loadhunt: daemon never became ready")
+    return Daemon(proc=proc, address=ready["address"], ready=ready,
+                  status_file=status_file, obs_log=obs_log,
+                  log_path=log_path)
+
+
+def stop_daemon(d: Daemon) -> dict:
+    """SIGTERM drain; returns {rc, status(json), obs_end_status}."""
+    if d.alive():
+        d.proc.send_signal(signal.SIGTERM)
+        try:
+            d.proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            d.proc.kill()
+            d.proc.wait(timeout=10)
+    status = None
+    try:
+        with open(d.status_file, encoding="utf-8") as fh:
+            status = json.load(fh)
+    except (OSError, ValueError):
+        pass
+    obs_end = None
+    try:
+        with open(d.obs_log, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("kind") == "run_end":
+                    obs_end = ev.get("status")
+    except OSError:
+        pass
+    return {"rc": d.proc.returncode, "status": status, "obs_end": obs_end}
+
+
+# ---------------------------------------------------------------------------
+# clients
+# ---------------------------------------------------------------------------
+
+
+def _request_body(spec: ClientSpec, fx: Fixtures, out: str) -> dict:
+    body = {"input": fx.input_vcf, "model": fx.model, "model_name": "m",
+            "reference": fx.ref, "output": out,
+            "deadline_s": spec.deadline_s}
+    if spec.fault == "poison":
+        # a deterministically-failing chunk past its whole retry budget
+        body["faults"] = "pipeline.chunk:0"
+        body["knobs"] = {"VCTPU_CHUNK_RETRIES": "0"}
+    elif spec.fault == "hang":
+        # one long cancellable hang the v2 watchdog (daemon env pins
+        # VCTPU_STAGE_TIMEOUT_S=2) must dump, cancel and recover
+        body["faults"] = "pipeline.stage_hang:1@30"
+    elif spec.fault == "oom":
+        # device OOM on a request-scoped dp=2 mesh: the shrink rung of
+        # the ladder absorbs it and the request completes byte-identically
+        body["faults"] = "xla.dispatch_oom:1"
+        body["knobs"] = {"VCTPU_MESH_DEVICES": "2", "VCTPU_ENGINE": "jit"}
+    elif spec.fault == "commit":
+        # ENOSPC at every atomic-commit attempt: a distinct per-request
+        # failure; journal+partial stay behind, destination untouched
+        body["faults"] = "io.commit:0"
+    return body
+
+
+def run_client(address: str, spec: ClientSpec, fx: Fixtures,
+               out: str, retry_sheds: bool = False) -> dict:
+    """One client end to end; returns {idx, fault, code, status, wall_s,
+    hung, disconnect}.
+
+    ``retry_sheds`` models a well-behaved client: an explicit 503 shed
+    is obeyed (Retry-After backoff) and the request re-submitted until
+    the client bound — mixed schedules use it so every fault client
+    actually executes its fault; overload schedules do NOT (the shed IS
+    the expected outcome there)."""
+    body = _request_body(spec, fx, out)
+    data = json.dumps(body).encode()
+    t0 = time.time()
+    if spec.fault == "disconnect":
+        # mid-request disconnect: send the full request, then close the
+        # socket without reading the response
+        host, port = address[len("http://"):].split(":")
+        try:
+            s = socket.create_connection((host, int(port)), timeout=10)
+            s.sendall(b"POST /v1/filter HTTP/1.1\r\n"
+                      b"Host: localhost\r\n"
+                      b"Content-Type: application/json\r\n"
+                      + f"Content-Length: {len(data)}\r\n\r\n".encode()
+                      + data)
+            time.sleep(0.2)  # let the daemon start the request
+            s.close()
+        except OSError as e:
+            return {"idx": spec.idx, "fault": spec.fault, "code": None,
+                    "status": f"send_failed: {e}", "wall_s": 0.0,
+                    "hung": False, "disconnect": True}
+        return {"idx": spec.idx, "fault": spec.fault, "code": None,
+                "status": "disconnected", "wall_s": time.time() - t0,
+                "hung": False, "disconnect": True}
+    while True:
+        req = urllib.request.Request(
+            address + "/v1/filter", data=data,
+            headers={"Content-Type": "application/json"})
+        remaining = CLIENT_TIMEOUT_S - (time.time() - t0)
+        if remaining <= 0:
+            return {"idx": spec.idx, "fault": spec.fault, "code": None,
+                    "status": "hung: shed-retry budget spent",
+                    "wall_s": time.time() - t0, "hung": True,
+                    "disconnect": False}
+        try:
+            with urllib.request.urlopen(req, timeout=remaining) as r:
+                code, payload = r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                code, payload = e.code, json.loads(e.read())
+            except ValueError:
+                code, payload = e.code, {"status": f"http_{e.code}"}
+        except (TimeoutError, OSError) as e:
+            # shed-not-hang: neither an answer nor a shed within the bound
+            return {"idx": spec.idx, "fault": spec.fault, "code": None,
+                    "status": f"hung: {type(e).__name__}",
+                    "wall_s": time.time() - t0, "hung": True,
+                    "disconnect": False}
+        if retry_sheds and payload.get("status") in ("shed", "draining"):
+            time.sleep(min(2.0, float(payload.get("retry_after_s") or 0.3)))
+            continue
+        return {"idx": spec.idx, "fault": spec.fault, "code": code,
+                "status": payload.get("status"), "kind": payload.get("kind"),
+                "wall_s": round(time.time() - t0, 2), "hung": False,
+                "disconnect": False}
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+
+def _sidecars(out: str) -> dict:
+    from variantcalling_tpu.io import journal as journal_mod
+
+    return {"partial": bool(journal_mod.list_partials(out)),
+            "journal": os.path.exists(out + ".journal"),
+            "quarantine": os.path.exists(out + ".quarantine")}
+
+
+def _wait_daemon_idle(address: str, timeout_s: float = 60.0) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(address + "/v1/status",
+                                        timeout=10) as r:
+                st = json.loads(r.read())
+            if st.get("in_flight", 1) == 0 and st.get("queued", 1) == 0:
+                return True
+        except (OSError, ValueError):
+            return False
+        time.sleep(0.1)
+    return False
+
+
+def check_schedule(sched: Schedule, results: list[dict], fx: Fixtures,
+                   outs: dict[int, str], daemon_alive: bool,
+                   shutdown: dict | None) -> list[str]:
+    """The SLO invariants for one completed schedule (package docstring)."""
+    v: list[str] = []
+    if not daemon_alive:
+        v.append("daemon: process EXITED during the schedule")
+    for r in results:
+        name = f"client {r['idx']} ({r['fault']})"
+        if r["hung"]:
+            v.append(f"{name}: HUNG past the {CLIENT_TIMEOUT_S}s client "
+                     "bound (shed-not-hang violated)")
+            continue
+        if r["disconnect"]:
+            continue  # only the daemon-alive invariant applies
+        out = outs[r["idx"]]
+        side = _sidecars(out)
+        expect_error = r["fault"] in ("poison", "commit")
+        if expect_error:
+            if r["code"] == 200:
+                v.append(f"{name}: expected a per-request error, got ok")
+            elif r["status"] not in ("error", "failed"):
+                v.append(f"{name}: expected a distinct error status, got "
+                         f"{r['status']!r} (code {r['code']})")
+            if os.path.exists(out):
+                v.append(f"{name}: failed request left a destination file")
+            if side["partial"] != side["journal"]:
+                v.append(f"{name}: failure left an unpaired sidecar "
+                         f"({side})")
+        elif sched.mode == "overload":
+            if r["status"] not in ("ok", "shed", "deadline"):
+                v.append(f"{name}: overload produced status "
+                         f"{r['status']!r} (want ok/shed/deadline)")
+            if r["status"] == "ok":
+                _check_ok_bytes(v, name, out, fx, side)
+        else:  # clean / hang / oom must complete byte-identically
+            if r["code"] != 200 or r["status"] != "ok":
+                v.append(f"{name}: expected ok, got {r['status']!r} "
+                         f"(code {r['code']}, kind {r.get('kind')})")
+            else:
+                _check_ok_bytes(v, name, out, fx, side)
+    if sched.mode == "overload":
+        sheds = sum(1 for r in results if r["status"] in ("shed", "deadline"))
+        capacity = MAX_INFLIGHT + QUEUE_DEPTH
+        if len(sched.clients) > capacity and sheds == 0:
+            v.append(f"overload: {len(sched.clients)} clients vs capacity "
+                     f"{capacity} produced ZERO explicit sheds")
+    if shutdown is not None:
+        if shutdown["rc"] != 0:
+            v.append(f"drain: daemon exited rc={shutdown['rc']} (want 0)")
+        if shutdown["obs_end"] != "drain":
+            v.append(f"drain: obs run_end status {shutdown['obs_end']!r} "
+                     "(want 'drain')")
+        leaked = (shutdown.get("status") or {}).get("leaked")
+        if leaked:
+            v.append(f"drain: daemon self-reported leaked threads {leaked}")
+        if shutdown.get("status") is None:
+            v.append("drain: daemon wrote no shutdown status JSON")
+    return v
+
+
+def _check_ok_bytes(v: list[str], name: str, out: str, fx: Fixtures,
+                    side: dict) -> None:
+    if not os.path.exists(out):
+        v.append(f"{name}: ok response but no destination file")
+        return
+    if normalize_output(open(out, "rb").read()) != fx.reference_norm:
+        v.append(f"{name}: ok response but bytes differ from the cold-CLI "
+                 "reference")
+    if side["partial"] or side["journal"] or side["quarantine"]:
+        v.append(f"{name}: ok response left stray sidecars ({side})")
+
+
+# ---------------------------------------------------------------------------
+# schedule + campaign
+# ---------------------------------------------------------------------------
+
+
+def run_schedule(sched: Schedule, fx: Fixtures, workdir: str) -> dict:
+    """One schedule end to end: boot a fresh daemon, fire the clients
+    concurrently, wait idle, health-check, SIGTERM-drain, check every
+    invariant."""
+    import threading
+
+    outs = {c.idx: os.path.join(workdir,
+                                f"seed{sched.seed}_c{c.idx}.vcf")
+            for c in sched.clients}
+    for out in outs.values():
+        _remove_outputs(out)
+    daemon = start_daemon(workdir, overload=(sched.mode == "overload"))
+    results: list[dict] = []
+    lock = threading.Lock()
+    try:
+        # warm once so client latencies measure steady daemon state, not
+        # the first-compile cliff (admission still guards it)
+        try:
+            run_client(daemon.address, ClientSpec(-1, "clean",
+                                                  deadline_s=120.0),
+                       fx, os.path.join(workdir, f"seed{sched.seed}_warm.vcf"))
+        finally:
+            _remove_outputs(os.path.join(workdir,
+                                         f"seed{sched.seed}_warm.vcf"))
+
+        def client(spec: ClientSpec) -> None:
+            r = run_client(daemon.address, spec, fx, outs[spec.idx],
+                           retry_sheds=(sched.mode == "mixed"))
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    name=f"loadhunt-c{c.idx}", daemon=True)
+                   for c in sched.clients]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(10.0, SCHEDULE_TIMEOUT_S - (time.time() - t0)))
+        for t in threads:
+            if t.is_alive():
+                with lock:
+                    results.append({"idx": -99, "fault": "harness",
+                                    "code": None, "status": "client thread "
+                                    "never returned", "wall_s": 0.0,
+                                    "hung": True, "disconnect": False})
+                break
+        alive_during = daemon.alive()
+        # disconnect clients may have left server-side work in flight
+        _wait_daemon_idle(daemon.address) if alive_during else None
+    finally:
+        shutdown = stop_daemon(daemon)
+    violations = check_schedule(sched, sorted(results,
+                                              key=lambda r: r["idx"]),
+                                fx, outs, alive_during, shutdown)
+    for out in outs.values():
+        _remove_outputs(out)
+    return {"schedule": sched.to_json(), "describe": sched.describe(),
+            "results": sorted(results, key=lambda r: r["idx"]),
+            "violations": violations}
+
+
+def _remove_outputs(out: str) -> None:
+    from variantcalling_tpu.io import journal as journal_mod
+
+    targets = [out, out + ".journal", out + ".quarantine",
+               out + ".obs.jsonl"]
+    targets += journal_mod.list_partials(out)
+    for p in targets:
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+# -- delta-shrink (chaoshunt convention) ------------------------------------
+
+
+def _simplifications(sched: Schedule):
+    """Candidate one-step simplifications, most aggressive first."""
+    # drop whole clients (keep ≥1)
+    for i in range(len(sched.clients)):
+        if len(sched.clients) > 1:
+            kept = sched.clients[:i] + sched.clients[i + 1:]
+            yield dataclasses.replace(
+                sched, clients=[dataclasses.replace(c, idx=j)
+                                for j, c in enumerate(kept)])
+    # neutralize a client's fault
+    for i, c in enumerate(sched.clients):
+        if c.fault != "clean":
+            g = dataclasses.replace(c, fault="clean")
+            yield dataclasses.replace(
+                sched, clients=sched.clients[:i] + [g]
+                + sched.clients[i + 1:])
+    if sched.mode == "overload":
+        yield dataclasses.replace(sched, mode="mixed")
+
+
+def shrink_schedule(sched: Schedule, fx: Fixtures, workdir: str,
+                    budget: int = 12) -> tuple[Schedule, dict]:
+    """Greedy delta-shrink: keep any one-step simplification that still
+    violates, until none does or the evaluation budget (each evaluation
+    boots a fresh daemon) is spent."""
+    current = sched
+    result = run_schedule(current, fx, workdir)
+    spent = 1
+    progress = True
+    while progress and spent < budget:
+        progress = False
+        for cand in _simplifications(current):
+            if spent >= budget:
+                break
+            r = run_schedule(cand, fx, workdir)
+            spent += 1
+            if r["violations"]:
+                current, result = cand, r
+                progress = True
+                break
+    return current, result
+
+
+def run_campaign(seeds: list[int], workdir: str | None = None,
+                 records: int = 2000, shrink: bool = True,
+                 log=print) -> dict:
+    """Run one schedule per seed; on violations, delta-shrink the first
+    failing schedule to a minimal repro JSON. Returns the campaign
+    report (exit-code mapping in ``__main__``)."""
+    t0 = time.time()
+    owns_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="loadhunt-")
+    os.makedirs(workdir, exist_ok=True)
+    fx = build_fixtures(workdir, records=records)
+    results = []
+    first_violation: dict | None = None
+    for seed in seeds:
+        sched = draw_schedule(seed)
+        r = run_schedule(sched, fx, workdir)
+        results.append(r)
+        flag = "VIOLATION" if r["violations"] else "ok"
+        log(f"loadhunt seed {seed:>4} [{sched.describe()}] -> {flag}")
+        for msg in r["violations"]:
+            log(f"  ! {msg}")
+        if r["violations"] and first_violation is None:
+            first_violation = r
+    repro_path = None
+    shrunk = None
+    if first_violation is not None and shrink:
+        log("loadhunt: delta-shrinking the first violating schedule ...")
+        minimal, minimal_result = shrink_schedule(
+            Schedule.from_json(first_violation["schedule"]), fx, workdir)
+        shrunk = {"schedule": minimal.to_json(),
+                  "describe": minimal.describe(),
+                  "violations": minimal_result["violations"]}
+        repro_path = os.path.join(workdir, "loadhunt_repro.json")
+        with open(repro_path, "w", encoding="utf-8") as fh:
+            json.dump({"schedule": minimal.to_json(),
+                       "violations": minimal_result["violations"],
+                       "records": records}, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        log(f"loadhunt: minimal repro [{minimal.describe()}] written to "
+            f"{repro_path}")
+    n_viol = sum(1 for r in results if r["violations"])
+    report = {
+        "seeds": len(seeds),
+        "violating_schedules": n_viol,
+        "schedules": results,
+        "shrunk": shrunk,
+        "repro": repro_path,
+        "workdir": workdir if (n_viol or not owns_workdir) else None,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    if owns_workdir and not n_viol:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
+def replay(repro_path: str, workdir: str | None = None, log=print) -> dict:
+    """Re-run a shrunk repro JSON (fresh fixtures + daemon)."""
+    with open(repro_path, encoding="utf-8") as fh:
+        repro = json.load(fh)
+    sched = Schedule.from_json(repro["schedule"])
+    owns_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="loadhunt-replay-")
+    os.makedirs(workdir, exist_ok=True)
+    fx = build_fixtures(workdir, records=int(repro.get("records", 2000)))
+    r = run_schedule(sched, fx, workdir)
+    log(f"loadhunt replay [{sched.describe()}] -> "
+        f"{'VIOLATION' if r['violations'] else 'ok'}")
+    for msg in r["violations"]:
+        log(f"  ! {msg}")
+    if owns_workdir and not r["violations"]:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return r

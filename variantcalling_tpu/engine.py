@@ -143,6 +143,29 @@ def resolve() -> EngineDecision:
         return decision
 
 
+def resolve_request() -> EngineDecision:
+    """Per-REQUEST engine decision for the ``vctpu serve`` daemon
+    (docs/serving.md): an EXPLICIT scoped/env request (``VCTPU_ENGINE``
+    under ``knobs.scope``, or ``VCTPU_REQUIRE_NATIVE``) resolves fresh —
+    the process cache must not pin request A's engine onto request B —
+    while ``auto`` returns the cached process decision (the probe that
+    decides auto ran once and its inputs are process facts, not request
+    settings). Explicit native still fails loudly when unusable; the
+    failure is then a per-request configuration error."""
+    req = _requested()
+    if req == "auto":
+        return resolve()
+    if req == "native":
+        if not _native_usable():
+            raise EngineError(
+                "this request requires the native scoring engine "
+                f"({ENGINE_ENV}=native or {REQUIRE_ENV}=1) but the native "
+                "library is not loaded on this host. See "
+                "docs/robustness.md.")
+        return EngineDecision("native", req, "explicitly requested (scoped)")
+    return EngineDecision("jit", req, "explicitly requested (scoped)")
+
+
 def resolve_for_run() -> EngineDecision:
     """:func:`resolve` plus multi-host agreement: every rank must score
     with the SAME engine, or the allgathered score slices could mix

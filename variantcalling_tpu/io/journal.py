@@ -49,8 +49,102 @@ def fsync_enabled() -> bool:
     return knobs.get_bool("VCTPU_JOURNAL_FSYNC")
 
 
-def partial_path(out_path: str) -> str:
-    return str(out_path) + PARTIAL_SUFFIX
+def partial_path(out_path: str, token: str | None = None) -> str:
+    """The in-flight output path. ``token`` (``new_partial_token``)
+    makes it run-unique — two concurrent runs targeting the same output
+    then accumulate INDEPENDENT partials and the atomic ``os.replace``
+    commit makes the destination last-complete-writer-wins, where the
+    old fixed ``<out>.partial`` let them silently clobber each other's
+    bytes mid-write. ``None`` keeps the legacy fixed name (journals
+    written before the token field resume through it)."""
+    base = str(out_path) + PARTIAL_SUFFIX
+    return f"{base}.{token}" if token else base
+
+
+def list_partials(out_path: str) -> list[str]:
+    """Every partial next to ``out_path`` — the legacy fixed name plus
+    all unique-suffix partials. The ONE spelling of that glob, shared by
+    the chaos/load harnesses, the bench cleanup and the test sentinels,
+    so a future change to the naming scheme cannot strand a copy."""
+    import glob
+
+    base = str(out_path) + PARTIAL_SUFFIX
+    found = [base] if os.path.exists(base) else []
+    return found + sorted(glob.glob(glob.escape(base) + ".*"))
+
+
+def new_partial_token() -> str:
+    """A fresh run-unique partial suffix. The leading pid is load-
+    bearing: :func:`cleanup_stale_partials` only sweeps partials whose
+    owning process is DEAD, so a concurrent live run's partial is never
+    collected."""
+    return f"{os.getpid()}-{os.urandom(4).hex()}"
+
+
+def _token_pid(token: str) -> int | None:
+    head = token.split("-", 1)[0]
+    return int(head) if head.isdigit() else None
+
+
+#: partial tokens with an OPEN sink in THIS process — pid liveness alone
+#: cannot distinguish a serve daemon's in-flight request from its own
+#: finished-and-failed one (same pid), so the streaming writer claims
+#: its token for the sink's lifetime (set add/discard are GIL-atomic)
+_ACTIVE_TOKENS: set[str] = set()
+
+
+def claim_token(token: str) -> None:
+    _ACTIVE_TOKENS.add(token)
+
+
+def release_token(token: str) -> None:
+    _ACTIVE_TOKENS.discard(token)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # EPERM: alive under another uid
+    return True
+
+
+def token_in_use(token: str) -> bool:
+    """Does a RUNNING process/request own this partial? Another live pid
+    always counts as in use (conservative: a recycled pid keeps a stale
+    file rather than risking a live one); our own pid counts only while
+    the token is claimed by an open sink in this process."""
+    pid = _token_pid(token)
+    if pid is None or not _pid_alive(pid):
+        return False
+    if pid != os.getpid():
+        return True
+    return token in _ACTIVE_TOKENS
+
+
+def cleanup_stale_partials(out_path: str) -> None:
+    """Sweep ABANDONED unique-suffix partials next to ``out_path``: any
+    ``<out>.partial.<pid>-<hex>`` no running process/request owns
+    (:func:`token_in_use` — dead owner pid, or this process's pid with
+    no open sink claiming the token). A live FOREIGN pid's partial is
+    left strictly alone; unclaimed own-pid orphans must go, or a
+    long-lived serve daemon slowly accretes them."""
+    import glob
+
+    prefix = str(out_path) + PARTIAL_SUFFIX + "."
+    for p in glob.glob(glob.escape(str(out_path) + PARTIAL_SUFFIX) + ".*"):
+        token = p[len(prefix):]
+        if _token_pid(token) is None:
+            continue  # not our naming scheme — leave it
+        if token_in_use(token):
+            continue
+        try:
+            os.remove(p)
+            logger.info("swept stale partial %s (no live owner)", p)
+        except OSError:
+            pass
 
 
 def journal_path(out_path: str) -> str:
@@ -70,6 +164,9 @@ class ResumeState:
     watermark: int  # byte offset in the partial file after those chunks
     n_records: int
     n_pass: int
+    #: unique partial suffix the journal recorded (None: legacy fixed
+    #: ``<out>.partial`` written before the token field)
+    partial_token: str | None = None
 
 
 @dataclass
@@ -154,17 +251,22 @@ class ChunkJournal:
         return meta, entries
 
 
-def try_resume(out_path: str, meta: dict) -> ResumeState | None:
+def try_resume(out_path: str, meta: dict,
+               claim: bool = False) -> ResumeState | None:
     """Validate journal + partial file against this run's identity ``meta``
     and prepare the partial file for continuation.
 
     On success the partial file is TRUNCATED to the journaled watermark
-    (healing a torn final chunk) and a :class:`ResumeState` is returned;
-    ANY mismatch or malformation returns None (fresh run) — a corrupt
-    journal must never be able to crash every subsequent run.
+    (healing a torn final chunk), RE-TOKENED under this process's pid,
+    and a :class:`ResumeState` is returned; ANY mismatch or malformation
+    returns None (fresh run) — a corrupt journal must never be able to
+    crash every subsequent run. ``claim=True`` (the streaming writer)
+    additionally claims the new token ATOMICALLY with the rename, so no
+    concurrent discard/sweep can take the partial in the gap before the
+    writer opens it — the caller then owns :func:`release_token`.
     """
     try:
-        return _try_resume(out_path, meta)
+        return _try_resume(out_path, meta, claim=claim)
     except (KeyError, ValueError, TypeError, OSError):
         # journal parses as JSON but is structurally wrong (missing
         # fields, non-numeric values): suspicious -> fresh run
@@ -172,7 +274,8 @@ def try_resume(out_path: str, meta: dict) -> ResumeState | None:
         return None
 
 
-def _try_resume(out_path: str, meta: dict) -> ResumeState | None:
+def _try_resume(out_path: str, meta: dict,
+                claim: bool = False) -> ResumeState | None:
     loaded = ChunkJournal.load(out_path)
     if loaded is None:
         return None
@@ -183,7 +286,17 @@ def _try_resume(out_path: str, meta: dict) -> ResumeState | None:
         return None
     if not entries:
         return None
-    part = partial_path(out_path)
+    token = jmeta.get("partial") or None
+    if token is not None and token_in_use(token):
+        # the journal's partial belongs to a RUNNING process/request —
+        # truncating/appending a live writer's file would interleave two
+        # runs' bytes. Same-output concurrency is served by the unique
+        # partials + atomic commit (last complete writer wins); resume
+        # is only for DEAD runs.
+        logger.info("streaming resume: the journal's partial is owned by "
+                    "a running process — fresh run")
+        return None
+    part = partial_path(out_path, token)
     try:
         size = os.path.getsize(part)
     except OSError:
@@ -234,28 +347,57 @@ def _try_resume(out_path: str, meta: dict) -> ResumeState | None:
     if size > watermark:  # torn final chunk beyond the journal: heal it
         with open(part, "r+b") as fh:
             fh.truncate(watermark)
-    # heal the journal itself too: a SIGKILL mid-append can leave a torn
-    # (newline-less) tail line that load() dropped — appending after it
-    # would glue valid JSON onto garbage and poison the NEXT resume.
-    # Rewriting meta + the validated entries makes reopen()-append safe.
-    j = ChunkJournal(out_path)
-    j.begin(jmeta)
-    for e in entries:
-        j.append(int(e["seq"]), int(e["records"]), int(e["pass"]),
-                 int(e["body_len"]), int(e["crc"]))
-    j.close()
+    # RE-TOKEN on resume: the resumed run must own its partial under ITS
+    # pid — keeping the dead run's token would let a concurrent fresh
+    # run's stale-partial sweep (dead owner pid) delete the file out
+    # from under the live resumer. Legacy fixed-name partials adopt the
+    # token scheme here the same way.
+    new_token = new_partial_token()
+    if claim:
+        claim_token(new_token)  # before the file exists: no sweep gap
+    try:
+        os.rename(part, partial_path(out_path, new_token))
+        # heal the journal itself too: a SIGKILL mid-append can leave a
+        # torn (newline-less) tail line that load() dropped — appending
+        # after it would glue valid JSON onto garbage and poison the
+        # NEXT resume. Rewriting meta (with the NEW partial token) +
+        # the validated entries makes reopen()-append safe.
+        j = ChunkJournal(out_path)
+        j.begin(dict(jmeta, partial=new_token))
+        for e in entries:
+            j.append(int(e["seq"]), int(e["records"]), int(e["pass"]),
+                     int(e["body_len"]), int(e["crc"]))
+        j.close()
+    except BaseException:
+        if claim:
+            release_token(new_token)  # a failed resume owns nothing
+        raise
     return ResumeState(
         chunks=len(entries), watermark=watermark,
         n_records=sum(int(e["records"]) for e in entries),
         n_pass=sum(int(e["pass"]) for e in entries),
+        partial_token=new_token,
     )
 
 
 def discard(out_path: str) -> None:
-    """Remove journal + partial file (non-resumable failure, or a fresh
-    run superseding stale leftovers)."""
-    for p in (journal_path(out_path), partial_path(out_path)):
+    """Remove journal + its partial file (non-resumable failure, or a
+    fresh run superseding stale leftovers), then sweep abandoned
+    partials of dead runs. The journal is read FIRST so the unique-
+    suffix partial it names is removed with it — but ONLY when no
+    running process/request owns that partial (:func:`token_in_use`): a
+    concurrent live run to the same output keeps its data plane intact
+    and commits last-complete-writer-wins (its journal/resume
+    bookkeeping IS superseded — two journals cannot share one path;
+    bytes are safe, a later resume of the loser degrades to fresh)."""
+    loaded = ChunkJournal.load(out_path)
+    token = loaded[0].get("partial") if loaded else None
+    paths = [journal_path(out_path), partial_path(out_path)]
+    if token and not token_in_use(token):
+        paths.append(partial_path(out_path, token))
+    for p in paths:
         try:
             os.remove(p)
         except OSError:
             pass
+    cleanup_stale_partials(out_path)

@@ -34,6 +34,7 @@ disables the cache).
 
 from __future__ import annotations
 
+import contextvars
 import difflib
 import os
 from dataclasses import dataclass
@@ -196,6 +197,33 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
     _k("VCTPU_SUBPROC_TIMEOUT_S", "int", 3600,
        "timeout for external tool subprocesses (beagle, …) — VCT005: no "
        "subprocess runs unbounded", positive=True),
+    # -- vctpu serve — the resident daemon (docs/serving.md) -----------
+    _k("VCTPU_SERVE_HOST", "str", "127.0.0.1",
+       "vctpu serve bind address (localhost only by design — the daemon "
+       "is a host-local multiplexer, not an internet face)"),
+    _k("VCTPU_SERVE_PORT", "int", 8844,
+       "vctpu serve TCP port (0 = ephemeral, the chosen port lands in "
+       "the --ready-file)", minimum=0),
+    _k("VCTPU_SERVE_SOCKET", "str", "",
+       "vctpu serve Unix-domain socket path (set -> AF_UNIX instead of "
+       "TCP)"),
+    _k("VCTPU_SERVE_MAX_INFLIGHT", "int", 2,
+       "admission control: pipeline requests executing concurrently; "
+       "further admitted requests wait in the bounded queue",
+       positive=True),
+    _k("VCTPU_SERVE_QUEUE_DEPTH", "int", 8,
+       "admission control: requests allowed to WAIT for an execution "
+       "slot; arrivals beyond it are shed with an explicit 503 "
+       "(docs/serving.md admission/shed policy)", minimum=0),
+    _k("VCTPU_SERVE_DEADLINE_S", "float", 300.0,
+       "default per-request deadline in seconds (queue wait + "
+       "execution); the request JSON's deadline_s overrides per "
+       "request; expiry cancels the request at the next chunk boundary "
+       "(0 disables)", minimum=0.0),
+    _k("VCTPU_SERVE_DRAIN_S", "float", 60.0,
+       "graceful-drain budget on SIGTERM/SIGINT: finish in-flight "
+       "requests up to this many seconds while refusing new work, then "
+       "exit", minimum=0.0),
     # -- diagnostics / test harness ------------------------------------
     _k("VCTPU_OBS", "bool", False,
        "record run telemetry (manifest + metrics + event log) to an obs "
@@ -261,6 +289,10 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
     _k("VCTPU_CHAOS", "bool", False,
        "run_tests.sh: run the opt-in chaos smoke stage (tools/chaoshunt, "
        "10 fixed seeds) after tier-0 lint"),
+    _k("VCTPU_LOAD", "bool", False,
+       "run_tests.sh: run the opt-in load×chaos smoke stage "
+       "(tools/loadhunt, 10 fixed seeds against a real vctpu serve "
+       "daemon — docs/serving.md)"),
     _k("VCTPU_PROBE_INTERVAL", "int", 1800,
        "tools/tpu_probe.py polling interval in seconds", positive=True),
     _k("VCTPU_PROBE_HOURS", "float", 11.5,
@@ -269,13 +301,76 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
 )}
 
 
+#: request/thread-scoped override layer (``knobs.scope``): an immutable
+#: mapping of knob name -> raw string (or None == "mask the env: resolve
+#: the declared default"), carried in a contextvar so two concurrent
+#: ``vctpu serve`` requests can never observe each other's settings. The
+#: executor propagates the submitting context into its worker pools
+#: (parallel/pipeline.py), so the scope follows the request's work onto
+#: pooled chunk bodies, stage threads and the mesh dispatch worker.
+_SCOPE: contextvars.ContextVar[dict[str, str | None] | None] = \
+    contextvars.ContextVar("vctpu_knob_scope", default=None)
+
+
+class scope:
+    """Layer raw knob overrides over the process registry for the
+    current execution context (docs/serving.md "Per-request knobs").
+
+    ``overrides`` maps registered knob names to raw strings (parsed by
+    the registry's ONE parse point exactly as env text would be — a
+    malformed value raises ``EngineError`` at the first read) or to
+    ``None`` to mask an env setting back to the declared default.
+    Scopes nest: an inner scope merges over the outer one; leaving a
+    scope restores the previous layer exactly (contextvar token), so a
+    scope can never leak into a sibling request. Unknown names raise
+    ``KeyError`` at entry — a typo'd per-request knob is a per-request
+    configuration error, never a silent no-op."""
+
+    __slots__ = ("overrides", "_token")
+
+    def __init__(self, overrides: dict[str, object] | None = None, **kw):
+        merged: dict[str, object] = dict(overrides or {})
+        merged.update(kw)
+        for name in merged:
+            if name not in REGISTRY:
+                raise KeyError(f"{name} is not a registered VCTPU knob")
+        self.overrides = {
+            name: (None if value is None else str(value))
+            for name, value in merged.items()
+        }
+        self._token = None
+
+    def __enter__(self) -> "scope":
+        base = _SCOPE.get()
+        layered = dict(base) if base else {}
+        layered.update(self.overrides)
+        self._token = _SCOPE.set(layered)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _SCOPE.reset(self._token)
+        self._token = None
+        return False
+
+
+def scoped(name: str) -> bool:
+    """Is ``name`` overridden by the current context's scope layer?"""
+    layer = _SCOPE.get()
+    return layer is not None and name in layer
+
+
 def raw(name: str) -> str | None:
-    """The raw env string (None when unset) — the registry's single
-    ``os.environ`` access point for ``VCTPU_*`` keys. Callers that need
+    """The raw string a knob resolves from (None when unset): the
+    context's scope layer first (``knobs.scope`` — per-request
+    overrides), else the environment. This module is the single
+    ``os.environ`` access point for ``VCTPU_*`` keys; callers that need
     the uninterpreted text (predictor-cache keys) use this instead of
     touching the environment themselves."""
     if name not in REGISTRY:
         raise KeyError(f"{name} is not a registered VCTPU knob")
+    layer = _SCOPE.get()
+    if layer is not None and name in layer:
+        return layer[name]
     return os.environ.get(name)
 
 
@@ -368,7 +463,11 @@ def get_str(name: str) -> str | None:
 
 
 def source(name: str) -> str:
-    """Where the resolved value came from: ``"env"`` or ``"default"``."""
+    """Where the resolved value came from: ``"scope"`` (a
+    ``knobs.scope`` override in the current context), ``"env"`` or
+    ``"default"``."""
+    if scoped(name):
+        return "scope"
     return "env" if raw(name) is not None else "default"
 
 

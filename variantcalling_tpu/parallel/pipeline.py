@@ -39,6 +39,7 @@ numpy, and file I/O, all of which release it.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import queue
 import threading
@@ -175,9 +176,14 @@ class IoPool:
             item = self._q.get()
             if item is None:
                 return
-            fut, fn, args = item
+            fut, ctx, fn, args = item
             try:
-                fut._result = fn(*args)
+                # run in the SUBMITTER's context: request-scoped knob
+                # overrides, scoped faults and cancel tokens
+                # (knobs.scope / faults.scope / utils.cancellation)
+                # follow the request's work onto the pool — the
+                # per-request isolation contract of vctpu serve
+                fut._result = ctx.run(fn, *args)
             # not a swallow: result() re-raises in the consumer
             except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — relayed through the future and re-raised at result()
                 fut._exc = e
@@ -186,7 +192,7 @@ class IoPool:
 
     def submit(self, fn: Callable, *args) -> _IoFuture:
         fut = _IoFuture()
-        self._q.put((fut, fn, args))
+        self._q.put((fut, contextvars.copy_context(), fn, args))
         return fut
 
     def shutdown(self, timeout: float = 5.0) -> None:
@@ -756,14 +762,25 @@ class StagePipeline:
                         return
                     _put(q_out, (seq, out))
 
-                w = threading.Thread(target=_redispatch,
+                w = threading.Thread(target=_in_ctx, args=(_redispatch,),
                                      name=f"pipe-stage{i}-retry", daemon=True)
                 workers.append(w)
                 w.start()
 
-        workers = [threading.Thread(target=_feed, name="pipe-src", daemon=True)]
+        # every worker runs in the CALLER's context (fresh copy per
+        # thread — a Context object is single-threaded): request-scoped
+        # knobs/faults/cancel tokens bound where run() was called follow
+        # the stage bodies, the per-request isolation contract of
+        # vctpu serve (docs/serving.md)
+        run_ctx = contextvars.copy_context()
+
+        def _in_ctx(fn: Callable, *args) -> None:
+            run_ctx.copy().run(fn, *args)
+
+        workers = [threading.Thread(target=_in_ctx, args=(_feed,),
+                                    name="pipe-src", daemon=True)]
         workers += [
-            threading.Thread(target=_stage, args=(i, fn),
+            threading.Thread(target=_in_ctx, args=(_stage, i, fn),
                              name=f"pipe-stage{i}", daemon=True)
             for i, fn in enumerate(self.stages)
         ]

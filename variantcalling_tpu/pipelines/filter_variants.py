@@ -1281,15 +1281,19 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                         engine: engine_mod.EngineDecision | None = None,
                         mesh_plan=None) -> dict:
+    import contextvars
     import threading
     import time as _time
     import zlib
 
+    from variantcalling_tpu.utils import cancellation
     from variantcalling_tpu.utils import faults
     from variantcalling_tpu.io import journal as journal_mod
     from variantcalling_tpu.io.vcf import (VcfChunkReader, assemble_table_bytes,
                                            render_table_bytes_python)
     from variantcalling_tpu.parallel.pipeline import (StagePipeline,
+                                                      resolve_stage_timeout,
+                                                      resolve_threads,
                                                       retry_chunk,
                                                       retry_transient)
 
@@ -1328,8 +1332,14 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # and the join guarantees process exit never kills a .venc write
     # mid-file.
     prefetch_cancel = threading.Event()
-    prefetch = threading.Thread(target=fasta.encode_all, name="genome-prefetch",
-                                kwargs={"cancel": prefetch_cancel}, daemon=True)
+    # the prefetch runs in the CALLER's context (fresh copy — a Context
+    # object is single-threaded) so request-scoped knobs (genome-cache
+    # settings) follow it, like every pooled worker (pipeline.IoPool)
+    _prefetch_ctx = contextvars.copy_context()
+    prefetch = threading.Thread(
+        target=lambda: _prefetch_ctx.run(fasta.encode_all,
+                                         cancel=prefetch_cancel),
+        name="genome-prefetch", daemon=True)
     prefetch.start()
 
     def score_stage(table):
@@ -1449,7 +1459,6 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
 
     out_path = str(args.output_file)
     gz = out_path.endswith(".gz")
-    part_path = journal_mod.partial_path(out_path)
     header_bytes = (b"".join((line + "\n").encode() for line in header.lines)
                     + (header.column_header() + "\n").encode())
 
@@ -1537,7 +1546,9 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 "mesh_devices": ctx.mesh_plan.devices,
             },
         }
-        resume = journal_mod.try_resume(out_path, meta)
+        # claim=True: the re-tokened partial is OURS from the instant it
+        # exists — this writer releases the token on every exit path
+        resume = journal_mod.try_resume(out_path, meta, claim=True)
 
     n_total = n_pass = n_chunks = 0
     q_path = quarantine_path(out_path)
@@ -1550,40 +1561,74 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             os.remove(q_path)
         except OSError:
             pass
-    if gz:
-        journal_mod.discard(out_path)  # stale leftovers from older runs
-        # the compress stage produces finished BGZF blocks; the committer
-        # writes them raw (and rewindably, so transient write errors are
-        # retryable — the old in-consumer BgzfWriter could not rewind)
-        sink = open(part_path, "wb")
-        if obs.active():
-            obs.event("journal", "resume_decision", outcome="disabled",
-                      reason="gz output: BGZF block state does not survive "
-                             "a kill")
-    elif resume is not None:
-        n_chunks = resume.chunks
-        n_total = resume.n_records
-        n_pass = resume.n_pass
-        reader.skip(resume.chunks)
-        sink = open(part_path, "ab")  # truncated to the watermark already
-        journal = journal_mod.ChunkJournal(out_path)
-        journal.reopen()
-        logger.info("streaming resume: %d chunks (%d records) already committed",
-                    resume.chunks, resume.n_records)
-        if obs.active():
-            obs.event("journal", "resume_decision", outcome="resumed",
-                      chunks=resume.chunks, records=resume.n_records,
-                      watermark=resume.watermark)
-    else:
-        journal_mod.discard(out_path)
-        sink = open(part_path, "wb")
-        if resume_enabled:
+    # the partial path carries a UNIQUE per-run suffix (pid + random,
+    # recorded in the journal header so resume finds it): two concurrent
+    # runs targeting the same output accumulate independent partials and
+    # the atomic os.replace commit makes the destination last-complete-
+    # writer-wins — the old fixed <out>.partial let them silently
+    # clobber each other's bytes. A resumed run reopens the token its
+    # journal recorded; abandoned partials are swept by
+    # journal_mod.discard's cleanup. The token is CLAIMED before the
+    # file exists (a concurrent run's discard/sweep must always see it
+    # as in use — io/journal.token_in_use, the serve same-process
+    # concurrency case) and every raise between the claim and the main
+    # try/finally below releases it: a long-lived daemon must not
+    # accrete phantom claims from failed sink opens. The main body's
+    # teardown/commit paths own the release from there on. The
+    # remaining fallible setup (executor-knob parses, input stat) runs
+    # BEFORE the claim for the same reason.
+    resolve_threads()
+    resolve_stage_timeout()
+    input_bytes = os.path.getsize(args.input_file)
+    part_token = None
+    try:
+        if gz:
+            journal_mod.discard(out_path)  # stale leftovers of older runs
+            part_token = journal_mod.new_partial_token()
+            journal_mod.claim_token(part_token)
+            part_path = journal_mod.partial_path(out_path, part_token)
+            # the compress stage produces finished BGZF blocks; the
+            # committer writes them raw (and rewindably, so transient
+            # write errors are retryable — the old in-consumer
+            # BgzfWriter could not rewind)
+            sink = open(part_path, "wb")
+            if obs.active():
+                obs.event("journal", "resume_decision", outcome="disabled",
+                          reason="gz output: BGZF block state does not "
+                                 "survive a kill")
+        elif resume is not None:
+            n_chunks = resume.chunks
+            n_total = resume.n_records
+            n_pass = resume.n_pass
+            part_token = resume.partial_token  # re-tokened + claimed by try_resume
+            part_path = journal_mod.partial_path(out_path, part_token)
+            reader.skip(resume.chunks)
+            sink = open(part_path, "ab")  # truncated to the watermark already
             journal = journal_mod.ChunkJournal(out_path)
-            journal.begin(meta)
-        if obs.active():
-            obs.event("journal", "resume_decision",
-                      outcome="fresh" if resume_enabled else "opted_out",
-                      journaling=resume_enabled)
+            journal.reopen()
+            logger.info("streaming resume: %d chunks (%d records) already "
+                        "committed", resume.chunks, resume.n_records)
+            if obs.active():
+                obs.event("journal", "resume_decision", outcome="resumed",
+                          chunks=resume.chunks, records=resume.n_records,
+                          watermark=resume.watermark)
+        else:
+            journal_mod.discard(out_path)
+            part_token = journal_mod.new_partial_token()
+            journal_mod.claim_token(part_token)
+            part_path = journal_mod.partial_path(out_path, part_token)
+            sink = open(part_path, "wb")
+            if resume_enabled:
+                journal = journal_mod.ChunkJournal(out_path)
+                journal.begin(dict(meta, partial=part_token))
+            if obs.active():
+                obs.event("journal", "resume_decision",
+                          outcome="fresh" if resume_enabled else "opted_out",
+                          journaling=resume_enabled)
+    except BaseException:
+        if part_token is not None:
+            journal_mod.release_token(part_token)
+        raise
 
     wb = prof.stage("writeback") if prof is not None else None
     # the parallel layout (VCTPU_IO_THREADS > 1): scoring AND record
@@ -1722,7 +1767,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # PLAIN-TEXT inputs: a .gz reader consumes chunk_bytes of
     # decompressed text while getsize() is compressed, so gz runs emit
     # heartbeats without pct/eta rather than a clamped-to-100 lie.
-    input_bytes = os.path.getsize(args.input_file)
+    # (input_bytes was stat'ed above, before the token claim.)
     bytes_comparable = not args.input_file.endswith(".gz")
     resumed_chunks = n_chunks
     resumed_records = n_total
@@ -1742,6 +1787,13 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 else:
                     _sink_write(sink, header_bytes)
             for body, k, p, qbody, trace_id in gen:
+                # cooperative per-request cancellation (vctpu serve
+                # deadlines/drain, docs/serving.md): chunk-granular by
+                # design — raising here unwinds through the normal
+                # failure teardown (workers joined, journal+partial
+                # kept for resume), never a torn commit. One contextvar
+                # read per chunk outside a serve request.
+                cancellation.check("streaming filter run")
                 if qbody:
                     # quarantined chunk: its ORIGINAL records append to
                     # the sidecar (plain text, never compressed) and the
@@ -1834,6 +1886,10 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         if journal is not None:
             journal.close()
         if not ok:
+            # failure exit: the partial (if kept) now awaits a RESUME —
+            # release the claim so the resumer (or a superseding fresh
+            # run's discard) may take the file over
+            journal_mod.release_token(part_token)
             if journal is None:
                 # non-resumable run: never leave droppings next to the
                 # destination (the destination itself was never touched)
@@ -1862,6 +1918,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     try:
         retry_transient(_commit, "output commit")
     except BaseException:
+        journal_mod.release_token(part_token)
         if journal is None:
             # non-resumable run: never leave droppings at the destination
             try:
@@ -1875,6 +1932,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             if obs.active():
                 obs.event("journal", "kept_for_resume", chunks=n_chunks)
         raise
+    journal_mod.release_token(part_token)  # committed: the partial is gone
     if journal is not None:
         journal.finish()
     if obs.active():
@@ -1945,8 +2003,6 @@ def run(argv: list[str]) -> int:
 
 
 def _run_impl(args) -> int:
-    from variantcalling_tpu.utils.trace import report, stage
-
     # resolve the scoring engine ONCE, up front (engine contract,
     # docs/robustness.md): an explicitly required native engine that
     # cannot build/load fails the run HERE with a clear message — never a
@@ -1963,7 +2019,20 @@ def _run_impl(args) -> int:
     fasta = FastaReader(args.reference_file)
     annotate = {_interval_name(p): bedio.read_intervals(p) for p in args.annotate_intervals}
     blacklist = read_blacklist(args.blacklist) if args.blacklist else None
+    return run_loaded(args, model, fasta, annotate, blacklist, engine=eng)
 
+
+def run_loaded(args, model, fasta: FastaReader, annotate, blacklist,
+               engine: engine_mod.EngineDecision | None = None) -> int:
+    """The filter pipeline over ALREADY-LOADED resources — the split
+    that lets ``vctpu serve`` (docs/serving.md) run requests against its
+    resident model/genome caches without re-paying the load, while the
+    cold CLI (:func:`_run_impl`) rides the same code so serve output is
+    byte-identical to the batch path by construction."""
+    from variantcalling_tpu.utils import cancellation
+    from variantcalling_tpu.utils.trace import report, stage
+
+    eng = engine if engine is not None else engine_mod.resolve_for_run()
     # streaming executor first: overlapped ingest/score/writeback with
     # byte-identical output; falls through to the serial path when
     # ineligible (VCTPU_THREADS=1, multi-process, region-limited, no
@@ -1987,6 +2056,9 @@ def _run_impl(args) -> int:
     logger.info("reading %s", args.input_file)
     with stage("ingest"):
         table = read_vcf(args.input_file)
+    # serial path: cancellation polls at stage boundaries (the
+    # streaming path polls per chunk)
+    cancellation.check("filter run")
     if args.limit_to_contig:
         keep = np.asarray(table.chrom) == args.limit_to_contig
         table = _subset(table, keep)
@@ -2050,6 +2122,7 @@ def _run_impl(args) -> int:
                         jax.process_index(), n_proc)
             return 0
 
+    cancellation.check("filter run")
     _ensure_output_header(table.header, engine=ctx.engine,
                           strategy=ctx.forest_strategy,
                           mesh_plan=ctx.mesh_plan)

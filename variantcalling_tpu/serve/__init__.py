@@ -1,0 +1,39 @@
+"""``vctpu serve`` — the fault-isolated resident daemon (docs/serving.md).
+
+Every CLI run today pays the same cold tax: interpreter + jax import,
+XLA compiles, ``.venc`` genome encode, model unpickle, forest/predictor
+build. The daemon pays them ONCE and multiplexes filter/score/coverage
+requests onto the hardened streaming executor over localhost HTTP or a
+Unix socket (stdlib only — no new dependencies), which is what the
+north star's "heavy traffic from millions of users" needs from a single
+host: the serving tier in front of the scoring core.
+
+The robustness core is the headline, not the transport:
+
+- **per-request fault isolation** — each request executes under its own
+  :func:`knobs.scope` (typed per-request knob overrides that can never
+  leak across concurrent requests), its own :func:`faults.scope`
+  (request-scoped injection for the loadhunt harness), its own
+  cancellation token, and its own recovery-ladder budget (chunk retry,
+  watchdog re-dispatch, OOM shrink→dp=1 degrade, quarantine — all
+  per-run state already). A poisoned request returns a distinct
+  per-request error; the daemon and concurrent requests are untouched.
+- **admission control + load shedding** — a bounded admission queue
+  (``VCTPU_SERVE_MAX_INFLIGHT`` executing, ``VCTPU_SERVE_QUEUE_DEPTH``
+  waiting) with explicit 503 shed responses when full, an SLO-aware
+  early shed fed by the PR 11 rolling latency histograms, per-request
+  deadlines with chunk-granular cancellation, and graceful SIGTERM
+  drain (finish in-flight, refuse new, flush obs with status
+  ``drain``).
+- **observability** — one obs run spans the daemon's lifetime;
+  request_start/request_end events, per-endpoint rolling-quantile
+  histograms and shed/accepted/failed counters ride the existing
+  metrics plane, so ``vctpu obs prom`` / ``VCTPU_OBS_PROM_FILE`` cover
+  the daemon unchanged.
+
+``tools/loadhunt`` is the closed-loop gate: seeded campaigns of
+concurrent clients × fault schedules × SLO invariants prove "survives
+heavy traffic" the way chaoshunt proves "survives faults".
+"""
+
+from variantcalling_tpu.serve.daemon import Server  # noqa: F401

@@ -1,0 +1,91 @@
+"""Cooperative request cancellation for long-running pipeline bodies.
+
+``vctpu serve`` gives every request a deadline (docs/serving.md); a
+request that blows it must stop consuming the daemon's cores — but a
+streaming run cannot be killed from outside without tearing its
+journal/partial protocol. The contract here is cooperative and chunk-
+granular: the serve layer binds a :class:`CancelToken` to the request's
+execution context, a deadline reaper (or a disconnect detector) trips
+the token from any thread, and the streaming commit loop polls
+:func:`check` once per chunk — the run then unwinds through its normal
+failure teardown (workers joined, partial+journal kept for resume or
+discarded), exactly as if a chunk had failed.
+
+The token rides a ``contextvars.ContextVar`` so concurrent requests can
+never trip each other, and the executor's context propagation
+(parallel/pipeline.py) carries it onto pooled workers. Checking is one
+contextvar read when no scope is bound — cheap enough for per-chunk
+cadence, invisible to CLI runs (no scope, no cost).
+
+This module is deliberately free of serve imports so the pipelines can
+poll it without a dependency cycle.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+
+_TOKEN: contextvars.ContextVar["CancelToken | None"] = \
+    contextvars.ContextVar("vctpu_cancel_token", default=None)
+
+
+class CancelledError(RuntimeError):
+    """The bound scope's work was cancelled (deadline expiry, client
+    disconnect, daemon drain timeout). Deliberately NOT an
+    ``EngineError``: cancellation is a per-request outcome, not a
+    configuration error."""
+
+
+class CancelToken:
+    """One cancellable unit of work (a serve request). ``cancel`` may be
+    called from any thread, any number of times; the first reason wins."""
+
+    __slots__ = ("_event", "reason")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: str = ""
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+
+class scope:
+    """Bind ``token`` to the current execution context (restores the
+    previous binding on exit, so nested/sibling scopes stay correct)."""
+
+    __slots__ = ("token", "_cv_token")
+
+    def __init__(self, token: CancelToken):
+        self.token = token
+        self._cv_token = None
+
+    def __enter__(self) -> CancelToken:
+        self._cv_token = _TOKEN.set(self.token)
+        return self.token
+
+    def __exit__(self, *exc) -> bool:
+        _TOKEN.reset(self._cv_token)
+        self._cv_token = None
+        return False
+
+
+def current() -> CancelToken | None:
+    """The context's bound token (None outside any scope)."""
+    return _TOKEN.get()
+
+
+def check(what: str = "run") -> None:
+    """Raise :class:`CancelledError` when the context's token (if any)
+    has been tripped — the ONE polling point pipeline loops call."""
+    token = _TOKEN.get()
+    if token is not None and token.cancelled:
+        raise CancelledError(
+            f"{what} cancelled: {token.reason or 'cancelled'}")

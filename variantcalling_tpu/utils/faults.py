@@ -41,6 +41,7 @@ APIs.
 
 from __future__ import annotations
 
+import contextvars
 import errno
 import threading
 
@@ -103,13 +104,31 @@ POINTS: dict[str, tuple[str, object]] = {
 
 _LOCK = threading.Lock()
 _ARMED: dict[str, "_Fault"] = {}
-_HANG_CANCEL = threading.Event()
 #: fast-path flag — hot sites check this before taking the lock
 _ACTIVE = False
 
+#: open scope layers (below), registered so :func:`cancel_hangs` can
+#: release scoped hangs too — the watchdog recovering one request must
+#: be able to cancel that request's injected hang
+_OPEN_SCOPES: list[dict] = []
+
+#: request-scoped fault layer (``faults.scope`` — the ``vctpu serve``
+#: per-request poison channel): a dict of armed faults carried in a
+#: contextvar, consulted BEFORE the process-global ``_ARMED`` table so
+#: one request's injected failure can never fire inside a concurrent
+#: request's body. The executor propagates the submitting context into
+#: its worker pools (parallel/pipeline.py), so the scope follows the
+#: request's chunks. Firing state is shared across the scope's threads
+#: (one dict object), mutated under ``_LOCK`` like the global table.
+_SCOPE_ARMED: contextvars.ContextVar[dict[str, "_Fault"] | None] = \
+    contextvars.ContextVar("vctpu_fault_scope", default=None)
+#: count of OPEN fault scopes — keeps the ``_ACTIVE`` fast path honest
+#: (a scoped fault must fire even when the global table is empty)
+_N_SCOPES = 0
+
 
 class _Fault:
-    __slots__ = ("point", "times", "seconds", "after", "fired")
+    __slots__ = ("point", "times", "seconds", "after", "fired", "cancel")
 
     def __init__(self, point: str, times: int | None, seconds: float | None,
                  after: int = 0):
@@ -118,6 +137,11 @@ class _Fault:
         self.seconds = seconds
         self.after = after  # free passes before the first firing
         self.fired = 0
+        #: PER-FAULT hang release (was one process-global latch): a
+        #: newly armed hang always hangs (fresh Event — nothing to
+        #: clear), and releasing one run's hangs cannot be undone by a
+        #: concurrent request arming its own scope
+        self.cancel = threading.Event()
 
     def _take(self) -> bool:
         """Consume one firing; False once the budget is spent."""
@@ -132,7 +156,7 @@ class _Fault:
 
 def _refresh_active() -> None:
     global _ACTIVE
-    _ACTIVE = bool(_ARMED)
+    _ACTIVE = bool(_ARMED) or _N_SCOPES > 0
 
 
 def arm(point: str, times: int | None = 1, seconds: float | None = None,
@@ -149,9 +173,6 @@ def arm(point: str, times: int | None = 1, seconds: float | None = None,
     with _LOCK:
         _ARMED[point] = _Fault(point, times, seconds, after=after)
         _refresh_active()
-    # a newly armed hang must actually hang: clear any cancel latch left
-    # behind by a previous pipeline teardown
-    _HANG_CANCEL.clear()
 
 
 def disarm(point: str) -> None:
@@ -161,11 +182,11 @@ def disarm(point: str) -> None:
 
 
 def reset() -> None:
-    """Disarm everything and clear the hang-cancel latch (test teardown)."""
+    """Disarm everything (test teardown). Per-fault cancel events die
+    with their faults, so there is no latch to clear."""
     with _LOCK:
         _ARMED.clear()
         _refresh_active()
-    _HANG_CANCEL.clear()
 
 
 def fired(point: str) -> int:
@@ -176,12 +197,16 @@ def fired(point: str) -> int:
 
 
 def cancel_hangs() -> None:
-    """Release every in-flight injected hang (watchdog/teardown path).
-
-    Hangs armed AFTER this call wait normally again once :func:`reset`
-    clears the latch.
-    """
-    _HANG_CANCEL.set()
+    """Release every in-flight injected hang (watchdog/teardown path) —
+    process-global faults AND every open scope's (the watchdog serving
+    a request must release that request's scoped hang). Per-fault
+    events: a hang ARMED after this call waits normally (its Event is
+    fresh), so no latch-clearing is needed anywhere."""
+    with _LOCK:
+        targets = list(_ARMED.values()) + [
+            f for layer in _OPEN_SCOPES for f in layer.values()]
+    for f in targets:
+        f.cancel.set()
 
 
 def _record_firing(point: str, style: str, seconds: float | None = None) -> None:
@@ -198,6 +223,16 @@ def _record_firing(point: str, style: str, seconds: float | None = None) -> None
         obs.counter("faults.fired").add(1)
 
 
+def _armed_fault(point: str) -> "_Fault | None":
+    """The fault governing ``point`` in this context: the scope layer
+    wins (a per-request poison must not also consume the global table's
+    budget), else the process-global table. Callers hold ``_LOCK``."""
+    layer = _SCOPE_ARMED.get()
+    if layer is not None and point in layer:
+        return layer[point]
+    return _ARMED.get(point)
+
+
 def should_fire(point: str) -> bool:
     """Availability-style query: does ``point`` fire now? (no raise/sleep).
 
@@ -206,7 +241,7 @@ def should_fire(point: str) -> bool:
     if not _ACTIVE:
         return False
     with _LOCK:
-        f = _ARMED.get(point)
+        f = _armed_fault(point)
         fire = f is not None and f._take()
     if fire:
         _record_firing(point, "availability")
@@ -219,7 +254,7 @@ def check(point: str) -> None:
     if not _ACTIVE:
         return
     with _LOCK:
-        f = _ARMED.get(point)
+        f = _armed_fault(point)
         if f is None or not f._take():
             return
         seconds = f.seconds
@@ -228,8 +263,9 @@ def check(point: str) -> None:
                    seconds=seconds)
     if seconds is not None:
         # cancellable: a watchdog that aborts the run can release us so
-        # the owning thread still joins
-        _HANG_CANCEL.wait(seconds)
+        # the owning thread still joins (per-fault event — releasing
+        # this hang cannot affect a concurrent scope's faults)
+        f.cancel.wait(seconds)
         if exc_factory is None:
             return
     if exc_factory is None:
@@ -237,15 +273,13 @@ def check(point: str) -> None:
     raise exc_factory()
 
 
-def _arm_from_env() -> None:
-    """Parse ``VCTPU_FAULTS`` (see module docstring) — once at import, so
-    subprocess-based tests can arm faults without touching test APIs."""
-    from variantcalling_tpu import knobs
-
-    spec = (knobs.get_str("VCTPU_FAULTS") or "").strip()
-    if not spec:
-        return
-    for item in spec.split(","):
+def parse_spec(spec: str) -> list[tuple[str, int | None, float | None, int]]:
+    """Parse a ``VCTPU_FAULTS``-grammar string into a list of
+    ``(point, times, seconds, after)`` tuples (module docstring for the
+    grammar). Unknown points are dropped, matching the env path's
+    tolerance — subprocess harnesses arm against old/new trees alike."""
+    out: list[tuple[str, int | None, float | None, int]] = []
+    for item in (spec or "").split(","):
         item = item.strip()
         if not item:
             continue
@@ -276,7 +310,62 @@ def _arm_from_env() -> None:
         if item == "native.build" and not explicit_times:
             times = None  # an unavailable engine stays unavailable
         if item in POINTS:
-            arm(item, times=times, seconds=seconds, after=after)
+            out.append((item, times, seconds, after))
+    return out
+
+
+class scope:
+    """Context-scoped fault arming (the ``vctpu serve`` per-request
+    poison channel): the given ``VCTPU_FAULTS``-grammar spec is armed
+    for the current execution context only — :func:`check` inside the
+    scope fires these faults; concurrent contexts (other requests) see
+    only their own scopes and the process-global table. An empty spec
+    is a no-op scope, so callers need not branch."""
+
+    __slots__ = ("spec", "_token", "_layer")
+
+    def __init__(self, spec: str):
+        self.spec = spec or ""
+        self._token = None
+        self._layer: dict | None = None
+
+    def __enter__(self) -> "scope":
+        global _N_SCOPES
+        parsed = parse_spec(self.spec)
+        if not parsed:
+            return self
+        self._layer = {point: _Fault(point, times, seconds, after=after)
+                       for point, times, seconds, after in parsed}
+        with _LOCK:
+            self._token = _SCOPE_ARMED.set(self._layer)
+            _OPEN_SCOPES.append(self._layer)
+            _N_SCOPES += 1
+            _refresh_active()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        global _N_SCOPES
+        if self._token is not None:
+            with _LOCK:
+                _SCOPE_ARMED.reset(self._token)
+                self._token = None
+                try:
+                    _OPEN_SCOPES.remove(self._layer)
+                except ValueError:  # pragma: no cover — enter/exit paired
+                    pass
+                _N_SCOPES -= 1
+                _refresh_active()
+        return False
+
+
+def _arm_from_env() -> None:
+    """Parse ``VCTPU_FAULTS`` (see module docstring) — once at import, so
+    subprocess-based tests can arm faults without touching test APIs."""
+    from variantcalling_tpu import knobs
+
+    spec = (knobs.get_str("VCTPU_FAULTS") or "").strip()
+    for point, times, seconds, after in parse_spec(spec):
+        arm(point, times=times, seconds=seconds, after=after)
 
 
 _arm_from_env()
