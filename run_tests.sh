@@ -64,6 +64,22 @@ if [ "${VCTPU_LOAD:-0}" != "0" ]; then
   }
 fi
 
+# -- opt-in simulated multi-host stage (docs/scaleout.md) ------------------
+# VCTPU_SCALEOUT=1: the 2-process local-launcher pipeline end-to-end on
+# the cpu backend (tools/podrun spawns rank workers with VCTPU_RANK set,
+# byte parity vs the single-rank run, SIGKILL-one-rank resume), plus the
+# jax.distributed system tests — the PR 5 collectives capability probe
+# turns their skips into real runs on jaxlib builds that support
+# multi-process CPU collectives. Bounded (~2 min).
+if [ "${VCTPU_SCALEOUT:-0}" != "0" ]; then
+  echo "scaleout stage: pytest tests/system/test_scaleout.py tests/system/test_multihost.py"
+  env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m pytest tests/system/test_scaleout.py tests/system/test_multihost.py -q -p no:cacheprovider || {
+    echo "scaleout stage failed — the rank-partitioned path is broken" >&2
+    exit 1
+  }
+fi
+
 # -- tier-0 jaxpr audit stage (docs/static_analysis.md) --------------------
 # Trace every registered scoring program (forest strategies x
 # shard_program at dp in {1,2} + the coverage reduce kernels) with

@@ -308,10 +308,17 @@ def test_two_rank_filter_variants_pipeline_cli(tmp_path, multiprocess_collective
 
     a = open(f"{d}/out_shared.vcf", "rb").read()
     assert a.count(b"TREE_SCORE=") == 6000
-    # exactly one rank wrote; the other delegated (no shared-FS write race)
-    assert sum("delegated to rank 0" in log for log in rank_logs) == 1
+    # exactly one rank committed the shared path: either the serial
+    # allgather path's writeback delegation, or — when the ranks took
+    # the rank-partitioned streaming path (docs/scaleout.md) — rank 0's
+    # rank-sequenced merge after the completion barrier
+    assert sum("delegated to rank 0" in log
+               or "commit delegated to rank 0" in log
+               for log in rank_logs) == 1
 
-    # single-process run must produce the same bytes
+    # single-process run must produce the same bytes modulo the
+    # ##vctpu_* provenance headers (a 2-rank run records
+    # ##vctpu_ranks=n=2; a single-rank run records no such line)
     env1 = dict(env_base)
     for k in ("VCTPU_COORDINATOR", "VCTPU_NUM_PROCESSES"):
         env1.pop(k, None)
@@ -323,4 +330,7 @@ def test_two_rank_filter_variants_pipeline_cli(tmp_path, multiprocess_collective
          "--output_file", f"{d}/out_single.vcf"],
         env=env1, cwd=_REPO, capture_output=True, text=True, timeout=300)
     assert p1.returncode == 0, p1.stderr[-2000:]
-    assert open(f"{d}/out_single.vcf", "rb").read() == a
+
+    from tools.chaoshunt.harness import normalize_output as norm
+
+    assert norm(open(f"{d}/out_single.vcf", "rb").read()) == norm(a)

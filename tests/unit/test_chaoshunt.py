@@ -183,6 +183,54 @@ def test_kill_leg_rejects_torn_destination_accepts_complete(tmp_path):
     assert v == []
 
 
+def test_rank_kill_schedules_drawn_and_round_trip():
+    """The pod fault class (docs/scaleout.md): some seeds draw it, the
+    schedule serializes/round-trips, and its describe() names the rank."""
+    drawn = [harness.draw_schedule(s) for s in range(40)]
+    pods = [s for s in drawn if s.rank_kill is not None]
+    assert pods, "no rank_kill schedule drawn in 40 seeds"
+    sched = pods[0]
+    assert sched.rank_kill["ranks"] == 2
+    assert sched.rank_kill["kill_rank"] in (0, 1)
+    assert "rank_kill" in sched.describe()
+    again = harness.Schedule.from_json(json.loads(json.dumps(
+        sched.to_json())))
+    assert again.to_json() == sched.to_json()
+    # the shrinker can degrade a pod schedule to the ordinary flow
+    assert any(c.rank_kill is None for c in harness._simplifications(sched))
+
+
+def test_check_pod_leg_invariants(tmp_path):
+    out = str(tmp_path / "o.vcf")
+    fx = _fx(tmp_path)
+
+    def pod_leg(**kw):
+        leg = {"rc": 0, "killed": False, "out_exists": True,
+               "stdout": "", "segments": [False, False]}
+        leg.update(kw)
+        return leg
+
+    # clean pod: reference bytes + swept segments
+    open(out, "wb").write(b"##h\nrec\n")
+    assert harness._check_pod_leg(pod_leg(), fx, out, "fresh") == []
+    v = harness._check_pod_leg(pod_leg(segments=[True, False]), fx, out,
+                               "fresh")
+    assert any("segments" in m for m in v)
+    # killed pod: the launcher's DISTINCT code, destination untouched
+    os.remove(out)
+    assert harness._check_pod_leg(
+        pod_leg(rc=3, killed=True, out_exists=False,
+                segments=[True, False]), fx, out, "fresh") == []
+    v = harness._check_pod_leg(
+        pod_leg(rc=1, killed=True, out_exists=False), fx, out, "fresh")
+    assert any("distinct" in m for m in v)
+    # killed pod leaving TORN destination bytes is the violation
+    open(out, "wb").write(b"half-a")
+    v = harness._check_pod_leg(
+        pod_leg(rc=3, killed=True, out_exists=True), fx, out, "fresh")
+    assert any("not a complete output" in m for m in v)
+
+
 # ---------------------------------------------------------------------------
 # CLI contract
 # ---------------------------------------------------------------------------
@@ -209,6 +257,8 @@ def _pick_seed(layout="serial", max_faults=1, no_kill=True) -> int:
             continue
         if no_kill and s.kill_after_chunks is not None:
             continue
+        if s.rank_kill is not None:
+            continue  # pod schedules spawn 3 processes: own e2e below
         if any(f.seconds and f.seconds > 1 for f in s.faults):
             continue  # long-hang schedules cost wall time
         return seed

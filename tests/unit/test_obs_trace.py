@@ -382,6 +382,64 @@ def test_diff_tolerates_in_flight_candidate(tmp_path):
     assert rc == 0  # identical logs: no regression, no stack trace
 
 
+def test_critical_path_keeps_rank_dags_apart(tmp_path):
+    """Multi-rank merged timelines (docs/scaleout.md): every rank's
+    writer allocated its own t<N>/s<N> id sequences, so the merged
+    reader must scope ids per rank — otherwise two ranks' chunk DAGs
+    silently fuse into nonsense paths. One chunk per rank, IDENTICAL
+    bare ids, different geometry: the roll-up must see two independent
+    chunks with each rank's own latency."""
+
+    def rank_log(path, score_dur):
+        with open(path, "w", encoding="utf-8") as fh:
+            evs = [
+                _env(0, 0.0, "manifest", "m", tool="m", version="0",
+                     knobs={}, topology={}),
+                _env(1, 0.01, "trace", "ingest", trace_id="t0",
+                     span_id="s0", dur=0.01),
+                _env(2, 0.01 + score_dur, "trace", "score_stage",
+                     trace_id="t0", span_id="s1", dur=score_dur,
+                     parents=["s0"]),
+                _env(3, 0.02 + score_dur, "trace", "writeback",
+                     trace_id="t0", span_id="s2", dur=0.01,
+                     parents=["s1"]),
+                _env(4, 1.0, "run_end", "m", status="ok", dur=1.0),
+            ]
+            for e in evs:
+                fh.write(json.dumps(e) + "\n")
+
+    base = str(tmp_path / "pod.obs.jsonl")
+    rank_log(base, 0.10)
+    rank_log(base + ".rank1", 0.50)
+    events = export_mod.read_run(base)
+    assert any(e.get("rank") == 1 for e in events)
+    cp = critical_mod.critical_path(events)
+    # two chunks, NOT one fused DAG of colliding ids
+    assert cp["chunks"] == 2
+    paths = {p["trace"]: p for p in critical_mod.chunk_paths(events)}
+    assert set(paths) == {"r0:t0", "r1:t0"}
+    assert paths["r0:t0"]["latency_s"] == pytest.approx(0.02 + 0.10,
+                                                        abs=1e-6)
+    assert paths["r1:t0"]["latency_s"] == pytest.approx(0.02 + 0.50,
+                                                        abs=1e-6)
+    # every path stays within its rank: 3 edges' worth of spans each
+    for p in paths.values():
+        assert [e["edge"] for e in p["edges"]] == [
+            "ingest.work", "score_stage.wait", "score_stage.work",
+            "writeback.wait", "writeback.work"]
+    # the Perfetto exporter draws flow arrows within ranks only: one
+    # arrow pair per parent link per rank (4 links total)
+    trace = export_mod.to_chrome_trace(events)
+    flows = [e for e in trace["traceEvents"]
+             if e.get("cat") == "trace.flow"]
+    assert len(flows) == 2 * 4
+    # a flow's start and finish share one pid (arrows never cross ranks)
+    pids_by_id: dict = {}
+    for f in flows:
+        pids_by_id.setdefault(f["id"], set()).add(f["pid"])
+    assert all(len(p) == 1 for p in pids_by_id.values())
+
+
 # ---------------------------------------------------------------------------
 # log size cap + segment rotation
 # ---------------------------------------------------------------------------

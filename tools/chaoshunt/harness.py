@@ -93,12 +93,17 @@ class FaultSpec:
 
 @dataclasses.dataclass
 class Schedule:
-    """One drawn chaos schedule: layout x faults x optional SIGKILL."""
+    """One drawn chaos schedule: layout x faults x optional SIGKILL
+    (single-process), or a ``rank_kill`` pod schedule — SIGKILL one
+    worker rank of a 2-rank local-launcher run mid-stream
+    (docs/scaleout.md failure semantics)."""
 
     seed: int
     layout: str  # serial | io4 | mesh2
     faults: list[FaultSpec] = dataclasses.field(default_factory=list)
     kill_after_chunks: int | None = None  # SIGKILL once N chunks journaled
+    #: pod fault class: {"ranks": N, "kill_rank": r, "after_chunks": k}
+    rank_kill: dict | None = None
 
     def faults_env(self) -> str:
         return ",".join(f.spec() for f in self.faults)
@@ -106,7 +111,8 @@ class Schedule:
     def to_json(self) -> dict:
         return {"seed": self.seed, "layout": self.layout,
                 "faults": [f.to_json() for f in self.faults],
-                "kill_after_chunks": self.kill_after_chunks}
+                "kill_after_chunks": self.kill_after_chunks,
+                "rank_kill": self.rank_kill}
 
     @staticmethod
     def from_json(d: dict) -> "Schedule":
@@ -114,7 +120,8 @@ class Schedule:
                         layout=d.get("layout", "serial"),
                         faults=[FaultSpec.from_json(f)
                                 for f in d.get("faults", [])],
-                        kill_after_chunks=d.get("kill_after_chunks"))
+                        kill_after_chunks=d.get("kill_after_chunks"),
+                        rank_kill=d.get("rank_kill"))
 
     def describe(self) -> str:
         parts = [self.layout]
@@ -122,6 +129,10 @@ class Schedule:
             parts.append(self.faults_env())
         if self.kill_after_chunks is not None:
             parts.append(f"SIGKILL@{self.kill_after_chunks}ch")
+        if self.rank_kill is not None:
+            parts.append(f"rank_kill r{self.rank_kill['kill_rank']}"
+                         f"/{self.rank_kill['ranks']}"
+                         f"@{self.rank_kill['after_chunks']}ch")
         return " ".join(parts)
 
 
@@ -133,12 +144,25 @@ def draw_schedule(seed: int) -> Schedule:
     commit-ENOSPC, or a SIGKILL-at-random-progress leg."""
     rng = random.Random(seed)
     layout = LAYOUTS[seed % len(LAYOUTS)]
-    modes = ["transient", "persistent", "hang", "kill", "commit", "mixed"]
+    modes = ["transient", "persistent", "hang", "kill", "commit", "mixed",
+             "rank_kill"]
     if layout == "mesh2":
         modes.append("oom")
     mode = rng.choice(modes)
     faults: list[FaultSpec] = []
     kill = None
+    rank_kill = None
+    if mode == "rank_kill":
+        # pod fault class (docs/scaleout.md): a 2-rank local-launcher
+        # run; one worker rank is SIGKILLed once its SEGMENT journal
+        # shows progress. A persistent per-chunk delay keeps every rank
+        # mid-stream long enough for the kill to land mid-run.
+        rank_kill = {"ranks": 2, "kill_rank": rng.randint(0, 1),
+                     "after_chunks": rng.randint(1, 2)}
+        faults.append(FaultSpec("pipeline.stage_hang", times=None,
+                                seconds=0.05))
+        return Schedule(seed=seed, layout=layout, faults=faults,
+                        rank_kill=rank_kill)
     if mode == "transient":
         for _ in range(rng.randint(1, 2)):
             faults.append(FaultSpec(rng.choice(TRANSIENT_POINTS),
@@ -197,17 +221,17 @@ class Fixtures:
     reference_norm: bytes  # normalized clean-run output bytes
 
 
-_PROVENANCE_PREFIXES = (b"##vctpu_engine=", b"##vctpu_forest_strategy=",
-                        b"##vctpu_mesh=", b"##vctpu_knobs=")
-
-
 def normalize_output(data: bytes) -> bytes:
-    """Strip the provenance header lines that legitimately differ across
-    engine/strategy/mesh layouts — record bytes are identical by the
-    byte-parity contract, so these lines are the ONLY tolerated delta."""
+    """Strip the ``##vctpu_*`` provenance header lines that legitimately
+    differ across engine/strategy/mesh/rank layouts — record bytes are
+    identical by the byte-parity contract, so these lines are the ONLY
+    tolerated delta. The ONE normalization spelling (prefix, not an
+    enumerated list — a NEW provenance line must never silently diverge
+    the comparators), shared by loadhunt, the bench ``scaleout`` digest
+    legs and the scale-out test suites."""
     return b"\n".join(
         ln for ln in data.split(b"\n")
-        if not ln.startswith(_PROVENANCE_PREFIXES))
+        if not ln.startswith(b"##vctpu_"))
 
 
 def _layout_env(layout: str) -> dict:
@@ -385,12 +409,18 @@ def _check_leg(leg: dict, fx: Fixtures, out: str, name: str,
 
 def _remove_run_files(out: str, extra: tuple[str, ...] = ()) -> None:
     """Sweep one leg's output + sidecars, including every unique-suffix
-    partial (``<out>.partial.<pid>-<hex>``, ISSUE 14)."""
+    partial (``<out>.partial.<pid>-<hex>``, ISSUE 14) and — for pod
+    legs — the rank segments, their journals/markers, worker logs and
+    the launcher state file (docs/scaleout.md)."""
+    import glob
+
     from variantcalling_tpu.io import journal as journal_mod
 
-    targets = [out, out + ".journal", out + ".quarantine"]
+    targets = [out, out + ".journal", out + ".quarantine",
+               out + ".podrun.json"]
     targets += [out + s for s in extra]
     targets += journal_mod.list_partials(out)
+    targets += glob.glob(glob.escape(out) + ".rank*")
     for p in targets:
         try:
             os.remove(p)
@@ -398,11 +428,134 @@ def _remove_run_files(out: str, extra: tuple[str, ...] = ()) -> None:
             pass
 
 
+# ---------------------------------------------------------------------------
+# the rank_kill pod fault class (docs/scaleout.md failure semantics)
+# ---------------------------------------------------------------------------
+
+
+def run_pod_leg(fx: Fixtures, out: str, layout: str, ranks: int,
+                faults_spec: str = "", kill_rank: int | None = None,
+                kill_after_chunks: int | None = None) -> dict:
+    """One 2-rank local-launcher run (``tools/podrun`` as a subprocess),
+    optionally SIGKILLing worker rank ``kill_rank`` once ITS segment
+    journal shows ``kill_after_chunks`` committed chunks (the launcher's
+    ``<out>.podrun.json`` state file maps rank -> worker pid)."""
+    env = _child_env(layout, faults_spec)
+    argv = [sys.executable, "-m", "tools.podrun", "--ranks", str(ranks),
+            "--timeout", str(CHILD_TIMEOUT_S - 30), "--",
+            "--input_file", fx.input_vcf, "--model_file", fx.model,
+            "--model_name", "m", "--reference_file", fx.ref,
+            "--output_file", out, "--backend", "cpu"]
+    p = subprocess.Popen(argv, env=env, cwd=REPO,  # noqa: S603
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+    killed = False
+    if kill_rank is not None:
+        jpath = f"{out}.rank{kill_rank}of{ranks}.seg.journal"
+        spath = out + ".podrun.json"
+        deadline = time.time() + CHILD_TIMEOUT_S
+        while time.time() < deadline and p.poll() is None:
+            try:
+                with open(jpath, encoding="utf-8") as fh:
+                    committed = max(0, len(fh.read().splitlines()) - 1)
+            except OSError:
+                committed = 0
+            if committed >= kill_after_chunks:
+                try:
+                    with open(spath, encoding="utf-8") as fh:
+                        state = json.load(fh)
+                    pid = next(w["pid"] for w in state["workers"]
+                               if w["rank"] == kill_rank)
+                    os.kill(pid, signal.SIGKILL)
+                    killed = True
+                except (OSError, ValueError, KeyError, StopIteration,
+                        ProcessLookupError):
+                    pass  # worker already gone: the pod completes clean
+                break
+            time.sleep(0.02)
+    try:
+        stdout, _ = p.communicate(timeout=CHILD_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        stdout, _ = p.communicate(timeout=30)
+    segs = [f"{out}.rank{r}of{ranks}.seg" for r in range(ranks)]
+    return {"rc": p.returncode, "killed": killed,
+            "out_exists": os.path.exists(out),
+            "stdout": (stdout or "")[-4000:],
+            "segments": [os.path.exists(s) for s in segs]}
+
+
+def _check_pod_leg(leg: dict, fx: Fixtures, out: str, name: str) -> list[str]:
+    """Pod invariants: a clean pod commits the clean-reference bytes and
+    sweeps its segments; a rank-killed pod exits the launcher's DISTINCT
+    code (3) with the destination untouched (surviving ranks' segments
+    stay staged for the relaunch)."""
+    v: list[str] = []
+    if leg["killed"] and leg["rc"] != 0:
+        if leg["rc"] != 3:
+            v.append(f"{name}: podrun exited rc={leg['rc']} after a rank "
+                     "SIGKILL (expected the distinct rank-kill code 3)")
+        if leg["out_exists"]:
+            data = open(out, "rb").read()
+            if normalize_output(data) != fx.reference_norm:
+                v.append(f"{name}: rank SIGKILL left bytes at the "
+                         "destination that are not a complete output")
+        return v
+    # no kill landed (or it raced the worker's clean exit): the pod must
+    # have completed byte-identically and swept its segments
+    if leg["rc"] != 0:
+        v.append(f"{name}: pod run failed rc={leg['rc']}: "
+                 f"{leg['stdout'][-500:]}")
+        return v
+    if not leg["out_exists"]:
+        v.append(f"{name}: pod success but no destination file")
+    elif normalize_output(open(out, "rb").read()) != fx.reference_norm:
+        v.append(f"{name}: pod success but bytes differ from the clean "
+                 "reference")
+    if any(leg["segments"]):
+        v.append(f"{name}: pod success left staged rank segments behind")
+    return v
+
+
+def run_rank_kill_schedule(sched: Schedule, fx: Fixtures,
+                           workdir: str) -> dict:
+    """The rank_kill fault class end to end: a pod leg with one worker
+    rank SIGKILLed mid-run, then a fault-free RELAUNCH that must resume
+    from the per-rank journals/markers and commit byte-identically."""
+    rk = sched.rank_kill or {}
+    ranks = int(rk.get("ranks", 2))
+    out = os.path.join(workdir, f"seed{sched.seed}_pod.vcf")
+    _remove_run_files(out)
+    legs: list[dict] = []
+    violations: list[str] = []
+    leg1 = run_pod_leg(fx, out, sched.layout, ranks,
+                       faults_spec=sched.faults_env(),
+                       kill_rank=int(rk.get("kill_rank", 1)),
+                       kill_after_chunks=int(rk.get("after_chunks", 1)))
+    legs.append(dict(leg1, name="fresh"))
+    violations += _check_pod_leg(leg1, fx, out, "fresh")
+    if leg1["killed"] and leg1["rc"] != 0:
+        # the relaunch: no faults, no kill — per-rank journal resume +
+        # marker skip must complete byte-identically
+        leg2 = run_pod_leg(fx, out, sched.layout, ranks)
+        legs.append(dict(leg2, name="relaunch"))
+        violations += _check_pod_leg(leg2, fx, out, "relaunch")
+    _remove_run_files(out, (".obs.jsonl",))
+    return {"schedule": sched.to_json(), "describe": sched.describe(),
+            "legs": [{k: leg[k] for k in ("name", "rc", "killed",
+                                          "out_exists")}
+                     for leg in legs],
+            "violations": violations}
+
+
 def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
                  sabotage: str | None = None) -> dict:
     """One schedule end to end: the faulted fresh leg, then — whenever
     the faulted leg left a resumable journal (or was killed) — a
-    fault-free RESUME leg that must complete byte-identically."""
+    fault-free RESUME leg that must complete byte-identically.
+    ``rank_kill`` schedules route to the pod harness."""
+    if sched.rank_kill is not None:
+        return run_rank_kill_schedule(sched, fx, workdir)
     out = os.path.join(workdir, f"seed{sched.seed}.vcf")
     _remove_run_files(out)
     violations: list[str] = []
@@ -439,6 +592,10 @@ def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
 
 def _simplifications(sched: Schedule):
     """Candidate one-step simplifications, most aggressive first."""
+    if sched.rank_kill is not None:
+        # does the violation need the pod at all? dropping rank_kill
+        # degrades the schedule to the ordinary single-process flow
+        yield dataclasses.replace(sched, rank_kill=None)
     if sched.kill_after_chunks is not None:
         yield dataclasses.replace(sched, kill_after_chunks=None)
     for i in range(len(sched.faults)):

@@ -1,0 +1,213 @@
+"""podrun — the local rank-partitioned launcher (docs/scaleout.md).
+
+Spawns N worker processes of the flagship filter CLI, each pinned to
+one rank of a :class:`~variantcalling_tpu.parallel.rank_plan.RankPlan`
+via ``VCTPU_RANK``/``VCTPU_NUM_PROCESSES`` (no coordinator, no
+jax.distributed — ranks share nothing but the input file and the final
+commit), monitors them, and — when every rank staged its segment —
+runs the rank-sequenced committer in-process (the same
+``merge_ranks`` the ``vctpu merge-ranks`` CLI exposes).
+
+    python -m tools.podrun --ranks 4 -- \
+        --input_file calls.vcf.gz --model_file model.pkl --model_name m \
+        --reference_file ref.fa --output_file out.vcf.gz --backend cpu
+
+Exit codes are DISTINCT per failure class, so harnesses (chaoshunt's
+``rank_kill`` fault class, the bench ``scaleout`` phase) can tell what
+died:
+
+- ``0``  — every rank completed and the merge committed;
+- ``2``  — usage/configuration error (bad flags, no --output_file);
+- ``3``  — one or more workers were SIGNAL-killed (the merge is
+  SKIPPED: the destination stays untouched; a relaunch resumes the
+  killed rank from its journal and skips finished ranks via their
+  ``.done`` markers);
+- ``4``  — workers completed but the merge failed;
+- ``5``  — the pod timed out (remaining workers terminated);
+- else  — the first failing worker's own exit code (e.g. 1/2).
+
+A ``<out>.podrun.json`` state file maps rank -> pid while the pod runs
+(written atomically; removed on success) — operators and the chaos
+harness use it to find a specific rank's worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXIT_USAGE = 2
+EXIT_KILLED = 3
+EXIT_MERGE = 4
+EXIT_TIMEOUT = 5
+
+
+def state_path(out_path: str) -> str:
+    return str(out_path) + ".podrun.json"
+
+
+def _write_state(out_path: str, ranks: int, procs) -> None:
+    doc = {"ranks": ranks,
+           "workers": [{"rank": r, "pid": p.pid}
+                       for r, p in enumerate(procs)],
+           "launcher_pid": os.getpid()}
+    tmp = state_path(out_path) + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, state_path(out_path))
+
+
+def _output_file_of(fwd: list[str]) -> str | None:
+    for i, a in enumerate(fwd):
+        if a == "--output_file":
+            return fwd[i + 1] if i + 1 < len(fwd) else None
+        if a.startswith("--output_file="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    fwd: list[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, fwd = argv[:split], argv[split + 1:]
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.podrun",
+        description="spawn N rank-partitioned filter workers + the "
+                    "rank-sequenced merge (docs/scaleout.md)")
+    ap.add_argument("--ranks", type=int, required=True,
+                    help="worker process count (N)")
+    ap.add_argument("--timeout", type=float, default=3600.0,
+                    help="whole-pod wall bound in seconds "
+                         "(default %(default)s)")
+    ap.add_argument("--no-merge", action="store_true",
+                    help="stage the segments only; commit later with "
+                         "`vctpu merge-ranks <out>`")
+    ap.add_argument("--keep-logs", action="store_true",
+                    help="keep per-rank worker logs even on success")
+    args = ap.parse_args(argv)
+    if args.ranks <= 0:
+        print("podrun: --ranks must be positive", file=sys.stderr)
+        return EXIT_USAGE
+    if not fwd:
+        print("podrun: pass the filter CLI arguments after `--`",
+              file=sys.stderr)
+        return EXIT_USAGE
+    out_path = _output_file_of(fwd)
+    if not out_path:
+        print("podrun: the forwarded arguments must include "
+              "--output_file (the merge target)", file=sys.stderr)
+        return EXIT_USAGE
+
+    procs: list[subprocess.Popen] = []
+    logs: list[str] = []
+    for r in range(args.ranks):
+        env = dict(os.environ,
+                   VCTPU_RANK=str(r), VCTPU_NUM_PROCESSES=str(args.ranks))
+        log = f"{out_path}.rank{r}.podlog"
+        logs.append(log)
+        fh = open(log, "wb")
+        procs.append(subprocess.Popen(  # noqa: S603
+            [sys.executable, "-m", "variantcalling_tpu",
+             "filter_variants_pipeline", *fwd],
+            env=env, cwd=REPO, stdout=fh, stderr=subprocess.STDOUT))
+        fh.close()  # the child holds the fd; the launcher only re-reads
+    _write_state(out_path, args.ranks, procs)
+    print(f"podrun: spawned {args.ranks} workers "
+          f"(pids {[p.pid for p in procs]}) -> {out_path}", flush=True)
+
+    deadline = time.monotonic() + args.timeout
+    timed_out = False
+    try:
+        while any(p.poll() is None for p in procs):
+            if time.monotonic() > deadline:
+                timed_out = True
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                break
+            time.sleep(0.05)
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=30)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        print("podrun: interrupted — workers terminated; segments + "
+              "journals kept for resume", file=sys.stderr)
+        return 130
+
+    rcs = [p.returncode for p in procs]
+    for r, rc in enumerate(rcs):
+        if rc != 0:
+            tail = b""
+            try:
+                with open(logs[r], "rb") as fh:
+                    tail = fh.read()[-2000:]
+            except OSError:
+                pass
+            print(f"podrun: rank {r} exited rc={rc}\n"
+                  f"{tail.decode(errors='replace')}", file=sys.stderr)
+    try:
+        os.remove(state_path(out_path))
+    except OSError:
+        pass
+
+    if timed_out:
+        print(f"podrun: pod timed out after {args.timeout:.0f}s — "
+              "segments + journals kept for resume", file=sys.stderr)
+        return EXIT_TIMEOUT
+    if any(rc is not None and rc < 0 for rc in rcs):
+        # a signal-killed worker: its segment is incomplete, so the merge
+        # MUST NOT run — the destination stays untouched-or-previous and
+        # a relaunch resumes from the per-rank journals
+        print(f"podrun: worker(s) signal-killed (rcs={rcs}) — merge "
+              "skipped; relaunch to resume", file=sys.stderr)
+        return EXIT_KILLED
+    if any(rcs):
+        return next(rc for rc in rcs if rc)
+
+    if args.no_merge:
+        print(f"podrun: {args.ranks} segments staged (--no-merge); commit "
+              f"with `vctpu merge-ranks {out_path}`", flush=True)
+    else:
+        sys.path.insert(0, REPO)
+        from variantcalling_tpu.parallel import rank_plan as rank_plan_mod
+
+        try:
+            stats = rank_plan_mod.merge_ranks(out_path, args.ranks)
+        except rank_plan_mod.MergeError as e:
+            print(f"podrun: merge failed: {e}", file=sys.stderr)
+            return EXIT_MERGE
+        print(f"podrun: wrote {out_path}: {stats['n']} variants, "
+              f"{stats['n_pass']} PASS from {stats['ranks']} ranks",
+              flush=True)
+    if not args.keep_logs:
+        for log in logs:
+            try:
+                os.remove(log)
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ TOOLS: dict[str, str] = {
     "knobs": "variantcalling_tpu.knobs",
     "obs": "variantcalling_tpu.obs.cli",
     "serve": "variantcalling_tpu.serve.cli",
+    "merge-ranks": "variantcalling_tpu.parallel.rank_plan",
     "filter_variants_pipeline": "variantcalling_tpu.pipelines.filter_variants",
     "train_models_pipeline": "variantcalling_tpu.pipelines.train_models",
     "training_prep_pipeline": "variantcalling_tpu.pipelines.training_prep",

@@ -26,6 +26,16 @@ watermark, continue" reproduces the uninterrupted output byte for byte
 Anything suspicious — signature mismatch, truncated journal line, CRC
 mismatch, partial file shorter than the watermark — degrades to a fresh
 run; resume is an optimization, never a correctness risk.
+
+Rank-partitioned scale-out runs (docs/scaleout.md) ride this protocol
+PER RANK: each rank's streaming run targets its own segment path
+(``<out>.rank{r}of{N}.seg``), so every rank keeps its own journal +
+partial pair and a SIGKILLed rank resumes from ITS journal while its
+siblings are untouched — the resume identity additionally pins the rank
+layout (``config.ranks``), because a journal written by rank r of N
+describes r's chunk span only. Completed segments are sealed by a
+``.done`` marker (``parallel/rank_plan.py``) the relaunch skip-path and
+the rank-sequenced committer both verify.
 """
 
 from __future__ import annotations

@@ -798,16 +798,19 @@ class _ParallelBgzfStream:
     stream.
     """
 
-    def __init__(self, path: str, pool, profiler=None):
+    def __init__(self, path: str, pool, profiler=None, spans=None):
         from variantcalling_tpu.io import bgzf as bgzf_mod
 
         size = os.path.getsize(path)
         self.path = str(path)
         self._mm = (np.memmap(path, dtype=np.uint8, mode="r")
                     if size else np.empty(0, dtype=np.uint8))
-        spans = bgzf_mod.scan_block_spans(self._mm) if size else []
         if spans is None:
-            raise ValueError(f"{path}: not BGZF-framed")
+            spans = bgzf_mod.scan_block_spans(self._mm) if size else []
+            if spans is None:
+                raise ValueError(f"{path}: not BGZF-framed")
+        # ``spans`` given: a SUBSET of the member chain — the rank-span
+        # window (docs/scaleout.md) inflates only its share of the file
         groups = bgzf_mod.group_spans(spans,
                                       knobs.get_int("VCTPU_IO_SHARD_BYTES"))
         from variantcalling_tpu.parallel.pipeline import imap_ordered
@@ -858,6 +861,141 @@ class _ParallelBgzfStream:
         self._mm = None
 
 
+class _MemberStream:
+    """Serial ``read(n)`` over a run of BGZF members (one rank's suffix
+    of the member chain) — the ``VCTPU_IO_THREADS=1`` sibling of
+    :class:`_ParallelBgzfStream` for the rank-span window."""
+
+    def __init__(self, mm, spans):
+        self._mm = mm
+        self._spans = spans
+        self._i = 0
+        self._buf = bytearray()
+
+    def read(self, n: int) -> bytes:
+        from variantcalling_tpu.io import bgzf as bgzf_mod
+
+        while len(self._buf) < n and self._i < len(self._spans):
+            j = min(self._i + 16, len(self._spans))
+            self._buf += bgzf_mod.inflate_spans(self._mm,
+                                                self._spans[self._i:j])
+            self._i = j
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def close(self) -> None:
+        self._buf.clear()
+        self._i = len(self._spans)
+
+
+class _SpanGzWindow:
+    """File-like ``read(n)`` serving ONE rank's line-aligned window of a
+    BGZF file's decompressed stream (the docs/scaleout.md partition
+    rule).
+
+    The window is ``[cut(t_lo), cut(t_hi))`` where ``cut(u)`` is the
+    smallest line-start position >= ``u``: the position after the first
+    newline at offset >= ``u - 1``, clamped to the record region
+    ``[h, total]`` (the header always ends at a line start, so rank 0's
+    window begins exactly at ``h``). Adjacent ranks compute the SAME cut
+    for their shared target, so the windows partition the record region
+    exactly — no record is lost or duplicated, whatever the BGZF block
+    layout. The inner stream starts at the member holding the first
+    byte the window needs, so a rank inflates only ~its share (plus the
+    members its boundary lines straddle).
+    """
+
+    def __init__(self, inner, base: int, t_lo: int, t_hi: int,
+                 h: int, total: int):
+        self._inner = inner
+        self._buf = bytearray()
+        self._buf_abs = base  # absolute offset of _buf[0]
+        self._inner_eof = False
+        self._t_lo, self._t_hi = t_lo, t_hi
+        self._h, self._total = h, total
+        self._start: int | None = None  # cut(t_lo), resolved lazily
+        self._end: int | None = None  # cut(t_hi)
+
+    def _more(self) -> bool:
+        if self._inner_eof:
+            return False
+        block = self._inner.read(4 << 20)
+        if not block:
+            self._inner_eof = True
+            return False
+        self._buf += block
+        return True
+
+    def _drop(self, n: int) -> None:
+        del self._buf[:n]
+        self._buf_abs += n
+
+    def _cut(self, t: int) -> int:
+        """``cut(t)``, buffering inner bytes as needed; inner EOF clamps
+        to the end of the stream."""
+        if t <= self._h:
+            return self._h
+        if t >= self._total:
+            return self._total
+        probe = t - 1
+        if probe < self._buf_abs:
+            # the probe byte is already consumed — only possible when
+            # this cut coincides with the (already resolved) start cut:
+            # no newline separates the two targets, or cut(t_lo) would
+            # have stopped earlier
+            return self._start if self._start is not None else self._buf_abs
+        while True:
+            start_idx = probe - self._buf_abs
+            if start_idx < len(self._buf):
+                nl = self._buf.find(b"\n", start_idx)
+                if nl >= 0:
+                    return self._buf_abs + nl + 1
+                probe = self._buf_abs + len(self._buf)
+            if not self._more():
+                return self._buf_abs + len(self._buf)  # EOF mid-final-line
+
+    def read(self, n: int) -> bytes:
+        if self._start is None:
+            self._start = self._cut(self._t_lo)
+            if self._t_hi >= self._total:
+                self._end = self._total
+            while self._buf_abs < self._start:
+                if not self._buf:
+                    if not self._more():
+                        break
+                    continue
+                self._drop(min(len(self._buf),
+                               self._start - self._buf_abs))
+        out = bytearray()
+        while len(out) < n:
+            if self._end is not None and self._buf_abs >= self._end:
+                break
+            if not self._buf and not self._more():
+                break
+            avail = len(self._buf)
+            if self._end is None:
+                # end unknown: everything strictly before t_hi - 1 is
+                # in-window; once the buffer reaches the probe byte,
+                # resolve the end cut (which may buffer further — the
+                # final line can straddle members)
+                if self._buf_abs + avail > self._t_hi - 1:
+                    self._end = self._cut(self._t_hi)
+                    continue
+                take = avail
+            else:
+                take = min(avail, self._end - self._buf_abs)
+            take = min(take, n - len(out))
+            if take <= 0:
+                break
+            out += self._buf[:take]
+            self._drop(take)
+        return bytes(out)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 class VcfChunkReader:
     """Line-aligned chunked native VCF ingest for the streaming executor.
 
@@ -888,13 +1026,27 @@ class VcfChunkReader:
     """
 
     def __init__(self, path: str, chunk_bytes: int = 0,
-                 io_threads: int | None = None, profiler=None):
+                 io_threads: int | None = None, profiler=None,
+                 rank_span: tuple[int, int] | None = None):
         from variantcalling_tpu import native
         from variantcalling_tpu.parallel.pipeline import resolve_io_threads
 
         if not native.available():
             raise RuntimeError("VcfChunkReader requires the native engine")
         self.path = str(path)
+        # rank-partitioned ingest (docs/scaleout.md): ``(rank, ranks)``
+        # restricts this reader to ONE contiguous line-aligned span of
+        # the record region — the deterministic cut rule shared with
+        # every other rank, so the spans partition the file exactly
+        self._rank_span: tuple[int, int] | None = None
+        if rank_span is not None and int(rank_span[1]) > 1:
+            r, nr = int(rank_span[0]), int(rank_span[1])
+            if not 0 <= r < nr:
+                raise ValueError(f"rank_span {rank_span!r} out of range")
+            self._rank_span = (r, nr)
+        #: decompressed bytes of this reader's span (None: whole file) —
+        #: the heartbeat's progress denominator for rank runs
+        self.span_bytes: int | None = None
         # arg beats the env knob beats the (test-patchable) module
         # default; resolved here, not at import, so a malformed value is
         # caught by run()'s up-front knobs.validate_all() instead of an
@@ -915,22 +1067,21 @@ class VcfChunkReader:
         self._mm: np.ndarray | None = None
         self._fh = None
         self._pending = b""
-        if self._gz:
+        if self._gz and self._rank_span is not None:
+            # rank-span gz ingest: member-mapped window (BGZF only)
+            try:
+                self._init_gz_span()
+            except BaseException:
+                self.close()
+                raise
+        elif self._gz:
             # a failing header scan (e.g. a persistent shard-inflate error
             # surfacing through the parallel stream) must release the
             # already-started pool workers — close() is unreachable from
             # callers when the constructor itself raises
             try:
                 self._fh = self._open_gz_stream()
-                head = b""
-                while True:
-                    block = self._fh.read(self.chunk_bytes)
-                    head += block
-                    header, first_off = parse_header_bytes(head)
-                    # complete when a record line begins, or the stream ended
-                    if not block or (first_off < len(head) and head[first_off : first_off + 1] != b"#"):
-                        break
-                self.header = header
+                self.header, first_off, head = self._scan_gz_header(self._fh)
                 self._pending = head[first_off:]
             except BaseException:
                 self.close()
@@ -949,6 +1100,107 @@ class VcfChunkReader:
                 cap *= 8
             self.header = header
             self._first_off = first_off
+            self._span_lo, self._span_hi = first_off, size
+            if self._rank_span is not None:
+                self._span_lo, self._span_hi = self._mm_span_bounds(size)
+                self.span_bytes = self._span_hi - self._span_lo
+
+    def _scan_gz_header(self, fh) -> tuple:
+        """Read the VCF header off a decompressed-byte stream — the ONE
+        gz header-scan rule (read ``chunk_bytes`` windows until a record
+        line begins or the stream ends), shared by the whole-file and
+        rank-span constructors so the two can never parse different
+        headers for the same file. Returns ``(header, first_off, head)``
+        — ``head[first_off:]`` is the already-read record remainder."""
+        head = b""
+        while True:
+            block = fh.read(self.chunk_bytes)
+            head += block
+            header, first_off = parse_header_bytes(head)
+            if not block or (first_off < len(head)
+                             and head[first_off:first_off + 1] != b"#"):
+                break
+        return header, first_off, head
+
+    def _mm_newline_cut(self, u: int, size: int) -> int:
+        """The smallest line-start position >= ``u`` (the rank-span cut
+        rule): the position after the first newline at index >= u - 1,
+        clamped to the record region. The SAME rule every rank applies,
+        so adjacent spans meet exactly."""
+        if u <= self._first_off:
+            return self._first_off
+        if u >= size:
+            return size
+        pos = u - 1
+        probe = 1 << 16
+        while pos < size:
+            w = self._mm[pos: min(pos + probe, size)]
+            hits = np.flatnonzero(w == 0x0A)
+            if len(hits):
+                return min(pos + int(hits[0]) + 1, size)
+            pos += len(w)
+            probe *= 8
+        return size
+
+    def _mm_span_bounds(self, size: int) -> tuple[int, int]:
+        r, n_ranks = self._rank_span
+        body = size - self._first_off
+        lo = self._mm_newline_cut(self._first_off + body * r // n_ranks,
+                                  size)
+        hi = self._mm_newline_cut(
+            self._first_off + body * (r + 1) // n_ranks, size)
+        return lo, max(lo, hi)
+
+    def _init_gz_span(self) -> None:
+        """Rank-span ingest of a BGZF input: map the member chain, parse
+        the header with a short serial inflate from the file start, then
+        serve this rank's line-aligned window of the decompressed stream
+        (:class:`_SpanGzWindow`) starting at the member that holds the
+        window's first needed byte. Plain single-member gzip has no
+        member split points — rank partitioning refuses it loudly
+        (EngineError, exit 2) rather than silently re-inflating the
+        whole prefix per rank."""
+        from variantcalling_tpu.engine import EngineError
+        from variantcalling_tpu.io import bgzf as bgzf_mod
+
+        size = os.path.getsize(self.path)
+        mm = (np.memmap(self.path, dtype=np.uint8, mode="r")
+              if size else np.empty(0, dtype=np.uint8))
+        spans = bgzf_mod.scan_block_spans(mm) if size else []
+        if spans is None:
+            raise EngineError(
+                f"{self.path}: rank-partitioned ingest needs BGZF-framed "
+                "input (plain gzip is one indivisible deflate stream) — "
+                "re-compress with bgzip/the BGZF writer, or run "
+                "single-rank (docs/scaleout.md)")
+        with gzip.open(self.path, "rb") as fh:
+            self.header, first_off, _ = self._scan_gz_header(fh)
+        h = first_off
+        total = int(sum(s[2] for s in spans))
+        r, n_ranks = self._rank_span
+        body = max(0, total - h)
+        t_lo = h + body * r // n_ranks
+        t_hi = h + body * (r + 1) // n_ranks
+        self.span_bytes = max(0, t_hi - t_lo)
+        # first decompressed byte the window needs: the line-start probe
+        # at t_lo - 1 (or the header end, for rank 0's window)
+        probe = t_lo - 1 if t_lo > h else h
+        probe = max(0, min(probe, max(total - 1, 0)))
+        cum = 0
+        m_lo = len(spans)
+        for i, s in enumerate(spans):
+            if cum + s[2] > probe:
+                m_lo = i
+                break
+            cum += s[2]
+        tail = spans[m_lo:]
+        if self.io_threads > 1 and tail:
+            inner = _ParallelBgzfStream(self.path, self._ensure_pool(),
+                                        profiler=self.profiler, spans=tail)
+        else:
+            inner = _MemberStream(mm, tail)
+        self._fh = _SpanGzWindow(inner, cum, t_lo, t_hi, h, total)
+        self._pending = b""
 
     def _open_gz_stream(self):
         """The decompressed-byte source for ``.gz`` input: shard-parallel
@@ -1092,10 +1344,14 @@ class VcfChunkReader:
 
     def _raw_mm(self):
         """(buf_np, lazy_buf) chunk buffers in file order (plain text):
-        the SAME boundary rule at every ``VCTPU_IO_THREADS`` setting."""
+        the SAME boundary rule at every ``VCTPU_IO_THREADS`` setting.
+        A rank-span reader iterates only its line-aligned span — the
+        chunk rule applies to the span's bytes exactly as it would to a
+        standalone file (chunk boundaries never change output bytes;
+        they only shape the rank-local journal)."""
         mm = self._mm
-        n = len(mm)
-        off = self._first_off
+        n = self._span_hi
+        off = self._span_lo
         while off < n:
             end = min(off + self.chunk_bytes, n)
             if end < n:

@@ -172,7 +172,13 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "host:port of rank 0 — presence turns any tool into one rank of "
        "a global mesh (docs/distributed.md)"),
     _k("VCTPU_NUM_PROCESSES", "int", None,
-       "total ranks of a multi-host launch", positive=True),
+       "total ranks of a multi-host launch (jax.distributed) or of a "
+       "rank-partitioned local pod run (docs/scaleout.md)", positive=True),
+    _k("VCTPU_RANK", "int", None,
+       "this process's rank in a rank-partitioned scale-out run "
+       "(tools/podrun sets it; resolved BEFORE any jax init, so the "
+       "local launcher needs no jax.distributed — docs/scaleout.md)",
+       minimum=0),
     _k("VCTPU_PROCESS_ID", "int", None,
        "this rank's id in a multi-host launch", minimum=0),
     _k("VCTPU_AUTO_DISTRIBUTED", "bool", False,
@@ -293,6 +299,10 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "run_tests.sh: run the opt-in load×chaos smoke stage "
        "(tools/loadhunt, 10 fixed seeds against a real vctpu serve "
        "daemon — docs/serving.md)"),
+    _k("VCTPU_SCALEOUT", "bool", False,
+       "run_tests.sh: run the opt-in simulated multi-host stage (the "
+       "2-process local launcher end-to-end on the cpu backend plus the "
+       "multi-process system tests — docs/scaleout.md)"),
     _k("VCTPU_PROBE_INTERVAL", "int", 1800,
        "tools/tpu_probe.py polling interval in seconds", positive=True),
     _k("VCTPU_PROBE_HOURS", "float", 11.5,

@@ -280,14 +280,15 @@ class ObsRun:
 
 def _rank_suffixed(path: str) -> str:
     """Multi-rank runs must not interleave one file: rank N > 0 writes
-    ``<path>.rankN``."""
-    try:
-        import jax
+    ``<path>.rankN``. Rank resolution is the ONE shared spelling
+    (``parallel/distributed.rank``): ``VCTPU_RANK`` first — a local
+    scale-out launcher's worker (tools/podrun) must suffix correctly
+    WITHOUT initializing a jax backend — then the guarded
+    ``jax.process_index()`` fallback the coordinator mode uses."""
+    from variantcalling_tpu.parallel.distributed import rank as _rank
 
-        rank = jax.process_index()
-    except Exception:  # noqa: BLE001 # vctpu-lint: disable=VCT002 — uninitialized backend == rank 0, recorded in the manifest topology instead
-        rank = 0
-    return f"{path}.rank{rank}" if rank else path
+    r = _rank()
+    return f"{path}.rank{r}" if r else path
 
 
 def start_run(tool: str, default_path: str | None = None,

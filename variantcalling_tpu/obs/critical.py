@@ -35,29 +35,44 @@ from __future__ import annotations
 from variantcalling_tpu.obs import export as export_mod
 
 
+def _rank_key(e: dict, ident) -> str | None:
+    """Scope an id to its rank on a merged multi-rank timeline
+    (``export.read_run`` tags every event with ``rank``): each rank's
+    writer allocated its own ``t<N>``/``s<N>`` sequences, so bare ids
+    COLLIDE across ranks — two ranks' chunk DAGs would silently fuse.
+    Single-rank logs keep the bare id (no ``rank`` field)."""
+    if not isinstance(ident, str):
+        return None
+    return f"r{e['rank']}:{ident}" if "rank" in e else ident
+
+
 def span_records(events: list[dict]) -> dict[str, dict]:
     """``span_id -> normalized span record`` for every ``trace`` event
     (start/end derived from the envelope ``t`` = emission time ≈ span
-    end)."""
+    end). On a rank-merged timeline every id is rank-scoped — parent
+    links never cross ranks (ranks share no chunks)."""
     spans: dict[str, dict] = {}
     for e in events:
         if e.get("kind") != "trace":
             continue
-        sid = e.get("span_id")
-        if not isinstance(sid, str):
+        sid = _rank_key(e, e.get("span_id"))
+        if sid is None:
             continue
         end = float(e.get("t", 0.0))
         dur = max(0.0, float(e.get("dur", 0.0)))
+        traces = e.get("traces")
         spans[sid] = {
             "id": sid,
             "name": e.get("name", "?"),
-            "trace": e.get("trace_id"),
-            "traces": e.get("traces"),
+            "trace": _rank_key(e, e.get("trace_id")),
+            "traces": ([_rank_key(e, t) for t in traces]
+                       if traces else None),
             "start": end - dur,
             "end": end,
             "dur": dur,
-            "parents": [p for p in e.get("parents", ())
-                        if isinstance(p, str)],
+            "parents": [k for k in (_rank_key(e, p)
+                                    for p in e.get("parents", ()))
+                        if k is not None],
         }
     return spans
 

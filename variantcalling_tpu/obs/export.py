@@ -174,10 +174,13 @@ def to_chrome_trace(events: list[dict]) -> dict:
     # causal trace spans: ph X slices like ordinary spans, PLUS flow
     # arrows (ph s/f pairs) along every parent link — Perfetto then draws
     # the chunk DAG (megabatch fan-in included) across thread tracks
-    span_index: dict[str, dict] = {}
+    # index keyed by (pid, span_id): on a rank-merged timeline the pid
+    # IS the rank and every rank allocated its own s<N> sequence, so a
+    # bare-id index would draw flow arrows across unrelated ranks' spans
+    span_index: dict[tuple, dict] = {}
     for e in events:
         if e.get("kind") == "trace" and isinstance(e.get("span_id"), str):
-            span_index[e["span_id"]] = e
+            span_index[(e.get("pid", 0), e["span_id"])] = e
     flow_id = 0
 
     for e in events:
@@ -196,7 +199,7 @@ def to_chrome_trace(events: list[dict]) -> dict:
                           "cat": "trace", "ts": start_us, "dur": dur_us,
                           "pid": pid, "tid": tid, "args": _args_of(e)})
             for parent_id in e.get("parents", ()):
-                parent = span_index.get(parent_id)
+                parent = span_index.get((pid, parent_id))
                 if parent is None:
                     continue
                 flow_id += 1

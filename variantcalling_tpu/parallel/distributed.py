@@ -34,6 +34,29 @@ from variantcalling_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 _INITIALIZED = False
 
 
+def rank() -> int:
+    """This process's rank, resolved from the env BEFORE any jax init.
+
+    Resolution order: ``VCTPU_RANK`` (the local scale-out launcher,
+    tools/podrun — no jax.distributed, no backend init), then
+    ``jax.process_index()`` guarded — an uninitialized/failed backend
+    means single-process, i.e. rank 0. The ONE rank spelling: obs log
+    suffixing (``obs._rank_suffixed``) and the RankPlan resolution
+    (``parallel/rank_plan.py``) agree with this by construction — a
+    coordinator-mode launch (``VCTPU_PROCESS_ID``) counts as ranked
+    only once ``jax.distributed`` actually initialized, so a
+    half-configured env can never make the telemetry claim a rank
+    separation the work assignment does not have.
+    """
+    r = knobs.get_int("VCTPU_RANK")
+    if r is not None:
+        return r
+    try:
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 # vctpu-lint: disable=VCT002 — uninitialized backend == single process == rank 0 by contract
+        return 0
+
+
 def init_from_env() -> bool:
     """Initialize jax.distributed when the env asks for it; idempotent.
 

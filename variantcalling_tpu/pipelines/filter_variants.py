@@ -798,6 +798,7 @@ class FilterContext:
         is_mutect: bool = False,
         engine: engine_mod.EngineDecision | None = None,
         mesh_plan=None,
+        rank_plan=None,
     ):
         # the run-level scoring engine (VCTPU_ENGINE): resolved once and
         # held here so every chunk of a run scores on the SAME engine.
@@ -862,6 +863,18 @@ class FilterContext:
         self.mesh_plan = mesh_plan if mesh_plan is not None \
             else shard_score.resolve_plan(eng.name)
         shard_score.log_plan(self.mesh_plan)
+        # the run-level RANK plan (VCTPU_RANK/VCTPU_NUM_PROCESSES or an
+        # initialized jax.distributed runtime): resolved once here next
+        # to the mesh plan, recorded as ##vctpu_ranks= when >1 rank and
+        # pinned into the chunk-journal resume identity — the scale-out
+        # layout every rank of a pod run agrees on (docs/scaleout.md).
+        # ``rank_plan`` pins an externally-resolved plan (the scale-out
+        # driver passes the one it partitioned by).
+        from variantcalling_tpu.parallel import rank_plan as rank_plan_mod
+
+        self.rank_plan = rank_plan if rank_plan is not None \
+            else rank_plan_mod.resolve()
+        rank_plan_mod.log_plan(self.rank_plan)
         self.model = model
         self.fasta = fasta
         self.hpol_length = hpol_length
@@ -1095,7 +1108,7 @@ def _replace_or_append_meta(header, prefix: str, line: str) -> None:
 
 def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = None,
                           strategy: str | None = None,
-                          mesh_plan=None) -> None:
+                          mesh_plan=None, rank_plan=None) -> None:
     """The filter pipeline's header additions — ONE place so the serial and
     streaming writers emit identical header bytes. Records the scoring
     engine (``##vctpu_engine=...``), the resolved forest strategy
@@ -1125,6 +1138,21 @@ def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = Non
     else:
         header.lines[:] = [ln for ln in header.lines
                            if not ln.startswith(mesh_prefix)]
+    # rank provenance (docs/scaleout.md): >1-rank runs record the pod
+    # layout; single-rank runs emit NO line (and strip a stale one from
+    # a re-filtered input) — record bytes are rank-count-invariant, so
+    # this line is the only byte naming the scale-out layout. The line
+    # names only n (never the rank id): every rank's segment must emit
+    # IDENTICAL header bytes for the seam commit's cross-rank check.
+    from variantcalling_tpu.parallel.rank_plan import RANKS_HEADER_KEY
+
+    ranks_prefix = f"##{RANKS_HEADER_KEY}="
+    if rank_plan is not None and rank_plan.ranks > 1:
+        _replace_or_append_meta(header, ranks_prefix,
+                                rank_plan.header_line())
+    else:
+        header.lines[:] = [ln for ln in header.lines
+                           if not ln.startswith(ranks_prefix)]
     # explicitly-set scoring knobs (wide chunk/block, pallas opt-out):
     # full provenance next to the engine/strategy lines. Execution-only
     # knobs are excluded so streaming/serial/resumed runs stay
@@ -1140,11 +1168,15 @@ def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = Non
                            if not ln.startswith(knob_prefix)]
 
 
-def streaming_eligible(args_limit_to_contig=None) -> bool:
+def streaming_eligible(args_limit_to_contig=None,
+                       allow_multiprocess: bool = False) -> bool:
     """The streaming executor runs when host threads are available
     (``VCTPU_THREADS`` != 1, ``VCTPU_STREAM`` != 0), the native engine is
     built, and the job is single-process / whole-file. Anything else —
-    including ``VCTPU_THREADS=1`` — cleanly selects the serial path."""
+    including ``VCTPU_THREADS=1`` — cleanly selects the serial path.
+    ``allow_multiprocess`` is the rank-partitioned scale-out driver's
+    opt-in (parallel/rank_plan.py): each rank IS one of N processes by
+    design, streaming over its own span."""
     from variantcalling_tpu import native
     from variantcalling_tpu.parallel.pipeline import resolve_threads
 
@@ -1152,12 +1184,13 @@ def streaming_eligible(args_limit_to_contig=None) -> bool:
         return False
     if not native.available() or args_limit_to_contig:
         return False
-    try:
-        if jax.process_count() > 1:
-            return False
-    except Exception as e:  # noqa: BLE001 — uninitialized backend == single process
-        degrade.record("pipeline.process_count_probe", e,
-                       fallback="assume single process")
+    if not allow_multiprocess:
+        try:
+            if jax.process_count() > 1:
+                return False
+        except Exception as e:  # noqa: BLE001 — uninitialized backend == single process
+            degrade.record("pipeline.process_count_probe", e,
+                           fallback="assume single process")
     return True
 
 
@@ -1197,7 +1230,8 @@ def _sink_write(sink, data) -> None:
 
 
 def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
-                  engine: engine_mod.EngineDecision | None = None) -> dict | None:
+                  engine: engine_mod.EngineDecision | None = None,
+                  rank_plan=None) -> dict | None:
     """Chunked three-stage streaming execution: BGZF/VCF chunk ingest ->
     fused featurize+score -> ordered VCF writeback, overlapped on the
     bounded-queue stage executor (parallel/pipeline.py).
@@ -1229,7 +1263,9 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
     Returns a stats dict, or None when ineligible (caller runs serial).
     """
-    if not streaming_eligible(args.limit_to_contig):
+    multiproc = rank_plan is not None and rank_plan.ranks > 1
+    if not streaming_eligible(args.limit_to_contig,
+                              allow_multiprocess=multiproc):
         return None
 
     # telemetry: callers that came through run() already opened the obs
@@ -1246,7 +1282,8 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
         try:
             stats = _run_streaming_impl(args, model, fasta, annotate,
-                                        blacklist, engine=engine)
+                                        blacklist, engine=engine,
+                                        rank_plan=rank_plan)
         except shard_score.MeshDegradeRestart as e:
             # recovery ladder, top rung: device OOM survived the
             # megabatch shrink — restart the WHOLE stream on a dp=1
@@ -1270,7 +1307,8 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
                 "degraded to dp=1")
             stats = _run_streaming_impl(args, model, fasta, annotate,
                                         blacklist, engine=engine,
-                                        mesh_plan=plan1)
+                                        mesh_plan=plan1,
+                                        rank_plan=rank_plan)
     except BaseException as e:
         obs.end_run(obs_run, f"error: {type(e).__name__}")
         raise
@@ -1280,7 +1318,7 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
 def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                         engine: engine_mod.EngineDecision | None = None,
-                        mesh_plan=None) -> dict:
+                        mesh_plan=None, rank_plan=None) -> dict:
     import contextvars
     import threading
     import time as _time
@@ -1310,7 +1348,20 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # continuous-profiler attribution (obs v3): this thread runs the
     # sequenced single-writer commit loop for the duration of the run
     sampler_mod.register_current("committer")
-    reader = VcfChunkReader(args.input_file, profiler=prof)
+    # rank-partitioned ingest (docs/scaleout.md): a multi-rank plan
+    # restricts the reader to THIS rank's contiguous line-aligned span —
+    # chunk boundaries, the journal and the output segment are all
+    # rank-local, and the rank-sequenced committer splices the segments.
+    # Resolved HERE when not passed (direct callers under a launcher
+    # env), so the reader's span and the header's ##vctpu_ranks= line
+    # can never disagree.
+    if rank_plan is None:
+        from variantcalling_tpu.parallel import rank_plan as rank_plan_mod
+
+        rank_plan = rank_plan_mod.resolve()
+    span = (rank_plan.rank, rank_plan.ranks) \
+        if rank_plan is not None and rank_plan.ranks > 1 else None
+    reader = VcfChunkReader(args.input_file, profiler=prof, rank_span=span)
     header = reader.header
     ctx = FilterContext(
         model, fasta, runs_file=args.runs_file,
@@ -1320,9 +1371,10 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         blacklist_cg_insertions=args.blacklist_cg_insertions,
         annotate_intervals=annotate, flow_order=args.flow_order,
         is_mutect=args.is_mutect, engine=engine, mesh_plan=mesh_plan,
+        rank_plan=rank_plan,
     )
     _ensure_output_header(header, engine=ctx.engine, strategy=ctx.forest_strategy,
-                          mesh_plan=ctx.mesh_plan)
+                          mesh_plan=ctx.mesh_plan, rank_plan=ctx.rank_plan)
 
     # kill the warmup cliff: encode (and persist) the genome on a prefetch
     # thread; scoring's per-contig fetch_encoded waits only for the contig
@@ -1544,6 +1596,11 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                 # would mismatch anyway; pinning it here makes the
                 # decision explicit, tests/unit/test_streaming_faults.py)
                 "mesh_devices": ctx.mesh_plan.devices,
+                # the rank layout partitions the CHUNK SEQUENCE itself:
+                # a journal written by rank r of n describes r's span
+                # only, so a resume under any other layout restarts
+                # (docs/scaleout.md — per-rank journals)
+                "ranks": [ctx.rank_plan.rank, ctx.rank_plan.ranks],
             },
         }
         # claim=True: the re-tokened partial is OURS from the instant it
@@ -1579,7 +1636,10 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # BEFORE the claim for the same reason.
     resolve_threads()
     resolve_stage_timeout()
-    input_bytes = os.path.getsize(args.input_file)
+    # a rank-span reader processes only its share: heartbeat progress
+    # divides by the SPAN's bytes, not the whole file's
+    input_bytes = reader.span_bytes if reader.span_bytes is not None \
+        else os.path.getsize(args.input_file)
     part_token = None
     try:
         if gz:
@@ -2033,7 +2093,36 @@ def run_loaded(args, model, fasta: FastaReader, annotate, blacklist,
     from variantcalling_tpu.utils.trace import report, stage
 
     eng = engine if engine is not None else engine_mod.resolve_for_run()
-    # streaming executor first: overlapped ingest/score/writeback with
+    # rank-partitioned scale-out FIRST (docs/scaleout.md): a multi-rank
+    # plan (VCTPU_RANK under the local launcher, or an initialized
+    # jax.distributed runtime) runs this process as ONE rank of a pod —
+    # full sharded ingest -> fused score -> render over its contiguous
+    # span, staged into a rank segment for the rank-sequenced committer.
+    from variantcalling_tpu.parallel import rank_plan as rank_plan_mod
+
+    try:
+        plan = rank_plan_mod.resolve()
+        if plan.ranks > 1 and rank_plan_mod.scaleout_eligible(args):
+            logger.info("rank-partitioned scale-out: rank %d of %d (%s)",
+                        plan.rank, plan.ranks, plan.source)
+            with stage("scaleout"):
+                return rank_plan_mod.run_scaleout(
+                    args, model, fasta, annotate, blacklist, engine=eng,
+                    plan=plan)
+        if plan.ranks > 1 and plan.source == "env":
+            # an env-launched worker has NO collectives to merge scores
+            # through — silently writing the FULL output would make N
+            # ranks race on one destination; fail loudly instead
+            raise EngineError(
+                "VCTPU_RANK is set but this job cannot run the "
+                "rank-partitioned streaming executor (it needs the "
+                "native engine, VCTPU_STREAM=1, VCTPU_THREADS>1 and no "
+                "--limit_to_contig) — unset VCTPU_RANK or fix the "
+                "configuration; docs/scaleout.md")
+    except EngineError as e:
+        logger.error("%s", e)
+        return 2
+    # streaming executor next: overlapped ingest/score/writeback with
     # byte-identical output; falls through to the serial path when
     # ineligible (VCTPU_THREADS=1, multi-process, region-limited, no
     # native engine)
@@ -2125,7 +2214,8 @@ def run_loaded(args, model, fasta: FastaReader, annotate, blacklist,
     cancellation.check("filter run")
     _ensure_output_header(table.header, engine=ctx.engine,
                           strategy=ctx.forest_strategy,
-                          mesh_plan=ctx.mesh_plan)
+                          mesh_plan=ctx.mesh_plan,
+                          rank_plan=ctx.rank_plan)
     with stage("writeback"):
         # verbatim_core: this pipeline never edits CHROM..QUAL, so record
         # assembly can splice FILTER/TREE_SCORE between original byte spans
