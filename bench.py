@@ -1490,6 +1490,142 @@ def scaleout_phase(fixture_dir: str) -> dict:
     }
 
 
+def cache_phase(fixture_dir: str) -> dict:
+    """Chunk-result cache speedup (docs/caching.md): the 1M e2e fixture
+    re-filtered in-process against ONE on-disk store — cold (populates,
+    pays publish), fully warm (every chunk replays rendered bytes) and
+    mixed (half the entries evicted, hits and misses interleave through
+    the same sequenced commit). The legs deliberately measure the
+    RE-FILTER itself (the resident ``vctpu serve`` economics — one warm
+    process, repeated traffic), not interpreter+jax startup: a fresh CLI
+    invocation adds the same fixed startup to every leg and would report
+    process spawn cost, not cache effect. Warmup mirrors e2e_pipeline
+    (engine warm + a cache-off run that also pre-caches the .venc genome
+    encode, so warm_hit_over_cold attributes to THIS cache, not the
+    reference cache riding along).
+
+    The sha256 digest tripwire mirrors scaleout_phase: all three legs'
+    outputs must be identical modulo ``##vctpu_*`` provenance headers,
+    or ``digest_state="mismatch"``/``bytes_identical=0`` hard-fails in
+    tools/bench_gate.py — a parity break can never land as a quietly-
+    faster number. The committed row carries each leg's cache counters
+    straight from the run stats (warm legs must prove they actually
+    hit); the phase's obs run log (OBS_ATTRIBUTED_PHASES) carries the
+    same counters in its metrics snapshots.
+    """
+    import hashlib
+    import shutil
+
+    from variantcalling_tpu.io.fasta import FastaReader
+    from variantcalling_tpu.io.vcf import VcfChunkReader
+    from variantcalling_tpu.pipelines.filter_variants import (filter_variants,
+                                                              run_streaming)
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    vcf_in = os.path.join(fixture_dir, "calls.vcf.gz")
+    if not os.path.exists(vcf_in):
+        vcf_in = os.path.join(fixture_dir, "calls.vcf")
+    out_path = os.path.join(fixture_dir, "cache_out.vcf")
+
+    from tools.chaoshunt.harness import normalize_output as normalize
+
+    store = os.path.join(fixture_dir, "cache_store")
+    shutil.rmtree(store, ignore_errors=True)
+
+    fasta = FastaReader(os.path.join(fixture_dir, "ref.fa"))
+    model = synthetic_forest(np.random.default_rng(0), n_trees=N_TREES,
+                             depth=DEPTH)
+
+    # VCTPU_THREADS=2 keeps streaming (and so the cache) eligible even
+    # when the bench host exposes a single core; save/restore the knobs
+    # this phase owns
+    saved = {k: os.environ.get(k)
+             for k in ("VCTPU_THREADS", "VCTPU_CACHE", "VCTPU_CACHE_DIR")}
+    os.environ.update(VCTPU_THREADS=os.environ.get("VCTPU_THREADS") or "2",
+                      VCTPU_CACHE="1", VCTPU_CACHE_DIR=store)
+    # The in-process serve phase leaves the daemon's resident warm index
+    # on; this phase measures the DISK tier, and the mixed leg's
+    # evictions must actually miss — pin resident off, restore after.
+    from variantcalling_tpu.io import chunk_cache
+    was_resident = chunk_cache.resident_stats()["resident"]
+    chunk_cache.resident_mode(False)
+    try:
+        from variantcalling_tpu import native
+
+        if native.available():
+            first_chunk = next(iter(VcfChunkReader(vcf_in,
+                                                   chunk_bytes=256 << 10)))
+            filter_variants(first_chunk, model, fasta)
+        # cache-off warm run: engine + .venc genome-encode cache
+        os.environ["VCTPU_CACHE"] = "0"
+        warm_stats = run_streaming(_fvp_args(vcf_in, out_path), model,
+                                   fasta, {}, None)
+        if warm_stats is None:  # streaming ineligible: no cache to bench
+            return {"mode": "serial-fallback",
+                    "note": "streaming ineligible; chunk cache inactive"}
+        os.environ["VCTPU_CACHE"] = "1"
+        print("BENCH_PHASE cache warmup done", flush=True)
+
+        legs: dict[str, dict] = {}
+        digests: dict[str, str] = {}
+
+        def leg(name: str, best_of: int = 1) -> None:
+            wall = stats = None
+            for _ in range(best_of):
+                ts = time.perf_counter()
+                s = run_streaming(_fvp_args(vcf_in, out_path), model,
+                                  fasta, {}, None)
+                dt = time.perf_counter() - ts
+                if wall is None or dt < wall:
+                    wall, stats = dt, s
+            digests[name] = hashlib.sha256(
+                normalize(open(out_path, "rb").read())).hexdigest()
+            legs[name] = {"wall_s": round(wall, 3),
+                          "vps": round(stats["n"] / wall),
+                          "cache": stats["cache"]}
+            print(f"BENCH_PHASE cache {name} leg done", flush=True)
+
+        leg("cold")
+        leg("warm", best_of=2)
+        # mixed leg: evict every 2nd entry — hits and misses interleave
+        # through the SAME sequenced commit, the hardest compressor-
+        # carry shape
+        entries = sorted(e for e in os.listdir(store)
+                         if e.endswith(".vcc"))
+        for name in entries[::2]:
+            os.remove(os.path.join(store, name))
+        leg("mixed")
+    finally:
+        chunk_cache.resident_mode(was_resident)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(store, ignore_errors=True)
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
+
+    match = digests["cold"] == digests["warm"] == digests["mixed"]
+    return {
+        "n": E2E_N,
+        "entries": len(entries),
+        "vps": {k: v["vps"] for k, v in legs.items()},
+        "wall_s": {k: v["wall_s"] for k, v in legs.items()},
+        "warm_hit_over_cold": round(legs["warm"]["vps"]
+                                    / legs["cold"]["vps"], 3),
+        "mixed_over_cold": round(legs["mixed"]["vps"]
+                                 / legs["cold"]["vps"], 3),
+        "counters": {k: v["cache"] for k, v in legs.items()},
+        "digest_state": "match" if match else "mismatch",
+        "bytes_identical": 1 if match else 0,
+        "digest_sha256": digests["cold"],
+        "engine": "native",
+    }
+
+
 def sec_fixture() -> np.ndarray:
     rng = np.random.default_rng(2)
     return rng.integers(0, 50, size=(SEC_SAMPLES, SEC_LOCI, SEC_ALLELES)).astype(np.float32)
@@ -1546,7 +1682,7 @@ def _engine_name() -> str:
 #: its own attribution. The `obs` phase is deliberately EXCLUDED (it
 #: measures off-vs-on itself — an ambient stream would contaminate the
 #: off leg), as is `scaling` (its serial legs compare raw stage walls).
-OBS_ATTRIBUTED_PHASES = ("e2e", "e2e_5m", "genome3g")
+OBS_ATTRIBUTED_PHASES = ("e2e", "e2e_5m", "genome3g", "cache")
 
 
 def _phase_attribution(log_path: str) -> dict | None:
@@ -1611,9 +1747,11 @@ def _phase_cpuledger(log_path: str) -> dict | None:
 def child_main(fixture_dir: str) -> None:
     t_start = time.time()
     # 420 -> 500 with the scaleout phase (two full fresh pod/CLI legs,
-    # ~40s): the committed artifact must stay self-contained through
-    # e2e_5m/genome3g (the round-5 VERDICT rule)
-    budget = float(os.environ.get("VCTPU_BENCH_CHILD_BUDGET", "500"))
+    # ~40s), 500 -> 560 with the cache phase (three fresh CLI legs, of
+    # which only the cold one pays full compute): the committed artifact
+    # must stay self-contained through e2e_5m/genome3g (the round-5
+    # VERDICT rule)
+    budget = float(os.environ.get("VCTPU_BENCH_CHILD_BUDGET", "560"))
     result: dict = {}
 
     def emit() -> None:
@@ -1773,6 +1911,13 @@ def child_main(fixture_dir: str) -> None:
         # across legs; parity + no-regression on this 2-core box
         phase("scaleout", lambda: scaleout_phase(fixture_dir),
               min_remaining=110)
+    if want("cache") and cpu:
+        # chunk-result cache (docs/caching.md): cold/warm/mixed CLI legs
+        # over one on-disk store, sha256 digest tripwire across legs;
+        # warm_hit_over_cold is the committed speedup, warm counters
+        # prove the hits came from the cache
+        phase("cache", lambda: cache_phase(fixture_dir),
+              min_remaining=150)
     # budgets rebalanced so the committed per-round artifact is
     # self-contained (round-5 VERDICT item 6: genome3g died mid-phase):
     # streaming e2e_5m ≈ fixture 50s + runs ~25s, genome3g ≈ fixture ~100s
@@ -2032,8 +2177,8 @@ def main(tpu_only: bool = False) -> None:
         out["device"] = child.get("device", "?")
         out["attempt"] = label
         for k in ("hot_small", "hot", "io", "mesh", "e2e", "obs", "serve",
-                  "scaleout", "e2e_5m", "genome3g", "scaling", "skipped",
-                  "phase_errors", "incomplete"):
+                  "scaleout", "cache", "e2e_5m", "genome3g", "scaling",
+                  "skipped", "phase_errors", "incomplete"):
             if k in child:
                 out[k] = child[k]
         def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:

@@ -259,6 +259,8 @@ def _pick_seed(layout="serial", max_faults=1, no_kill=True) -> int:
             continue
         if s.rank_kill is not None:
             continue  # pod schedules spawn 3 processes: own e2e below
+        if s.cache is not None:
+            continue  # cache schedules run 2-3 legs: own e2e coverage
         if any(f.seconds and f.seconds > 1 for f in s.faults):
             continue  # long-hang schedules cost wall time
         return seed

@@ -10,7 +10,7 @@ human diff would catch it. This tool is the gate:
   its direction and its noise band) and **exits 1 on any regression
   beyond the band**, 0 when clean, 2 on usage/IO errors.
 - ``python -m tools.bench_gate --run`` runs a fresh reduced bench
-  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve,scaleout``
+  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve,scaleout,cache``
   — the phases the gate reads) and compares it against the newest committed ``BENCH_r*.json``
   (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
   opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
@@ -203,6 +203,18 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("scaleout.vps.r2", "higher", 0.25),
     ("scaleout.scaling_r2_over_r1", "higher", 0.25),
     ("scaleout.bytes_identical", "nonzero", 0.0),
+    # -- content-addressed chunk cache (docs/caching.md): three fresh
+    #    CLI legs over one on-disk store. warm_hit_over_cold is the
+    #    headline — a fully-warm re-filter replays rendered bytes
+    #    instead of parse->featurize->score->render, so the ratio
+    #    collapsing toward 1.0 means the fast path quietly died (a key
+    #    spelling drift makes every warm leg miss, and ONLY this ratio
+    #    notices — byte parity still holds on a dead cache). The wide
+    #    band tolerates box mood on the warm leg's fixed startup cost.
+    #    bytes_identical is the presence tripwire twin of the
+    #    digest_state hard-fail below.
+    ("cache.warm_hit_over_cold", "higher", 0.40),
+    ("cache.bytes_identical", "nonzero", 0.0),
 )
 
 #: string-valued tripwires: (dotted path, forbidden value). The metric
@@ -218,6 +230,11 @@ FORBIDDEN_VALUES: tuple[tuple[str, str], ...] = (
     # — the bench phase records the comparison instead of raising, so
     # the failure mode is THIS hard gate, never a lost row
     ("scaleout.digest_state", "mismatch"),
+    # the cache digest tripwire: warm-hit and mixed hit/miss replays
+    # must reproduce the cold run's bytes modulo ##vctpu_* headers —
+    # a cache that serves stale or torn bodies fails HERE, hard, never
+    # as a silently-faster number
+    ("cache.digest_state", "mismatch"),
 )
 
 
@@ -387,14 +404,15 @@ def newest_committed_baseline() -> str | None:
     return best[1] if best else None
 
 
-def run_fresh_bench(timeout_s: int = 640) -> dict | None:
+def run_fresh_bench(timeout_s: int = 720) -> dict | None:
     """A reduced fresh bench (the gate's phases only) on the CPU engine;
     returns its parsed JSON or None with the failure printed. The
-    subprocess bound sits ABOVE bench.py's own budgets (child 500s,
-    parent 560s + retry logic) so the gate can never SIGKILL a bench
+    subprocess bound sits ABOVE bench.py's own budgets (child 560s,
+    parent + retry logic) so the gate can never SIGKILL a bench
     that its own budget logic would have finished self-contained."""
     env = dict(os.environ)
-    env["VCTPU_BENCH_PHASES"] = "hot_small,hot,io,mesh,e2e,obs,serve,scaleout"
+    env["VCTPU_BENCH_PHASES"] = \
+        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,cache"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

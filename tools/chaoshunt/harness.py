@@ -104,6 +104,10 @@ class Schedule:
     kill_after_chunks: int | None = None  # SIGKILL once N chunks journaled
     #: pod fault class: {"ranks": N, "kill_rank": r, "after_chunks": k}
     rank_kill: dict | None = None
+    #: chunk-cache fault class (docs/caching.md): {"mode": "poison"}
+    #: (bit-flipped entry bodies) or {"mode": "torn"} (SIGKILL inside an
+    #: entry write) — the cache must recompute, never serve wrong bytes
+    cache: dict | None = None
 
     def faults_env(self) -> str:
         return ",".join(f.spec() for f in self.faults)
@@ -112,7 +116,8 @@ class Schedule:
         return {"seed": self.seed, "layout": self.layout,
                 "faults": [f.to_json() for f in self.faults],
                 "kill_after_chunks": self.kill_after_chunks,
-                "rank_kill": self.rank_kill}
+                "rank_kill": self.rank_kill,
+                "cache": self.cache}
 
     @staticmethod
     def from_json(d: dict) -> "Schedule":
@@ -121,7 +126,8 @@ class Schedule:
                         faults=[FaultSpec.from_json(f)
                                 for f in d.get("faults", [])],
                         kill_after_chunks=d.get("kill_after_chunks"),
-                        rank_kill=d.get("rank_kill"))
+                        rank_kill=d.get("rank_kill"),
+                        cache=d.get("cache"))
 
     def describe(self) -> str:
         parts = [self.layout]
@@ -133,6 +139,8 @@ class Schedule:
             parts.append(f"rank_kill r{self.rank_kill['kill_rank']}"
                          f"/{self.rank_kill['ranks']}"
                          f"@{self.rank_kill['after_chunks']}ch")
+        if self.cache is not None:
+            parts.append(f"cache_{self.cache['mode']}")
         return " ".join(parts)
 
 
@@ -147,11 +155,18 @@ def draw_schedule(seed: int) -> Schedule:
     modes = ["transient", "persistent", "hang", "kill", "commit", "mixed",
              "rank_kill"]
     if layout == "mesh2":
+        # the mesh megabatch layout bypasses the chunk cache, so cache
+        # fault classes are drawn on the host layouts only
         modes.append("oom")
+    else:
+        modes += ["cache_poison", "cache_torn"]
     mode = rng.choice(modes)
     faults: list[FaultSpec] = []
     kill = None
     rank_kill = None
+    if mode in ("cache_poison", "cache_torn"):
+        return Schedule(seed=seed, layout=layout,
+                        cache={"mode": mode.removeprefix("cache_")})
     if mode == "rank_kill":
         # pod fault class (docs/scaleout.md): a 2-rank local-launcher
         # run; one worker rank is SIGKILLed once its SEGMENT journal
@@ -246,7 +261,8 @@ def _layout_env(layout: str) -> dict:
     raise ValueError(f"unknown layout {layout!r}")
 
 
-def _child_env(layout: str, faults_spec: str = "") -> dict:
+def _child_env(layout: str, faults_spec: str = "",
+               extra_env: dict | None = None) -> dict:
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("VCTPU_") and k not in ("XLA_FLAGS",
                                                        "PYTHONPATH")}
@@ -257,6 +273,8 @@ def _child_env(layout: str, faults_spec: str = "") -> dict:
     env.update(_layout_env(layout))
     if faults_spec:
         env["VCTPU_FAULTS"] = faults_spec
+    if extra_env:
+        env.update(extra_env)
     return env
 
 
@@ -298,7 +316,8 @@ def build_fixtures(workdir: str, records: int = 2000) -> Fixtures:
 
 def run_leg(fx: Fixtures, out: str, layout: str, faults_spec: str,
             kill_after_chunks: int | None,
-            sabotage: str | None = None) -> dict:
+            sabotage: str | None = None,
+            extra_env: dict | None = None) -> dict:
     """Run the filter CLI once in a subprocess; returns the leg record
     (rc, killed, status, sidecar presence)."""
     status_path = out + ".chaos_status.json"
@@ -307,7 +326,7 @@ def run_leg(fx: Fixtures, out: str, layout: str, faults_spec: str,
         json.dump({"input": fx.input_vcf, "model": fx.model, "ref": fx.ref,
                    "out": out, "status": status_path,
                    "sabotage": sabotage}, fh)
-    env = _child_env(layout, faults_spec)
+    env = _child_env(layout, faults_spec, extra_env)
     argv = [sys.executable, "-c", _DRIVER, cfg_path]
     killed = False
     if kill_after_chunks is None:
@@ -548,14 +567,95 @@ def run_rank_kill_schedule(sched: Schedule, fx: Fixtures,
             "violations": violations}
 
 
+def run_cache_schedule(sched: Schedule, fx: Fixtures, workdir: str) -> dict:
+    """The chunk-cache fault classes (docs/caching.md): the cache may
+    only ever DEGRADE a run to cold — wrong bytes are the violation.
+
+    - ``cache_poison``: a cold leg populates a fresh store, every
+      entry's body gets one bit flipped, then a warm leg must detect the
+      corruption (CRC), recompute, and still produce the reference
+      bytes.
+    - ``cache_torn``: a leg is SIGKILLed inside an entry write (the
+      ``cache.entry_write`` hang window), then a fault-free leg over the
+      same store must complete byte-identically — a torn tmp file can
+      never be served.
+
+    Children pin ``VCTPU_THREADS=2``: the cache rides the streaming
+    executor, which degrades to the (cache-less) serial path on a
+    single-core host — the schedule must exercise the store either way.
+    """
+    import shutil
+
+    mode = sched.cache["mode"]
+    out = os.path.join(workdir, f"seed{sched.seed}_cache.vcf")
+    store = os.path.join(workdir, f"seed{sched.seed}_cache_store")
+    shutil.rmtree(store, ignore_errors=True)
+    _remove_run_files(out)
+    cache_env = {"VCTPU_CACHE": "1", "VCTPU_CACHE_DIR": store,
+                 "VCTPU_THREADS": "2"}
+    legs: list[dict] = []
+    violations: list[str] = []
+
+    def check_clean(leg: dict, name: str) -> None:
+        if leg["rc"] != 0:
+            violations.append(f"{name}: leg failed rc={leg['rc']}"
+                              + (f", {leg['status'].get('error')}"
+                                 if leg["status"] else ""))
+        else:
+            violations.extend(_check_leg(leg, fx, out, name,
+                                         prior_bytes=None))
+
+    if mode == "poison":
+        leg1 = run_leg(fx, out, sched.layout, sched.faults_env(), None,
+                       extra_env=cache_env)
+        legs.append(dict(leg1, name="populate"))
+        check_clean(leg1, "populate")
+        entries = [os.path.join(store, n) for n in
+                   (os.listdir(store) if os.path.isdir(store) else [])
+                   if n.endswith(".vcc")]
+        if not violations and not entries:
+            violations.append("populate: cold leg published no cache "
+                              "entries (store never engaged)")
+        for p in entries:
+            with open(p, "r+b") as fh:
+                data = bytearray(fh.read())
+                data[len(data) // 2] ^= 0x01
+                fh.seek(0)
+                fh.write(bytes(data))
+        leg2 = run_leg(fx, out, sched.layout, sched.faults_env(), None,
+                       extra_env=cache_env)
+        legs.append(dict(leg2, name="poisoned-warm"))
+        check_clean(leg2, "poisoned-warm")
+    else:  # torn: SIGKILL inside the first entry write
+        spec = ",".join(filter(None, [sched.faults_env(),
+                                      "cache.entry_write:1@30"]))
+        leg1 = run_leg(fx, out, sched.layout, spec, 1, extra_env=cache_env)
+        legs.append(dict(leg1, name="torn"))
+        violations.extend(_check_leg(leg1, fx, out, "torn",
+                                     prior_bytes=None))
+        leg2 = run_leg(fx, out, sched.layout, "", None, extra_env=cache_env)
+        legs.append(dict(leg2, name="recover"))
+        check_clean(leg2, "recover")
+    _remove_run_files(out, (".obs.jsonl",))
+    shutil.rmtree(store, ignore_errors=True)
+    return {"schedule": sched.to_json(), "describe": sched.describe(),
+            "legs": [{k: leg[k] for k in
+                      ("name", "rc", "killed", "partial", "journal")}
+                     for leg in legs],
+            "violations": violations}
+
+
 def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
                  sabotage: str | None = None) -> dict:
     """One schedule end to end: the faulted fresh leg, then — whenever
     the faulted leg left a resumable journal (or was killed) — a
     fault-free RESUME leg that must complete byte-identically.
-    ``rank_kill`` schedules route to the pod harness."""
+    ``rank_kill`` schedules route to the pod harness, ``cache``
+    schedules to the chunk-cache harness."""
     if sched.rank_kill is not None:
         return run_rank_kill_schedule(sched, fx, workdir)
+    if sched.cache is not None:
+        return run_cache_schedule(sched, fx, workdir)
     out = os.path.join(workdir, f"seed{sched.seed}.vcf")
     _remove_run_files(out)
     violations: list[str] = []
@@ -596,6 +696,10 @@ def _simplifications(sched: Schedule):
         # does the violation need the pod at all? dropping rank_kill
         # degrades the schedule to the ordinary single-process flow
         yield dataclasses.replace(sched, rank_kill=None)
+    if sched.cache is not None:
+        # does the violation need the cache? dropping it degrades the
+        # schedule to the ordinary (cache-off) single-process flow
+        yield dataclasses.replace(sched, cache=None)
     if sched.kill_after_chunks is not None:
         yield dataclasses.replace(sched, kill_after_chunks=None)
     for i in range(len(sched.faults)):

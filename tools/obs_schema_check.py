@@ -61,6 +61,11 @@ def main() -> int:
         obs.event("heartbeat", "stream", chunks=1, records=128, vps=1000,
                   pct=50.0, eta_s=1.0)
         obs.event("journal", "resume_decision", outcome="fresh")
+        obs.counter("cache.hit").add(3)
+        obs.counter("cache.miss").add(1)
+        obs.counter("cache.bytes_saved").add(4096)
+        obs.event("cache", "session", hits=3, misses=1, bytes_saved=4096,
+                  published=1)
         # obs v2 profile producers (attribution events + bottleneck surface)
         obs.event("profile", "stage", stage="score_stage", work_s=0.5,
                   wait_in_s=0.1, wait_out_s=0.0, items=1, records=128)
@@ -123,7 +128,7 @@ def main() -> int:
         parsed = [json.loads(ln) for ln in lines]
         kinds = {e["kind"] for e in parsed}
         for required in ("manifest", "span", "degrade", "fault", "heartbeat",
-                         "journal", "profile", "trace", "snapshot",
+                         "journal", "cache", "profile", "trace", "snapshot",
                          "sample", "recovery", "metrics", "run_end"):
             if required not in kinds:
                 errors.append(f"stream is missing a {required!r} event")

@@ -1,0 +1,462 @@
+"""Content-addressed chunk-result cache — stop recomputing what heavy
+traffic repeats (docs/caching.md).
+
+Real request streams repeat: the same reference panels, the same exome
+intervals, the same callset re-filtered with one knob changed. The
+streaming executor's structure already makes chunk results pure —
+chunk boundaries are a function of (input bytes, chunk_bytes), every
+per-variant product is row-local, and the resume journal proves rendered
+bytes are a pure function of (input span, scoring config). This module
+promotes that proof from within one run (resume) to ACROSS runs and
+requests: a bounded store of rendered chunk bodies keyed by
+
+    ``<fingerprint[:16]>-<crc32(raw span)>-<len(raw span)>``
+
+where the fingerprint is :func:`io.identity.fingerprint` over the SAME
+``config`` dict the resume journal pins (engine, strategy, mesh/rank
+layout, model/flags/files — scoring-relevant knobs ONLY, so an
+io-thread or obs change still hits). Values are UNCOMPRESSED rendered
+plain-text bodies plus their (records, pass) counts: a ``.gz`` run
+recompresses replayed bodies through the live BGZF carry, so output
+framing stays byte-identical to a cold run at any hit/miss interleaving.
+
+Three tiers share the store machinery:
+
+- **batch CLI** — :class:`DiskStore` under ``VCTPU_CACHE_DIR``:
+  atomic per-entry write (tmp + ``os.replace``; a SIGKILL mid-write
+  leaves only swept tmp garbage, never a torn entry), CRC-verified
+  read (a poisoned/torn entry is evicted and recomputed — the cache can
+  DEGRADE a run to cold, never corrupt it), mtime-LRU bound by
+  ``VCTPU_CACHE_MAX_MB``;
+- **vctpu serve** — an in-process :class:`MemoryStore` warm index
+  shared across requests (:func:`resident_mode`), consulted before
+  disk and warmed by disk hits;
+- **rank-partitioned pod** — per-rank subdirectories
+  (``rank{r}of{n}``): the deterministic cut rule means a rank's spans
+  re-key identically across runs, and sibling ranks never contend on
+  one LRU.
+
+Publication is **committed-prefix only**: workers STAGE computed
+entries by chunk sequence number, and the sequenced committer publishes
+them only after the chunk's bytes are in the partial file (and
+journaled). A cancelled serve request, a failed run, or a SIGKILL
+therefore never publishes an entry for bytes no output carried — the
+warm index is exactly as the request found it.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+import threading
+import zlib
+from collections import OrderedDict
+
+from variantcalling_tpu import knobs, logger
+from variantcalling_tpu.io import identity as identity_mod
+from variantcalling_tpu.utils import degrade, faults
+
+#: on-disk entry framing: magic, n_records, n_pass, body_len, body_crc32
+_MAGIC = b"VCC1"
+_HDR = struct.Struct("<4sIIQI")
+ENTRY_SUFFIX = ".vcc"
+_TMP_PREFIX = ".vcc_tmp_"
+#: tmp files older than this are torn leftovers of a killed writer
+_STALE_TMP_S = 300.0
+
+
+def enabled() -> bool:
+    """Opt-in (``VCTPU_CACHE=1``): default off so existing baselines,
+    byte-parity suites and air-gapped runs are untouched."""
+    return knobs.get_bool("VCTPU_CACHE")
+
+
+def store_dir() -> str:
+    d = knobs.get_str("VCTPU_CACHE_DIR")
+    return d or os.path.join(os.path.expanduser("~"), ".cache", "vctpu",
+                             "chunks")
+
+
+def max_bytes() -> int:
+    return knobs.get_int("VCTPU_CACHE_MAX_MB") << 20
+
+
+def _encode(body: bytes, records: int, passed: int) -> bytes:
+    return _HDR.pack(_MAGIC, records, passed, len(body),
+                     zlib.crc32(body)) + body
+
+
+def _decode(blob: bytes) -> tuple[bytes, int, int] | None:
+    """Parse + verify one stored entry; ``None`` for ANYTHING suspicious
+    (short read, bad magic, length mismatch, CRC mismatch) — the caller
+    treats it as a miss and recomputes."""
+    if len(blob) < _HDR.size:
+        return None
+    magic, records, passed, body_len, crc = _HDR.unpack_from(blob)
+    if magic != _MAGIC or len(blob) != _HDR.size + body_len:
+        return None
+    body = blob[_HDR.size:]
+    if zlib.crc32(body) != crc:
+        return None
+    return body, records, passed
+
+
+class DiskStore:
+    """One directory of ``<key>.vcc`` entries, LRU-bounded by mtime.
+
+    Concurrency: safe for many processes (the pod tier gives each rank
+    its own directory, but nothing breaks without that) — writes are
+    atomic renames, reads tolerate concurrent eviction, and the bound
+    enforcement treats every stat/remove as best-effort.
+    """
+
+    def __init__(self, root: str, bound: int):
+        self.root = root
+        self.bound = bound
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+        self._sweep_tmp()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + ENTRY_SUFFIX)
+
+    def _sweep_tmp(self) -> None:
+        """Collect torn tmp files a SIGKILLed writer left behind —
+        age-gated so a live concurrent writer's in-flight tmp survives."""
+        import time
+
+        now = time.time()  # vctpu-lint: disable=VCT006 — stale-file age gate, not a measurement
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            p = os.path.join(self.root, name)
+            try:
+                if now - os.stat(p).st_mtime > _STALE_TMP_S:
+                    os.remove(p)
+            except OSError:
+                pass
+
+    def get(self, key: str) -> tuple[bytes, int, int] | None:
+        path = self._path(key)
+        try:
+            faults.check("cache.entry_read")
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            degrade.record("chunk_cache.entry_read", e,
+                           fallback="treated as a miss — chunk recomputed")
+            return None
+        ent = _decode(blob)
+        if ent is None:
+            # poisoned/torn entry: never serve it, never trust it again —
+            # evict so the recomputed result can take the slot
+            logger.warning("chunk cache: corrupt entry %s — evicted, "
+                           "recomputing", path)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            pass
+        return ent
+
+    def put(self, key: str, body: bytes, records: int, passed: int) -> None:
+        fd, tmp = tempfile.mkstemp(prefix=_TMP_PREFIX, dir=self.root)
+        try:
+            # injection point "cache.entry_write": armed with a delay it
+            # hangs HERE, mid-entry-write — the chaoshunt ``cache_torn``
+            # class SIGKILLs the process in this window, leaving only the
+            # tmp file (swept later), never a torn published entry
+            faults.check("cache.entry_write")
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(_encode(body, records, passed))
+            os.replace(tmp, self._path(key))
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        self._enforce_bound()
+
+    def _enforce_bound(self) -> None:
+        """Evict least-recently-USED (mtime — reads touch) entries until
+        the directory fits the byte bound. Races with concurrent ranks/
+        processes resolve to at-worst extra eviction, never corruption."""
+        with self._lock:
+            try:
+                names = os.listdir(self.root)
+            except OSError:
+                return
+            entries = []
+            total = 0
+            for name in names:
+                if not name.endswith(ENTRY_SUFFIX):
+                    continue
+                p = os.path.join(self.root, name)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+            if total <= self.bound:
+                return
+            for _, size, p in sorted(entries):
+                try:
+                    os.remove(p)
+                except OSError:
+                    continue
+                total -= size
+                if total <= self.bound:
+                    break
+
+    def stats(self) -> dict:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return {"entries": 0, "bytes": 0}
+        n = b = 0
+        for name in names:
+            if name.endswith(ENTRY_SUFFIX):
+                try:
+                    b += os.stat(os.path.join(self.root, name)).st_size
+                except OSError:
+                    continue
+                n += 1
+        return {"entries": n, "bytes": b}
+
+
+class MemoryStore:
+    """Byte-bounded in-process LRU — the ``vctpu serve`` warm index.
+    Entries are immutable bytes; all map/size state is lock-protected
+    (requests look up from pooled worker threads)."""
+
+    def __init__(self, bound: int):
+        self.bound = bound
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, tuple[bytes, int, int]] = OrderedDict()
+        self._bytes = 0
+
+    def get(self, key: str) -> tuple[bytes, int, int] | None:
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+            return ent
+
+    def put(self, key: str, body: bytes, records: int, passed: int) -> None:
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old[0])
+            self._entries[key] = (body, records, passed)
+            self._bytes += len(body)
+            while self._bytes > self.bound and self._entries:
+                _, (b, _k, _p) = self._entries.popitem(last=False)
+                self._bytes -= len(b)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+
+#: resident warm index (vctpu serve): created on first use AFTER the
+#: daemon opted in, shared across requests for the process lifetime
+_RESIDENT = False
+_MEMORY: MemoryStore | None = None
+_MEMORY_LOCK = threading.Lock()
+
+#: process-cumulative session tallies (serve status/debuggability);
+#: updated under _MEMORY_LOCK at session finish
+_TOTALS = {"sessions": 0, "hits": 0, "misses": 0, "bytes_saved": 0,
+           "published": 0}
+
+
+def resident_mode(on: bool = True) -> None:
+    """Opt this process into the in-memory warm index (the serve daemon
+    calls this at startup). Batch CLIs skip it: a one-shot run would
+    only duplicate every rendered body in RAM."""
+    global _RESIDENT
+    with _MEMORY_LOCK:
+        _RESIDENT = on
+
+
+def _memory_store() -> MemoryStore | None:
+    global _MEMORY
+    with _MEMORY_LOCK:
+        if not _RESIDENT:
+            return None
+        if _MEMORY is None:
+            _MEMORY = MemoryStore(max_bytes())
+        return _MEMORY
+
+
+def resident_stats() -> dict:
+    """Serve ``/status`` payload: warm-index size + cumulative traffic."""
+    with _MEMORY_LOCK:
+        out = dict(_TOTALS, enabled=enabled(), resident=_RESIDENT)
+        mem = _MEMORY
+    out["memory"] = mem.stats() if mem is not None else {"entries": 0,
+                                                         "bytes": 0}
+    return out
+
+
+def reset_for_tests() -> None:
+    global _RESIDENT, _MEMORY
+    with _MEMORY_LOCK:
+        _RESIDENT = False
+        _MEMORY = None
+        for k in _TOTALS:
+            _TOTALS[k] = 0
+
+
+class CacheSession:
+    """One run's view over the stores: fingerprint-scoped keys, counted
+    lookups, and committed-prefix publication.
+
+    Thread contract: :meth:`key_of`/:meth:`get`/:meth:`stage` run on
+    pooled chunk workers; :meth:`publish_up_to`/:meth:`discard`/
+    :meth:`finish` run on the sequenced committer. Shared tallies and
+    the staging map are lock-protected.
+    """
+
+    def __init__(self, fp: str, stores: list):
+        self.fingerprint = fp
+        self._fp16 = fp[:16]
+        self._stores = stores  # consult order: memory (if any), disk
+        self._lock = threading.Lock()
+        self._staged: dict[int, tuple[str, object, int, int]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.bytes_saved = 0
+        self.published = 0
+
+    def key_of(self, raw) -> str:
+        """Content address of one raw input span under this config.
+        CRC32 (GIL-releasing, ~1 GB/s) + span length over the UNPARSED
+        chunk bytes — the same span identity the resume journal's
+        chunk-boundary argument rests on."""
+        return (f"{self._fp16}-{zlib.crc32(raw) & 0xFFFFFFFF:08x}-"
+                f"{len(raw)}")
+
+    def get(self, key: str) -> tuple[bytes, int, int] | None:
+        from variantcalling_tpu import obs
+
+        for i, store in enumerate(self._stores):
+            ent = store.get(key)
+            if ent is None:
+                continue
+            body, records, passed = ent
+            if i > 0 and self._stores and \
+                    isinstance(self._stores[0], MemoryStore):
+                # a disk hit warms the resident index for the NEXT request
+                self._stores[0].put(key, bytes(body), records, passed)
+            with self._lock:
+                self.hits += 1
+                self.bytes_saved += len(body)
+            if obs.active():
+                obs.counter("cache.hit").add(1)
+                obs.counter("cache.bytes_saved").add(len(body))
+            return body, records, passed
+        with self._lock:
+            self.misses += 1
+        if obs.active():
+            obs.counter("cache.miss").add(1)
+        return None
+
+    def stage(self, seq: int, key: str, body, records: int,
+              passed: int) -> None:
+        """Hold a computed entry until its chunk COMMITS. ``body`` may
+        be an ndarray view; it is copied to bytes at publish time (the
+        committer), never on the worker's hot path."""
+        with self._lock:
+            self._staged[seq] = (key, body, records, passed)
+
+    def publish_up_to(self, seq: int) -> None:
+        """Publish every staged entry whose chunk sequence number is
+        ``<= seq`` — called by the committer AFTER those bytes reached
+        the sink (and the journal, when journaling). Store failures
+        degrade (entry dropped), never fail the run."""
+        with self._lock:
+            ready = sorted(s for s in self._staged if s <= seq)
+            items = [(s, self._staged.pop(s)) for s in ready]
+        for _s, (key, body, records, passed) in items:
+            blob = body if isinstance(body, bytes) else bytes(body)
+            for store in self._stores:
+                try:
+                    store.put(key, blob, records, passed)
+                except OSError as e:
+                    degrade.record(
+                        "chunk_cache.entry_write", e, warn=True,
+                        fallback="cache entry dropped — output unaffected")
+            with self._lock:
+                self.published += 1
+
+    def discard(self) -> None:
+        """Failure/cancellation path: drop everything unpublished — a
+        dead request leaves the warm index exactly as it found it."""
+        with self._lock:
+            self._staged.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "bytes_saved": self.bytes_saved,
+                    "published": self.published}
+
+    def finish(self) -> None:
+        """End-of-run bookkeeping: one ``cache`` obs event with the
+        session's traffic, rolled into the process totals serve's
+        ``/status`` reports."""
+        from variantcalling_tpu import obs
+
+        st = self.stats()
+        with _MEMORY_LOCK:
+            _TOTALS["sessions"] += 1
+            _TOTALS["hits"] += st["hits"]
+            _TOTALS["misses"] += st["misses"]
+            _TOTALS["bytes_saved"] += st["bytes_saved"]
+            _TOTALS["published"] += st["published"]
+        if obs.active():
+            obs.event("cache", "session", **st)
+
+
+def open_session(config: dict, rank: int = 0,
+                 ranks: int = 1) -> CacheSession | None:
+    """The one constructor (``pipelines/filter_variants.py``): ``None``
+    when the cache is off; otherwise a session over the resident memory
+    index (serve) and/or the on-disk store. An unusable cache directory
+    degrades to whatever stores remain — never fails the run."""
+    if not enabled():
+        return None
+    fp = identity_mod.fingerprint(config)
+    stores: list = []
+    mem = _memory_store()
+    if mem is not None:
+        stores.append(mem)
+    root = store_dir()
+    if ranks > 1:
+        # per-rank stores: the deterministic cut rule re-keys a rank's
+        # spans identically across runs of the same layout, and sibling
+        # ranks never contend on one directory's LRU (docs/scaleout.md)
+        root = os.path.join(root, f"rank{rank}of{ranks}")
+    try:
+        stores.append(DiskStore(root, max_bytes()))
+    except OSError as e:
+        degrade.record("chunk_cache.store_open", e, warn=True,
+                       fallback="chunk cache disabled for this run"
+                       if not stores else "in-memory warm index only")
+    if not stores:
+        return None
+    return CacheSession(fp, stores)

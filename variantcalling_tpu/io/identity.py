@@ -1,0 +1,156 @@
+"""The ONE spelling of run identity — what makes scored bytes a pure
+function of input.
+
+Three subsystems must agree, byte for byte, on "same configuration":
+
+- the resume journal (``io/journal.py``): already-committed chunks carry
+  the old run's scores, so resuming under a different model/flags/engine
+  would atomically commit a silently mixed output;
+- the rank-segment markers (``parallel/rank_plan.py``): a completed
+  segment is reusable only for the exact configuration that produced it;
+- the chunk-result cache (``io/chunk_cache.py``): a cached rendered body
+  may replay into a run only when every scoring-relevant input is
+  identical — and MUST still replay when only scoring-IRRELEVANT knobs
+  (io threads, obs, heartbeat cadence) changed, or the cache never hits.
+
+Before this module each consumer spelled the identity dict inline; a
+field added to one spelling but not another would silently weaken resume
+safety or cache correctness. Now they all call :func:`scoring_fields` /
+:func:`scoring_config` / :func:`resume_meta`, and
+``tests/unit/test_chunk_cache.py`` locks the single-source-of-truth
+property (the journal's config sub-dict IS the cache fingerprint input).
+
+``input_signature`` lives here (journal re-exports it for callers of the
+old spelling): the (size, mtime_ns) stat pair that pins a referenced
+file without reading it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+
+def input_signature(path: str) -> list[int]:
+    """Cheap identity of a referenced file: (size, mtime_ns). Pins the
+    file across runs without reading it — any rewrite, even same-size,
+    bumps mtime_ns on every filesystem we target."""
+    st = os.stat(path)
+    return [int(st.st_size), int(st.st_mtime_ns)]
+
+
+def file_sig(path: str | None) -> list | None:
+    """``[abspath, size, mtime_ns]`` of an optional referenced file —
+    the journal's ``_file_sig`` spelling, shared."""
+    return None if not path else [os.path.abspath(path),
+                                  *input_signature(path)]
+
+
+def scoring_fields(args) -> dict:
+    """The args-derived scoring identity: every flag/file that changes
+    what TREE_SCORE/FILTER a record gets. Keys and value spellings are
+    load-bearing — the journal header, segment markers and cache
+    fingerprints are all built from this dict, so renaming a key
+    invalidates (safely: recompute) every persisted identity."""
+    return {
+        "model_file": file_sig(getattr(args, "model_file", None)),
+        "model_name": getattr(args, "model_name", None),
+        "runs_file": file_sig(getattr(args, "runs_file", None)),
+        "blacklist": file_sig(getattr(args, "blacklist", None)),
+        "blacklist_cg_insertions": bool(
+            getattr(args, "blacklist_cg_insertions", False)),
+        "hpol": [int(v) for v in getattr(args, "hpol_filter_length_dist",
+                                         [10, 10])],
+        "flow_order": getattr(args, "flow_order", "TGCA"),
+        "is_mutect": bool(getattr(args, "is_mutect", False)),
+        "annotate_intervals": sorted(
+            os.path.abspath(p)
+            for p in (getattr(args, "annotate_intervals", None) or [])),
+    }
+
+
+def scoring_config(args, engine: str | None, forest_strategy: str | None,
+                   mesh_devices: int, rank: int, ranks: int) -> dict:
+    """The FULL scoring configuration: args-derived fields plus the
+    resolved execution selection. This is the journal's ``config``
+    sub-dict AND the chunk cache's fingerprint input — one object, so
+    the two can never diverge.
+
+    Why each execution field is identity (and io-threads/obs are NOT):
+
+    - ``engine``/``forest_strategy``: every strategy is parity-tested
+      byte-identical, but the identity pins the FULL scoring
+      configuration (PR-2 contract) — provenance headers differ, and a
+      parity regression must never be masked by a stale reuse;
+    - ``mesh_devices``: record bytes are device-count-invariant but the
+      provenance HEADER differs (``##vctpu_mesh=``), so a reuse across
+      mesh layouts would splice mismatched provenance;
+    - ``ranks``: the rank layout partitions the CHUNK SEQUENCE itself —
+      a journal/segment/cache span written by rank r of n describes r's
+      spans only (docs/scaleout.md). The deterministic cut rule means a
+      rank's spans re-key identically across runs of the same layout.
+    """
+    cfg = scoring_fields(args)
+    cfg["engine"] = engine
+    cfg["forest_strategy"] = forest_strategy
+    cfg["mesh_devices"] = mesh_devices
+    cfg["ranks"] = [rank, ranks]
+    return cfg
+
+
+def resume_meta(args, chunk_bytes: int, header_bytes: bytes,
+                config: dict) -> dict:
+    """The journal header identity: the exact input file + chunking +
+    output header this partial was produced under, wrapping the shared
+    scoring ``config``. Chunk boundaries are a pure function of (input
+    bytes, chunk_bytes), so pinning both makes "skip the journaled
+    prefix" byte-safe; the header length/CRC pin the provenance lines a
+    resumed tail is spliced after."""
+    import zlib
+
+    return {
+        "input": os.path.abspath(args.input_file),
+        "input_sig": input_signature(args.input_file),
+        "chunk_bytes": int(chunk_bytes),
+        "header_len": len(header_bytes),
+        "header_crc": zlib.crc32(header_bytes),
+        "config": config,
+    }
+
+
+def fingerprint(config: dict) -> str:
+    """Content address of a scoring configuration: sha256 over the
+    canonical (sorted-keys, compact) JSON encoding. The cache composes
+    this with the raw input span's CRC32 to key stored chunk results;
+    canonical encoding means a dict built twice from the same inputs —
+    or loaded back from a journal header — fingerprints identically."""
+    blob = json.dumps(config, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()
+
+
+def describe_mismatch(old: dict, new: dict, _prefix: str = "",
+                      _limit: int = 6) -> str:
+    """Human-readable field-level diff of two identity dicts — the
+    resume/invalidation debuggability fix: production logs must say
+    WHICH field invalidated a journal (or would invalidate a cache),
+    not just that one did. Returns e.g.
+    ``config.engine: journal='jit' run='native'``."""
+    diffs: list[str] = []
+
+    def walk(o, n, prefix):
+        if len(diffs) >= _limit:
+            return
+        if isinstance(o, dict) and isinstance(n, dict):
+            for k in sorted(set(o) | set(n)):
+                walk(o.get(k), n.get(k),
+                     f"{prefix}.{k}" if prefix else str(k))
+            return
+        if o != n:
+            diffs.append(f"{prefix}: journal={o!r} run={n!r}")
+
+    walk(old, new, _prefix)
+    if not diffs:
+        return "no field-level difference (type/shape change)"
+    return "; ".join(diffs[:_limit])

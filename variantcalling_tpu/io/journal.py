@@ -161,9 +161,10 @@ def journal_path(out_path: str) -> str:
     return str(out_path) + JOURNAL_SUFFIX
 
 
-def input_signature(path: str) -> list[int]:
-    st = os.stat(path)
-    return [int(st.st_size), int(st.st_mtime_ns)]
+# the (size, mtime_ns) input pin moved to io/identity.py — the ONE
+# spelling shared with the segment markers and the chunk cache; this
+# re-export keeps the journal's historical import surface working
+from variantcalling_tpu.io.identity import input_signature  # noqa: F401
 
 
 @dataclass
@@ -292,7 +293,15 @@ def _try_resume(out_path: str, meta: dict,
     jmeta, entries = loaded
     expect = dict(meta, version=_VERSION)
     if {k: jmeta.get(k) for k in expect} != expect:
-        logger.info("streaming resume: journal identity mismatch — fresh run")
+        # say WHICH field invalidated the journal (old vs new value):
+        # resume/cache invalidation must be debuggable from production
+        # logs, not reproducible-only (io/identity.describe_mismatch)
+        from variantcalling_tpu.io import identity as identity_mod
+
+        logger.info("streaming resume: journal identity mismatch (%s) — "
+                    "fresh run",
+                    identity_mod.describe_mismatch(
+                        {k: jmeta.get(k) for k in expect}, expect))
         return None
     if not entries:
         return None

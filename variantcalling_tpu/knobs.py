@@ -187,6 +187,18 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
     _k("VCTPU_ALL_RANKS_WRITE", "bool", False,
        "let every rank write its own output copy (default: rank 0 only)"),
     # -- caches / IO ----------------------------------------------------
+    _k("VCTPU_CACHE", "bool", False,
+       "content-addressed chunk-result cache: replay rendered chunk "
+       "bodies keyed on (input span CRC, scoring identity) instead of "
+       "recomputing them (OPT-IN; byte-identical output either way — "
+       "docs/caching.md)"),
+    _k("VCTPU_CACHE_DIR", "str", "",
+       "chunk-result cache directory (default ~/.cache/vctpu/chunks; "
+       "rank-partitioned runs use per-rank subdirectories)"),
+    _k("VCTPU_CACHE_MAX_MB", "int", 512,
+       "chunk-result cache size bound in MiB (LRU eviction; bounds the "
+       "on-disk store and the serve daemon's in-memory warm index "
+       "separately)", positive=True),
     _k("VCTPU_COMPILE_CACHE", "str", None,
        "persistent XLA compilation cache dir; empty string disables; "
        "default ~/.cache/vctpu/xla"),

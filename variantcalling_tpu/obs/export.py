@@ -278,6 +278,19 @@ def summarize(events: list[dict]) -> dict:
             recoveries[e.get("name", "?")] = \
                 recoveries.get(e.get("name", "?"), 0) + 1
 
+    # chunk-cache roll-up (docs/caching.md): the final metrics snapshot
+    # carries the cache.hit / cache.miss / cache.bytes_saved counters the
+    # filter pipeline maintains; a stream with no cache traffic (cache
+    # off, or predating the cache) rolls up to None, not zeros
+    cache = None
+    m_counters = (_args_of(metrics).get("counters") or {}) if metrics else {}
+    c_hits = int(m_counters.get("cache.hit", 0))
+    c_misses = int(m_counters.get("cache.miss", 0))
+    if c_hits or c_misses:
+        cache = {"hits": c_hits, "misses": c_misses,
+                 "bytes_saved": int(m_counters.get("cache.bytes_saved", 0)),
+                 "hit_rate": round(c_hits / (c_hits + c_misses), 4)}
+
     slowest = sorted(chunk_spans, key=lambda e: -float(e.get("dur", 0.0)))[:5]
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
     # multi-rank merged timelines (read_run): each rank reported its own
@@ -311,6 +324,7 @@ def summarize(events: list[dict]) -> dict:
         "degradations": degradations,
         "faults": faults,
         "recoveries": recoveries,
+        "cache": cache,
         "slowest_chunks": [{"name": e.get("name"), "chunk": e.get("chunk"),
                             "dur_s": round(float(e.get("dur", 0.0)), 6)}
                            for e in slowest],
@@ -579,6 +593,11 @@ def render_summary(summary: dict) -> str:
         lines.append(f"throughput: {tp['records']} records"
                      + (f" ({tp['records_per_s']}/s)"
                         if tp.get("records_per_s") else ""))
+    if summary.get("cache"):
+        c = summary["cache"]
+        lines.append(f"chunk cache: {c['hits']} hit / {c['misses']} miss "
+                     f"({c['hit_rate']:.0%} hit rate), "
+                     f"{c['bytes_saved']} rendered bytes replayed")
     if summary["degradations"]:
         lines.append("degradations: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(summary["degradations"].items())))

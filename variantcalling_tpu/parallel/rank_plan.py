@@ -179,40 +179,25 @@ def segment_identity(args, plan: RankPlan,
                      engine_name: str | None = None) -> dict:
     """The identity a completed segment is valid FOR: input + model +
     every scoring flag + the rank layout + the engine-selection env.
-    Mirrors the streaming resume identity (io/journal.py) — a relaunch
-    under any changed configuration recomputes instead of reusing a
-    stale segment."""
-    from variantcalling_tpu.io import journal as journal_mod
+    Built from the SAME ``io/identity.scoring_fields`` dict the
+    streaming resume journal and the chunk cache use (one source of
+    truth for "what makes scored bytes a pure function of input") — a
+    relaunch under any changed configuration recomputes instead of
+    reusing a stale segment."""
+    from variantcalling_tpu.io import identity as identity_mod
 
-    def sig(p):
-        return None if not p else [os.path.abspath(p),
-                                   *journal_mod.input_signature(p)]
-
-    return {
-        "input": sig(args.input_file),
-        "model": sig(getattr(args, "model_file", None)),
-        "model_name": getattr(args, "model_name", None),
-        "runs_file": sig(getattr(args, "runs_file", None)),
-        "blacklist": sig(getattr(args, "blacklist", None)),
-        "blacklist_cg_insertions": bool(
-            getattr(args, "blacklist_cg_insertions", False)),
-        "hpol": [int(v) for v in getattr(args, "hpol_filter_length_dist",
-                                         [10, 10])],
-        "flow_order": getattr(args, "flow_order", "TGCA"),
-        "is_mutect": bool(getattr(args, "is_mutect", False)),
-        "annotate_intervals": sorted(
-            os.path.abspath(p)
-            for p in (getattr(args, "annotate_intervals", None) or [])),
-        "ranks": [plan.rank, plan.ranks],
-        # engine-selection env: resolved engine name + the raw strategy/
-        # mesh requests — they change the segment's provenance HEADER
-        # bytes, so a stale segment under a different selection must
-        # recompute (the merge's header equality check backstops this
-        # across ranks; identity catches the all-ranks-stale case)
-        "engine": engine_name,
-        "forest_strategy": knobs.raw("VCTPU_FOREST_STRATEGY") or "auto",
-        "mesh_devices": knobs.raw("VCTPU_MESH_DEVICES"),
-    }
+    ident = identity_mod.scoring_fields(args)
+    ident["input"] = identity_mod.file_sig(args.input_file)
+    ident["ranks"] = [plan.rank, plan.ranks]
+    # engine-selection env: resolved engine name + the raw strategy/
+    # mesh requests — they change the segment's provenance HEADER
+    # bytes, so a stale segment under a different selection must
+    # recompute (the merge's header equality check backstops this
+    # across ranks; identity catches the all-ranks-stale case)
+    ident["engine"] = engine_name
+    ident["forest_strategy"] = knobs.raw("VCTPU_FOREST_STRATEGY") or "auto"
+    ident["mesh_devices"] = knobs.raw("VCTPU_MESH_DEVICES")
+    return ident
 
 
 def write_marker(seg_path: str, identity: dict, stats: dict) -> None:

@@ -1326,6 +1326,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
 
     from variantcalling_tpu.utils import cancellation
     from variantcalling_tpu.utils import faults
+    from variantcalling_tpu.io import chunk_cache as chunk_cache_mod
+    from variantcalling_tpu.io import identity as identity_mod
     from variantcalling_tpu.io import journal as journal_mod
     from variantcalling_tpu.io.vcf import (VcfChunkReader, assemble_table_bytes,
                                            render_table_bytes_python)
@@ -1447,8 +1449,26 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         emitted here with the parse duration — ONCE per chunk, whatever
         the retry budget spends (a re-dispatched body re-parses but must
         not grow a second root span), so the chunk DAG keeps the exact
-        shape every obs consumer expects."""
-        buf_np, lazy_buf, tid = item
+        shape every obs consumer expects.
+
+        Chunk-cache fast path (VCTPU_CACHE=1, docs/caching.md): the
+        worker keys the RAW span (CRC32 + length under the scoring
+        fingerprint) BEFORE parsing — a hit replays the stored rendered
+        body straight to the sequenced commit, skipping parse→featurize→
+        score→render entirely (its chunk DAG is one ``cache_hit`` span
+        plus the committer's writeback). A miss computes as always and
+        STAGES the result by sequence number; the committer publishes it
+        only after the chunk commits."""
+        seq, buf_np, lazy_buf, tid = item
+        ckey = None
+        if cache_session is not None:
+            ckey = cache_session.key_of(buf_np)
+            hit = cache_session.get(ckey)
+            if hit is not None:
+                cbody, k, p = hit
+                if tid is not None:
+                    obs.trace_span(tid, "cache_hit", 0.0, records=k)
+                return cbody, k, p, None, tid
         ingest_span_emitted = [False]
 
         def body():
@@ -1469,15 +1489,22 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                                  len(table))
 
         with obs.trace_scope(tid):
-            return retry_chunk(body, "chunk_worker")
+            out = retry_chunk(body, "chunk_worker")
+        if ckey is not None and out[3] is None:
+            # clean chunks only: a quarantined chunk's zero-byte body is
+            # a degradation artifact, not a pure function of the input
+            cache_session.stage(seq, ckey, out[0], out[1], out[2])
+        return out
 
     def _traced_raw(raws):
         """Allocate trace ids at the raw feed, in canonical chunk order
         (the ``_traced_chunks`` contract, kept for the raw layout — the
         pooled workers parse concurrently, so allocation cannot wait
-        until parse time)."""
-        for buf_np, lazy_buf in raws:
-            yield buf_np, lazy_buf, obs.new_trace()
+        until parse time). The sequence number rides along: it is the
+        chunk-cache staging key, matched against the committer's chunk
+        counter at publish time (both count post-skip delivery order)."""
+        for seq, (buf_np, lazy_buf) in enumerate(raws):
+            yield seq, buf_np, lazy_buf, obs.new_trace()
 
     def render_stage(item):
         table, score, filters = item
@@ -1550,6 +1577,18 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         # item and fail loudly instead (the pre-ladder gz semantics)
         compress_stage.retry_safe = False
 
+    # the WHOLE scoring configuration, spelled ONCE (io/identity.py):
+    # already-committed chunks carry the old run's scores, so resuming —
+    # or replaying a cached chunk body — under a different model/flags/
+    # engine would silently mix configurations. Built unconditionally:
+    # the chunk cache needs the identity even for .gz / resume-opted-out
+    # runs. Per-field rationale (strategy/mesh/ranks) lives with the
+    # spelling in identity_mod.scoring_config.
+    scoring_cfg = identity_mod.scoring_config(
+        args, engine=ctx.engine.name, forest_strategy=ctx.forest_strategy,
+        mesh_devices=ctx.mesh_plan.devices,
+        rank=ctx.rank_plan.rank, ranks=ctx.rank_plan.ranks)
+
     # resume only for plain-text outputs: a killed BGZF writer's in-flight
     # block state is unrecoverable, so .gz runs restart (still atomic)
     resume_enabled = not gz and knobs.get_bool("VCTPU_RESUME")
@@ -1557,52 +1596,9 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     journal: journal_mod.ChunkJournal | None = None
     meta = None
     if resume_enabled:
-        def _file_sig(p):
-            return None if not p else [os.path.abspath(p),
-                                       *journal_mod.input_signature(p)]
-
-        meta = {
-            "input": os.path.abspath(args.input_file),
-            "input_sig": journal_mod.input_signature(args.input_file),
-            "chunk_bytes": reader.chunk_bytes,
-            "header_len": len(header_bytes),
-            "header_crc": zlib.crc32(header_bytes),
-            # the WHOLE scoring configuration is part of the resume
-            # identity: already-committed chunks carry the old run's
-            # scores, so resuming under a different model/flags/engine
-            # would atomically commit a silently mixed output
-            "config": {
-                "model_file": _file_sig(getattr(args, "model_file", None)),
-                "model_name": getattr(args, "model_name", None),
-                "runs_file": _file_sig(args.runs_file),
-                "blacklist": _file_sig(getattr(args, "blacklist", None)),
-                "blacklist_cg_insertions": bool(args.blacklist_cg_insertions),
-                "hpol": [int(v) for v in args.hpol_filter_length_dist],
-                "flow_order": args.flow_order,
-                "is_mutect": bool(args.is_mutect),
-                "annotate_intervals": sorted(
-                    os.path.abspath(p) for p in (args.annotate_intervals or [])),
-                "engine": ctx.engine.name,
-                # committed chunks carry the old run's strategy: even though
-                # every strategy is parity-tested byte-identical, the resume
-                # identity pins the FULL scoring configuration (PR-2
-                # contract) — a run resumed under a different
-                # VCTPU_FOREST_STRATEGY restarts instead of splicing
-                "forest_strategy": ctx.forest_strategy,
-                # the mesh layout is provenance (##vctpu_mesh= when >1
-                # device): record bytes are device-count-invariant, but
-                # the HEADER byte differs — a resume under a different
-                # VCTPU_MESH_DEVICES RESTARTS cleanly (the header_crc
-                # would mismatch anyway; pinning it here makes the
-                # decision explicit, tests/unit/test_streaming_faults.py)
-                "mesh_devices": ctx.mesh_plan.devices,
-                # the rank layout partitions the CHUNK SEQUENCE itself:
-                # a journal written by rank r of n describes r's span
-                # only, so a resume under any other layout restarts
-                # (docs/scaleout.md — per-rank journals)
-                "ranks": [ctx.rank_plan.rank, ctx.rank_plan.ranks],
-            },
-        }
+        meta = identity_mod.resume_meta(args, chunk_bytes=reader.chunk_bytes,
+                                        header_bytes=header_bytes,
+                                        config=scoring_cfg)
         # claim=True: the re-tokened partial is OURS from the instant it
         # exists — this writer releases the token on every exit path
         resume = journal_mod.try_resume(out_path, meta, claim=True)
@@ -1711,6 +1707,17 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     # and output bytes are identical to the single-device layouts.
     source_pooled = reader.io_threads > 1
     mesh_scoring = ctx.mesh_plan.devices > 1
+    # chunk-result cache (VCTPU_CACHE=1, docs/caching.md): opened AFTER
+    # the resume decision so a resumed run's cache spans key identically
+    # (reader.skip preserves the deterministic chunk cut; seq numbers
+    # below count post-skip delivery order on both sides). The mesh
+    # megabatch layout bypasses the cache — its device-count-sized
+    # batches span chunks, so there is no per-chunk raw-span fast path
+    # to skip (documented limitation; record bytes would still match).
+    cache_session = None
+    if not mesh_scoring:
+        cache_session = chunk_cache_mod.open_session(
+            scoring_cfg, rank=ctx.rank_plan.rank, ranks=ctx.rank_plan.ranks)
     if mesh_scoring:
         from variantcalling_tpu.parallel import shard_score
         from variantcalling_tpu.parallel.pipeline import imap_ordered
@@ -1799,6 +1806,14 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                               _traced_raw(reader.iter_raw()),
                               window=reader.io_threads + 2)
         stages = []
+    elif cache_session is not None:
+        # serial-IO cached layout: the same raw-buffer chunk body, run
+        # inline on the feed — lookups must key on the RAW span (parsed
+        # tables have no stable byte identity), so the cache rides the
+        # raw feed here too; stages collapse into the worker exactly as
+        # in the pooled layout, keeping one code path for hit/miss/stage
+        source = map(raw_chunk_worker, _traced_raw(reader.iter_raw()))
+        stages = []
     else:
         source = _traced_chunks(reader)
         stages = [score_stage, render_stage]
@@ -1809,9 +1824,12 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                          # mesh serial-IO counts too: the source chain
                          # attributes its own ingest/featurize/score work
                          # (timed_tables + _timed_worker + score.dN), so
-                         # feed-blocked time is queue-wait, never work
+                         # feed-blocked time is queue-wait, never work —
+                         # and the serial cached layout likewise runs the
+                         # self-attributing chunk body inline on the feed
                          consumer_name="writeback",
-                         source_pooled=source_pooled or mesh_scoring,
+                         source_pooled=(source_pooled or mesh_scoring
+                                        or cache_session is not None),
                          # SUPERVISED mode (docs/robustness.md "Recovery
                          # ladder"): stage-item re-dispatch, watchdog v2
                          # (stack dump + one wedged-chunk retry before
@@ -1924,6 +1942,15 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                         os.fsync(sink.fileno())
                     journal.append(n_chunks - 1, k, p, len(data),
                                    zlib.crc32(data))
+                if cache_session is not None:
+                    # committed-prefix publication: entries become
+                    # visible (disk store / serve warm index) only once
+                    # their chunk's bytes are in the partial file — and
+                    # past the journal line when journaling — so a
+                    # cancelled request or failed run never publishes
+                    # an entry no output carried (docs/caching.md)
+                    cache_session.publish_up_to(
+                        n_chunks - resumed_chunks - 1)
             if compressor is not None:
                 # the final partial block + EOF sentinel — the committer
                 # (this thread) is the only writer, in sequence order
@@ -1945,6 +1972,10 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             qsink.close()
         if journal is not None:
             journal.close()
+        if cache_session is not None and not ok:
+            # failure/cancellation: drop everything unpublished — the
+            # stores hold only committed chunks' entries
+            cache_session.discard()
         if not ok:
             # failure exit: the partial (if kept) now awaits a RESUME —
             # release the claim so the resumer (or a superseding fresh
@@ -1995,6 +2026,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     journal_mod.release_token(part_token)  # committed: the partial is gone
     if journal is not None:
         journal.finish()
+    if cache_session is not None:
+        cache_session.finish()
     if obs.active():
         obs.event("journal", "committed", chunks=n_chunks, records=n_total)
     if n_quar_chunks:
@@ -2022,6 +2055,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             "resumed_chunks": resume.chunks if resume is not None else 0,
             "quarantined_chunks": n_quar_chunks,
             "quarantined_records": n_quar_records,
+            "cache": cache_session.stats() if cache_session is not None
+            else None,
             "mode": "streaming" if pipe.parallel else "serial-chunked"}
 
 

@@ -132,10 +132,17 @@ class Server:
         """Bind, warm the process-level caches, and serve on a
         background thread (the caller owns the foreground — CLI main
         loop or a test)."""
+        from variantcalling_tpu.io import chunk_cache
         from variantcalling_tpu.utils.compile_cache import \
             enable_persistent_cache
 
         enable_persistent_cache()
+        # opt this process into the chunk cache's in-memory warm index
+        # (docs/caching.md): requests that repeat an input span under the
+        # same scoring config replay rendered bytes without touching disk.
+        # Resident mode only — a one-shot CLI would just duplicate every
+        # rendered body in RAM. No-op until VCTPU_CACHE=1.
+        chunk_cache.resident_mode(True)
         if self._obs_log:
             self._obs_run = obs.start_run("serve", force_path=self._obs_log)
         elif obs.enabled():
@@ -468,6 +475,7 @@ class Server:
             "queue_depth": self.admission.queue_depth,
             "endpoints": per_endpoint,
             "resident": self.state.stats(),
+            "cache": _chunk_cache_stats(),
         }
 
     def metrics_payload(self) -> str:
@@ -475,6 +483,12 @@ class Server:
 
         return prom.snapshot_to_prom(self.metrics.snapshot(), tool="serve",
                                      in_flight=not self.draining.is_set())
+
+
+def _chunk_cache_stats() -> dict:
+    from variantcalling_tpu.io import chunk_cache
+
+    return chunk_cache.resident_stats()
 
 
 def _read_intervals(path: str):

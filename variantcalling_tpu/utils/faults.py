@@ -100,6 +100,19 @@ POINTS: dict[str, tuple[str, object]] = {
         "one rank entering a collective late (cancellable delay)",
         None,  # delay-style
     ),
+    "cache.entry_read": (
+        "IO error reading a chunk-cache entry (degrades to a miss — the "
+        "chunk recomputes; torn/poisoned CONTENT needs no injection, the "
+        "CRC check catches it)",
+        lambda: OSError(errno.EIO, "injected fault: cache entry read error"),
+    ),
+    "cache.entry_write": (
+        "chunk-cache entry publication failure — armed with seconds it "
+        "hangs MID-entry-write (the chaoshunt cache_torn SIGKILL window) "
+        "before raising; the entry is dropped, output bytes unaffected",
+        lambda: OSError(errno.ENOSPC,
+                        "injected fault: no space left writing cache entry"),
+    ),
 }
 
 _LOCK = threading.Lock()
