@@ -1490,6 +1490,110 @@ def scaleout_phase(fixture_dir: str) -> dict:
     }
 
 
+def straggler_phase(fixture_dir: str) -> dict:
+    """Straggler-rescue economics (docs/scaleout.md "Elastic
+    membership"): the 1M e2e fixture through a clean 2-worker elastic
+    pod, then the same pod with worker slot 1 slowed ~10x by a
+    persistent per-chunk hang (``--worker-env``, the deterministic
+    straggler). The coordinator must notice the laggard from the
+    journals' progress rates, kill it, re-cut its span at the watermark
+    and finish on a clean replacement IN THE SAME LAUNCH — so
+    ``straggler_over_clean`` prices a straggler WITH rescue, and its
+    absolute budget in tools/bench_gate.py (1.5x the clean wall) is the
+    acceptance bar: without stealing, a 10x-slow worker would cost ~5x.
+    ``steals`` is the presence tripwire — a ratio measured without an
+    actual steal would gate a different machine than the one shipped.
+    The sha256 digest tripwire mirrors scaleout_phase: both legs'
+    outputs must be identical modulo ``##vctpu_*`` provenance headers
+    (elastic span workers carry no rank header at all), or
+    ``digest_state="mismatch"`` hard-fails in tools/bench_gate.py.
+    """
+    import hashlib
+    import pickle
+
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    vcf_in = os.path.join(fixture_dir, "calls.vcf")
+    ref_fa = os.path.join(fixture_dir, "ref.fa")
+    model_pkl = os.path.join(fixture_dir, "straggler_model.pkl")
+    with open(model_pkl, "wb") as fh:
+        pickle.dump({"m": synthetic_forest(np.random.default_rng(0),
+                                           n_trees=N_TREES, depth=DEPTH)},
+                    fh)
+
+    from tools.chaoshunt.harness import normalize_output as normalize
+
+    def cli_args(out: str) -> list[str]:
+        return ["--input_file", vcf_in, "--model_file", model_pkl,
+                "--model_name", "m", "--reference_file", ref_fa,
+                "--output_file", out, "--backend", "cpu"]
+
+    # a leased span IS the partition spelling — scrub any ambient rank
+    # env (mirrors the scaleout honest-baseline scrub); pin the chunk
+    # size so the per-chunk hang arithmetic below is host-independent
+    chunk_bytes = 1 << 20
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("VCTPU_RANK", "VCTPU_NUM_PROCESSES",
+                             "PYTHONPATH")}
+    base_env.update(JAX_PLATFORMS="cpu",
+                    VCTPU_STREAM_CHUNK_BYTES=str(chunk_bytes))
+
+    def pod(out: str, *flags: str) -> tuple[float, str]:
+        t0 = time.perf_counter()
+        proc = subprocess.run(  # noqa: S603
+            [sys.executable, "-m", "tools.podrun", "--elastic",
+             "--ranks", "2", "--timeout", "240", *flags,
+             "--", *cli_args(out)],
+            env=base_env, cwd=_REPO, timeout=300, capture_output=True,
+            text=True)
+        wall = time.perf_counter() - t0
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"straggler {os.path.basename(out)} leg failed "
+                f"(rc={proc.returncode}): "
+                f"{(proc.stderr or proc.stdout)[-400:]}")
+        # membership transitions ride the coordinator's log stream
+        return wall, proc.stdout + proc.stderr
+
+    out_clean = os.path.join(fixture_dir, "straggler_clean.vcf")
+    wall_clean, _ = pod(out_clean)
+
+    # size the hang to ~9x the clean per-chunk wall: the slowed worker
+    # runs at ~1/10 the clean rate (the ISSUE's 10x straggler) — far
+    # past the steal factor, so detection never depends on the margin
+    n_chunks = max(1, os.path.getsize(vcf_in) // chunk_bytes)
+    hang_s = max(0.2, round(9.0 * wall_clean / n_chunks, 2))
+    # grace 2.0: a fresh replacement's early rate probe is biased low
+    # by its own interpreter+jax startup — a tighter grace re-steals
+    # the rescuer itself (converges, but inflates the measured rescue)
+    out_slow = os.path.join(fixture_dir, "straggler_slow.vcf")
+    wall_slow, log = pod(
+        out_slow, "--max-ranks", "3", "--grace", "2.0",
+        "--worker-env", f"1:VCTPU_FAULTS=pipeline.stage_hang:0@{hang_s}")
+    steals = log.count("membership: steal")
+
+    digests = {}
+    for name, p in (("clean", out_clean), ("slow", out_slow)):
+        digests[name] = hashlib.sha256(
+            normalize(open(p, "rb").read())).hexdigest()
+        os.remove(p)
+
+    match = digests["clean"] == digests["slow"]
+    return {
+        "n": E2E_N,
+        "ranks": 2,
+        "hang_s_per_chunk": hang_s,
+        "wall_s": {"clean": round(wall_clean, 3),
+                   "straggler": round(wall_slow, 3)},
+        "straggler_over_clean": round(wall_slow / wall_clean, 3),
+        "steals": steals,
+        "digest_state": "match" if match else "mismatch",
+        "bytes_identical": 1 if match else 0,
+        "digest_sha256": digests["clean"],
+        "engine": "native",
+    }
+
+
 def cache_phase(fixture_dir: str) -> dict:
     """Chunk-result cache speedup (docs/caching.md): the 1M e2e fixture
     re-filtered in-process against ONE on-disk store — cold (populates,
@@ -1911,6 +2015,13 @@ def child_main(fixture_dir: str) -> None:
         # across legs; parity + no-regression on this 2-core box
         phase("scaleout", lambda: scaleout_phase(fixture_dir),
               min_remaining=110)
+    if want("straggler") and cpu:
+        # elastic straggler rescue (docs/scaleout.md "Elastic
+        # membership"): clean 2-worker elastic pod vs one with a
+        # 10x-slowed worker that must be stolen from mid-run; the wall
+        # ratio prices the rescue, digest tripwire across legs
+        phase("straggler", lambda: straggler_phase(fixture_dir),
+              min_remaining=120)
     if want("cache") and cpu:
         # chunk-result cache (docs/caching.md): cold/warm/mixed CLI legs
         # over one on-disk store, sha256 digest tripwire across legs;
@@ -2177,8 +2288,8 @@ def main(tpu_only: bool = False) -> None:
         out["device"] = child.get("device", "?")
         out["attempt"] = label
         for k in ("hot_small", "hot", "io", "mesh", "e2e", "obs", "serve",
-                  "scaleout", "cache", "e2e_5m", "genome3g", "scaling",
-                  "skipped", "phase_errors", "incomplete"):
+                  "scaleout", "straggler", "cache", "e2e_5m", "genome3g",
+                  "scaling", "skipped", "phase_errors", "incomplete"):
             if k in child:
                 out[k] = child[k]
         def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:

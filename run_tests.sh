@@ -67,14 +67,16 @@ fi
 # -- opt-in simulated multi-host stage (docs/scaleout.md) ------------------
 # VCTPU_SCALEOUT=1: the 2-process local-launcher pipeline end-to-end on
 # the cpu backend (tools/podrun spawns rank workers with VCTPU_RANK set,
-# byte parity vs the single-rank run, SIGKILL-one-rank resume), plus the
-# jax.distributed system tests — the PR 5 collectives capability probe
-# turns their skips into real runs on jaxlib builds that support
-# multi-process CPU collectives. Bounded (~2 min).
+# byte parity vs the single-rank run, SIGKILL-one-rank resume), the
+# elastic-membership pod (span leases, mid-run SIGKILL answered by a
+# re-cut in the SAME launch, chaos drills), plus the jax.distributed
+# system tests — the PR 5 collectives capability probe turns their
+# skips into real runs on jaxlib builds that support multi-process CPU
+# collectives. Bounded (~3 min).
 if [ "${VCTPU_SCALEOUT:-0}" != "0" ]; then
-  echo "scaleout stage: pytest tests/system/test_scaleout.py tests/system/test_multihost.py"
+  echo "scaleout stage: pytest tests/system/test_scaleout.py tests/system/test_elastic.py tests/system/test_multihost.py"
   env PYTHONPATH= JAX_PLATFORMS=cpu \
-    python -m pytest tests/system/test_scaleout.py tests/system/test_multihost.py -q -p no:cacheprovider || {
+    python -m pytest tests/system/test_scaleout.py tests/system/test_elastic.py tests/system/test_multihost.py -q -p no:cacheprovider || {
     echo "scaleout stage failed — the rank-partitioned path is broken" >&2
     exit 1
   }

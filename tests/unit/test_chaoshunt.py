@@ -231,6 +231,64 @@ def test_check_pod_leg_invariants(tmp_path):
     assert any("not a complete output" in m for m in v)
 
 
+def test_elastic_schedules_drawn_and_round_trip():
+    """The elastic fault classes (docs/scaleout.md "Elastic
+    membership"): every mode is drawn, schedules round-trip, describe()
+    names the mode, and the shrinker can degrade an elastic schedule to
+    the ordinary single-process flow."""
+    drawn = [harness.draw_schedule(s) for s in range(200)]
+    els = [s for s in drawn if s.elastic is not None]
+    assert {s.elastic["mode"] for s in els} == \
+        {"rank_flap", "steal_race", "join_during_merge"}
+    assert all(s.layout != "mesh2" for s in els)
+    flap = next(s for s in els if s.elastic["mode"] == "rank_flap")
+    assert flap.elastic["ranks"] == 2
+    assert flap.elastic["kills"] in (1, 2)
+    # the flap leg needs the per-chunk delay so kills land mid-stream
+    assert any(f.point == "pipeline.stage_hang" and f.times is None
+               for f in flap.faults)
+    assert "elastic_rank_flap" in flap.describe()
+    again = harness.Schedule.from_json(json.loads(json.dumps(
+        flap.to_json())))
+    assert again.to_json() == flap.to_json()
+    assert any(c.elastic is None for c in harness._simplifications(flap))
+
+
+def test_check_elastic_leg_invariants(tmp_path):
+    """Success must match the reference and sweep its span files;
+    failure must use a documented distinct code and leave the
+    destination untouched — a hung pod cannot even reach this check."""
+    out = str(tmp_path / "o.vcf")
+    fx = _fx(tmp_path)
+
+    def leg(**kw):
+        base = {"rc": 0, "kills": 0, "out_exists": True,
+                "stdout": "", "leftovers": []}
+        base.update(kw)
+        return base
+
+    open(out, "wb").write(b"##h\nrec\n")
+    assert harness._check_elastic_leg(leg(), fx, out, "flap") == []
+    v = harness._check_elastic_leg(
+        leg(leftovers=["o.vcf.span0-9.seg"]), fx, out, "flap")
+    assert any("span files" in m for m in v)
+    open(out, "wb").write(b"##h\nWRONG\n")
+    v = harness._check_elastic_leg(leg(), fx, out, "flap")
+    assert any("bytes differ" in m for m in v)
+    # failure: every documented code is accepted with no destination...
+    os.remove(out)
+    for rc in harness.ELASTIC_FAIL_CODES:
+        assert harness._check_elastic_leg(
+            leg(rc=rc, out_exists=False), fx, out, "flap") == []
+    # ... an undocumented code (e.g. the classic rank-kill 3) is not
+    v = harness._check_elastic_leg(
+        leg(rc=3, out_exists=False), fx, out, "flap")
+    assert any("UNDOCUMENTED" in m for m in v)
+    open(out, "wb").write(b"half")
+    v = harness._check_elastic_leg(leg(rc=7), fx, out, "flap")
+    assert any("left bytes" in m for m in v)
+
+
 # ---------------------------------------------------------------------------
 # CLI contract
 # ---------------------------------------------------------------------------
@@ -261,6 +319,8 @@ def _pick_seed(layout="serial", max_faults=1, no_kill=True) -> int:
             continue  # pod schedules spawn 3 processes: own e2e below
         if s.cache is not None:
             continue  # cache schedules run 2-3 legs: own e2e coverage
+        if s.elastic is not None:
+            continue  # elastic pod schedules: own e2e in test_elastic
         if any(f.seconds and f.seconds > 1 for f in s.faults):
             continue  # long-hang schedules cost wall time
         return seed

@@ -10,7 +10,7 @@ human diff would catch it. This tool is the gate:
   its direction and its noise band) and **exits 1 on any regression
   beyond the band**, 0 when clean, 2 on usage/IO errors.
 - ``python -m tools.bench_gate --run`` runs a fresh reduced bench
-  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve,scaleout,cache``
+  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache``
   — the phases the gate reads) and compares it against the newest committed ``BENCH_r*.json``
   (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
   opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
@@ -203,6 +203,18 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("scaleout.vps.r2", "higher", 0.25),
     ("scaleout.scaling_r2_over_r1", "higher", 0.25),
     ("scaleout.bytes_identical", "nonzero", 0.0),
+    # -- elastic straggler rescue (docs/scaleout.md "Elastic
+    #    membership"): the same pod with one worker slowed ~10x must be
+    #    rescued by the coordinator's work-stealing IN THE SAME LAUNCH.
+    #    The ratio is an ABSOLUTE budget (the acceptance bar: a rescued
+    #    straggler costs at most 1.5x the clean wall — without stealing
+    #    a 10x-slow worker would cost ~5x, so the budget fails loudly
+    #    the day detection or the re-cut handoff silently breaks). The
+    #    steals presence tripwire keeps the ratio honest: a leg where
+    #    no steal actually fired measured a different machine.
+    ("straggler.straggler_over_clean", "budget", 1.5),
+    ("straggler.steals", "nonzero", 0.0),
+    ("straggler.bytes_identical", "nonzero", 0.0),
     # -- content-addressed chunk cache (docs/caching.md): three fresh
     #    CLI legs over one on-disk store. warm_hit_over_cold is the
     #    headline — a fully-warm re-filter replays rendered bytes
@@ -230,6 +242,10 @@ FORBIDDEN_VALUES: tuple[tuple[str, str], ...] = (
     # — the bench phase records the comparison instead of raising, so
     # the failure mode is THIS hard gate, never a lost row
     ("scaleout.digest_state", "mismatch"),
+    # the straggler digest tripwire: the rescued pod (steal + re-cut +
+    # adopted journal prefix) must reproduce the clean elastic pod's
+    # bytes modulo ##vctpu_* headers — a seam error lands HERE, hard
+    ("straggler.digest_state", "mismatch"),
     # the cache digest tripwire: warm-hit and mixed hit/miss replays
     # must reproduce the cold run's bytes modulo ##vctpu_* headers —
     # a cache that serves stale or torn bodies fails HERE, hard, never
@@ -412,7 +428,7 @@ def run_fresh_bench(timeout_s: int = 720) -> dict | None:
     that its own budget logic would have finished self-contained."""
     env = dict(os.environ)
     env["VCTPU_BENCH_PHASES"] = \
-        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,cache"
+        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

@@ -94,9 +94,10 @@ class FaultSpec:
 @dataclasses.dataclass
 class Schedule:
     """One drawn chaos schedule: layout x faults x optional SIGKILL
-    (single-process), or a ``rank_kill`` pod schedule — SIGKILL one
-    worker rank of a 2-rank local-launcher run mid-stream
-    (docs/scaleout.md failure semantics)."""
+    (single-process), a ``rank_kill`` pod schedule — SIGKILL one worker
+    rank of a 2-rank local-launcher run mid-stream — or an ``elastic``
+    pod schedule against ``tools/podrun --elastic`` (docs/scaleout.md
+    "Elastic membership")."""
 
     seed: int
     layout: str  # serial | io4 | mesh2
@@ -104,6 +105,13 @@ class Schedule:
     kill_after_chunks: int | None = None  # SIGKILL once N chunks journaled
     #: pod fault class: {"ranks": N, "kill_rank": r, "after_chunks": k}
     rank_kill: dict | None = None
+    #: elastic pod fault class (docs/scaleout.md "Elastic membership"):
+    #: {"mode": "rank_flap", "ranks": 2, "kills": k, "after_chunks": c}
+    #: (SIGKILL k span workers mid-journal — the coordinator must re-cut
+    #: and finish IN THE SAME LAUNCH), {"mode": "steal_race"} or
+    #: {"mode": "join_during_merge"} (the launcher's built-in duplicate-
+    #: claimant drills — the lease must refuse the second renderer)
+    elastic: dict | None = None
     #: chunk-cache fault class (docs/caching.md): {"mode": "poison"}
     #: (bit-flipped entry bodies) or {"mode": "torn"} (SIGKILL inside an
     #: entry write) — the cache must recompute, never serve wrong bytes
@@ -117,7 +125,8 @@ class Schedule:
                 "faults": [f.to_json() for f in self.faults],
                 "kill_after_chunks": self.kill_after_chunks,
                 "rank_kill": self.rank_kill,
-                "cache": self.cache}
+                "cache": self.cache,
+                "elastic": self.elastic}
 
     @staticmethod
     def from_json(d: dict) -> "Schedule":
@@ -127,7 +136,8 @@ class Schedule:
                                 for f in d.get("faults", [])],
                         kill_after_chunks=d.get("kill_after_chunks"),
                         rank_kill=d.get("rank_kill"),
-                        cache=d.get("cache"))
+                        cache=d.get("cache"),
+                        elastic=d.get("elastic"))
 
     def describe(self) -> str:
         parts = [self.layout]
@@ -141,6 +151,12 @@ class Schedule:
                          f"@{self.rank_kill['after_chunks']}ch")
         if self.cache is not None:
             parts.append(f"cache_{self.cache['mode']}")
+        if self.elastic is not None:
+            s = f"elastic_{self.elastic['mode']}"
+            if self.elastic["mode"] == "rank_flap":
+                s += (f" x{self.elastic.get('kills', 1)}"
+                      f"@{self.elastic.get('after_chunks', 1)}ch")
+            parts.append(s)
         return " ".join(parts)
 
 
@@ -156,10 +172,13 @@ def draw_schedule(seed: int) -> Schedule:
              "rank_kill"]
     if layout == "mesh2":
         # the mesh megabatch layout bypasses the chunk cache, so cache
-        # fault classes are drawn on the host layouts only
+        # fault classes are drawn on the host layouts only — and the
+        # elastic pod classes ride the host layouts too (every span
+        # worker of a mesh pod would multiply the process budget)
         modes.append("oom")
     else:
-        modes += ["cache_poison", "cache_torn"]
+        modes += ["cache_poison", "cache_torn",
+                  "rank_flap", "steal_race", "join_during_merge"]
     mode = rng.choice(modes)
     faults: list[FaultSpec] = []
     kill = None
@@ -167,6 +186,20 @@ def draw_schedule(seed: int) -> Schedule:
     if mode in ("cache_poison", "cache_torn"):
         return Schedule(seed=seed, layout=layout,
                         cache={"mode": mode.removeprefix("cache_")})
+    if mode == "rank_flap":
+        # elastic membership churn: SIGKILL k span workers, each only
+        # after ITS journal shows progress — the coordinator must re-cut
+        # at the watermark and commit in the SAME launch. A persistent
+        # per-chunk delay keeps every worker mid-stream long enough.
+        faults.append(FaultSpec("pipeline.stage_hang", times=None,
+                                seconds=0.2))
+        return Schedule(seed=seed, layout=layout, faults=faults,
+                        elastic={"mode": "rank_flap", "ranks": 2,
+                                 "kills": rng.randint(1, 2),
+                                 "after_chunks": rng.randint(1, 2)})
+    if mode in ("steal_race", "join_during_merge"):
+        return Schedule(seed=seed, layout=layout,
+                        elastic={"mode": mode, "ranks": 2})
     if mode == "rank_kill":
         # pod fault class (docs/scaleout.md): a 2-rank local-launcher
         # run; one worker rank is SIGKILLed once its SEGMENT journal
@@ -269,7 +302,11 @@ def _child_env(layout: str, faults_spec: str = "",
     env.update(PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
                VCTPU_STREAM_CHUNK_BYTES=str(1 << 14),
                VCTPU_IO_BACKOFF_S="0.01",
-               VCTPU_STAGE_TIMEOUT_S="2")
+               VCTPU_STAGE_TIMEOUT_S="2",
+               # pin the compute pool: streaming eligibility must not
+               # depend on the host's core count (1-CPU runners would
+               # silently divert every leg onto the batch path)
+               VCTPU_THREADS="2")
     env.update(_layout_env(layout))
     if faults_spec:
         env["VCTPU_FAULTS"] = faults_spec
@@ -429,17 +466,18 @@ def _check_leg(leg: dict, fx: Fixtures, out: str, name: str,
 def _remove_run_files(out: str, extra: tuple[str, ...] = ()) -> None:
     """Sweep one leg's output + sidecars, including every unique-suffix
     partial (``<out>.partial.<pid>-<hex>``, ISSUE 14) and — for pod
-    legs — the rank segments, their journals/markers, worker logs and
-    the launcher state file (docs/scaleout.md)."""
+    legs — the rank/span segments, their journals/markers/leases,
+    worker logs and the launcher state file (docs/scaleout.md)."""
     import glob
 
     from variantcalling_tpu.io import journal as journal_mod
 
     targets = [out, out + ".journal", out + ".quarantine",
-               out + ".podrun.json"]
+               out + ".podrun.json", out + ".podrun.obs.jsonl"]
     targets += [out + s for s in extra]
     targets += journal_mod.list_partials(out)
     targets += glob.glob(glob.escape(out) + ".rank*")
+    targets += glob.glob(glob.escape(out) + ".span*")
     for p in targets:
         try:
             os.remove(p)
@@ -567,6 +605,166 @@ def run_rank_kill_schedule(sched: Schedule, fx: Fixtures,
             "violations": violations}
 
 
+# ---------------------------------------------------------------------------
+# the elastic pod fault classes (docs/scaleout.md "Elastic membership")
+# ---------------------------------------------------------------------------
+
+
+def run_elastic_leg(fx: Fixtures, out: str, layout: str, ranks: int,
+                    faults_spec: str = "", chaos: str | None = None,
+                    flap_kills: int = 0, after_chunks: int = 1) -> dict:
+    """One ``tools/podrun --elastic`` run. ``flap_kills`` > 0 SIGKILLs
+    that many span workers — each only once ITS journal shows
+    ``after_chunks`` committed chunks (the state file maps spans ->
+    pids) — exercising the re-cut + re-assignment path WITHIN the
+    launch. Children pin ``VCTPU_THREADS=2``: span workers ride the
+    streaming executor (like the cache schedules)."""
+    env = _child_env(layout, faults_spec, {"VCTPU_THREADS": "2"})
+    argv = [sys.executable, "-m", "tools.podrun", "--elastic",
+            "--ranks", str(ranks), "--grace", "0.5",
+            "--timeout", str(CHILD_TIMEOUT_S - 30)]
+    if chaos is not None:
+        argv += ["--chaos", chaos]
+    argv += ["--", "--input_file", fx.input_vcf, "--model_file", fx.model,
+             "--model_name", "m", "--reference_file", fx.ref,
+             "--output_file", out, "--backend", "cpu"]
+    p = subprocess.Popen(argv, env=env, cwd=REPO,  # noqa: S603
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True)
+    kills = 0
+    if flap_kills > 0:
+        spath = out + ".podrun.json"
+        downed: set[int] = set()
+        deadline = time.time() + CHILD_TIMEOUT_S
+        while kills < flap_kills and time.time() < deadline \
+                and p.poll() is None:
+            try:
+                with open(spath, encoding="utf-8") as fh:
+                    workers = json.load(fh).get("workers") or []
+            except (OSError, ValueError):
+                workers = []
+            for w in workers:
+                pid = w.get("pid")
+                if not pid or pid in downed:
+                    continue
+                lo, hi = w["span"]
+                try:
+                    with open(f"{out}.span{lo}-{hi}.seg.journal",
+                              encoding="utf-8") as fh:
+                        committed = max(0,
+                                        len(fh.read().splitlines()) - 1)
+                except OSError:
+                    committed = 0
+                if committed < after_chunks:
+                    continue
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    continue
+                downed.add(pid)
+                kills += 1
+                break
+            time.sleep(0.02)
+    try:
+        stdout, _ = p.communicate(timeout=CHILD_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        stdout, _ = p.communicate(timeout=30)
+    import glob
+
+    leftovers = sorted(os.path.basename(q) for q in
+                       glob.glob(glob.escape(out) + ".span*")
+                       if not q.endswith(".obs.jsonl"))
+    return {"rc": p.returncode, "kills": kills,
+            "out_exists": os.path.exists(out),
+            "stdout": (stdout or "")[-4000:], "leftovers": leftovers}
+
+
+#: an elastic pod failure must be one of the launcher's DISTINCT codes —
+#: config (2), merge (4), timeout (5), span-gave-up (7) — never a hang
+#: and never an undocumented code
+ELASTIC_FAIL_CODES = (2, 4, 5, 7)
+
+
+def _check_elastic_leg(leg: dict, fx: Fixtures, out: str,
+                       name: str) -> list[str]:
+    """Elastic pod invariants: success commits bytes identical to the
+    clean reference (modulo normalize_output) and sweeps every span
+    file; failure uses a distinct exit code with the destination
+    untouched. Either way the launcher RETURNED — the
+    hung-forever outcome is impossible by construction."""
+    v: list[str] = []
+    if leg["rc"] == 0:
+        if not leg["out_exists"]:
+            v.append(f"{name}: elastic success but no destination file")
+        elif normalize_output(open(out, "rb").read()) != fx.reference_norm:
+            v.append(f"{name}: elastic success but bytes differ from the "
+                     "clean reference")
+        if leg["leftovers"]:
+            v.append(f"{name}: elastic success left span files behind: "
+                     f"{leg['leftovers'][:4]}")
+        return v
+    if leg["rc"] not in ELASTIC_FAIL_CODES:
+        v.append(f"{name}: elastic pod failed with UNDOCUMENTED code "
+                 f"rc={leg['rc']} (expected one of "
+                 f"{ELASTIC_FAIL_CODES}): {leg['stdout'][-400:]}")
+    if leg["out_exists"]:
+        v.append(f"{name}: elastic failure (rc={leg['rc']}) left bytes at "
+                 "the destination")
+    return v
+
+
+def run_elastic_schedule(sched: Schedule, fx: Fixtures,
+                         workdir: str) -> dict:
+    """The elastic fault classes end to end — one leg each:
+
+    - ``rank_flap``: SIGKILL k span workers mid-journal; the SAME launch
+      must re-cut, adopt the journaled prefixes and commit
+      byte-identically (no relaunch — that is the class's whole point);
+    - ``steal_race``: the launcher spawns a duplicate claimant for a
+      live (span, generation); the lease must yield one winner
+      (``claim_lost`` reported) and the bytes stay identical;
+    - ``join_during_merge``: a late join against a completed span must
+      be refused by the persisted lease (``join_refused`` reported).
+    """
+    el = sched.elastic or {}
+    mode = el.get("mode", "rank_flap")
+    ranks = int(el.get("ranks", 2))
+    out = os.path.join(workdir, f"seed{sched.seed}_elastic.vcf")
+    _remove_run_files(out)
+    violations: list[str] = []
+    if mode == "rank_flap":
+        leg = run_elastic_leg(fx, out, sched.layout, ranks,
+                              faults_spec=sched.faults_env(),
+                              flap_kills=int(el.get("kills", 1)),
+                              after_chunks=int(el.get("after_chunks", 1)))
+        violations += _check_elastic_leg(leg, fx, out, "flap")
+        # the class only proves self-healing when a kill actually
+        # landed; a worker outracing the killer is a (logged) miss,
+        # not a product violation
+        if leg["kills"] > 0 and leg["rc"] == 0 \
+                and "membership: recut" not in leg["stdout"] \
+                and "membership: reassign" not in leg["stdout"]:
+            violations.append("flap: a worker was SIGKILLed but the "
+                              "coordinator recorded no recut/reassign "
+                              "transition")
+    else:
+        leg = run_elastic_leg(fx, out, sched.layout, ranks, chaos=mode)
+        violations += _check_elastic_leg(leg, fx, out, mode)
+        marker = ("claim_lost" if mode == "steal_race"
+                  else "join_refused")
+        if leg["rc"] == 0 and marker not in leg["stdout"]:
+            violations.append(f"{mode}: the chaos drill completed "
+                              f"without reporting {marker}")
+    legs = [dict(leg, name=mode)]
+    _remove_run_files(out, (".obs.jsonl",))
+    return {"schedule": sched.to_json(), "describe": sched.describe(),
+            "legs": [{k: leg[k] for k in ("name", "rc", "kills",
+                                          "out_exists")}
+                     for leg in legs],
+            "violations": violations}
+
+
 def run_cache_schedule(sched: Schedule, fx: Fixtures, workdir: str) -> dict:
     """The chunk-cache fault classes (docs/caching.md): the cache may
     only ever DEGRADE a run to cold — wrong bytes are the violation.
@@ -651,11 +849,14 @@ def run_schedule(sched: Schedule, fx: Fixtures, workdir: str,
     the faulted leg left a resumable journal (or was killed) — a
     fault-free RESUME leg that must complete byte-identically.
     ``rank_kill`` schedules route to the pod harness, ``cache``
-    schedules to the chunk-cache harness."""
+    schedules to the chunk-cache harness, ``elastic`` schedules to the
+    elastic-pod harness."""
     if sched.rank_kill is not None:
         return run_rank_kill_schedule(sched, fx, workdir)
     if sched.cache is not None:
         return run_cache_schedule(sched, fx, workdir)
+    if sched.elastic is not None:
+        return run_elastic_schedule(sched, fx, workdir)
     out = os.path.join(workdir, f"seed{sched.seed}.vcf")
     _remove_run_files(out)
     violations: list[str] = []
@@ -700,6 +901,12 @@ def _simplifications(sched: Schedule):
         # does the violation need the cache? dropping it degrades the
         # schedule to the ordinary (cache-off) single-process flow
         yield dataclasses.replace(sched, cache=None)
+    if sched.elastic is not None:
+        # does the violation need the elastic pod at all?
+        yield dataclasses.replace(sched, elastic=None)
+        if sched.elastic.get("kills", 0) > 1:
+            yield dataclasses.replace(
+                sched, elastic=dict(sched.elastic, kills=1))
     if sched.kill_after_chunks is not None:
         yield dataclasses.replace(sched, kill_after_chunks=None)
     for i in range(len(sched.faults)):

@@ -66,6 +66,12 @@ def main() -> int:
         obs.counter("cache.bytes_saved").add(4096)
         obs.event("cache", "session", hits=3, misses=1, bytes_saved=4096,
                   published=1)
+        # elastic pod membership transitions (parallel/elastic.py)
+        obs.event("membership", "[0,1024)", action="join", gen=0, pid=1234)
+        obs.event("membership", "[0,1024)", action="steal", gen=0,
+                  done_bytes=512, rate=10.0, median=100.0)
+        obs.event("membership", "[0,512)", action="recut", at=512,
+                  adopted_chunks=2)
         # obs v2 profile producers (attribution events + bottleneck surface)
         obs.event("profile", "stage", stage="score_stage", work_s=0.5,
                   wait_in_s=0.1, wait_out_s=0.0, items=1, records=128)
@@ -128,8 +134,9 @@ def main() -> int:
         parsed = [json.loads(ln) for ln in lines]
         kinds = {e["kind"] for e in parsed}
         for required in ("manifest", "span", "degrade", "fault", "heartbeat",
-                         "journal", "cache", "profile", "trace", "snapshot",
-                         "sample", "recovery", "metrics", "run_end"):
+                         "journal", "cache", "membership", "profile", "trace",
+                         "snapshot", "sample", "recovery", "metrics",
+                         "run_end"):
             if required not in kinds:
                 errors.append(f"stream is missing a {required!r} event")
         # causal-trace integrity: the recovery event's trace_id must

@@ -12,23 +12,39 @@ runs the rank-sequenced committer in-process (the same
         --input_file calls.vcf.gz --model_file model.pkl --model_name m \
         --reference_file ref.fa --output_file out.vcf.gz --backend cpu
 
-Exit codes are DISTINCT per failure class, so harnesses (chaoshunt's
-``rank_kill`` fault class, the bench ``scaleout`` phase) can tell what
-died:
+``--elastic`` switches to the ELASTIC pod (docs/scaleout.md "Elastic
+membership"): workers are leased absolute byte spans (``VCTPU_SPAN``)
+instead of rank fractions, and the
+:class:`~variantcalling_tpu.parallel.elastic.Coordinator` state machine
+re-offers a dead worker's span (re-cut at its journal watermark so the
+journaled prefix is adopted, not recomputed), steals from stragglers,
+grows the pool toward ``--max-ranks`` and sheds under host load. The
+merged bytes are identical to the single-rank run whatever the final
+span plan looks like.
 
-- ``0``  — every rank completed and the merge committed;
+Exit codes are DISTINCT per failure class, so harnesses (chaoshunt's
+``rank_kill``/elastic fault classes, the bench ``scaleout``/
+``straggler`` phases) can tell what died:
+
+- ``0``  — every worker completed and the merge committed;
 - ``2``  — usage/configuration error (bad flags, no --output_file);
-- ``3``  — one or more workers were SIGNAL-killed (the merge is
-  SKIPPED: the destination stays untouched; a relaunch resumes the
-  killed rank from its journal and skips finished ranks via their
-  ``.done`` markers);
+- ``3``  — classic mode only: one or more workers were SIGNAL-killed
+  (the merge is SKIPPED: the destination stays untouched; a relaunch
+  resumes the killed rank from its journal and skips finished ranks
+  via their ``.done`` markers — the elastic coordinator re-assigns
+  instead of exiting);
 - ``4``  — workers completed but the merge failed;
 - ``5``  — the pod timed out (remaining workers terminated);
+- ``7``  — elastic mode: a span died more than its attempt budget
+  (EXIT_SPAN_FAILED — loud, never a hang);
 - else  — the first failing worker's own exit code (e.g. 1/2).
+  (Workers themselves exit ``6`` when they lose a span lease race —
+  benign, absorbed by the coordinator, never the pod's code.)
 
-A ``<out>.podrun.json`` state file maps rank -> pid while the pod runs
-(written atomically; removed on success) — operators and the chaos
-harness use it to find a specific rank's worker.
+A ``<out>.podrun.json`` state file maps workers -> pids while the pod
+runs (written atomically; removed on success) — operators and the chaos
+harness use it to find a specific worker. Elastic state files carry
+``"mode": "elastic"`` and per-worker ``span``/``gen`` instead of ranks.
 """
 
 from __future__ import annotations
@@ -36,7 +52,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -53,11 +68,7 @@ def state_path(out_path: str) -> str:
     return str(out_path) + ".podrun.json"
 
 
-def _write_state(out_path: str, ranks: int, procs) -> None:
-    doc = {"ranks": ranks,
-           "workers": [{"rank": r, "pid": p.pid}
-                       for r, p in enumerate(procs)],
-           "launcher_pid": os.getpid()}
+def _dump_state(out_path: str, doc: dict) -> None:
     tmp = state_path(out_path) + ".tmp"
     with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, sort_keys=True)
@@ -65,13 +76,41 @@ def _write_state(out_path: str, ranks: int, procs) -> None:
     os.replace(tmp, state_path(out_path))
 
 
-def _output_file_of(fwd: list[str]) -> str | None:
+def _write_state(out_path: str, ranks: int, procs) -> None:
+    _dump_state(out_path, {
+        "ranks": ranks,
+        "workers": [{"rank": r, "pid": p.pid}
+                    for r, p in enumerate(procs)],
+        "launcher_pid": os.getpid()})
+
+
+def _flag_of(fwd: list[str], flag: str) -> str | None:
     for i, a in enumerate(fwd):
-        if a == "--output_file":
+        if a == flag:
             return fwd[i + 1] if i + 1 < len(fwd) else None
-        if a.startswith("--output_file="):
+        if a.startswith(flag + "="):
             return a.split("=", 1)[1]
     return None
+
+
+def _output_file_of(fwd: list[str]) -> str | None:
+    return _flag_of(fwd, "--output_file")
+
+
+def _parse_worker_env(specs: list[str]) -> dict[int, list[tuple[str, str]]]:
+    """``IDX:KEY=VAL`` per-worker env overrides (the bench straggler
+    phase slows exactly one initial worker this way; replacement workers
+    spawned by the coordinator get NO overrides — slot is None)."""
+    out: dict[int, list[tuple[str, str]]] = {}
+    for spec in specs:
+        try:
+            idx, kv = spec.split(":", 1)
+            key, val = kv.split("=", 1)
+            out.setdefault(int(idx), []).append((key, val))
+        except ValueError:
+            raise SystemExit(
+                f"podrun: bad --worker-env {spec!r} (want IDX:KEY=VAL)")
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -85,7 +124,8 @@ def main(argv: list[str] | None = None) -> int:
         description="spawn N rank-partitioned filter workers + the "
                     "rank-sequenced merge (docs/scaleout.md)")
     ap.add_argument("--ranks", type=int, required=True,
-                    help="worker process count (N)")
+                    help="worker process count (N); elastic pods seed N "
+                         "initial spans")
     ap.add_argument("--timeout", type=float, default=3600.0,
                     help="whole-pod wall bound in seconds "
                          "(default %(default)s)")
@@ -93,7 +133,34 @@ def main(argv: list[str] | None = None) -> int:
                     help="stage the segments only; commit later with "
                          "`vctpu merge-ranks <out>`")
     ap.add_argument("--keep-logs", action="store_true",
-                    help="keep per-rank worker logs even on success")
+                    help="keep per-worker logs even on success")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic membership: leased spans + the "
+                         "coordinator state machine (re-assign on death, "
+                         "straggler stealing, autoscaling) — "
+                         "docs/scaleout.md \"Elastic membership\"")
+    ap.add_argument("--min-ranks", type=int, default=1,
+                    help="elastic: never shed below this many workers "
+                         "(default %(default)s)")
+    ap.add_argument("--max-ranks", type=int, default=None,
+                    help="elastic: pool growth bound (default: --ranks)")
+    ap.add_argument("--steal-factor", type=float, default=4.0,
+                    help="elastic: steal when a worker's journal rate "
+                         "falls below median/FACTOR (0 disables; "
+                         "default %(default)s)")
+    ap.add_argument("--grace", type=float, default=1.5,
+                    help="elastic: seconds before a worker is eligible "
+                         "for stealing (default %(default)s)")
+    ap.add_argument("--max-load", type=float, default=None,
+                    help="elastic: shed (no new joins, down to "
+                         "--min-ranks) while loadavg exceeds this "
+                         "(default: no shedding)")
+    ap.add_argument("--worker-env", action="append", default=[],
+                    metavar="IDX:KEY=VAL",
+                    help="extra env for initial worker IDX (repeatable)")
+    ap.add_argument("--chaos", choices=("steal_race", "join_during_merge"),
+                    default=None,
+                    help="elastic fault injection for the chaos harness")
     args = ap.parse_args(argv)
     if args.ranks <= 0:
         print("podrun: --ranks must be positive", file=sys.stderr)
@@ -107,12 +174,24 @@ def main(argv: list[str] | None = None) -> int:
         print("podrun: the forwarded arguments must include "
               "--output_file (the merge target)", file=sys.stderr)
         return EXIT_USAGE
+    try:
+        worker_env = _parse_worker_env(args.worker_env)
+    except SystemExit as e:
+        print(e, file=sys.stderr)
+        return EXIT_USAGE
+    if args.elastic:
+        return _run_elastic(args, fwd, out_path, worker_env)
+    if args.chaos:
+        print("podrun: --chaos requires --elastic", file=sys.stderr)
+        return EXIT_USAGE
 
     procs: list[subprocess.Popen] = []
     logs: list[str] = []
     for r in range(args.ranks):
         env = dict(os.environ,
                    VCTPU_RANK=str(r), VCTPU_NUM_PROCESSES=str(args.ranks))
+        for k, v in worker_env.get(r, []):
+            env[k] = v
         log = f"{out_path}.rank{r}.podlog"
         logs.append(log)
         fh = open(log, "wb")
@@ -207,6 +286,137 @@ def main(argv: list[str] | None = None) -> int:
             except OSError:
                 pass
     return 0
+
+
+def _run_elastic(args, fwd: list[str], out_path: str,
+                 worker_env: dict[int, list[tuple[str, str]]]) -> int:
+    """The elastic pod: scan the record region, seed the initial span
+    plan, hand the coordinator a real-subprocess spawner, then commit
+    the final (possibly re-cut) span plan."""
+    sys.path.insert(0, REPO)
+    from variantcalling_tpu import obs
+    from variantcalling_tpu.io import vcf as vcf_mod
+    from variantcalling_tpu.parallel import elastic
+    from variantcalling_tpu.parallel import rank_plan as rank_plan_mod
+
+    inp = _flag_of(fwd, "--input_file")
+    if not inp:
+        print("podrun: --elastic needs --input_file in the forwarded "
+              "arguments (the span plan partitions it)", file=sys.stderr)
+        return EXIT_USAGE
+    try:
+        header_end, total = vcf_mod.scan_record_region(inp)
+    except Exception as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — refuses loudly with exit 2, never continues
+        print(f"podrun: cannot span-partition {inp}: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    spans = elastic.initial_spans(header_end, total, args.ranks)
+
+    run = obs.start_run("podrun", default_path=out_path + ".podrun.obs.jsonl")
+    logs: list[str] = []
+
+    def spawn(span, slot):
+        env = dict(os.environ, VCTPU_SPAN=elastic.span_env(span))
+        # a leased span IS the whole partition spelling — a leaked rank
+        # env would make resolve() refuse the ambiguity (exit 2)
+        env.pop("VCTPU_RANK", None)
+        env.pop("VCTPU_NUM_PROCESSES", None)
+        if obs.enabled():
+            # one obs stream per worker attempt; the coordinator's own
+            # stream holds the membership timeline
+            env["VCTPU_OBS_PATH"] = (f"{out_path}.span{span.lo}-{span.hi}"
+                                     f".g{span.gen}.obs.jsonl")
+        if slot is not None:
+            for k, v in worker_env.get(slot, []):
+                env[k] = v
+        log = f"{out_path}.span{span.lo}-{span.hi}.g{span.gen}.podlog"
+        logs.append(log)
+        fh = open(log, "ab")
+        p = subprocess.Popen(  # noqa: S603  # vctpu-lint: disable=VCT005 — the Coordinator polls/kills under its own deadline
+            [sys.executable, "-m", "variantcalling_tpu",
+             "filter_variants_pipeline", *fwd],
+            env=env, cwd=REPO, stdout=fh, stderr=subprocess.STDOUT)
+        fh.close()
+        return p
+
+    def on_state(workers):
+        _dump_state(out_path, {"mode": "elastic", "ranks": args.ranks,
+                               "workers": workers,
+                               "launcher_pid": os.getpid()})
+
+    coord = elastic.Coordinator(
+        out_path, spans, spawn,
+        max_ranks=args.max_ranks if args.max_ranks else args.ranks,
+        min_ranks=args.min_ranks, steal_factor=args.steal_factor,
+        grace_s=args.grace, timeout_s=args.timeout,
+        max_load=args.max_load, chaos=args.chaos, on_state=on_state)
+    print(f"podrun: elastic pod, {len(spans)} initial spans "
+          f"(max {coord.max_ranks} workers) -> {out_path}", flush=True)
+    try:
+        rc = coord.run()
+    except KeyboardInterrupt:
+        obs.end_run(run, status="interrupted")
+        print("podrun: interrupted — workers terminated; segments + "
+              "journals kept for resume", file=sys.stderr)
+        return 130
+
+    if args.chaos == "steal_race":
+        print(f"podrun: chaos steal_race: claim_lost={coord.claim_lost}",
+              flush=True)
+    try:
+        os.remove(state_path(out_path))
+    except OSError:
+        pass
+    if rc != 0:
+        _print_worker_tails(logs)
+        obs.end_run(run, status=f"rc={rc}")
+        print(f"podrun: elastic pod failed rc={rc} — segments + journals "
+              "kept for resume", file=sys.stderr)
+        return rc
+
+    if args.chaos == "join_during_merge":
+        if coord.chaos_join_during_merge():
+            print("podrun: chaos join_during_merge: join_refused",
+                  flush=True)
+        else:
+            obs.end_run(run, status="chaos_failed")
+            print("podrun: chaos join_during_merge: duplicate claimant "
+                  "was NOT refused", file=sys.stderr)
+            return 1
+
+    if args.no_merge:
+        obs.end_run(run)
+        print(f"podrun: {len(coord.spans)} span segments staged "
+              "(--no-merge)", flush=True)
+        return 0
+    try:
+        stats = elastic.merge_spans(out_path, coord.spans)
+    except rank_plan_mod.MergeError as e:
+        obs.end_run(run, status="merge_failed")
+        print(f"podrun: merge failed: {e}", file=sys.stderr)
+        return EXIT_MERGE
+    obs.end_run(run)
+    print(f"podrun: wrote {out_path}: {stats['n']} variants, "
+          f"{stats['n_pass']} PASS from {stats['spans']} spans "
+          f"({len(coord.transitions)} membership transitions)", flush=True)
+    if not args.keep_logs:
+        for log in logs:
+            try:
+                os.remove(log)
+            except OSError:
+                pass
+    return 0
+
+
+def _print_worker_tails(logs: list[str]) -> None:
+    for log in logs:
+        try:
+            with open(log, "rb") as fh:
+                tail = fh.read()[-1500:]
+        except OSError:
+            continue
+        if tail:
+            print(f"podrun: --- {os.path.basename(log)} ---\n"
+                  f"{tail.decode(errors='replace')}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -31,10 +31,13 @@ Three tiers share the store machinery:
 - **vctpu serve** — an in-process :class:`MemoryStore` warm index
   shared across requests (:func:`resident_mode`), consulted before
   disk and warmed by disk hits;
-- **rank-partitioned pod** — per-rank subdirectories
-  (``rank{r}of{n}``): the deterministic cut rule means a rank's spans
-  re-key identically across runs, and sibling ranks never contend on
-  one LRU.
+- **rank-partitioned / elastic pod** — ONE shared store with
+  PARTITION-AGNOSTIC keys (``identity.cache_identity`` strips the
+  rank/span layout from the fingerprint): rendered record bytes are a
+  pure function of (raw span, scoring config), never of which worker
+  rendered them, so a re-cut or stolen elastic span warm-hits entries
+  its dead predecessor published. Sibling workers share the directory
+  safely — writes are atomic renames, eviction is best-effort.
 
 Publication is **committed-prefix only**: workers STAGE computed
 entries by chunk sequence number, and the sequenced committer publishes
@@ -437,20 +440,26 @@ def open_session(config: dict, rank: int = 0,
     """The one constructor (``pipelines/filter_variants.py``): ``None``
     when the cache is off; otherwise a session over the resident memory
     index (serve) and/or the on-disk store. An unusable cache directory
-    degrades to whatever stores remain — never fails the run."""
+    degrades to whatever stores remain — never fails the run.
+
+    Keys are PARTITION-AGNOSTIC (``identity.cache_identity``): the
+    rank/span layout is stripped from the fingerprint and every worker
+    shares ONE store directory, so a re-cut or stolen elastic span
+    warm-hits entries its dead predecessor published — on mm inputs the
+    chunk-boundary recurrence makes a re-cut suffix re-key identically
+    (docs/caching.md "Elastic pods"). The ``rank``/``ranks`` parameters
+    remain for call-site symmetry; they no longer shape the key or the
+    store path. Concurrent ranks on one DiskStore are safe by its
+    atomic-rename + best-effort-evict design."""
+    del rank, ranks  # partition-agnostic since the elastic-pods PR
     if not enabled():
         return None
-    fp = identity_mod.fingerprint(config)
+    fp = identity_mod.fingerprint(identity_mod.cache_identity(config))
     stores: list = []
     mem = _memory_store()
     if mem is not None:
         stores.append(mem)
     root = store_dir()
-    if ranks > 1:
-        # per-rank stores: the deterministic cut rule re-keys a rank's
-        # spans identically across runs of the same layout, and sibling
-        # ranks never contend on one directory's LRU (docs/scaleout.md)
-        root = os.path.join(root, f"rank{rank}of{ranks}")
     try:
         stores.append(DiskStore(root, max_bytes()))
     except OSError as e:

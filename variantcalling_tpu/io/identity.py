@@ -71,7 +71,8 @@ def scoring_fields(args) -> dict:
 
 
 def scoring_config(args, engine: str | None, forest_strategy: str | None,
-                   mesh_devices: int, rank: int, ranks: int) -> dict:
+                   mesh_devices: int, rank: int, ranks: int,
+                   span: tuple | None = None) -> dict:
     """The FULL scoring configuration: args-derived fields plus the
     resolved execution selection. This is the journal's ``config``
     sub-dict AND the chunk cache's fingerprint input — one object, so
@@ -90,12 +91,33 @@ def scoring_config(args, engine: str | None, forest_strategy: str | None,
       a journal/segment/cache span written by rank r of n describes r's
       spans only (docs/scaleout.md). The deterministic cut rule means a
       rank's spans re-key identically across runs of the same layout.
+    - ``span``: the elastic spelling of the same fact — an elastic
+      worker's journal/segment describes exactly the absolute target
+      interval ``[lo, hi)`` it was leased (``parallel/elastic.py``), so
+      a journal handed off across a re-cut must pin the NEW interval.
+      ``None`` for rank-fraction and single runs.
     """
     cfg = scoring_fields(args)
     cfg["engine"] = engine
     cfg["forest_strategy"] = forest_strategy
     cfg["mesh_devices"] = mesh_devices
     cfg["ranks"] = [rank, ranks]
+    cfg["span"] = [int(span[0]), int(span[1])] if span is not None else None
+    return cfg
+
+
+def cache_identity(config: dict) -> dict:
+    """The chunk cache's PARTITION-AGNOSTIC view of a scoring config:
+    ``ranks``/``span`` removed. Record bytes are a pure function of the
+    raw input span + the scoring configuration — never of which rank or
+    elastic span rendered them — so a re-cut or stolen span must still
+    warm-hit entries produced under the old partitioning
+    (docs/caching.md). Resume journals and segment markers keep the
+    partition fields: THEIR artifacts (chunk sequences, segments) really
+    are partition-shaped."""
+    cfg = dict(config)
+    cfg.pop("ranks", None)
+    cfg.pop("span", None)
     return cfg
 
 

@@ -201,11 +201,19 @@ class ChunkJournal:
         self._fh = open(journal_path(self.out_path), "a", encoding="utf-8")
 
     def append(self, seq: int, records: int, passed: int, body_len: int,
-               crc: int) -> None:
+               crc: int, in_end: int | None = None) -> None:
         assert self._fh is not None, "journal not started"
-        self._fh.write(json.dumps(
-            {"seq": seq, "records": records, "pass": passed,
-             "body_len": body_len, "crc": crc}) + "\n")
+        entry = {"seq": seq, "records": records, "pass": passed,
+                 "body_len": body_len, "crc": crc}
+        if in_end is not None:
+            # absolute decompressed END offset of the chunk's INPUT span
+            # — the elastic re-cut rule (parallel/elastic.py) splits a
+            # dead rank's span at the last journaled in_end, so the
+            # journaled prefix is adoptable as a complete sub-span and
+            # the remainder re-cuts fresh. Optional: journals without it
+            # (older writers) degrade to whole-span re-assignment.
+            entry["in_end"] = int(in_end)
+        self._fh.write(json.dumps(entry) + "\n")
         self._fh.flush()
         if fsync_enabled():
             # durability knob (VCTPU_JOURNAL_FSYNC): the journal line
@@ -385,7 +393,8 @@ def _try_resume(out_path: str, meta: dict,
         j.begin(dict(jmeta, partial=new_token))
         for e in entries:
             j.append(int(e["seq"]), int(e["records"]), int(e["pass"]),
-                     int(e["body_len"]), int(e["crc"]))
+                     int(e["body_len"]), int(e["crc"]),
+                     in_end=e.get("in_end"))
         j.close()
     except BaseException:
         if claim:

@@ -917,6 +917,28 @@ class _SpanGzWindow:
         self._start: int | None = None  # cut(t_lo), resolved lazily
         self._end: int | None = None  # cut(t_hi)
 
+    def start(self) -> int:
+        """Absolute decompressed offset of the window's first byte —
+        ``cut(t_lo)`` — resolving (and discarding the pre-window prefix)
+        eagerly. The reader uses it as the base for the per-chunk input
+        end offsets the elastic re-cut consumes."""
+        self._resolve_start()
+        return self._start
+
+    def _resolve_start(self) -> None:
+        if self._start is not None:
+            return
+        self._start = self._cut(self._t_lo)
+        if self._t_hi >= self._total:
+            self._end = self._total
+        while self._buf_abs < self._start:
+            if not self._buf:
+                if not self._more():
+                    break
+                continue
+            self._drop(min(len(self._buf),
+                           self._start - self._buf_abs))
+
     def _more(self) -> bool:
         if self._inner_eof:
             return False
@@ -956,17 +978,7 @@ class _SpanGzWindow:
                 return self._buf_abs + len(self._buf)  # EOF mid-final-line
 
     def read(self, n: int) -> bytes:
-        if self._start is None:
-            self._start = self._cut(self._t_lo)
-            if self._t_hi >= self._total:
-                self._end = self._total
-            while self._buf_abs < self._start:
-                if not self._buf:
-                    if not self._more():
-                        break
-                    continue
-                self._drop(min(len(self._buf),
-                               self._start - self._buf_abs))
+        self._resolve_start()
         out = bytearray()
         while len(out) < n:
             if self._end is not None and self._buf_abs >= self._end:
@@ -1027,7 +1039,8 @@ class VcfChunkReader:
 
     def __init__(self, path: str, chunk_bytes: int = 0,
                  io_threads: int | None = None, profiler=None,
-                 rank_span: tuple[int, int] | None = None):
+                 rank_span: tuple[int, int] | None = None,
+                 span_targets: tuple[int, int] | None = None):
         from variantcalling_tpu import native
         from variantcalling_tpu.parallel.pipeline import resolve_io_threads
 
@@ -1044,9 +1057,28 @@ class VcfChunkReader:
             if not 0 <= r < nr:
                 raise ValueError(f"rank_span {rank_span!r} out of range")
             self._rank_span = (r, nr)
+        # elastic spans (docs/scaleout.md "Elastic membership"): absolute
+        # decompressed-byte targets ``[t_lo, t_hi)``. The rank fractions
+        # above are the special case ``t = h + body*r//n``; the SAME cut
+        # rule maps ANY monotone target sequence to an exact line-aligned
+        # partition, so re-cut/stolen spans keep the byte-parity contract
+        self._span_targets: tuple[int, int] | None = None
+        if span_targets is not None:
+            lo, hi = int(span_targets[0]), int(span_targets[1])
+            if hi < lo:
+                raise ValueError(f"span_targets {span_targets!r} inverted")
+            self._span_targets = (lo, hi)
+            if self._rank_span is not None:
+                raise ValueError("rank_span and span_targets are exclusive")
         #: decompressed bytes of this reader's span (None: whole file) —
         #: the heartbeat's progress denominator for rank runs
         self.span_bytes: int | None = None
+        #: absolute decompressed END offset of every chunk boundary this
+        #: reader computed so far (skipped chunks included, indexed by
+        #: chunk sequence number) — the committer journals it as
+        #: ``in_end`` so an elastic re-cut can split a dead span at the
+        #: last journaled boundary (parallel/elastic.py)
+        self.chunk_ends: list[int] = []
         # arg beats the env knob beats the (test-patchable) module
         # default; resolved here, not at import, so a malformed value is
         # caught by run()'s up-front knobs.validate_all() instead of an
@@ -1067,7 +1099,8 @@ class VcfChunkReader:
         self._mm: np.ndarray | None = None
         self._fh = None
         self._pending = b""
-        if self._gz and self._rank_span is not None:
+        if self._gz and (self._rank_span is not None
+                         or self._span_targets is not None):
             # rank-span gz ingest: member-mapped window (BGZF only)
             try:
                 self._init_gz_span()
@@ -1083,6 +1116,7 @@ class VcfChunkReader:
                 self._fh = self._open_gz_stream()
                 self.header, first_off, head = self._scan_gz_header(self._fh)
                 self._pending = head[first_off:]
+                self._gz_base = first_off  # chunk-end offset base
             except BaseException:
                 self.close()
                 raise
@@ -1103,6 +1137,12 @@ class VcfChunkReader:
             self._span_lo, self._span_hi = first_off, size
             if self._rank_span is not None:
                 self._span_lo, self._span_hi = self._mm_span_bounds(size)
+                self.span_bytes = self._span_hi - self._span_lo
+            elif self._span_targets is not None:
+                lo, hi = self._span_targets
+                self._span_lo = self._mm_newline_cut(lo, size)
+                self._span_hi = max(self._span_lo,
+                                    self._mm_newline_cut(hi, size))
                 self.span_bytes = self._span_hi - self._span_lo
 
     def _scan_gz_header(self, fh) -> tuple:
@@ -1177,10 +1217,17 @@ class VcfChunkReader:
             self.header, first_off, _ = self._scan_gz_header(fh)
         h = first_off
         total = int(sum(s[2] for s in spans))
-        r, n_ranks = self._rank_span
-        body = max(0, total - h)
-        t_lo = h + body * r // n_ranks
-        t_hi = h + body * (r + 1) // n_ranks
+        if self._span_targets is not None:
+            # elastic span: explicit absolute targets, clamped to the
+            # record region — the rank fractions below are the special
+            # case the coordinator's initial plan reproduces exactly
+            t_lo = max(h, min(self._span_targets[0], total))
+            t_hi = max(t_lo, min(self._span_targets[1], total))
+        else:
+            r, n_ranks = self._rank_span
+            body = max(0, total - h)
+            t_lo = h + body * r // n_ranks
+            t_hi = h + body * (r + 1) // n_ranks
         self.span_bytes = max(0, t_hi - t_lo)
         # first decompressed byte the window needs: the line-start probe
         # at t_lo - 1 (or the header end, for rank 0's window)
@@ -1256,6 +1303,14 @@ class VcfChunkReader:
         them (journal resume — their rendered bytes are already on disk).
         Must be called before iteration starts."""
         self._skip = max(0, int(n_chunks))
+
+    def chunk_end(self, seq: int) -> int | None:
+        """Absolute decompressed end offset of chunk ``seq`` (``None``
+        before its boundary is computed). Boundaries are computed during
+        ingest, which strictly precedes the chunk's commit, so the
+        committer's lookup for the chunk it just wrote always lands."""
+        return self.chunk_ends[seq] if 0 <= seq < len(self.chunk_ends) \
+            else None
 
     def _parse_chunk(self, buf_np: np.ndarray, lazy_buf) -> VariantTable:
         from variantcalling_tpu import native
@@ -1368,6 +1423,7 @@ class VcfChunkReader:
                         end = n
                         break
                     probe *= 8
+            self.chunk_ends.append(end)
             if self._skip > 0:
                 self._skip -= 1
             else:
@@ -1380,6 +1436,11 @@ class VcfChunkReader:
         the boundary rule reads fixed-size windows off ``self._fh``, so it
         is identical whether the stream is the serial gzip reader or the
         shard-parallel BGZF inflater."""
+        # absolute offset of the next unconsumed decompressed byte: the
+        # header end for whole-file ingest, cut(t_lo) for a span window —
+        # chunk_ends advances from it by each chunk's raw length
+        pos = (self._fh.start() if isinstance(self._fh, _SpanGzWindow)
+               else self._gz_base)
         carry = self._pending
         self._pending = b""
         while True:
@@ -1393,16 +1454,70 @@ class VcfChunkReader:
                 continue
             carry = block[cut + 1 :]
             chunk = block[: cut + 1]
+            pos += len(chunk)
+            self.chunk_ends.append(pos)
             if self._skip > 0:
                 self._skip -= 1
                 continue
             yield np.frombuffer(chunk, dtype=np.uint8), chunk
         if carry:
+            pos += len(carry)
+            self.chunk_ends.append(pos)
             if self._skip > 0:
                 self._skip -= 1
             else:
                 yield np.frombuffer(carry, dtype=np.uint8), carry
         self._fh.close()
+
+
+def scan_record_region(path: str) -> tuple[int, int]:
+    """``(header_end, total_size)`` of a VCF in DECOMPRESSED bytes — the
+    target domain the elastic coordinator cuts spans over
+    (``parallel/elastic.py``). The header-end rule matches the chunk
+    readers' (``parse_header_bytes`` over a growing prefix), so the
+    coordinator's span targets and every worker's cuts agree byte for
+    byte. BGZF totals come from the member index (``scan_block_spans``
+    isize sum) without inflating the file; plain single-member gzip has
+    no split points and is refused loudly, exactly like rank-span
+    ingest."""
+    path = str(path)
+    if path.endswith((".gz", ".bgz")):
+        from variantcalling_tpu.engine import EngineError
+        from variantcalling_tpu.io import bgzf as bgzf_mod
+
+        size = os.path.getsize(path)
+        mm = (np.memmap(path, dtype=np.uint8, mode="r") if size
+              else np.empty(0, dtype=np.uint8))
+        spans = bgzf_mod.scan_block_spans(mm) if size else []
+        if spans is None:
+            raise EngineError(
+                f"{path}: span-partitioned ingest needs BGZF-framed "
+                "input (plain gzip is one indivisible deflate stream) — "
+                "re-compress with bgzip/the BGZF writer, or run "
+                "single-rank (docs/scaleout.md)")
+        total = int(sum(s[2] for s in spans))
+        head = b""
+        with gzip.open(path, "rb") as fh:
+            while True:
+                block = fh.read(STREAM_CHUNK_BYTES)
+                head += block
+                _header, first_off = parse_header_bytes(head)
+                if not block or (first_off < len(head)
+                                 and head[first_off:first_off + 1] != b"#"):
+                    break
+        return first_off, total
+    size = os.path.getsize(path)
+    mm = (np.memmap(path, dtype=np.uint8, mode="r") if size
+          else np.empty(0, dtype=np.uint8))
+    cap = 1 << 20
+    while True:
+        head = bytes(memoryview(mm[: min(cap, size)]))
+        _header, first_off = parse_header_bytes(head)
+        if (first_off < len(head) and head[first_off:first_off + 1] != b"#") \
+                or cap >= size:
+            break
+        cap *= 8
+    return first_off, size
 
 
 def format_qual(q: float) -> str:

@@ -181,6 +181,11 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        minimum=0),
     _k("VCTPU_PROCESS_ID", "int", None,
        "this rank's id in a multi-host launch", minimum=0),
+    _k("VCTPU_SPAN", "str", None,
+       "lo:hi:gen — this worker is one leased span of an elastic pod "
+       "(absolute decompressed-byte targets + lease generation; "
+       "tools/podrun --elastic sets it — docs/scaleout.md \"Elastic "
+       "membership\")"),
     _k("VCTPU_AUTO_DISTRIBUTED", "bool", False,
        "initialize jax.distributed from the cluster environment (TPU "
        "pods)"),
@@ -194,7 +199,8 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "docs/caching.md)"),
     _k("VCTPU_CACHE_DIR", "str", "",
        "chunk-result cache directory (default ~/.cache/vctpu/chunks; "
-       "rank-partitioned runs use per-rank subdirectories)"),
+       "one store shared across ranks/spans — keys are "
+       "partition-agnostic)"),
     _k("VCTPU_CACHE_MAX_MB", "int", 512,
        "chunk-result cache size bound in MiB (LRU eviction; bounds the "
        "on-disk store and the serve daemon's in-memory warm index "

@@ -265,6 +265,7 @@ def summarize(events: list[dict]) -> dict:
     degradations: dict[str, int] = {}
     faults: dict[str, int] = {}
     recoveries: dict[str, int] = {}
+    membership: dict[str, int] = {}
     for e in events:
         if e.get("kind") == "degrade":
             degradations[e.get("name", "?")] = \
@@ -277,6 +278,13 @@ def summarize(events: list[dict]) -> dict:
             # dp_degrade) — docs/robustness.md
             recoveries[e.get("name", "?")] = \
                 recoveries.get(e.get("name", "?"), 0) + 1
+        elif e.get("kind") == "membership":
+            # elastic pod transitions (join / leave / steal / recut /
+            # reassign / shed / claim_lost / join_refused) — rolled up
+            # by ACTION, the span label stays in the raw stream
+            # (docs/scaleout.md "Elastic membership")
+            membership[e.get("action", "?")] = \
+                membership.get(e.get("action", "?"), 0) + 1
 
     # chunk-cache roll-up (docs/caching.md): the final metrics snapshot
     # carries the cache.hit / cache.miss / cache.bytes_saved counters the
@@ -324,6 +332,7 @@ def summarize(events: list[dict]) -> dict:
         "degradations": degradations,
         "faults": faults,
         "recoveries": recoveries,
+        "membership": membership,
         "cache": cache,
         "slowest_chunks": [{"name": e.get("name"), "chunk": e.get("chunk"),
                             "dur_s": round(float(e.get("dur", 0.0)), 6)}
@@ -607,6 +616,9 @@ def render_summary(summary: dict) -> str:
     if summary.get("recoveries"):
         lines.append("recovery actions: " + ", ".join(
             f"{k} x{v}" for k, v in sorted(summary["recoveries"].items())))
+    if summary.get("membership"):
+        lines.append("membership transitions: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(summary["membership"].items())))
     if summary["slowest_chunks"]:
         lines.append("slowest chunks: " + ", ".join(
             f"{c['name']}#{c['chunk']} {c['dur_s']:.3f}s"

@@ -71,12 +71,23 @@ class MergeError(RuntimeError):
 
 @dataclass(frozen=True)
 class RankPlan:
-    """The run-level rank layout (docs/scaleout.md)."""
+    """The run-level rank layout (docs/scaleout.md).
+
+    Elastic pods (docs/scaleout.md "Elastic membership") add the
+    ``span`` spelling: a worker leased an absolute target interval
+    ``[lo, hi)`` of the decompressed record region runs as a
+    single-rank plan (``ranks=1``) whose reader is span-bounded —
+    so its segment header carries NO ``##vctpu_ranks=`` line and the
+    merged bytes stay identical to the serial run whatever the final
+    span plan looks like. ``gen`` is the lease generation the
+    coordinator offered this span under (``parallel/elastic.py``)."""
 
     ranks: int
     rank: int
-    source: str  # "env" (local launcher) | "distributed" | "single"
+    source: str  # "env" (local launcher) | "distributed" | "span" | "single"
     reason: str
+    span: tuple | None = None  # absolute (lo, hi) byte targets
+    gen: int = 0  # lease generation of an elastic offer
 
     def header_line(self) -> str:
         # n only — never the rank id: every rank's segment must emit
@@ -88,12 +99,29 @@ class RankPlan:
 def resolve() -> RankPlan:
     """Resolve THIS process's rank layout, once per run.
 
-    ``VCTPU_RANK`` (+ ``VCTPU_NUM_PROCESSES``) is the local launcher's
-    spelling and is read BEFORE any jax init; without it, an initialized
-    ``jax.distributed`` runtime (coordinator/auto mode) supplies the
-    layout; everything else is the single plan. An out-of-range rank is
-    a configuration error (exit 2), never a clamp."""
+    ``VCTPU_SPAN`` (``lo:hi:gen``, the elastic launcher's spelling —
+    ``parallel/elastic.py``) wins first: the worker is one leased span
+    of an elastic pod, running as a single-rank plan with a
+    span-bounded reader. ``VCTPU_RANK`` (+ ``VCTPU_NUM_PROCESSES``) is
+    the classic local launcher's spelling and is read BEFORE any jax
+    init; without it, an initialized ``jax.distributed`` runtime
+    (coordinator/auto mode) supplies the layout; everything else is the
+    single plan. An out-of-range rank is a configuration error
+    (exit 2), never a clamp."""
+    s = knobs.get_str("VCTPU_SPAN")
     r = knobs.get_int("VCTPU_RANK")
+    if s:
+        if r is not None:
+            raise EngineError(
+                "VCTPU_SPAN and VCTPU_RANK are both set — a worker is "
+                "either one leased span of an elastic pod or one rank "
+                "of a static pod, never both (docs/scaleout.md)")
+        from variantcalling_tpu.parallel import elastic
+
+        lo, hi, gen = elastic.parse_span_env(s)
+        return RankPlan(ranks=1, rank=0, source="span",
+                        reason="VCTPU_SPAN (elastic launcher)",
+                        span=(lo, hi), gen=gen)
     if r is not None:
         n = knobs.get_int("VCTPU_NUM_PROCESSES")
         if n is None:
@@ -127,7 +155,16 @@ def resolve() -> RankPlan:
 
 def log_plan(plan: RankPlan) -> None:
     """Announce a resolved multi-rank plan (obs ``resolve`` event + log);
-    single-rank plans stay silent, like the mesh plan."""
+    single-rank plans stay silent, like the mesh plan. Elastic span
+    plans announce their leased interval instead of a rank id."""
+    if plan.span is not None:
+        logger.info("span plan: [%d,%d) gen %d (%s)", plan.span[0],
+                    plan.span[1], plan.gen, plan.reason)
+        if obs.active():
+            obs.event("resolve", "span_plan",
+                      value=f"[{plan.span[0]},{plan.span[1]})",
+                      gen=plan.gen, source=plan.source, reason=plan.reason)
+        return
     if plan.ranks <= 1:
         return
     logger.info("rank plan: rank %d of %d (%s)", plan.rank, plan.ranks,
@@ -189,6 +226,13 @@ def segment_identity(args, plan: RankPlan,
     ident = identity_mod.scoring_fields(args)
     ident["input"] = identity_mod.file_sig(args.input_file)
     ident["ranks"] = [plan.rank, plan.ranks]
+    if plan.span is not None:
+        # elastic span workers: the segment is valid for exactly the
+        # leased target interval — a re-cut span recomputes (or adopts
+        # the handed-off journal), never reuses a different interval's
+        # bytes. The splice masks BOTH partition fields when checking
+        # cross-segment config agreement.
+        ident["span"] = [int(plan.span[0]), int(plan.span[1])]
     # engine-selection env: resolved engine name + the raw strategy/
     # mesh requests — they change the segment's provenance HEADER
     # bytes, so a stale segment under a different selection must
@@ -329,40 +373,77 @@ def merge_ranks(out_path: str, ranks: int | None = None,
         ranks = discover_ranks(out_path)
         if ranks is None:
             raise MergeError(f"no rank segments found next to {out_path}")
-    segs = [segment_path(out_path, r, ranks) for r in range(ranks)]
+    segs = [(f"rank {r}/{ranks}", segment_path(out_path, r, ranks))
+            for r in range(ranks)]
+    total, markers = splice_segments(out_path, segs)
+    stats = {
+        "ranks": ranks,
+        "bytes": total,
+        "n": sum(int((m.get("stats") or {}).get("n") or 0)
+                 for m in markers),
+        "n_pass": sum(int((m.get("stats") or {}).get("n_pass") or 0)
+                      for m in markers),
+    }
+    if obs.active():
+        obs.event("journal", "rank_merge", ranks=ranks, bytes=total,
+                  records=stats["n"])
+    if cleanup:
+        discard_segments(out_path)
+    logger.info("merged %d rank segments -> %s (%d records, %d bytes "
+                "uncompressed)", ranks, out_path, stats["n"], total)
+    return stats
+
+
+def splice_segments(out_path: str,
+                    segs: list[tuple[str, str]]) -> tuple[int, list[dict]]:
+    """The seam-aware splice core shared by :func:`merge_ranks` and the
+    elastic span committer (``parallel/elastic.merge_spans``): verify
+    every ``(label, path)`` segment — present, sealed by a ``.done``
+    marker, length-consistent with it, produced under ONE configuration
+    modulo the partition fields (``ranks``/``span`` are exactly what may
+    legitimately differ across a plan), identical header bytes — then
+    stream ``header + body_0 + ... + body_{k-1}`` into ``out_path``
+    through the run-unique ``.partial`` + atomic ``os.replace``
+    protocol. ``.gz`` destinations re-compress through ONE
+    :class:`~variantcalling_tpu.io.bgzf.BgzfChunkCompressor` so the
+    65280-byte block carry is re-carried across however many seams the
+    final plan has. Returns ``(uncompressed_bytes, markers)``."""
+    if not segs:
+        raise MergeError(f"empty segment plan for {out_path}")
     markers = []
-    for r, seg in enumerate(segs):
+    for label, seg in segs:
         if not os.path.exists(seg):
             raise MergeError(
-                f"rank {r}/{ranks} segment missing: {seg} — that rank has "
-                "not completed (relaunch it; finished ranks skip via their "
+                f"{label} segment missing: {seg} — that worker has not "
+                "completed (relaunch it; finished workers skip via their "
                 ".done markers)")
         doc = load_marker(seg)
         if doc is None:
             raise MergeError(
-                f"rank {r}/{ranks} completion marker missing/unreadable "
+                f"{label} completion marker missing/unreadable "
                 f"({marker_path(seg)}) — the segment may be mid-write")
         if os.path.getsize(seg) != doc.get("bytes"):
             raise MergeError(
-                f"rank {r}/{ranks} segment length disagrees with its "
+                f"{label} segment length disagrees with its "
                 "marker — torn or concurrently-written segment")
         markers.append(doc)
-    idents = {json.dumps(dict(m.get("identity") or {}, ranks=None),
-                         sort_keys=True) for m in markers}
+    idents = {json.dumps(dict(m.get("identity") or {}, ranks=None,
+                              span=None), sort_keys=True) for m in markers}
     if len(idents) > 1:
         raise MergeError(
-            "rank segments were produced under DIFFERENT configurations "
+            "segments were produced under DIFFERENT configurations "
             "(identity mismatch across markers) — refusing to splice them")
 
-    header_lens = [_header_len(s) for s in segs]
-    with open(segs[0], "rb") as fh:
+    header_lens = [_header_len(seg) for _, seg in segs]
+    with open(segs[0][1], "rb") as fh:
         header = fh.read(header_lens[0])
-    for r in range(1, ranks):
-        with open(segs[r], "rb") as fh:
-            if fh.read(header_lens[r]) != header:
+    for i in range(1, len(segs)):
+        with open(segs[i][1], "rb") as fh:
+            if fh.read(header_lens[i]) != header:
                 raise MergeError(
-                    f"rank {r} segment header differs from rank 0's — "
-                    "cross-rank configuration drift; refusing to splice")
+                    f"{segs[i][0]} segment header differs from "
+                    f"{segs[0][0]}'s — cross-worker configuration drift; "
+                    "refusing to splice")
 
     from variantcalling_tpu.io import journal as journal_mod
 
@@ -381,9 +462,9 @@ def merge_ranks(out_path: str, ranks: int | None = None,
             else:
                 sink.write(header)
             total += len(header)
-            for r, seg in enumerate(segs):
+            for i, (_, seg) in enumerate(segs):
                 with open(seg, "rb") as fh:
-                    fh.seek(header_lens[r])
+                    fh.seek(header_lens[i])
                     while True:
                         block = fh.read(_MERGE_BLOCK)
                         if not block:
@@ -408,22 +489,7 @@ def merge_ranks(out_path: str, ranks: int | None = None,
             build_tabix_index(out_path)
         except (ValueError, OSError):
             pass  # unsorted/odd inputs: the VCF itself is still valid
-    stats = {
-        "ranks": ranks,
-        "bytes": total,
-        "n": sum(int((m.get("stats") or {}).get("n") or 0)
-                 for m in markers),
-        "n_pass": sum(int((m.get("stats") or {}).get("n_pass") or 0)
-                      for m in markers),
-    }
-    if obs.active():
-        obs.event("journal", "rank_merge", ranks=ranks, bytes=total,
-                  records=stats["n"])
-    if cleanup:
-        discard_segments(out_path)
-    logger.info("merged %d rank segments -> %s (%d records, %d bytes "
-                "uncompressed)", ranks, out_path, stats["n"], total)
-    return stats
+    return total, markers
 
 
 # ---------------------------------------------------------------------------
@@ -455,7 +521,22 @@ def run_scaleout(args, model, fasta, annotate, blacklist, engine=None,
 
     plan = plan or resolve()
     out_path = str(args.output_file)
-    seg = segment_path(out_path, plan.rank, plan.ranks)
+    if plan.span is not None:
+        from variantcalling_tpu.parallel import elastic
+
+        seg = elastic.span_segment_path(out_path, plan.span[0],
+                                        plan.span[1])
+        # single-claimant lease: claimed BEFORE any compute or skip
+        # check, so two workers offered the same (span, generation) can
+        # never render the same segment — the loser exits
+        # EXIT_LEASE_LOST (6), benign to the coordinator
+        if not elastic.claim_lease(seg, plan.gen):
+            raise elastic.LeaseLost(
+                f"span [{plan.span[0]},{plan.span[1]}) generation "
+                f"{plan.gen}: lease already claimed "
+                f"({elastic.lease_path(seg, plan.gen)})")
+    else:
+        seg = segment_path(out_path, plan.rank, plan.ranks)
     identity = segment_identity(args, plan,
                                 engine.name if engine is not None else None)
     prior = valid_segment(seg, identity)

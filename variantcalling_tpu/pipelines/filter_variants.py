@@ -1263,7 +1263,8 @@ def run_streaming(args, model, fasta: FastaReader, annotate, blacklist,
 
     Returns a stats dict, or None when ineligible (caller runs serial).
     """
-    multiproc = rank_plan is not None and rank_plan.ranks > 1
+    multiproc = rank_plan is not None and (rank_plan.ranks > 1
+                                           or rank_plan.span is not None)
     if not streaming_eligible(args.limit_to_contig,
                               allow_multiprocess=multiproc):
         return None
@@ -1363,7 +1364,12 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         rank_plan = rank_plan_mod.resolve()
     span = (rank_plan.rank, rank_plan.ranks) \
         if rank_plan is not None and rank_plan.ranks > 1 else None
-    reader = VcfChunkReader(args.input_file, profiler=prof, rank_span=span)
+    # elastic span workers (docs/scaleout.md "Elastic membership") carry
+    # absolute byte targets instead of a rank fraction — same cut rule,
+    # so re-cut spans tile the record body exactly like rank spans
+    targets = rank_plan.span if rank_plan is not None else None
+    reader = VcfChunkReader(args.input_file, profiler=prof, rank_span=span,
+                            span_targets=targets)
     header = reader.header
     ctx = FilterContext(
         model, fasta, runs_file=args.runs_file,
@@ -1587,7 +1593,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
     scoring_cfg = identity_mod.scoring_config(
         args, engine=ctx.engine.name, forest_strategy=ctx.forest_strategy,
         mesh_devices=ctx.mesh_plan.devices,
-        rank=ctx.rank_plan.rank, ranks=ctx.rank_plan.ranks)
+        rank=ctx.rank_plan.rank, ranks=ctx.rank_plan.ranks,
+        span=ctx.rank_plan.span)
 
     # resume only for plain-text outputs: a killed BGZF writer's in-flight
     # block state is unrecoverable, so .gz runs restart (still atomic)
@@ -1941,7 +1948,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
                         # most the in-flight chunk
                         os.fsync(sink.fileno())
                     journal.append(n_chunks - 1, k, p, len(data),
-                                   zlib.crc32(data))
+                                   zlib.crc32(data),
+                                   in_end=reader.chunk_end(n_chunks - 1))
                 if cache_session is not None:
                     # committed-prefix publication: entries become
                     # visible (disk store / serve warm index) only once
@@ -2137,22 +2145,40 @@ def run_loaded(args, model, fasta: FastaReader, annotate, blacklist,
 
     try:
         plan = rank_plan_mod.resolve()
-        if plan.ranks > 1 and rank_plan_mod.scaleout_eligible(args):
-            logger.info("rank-partitioned scale-out: rank %d of %d (%s)",
-                        plan.rank, plan.ranks, plan.source)
+        partitioned = plan.ranks > 1 or plan.span is not None
+        if partitioned and rank_plan_mod.scaleout_eligible(args):
+            if plan.span is not None:
+                logger.info("elastic scale-out: span [%d,%d) gen %d",
+                            plan.span[0], plan.span[1], plan.gen)
+            else:
+                logger.info("rank-partitioned scale-out: rank %d of %d "
+                            "(%s)", plan.rank, plan.ranks, plan.source)
             with stage("scaleout"):
-                return rank_plan_mod.run_scaleout(
-                    args, model, fasta, annotate, blacklist, engine=eng,
-                    plan=plan)
-        if plan.ranks > 1 and plan.source == "env":
-            # an env-launched worker has NO collectives to merge scores
-            # through — silently writing the FULL output would make N
-            # ranks race on one destination; fail loudly instead
+                try:
+                    return rank_plan_mod.run_scaleout(
+                        args, model, fasta, annotate, blacklist,
+                        engine=eng, plan=plan)
+                except Exception as e:
+                    from variantcalling_tpu.parallel import elastic
+
+                    if isinstance(e, elastic.LeaseLost):
+                        # benign: another worker holds this (span, gen)
+                        # lease — exit distinctly so the coordinator can
+                        # tell a lost race from a real failure
+                        logger.info("%s — yielding (exit %d)", e,
+                                    elastic.EXIT_LEASE_LOST)
+                        return elastic.EXIT_LEASE_LOST
+                    raise
+        if partitioned and plan.source in ("env", "span"):
+            # an env/span-launched worker has NO collectives to merge
+            # scores through — silently writing the FULL output would
+            # make N workers race on one destination; fail loudly
             raise EngineError(
-                "VCTPU_RANK is set but this job cannot run the "
-                "rank-partitioned streaming executor (it needs the "
-                "native engine, VCTPU_STREAM=1, VCTPU_THREADS>1 and no "
-                "--limit_to_contig) — unset VCTPU_RANK or fix the "
+                f"VCTPU_{'SPAN' if plan.span is not None else 'RANK'} is "
+                "set but this job cannot run the rank-partitioned "
+                "streaming executor (it needs the native engine, "
+                "VCTPU_STREAM=1, VCTPU_THREADS>1 and no "
+                "--limit_to_contig) — unset it or fix the "
                 "configuration; docs/scaleout.md")
     except EngineError as e:
         logger.error("%s", e)
