@@ -10,7 +10,7 @@ human diff would catch it. This tool is the gate:
   its direction and its noise band) and **exits 1 on any regression
   beyond the band**, 0 when clean, 2 on usage/IO errors.
 - ``python -m tools.bench_gate --run`` runs a fresh reduced bench
-  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache``
+  (``VCTPU_BENCH_PHASES=hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache,dan``
   — the phases the gate reads) and compares it against the newest committed ``BENCH_r*.json``
   (or ``VCTPU_BENCH_BASELINE``). ``run_tests.sh`` wires this in as an
   opt-in tier-0 stage behind ``VCTPU_BENCH_GATE=1``.
@@ -227,6 +227,25 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     #    digest_state hard-fail below.
     ("cache.warm_hit_over_cold", "higher", 0.40),
     ("cache.bytes_identical", "nonzero", 0.0),
+    # -- DAN scoring family (docs/models.md): the GEMM-native second
+    #    model family on the SAME streaming hot path. The streaming-leg
+    #    vps rows gate the fused forward pass's throughput relatively
+    #    (wide bands: in-process legs on the shared 2-core box inherit
+    #    the io t2 placement modes); train_steps_per_s gates the
+    #    train_step GEMM path. The accuracy row gates relatively with a
+    #    tight band: the fit is fully seeded (fixed rng, fixed init, a
+    #    planted rule), so a drop means the training or serving program
+    #    changed, not the box — an untrained net scores ~0.5 against the
+    #    committed ~0.9+, far past any band. bytes_identical is the
+    #    presence twin of the dan.digest_state hard-fail: streaming
+    #    io1/io4 and serial legs must produce identical bytes modulo
+    #    ##vctpu_* headers — f32 end-to-end determinism is the family's
+    #    serving contract.
+    ("dan.vps.stream_io4", "higher", 0.25),
+    ("dan.vps.serial", "higher", 0.25),
+    ("dan.train_steps_per_s", "higher", 0.25),
+    ("dan.accuracy.dan", "higher", 0.05),
+    ("dan.bytes_identical", "nonzero", 0.0),
 )
 
 #: string-valued tripwires: (dotted path, forbidden value). The metric
@@ -251,6 +270,11 @@ FORBIDDEN_VALUES: tuple[tuple[str, str], ...] = (
     # a cache that serves stale or torn bodies fails HERE, hard, never
     # as a silently-faster number
     ("cache.digest_state", "mismatch"),
+    # the DAN cross-leg score-digest tripwire: streaming io1, streaming
+    # io4 and serial legs scored by the SAME DAN must commit identical
+    # bytes modulo ##vctpu_* headers — a worker-count- or path-dependent
+    # f32 score fails HERE, hard, never as a quietly-different number
+    ("dan.digest_state", "mismatch"),
 )
 
 
@@ -420,15 +444,15 @@ def newest_committed_baseline() -> str | None:
     return best[1] if best else None
 
 
-def run_fresh_bench(timeout_s: int = 720) -> dict | None:
+def run_fresh_bench(timeout_s: int = 900) -> dict | None:
     """A reduced fresh bench (the gate's phases only) on the CPU engine;
     returns its parsed JSON or None with the failure printed. The
-    subprocess bound sits ABOVE bench.py's own budgets (child 560s,
+    subprocess bound sits ABOVE bench.py's own budgets (child 680s,
     parent + retry logic) so the gate can never SIGKILL a bench
     that its own budget logic would have finished self-contained."""
     env = dict(os.environ)
     env["VCTPU_BENCH_PHASES"] = \
-        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache"
+        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache,dan"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

@@ -35,6 +35,12 @@ def get_parser() -> argparse.ArgumentParser:
                     help="python snippet exec'd in every child before the "
                          "run — the harness SELF-TEST hook (seed a "
                          "deliberate regression, assert it is caught)")
+    ap.add_argument("--model-family", default="forest",
+                    choices=("forest", "dan"),
+                    help="scoring model family the campaign pickles "
+                         "(docs/models.md); the recovery ladder's "
+                         "invariants must hold under either "
+                         "(default %(default)s)")
     return ap
 
 
@@ -59,7 +65,8 @@ def run(argv: list[str]) -> int:
             report = harness.run_campaign(
                 list(range(args.seed_base, args.seed_base + args.seeds)),
                 workdir=args.out, records=args.records,
-                sabotage=args.sabotage, shrink=not args.no_shrink, log=log)
+                sabotage=args.sabotage, shrink=not args.no_shrink,
+                model_family=args.model_family, log=log)
             failed = report["violating_schedules"] > 0
     except (OSError, ValueError, RuntimeError) as e:
         print(f"error: {e}", file=sys.stderr)

@@ -315,22 +315,34 @@ def _child_env(layout: str, faults_spec: str = "",
     return env
 
 
-def build_fixtures(workdir: str, records: int = 2000) -> Fixtures:
+def build_fixtures(workdir: str, records: int = 2000,
+                   model_family: str = "forest") -> Fixtures:
     """Synthesize the input set once per campaign and produce the clean
     byte reference (a fault-free, SABOTAGE-free serial-layout child run —
     the oracle models the known-good behavior, so a ``--sabotage``
-    regression applies only to the legs under test)."""
+    regression applies only to the legs under test).
+
+    ``model_family`` picks the scoring model the campaign pickles —
+    "forest" (the default) or "dan" (docs/models.md): the recovery
+    ladder's invariants are family-independent by contract, so the same
+    schedules must hold whichever family scored."""
     import pickle
 
     import numpy as np
 
     import bench
-    from variantcalling_tpu.synthetic import synthetic_forest
+    from variantcalling_tpu.synthetic import synthetic_dan, synthetic_forest
 
     d = os.path.join(workdir, "fixtures")
     os.makedirs(d, exist_ok=True)
     bench.make_fixtures(d, n=records, genome_len=150_000)
-    model = synthetic_forest(np.random.default_rng(0), n_trees=8, depth=4)
+    if model_family == "dan":
+        from variantcalling_tpu.featurize import BASE_FEATURES
+
+        model = synthetic_dan(np.random.default_rng(0), BASE_FEATURES)
+    else:
+        model = synthetic_forest(np.random.default_rng(0), n_trees=8,
+                                 depth=4)
     with open(os.path.join(d, "model.pkl"), "wb") as fh:
         pickle.dump({"m": model}, fh)
     fx = Fixtures(dir=d, input_vcf=os.path.join(d, "calls.vcf"),
@@ -956,7 +968,8 @@ def shrink_schedule(sched: Schedule, fx: Fixtures, workdir: str,
 
 def run_campaign(seeds: list[int], workdir: str | None = None,
                  records: int = 2000, sabotage: str | None = None,
-                 shrink: bool = True, log=print) -> dict:
+                 shrink: bool = True, model_family: str = "forest",
+                 log=print) -> dict:
     """Run one schedule per seed; on violations, delta-shrink the first
     failing schedule and write the minimal repro JSON next to the report.
     Returns the campaign report dict (see ``__main__`` for the exit-code
@@ -965,7 +978,8 @@ def run_campaign(seeds: list[int], workdir: str | None = None,
     owns_workdir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="chaoshunt-")
     os.makedirs(workdir, exist_ok=True)
-    fx = build_fixtures(workdir, records=records)
+    fx = build_fixtures(workdir, records=records,
+                        model_family=model_family)
     results = []
     first_violation: dict | None = None
     for seed in seeds:
@@ -992,7 +1006,9 @@ def run_campaign(seeds: list[int], workdir: str | None = None,
         with open(repro_path, "w", encoding="utf-8") as fh:
             json.dump({"schedule": minimal.to_json(),
                        "violations": minimal_result["violations"],
-                       "records": records}, fh, indent=2, sort_keys=True)
+                       "records": records,
+                       "model_family": model_family},
+                      fh, indent=2, sort_keys=True)
             fh.write("\n")
         log(f"chaoshunt: minimal repro [{minimal.describe()}] "
             f"written to {repro_path}")
@@ -1021,7 +1037,8 @@ def replay(repro_path: str, workdir: str | None = None,
     sched = Schedule.from_json(repro["schedule"])
     owns = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="chaoshunt-replay-")
-    fx = build_fixtures(workdir, records=int(repro.get("records", 2000)))
+    fx = build_fixtures(workdir, records=int(repro.get("records", 2000)),
+                        model_family=repro.get("model_family", "forest"))
     result = run_schedule(sched, fx, workdir)
     log(f"chaoshunt replay [{sched.describe()}] -> "
         + ("VIOLATION" if result["violations"] else "ok"))

@@ -160,6 +160,7 @@ def build_programs(contract: dict) -> list[tuple[str, object, tuple, str]]:
             programs.append((f"margin/{strategy}/dp={dp}", fn, (x_aval,),
                              "margin"))
     programs.extend(build_fused_programs(contract))
+    programs.extend(build_dan_programs(contract))
     depth_aval = jax.ShapeDtypeStruct((4096,), jnp.int32)
     programs.append(("coverage/binned_mean",
                      lambda d: coverage.binned_mean(d, 100),
@@ -227,6 +228,60 @@ def build_fused_programs(contract: dict) -> list[tuple[str, object, tuple, str]]
             avals = ((genome_aval, gpos_aval) if variant == "genome"
                      else (win_aval,)) + (host_avals,) + aux
             programs.append((f"fused/{variant}/dp={dp}", fn, avals, "margin"))
+    return programs
+
+
+def build_dan_programs(contract: dict) -> list[tuple[str, object, tuple, str]]:
+    """The DAN family's scoring programs (contract ``dan``): the fused
+    batched forward pass (``models/dan.make_score_predictor``) traced
+    bare over the (rows, F) feature matrix and through the real
+    ``_fused_program`` entry, at every committed device count. Kind
+    "dan" runs the callback/collective/f64/tree-axis walks and the
+    f32-output check but NOT the sequential-loop requirement — a GEMM
+    forward has no tree-sum ordering hazard (every reduction is a
+    row-local contraction), which is exactly why the family composes
+    with the dp mesh without the forest's loop discipline."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from variantcalling_tpu.featurize import (BASE_FEATURES, DEVICE_FEATURES,
+                                              WINDOW_RADIUS)
+    from variantcalling_tpu.models import dan as dan_mod
+    from variantcalling_tpu.parallel import shard_score
+    from variantcalling_tpu.pipelines import filter_variants as fv
+    from variantcalling_tpu.synthetic import synthetic_dan
+
+    spec = contract.get("dan")
+    if not spec:
+        return []
+    names = list(BASE_FEATURES)
+    model = synthetic_dan(np.random.default_rng(0), names,
+                          embed_dim=int(spec["embed_dim"]),
+                          hidden=int(spec["hidden"]),
+                          n_layers=int(spec["n_layers"]))
+    rows = int(contract["batch_rows"])
+    x_aval = jax.ShapeDtypeStruct((rows, len(names)), jnp.float32)
+    host_names = [f for f in names if f not in DEVICE_FEATURES]
+    host_avals = tuple(jax.ShapeDtypeStruct((rows,), jnp.float32)
+                       for _ in host_names)
+    aux = tuple(jax.ShapeDtypeStruct((rows,), jnp.uint8) for _ in range(5))
+    win_aval = jax.ShapeDtypeStruct((rows, 2 * WINDOW_RADIUS + 1), jnp.uint8)
+    programs: list[tuple[str, object, tuple, str]] = []
+    for dp in spec["mesh_device_counts"]:
+        mesh = None
+        if dp > 1:
+            plan = shard_score.MeshPlan(dp, str(dp), "jaxpr audit")
+            mesh = shard_score.mesh_for(plan)
+        fn = dan_mod.make_score_predictor(model, names)
+        if mesh is not None:
+            fn = shard_score.shard_program(fn, mesh, n_data_args=1)
+        programs.append((f"dan/score/dp={dp}", fn, (x_aval,), "dan"))
+        fused, _hosts, _fin = fv._fused_program(model, names, "TGCA",
+                                                mesh=mesh)
+        programs.append((f"dan/fused/windows/dp={dp}", fused,
+                         (win_aval, host_avals) + aux, "dan"))
     return programs
 
 
@@ -318,16 +373,20 @@ def audit_closed_jaxpr(closed, contract: dict, label: str,
                      "fori_loop (round-5 1-ulp parity incident)")
         for v in list(eqn.invars) + list(eqn.outvars):
             check_aval(getattr(v, "aval", None), f"{name} operand")
-    if kind == "margin":
-        if contract.get("require_sequential_tree_loop") and not saw_loop:
-            flag("sequential-loop-missing",
-                 "no while/scan loop in the traced margin program — the "
-                 "sanctioned sequential_tree_sum accumulation (a loop-"
-                 "carried fori_loop XLA cannot reassociate) is absent")
+    if kind == "margin" and contract.get("require_sequential_tree_loop") \
+            and not saw_loop:
+        flag("sequential-loop-missing",
+             "no while/scan loop in the traced margin program — the "
+             "sanctioned sequential_tree_sum accumulation (a loop-"
+             "carried fori_loop XLA cannot reassociate) is absent")
+    if kind in ("margin", "dan"):
+        # score outputs are f32 for EVERY scoring family: the forest's
+        # margin accumulator contract and the DAN's f32-end-to-end
+        # determinism contract meet at the same output dtype
         for aval in closed.out_avals:
             if str(getattr(aval, "dtype", "")) != margin_dtype:
                 flag("margin-dtype",
-                     f"margin program output dtype {aval.dtype} != "
+                     f"scoring program output dtype {aval.dtype} != "
                      f"{margin_dtype} — both engines agree on "
                      f"{margin_dtype} accumulators (engine contract)")
     return violations

@@ -72,7 +72,9 @@ def scoring_fields(args) -> dict:
 
 def scoring_config(args, engine: str | None, forest_strategy: str | None,
                    mesh_devices: int, rank: int, ranks: int,
-                   span: tuple | None = None) -> dict:
+                   span: tuple | None = None,
+                   model_family: str | None = None,
+                   model_digest: str | None = None) -> dict:
     """The FULL scoring configuration: args-derived fields plus the
     resolved execution selection. This is the journal's ``config``
     sub-dict AND the chunk cache's fingerprint input — one object, so
@@ -96,6 +98,13 @@ def scoring_config(args, engine: str | None, forest_strategy: str | None,
       interval ``[lo, hi)`` it was leased (``parallel/elastic.py``), so
       a journal handed off across a re-cut must pin the NEW interval.
       ``None`` for rank-fraction and single runs.
+    - ``model_family``/``model_digest``: the resolved scoring family
+      (forest|dan|threshold) and, for families whose weights don't pin
+      through the model FILE alone (one pickle can hold several
+      families under different names), a content digest of the selected
+      model's weights. A family change — or a same-file weights change —
+      restarts journals cleanly and can never cache-collide a DAN run
+      into forest chunk bodies (or vice versa).
     """
     cfg = scoring_fields(args)
     cfg["engine"] = engine
@@ -103,6 +112,8 @@ def scoring_config(args, engine: str | None, forest_strategy: str | None,
     cfg["mesh_devices"] = mesh_devices
     cfg["ranks"] = [rank, ranks]
     cfg["span"] = [int(span[0]), int(span[1])] if span is not None else None
+    cfg["model_family"] = model_family
+    cfg["model_digest"] = model_digest
     return cfg
 
 

@@ -91,6 +91,11 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "(docs/models.md)",
        choices=("auto", "gather", "gemm", "wide", "pallas"),
        label="forest strategy"),
+    _k("VCTPU_MODEL_FAMILY", "enum", "auto",
+       "scoring model family: auto|forest|dan — explicit request fails "
+       "loudly when the loaded model is another family (docs/models.md)",
+       choices=("auto", "forest", "dan"),
+       label="model family"),
     _k("VCTPU_PALLAS", "bool", True,
        "allow the pallas wide-block kernel in strategy auto-resolution",
        in_header=True),

@@ -15,6 +15,8 @@ a Deep Averaging Network" (PAPERS.md).
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -27,6 +29,9 @@ import optax
 from variantcalling_tpu.parallel.mesh import MODEL_AXIS
 
 MOTIF_VOCAB = 5**5  # base-5 packed 5-mers (A,C,G,T,N)
+
+FAMILY = "dan"
+FAMILY_HEADER_KEY = "vctpu_model_family"
 
 
 @dataclass(frozen=True)
@@ -141,3 +146,69 @@ class DanModel:
             numeric_features=list(numeric_features),
             pass_threshold=pass_threshold,
         )
+
+
+def weights_digest(model: DanModel) -> str:
+    """Content address of a DAN's weights + scoring-relevant metadata.
+
+    Feeds the scoring identity (io/identity.py): two DAN runs share
+    journal/cache entries only when config, params, feature layout and
+    normalization all match byte-for-byte — the model FILE signature
+    alone cannot distinguish two families living in one pickle."""
+    h = hashlib.sha256()
+    h.update(repr(model.cfg).encode())
+    h.update(repr((model.feature_names, model.numeric_features,
+                   float(model.pass_threshold))).encode())
+    for k in sorted(model.params_np):
+        a = np.ascontiguousarray(model.params_np[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    for norm in (model.norm_mu, model.norm_sd):
+        if norm is None:
+            h.update(b"none")
+        else:
+            h.update(np.ascontiguousarray(norm, np.float32).tobytes())
+    return h.hexdigest()
+
+
+def make_score_predictor(model: DanModel, feature_names: list[str]):
+    """Fused GEMM score program over the run's stacked (N, F) f32 feature
+    matrix — the DAN twin of ``forest.make_margin_predictor``.
+
+    Column selection is precomputed by NAME against the run's feature
+    layout (a positional mismatch would silently score wrong columns);
+    the forward pass is forced to f32 end-to-end so scores are
+    bit-identical across batch buckets, padding, io threads and mesh
+    device counts — the bfloat16 training dtype is a fit-time choice,
+    not a serving contract. Motif codes arrive as f32 feature columns
+    (exact integers < 5^5, all f32-representable) and are cast back to
+    int32 embedding indices here."""
+    idx = {f: i for i, f in enumerate(feature_names)}
+    needed = [*model.numeric_features, "left_motif", "right_motif"]
+    missing = [f for f in needed if f not in idx]
+    if missing:
+        from variantcalling_tpu.engine import EngineError
+
+        raise EngineError(
+            f"dan model needs feature(s) {missing} absent from the run's "
+            f"feature layout {sorted(idx)}")
+    cfg32 = dataclasses.replace(model.cfg, dtype="float32")
+    num_idx = jnp.asarray([idx[f] for f in model.numeric_features], jnp.int32)
+    li, ri = idx["left_motif"], idx["right_motif"]
+    params32 = {k: jnp.asarray(np.asarray(v), jnp.float32)
+                for k, v in model.params_np.items()}
+    mu = None if model.norm_mu is None else jnp.asarray(model.norm_mu, jnp.float32)
+    sd = (None if model.norm_sd is None
+          else jnp.asarray(np.maximum(np.asarray(model.norm_sd, np.float32), 1e-6)))
+
+    def program(x):
+        numeric = jnp.take(x, num_idx, axis=1)
+        if mu is not None:
+            numeric = (numeric - mu) / sd
+        ml = jnp.clip(x[:, li].astype(jnp.int32), 0, MOTIF_VOCAB - 1)
+        mr = jnp.clip(x[:, ri].astype(jnp.int32), 0, MOTIF_VOCAB - 1)
+        return predict_score(cfg32, params32, numeric, ml, mr)
+
+    return program

@@ -13,10 +13,36 @@ from __future__ import annotations
 import os
 import pickle
 
+from variantcalling_tpu.models.dan import DanModel
 from variantcalling_tpu.models.forest import FlatForest, from_sklearn
 from variantcalling_tpu.models.threshold import ThresholdModel
 
 MODEL_NAME_PATTERN = "{family}_model_{gt}_{hpol}"  # e.g. rf_model_ignore_gt_incl_hpol_runs
+
+# Model-family resolution (docs/models.md). "forest" covers every
+# tree-shaped scorer (FlatForest and anything _coerce turns into one);
+# the name prefixes in MODEL_NAME_PATTERN map onto these families.
+FAMILIES = ("forest", "threshold", "dan")
+_NAME_PREFIX_FAMILY = {"rf": "forest", "xgb": "forest",
+                       "threshold": "threshold", "dan": "dan"}
+
+
+def family_of(model: object) -> str:
+    """The scoring family a loaded model belongs to — the single
+    spelling used by FilterContext resolution, provenance headers and
+    the scoring identity."""
+    if isinstance(model, DanModel):
+        return "dan"
+    if isinstance(model, ThresholdModel):
+        return "threshold"
+    return "forest"
+
+
+def family_of_name(model_name: str) -> str | None:
+    """Family implied by a registry model name (``rf_model_...`` →
+    forest), or None when the name follows no known pattern."""
+    prefix = model_name.split("_model_", 1)[0] if "_model_" in model_name else model_name
+    return _NAME_PREFIX_FAMILY.get(prefix)
 
 
 def standard_model_names(families=("rf", "threshold")) -> list[str]:
@@ -58,12 +84,22 @@ def load_models(path: str) -> dict[str, object]:
 def load_model(path: str, model_name: str) -> object:
     models = load_models(path)
     if model_name not in models:
-        raise KeyError(f"model {model_name!r} not in {sorted(models)} (file: {path})")
+        # Name the missing FAMILY, not just the key: a family-explicit
+        # run (VCTPU_MODEL_FAMILY=dan against a forest-only pickle) must
+        # say which family the file lacks, not raise a bare KeyError.
+        requested = family_of_name(model_name)
+        present = sorted({family_of(m) for m in models.values()})
+        hint = ""
+        if requested is not None and requested not in present:
+            hint = (f"; no {requested!r}-family model in this file "
+                    f"(families present: {present})")
+        raise KeyError(
+            f"model {model_name!r} not in {sorted(models)} (file: {path}){hint}")
     return models[model_name]
 
 
 def _coerce(model: object) -> object:
-    if isinstance(model, (FlatForest, ThresholdModel)):
+    if isinstance(model, (FlatForest, ThresholdModel, DanModel)):
         return model
     from variantcalling_tpu.models.xgb import from_xgboost, from_xgboost_json, looks_like_xgboost
 

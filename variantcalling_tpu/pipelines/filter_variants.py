@@ -31,12 +31,19 @@ from variantcalling_tpu.featurize import host_featurize
 from variantcalling_tpu.io import bed as bedio
 from variantcalling_tpu.io.fasta import FastaReader
 from variantcalling_tpu.io.vcf import FactorizedColumn, VariantTable, read_vcf, write_vcf
+from variantcalling_tpu.models import dan as dan_mod
 from variantcalling_tpu.models import forest as forest_mod
+from variantcalling_tpu.models import registry as registry_mod
 from variantcalling_tpu.models import threshold as threshold_mod
+from variantcalling_tpu.models.dan import DanModel
 from variantcalling_tpu.models.forest import FlatForest
 from variantcalling_tpu.models.registry import load_model
 from variantcalling_tpu.models.threshold import ThresholdModel
 from variantcalling_tpu.ops import intervals as iops
+
+#: model types that ride the fused featurize+score device program
+#: (everything else falls back to the host predict_proba path)
+_FUSED_MODEL_TYPES = (FlatForest, ThresholdModel, DanModel)
 
 LOW_SCORE = "LOW_SCORE"
 COHORT_FP = "COHORT_FP"
@@ -262,6 +269,10 @@ def _raw_predictor(model, feature_names: list[str], strategy: str | None = None)
         program = forest_mod.make_margin_predictor(
             ordered, len(feature_names), strategy=strategy)
         return program, (lambda m: forest_mod.finalize_margin(m, ordered))
+    if isinstance(model, DanModel):
+        # GEMM-native family: the fused forward pass IS the score (f32
+        # end-to-end, docs/models.md) — no host finalize stage.
+        return dan_mod.make_score_predictor(model, feature_names), None
     return (lambda xx: threshold_mod.predict_score(model, xx, feature_names)), None
 
 
@@ -732,7 +743,7 @@ def score_variants(model, x: np.ndarray, feature_names: list[str],
     contract (``VCTPU_ENGINE``): ``native`` runs the C++ walk or raises —
     never a silent jit fallback.
     """
-    if not isinstance(model, (FlatForest, ThresholdModel)):
+    if not isinstance(model, _FUSED_MODEL_TYPES):
         # raw sklearn estimator that escaped conversion
         return np.asarray(model.predict_proba(x)[:, 1])
     eng = engine or engine_mod.resolve()
@@ -845,7 +856,32 @@ class FilterContext:
         elif isinstance(model, FlatForest):
             self.forest_strategy = forest_mod.resolve_strategy(model)
         else:
-            self.forest_strategy = "jit"  # threshold/sklearn program
+            self.forest_strategy = "jit"  # threshold/dan/sklearn program
+        # the run-level MODEL FAMILY (VCTPU_MODEL_FAMILY): resolved once
+        # here under the exact contract the engine/strategy obey — auto
+        # resolves to the loaded model's family; an EXPLICIT request for
+        # a family the model file didn't serve fails loudly (EngineError,
+        # exit 2) instead of silently scoring with the other family. The
+        # resolved family is recorded as ##vctpu_model_family= when it is
+        # not the forest default, pinned into the journal resume identity
+        # and the chunk-cache fingerprint (io/identity.py) together with
+        # a DAN weights digest, and emitted as a resolve obs event.
+        fam_req = knobs.get("VCTPU_MODEL_FAMILY")
+        fam = registry_mod.family_of(model)
+        if fam_req != "auto" and fam_req != fam:
+            raise EngineError(
+                f"VCTPU_MODEL_FAMILY={fam_req} was explicitly requested but "
+                f"the loaded model is family {fam!r} "
+                f"({type(model).__name__}) — point --model_file/--model_name "
+                f"at a {fam_req} model or rerun with VCTPU_MODEL_FAMILY="
+                "auto. See docs/models.md.")
+        self.model_family = fam
+        self.model_digest = (dan_mod.weights_digest(model)
+                             if isinstance(model, DanModel) else None)
+        if obs.active():
+            obs.event("resolve", "model_family", value=fam,
+                      requested=fam_req,
+                      reason=f"model type {type(model).__name__}")
         # the run-level SCORING MESH (VCTPU_MESH_DEVICES): resolved once
         # here next to the engine and strategy, recorded as
         # ##vctpu_mesh= in the output header when >1 device and pinned
@@ -940,7 +976,7 @@ class FilterContext:
         genome_sharding = standard_genome_sharding(mesh)
         needs_host_windows = (
             self.blacklist_cg_insertions
-            or not isinstance(model, (FlatForest, ThresholdModel))
+            or not isinstance(model, _FUSED_MODEL_TYPES)
             or not _genome_resident_worthwhile(table, fasta, sharding=genome_sharding)
         )
         hf = host_featurize(table, fasta, annotate_intervals=self.annotate_intervals,
@@ -954,9 +990,10 @@ class FilterContext:
     def _score_hf(self, table: VariantTable, hf) -> np.ndarray:
         model, fasta = self.model, self.fasta
         strat = self._pinned_strategy()
-        if isinstance(model, (FlatForest, ThresholdModel)):
-            # fused featurize+score: window features and the forest walk run
-            # as one device program, only TREE_SCORE returns to the host
+        if isinstance(model, _FUSED_MODEL_TYPES):
+            # fused featurize+score: window features and the model program
+            # (forest walk or DAN forward) run as one device program, only
+            # TREE_SCORE returns to the host
             return fused_featurize_score(model, hf, self.flow_order, table=table,
                                          fasta=fasta, engine=self.engine,
                                          strategy=strat, plan=self.mesh_plan)
@@ -991,7 +1028,7 @@ class FilterContext:
         """
         model = self.model
         if self.mesh_plan.devices <= 1 or self.engine.name == "native" \
-                or not isinstance(model, (FlatForest, ThresholdModel)):
+                or not isinstance(model, _FUSED_MODEL_TYPES):
             out = []
             for table, hf in pairs:
                 score = self._score_hf(table, hf)
@@ -1108,14 +1145,16 @@ def _replace_or_append_meta(header, prefix: str, line: str) -> None:
 
 def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = None,
                           strategy: str | None = None,
-                          mesh_plan=None, rank_plan=None) -> None:
+                          mesh_plan=None, rank_plan=None,
+                          model_family: str | None = None) -> None:
     """The filter pipeline's header additions — ONE place so the serial and
     streaming writers emit identical header bytes. Records the scoring
     engine (``##vctpu_engine=...``), the resolved forest strategy
-    (``##vctpu_forest_strategy=...``) and — for >1-device runs — the
-    scoring-mesh layout (``##vctpu_mesh=dp=N``) so every output file
-    names the full scoring configuration that produced it (engine
-    contract, docs/robustness.md)."""
+    (``##vctpu_forest_strategy=...``), the model family when it is not
+    the forest default (``##vctpu_model_family=dan``) and — for
+    >1-device runs — the scoring-mesh layout (``##vctpu_mesh=dp=N``) so
+    every output file names the full scoring configuration that produced
+    it (engine contract, docs/robustness.md)."""
     header.ensure_filter(LOW_SCORE, "Model score below threshold")
     header.ensure_filter(COHORT_FP, "Blacklisted cohort false-positive locus")
     header.ensure_filter(HPOL_RUN, "Variant close to long homopolymer run")
@@ -1126,6 +1165,17 @@ def _ensure_output_header(header, engine: engine_mod.EngineDecision | None = Non
     if strategy is not None:
         key = forest_mod.STRATEGY_HEADER_KEY
         _replace_or_append_meta(header, f"##{key}=", f"##{key}={strategy}")
+    # model-family provenance: non-forest families record the family that
+    # scored; forest runs emit NO line (and strip a stale one inherited
+    # from a re-filtered input) so pre-existing forest outputs stay
+    # byte-identical to every prior release
+    fam_prefix = f"##{dan_mod.FAMILY_HEADER_KEY}="
+    if model_family is not None and model_family != "forest":
+        _replace_or_append_meta(header, fam_prefix,
+                                f"{fam_prefix}{model_family}")
+    else:
+        header.lines[:] = [ln for ln in header.lines
+                           if not ln.startswith(fam_prefix)]
     # mesh provenance: >1-device runs record the dp layout; single-device
     # runs emit NO line (and strip a stale one inherited from a
     # re-filtered input) — record bytes are identical at every device
@@ -1382,7 +1432,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         rank_plan=rank_plan,
     )
     _ensure_output_header(header, engine=ctx.engine, strategy=ctx.forest_strategy,
-                          mesh_plan=ctx.mesh_plan, rank_plan=ctx.rank_plan)
+                          mesh_plan=ctx.mesh_plan, rank_plan=ctx.rank_plan,
+                          model_family=ctx.model_family)
 
     # kill the warmup cliff: encode (and persist) the genome on a prefetch
     # thread; scoring's per-contig fetch_encoded waits only for the contig
@@ -1594,7 +1645,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         args, engine=ctx.engine.name, forest_strategy=ctx.forest_strategy,
         mesh_devices=ctx.mesh_plan.devices,
         rank=ctx.rank_plan.rank, ranks=ctx.rank_plan.ranks,
-        span=ctx.rank_plan.span)
+        span=ctx.rank_plan.span,
+        model_family=ctx.model_family, model_digest=ctx.model_digest)
 
     # resume only for plain-text outputs: a killed BGZF writer's in-flight
     # block state is unrecoverable, so .gz runs restart (still atomic)
@@ -2276,7 +2328,8 @@ def run_loaded(args, model, fasta: FastaReader, annotate, blacklist,
     _ensure_output_header(table.header, engine=ctx.engine,
                           strategy=ctx.forest_strategy,
                           mesh_plan=ctx.mesh_plan,
-                          rank_plan=ctx.rank_plan)
+                          rank_plan=ctx.rank_plan,
+                          model_family=ctx.model_family)
     with stage("writeback"):
         # verbatim_core: this pipeline never edits CHROM..QUAL, so record
         # assembly can splice FILTER/TREE_SCORE between original byte spans

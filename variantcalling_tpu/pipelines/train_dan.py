@@ -2,30 +2,55 @@
 
 The reference trains sklearn/xgboost in one shot and "checkpoints" only via
 stage artifacts (SURVEY §5.4: no in-process checkpointing exists). This
-trainer adds what the reference never had: an iterative sharded training
-loop (dp over variants × mp over hidden, models/dan) with orbax
-checkpointing — training state (params + optimizer + step) saves every
-``--checkpoint_every`` steps and restores automatically on restart, so a
-preempted multi-host run resumes mid-fit. The final model lands in the
-registry pickle alongside the forest families and is servable by
-filter_variants_pipeline.
+trainer adds what the reference never had:
+
+- an iterative sharded training loop (dp over variants × mp over hidden,
+  models/dan) with orbax checkpointing — training state (params +
+  optimizer + step) saves every ``--checkpoint_every`` steps and restores
+  automatically on restart, so a preempted multi-host run resumes mid-fit;
+- a CHUNKED, JOURNALED, RESUMABLE ingest modeled on the filter's
+  streaming executor (docs/streaming_executor.md): the concordance input
+  is cut into bounded chunks (per-contig h5 frames, or row ranges of a
+  single frame), each featurized chunk commits atomically to an ingest
+  cache next to the checkpoints under the run's identity fingerprint
+  (io/identity.py spelling), and a restarted run re-featurizes only the
+  chunks the journal has not committed — an identity change (input file,
+  contig filter, weighting, rank layout) restarts the ingest cleanly;
+- the pod partition rule: with >1 ranks (VCTPU_RANK/VCTPU_NUM_PROCESSES,
+  parallel/rank_plan.py) each rank ingests and trains on the contiguous
+  chunk span at proportional targets ``r/N`` — the same cut rule that
+  partitions the filter's byte stream;
+- per-step loss and throughput as obs metrics (``VCTPU_OBS=1``,
+  docs/observability.md): ``train``-kind step events plus step-latency
+  histograms in the run stream.
+
+The final model lands in the registry pickle alongside the forest
+families and is servable by filter_variants_pipeline
+(``VCTPU_MODEL_FAMILY=dan``, docs/models.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import time
 
 import numpy as np
 
 import jax
 
-from variantcalling_tpu import logger
+from variantcalling_tpu import logger, obs
+from variantcalling_tpu.io import identity as identity_mod
 from variantcalling_tpu.models import dan, registry
 from variantcalling_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
 MODEL_NAME = "dan_model_ignore_gt_incl_hpol_runs"
+
+#: ingest journal schema version — bump on any change to the cached
+#: chunk layout so stale caches restart instead of misloading
+_INGEST_VERSION = 1
 
 
 def parse_args(argv):
@@ -42,8 +67,15 @@ def parse_args(argv):
     ap.add_argument("--embed_dim", type=int, default=16)
     ap.add_argument("--learning_rate", type=float, default=1e-3)
     ap.add_argument("--checkpoint_dir", default=None,
-                    help="orbax checkpoint dir (enables save/resume)")
+                    help="orbax checkpoint dir (enables save/resume; also "
+                         "hosts the journaled ingest cache)")
     ap.add_argument("--checkpoint_every", type=int, default=200)
+    ap.add_argument("--ingest_cache_dir", default=None,
+                    help="journaled ingest cache dir (default: "
+                         "<checkpoint_dir>/ingest when checkpointing)")
+    ap.add_argument("--ingest_chunk_rows", type=int, default=1 << 16,
+                    help="row-range chunk size for single-frame inputs")
+    ap.add_argument("--log_every", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--verbosity", default="INFO")
     return ap.parse_args(argv)
@@ -67,12 +99,226 @@ def _split_features(x: np.ndarray, names: list[str]):
     return numeric.astype(np.float32), left, right, [names[i] for i in numeric_idx]
 
 
-def run(argv) -> int:
-    """Train the DAN variant filter with orbax checkpoint/resume."""
-    args = parse_args(argv)
+# ---------------------------------------------------------------------------
+# Streaming ingest: bounded chunks + identity-pinned journal + rank cut
+# ---------------------------------------------------------------------------
+
+
+def _frame_to_training(df, args):
+    """One h5 frame chunk -> (x, names, label, weight) — the exact
+    per-row transform of train_models._ingest's h5 body, applied to a
+    bounded chunk so peak host memory is one chunk, not the callset."""
+    from variantcalling_tpu.pipelines.train_models import H5_FEATURES, _exome_weight
+
+    if args.list_of_contigs_to_read and "chrom" in df.columns:
+        df = df[df["chrom"].astype(str).isin(args.list_of_contigs_to_read)]
+    cls = df["classify"].astype(str).to_numpy()
+    keep = np.isin(cls, ["tp", "fp"])
+    df = df[keep]
+    label = (cls[keep] == "tp").astype(np.float32)
+    names = [f for f in H5_FEATURES if f in df.columns]
+    names += [c for c in df.columns
+              if c.startswith(("LCR", "mappability", "exome", "ug_hcr"))]
+    if len(df):
+        x = np.stack([np.nan_to_num(np.asarray(df[f], dtype=np.float32))
+                      for f in names], axis=1)
+    else:
+        x = np.zeros((0, len(names)), np.float32)
+    weight = _exome_weight(args, names, x)
+    return x, names, label, np.asarray(weight, np.float32)
+
+
+def _ingest_units(args) -> tuple[list, str]:
+    """The chunk axis of this input: ``(units, mode)`` where units are
+    h5 frame keys (``mode="keys"``) or ``[lo, hi)`` row ranges of one
+    frame (``mode="rows"``). Non-h5 inputs get one whole-input unit
+    (``mode="whole"`` — VCF featurization stays one-shot)."""
+    if args.input_file.endswith((".h5", ".hdf", ".hdf5")):
+        from variantcalling_tpu.utils.h5_utils import list_keys, read_hdf
+
+        skip = {"concordance", "scored_concordance", "input_args",
+                "comparison_result"}
+        keys = [k for k in list_keys(args.input_file)
+                if k not in skip and k != "all"]
+        if len(keys) > 1:
+            return keys, "keys"
+        # single-frame file: cut deterministic row ranges
+        df = read_hdf(args.input_file, key="all", skip_keys=sorted(skip))
+        step = max(1, int(args.ingest_chunk_rows))
+        spans = [[lo, min(lo + step, len(df))]
+                 for lo in range(0, max(len(df), 1), step)]
+        return spans, "rows"
+    return [None], "whole"
+
+
+def _read_unit(args, unit, mode):
+    """Materialize one ingest unit as (x, names, label, weight)."""
+    if mode == "keys":
+        from variantcalling_tpu.utils.h5_utils import read_hdf
+
+        return _frame_to_training(read_hdf(args.input_file, key=unit), args)
+    if mode == "rows":
+        from variantcalling_tpu.utils.h5_utils import read_hdf
+
+        df = read_hdf(args.input_file, key="all",
+                      skip_keys=["concordance", "scored_concordance",
+                                 "input_args", "comparison_result"])
+        return _frame_to_training(df.iloc[unit[0]:unit[1]], args)
     from variantcalling_tpu.pipelines.train_models import _ingest
 
     x, names, label, _lgt, weight, _hpol, _contig = _ingest(args)
+    return x, names, label, np.asarray(weight, np.float32)
+
+
+def _ingest_identity(args, units, mode, plan) -> dict:
+    """What makes a cached ingest chunk reusable — the io/identity.py
+    discipline applied to training: input bytes, the chunk cut, every
+    flag that changes a row's features/label/weight, and the rank
+    layout (a re-cut pod must restart, docs/scaleout.md)."""
+    return {
+        "version": _INGEST_VERSION,
+        "input": identity_mod.file_sig(args.input_file),
+        "mode": mode,
+        "units": [list(u) if isinstance(u, (list, tuple)) else u
+                  for u in units],
+        "contigs": sorted(args.list_of_contigs_to_read or []),
+        "exome_weight": [float(args.exome_weight),
+                         args.exome_weight_annotation],
+        "ranks": [plan.rank, plan.ranks],
+    }
+
+
+def _rank_cut(units: list, plan) -> list:
+    """The pod partition rule (parallel/rank_plan.py): rank r of N owns
+    the contiguous span at proportional targets r/N — applied to the
+    chunk-unit sequence instead of the byte stream."""
+    lo = (len(units) * plan.rank) // plan.ranks
+    hi = (len(units) * (plan.rank + 1)) // plan.ranks
+    return units[lo:hi]
+
+
+def ingest_streaming(args):
+    """Chunked/journaled/resumable training ingest; returns
+    ``(x, names, label, weight)`` for THIS RANK's shard.
+
+    With a cache dir (``--ingest_cache_dir``, defaulting next to the
+    checkpoints), each featurized chunk commits atomically
+    (``.partial`` + rename, then a journal line) under the run identity
+    fingerprint — a restart re-featurizes only uncommitted chunks, and
+    ANY identity change (input, flags, rank layout) discards the cache
+    with a field-level mismatch log instead of splicing stale rows."""
+    from variantcalling_tpu.parallel import rank_plan as rank_plan_mod
+
+    plan = rank_plan_mod.resolve()
+    units, mode = _ingest_units(args)
+    units = _rank_cut(units, plan) if plan.ranks > 1 else units
+    ident = _ingest_identity(args, units, mode, plan)
+    fp = identity_mod.fingerprint(ident)
+
+    cache_dir = args.ingest_cache_dir or (
+        os.path.join(args.checkpoint_dir, "ingest")
+        if args.checkpoint_dir else None)
+    done: dict[int, str] = {}
+    journal = meta_path = None
+    if cache_dir:
+        os.makedirs(cache_dir, exist_ok=True)
+        meta_path = os.path.join(cache_dir, "ingest.json")
+        journal = os.path.join(cache_dir, "ingest.journal")
+        stale = None
+        if os.path.exists(meta_path):
+            with open(meta_path, encoding="utf-8") as fh:
+                old = json.load(fh)
+            if old.get("fingerprint") != fp:
+                stale = identity_mod.describe_mismatch(
+                    old.get("identity", {}), ident)
+        if stale is not None:
+            logger.info("ingest cache identity changed (%s): restarting "
+                        "ingest", stale)
+            for name in os.listdir(cache_dir):
+                os.unlink(os.path.join(cache_dir, name))
+        if not os.path.exists(meta_path):
+            tmp = f"{meta_path}.partial"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"fingerprint": fp, "identity": ident}, fh)
+            os.replace(tmp, meta_path)  # vctpu-lint: disable=VCT008 — ingest-cache metadata (train side), not a pipeline output commit
+        if os.path.exists(journal):
+            with open(journal, encoding="utf-8") as fh:
+                for line in fh:
+                    rec = json.loads(line)
+                    path = os.path.join(cache_dir, rec["file"])
+                    if os.path.exists(path):
+                        done[int(rec["i"])] = path
+
+    parts = []
+    names: list[str] | None = None
+    resumed = 0
+    for i, unit in enumerate(units):
+        t0 = time.monotonic()
+        if i in done:
+            with np.load(done[i], allow_pickle=False) as z:
+                part = (z["x"], [str(s) for s in z["names"]],
+                        z["label"], z["weight"])
+            resumed += 1
+        else:
+            x, unit_names, label, weight = _read_unit(args, unit, mode)
+            part = (x, unit_names, label, weight)
+            if cache_dir:
+                fname = f"chunk_{i:06d}.npz"
+                path = os.path.join(cache_dir, fname)
+                tmp = f"{path}.partial.npz"
+                np.savez(tmp, x=x, names=np.asarray(unit_names), label=label,
+                         weight=weight)
+                os.replace(tmp, path)  # vctpu-lint: disable=VCT008 — journaled ingest-cache chunk (train side), not a pipeline output commit
+                with open(journal, "a", encoding="utf-8") as fh:
+                    fh.write(json.dumps({"i": i, "file": fname,
+                                         "rows": int(len(x))}) + "\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        if names is None:
+            names = part[1]
+        elif part[1] != names:
+            raise SystemExit(
+                f"ingest chunk {i} produced feature layout {part[1]} != "
+                f"{names} — the input's frames disagree on columns")
+        parts.append(part)
+        if obs.active():
+            obs.event("train", "ingest_chunk", i=i, rows=int(len(part[0])),
+                      cached=i in done,
+                      seconds=round(time.monotonic() - t0, 4))
+    if resumed:
+        logger.info("ingest resumed %d/%d chunks from cache", resumed,
+                    len(units))
+    if not parts:
+        return np.zeros((0, 0), np.float32), [], \
+            np.zeros(0, np.float32), np.zeros(0, np.float32)
+    x = np.concatenate([p[0] for p in parts], axis=0)
+    label = np.concatenate([p[2] for p in parts])
+    weight = np.concatenate([p[3] for p in parts])
+    return x, names or [], label, weight
+
+
+def run(argv) -> int:
+    """Train the DAN variant filter with orbax checkpoint/resume and a
+    journaled streaming ingest."""
+    args = parse_args(argv)
+    obs_run = obs.start_run(
+        "train_dan",
+        default_path=str(args.output_file_prefix) + ".obs.jsonl",
+        argv=argv, inputs={"input": args.input_file})
+    status = "error"
+    try:
+        rc = _run_impl(args)
+        status = "ok" if rc == 0 else f"exit {rc}"
+        return rc
+    except BaseException as e:
+        status = f"error: {type(e).__name__}"
+        raise
+    finally:
+        obs.end_run(obs_run, status=status)
+
+
+def _run_impl(args) -> int:
+    x, names, label, weight = ingest_streaming(args)
     numeric, left, right, numeric_names = _split_features(x, names)
     mu = numeric.mean(axis=0)
     sd = np.maximum(numeric.std(axis=0), 1e-6)
@@ -117,7 +363,11 @@ def run(argv) -> int:
     if mesh is not None:
         bs -= bs % n_dev or 0
     loss = float("nan")
+    step_hist = obs.histogram("train.step_s")
+    window_t0 = time.monotonic()
+    window_start = start_step
     for step in range(start_step, args.n_steps):
+        t0 = time.monotonic()
         idx = rng.integers(0, n, bs)
         batch = {
             "numeric": numeric[idx],
@@ -133,8 +383,18 @@ def run(argv) -> int:
             ds2 = NamedSharding(mesh, P(DATA_AXIS, None))
             batch = {k: jax.device_put(v, ds2 if v.ndim == 2 else ds1) for k, v in batch.items()}
         params, opt_state, loss = dan.train_step(cfg, optimizer, params, opt_state, batch)
-        if step % 100 == 0:
-            logger.info("step %d loss %.4f", step, float(loss))
+        step_hist.observe(time.monotonic() - t0)
+        if step % max(1, args.log_every) == 0:
+            elapsed = max(time.monotonic() - window_t0, 1e-9)
+            steps_per_s = (step + 1 - window_start) / elapsed
+            logger.info("step %d loss %.4f (%.1f step/s)", step, float(loss),
+                        steps_per_s)
+            if obs.active():
+                obs.event("train", "step", step=step, loss=float(loss),
+                          steps_per_s=round(steps_per_s, 3),
+                          rows_per_s=round(steps_per_s * bs, 1))
+            window_t0 = time.monotonic()
+            window_start = step + 1
         if ckptr is not None and (step + 1) % args.checkpoint_every == 0:
             import orbax.checkpoint as ocp
 

@@ -51,6 +51,39 @@ def synthetic_forest(rng: np.random.Generator, n_trees: int = 40, depth: int = 1
     )
 
 
+def synthetic_dan(rng: np.random.Generator, feature_names: list[str],
+                  embed_dim: int = 4, hidden: int = 16, n_layers: int = 2):
+    """Random but structurally-valid DAN over a real feature layout: the
+    numeric block is every feature except the motif-code columns, so the
+    model scores through the same fused (N, F) matrix path as a trained
+    one (models/dan.make_score_predictor). Deterministic in ``rng``."""
+    import jax
+
+    from variantcalling_tpu.models import dan as dan_mod
+
+    numeric_features = [f for f in feature_names
+                        if f not in ("left_motif", "right_motif")]
+    cfg = dan_mod.DanConfig(n_numeric=len(numeric_features),
+                            embed_dim=embed_dim, hidden=hidden,
+                            n_layers=n_layers)
+    params = dan_mod.init_params(
+        cfg, jax.random.PRNGKey(int(rng.integers(0, 2**31 - 1))))
+    # init_params zeroes the output head (a training-friendly init): a
+    # synthetic scorer needs VARYING scores or every parity/digest check
+    # downstream would pass trivially on a constant-0.5 output
+    params["w_out"] = jax.random.normal(
+        jax.random.PRNGKey(int(rng.integers(0, 2**31 - 1))),
+        params["w_out"].shape) * (1.0 / np.sqrt(hidden))
+    model = dan_mod.DanModel.from_params(
+        cfg, params, feature_names=list(feature_names),
+        numeric_features=numeric_features)
+    # normalization keeps the random logits in sigmoid's useful range for
+    # arbitrary feature scales (qual ~ [0, 100], flags ~ {0, 1})
+    model.norm_mu = np.zeros(len(numeric_features), np.float32)
+    model.norm_sd = np.full(len(numeric_features), 10.0, np.float32)
+    return model
+
+
 def fused_hot_path(forest: FlatForest):
     """The filter device program: windows+scalars -> features -> TREE_SCORE.
 
