@@ -35,6 +35,21 @@ else
   echo "lint stage: ruff not installed — skipped"
 fi
 
+# -- tier-0 protocol model-check stage (docs/static_analysis.md) -----------
+# Explicit-state BFS over the elastic lease protocol (tools/protocheck):
+# one-owner-per-(span,generation), exact-once span coverage, no
+# stale-generation commit, monotone seam merge — with the model's
+# constants (lease scheme, O_EXCL flags, generation-bump rule, marker
+# suffix) mechanically anchored against parallel/elastic.py and
+# parallel/rank_plan.py. An invariant violation prints a minimal
+# interleaving; anchor drift means code and model diverged. Bounded
+# (~4k states, sub-second; 120s wall budget).
+echo "protocheck stage: python -m tools.protocheck --json"
+timeout -k 5 120 env PYTHONPATH= JAX_PLATFORMS=cpu python -m tools.protocheck --json || {
+  echo "protocheck found an elastic-protocol violation or model/code anchor drift — failing before pytest" >&2
+  exit 1
+}
+
 # -- opt-in chaos smoke stage (docs/robustness.md) -------------------------
 # VCTPU_CHAOS=1: 10 fixed-seed chaos schedules over the streaming filter
 # executor (tools/chaoshunt — fault classes x layouts x fresh/resumed,

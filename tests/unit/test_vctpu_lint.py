@@ -537,7 +537,7 @@ def test_vct008_scoped_to_pipelines_and_suppressible():
     assert codes("""
         import os
         os.replace(a, b)  # vctpu-lint: disable=VCT008 — sanctioned atomic commit
-        """, path=PIPE) == []
+        """, path=PIPE, select={"VCT008"}) == []
 
 
 # ---------------------------------------------------------------------------

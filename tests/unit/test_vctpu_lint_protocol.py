@@ -1,0 +1,561 @@
+"""vctpu-lint v3 self-tests: golden positive/negative fixtures for the
+distributed-protocol checkers — VCT011 (run-state filesystem protocol:
+ownership through cross-module alias spellings, tmp-sibling os.replace
+idiom, O_EXCL lease acquire, marker-before-finish ordering) and VCT012
+(byte-influence taint: knob reads in the backward cone of the
+sequenced-commit sinks vs knobs_contract.json, plus the registry
+cross-check inside knobs.py) — and regression tests for the runtime
+fixes the checkers forced (journal partial helpers, the
+VCTPU_QUARANTINE provenance header).
+
+ISSUE 19 tentpole satellite."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tools import vctpu_lint as lint
+from tools.vctpu_lint import checkers as checkers_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run(src: str, path: str = "variantcalling_tpu/pipelines/snippet.py",
+        select: set[str] | None = None) -> list[lint.Finding]:
+    return lint.lint_source(path, textwrap.dedent(src), select)
+
+
+def run_sources(sources: dict[str, str],
+                select: set[str] | None = None) -> list[lint.Finding]:
+    return lint.lint_sources(
+        {p: textwrap.dedent(s) for p, s in sources.items()}, select)
+
+
+@pytest.fixture
+def contract(monkeypatch):
+    """Pin VCT012's knobs contract for the duration of one test."""
+    def set_contract(entries: dict) -> None:
+        monkeypatch.setattr(checkers_mod.ByteInfluenceTaintChecker,
+                            "_contract_cache", entries)
+    return set_contract
+
+
+# ---------------------------------------------------------------------------
+# VCT011 rule 1: run-state suffix ownership
+# ---------------------------------------------------------------------------
+
+
+def test_vct011_partial_write_outside_owners_flagged():
+    fs = run('''
+        def dump(out):
+            with open(out + ".partial", "wb") as fh:
+                fh.write(b"x")
+        ''', select={"VCT011"})
+    assert [f.code for f in fs] == ["VCT011"]
+    assert "run-state path" in fs[0].message
+    assert ".partial" in fs[0].message
+
+
+def test_vct011_cross_module_alias_spelling_flagged():
+    # the suffix lives in ANOTHER module's helper; the rogue write site
+    # only sees an opaque call — lineage must cross the module boundary
+    fs = run_sources({
+        "variantcalling_tpu/io/pathlib_util.py": '''
+            def side_journal(out):
+                return out + ".journal"
+            ''',
+        "variantcalling_tpu/pipelines/rogue.py": '''
+            from variantcalling_tpu.io.pathlib_util import side_journal
+
+            def checkpoint(out, doc):
+                with open(side_journal(out), "w") as fh:
+                    fh.write(doc)
+            ''',
+    }, select={"VCT011"})
+    assert [(f.path, f.code) for f in fs] \
+        == [("variantcalling_tpu/pipelines/rogue.py", "VCT011")]
+    assert ".journal" in fs[0].message
+
+
+def test_vct011_owner_module_writes_freely():
+    # the journal module IS the protocol owner
+    assert run('''
+        def open_partial(out, token):
+            return open(out + ".partial." + token, "wb")
+        ''', path="variantcalling_tpu/io/journal.py",
+        select={"VCT011"}) == []
+
+
+def test_vct011_sink_write_is_sanctioned():
+    assert run('''
+        def _sink_write(out, payload):
+            with open(out + ".partial", "ab") as fh:
+                fh.write(payload)
+        ''', select={"VCT011"}) == []
+
+
+def test_vct011_read_of_run_state_path_not_flagged():
+    # ownership governs WRITES; readers (resume scans) are fine anywhere
+    assert run('''
+        def peek(out):
+            with open(out + ".journal") as fh:
+                return fh.read()
+        ''', select={"VCT011"}) == []
+
+
+def test_vct011_plain_output_write_not_flagged():
+    assert run('''
+        def dump(out):
+            with open(out, "wb") as fh:
+                fh.write(b"x")
+        ''', select={"VCT011"}) == []
+
+
+def test_vct011_suppressible():
+    assert run('''
+        def dump(out):
+            with open(out + ".partial", "wb") as fh:  # vctpu-lint: disable=VCT011 — fixture generator for the resume tests
+                fh.write(b"x")
+        ''', select={"VCT011"}) == []
+
+
+# ---------------------------------------------------------------------------
+# VCT011 rule 2: tmp-sibling os.replace idiom
+# ---------------------------------------------------------------------------
+
+
+def test_vct011_replace_without_tmp_sibling_flagged():
+    fs = run('''
+        import os
+
+        def publish(out, doc):
+            with open(out + ".new", "w") as fh:
+                fh.write(doc)
+            os.replace(out + ".new", out)
+        ''', select={"VCT011"})
+    assert [f.code for f in fs] == ["VCT011"]
+    assert "tmp-sibling" in fs[0].message
+
+
+def test_vct011_tmp_sibling_replace_clean():
+    assert run('''
+        import os
+
+        def publish(out, doc):
+            with open(out + ".tmp", "w") as fh:
+                fh.write(doc)
+            os.replace(out + ".tmp", out)
+        ''', select={"VCT011"}) == []
+
+
+def test_vct011_mkstemp_replace_clean():
+    assert run('''
+        import os
+        import tempfile
+
+        def publish(out, payload):
+            fd, tmp = tempfile.mkstemp(dir=".")
+            os.write(fd, payload)
+            os.close(fd)
+            os.replace(tmp, out)
+        ''', select={"VCT011"}) == []
+
+
+def test_vct011_partial_promotion_replace_clean():
+    # committing a .partial IS the sanctioned promotion (owner module)
+    assert run('''
+        import os
+
+        def commit_partial(out, token):
+            os.replace(out + ".partial." + token, out)
+        ''', path="variantcalling_tpu/io/journal.py",
+        select={"VCT011"}) == []
+
+
+# ---------------------------------------------------------------------------
+# VCT011 rule 3: O_EXCL lease acquire
+# ---------------------------------------------------------------------------
+
+
+def test_vct011_lease_without_o_excl_flagged():
+    fs = run('''
+        import os
+
+        def claim(seg):
+            fd = os.open(seg + ".lease.g0",
+                         os.O_CREAT | os.O_WRONLY, 0o644)
+            os.close(fd)
+        ''', path="variantcalling_tpu/parallel/elastic.py",
+        select={"VCT011"})
+    assert [f.code for f in fs] == ["VCT011"]
+    assert "O_EXCL" in fs[0].message
+
+
+def test_vct011_lease_with_o_excl_clean():
+    assert run('''
+        import os
+
+        def claim(seg):
+            fd = os.open(seg + ".lease.g0",
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            os.close(fd)
+        ''', path="variantcalling_tpu/parallel/elastic.py",
+        select={"VCT011"}) == []
+
+
+# ---------------------------------------------------------------------------
+# VCT011 rule 4: .done marker before journal finish()
+# ---------------------------------------------------------------------------
+
+
+def test_vct011_marker_before_finish_flagged():
+    fs = run('''
+        from variantcalling_tpu.parallel.rank_plan import write_marker
+
+        def seal(journal, seg):
+            write_marker(seg)
+            journal.finish()
+        ''', select={"VCT011"})
+    assert [f.code for f in fs] == ["VCT011"]
+    assert "before the journal finish()" in fs[0].message
+
+
+def test_vct011_finish_then_marker_clean():
+    assert run('''
+        from variantcalling_tpu.parallel.rank_plan import write_marker
+
+        def seal(journal, seg):
+            journal.finish()
+            write_marker(seg)
+        ''', select={"VCT011"}) == []
+
+
+# ---------------------------------------------------------------------------
+# VCT012: byte-influence taint vs knobs_contract.json
+# ---------------------------------------------------------------------------
+
+_SINK_FIXTURE = {
+    # named after the REAL sink module so resolution works unchanged
+    "variantcalling_tpu/io/bgzf.py": '''
+        def compress_block(data):
+            return data
+        ''',
+}
+
+
+def test_vct012_unclassified_byte_reaching_knob_flagged(contract):
+    contract({})
+    fs = run_sources({
+        **_SINK_FIXTURE,
+        "variantcalling_tpu/pipelines/emit.py": '''
+            from variantcalling_tpu import knobs
+            from variantcalling_tpu.io.bgzf import compress_block
+
+            def emit(data):
+                if knobs.get_bool("VCTPU_FAKE_SHINY"):
+                    data = data[::-1]
+                return compress_block(data)
+            ''',
+    }, select={"VCT012"})
+    assert [(f.path, f.code) for f in fs] \
+        == [("variantcalling_tpu/pipelines/emit.py", "VCT012")]
+    assert "VCTPU_FAKE_SHINY" in fs[0].message
+    assert "knobs_contract.json" in fs[0].message
+
+
+def test_vct012_classified_knob_clean(contract):
+    contract({"VCTPU_FAKE_SHINY": {"class": "scoring"}})
+    assert run_sources({
+        **_SINK_FIXTURE,
+        "variantcalling_tpu/pipelines/emit.py": '''
+            from variantcalling_tpu import knobs
+            from variantcalling_tpu.io.bgzf import compress_block
+
+            def emit(data):
+                if knobs.get_bool("VCTPU_FAKE_SHINY"):
+                    data = data[::-1]
+                return compress_block(data)
+            ''',
+    }, select={"VCT012"}) == []
+
+
+def test_vct012_knob_outside_cone_clean(contract):
+    # same read, but the function never reaches a commit sink
+    contract({})
+    assert run_sources({
+        **_SINK_FIXTURE,
+        "variantcalling_tpu/pipelines/emit.py": '''
+            from variantcalling_tpu import knobs
+
+            def tune_pool():
+                return knobs.get_int("VCTPU_FAKE_THREADS")
+            ''',
+    }, select={"VCT012"}) == []
+
+
+def test_vct012_invalid_contract_class_flagged(contract):
+    contract({"VCTPU_FAKE_SHINY": {"class": "mystery"}})
+    fs = run_sources({
+        **_SINK_FIXTURE,
+        "variantcalling_tpu/pipelines/emit.py": '''
+            from variantcalling_tpu import knobs
+            from variantcalling_tpu.io.bgzf import compress_block
+
+            def emit(data):
+                knobs.get("VCTPU_FAKE_SHINY")
+                return compress_block(data)
+            ''',
+    }, select={"VCT012"})
+    assert len(fs) == 1 and "invalid contract class" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# VCT012 registry rules (inside knobs.py)
+# ---------------------------------------------------------------------------
+
+_KNOBS_PATH = "variantcalling_tpu/knobs.py"
+
+
+def test_vct012_scoring_knob_without_header_flagged(contract):
+    contract({"VCTPU_FAKE_SHINY": {"class": "scoring"}})
+    fs = run('''
+        _k("VCTPU_FAKE_SHINY", default=False)
+        ''', path=_KNOBS_PATH, select={"VCT012"})
+    assert len(fs) == 1
+    assert "in_header=True" in fs[0].message
+
+
+def test_vct012_scoring_knob_with_header_clean(contract):
+    contract({"VCTPU_FAKE_SHINY": {"class": "scoring"}})
+    assert run('''
+        _k("VCTPU_FAKE_SHINY", default=False, in_header=True)
+        ''', path=_KNOBS_PATH, select={"VCT012"}) == []
+
+
+def test_vct012_byte_neutral_in_header_flagged(contract):
+    contract({"VCTPU_FAKE_CACHE": {"class": "byte_neutral"}})
+    fs = run('''
+        _k("VCTPU_FAKE_CACHE", default=True, in_header=True)
+        ''', path=_KNOBS_PATH, select={"VCT012"})
+    assert len(fs) == 1
+    assert "byte_neutral" in fs[0].message
+
+
+def test_vct012_stale_contract_entry_flagged(contract):
+    contract({"VCTPU_GONE": {"class": "scoring"}})
+    fs = run('''
+        _k("VCTPU_FAKE_SHINY", default=False)
+        ''', path=_KNOBS_PATH, select={"VCT012"})
+    assert any("no longer defines" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# the committed contract itself stays honest
+# ---------------------------------------------------------------------------
+
+
+def test_real_contract_is_valid_and_matches_registry():
+    from variantcalling_tpu import knobs as knobs_mod
+
+    with open(os.path.join(REPO, "tools/vctpu_lint/knobs_contract.json"),
+              encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc["knobs"], "the contract must classify the proven knobs"
+    for name, entry in doc["knobs"].items():
+        assert entry["class"] in ("scoring", "byte_neutral"), name
+        assert entry.get("reason"), f"{name} needs a recorded reason"
+        assert name in knobs_mod.REGISTRY, f"stale contract entry {name}"
+        if entry["class"] == "scoring":
+            assert knobs_mod.REGISTRY[name].in_header, \
+                f"scoring knob {name} must ride the provenance header"
+
+
+def test_real_tree_vct011_vct012_clean_on_protocol_modules():
+    # the owner modules and the committer pipeline must lint clean —
+    # every true positive was fixed in-diff, not baselined
+    paths = [
+        "variantcalling_tpu/io/journal.py",
+        "variantcalling_tpu/io/chunk_cache.py",
+        "variantcalling_tpu/parallel/elastic.py",
+        "variantcalling_tpu/parallel/rank_plan.py",
+        "variantcalling_tpu/knobs.py",
+    ]
+    sources = {}
+    for rel in paths:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            sources[rel] = fh.read()
+    assert lint.lint_sources(sources, select={"VCT011", "VCT012"}) == []
+
+
+# ---------------------------------------------------------------------------
+# --prune-baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_prune_subtracts_stale_budget(tmp_path):
+    from collections import Counter
+
+    from tools.vctpu_lint import baseline as baseline_mod
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [
+        {"code": "VCT001", "path": "a.py", "line_text": "x", "count": 3,
+         "justification": "keep some"},
+        {"code": "VCT002", "path": "b.py", "line_text": "y", "count": 1,
+         "justification": "fully stale"},
+    ]}))
+    stale = Counter({("VCT001", "a.py", "x"): 2,
+                     ("VCT002", "b.py", "y"): 1})
+    removed, remaining = baseline_mod.prune(str(bl), stale)
+    assert (removed, remaining) == (3, 1)
+    doc = json.loads(bl.read_text())
+    assert doc["entries"] == [
+        {"code": "VCT001", "path": "a.py", "line_text": "x", "count": 1,
+         "justification": "keep some"}]
+    # a second prune with nothing stale is a no-op
+    assert baseline_mod.prune(str(bl), Counter()) == (0, 1)
+
+
+def test_prune_baseline_cli_guards(tmp_path, capsys):
+    from tools.vctpu_lint.__main__ import main as lint_main
+
+    # scoped paths / --select / other baseline modes refuse to prune
+    assert lint_main([str(tmp_path), "--prune-baseline"]) == 2
+    assert lint_main(["--prune-baseline", "--select", "VCT001"]) == 2
+    assert lint_main(["--prune-baseline", "--no-baseline"]) == 2
+    assert lint_main(["--prune-baseline", "--write-baseline"]) == 2
+    assert lint_main(["--prune-baseline", "--update-baseline",
+                      "--justify", "x"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# obs schema audit: the static (writer-side) half is bidirectional
+# ---------------------------------------------------------------------------
+
+
+def _fake_repo(tmp_path, schema_kinds, sources):
+    obs_dir = tmp_path / "variantcalling_tpu" / "obs"
+    obs_dir.mkdir(parents=True)
+    (tmp_path / "tools").mkdir()
+    (obs_dir / "event_schema.json").write_text(
+        json.dumps({"kinds": {k: {} for k in schema_kinds}}))
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def test_static_kind_audit_clean(tmp_path):
+    from tools.obs_schema_check import static_kind_audit
+
+    root = _fake_repo(tmp_path, ["span"], {
+        "variantcalling_tpu/writer.py": '''
+            def go(obs):
+                obs.event("span", "outer", dur=1.0)
+            ''',
+    })
+    assert static_kind_audit(root) == []
+
+
+def test_static_kind_audit_flags_unemitted_schema_kind(tmp_path):
+    from tools.obs_schema_check import static_kind_audit
+
+    root = _fake_repo(tmp_path, ["span", "ghost"], {
+        "variantcalling_tpu/writer.py": '''
+            def go(obs):
+                obs.event("span", "outer")
+            ''',
+    })
+    errs = static_kind_audit(root)
+    assert len(errs) == 1
+    assert "'ghost'" in errs[0] and "no literal emission site" in errs[0]
+
+
+def test_static_kind_audit_flags_non_literal_site(tmp_path):
+    from tools.obs_schema_check import static_kind_audit
+
+    root = _fake_repo(tmp_path, ["span"], {
+        "variantcalling_tpu/writer.py": '''
+            def go(obs, kind):
+                obs.event("span", "outer")
+                obs.event(kind, "relay")
+            ''',
+    })
+    errs = static_kind_audit(root)
+    assert len(errs) == 1
+    assert "non-literal event kind" in errs[0]
+    assert "writer.py:4" in errs[0]
+
+
+def test_static_kind_audit_exempts_the_forwarder(tmp_path):
+    from tools.obs_schema_check import static_kind_audit
+
+    root = _fake_repo(tmp_path, ["span"], {
+        "variantcalling_tpu/obs/__init__.py": '''
+            def event(kind, name, **fields):
+                run = _current()
+                run._emit(kind, name, fields)
+
+            def _span(run, name):
+                run._emit("span", name, {})
+            ''',
+    })
+    assert static_kind_audit(root) == []
+
+
+def test_static_kind_audit_real_tree_clean():
+    from tools.obs_schema_check import static_kind_audit
+
+    assert static_kind_audit() == []
+
+
+# ---------------------------------------------------------------------------
+# regression: the runtime fixes VCT011/VCT012 forced
+# ---------------------------------------------------------------------------
+
+
+def test_journal_partial_helpers_roundtrip(tmp_path):
+    from variantcalling_tpu.io import journal
+
+    out = str(tmp_path / "out.vcf")
+    token = journal.new_partial_token()
+    with journal.open_partial(out, token, "wb") as fh:
+        fh.write(b"hel")
+    with journal.open_partial(out, token, "ab") as fh:
+        fh.write(b"lo")
+    part = journal.partial_path(out, token)
+    assert os.path.exists(part)
+    journal.commit_partial(out, token)
+    assert not os.path.exists(part)
+    with open(out, "rb") as fh:
+        assert fh.read() == b"hello"
+    # remove_partial is best-effort: idempotent on the committed token
+    journal.remove_partial(out, token)
+
+
+def test_journal_remove_partial_best_effort(tmp_path):
+    from variantcalling_tpu.io import journal
+
+    out = str(tmp_path / "out.vcf")
+    token = journal.new_partial_token()
+    with journal.open_partial(out, token) as fh:
+        fh.write(b"abandoned")
+    journal.remove_partial(out, token)
+    assert not os.path.exists(journal.partial_path(out, token))
+    journal.remove_partial(out, token)  # second call must not raise
+
+
+def test_quarantine_knob_rides_provenance_header(monkeypatch):
+    from variantcalling_tpu import knobs as knobs_mod
+
+    monkeypatch.delenv("VCTPU_QUARANTINE", raising=False)
+    assert "VCTPU_QUARANTINE" not in knobs_mod.header_line()
+    monkeypatch.setenv("VCTPU_QUARANTINE", "1")
+    assert "VCTPU_QUARANTINE=True" in knobs_mod.header_line()

@@ -11,6 +11,13 @@ spans incl. a worker thread, degradations, fault firings, metrics,
 heartbeat, run end) and the Perfetto exporter's invariants (sorted ts,
 ph/pid/tid on every trace event).
 
+The audit is BIDIRECTIONAL: besides validating the generated stream,
+:func:`static_kind_audit` walks the writer sources and fails on schema
+kinds no code ever emits (dead schema surface the validator can never
+exercise) and on emission sites whose kind is not a string literal
+(invisible to both this audit and vctpu-lint's VCT007) outside the one
+sanctioned ``obs.event`` forwarder.
+
 Exit codes: 0 valid, 1 schema violations (printed), 2 internal error.
 """
 
@@ -21,6 +28,83 @@ import sys
 import tempfile
 import threading
 import time
+
+#: emission sites allowed to pass a NON-LITERAL kind: the public
+#: ``obs.event(kind, name, **fields)`` forwarder re-emits its caller's
+#: kind verbatim — every other site must name its kind literally so the
+#: static audit (and VCT007) can see it
+_KIND_FORWARDERS = ("variantcalling_tpu/obs/__init__.py",)
+
+
+def static_kind_audit(repo_root: str | None = None) -> list[str]:
+    """The writer-side half of the schema gate, statically.
+
+    Walks every ``.py`` under ``variantcalling_tpu/`` and ``tools/``
+    (tests excluded — they emit deliberately-bogus kinds), collects the
+    string-literal kinds passed to ``obs.event(...)`` / ``*._emit(...)``,
+    and returns one error per (a) schema kind with no literal emission
+    site anywhere — dead schema surface the generated-log validation can
+    never exercise — and (b) emission site whose kind expression is not
+    a string literal outside :data:`_KIND_FORWARDERS`. Complements
+    VCT007, which checks the opposite direction (literal kind missing
+    from the schema).
+    """
+    import ast
+    import json
+
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    schema_path = os.path.join(
+        root, "variantcalling_tpu", "obs", "event_schema.json")
+    try:
+        with open(schema_path, encoding="utf-8") as fh:
+            kinds = set(json.load(fh)["kinds"])
+    except (OSError, ValueError, KeyError) as e:
+        return [f"static audit: cannot load event schema: {e}"]
+    emitted: set[str] = set()
+    errors: list[str] = []
+    for top in ("variantcalling_tpu", "tools"):
+        for dirpath, dirnames, files in os.walk(os.path.join(root, top)):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+                try:
+                    tree = ast.parse(src, filename=rel)
+                except SyntaxError:
+                    continue  # the lint stage owns syntax findings
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Call) or not node.args:
+                        continue
+                    func = node.func
+                    is_emit = isinstance(func, ast.Attribute) and (
+                        func.attr == "_emit"
+                        or (func.attr == "event"
+                            and isinstance(func.value, ast.Name)
+                            and func.value.id == "obs"))
+                    if not is_emit:
+                        continue
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str):
+                        emitted.add(arg.value)
+                    elif rel not in _KIND_FORWARDERS:
+                        errors.append(
+                            f"{rel}:{node.lineno}: non-literal event kind "
+                            "at an emission site — pass the kind as a "
+                            "string literal so the schema<->writer audit "
+                            "can see it (only the obs.event forwarder is "
+                            "exempt)")
+    for kind in sorted(kinds - emitted):
+        errors.append(
+            f"schema kind {kind!r} has no literal emission site under "
+            "variantcalling_tpu/ or tools/ — dead schema surface: emit "
+            "it or prune it from event_schema.json")
+    return errors
 
 
 def main() -> int:
@@ -126,7 +210,8 @@ def main() -> int:
 
         with open(path, encoding="utf-8") as fh:
             lines = fh.read().splitlines()
-        errors = errors_pre + schema.validate_lines(lines)
+        errors = static_kind_audit() + errors_pre \
+            + schema.validate_lines(lines)
         # the stream must actually contain every producer's kind — a
         # silently-dropped event class would otherwise "validate"
         import json

@@ -1,0 +1,166 @@
+"""Mechanical model <-> code anchoring.
+
+The model in :mod:`tools.protocheck.model` is only evidence about the
+REAL protocol while its constants match the code. Each check here
+EXTRACTS the code-side value from the ``elastic.py``/``rank_plan.py``
+ASTs (located through the vctpu-lint project index — same resolution
+the checkers use) and compares it against the model constant; a
+mismatch is a drift finding that fails the tier-0 stage. Renaming the
+lease scheme, dropping O_EXCL, changing the generation-bump rule or the
+marker suffix in code without updating the model (or vice versa) is
+caught mechanically, not by review.
+
+Extraction is deliberately structural (walk the function's AST for the
+specific literal/flag/shape), not textual — a reformat cannot fake an
+anchor, and a semantic change cannot hide behind one.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.protocheck import model as model_mod
+from tools.vctpu_lint import project as project_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ELASTIC = "variantcalling_tpu/parallel/elastic.py"
+RANK_PLAN = "variantcalling_tpu/parallel/rank_plan.py"
+
+
+def _load_sources() -> dict[str, str]:
+    out = {}
+    for rel in (ELASTIC, RANK_PLAN):
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            out[rel] = fh.read()
+    return out
+
+
+def _func(index: "project_mod.ProjectIndex", path: str, qual: str):
+    info = index.modules.get(path)
+    fn = info.functions.get(qual) if info else None
+    return fn.node if fn else None
+
+
+def _str_literals(node: ast.AST) -> list[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def verify(sources: dict[str, str] | None = None) -> list[str]:
+    """Compare every model constant against the code; returns drift
+    messages (empty = anchored). ``sources`` overrides the on-disk
+    files (the drift tests feed tampered copies)."""
+    if sources is None:
+        sources = _load_sources()
+    index = project_mod.ProjectIndex.build(sources)
+    drift: list[str] = []
+
+    def miss(what: str, model_val, code_desc: str) -> None:
+        drift.append(f"anchor drift — {what}: model says {model_val!r} "
+                     f"but {code_desc}")
+
+    # 1. lease filename scheme: lease_path's f-string must carry the
+    #    model's LEASE_SCHEME literal
+    fn = _func(index, ELASTIC, "lease_path")
+    if fn is None or model_mod.LEASE_SCHEME not in "".join(
+            _str_literals(fn)):
+        miss("lease filename scheme", model_mod.LEASE_SCHEME,
+             f"elastic.lease_path builds {_str_literals(fn) if fn else 'MISSING'}")
+
+    # 2. acquire flags: claim_lease's os.open must carry every model
+    #    ACQUIRE_FLAG (O_EXCL is the whole mutual-exclusion argument)
+    fn = _func(index, ELASTIC, "claim_lease")
+    flags: set[str] = set()
+    if fn is not None:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Attribute) and n.attr.startswith("O_"):
+                flags.add(n.attr)
+    if not model_mod.ACQUIRE_FLAGS <= flags:
+        miss("lease acquire flags", sorted(model_mod.ACQUIRE_FLAGS),
+             f"elastic.claim_lease opens with {sorted(flags) or 'MISSING'}")
+
+    # 3. generation rules in Coordinator._requeue: the adopt and the
+    #    whole-span re-offer bump .gen by GEN_BUMP; the re-cut remainder
+    #    restarts at FRESH_REST_GEN
+    fn = _func(index, ELASTIC, "Coordinator._requeue")
+    bumps = 0
+    fresh = 0
+    if fn is not None:
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "Span" and len(n.args) >= 3):
+                continue
+            g = n.args[2]
+            if isinstance(g, ast.BinOp) and isinstance(g.op, ast.Add) \
+                    and isinstance(g.right, ast.Constant) \
+                    and g.right.value == model_mod.GEN_BUMP \
+                    and isinstance(g.left, ast.Attribute) \
+                    and g.left.attr == "gen":
+                bumps += 1
+            elif isinstance(g, ast.Constant) \
+                    and g.value == model_mod.FRESH_REST_GEN:
+                fresh += 1
+    if bumps < 2:
+        miss("generation bump (+%d on adopt AND whole-span re-offer)"
+             % model_mod.GEN_BUMP, model_mod.GEN_BUMP,
+             f"Coordinator._requeue has {bumps} Span(.., .gen + "
+             f"{model_mod.GEN_BUMP}) constructions (need 2)")
+    if fresh < 1:
+        miss("re-cut remainder generation", model_mod.FRESH_REST_GEN,
+             "Coordinator._requeue never constructs the remainder at "
+             f"generation {model_mod.FRESH_REST_GEN}")
+
+    # 4. the re-cut watermark comes from the journal's in_end field
+    fn = _func(index, ELASTIC, "journal_progress")
+    if fn is None or "in_end" not in _str_literals(fn):
+        miss("re-cut watermark source", "journal in_end",
+             "elastic.journal_progress no longer reads the journal's "
+             "'in_end' field")
+
+    # 5. merge contiguity: merge_spans refuses a.hi != b.lo
+    fn = _func(index, ELASTIC, "merge_spans")
+    found = False
+    if fn is not None:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Compare) and len(n.ops) == 1 \
+                    and isinstance(n.ops[0], ast.NotEq) \
+                    and isinstance(n.left, ast.Attribute) \
+                    and n.left.attr == "hi" \
+                    and isinstance(n.comparators[0], ast.Attribute) \
+                    and n.comparators[0].attr == "lo":
+                found = True
+    if model_mod.MERGE_CONTIGUOUS and not found:
+        miss("merge contiguity check", "a.hi != b.lo refusal",
+             "elastic.merge_spans no longer compares adjacent spans' "
+             "hi/lo seams")
+
+    # 6. span segment scheme: span_segment_path's f-string parts
+    fn = _func(index, ELASTIC, "span_segment_path")
+    lits = "".join(_str_literals(fn)) if fn else ""
+    if not all(part in lits for part in model_mod.SEG_SCHEME):
+        miss("span segment scheme", model_mod.SEG_SCHEME,
+             f"elastic.span_segment_path builds {lits!r}")
+
+    # 7. completion marker suffix: rank_plan.marker_path
+    fn = _func(index, RANK_PLAN, "marker_path")
+    if fn is None or model_mod.DONE_SUFFIX not in _str_literals(fn):
+        miss("completion marker suffix", model_mod.DONE_SUFFIX,
+             f"rank_plan.marker_path builds "
+             f"{_str_literals(fn) if fn else 'MISSING'}")
+
+    # 8. the marker seal is atomic (tmp sibling + os.replace): the
+    #    model's commit transition is a single step BECAUSE the code's
+    #    marker write cannot be observed half-done
+    fn = _func(index, RANK_PLAN, "write_marker")
+    has_tmp = fn is not None and any(".tmp" in s for s in _str_literals(fn))
+    has_replace = fn is not None and any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "replace" for n in ast.walk(fn))
+    if not (has_tmp and has_replace):
+        miss("atomic marker seal", "tmp sibling + os.replace",
+             "rank_plan.write_marker lost the tmp-sibling atomic write")
+
+    return drift

@@ -1,0 +1,352 @@
+"""The elastic-protocol transition system and its invariants.
+
+A state is an immutable snapshot of one pod run over an abstract input
+``[0, total)`` (units are "progress steps" — chunk boundaries; byte
+offsets add nothing to the argument). Spans are ``(lo, hi, gen)``
+triples exactly like :class:`variantcalling_tpu.parallel.elastic.Span`;
+the constants the code side must agree on (lease scheme, flags,
+generation rules, marker suffix) live at the top and are MECHANICALLY
+anchored against the real source by :mod:`tools.protocheck.anchor`.
+
+Transitions model the coordinator loop:
+
+* ``acquire`` — a worker joins and claims a pending span's lease.
+  O_EXCL semantics: a lease that already exists on disk refuses the
+  claim (the loser of the race gets ``FileExistsError``).
+* ``shadow`` — a second worker races the SAME offered span (the
+  join-during-run case). Under O_EXCL this is a no-op (the lease file
+  refuses); with the ``drop_o_excl`` mutation both claims win.
+* ``work`` — one journaled chunk of progress.
+* ``crash`` — SIGKILL mid-span, then the coordinator reaps: at a
+  mid-span journal watermark the span is RE-CUT (``adopt`` keeps the
+  journaled prefix under ``gen+1``, ``rest`` restarts fresh at gen
+  ``0``); otherwise the whole span is re-offered under ``gen+1``.
+* ``steal`` — the straggler path: kill the worker, then the same
+  re-cut. The ``commit_stale_gen`` mutation "forgets" the kill so a
+  zombie later commits a superseded generation; the ``double_cover``
+  mutation re-cuts the rest one step early so the stolen span is
+  covered twice.
+* ``commit`` — a finished worker seals its span (marker + lease kept).
+* ``merge`` — once drained, splice committed spans in seam order.
+
+Invariants (checked in every reached state):
+
+* **I1 one-owner** — at most one live worker per (span, generation).
+* **I2 exact-cover** — pending + live non-superseded running + committed
+  non-superseded spans tile ``[0, total)`` exactly once.
+* **I3 no-stale-commit** — no committed span carries a generation that a
+  steal/crash re-cut superseded.
+* **I4 merge-monotone** — the splice consumes committed spans in
+  strictly increasing seam order with no gap.
+
+Everything is stdlib; breadth-first exploration keeps the first
+violation's interleaving minimal.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+# -- the model constants the CODE must agree on (anchor.py) -----------------
+
+#: lease filename scheme: ``<seg>.lease.g<gen>`` (elastic.lease_path)
+LEASE_SCHEME = ".lease.g"
+
+#: span segment scheme: ``<out>.span<lo>-<hi>.seg`` (span_segment_path)
+SEG_SCHEME = (".span", "-", ".seg")
+
+#: completion marker suffix: ``<seg>.done`` (rank_plan.marker_path)
+DONE_SUFFIX = ".done"
+
+#: the acquire's open(2) flags — O_EXCL is the mutual exclusion
+ACQUIRE_FLAGS = frozenset({"O_CREAT", "O_EXCL"})
+
+#: a re-offered / adopted span bumps its generation by exactly this
+GEN_BUMP = 1
+
+#: the re-cut's fresh remainder restarts at this generation
+FRESH_REST_GEN = 0
+
+#: the merge refuses non-contiguous plans (a.hi != b.lo)
+MERGE_CONTIGUOUS = True
+
+MUTATIONS = ("drop_o_excl", "commit_stale_gen", "double_cover")
+
+
+@dataclass(frozen=True)
+class State:
+    """One immutable pod snapshot (hashable: the BFS frontier key)."""
+
+    pending: frozenset      # {(lo, hi, gen)} offered, unclaimed
+    running: frozenset      # {(span, progress, worker_idx)}
+    leases: frozenset       # {(lo, hi, gen)} lease files on disk
+    committed: frozenset    # {(lo, hi, gen)} sealed segments
+    superseded: frozenset   # {(lo, hi, gen)} killed by re-cut/re-offer
+    merged_upto: int        # seam position the splice has consumed
+    crashes_left: int
+    steals_left: int
+
+
+class Model:
+    """The transition system; ``mutate`` seeds one protocol bug."""
+
+    def __init__(self, total: int = 4, workers: int = 2, max_gen: int = 2,
+                 crashes: int = 2, steals: int = 1,
+                 mutate: str | None = None):
+        if mutate is not None and mutate not in MUTATIONS:
+            raise ValueError(f"unknown mutation {mutate!r} "
+                             f"(choose from {MUTATIONS})")
+        self.total = int(total)
+        self.workers = int(workers)
+        self.max_gen = int(max_gen)
+        self.mutate = mutate
+        self._crashes = int(crashes)
+        self._steals = int(steals)
+
+    # -- states ------------------------------------------------------------
+
+    def initial(self) -> State:
+        """The seeded pod: ``initial_spans`` worker-count fractions at
+        generation 0 (elastic.initial_spans with header_end=0)."""
+        cuts = [self.total * i // self.workers
+                for i in range(self.workers + 1)]
+        spans = frozenset((cuts[i], cuts[i + 1], 0)
+                          for i in range(self.workers)
+                          if cuts[i] < cuts[i + 1])
+        return State(pending=spans, running=frozenset(),
+                     leases=frozenset(), committed=frozenset(),
+                     superseded=frozenset(), merged_upto=0,
+                     crashes_left=self._crashes, steals_left=self._steals)
+
+    # -- transitions -------------------------------------------------------
+
+    def transitions(self, s: State) -> list[tuple[str, State]]:
+        out: list[tuple[str, State]] = []
+        drained = not s.pending and not s.running
+
+        for span in sorted(s.pending):
+            if len(s.running) >= self.workers:
+                break
+            # O_EXCL: an existing lease file refuses the claim — the
+            # drop_o_excl mutation is the open() without the flag
+            if span in s.leases and self.mutate != "drop_o_excl":
+                continue
+            out.append((f"acquire{_lbl(span)}", State(
+                pending=s.pending - {span},
+                running=s.running | {(span, 0, 0)},
+                leases=s.leases | {span},
+                committed=s.committed, superseded=s.superseded,
+                merged_upto=s.merged_upto,
+                crashes_left=s.crashes_left, steals_left=s.steals_left)))
+
+        # a late joiner races an ALREADY-CLAIMED span (its offer is
+        # still visible until the worker commits). Under O_EXCL the
+        # lease refuses — no transition; without it, both claims win.
+        if self.mutate == "drop_o_excl":
+            for (span, p, idx) in sorted(s.running):
+                if idx == 0 and span in s.leases \
+                        and len(s.running) < self.workers + 1:
+                    out.append((f"shadow{_lbl(span)}", State(
+                        pending=s.pending,
+                        running=s.running | {(span, 0, 1)},
+                        leases=s.leases, committed=s.committed,
+                        superseded=s.superseded,
+                        merged_upto=s.merged_upto,
+                        crashes_left=s.crashes_left,
+                        steals_left=s.steals_left)))
+
+        for (span, p, idx) in sorted(s.running):
+            lo, hi, gen = span
+            if p < hi - lo:
+                out.append((f"work{_lbl(span)}", State(
+                    pending=s.pending,
+                    running=(s.running - {(span, p, idx)})
+                    | {(span, p + 1, idx)},
+                    leases=s.leases, committed=s.committed,
+                    superseded=s.superseded, merged_upto=s.merged_upto,
+                    crashes_left=s.crashes_left,
+                    steals_left=s.steals_left)))
+            else:
+                # the zombie of commit_stale_gen commits its superseded
+                # span; a live worker seals normally
+                out.append((f"commit{_lbl(span)}", State(
+                    pending=s.pending,
+                    running=s.running - {(span, p, idx)},
+                    leases=s.leases, committed=s.committed | {span},
+                    superseded=s.superseded, merged_upto=s.merged_upto,
+                    crashes_left=s.crashes_left,
+                    steals_left=s.steals_left)))
+            if s.crashes_left > 0:
+                out.append((f"crash{_lbl(span)}@{p}",
+                            self._reap(s, span, p, idx, steal=False)))
+            if s.steals_left > 0 and 0 < p < hi - lo \
+                    and gen + GEN_BUMP <= self.max_gen:
+                out.append((f"steal{_lbl(span)}@{p}",
+                            self._reap(s, span, p, idx, steal=True)))
+
+        if drained and s.committed:
+            nxt = self._next_merge(s)
+            if nxt is not None:
+                out.append((f"merge{_lbl(nxt)}", State(
+                    pending=s.pending, running=s.running,
+                    leases=s.leases, committed=s.committed,
+                    superseded=s.superseded, merged_upto=nxt[1],
+                    crashes_left=s.crashes_left,
+                    steals_left=s.steals_left)))
+        return out
+
+    def _reap(self, s: State, span, p: int, idx: int, steal: bool) -> State:
+        """Kill one worker and requeue its span — elastic's
+        ``Coordinator._requeue``: re-cut at a mid-span watermark
+        (journaled prefix adopted under gen+1, remainder fresh at gen
+        0), whole-span re-offer under gen+1 otherwise."""
+        lo, hi, gen = span
+        running = s.running - {(span, p, idx)}
+        if steal and self.mutate == "commit_stale_gen":
+            # the seeded bug: the coordinator re-cuts without actually
+            # killing the worker — the zombie later commits gen `gen`
+            # after the steal superseded it
+            running = s.running
+        crashes = s.crashes_left - (0 if steal else 1)
+        steals = s.steals_left - (1 if steal else 0)
+        if 0 < p < hi - lo and gen + GEN_BUMP <= self.max_gen:
+            adopt = (lo, lo + p, gen + GEN_BUMP)
+            rest_lo = lo + p
+            if steal and self.mutate == "double_cover":
+                # the seeded bug: the fresh remainder is cut one step
+                # early, so [rest_lo-1, rest_lo) is covered twice
+                rest_lo = lo + p - 1
+            rest = (rest_lo, hi, FRESH_REST_GEN)
+            pending = s.pending | {adopt, rest}
+        else:
+            pending = s.pending | {(lo, hi, min(gen + GEN_BUMP,
+                                                self.max_gen + 1))}
+        return State(pending=pending, running=running, leases=s.leases,
+                     committed=s.committed,
+                     superseded=s.superseded | {span},
+                     merged_upto=s.merged_upto,
+                     crashes_left=crashes, steals_left=steals)
+
+    def _next_merge(self, s: State):
+        live = sorted(sp for sp in s.committed
+                      if sp not in s.superseded and sp[1] > s.merged_upto)
+        return live[0] if live else None
+
+    # -- invariants --------------------------------------------------------
+
+    def violations(self, s: State) -> list[str]:
+        out: list[str] = []
+        # I1: at most one live owner per (span, generation)
+        owned = [sp for (sp, _p, _i) in s.running]
+        dupes = {sp for sp in owned if owned.count(sp) > 1}
+        for sp in sorted(dupes):
+            out.append(f"I1 one-owner: two live workers own span "
+                       f"{_lbl(sp)} — the O_EXCL lease must refuse the "
+                       "second claim")
+        # I2: pending + live running + committed tile [0, total) once
+        cover = sorted(
+            [sp for sp in s.pending]
+            + [sp for (sp, _p, _i) in s.running if sp not in s.superseded]
+            + [sp for sp in s.committed if sp not in s.superseded])
+        pos = 0
+        for (lo, hi, gen) in cover:
+            if lo < pos:
+                out.append(f"I2 exact-cover: span {_lbl((lo, hi, gen))} "
+                           f"overlaps [{lo},{pos}) already covered — "
+                           "some bytes would be committed twice")
+                pos = max(pos, hi)
+            elif lo > pos:
+                out.append(f"I2 exact-cover: gap [{pos},{lo}) has no "
+                           "owner — those bytes would never be "
+                           "committed")
+                pos = hi
+            else:
+                pos = hi
+        if not out and pos != self.total and cover:
+            out.append(f"I2 exact-cover: coverage ends at {pos} != "
+                       f"{self.total}")
+        # I3: no superseded generation ever commits
+        for sp in sorted(s.committed & s.superseded):
+            out.append(f"I3 no-stale-commit: span {_lbl(sp)} committed "
+                       "after a steal/re-cut superseded its generation")
+        # I4 (merge monotonicity) is enforced structurally: merge
+        # consumes the lowest unmerged committed span — check the seam
+        if not s.pending and not s.running:
+            nxt = self._next_merge(s)
+            if nxt is not None and nxt[0] != s.merged_upto:
+                out.append(f"I4 merge-monotone: next committed span "
+                           f"{_lbl(nxt)} does not start at the merge "
+                           f"watermark {s.merged_upto} — the splice "
+                           "would gap or double bytes")
+        return out
+
+
+def _lbl(span) -> str:
+    lo, hi, gen = span
+    return f"[{lo},{hi})g{gen}"
+
+
+@dataclass
+class Result:
+    states: int
+    complete: bool                      # False when max_states hit
+    violations: list = field(default_factory=list)  # (msg, trace)
+    deadlocks: int = 0
+
+
+def explore(model: Model, max_states: int = 200_000,
+            max_violations: int = 16) -> Result:
+    """BFS the reachable state space; the first trace reported for any
+    violation is minimal (BFS layers = interleaving length). Each
+    distinct violation MESSAGE is reported once, with its shortest
+    witness."""
+    init = model.initial()
+    parent: dict[State, tuple[State, str] | None] = {init: None}
+    q: deque[State] = deque([init])
+    res = Result(states=0, complete=True)
+    seen_msgs: set[str] = set()
+
+    def trace_of(s: State) -> list[str]:
+        labels: list[str] = []
+        cur = s
+        while parent[cur] is not None:
+            prev, lbl = parent[cur]
+            labels.append(lbl)
+            cur = prev
+        return list(reversed(labels))
+
+    while q:
+        s = q.popleft()
+        res.states += 1
+        for msg in model.violations(s):
+            if msg not in seen_msgs and \
+                    len(res.violations) < max_violations:
+                seen_msgs.add(msg)
+                res.violations.append((msg, trace_of(s)))
+        nexts = model.transitions(s)
+        if not nexts and (s.pending or s.running):
+            res.deadlocks += 1
+        for lbl, ns in nexts:
+            if ns not in parent:
+                if len(parent) >= max_states:
+                    res.complete = False
+                    continue
+                parent[ns] = (s, lbl)
+                q.append(ns)
+    return res
+
+
+def replay(model: Model, trace: list[str]) -> list[str]:
+    """Re-execute a violation trace label by label from the initial
+    state; returns the violations observed in the final state. The
+    mutation tests use this to prove traces are REPLAYABLE, not just
+    printable."""
+    s = model.initial()
+    for lbl in trace:
+        nexts = dict(model.transitions(s))
+        if lbl not in nexts:
+            raise ValueError(f"trace label {lbl!r} is not enabled in the "
+                             f"reached state (enabled: {sorted(nexts)})")
+        s = nexts[lbl]
+    return model.violations(s)

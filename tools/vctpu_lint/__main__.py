@@ -50,6 +50,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--justify", default=None, metavar="REASON",
                         help="justification string recorded on every entry "
                              "--update-baseline adds")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="delete baseline entries this run no longer "
+                             "produces (requires the full default path set "
+                             "and all checkers — a scoped run would misread "
+                             "out-of-scope entries as stale)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output: findings + per-"
                              "checker wall time")
@@ -73,6 +78,18 @@ def main(argv: list[str] | None = None) -> int:
         if bad:
             print(f"unknown checker code(s): {', '.join(sorted(bad))}",
                   file=sys.stderr)
+            return 2
+
+    if args.prune_baseline:
+        if args.no_baseline or args.write_baseline or args.update_baseline:
+            print("--prune-baseline is incompatible with --no-baseline / "
+                  "--write-baseline / --update-baseline", file=sys.stderr)
+            return 2
+        if args.paths or args.select:
+            print("--prune-baseline requires the full default path set and "
+                  "every checker — pruning against a scoped run would "
+                  "misread out-of-scope entries as stale and delete "
+                  "justified debt", file=sys.stderr)
             return 2
 
     if args.update_baseline and not args.justify:
@@ -120,6 +137,27 @@ def main(argv: list[str] | None = None) -> int:
     allowed = baseline_mod.load(args.baseline) if not args.no_baseline \
         else baseline_mod.load("/nonexistent")
     new, old, stale = baseline_mod.partition(findings, allowed)
+
+    if args.prune_baseline:
+        removed, remaining = baseline_mod.prune(args.baseline, stale)
+        if args.as_json:
+            json.dump({"version": 1, "action": "prune-baseline",
+                       "baseline": args.baseline, "pruned": removed,
+                       "entries": remaining, "new": len(new),
+                       "exit": 1 if new else 0}, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            print(f"pruned {removed} stale count(s); baseline now holds "
+                  f"{remaining} entr{'y' if remaining == 1 else 'ies'} -> "
+                  f"{args.baseline}")
+        if new:
+            # pruning never suppresses anything — new findings still gate
+            for f in new:
+                print(f.render())
+            print(f"{len(new)} new finding(s) — pruning does not bypass "
+                  "the gate", file=sys.stderr)
+            return 1
+        return 0
 
     if args.as_json:
         by_code = sorted(CHECKERS, key=lambda c: c.code)

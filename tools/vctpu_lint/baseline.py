@@ -79,6 +79,39 @@ def write(path: str, findings: list[Finding],
     return len(entries)
 
 
+def prune(path: str, stale: Counter) -> tuple[int, int]:
+    """Subtract the unused budget (``partition``'s ``stale``) from the
+    baseline: entries whose count drops to zero are deleted, partially
+    used entries keep the residual count and their justification.
+    Returns ``(counts_removed, entries_remaining)``. The CLI only calls
+    this from a full-tree, all-checkers run — pruning against a scoped
+    run would misread out-of-scope entries as stale and delete
+    justified debt."""
+    if not os.path.exists(path) or not stale:
+        return 0, len(load(path))
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    merged: dict[tuple, dict] = {}
+    for entry in data.get("entries", []):
+        fp = (entry["code"], entry["path"], entry["line_text"])
+        if fp in merged:
+            merged[fp]["count"] += int(entry.get("count", 1))
+        else:
+            merged[fp] = dict(entry, count=int(entry.get("count", 1)))
+    removed = 0
+    kept = []
+    for fp, entry in sorted(merged.items()):
+        cut = min(entry["count"], stale.get(fp, 0))
+        removed += cut
+        entry["count"] -= cut
+        if entry["count"] > 0:
+            kept.append(entry)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": kept}, fh, indent=2)
+        fh.write("\n")
+    return removed, len(kept)
+
+
 def partition(findings: list[Finding],
               allowed: Counter) -> tuple[list[Finding], list[Finding], Counter]:
     """Split findings into (new, baselined); also return the unused

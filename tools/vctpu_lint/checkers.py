@@ -825,6 +825,329 @@ class ConcurrencyDisciplineChecker(Checker):
                 self.report(_Anchor(line), message)
 
 
+#: modules that OWN the run-state filesystem protocol (VCT011): the
+#: journal (``.journal``/``.partial`` lifecycle + resume rename), the
+#: chunk cache (``.vcc`` mkstemp+replace publish), the elastic lease
+#: arbiter (``.lease.gN`` O_EXCL acquire + handoff rename), and
+#: rank_plan (the ``.done`` marker sealer + the one seam-merge
+#: committer ``splice_segments``). Everything else — including the
+#: pipelines — must go through these helpers or the ``_sink_write``
+#: committer so crash-recovery sees exactly one naming discipline.
+_RUN_STATE_OWNERS = (
+    "variantcalling_tpu/io/journal.py",
+    "variantcalling_tpu/io/chunk_cache.py",
+    "variantcalling_tpu/parallel/elastic.py",
+    "variantcalling_tpu/parallel/rank_plan.py",
+)
+
+#: the sanctioned output committer (shared with VCT008's rule)
+_SANCTIONED_SINK_FN = "_sink_write"
+
+
+@register
+class RunStateProtocolChecker(Checker):
+    """VCT011 — run-state filesystem protocol discipline.
+
+    Incident class: the byte-parity story is now enforced by a
+    *filesystem protocol* — O_EXCL ``.lease.gN`` acquires, tmp-sibling
+    ``os.replace`` commits, ``.done`` markers sealed only after the
+    journal's ``finish()`` — scattered across 13 modules. A module that
+    opens a ``.partial`` or writes a ``.done`` marker with its own
+    spelling bypasses the crash-recovery scan (``_try_resume`` renames,
+    marker trust in ``run_scaleout``) silently: the run "succeeds" and
+    resumes wrong. Using the project model's filesystem-effect index
+    (suffix lineage resolved through path helpers, module constants and
+    ``self.attr`` bindings), four rules:
+
+    1. **Ownership.** Any *write* effect whose path lineage carries a
+       run-state suffix (``.journal``/``.partial``/``.lease``/``.done``/
+       ``.vcc``) outside the owner modules or the ``_sink_write``
+       committer.
+    2. **Tmp-sibling commits.** Any ``os.replace``/``os.rename`` whose
+       SOURCE lineage shows neither a ``.tmp`` sibling, an ``mkstemp``
+       result, nor a ``.partial`` being promoted — a non-atomic-idiom
+       commit that can expose a torn file.
+    3. **O_EXCL leases.** Any ``os.open`` of a ``.lease`` path without
+       ``O_EXCL`` in its flags — a lease acquire that two workers can
+       both win.
+    4. **Marker-before-finish.** A ``.done`` marker written before the
+       journal ``finish()`` in the same function's statement order —
+       the marker would claim completion while the journal still says
+       in-flight.
+
+    Scope: the library and tools, tests excluded (fixtures deliberately
+    misuse the protocol). Snippet mode builds a throwaway single-module
+    index so golden fixtures stay one file.
+    """
+
+    code = "VCT011"
+    name = "run-state-protocol"
+    description = ("run-state suffix write outside the sanctioned "
+                   "helpers, non-tmp-sibling os.replace, lease acquire "
+                   "without O_EXCL, or .done marker before journal "
+                   "finish()")
+
+    def applies_to(self, path: str) -> bool:
+        return "tests/" not in path and not path.startswith("test")
+
+    def visit_Module(self, node: ast.Module) -> None:
+        index = self.project
+        if index is None:
+            index = project_mod.ProjectIndex.build_single(
+                self.path, node, self.lines)
+        run_state = frozenset(project_mod.RUN_STATE_SUFFIXES)
+        own = [e for e in index.fs_effects() if e.module == self.path]
+        is_owner = any(self.path.endswith(p) for p in _RUN_STATE_OWNERS)
+        for e in own:
+            anchor = _Anchor(e.line)
+            suffixes = sorted(e.tokens & run_state)
+            in_sink = e.qualname.split(".")[-1] == _SANCTIONED_SINK_FN
+            if e.write and suffixes and not is_owner and not in_sink:
+                self.report(anchor,
+                            f"{e.op} writes a run-state path "
+                            f"({'/'.join(suffixes)}) outside the "
+                            "sanctioned protocol owners — route through "
+                            "io.journal / io.chunk_cache / "
+                            "parallel.elastic / parallel.rank_plan so "
+                            "crash recovery sees one naming discipline")
+            if e.op == "replace" and not (
+                    e.src_tokens & project_mod.TMP_SOURCE_TOKENS):
+                self.report(anchor,
+                            "os.replace source lacks the tmp-sibling "
+                            "idiom — write to a '.tmp' sibling (or "
+                            "mkstemp/.partial) and replace it so a "
+                            "crash never exposes a torn file")
+            if e.op == "os.open" and ".lease" in e.tokens \
+                    and "O_EXCL" not in e.flags:
+                self.report(anchor,
+                            "lease acquire without O_EXCL — two workers "
+                            "can both win this open; the elastic "
+                            "protocol's mutual exclusion rests on "
+                            "O_CREAT|O_EXCL failing for the loser")
+        # rule 4: per function, a .done marker effect (or write_marker
+        # call) textually before a journal finish() call
+        self._marker_order(index, own)
+
+    def _marker_order(self, index, own_effects) -> None:
+        marker_lines: dict[str, list[int]] = {}
+        for e in own_effects:
+            if e.write and ".done" in e.tokens:
+                marker_lines.setdefault(e.qualname, []).append(e.line)
+        info = index.modules.get(self.path)
+        if info is None:
+            return
+        for fn in info.functions.values():
+            finishes: list[int] = []
+            for n in project_mod._walk_own_scope(fn.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if isinstance(f, ast.Attribute) and f.attr == "write_marker":
+                    marker_lines.setdefault(fn.qualname, []).append(n.lineno)
+                elif isinstance(f, ast.Name) and f.id == "write_marker":
+                    marker_lines.setdefault(fn.qualname, []).append(n.lineno)
+                elif isinstance(f, ast.Attribute) and f.attr == "finish":
+                    owner = f.value
+                    oname = owner.id if isinstance(owner, ast.Name) else \
+                        owner.attr if isinstance(owner, ast.Attribute) else ""
+                    if "journal" in oname.lower() or "jrn" in oname.lower():
+                        finishes.append(n.lineno)
+            marks = marker_lines.get(fn.qualname, ())
+            if marks and finishes:
+                first_mark = min(marks)
+                if any(fin > first_mark for fin in finishes):
+                    self.report(_Anchor(first_mark),
+                                ".done marker written before the journal "
+                                "finish() in this function — the marker "
+                                "claims completion while the journal "
+                                "still says in-flight; finish() first, "
+                                "then seal the marker")
+
+
+#: the sequenced-commit byte sinks (VCT012): every function whose output
+#: bytes reach the committed artifact — the sink committer, the VCF
+#: renderer, the BGZF compressors, and the seam-merge splicer
+_BYTE_SINKS = (
+    ("variantcalling_tpu.pipelines.filter_variants", "_sink_write"),
+    ("variantcalling_tpu.io.vcf", "render_table_bytes_python"),
+    ("variantcalling_tpu.io.bgzf", "compress_block"),
+    ("variantcalling_tpu.io.bgzf", "BgzfChunkCompressor.add"),
+    ("variantcalling_tpu.io.bgzf", "BgzfChunkCompressor.finish"),
+    ("variantcalling_tpu.parallel.rank_plan", "splice_segments"),
+)
+
+#: knob-registry getter methods whose first argument is the knob name
+_KNOB_GETTERS = ("get", "get_bool", "get_int", "get_float", "get_str", "raw")
+
+#: the committed byte-influence contract VCT012 checks against
+_KNOBS_CONTRACT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "knobs_contract.json")
+
+_CONTRACT_CLASSES = ("scoring", "byte_neutral")
+
+
+@register
+class ByteInfluenceTaintChecker(Checker):
+    """VCT012 — byte-influence taint from knob reads to commit sinks.
+
+    Incident class: PR 18 added a whole scoring family behind new knobs;
+    nothing but reviewer diligence noticed that a knob reaching the
+    chunk body changes committed bytes and therefore must ride the
+    ``##vctpu_knobs=`` provenance header. This checker closes that gap
+    mechanically: walk the resolved call graph backward from the
+    sequenced-commit sinks (the ``_sink_write`` committer, the VCF
+    renderer, the BGZF compressors, the seam-merge splicer); any
+    ``knobs.get*("VCTPU_X")`` read inside that backward cone is
+    *byte-reaching* and must be declared in the committed
+    ``knobs_contract.json`` as either
+
+    - ``scoring`` — changes bytes by design, and therefore MUST carry
+      ``in_header=True`` in the registry so runs are reproducible from
+      the artifact alone, or
+    - ``byte_neutral`` — proven not to change committed bytes (cache
+      on/off, pool sizing, observability), with the reason recorded.
+
+    Findings: an unclassified byte-reaching knob; a ``scoring`` knob
+    not in the provenance header; a contract entry for a knob the
+    registry no longer defines (stale contract); an invalid class.
+
+    Scope: the library and tools, tests excluded. In snippet mode the
+    fixture names its fake module after the real sink module (e.g. a
+    sources dict keyed ``variantcalling_tpu/io/bgzf.py``) so the sink
+    resolution works unchanged.
+    """
+
+    code = "VCT012"
+    name = "byte-influence-taint"
+    description = ("knob read reaching a sequenced-commit byte sink "
+                   "without a knobs_contract.json classification, or a "
+                   "scoring knob missing in_header provenance")
+
+    _contract_cache: dict | None = None
+
+    @classmethod
+    def contract(cls) -> dict:
+        if cls._contract_cache is None:
+            try:
+                with open(_KNOBS_CONTRACT_PATH, encoding="utf-8") as fh:
+                    cls._contract_cache = json.load(fh).get("knobs", {})
+            except (OSError, ValueError):
+                cls._contract_cache = {}
+        return cls._contract_cache
+
+    def applies_to(self, path: str) -> bool:
+        return "tests/" not in path and not path.startswith("test")
+
+    def visit_Module(self, node: ast.Module) -> None:
+        index = self.project
+        if index is None:
+            index = project_mod.ProjectIndex.build_single(
+                self.path, node, self.lines)
+        sinks = frozenset(
+            k for k in (index.function_key(mod, qual)
+                        for mod, qual in _BYTE_SINKS) if k is not None)
+        if not sinks:
+            cone: frozenset = frozenset()
+        else:
+            cone = frozenset(index.callers_closure(sinks))
+        info = index.modules.get(self.path)
+        if info is None:
+            return
+        contract = self.contract()
+        if self.path.endswith("knobs.py"):
+            self._registry_rules(node, contract)
+            return
+        for fn in info.functions.values():
+            if fn.key not in cone:
+                continue
+            for n in project_mod._walk_own_scope(fn.node):
+                knob = self._knob_read(info, n)
+                if knob is None:
+                    continue
+                entry = contract.get(knob)
+                if entry is None:
+                    self.report(n, f"knob {knob!r} read on a byte-"
+                                   "reaching path (this function reaches "
+                                   "a sequenced-commit sink) but is not "
+                                   "classified in knobs_contract.json — "
+                                   "declare it 'scoring' (and put it in "
+                                   "the provenance header) or "
+                                   "'byte_neutral' with a reason")
+                elif entry.get("class") not in _CONTRACT_CLASSES:
+                    self.report(n, f"knob {knob!r} has invalid contract "
+                                   f"class {entry.get('class')!r} — must "
+                                   "be 'scoring' or 'byte_neutral'")
+
+    @staticmethod
+    def _knob_read(info, node) -> str | None:
+        """The knob-name literal if ``node`` is a registry read."""
+        if not isinstance(node, ast.Call) or not node.args:
+            return None
+        name = _const_str(node.args[0])
+        if name is None or not name.startswith("VCTPU_"):
+            return None
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _KNOB_GETTERS:
+            owner = f.value
+            if isinstance(owner, ast.Name):
+                oname = owner.id
+                target = info.imports.get(oname) or \
+                    ".".join(info.from_imports.get(oname, ("", "")))
+                if oname == "knobs" or "knobs" in (target or ""):
+                    return name
+        elif isinstance(f, ast.Name) and f.id in _KNOB_GETTERS:
+            src = info.from_imports.get(f.id)
+            if src and "knobs" in src[0]:
+                return name
+        return None
+
+    def _registry_rules(self, node: ast.Module, contract: dict) -> None:
+        """Inside knobs.py: cross-check the registry vs the contract —
+        scoring entries must ride the provenance header, header knobs
+        must not be declared byte_neutral, contract names must exist."""
+        registered: dict[str, tuple[ast.Call, bool]] = {}
+        for n in ast.walk(node):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "_k" and n.args):
+                continue
+            kname = _const_str(n.args[0])
+            if kname is None:
+                continue
+            in_header = any(
+                kw.arg == "in_header"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in n.keywords)
+            registered[kname] = (n, in_header)
+        if not registered:
+            # a knobs.py with zero _k registrations is a test fixture,
+            # not the registry — the contract-vs-registry integrity of
+            # the REAL module is covered by its own regression test
+            return
+        for kname, entry in sorted(contract.items()):
+            if kname not in registered:
+                self.report(_Anchor(1),
+                            f"knobs_contract.json entry {kname!r} names "
+                            "a knob the registry no longer defines — "
+                            "prune the stale contract entry")
+                continue
+            call, in_header = registered[kname]
+            cls_ = entry.get("class")
+            if cls_ == "scoring" and not in_header:
+                self.report(call,
+                            f"knob {kname!r} is contracted 'scoring' "
+                            "(changes committed bytes) but lacks "
+                            "in_header=True — scoring knobs must ride "
+                            "the ##vctpu_knobs= provenance header")
+            elif cls_ == "byte_neutral" and in_header:
+                self.report(call,
+                            f"knob {kname!r} is contracted "
+                            "'byte_neutral' yet rides the provenance "
+                            "header — either it changes bytes (contract "
+                            "it 'scoring') or it should not be in the "
+                            "header")
+
+
 class _Anchor:
     """Minimal node stand-in anchoring a project-level finding to a line."""
 

@@ -66,6 +66,24 @@ _MUTATORS = {"append", "appendleft", "extend", "insert", "add", "update",
 #: constructor spellings of lock-like objects
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
 
+#: run-state filename suffixes owned by the distributed commit protocol
+#: (VCT011): the chunk journal and its crash-safe ``.partial`` staging
+#: twin, elastic span leases (``.lease.g<gen>``), rank ``.done`` markers,
+#: and chunk-cache store entries. A write to one of these outside its
+#: owning module is a protocol bypass — the byte-parity argument of the
+#: partition/pipeline/merge design rests on exactly who may touch them.
+RUN_STATE_SUFFIXES = (".journal", ".partial", ".lease", ".done", ".vcc")
+
+#: lineage tokens that mark an ``os.replace``/``os.rename`` SOURCE as
+#: crash-safe staging (the tmp-sibling idiom): an explicit ``.tmp``
+#: sibling, a ``tempfile.mkstemp`` file, or the journalled ``.partial``
+#: itself (the streaming committer and the elastic handoff both promote
+#: a ``.partial`` — it IS the staging file, torn states are resumable)
+TMP_SOURCE_TOKENS = frozenset({".tmp", "<mkstemp>", ".partial"})
+
+#: every token the suffix-lineage walk tracks through path expressions
+_PATH_TOKENS = RUN_STATE_SUFFIXES + (".tmp",)
+
 
 def _call_name(func: ast.expr) -> str:
     """Last identifier of a call target (``a.b.c`` -> ``c``)."""
@@ -182,6 +200,29 @@ class EntrySite:
 
 
 @dataclass
+class FsEffect:
+    """One filesystem-protocol call site — VCT011's unit of analysis.
+
+    Collected by :meth:`ProjectIndex.fs_effects`: every ``open`` /
+    ``os.open`` / ``os.replace``/``os.rename`` / ``os.remove`` /
+    ``Path.write_*`` call, with the run-state suffix lineage of its path
+    argument resolved through string literals, module-level suffix
+    constants (``JOURNAL_SUFFIX``), local assignments, ``self.attr``
+    bindings, and the return expressions of path-helper functions
+    (``journal_path``/``marker_path``/``lease_path``/...) across the
+    alias closure."""
+
+    module: str  # module path (posix, repo-relative)
+    qualname: str  # enclosing function ("" = module/class level)
+    line: int
+    op: str  # "open" | "os.open" | "replace" | "remove" | "path_write"
+    write: bool  # the call mutates the target path
+    tokens: frozenset  # suffix-lineage tokens of the target path
+    src_tokens: frozenset  # replace only: lineage of the SOURCE path
+    flags: frozenset  # os.open only: O_* flag names
+
+
+@dataclass
 class ModuleInfo:
     """Per-module slice of the index."""
 
@@ -205,6 +246,10 @@ class ModuleInfo:
     module_locks: set[str] = field(default_factory=set)
     #: module-level names bound to queue constructors
     module_queues: set[str] = field(default_factory=set)
+    #: module-level names bound to string constants (suffix constants
+    #: like ``JOURNAL_SUFFIX = ".journal"`` — the fs-effect lineage walk
+    #: resolves them, locally and through imports)
+    module_consts: dict[str, str] = field(default_factory=dict)
 
 
 #: "lock" as a WORD in an identifier, any convention: lock/_lock/rlock/
@@ -284,6 +329,14 @@ class ProjectIndex:
         self._concurrency: list | None = None
         self._reachable: set[tuple[str, str]] | None = None
         self._call_ctx: tuple[set, set] | None = None
+        self._fs_effects: list[FsEffect] | None = None
+        self._ret_tokens: dict[tuple[str, str], frozenset] | None = None
+        self._fs_params: dict[tuple[str, str], dict[str, frozenset]] = {}
+        self._fs_call_cache: dict[int, tuple[str, str] | None] = {}
+        self._fs_assigns: dict[tuple[str, str], list] = {}
+        #: (module path, class, attr) -> suffix tokens of self.attr bindings
+        self._attr_map: dict[tuple[str, str, str], frozenset] = {}
+        self._callers_cache: dict[frozenset, set] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -376,6 +429,9 @@ class ProjectIndex:
                     info.module_queues.add(t.id)
                 elif isinstance(value, (ast.Dict, ast.List, ast.Set, ast.Call)):
                     info.module_state[t.id] = ctor
+                elif isinstance(value, ast.Constant) \
+                        and isinstance(value.value, str):
+                    info.module_consts[t.id] = value.value
             for sub in _branch_bodies(stmt):
                 self._collect_module_bindings(info, sub)
 
@@ -778,6 +834,363 @@ class ProjectIndex:
         return {qual for (mod, qual), sites in self.traced_bodies.items()
                 if mod == module_path
                 and any(s.kind == "shard_map" for s in sites)}
+
+    # -- filesystem-effect index (VCT011) ----------------------------------
+
+    def fs_effects(self) -> list[FsEffect]:
+        """Every filesystem-protocol call site in the project with its
+        path's run-state suffix lineage, cached (see :class:`FsEffect`).
+
+        The lineage walk is deliberately over-approximate (a token
+        anywhere in the expression's reachable literals counts): a
+        checker would rather classify too many sites than let a
+        ``marker_path(seg)`` spelling hide a ``.done`` write."""
+        if self._fs_effects is not None:
+            return self._fs_effects
+        ret = self._fs_prepare()
+        out: list[FsEffect] = []
+        for info in self.modules.values():
+            # module/class-level statements: a pseudo-function over the
+            # tree whose own-scope walk skips real defs (scanned below)
+            pseudo = FunctionInfo(module=info.path, qualname="",
+                                  node=info.tree)
+            out.extend(self._scan_fs(info, pseudo, ret))
+            for fn in info.functions.values():
+                out.extend(self._scan_fs(info, fn, ret))
+        out.sort(key=lambda e: (e.module, e.line, e.op))
+        self._fs_effects = out
+        return out
+
+    def _fs_prepare(self) -> dict[tuple[str, str], frozenset]:
+        """Fixpoint the per-function return-suffix map (``journal_path``
+        -> {".partial"}), the per-parameter lineage map (the committers
+        take the tmp sibling as an argument — ``_commit(part_path, out)``
+        — so argument tokens flow into callee parameters), then the
+        ``self.attr`` binding map. These are the resolution tables the
+        lineage walk consults."""
+        if self._ret_tokens is not None:
+            return self._ret_tokens
+        ret: dict[tuple[str, str], frozenset] = {
+            fn.key: frozenset()
+            for info in self.modules.values()
+            for fn in info.functions.values()}
+        par: dict[tuple[str, str], dict[str, frozenset]] = {
+            k: {} for k in ret}
+        self._fs_params = par
+        # one AST walk per function, reused across fixpoint iterations
+        # (re-walking each scope per iteration dominated the VCT011 wall)
+        shapes: list[tuple[ModuleInfo, FunctionInfo, list, list]] = []
+        for info in self.modules.values():
+            for fn in info.functions.values():
+                returns: list[ast.expr] = []
+                calls: list[ast.Call] = []
+                for n in _walk_own_scope(fn.node):
+                    if isinstance(n, ast.Return) and n.value is not None:
+                        returns.append(n.value)
+                    elif isinstance(n, ast.Call):
+                        calls.append(n)
+                self._fs_assigns[fn.key] = self._collect_assigns(fn)
+                shapes.append((info, fn, returns, calls))
+        changed = True
+        while changed:
+            changed = False
+            for info, fn, returns, calls in shapes:
+                local = self._local_tokens(info, fn, ret, par.get(fn.key))
+                toks = set(ret[fn.key])
+                for v in returns:
+                    toks |= self._expr_tokens(info, v, fn, ret, local)
+                for n in calls:
+                    changed |= self._flow_args(info, fn, n, ret, local, par)
+                fz = frozenset(toks)
+                if fz != ret[fn.key]:
+                    ret[fn.key] = fz
+                    changed = True
+        self._ret_tokens = ret
+        # self.attr bindings (``self.path = journal_path(out)``): one
+        # token set per (class, attr), unioned over every method —
+        # ``open(self.path, "w")`` in another method then classifies
+        for info in self.modules.values():
+            for fn in info.functions.values():
+                if fn.cls is None:
+                    continue
+                local = self._local_tokens(info, fn, ret, par.get(fn.key))
+                for n in _walk_own_scope(fn.node):
+                    if not isinstance(n, ast.Assign):
+                        continue
+                    toks = None
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            if toks is None:
+                                toks = frozenset(self._expr_tokens(
+                                    info, n.value, fn, ret, local))
+                            if toks:
+                                key = (info.path, fn.cls, t.attr)
+                                self._attr_map[key] = \
+                                    self._attr_map.get(key, frozenset()) | toks
+        return ret
+
+    def _flow_args(self, info: ModuleInfo, fn: FunctionInfo, call: ast.Call,
+                   ret: dict, local: dict,
+                   par: dict[tuple[str, str], dict[str, frozenset]]) -> bool:
+        """Union this call's argument lineage into the callee's parameter
+        slots (positional by position past any self/cls, keyword by
+        name). Returns True when anything grew."""
+        key = self._fs_call_key(info, fn, call)
+        if key is None or key not in par:
+            return False
+        target = self.modules[key[0]].functions.get(key[1])
+        if target is None or not isinstance(
+                target.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        params = [a.arg for a in target.node.args.args]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        slots = par[key]
+        grew = False
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred) or i >= len(params):
+                break
+            toks = frozenset(self._expr_tokens(info, arg, fn, ret, local))
+            if toks and not toks <= slots.get(params[i], frozenset()):
+                slots[params[i]] = slots.get(params[i], frozenset()) | toks
+                grew = True
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg not in params:
+                continue
+            toks = frozenset(self._expr_tokens(info, kw.value, fn, ret,
+                                               local))
+            if toks and not toks <= slots.get(kw.arg, frozenset()):
+                slots[kw.arg] = slots.get(kw.arg, frozenset()) | toks
+                grew = True
+        return grew
+
+    def _expr_tokens(self, info: ModuleInfo, expr: ast.expr,
+                     fn: FunctionInfo | None,
+                     ret: dict[tuple[str, str], frozenset],
+                     local: dict[str, set[str]] | None = None) -> set[str]:
+        """Suffix-lineage tokens of one path expression: literals,
+        module-level suffix constants (local or imported), local
+        variables, ``self.attr`` bindings, ``mkstemp`` results, and
+        resolved path-helper return suffixes."""
+        out: set[str] = set()
+        stack: list[ast.AST] = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.Dict, ast.DictComp, ast.ListComp,
+                              ast.SetComp, ast.GeneratorExp)):
+                # containers/comprehensions are OPAQUE to path lineage:
+                # ``return {"out": part}`` returns a record, not a path —
+                # tainting through it made every leg-dict consumer look
+                # like it touched run-state (subscript reads aren't
+                # tracked either, so this loses nothing we could use)
+                continue
+            s: str | None = None
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                s = n.value
+            elif isinstance(n, ast.Name):
+                if local is not None and n.id in local:
+                    out |= local[n.id]
+                    continue
+                s = info.module_consts.get(n.id)
+                if s is None and n.id in info.from_imports:
+                    src_mod, orig = info.from_imports[n.id]
+                    tpath = self._by_modname.get(src_mod)
+                    if tpath is not None:
+                        s = self.modules[tpath].module_consts.get(orig)
+            elif isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+                base = n.value.id
+                if base in ("self", "cls") and fn is not None and fn.cls:
+                    out |= self._attr_map.get(
+                        (info.path, fn.cls, n.attr), frozenset())
+                    continue
+                mod = info.imports.get(base)
+                if mod is None and base in info.from_imports:
+                    sm, orig = info.from_imports[base]
+                    mod = f"{sm}.{orig}"
+                tpath = self._by_modname.get(mod) if mod else None
+                if tpath is not None:
+                    s = self.modules[tpath].module_consts.get(n.attr)
+            elif isinstance(n, ast.Call):
+                if _call_name(n.func) == "mkstemp":
+                    out.add("<mkstemp>")
+                    continue
+                key = self._fs_call_key(info, fn, n)
+                if key is not None:
+                    out |= ret.get(key, frozenset())
+                stack.extend(ast.iter_child_nodes(n))  # args carry lineage too
+                continue
+            if s:
+                out.update(t for t in _PATH_TOKENS if t in s)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _fs_call_key(self, info: ModuleInfo, fn: FunctionInfo | None,
+                     call: ast.Call) -> tuple[str, str] | None:
+        # memoized per call NODE: the fixpoint revisits every call each
+        # iteration and name resolution dominated the VCT011 wall
+        cache = self._fs_call_cache
+        got = cache.get(id(call), False)
+        if got is not False:
+            return got
+        if fn is not None and fn.qualname:
+            key = self._call_target(info, fn, call)
+        elif isinstance(call.func, ast.Name):
+            key = self.resolve_name(info.path, call.func.id)
+        else:
+            dotted = _dotted(call.func)
+            key = self.resolve_name(info.path, dotted) if dotted else None
+        cache[id(call)] = key
+        return key
+
+    @staticmethod
+    def _collect_assigns(fn: FunctionInfo
+                         ) -> list[tuple[list[ast.expr], ast.expr]]:
+        assigns: list[tuple[list[ast.expr], ast.expr]] = []
+        for n in _walk_own_scope(fn.node):
+            if isinstance(n, ast.Assign):
+                assigns.append((list(n.targets), n.value))
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)) \
+                    and n.value is not None:
+                assigns.append(([n.target], n.value))
+        return assigns
+
+    def _local_tokens(self, info: ModuleInfo, fn: FunctionInfo,
+                      ret: dict[tuple[str, str], frozenset],
+                      params: dict[str, frozenset] | None = None
+                      ) -> dict[str, set[str]]:
+        """Per-function local-variable lineage, seeded with the
+        parameter lineage flowed in from call sites (two fixpoint
+        passes, so out-of-document-order walks and chained assignments
+        converge)."""
+        local: dict[str, set[str]] = {
+            name: set(toks) for name, toks in (params or {}).items()}
+        assigns = self._fs_assigns.get(fn.key)
+        if assigns is None:
+            assigns = self._collect_assigns(fn)
+        for _ in range(2):
+            for targets, value in assigns:
+                # element-wise unpack when shapes line up: ``a, b = x, y``
+                # must NOT bleed y's lineage into a (the chaos harness's
+                # ``current, result = cand, r`` tainted every schedule)
+                if len(targets) == 1 \
+                        and isinstance(targets[0], (ast.Tuple, ast.List)) \
+                        and isinstance(value, (ast.Tuple, ast.List)) \
+                        and len(targets[0].elts) == len(value.elts):
+                    for t, v in zip(targets[0].elts, value.elts):
+                        if isinstance(t, ast.Name):
+                            toks = self._expr_tokens(info, v, fn, ret, local)
+                            if toks:
+                                local[t.id] = local.get(t.id, set()) | toks
+                    continue
+                toks = self._expr_tokens(info, value, fn, ret, local)
+                if not toks:
+                    continue
+                stack = list(targets)
+                while stack:
+                    t = stack.pop()
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        stack.extend(t.elts)
+                    elif isinstance(t, ast.Name):
+                        local[t.id] = local.get(t.id, set()) | toks
+        return local
+
+    @staticmethod
+    def _open_mode(call: ast.Call) -> str:
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            return call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return "r"
+
+    @staticmethod
+    def _flag_names(expr: ast.expr) -> frozenset:
+        names: set[str] = set()
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr.startswith("O_"):
+                names.add(n.attr)
+            elif isinstance(n, ast.Name) and n.id.startswith("O_"):
+                names.add(n.id)
+        return frozenset(names)
+
+    def _scan_fs(self, info: ModuleInfo, fn: FunctionInfo,
+                 ret: dict[tuple[str, str], frozenset]) -> list[FsEffect]:
+        local = self._local_tokens(info, fn, ret,
+                                   self._fs_params.get(fn.key))
+        effects: list[FsEffect] = []
+        for n in _walk_own_scope(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            func = n.func
+            op: str | None = None
+            write = False
+            flags: frozenset = frozenset()
+            src_tokens: frozenset = frozenset()
+            target: ast.expr | None = None
+            if isinstance(func, ast.Name) and func.id == "open" and n.args:
+                op, target = "open", n.args[0]
+                write = any(c in self._open_mode(n) for c in "wax+")
+            elif isinstance(func, ast.Attribute):
+                base = func.value
+                base_is_os = isinstance(base, ast.Name) and (
+                    base.id == "os" or info.imports.get(base.id) == "os")
+                if base_is_os and func.attr == "open" and n.args:
+                    op, target = "os.open", n.args[0]
+                    if len(n.args) > 1:
+                        flags = self._flag_names(n.args[1])
+                    write = bool(flags & {"O_WRONLY", "O_RDWR", "O_CREAT",
+                                          "O_TRUNC", "O_APPEND"})
+                elif base_is_os and func.attr in ("replace", "rename") \
+                        and len(n.args) >= 2:
+                    op, target, write = "replace", n.args[1], True
+                    src_tokens = frozenset(self._expr_tokens(
+                        info, n.args[0], fn, ret, local))
+                elif base_is_os and func.attr in ("remove", "unlink") \
+                        and n.args:
+                    op, target, write = "remove", n.args[0], True
+                elif func.attr in ("write_bytes", "write_text"):
+                    op, target, write = "path_write", base, True
+                elif func.attr == "open" and isinstance(base, ast.Name) \
+                        and base.id == "io" and n.args:
+                    op, target = "open", n.args[0]
+                    write = any(c in self._open_mode(n) for c in "wax+")
+            if op is None or target is None:
+                continue
+            toks = frozenset(self._expr_tokens(info, target, fn, ret, local))
+            effects.append(FsEffect(
+                module=info.path, qualname=fn.qualname,
+                line=getattr(n, "lineno", 1), op=op, write=write,
+                tokens=toks, src_tokens=src_tokens, flags=flags))
+        return effects
+
+    # -- byte-influence taint (VCT012) -------------------------------------
+
+    def callers_closure(self, targets: frozenset) -> set[tuple[str, str]]:
+        """Every function key from which ANY of ``targets`` is reachable
+        over the resolved call graph, targets included — the backward
+        walk VCT012 runs from the byte sinks (cached per target set)."""
+        got = self._callers_cache.get(targets)
+        if got is not None:
+            return got
+        rev: dict[tuple[str, str], list[tuple[str, str]]] = {}
+        for info in self.modules.values():
+            for fn in info.functions.values():
+                for callee in fn.calls:
+                    rev.setdefault(callee, []).append(fn.key)
+        seen: set[tuple[str, str]] = set()
+        frontier = list(targets)
+        while frontier:
+            key = frontier.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            frontier.extend(k for k in rev.get(key, ()) if k not in seen)
+        self._callers_cache[targets] = seen
+        return seen
 
     # -- concurrency analysis (VCT010) -------------------------------------
 

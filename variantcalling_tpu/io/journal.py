@@ -71,6 +71,33 @@ def partial_path(out_path: str, token: str | None = None) -> str:
     return f"{base}.{token}" if token else base
 
 
+def open_partial(out_path: str, token: str | None, mode: str = "wb"):
+    """Open the in-flight partial for ``out_path`` — the ONE sanctioned
+    partial-open (VCT011 run-state ownership): the streaming sink's
+    binary handle comes from here, so the ``.partial`` naming scheme has
+    exactly one writer-side spelling and a rename of the scheme cannot
+    leave a pipeline opening the old name."""
+    return open(partial_path(out_path, token), mode)
+
+
+def remove_partial(out_path: str, token: str | None) -> None:
+    """Best-effort removal of the in-flight partial (failure-exit
+    cleanup of a non-resumable run) — the sanctioned spelling of the
+    unlink, so droppings-removal tracks the naming scheme."""
+    try:
+        os.remove(partial_path(out_path, token))
+    except OSError:
+        pass
+
+
+def commit_partial(out_path: str, token: str | None) -> None:
+    """Atomically commit the partial onto its destination. The source
+    is a ``.partial`` sibling by construction (the tmp-sibling idiom
+    VCT011 requires), so an interrupted commit never exposes a torn
+    destination — either the old bytes or the complete new ones."""
+    os.replace(partial_path(out_path, token), out_path)
+
+
 def list_partials(out_path: str) -> list[str]:
     """Every partial next to ``out_path`` — the legacy fixed name plus
     all unique-suffix partials. The ONE spelling of that glob, shared by

@@ -160,7 +160,11 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "divert deterministically-failing chunks to a <out>.quarantine "
        "sidecar instead of failing the run (OPT-IN: changes which "
        "records reach the output; default fails loudly — "
-       "docs/robustness.md recovery ladder)"),
+       "docs/robustness.md recovery ladder)",
+       # changes WHICH records reach the output => scoring-class
+       # (knobs_contract.json): an artifact produced under quarantine
+       # must say so in its ##vctpu_knobs= provenance header
+       in_header=True),
     _k("VCTPU_RESUME", "bool", True,
        "resume interrupted plain-text runs from the chunk journal"),
     _k("VCTPU_RESUME_VERIFY", "enum", "last",

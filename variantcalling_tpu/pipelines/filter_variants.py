@@ -1706,7 +1706,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             # committer writes them raw (and rewindably, so transient
             # write errors are retryable — the old in-consumer
             # BgzfWriter could not rewind)
-            sink = open(part_path, "wb")
+            sink = journal_mod.open_partial(out_path, part_token, "wb")
             if obs.active():
                 obs.event("journal", "resume_decision", outcome="disabled",
                           reason="gz output: BGZF block state does not "
@@ -1718,7 +1718,8 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             part_token = resume.partial_token  # re-tokened + claimed by try_resume
             part_path = journal_mod.partial_path(out_path, part_token)
             reader.skip(resume.chunks)
-            sink = open(part_path, "ab")  # truncated to the watermark already
+            # truncated to the watermark already
+            sink = journal_mod.open_partial(out_path, part_token, "ab")
             journal = journal_mod.ChunkJournal(out_path)
             journal.reopen()
             logger.info("streaming resume: %d chunks (%d records) already "
@@ -1732,7 +1733,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             part_token = journal_mod.new_partial_token()
             journal_mod.claim_token(part_token)
             part_path = journal_mod.partial_path(out_path, part_token)
-            sink = open(part_path, "wb")
+            sink = journal_mod.open_partial(out_path, part_token, "wb")
             if resume_enabled:
                 journal = journal_mod.ChunkJournal(out_path)
                 journal.begin(dict(meta, partial=part_token))
@@ -2044,10 +2045,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
             if journal is None:
                 # non-resumable run: never leave droppings next to the
                 # destination (the destination itself was never touched)
-                try:
-                    os.remove(part_path)
-                except OSError:
-                    pass
+                journal_mod.remove_partial(out_path, part_token)
             else:
                 logger.info("streaming run failed after %d chunks; partial "
                             "output + journal kept for resume at %s",
@@ -2060,7 +2058,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         # injected ENOSPC is cleanly retryable and a persistent one
         # leaves journal + partial behind for resume
         faults.check("io.commit")
-        os.replace(part_path, out_path)  # vctpu-lint: disable=VCT008 — THE one sanctioned atomic commit
+        journal_mod.commit_partial(out_path, part_token)  # vctpu-lint: disable=VCT008 — THE one sanctioned atomic commit
 
     # the journal outlives the commit attempt (recovery ladder): an
     # ENOSPC on the rename itself must leave journal + partial behind so
@@ -2072,10 +2070,7 @@ def _run_streaming_impl(args, model, fasta: FastaReader, annotate, blacklist,
         journal_mod.release_token(part_token)
         if journal is None:
             # non-resumable run: never leave droppings at the destination
-            try:
-                os.remove(part_path)
-            except OSError:
-                pass
+            journal_mod.remove_partial(out_path, part_token)
         else:
             logger.info("output commit failed after %d chunks; partial "
                         "output + journal kept for resume at %s",

@@ -223,7 +223,12 @@ def ingest_streaming(args):
     if cache_dir:
         os.makedirs(cache_dir, exist_ok=True)
         meta_path = os.path.join(cache_dir, "ingest.json")
-        journal = os.path.join(cache_dir, "ingest.journal")
+        # deliberately NOT named *.journal: the run-state suffixes
+        # (.journal/.partial/...) belong to the io.journal resume
+        # protocol (VCT011) — this manifest is the train-side ingest
+        # cache's own format, and squatting on the suffix would invite
+        # the recovery scan to misread it
+        journal = os.path.join(cache_dir, "ingest.manifest")
         stale = None
         if os.path.exists(meta_path):
             with open(meta_path, encoding="utf-8") as fh:
@@ -237,7 +242,7 @@ def ingest_streaming(args):
             for name in os.listdir(cache_dir):
                 os.unlink(os.path.join(cache_dir, name))
         if not os.path.exists(meta_path):
-            tmp = f"{meta_path}.partial"
+            tmp = f"{meta_path}.tmp"
             with open(tmp, "w", encoding="utf-8") as fh:
                 json.dump({"fingerprint": fp, "identity": ident}, fh)
             os.replace(tmp, meta_path)  # vctpu-lint: disable=VCT008 — ingest-cache metadata (train side), not a pipeline output commit
@@ -265,7 +270,7 @@ def ingest_streaming(args):
             if cache_dir:
                 fname = f"chunk_{i:06d}.npz"
                 path = os.path.join(cache_dir, fname)
-                tmp = f"{path}.partial.npz"
+                tmp = f"{path}.tmp.npz"
                 np.savez(tmp, x=x, names=np.asarray(unit_names), label=label,
                          weight=weight)
                 os.replace(tmp, path)  # vctpu-lint: disable=VCT008 — journaled ingest-cache chunk (train side), not a pipeline output commit

@@ -36,7 +36,7 @@ def cloud_sync(uri: str, cache_dir: str = DEFAULT_CACHE, force: bool = False) ->
     if os.path.exists(target) and not force:
         return target
     os.makedirs(os.path.dirname(target), exist_ok=True)
-    tmp = target + ".part"
+    tmp = target + ".tmp"
     if uri.startswith("gs://"):
         cmds = [["gsutil", "-q", "cp", uri, tmp], ["gcloud", "storage", "cp", uri, tmp]]
     else:
