@@ -1490,6 +1490,123 @@ def scaleout_phase(fixture_dir: str) -> dict:
     }
 
 
+def fabric_phase(fixture_dir: str) -> dict:
+    """Serving-fabric economics (docs/serving_fabric.md): the 1M e2e
+    fixture filtered through a real 1-router + 2-backend fleet
+    (``tools/podrun.start_fabric`` — separate processes, streamed
+    request bodies), warm both ways:
+
+    - ``single_s`` — a warm request pinned to ONE span (``ranks=1``:
+      same router, same transport, one backend does all the work);
+    - ``fabric_s`` — the same request fanned out over both backends
+      (``ranks=2``) with the seam merge on the response path;
+    - ``fanout_over_single`` — the headline ratio (>1 means the fan-out
+      pays). CAPTURE NOTE (this 2-core container): both backends share
+      the single-span leg's two cores, so the committed ratio prices
+      fan-out STRUCTURE (span slicing + second stream + seam merge)
+      against ~zero spare cores — near-2x needs real spare cores, and
+      the gate's band admits <1 here exactly like scaleout's.
+
+    The sha256 digest tripwire covers all THREE legs — batch CLI,
+    ranks=1, ranks=2 — normalized modulo ``##vctpu_*`` headers;
+    a mismatch lands as ``digest_state="mismatch"`` and hard-fails in
+    tools/bench_gate.py, never as a quietly-committed number.
+    """
+    import hashlib
+    import pickle
+
+    from variantcalling_tpu.synthetic import synthetic_forest
+
+    vcf_in = os.path.join(fixture_dir, "calls.vcf")
+    ref_fa = os.path.join(fixture_dir, "ref.fa")
+    model_pkl = os.path.join(fixture_dir, "fabric_model.pkl")
+    with open(model_pkl, "wb") as fh:
+        pickle.dump({"m": synthetic_forest(np.random.default_rng(0),
+                                           n_trees=N_TREES, depth=DEPTH)},
+                    fh)
+
+    from tools.chaoshunt.harness import normalize_output as normalize
+
+    # batch CLI reference leg (fresh subprocess, the parity anchor)
+    cli_out = os.path.join(fixture_dir, "fabric_cli.vcf")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("VCTPU_RANK", "VCTPU_NUM_PROCESSES")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(  # noqa: S603
+        [sys.executable, "-m", "variantcalling_tpu",
+         "filter_variants_pipeline", "--input_file", vcf_in,
+         "--model_file", model_pkl, "--model_name", "m",
+         "--reference_file", ref_fa, "--output_file", cli_out,
+         "--backend", "cpu"],
+        env=env, cwd=_REPO, timeout=240, capture_output=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"fabric bench: batch CLI leg failed "
+                           f"(rc={proc.returncode}): "
+                           f"{proc.stderr.decode()[-400:]}")
+    digests = {"cli": hashlib.sha256(
+        normalize(open(cli_out, "rb").read())).hexdigest()}
+
+    from tools import podrun
+    from variantcalling_tpu.serve import transport
+
+    base = os.path.join(fixture_dir, "fabric")
+    h = podrun.start_fabric(base, n_backends=2, env=env)
+    outs: list[str] = [cli_out]
+
+    def request(out: str, ranks: int) -> float:
+        outs.append(out)
+        params = {"model": model_pkl, "model_name": "m",
+                  "reference": ref_fa,
+                  "output_name": os.path.basename(out),
+                  "ranks": ranks, "deadline_s": 180.0}
+        ts = time.perf_counter()
+        code, payload = transport.client_filter(
+            h.router_address, params, vcf_in, out, timeout=200.0)
+        wall = time.perf_counter() - ts
+        if code != 200:
+            raise RuntimeError(f"fabric bench: ranks={ranks} request "
+                               f"failed ({code}): {payload}")
+        return wall
+
+    try:
+        # warm both backends + first-request compile OUTSIDE the
+        # measured window (residency is serve_phase's story; this
+        # phase prices the fan-out)
+        request(os.path.join(fixture_dir, "fabric_w.vcf"), 2)
+        out1 = os.path.join(fixture_dir, "fabric_n1.vcf")
+        out2 = os.path.join(fixture_dir, "fabric_n2.vcf")
+        single_s = min(request(out1, 1) for _ in range(2))
+        fabric_s = min(request(out2, 2) for _ in range(2))
+        digests["n1"] = hashlib.sha256(
+            normalize(open(out1, "rb").read())).hexdigest()
+        digests["n2"] = hashlib.sha256(
+            normalize(open(out2, "rb").read())).hexdigest()
+    finally:
+        report = podrun.stop_fabric(h)
+        for p in outs:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    leaked = report["router"].get("leaked") or []
+    if report["router"].get("rc") != 0 or leaked:
+        raise RuntimeError(f"fabric bench: router drain failed: {report}")
+
+    match = len(set(digests.values())) == 1
+    return {
+        "n": E2E_N,
+        "backends": 2,
+        "single_s": round(single_s, 3),
+        "fabric_s": round(fabric_s, 3),
+        "fanout_over_single": round(single_s / fabric_s, 3),
+        "vps": {"n1": round(E2E_N / single_s), "n2": round(E2E_N / fabric_s)},
+        "digest_state": "match" if match else "mismatch",
+        "bytes_identical": 1 if match else 0,
+        "digest_sha256": digests["cli"],
+        "engine": "native",
+    }
+
+
 def straggler_phase(fixture_dir: str) -> dict:
     """Straggler-rescue economics (docs/scaleout.md "Elastic
     membership"): the 1M e2e fixture through a clean 2-worker elastic
@@ -2217,6 +2334,12 @@ def child_main(fixture_dir: str) -> None:
         # across legs; parity + no-regression on this 2-core box
         phase("scaleout", lambda: scaleout_phase(fixture_dir),
               min_remaining=110)
+    if want("fabric") and cpu:
+        # serving fabric (docs/serving_fabric.md): warm ranks=1 vs
+        # ranks=2 requests through a real 1-router + 2-backend fleet,
+        # three-leg sha256 digest tripwire vs the batch CLI
+        phase("fabric", lambda: fabric_phase(fixture_dir),
+              min_remaining=115)
     if want("straggler") and cpu:
         # elastic straggler rescue (docs/scaleout.md "Elastic
         # membership"): clean 2-worker elastic pod vs one with a
@@ -2497,9 +2620,9 @@ def main(tpu_only: bool = False) -> None:
         out["device"] = child.get("device", "?")
         out["attempt"] = label
         for k in ("hot_small", "hot", "io", "mesh", "e2e", "obs", "serve",
-                  "scaleout", "straggler", "cache", "dan", "e2e_5m",
-                  "genome3g", "scaling", "skipped", "phase_errors",
-                  "incomplete"):
+                  "scaleout", "fabric", "straggler", "cache", "dan",
+                  "e2e_5m", "genome3g", "scaling", "skipped",
+                  "phase_errors", "incomplete"):
             if k in child:
                 out[k] = child[k]
         def attach_baseline(key: str, baseline_fn, base_key: str, ratio) -> None:

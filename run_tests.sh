@@ -97,6 +97,27 @@ if [ "${VCTPU_SCALEOUT:-0}" != "0" ]; then
   }
 fi
 
+# -- opt-in serving-fabric smoke stage (docs/serving_fabric.md) ------------
+# VCTPU_FABRIC=1: the end-to-end fabric tests against a real subprocess
+# fleet (tools/podrun.start_fabric: 1 router + 2 resident backends,
+# streamed bodies, sha256 parity vs the batch CLI, leak-free drain)
+# plus a 2-seed backend_kill chaos campaign (SIGKILL a registered
+# backend mid-request — re-span or shed, never hang). Bounded (~2 min);
+# larger sweeps: python -m tools.loadhunt --campaign backend_kill --seeds 10.
+if [ "${VCTPU_FABRIC:-0}" != "0" ]; then
+  echo "fabric smoke stage: pytest tests/system/test_fabric_fleet.py + loadhunt --campaign backend_kill"
+  env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m pytest tests/system/test_fabric_fleet.py -q -p no:cacheprovider || {
+    echo "fabric fleet smoke failed — the router tier is broken" >&2
+    exit 1
+  }
+  env PYTHONPATH= JAX_PLATFORMS=cpu \
+    python -m tools.loadhunt --campaign backend_kill --seed-list 0,1 --records 1500 --json || {
+    echo "backend_kill campaign found an invariant violation" >&2
+    exit 1
+  }
+fi
+
 # -- tier-0 jaxpr audit stage (docs/static_analysis.md) --------------------
 # Trace every registered scoring program (forest strategies x
 # shard_program at dp in {1,2} + the coverage reduce kernels) with

@@ -238,6 +238,31 @@ def test_session_write_failure_degrades_never_raises(tmp_path):
     assert store.stats()["entries"] == 1
 
 
+def test_open_session_rank_agnostic_two_rank_counts(monkeypatch):
+    """The serving-fabric warm-hit property (docs/serving_fabric.md): a
+    session opened as rank 0 of 1 and one opened as rank 1 of 2 share
+    the fingerprint, the content keys, and the store — so a span
+    rendered under one partitioning warm-hits under the other, which is
+    what lets the router's contig-aware re-cut (and an elastic re-span
+    after backend death) reuse a dead predecessor's work."""
+    monkeypatch.setenv("VCTPU_CACHE", "1")
+    cfg = {"engine": "native", "model_sig": "m" * 16}
+    one = chunk_cache.open_session(dict(cfg, ranks=1), rank=0, ranks=1)
+    two = chunk_cache.open_session(dict(cfg, ranks=2, span=(0, 512)),
+                                   rank=1, ranks=2)
+    assert one is not None and two is not None
+    assert one.fingerprint == two.fingerprint
+    raw = b"chr1\t100\t.\tA\tT\t.\tPASS\t.\n" * 64
+    key = one.key_of(raw)
+    assert two.key_of(raw) == key
+    one.stage(0, key, b"rendered-bytes", 64, 31)
+    one.publish_up_to(0)
+    one.finish()
+    assert two.get(key) == (b"rendered-bytes", 64, 31)
+    assert two.stats()["hits"] == 1 and two.stats()["misses"] == 0
+    two.finish()
+
+
 # ---------------------------------------------------------------------------
 # streaming byte parity: cold / warm / mixed / off, across layouts+engines
 # ---------------------------------------------------------------------------

@@ -509,6 +509,39 @@ def test_single_rank_log_unchanged_by_merge(tmp_path):
     assert events == export_mod.read_events(base)
 
 
+def test_fabric_backend_siblings_merge_into_one_timeline(tmp_path, capsys):
+    """The serving-fabric spelling of the sibling merge (ISSUE 20): the
+    router's log is the base path, each backend H wrote ``.backendH``
+    next to it (tools/podrun --fabric); ``vctpu obs tail``/``summary``/
+    ``prom`` read them as ONE timeline with the tiers labeled apart."""
+    base = _write_rank_log(tmp_path, "fabric.jsonl", tool="fabric",
+                           records=100)
+    _write_rank_log(tmp_path, "fabric.jsonl.backend1", tool="fabric",
+                    records=60)
+    _write_rank_log(tmp_path, "fabric.jsonl.backend2", tool="fabric",
+                    records=40)
+
+    events = export_mod.read_run(base)
+    assert {e.get("backend") for e in events} == {0, 1, 2}
+    assert {e["pid"] for e in events} == {0, 1, 2}
+    trace = export_mod.to_chrome_trace(events)
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["name"] == "process_name"}
+    assert names == {"fabric (router)", "fabric (backend 1)",
+                     "fabric (backend 2)"}
+    ts = [e["ts"] for e in trace["traceEvents"]]
+    assert ts == sorted(ts)
+
+    # every tier's work lands in one summary, and the CLI reads the
+    # merged run transparently (tail/summary/prom share this loader)
+    s = export_mod.summarize(events)
+    assert s["stages"]["score"]["count"] == 3
+    assert s["throughput"]["records"] == 200
+    assert obs_cli.run(["summary", "--json", base]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["throughput"]["records"] == 200
+
+
 # ---------------------------------------------------------------------------
 # atexit / SIGTERM flush (satellite): no silently truncated streams
 # ---------------------------------------------------------------------------

@@ -215,6 +215,19 @@ METRICS: tuple[tuple[str, str, float], ...] = (
     ("straggler.straggler_over_clean", "budget", 1.5),
     ("straggler.steals", "nonzero", 0.0),
     ("straggler.bytes_identical", "nonzero", 0.0),
+    # -- serving fabric (docs/serving_fabric.md): warm ranks=1 vs
+    #    ranks=2 requests through a real 1-router + 2-backend fleet
+    #    (separate processes, streamed bodies, seam merge on the
+    #    response path). On this 2-core container both backends share
+    #    the single-span leg's cores, so fanout_over_single prices
+    #    fan-out STRUCTURE, not a speedup (the honest capture note in
+    #    bench.py) — the wide band catches the structure regressing
+    #    (spans serializing, a quadratic merge) without gating the
+    #    box's mood or demanding >1 on saturated cores. bytes_identical
+    #    is the presence twin of the fabric.digest_state hard-fail:
+    #    router-merged responses must reproduce the batch CLI's bytes.
+    ("fabric.fanout_over_single", "higher", 0.40),
+    ("fabric.bytes_identical", "nonzero", 0.0),
     # -- content-addressed chunk cache (docs/caching.md): three fresh
     #    CLI legs over one on-disk store. warm_hit_over_cold is the
     #    headline — a fully-warm re-filter replays rendered bytes
@@ -265,6 +278,11 @@ FORBIDDEN_VALUES: tuple[tuple[str, str], ...] = (
     # adopted journal prefix) must reproduce the clean elastic pod's
     # bytes modulo ##vctpu_* headers — a seam error lands HERE, hard
     ("straggler.digest_state", "mismatch"),
+    # the fabric digest tripwire: the router's seam-merged response —
+    # whether one span or two, against either backend — must reproduce
+    # the batch CLI's bytes modulo ##vctpu_* headers; a fan-out seam
+    # error fails HERE, hard, never as a silently-committed ratio
+    ("fabric.digest_state", "mismatch"),
     # the cache digest tripwire: warm-hit and mixed hit/miss replays
     # must reproduce the cold run's bytes modulo ##vctpu_* headers —
     # a cache that serves stale or torn bodies fails HERE, hard, never
@@ -452,7 +470,8 @@ def run_fresh_bench(timeout_s: int = 900) -> dict | None:
     that its own budget logic would have finished self-contained."""
     env = dict(os.environ)
     env["VCTPU_BENCH_PHASES"] = \
-        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,straggler,cache,dan"
+        "hot_small,hot,io,mesh,e2e,obs,serve,scaleout,fabric,straggler," \
+        "cache,dan"
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.pop("PYTHONPATH", None)  # no PJRT sitecustomize in the gate stage
     try:

@@ -20,6 +20,11 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m tools.loadhunt",
         description="closed-loop load×chaos campaigns for vctpu serve "
                     "(docs/serving.md)")
+    ap.add_argument("--campaign", choices=("serve", "backend_kill"),
+                    default="serve",
+                    help="serve: load×chaos against one daemon (default); "
+                         "backend_kill: SIGKILL a registered fabric "
+                         "backend mid-request (docs/serving_fabric.md)")
     ap.add_argument("--seeds", type=int, default=10,
                     help="run seeds 0..N-1 (default 10, the CI smoke)")
     ap.add_argument("--seed-list", default=None,
@@ -51,9 +56,13 @@ def main(argv: list[str] | None = None) -> int:
         if not seeds:
             print("loadhunt: no seeds", file=sys.stderr)
             return 2
-        report = harness.run_campaign(seeds, workdir=args.workdir,
-                                      records=args.records,
-                                      shrink=not args.no_shrink)
+        if args.campaign == "backend_kill":
+            report = harness.run_backend_kill_campaign(
+                seeds, workdir=args.workdir, records=args.records)
+        else:
+            report = harness.run_campaign(seeds, workdir=args.workdir,
+                                          records=args.records,
+                                          shrink=not args.no_shrink)
     except (OSError, RuntimeError, ValueError) as e:
         print(f"loadhunt: {e}", file=sys.stderr)
         return 2

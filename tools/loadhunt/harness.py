@@ -598,6 +598,303 @@ def run_campaign(seeds: list[int], workdir: str | None = None,
     return report
 
 
+# ---------------------------------------------------------------------------
+# backend_kill — the serving-fabric fault class (docs/serving_fabric.md)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KillSchedule:
+    """One backend_kill draw: N concurrent clean clients through the
+    fabric router while one registered backend is SIGKILLed mid-flight."""
+
+    seed: int
+    n_clients: int
+    kill_backend: int     # 1-based fabric id
+    kill_after_s: float   # SIGKILL delay after the clients launch
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_json(d: dict) -> "KillSchedule":
+        return KillSchedule(seed=int(d.get("seed", 0)),
+                            n_clients=int(d.get("n_clients", 2)),
+                            kill_backend=int(d.get("kill_backend", 1)),
+                            kill_after_s=float(d.get("kill_after_s", 0.2)))
+
+    def describe(self) -> str:
+        return (f"backend_kill n={self.n_clients} "
+                f"kill=backend{self.kill_backend}@{self.kill_after_s}s")
+
+
+def draw_backend_kill_schedule(seed: int) -> KillSchedule:
+    rng = random.Random(seed)
+    return KillSchedule(seed=seed, n_clients=rng.randint(2, 4),
+                        kill_backend=rng.choice((1, 2)),
+                        kill_after_s=round(rng.uniform(0.05, 0.8), 2))
+
+
+def _fabric_env() -> dict:
+    """The fleet's env: inherited VCTPU_* stripped (same hygiene as
+    ``_daemon_env``), fast heartbeats so the router notices the SIGKILL
+    within the schedule, small chunks so requests span several of them."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("VCTPU_") and k not in ("XLA_FLAGS",
+                                                       "PYTHONPATH")}
+    env.update(
+        PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        VCTPU_STREAM_CHUNK_BYTES=str(1 << 14),
+        VCTPU_IO_BACKOFF_S="0.01",
+        VCTPU_SERVE_DRAIN_S="30",
+        VCTPU_FABRIC_HEARTBEAT_S="0.2",
+        VCTPU_FABRIC_DEAD_AFTER="2",
+    )
+    return env
+
+
+def run_fabric_client(address: str, idx: int, fx: Fixtures,
+                      out: str) -> dict:
+    """One streaming client through the router front door (upload +
+    download over ``serve/transport`` — no host paths cross the wire)."""
+    from variantcalling_tpu.serve import transport
+
+    params = {"model": fx.model, "model_name": "m", "reference": fx.ref,
+              "output_name": os.path.basename(out), "deadline_s": 60.0}
+    t0 = time.time()
+    try:
+        code, payload = transport.client_filter(
+            address, params, fx.input_vcf, out,
+            timeout=CLIENT_TIMEOUT_S)
+    except (OSError, ValueError) as e:
+        wall = time.time() - t0
+        hung = wall >= CLIENT_TIMEOUT_S - 2
+        return {"idx": idx, "fault": "clean", "code": None,
+                "status": (f"hung: {type(e).__name__}" if hung
+                           else f"transport: {type(e).__name__}: {e}"),
+                "wall_s": round(wall, 2), "hung": hung,
+                "disconnect": False}
+    return {"idx": idx, "fault": "clean", "code": code,
+            "status": payload.get("status"),
+            "wall_s": round(time.time() - t0, 2), "hung": False,
+            "disconnect": False}
+
+
+#: error statuses a backend_kill client may legitimately see — each is
+#: DISTINCT and retryable; anything else (or a hang, or torn ok-bytes)
+#: is a violation
+_KILL_OK_ERRORS = ("backend_lost", "shed", "draining", "deadline",
+                   "cancelled")
+
+
+def _fabric_membership_actions(obs_log: str) -> list[str]:
+    actions = []
+    try:
+        with open(obs_log, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("kind") == "membership":
+                    actions.append(ev.get("action"))
+    except OSError:
+        pass
+    return actions
+
+
+def check_kill_schedule(sched: KillSchedule, results: list[dict],
+                        fx: Fixtures, outs: dict[int, str],
+                        router_alive: bool, report: dict,
+                        membership: list[str]) -> list[str]:
+    """The backend_kill invariants: the router survives and notices the
+    death (membership event), every client gets ok-with-identical-bytes
+    or a distinct retryable error — never a hang, never torn bytes —
+    and the surviving tiers drain clean with no leaked threads."""
+    v: list[str] = []
+    if not router_alive:
+        v.append("router: process EXITED during the schedule")
+    if "dead" not in membership:
+        v.append(f"router: backend {sched.kill_backend} was SIGKILLed but "
+                 "no membership 'dead' event was recorded")
+    for r in results:
+        name = f"client {r['idx']}"
+        if r["hung"]:
+            v.append(f"{name}: HUNG past the {CLIENT_TIMEOUT_S}s client "
+                     "bound (never-hang violated)")
+            continue
+        out = outs[r["idx"]]
+        if r["code"] == 200 and r["status"] == "ok":
+            if not os.path.exists(out):
+                v.append(f"{name}: ok response but no destination file")
+            elif normalize_output(open(out, "rb").read()) \
+                    != fx.reference_norm:
+                v.append(f"{name}: ok response but bytes differ from the "
+                         "cold-CLI reference (torn by the kill)")
+        elif r["status"] in _KILL_OK_ERRORS:
+            if os.path.exists(out):
+                v.append(f"{name}: error response "
+                         f"({r['status']}) left a destination file")
+        else:
+            v.append(f"{name}: expected ok or a distinct retryable error, "
+                     f"got {r['status']!r} (code {r['code']})")
+    router_doc = report.get("router") or {}
+    if router_doc.get("rc") != 0:
+        v.append(f"drain: router exited rc={router_doc.get('rc')} (want 0)")
+    if router_doc.get("leaked"):
+        v.append(f"drain: router leaked threads {router_doc['leaked']}")
+    for bid, doc in (report.get("backends") or {}).items():
+        doc = doc or {}
+        if int(bid) == sched.kill_backend:
+            if doc.get("rc") == 0:
+                v.append(f"backend {bid}: SIGKILLed but exited rc=0 "
+                         "(the kill never landed)")
+            continue
+        if doc.get("rc") != 0:
+            v.append(f"drain: surviving backend {bid} exited "
+                     f"rc={doc.get('rc')} (want 0)")
+        if doc.get("leaked"):
+            v.append(f"drain: surviving backend {bid} leaked threads "
+                     f"{doc['leaked']}")
+    return v
+
+
+def _wait_backend_dead(address: str, backend_id: int,
+                       timeout_s: float = 10.0) -> bool:
+    """Poll the router registry until it marks ``backend_id`` dead
+    (bounded).  Detection takes heartbeat_s x dead_after (~0.4s at the
+    campaign's settings); returning False just means the invariant
+    check will report the missing membership event."""
+    from variantcalling_tpu.serve import transport
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            with transport.request(address, "GET", "/v1/fabric/backends",
+                                   timeout=5.0) as resp:
+                doc = resp.json() if resp.status == 200 else {}
+        except (transport.TransportError, OSError, ValueError):
+            return False  # router itself gone; the alive check catches it
+        for be in doc.get("backends", []):
+            if be.get("id") == backend_id and not be.get("alive", True):
+                return True
+        time.sleep(0.1)
+    return False
+
+
+def run_kill_schedule(sched: KillSchedule, fx: Fixtures,
+                      workdir: str) -> dict:
+    """One backend_kill schedule end to end: boot the 2-backend fabric
+    (tools/podrun), fire the clients, SIGKILL the drawn backend
+    mid-flight, drain, check every invariant."""
+    import threading
+
+    from tools import podrun
+
+    base = os.path.join(workdir, f"kseed{sched.seed}")
+    outs = {i: os.path.join(workdir, f"kseed{sched.seed}_c{i}.vcf")
+            for i in range(sched.n_clients)}
+    for out in outs.values():
+        _remove_outputs(out)
+    # slow every chunk body a little (the overload-mode spelling) so
+    # requests are actually IN FLIGHT when the SIGKILL lands — a warm
+    # backend otherwise answers in milliseconds and the kill tests
+    # nothing but the heartbeat
+    h = podrun.start_fabric(
+        base, n_backends=2, env=_fabric_env(),
+        backend_env={"VCTPU_FAULTS": "pipeline.stage_hang:0@0.15",
+                     "VCTPU_STAGE_TIMEOUT_S": "5"})
+    results: list[dict] = []
+    lock = threading.Lock()
+    try:
+        # warm the fleet so the kill lands on steady-state requests,
+        # not the first-compile cliff
+        warm_out = os.path.join(workdir, f"kseed{sched.seed}_warm.vcf")
+        try:
+            run_fabric_client(h.router_address, -1, fx, warm_out)
+        finally:
+            _remove_outputs(warm_out)
+
+        def client(i: int) -> None:
+            r = run_fabric_client(h.router_address, i, fx, outs[i])
+            with lock:
+                results.append(r)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"loadhunt-k{i}", daemon=True)
+                   for i in range(sched.n_clients)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(sched.kill_after_s)
+        victim = h.backends[sched.kill_backend - 1]
+        if victim.poll() is None:
+            victim.send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=max(10.0,
+                               SCHEDULE_TIMEOUT_S - (time.time() - t0)))
+        # the membership invariant needs the heartbeat (period x
+        # dead_after ~ 0.4s) to actually observe the corpse before we
+        # drain the fleet — wait for the router to mark it dead
+        _wait_backend_dead(h.router_address, sched.kill_backend)
+        for t in threads:
+            if t.is_alive():
+                with lock:
+                    results.append({"idx": -99, "fault": "harness",
+                                    "code": None, "status": "client thread "
+                                    "never returned", "wall_s": 0.0,
+                                    "hung": True, "disconnect": False})
+                break
+        router_alive = h.router.poll() is None
+    finally:
+        report = podrun.stop_fabric(h)
+    membership = _fabric_membership_actions(base + ".obs.jsonl")
+    violations = check_kill_schedule(
+        sched, sorted(results, key=lambda r: r["idx"]), fx, outs,
+        router_alive, report, membership)
+    for out in outs.values():
+        _remove_outputs(out)
+    return {"schedule": sched.to_json(), "describe": sched.describe(),
+            "results": sorted(results, key=lambda r: r["idx"]),
+            "membership": membership, "violations": violations}
+
+
+def run_backend_kill_campaign(seeds: list[int], workdir: str | None = None,
+                              records: int = 2000, log=print) -> dict:
+    """The fabric chaos campaign: one backend_kill schedule per seed.
+    Same report shape as :func:`run_campaign` (no shrink stage — the
+    schedule is already two knobs: client count and kill delay)."""
+    t0 = time.time()
+    owns_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="loadhunt-bk-")
+    os.makedirs(workdir, exist_ok=True)
+    fx = build_fixtures(workdir, records=records)
+    results = []
+    for seed in seeds:
+        sched = draw_backend_kill_schedule(seed)
+        r = run_kill_schedule(sched, fx, workdir)
+        results.append(r)
+        flag = "VIOLATION" if r["violations"] else "ok"
+        log(f"loadhunt seed {seed:>4} [{sched.describe()}] -> {flag}")
+        for msg in r["violations"]:
+            log(f"  ! {msg}")
+    n_viol = sum(1 for r in results if r["violations"])
+    report = {
+        "seeds": len(seeds),
+        "violating_schedules": n_viol,
+        "schedules": results,
+        "shrunk": None,
+        "repro": None,
+        "workdir": workdir if (n_viol or not owns_workdir) else None,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    if owns_workdir and not n_viol:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
 def replay(repro_path: str, workdir: str | None = None, log=print) -> dict:
     """Re-run a shrunk repro JSON (fresh fixtures + daemon)."""
     with open(repro_path, encoding="utf-8") as fh:

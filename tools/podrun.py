@@ -45,6 +45,16 @@ A ``<out>.podrun.json`` state file maps workers -> pids while the pod
 runs (written atomically; removed on success) — operators and the chaos
 harness use it to find a specific worker. Elastic state files carry
 ``"mode": "elastic"`` and per-worker ``span``/``gen`` instead of ranks.
+
+``--fabric`` launches the SERVING fabric instead of a batch pod
+(docs/serving_fabric.md): ``--ranks`` backend daemons (``vctpu serve
+--fabric-backend``, each on an ephemeral port) plus one router
+(``vctpu serve --fabric``) fronting them, then stays resident until
+SIGTERM/SIGINT and drains the fleet router-first. Obs logs land in the
+sibling shape ``vctpu obs`` merges into one timeline: the router at
+``<base>.obs.jsonl``, backend H at ``<base>.obs.jsonl.backendH``. The
+bench ``fabric`` phase and the loadhunt ``backend_kill`` campaign use
+the importable :func:`start_fabric`/:func:`stop_fabric` pair directly.
 """
 
 from __future__ import annotations
@@ -95,6 +105,187 @@ def _flag_of(fwd: list[str], flag: str) -> str | None:
 
 def _output_file_of(fwd: list[str]) -> str | None:
     return _flag_of(fwd, "--output_file")
+
+
+class FabricHandle:
+    """A running local serving fabric (``start_fabric``): the router +
+    backend processes, their addresses, and the artifact paths."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.router = None          # subprocess.Popen
+        self.router_address = None
+        self.backends: list = []    # subprocess.Popen, 1-based ids
+        self.backend_addresses: list[str] = []
+        self.logs: list[str] = []
+
+
+def _wait_ready(ready_file: str, proc, deadline: float, what: str) -> dict:
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"podrun fabric: {what} exited rc={proc.returncode} "
+                "before becoming ready")
+        try:
+            with open(ready_file, encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            time.sleep(0.05)
+    raise RuntimeError(f"podrun fabric: {what} not ready in time")
+
+
+def start_fabric(base: str, n_backends: int = 2, timeout: float = 90.0,
+                 env: dict | None = None, backend_env: dict | None = None,
+                 router_env: dict | None = None,
+                 obs_logs: bool = True) -> FabricHandle:
+    """Spawn the local serving fabric: ``n_backends`` ``vctpu serve
+    --fabric-backend`` daemons on ephemeral ports, then one ``vctpu
+    serve --fabric`` router registered over them. Artifacts hang off
+    ``base``: ``.backendH.{ready,status,podlog}``, ``.router.*``, and
+    the obs sibling shape (router ``<base>.obs.jsonl``, backend H
+    ``<base>.obs.jsonl.backendH``) ``vctpu obs`` merges. Raises
+    RuntimeError (fleet torn down) if any tier fails to come up."""
+    env = dict(os.environ if env is None else env)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    h = FabricHandle(base)
+    try:
+        readies = []
+        for i in range(1, n_backends + 1):
+            ready = f"{base}.backend{i}.ready"
+            for stale in (ready, f"{base}.backend{i}.status"):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+            cmd = [sys.executable, "-m", "variantcalling_tpu", "serve",
+                   "--fabric-backend", "--port", "0", "--backend", "cpu",
+                   "--ready-file", ready,
+                   "--status-file", f"{base}.backend{i}.status"]
+            if obs_logs:
+                cmd += ["--obs-log", f"{base}.obs.jsonl.backend{i}"]
+            log = f"{base}.backend{i}.podlog"
+            h.logs.append(log)
+            fh = open(log, "wb")
+            h.backends.append(subprocess.Popen(  # noqa: S603  # vctpu-lint: disable=VCT005 — stop_fabric waits under its own bound
+                cmd, env=dict(env, **(backend_env or {})), cwd=REPO,
+                stdout=fh, stderr=subprocess.STDOUT))
+            fh.close()
+            readies.append(ready)
+        deadline = time.monotonic() + timeout
+        h.backend_addresses = [
+            _wait_ready(r, p, deadline, f"backend {i + 1}")["address"]
+            for i, (r, p) in enumerate(zip(readies, h.backends))]
+
+        ready = f"{base}.router.ready"
+        for stale in (ready, f"{base}.router.status"):
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+        cmd = [sys.executable, "-m", "variantcalling_tpu", "serve",
+               "--fabric", "--port", "0",
+               "--backends", ",".join(h.backend_addresses),
+               "--ready-file", ready,
+               "--status-file", f"{base}.router.status"]
+        if obs_logs:
+            cmd += ["--obs-log", f"{base}.obs.jsonl"]
+        log = f"{base}.router.podlog"
+        h.logs.append(log)
+        fh = open(log, "wb")
+        h.router = subprocess.Popen(  # noqa: S603  # vctpu-lint: disable=VCT005 — stop_fabric waits under its own bound
+            cmd, env=dict(env, **(router_env or {})), cwd=REPO,
+            stdout=fh, stderr=subprocess.STDOUT)
+        fh.close()
+        h.router_address = _wait_ready(
+            ready, h.router, time.monotonic() + timeout,
+            "router")["address"]
+    except Exception:
+        stop_fabric(h)
+        raise
+    return h
+
+
+def stop_fabric(h: FabricHandle, timeout: float = 45.0) -> dict:
+    """Drain the fleet router-first (SIGTERM = graceful drain, exit 0)
+    and collect each tier's shutdown report: ``{"router": {...},
+    "backends": {id: {...}}}`` with rc + the ``--status-file`` doc
+    (leaked-thread sentinel included) when one was written."""
+    report: dict = {"router": None, "backends": {}}
+
+    def stop_one(proc, status_file, what):
+        if proc is None:
+            return None
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=timeout)
+        doc = {"rc": proc.returncode}
+        try:
+            with open(status_file, encoding="utf-8") as fh:
+                doc.update(json.load(fh))
+        except (OSError, ValueError):
+            pass
+        return doc
+
+    report["router"] = stop_one(h.router, f"{h.base}.router.status",
+                                "router")
+    for i, p in enumerate(h.backends, start=1):
+        report["backends"][i] = stop_one(p, f"{h.base}.backend{i}.status",
+                                         f"backend {i}")
+    return report
+
+
+def _run_fabric(args) -> int:
+    import signal
+
+    base = args.base or "fabric"
+    try:
+        h = start_fabric(base, n_backends=args.ranks, timeout=args.timeout)
+    except RuntimeError as e:
+        print(str(e), file=sys.stderr)
+        return EXIT_USAGE
+    _dump_state(base, {
+        "mode": "fabric", "router": {"pid": h.router.pid,
+                                     "address": h.router_address},
+        "workers": [{"backend": i, "pid": p.pid, "address": a}
+                    for i, (p, a) in enumerate(
+                        zip(h.backends, h.backend_addresses), start=1)],
+        "launcher_pid": os.getpid()})
+    print(f"podrun: fabric up — router {h.router_address} over "
+          f"{args.ranks} backends {h.backend_addresses}", flush=True)
+
+    stop = {"sig": None}
+
+    def _sig(signum, frame):
+        stop["sig"] = signum
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while stop["sig"] is None:
+            if h.router.poll() is not None:
+                print("podrun: router exited "
+                      f"rc={h.router.returncode}", file=sys.stderr)
+                break
+            time.sleep(0.2)
+    finally:
+        report = stop_fabric(h)
+        try:
+            os.remove(state_path(base))
+        except OSError:
+            pass
+    leaked = [w for w, doc in [("router", report["router"])]
+              + [(f"backend{i}", d) for i, d in report["backends"].items()]
+              if doc and doc.get("leaked")]
+    if leaked:
+        print(f"podrun: fabric drain leaked threads in {leaked}",
+              file=sys.stderr)
+        return 1
+    print("podrun: fabric drained", flush=True)
+    return 0
 
 
 def _parse_worker_env(specs: list[str]) -> dict[int, list[tuple[str, str]]]:
@@ -161,10 +352,23 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--chaos", choices=("steal_race", "join_during_merge"),
                     default=None,
                     help="elastic fault injection for the chaos harness")
+    ap.add_argument("--fabric", action="store_true",
+                    help="serving-fabric mode: spawn --ranks backend "
+                         "daemons + 1 router and stay resident until "
+                         "SIGTERM (docs/serving_fabric.md)")
+    ap.add_argument("--base", default=None,
+                    help="fabric: artifact base path (ready/status/obs/"
+                         "log files hang off it; default ./fabric)")
     args = ap.parse_args(argv)
     if args.ranks <= 0:
         print("podrun: --ranks must be positive", file=sys.stderr)
         return EXIT_USAGE
+    if args.fabric:
+        if fwd:
+            print("podrun: --fabric takes no forwarded CLI arguments "
+                  "(clients bring the requests)", file=sys.stderr)
+            return EXIT_USAGE
+        return _run_fabric(args)
     if not fwd:
         print("podrun: pass the filter CLI arguments after `--`",
               file=sys.stderr)

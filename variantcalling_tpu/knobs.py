@@ -257,6 +257,37 @@ REGISTRY: dict[str, Knob] = {k.name: k for k in (
        "graceful-drain budget on SIGTERM/SIGINT: finish in-flight "
        "requests up to this many seconds while refusing new work, then "
        "exit", minimum=0.0),
+    # -- vctpu serve --fabric — the scatter-gather router tier
+    #    (docs/serving_fabric.md) ---------------------------------------
+    _k("VCTPU_FABRIC_BACKENDS", "str", "",
+       "comma-separated backend daemon addresses the router registers "
+       "at startup (http://host:port, or a filesystem path for "
+       "AF_UNIX); each must be a `vctpu serve --fabric-backend` daemon"),
+    _k("VCTPU_FABRIC_HEARTBEAT_S", "float", 2.0,
+       "router heartbeat period in seconds: each beat polls every "
+       "registered backend's /v1/status (rolling-SLO series) and "
+       "/v1/metrics (prom text, cpu-ledger series included when the "
+       "backend samples them)", minimum=0.05),
+    _k("VCTPU_FABRIC_DEAD_AFTER", "int", 3,
+       "consecutive failed heartbeats before the router marks a backend "
+       "dead (stops placing spans on it; membership event emitted)",
+       positive=True),
+    _k("VCTPU_FABRIC_QUOTA", "int", 4,
+       "per-principal concurrent-request quota at the front door; "
+       "arrivals beyond it get 429 with Retry-After (bearer tokens map "
+       "requests to principals — VCTPU_FABRIC_TOKENS)", positive=True),
+    _k("VCTPU_FABRIC_TOKENS", "str", "",
+       "bearer-token auth table for the front door: "
+       "'token:principal,token2:principal2'; empty string disables auth "
+       "(every request is the 'anonymous' principal)"),
+    _k("VCTPU_FABRIC_STREAM_CHUNK_BYTES", "int", 1 << 20,
+       "chunked-transfer frame size for fabric body streaming (request "
+       "upload spooling and response download)", positive=True),
+    _k("VCTPU_FABRIC_SPAN_ATTEMPTS", "int", 2,
+       "placement attempts per span before the whole request fails with "
+       "a distinct backend_lost status (each re-span bumps the lease "
+       "generation and lands on a different live backend)",
+       positive=True),
     # -- diagnostics / test harness ------------------------------------
     _k("VCTPU_OBS", "bool", False,
        "record run telemetry (manifest + metrics + event log) to an obs "

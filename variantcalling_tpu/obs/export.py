@@ -123,16 +123,31 @@ def read_run(path: str) -> list[dict]:
     """
     siblings = _numbered_siblings(path, "rank")
     events = read_log(path)
-    if not siblings:
+    if siblings:
+        merged: list[dict] = []
+        for rank, rank_path in [(0, path)] + sorted(siblings):
+            rank_events = events if rank == 0 else read_log(rank_path)
+            for e in rank_events:
+                e = dict(e, rank=rank)
+                e["pid"] = rank  # rank as Perfetto pid: one track per rank
+                merged.append(e)
+        merged.sort(key=lambda e: (e.get("ts", 0), e.get("rank", 0),
+                                   e.get("seq", 0)))
+        return merged
+    # the serving fabric's spelling of the same shape: the router's log
+    # is the base path, backend H wrote <path>.backendH next to it
+    # (tools/podrun --fabric) — merge the tiers into one timeline, the
+    # backend id as the Perfetto pid (0 = the router's track)
+    backends = _numbered_siblings(path, "backend")
+    if not backends:
         return events
-    merged: list[dict] = []
-    for rank, rank_path in [(0, path)] + sorted(siblings):
-        rank_events = events if rank == 0 else read_log(rank_path)
-        for e in rank_events:
-            e = dict(e, rank=rank)
-            e["pid"] = rank  # rank as Perfetto pid: one track per rank
+    merged = []
+    for n, b_path in [(0, path)] + sorted(backends):
+        for e in (events if n == 0 else read_log(b_path)):
+            e = dict(e, backend=n)
+            e["pid"] = n
             merged.append(e)
-    merged.sort(key=lambda e: (e.get("ts", 0), e.get("rank", 0),
+    merged.sort(key=lambda e: (e.get("ts", 0), e.get("backend", 0),
                                e.get("seq", 0)))
     return merged
 
@@ -161,10 +176,16 @@ def to_chrome_trace(events: list[dict]) -> dict:
         if key not in threads or (name and threads[key] == "thread"):
             threads[key] = name or "thread"
     ranked = any("rank" in e for e in events)
+    fabric = not ranked and any("backend" in e for e in events)
     for pid in sorted(pids):
         # rank-merged timelines use the rank AS the pid (read_run), so
-        # the process track is labeled by rank
-        name = f"{tool} (rank {pid})" if ranked else tool
+        # the process track is labeled by rank; fabric-merged timelines
+        # use the backend id (0 = the router tier)
+        if fabric:
+            name = f"{tool} (router)" if pid == 0 \
+                else f"{tool} (backend {pid})"
+        else:
+            name = f"{tool} (rank {pid})" if ranked else tool
         trace.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
                       "ts": 0, "args": {"name": name}})
     for (pid, tid), name in sorted(threads.items()):
@@ -303,9 +324,10 @@ def summarize(events: list[dict]) -> dict:
     heartbeats = [e for e in events if e.get("kind") == "heartbeat"]
     # multi-rank merged timelines (read_run): each rank reported its own
     # progress — total records is the SUM of every rank's last heartbeat
+    # (fabric-merged timelines spell the reporter "backend")
     last_hb_by_rank: dict = {}
     for e in heartbeats:
-        last_hb_by_rank[e.get("rank", 0)] = e
+        last_hb_by_rank[(e.get("rank", 0), e.get("backend", 0))] = e
     records = sum(e.get("records", 0) for e in last_hb_by_rank.values()) \
         if last_hb_by_rank else None
     ranks = sorted({e.get("rank", 0) for e in events})

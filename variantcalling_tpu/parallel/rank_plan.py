@@ -212,6 +212,77 @@ def discover_ranks(out_path: str) -> int | None:
     return ns.pop()
 
 
+def contig_spans(path: str, n: int, header_end: int | None = None,
+                 total: int | None = None,
+                 slack: float = 0.2) -> list[tuple[int, int]]:
+    """Contig-aware span plan over the record region of a PLAIN-text
+    VCF: cut at ~equal byte targets advanced to the next line start
+    (the rank-partition rule), then — when a contig boundary lies
+    within ``slack`` of the span size past the cut — snap the cut to
+    that boundary, so a contig's records land on ONE worker and its
+    reference-genome cache stays hot (the serving-fabric placement
+    rule, docs/serving_fabric.md). The snap only ever moves a cut
+    forward to another line start, so the spans still tile the record
+    region exactly and the concatenation of span outputs remains the
+    serial record stream whatever the snaps did."""
+    if header_end is None or total is None:
+        from variantcalling_tpu.io import vcf as vcf_mod
+
+        header_end, total = vcf_mod.scan_record_region(path)
+    body = total - header_end
+    if body <= 0 or n <= 1:
+        return [(header_end, total)]
+    n = min(n, body)
+    budget = max(1, int(body / n * slack))
+    cuts: list[int] = []
+    with open(path, "rb") as fh:
+        for i in range(1, n):
+            cut = _line_start(fh, header_end + (body * i) // n, total)
+            cuts.append(_snap_to_contig(fh, cut, total, budget))
+    edges = [header_end] + sorted(set(cuts)) + [total]
+    return [(lo, hi) for lo, hi in zip(edges, edges[1:]) if hi > lo]
+
+
+def _line_start(fh, target: int, total: int) -> int:
+    """Advance ``target`` to the next line start at or after it (the
+    VcfChunkReader rank_span rule: a cut never tears a record)."""
+    if target <= 0:
+        return 0
+    fh.seek(target - 1)
+    off = target - 1
+    while off < total:
+        block = fh.read(min(1 << 16, total - off))
+        if not block:
+            break
+        nl = block.find(b"\n")
+        if nl >= 0:
+            return min(off + nl + 1, total)
+        off += len(block)
+    return total
+
+
+def _snap_to_contig(fh, cut: int, total: int, budget: int) -> int:
+    """Move a line-start cut forward to the first contig change within
+    ``budget`` bytes; keep the plain cut when the contig runs past the
+    budget (locality is best effort, balance is not negotiable)."""
+    fh.seek(cut)
+    scanned = 0
+    first_contig = None
+    pos = cut
+    while pos < total and scanned <= budget:
+        line = fh.readline()
+        if not line:
+            break
+        contig = line.split(b"\t", 1)[0]
+        if first_contig is None:
+            first_contig = contig
+        elif contig != first_contig:
+            return pos  # the boundary: records of the next contig start here
+        pos += len(line)
+        scanned += len(line)
+    return cut
+
+
 def segment_identity(args, plan: RankPlan,
                      engine_name: str | None = None) -> dict:
     """The identity a completed segment is valid FOR: input + model +

@@ -1,0 +1,168 @@
+"""The fabric backend: ``vctpu serve --fabric-backend``.
+
+One resident per-host daemon of the serving fabric
+(docs/serving_fabric.md): everything the plain daemon is — warm
+model/genome caches, warm chunk-cache index (``resident_mode``),
+persistent XLA compile cache, admission, per-request isolation, no
+per-request jax startup — plus the span-segment endpoint the router
+fans filter requests out to:
+
+- ``POST /v1/segment`` — a STREAMING endpoint (``serve/transport``):
+  the router uploads ``header + its span's slice`` of the request's
+  record region as a standalone VCF body (chunked), the backend runs
+  the unchanged filter pipeline on it under the request's scoped
+  knobs/faults/deadline, and streams the finished segment bytes back
+  (chunked) with the run stats in the ``X-Vctpu-Stats`` header. The
+  slice is a complete single-rank input, so the segment carries the
+  same header bytes every sibling span carries and the router's
+  response-path seam merge (``rank_plan.splice_segments``) can verify
+  and splice them into the exact serial record stream.
+
+Heartbeats are PULL: the router polls ``GET /v1/status`` (the rolling
+per-endpoint SLO series — ``segment`` included, it is a first-class
+admission endpoint here) and ``GET /v1/metrics`` (Prometheus text;
+cpu-ledger series ride along when the backend samples them). The
+status payload labels itself ``"role": "backend"`` so operators can
+tell the tiers apart in one glance.
+
+Failure matrix: a request-level failure (poison span, watchdog abort,
+cancelled deadline) is THIS segment request's error response — the
+backend, its warmed state and concurrent segments are untouched (the
+Server isolation boundary). Host death is the router's problem: its
+heartbeat marks the backend dead and re-spans in-flight work onto live
+backends (``docs/serving_fabric.md`` failure matrix).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+
+from variantcalling_tpu import logger
+from variantcalling_tpu.serve import transport
+from variantcalling_tpu.serve.daemon import RequestError, Server
+
+
+def segment_stats(path: str) -> dict:
+    """The per-segment run stats the router records into the segment's
+    ``.done`` marker: record count + PASS count from the finished
+    bytes themselves (the one source both tiers can agree on without a
+    side channel)."""
+    n = n_pass = 0
+    with open(path, "rb") as fh:
+        for line in fh:
+            if line.startswith(b"#"):
+                continue
+            n += 1
+            cols = line.split(b"\t", 8)
+            if len(cols) > 6 and cols[6] == b"PASS":
+                n_pass += 1
+    return {"n": n, "n_pass": n_pass}
+
+
+class Backend(Server):
+    """The per-host rank daemon of the fabric (see module docstring)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        #: local spool for streamed-in slices and their finished
+        #: segments; swept per request and at drain
+        self._spool = tempfile.mkdtemp(prefix="vctpu-backend-")
+
+    # -- the segment pipeline endpoint (JSON half) --------------------------
+
+    def _do_segment(self, body: dict, req: str):
+        """The pipeline half of a span-segment request: exactly the
+        filter endpoint (same ``run_loaded``, same resident caches)
+        against the spooled slice, plus the stats scan of the finished
+        bytes. Runs inside ``execute``'s admission + isolation
+        envelope like every other pipeline endpoint."""
+        code, payload = self._do_filter(body, req)
+        if code == 200:
+            payload["stats"] = segment_stats(body["output"])
+        return code, payload
+
+    # -- the streaming transport half ---------------------------------------
+
+    def _handle_segment(self, handler) -> None:
+        """Own the whole ``POST /v1/segment`` exchange: spool the
+        chunked slice upload, run the pipeline via ``execute`` (so
+        admission/shed/deadline/isolation all apply), stream the
+        finished segment back with stats in the header."""
+        try:
+            params = json.loads(
+                handler.headers.get(transport.PARAMS_HEADER) or "{}")
+            if not isinstance(params, dict):
+                raise ValueError("params header must be a JSON object")
+        except ValueError as e:
+            handler._respond(400, {"status": "bad_request",
+                                   "error": f"malformed params: {e}"})
+            return
+        tag = params.get("req") or "seg"
+        spool_in = os.path.join(self._spool, f"{tag}.in.vcf")
+        spool_out = os.path.join(self._spool, f"{tag}.out.vcf")
+        try:
+            try:
+                transport.spool_body(handler, spool_in)
+            except (ValueError, OSError) as e:
+                handler._respond(400, {"status": "bad_request",
+                                       "error": f"body upload failed: {e}"})
+                return
+            body = {"input": spool_in, "output": spool_out,
+                    "model": params.get("model"),
+                    "model_name": params.get("model_name"),
+                    "reference": params.get("reference"),
+                    "knobs": params.get("knobs"),
+                    "faults": params.get("faults")}
+            if params.get("deadline_s") is not None:
+                body["deadline_s"] = params["deadline_s"]
+            for k in ("runs_file", "blacklist", "blacklist_cg_insertions",
+                      "flow_order", "is_mutect", "annotate_intervals",
+                      "limit_to_contig", "hpol_filter_length_dist"):
+                if params.get(k) is not None:
+                    body[k] = params[k]
+            code, payload = self.execute("segment", body)
+            if code != 200:
+                handler._respond(code, payload,
+                                 retry_after_s=payload.get("retry_after_s"))
+                return
+            stats = payload.get("stats") or {}
+            try:
+                transport.send_stream(
+                    handler, 200, spool_out,
+                    {transport.STATS_HEADER: json.dumps(stats)})
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the router went away mid-download (re-span or its own
+                # death): the segment was computed and streamed as far
+                # as the socket allowed — account and move on
+                self.metrics.registry.counter("serve.disconnects").add(1)
+                logger.info("backend: peer went away mid-segment stream")
+        finally:
+            for p in (spool_in, spool_out):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            from variantcalling_tpu.io import journal as journal_mod
+
+            try:
+                journal_mod.discard(spool_out)
+            except OSError:
+                pass
+
+    # -- introspection ------------------------------------------------------
+
+    def status_payload(self) -> dict:
+        payload = super().status_payload()
+        payload["role"] = "backend"
+        return payload
+
+    def drain(self, reason: str = "sigterm") -> None:
+        super().drain(reason)
+        shutil.rmtree(self._spool, ignore_errors=True)
+
+
+Backend.ENDPOINTS = dict(Server.ENDPOINTS, segment=Backend._do_segment)
+Backend.STREAM_ROUTES = {"/v1/segment": "_handle_segment"}

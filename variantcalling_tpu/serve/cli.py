@@ -52,6 +52,18 @@ def get_parser() -> argparse.ArgumentParser:
                     help="force an obs run stream at this path")
     ap.add_argument("--backend", default="cpu", choices=["tpu", "cpu"],
                     help="execution backend (serve pins it at startup)")
+    ap.add_argument("--fabric", action="store_true",
+                    help="run the fabric ROUTER tier: the scatter-gather "
+                         "front door over the backends named by "
+                         "VCTPU_FABRIC_BACKENDS / --backends "
+                         "(docs/serving_fabric.md); never touches jax")
+    ap.add_argument("--fabric-backend", action="store_true",
+                    help="run a fabric BACKEND: the resident daemon plus "
+                         "the streaming /v1/segment endpoint the router "
+                         "fans spans out to")
+    ap.add_argument("--backends", default=None,
+                    help="router only: comma-separated backend addresses "
+                         "(default VCTPU_FABRIC_BACKENDS)")
     return ap
 
 
@@ -71,21 +83,39 @@ def _leaked_threads() -> list[str]:
 
 def run(argv: list[str]) -> int:
     args = get_parser().parse_args(argv)
-    import jax
-
     from variantcalling_tpu import knobs, logger
     from variantcalling_tpu.engine import EngineError
-    from variantcalling_tpu.serve.daemon import Server
 
-    if args.backend == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    if args.fabric and args.fabric_backend:
+        logger.error("--fabric and --fabric-backend are different tiers; "
+                     "pick one")
+        return 2
     try:
         knobs.validate_all()
     except EngineError as e:
         logger.error("%s", e)
         return 2
-    server = Server(host=args.host, port=args.port,
-                    socket_path=args.socket, obs_log=args.obs_log)
+    if args.fabric:
+        # the router tier is pure placement + transport + splice: no
+        # pipeline, no jax — cheap to restart, cheap to front-load
+        from variantcalling_tpu.serve.router import Router
+
+        server = Router(host=args.host, port=args.port,
+                        socket_path=args.socket, obs_log=args.obs_log,
+                        backends=[a.strip() for a in args.backends.split(",")
+                                  if a.strip()]
+                        if args.backends is not None else None)
+    else:
+        import jax
+
+        if args.backend == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        if args.fabric_backend:
+            from variantcalling_tpu.serve.backend import Backend as _Cls
+        else:
+            from variantcalling_tpu.serve.daemon import Server as _Cls
+        server = _Cls(host=args.host, port=args.port,
+                      socket_path=args.socket, obs_log=args.obs_log)
     # graceful drain on SIGTERM/SIGINT: refuse new work, finish
     # in-flight, flush obs with status "drain", exit 0 — installed
     # BEFORE start() so obs's own flush handlers (which only bind to

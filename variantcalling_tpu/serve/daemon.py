@@ -52,7 +52,8 @@ from variantcalling_tpu.utils import cancellation, faults
 #: (VCTPU_FAULTS is env-armed at import time, so a scoped override would
 #: be silently inert — the request-level channel is the 'faults' field)
 _UNSCOPABLE = frozenset(n for n in knobs.REGISTRY
-                        if n.startswith(("VCTPU_SERVE_", "VCTPU_OBS"))) \
+                        if n.startswith(("VCTPU_SERVE_", "VCTPU_FABRIC_",
+                                         "VCTPU_OBS"))) \
     | {"VCTPU_FAULTS"}
 
 #: request fields accepted by the filter/score endpoints beyond the
@@ -94,6 +95,15 @@ def _filter_namespace(body: dict, output_file: str | None) -> argparse.Namespace
 
 class Server:
     """One resident daemon: warmed state + admission + HTTP front."""
+
+    #: endpoint name -> unbound handler; subclasses (the fabric backend)
+    #: extend with ``dict(Server.ENDPOINTS, ...)`` — bound at the bottom
+    #: of this module once the methods exist
+    ENDPOINTS: dict = {}
+    #: path -> method name for endpoints that own their transport
+    #: (streamed bodies instead of the JSON round trip); checked before
+    #: the JSON routes
+    STREAM_ROUTES: dict = {}
 
     def __init__(self, host: str | None = None, port: int | None = None,
                  socket_path: str | None = None,
@@ -341,7 +351,7 @@ class Server:
                 # is THIS request's configuration error (exit-2 moral
                 # equivalent), never a daemon fault
                 knobs.validate_all()
-                handler = _ENDPOINTS[endpoint]
+                handler = self.ENDPOINTS[endpoint]
                 return handler(self, body, req)
         except RequestError as e:
             return 400, {"status": "bad_request", "error": str(e)}
@@ -459,7 +469,7 @@ class Server:
 
     def status_payload(self) -> dict:
         per_endpoint = {}
-        for ep in sorted(_ENDPOINTS):
+        for ep in sorted(self.ENDPOINTS):
             p50, p99 = self.metrics.rolling_p50(ep), self.metrics.rolling_p99(ep)
             if p50 is not None or p99 is not None:
                 per_endpoint[ep] = {
@@ -506,6 +516,7 @@ _ENDPOINTS = {
     "coverage": Server._do_coverage,
     "warm": Server._do_warm,
 }
+Server.ENDPOINTS = _ENDPOINTS
 
 
 # -- transport --------------------------------------------------------------
@@ -556,7 +567,7 @@ def _make_handler(server: Server):
         #: handler thread instead of pinning it forever
         timeout = 60
         #: argparse-free routing table: path -> endpoint name
-        _POST_ROUTES = {f"/v1/{name}": name for name in _ENDPOINTS}
+        _POST_ROUTES = {f"/v1/{name}": name for name in server.ENDPOINTS}
 
         def log_message(self, fmt, *args):  # quiet: obs carries the events
             logger.debug("serve http: " + fmt, *args)
@@ -615,6 +626,21 @@ def _make_handler(server: Server):
                                     "error": f"unknown path {self.path}"})
 
         def do_POST(self):
+            stream = server.STREAM_ROUTES.get(self.path)
+            if stream is not None:
+                # a streaming endpoint owns its whole transport exchange
+                # (chunked upload in, chunked artifact out) — same
+                # belt-and-braces rule: a serve-layer bug still answers
+                try:
+                    getattr(server, stream)(self)
+                except BaseException as e:  # noqa: BLE001  # vctpu-lint: disable=VCT002 — transport-level last resort: reported to the client as a 500, logged; never silent
+                    logger.warning("serve: internal error handling %s: "
+                                   "%s: %s", self.path,
+                                   type(e).__name__, e)
+                    self._respond(500, {"status": "error",
+                                        "kind": type(e).__name__,
+                                        "error": str(e)[:2000]})
+                return
             endpoint = self._POST_ROUTES.get(self.path)
             if endpoint is None:
                 self._respond(404, {"status": "not_found",
